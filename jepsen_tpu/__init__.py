@@ -1,0 +1,30 @@
+"""jepsen_tpu: a TPU-native distributed-systems consistency-testing framework.
+
+A test drives generator-scheduled client operations and injected faults
+against a real (or fake, in-process) distributed system, records every
+invocation/completion into a history, and verifies that history against
+consistency models.  The analysis plane is TPU-first: histories are encoded
+as integer op tensors and linearizability / transactional-anomaly checking
+runs as jit-compiled JAX kernels, vmapped over batches of independent
+histories and sharded across a device mesh (falling back to a pure-Python
+oracle when no accelerator is present).
+
+Capability map (reference: remysaissy/jepsen, studied in SURVEY.md):
+
+- ``jepsen_tpu.history``    — op/history data model (knossos.op equivalent)
+- ``jepsen_tpu.models``     — consistency models (knossos.model equivalent)
+- ``jepsen_tpu.checker``    — Checker protocol + built-in checkers
+- ``jepsen_tpu.ops``        — TPU kernels: encode, step kernels, WGL search
+- ``jepsen_tpu.parallel``   — mesh/sharding helpers for batched checking
+- ``jepsen_tpu.generator``  — pure-functional op scheduling DSL
+- ``jepsen_tpu.interpreter``— threaded event loop building histories
+- ``jepsen_tpu.client``     — Client protocol
+- ``jepsen_tpu.nemesis``    — fault injection
+- ``jepsen_tpu.control``    — remote execution (ssh/docker/k8s/dummy)
+- ``jepsen_tpu.db``         — database lifecycle protocols
+- ``jepsen_tpu.store``      — test persistence
+- ``jepsen_tpu.cli``        — command-line entry points
+- ``jepsen_tpu.elle``       — transactional anomaly (cycle) checking
+"""
+
+__version__ = "0.1.0"

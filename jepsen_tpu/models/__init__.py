@@ -1,0 +1,300 @@
+"""Consistency models: pure state machines checked against histories.
+
+Equivalent of the external knossos.model namespace the reference consumes
+at jepsen/src/jepsen/checker.clj:19-26 (models: register, cas-register,
+mutex, fifo-queue, unordered-queue) and jepsen/src/jepsen/tests/causal.clj's
+local Model protocol (causal.clj:12-33).
+
+A model is an immutable value with ``step(op) -> Model``; an invalid
+transition returns an :class:`Inconsistent` model.  Models must be hashable
+and comparable so searches can deduplicate configurations.
+
+Every model here has a matching branchless TPU step kernel in
+``jepsen_tpu.ops.step_kernels``; this module is the oracle the kernels are
+differentially tested against.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+
+class Model:
+    """Base class. Subclasses implement step(op) returning a new model."""
+
+    def step(self, op) -> "Model":  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def is_inconsistent(self) -> bool:
+        return False
+
+
+class Inconsistent(Model):
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def step(self, op) -> "Model":
+        return self
+
+    @property
+    def is_inconsistent(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Inconsistent)
+
+    def __hash__(self):
+        return hash("inconsistent")
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+class Register(Model):
+    """A read/write register.  fs: "write" (value v), "read" (observed v;
+    a read of None — unknown value — always passes)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+    def step(self, op) -> Model:
+        if op.f == "write":
+            return Register(op.value)
+        elif op.f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(f"read {op.value!r}, expected {self.value!r}")
+        return inconsistent(f"unknown op f={op.f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, Register) and other.value == self.value
+
+    def __hash__(self):
+        return hash(("register", self.value))
+
+    def __repr__(self):
+        return f"Register({self.value!r})"
+
+
+class CASRegister(Model):
+    """A register with read / write / compare-and-set.
+
+    fs: "read" (observed v), "write" (v), "cas" ((old, new)).
+    """
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+    def step(self, op) -> Model:
+        f = op.f
+        if f == "write":
+            return CASRegister(op.value)
+        elif f == "cas":
+            if op.value is None:
+                return inconsistent("cas with nil value")
+            old, new = op.value
+            if old == self.value:
+                return CASRegister(new)
+            return inconsistent(f"cas expected {old!r}, had {self.value!r}")
+        elif f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(f"read {op.value!r}, expected {self.value!r}")
+        return inconsistent(f"unknown op f={f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, CASRegister) and other.value == self.value
+
+    def __hash__(self):
+        return hash(("cas-register", self.value))
+
+    def __repr__(self):
+        return f"CASRegister({self.value!r})"
+
+
+class Mutex(Model):
+    """A lock. fs: "acquire", "release"."""
+
+    __slots__ = ("locked",)
+
+    def __init__(self, locked: bool = False):
+        self.locked = locked
+
+    def step(self, op) -> Model:
+        if op.f == "acquire":
+            if self.locked:
+                return inconsistent("cannot acquire a held lock")
+            return Mutex(True)
+        elif op.f == "release":
+            if not self.locked:
+                return inconsistent("cannot release a free lock")
+            return Mutex(False)
+        return inconsistent(f"unknown op f={op.f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, Mutex) and other.locked == self.locked
+
+    def __hash__(self):
+        return hash(("mutex", self.locked))
+
+    def __repr__(self):
+        return f"Mutex({'locked' if self.locked else 'free'})"
+
+
+class MultiRegister(Model):
+    """A map of independent registers; op value is [(f, k, v), ...] mops."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: Any = None):
+        self.values = frozenset((values or {}).items()) if isinstance(values, dict) else (values or frozenset())
+
+    def _as_dict(self):
+        return dict(self.values)
+
+    def step(self, op) -> Model:
+        vals = self._as_dict()
+        for f, k, v in op.value or []:
+            if f in ("w", "write"):
+                vals[k] = v
+            elif f in ("r", "read"):
+                if v is not None and vals.get(k) != v:
+                    return inconsistent(f"read {v!r} of {k!r}, expected {vals.get(k)!r}")
+            else:
+                return inconsistent(f"unknown mop f={f!r}")
+        return MultiRegister(vals)
+
+    def __eq__(self, other):
+        return isinstance(other, MultiRegister) and other.values == self.values
+
+    def __hash__(self):
+        return hash(("multi-register", self.values))
+
+    def __repr__(self):
+        return f"MultiRegister({dict(self.values)!r})"
+
+
+class FIFOQueue(Model):
+    """A FIFO queue. fs: "enqueue" (v), "dequeue" (observed v)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Tuple = ()):
+        self.items = tuple(items)
+
+    def step(self, op) -> Model:
+        if op.f == "enqueue":
+            return FIFOQueue(self.items + (op.value,))
+        elif op.f == "dequeue":
+            if not self.items:
+                return inconsistent("dequeue from empty queue")
+            head, rest = self.items[0], self.items[1:]
+            if op.value is not None and op.value != head:
+                return inconsistent(f"dequeued {op.value!r}, expected {head!r}")
+            return FIFOQueue(rest)
+        return inconsistent(f"unknown op f={op.f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, FIFOQueue) and other.items == self.items
+
+    def __hash__(self):
+        return hash(("fifo-queue", self.items))
+
+    def __repr__(self):
+        return f"FIFOQueue({list(self.items)!r})"
+
+
+class UnorderedQueue(Model):
+    """A bag: enqueue/dequeue with no ordering constraint."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items=frozenset()):
+        # multiset as frozenset of (value, count)
+        if isinstance(items, frozenset):
+            self.items = items
+        else:
+            counts: dict = {}
+            for x in items:
+                counts[x] = counts.get(x, 0) + 1
+            self.items = frozenset(counts.items())
+
+    def _counts(self):
+        return dict(self.items)
+
+    def step(self, op) -> Model:
+        counts = self._counts()
+        if op.f == "enqueue":
+            counts[op.value] = counts.get(op.value, 0) + 1
+            return UnorderedQueue(frozenset(counts.items()))
+        elif op.f == "dequeue":
+            v = op.value
+            if v is None:
+                return inconsistent("dequeue with unknown value")
+            if counts.get(v, 0) <= 0:
+                return inconsistent(f"dequeued {v!r} not in queue")
+            counts[v] -= 1
+            if counts[v] == 0:
+                del counts[v]
+            return UnorderedQueue(frozenset(counts.items()))
+        return inconsistent(f"unknown op f={op.f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, UnorderedQueue) and other.items == self.items
+
+    def __hash__(self):
+        return hash(("unordered-queue", self.items))
+
+    def __repr__(self):
+        return f"UnorderedQueue({dict(self.items)!r})"
+
+
+class NoOp(Model):
+    """A model that accepts everything."""
+
+    def step(self, op) -> Model:
+        return self
+
+    def __eq__(self, other):
+        return isinstance(other, NoOp)
+
+    def __hash__(self):
+        return hash("noop-model")
+
+    def __repr__(self):
+        return "NoOp()"
+
+
+def register(value: Any = None) -> Register:
+    return Register(value)
+
+
+def cas_register(value: Any = None) -> CASRegister:
+    return CASRegister(value)
+
+
+def mutex() -> Mutex:
+    return Mutex()
+
+
+def multi_register(values: Any = None) -> MultiRegister:
+    return MultiRegister(values)
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue()
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue()

@@ -1,0 +1,119 @@
+"""History/op data model tests (reference test style:
+jepsen/test/jepsen/checker_test.clj builds literal histories)."""
+
+from jepsen_tpu.history import (
+    History,
+    Op,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+    strip_indeterminate_reads,
+)
+
+
+def h(*ops) -> History:
+    return History(ops).index_ops()
+
+
+def test_index_ops():
+    hist = h(invoke_op(0, "read"), ok_op(0, "read", 1))
+    assert [op.index for op in hist] == [0, 1]
+
+
+def test_pairing():
+    hist = h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(0, "write", 1),
+        ok_op(1, "read", 1),
+    )
+    assert hist.pair_index() == [2, 3, 0, 1]
+    pairs = list(hist.pairs())
+    assert pairs[0][0].process == 0 and pairs[0][1].type == "ok"
+    assert pairs[1][0].process == 1 and pairs[1][1].value == 1
+
+
+def test_unpaired_invoke():
+    hist = h(invoke_op(0, "write", 1))
+    assert hist.pair_index() == [-1]
+    assert list(hist.pairs())[0][1] is None
+
+
+def test_complete_propagates_read_values():
+    hist = h(invoke_op(0, "read"), ok_op(0, "read", 42))
+    c = hist.complete()
+    assert c[0].value == 42
+
+
+def test_complete_fills_completion_from_invoke():
+    # a write acked without echoing the value: invoke keeps 7, ok inherits it
+    hist = h(invoke_op(0, "write", 7), ok_op(0, "write"))
+    c = hist.complete()
+    assert c[0].value == 7
+    assert c[1].value == 7
+
+
+def test_without_failures():
+    hist = h(
+        invoke_op(0, "write", 1),
+        fail_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(1, "write", 2),
+    )
+    cleaned = hist.without_failures()
+    assert len(cleaned) == 2
+    assert all(op.process == 1 for op in cleaned)
+
+
+def test_strip_indeterminate_reads():
+    hist = h(
+        invoke_op(0, "read"),
+        invoke_op(1, "write", 5),
+        info_op(0, "read"),
+        ok_op(1, "write", 5),
+    )
+    out = strip_indeterminate_reads(hist, ["read"])
+    assert len(out) == 2
+    assert all(op.f == "write" for op in out)
+
+
+def test_completion_of_unindexed_and_filtered():
+    hist = History([invoke_op(0, "read"), ok_op(0, "read", 1)])  # never indexed
+    assert hist.completion_of(hist[0]).type == "ok"
+    indexed = h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 1),
+        ok_op(0, "write", 1),
+    )
+    sub = History(op for op in indexed if op.process == 1)  # stale indices
+    assert sub.completion_of(sub[0]).value == 1
+
+
+def test_op_dict_roundtrip():
+    op = invoke_op(3, "cas", (1, 2), time=17, error="boom")
+    d = op.to_dict()
+    assert d["error"] == "boom"
+    op2 = Op.from_dict(d)
+    assert op2 == op
+
+
+def test_op_extra_access():
+    op = ok_op("nemesis", "start-partition", "majority")
+    op["grudge"] = {1: [2]}
+    assert op["grudge"] == {1: [2]}
+    assert op.get("missing", "d") == "d"
+    assert "grudge" in op
+
+
+def test_views():
+    hist = h(
+        invoke_op(0, "read"),
+        info_op("nemesis", "start"),
+        ok_op(0, "read", 1),
+    )
+    assert len(hist.client_ops()) == 2
+    assert len(hist.nemesis_ops()) == 1
+    assert len(list(hist.oks())) == 1
+    assert len(list(hist.invocations())) == 1

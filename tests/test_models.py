@@ -1,0 +1,75 @@
+"""Model state-machine tests (knossos.model oracle semantics)."""
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import invoke_op
+
+
+def op(f, value=None):
+    return invoke_op(0, f, value)
+
+
+def test_register():
+    r = m.register()
+    r = r.step(op("write", 5))
+    assert r == m.register(5)
+    assert not r.step(op("read", 5)).is_inconsistent
+    assert r.step(op("read", 6)).is_inconsistent
+    assert not r.step(op("read", None)).is_inconsistent  # unknown read passes
+
+
+def test_cas_register():
+    r = m.cas_register(0)
+    assert r.step(op("cas", (0, 5))) == m.cas_register(5)
+    assert r.step(op("cas", (1, 5))).is_inconsistent
+    assert r.step(op("write", 7)) == m.cas_register(7)
+    assert r.step(op("read", 0)) == r
+    assert r.step(op("read", 3)).is_inconsistent
+    assert r.step(op("bogus")).is_inconsistent
+
+
+def test_mutex():
+    mu = m.mutex()
+    assert mu.step(op("release")).is_inconsistent
+    locked = mu.step(op("acquire"))
+    assert locked == m.Mutex(True)
+    assert locked.step(op("acquire")).is_inconsistent
+    assert locked.step(op("release")) == m.mutex()
+
+
+def test_multi_register():
+    r = m.multi_register({})
+    r = r.step(op("txn", [("w", "x", 1), ("w", "y", 2)]))
+    assert not r.step(op("txn", [("r", "x", 1), ("r", "y", 2)])).is_inconsistent
+    assert r.step(op("txn", [("r", "x", 2)])).is_inconsistent
+    # read-your-writes inside one txn
+    assert not r.step(op("txn", [("w", "x", 9), ("r", "x", 9)])).is_inconsistent
+
+
+def test_fifo_queue():
+    q = m.fifo_queue()
+    assert q.step(op("dequeue", 1)).is_inconsistent
+    q = q.step(op("enqueue", 1)).step(op("enqueue", 2))
+    assert q.step(op("dequeue", 2)).is_inconsistent
+    q = q.step(op("dequeue", 1))
+    assert q == m.FIFOQueue((2,))
+
+
+def test_unordered_queue():
+    q = m.unordered_queue()
+    q = q.step(op("enqueue", 1)).step(op("enqueue", 2)).step(op("enqueue", 1))
+    assert not q.step(op("dequeue", 2)).is_inconsistent
+    q2 = q.step(op("dequeue", 1)).step(op("dequeue", 1))
+    assert not q2.is_inconsistent
+    assert q2.step(op("dequeue", 1)).is_inconsistent
+
+
+def test_inconsistent_absorbing():
+    bad = m.inconsistent("x")
+    assert bad.step(op("write", 1)).is_inconsistent
+    assert bad == m.inconsistent("y")  # equality ignores message
+
+
+def test_models_hashable_for_dedup():
+    assert len({m.register(1), m.register(1), m.register(2)}) == 2
+    assert len({m.Mutex(True), m.Mutex(True)}) == 1
+    assert hash(m.cas_register(3)) == hash(m.cas_register(3))

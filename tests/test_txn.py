@@ -1,0 +1,34 @@
+from jepsen_tpu import txn
+
+
+def test_ext_reads():
+    # a read before a write is external; after a write it's internal
+    t = [("r", "x", 1), ("w", "x", 2), ("r", "x", 2), ("r", "y", 3)]
+    assert txn.ext_reads(t) == {"x": 1, "y": 3}
+
+
+def test_ext_reads_after_write_ignored():
+    t = [("w", "x", 2), ("r", "x", 2)]
+    assert txn.ext_reads(t) == {}
+
+
+def test_ext_writes_last_wins():
+    t = [("w", "x", 1), ("w", "x", 2), ("w", "y", 9)]
+    assert txn.ext_writes(t) == {"x": 2, "y": 9}
+
+
+def test_ext_appends():
+    t = [("append", "x", 1), ("append", "y", 2), ("append", "x", 3)]
+    assert txn.ext_appends(t) == {"x": [1, 3], "y": [2]}
+
+
+def test_reduce_mops():
+    t = [("r", "x", 1), ("w", "y", 2)]
+    keys = txn.reduce_mops(lambda acc, mop: acc + [mop[1]], [], t)
+    assert keys == ["x", "y"]
+
+
+def test_key_views():
+    t = [("r", "x", 1), ("w", "x", 2), ("append", "x", 3)]
+    assert txn.reads_of_key(t, "x") == [1]
+    assert txn.writes_of_key(t, "x") == [2, 3]

@@ -1,0 +1,136 @@
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import util
+from jepsen_tpu.history import History, invoke_op, ok_op, info_op
+
+
+def test_majority():
+    assert util.majority(0) == 1
+    assert util.majority(1) == 1
+    assert util.majority(2) == 2
+    assert util.majority(3) == 2
+    assert util.majority(5) == 3
+
+
+def test_real_pmap_parallel_and_errors():
+    assert util.real_pmap(lambda x: x * 2, [1, 2, 3]) == [2, 4, 6]
+    with pytest.raises(ValueError):
+        util.real_pmap(lambda x: (_ for _ in ()).throw(ValueError("x")), [1])
+
+
+def test_real_pmap_runs_concurrently():
+    barrier = threading.Barrier(4, timeout=5)
+    util.real_pmap(lambda _: barrier.wait(), range(4))
+
+
+def test_bounded_pmap():
+    assert util.bounded_pmap(lambda x: x + 1, list(range(100)), limit=4) == list(
+        range(1, 101)
+    )
+
+
+def test_relative_time():
+    with util.with_relative_time():
+        t0 = util.relative_time_nanos()
+        time.sleep(0.01)
+        assert util.relative_time_nanos() > t0
+    with pytest.raises(RuntimeError):
+        util.relative_time_nanos()
+
+
+def test_timeout():
+    assert util.timeout(50, lambda: 42) == 42
+    assert util.timeout(30, lambda: time.sleep(5), default="late") == "late"
+    with pytest.raises(util.TimeoutError_):
+        util.timeout(30, lambda: time.sleep(5))
+
+
+def test_retry():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("nope")
+        return "ok"
+
+    assert util.retry(0.001, flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_integer_interval_set_str():
+    assert util.integer_interval_set_str([]) == "#{}"
+    assert util.integer_interval_set_str([1]) == "#{1}"
+    assert util.integer_interval_set_str([1, 2]) == "#{1 2}"
+    assert util.integer_interval_set_str([1, 2, 3, 5, 7, 8, 9]) == "#{1..3 5 7..9}"
+
+
+def test_random_nonempty_subset():
+    import random
+
+    rng = random.Random(0)
+    for _ in range(20):
+        s = util.random_nonempty_subset([1, 2, 3], rng)
+        assert 1 <= len(s) <= 3
+        assert set(s) <= {1, 2, 3}
+    assert util.random_nonempty_subset([]) == []
+
+
+def test_history_latencies():
+    hist = History(
+        [
+            invoke_op(0, "read", time=100),
+            ok_op(0, "read", 1, time=350),
+        ]
+    ).index_ops()
+    lats = util.history_latencies(hist)
+    assert lats[0].extra["latency"] == 250
+    assert lats[0].extra["completion_type"] == "ok"
+
+
+def test_nemesis_intervals():
+    hist = History(
+        [
+            info_op("nemesis", "start-partition", time=1),
+            info_op("nemesis", "stop-partition", time=9),
+            info_op("nemesis", "start-partition", time=12),
+        ]
+    ).index_ops()
+    ivals = util.nemesis_intervals(
+        hist, fs_start=["start-partition"], fs_stop=["stop-partition"]
+    )
+    assert len(ivals) == 2
+    assert ivals[0][0].time == 1 and ivals[0][1].time == 9
+    assert ivals[1][1] is None
+
+
+def test_timeout_returns_promptly():
+    t0 = time.monotonic()
+    assert util.timeout(30, lambda: time.sleep(3), default="late") == "late"
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_nemesis_intervals_overlapping_fault_kinds():
+    hist = History(
+        [
+            info_op("nemesis", "start-partition", time=1),
+            info_op("nemesis", "start-clock", time=2),
+            info_op("nemesis", "stop-clock", time=3),
+            info_op("nemesis", "stop-partition", time=4),
+        ]
+    ).index_ops()
+    ivals = util.nemesis_intervals(hist, fs_start=["start"], fs_stop=["stop"])
+    assert {(a.f, b.f) for a, b in ivals} == {
+        ("start-partition", "stop-partition"),
+        ("start-clock", "stop-clock"),
+    }
+
+
+def test_named_locks():
+    locks = util.NamedLocks()
+    with locks.hold("a"):
+        assert not locks.get("b").locked()
+        assert locks.get("a").locked()
