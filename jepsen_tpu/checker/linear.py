@@ -1,0 +1,176 @@
+"""Linearizability checking — CPU oracle.
+
+Event-driven just-in-time linearization (the knossos.linear / knossos.wgl
+algorithm family the reference consumes at checker.clj:199-203, here
+re-derived rather than ported):
+
+A *configuration* is ``(model-state, linearized-set)`` where the
+linearized-set holds ops that have been linearized but whose completion
+event hasn't been reached yet.  Walking the history event by event:
+
+- ``invoke i``: op i becomes *open* (callable).  No expansion yet —
+  closure is deferred to the next filtering event, which is sound because
+  closure only ever grows the config set.
+- ``ok i``: first expand the closure — repeatedly linearize any open,
+  not-yet-linearized op against every config (dropping inconsistent
+  steps) until fixpoint — then keep only configs that linearized i, and
+  remove i from their linearized-sets (it is now part of the common
+  prefix).  An empty config set here means the history is not
+  linearizable, and op i is the witness.
+- ``info i``: op i stays open forever — it may linearize at any later
+  point, or never (indeterminate ops are concurrent with everything after
+  them; reference semantics per knossos).
+- ``fail i``: op i never happened; it and its invocation are removed in
+  preprocessing.
+
+Real-time order is respected structurally: an op invoked after ``ok i``
+only enters ``open`` after configs that failed to linearize i have been
+discarded.
+
+The TPU implementation in jepsen_tpu.ops.wgl runs this same search as a
+vmapped bitset frontier expansion; this module is its differential-test
+oracle and the fallback when no accelerator is present.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, FrozenSet, Iterable, Optional, Set, Tuple
+
+from ..history import History, INVOKE, OK, FAIL, INFO, Op
+from ..models import Model
+
+#: Bound on the config-set size before we give up with :unknown.  Mirrors
+#: the reference's practice of truncating/giving-up on pathological
+#: searches (checker.clj:213-216).
+DEFAULT_MAX_CONFIGS = 100_000
+
+
+class Analysis(dict):
+    """Result dict with attribute sugar."""
+
+
+def prepare(history: History, pure_fs: Iterable[Any] = ()) -> Tuple[list, list]:
+    """Preprocess a raw history into (events, ops):
+
+    events: [(kind, op_id)] with kind ∈ {invoke, ok, info};
+    ops:    [Op] per op id, with completion values propagated onto the
+            invocation (so a read's observed value is available when the
+            op linearizes).
+
+    Failed ops are dropped entirely; indeterminate ops whose :f is in
+    pure_fs (state-preserving reads) are dropped too.
+    """
+    from ..history import strip_indeterminate_reads
+
+    h = History(op for op in history if isinstance(op.process, int))
+    h = h.complete().without_failures()
+    if pure_fs:
+        h = strip_indeterminate_reads(h, pure_fs)
+
+    events = []
+    ops: list = []
+    open_by_process: Dict[Any, int] = {}
+    for op in h:
+        if op.type == INVOKE:
+            op_id = len(ops)
+            ops.append(op)
+            open_by_process[op.process] = op_id
+            events.append((INVOKE, op_id))
+        elif op.type == OK:
+            op_id = open_by_process.pop(op.process, None)
+            if op_id is not None:
+                events.append((OK, op_id))
+        elif op.type == INFO:
+            op_id = open_by_process.pop(op.process, None)
+            if op_id is not None:
+                events.append((INFO, op_id))
+    # processes whose invoke never completed at all: same as info (open
+    # forever)
+    for op_id in open_by_process.values():
+        events.append((INFO, op_id))
+    return events, ops
+
+
+def _closure(
+    configs: Set[Tuple[Model, FrozenSet[int]]],
+    open_ops: Set[int],
+    ops: list,
+    max_configs: int,
+) -> Tuple[Set[Tuple[Model, FrozenSet[int]]], bool]:
+    """Expand configs by linearizing open ops until fixpoint.
+    Returns (configs, overflowed?)."""
+    frontier = configs
+    seen = set(configs)
+    while frontier:
+        new: Set[Tuple[Model, FrozenSet[int]]] = set()
+        for model, linset in frontier:
+            for op_id in open_ops:
+                if op_id in linset:
+                    continue
+                op = ops[op_id]
+                model2 = model.step(op)
+                if model2.is_inconsistent:
+                    continue
+                cfg = (model2, linset | {op_id})
+                if cfg not in seen:
+                    seen.add(cfg)
+                    new.add(cfg)
+                    if len(seen) > max_configs:
+                        return seen, True
+        frontier = new
+    return seen, False
+
+
+def analysis(
+    model: Model,
+    history: History,
+    pure_fs: Iterable[Any] = (),
+    max_configs: int = DEFAULT_MAX_CONFIGS,
+) -> dict:
+    """Check history against model. Returns
+    {"valid?": True|False|"unknown", ...} with a witness :op on failure
+    and sample :configs (truncated to 10, as the reference does at
+    checker.clj:213-216)."""
+    events, ops = prepare(history, pure_fs)
+
+    configs: Set[Tuple[Model, FrozenSet[int]]] = {(model, frozenset())}
+    open_ops: Set[int] = set()
+
+    for kind, op_id in events:
+        if kind == INVOKE:
+            open_ops.add(op_id)
+        elif kind == OK:
+            configs, overflow = _closure(configs, open_ops, ops, max_configs)
+            if overflow:
+                return {
+                    "valid?": "unknown",
+                    "error": f"config set exceeded {max_configs}; aborting search",
+                    "op": ops[op_id].to_dict(),
+                }
+            # keep configs that linearized op_id; promote it into the prefix
+            survivors = {
+                (m, linset - {op_id}) for (m, linset) in configs if op_id in linset
+            }
+            if not survivors:
+                return {
+                    "valid?": False,
+                    "op": ops[op_id].to_dict(),
+                    "configs": [
+                        {"model": repr(m), "pending": sorted(linset)}
+                        for m, linset in list(configs)[:10]
+                    ],
+                }
+            configs = survivors
+            open_ops.discard(op_id)
+        elif kind == INFO:
+            # stays open forever; nothing to do
+            pass
+
+    return {
+        "valid?": True,
+        "configs": [
+            {"model": repr(m), "pending": sorted(linset)}
+            for m, linset in list(configs)[:10]
+        ],
+        "op-count": len(ops),
+    }

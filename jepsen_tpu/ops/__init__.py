@@ -1,0 +1,8 @@
+"""TPU analysis kernels: history encoding, model step kernels, and the
+batched linearizability search.
+
+- ``encode``: host-side packing of histories into padded int32 tensors
+- ``step_kernels``: branchless jit-compatible model transition functions
+- ``wgl``: the vmapped bitset-frontier linearizability search
+- ``cycle``: batched transitive-closure cycle detection (Elle-style)
+"""

@@ -1,0 +1,195 @@
+"""Host-side encoding of histories into the padded int tensors the WGL
+kernel consumes.
+
+The key idea is *slot remapping*: at any moment at most ``slot_cap`` ops
+are open (invoked, not yet ok — including indeterminate ops, which stay
+open forever), so each op borrows a transient slot id and a config's
+linearized-set fits one uint32 **independent of history length**.  Slots
+free when their op completes (the completed op joins the common linearized
+prefix); info ops hold their slot to the end.
+
+Invoke and info events are no-ops for the search (closure is deferred to
+the filtering events — see jepsen_tpu.checker.linear), so the event stream
+the device sees is just the *ok* completions, each with a snapshot of the
+currently-open candidate ops:
+
+- ``ev_slot[E]``      slot of the op completing at event e (-1 = padding)
+- ``cand_slot[E,C]``  open slots at event e (-1 = unused lane)
+- ``cand_f/a/b[E,C]`` the op encodings for those slots
+
+Histories whose open-op count ever exceeds slot_cap fall back to the CPU
+oracle (reported by returning None), mirroring how the reference degrades
+to :unknown rather than guessing (checker.clj:74-85).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..history import History
+from ..checker import linear
+from .. import models as m
+from .step_kernels import ModelSpec, spec_for
+
+DEFAULT_SLOT_CAP = 32
+
+
+@dataclass
+class EncodedHistory:
+    init_state: int
+    ev_slot: np.ndarray      # [E] int32
+    cand_slot: np.ndarray    # [E, C] int32
+    cand_f: np.ndarray       # [E, C] int32
+    cand_a: np.ndarray       # [E, C] int32
+    cand_b: np.ndarray       # [E, C] int32
+    n_ops: int
+
+
+@dataclass
+class EncodedBatch:
+    """A stack of encoded histories padded to common [B, E, C] shapes."""
+
+    init_state: np.ndarray   # [B] int32
+    ev_slot: np.ndarray      # [B, E] int32
+    cand_slot: np.ndarray    # [B, E, C]
+    cand_f: np.ndarray       # [B, E, C]
+    cand_a: np.ndarray       # [B, E, C]
+    cand_b: np.ndarray       # [B, E, C]
+    #: positions of histories that could not be encoded (oracle fallback)
+    fallback: List[int] = field(default_factory=list)
+    #: original batch order index per encoded row
+    row_history: List[int] = field(default_factory=list)
+
+
+def encode_history(
+    history: History,
+    model: m.Model,
+    slot_cap: int = DEFAULT_SLOT_CAP,
+    spec: Optional[ModelSpec] = None,
+) -> Optional[EncodedHistory]:
+    """Encode one history, or None if unsupported (model has no kernel,
+    open-op count exceeds slot_cap, or an op can't be encoded)."""
+    spec = spec or spec_for(model)
+    if spec is None:
+        return None
+    events, ops = linear.prepare(history, pure_fs=spec.pure_fs)
+
+    valmap: Dict[Any, int] = {}
+    try:
+        init = spec.init_state(model, valmap)
+        enc_ops = [spec.encode_op(op, valmap) for op in ops]
+    except ValueError:
+        return None
+
+    E = sum(1 for kind, _ in events if kind == "ok")
+    C = slot_cap
+    ev_slot_arr = np.full((E,), -1, np.int32)
+    cand_slot = np.full((E, C), -1, np.int32)
+    cand_f = np.zeros((E, C), np.int32)
+    cand_a = np.zeros((E, C), np.int32)
+    cand_b = np.zeros((E, C), np.int32)
+
+    slot_of: Dict[int, int] = {}
+    free = sorted(range(slot_cap), reverse=True)  # pop() takes smallest
+    row = 0
+    for kind, op_id in events:
+        if kind == "invoke":
+            if not free:
+                return None  # too many concurrently-open ops
+            slot_of[op_id] = free.pop()
+        elif kind == "ok":
+            # snapshot of open ops (incl. the completing one) BEFORE filter
+            for lane, oid in enumerate(sorted(slot_of.keys())):
+                f, a, b = enc_ops[oid]
+                cand_slot[row, lane] = slot_of[oid]
+                cand_f[row, lane] = f
+                cand_a[row, lane] = a
+                cand_b[row, lane] = b
+            ev_slot_arr[row] = slot_of[op_id]
+            row += 1
+            free.append(slot_of.pop(op_id))
+            free.sort(reverse=True)
+        # info: op keeps its slot forever
+
+    return EncodedHistory(
+        init_state=init,
+        ev_slot=ev_slot_arr,
+        cand_slot=cand_slot,
+        cand_f=cand_f,
+        cand_a=cand_a,
+        cand_b=cand_b,
+        n_ops=len(ops),
+    )
+
+
+def round_up(n: int, multiple: int = 64) -> int:
+    """Bucket sizes to multiples to bound recompilation."""
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def batch_encode(
+    histories: Sequence[History],
+    model: m.Model,
+    slot_cap: int = DEFAULT_SLOT_CAP,
+    event_bucket: int = 64,
+) -> EncodedBatch:
+    """Encode histories into one padded batch; unencodable ones land in
+    ``fallback`` for the CPU oracle."""
+    spec = spec_for(model)
+    encoded: List[EncodedHistory] = []
+    rows: List[int] = []
+    fallback: List[int] = []
+    for i, h in enumerate(histories):
+        e = encode_history(h, model, slot_cap, spec) if spec else None
+        if e is None:
+            fallback.append(i)
+        else:
+            encoded.append(e)
+            rows.append(i)
+
+    if not encoded:
+        return EncodedBatch(
+            init_state=np.zeros((0,), np.int32),
+            ev_slot=np.zeros((0, 0), np.int32),
+            cand_slot=np.zeros((0, 0, slot_cap), np.int32),
+            cand_f=np.zeros((0, 0, slot_cap), np.int32),
+            cand_a=np.zeros((0, 0, slot_cap), np.int32),
+            cand_b=np.zeros((0, 0, slot_cap), np.int32),
+            fallback=fallback,
+            row_history=rows,
+        )
+
+    E = round_up(max(e.ev_slot.shape[0] for e in encoded), event_bucket)
+    B = len(encoded)
+    C = slot_cap
+
+    init_state = np.zeros((B,), np.int32)
+    ev_slot = np.full((B, E), -1, np.int32)
+    cand_slot = np.full((B, E, C), -1, np.int32)
+    cand_f = np.zeros((B, E, C), np.int32)
+    cand_a = np.zeros((B, E, C), np.int32)
+    cand_b = np.zeros((B, E, C), np.int32)
+    for bi, e in enumerate(encoded):
+        n = e.ev_slot.shape[0]
+        init_state[bi] = e.init_state
+        ev_slot[bi, :n] = e.ev_slot
+        cand_slot[bi, :n] = e.cand_slot
+        cand_f[bi, :n] = e.cand_f
+        cand_a[bi, :n] = e.cand_a
+        cand_b[bi, :n] = e.cand_b
+
+    return EncodedBatch(
+        init_state=init_state,
+        ev_slot=ev_slot,
+        cand_slot=cand_slot,
+        cand_f=cand_f,
+        cand_a=cand_a,
+        cand_b=cand_b,
+        fallback=fallback,
+        row_history=rows,
+    )

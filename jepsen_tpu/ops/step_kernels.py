@@ -1,0 +1,151 @@
+"""Branchless model step kernels for the TPU linearizability search.
+
+Each supported model (see jepsen_tpu.models for the CPU oracles these are
+differentially tested against) gets:
+
+- an integer encoding of its state (one int32),
+- an op encoding ``(f, a, b)`` of int32s, and
+- a pure, branchless ``step(state, f, a, b) -> (state', ok)`` built from
+  jnp.where/select so it vectorizes over (frontier × candidate) lanes and
+  compiles into the surrounding scan without data-dependent control flow.
+
+Covers the knossos.model set the reference's linearizable checker uses
+(jepsen/src/jepsen/checker.clj:19-26,185-216): register, cas-register,
+mutex.  Richer-state models (queues) stay on the CPU oracle path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .. import models as m
+
+# Op function codes shared by the register-family kernels.
+F_READ = 0        # a = expected value id (observed at completion)
+F_WRITE = 1       # a = written value id
+F_CAS = 2         # a = expected old value id, b = new value id
+F_READ_ANY = 3    # read with unknown value: always ok, no state change
+F_ACQUIRE = 4     # mutex
+F_RELEASE = 5     # mutex
+
+#: Value id reserved for "unknown/None". Known values are 1-based.
+V_UNKNOWN = 0
+
+
+def register_step(state, f, a, b):
+    """Read/write register.  (oracle: models.Register)"""
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_read_any = f == F_READ_ANY
+    ok = is_write | is_read_any | (is_read & (state == a))
+    state2 = jnp.where(is_write, a, state)
+    return state2, ok
+
+
+def cas_register_step(state, f, a, b):
+    """Read/write/compare-and-set register.  (oracle: models.CASRegister)"""
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    is_read_any = f == F_READ_ANY
+    cas_ok = is_cas & (state == a)
+    ok = is_write | is_read_any | (is_read & (state == a)) | cas_ok
+    state2 = jnp.where(is_write, a, jnp.where(cas_ok, b, state))
+    return state2, ok
+
+
+def mutex_step(state, f, a, b):
+    """Lock: state 0 = free, 1 = held.  (oracle: models.Mutex)"""
+    is_acq = f == F_ACQUIRE
+    is_rel = f == F_RELEASE
+    ok = (is_acq & (state == 0)) | (is_rel & (state == 1))
+    state2 = jnp.where(is_acq, 1, jnp.where(is_rel, 0, state)).astype(state.dtype)
+    return state2, ok
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Host-side description of how a model maps onto the kernel."""
+
+    name: str
+    step: Callable  # (state, f, a, b) -> (state', ok), broadcastable
+    #: encode an op (with completion value already propagated) into
+    #: (f, a, b) int codes, given a mutable value→id map
+    encode_op: Callable[[Any, Dict[Any, int]], Tuple[int, int, int]]
+    #: initial kernel state from the oracle model instance
+    init_state: Callable[[m.Model, Dict[Any, int]], int]
+    #: fs that never change state — indeterminate ones are stripped
+    pure_fs: Tuple[str, ...]
+
+
+def _value_id(value, valmap: Dict[Any, int]) -> int:
+    if value is None:
+        return V_UNKNOWN
+    vid = valmap.get(value)
+    if vid is None:
+        vid = len(valmap) + 1  # ids are 1-based; 0 is V_UNKNOWN
+        valmap[value] = vid
+    return vid
+
+
+def _encode_register_op(op, valmap) -> Tuple[int, int, int]:
+    if op.f == "write":
+        return F_WRITE, _value_id(op.value, valmap), 0
+    if op.f == "read":
+        if op.value is None:
+            return F_READ_ANY, 0, 0
+        return F_READ, _value_id(op.value, valmap), 0
+    raise ValueError(f"register cannot encode op f={op.f!r}")
+
+
+def _encode_cas_op(op, valmap) -> Tuple[int, int, int]:
+    if op.f == "cas":
+        if op.value is None:
+            raise ValueError("cas with nil value is never linearizable")
+        old, new = op.value
+        return F_CAS, _value_id(old, valmap), _value_id(new, valmap)
+    return _encode_register_op(op, valmap)
+
+
+def _encode_mutex_op(op, valmap) -> Tuple[int, int, int]:
+    if op.f == "acquire":
+        return F_ACQUIRE, 0, 0
+    if op.f == "release":
+        return F_RELEASE, 0, 0
+    raise ValueError(f"mutex cannot encode op f={op.f!r}")
+
+
+def _register_init(model, valmap) -> int:
+    return _value_id(model.value, valmap)
+
+
+SPECS: Dict[type, ModelSpec] = {
+    m.Register: ModelSpec(
+        name="register",
+        step=register_step,
+        encode_op=_encode_register_op,
+        init_state=_register_init,
+        pure_fs=("read",),
+    ),
+    m.CASRegister: ModelSpec(
+        name="cas-register",
+        step=cas_register_step,
+        encode_op=_encode_cas_op,
+        init_state=_register_init,
+        pure_fs=("read",),
+    ),
+    m.Mutex: ModelSpec(
+        name="mutex",
+        step=mutex_step,
+        encode_op=_encode_mutex_op,
+        init_state=lambda model, valmap: 1 if model.locked else 0,
+        pure_fs=(),
+    ),
+}
+
+
+def spec_for(model: m.Model) -> Optional[ModelSpec]:
+    return SPECS.get(type(model))

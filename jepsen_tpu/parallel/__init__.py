@@ -1,0 +1,12 @@
+"""Device-mesh parallelism for the analysis plane.
+
+The framework's data-parallel axis is the *history batch* (the TPU mapping
+of the reference's jepsen.independent keyed sub-histories — SURVEY.md
+§2.3.3): thousands of independent histories shard across devices over ICI,
+each device runs the identical search kernel on its shard, and only the
+aggregate verdict/statistics ride collectives.
+"""
+
+from .mesh import default_mesh, shard_batch, sharded_check, verdict_stats
+
+__all__ = ["default_mesh", "shard_batch", "sharded_check", "verdict_stats"]

@@ -1,0 +1,86 @@
+"""Mesh construction and history-batch sharding.
+
+Replaces the reference's control-plane parallelism (CyclicBarrier +
+real-pmap over SSH sessions, jepsen/src/jepsen/core.clj:44-57) on the
+*analysis* side with XLA collectives over a jax.sharding.Mesh: histories
+are device-data-parallel; a single psum aggregates verdict statistics
+(SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+HIST_AXIS = "hist"
+
+
+def default_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over all (or the given) devices; the history batch
+    shards along it."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (HIST_AXIS,))
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, fill=0) -> np.ndarray:
+    """Pad axis 0 up to a multiple of `multiple` with `fill`."""
+    b = arr.shape[0]
+    rem = (-b) % multiple
+    if rem == 0:
+        return arr
+    pad = np.full((rem,) + arr.shape[1:], fill, arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def shard_batch(mesh: Mesh, *arrays: np.ndarray):
+    """device_put each array with its leading axis sharded over the mesh
+    (trailing axes replicated).  Leading dims must be divisible by the
+    mesh size (use pad_to_multiple)."""
+    sharding = NamedSharding(mesh, P(HIST_AXIS))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def sharded_check(
+    check_fn,
+    mesh: Mesh,
+    init_state: np.ndarray,
+    ev_slot: np.ndarray,
+    cand_slot: np.ndarray,
+    cand_f: np.ndarray,
+    cand_a: np.ndarray,
+    cand_b: np.ndarray,
+):
+    """Run a jitted batched checker with inputs sharded over the mesh.
+    The batch is padded to a device multiple — padding rows use
+    ev_slot/cand_slot = -1, which the kernel treats as no-op events, so
+    they report valid and are sliced off by the caller.  XLA partitions
+    the vmapped search across devices; no collectives are needed for the
+    per-history verdicts themselves."""
+    n = mesh.devices.size
+    b = init_state.shape[0]
+    arrays = (
+        pad_to_multiple(init_state, n, 0),
+        pad_to_multiple(ev_slot, n, -1),
+        pad_to_multiple(cand_slot, n, -1),
+        pad_to_multiple(cand_f, n, 0),
+        pad_to_multiple(cand_a, n, 0),
+        pad_to_multiple(cand_b, n, 0),
+    )
+    sharded = shard_batch(mesh, *arrays)
+    with mesh:
+        ok, failed_at, overflow = check_fn(*sharded)
+    return ok[:b], failed_at[:b], overflow[:b]
+
+
+def verdict_stats(ok: jnp.ndarray, overflow: jnp.ndarray, mesh: Optional[Mesh] = None):
+    """Aggregate verdict statistics. On a mesh, this is the one place a
+    collective runs (an all-reduce over the history axis)."""
+    valid = jnp.sum(ok & ~overflow)
+    invalid = jnp.sum(~ok & ~overflow)
+    unknown = jnp.sum(overflow)
+    return {"valid": valid, "invalid": invalid, "unknown": unknown}

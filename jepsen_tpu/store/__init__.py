@@ -1,0 +1,39 @@
+"""Test persistence: directory layout and artifact paths.
+
+Minimal core for now: the canonical path scheme
+``<base>/<test-name>/<start-time>/...`` (reference:
+jepsen/src/jepsen/store.clj:40-60 `path`).  The full 3-phase save,
+binary format, and logging land with the store milestone.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+BASE = "store"
+
+
+def base_dir(test: dict) -> str:
+    return test.get("store-base", BASE)
+
+
+def test_dir(test: dict) -> str:
+    """store/<name>/<start-time> for this test run."""
+    name = test.get("name", "noname")
+    start = str(test.get("start-time", "unknown"))
+    return os.path.join(base_dir(test), name, start)
+
+
+def path(test: dict, *components: Any) -> str:
+    """Path to an artifact within the test's store directory.
+    (reference: store.clj:40-56)"""
+    return os.path.join(test_dir(test), *[str(c) for c in components])
+
+
+def path_(test: dict, *components: Any) -> str:
+    """Like path, but ensures the parent directory exists.
+    (reference: store.clj `path!`)"""
+    p = path(test, *components)
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    return p

@@ -1,0 +1,117 @@
+"""Synthetic history generation — for differential tests and benchmarks.
+
+Simulates honest linearizable executions of a CAS register with real
+concurrency (ops linearize at completion; crashes secretly apply or not),
+plus an optional corruption pass that produces likely-invalid histories.
+This is the batch feeder for BASELINE configs 1 and 3 (synthetic
+CAS-register suites).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from .history import History, invoke_op, ok_op, fail_op, info_op
+
+
+def generate_history(
+    rng: random.Random,
+    n_procs: int = 4,
+    n_ops: int = 30,
+    crash_p: float = 0.1,
+    corrupt: bool = False,
+    n_values: int = 5,
+) -> History:
+    """One simulated concurrent CAS-register execution.
+
+    Valid by construction when corrupt=False (every completed op
+    linearizes at its completion point; crashed ops apply secretly with
+    probability 1/2).  corrupt=True flips one completion value, usually
+    (not always) making the history non-linearizable.
+    """
+    state = 0
+    hist = []
+    pending = {}
+    idle = list(range(n_procs))
+    values = list(range(1, n_values + 1))
+    ops_done = 0
+    while ops_done < n_ops or pending:
+        do_invoke = idle and (ops_done < n_ops) and (not pending or rng.random() < 0.6)
+        if do_invoke:
+            p = rng.choice(idle)
+            idle.remove(p)
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                hist.append(invoke_op(p, "read"))
+                pending[p] = ("read", None)
+            elif f == "write":
+                v = rng.choice(values)
+                hist.append(invoke_op(p, "write", v))
+                pending[p] = ("write", v)
+            else:
+                old = rng.choice(values + [state])
+                new = rng.choice(values)
+                hist.append(invoke_op(p, "cas", (old, new)))
+                pending[p] = ("cas", (old, new))
+            ops_done += 1
+        else:
+            p = rng.choice(list(pending.keys()))
+            f, v = pending.pop(p)
+            if rng.random() < crash_p:
+                # crashed: decide secretly whether it took effect; the
+                # crashed process id is never reused
+                if f == "write" and rng.random() < 0.5:
+                    state = v
+                elif f == "cas" and rng.random() < 0.5 and state == v[0]:
+                    state = v[1]
+                hist.append(info_op(p, f, v))
+            else:
+                if f == "read":
+                    v = state
+                elif f == "write":
+                    state = v
+                elif f == "cas":
+                    if state == v[0]:
+                        state = v[1]
+                    else:
+                        hist.append(fail_op(p, f, v))
+                        idle.append(p)
+                        continue
+                hist.append(ok_op(p, f, v))
+                idle.append(p)
+        if not idle and not pending:
+            break
+    out = History(hist)
+    if corrupt and len(out) > 2:
+        oks = [i for i, op in enumerate(out) if op.type == "ok"]
+        if oks:
+            i = rng.choice(oks)
+            op = out[i]
+            if op.f in ("read", "write"):
+                out[i] = op.copy(value=rng.choice([7, 8, 9]))
+    for i, op in enumerate(out):
+        op.index = i
+        op.time = i
+    return out
+
+
+def generate_batch(
+    seed: int,
+    n_histories: int,
+    n_procs: int = 4,
+    n_ops: int = 30,
+    crash_p: float = 0.05,
+    corrupt_fraction: float = 0.0,
+):
+    """A list of histories, a deterministic function of seed."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n_histories):
+        corrupt = rng.random() < corrupt_fraction
+        out.append(
+            generate_history(
+                rng, n_procs=n_procs, n_ops=n_ops, crash_p=crash_p, corrupt=corrupt
+            )
+        )
+    return out

@@ -1,19 +1,26 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding paths
-(mesh/pjit/shard_map) are exercised without TPU hardware.  These env vars
-must be set before jax initializes its backends, so this executes at
-conftest import time — before any test module imports jax.
+(mesh/pjit/shard_map) are exercised without TPU hardware.
+
+The environment's axon TPU plugin (sitecustomize in PYTHONPATH) forces
+JAX_PLATFORMS=axon regardless of the env var, so plain env overrides are
+not enough: we must set jax_platforms via jax.config after import, before
+any backend initializes.  XLA_FLAGS still must be set before first
+backend use for the virtual device count to apply.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

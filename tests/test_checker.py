@@ -1,0 +1,437 @@
+"""Built-in checker tests on literal histories (mirrors the reference's
+test strategy: jepsen/test/jepsen/checker_test.clj)."""
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import models as m
+from jepsen_tpu.history import (
+    History,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+)
+
+
+def h(*ops) -> History:
+    hist = History(ops)
+    for i, op in enumerate(hist):
+        op.index = i
+        if op.time == 0:
+            op.time = i
+    return hist
+
+
+def test_merge_valid():
+    assert c.merge_valid([]) is True
+    assert c.merge_valid([True, True]) is True
+    assert c.merge_valid([True, "unknown"]) == "unknown"
+    assert c.merge_valid([True, "unknown", False]) is False
+    with pytest.raises(ValueError):
+        c.merge_valid([None])
+
+
+def test_check_safe_wraps_exceptions():
+    class Boom(c.Checker):
+        def check(self, test, history, opts=None):
+            raise RuntimeError("boom")
+
+    out = c.check_safe(Boom(), {}, h())
+    assert out["valid?"] == "unknown"
+    assert "boom" in out["error"]
+
+
+def test_compose():
+    out = c.compose(
+        {"opt": c.unbridled_optimism(), "noop": c.noop()}
+    ).check({}, h(), {})
+    assert out["valid?"] is True
+    assert out["opt"] == {"valid?": True}
+
+
+def test_compose_merges_worst():
+    class Bad(c.Checker):
+        def check(self, test, history, opts=None):
+            return {"valid?": False}
+
+    out = c.compose({"good": c.unbridled_optimism(), "bad": Bad()}).check({}, h(), {})
+    assert out["valid?"] is False
+
+
+def test_stats():
+    # mirrors reference stats-test (checker_test.clj:44-66)
+    out = c.stats().check(
+        {},
+        h(
+            ok_op(0, "foo"),
+            fail_op(0, "foo"),
+            info_op(0, "bar"),
+            fail_op(0, "bar"),
+            fail_op(0, "bar"),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+    assert out["count"] == 5
+    assert out["ok-count"] == 1
+    assert out["by-f"]["foo"]["valid?"] is True
+    assert out["by-f"]["bar"]["valid?"] is False
+    assert out["by-f"]["bar"]["info-count"] == 1
+
+
+def test_stats_ignores_nemesis_and_invokes():
+    out = c.stats().check(
+        {}, h(invoke_op(0, "foo"), ok_op(0, "foo"), info_op("nemesis", "kill")), {}
+    )
+    assert out["count"] == 1
+    assert out["valid?"] is True
+
+
+def test_queue_checker():
+    # reference checker_test.clj:68-88
+    assert c.queue(m.unordered_queue()).check({}, h(), {})["valid?"] is True
+    assert (
+        c.queue(m.unordered_queue())
+        .check({}, h(invoke_op(1, "enqueue", 1)), {})["valid?"]
+        is True
+    )
+    # concurrent enqueue/dequeue: dequeue sees the possibly-enqueued value
+    out = c.queue(m.unordered_queue()).check(
+        {},
+        h(
+            invoke_op(2, "dequeue"),
+            invoke_op(1, "enqueue", 1),
+            ok_op(2, "dequeue", 1),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    # dequeue of something never enqueued
+    out = c.queue(m.unordered_queue()).check(
+        {}, h(invoke_op(2, "dequeue"), ok_op(2, "dequeue", 9)), {}
+    )
+    assert out["valid?"] is False
+
+
+def test_set_checker():
+    out = c.set_checker().check(
+        {},
+        h(
+            invoke_op(0, "add", 0),
+            ok_op(0, "add", 0),
+            invoke_op(0, "add", 1),
+            fail_op(0, "add", 1),
+            invoke_op(0, "add", 2),
+            info_op(0, "add", 2),
+            invoke_op(1, "read"),
+            ok_op(1, "read", [0, 2]),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    assert out["recovered-count"] == 1  # 2 was indeterminate but observed
+    assert out["ok-count"] == 2
+
+
+def test_set_checker_lost_and_unexpected():
+    out = c.set_checker().check(
+        {},
+        h(
+            invoke_op(0, "add", 0),
+            ok_op(0, "add", 0),
+            invoke_op(1, "read"),
+            ok_op(1, "read", [5]),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+    assert out["lost-count"] == 1
+    assert out["unexpected-count"] == 1
+
+
+def test_set_checker_never_read():
+    out = c.set_checker().check({}, h(invoke_op(0, "add", 0), ok_op(0, "add", 0)), {})
+    assert out["valid?"] == "unknown"
+
+
+def test_total_queue_sane():
+    # reference checker_test.clj:94-115
+    out = c.total_queue().check(
+        {},
+        h(
+            invoke_op(1, "enqueue", 1),
+            invoke_op(2, "enqueue", 2),
+            ok_op(2, "enqueue", 2),
+            invoke_op(3, "dequeue", 1),
+            ok_op(3, "dequeue", 1),
+            invoke_op(3, "dequeue", 2),
+            ok_op(3, "dequeue", 2),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    assert out["attempt-count"] == 2
+    assert out["acknowledged-count"] == 1
+    assert out["ok-count"] == 2
+    assert out["recovered-count"] == 1
+
+
+def test_total_queue_pathological():
+    # reference checker_test.clj:117-143
+    out = c.total_queue().check(
+        {},
+        h(
+            invoke_op(1, "enqueue", "hung"),
+            invoke_op(2, "enqueue", "enqueued"),
+            ok_op(2, "enqueue", "enqueued"),
+            invoke_op(3, "enqueue", "dup"),
+            ok_op(3, "enqueue", "dup"),
+            invoke_op(4, "dequeue"),
+            invoke_op(5, "dequeue"),
+            ok_op(5, "dequeue", "wtf"),
+            invoke_op(6, "dequeue"),
+            ok_op(6, "dequeue", "dup"),
+            invoke_op(7, "dequeue"),
+            ok_op(7, "dequeue", "dup"),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+    assert out["lost"] == {"enqueued": 1}
+    assert out["unexpected"] == {"wtf": 1}
+    assert out["duplicated"] == {"dup": 1}
+    assert out["ok-count"] == 1
+
+
+def test_total_queue_drain_expansion():
+    out = c.total_queue().check(
+        {},
+        h(
+            invoke_op(1, "enqueue", "a"),
+            ok_op(1, "enqueue", "a"),
+            invoke_op(2, "drain"),
+            ok_op(2, "drain", ["a"]),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    assert out["ok-count"] == 1
+
+
+def test_unique_ids():
+    out = c.unique_ids().check(
+        {},
+        h(
+            invoke_op(0, "generate"),
+            ok_op(0, "generate", 10),
+            invoke_op(0, "generate"),
+            ok_op(0, "generate", 11),
+            invoke_op(0, "generate"),
+            ok_op(0, "generate", 10),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+    assert out["duplicated"] == {10: 2}
+    assert out["range"] == [10, 11]
+    assert out["attempted-count"] == 3
+
+
+def test_counter_empty_and_initial():
+    # reference checker_test.clj:145-180
+    assert c.counter().check({}, h(), {}) == {
+        "valid?": True,
+        "reads": [],
+        "errors": [],
+    }
+    out = c.counter().check({}, h(invoke_op(0, "read"), ok_op(0, "read", 0)), {})
+    assert out == {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+    out = c.counter().check({}, h(invoke_op(0, "read"), ok_op(0, "read", 1)), {})
+    assert out == {"valid?": False, "reads": [[0, 1, 0]], "errors": [[0, 1, 0]]}
+
+
+def test_counter_ignores_failed_adds():
+    out = c.counter().check(
+        {},
+        h(
+            invoke_op(0, "add", 1),
+            fail_op(0, "add", 1),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 0),
+        ),
+        {},
+    )
+    assert out == {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_concurrent_bounds():
+    # a read concurrent with an add may see either value
+    out = c.counter().check(
+        {},
+        h(
+            invoke_op(0, "read"),
+            invoke_op(1, "add", 1),
+            ok_op(1, "add", 1),
+            ok_op(0, "read", 1),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    assert out["reads"] == [[0, 1, 1]]
+    # reading 2 when at most 1 was ever added is invalid
+    out = c.counter().check(
+        {},
+        h(
+            invoke_op(0, "read"),
+            invoke_op(1, "add", 1),
+            ok_op(1, "add", 1),
+            ok_op(0, "read", 2),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+
+
+def test_counter_indeterminate_add_widens_upper():
+    out = c.counter().check(
+        {},
+        h(
+            invoke_op(1, "add", 5),
+            info_op(1, "add", 5),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 5),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    out2 = c.counter().check(
+        {},
+        h(
+            invoke_op(1, "add", 5),
+            info_op(1, "add", 5),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 0),
+        ),
+        {},
+    )
+    assert out2["valid?"] is True  # lower bound stays 0
+
+
+def test_set_full_never_read():
+    # reference checker_test.clj:516-533
+    out = c.set_full().check({}, h(invoke_op(0, "add", 0), ok_op(0, "add", 0)), {})
+    assert out["valid?"] == "unknown"
+    assert out["never-read"] == [0]
+    assert out["attempt-count"] == 1
+
+
+def test_set_full_stable_and_lost():
+    out = c.set_full().check(
+        {},
+        h(
+            invoke_op(0, "add", 0),
+            ok_op(0, "add", 0),
+            invoke_op(1, "read"),
+            ok_op(1, "read", [0]),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+    assert out["stable-count"] == 1
+
+    out = c.set_full().check(
+        {},
+        h(
+            invoke_op(0, "add", 0),
+            ok_op(0, "add", 0),
+            invoke_op(1, "read"),
+            ok_op(1, "read", [0]),
+            invoke_op(1, "read"),
+            ok_op(1, "read", []),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+    assert out["lost"] == [0]
+
+
+def test_set_full_stale_read_linearizable():
+    second = 1_000_000_000
+    hist = h(
+        invoke_op(0, "add", 0, time=0 * second),
+        ok_op(0, "add", 0, time=1 * second),
+        invoke_op(1, "read", time=2 * second),   # read begins after add ok...
+        ok_op(1, "read", [], time=3 * second),   # ...but misses it: stale
+        invoke_op(1, "read", time=4 * second),
+        ok_op(1, "read", [0], time=5 * second),  # later it appears
+    )
+    relaxed = c.set_full(linearizable=False).check({}, hist, {})
+    assert relaxed["valid?"] is True
+    assert relaxed["stale"] == [0]
+    strict = c.set_full(linearizable=True).check({}, hist, {})
+    assert strict["valid?"] is False
+
+
+def test_set_full_duplicates():
+    out = c.set_full().check(
+        {},
+        h(
+            invoke_op(0, "add", 0),
+            ok_op(0, "add", 0),
+            invoke_op(1, "read"),
+            ok_op(1, "read", [0, 0]),
+        ),
+        {},
+    )
+    assert out["valid?"] is False
+    assert out["duplicated"] == {0: 2}
+
+
+def test_set_full_concurrent_absent_read_is_never_read():
+    # A read concurrent with the add that misses the element could have
+    # linearized before it: never-read, not lost (checker.clj:363-381).
+    out = c.set_full().check(
+        {},
+        h(
+            invoke_op(1, "read"),
+            invoke_op(0, "add", 0),
+            ok_op(1, "read", []),
+            ok_op(0, "add", 0),
+        ),
+        {},
+    )
+    assert out["lost-count"] == 0
+    assert out["never-read"] == [0]
+
+
+def test_unhandled_exceptions():
+    hist = h(
+        info_op(0, "write", 1, exception="boom", exception_class="RuntimeError"),
+        info_op(1, "write", 2, exception="boom", exception_class="RuntimeError"),
+        ok_op(2, "write", 3),
+    )
+    out = c.unhandled_exceptions().check({}, hist, {})
+    assert out["valid?"] is True
+    assert out["exceptions"][0]["class"] == "RuntimeError"
+    assert out["exceptions"][0]["count"] == 2
+
+
+def test_log_file_pattern(tmp_path):
+    test = {"name": "t", "start-time": "now", "store-base": str(tmp_path), "nodes": ["n1", "n2"]}
+    import os
+
+    from jepsen_tpu import store
+
+    p = store.path_(test, "n1", "db.log")
+    with open(p, "w") as f:
+        f.write("starting up\npanic: invariant violation\nok\n")
+    os.makedirs(os.path.dirname(store.path(test, "n2", "db.log")), exist_ok=True)
+    with open(store.path(test, "n2", "db.log"), "w") as f:
+        f.write("all good\n")
+    out = c.log_file_pattern(r"panic: (\w+)", "db.log").check(test, h(), {})
+    assert out["valid?"] is False
+    assert out["count"] == 1
+    assert out["matches"][0]["node"] == "n1"
+    out2 = c.log_file_pattern(r"unfindable", "db.log").check(test, h(), {})
+    assert out2["valid?"] is True

@@ -1,0 +1,311 @@
+"""CPU linearizability oracle tests: golden valid and invalid histories.
+
+Mirrors the role knossos's own test suite plays for the reference
+(consumed at jepsen/src/jepsen/checker.clj:185-216).
+"""
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import linear, linearizable
+from jepsen_tpu.history import (
+    History,
+    invoke_op,
+    ok_op,
+    fail_op,
+    info_op,
+)
+
+
+def h(*ops) -> History:
+    hist = History(ops)
+    for i, op in enumerate(hist):
+        op.index = i
+        op.time = i
+    return hist
+
+
+def check(model, hist, **kw):
+    return linear.analysis(model, hist, **kw)
+
+
+# -- sequential histories ---------------------------------------------------
+
+
+def test_empty():
+    assert check(m.register(0), h())["valid?"] is True
+
+
+def test_sequential_valid():
+    out = check(
+        m.cas_register(None),
+        h(
+            invoke_op(0, "write", 1),
+            ok_op(0, "write", 1),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 1),
+            invoke_op(0, "cas", (1, 2)),
+            ok_op(0, "cas", (1, 2)),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 2),
+        ),
+    )
+    assert out["valid?"] is True
+
+
+def test_sequential_invalid_read():
+    out = check(
+        m.register(None),
+        h(
+            invoke_op(0, "write", 1),
+            ok_op(0, "write", 1),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 2),
+        ),
+    )
+    assert out["valid?"] is False
+    assert out["op"]["f"] == "read"
+
+
+def test_sequential_invalid_cas():
+    out = check(
+        m.cas_register(0),
+        h(invoke_op(0, "cas", (5, 6)), ok_op(0, "cas", (5, 6))),
+    )
+    assert out["valid?"] is False
+
+
+# -- concurrency ------------------------------------------------------------
+
+
+def test_concurrent_writes_either_order():
+    # two concurrent writes; a later read may see either one...
+    base = [
+        invoke_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(0, "write", 1),
+        ok_op(1, "write", 2),
+        invoke_op(0, "read"),
+    ]
+    for v in (1, 2):
+        out = check(m.register(None), h(*base, ok_op(0, "read", v)))
+        assert out["valid?"] is True, v
+    # ...but not a value never written
+    out = check(m.register(None), h(*base, ok_op(0, "read", 3)))
+    assert out["valid?"] is False
+
+
+def test_read_concurrent_with_write():
+    # read overlapping a write may see old or new value
+    for v in (0, 1):
+        out = check(
+            m.register(0),
+            h(
+                invoke_op(0, "read"),
+                invoke_op(1, "write", 1),
+                ok_op(0, "read", v),
+                ok_op(1, "write", 1),
+            ),
+        )
+        assert out["valid?"] is True, v
+
+
+def test_non_overlapping_reads_respect_real_time():
+    # write completes, THEN read begins: must see the new value
+    out = check(
+        m.register(0),
+        h(
+            invoke_op(1, "write", 1),
+            ok_op(1, "write", 1),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 0),
+        ),
+    )
+    assert out["valid?"] is False
+
+
+def test_stale_read_between_processes():
+    # p0 reads 1, then later (non-overlapping) p1 reads 0: invalid
+    out = check(
+        m.register(None),
+        h(
+            invoke_op(2, "write", 0),
+            ok_op(2, "write", 0),
+            invoke_op(2, "write", 1),
+            ok_op(2, "write", 1),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 0),
+        ),
+    )
+    assert out["valid?"] is False
+
+
+# -- crashes (:info) --------------------------------------------------------
+
+
+def test_indeterminate_write_may_happen():
+    out = check(
+        m.register(0),
+        h(
+            invoke_op(0, "write", 1),
+            info_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 1),
+        ),
+    )
+    assert out["valid?"] is True
+
+
+def test_indeterminate_write_may_not_happen():
+    out = check(
+        m.register(0),
+        h(
+            invoke_op(0, "write", 1),
+            info_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 0),
+        ),
+    )
+    assert out["valid?"] is True
+
+
+def test_indeterminate_write_takes_effect_late():
+    # crashed write linearizes AFTER an intervening read of the old value
+    out = check(
+        m.register(0),
+        h(
+            invoke_op(0, "write", 1),
+            info_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 0),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 1),
+        ),
+    )
+    assert out["valid?"] is True
+
+
+def test_failed_write_never_happens():
+    out = check(
+        m.register(0),
+        h(
+            invoke_op(0, "write", 1),
+            fail_op(0, "write", 1),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 1),
+        ),
+    )
+    assert out["valid?"] is False
+
+
+def test_crashed_read_is_stripped():
+    out = check(
+        m.register(0),
+        h(
+            invoke_op(0, "read"),
+            info_op(0, "read"),
+            invoke_op(1, "write", 1),
+            ok_op(1, "write", 1),
+        ),
+        pure_fs=("read",),
+    )
+    assert out["valid?"] is True
+    assert out["op-count"] == 1  # the read is gone
+
+
+# -- the classic knossos examples ------------------------------------------
+
+
+def test_cas_register_multiprocess_valid():
+    out = check(
+        m.cas_register(0),
+        h(
+            invoke_op(0, "read"),
+            ok_op(0, "read", 0),
+            invoke_op(1, "cas", (0, 2)),
+            invoke_op(2, "cas", (0, 3)),
+            ok_op(1, "cas", (0, 2)),
+            info_op(2, "cas", (0, 3)),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 2),
+        ),
+    )
+    assert out["valid?"] is True
+
+
+def test_cas_register_multiprocess_invalid():
+    # both CASes from 0 cannot both succeed
+    out = check(
+        m.cas_register(0),
+        h(
+            invoke_op(1, "cas", (0, 2)),
+            ok_op(1, "cas", (0, 2)),
+            invoke_op(2, "cas", (0, 3)),
+            ok_op(2, "cas", (0, 3)),
+        ),
+    )
+    assert out["valid?"] is False
+
+
+def test_mutex():
+    out = check(
+        m.mutex(),
+        h(
+            invoke_op(0, "acquire"),
+            ok_op(0, "acquire"),
+            invoke_op(1, "acquire"),
+            invoke_op(0, "release"),
+            ok_op(0, "release"),
+            ok_op(1, "acquire"),
+        ),
+    )
+    assert out["valid?"] is True
+    # double acquire without release is not linearizable
+    out = check(
+        m.mutex(),
+        h(
+            invoke_op(0, "acquire"),
+            ok_op(0, "acquire"),
+            invoke_op(1, "acquire"),
+            ok_op(1, "acquire"),
+        ),
+    )
+    assert out["valid?"] is False
+
+
+def test_overflow_returns_unknown():
+    ops = []
+    for i in range(12):
+        ops.append(invoke_op(i, "write", i))
+    for i in range(12):
+        ops.append(ok_op(i, "write", i))
+    out = check(m.register(None), h(*ops), max_configs=50)
+    assert out["valid?"] == "unknown"
+
+
+def test_checker_wrapper_oracle():
+    chk = linearizable(m.cas_register(0), algorithm="oracle")
+    out = chk.check(
+        {},
+        h(
+            invoke_op(0, "write", 3),
+            ok_op(0, "write", 3),
+            invoke_op(1, "read"),
+            ok_op(1, "read", 3),
+        ),
+        {},
+    )
+    assert out["valid?"] is True
+
+
+def test_nemesis_ops_ignored():
+    out = check(
+        m.register(0),
+        h(
+            info_op("nemesis", "start-partition"),
+            invoke_op(0, "read"),
+            ok_op(0, "read", 0),
+            info_op("nemesis", "stop-partition"),
+        ),
+    )
+    assert out["valid?"] is True
