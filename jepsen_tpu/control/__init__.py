@@ -1,0 +1,85 @@
+"""Remote-command control plane.
+
+The dynamic-context command DSL over pluggable Remote transports
+(reference: jepsen/src/jepsen/control.clj:40-319 — dynamic vars, exec,
+su/sudo/cd, upload/download, sessions, on-nodes).
+
+This module holds the dynamic execution context (current node, session,
+sudo/dir state, thread-local) and the session lifecycle; transports live
+in submodules (``core`` for the Remote protocol and escaping, ``ssh``,
+``docker``, ``k8s``, ``retry``, ``scp``, and a dummy remote mirroring the
+reference's ``:dummy?`` mode, control.clj:40, used by in-process tests).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Optional
+
+from .core import Remote, DummyRemote, RemoteError, lit, escape  # noqa: F401
+
+# The node binding is thread-local (each on-nodes worker thread binds its
+# own node — the reference uses dynamic vars with binding conveyance,
+# control.clj:40-53 + util.clj:65-83).  The session table is
+# process-global: worker threads spawned by real_pmap must see it.
+_local = threading.local()
+_sessions_lock = threading.Lock()
+_sessions: Dict[Any, Remote] = {}
+
+
+@contextmanager
+def with_session(test: dict, remote: Remote):
+    """Open a session per node; body runs with sessions available.
+    Sessions do not nest: one test's control plane at a time.
+    (reference: core.clj:275-296 with-sessions + control.clj:226-266)"""
+    sessions = {}
+    try:
+        for node in test["nodes"]:
+            sessions[node] = remote.connect(node, test)
+        with _sessions_lock:
+            _sessions.update(sessions)
+        try:
+            yield sessions
+        finally:
+            with _sessions_lock:
+                for node in sessions:
+                    _sessions.pop(node, None)
+    finally:
+        for s in sessions.values():
+            try:
+                s.disconnect()
+            except Exception:
+                pass
+
+
+@contextmanager
+def dummy_session(test: dict):
+    """All commands become no-ops that record themselves — the
+    reference's :dummy? ssh mode (control.clj:40, cli.clj:85-86)."""
+    remote = DummyRemote()
+    with with_session(test, remote) as sessions:
+        yield sessions
+
+
+def with_node(node: Any, fn: Callable[[], Any]) -> Any:
+    """Bind the dynamic node for this thread while running fn.
+    (reference: control.clj:272-293 on/on-nodes)"""
+    prev = getattr(_local, "node", None)
+    _local.node = node
+    try:
+        return fn()
+    finally:
+        _local.node = prev
+
+
+def current_node() -> Optional[Any]:
+    return getattr(_local, "node", None)
+
+
+def current_session() -> Optional[Remote]:
+    node = current_node()
+    if node is None:
+        return None
+    with _sessions_lock:
+        return _sessions.get(node)

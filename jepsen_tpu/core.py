@@ -1,0 +1,175 @@
+"""Test lifecycle orchestration (reference: jepsen/src/jepsen/core.clj).
+
+``run(test)`` drives the full lifecycle: prepare, OS/DB setup (when a
+remote control plane is configured), client+nemesis setup, the
+interpreter, analysis, and persistence:
+
+    run! (core.clj:327) → prepare-test:311 → with-os/with-db:93-181
+    → run-case!:214 (client+nemesis setup/teardown:183-212 around
+      generator.interpreter/run!) → analyze!:221 → log-results:239
+
+In-process tests use a dummy remote + fake clients and skip OS/DB setup,
+exactly like the reference's ``:ssh {:dummy? true}`` mode
+(control.clj:40, core_test.clj:55-120).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+from typing import Any, Optional
+
+from . import checker as checker_mod
+from . import client as client_mod
+from . import interpreter
+from . import nemesis as nemesis_mod
+from .history import History
+from .util import real_pmap, with_relative_time
+
+log = logging.getLogger("jepsen_tpu.core")
+
+
+class Synchronizer:
+    """A reusable barrier for :conn-barrier style cross-node sync during
+    DB setup (reference: core.clj:44-57 synchronize)."""
+
+    def __init__(self, parties: int):
+        self.barrier = threading.Barrier(parties)
+
+    def synchronize(self, timeout: Optional[float] = None):
+        self.barrier.wait(timeout)
+
+
+def prepare_test(test: dict) -> dict:
+    """Fill in start-time, barrier, default keys.
+    (reference: core.clj:311-325)"""
+    test = dict(test)
+    test.setdefault("start-time", datetime.datetime.now().strftime("%Y%m%dT%H%M%S.%f")[:-3])
+    test.setdefault("nodes", ["n1", "n2", "n3", "n4", "n5"])
+    test.setdefault("concurrency", len(test["nodes"]))
+    test.setdefault("barrier", Synchronizer(len(test["nodes"])))
+    test.setdefault("checker", checker_mod.unbridled_optimism())
+    test.setdefault("nemesis", nemesis_mod.noop())
+    test.setdefault("client", client_mod.noop())
+    return test
+
+
+def run_case(test: dict) -> History:
+    """Set up nemesis + per-node clients, run the interpreter, tear down.
+    (reference: core.clj:183-218)"""
+    client = test["client"]
+    nemesis = nemesis_mod.validate(test["nemesis"])
+
+    nemesis = nemesis.setup(test)
+    test = {**test, "nemesis": nemesis}
+
+    # Track successfully-opened clients even if a later node's open
+    # raises, so teardown ALWAYS covers what was opened (reference
+    # guarantees teardown of both nemesis and clients, core.clj:183-212).
+    clients: list = []
+    clients_lock = threading.Lock()
+
+    def open_and_setup(node):
+        c = client.open(test, node)
+        with clients_lock:
+            clients.append((c, node))
+        c.setup(test)
+        return c
+
+    try:
+        real_pmap(open_and_setup, test["nodes"])
+        return interpreter.run(test)
+    finally:
+        try:
+            nemesis.teardown(test)
+        finally:
+
+            def teardown_and_close(cn):
+                c, _node = cn
+                try:
+                    c.teardown(test)
+                finally:
+                    c.close(test)
+
+            with clients_lock:
+                opened = list(clients)
+            real_pmap(teardown_and_close, opened)
+
+
+def analyze(test: dict) -> dict:
+    """Index the history, run checkers, attach results.
+    (reference: core.clj:221-237)"""
+    history = test["history"]
+    if isinstance(history, History):
+        history.index_ops()
+    results = checker_mod.check_safe(
+        test["checker"], test, history, {}
+    )
+    return {**test, "results": results}
+
+
+def log_results(test: dict) -> dict:
+    """(reference: core.clj:239-253)"""
+    r = test.get("results", {})
+    verdict = r.get("valid?")
+    if verdict is False:
+        log.warning("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+    elif verdict == "unknown":
+        log.warning("Errors occurred during analysis, but no anomalies found. ಠ~ಠ")
+    else:
+        log.info("Everything looks good! ヽ(‘ー`)ノ")
+    return test
+
+
+def run(test: dict) -> dict:
+    """Full lifecycle; returns the test with :history and :results.
+    (reference: core.clj:327-406)"""
+    test = prepare_test(test)
+
+    # OS + DB setup over the control plane, when configured (real
+    # clusters; in-process tests leave these unset / dummy)
+    from . import db as db_mod
+
+    db = test.get("db")
+    os_ = test.get("os")
+    control_ctx = _control_context(test)
+    with control_ctx:
+        if os_ is not None:
+            _on_nodes(test, lambda node: os_.setup(test, node))
+        if db is not None:
+            db_mod.cycle(test)
+        try:
+            with with_relative_time():
+                history = run_case(test)
+            test = {**test, "history": history}
+            test = analyze(test)
+            return log_results(test)
+        finally:
+            if db is not None and not test.get("leave-db-running?"):
+                _on_nodes(test, lambda node: db.teardown(test, node))
+
+
+def _control_context(test: dict):
+    """The remote-session context for this test (dummy by default)."""
+    from . import control
+
+    remote = test.get("remote")
+    if remote is None:
+        return control.dummy_session(test)
+    return control.with_session(test, remote)
+
+
+def _on_nodes(test: dict, fn):
+    """Run fn on every node concurrently.
+    (reference: control.clj:295-311 on-nodes)"""
+    from . import control
+
+    return dict(
+        zip(
+            test["nodes"],
+            real_pmap(
+                lambda node: control.with_node(node, lambda: fn(node)), test["nodes"]
+            ),
+        )
+    )

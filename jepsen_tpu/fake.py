@@ -1,0 +1,108 @@
+"""In-process fake DB and client for integration tests without a cluster.
+
+(reference: jepsen/src/jepsen/tests.clj:27-66 atom-db/atom-client, used by
+core_test.clj's basic-cas-test to drive the *real* interpreter.)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+from . import client as client_mod
+
+
+class AtomState:
+    """A compare-and-settable cell guarded by a lock."""
+
+    def __init__(self, value: Any = None):
+        self.lock = threading.Lock()
+        self.value = value
+
+    def reset(self, value: Any) -> Any:
+        with self.lock:
+            self.value = value
+            return value
+
+    def deref(self) -> Any:
+        with self.lock:
+            return self.value
+
+    def cas(self, old: Any, new: Any) -> bool:
+        with self.lock:
+            if self.value == old:
+                self.value = new
+                return True
+            return False
+
+
+class AtomClient(client_mod.Client):
+    """CAS-register client over an AtomState.
+    (reference: tests.clj:34-66)"""
+
+    def __init__(
+        self,
+        state: AtomState,
+        meta_log: Optional[List[str]] = None,
+        latency: float = 0.001,
+    ):
+        self.state = state
+        self.meta_log = meta_log if meta_log is not None else []
+        self.latency = latency
+
+    def open(self, test, node):
+        self.meta_log.append("open")
+        return AtomClient(self.state, self.meta_log, self.latency)
+
+    def setup(self, test):
+        self.meta_log.append("setup")
+
+    def invoke(self, test, op):
+        # sleep to get actual concurrency (reference: tests.clj:50)
+        if self.latency:
+            time.sleep(self.latency)
+        f = op["f"]
+        if f == "write":
+            self.state.reset(op["value"])
+            return {**op, "type": "ok"}
+        elif f == "cas":
+            old, new = op["value"]
+            ok = self.state.cas(old, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        elif f == "read":
+            return {**op, "type": "ok", "value": self.state.deref()}
+        raise ValueError(f"unknown op f={f!r}")
+
+    def teardown(self, test):
+        self.meta_log.append("teardown")
+
+    def close(self, test):
+        self.meta_log.append("close")
+
+
+class CrashingClient(AtomClient):
+    """Like AtomClient but raises on a fraction of ops — exercises the
+    interpreter's crash→:info→process-retirement path."""
+
+    def __init__(self, state, crash_every: int = 5, **kw):
+        super().__init__(state, **kw)
+        self.crash_every = crash_every
+        self.counter = {"n": 0}
+
+    def open(self, test, node):
+        self.meta_log.append("open")
+        c = CrashingClient(
+            self.state,
+            crash_every=self.crash_every,
+            meta_log=self.meta_log,
+            latency=self.latency,
+        )
+        c.counter = self.counter
+        return c
+
+    def invoke(self, test, op):
+        self.counter["n"] += 1
+        if self.counter["n"] % self.crash_every == 0:
+            raise RuntimeError("client crashed!")
+        return super().invoke(test, op)

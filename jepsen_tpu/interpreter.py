@@ -1,0 +1,266 @@
+"""The interpreter: executes a generator against real worker threads,
+building the history.
+
+(reference: jepsen/src/jepsen/generator/interpreter.clj — Worker protocol
+:19-31, ClientWorker re-open logic :33-67, worker thread loop :99-164,
+scheduler loop :181-292, crash-to-:info conversion :142-157,
+max-pending-interval :166-170.)
+
+One thread per worker (concurrency clients + 1 nemesis) with a
+size-1 in-queue each and a shared completion queue; a single scheduler
+thread drives the generator, dispatches invocations, applies completions,
+and retires crashed processes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from . import client as client_mod
+from . import generator as gen
+from .history import History, NEMESIS, Op
+from .util import relative_time_nanos
+
+#: Max micros to wait before re-polling a pending generator
+#: (reference: interpreter.clj:166-170)
+MAX_PENDING_INTERVAL_US = 1000
+
+
+class ClientWorker:
+    """Wraps a client, reopening it when its process changes (unless the
+    client is reusable).  (reference: interpreter.clj:33-67)"""
+
+    def __init__(self, node):
+        self.node = node
+        self.process = None
+        self.client: Optional[client_mod.Client] = None
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        while True:
+            if self.process != op["process"] and not client_mod.is_reusable(
+                self.client, test
+            ):
+                self.close(test)
+                try:
+                    self.client = client_mod.validate(test["client"]).open(
+                        test, self.node
+                    )
+                    self.process = op["process"]
+                except Exception as e:
+                    self.client = None
+                    return {
+                        **op,
+                        "type": "fail",
+                        "error": ["no-client", str(e)],
+                    }
+                continue
+            return self.client.invoke(test, op)
+
+    def close(self, test: dict) -> None:
+        if self.client is not None:
+            self.client.close(test)
+            self.client = None
+
+
+class NemesisWorker:
+    """(reference: interpreter.clj:69-76)"""
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        return test["nemesis"].invoke(test, op)
+
+    def close(self, test: dict) -> None:
+        pass
+
+
+def make_worker(test: dict, worker_id: Any):
+    """client for integer ids (round-robin over nodes), nemesis
+    otherwise.  (reference: interpreter.clj:80-97)"""
+    if isinstance(worker_id, int):
+        nodes = test.get("nodes") or [None]
+        return ClientWorker(nodes[worker_id % len(nodes)])
+    return NemesisWorker()
+
+
+class _WorkerThread:
+    """Thread + queues for one worker.  (reference: interpreter.clj:99-164)"""
+
+    def __init__(self, test: dict, out: "queue.Queue", worker, worker_id):
+        self.id = worker_id
+        self.inq: "queue.Queue" = queue.Queue(maxsize=1)
+        self.test = test
+        self.out = out
+        self.worker = worker
+        self.thread = threading.Thread(
+            target=self._run, name=f"jepsen-worker-{worker_id}", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self):
+        test, out, worker = self.test, self.out, self.worker
+        try:
+            while True:
+                op = self.inq.get()
+                try:
+                    t = op.get("type")
+                    if t == "exit":
+                        return
+                    elif t == "sleep":
+                        import time as _t
+
+                        _t.sleep(op["value"])
+                        out.put(op)
+                    elif t == "log":
+                        import logging
+
+                        logging.getLogger("jepsen_tpu").info(op.get("value"))
+                        out.put(op)
+                    else:
+                        out.put(worker.invoke(test, op))
+                except Exception as e:
+                    # worker crash ⇒ indeterminate op
+                    # (reference: interpreter.clj:142-157)
+                    out.put(
+                        {
+                            **op,
+                            "type": "info",
+                            "exception": traceback.format_exc(),
+                            "exception_class": type(e).__name__,
+                            "error": f"indeterminate: {e}",
+                        }
+                    )
+        finally:
+            try:
+                worker.close(test)
+            except Exception:
+                pass
+
+
+def goes_in_history(op: dict) -> bool:
+    """:sleep and :log ops are not journaled.
+    (reference: interpreter.clj:172-179)"""
+    return op.get("type") not in ("sleep", "log")
+
+
+def run(test: dict) -> History:
+    """Evaluate all ops from test["generator"] against workers driving
+    test["client"] / test["nemesis"]; returns the History.
+    (reference: interpreter.clj:181-292)"""
+    ctx = gen.context(test)
+    worker_ids = gen.all_threads(ctx)
+    completions: "queue.Queue" = queue.Queue(maxsize=len(worker_ids))
+    workers = [
+        _WorkerThread(test, completions, make_worker(test, wid), wid)
+        for wid in worker_ids
+    ]
+    invocations: Dict[Any, "queue.Queue"] = {w.id: w.inq for w in workers}
+    g = gen.validate(gen.friendly_exceptions(test.get("generator")))
+
+    outstanding = 0
+    poll_timeout_us = 0
+    history: List[dict] = []
+
+    try:
+        while True:
+            op_done = None
+            if poll_timeout_us > 0:
+                try:
+                    op_done = completions.get(timeout=poll_timeout_us / 1e6)
+                except queue.Empty:
+                    op_done = None
+            else:
+                try:
+                    op_done = completions.get_nowait()
+                except queue.Empty:
+                    op_done = None
+
+            if op_done is not None:
+                # completion-first: latency sensitive
+                # (reference: interpreter.clj:212-241)
+                thread = gen.process_to_thread(ctx, op_done.get("process"))
+                now = relative_time_nanos()
+                op_done = {**op_done, "time": now}
+                ctx = {
+                    **ctx,
+                    "time": now,
+                    "free_threads": tuple(ctx["free_threads"]) + (thread,),
+                }
+                g = gen.update(g, test, ctx, op_done)
+                if thread != NEMESIS and op_done.get("type") == "info":
+                    workers_map = dict(ctx["workers"])
+                    workers_map[thread] = gen.next_process(ctx, thread)
+                    ctx = {**ctx, "workers": workers_map}
+                if goes_in_history(op_done):
+                    history.append(op_done)
+                outstanding -= 1
+                poll_timeout_us = 0
+                continue
+
+            now = relative_time_nanos()
+            ctx = {**ctx, "time": now}
+            res = gen.op(g, test, ctx)
+
+            if res is None:
+                if outstanding > 0:
+                    poll_timeout_us = MAX_PENDING_INTERVAL_US
+                    continue
+                for q in invocations.values():
+                    q.put({"type": "exit"})
+                for w in workers:
+                    w.thread.join(timeout=10)
+                return _to_history(history)
+
+            op, g2 = res
+            if op == gen.PENDING:
+                poll_timeout_us = MAX_PENDING_INTERVAL_US
+                continue
+
+            if now < op["time"]:
+                # not time yet; sleep until then (or a completion)
+                poll_timeout_us = max(1, int((op["time"] - now) / 1000))
+                continue
+
+            thread = gen.process_to_thread(ctx, op["process"])
+            invocations[thread].put(op)
+            ctx = {
+                **ctx,
+                "time": op["time"],
+                "free_threads": tuple(
+                    t for t in ctx["free_threads"] if t != thread
+                ),
+            }
+            g2 = gen.update(g2, test, ctx, op)
+            if goes_in_history(op):
+                history.append(op)
+            g = g2
+            outstanding += 1
+            poll_timeout_us = 0
+    except BaseException:
+        # abnormal exit: keep offering exit until each worker drains its
+        # in-flight op and accepts it, bounded in time (reference keeps
+        # offering through the queue, interpreter.clj:294-309; workers
+        # are daemon threads as a last resort)
+        import time as _time
+
+        deadline = _time.monotonic() + 10.0
+        pending = list(workers)
+        while pending and _time.monotonic() < deadline:
+            still = []
+            for w in pending:
+                if not w.thread.is_alive():
+                    continue
+                try:
+                    w.inq.put_nowait({"type": "exit"})
+                except queue.Full:
+                    still.append(w)
+            pending = still
+            if pending:
+                _time.sleep(0.01)
+        raise
+
+
+def _to_history(ops: List[dict]) -> History:
+    h = History(Op.from_dict(d) for d in ops)
+    return h.index_ops()

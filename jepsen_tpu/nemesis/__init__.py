@@ -1,0 +1,75 @@
+"""Nemesis protocol — fault injection into the system under test.
+
+(reference: jepsen/src/jepsen/nemesis.clj:11-90 for the protocol and
+validation; partitioners, grudges, and composition live in this package's
+submodules.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+
+class Nemesis:
+    def setup(self, test: dict) -> "Nemesis":
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        raise NotImplementedError
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+    #: Optional reflection: the set of :f values this nemesis handles
+    #: (reference: nemesis.clj:18-47 Reflection/fs)
+    def fs(self) -> Iterable[Any]:
+        return ()
+
+
+class NoopNemesis(Nemesis):
+    """(reference: nemesis.clj noop)"""
+
+    def invoke(self, test, op):
+        return {**op, "type": "info"}
+
+
+def noop() -> Nemesis:
+    return NoopNemesis()
+
+
+class ValidationError(Exception):
+    pass
+
+
+class Validate(Nemesis):
+    """(reference: nemesis.clj:49-90)"""
+
+    def __init__(self, nemesis: Nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        inner = self.nemesis.setup(test)
+        if inner is None:
+            raise ValidationError(
+                f"Expected nemesis setup to return a nemesis, got None from "
+                f"{self.nemesis!r}"
+            )
+        return Validate(inner)
+
+    def invoke(self, test, op):
+        res = self.nemesis.invoke(test, op)
+        if not isinstance(res, dict):
+            raise ValidationError(
+                f"Nemesis {self.nemesis!r} returned {res!r} for {op!r}"
+            )
+        return res
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def fs(self):
+        return self.nemesis.fs()
+
+
+def validate(nemesis: Nemesis) -> Nemesis:
+    return Validate(nemesis)

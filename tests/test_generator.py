@@ -1,0 +1,398 @@
+"""Generator DSL + simulator tests.
+
+Mirrors jepsen/test/jepsen/generator_test.clj's strategy: run generators
+through the deterministic virtual-time simulator and assert schedules.
+(Exact thread orders differ from the reference since RNG streams differ;
+we assert invariants plus determinism under our fixed seed.)
+"""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import sim
+
+
+def fs(history):
+    return [o.get("f") for o in history]
+
+
+def values(history):
+    return [o.get("value") for o in history]
+
+
+def times(history):
+    return [o["time"] for o in history]
+
+
+def procs(history):
+    return [o["process"] for o in history]
+
+
+# -- base lifts -------------------------------------------------------------
+
+
+def test_nil():
+    assert sim.perfect(None) == []
+
+
+def test_map_once():
+    out = sim.perfect({"f": "write"})
+    assert len(out) == 1
+    assert out[0]["type"] == "invoke"
+    assert out[0]["time"] == 0
+    assert out[0]["f"] == "write"
+
+
+def test_map_concurrent_saturates_all_threads():
+    # 3 threads (2 workers + nemesis); 6 ops: two waves of 3 at t=0, t=10
+    out = sim.perfect(gen.repeat(6, {"f": "write"}))
+    assert times(out) == [0, 0, 0, 10, 10, 10]
+    assert sorted(str(p) for p in procs(out)[:3]) == ["0", "1", "nemesis"]
+
+
+def test_map_all_threads_busy():
+    ctx = sim.default_context()
+    ctx = {**ctx, "free_threads": ()}
+    res = gen.op({"f": "write"}, {}, ctx)
+    assert res[0] == gen.PENDING
+
+
+def test_seq_vectors():
+    assert values(sim.quick([{"value": 1}, {"value": 2}, {"value": 3}])) == [
+        1,
+        2,
+        3,
+    ]
+
+
+def test_seq_nested():
+    out = sim.quick(
+        [
+            [{"value": 1}, {"value": 2}],
+            [[{"value": 3}], {"value": 4}],
+            {"value": 5},
+        ]
+    )
+    assert values(out) == [1, 2, 3, 4, 5]
+
+
+def test_fn_generator():
+    counter = {"n": 0}
+
+    def f():
+        counter["n"] += 1
+        if counter["n"] <= 3:
+            return {"value": counter["n"]}
+        return None
+
+    assert values(sim.quick(f)) == [1, 2, 3]
+
+
+def test_fn_with_args():
+    def f(test, ctx):
+        return {"value": ctx["time"]}
+
+    out = sim.perfect(gen.limit(2, f))
+    assert len(out) == 2
+
+
+# -- combinators ------------------------------------------------------------
+
+
+def test_limit():
+    out = sim.quick(gen.limit(2, gen.repeat({"f": "write", "value": 1})))
+    assert len(out) == 2
+    assert values(out) == [1, 1]
+
+
+def test_once():
+    assert len(sim.quick(gen.once(gen.repeat({"f": "w"})))) == 1
+
+
+def test_repeat_does_not_advance_inner():
+    # repeating a seq-generator re-emits its first element
+    out = sim.perfect(gen.repeat(3, [{"value": 0}, {"value": 1}]))
+    assert values(out) == [0, 0, 0]
+
+
+def test_cycle():
+    out = sim.quick(gen.cycle(2, [{"value": 1}, {"value": 2}]))
+    assert values(out) == [1, 2, 1, 2]
+
+
+def test_delay():
+    out = sim.perfect(
+        gen.limit(5, gen.delay(3e-9, gen.repeat({"f": "write"})))
+    )
+    # ops 3ns apart until all threads busy at t=6 (3 threads); the 4th
+    # op waits for a worker to free at t=10 (perfect latency)
+    assert times(out) == [0, 3, 6, 10, 13]
+
+
+def test_stagger_monotone_nondecreasing():
+    out = sim.perfect(
+        gen.limit(10, gen.stagger(5e-9, gen.repeat({"f": "w"})))
+    )
+    ts = times(out)
+    assert ts == sorted(ts)
+    assert len(out) == 10
+
+
+def test_concat_and_phases():
+    out = sim.perfect(
+        gen.phases(
+            gen.limit(2, gen.repeat({"f": "a"})),
+            gen.limit(2, gen.repeat({"f": "b"})),
+        )
+    )
+    assert fs(out) == ["a", "a", "b", "b"]
+    # phase b begins only after both a-ops complete (synchronize barrier)
+    assert times(out)[2] >= 10
+
+
+def test_then():
+    out = sim.perfect(
+        gen.then(gen.once({"f": "read"}), gen.limit(3, gen.repeat({"f": "w"})))
+    )
+    assert fs(out) == ["w", "w", "w", "read"]
+
+
+def test_map_transform():
+    out = sim.quick(gen.map(lambda o: {**o, "value": 7}, gen.limit(2, gen.repeat({"f": "w"}))))
+    assert values(out) == [7, 7]
+
+
+def test_f_map():
+    out = sim.quick(gen.f_map({"start": "start-partition"}, gen.once({"f": "start"})))
+    assert fs(out) == ["start-partition"]
+
+
+def test_filter():
+    src = [{"value": i} for i in range(10)]
+    out = sim.quick(gen.filter(lambda o: o["value"] % 2 == 0, src))
+    assert values(out) == [0, 2, 4, 6, 8]
+
+
+def test_any_prefers_soonest():
+    out = sim.perfect(
+        gen.limit(
+            4,
+            gen.any(
+                gen.delay(100e-9, gen.repeat({"f": "slow"})),
+                gen.repeat({"f": "fast"}),
+            ),
+        )
+    )
+    # fast ops at time 0 beat slow ones scheduled later
+    assert fs(out).count("fast") >= 3
+
+
+def test_mix_draws_from_all():
+    out = sim.quick(
+        gen.limit(
+            50,
+            gen.mix([gen.repeat({"f": "a"}), gen.repeat({"f": "b"})]),
+        )
+    )
+    assert set(fs(out)) == {"a", "b"}
+    assert len(out) == 50
+
+
+def test_mix_exhaustion_compacts():
+    out = sim.quick(gen.mix([gen.limit(2, gen.repeat({"f": "a"})), gen.limit(2, gen.repeat({"f": "b"}))]))
+    assert sorted(fs(out)) == ["a", "a", "b", "b"]
+
+
+def test_clients_and_nemesis_routing():
+    out = sim.perfect(
+        gen.limit(
+            6,
+            gen.clients(
+                gen.repeat({"f": "read"}), gen.repeat({"f": "break"})
+            ),
+        )
+    )
+    for o in out:
+        if o["process"] == "nemesis":
+            assert o["f"] == "break"
+        else:
+            assert o["f"] == "read"
+    assert {o["f"] for o in out} == {"read", "break"}
+
+
+def test_on_threads_restricts():
+    out = sim.perfect(
+        gen.limit(4, gen.on_threads(lambda t: t == 0, gen.repeat({"f": "w"})))
+    )
+    assert all(p == 0 for p in procs(out))
+    # sequential: single thread can't overlap its own ops
+    assert times(out) == [0, 10, 20, 30]
+
+
+def test_each_thread():
+    out = sim.perfect(gen.each_thread({"f": "meow"}))
+    # one op per thread (2 workers + nemesis)
+    assert len(out) == 3
+    assert sorted(str(p) for p in procs(out)) == ["0", "1", "nemesis"]
+
+
+def test_each_thread_exhausted_is_nil():
+    # after all threads have run it once, generator is exhausted
+    out = sim.perfect(gen.each_thread(gen.limit(2, gen.repeat({"f": "m"}))))
+    assert len(out) == 6
+
+
+def test_reserve():
+    out = sim.perfect(
+        gen.limit(
+            20,
+            gen.reserve(
+                1, gen.repeat({"f": "write"}), gen.repeat({"f": "read"})
+            ),
+        ),
+        ctx=sim.n_plus_nemesis_context(3),
+    )
+    for o in out:
+        if o["process"] == 0:
+            assert o["f"] == "write"
+        elif o["process"] == "nemesis" or o["process"] in (1, 2):
+            assert o["f"] == "read"
+    assert {o["f"] for o in out} == {"write", "read"}
+
+
+def test_reserve_updates_route_by_thread():
+    # just exercises the update path
+    g = gen.reserve(1, gen.until_ok(gen.repeat({"f": "w"})), gen.repeat({"f": "r"}))
+    out = sim.perfect_star(gen.limit(6, g))
+    assert len(out) == 12  # 6 invokes + 6 oks
+
+
+def test_process_limit():
+    out = sim.invocations(
+        sim.imperfect(
+            gen.process_limit(4, gen.repeat({"f": "w"}))
+        )
+    )
+    # crashes retire processes; only 4 distinct processes may ever appear
+    distinct = {o["process"] for o in out if o["process"] != "nemesis"}
+    assert len(distinct) <= 4
+
+
+def test_time_limit():
+    out = sim.perfect(
+        gen.time_limit(25e-9, gen.delay(10e-9, gen.repeat({"f": "w"})))
+    )
+    assert times(out) == [0, 10, 20]
+
+
+def test_until_ok_imperfect():
+    # threads cycle fail → info → ok; generator stops ISSUING once an ok
+    # completes (in-flight ops may still complete ok — reference
+    # generator_test.clj:96-120 shows two oks)
+    out = sim.imperfect(gen.clients(gen.until_ok(gen.repeat({"f": "r"}))))
+    oks = [o for o in out if o["type"] == "ok"]
+    assert len(oks) >= 1
+    first_ok_time = oks[0]["time"]
+    late_invokes = [
+        o for o in out if o["type"] == "invoke" and o["time"] > first_ok_time
+    ]
+    assert late_invokes == []
+
+
+def test_flip_flop():
+    out = sim.quick(
+        gen.limit(6, gen.flip_flop(gen.repeat({"f": "a"}), gen.repeat({"f": "b"})))
+    )
+    assert fs(out) == ["a", "b", "a", "b", "a", "b"]
+
+
+def test_flip_flop_stops_at_exhaustion():
+    out = sim.quick(gen.flip_flop(gen.limit(2, gen.repeat({"f": "a"})), gen.limit(9, gen.repeat({"f": "b"}))))
+    assert fs(out) == ["a", "b", "a", "b"]
+
+
+def test_synchronize_waits():
+    out = sim.perfect_star(
+        [
+            gen.limit(2, gen.repeat({"f": "a"})),
+            gen.synchronize(gen.once({"f": "b"})),
+        ]
+    )
+    b_invoke = next(o for o in out if o["f"] == "b" and o["type"] == "invoke")
+    a_completions = [o for o in out if o["f"] == "a" and o["type"] == "ok"]
+    assert all(b_invoke["time"] >= o["time"] for o in a_completions)
+
+
+def test_cycle_times():
+    out = sim.perfect(
+        gen.time_limit(
+            60e-9,
+            gen.cycle_times(
+                20e-9, gen.repeat({"f": "quiet"}),
+                10e-9, gen.repeat({"f": "loud"}),
+            ),
+        )
+    )
+    for o in out:
+        phase = o["time"] % 30
+        if phase < 20:
+            assert o["f"] == "quiet", o
+        else:
+            assert o["f"] == "loud", o
+
+
+def test_log_and_sleep_ops():
+    out = sim.quick([gen.log("hi"), gen.sleep(1e-9)])
+    assert out == []  # neither are invocations
+    full = sim.quick_ops([gen.log("hi")])
+    assert full[0]["type"] == "log"
+
+
+def test_validate_catches_bad_ops():
+    class Bad(gen.Generator):
+        def op(self, test, ctx):
+            return ({"f": "w"}, None)  # no type/time/process
+
+    with pytest.raises(gen.InvalidOp):
+        sim.quick(Bad())
+
+
+def test_friendly_exceptions():
+    class Boom(gen.Generator):
+        def op(self, test, ctx):
+            raise ValueError("boom")
+
+    with pytest.raises(RuntimeError, match="ValueError"):
+        gen.op(gen.friendly_exceptions(Boom()), {}, sim.default_context())
+
+
+def test_determinism_under_seed():
+    g = lambda: gen.limit(  # noqa: E731
+        30,
+        gen.mix([gen.repeat({"f": "a"}), gen.repeat({"f": "b"})]),
+    )
+    out1 = sim.perfect(g())
+    out2 = sim.perfect(g())
+    assert out1 == out2
+
+
+def test_on_update():
+    seen = []
+
+    def f(this, test, ctx, event):
+        seen.append(event["type"])
+        # delegate to the wrapped generator, preserving the hook
+        return gen.on_update(f, gen.update(this.gen, test, ctx, event))
+
+    # until_ok keeps the generator alive past completions, so update
+    # events of both kinds flow (an exhausted generator stops receiving
+    # updates — reference generator/test.clj:62-66 returns immediately)
+    sim.imperfect(gen.clients(gen.on_update(f, gen.until_ok(gen.repeat({"f": "w"})))))
+    assert "invoke" in seen and "ok" in seen
+
+
+def test_ignore_updates():
+    g = gen.ignore_updates(gen.until_ok(gen.repeat({"f": "w"})))
+    out = sim.perfect(gen.limit(5, g))
+    # updates never reach until_ok, so it never stops
+    assert len(out) == 5
