@@ -1,0 +1,44 @@
+"""Value serialization for client payloads.
+
+(reference: jepsen/src/jepsen/codec.clj:9-29 — edn↔bytes; here
+JSON-with-tuples, the Python-native equivalent.)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def _encode_value(v: Any) -> Any:
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode_value(x) for x in v]}
+    if isinstance(v, list):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _encode_value(x) for k, x in v.items()}
+    return v
+
+
+def _decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if set(v.keys()) == {"__tuple__"}:
+            return tuple(_decode_value(x) for x in v["__tuple__"])
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def encode(value: Any) -> bytes:
+    """(reference: codec.clj:9-16)"""
+    if value is None:
+        return b""
+    return json.dumps(_encode_value(value)).encode()
+
+
+def decode(data: bytes) -> Any:
+    """(reference: codec.clj:17-29)"""
+    if not data:
+        return None
+    return _decode_value(json.loads(data.decode()))

@@ -83,3 +83,150 @@ def current_session() -> Optional[Remote]:
         return None
     with _sessions_lock:
         return _sessions.get(node)
+
+
+# ---------------------------------------------------------------------------
+# Command DSL (reference: control.clj:138-218 exec/su/sudo/cd,
+# :167-189 upload/download)
+# ---------------------------------------------------------------------------
+
+
+def _dyn(name: str, default=None):
+    return getattr(_local, name, default)
+
+
+@contextmanager
+def sudo(user: str = "root", password: Optional[str] = None):
+    """Run body's commands as `user` (optionally with a sudo password,
+    fed on stdin via sudo -S).  (reference: control.clj:203-213)"""
+    prev = _dyn("sudo")
+    prev_pw = _dyn("sudo_password")
+    _local.sudo = user
+    if password is not None:
+        _local.sudo_password = password
+    try:
+        yield
+    finally:
+        _local.sudo = prev
+        _local.sudo_password = prev_pw
+
+
+su = sudo  # reference aliases su to sudo-as-root
+
+
+@contextmanager
+def cd(dir: str):
+    """Run body's commands within `dir`.  (reference: control.clj:214-218)"""
+    prev = _dyn("dir")
+    _local.dir = dir
+    try:
+        yield
+    finally:
+        _local.dir = prev
+
+
+def execute(*args, stdin: Optional[str] = None, check: bool = True):
+    """Build + run one shell command on the current node's session.
+    Args are escaped (Lit passes raw).  Returns stdout (stripped), like
+    the reference's exec (control.clj:138-157)."""
+    from .core import Command, escape, throw_on_nonzero_exit
+
+    session = current_session()
+    if session is None:
+        raise RuntimeError(
+            f"no session bound for node {current_node()!r}; "
+            "use with_session/on_nodes"
+        )
+    cmd = " ".join(escape(a) for a in args)
+    command = Command(
+        cmd=cmd,
+        stdin=stdin,
+        sudo=_dyn("sudo"),
+        dir=_dyn("dir"),
+        sudo_password=_dyn("sudo_password"),
+    )
+    result = session.execute(command)
+    if check:
+        throw_on_nonzero_exit(result)
+    return result.out.strip()
+
+
+# short name matching the reference's c/exec
+exec_ = execute
+
+
+def upload(local_path, remote_path):
+    """(reference: control.clj:167-178)"""
+    session = current_session()
+    if session is None:
+        raise RuntimeError("no session bound")
+    session.upload(local_path, remote_path)
+
+
+def download(remote_path, local_path):
+    """(reference: control.clj:179-189)"""
+    session = current_session()
+    if session is None:
+        raise RuntimeError("no session bound")
+    session.download(remote_path, local_path)
+
+
+def _binding_snapshot() -> dict:
+    """Capture the caller's dynamic bindings so worker threads inherit
+    them — the reference's binding conveyance (util.clj:65-83)."""
+    return {
+        "sudo": _dyn("sudo"),
+        "dir": _dyn("dir"),
+        "sudo_password": _dyn("sudo_password"),
+    }
+
+
+@contextmanager
+def _with_bindings(snapshot: dict):
+    prev = {k: _dyn(k) for k in snapshot}
+    for k, v in snapshot.items():
+        setattr(_local, k, v)
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            setattr(_local, k, v)
+
+
+def on_nodes(test: dict, fn_or_nodes, maybe_fn=None) -> Dict[Any, Any]:
+    """Run (fn test node) on some (default: all) nodes concurrently, with
+    the node binding set and the caller's sudo/cd bindings conveyed.
+    Returns {node: result}.  (reference: control.clj:295-311)"""
+    from ..util import real_pmap
+
+    if maybe_fn is None:
+        nodes, fn = test["nodes"], fn_or_nodes
+    else:
+        nodes, fn = fn_or_nodes, maybe_fn
+    snapshot = _binding_snapshot()
+
+    def run_one(node):
+        with _with_bindings(snapshot):
+            return with_node(node, lambda: fn(test, node))
+
+    return dict(zip(nodes, real_pmap(run_one, list(nodes))))
+
+
+def on_many(nodes, thunk: Callable[[], Any]) -> Dict[Any, Any]:
+    """Run thunk bound to each node concurrently; {node: result}.
+    Conveys the caller's sudo/cd bindings into the worker threads.
+    (reference: control.clj:272-293 on-many)"""
+    from ..util import real_pmap
+
+    snapshot = _binding_snapshot()
+
+    def run_one(node):
+        with _with_bindings(snapshot):
+            return with_node(node, thunk)
+
+    return dict(zip(nodes, real_pmap(run_one, list(nodes))))
+
+
+def with_test_nodes(test: dict, thunk: Callable[[], Any]) -> Dict[Any, Any]:
+    """(reference: control.clj with-test-nodes)"""
+    return on_many(test["nodes"], thunk)

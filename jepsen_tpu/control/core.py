@@ -68,6 +68,7 @@ class Command:
     stdin: Optional[str] = None
     sudo: Optional[str] = None
     dir: Optional[str] = None
+    sudo_password: Optional[str] = None
 
 
 def wrap_sudo(command: Command) -> str:
@@ -79,6 +80,15 @@ def wrap_sudo(command: Command) -> str:
     if command.sudo:
         cmd = f"sudo -k -S -u {escape(command.sudo)} bash -c {escape(cmd)}"
     return cmd
+
+
+def effective_stdin(command: Command) -> Optional[str]:
+    """The stdin a transport should feed: sudo -S reads the password from
+    the first stdin line, so prepend it ahead of any command stdin
+    (reference semantics: control/core.clj:142-153 feeds *password*)."""
+    if command.sudo and command.sudo_password is not None:
+        return command.sudo_password + "\n" + (command.stdin or "")
+    return command.stdin
 
 
 @dataclass

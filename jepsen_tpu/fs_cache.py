@@ -1,0 +1,89 @@
+"""Control-node file cache for downloaded artifacts, with atomic writes.
+
+(reference: jepsen/src/jepsen/fs_cache.clj — cache layout and encoding,
+write-atomic! :140-170, cached? :184-200, save-remote!/deploy-remote!
+:244-278.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from . import control
+
+DEFAULT_DIR = os.path.expanduser("~/.jepsen_tpu/cache")
+
+
+def _key_path(base: str, key: Any) -> str:
+    """Encode an arbitrary key into a filesystem path."""
+    if isinstance(key, (list, tuple)):
+        digest = hashlib.sha256(repr(tuple(key)).encode()).hexdigest()[:32]
+    else:
+        digest = hashlib.sha256(str(key).encode()).hexdigest()[:32]
+    return os.path.join(base, digest[:2], digest)
+
+
+class Cache:
+    def __init__(self, directory: str = DEFAULT_DIR):
+        self.dir = directory
+
+    def path(self, key: Any) -> str:
+        return _key_path(self.dir, key)
+
+    def cached(self, key: Any) -> bool:
+        """(reference: fs_cache.clj:184-200)"""
+        return os.path.exists(self.path(key))
+
+    @contextmanager
+    def atomic_write(self, key: Any):
+        """Yield a temp path; on clean exit it's renamed into place.
+        (reference: fs_cache.clj:140-170 write-atomic!)"""
+        dest = self.path(key)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest))
+        os.close(fd)
+        try:
+            yield tmp
+            os.replace(tmp, dest)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def save_bytes(self, data: bytes, key: Any) -> str:
+        with self.atomic_write(key) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(data)
+        return self.path(key)
+
+    def load_bytes(self, key: Any) -> Optional[bytes]:
+        if not self.cached(key):
+            return None
+        with open(self.path(key), "rb") as f:
+            return f.read()
+
+    def save_remote(self, remote_path: str, key: Any) -> str:
+        """Download a file from the current node into the cache.
+        (reference: fs_cache.clj:244-251)"""
+        with self.atomic_write(key) as tmp:
+            control.download(remote_path, tmp)
+        return self.path(key)
+
+    def deploy_remote(self, key: Any, remote_path: str) -> None:
+        """Upload a cached file to the current node.
+        (reference: fs_cache.clj:252-260)"""
+        local = self.path(key)
+        if not os.path.exists(local):
+            raise FileNotFoundError(f"cache miss for {key!r}")
+        control.upload(local, remote_path)
+
+    def clear(self) -> None:
+        if os.path.exists(self.dir):
+            shutil.rmtree(self.dir)
+
+
+cache = Cache()

@@ -1,0 +1,199 @@
+"""Cluster-membership nemesis: join/leave churn as a state machine with
+per-node views and pending-op resolution.
+
+(reference: jepsen/src/jepsen/nemesis/membership.clj — node-view-interval
+:59-61, initial-state :68-77, resolve/resolve-ops :79-107,
+update-node-view! :109-142, node-view-future :143-157, the Nemesis record
+:159-225, the Generator :227-237, package :239-270 — plus
+membership/state.clj:21-58 for the State protocol.)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .. import control
+from .. import generator as gen
+from . import Nemesis
+
+log = logging.getLogger("jepsen_tpu.nemesis.membership")
+
+#: Seconds between node-view refreshes (reference: membership.clj:59-61)
+NODE_VIEW_INTERVAL = 5
+
+
+class State:
+    """Membership state machine protocol.  Implementations carry three
+    special fields maintained by the nemesis: ``node_views`` (node →
+    view), ``view`` (merged view), ``pending`` (list of (op, op') dict
+    pairs, matching the reference's contract).
+    (reference: membership/state.clj:21-58)"""
+
+    node_views: Dict[Any, Any]
+    view: Any
+    pending: List[Tuple[dict, dict]]
+
+    def setup(self, test: dict) -> "State":
+        return self
+
+    def node_view(self, test: dict, node: Any) -> Any:
+        """The cluster as seen from `node`; None = unknown."""
+        return None
+
+    def merge_views(self, test: dict) -> Any:
+        """Derive the authoritative view from node_views."""
+        return self.view
+
+    def fs(self) -> Set[Any]:
+        return set()
+
+    def op(self, test: dict):
+        """Next membership op to perform, or "pending" if none."""
+        return "pending"
+
+    def invoke(self, test: dict, op: dict):
+        """Apply an op. Returns op' or (op', state')."""
+        raise NotImplementedError
+
+    def resolve(self, test: dict) -> "State":
+        """Evolve toward a fixed point."""
+        return self
+
+    def resolve_op(self, test: dict, op_pair: Tuple) -> Optional["State"]:
+        """If op_pair has resolved, the new state; else None."""
+        return None
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+
+def _init_special_fields(state: State) -> State:
+    if not hasattr(state, "node_views") or state.node_views is None:
+        state.node_views = {}
+    if not hasattr(state, "view"):
+        state.view = None
+    # pending holds REAL (op, op') dict pairs, as the State contract
+    # documents; stored as a list (dicts aren't hashable) with
+    # identity-based removal
+    if not hasattr(state, "pending") or state.pending is None:
+        state.pending = []
+    elif isinstance(state.pending, set):
+        state.pending = list(state.pending)
+    return state
+
+
+def _resolve(state: State, test: dict) -> State:
+    """resolve + resolve-ops to fixed point.
+    (reference: membership.clj:79-107)"""
+    for _ in range(100):
+        before = (state.view, len(state.pending))
+        state = state.resolve(test) or state
+        remaining = []
+        for pair in list(state.pending):
+            s2 = state.resolve_op(test, pair)
+            if s2 is not None:
+                state = s2
+            else:
+                remaining.append(pair)
+        state.pending = remaining
+        if (state.view, len(state.pending)) == before:
+            return state
+    return state
+
+
+class MembershipNemesis(Nemesis):
+    """(reference: membership.clj:159-225)"""
+
+    def __init__(self, state: State, opts: Optional[dict] = None):
+        self.lock = threading.RLock()
+        self.state = _init_special_fields(state)
+        self.opts = opts or {}
+        self.running = False
+        self.threads: List[threading.Thread] = []
+
+    def setup(self, test):
+        with self.lock:
+            self.state = _init_special_fields(self.state.setup(test) or self.state)
+        self.running = True
+        for node in test["nodes"]:
+            t = threading.Thread(
+                target=self._view_loop,
+                args=(test, node),
+                name=f"membership-view-{node}",
+                daemon=True,
+            )
+            t.start()
+            self.threads.append(t)
+        return self
+
+    def _view_loop(self, test, node):
+        """(reference: membership.clj:109-157)"""
+        import time as _time
+
+        while self.running:
+            try:
+                control.with_node(node, lambda: self._update_node_view(test, node))
+            except Exception:
+                log.exception("node view updater for %s failed; will retry", node)
+            _time.sleep(NODE_VIEW_INTERVAL)
+
+    def _update_node_view(self, test, node):
+        nv = self.state.node_view(test, node)
+        if nv is None:
+            return
+        with self.lock:
+            self.state.node_views = {**self.state.node_views, node: nv}
+            self.state.view = self.state.merge_views(test)
+            self.state = _resolve(self.state, test)
+
+    def invoke(self, test, op):
+        with self.lock:
+            res = self.state.invoke(test, op)
+            if isinstance(res, tuple):
+                op2, state2 = res
+                self.state = _init_special_fields(state2)
+            else:
+                op2 = res
+            self.state.pending = list(self.state.pending) + [(op, op2)]
+            self.state = _resolve(self.state, test)
+            return op2
+
+    def teardown(self, test):
+        self.running = False
+        self.state.teardown(test)
+
+    def fs(self):
+        return self.state.fs()
+
+
+class MembershipGenerator(gen.Generator):
+    """Ask the state machine for its next op.
+    (reference: membership.clj:227-237)"""
+
+    def __init__(self, nemesis: MembershipNemesis):
+        self.nemesis = nemesis
+
+    def op(self, test, ctx):
+        with self.nemesis.lock:
+            o = self.nemesis.state.op(test)
+        if o is None:
+            return None
+        if o == "pending":
+            return (gen.PENDING, self)
+        return (gen.fill_in_op(dict(o), ctx), self)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def package(opts: dict) -> Optional[dict]:
+    """{state, nemesis, generator} package, or None if membership faults
+    aren't enabled.  (reference: membership.clj:239-270)"""
+    if "membership" not in set(opts.get("faults", ())):
+        return None
+    mopts = opts.get("membership", {})
+    nem = MembershipNemesis(mopts["state"], mopts)
+    g = gen.stagger(opts.get("interval", 10), MembershipGenerator(nem))
+    return {"state": nem, "nemesis": nem, "generator": g}

@@ -1,0 +1,203 @@
+"""Network fault primitives (reference: jepsen/src/jepsen/net.clj).
+
+The Net protocol (:15-26): drop!/heal!/slow!/flaky!/fast!, plus the
+grudge-bulk drop-all! (:29-44, with the iptables fast path :101-111).
+A *grudge* maps each node to the set of nodes it should drop traffic
+FROM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+from . import control
+from .control.core import RemoteError, lit
+from .util import real_pmap
+
+TC = "/sbin/tc"
+
+
+def node_ip(node: Any) -> str:
+    """Resolve a node's IP.  On real clusters this shells out to
+    getent/host (reference: control/net.clj); nodes that already look
+    like IPs (or dummy nodes) pass through."""
+    s = str(node)
+    if all(c.isdigit() or c == "." for c in s) and s.count(".") == 3:
+        return s
+    try:
+        out = control.execute("getent", "ahostsv4", s, check=True)
+        first = out.split()
+        return first[0] if first else s
+    except Exception:
+        return s
+
+
+class Net:
+    def drop(self, test: dict, src: Any, dest: Any) -> None:
+        raise NotImplementedError
+
+    def heal(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def slow(self, test: dict, opts: Optional[dict] = None) -> None:
+        raise NotImplementedError
+
+    def flaky(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def fast(self, test: dict) -> None:
+        raise NotImplementedError
+
+    # PartitionAll fast path (reference: net/proto.clj + net.clj:101-111)
+    def drop_all(self, test: dict, grudge: Dict[Any, Iterable[Any]]) -> None:
+        pairs = [
+            (src, dst) for dst, srcs in grudge.items() for src in srcs
+        ]
+        real_pmap(lambda p: self.drop(test, p[0], p[1]), pairs)
+
+
+class NoopNet(Net):
+    """(reference: net.clj:48-56)"""
+
+    def drop(self, test, src, dest):
+        pass
+
+    def heal(self, test):
+        pass
+
+    def slow(self, test, opts=None):
+        pass
+
+    def flaky(self, test):
+        pass
+
+    def fast(self, test):
+        pass
+
+    def drop_all(self, test, grudge):
+        pass
+
+
+noop = NoopNet()
+
+
+class IPTables(Net):
+    """Default iptables implementation (reference: net.clj:58-111)."""
+
+    def drop(self, test, src, dest):
+        def thunk():
+            with control.su():
+                control.execute(
+                    "iptables", "-A", "INPUT", "-s", node_ip(src), "-j",
+                    "DROP", "-w",
+                )
+
+        control.on_many([dest], thunk)
+
+    def heal(self, test):
+        def thunk():
+            with control.su():
+                control.execute("iptables", "-F", "-w")
+                control.execute("iptables", "-X", "-w")
+
+        control.with_test_nodes(test, thunk)
+
+    def slow(self, test, opts=None):
+        opts = opts or {}
+        mean = opts.get("mean", 50)
+        variance = opts.get("variance", 10)
+        distribution = opts.get("distribution", "normal")
+
+        def thunk():
+            with control.su():
+                control.execute(
+                    TC, "qdisc", "add", "dev", "eth0", "root", "netem",
+                    "delay", f"{mean}ms", f"{variance}ms", "distribution",
+                    distribution,
+                )
+
+        control.with_test_nodes(test, thunk)
+
+    def flaky(self, test):
+        def thunk():
+            with control.su():
+                control.execute(
+                    TC, "qdisc", "add", "dev", "eth0", "root", "netem",
+                    "loss", "20%", "75%",
+                )
+
+        control.with_test_nodes(test, thunk)
+
+    def fast(self, test):
+        def thunk():
+            with control.su():
+                try:
+                    control.execute(TC, "qdisc", "del", "dev", "eth0", "root")
+                except RemoteError as e:
+                    if "RTNETLINK answers: No such file or directory" in str(e):
+                        return
+                    raise
+
+        control.with_test_nodes(test, thunk)
+
+    def drop_all(self, test, grudge):
+        # one iptables rule per node with a comma-joined source list
+        # (reference: net.clj:101-111 PartitionAll fast path)
+        def snub(test_, node):
+            srcs = list(grudge.get(node) or [])
+            if not srcs:
+                return
+            with control.su():
+                control.execute(
+                    "iptables", "-A", "INPUT", "-s",
+                    ",".join(node_ip(s) for s in srcs), "-j", "DROP", "-w",
+                )
+
+        control.on_nodes(test, list(grudge.keys()), snub)
+
+
+iptables = IPTables()
+
+
+class IPFilter(Net):
+    """ipf-based variant for SmartOS/illumos (reference: net.clj:113-145)."""
+
+    def drop(self, test, src, dest):
+        def thunk():
+            with control.su():
+                control.execute(
+                    lit(f"echo block in from {node_ip(src)} to any | ipf -f -")
+                )
+
+        control.on_many([dest], thunk)
+
+    def heal(self, test):
+        def thunk():
+            with control.su():
+                control.execute("ipf", "-Fa")
+
+        control.with_test_nodes(test, thunk)
+
+    slow = IPTables.slow
+    flaky = IPTables.flaky
+
+    def fast(self, test):
+        def thunk():
+            with control.su():
+                control.execute(TC, "qdisc", "del", "dev", "eth0", "root")
+
+        control.with_test_nodes(test, thunk)
+
+
+ipfilter = IPFilter()
+
+
+def drop_all(test: dict, grudge: Dict[Any, Iterable[Any]]) -> None:
+    """Apply a grudge via the test's net.  (reference: net.clj:29-44)"""
+    net = test.get("net", iptables)
+    net.drop_all(test, grudge)
+
+
+def heal(test: dict) -> None:
+    net = test.get("net", iptables)
+    net.heal(test)

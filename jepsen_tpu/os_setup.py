@@ -1,0 +1,149 @@
+"""OS provisioning (reference: jepsen/src/jepsen/os.clj:4-16 protocol;
+os/debian.clj, os/centos.clj, os/ubuntu.clj, os/smartos.clj).
+
+Sets up hostfiles, installs base packages, disables unattended upgrades —
+the pre-DB groundwork each node needs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Iterable, List
+
+from . import control
+from .control.core import RemoteError, lit
+from .control.util import meh
+
+log = logging.getLogger("jepsen_tpu.os")
+
+
+class OS:
+    """(reference: os.clj:4-8)"""
+
+    def setup(self, test: dict, node: Any) -> None:
+        pass
+
+    def teardown(self, test: dict, node: Any) -> None:
+        pass
+
+
+class NoopOS(OS):
+    pass
+
+
+noop = NoopOS()
+
+
+def setup_hostfile(test: dict, node: Any) -> None:
+    """Write /etc/hosts entries for every test node.
+    (reference: os/debian.clj:13-26 setup-hostfile!)"""
+    lines = ["127.0.0.1 localhost"]
+    for n in test["nodes"]:
+        try:
+            from .net import node_ip
+
+            ip = node_ip(n)
+        except Exception:
+            ip = str(n)
+        lines.append(f"{ip} {n}")
+    content = "\n".join(lines) + "\n"
+    with control.su():
+        from .control.util import write_file
+
+        write_file(content, "/etc/hosts")
+
+
+class Debian(OS):
+    """(reference: os/debian.clj)"""
+
+    def __init__(self, extra_packages: Iterable[str] = ()):
+        self.extra_packages = list(extra_packages)
+
+    base_packages = [
+        "curl",
+        "faketime",
+        "iptables",
+        "iputils-ping",
+        "logrotate",
+        "man-db",
+        "net-tools",
+        "ntpdate",
+        "psmisc",
+        "rsyslog",
+        "sudo",
+        "tar",
+        "unzip",
+        "wget",
+    ]
+
+    def setup(self, test, node):
+        setup_hostfile(test, node)
+        with control.su():
+            # stop unattended upgrades from holding the dpkg lock
+            meh(lambda: control.execute("systemctl", "stop", "unattended-upgrades", check=False))
+            control.execute(
+                "env", "DEBIAN_FRONTEND=noninteractive", "apt-get", "install",
+                "-y", "--no-install-recommends",
+                *(self.base_packages + self.extra_packages),
+            )
+
+    def install(self, packages: Iterable[str]) -> None:
+        """(reference: os/debian.clj install)"""
+        with control.su():
+            control.execute(
+                "env", "DEBIAN_FRONTEND=noninteractive", "apt-get", "install",
+                "-y", "--no-install-recommends", *packages,
+            )
+
+    def installed_version(self, package: str) -> str:
+        return control.execute(
+            "dpkg-query", "-W", "-f", "${Version}", package
+        )
+
+
+debian = Debian()
+
+
+class CentOS(OS):
+    """(reference: os/centos.clj)"""
+
+    base_packages = [
+        "curl",
+        "iptables",
+        "iputils",
+        "logrotate",
+        "man-db",
+        "net-tools",
+        "ntpdate",
+        "psmisc",
+        "rsyslog",
+        "sudo",
+        "tar",
+        "unzip",
+        "wget",
+    ]
+
+    def setup(self, test, node):
+        setup_hostfile(test, node)
+        with control.su():
+            control.execute("yum", "install", "-y", *self.base_packages)
+
+    def install(self, packages: Iterable[str]) -> None:
+        with control.su():
+            control.execute("yum", "install", "-y", *packages)
+
+
+centos = CentOS()
+
+
+class Ubuntu(Debian):
+    """Ubuntu = Debian + snapd/cloud-init quirks handled.
+    (reference: os/ubuntu.clj:14-46)"""
+
+    def setup(self, test, node):
+        with control.su():
+            meh(lambda: control.execute("systemctl", "stop", "snapd", check=False))
+        super().setup(test, node)
+
+
+ubuntu = Ubuntu()

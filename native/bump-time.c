@@ -1,0 +1,55 @@
+/* bump-time: jump the system wall clock by a signed delta, in
+ * milliseconds, then print the resulting POSIX time in ms.
+ *
+ * Usage: bump-time <delta-ms>
+ *
+ * Compiled with gcc on each DB node by the clock nemesis (same
+ * deployment mechanism as the reference's resources/bump-time.c,
+ * behavior re-implemented from its interface: one-shot settimeofday
+ * jump).  Requires CAP_SYS_TIME (run as root).
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+
+int main(int argc, char **argv) {
+  struct timeval tv;
+  long long delta_ms;
+  char *end;
+
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+    return 2;
+  }
+
+  delta_ms = strtoll(argv[1], &end, 10);
+  if (*end != '\0') {
+    fprintf(stderr, "bad delta: %s\n", argv[1]);
+    return 2;
+  }
+
+  if (gettimeofday(&tv, NULL) != 0) {
+    perror("gettimeofday");
+    return 1;
+  }
+
+  /* add delta, normalizing microseconds */
+  long long usec = (long long)tv.tv_usec + (delta_ms % 1000) * 1000LL;
+  tv.tv_sec += delta_ms / 1000 + usec / 1000000LL;
+  usec %= 1000000LL;
+  if (usec < 0) {
+    usec += 1000000LL;
+    tv.tv_sec -= 1;
+  }
+  tv.tv_usec = (suseconds_t)usec;
+
+  if (settimeofday(&tv, NULL) != 0) {
+    perror("settimeofday");
+    return 1;
+  }
+
+  printf("%lld\n", (long long)tv.tv_sec * 1000LL + tv.tv_usec / 1000);
+  return 0;
+}

@@ -1,0 +1,77 @@
+/* strobe-time: oscillate the system wall clock by +/- delta ms every
+ * period ms, for duration seconds, using CLOCK_MONOTONIC as the
+ * untouched reference timeline.
+ *
+ * Usage: strobe-time <delta-ms> <period-ms> <duration-s>
+ *
+ * Behavior mirrors the reference's resources/strobe-time.c interface
+ * (re-implemented): at each period boundary the wall clock flips
+ * between base+delta and base-delta, where base tracks real elapsed
+ * monotonic time from the start, so the clock averages true time while
+ * strobing around it.  Requires CAP_SYS_TIME.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+#include <sys/time.h>
+
+static long long mono_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static int set_wall_ms(long long wall_ms) {
+  struct timeval tv;
+  tv.tv_sec = wall_ms / 1000;
+  tv.tv_usec = (wall_ms % 1000) * 1000;
+  return settimeofday(&tv, NULL);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-s>\n",
+            argv[0]);
+    return 2;
+  }
+
+  long long delta_ms = atoll(argv[1]);
+  long long period_ms = atoll(argv[2]);
+  long long duration_s = atoll(argv[3]);
+
+  if (period_ms <= 0 || duration_s <= 0) {
+    fprintf(stderr, "period and duration must be positive\n");
+    return 2;
+  }
+
+  struct timeval tv0;
+  if (gettimeofday(&tv0, NULL) != 0) {
+    perror("gettimeofday");
+    return 1;
+  }
+  long long wall0_ms = (long long)tv0.tv_sec * 1000LL + tv0.tv_usec / 1000;
+  long long mono0 = mono_ns();
+  long long end_ns = mono0 + duration_s * 1000000000LL;
+  int sign = 1;
+
+  while (mono_ns() < end_ns) {
+    long long elapsed_ms = (mono_ns() - mono0) / 1000000LL;
+    long long target = wall0_ms + elapsed_ms + sign * delta_ms;
+    if (set_wall_ms(target) != 0) {
+      perror("settimeofday");
+      return 1;
+    }
+    sign = -sign;
+    usleep((useconds_t)(period_ms * 1000));
+  }
+
+  /* restore: wall = start + true elapsed */
+  long long elapsed_ms = (mono_ns() - mono0) / 1000000LL;
+  if (set_wall_ms(wall0_ms + elapsed_ms) != 0) {
+    perror("settimeofday");
+    return 1;
+  }
+  return 0;
+}

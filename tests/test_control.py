@@ -1,0 +1,245 @@
+"""Control DSL tests: escaping, sudo wrapping, sessions, daemon helpers
+against the dummy remote (reference: jepsen/test/jepsen/control_test.clj
+and control/util_test.clj)."""
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.control import util as cutil
+from jepsen_tpu.control.core import (
+    Command,
+    DummyRemote,
+    RemoteError,
+    Result,
+    escape,
+    env,
+    lit,
+    throw_on_nonzero_exit,
+    wrap_sudo,
+)
+
+
+def test_escape():
+    assert escape("simple") == "simple"
+    assert escape("with space") == "'with space'"
+    assert escape("it's") == "'it'\\''s'"
+    assert escape("") == "''"
+    assert escape(123) == "123"
+    assert escape(True) == "true"
+    assert escape(lit("a | b")) == "a | b"
+    assert escape(["a", "b c"]) == "a 'b c'"
+    assert escape("/path/to/file-2.0") == "/path/to/file-2.0"
+
+
+def test_env():
+    assert env(None) == []
+    assert env({"B": "2", "A": "a value"}) == ["A='a value'", "B=2"]
+
+
+def test_wrap_sudo():
+    c = Command(cmd="ls /root")
+    assert wrap_sudo(c) == "ls /root"
+    c = Command(cmd="ls /root", sudo="root")
+    assert wrap_sudo(c) == "sudo -k -S -u root bash -c 'ls /root'"
+    c = Command(cmd="make", dir="/build", sudo="admin")
+    assert wrap_sudo(c) == "sudo -k -S -u admin bash -c 'cd /build; make'"
+
+
+def test_throw_on_nonzero():
+    assert throw_on_nonzero_exit(Result(cmd="x", exit=0)).exit == 0
+    with pytest.raises(RemoteError):
+        throw_on_nonzero_exit(Result(cmd="x", exit=1, err="bad"))
+
+
+def test_session_binding_and_execute():
+    test = {"nodes": ["n1", "n2"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        out = control.on_nodes(test, lambda t, node: control.execute("hostname"))
+        assert set(out.keys()) == {"n1", "n2"}
+    # both nodes saw the command
+    assert {node for node, c in remote.log} == {"n1", "n2"}
+
+
+def test_execute_outside_session_raises():
+    with pytest.raises(RuntimeError, match="no session"):
+        control.execute("ls")
+
+
+def test_sudo_and_cd_context():
+    test = {"nodes": ["n1"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+
+        def thunk():
+            with control.su():
+                with control.cd("/tmp"):
+                    control.execute("ls")
+
+        control.on_many(["n1"], thunk)
+    node, cmd = remote.log[0]
+    assert cmd.sudo == "root"
+    assert cmd.dir == "/tmp"
+
+
+def test_nested_node_binding_restored():
+    test = {"nodes": ["n1", "n2"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        def inner():
+            assert control.current_node() == "n2"
+            return "ok"
+
+        def outer():
+            assert control.current_node() == "n1"
+            control.with_node("n2", inner)
+            assert control.current_node() == "n1"
+
+        control.with_node("n1", outer)
+
+
+def test_sudo_binding_conveys_into_on_nodes():
+    """with su(): on_nodes(...) must run the node commands as root —
+    dynamic-binding conveyance into worker threads."""
+    test = {"nodes": ["n1", "n2"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        with control.su():
+            control.on_nodes(test, lambda t, node: control.execute("whoami"))
+    sudos = [c.sudo for node, c in remote.log if hasattr(c, "sudo")]
+    assert sudos == ["root", "root"]
+
+
+def test_sudo_password_feeds_stdin():
+    from jepsen_tpu.control.core import Command, effective_stdin
+
+    c = Command(cmd="ls", sudo="root", sudo_password="hunter2", stdin="data")
+    assert effective_stdin(c) == "hunter2\ndata"
+    c2 = Command(cmd="ls", stdin="data")
+    assert effective_stdin(c2) == "data"
+
+
+def test_daemon_helpers_emit_commands():
+    test = {"nodes": ["n1"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+
+        def thunk():
+            cutil.start_daemon(
+                {
+                    "logfile": "/var/log/db.log",
+                    "pidfile": "/var/run/db.pid",
+                    "chdir": "/opt/db",
+                },
+                "/opt/db/bin/db",
+                "--port",
+                5000,
+            )
+            cutil.stop_daemon(pidfile="/var/run/db.pid", cmd="db")
+            cutil.grepkill("dbproc")
+
+        control.on_many(["n1"], thunk)
+    cmds = [c.cmd for node, c in remote.log if hasattr(c, "cmd")]
+    ssd = [c for c in cmds if "start-stop-daemon" in c]
+    assert ssd
+    assert "--pidfile /var/run/db.pid" in ssd[0]
+    assert "--chdir /opt/db" in ssd[0]
+    assert "--startas /opt/db/bin/db" in ssd[0]
+    assert any("killall -9 -w db" in c for c in cmds)
+    assert any("xargs --no-run-if-empty kill -9" in c for c in cmds)
+
+
+def test_write_file_uses_stdin():
+    test = {"nodes": ["n1"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        control.on_many(["n1"], lambda: cutil.write_file("hello\n", "/etc/motd"))
+    node, cmd = remote.log[0]
+    assert cmd.stdin == "hello\n"
+    assert "cat > /etc/motd" in cmd.cmd
+
+
+def test_retry_remote_reconnects():
+    from jepsen_tpu.control.retry import RetryRemote
+
+    class FlakyRemote(DummyRemote):
+        def __init__(self, fail_times=2, state=None):
+            super().__init__()
+            self.state = state if state is not None else {"fails": fail_times}
+
+        def connect(self, node, test=None):
+            r = FlakyRemote(state=self.state)
+            r.node = node
+            return r
+
+        def execute(self, command):
+            if self.state["fails"] > 0:
+                self.state["fails"] -= 1
+                raise OSError("connection reset")
+            return Result(cmd=command.cmd, exit=0, out="ok", node=self.node)
+
+    remote = RetryRemote(FlakyRemote(), backoff=0.001)
+    conn = remote.connect("n1")
+    res = conn.execute(Command(cmd="ls"))
+    assert res.out == "ok"
+
+
+def test_retry_remote_does_not_mask_command_failure():
+    from jepsen_tpu.control.retry import RetryRemote
+
+    class FailingRemote(DummyRemote):
+        def connect(self, node, test=None):
+            r = FailingRemote()
+            r.node = node
+            return r
+
+        def execute(self, command):
+            raise RemoteError(Result(cmd=command.cmd, exit=7, node=self.node))
+
+    conn = RetryRemote(FailingRemote(), backoff=0.001).connect("n1")
+    with pytest.raises(RemoteError):
+        conn.execute(Command(cmd="false"))
+
+
+def test_net_iptables_grudge_fast_path():
+    from jepsen_tpu import net
+
+    test = {"nodes": ["n1", "n2", "n3"], "net": net.iptables}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        net.drop_all(test, {"n1": {"n2", "n3"}, "n2": set()})
+    cmds = [(node, c.cmd) for node, c in remote.log if hasattr(c, "cmd")]
+    n1_cmds = [c for node, c in cmds if node == "n1"]
+    assert any("iptables -A INPUT -s" in c and "DROP" in c for c in n1_cmds)
+    # n2 has an empty grudge: no DROP rule
+    assert not [c for node, c in cmds if node == "n2" and "DROP" in c]
+
+
+def test_os_debian_setup_emits_apt():
+    from jepsen_tpu import os_setup
+
+    test = {"nodes": ["n1"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        control.on_nodes(test, lambda t, node: os_setup.debian.setup(t, node))
+    cmds = [c.cmd for node, c in remote.log if hasattr(c, "cmd")]
+    assert any("apt-get install" in c for c in cmds)
+    assert any("cat > /etc/hosts" in c for c in cmds)
+
+
+def test_clock_nemesis_compiles_tools_on_node():
+    from jepsen_tpu.nemesis import time as nt
+
+    test = {"nodes": ["n1"]}
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        nem = nt.clock_nemesis().setup(test)
+        nem.invoke(
+            test, {"f": "bump", "value": {"n1": 4096}, "process": "nemesis", "time": 0}
+        )
+    cmds = [c.cmd for node, c in remote.log if hasattr(c, "cmd")]
+    assert any("gcc -O2 -o /opt/jepsen/bump-time" in c for c in cmds)
+    assert any("/opt/jepsen/bump-time 4096" in c for c in cmds)
+    # uploaded source is real C with settimeofday
+    stdins = [c.stdin for node, c in remote.log if hasattr(c, "stdin") and c.stdin]
+    assert any("settimeofday" in s for s in stdins)

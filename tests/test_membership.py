@@ -1,0 +1,95 @@
+"""Membership nemesis state-machine tests (reference:
+jepsen/src/jepsen/nemesis/membership.clj + membership/state.clj)."""
+
+import time
+
+from jepsen_tpu import control, generator as gen
+from jepsen_tpu.control.core import DummyRemote
+from jepsen_tpu.nemesis import membership
+
+
+class GrowShrinkState(membership.State):
+    """A toy cluster whose members can be added/removed; node views
+    converge instantly."""
+
+    def __init__(self, members):
+        self.members = set(members)
+        self.node_views = {}
+        self.view = None
+        self.pending = []
+        self.resolved_log = []
+
+    def node_view(self, test, node):
+        return frozenset(self.members)
+
+    def merge_views(self, test):
+        views = list(self.node_views.values())
+        return views[0] if views else None
+
+    def fs(self):
+        return {"add-node", "remove-node"}
+
+    def op(self, test):
+        candidates = [n for n in test["nodes"] if n not in self.members]
+        if candidates:
+            return {"f": "add-node", "value": candidates[0]}
+        if len(self.members) > 1:
+            return {"f": "remove-node", "value": sorted(self.members)[0]}
+        return "pending"
+
+    def invoke(self, test, op):
+        if op["f"] == "add-node":
+            self.members.add(op["value"])
+        elif op["f"] == "remove-node":
+            self.members.discard(op["value"])
+        return {**op, "type": "info"}
+
+    def resolve_op(self, test, op_pair):
+        self.resolved_log.append(op_pair)
+        return self  # instantly resolved
+
+
+def test_membership_nemesis_lifecycle():
+    test = {"nodes": ["n1", "n2", "n3"], "concurrency": 1}
+    state = GrowShrinkState(["n1"])
+    nem = membership.MembershipNemesis(state)
+    remote = DummyRemote()
+    with control.with_session(test, remote):
+        nem = nem.setup(test)
+        try:
+            out = nem.invoke(
+                test, {"f": "add-node", "value": "n2", "process": "nemesis", "time": 0}
+            )
+            assert out["type"] == "info"
+            assert "n2" in nem.state.members
+            # pending op resolved instantly and removed
+            assert nem.state.pending == []
+            # resolve_op received the REAL (op, op') dict pair
+            assert nem.state.resolved_log
+            inv, comp = nem.state.resolved_log[0]
+            assert inv["f"] == "add-node" and inv["value"] == "n2"
+            assert comp["type"] == "info"
+        finally:
+            nem.teardown(test)
+    assert nem.running is False
+
+
+def test_membership_generator_asks_state():
+    test = {"nodes": ["n1", "n2"], "concurrency": 1}
+    state = GrowShrinkState(["n1", "n2"])
+    nem = membership.MembershipNemesis(state)
+    g = membership.MembershipGenerator(nem)
+    ctx = gen.context(test)
+    op, g2 = gen.op(g, test, ctx)
+    assert op["f"] == "remove-node"
+    assert op["type"] == "invoke"
+
+
+def test_membership_package_gated_on_faults():
+    state = GrowShrinkState(["n1"])
+    assert membership.package({"faults": set(), "membership": {"state": state}}) is None
+    pkg = membership.package(
+        {"faults": {"membership"}, "membership": {"state": state}, "interval": 1}
+    )
+    assert pkg is not None
+    assert pkg["nemesis"].fs() == {"add-node", "remove-node"}

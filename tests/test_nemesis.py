@@ -1,0 +1,307 @@
+"""Nemesis tests: grudge math (pure) and fault command emission against
+the dummy remote (reference: jepsen/test/jepsen/nemesis_test.clj)."""
+
+import pytest
+
+from jepsen_tpu import control, generator as gen
+from jepsen_tpu import nemesis as n
+from jepsen_tpu import net
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.util import majority
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def setup_function(_):
+    gen.set_seed(45100)
+
+
+# -- grudges ----------------------------------------------------------------
+
+
+def test_bisect():
+    assert n.bisect([1, 2, 3, 4]) == [[1, 2], [3, 4]]
+    assert n.bisect([1, 2, 3, 4, 5]) == [[1, 2], [3, 4, 5]]
+    assert n.bisect([]) == [[], []]
+
+
+def test_split_one():
+    loner, rest = n.split_one(NODES, loner="n3")
+    assert loner == ["n3"]
+    assert set(rest) == {"n1", "n2", "n4", "n5"}
+
+
+def test_complete_grudge():
+    g = n.complete_grudge(n.bisect(NODES))
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+    # every node appears; nobody grudges their own component
+    assert set(g.keys()) == set(NODES)
+
+
+def test_bridge():
+    g = n.bridge(NODES)
+    # bridge node (first of second half = n3) is absent and never snubbed
+    assert "n3" not in g
+    for node, snubbed in g.items():
+        assert "n3" not in snubbed
+    # the two sides still can't see each other
+    assert "n4" in g["n1"] and "n1" in g["n4"]
+
+
+def test_majorities_ring_perfect():
+    g = n.majorities_ring(NODES)
+    m = majority(len(NODES))
+    # every node sees a majority (incl. itself): n - dropped >= majority
+    for node in NODES:
+        dropped = g.get(node, set())
+        assert len(NODES) - len(dropped) >= m
+    # at least two distinct drop-sets (no shared majority)
+    assert len({frozenset(v) for v in g.values()}) > 1
+
+
+def test_majorities_ring_stochastic():
+    nodes = [f"m{i}" for i in range(7)]
+    g = n.majorities_ring(nodes)
+    m = majority(len(nodes))
+    for node in nodes:
+        visible = len(nodes) - len(g.get(node, set()))
+        assert visible >= m, (node, g)
+
+
+def test_invert_grudge():
+    conns = {"a": {"a", "b"}, "b": {"a", "b"}, "c": {"c"}}
+    g = n.invert_grudge(["a", "b", "c"], conns)
+    assert g["a"] == {"c"}
+    assert g["c"] == {"a", "b"}
+
+
+# -- partitioner against dummy remote --------------------------------------
+
+
+def dummy_test(**kw):
+    t = {
+        "name": "nemtest",
+        "nodes": list(NODES),
+        "net": net.iptables,
+        "concurrency": 2,
+    }
+    t.update(kw)
+    return t
+
+
+def run_nemesis(nem, ops, test=None):
+    test = test or dummy_test()
+    remote = control.DummyRemote()
+    results = []
+    with control.with_session(test, remote):
+        nem = nem.setup(test)
+        for op in ops:
+            results.append(nem.invoke(test, op))
+        nem.teardown(test)
+    return results, remote.log
+
+
+def test_partitioner_emits_iptables():
+    results, log = run_nemesis(
+        n.partition_halves(),
+        [
+            {"f": "start", "value": None, "process": "nemesis", "time": 0},
+            {"f": "stop", "value": None, "process": "nemesis", "time": 1},
+        ],
+    )
+    assert results[0]["value"][0] == "isolated"
+    assert results[1]["value"] == "network-healed"
+    cmds = [c.cmd for node, c in log if hasattr(c, "cmd")]
+    drops = [c for c in cmds if "iptables -A INPUT -s" in c and "DROP" in c]
+    assert drops, cmds
+    flushes = [c for c in cmds if "iptables -F" in c]
+    assert flushes  # heal on setup, stop, and teardown
+
+
+def test_partitioner_sudo_wrapping():
+    _, log = run_nemesis(
+        n.partition_random_node(),
+        [{"f": "start", "value": None, "process": "nemesis", "time": 0}],
+    )
+    sudos = [c for node, c in log if hasattr(c, "sudo") and c.sudo]
+    assert sudos, "iptables commands must run under sudo"
+
+
+def test_partitioner_explicit_grudge_value():
+    grudge = {"n1": {"n2"}}
+    results, log = run_nemesis(
+        n.partitioner(),
+        [{"f": "start", "value": grudge, "process": "nemesis", "time": 0}],
+    )
+    assert results[0]["value"][0] == "isolated"
+    cmds = [c.cmd for node, c in log if hasattr(c, "cmd")]
+    assert any("-s n2" in c or "-s " in c for c in cmds)
+
+
+def test_f_map_remaps():
+    lifted = n.f_map(lambda f: f"net-{f}", n.partition_halves())
+    assert lifted.fs() == {"net-start", "net-stop"}
+    results, _ = run_nemesis(
+        lifted, [{"f": "net-start", "value": None, "process": "nemesis", "time": 0}]
+    )
+    assert results[0]["f"] == "net-start"
+
+
+def test_compose_reflection_routing():
+    class A(n.Nemesis):
+        def invoke(self, test, op):
+            return {**op, "type": "info", "value": "A"}
+
+        def fs(self):
+            return {"a"}
+
+    class B(n.Nemesis):
+        def invoke(self, test, op):
+            return {**op, "type": "info", "value": "B"}
+
+        def fs(self):
+            return {"b"}
+
+    c = n.compose([A(), B()])
+    assert c.invoke({}, {"f": "a"})["value"] == "A"
+    assert c.invoke({}, {"f": "b"})["value"] == "B"
+    with pytest.raises(ValueError):
+        c.invoke({}, {"f": "zzz"})
+    assert c.fs() == {"a", "b"}
+
+
+def test_compose_conflicting_fs_raises():
+    class A(n.Nemesis):
+        def fs(self):
+            return {"x"}
+
+    with pytest.raises(ValueError, match="incompatible"):
+        n.compose([A(), A()])
+
+
+def test_compose_map_rewrites_f():
+    class Partish(n.Nemesis):
+        def invoke(self, test, op):
+            assert op["f"] in ("start", "stop")
+            return {**op, "type": "info", "value": op["f"]}
+
+        def fs(self):
+            return {"start", "stop"}
+
+    c = n.compose([({"split-start": "start", "split-stop": "stop"}, Partish())])
+    out = c.invoke({}, {"f": "split-start"})
+    assert out["value"] == "start"
+    assert out["f"] == "split-start"
+    assert c.fs() == {"split-start", "split-stop"}
+
+
+def test_hammer_time_emits_killall():
+    _, log = run_nemesis(
+        n.hammer_time("mydb"),
+        [
+            {"f": "start", "value": None, "process": "nemesis", "time": 0},
+            {"f": "stop", "value": None, "process": "nemesis", "time": 1},
+        ],
+    )
+    cmds = [c.cmd for node, c in log if hasattr(c, "cmd")]
+    assert any("killall -s STOP mydb" in c for c in cmds)
+    assert any("killall -s CONT mydb" in c for c in cmds)
+
+
+def test_truncate_file():
+    _, log = run_nemesis(
+        n.truncate_file(),
+        [
+            {
+                "f": "truncate",
+                "process": "nemesis",
+                "time": 0,
+                "value": {"n1": {"file": "/var/lib/db/wal", "drop": 64}},
+            }
+        ],
+    )
+    cmds = [(node, c.cmd) for node, c in log if hasattr(c, "cmd")]
+    assert any(
+        node == "n1" and "truncate -c -s -64 /var/lib/db/wal" in cmd
+        for node, cmd in cmds
+    )
+
+
+# -- combined packages -------------------------------------------------------
+
+
+def test_db_nodes_specs():
+    test = dummy_test()
+    from jepsen_tpu import db as db_mod
+
+    db = db_mod.noop()
+    assert combined.db_nodes(test, db, "all") == NODES
+    assert len(combined.db_nodes(test, db, "one")) == 1
+    assert len(combined.db_nodes(test, db, "majority")) == 3
+    assert len(combined.db_nodes(test, db, "minority")) == 2
+    assert combined.db_nodes(test, db, ["n2"]) == ["n2"]
+    sub = combined.db_nodes(test, db, None)
+    assert 1 <= len(sub) <= 5
+
+
+def test_grudge_specs():
+    test = dummy_test()
+    from jepsen_tpu import db as db_mod
+
+    db = db_mod.noop()
+    g = combined.grudge(test, db, "one")
+    isolated = [node for node, v in g.items() if len(v) == 4]
+    assert len(isolated) == 1
+    g2 = combined.grudge(test, db, "majority")
+    sizes = sorted(len(v) for v in g2.values())
+    assert sizes == [2, 2, 2, 3, 3]
+    g3 = combined.grudge(test, db, "majorities-ring")
+    assert set(g3.keys()) <= set(NODES)
+
+
+def test_partition_package_lifecycle():
+    from jepsen_tpu import db as db_mod
+
+    pkg = combined.partition_package(
+        {"db": db_mod.noop(), "faults": {"partition"}, "interval": 1}
+    )
+    assert pkg["generator"] is not None
+    assert pkg["nemesis"].fs() == {"start-partition", "stop-partition"}
+    test = dummy_test()
+    remote = control.DummyRemote()
+    with control.with_session(test, remote):
+        nem = pkg["nemesis"].setup(test)
+        out = nem.invoke(
+            test,
+            {"f": "start-partition", "value": "majority", "process": "nemesis", "time": 0},
+        )
+        assert out["f"] == "start-partition"
+        out2 = nem.invoke(
+            test, {"f": "stop-partition", "value": None, "process": "nemesis", "time": 1}
+        )
+        assert out2["value"] == "network-healed"
+
+
+def test_nemesis_package_composes():
+    from jepsen_tpu import db as db_mod
+
+    pkg = combined.nemesis_package(
+        {"db": db_mod.noop(), "faults": {"partition"}, "interval": 1}
+    )
+    # only partition faults are enabled, but the composed nemesis still
+    # routes all three packages' fs
+    fs = pkg["nemesis"].fs()
+    assert "start-partition" in fs
+    assert "reset-clock" in fs
+    assert pkg["generator"] is not None
+
+
+def test_package_f_map():
+    from jepsen_tpu import db as db_mod
+
+    pkg = combined.partition_package(
+        {"db": db_mod.noop(), "faults": {"partition"}}
+    )
+    lifted = combined.f_map(lambda f: ("db1", f), pkg)
+    assert ("db1", "start-partition") in lifted["nemesis"].fs()
