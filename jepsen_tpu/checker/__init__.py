@@ -632,3 +632,62 @@ def log_file_pattern(pattern, filename: str) -> Checker:
     the test.  (reference: checker.clj:839-881; uses Python re instead of
     shelling out to grep -P)"""
     return _LogFilePattern(pattern, filename)
+
+
+# ---------------------------------------------------------------------------
+# Graph checkers (SVG renderers; reference used gnuplot)
+# ---------------------------------------------------------------------------
+
+
+class _LatencyGraph(Checker):
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        if not test.get("store?", True):
+            return {"valid?": True}
+        from . import perf as perf_mod
+
+        o = {**self.opts, **(opts or {})}
+        perf_mod.point_graph(test, history, o)
+        perf_mod.quantiles_graph(test, history, o)
+        return {"valid?": True}
+
+
+def latency_graph(opts: Optional[dict] = None) -> Checker:
+    """Plots latency raw + quantiles.  (reference: checker.clj:797-808)"""
+    return _LatencyGraph(opts)
+
+
+class _RateGraph(Checker):
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+
+    def check(self, test, history, opts=None):
+        if not test.get("store?", True):
+            return {"valid?": True}
+        from . import perf as perf_mod
+
+        perf_mod.rate_graph(test, history, {**self.opts, **(opts or {})})
+        return {"valid?": True}
+
+
+def rate_graph(opts: Optional[dict] = None) -> Checker:
+    """Plots throughput over time.  (reference: checker.clj:810-820)"""
+    return _RateGraph(opts)
+
+
+def perf_checker(opts: Optional[dict] = None) -> Checker:
+    """Composes latency + rate graphs.  (reference: checker.clj:822-829;
+    named perf_checker because the submodule jepsen_tpu.checker.perf holds
+    the plot functions)"""
+    return compose(
+        {"latency-graph": latency_graph(opts), "rate-graph": rate_graph(opts)}
+    )
+
+
+def clock_plot() -> Checker:
+    """Plots clock offsets on all nodes.  (reference: checker.clj:831-837)"""
+    from . import clock as clock_mod
+
+    return clock_mod.plotter()

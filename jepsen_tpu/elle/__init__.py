@@ -1,0 +1,37 @@
+"""Elle-equivalent transactional anomaly checker.
+
+Black-box transactional safety analysis: histories of micro-op
+transactions are reduced to typed dependency graphs (ww/wr/rw +
+process/realtime), and Adya anomalies are cycles with particular edge
+profiles.  Two inference modes:
+
+- :mod:`list_append` — appends + list reads; version order is recovered
+  exactly from read prefixes (the strongest mode)
+- :mod:`rw_register` — writes + point reads; version order is inferred
+  from sound sources only
+
+The reference consumes the external Elle 0.1.3 library for this
+(jepsen/project.clj:11, jepsen/src/jepsen/tests/cycle.clj:5-16); here
+it is native, with the bulk cycle screening offloadable to the
+accelerator (jepsen_tpu.ops.cycles — batched boolean matrix closure on
+the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..history import History
+from . import consistency, core, cycles, graph, list_append, rw_register
+
+
+def check(opts: Optional[dict], history: History) -> dict:
+    """Elle-style entry point: opts include ``workload`` ("list-append"
+    or "rw-register"), plus ``consistency-models`` / ``anomalies``."""
+    opts = opts or {}
+    workload = opts.get("workload", "list-append")
+    if workload == "list-append":
+        return list_append.check(history, opts)
+    if workload == "rw-register":
+        return rw_register.check(history, opts)
+    raise KeyError(f"unknown elle workload {workload!r}")

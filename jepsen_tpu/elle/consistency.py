@@ -1,0 +1,105 @@
+"""Consistency models → proscribed anomalies, and verdict shaping.
+
+A small lattice in the spirit of Elle's elle.consistency-model
+(consumed transitively by the reference at
+jepsen/src/jepsen/tests/cycle/wr.clj:33-47, whose docstring enumerates
+these same anomaly names).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+#: Anomalies each model proscribes.  Weaker models inherit into stronger
+#: ones below.
+_BASE: Dict[str, Set[str]] = {
+    "read-uncommitted": {"G0", "dirty-update", "duplicate-elements",
+                         "incompatible-order"},
+    "read-committed": {"G1a", "G1b", "G1c", "internal"},
+    "repeatable-read": {"G2-item"},
+    "snapshot-isolation": {"G-single"},
+    "serializable": {"G-single", "G2-item"},
+    "strict-serializable": {
+        "G0-realtime", "G1c-realtime", "G-single-realtime",
+        "G2-item-realtime",
+    },
+    "sequential": {
+        "G0-process", "G1c-process", "G-single-process", "G2-item-process",
+    },
+}
+
+#: What each model implies (transitively expanded at lookup).
+_IMPLIES: Dict[str, Sequence[str]] = {
+    "read-committed": ("read-uncommitted",),
+    "repeatable-read": ("read-committed",),
+    "snapshot-isolation": ("read-committed",),
+    "serializable": ("repeatable-read", "snapshot-isolation"),
+    "sequential": ("serializable",),
+    "strict-serializable": ("serializable", "sequential"),
+}
+
+KNOWN_MODELS = sorted(_BASE)
+
+#: Cycle anomalies implied by others (a G0 is also a G1c profile etc.) —
+#: used only for reporting, not detection.
+SEVERITY = [
+    "G0", "G1c", "G-single", "G2-item",
+    "G0-process", "G1c-process", "G-single-process", "G2-item-process",
+    "G0-realtime", "G1c-realtime", "G-single-realtime", "G2-item-realtime",
+    "G1a", "G1b", "dirty-update", "internal", "duplicate-elements",
+    "incompatible-order",
+]
+
+
+def proscribed_for_model(model: str) -> Set[str]:
+    if model not in _BASE:
+        raise KeyError(f"unknown consistency model {model!r}; known: {KNOWN_MODELS}")
+    out = set(_BASE[model])
+    for dep in _IMPLIES.get(model, ()):
+        out |= proscribed_for_model(dep)
+    return out
+
+
+def proscribed(opts: dict) -> Set[str]:
+    """The set of anomaly names that invalidate this test, from opts:
+    either explicit ``anomalies`` or ``consistency-models`` (default
+    strict-serializable)."""
+    out: Set[str] = set()
+    for a in opts.get("anomalies", ()):
+        if a == "G1":
+            out |= {"G1a", "G1b", "G1c"}
+        elif a == "G2":
+            out |= {"G-single", "G2-item"}
+        else:
+            out.add(a)
+    for m in opts.get("consistency-models") or (
+        [] if opts.get("anomalies") else ["strict-serializable"]
+    ):
+        out |= proscribed_for_model(m)
+    return out
+
+
+def result(
+    anomalies: Dict[str, list], wanted: Set[str], txn_count: int = 0
+) -> dict:
+    """Shape the final verdict: valid iff no *proscribed* anomaly was
+    found; unproscribed findings are reported under also-anomalies."""
+    bad = {k: v for k, v in anomalies.items() if k in wanted}
+    also = {k: v for k, v in anomalies.items() if k not in wanted}
+    out: dict = {
+        "valid?": not bad,
+        "txn-count": txn_count,
+        "anomaly-types": sorted(bad, key=_severity_key),
+        "anomalies": bad,
+    }
+    if also:
+        out["also-anomaly-types"] = sorted(also, key=_severity_key)
+        out["also-anomalies"] = also
+    return out
+
+
+def _severity_key(name: str) -> int:
+    try:
+        return SEVERITY.index(name)
+    except ValueError:
+        return len(SEVERITY)

@@ -1,0 +1,146 @@
+"""Cycle classification: SCCs → anomaly-typed witness cycles.
+
+Adya's phenomena as edge-type profiles over the dependency graph:
+
+- G0         cycle of only ww edges
+- G1c        cycle of ww/wr edges (not G0)
+- G-single   cycle with exactly one rw edge, rest ww/wr
+- G2-item    cycle with ≥1 rw edges (≥2 once G-single is excluded)
+
+With realtime/process graphs unioned in, the same profiles allowing
+those edges yield the -realtime / -process variants (e.g. a cycle of ww
++ realtime edges is G0-realtime, proscribed by strict serializability
+but not plain serializability).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set
+
+from .graph import (
+    Graph,
+    WW,
+    WR,
+    RW,
+    PROCESS,
+    REALTIME,
+    cycle_rels,
+    find_cycle,
+    find_cycle_with,
+    strongly_connected_components,
+)
+
+_ORDER = [PROCESS, REALTIME]
+
+
+def _fmt_cycle(g: Graph, cyc: List[Any]) -> dict:
+    steps = []
+    for a, b in zip(cyc, cyc[1:]):
+        steps.append(
+            {"from": repr(a), "rels": sorted(g.edge_rels(a, b)), "to": repr(b)}
+        )
+    return {"cycle": [repr(v) for v in cyc], "steps": steps}
+
+
+def _suffix(rels_used: Set[str]) -> str:
+    if REALTIME in rels_used:
+        return "-realtime"
+    if PROCESS in rels_used:
+        return "-process"
+    return ""
+
+
+def classify(g: Graph) -> Dict[str, list]:
+    """Find one witness cycle per anomaly type per SCC."""
+    anomalies: Dict[str, list] = {}
+
+    def record(name: str, cyc: List[Any]) -> None:
+        anomalies.setdefault(name, []).append(_fmt_cycle(g, cyc))
+
+    for scc in strongly_connected_components(g):
+        # Most-severe-first: G0, then G1c, then G-single, then G2-item.
+        ww_only = lambda rels: rels <= {WW}  # noqa: E731
+        ww_wr = lambda rels: bool(rels & {WW, WR}) and not (rels & {RW})  # noqa: E731
+        has_rw = lambda rels: RW in rels  # noqa: E731
+
+        sub = g.filtered(lambda rels: bool(rels & {WW}))
+        cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+        if cyc is not None:
+            record("G0", cyc)
+            continue
+
+        sub = g.filtered(lambda rels: bool(rels & {WW, WR}))
+        cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+        if cyc is not None:
+            record("G1c", cyc)
+            continue
+
+        cyc = find_cycle_with(
+            g,
+            scc,
+            want=has_rw,
+            rest=lambda rels: bool(rels & {WW, WR}),
+            want_count=1,
+        )
+        if cyc is not None:
+            record("G-single", cyc)
+            continue
+
+        sub = g.filtered(lambda rels: bool(rels & {WW, WR, RW}))
+        cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+        if cyc is not None:
+            record("G2-item", cyc)
+            continue
+
+        # Cycle requires process/realtime edges: -realtime/-process
+        # variants of the same ladder.
+        for want_rels, name in (
+            ({WW}, "G0"),
+            ({WW, WR}, "G1c"),
+            (None, "G-single"),
+            ({WW, WR, RW}, "G2-item"),
+        ):
+            if name == "G-single":
+                cyc = find_cycle_with(
+                    g,
+                    scc,
+                    want=has_rw,
+                    rest=lambda rels: bool(rels & {WW, WR, PROCESS, REALTIME}),
+                    want_count=1,
+                )
+            else:
+                sub = g.filtered(
+                    lambda rels, wr=want_rels: bool(
+                        rels & (wr | {PROCESS, REALTIME})
+                    )
+                )
+                cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+            if cyc is not None:
+                used: Set[str] = set()
+                for rels in cycle_rels(g, cyc):
+                    used |= rels
+                record(name + _suffix(used), cyc)
+                break
+    return anomalies
+
+
+def cyclic_graph_mask(graphs: List[Graph], use_device: Optional[bool] = None):
+    """Batched cycle screening: which of these graphs contain a cycle at
+    all?  Pads adjacency matrices to a common bucket and runs the
+    boolean-closure kernel (jepsen_tpu.ops.cycles) in one dispatch —
+    the Elle-on-TPU formulation from SURVEY.md §7 step 8.  Falls back to
+    CPU SCC when no accelerator is available."""
+    import numpy as np
+
+    if not graphs:
+        return np.zeros((0,), dtype=bool)
+    if use_device is None:
+        use_device = max(len(g.vertices) for g in graphs) >= 16
+    if not use_device:
+        return np.array(
+            [bool(strongly_connected_components(g)) for g in graphs]
+        )
+    from ..ops import cycles as ops_cycles
+
+    mats = [g.adjacency()[1] for g in graphs]
+    return ops_cycles.has_cycle_batch(mats)

@@ -1,0 +1,219 @@
+"""Typed dependency graphs and cycle search.
+
+The transactional checker reduces a history to a directed graph whose
+vertices are transactions and whose edges carry dependency types
+(``ww``/``wr``/``rw``, plus ``process``/``realtime``).  Anomalies are
+cycles with particular edge-type profiles, found via strongly-connected
+components (Tarjan, iterative) and per-SCC BFS.
+
+The reference consumes the external Elle library for this
+(jepsen/project.clj:11; jepsen/src/jepsen/tests/cycle.clj:5-16).  The
+hot screening step — does any cycle exist over thousands of per-key
+graphs — can run on TPU via jepsen_tpu.ops.cycles (batched boolean
+matrix closure); this module is the exact CPU path and witness extractor.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Any, Callable, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+#: Dependency edge types.
+WW = "ww"
+WR = "wr"
+RW = "rw"
+PROCESS = "process"
+REALTIME = "realtime"
+
+
+class Graph:
+    """A directed multigraph: edges carry a set of dependency types."""
+
+    def __init__(self):
+        self.vertices: Set[Any] = set()
+        self.out: Dict[Any, Dict[Any, Set[str]]] = defaultdict(dict)
+
+    def add_vertex(self, v: Any) -> None:
+        self.vertices.add(v)
+
+    def add_edge(self, a: Any, b: Any, rel: str) -> None:
+        if a == b:
+            return  # self-deps are intra-txn; never cycle material
+        self.vertices.add(a)
+        self.vertices.add(b)
+        rels = self.out[a].get(b)
+        if rels is None:
+            self.out[a][b] = {rel}
+        else:
+            rels.add(rel)
+
+    def edge_rels(self, a: Any, b: Any) -> Set[str]:
+        return self.out.get(a, {}).get(b, set())
+
+    def successors(self, v: Any) -> Iterable[Any]:
+        return self.out.get(v, {}).keys()
+
+    def union(self, other: "Graph") -> "Graph":
+        g = Graph()
+        for v in self.vertices | other.vertices:
+            g.add_vertex(v)
+        for src in (self, other):
+            for a, nbrs in src.out.items():
+                for b, rels in nbrs.items():
+                    for r in rels:
+                        g.add_edge(a, b, r)
+        return g
+
+    def filtered(self, pred: Callable[[Set[str]], bool]) -> "Graph":
+        """Subgraph keeping only edges whose rel-set satisfies pred."""
+        g = Graph()
+        for v in self.vertices:
+            g.add_vertex(v)
+        for a, nbrs in self.out.items():
+            for b, rels in nbrs.items():
+                if pred(rels):
+                    for r in rels:
+                        g.add_edge(a, b, r)
+        return g
+
+    def edge_count(self) -> int:
+        return sum(len(nbrs) for nbrs in self.out.values())
+
+    def adjacency(self, order: Optional[List[Any]] = None):
+        """(order, dense bool numpy adjacency) — feed for the TPU kernel."""
+        import numpy as np
+
+        order = order or sorted(self.vertices, key=str)
+        index = {v: i for i, v in enumerate(order)}
+        n = len(order)
+        m = np.zeros((n, n), dtype=bool)
+        for a, nbrs in self.out.items():
+            for b in nbrs:
+                m[index[a], index[b]] = True
+        return order, m
+
+
+def strongly_connected_components(g: Graph) -> List[List[Any]]:
+    """Tarjan's SCC, iterative (histories can be deep).  Only components
+    with ≥2 vertices or a self-loop can hold cycles; we return all and
+    let callers filter."""
+    index: Dict[Any, int] = {}
+    low: Dict[Any, int] = {}
+    on_stack: Set[Any] = set()
+    stack: List[Any] = []
+    sccs: List[List[Any]] = []
+    counter = [0]
+
+    for root in g.vertices:
+        if root in index:
+            continue
+        work: List[Tuple[Any, Optional[Iterable]]] = [(root, None)]
+        while work:
+            v, it = work.pop()
+            if it is None:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+                it = iter(list(g.successors(v)))
+            advanced = False
+            for w in it:
+                if w not in index:
+                    work.append((v, it))
+                    work.append((w, None))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+    return [c for c in sccs if len(c) > 1]
+
+
+def find_cycle(g: Graph, scc: List[Any]) -> Optional[List[Any]]:
+    """A shortest cycle within an SCC: BFS from each vertex back to
+    itself through SCC-internal edges.  Returns [v1 v2 … v1] or None."""
+    members = set(scc)
+    for start in scc:
+        parent: Dict[Any, Any] = {}
+        q = deque([start])
+        seen = {start}
+        while q:
+            v = q.popleft()
+            for w in g.successors(v):
+                if w not in members:
+                    continue
+                if w == start:
+                    path = [v]
+                    while path[-1] != start:
+                        path.append(parent[path[-1]])
+                    path.reverse()
+                    path.append(start)
+                    return path
+                if w not in seen:
+                    seen.add(w)
+                    parent[w] = v
+                    q.append(w)
+    return None
+
+
+def find_cycle_with(
+    g: Graph,
+    scc: List[Any],
+    want: Callable[[Set[str]], bool],
+    rest: Callable[[Set[str]], bool],
+    want_count: int = 1,
+) -> Optional[List[Any]]:
+    """Find a cycle containing exactly ``want_count`` edges satisfying
+    ``want``, all other edges satisfying ``rest``.  Used for G-single
+    (exactly one rw, rest ww/wr).  BFS over a layered product graph:
+    state = (vertex, #want-edges-used)."""
+    members = set(scc)
+    for start in scc:
+        # state: (v, k) = reached v using k want-edges
+        parent: Dict[Tuple[Any, int], Tuple[Any, int]] = {}
+        q = deque([(start, 0)])
+        seen = {(start, 0)}
+        while q:
+            v, k = q.popleft()
+            for w in g.successors(v):
+                if w not in members:
+                    continue
+                rels = g.edge_rels(v, w)
+                steps = []
+                if want(rels) and k < want_count:
+                    steps.append(k + 1)
+                if rest(rels):
+                    steps.append(k)
+                for k2 in steps:
+                    if w == start and k2 == want_count:
+                        path = [v]
+                        vv, kk = v, k
+                        while (vv, kk) != (start, 0):
+                            vv, kk = parent[(vv, kk)]
+                            path.append(vv)
+                        path.reverse()
+                        path.append(start)
+                        return path
+                    if (w, k2) not in seen and w != start:
+                        seen.add((w, k2))
+                        parent[(w, k2)] = (v, k)
+                        q.append((w, k2))
+    return None
+
+
+def cycle_rels(g: Graph, cycle: List[Any]) -> List[Set[str]]:
+    """The rel-sets along a cycle path [v1 v2 … v1]."""
+    return [g.edge_rels(a, b) for a, b in zip(cycle, cycle[1:])]

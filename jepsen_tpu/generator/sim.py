@@ -105,17 +105,19 @@ def invocations(history: List[dict]) -> List[dict]:
     return [o for o in history if o.get("type") == "invoke"]
 
 
-def quick_ops(gen, ctx=None) -> List[dict]:
+def quick_ops(gen, ctx=None, test=None) -> List[dict]:
     """Every op completes perfectly, instantly, zero latency.
     (reference: generator/test.clj:110-117)"""
-    return simulate(gen, lambda ctx, inv: {**inv, "type": "ok"}, ctx=ctx)
+    return simulate(
+        gen, lambda ctx, inv: {**inv, "type": "ok"}, ctx=ctx, test=test
+    )
 
 
-def quick(gen, ctx=None) -> List[dict]:
-    return invocations(quick_ops(gen, ctx))
+def quick(gen, ctx=None, test=None) -> List[dict]:
+    return invocations(quick_ops(gen, ctx, test))
 
 
-def perfect_star(gen, ctx=None) -> List[dict]:
+def perfect_star(gen, ctx=None, test=None) -> List[dict]:
     """Ops succeed after 10ns; full history.
     (reference: generator/test.clj:130-141)"""
     return simulate(
@@ -126,14 +128,15 @@ def perfect_star(gen, ctx=None) -> List[dict]:
             "time": inv["time"] + PERFECT_LATENCY,
         },
         ctx=ctx,
+        test=test,
     )
 
 
-def perfect(gen, ctx=None) -> List[dict]:
-    return invocations(perfect_star(gen, ctx))
+def perfect(gen, ctx=None, test=None) -> List[dict]:
+    return invocations(perfect_star(gen, ctx, test))
 
 
-def perfect_info(gen, ctx=None) -> List[dict]:
+def perfect_info(gen, ctx=None, test=None) -> List[dict]:
     """Every op crashes after 10ns; invocations only.
     (reference: generator/test.clj:152-163)"""
     return invocations(
@@ -145,11 +148,12 @@ def perfect_info(gen, ctx=None) -> List[dict]:
                 "time": inv["time"] + PERFECT_LATENCY,
             },
             ctx=ctx,
+            test=test,
         )
     )
 
 
-def imperfect(gen, ctx=None) -> List[dict]:
+def imperfect(gen, ctx=None, test=None) -> List[dict]:
     """Threads cycle fail → info → ok; full history.
     (reference: generator/test.clj:165-182)"""
     state: dict = {}
@@ -160,4 +164,4 @@ def imperfect(gen, ctx=None) -> List[dict]:
         state[t] = transitions[state.get(t)]
         return {**inv, "type": state[t], "time": inv["time"] + PERFECT_LATENCY}
 
-    return simulate(gen, complete, ctx=ctx)
+    return simulate(gen, complete, ctx=ctx, test=test)

@@ -1,0 +1,91 @@
+"""Batched cycle detection on the accelerator.
+
+Dependency graphs become dense boolean adjacency matrices; transitive
+closure by log₂(N) rounds of boolean matrix squaring — each round one
+batched matmul, which XLA tiles straight onto the MXU in bfloat16 — and
+a graph is cyclic iff its closure has a true diagonal.  This is the
+screening kernel for the Elle-equivalent checker (SURVEY.md §7 step 8):
+thousands of per-key graphs are screened in one dispatch and only the
+cyclic ones get a CPU witness search.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _bucket(n: int) -> int:
+    """Pad sizes to powers of two (min 16) to bound recompiles."""
+    return max(16, 1 << (n - 1).bit_length())
+
+
+@lru_cache(maxsize=None)
+def _closure_fn(n: int):
+    rounds = max(1, math.ceil(math.log2(n)))
+
+    @jax.jit
+    def has_cycle(adj):  # adj: (B, n, n) bool
+        r = adj.astype(jnp.bfloat16)
+
+        def step(r, _):
+            # r ∪ r·r, saturated to {0,1}; stays in bfloat16 for the MXU
+            rr = jnp.clip(r + jnp.matmul(r, r), 0.0, 1.0)
+            return rr, None
+
+        r, _ = jax.lax.scan(step, r, None, length=rounds)
+        diag = jnp.diagonal(r, axis1=-2, axis2=-1)
+        return jnp.any(diag > 0.0, axis=-1)
+
+    return has_cycle
+
+
+def has_cycle_batch(mats: Sequence[np.ndarray]) -> np.ndarray:
+    """Which of these adjacency matrices contain a cycle?  Matrices are
+    bucketed by padded size so one compile covers many shapes."""
+    out = np.zeros(len(mats), dtype=bool)
+    by_bucket: dict = {}
+    for i, m in enumerate(mats):
+        by_bucket.setdefault(_bucket(m.shape[0]), []).append(i)
+    for n, idxs in by_bucket.items():
+        batch = np.zeros((len(idxs), n, n), dtype=bool)
+        for row, i in enumerate(idxs):
+            m = mats[i]
+            batch[row, : m.shape[0], : m.shape[1]] = m
+        verdicts = np.asarray(_closure_fn(n)(jnp.asarray(batch)))
+        for row, i in enumerate(idxs):
+            out[i] = bool(verdicts[row])
+    return out
+
+
+@lru_cache(maxsize=None)
+def _reach_fn(n: int):
+    rounds = max(1, math.ceil(math.log2(n)))
+
+    @jax.jit
+    def close(a):
+        r = a.astype(jnp.bfloat16)
+
+        def step(r, _):
+            return jnp.clip(r + jnp.matmul(r, r), 0.0, 1.0), None
+
+        r, _ = jax.lax.scan(step, r, None, length=rounds)
+        return r > 0.0
+
+    return close
+
+
+def reachability(adj: np.ndarray) -> np.ndarray:
+    """Full boolean transitive closure of one adjacency matrix (device)."""
+    n = _bucket(adj.shape[0])
+    padded = np.zeros((n, n), dtype=bool)
+    padded[: adj.shape[0], : adj.shape[1]] = adj
+    return np.asarray(_reach_fn(n)(jnp.asarray(padded)))[
+        : adj.shape[0], : adj.shape[1]
+    ]

@@ -1,0 +1,94 @@
+"""Transactional-cycle workloads: generators + checkers over the
+Elle-equivalent analysis plane (jepsen_tpu.elle).
+
+(reference: jepsen/src/jepsen/tests/cycle.clj — the generic adapter —
+plus cycle/append.clj and cycle/wr.clj)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ... import generator as gen
+from ...checker import Checker
+
+
+class _ElleChecker(Checker):
+    def __init__(self, workload: str, opts: Optional[dict]):
+        self.workload = workload
+        self.opts = dict(opts or {})
+
+    def check(self, test, history, opts=None):
+        from ... import elle
+
+        return elle.check(
+            {**self.opts, "workload": self.workload}, history
+        )
+
+
+def checker(workload: str, opts: Optional[dict] = None) -> Checker:
+    """A checker running the elle analysis for a txn workload.
+    (reference: cycle.clj:9-16)"""
+    return _ElleChecker(workload, opts)
+
+
+class TxnGenerator(gen.Generator):
+    """Random micro-op transactions over a rotating pool of keys.
+
+    Mirrors elle's wr-txns/append-txns behavior: ``key-count`` keys are
+    active at once; each key takes at most ``max-writes-per-key`` writes
+    before being retired for a fresh one; txns have min..max mops, each
+    a read or write/append of an active key with globally-unique written
+    values per key.
+    """
+
+    def __init__(self, mode: str, opts: dict, state: Optional[dict] = None):
+        self.mode = mode  # "append" | "wr"
+        self.opts = opts
+        if state is None:
+            kc = opts.get("key-count", 2)
+            state = {
+                "next_key": kc,
+                "active": list(range(kc)),
+                "writes": {k: 0 for k in range(kc)},
+                "counter": 0,
+            }
+        self.state = state
+
+    def op(self, test, ctx):
+        o = self.opts
+        min_len = o.get("min-txn-length", 1)
+        max_len = o.get("max-txn-length", 4)
+        max_wpk = o.get("max-writes-per-key", 32)
+        st = {
+            "next_key": self.state["next_key"],
+            "active": list(self.state["active"]),
+            "writes": dict(self.state["writes"]),
+            "counter": self.state["counter"],
+        }
+        n = min_len + gen.rng.randrange(max_len - min_len + 1)
+        value: List[list] = []
+        for _ in range(n):
+            k = st["active"][gen.rng.randrange(len(st["active"]))]
+            if gen.rng.random() < 0.5:
+                value.append(["r", k, None])
+            else:
+                st["counter"] += 1
+                f = "append" if self.mode == "append" else "w"
+                value.append([f, k, st["counter"]])
+                st["writes"][k] += 1
+                if st["writes"][k] >= max_wpk:
+                    idx = st["active"].index(k)
+                    fresh = st["next_key"]
+                    st["next_key"] += 1
+                    st["active"][idx] = fresh
+                    st["writes"][fresh] = 0
+        filled = gen.fill_in_op(
+            {"f": "txn", "value": value}, ctx
+        )
+        if filled == gen.PENDING:
+            return (gen.PENDING, self)
+        return (filled, TxnGenerator(self.mode, self.opts, st))
+
+    def update(self, test, ctx, event):
+        return self

@@ -1,0 +1,76 @@
+"""Linearizability over a set of independent CAS registers — the flagship
+workload of the TPU analysis plane.
+
+Clients understand three functions over ``[k, v]`` tuple values:
+
+    {"type": "invoke", "f": "write", "value": [k, v]}
+    {"type": "invoke", "f": "read",  "value": [k, None]}
+    {"type": "invoke", "f": "cas",   "value": [k, [v, v2]]}
+
+(reference: jepsen/src/jepsen/tests/linearizable_register.clj)
+
+Two checker lifts are offered: the classic per-key lift
+(independent.checker over checker.linearizable, which itself dispatches to
+the TPU kernel per history) and — by default — the batched lift
+(independent.batched_linearizable), which checks the entire keyspace in
+one vmapped device dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import checker as checker_mod
+from .. import generator as gen
+from .. import independent
+from .. import models
+from ..checker import timeline
+
+
+def w(test, ctx):
+    return {"type": "invoke", "f": "write", "value": gen.rng.randrange(5)}
+
+
+def r(test, ctx):
+    return {"type": "invoke", "f": "read"}
+
+
+def cas(test, ctx):
+    return {
+        "type": "invoke",
+        "f": "cas",
+        "value": [gen.rng.randrange(5), gen.rng.randrange(5)],
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    """A partial test (generator, model, checker); bring a client.
+    Options: ``nodes``, ``model``, ``per-key-limit``, ``process-limit``
+    (default 20), ``batched?`` (default True — one device dispatch for
+    all keys).  (reference: linearizable_register.clj:22-53)"""
+    opts = opts or {}
+    n = len(opts.get("nodes", ["n1"]))
+    model = opts.get("model", models.cas_register())
+
+    if opts.get("batched?", True):
+        lin = independent.batched_linearizable(model)
+    else:
+        lin = independent.checker(checker_mod.linearizable(model))
+
+    def fgen(k):
+        g = gen.reserve(n, r, gen.mix([w, cas, cas]))
+        pkl = opts.get("per-key-limit")
+        if pkl:
+            # Jitter the limit so keys drift off Significant Event
+            # Boundaries over time.  (reference: :45-49)
+            g = gen.limit(int((0.9 + gen.rng.random() * 0.1) * pkl) or 1, g)
+        return gen.process_limit(opts.get("process-limit", 20), g)
+
+    return {
+        "checker": checker_mod.compose(
+            {"linearizable": lin, "timeline": timeline.html()}
+        ),
+        "generator": independent.concurrent_generator(
+            2 * n, list(range(100_000)), fgen
+        ),
+    }

@@ -1,0 +1,393 @@
+"""Tests for the Elle-equivalent transactional checker.
+
+Histories are hand-written with known anomalies, mirroring the
+test strategy of the reference's checker tests (literal histories,
+SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import elle
+from jepsen_tpu.elle import cycles, graph as g_mod, list_append, rw_register
+from jepsen_tpu.elle.graph import Graph, WW, WR, RW
+from jepsen_tpu.history import History, Op, invoke_op, ok_op, fail_op
+
+
+def txn_pair(process, value_in, value_out, t, typ="ok"):
+    return [
+        invoke_op(process, "txn", value_in, time=t),
+        Op(typ, process, "txn", value_out, time=t + 1),
+    ]
+
+
+def hist(*pairs):
+    ops = [op for pair in pairs for op in pair]
+    ops.sort(key=lambda o: o.time)
+    return History(ops).index_ops()
+
+
+# ---------------------------------------------------------------------------
+# graph machinery
+# ---------------------------------------------------------------------------
+
+
+def test_scc_and_cycle():
+    g = Graph()
+    g.add_edge("a", "b", WW)
+    g.add_edge("b", "a", WW)
+    g.add_edge("b", "c", WR)
+    sccs = g_mod.strongly_connected_components(g)
+    assert len(sccs) == 1
+    assert set(sccs[0]) == {"a", "b"}
+    cyc = g_mod.find_cycle(g, sccs[0])
+    assert cyc is not None
+    assert cyc[0] == cyc[-1]
+    assert len(cyc) == 3
+
+
+def test_find_cycle_with_exactly_one_rw():
+    g = Graph()
+    g.add_edge("a", "b", RW)
+    g.add_edge("b", "a", WW)
+    cyc = g_mod.find_cycle_with(
+        g, ["a", "b"], want=lambda r: RW in r, rest=lambda r: WW in r
+    )
+    assert cyc is not None
+    # a double-rw cycle cannot be found with want_count=1
+    g2 = Graph()
+    g2.add_edge("a", "b", RW)
+    g2.add_edge("b", "a", RW)
+    assert (
+        g_mod.find_cycle_with(
+            g2, ["a", "b"], want=lambda r: RW in r, rest=lambda r: WW in r
+        )
+        is None
+    )
+
+
+def test_has_cycle_batch_matches_cpu():
+    rng = np.random.default_rng(7)
+    mats = []
+    for n in (3, 8, 20, 33):
+        m = rng.random((n, n)) < 0.15
+        np.fill_diagonal(m, False)
+        mats.append(m)
+    dev = cycles.cyclic_graph_mask.__wrapped__ if hasattr(cycles.cyclic_graph_mask, "__wrapped__") else None
+    from jepsen_tpu.ops import cycles as ops_cycles
+
+    got = ops_cycles.has_cycle_batch(mats)
+
+    def cpu_cyclic(m):
+        n = m.shape[0]
+        g = Graph()
+        for i in range(n):
+            g.add_vertex(i)
+            for j in range(n):
+                if m[i, j] and i != j:
+                    g.add_edge(i, j, WW)
+        return bool(g_mod.strongly_connected_components(g))
+
+    want = [cpu_cyclic(m) for m in mats]
+    assert list(got) == want
+
+
+# ---------------------------------------------------------------------------
+# list-append anomalies
+# ---------------------------------------------------------------------------
+
+
+def test_list_append_valid():
+    h = hist(
+        txn_pair(0, [["append", "x", 1]], [["append", "x", 1]], 0),
+        txn_pair(1, [["r", "x", None]], [["r", "x", [1]]], 10),
+        txn_pair(0, [["append", "x", 2]], [["append", "x", 2]], 20),
+        txn_pair(1, [["r", "x", None]], [["r", "x", [1, 2]]], 30),
+    )
+    res = list_append.check(h, {"consistency-models": ["strict-serializable"]})
+    assert res["valid?"] is True
+    assert res["anomaly-types"] == []
+
+
+def test_list_append_g1a():
+    h = hist(
+        txn_pair(0, [["append", "x", 1]], [["append", "x", 1]], 0, typ="fail"),
+        txn_pair(1, [["r", "x", None]], [["r", "x", [1]]], 10),
+    )
+    res = list_append.check(h, {"anomalies": ["G1"]})
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_list_append_g1b():
+    h = hist(
+        txn_pair(
+            0,
+            [["append", "x", 1], ["append", "x", 2]],
+            [["append", "x", 1], ["append", "x", 2]],
+            0,
+        ),
+        txn_pair(1, [["r", "x", None]], [["r", "x", [1]]], 10),
+    )
+    res = list_append.check(h, {"anomalies": ["G1"]})
+    assert res["valid?"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_list_append_g0():
+    # T1 appends x1 y2; T2 appends y1 x2 — ww cycle via both keys
+    h = hist(
+        txn_pair(
+            0,
+            [["append", "x", 1], ["append", "y", 2]],
+            [["append", "x", 1], ["append", "y", 2]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["append", "y", 1], ["append", "x", 2]],
+            [["append", "y", 1], ["append", "x", 2]],
+            0,
+        ),
+        txn_pair(2, [["r", "x", None], ["r", "y", None]],
+                 [["r", "x", [1, 2]], ["r", "y", [1, 2]]], 10),
+    )
+    res = list_append.check(h, {"anomalies": ["G0"]})
+    assert res["valid?"] is False
+    assert "G0" in res["anomaly-types"]
+
+
+def test_list_append_g_single():
+    # T1 reads x=[] while T2 appends 1; T2's append precedes T1's append
+    # of y observed by... construct: T1: r x -> [], append y 1
+    #                      T2: append x 1, r y -> [] ==> rw + rw = G2;
+    # simpler G-single: T1: r x [], T2: append x 1; T2 -wr-> T3 r x [1];
+    # T3 -?-> nope. Use canonical: T1 r x [] + append y 1;
+    # T2 append x 1 + r y [1] => T1 -rw-> T2 (missed x append),
+    # T1 -ww?-  no. T2 observed y=[1] => T1 -wr-> T2. So cycle T1->T2
+    # (rw) and T2->T1? need edge back: T2 -?-> T1: T2 read y [1] gives
+    # wr T1->T2 same direction. Instead:
+    # T1: append y 1, r x []     T2: append x 1, r y []
+    # T1 -rw-> T2 (T1 missed x1), T2 -rw-> T1 (T2 missed y1): G2-item.
+    h = hist(
+        txn_pair(
+            0,
+            [["append", "y", 1], ["r", "x", None]],
+            [["append", "y", 1], ["r", "x", []]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["append", "x", 1], ["r", "y", None]],
+            [["append", "x", 1], ["r", "y", []]],
+            0,
+        ),
+        txn_pair(2, [["r", "x", None], ["r", "y", None]],
+                 [["r", "x", [1]], ["r", "y", [1]]], 10),
+    )
+    res = list_append.check(h, {"anomalies": ["G2"]})
+    assert res["valid?"] is False
+    assert "G2-item" in res["anomaly-types"]
+
+
+def test_list_append_g_single_proper():
+    # T1: r x []           (missed T2's append => T1 -rw-> T2)
+    # T2: append x 1, append y 1
+    # T3: r y [1], r x... no — link T2 -wr-> T1 requires T1 to read T2.
+    # T1: r x [], append y 1; T2: append x 1, r y [1]:
+    #   T1 -rw-> T2 (missed x1); T2 reads y [1] => T1 -wr-> T2. Same
+    #   direction. Make T2 -ww-> T1 via y: version order y: [2 (T2), 1]?
+    # Canonical G-single: T1 -wr-> T2 -rw-> T1:
+    #   T1: append x 1; T2: r x [1], append y 1; T1': r y [] (same txn as T1?)
+    # Use: T1: append x 1, r y []; T2: r x [1], append y 1
+    #   T2 reads T1's x => T1 -wr-> T2. T1 read y [] missing T2's y1 =>
+    #   T1 -rw-> T2. Both same direction again! Need opposite:
+    #   T2 -x-> T1: T2 appends y after T1 read it: T1 -rw-> T2 and
+    #   T2 -wr-> T1 impossible (T1 can't read T2's write it missed).
+    # True G-single: T1 -ww-> T2, T2 -rw-> T1? T2 read z missing T1's
+    # append, T1 -ww-> T2 via key w order [T2's, T1's]... so:
+    #   key w order: a (T2) then b (T1)  => T2 -ww-> T1
+    #   T1 reads z [] missing T2's z1    => T1 -rw-> T2
+    h = hist(
+        txn_pair(
+            0,
+            [["append", "w", 2], ["r", "z", None]],
+            [["append", "w", 2], ["r", "z", []]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["append", "w", 1], ["append", "z", 1]],
+            [["append", "w", 1], ["append", "z", 1]],
+            0,
+        ),
+        txn_pair(2, [["r", "w", None], ["r", "z", None]],
+                 [["r", "w", [1, 2]], ["r", "z", [1]]], 10),
+    )
+    # txn0 appends w2 (second in order), reads z empty (missed txn1's z1)
+    # => txn0 -rw-> txn1; txn1 -ww-> txn0 via w order [1, 2].
+    res = list_append.check(h, {"consistency-models": ["snapshot-isolation"]})
+    assert res["valid?"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_list_append_internal():
+    h = hist(
+        txn_pair(
+            0,
+            [["r", "x", None], ["append", "x", 9], ["r", "x", None]],
+            [["r", "x", [1]], ["append", "x", 9], ["r", "x", [1]]],
+            0,
+        ),
+        txn_pair(1, [["append", "x", 1]], [["append", "x", 1]], -10),
+    )
+    res = list_append.check(h, {"anomalies": ["internal"]})
+    assert res["valid?"] is False
+    assert "internal" in res["anomaly-types"]
+
+
+def test_list_append_incompatible_order():
+    h = hist(
+        txn_pair(0, [["r", "x", None]], [["r", "x", [1, 2]]], 0),
+        txn_pair(1, [["r", "x", None]], [["r", "x", [2, 1]]], 10),
+        txn_pair(0, [["append", "x", 1]], [["append", "x", 1]], -20),
+        txn_pair(1, [["append", "x", 2]], [["append", "x", 2]], -10),
+    )
+    res = list_append.check(h, {"anomalies": ["incompatible-order"]})
+    assert res["valid?"] is False
+    assert "incompatible-order" in res["anomaly-types"]
+
+
+def test_list_append_duplicates():
+    h = hist(
+        txn_pair(0, [["append", "x", 1]], [["append", "x", 1]], 0),
+        txn_pair(1, [["r", "x", None]], [["r", "x", [1, 1]]], 10),
+    )
+    res = list_append.check(h, {"anomalies": ["duplicate-elements"]})
+    assert res["valid?"] is False
+    assert "duplicate-elements" in res["anomaly-types"]
+
+
+# ---------------------------------------------------------------------------
+# rw-register anomalies
+# ---------------------------------------------------------------------------
+
+
+def test_rw_register_valid():
+    h = hist(
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0),
+        txn_pair(1, [["r", "x", None]], [["r", "x", 1]], 10),
+        txn_pair(0, [["w", "x", 2]], [["w", "x", 2]], 20),
+        txn_pair(1, [["r", "x", None]], [["r", "x", 2]], 30),
+    )
+    res = rw_register.check(h, {"consistency-models": ["strict-serializable"]})
+    assert res["valid?"] is True
+
+
+def test_rw_register_g1a():
+    h = hist(
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0, typ="fail"),
+        txn_pair(1, [["r", "x", None]], [["r", "x", 1]], 10),
+    )
+    res = rw_register.check(h, {"anomalies": ["G1"]})
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_rw_register_g1b():
+    h = hist(
+        txn_pair(
+            0,
+            [["w", "x", 1], ["w", "x", 2]],
+            [["w", "x", 1], ["w", "x", 2]],
+            0,
+        ),
+        txn_pair(1, [["r", "x", None]], [["r", "x", 1]], 10),
+    )
+    res = rw_register.check(h, {"anomalies": ["G1"]})
+    assert res["valid?"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_rw_register_internal():
+    h = hist(
+        txn_pair(
+            0,
+            [["w", "x", 1], ["r", "x", None]],
+            [["w", "x", 1], ["r", "x", 5]],
+            0,
+        ),
+    )
+    res = rw_register.check(h, {"anomalies": ["internal"]})
+    assert res["valid?"] is False
+    assert "internal" in res["anomaly-types"]
+
+
+def test_rw_register_realtime_cycle():
+    # Linearizability violation visible through realtime order:
+    # T1 writes x=1, completes; then T2 writes x=2, completes; then T3
+    # reads x=1 — but wait, that alone is stale-read => T3 -rw-> T2 and
+    # T2 (realtime) -> T3: G-single-realtime.
+    h = hist(
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0),
+        txn_pair(0, [["w", "x", 2]], [["w", "x", 2]], 10),
+        txn_pair(1, [["r", "x", None]], [["r", "x", 1]], 20),
+    )
+    res = rw_register.check(
+        h, {"consistency-models": ["strict-serializable"]}
+    )
+    assert res["valid?"] is False
+    assert any("realtime" in a for a in res["anomaly-types"])
+
+
+def test_elle_check_dispatch():
+    h = hist(txn_pair(0, [["append", "x", 1]], [["append", "x", 1]], 0))
+    res = elle.check({"workload": "list-append"}, h)
+    assert res["valid?"] is True
+    res = elle.check({"workload": "rw-register"}, hist(
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0)
+    ))
+    assert res["valid?"] is True
+    with pytest.raises(KeyError):
+        elle.check({"workload": "nope"}, h)
+
+
+def test_rw_register_deep_version_chain():
+    # 2000-txn read->write chain per key must not blow the stack
+    pairs = []
+    prev = None
+    for i in range(2000):
+        pairs.append(
+            txn_pair(
+                0,
+                [["r", "x", None], ["w", "x", i]],
+                [["r", "x", prev], ["w", "x", i]],
+                i * 10,
+            )
+        )
+        prev = i
+    res = rw_register.check(hist(*pairs), {"anomalies": ["G1"]})
+    assert res["valid?"] is True
+
+
+def test_rw_register_cyclic_versions_does_not_mask_g1a():
+    h = hist(
+        # aborted read: definite anomaly
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0, typ="fail"),
+        txn_pair(1, [["r", "x", None]], [["r", "x", 1]], 10),
+        # cyclic version order on another key
+        txn_pair(0, [["r", "y", None], ["w", "y", 7]], [["r", "y", 8], ["w", "y", 7]], 20),
+        txn_pair(1, [["r", "y", None], ["w", "y", 8]], [["r", "y", 7], ["w", "y", 8]], 30),
+    )
+    res = rw_register.check(h, {"anomalies": ["G1"]})
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_cycle_workload_checker_defaults_survive_generator_opts():
+    from jepsen_tpu.workloads.cycle import append as cycle_append
+
+    # generator-only opts must not flip the checker to strict-serializable
+    t = cycle_append.test({"key-count": 3})
+    assert t["checker"].opts.get("anomalies") == ["G1", "G2"]
+    t2 = cycle_append.test({"consistency-models": ["serializable"]})
+    assert "anomalies" not in t2["checker"].opts

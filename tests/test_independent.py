@@ -1,0 +1,112 @@
+"""Tests for jepsen_tpu.independent (reference: independent.clj +
+test/jepsen/independent_test.clj behaviors)."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu import models
+from jepsen_tpu.generator import sim
+from jepsen_tpu.history import History, Op, invoke_op, ok_op
+from jepsen_tpu.checker import Checker
+
+
+def test_kv_tuple():
+    t = ind.kv("k", 3)
+    assert ind.is_tuple(t)
+    assert t.key == "k"
+    assert t.value == 3
+    assert not ind.is_tuple(("k", 3))
+    assert t == ("k", 3)  # still a tuple
+
+
+def test_sequential_generator_wraps_values():
+    g = ind.sequential_generator([0, 1], lambda k: [{"f": "read"}] * 2)
+    h = sim.quick(g, ctx=sim.n_plus_nemesis_context(1))
+    vals = [o["value"] for o in h]
+    assert all(ind.is_tuple(v) for v in vals)
+    assert [v.key for v in vals] == [0, 0, 1, 1]
+
+
+def test_concurrent_generator_groups():
+    # 4 client threads, 2 per key => 2 concurrent keys
+    g = ind.concurrent_generator(
+        2, list(range(4)), lambda k: [{"f": "read"}] * 4
+    )
+    h = sim.quick(g, ctx=sim.n_plus_nemesis_context(4))
+    keys = [o["value"].key for o in h]
+    assert len(h) == 16
+    # first two keys run concurrently before later ones appear
+    first_half = set(keys[:8])
+    assert first_half == {0, 1}
+    assert set(keys) == {0, 1, 2, 3}
+
+
+def test_concurrent_generator_rejects_bad_concurrency():
+    g = ind.concurrent_generator(3, [0], lambda k: [{"f": "read"}])
+    with pytest.raises(Exception):
+        sim.quick(g, ctx=sim.n_plus_nemesis_context(4))
+
+
+def test_history_keys_and_subhistory():
+    h = History(
+        [
+            invoke_op(0, "read", ind.kv(1, None), time=0, index=0),
+            Op("info", "nemesis", "start", None, time=1, index=1),
+            ok_op(0, "read", ind.kv(1, 5), time=2, index=2),
+            invoke_op(1, "write", ind.kv(2, 7), time=3, index=3),
+            ok_op(1, "write", ind.kv(2, 7), time=4, index=4),
+        ]
+    )
+    assert ind.history_keys(h) == {1, 2}
+    sub = ind.subhistory(1, h)
+    assert [op.value for op in sub] == [None, None, 5]
+    # nemesis op appears in every subhistory
+    assert any(op.process == "nemesis" for op in ind.subhistory(2, h))
+
+
+class _ValueChecker(Checker):
+    """Valid iff every ok op's value is even."""
+
+    def check(self, test, history, opts=None):
+        bad = [op.value for op in history if op.is_ok and op.value % 2]
+        return {"valid?": not bad, "bad": bad}
+
+
+def test_independent_checker():
+    h = History(
+        [
+            invoke_op(0, "w", ind.kv("a", 2), time=0),
+            ok_op(0, "w", ind.kv("a", 2), time=1),
+            invoke_op(0, "w", ind.kv("b", 3), time=2),
+            ok_op(0, "w", ind.kv("b", 3), time=3),
+        ]
+    ).index_ops()
+    chk = ind.checker(_ValueChecker())
+    res = chk.check({"name": "t", "store?": False}, h, {})
+    assert res["valid?"] is False
+    assert res["failures"] == ["b"]
+    assert res["results"]["a"]["valid?"] is True
+
+
+def _register_history(k, values_ok=True):
+    """A tiny per-key linearizable (or not) register history."""
+    ops = [
+        invoke_op(0, "write", ind.kv(k, 1), time=0),
+        ok_op(0, "write", ind.kv(k, 1), time=1),
+        invoke_op(1, "read", ind.kv(k, None), time=2),
+        ok_op(1, "read", ind.kv(k, 1 if values_ok else 9), time=3),
+    ]
+    return ops
+
+
+def test_batched_linearizable():
+    ops = _register_history("good") + _register_history("bad", values_ok=False)
+    # adjust times so ops interleave but remain per-key sane
+    h = History(ops).index_ops()
+    chk = ind.batched_linearizable(models.cas_register())
+    res = chk.check({"name": "t", "store?": False}, h, {})
+    assert res["results"]["good"]["valid?"] is True
+    assert res["results"]["bad"]["valid?"] is False
+    assert res["failures"] == ["bad"]
+    assert res["valid?"] is False

@@ -1,0 +1,139 @@
+"""Tests for the SVG/HTML renderers (perf, timeline, clock, bank plot).
+(reference behaviors: checker/perf.clj, checker/timeline.clj,
+checker/clock.clj)"""
+
+import os
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu.checker import clock, perf, svg, timeline
+from jepsen_tpu.history import History, Op, invoke_op, ok_op
+
+
+def _history():
+    ops = []
+    t = 0
+    for i in range(40):
+        p = i % 3
+        ops.append(invoke_op(p, "read" if i % 2 else "write", i, time=t))
+        ops.append(ok_op(p, "read" if i % 2 else "write", i, time=t + 5_000_000))
+        t += 50_000_000
+    ops.append(Op("info", "nemesis", "start", None, time=3 * 50_000_000))
+    ops.append(Op("info", "nemesis", "stop", None, time=20 * 50_000_000))
+    ops.sort(key=lambda o: o.time)
+    return History(ops).index_ops()
+
+
+def _test_map(tmp_path):
+    return {
+        "name": "render-test",
+        "start-time": "t0",
+        "store-base": str(tmp_path),
+    }
+
+
+def test_svg_render_basic(tmp_path):
+    path = str(tmp_path / "plot.svg")
+    out = svg.render(
+        path,
+        [svg.Series("a", [(0, 1), (1, 5), (2, 3)], mode="line")],
+        title="t",
+        regions=[svg.Region(0.5, 1.5, label="nem")],
+    )
+    assert out == path
+    content = open(path).read()
+    assert content.startswith("<svg")
+    assert "nem" in content
+
+
+def test_svg_render_empty_returns_none(tmp_path):
+    assert svg.render(str(tmp_path / "x.svg"), []) is None
+
+
+def test_perf_graphs(tmp_path):
+    test = _test_map(tmp_path)
+    h = _history()
+    p1 = perf.point_graph(test, h, {})
+    p2 = perf.quantiles_graph(test, h, {"dt": 1})
+    p3 = perf.rate_graph(test, h, {"dt": 1})
+    for p in (p1, p2, p3):
+        assert p is not None and os.path.exists(p)
+
+
+def test_perf_checker_composed(tmp_path):
+    test = _test_map(tmp_path)
+    res = chk.perf_checker().check(test, _history(), {})
+    assert res["valid?"] is True
+    base = tmp_path / "render-test" / "t0"
+    assert (base / "latency-raw.svg").exists()
+    assert (base / "rate.svg").exists()
+
+
+def test_latencies_to_quantiles():
+    pts = [(0.1, 10), (0.2, 20), (0.3, 30), (1.1, 100)]
+    qs = perf.latencies_to_quantiles(1.0, (0.5, 1.0), pts)
+    assert qs[1.0][0][1] == 30
+    assert qs[1.0][1][1] == 100
+    assert qs[0.5][0][1] == 20
+
+
+def test_timeline_html(tmp_path):
+    test = _test_map(tmp_path)
+    res = timeline.html().check(test, _history(), {})
+    assert res["valid?"] is True
+    path = tmp_path / "render-test" / "t0" / "timeline.html"
+    content = open(path).read()
+    assert "op ok" in content
+    assert "render-test" in content
+
+
+def test_timeline_pairs_handles_crashes():
+    h = History(
+        [
+            invoke_op(0, "w", 1, time=0),
+            Op("info", 0, "w", None, time=1),  # crash
+            Op("info", "nemesis", "start", None, time=2),  # unmatched info
+            invoke_op(1, "w", 2, time=3),  # never completes
+        ]
+    ).index_ops()
+    ps = timeline.pairs(h)
+    assert len(ps) == 3
+    lens = sorted(len(p) for p in ps)
+    assert lens == [1, 1, 2]
+
+
+def test_clock_plot(tmp_path):
+    test = _test_map(tmp_path)
+    h = History(
+        [
+            Op("info", "nemesis", "check-offsets", None, time=0,
+               **{"clock-offsets": {"n1": 0.5, "n2": -0.25}}),
+            Op("info", "nemesis", "check-offsets", None, time=2_000_000_000,
+               **{"clock-offsets": {"n1": 1.5, "n2": 0.0}}),
+        ]
+    ).index_ops()
+    res = clock.plotter().check(test, h, {})
+    assert res["valid?"] is True
+    assert (tmp_path / "render-test" / "t0" / "clock-skew.svg").exists()
+
+
+def test_short_node_names():
+    assert clock.short_node_names(
+        ["n1.foo.com", "n2.foo.com"]
+    ) == ["n1", "n2"]
+    assert clock.short_node_names(["a", "b"]) == ["a", "b"]
+
+
+def test_bank_plotter(tmp_path):
+    from jepsen_tpu.workloads import bank
+
+    test = {**_test_map(tmp_path), "nodes": ["n1", "n2"], "accounts": [0, 1],
+            "total-amount": 10, "max-transfer": 2}
+    h = History(
+        [
+            invoke_op(0, "read", None, time=0),
+            ok_op(0, "read", {0: 5, 1: 5}, time=1_000_000),
+        ]
+    ).index_ops()
+    res = bank.plotter().check(test, h, {})
+    assert res["valid?"] is True
+    assert (tmp_path / "render-test" / "t0" / "bank.svg").exists()

@@ -1,0 +1,308 @@
+"""Tests for the workloads package (reference: jepsen.tests.* suites)."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu import workloads
+from jepsen_tpu.generator import sim
+from jepsen_tpu.history import History, Op, invoke_op, ok_op
+from jepsen_tpu.workloads import (
+    adya,
+    bank,
+    causal,
+    causal_reverse,
+    linearizable_register,
+    long_fork,
+)
+from jepsen_tpu.workloads.cycle import append as cycle_append, wr as cycle_wr
+
+
+def _complete_pairs(pairs):
+    ops = [op for pair in pairs for op in pair]
+    ops.sort(key=lambda o: o.time)
+    return History(ops).index_ops()
+
+
+# ---------------------------------------------------------------------------
+# bank
+# ---------------------------------------------------------------------------
+
+
+def _bank_test():
+    t = bank.test()
+    t.update({"name": "bank", "nodes": ["n1"], "store?": False})
+    return t
+
+
+def test_bank_generator_shape():
+    t = _bank_test()
+    ops = sim.quick(
+        gen.limit(50, t["generator"]),
+        ctx=sim.n_plus_nemesis_context(2),
+        test=t,
+    )
+    assert len(ops) == 50
+    for o in ops:
+        assert o["f"] in ("read", "transfer")
+        if o["f"] == "transfer":
+            v = o["value"]
+            assert v["from"] != v["to"]
+            assert 1 <= v["amount"] <= 5
+
+
+def test_bank_checker_valid():
+    t = _bank_test()
+    h = History(
+        [
+            invoke_op(0, "read", None, time=0),
+            ok_op(0, "read", {i: 100 // 8 if i else 100 - 7 * (100 // 8) for i in range(8)}, time=1),
+        ]
+    ).index_ops()
+    res = bank.checker({}).check(t, h, {})
+    assert res["valid?"] is True
+    assert res["read-count"] == 1
+
+
+def test_bank_checker_catches_errors():
+    t = _bank_test()
+    h = History(
+        [
+            ok_op(0, "read", {i: 0 for i in range(8)}, time=1, index=0),   # wrong total
+            ok_op(0, "read", {0: 101, **{i: None for i in range(1, 8)}}, time=2, index=1),  # nils
+            ok_op(0, "read", {0: 100, 9: 0, **{i: 0 for i in range(1, 8)}}, time=3, index=2),  # key
+            ok_op(0, "read", {0: 105, 1: -5, **{i: 0 for i in range(2, 8)}}, time=4, index=3),  # neg
+        ]
+    )
+    res = bank.checker({}).check(t, h, {})
+    assert res["valid?"] is False
+    assert set(res["errors"]) == {
+        "wrong-total", "nil-balance", "unexpected-key", "negative-value",
+    }
+    # negative balances allowed when configured
+    res2 = bank.checker({"negative-balances?": True}).check(
+        t,
+        History([ok_op(0, "read", {0: 105, 1: -5, **{i: 0 for i in range(2, 8)}}, time=4, index=0)]),
+        {},
+    )
+    assert res2["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# long-fork
+# ---------------------------------------------------------------------------
+
+
+def test_long_fork_generator():
+    w = long_fork.workload(2)
+    ops = sim.quick(gen.limit(40, w["generator"]), ctx=sim.n_plus_nemesis_context(3))
+    assert len(ops) == 40
+    for o in ops:
+        assert o["f"] in ("read", "write")
+
+
+def test_long_fork_detects_fork():
+    n = 2
+    pair = lambda p, val, t: [  # noqa: E731
+        invoke_op(p, "read", [["r", 0, None], ["r", 1, None]], time=t),
+        ok_op(p, "read", val, time=t + 1),
+    ]
+    wr = lambda p, k, t: [  # noqa: E731
+        invoke_op(p, "write", [["w", k, 1]], time=t),
+        ok_op(p, "write", [["w", k, 1]], time=t + 1),
+    ]
+    h = _complete_pairs(
+        [
+            wr(0, 0, 0),
+            wr(1, 1, 10),
+            pair(2, [["r", 0, 1], ["r", 1, None]], 20),
+            pair(3, [["r", 0, None], ["r", 1, 1]], 30),
+        ]
+    )
+    res = long_fork.checker(n).check({}, h, {})
+    assert res["valid?"] is False
+    assert res["forks"]
+
+    h2 = _complete_pairs(
+        [
+            wr(0, 0, 0),
+            wr(1, 1, 10),
+            pair(2, [["r", 0, 1], ["r", 1, None]], 20),
+            pair(3, [["r", 0, 1], ["r", 1, 1]], 30),
+        ]
+    )
+    res2 = long_fork.checker(n).check({}, h2, {})
+    assert res2["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# causal
+# ---------------------------------------------------------------------------
+
+
+def test_causal_register_model():
+    m = causal.causal_register()
+    ops = [
+        Op("ok", 0, "read-init", None, link="init", position=1),
+        Op("ok", 0, "write", 1, link=1, position=2),
+        Op("ok", 0, "read", 1, link=2, position=3),
+    ]
+    for op in ops:
+        m = m.step(op)
+    assert repr(m) == "1"
+
+    # bad link
+    m2 = causal.causal_register().step(
+        Op("ok", 0, "write", 1, link=99, position=2)
+    )
+    from jepsen_tpu.models import Inconsistent
+
+    assert isinstance(m2, Inconsistent)
+
+
+def test_causal_checker():
+    h = History(
+        [
+            Op("ok", 0, "read-init", 0, link="init", position=1, time=0),
+            Op("ok", 0, "write", 1, link=1, position=2, time=1),
+            Op("ok", 0, "read", 5, link=2, position=3, time=2),
+        ]
+    ).index_ops()
+    res = causal.check(causal.causal_register()).check({}, h, {})
+    assert res["valid?"] is False
+
+
+# ---------------------------------------------------------------------------
+# causal-reverse
+# ---------------------------------------------------------------------------
+
+
+def test_causal_reverse_checker():
+    # w1 completes before w2 invokes; a read sees 2 but not 1 => error
+    h = History(
+        [
+            invoke_op(0, "write", 1, time=0),
+            ok_op(0, "write", 1, time=1),
+            invoke_op(0, "write", 2, time=2),
+            ok_op(0, "write", 2, time=3),
+            invoke_op(1, "read", None, time=4),
+            ok_op(1, "read", [2], time=5),
+        ]
+    ).index_ops()
+    res = causal_reverse.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["missing"] == [1]
+
+    h2 = History(
+        [
+            invoke_op(0, "write", 1, time=0),
+            ok_op(0, "write", 1, time=1),
+            invoke_op(1, "read", None, time=4),
+            ok_op(1, "read", [1], time=5),
+        ]
+    ).index_ops()
+    assert causal_reverse.checker().check({}, h2, {})["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# adya
+# ---------------------------------------------------------------------------
+
+
+def test_adya_g2_checker():
+    h = History(
+        [
+            ok_op(0, "insert", ind.kv(1, [None, 1]), time=0, index=0),
+            ok_op(1, "insert", ind.kv(1, [2, None]), time=1, index=1),
+            ok_op(0, "insert", ind.kv(2, [None, 3]), time=2, index=2),
+            Op("fail", 1, "insert", ind.kv(2, [4, None]), time=3, index=3),
+        ]
+    )
+    res = adya.g2_checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["illegal"] == {1: 2}
+    assert res["key-count"] == 2
+
+
+def test_adya_gen_unique_ids():
+    g = adya.g2_gen()
+    ops = sim.quick(gen.limit(20, g), ctx=sim.n_plus_nemesis_context(4))
+    ids = [x for o in ops for x in o["value"].value if x is not None]
+    assert len(ids) == len(set(ids))
+
+
+# ---------------------------------------------------------------------------
+# linearizable-register
+# ---------------------------------------------------------------------------
+
+
+def test_linearizable_register_workload():
+    t = linearizable_register.test({"nodes": ["n1"], "per-key-limit": 6})
+    ops = sim.quick(gen.limit(30, t["generator"]), ctx=sim.n_plus_nemesis_context(2))
+    assert ops
+    for o in ops:
+        assert o["f"] in ("read", "write", "cas")
+        assert ind.is_tuple(o["value"]) or o["value"] is None
+    # checker end-to-end on a tiny valid keyed history
+    h = History(
+        [
+            invoke_op(0, "write", ind.kv(0, 3), time=0),
+            ok_op(0, "write", ind.kv(0, 3), time=1),
+            invoke_op(1, "read", ind.kv(0, None), time=2),
+            ok_op(1, "read", ind.kv(0, 3), time=3),
+        ]
+    ).index_ops()
+    res = t["checker"].check({"name": "lr", "store?": False}, h, {})
+    assert res["valid?"] is True
+
+
+# ---------------------------------------------------------------------------
+# txn workloads (cycle/append, cycle/wr)
+# ---------------------------------------------------------------------------
+
+
+def test_cycle_append_generator_and_checker():
+    t = cycle_append.test({"key-count": 3, "max-txn-length": 3})
+    ops = sim.quick(gen.limit(30, t["generator"]), ctx=sim.n_plus_nemesis_context(2))
+    assert len(ops) == 30
+    for o in ops:
+        assert o["f"] == "txn"
+        for f, k, v in o["value"]:
+            assert f in ("r", "append")
+    # written values unique
+    writes = [(k, v) for o in ops for f, k, v in o["value"] if f == "append"]
+    assert len(writes) == len(set(writes))
+
+
+def test_cycle_wr_generator():
+    t = cycle_wr.test({})
+    ops = sim.quick(gen.limit(20, t["generator"]), ctx=sim.n_plus_nemesis_context(2))
+    for o in ops:
+        for f, k, v in o["value"]:
+            assert f in ("r", "w")
+
+
+def test_workload_registry():
+    for name in (
+        "bank",
+        "long-fork",
+        "causal",
+        "causal-reverse",
+        "adya-g2",
+        "linearizable-register",
+        "list-append",
+        "rw-register",
+    ):
+        w = workloads.workload(name, {"nodes": ["n1"], "time-limit": 1})
+        assert "checker" in w and "generator" in w
+    with pytest.raises(KeyError):
+        workloads.workload("nope")
+
+
+def test_noop_test_runs():
+    from jepsen_tpu import core
+
+    t = workloads.noop_test()
+    t["time-limit"] = 0.05
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
