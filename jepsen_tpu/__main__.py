@@ -1,0 +1,6 @@
+"""``python -m jepsen_tpu`` → the CLI.  (reference: project.clj:34
+``:main jepsen.cli``)"""
+
+from .cli import main
+
+main()

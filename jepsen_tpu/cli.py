@@ -1,0 +1,329 @@
+"""Command-line runner: ``test`` / ``analyze`` / ``serve`` / ``test-all``.
+
+(reference: jepsen/src/jepsen/cli.clj — run! dispatcher:258, standard
+test opt spec:64-111 incl. the "3n" concurrency convention:150-168,
+single-test-cmd:355 providing both `test` and `analyze`:389-431,
+serve-cmd:336, test-all-cmd:491, exit codes:129-138)
+
+Exit codes: 0 valid, 1 invalid, 2 unknown/errors, 254 usage error,
+255 crash.
+
+A DB suite builds its CLI by passing its test-constructor to
+:func:`single_test_cmd` and calling :func:`main` with the merged
+command map — same shape as the reference's `(cli/run! (merge
+(cli/single-test-cmd …) (cli/serve-cmd)))`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+EXIT_VALID = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_USAGE = 254
+EXIT_CRASH = 255
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def parse_concurrency(s: str, node_count: int) -> int:
+    """"30" → 30; "3n" → 3 × node count.  (reference: cli.clj:150-168)"""
+    s = str(s).strip()
+    if s.endswith("n"):
+        return int(s[:-1] or 1) * node_count
+    return int(s)
+
+
+def parse_nodes(args: argparse.Namespace) -> List[str]:
+    """--nodes a,b,c / repeated --node / --nodes-file, last wins per
+    source precedence (file > node > nodes).  (reference: cli.clj:68-84)"""
+    nodes: List[str] = list(DEFAULT_NODES)
+    if getattr(args, "nodes", None):
+        nodes = [n.strip() for n in args.nodes.split(",") if n.strip()]
+    if getattr(args, "node", None):
+        nodes = list(args.node)
+    if getattr(args, "nodes_file", None):
+        with open(args.nodes_file) as f:
+            nodes = [line.strip() for line in f if line.strip()]
+    return nodes
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """The standard test option spec.  (reference: cli.clj:64-111)"""
+    p.add_argument("--nodes", help="comma-separated node hostnames")
+    p.add_argument("--node", action="append", help="node hostname (repeatable)")
+    p.add_argument("--nodes-file", help="file with one node per line")
+    p.add_argument(
+        "--concurrency",
+        default="1n",
+        help='number of workers, or "<k>n" for k × node count (default 1n)',
+    )
+    p.add_argument(
+        "--time-limit",
+        type=float,
+        default=60,
+        help="run the workload this many seconds (default 60)",
+    )
+    p.add_argument(
+        "--test-count",
+        type=int,
+        default=1,
+        help="run the whole test suite this many times",
+    )
+    p.add_argument("--username", default="root", help="ssh username")
+    p.add_argument("--password", help="ssh password")
+    p.add_argument("--ssh-private-key", help="path to an ssh identity file")
+    p.add_argument(
+        "--dummy",
+        action="store_true",
+        help="use the no-IO dummy remote (in-process runs)",
+    )
+    p.add_argument(
+        "--leave-db-running",
+        action="store_true",
+        help="don't tear the DB down after the test",
+    )
+    p.add_argument(
+        "--logging-json", action="store_true", help="JSON-structured logs"
+    )
+    p.add_argument("--store-base", default="store", help="artifact directory")
+
+
+def test_opts_to_map(args: argparse.Namespace) -> dict:
+    """Build the base test map from parsed standard options.
+    (reference: cli.clj:245-254 test-opt-fn)"""
+    nodes = parse_nodes(args)
+    test = {
+        "nodes": nodes,
+        "concurrency": parse_concurrency(args.concurrency, len(nodes)),
+        "time-limit": args.time_limit,
+        "store-base": args.store_base,
+        "leave-db-running?": args.leave_db_running,
+        "logging-json?": args.logging_json,
+        "ssh": {
+            "username": args.username,
+            "password": args.password,
+            "private-key-path": args.ssh_private_key,
+        },
+    }
+    if args.dummy:
+        from .control.core import DummyRemote
+
+        test["remote"] = DummyRemote()
+    return test
+
+
+def _exit_code(results: dict) -> int:
+    v = (results or {}).get("valid?")
+    if v is True:
+        return EXIT_VALID
+    if v is False:
+        return EXIT_INVALID
+    return EXIT_UNKNOWN
+
+
+def run_test(test: dict) -> int:
+    """Run one prepared test map; returns its exit code."""
+    from . import core
+
+    result = core.run(test)
+    return _exit_code(result.get("results", {}))
+
+
+def single_test_cmd(
+    test_fn: Callable[[dict], dict],
+    opt_fn: Optional[Callable[[argparse.ArgumentParser], None]] = None,
+) -> Dict[str, dict]:
+    """Commands for running/re-analyzing one test family:
+
+    - ``test``: build the test from CLI opts via test_fn and run it
+    - ``analyze``: re-run checkers over a stored history without
+      re-running the test (analysis resume)
+
+    (reference: cli.clj:355-431)"""
+
+    def add_opts(p):
+        add_test_opts(p)
+        if opt_fn is not None:
+            opt_fn(p)
+
+    def run(args) -> int:
+        worst = EXIT_VALID
+        for _ in range(args.test_count):
+            test = test_fn({**vars(args), **test_opts_to_map(args)})
+            code = run_test(test)
+            worst = max(worst, code)
+            if code != EXIT_VALID:
+                return code
+        return worst
+
+    def analyze(args) -> int:
+        from . import checker as checker_mod
+        from . import store as store_mod
+
+        stored = (
+            store_mod.load(args.test_name, args.test_time)
+            if args.test_name
+            else store_mod.latest(args.store_base)
+        )
+        if stored is None:
+            print("no stored test found", file=sys.stderr)
+            return EXIT_USAGE
+        test = test_fn({**vars(args), **test_opts_to_map(args), **stored})
+        history = stored.get("history")
+        results = checker_mod.check_safe(test["checker"], test, history, {})
+        print_results = {
+            k: v for k, v in results.items() if k != "history"
+        }
+        print(logging_safe_repr(print_results))
+        return _exit_code(results)
+
+    def add_analyze_opts(p):
+        add_opts(p)
+        p.add_argument("--test-name", help="stored test name")
+        p.add_argument("--test-time", help="stored test start-time")
+
+    return {
+        "test": {
+            "help": "run a test",
+            "add_opts": add_opts,
+            "run": run,
+        },
+        "analyze": {
+            "help": "re-run the checker over a stored history",
+            "add_opts": add_analyze_opts,
+            "run": analyze,
+        },
+    }
+
+
+def serve_cmd() -> Dict[str, dict]:
+    """(reference: cli.clj:336-354)"""
+
+    def add_opts(p):
+        p.add_argument("--host", default="0.0.0.0")
+        p.add_argument("--port", "-b", type=int, default=8080)
+        p.add_argument("--store-base", default="store")
+
+    def run(args) -> int:
+        from . import web
+
+        web.serve(host=args.host, port=args.port, base=args.store_base)
+        return EXIT_VALID
+
+    return {"serve": {"help": "serve the store web UI",
+                      "add_opts": add_opts, "run": run}}
+
+
+def test_all_cmd(
+    tests_fn: Callable[[dict], List[dict]],
+    opt_fn: Optional[Callable[[argparse.ArgumentParser], None]] = None,
+) -> Dict[str, dict]:
+    """Run every test a suite defines; worst exit code wins.
+    (reference: cli.clj:491-519)"""
+
+    def add_opts(p):
+        add_test_opts(p)
+        if opt_fn is not None:
+            opt_fn(p)
+
+    def run(args) -> int:
+        worst = EXIT_VALID
+        for test in tests_fn({**vars(args), **test_opts_to_map(args)}):
+            code = run_test(test)
+            worst = max(worst, code)
+        return worst
+
+    return {"test-all": {"help": "run every defined test",
+                         "add_opts": add_opts, "run": run}}
+
+
+def logging_safe_repr(obj: Any) -> str:
+    import json
+
+    return json.dumps(obj, indent=2, default=repr)
+
+
+def run_cli(commands: Dict[str, dict], argv: Optional[List[str]] = None) -> int:
+    """Parse argv against the command map and dispatch.
+    (reference: cli.clj:258-334 run!)"""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s",
+    )
+    parser = argparse.ArgumentParser(
+        prog="jepsen-tpu", description="TPU-native distributed-systems tester"
+    )
+    sub = parser.add_subparsers(dest="command")
+    for name, spec in commands.items():
+        p = sub.add_parser(name, help=spec.get("help"))
+        spec.get("add_opts", lambda _p: None)(p)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return EXIT_USAGE
+    try:
+        return commands[args.command]["run"](args)
+    except SystemExit as e:
+        return int(e.code or 0)
+    except KeyboardInterrupt:
+        return EXIT_CRASH
+    except Exception:
+        traceback.print_exc()
+        return EXIT_CRASH
+
+
+def default_commands() -> Dict[str, dict]:
+    """The built-in command set: run any registered workload against the
+    in-memory fake client (dummy remote), plus serve/analyze."""
+
+    def add_workload_opt(p):
+        p.add_argument(
+            "--workload",
+            default="linearizable-register",
+            help="workload name (see jepsen_tpu.workloads.workload)",
+        )
+        p.add_argument(
+            "--per-key-limit",
+            type=int,
+            default=32,
+            help="ops per independent key before rotating to a fresh one",
+        )
+
+    def make_test(opts: dict) -> dict:
+        from . import generator as gen
+        from . import workloads
+        from .fake import KeyedAtomClient
+
+        opts = dict(opts)
+        if "per_key_limit" in opts:
+            opts.setdefault("per-key-limit", opts.pop("per_key_limit"))
+        wl = workloads.workload(opts["workload"], opts)
+        g = wl.get("generator")
+        if opts.get("time-limit"):
+            g = gen.time_limit(opts["time-limit"], g)
+        return {
+            **{k: v for k, v in opts.items() if not callable(v)},
+            "name": opts["workload"],
+            "client": KeyedAtomClient(),
+            "generator": g,
+            "checker": wl.get("checker"),
+        }
+
+    cmds: Dict[str, dict] = {}
+    cmds.update(single_test_cmd(make_test, add_workload_opt))
+    cmds.update(serve_cmd())
+    return cmds
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    sys.exit(run_cli(default_commands(), argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -124,13 +124,40 @@ def log_results(test: dict) -> dict:
 
 def run(test: dict) -> dict:
     """Full lifecycle; returns the test with :history and :results.
-    (reference: core.clj:327-406)"""
+    Persistence is 3-phase (save_0 at start, save_1 once the history is
+    durable, save_2 after analysis) unless ``store?`` is False.
+    (reference: core.clj:327-406 + store.clj:413-456)"""
+    from contextlib import nullcontext
+
+    from . import store as store_mod
+
     test = prepare_test(test)
+    storing = test.get("store?", True)
 
-    # OS + DB setup over the control plane, when configured (real
-    # clusters; in-process tests leave these unset / dummy)
+    if storing:
+        store_mod.start_logging(test, test.get("logging-json?", False))
+    try:
+        writer_ctx = (
+            store_mod.with_writer(test) if storing else nullcontext(test)
+        )
+        with writer_ctx as test:
+            if storing:
+                test = store_mod.save_0(test)
+            test = _run_body(test)
+            if storing:
+                test = store_mod.save_2(test)
+            return log_results(test)
+    finally:
+        if storing:
+            store_mod.stop_logging(test)
+
+
+def _run_body(test: dict) -> dict:
+    """OS/DB setup, the run itself, history save, analysis."""
     from . import db as db_mod
+    from . import store as store_mod
 
+    storing = test.get("store?", True)
     db = test.get("db")
     os_ = test.get("os")
     control_ctx = _control_context(test)
@@ -143,8 +170,9 @@ def run(test: dict) -> dict:
             with with_relative_time():
                 history = run_case(test)
             test = {**test, "history": history}
-            test = analyze(test)
-            return log_results(test)
+            if storing:
+                test = store_mod.save_1(test)
+            return analyze(test)
         finally:
             if db is not None and not test.get("leave-db-running?"):
                 _on_nodes(test, lambda node: db.teardown(test, node))

@@ -81,6 +81,50 @@ class AtomClient(client_mod.Client):
         self.meta_log.append("close")
 
 
+class KeyedAtomClient(client_mod.Client):
+    """A map of independent CAS registers: understands ops whose value
+    is an independent ``[k, v]`` tuple, routing v to the register for k.
+    Drives the keyed workloads (linearizable-register etc.) in-process."""
+
+    def __init__(self, registers=None, latency: float = 0.0):
+        self.registers = registers if registers is not None else {}
+        self.lock = threading.Lock()
+        self.latency = latency
+
+    def open(self, test, node):
+        c = KeyedAtomClient(registers=self.registers, latency=self.latency)
+        c.lock = self.lock
+        return c
+
+    def _register(self, k) -> AtomState:
+        with self.lock:
+            if k not in self.registers:
+                self.registers[k] = AtomState(None)
+            return self.registers[k]
+
+    def invoke(self, test, op):
+        from . import independent as ind
+
+        if self.latency:
+            time.sleep(self.latency)
+        v = op.get("value")
+        if not isinstance(v, ind.KV):
+            raise ValueError(f"expected [k, v] tuple value, got {v!r}")
+        k, inner_v = v.key, v.value
+        reg = self._register(k)
+        f = op["f"]
+        if f == "write":
+            reg.reset(inner_v)
+            return {**op, "type": "ok"}
+        if f == "cas":
+            old, new = inner_v
+            ok = reg.cas(old, new)
+            return {**op, "type": "ok" if ok else "fail"}
+        if f == "read":
+            return {**op, "type": "ok", "value": ind.kv(k, reg.deref())}
+        raise ValueError(f"unknown op f={f!r}")
+
+
 class CrashingClient(AtomClient):
     """Like AtomClient but raises on a fraction of ops — exercises the
     interpreter's crash→:info→process-retirement path."""

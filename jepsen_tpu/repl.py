@@ -1,0 +1,13 @@
+"""REPL conveniences.  (reference: jepsen/src/jepsen/repl.clj)"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import store
+
+
+def latest_test(base: str = store.BASE) -> Optional[dict]:
+    """The most recently run test, loaded from the store.
+    (reference: repl.clj:6-15)"""
+    return store.latest(base)

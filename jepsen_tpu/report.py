@@ -1,0 +1,33 @@
+"""Bind stdout to a report file.  (reference: jepsen/src/jepsen/report.clj)"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import sys
+from contextlib import contextmanager
+
+
+@contextmanager
+def to(filename: str):
+    """Within the block, stdout tees to `filename`.
+    (reference: report.clj:7-16)"""
+    real = sys.stdout
+
+    class Tee(io.TextIOBase):
+        def __init__(self, f):
+            self.f = f
+
+        def write(self, s):
+            real.write(s)
+            self.f.write(s)
+            return len(s)
+
+        def flush(self):
+            real.flush()
+            self.f.flush()
+
+    with open(filename, "w") as f:
+        tee = Tee(f)
+        with contextlib.redirect_stdout(tee):
+            yield

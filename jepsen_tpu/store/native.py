@@ -1,0 +1,85 @@
+"""ctypes bindings for the C++ block-file writer (native/blockfile.cc),
+with on-demand compilation and a graceful "not available" signal so the
+pure-Python path (store.format) can take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "blockfile.cc")
+_SO = os.path.join(_REPO_ROOT, "native", "libblockfile.so")
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if the
+    toolchain or sources are unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            l = ctypes.CDLL(so)
+        except OSError:
+            return None
+        l.bf_create.restype = ctypes.c_void_p
+        l.bf_create.argtypes = [ctypes.c_char_p]
+        l.bf_open_append.restype = ctypes.c_void_p
+        l.bf_open_append.argtypes = [ctypes.c_char_p]
+        l.bf_append_block.restype = ctypes.c_uint64
+        l.bf_append_block.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint16,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        l.bf_set_index_offset.restype = ctypes.c_int
+        l.bf_set_index_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        l.bf_tell.restype = ctypes.c_uint64
+        l.bf_tell.argtypes = [ctypes.c_void_p]
+        l.bf_flush.restype = ctypes.c_int
+        l.bf_flush.argtypes = [ctypes.c_void_p]
+        l.bf_close.restype = None
+        l.bf_close.argtypes = [ctypes.c_void_p]
+        l.bf_check_block.restype = ctypes.c_int64
+        l.bf_check_block.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint16),
+        ]
+        _lib = l
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None

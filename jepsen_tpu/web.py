@@ -1,0 +1,212 @@
+"""Web UI over the store: test table, directory browser, zip download.
+
+(reference: jepsen/src/jepsen/web.clj — home:146, dir:235, zip:305,
+files:349 with its scope check:328, serve!:385; http.server instead of
+http-kit, same routes)
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import os
+import threading
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from . import store as store_mod
+
+PAGE_STYLE = """\
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+th, td { padding: 4px 10px; border-bottom: 1px solid #ddd; text-align: left; }
+.valid-true { background: #c8f0c8; }
+.valid-false { background: #f0c8c8; }
+.valid-unknown { background: #f0e8c0; }
+a { text-decoration: none; }
+"""
+
+
+def test_row(base: str, name: str, t: str) -> dict:
+    """Cheap header info for one run: the valid? field via the partial
+    map head (no full deserialize — the point of the block format)."""
+    d = os.path.join(base, name, t)
+    valid: Any = "unknown"
+    try:
+        res_path = os.path.join(d, "results.json")
+        if os.path.exists(res_path):
+            with open(res_path) as f:
+                valid = json.load(f).get("valid?", "unknown")
+        else:
+            loaded = store_mod.load(
+                {"name": name, "start-time": t, "store-base": base}
+            )
+            valid = (loaded.get("results") or {}).get("valid?", "unknown")
+    except (OSError, ValueError):
+        valid = "unknown"
+    return {"name": name, "time": t, "valid": valid, "dir": d}
+
+
+def _valid_class(v: Any) -> str:
+    if v is True:
+        return "valid-true"
+    if v is False:
+        return "valid-false"
+    return "valid-unknown"
+
+
+def home_page(base: str) -> str:
+    rows = []
+    for name, runs in sorted(store_mod.tests(base).items()):
+        for t in reversed(runs):
+            rows.append(test_row(base, name, t))
+    rows.sort(key=lambda r: r["time"], reverse=True)
+    body = [
+        "<h1>Tests</h1>",
+        "<table><tr><th>name</th><th>time</th><th>valid?</th><th></th></tr>",
+    ]
+    for r in rows:
+        link = urllib.parse.quote(f"/files/{r['name']}/{r['time']}/")
+        zlink = urllib.parse.quote(f"/zip/{r['name']}/{r['time']}")
+        body.append(
+            f'<tr class="{_valid_class(r["valid"])}">'
+            f'<td><a href="{link}">{html.escape(r["name"])}</a></td>'
+            f'<td><a href="{link}">{html.escape(r["time"])}</a></td>'
+            f"<td>{html.escape(str(r['valid']))}</td>"
+            f'<td><a href="{zlink}">zip</a></td></tr>'
+        )
+    body.append("</table>")
+    return _page("Jepsen-TPU", "\n".join(body))
+
+
+def dir_page(base: str, rel: str) -> str:
+    d = os.path.join(base, rel) if rel else base
+    entries = sorted(os.listdir(d))
+    body = [f"<h1>{html.escape('/' + rel)}</h1>", "<ul>"]
+    if rel:
+        parent = os.path.dirname(rel.rstrip("/"))
+        body.append(
+            f'<li><a href="/files/{urllib.parse.quote(parent)}/">..</a></li>'
+            if parent
+            else '<li><a href="/files/">..</a></li>'
+        )
+    for e in entries:
+        p = os.path.join(d, e)
+        suffix = "/" if os.path.isdir(p) else ""
+        link = urllib.parse.quote(f"/files/{rel}/{e}".replace("//", "/"))
+        body.append(f'<li><a href="{link}{suffix}">{html.escape(e)}{suffix}</a></li>')
+    body.append("</ul>")
+    return _page(rel or "store", "\n".join(body))
+
+
+def _page(title: str, body: str) -> str:
+    return (
+        f"<html><head><title>{html.escape(title)}</title>"
+        f"<style>{PAGE_STYLE}</style></head><body>{body}</body></html>"
+    )
+
+
+def zip_bytes(d: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, os.path.dirname(d)))
+    return buf.getvalue()
+
+
+CONTENT_TYPES = {
+    ".html": "text/html", ".svg": "image/svg+xml", ".json": "application/json",
+    ".txt": "text/plain", ".log": "text/plain", ".jsonl": "text/plain",
+    ".edn": "text/plain",
+}
+
+
+class Handler(BaseHTTPRequestHandler):
+    base = "store"
+
+    def _ok(self, content: bytes, ctype: str = "text/html",
+            extra: Optional[dict] = None):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(content)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(content)
+
+    def _err(self, code: int, msg: str):
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain")
+        self.end_headers()
+        self.wfile.write(msg.encode())
+
+    def _resolve(self, rel: str) -> Optional[str]:
+        """Path-traversal scope check: everything must stay under base.
+        (reference: web.clj:328-347)"""
+        base_abs = os.path.abspath(self.base)
+        target = os.path.abspath(os.path.join(base_abs, rel))
+        if target != base_abs and not target.startswith(base_abs + os.sep):
+            return None
+        return target
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        try:
+            path = urllib.parse.unquote(urllib.parse.urlparse(self.path).path)
+            if path in ("/", ""):
+                self._ok(home_page(self.base).encode())
+                return
+            if path.startswith("/files"):
+                rel = path[len("/files"):].strip("/")
+                target = self._resolve(rel)
+                if target is None:
+                    self._err(403, "out of scope")
+                    return
+                if os.path.isdir(target):
+                    self._ok(dir_page(self.base, rel).encode())
+                elif os.path.isfile(target):
+                    ext = os.path.splitext(target)[1]
+                    with open(target, "rb") as f:
+                        self._ok(
+                            f.read(),
+                            CONTENT_TYPES.get(ext, "application/octet-stream"),
+                        )
+                else:
+                    self._err(404, "not found")
+                return
+            if path.startswith("/zip/"):
+                rel = path[len("/zip/"):].strip("/")
+                target = self._resolve(rel)
+                if target is None or not os.path.isdir(target):
+                    self._err(404, "not found")
+                    return
+                name = rel.replace("/", "-") + ".zip"
+                self._ok(
+                    zip_bytes(target),
+                    "application/zip",
+                    {"Content-Disposition": f'attachment; filename="{name}"'},
+                )
+                return
+            self._err(404, "not found")
+        except BrokenPipeError:
+            pass
+
+    def log_message(self, fmt, *args):
+        pass  # quiet; the store's jepsen.log is the log of record
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080, base: str = "store",
+          block: bool = True) -> ThreadingHTTPServer:
+    """Start the web UI.  (reference: web.clj:385-390)"""
+    handler = type("BoundHandler", (Handler,), {"base": base})
+    server = ThreadingHTTPServer((host, port), handler)
+    print(f"Serving {base!r} on http://{host}:{port}/")
+    if block:
+        server.serve_forever()
+    else:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -1,0 +1,201 @@
+// Block-file writer: the native core of the incremental test store.
+//
+// The reference implements its store's low-level writer as a Java class
+// (jepsen/src/jepsen/store/FileOffsetOutputStream.java:9-40 — an
+// offset-pinned, CRC32-tracking stream) under a Clojure format layer
+// (jepsen/src/jepsen/store/format.clj:1-200).  Here the equivalent is a
+// small C++ library driven from Python via ctypes: it appends
+// length/CRC32/type-framed blocks to a file in a single pass, patches
+// the root index offset, and verifies frames on read.
+//
+// File layout (all integers little-endian):
+//   magic "JTPU" | u32 version | u64 index-offset | block | block | ...
+// Block frame:
+//   u64 length (incl. frame) | u32 crc32 | u16 type | data...
+// The CRC is computed over data, then the frame with the crc field
+// zeroed — so a block can be written in one pass with unknown size.
+//
+// Build: g++ -O2 -shared -fPIC -o libblockfile.so blockfile.cc
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+// CRC32 (IEEE 802.3, reflected), table-driven.
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++)
+    crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+constexpr char MAGIC[4] = {'J', 'T', 'P', 'U'};
+constexpr uint32_t VERSION = 1;
+constexpr size_t HEADER_SIZE = 4 + 4 + 8;
+constexpr size_t FRAME_SIZE = 8 + 4 + 2;
+
+struct Writer {
+  FILE* f;
+  uint64_t offset;  // current end-of-file offset
+};
+
+void put_u16(uint8_t* p, uint16_t v) { memcpy(p, &v, 2); }
+void put_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+void put_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+uint16_t get_u16(const uint8_t* p) { uint16_t v; memcpy(&v, p, 2); return v; }
+uint32_t get_u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+uint64_t get_u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+}  // namespace
+
+extern "C" {
+
+// Open (creating or truncating) a block file; writes the header with a
+// zero index-offset.  Returns an opaque handle, or null on failure.
+void* bf_create(const char* path) {
+  crc_init();
+  FILE* f = fopen(path, "wb+");
+  if (!f) return nullptr;
+  uint8_t header[HEADER_SIZE];
+  memcpy(header, MAGIC, 4);
+  put_u32(header + 4, VERSION);
+  put_u64(header + 8, 0);
+  if (fwrite(header, 1, HEADER_SIZE, f) != HEADER_SIZE) {
+    fclose(f);
+    return nullptr;
+  }
+  Writer* w = new Writer{f, HEADER_SIZE};
+  return w;
+}
+
+// Re-open an existing block file for appending.  Returns null on
+// failure (bad magic/version).
+void* bf_open_append(const char* path) {
+  crc_init();
+  FILE* f = fopen(path, "rb+");
+  if (!f) return nullptr;
+  uint8_t header[HEADER_SIZE];
+  if (fread(header, 1, HEADER_SIZE, f) != HEADER_SIZE ||
+      memcmp(header, MAGIC, 4) != 0 || get_u32(header + 4) != VERSION) {
+    fclose(f);
+    return nullptr;
+  }
+  fseek(f, 0, SEEK_END);
+  long end = ftell(f);
+  Writer* w = new Writer{f, (uint64_t)end};
+  return w;
+}
+
+// Append one block; returns its file offset, or 0 on failure.
+uint64_t bf_append_block(void* handle, uint16_t type, const uint8_t* data,
+                         uint64_t len) {
+  Writer* w = (Writer*)handle;
+  uint64_t frame_len = FRAME_SIZE + len;
+  uint8_t frame[FRAME_SIZE];
+  put_u64(frame, frame_len);
+  put_u32(frame + 8, 0);  // crc slot zeroed for computation
+  put_u16(frame + 12, type);
+  uint32_t crc = crc32_update(0, data, len);
+  crc = crc32_update(crc, frame, FRAME_SIZE);
+  put_u32(frame + 8, crc);
+  uint64_t at = w->offset;
+  if (fseek(w->f, (long)at, SEEK_SET) != 0) return 0;
+  if (fwrite(frame, 1, FRAME_SIZE, w->f) != FRAME_SIZE) return 0;
+  if (len && fwrite(data, 1, len, w->f) != len) return 0;
+  w->offset = at + frame_len;
+  return at;
+}
+
+// Point the header's index-offset at the given block offset (the
+// atomic "commit" of a new index).
+int bf_set_index_offset(void* handle, uint64_t offset) {
+  Writer* w = (Writer*)handle;
+  uint8_t buf[8];
+  put_u64(buf, offset);
+  if (fseek(w->f, 8, SEEK_SET) != 0) return -1;
+  if (fwrite(buf, 1, 8, w->f) != 8) return -1;
+  fflush(w->f);
+  return 0;
+}
+
+uint64_t bf_tell(void* handle) { return ((Writer*)handle)->offset; }
+
+int bf_flush(void* handle) { return fflush(((Writer*)handle)->f); }
+
+void bf_close(void* handle) {
+  Writer* w = (Writer*)handle;
+  fflush(w->f);
+  fclose(w->f);
+  delete w;
+}
+
+// Verify one block frame at `offset`; returns the data length and
+// writes the type to *type_out, or -1 on CRC/frame mismatch.
+// Reading the data itself is done by Python (mmap/seek) — this check
+// exists so corrupted files fail loudly before deserialization.
+int64_t bf_check_block(const char* path, uint64_t offset, uint16_t* type_out) {
+  crc_init();
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  uint8_t frame[FRAME_SIZE];
+  if (fseek(f, (long)offset, SEEK_SET) != 0 ||
+      fread(frame, 1, FRAME_SIZE, f) != FRAME_SIZE) {
+    fclose(f);
+    return -1;
+  }
+  uint64_t frame_len = get_u64(frame);
+  uint32_t want = get_u32(frame + 8);
+  uint16_t type = get_u16(frame + 12);
+  if (frame_len < FRAME_SIZE) {
+    fclose(f);
+    return -1;
+  }
+  uint64_t len = frame_len - FRAME_SIZE;
+  put_u32(frame + 8, 0);
+  uint32_t crc = 0;
+  const size_t CHUNK = 1 << 20;
+  uint8_t* buf = new uint8_t[CHUNK];
+  uint64_t remaining = len;
+  bool first = true;
+  // crc over data...
+  uint32_t data_crc = 0;
+  while (remaining) {
+    size_t n = remaining < CHUNK ? (size_t)remaining : CHUNK;
+    if (fread(buf, 1, n, f) != n) {
+      delete[] buf;
+      fclose(f);
+      return -1;
+    }
+    if (first) {
+      data_crc = crc32_update(0, buf, n);
+      first = false;
+    } else {
+      data_crc = crc32_update(data_crc, buf, n);
+    }
+    remaining -= n;
+  }
+  delete[] buf;
+  fclose(f);
+  crc = crc32_update(data_crc, frame, FRAME_SIZE);
+  if (len == 0) crc = crc32_update(0, frame, FRAME_SIZE);
+  if (crc != want) return -1;
+  if (type_out) *type_out = type;
+  return (int64_t)len;
+}
+
+}  // extern "C"

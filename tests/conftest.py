@@ -24,3 +24,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _tmp_cwd(tmp_path, monkeypatch):
+    """Run every test in a scratch cwd so store writes (the default
+    `store/` directory) never land in the repo."""
+    monkeypatch.chdir(tmp_path)

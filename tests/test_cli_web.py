@@ -1,0 +1,151 @@
+"""Tests for the CLI, web UI, repl, and report modules.
+(reference behaviors: cli.clj exit codes:129-138 + "3n":150-168;
+web.clj routes + scope check:328)"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli, repl, report, store, web
+
+
+def test_parse_concurrency():
+    assert cli.parse_concurrency("30", 5) == 30
+    assert cli.parse_concurrency("3n", 5) == 15
+    assert cli.parse_concurrency("n", 5) == 5
+    with pytest.raises(ValueError):
+        cli.parse_concurrency("x", 5)
+
+
+def test_cli_test_run_in_process(tmp_path):
+    code = cli.run_cli(
+        cli.default_commands(),
+        [
+            "test",
+            "--workload", "linearizable-register",
+            "--dummy",
+            "--nodes", "n1",
+            "--concurrency", "2n",
+            "--time-limit", "1",
+            "--store-base", str(tmp_path / "store"),
+        ],
+    )
+    assert code == cli.EXIT_VALID
+    listing = store.tests(str(tmp_path / "store"))
+    assert "linearizable-register" in listing
+    d = os.path.join(
+        str(tmp_path / "store"),
+        "linearizable-register",
+        listing["linearizable-register"][0],
+    )
+    assert os.path.exists(os.path.join(d, "test.jtpu"))
+    # real work happened: history has ok ops
+    with open(os.path.join(d, "results.json")) as f:
+        results = json.load(f)
+    assert results["valid?"] is True
+    lin = results["linearizable"]
+    assert lin["results"], "no keys were checked"
+
+
+def test_cli_analyze_stored(tmp_path):
+    base = str(tmp_path / "store")
+    code = cli.run_cli(
+        cli.default_commands(),
+        ["test", "--workload", "linearizable-register", "--dummy",
+         "--nodes", "n1", "--concurrency", "2n", "--time-limit", "1",
+         "--store-base", base],
+    )
+    assert code == cli.EXIT_VALID
+    code = cli.run_cli(
+        cli.default_commands(),
+        ["analyze", "--workload", "linearizable-register",
+         "--store-base", base],
+    )
+    assert code == cli.EXIT_VALID
+
+
+def test_cli_usage_error():
+    assert cli.run_cli(cli.default_commands(), []) == cli.EXIT_USAGE
+
+
+def test_cli_exit_codes_from_results():
+    assert cli._exit_code({"valid?": True}) == 0
+    assert cli._exit_code({"valid?": False}) == 1
+    assert cli._exit_code({"valid?": "unknown"}) == 2
+    assert cli._exit_code({}) == 2
+
+
+def _make_store(tmp_path):
+    base = str(tmp_path / "store")
+    t = {"name": "webtest", "start-time": "20260729T000001",
+         "store-base": base}
+    with store.with_writer(t) as t2:
+        t2 = store.save_0(t2)
+        from jepsen_tpu.history import History, invoke_op, ok_op
+
+        t2 = {**t2, "history": History(
+            [invoke_op(0, "read", None, time=0), ok_op(0, "read", 1, time=1)]
+        ).index_ops()}
+        t2 = store.save_1(t2)
+        t2 = {**t2, "results": {"valid?": True}}
+        store.save_2(t2)
+    return base
+
+
+def test_web_routes(tmp_path):
+    base = _make_store(tmp_path)
+    server = web.serve(host="127.0.0.1", port=0, base=base, block=False)
+    port = server.server_address[1]
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}"
+            ) as r:
+                return r.status, r.read()
+
+        status, body = get("/")
+        assert status == 200
+        assert b"webtest" in body
+        assert b"valid-true" in body
+
+        status, body = get("/files/webtest/20260729T000001/")
+        assert status == 200
+        assert b"results.json" in body
+
+        status, body = get("/files/webtest/20260729T000001/results.json")
+        assert status == 200
+        assert json.loads(body)["valid?"] is True
+
+        status, body = get("/zip/webtest/20260729T000001")
+        assert status == 200
+        assert body[:2] == b"PK"
+
+        # scope check: traversal is refused
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/files/..%2f..%2fetc%2fpasswd"
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                assert r.status in (403, 404)
+        except urllib.error.HTTPError as e:
+            assert e.code in (403, 404)
+    finally:
+        server.shutdown()
+
+
+def test_repl_latest(tmp_path, monkeypatch):
+    base = _make_store(tmp_path)
+    t = repl.latest_test(base)
+    assert t is not None
+    assert t["name"] == "webtest"
+    assert t["results"]["valid?"] is True
+
+
+def test_report_to(tmp_path, capsys):
+    p = str(tmp_path / "report.txt")
+    with report.to(p):
+        print("report line")
+    assert "report line" in open(p).read()
+    assert "report line" in capsys.readouterr().out

@@ -1,0 +1,261 @@
+"""Tests for the store: block format round-trips, 3-phase save, load,
+symlinks, logging.  (reference behaviors: store.clj + store/format.clj
++ test/jepsen/store/format_test.clj round-trip strategy)"""
+
+import json
+import logging
+import os
+import struct
+import zlib
+
+import pytest
+
+from jepsen_tpu import store
+from jepsen_tpu.store import format as fmt
+from jepsen_tpu.store import native
+from jepsen_tpu.history import History, invoke_op, ok_op
+
+
+def _test_map(tmp_path, name="fmt-test"):
+    return {
+        "name": name,
+        "start-time": "20260729T000000",
+        "store-base": str(tmp_path / "store"),
+        "nodes": ["n1"],
+    }
+
+
+def _history():
+    return History(
+        [
+            invoke_op(0, "write", 3, time=0),
+            ok_op(0, "write", 3, time=1),
+            invoke_op(1, "read", None, time=2),
+            ok_op(1, "read", 3, time=3),
+        ]
+    ).index_ops()
+
+
+def test_native_lib_builds():
+    # The C++ writer must be available in this environment (g++ baked in).
+    assert native.available()
+
+
+def test_block_file_round_trip(tmp_path):
+    path = str(tmp_path / "t.jtpu")
+    with fmt.Writer(path) as w:
+        b1 = w.write_json({"a": 1, "b": [1, 2, 3]})
+        b2 = w.write_partial_map({"valid?": True}, rest_id=b1)
+        w.set_root(b2)
+        w.save_index()
+    r = fmt.Reader(path)
+    assert r.root == b2
+    v = r.root_value()
+    assert v["valid?"] is True
+    assert v["a"] == 1  # merged from the rest chain
+
+
+def test_partial_map_head_fast_path(tmp_path):
+    path = str(tmp_path / "t.jtpu")
+    with fmt.Writer(path) as w:
+        rest = w.write_json({"huge": list(range(1000))})
+        head = w.write_partial_map({"valid?": False}, rest_id=rest)
+        w.set_root(head)
+        w.save_index()
+    r = fmt.Reader(path)
+    type_, data = r.read_id(head)
+    (rest_id,) = struct.unpack("<I", data[:4])
+    assert json.loads(data[4:]) == {"valid?": False}
+    assert rest_id == rest
+
+
+def test_history_block_round_trip(tmp_path):
+    path = str(tmp_path / "t.jtpu")
+    h = _history()
+    with fmt.Writer(path) as w:
+        hid = w.write_history(h)
+        w.set_root(w.write_partial_map({"history": fmt.block_ref(hid)}))
+        w.save_index()
+    r = fmt.Reader(path)
+    h2 = r.read_history(hid)
+    assert len(h2) == 4
+    assert h2[0].type == "invoke"
+    assert h2[3].value == 3
+    packed = r.read_packed_history(hid)
+    assert packed["arrays"]["type"].shape == (4,)
+    assert packed["arrays"]["process"].tolist() == [0, 0, 1, 1]
+    assert len(packed["tables"]["f"]) == 2  # write, read
+
+
+def test_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "t.jtpu")
+    with fmt.Writer(path) as w:
+        bid = w.write_json({"x": 1})
+        w.set_root(bid)
+        w.save_index()
+    r = fmt.Reader(path)
+    off = r.blocks[bid]
+    with open(path, "r+b") as f:
+        f.seek(off + fmt.FRAME_SIZE + 2)
+        f.write(b"Z")
+    with pytest.raises(IOError, match="CRC"):
+        fmt.Reader(path).read_id(bid)
+
+
+def test_index_survives_torn_tail(tmp_path):
+    """Appending garbage after the committed index must not break reads
+    (append-only crash tolerance, reference format.clj:46-54)."""
+    path = str(tmp_path / "t.jtpu")
+    with fmt.Writer(path) as w:
+        bid = w.write_json({"x": 1})
+        w.set_root(bid)
+        w.save_index()
+    with open(path, "ab") as f:
+        f.write(b"\x00\x01garbage-torn-write")
+    r = fmt.Reader(path)
+    assert r.root_value() == {"x": 1}
+
+
+def test_python_and_native_writers_produce_identical_bytes(tmp_path):
+    if not native.available():
+        pytest.skip("no native lib")
+    p1 = str(tmp_path / "native.jtpu")
+    p2 = str(tmp_path / "python.jtpu")
+    w1 = fmt.Writer(p1)
+    assert w1._native is not None
+    w2 = fmt.Writer(p2)
+    w2._native = None  # force pure-Python path
+    if w2._f is None:
+        w2.close()
+        os.unlink(p2)
+        w2 = fmt.Writer.__new__(fmt.Writer)
+        w2.path = p2
+        w2.blocks, w2.next_id, w2.root = {}, 1, 0
+        w2._native = None
+        w2._f = open(p2, "wb+")
+        w2._f.write(fmt.MAGIC + struct.pack("<IQ", fmt.VERSION, 0))
+    for w in (w1, w2):
+        b = w.write_json({"k": "v"})
+        w.set_root(b)
+        w.save_index()
+        w.close()
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
+def test_three_phase_save_and_load(tmp_path):
+    t = _test_map(tmp_path)
+    t["extra-config"] = {"foo": 1}
+    with store.with_writer(t) as t2:
+        t2 = store.save_0(t2)
+        t2 = {**t2, "history": _history()}
+        t2 = store.save_1(t2)
+        t2 = {**t2, "results": {"valid?": True, "count": 4}}
+        t2 = store.save_2(t2)
+    loaded = store.load(
+        {"name": t["name"], "start-time": t["start-time"],
+         "store-base": t["store-base"]}
+    )
+    assert loaded["name"] == "fmt-test"
+    assert loaded["extra-config"] == {"foo": 1}
+    assert loaded["results"]["valid?"] is True
+    assert loaded["results"]["count"] == 4
+    assert len(loaded["history"]) == 4
+    # text artifacts written in parallel
+    d = store.test_dir(t)
+    assert os.path.exists(os.path.join(d, "history.txt"))
+    assert os.path.exists(os.path.join(d, "history.jsonl"))
+    assert os.path.exists(os.path.join(d, "results.json"))
+
+
+def test_crash_after_save_1_preserves_history(tmp_path):
+    """A crash between save_1 and save_2 must leave a loadable history
+    (analysis resume, reference format.clj:143-150 step 4)."""
+    t = _test_map(tmp_path, "crashy")
+    with store.with_writer(t) as t2:
+        t2 = store.save_0(t2)
+        t2 = {**t2, "history": _history()}
+        t2 = store.save_1(t2)
+        # no save_2: simulated analysis crash
+    loaded = store.load(
+        {"name": "crashy", "start-time": t["start-time"],
+         "store-base": t["store-base"]}
+    )
+    assert len(loaded["history"]) == 4
+    assert "results" not in loaded
+
+
+def test_packed_history_load(tmp_path):
+    t = _test_map(tmp_path)
+    with store.with_writer(t) as t2:
+        t2 = store.save_0(t2)
+        t2 = {**t2, "history": _history()}
+        t2 = store.save_1(t2)
+    packed = store.load_packed_history(
+        {"name": t["name"], "start-time": t["start-time"],
+         "store-base": t["store-base"]}
+    )
+    assert packed["arrays"]["time"].tolist() == [0, 1, 2, 3]
+
+
+def test_symlinks_and_listing(tmp_path):
+    t = _test_map(tmp_path)
+    os.makedirs(store.test_dir(t))
+    store.update_symlinks(t)
+    base = t["store-base"]
+    assert os.path.islink(os.path.join(base, "latest"))
+    assert os.path.islink(os.path.join(base, "current"))
+    assert os.path.islink(os.path.join(base, "fmt-test", "latest"))
+    listing = store.tests(base)
+    assert listing == {"fmt-test": ["20260729T000000"]}
+
+
+def test_serializable_test_drops_live_objects():
+    t = {
+        "name": "x",
+        "client": object(),
+        "checker": object(),
+        "history": [1],
+        "results": {},
+        "keep": 7,
+        "nonserializable-keys": ["custom"],
+        "custom": object(),
+    }
+    s = store.serializable_test(t)
+    assert set(s) == {"name", "keep", "nonserializable-keys"}
+
+
+def test_logging_lifecycle(tmp_path):
+    t = _test_map(tmp_path, "logging")
+    store.start_logging(t)
+    logging.getLogger("jepsen_tpu.test").info("hello from the test")
+    store.stop_logging(t)
+    content = open(store.path(t, "jepsen.log")).read()
+    assert "hello from the test" in content
+
+
+def test_core_run_persists(tmp_path):
+    from jepsen_tpu import core, fake
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import checker as checker_mod
+
+    state = fake.AtomState(0)
+    t = {
+        "name": "persisted",
+        "store-base": str(tmp_path / "store"),
+        "nodes": ["n1"],
+        "concurrency": 2,
+        "client": fake.AtomClient(state, latency=0.0),
+        "generator": gen.clients(gen.limit(10, gen.repeat({"f": "read"}))),
+        "checker": checker_mod.stats(),
+    }
+    result = core.run(t)
+    assert result["results"]["valid?"] is True
+    loaded = store.latest(str(tmp_path / "store"))
+    assert loaded is not None
+    assert loaded["name"] == "persisted"
+    assert len(loaded["history"]) == 20
+    assert loaded["results"]["valid?"] is True
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "store"), "persisted",
+                     result["start-time"], "jepsen.log")
+    )
