@@ -243,3 +243,56 @@ def test_clock_nemesis_compiles_tools_on_node():
     # uploaded source is real C with settimeofday
     stdins = [c.stdin for node, c in remote.log if hasattr(c, "stdin") and c.stdin]
     assert any("settimeofday" in s for s in stdins)
+
+
+class _ScpSpy:
+    """Collects scp subprocess invocations in place of subprocess.run."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, args, capture_output=True, timeout=None):
+        self.calls.append(args)
+        import types
+
+        return types.SimpleNamespace(returncode=0, stdout=b"", stderr=b"")
+
+
+def test_scp_remote_direct_transfer(monkeypatch):
+    from jepsen_tpu.control import scp as cscp
+
+    spy = _ScpSpy()
+    monkeypatch.setattr(cscp.subprocess, "run", spy)
+    inner = DummyRemote()
+    r = cscp.remote(inner, username="admin", port=2222).connect("n1", {})
+    r.upload("/local/a.tar", "/remote/a.tar")
+    r.download(["/var/log/db.log"], "/tmp/out")
+    up, down = spy.calls
+    assert up[:4] == ["scp", "-rpC", "-P", "2222"]
+    assert up[-2:] == ["/local/a.tar", "admin@n1:/remote/a.tar"]
+    assert down[-2:] == ["admin@n1:/var/log/db.log", "/tmp/out"]
+    # execute still goes through the wrapped remote
+    r.execute(Command(cmd="hostname"))
+    assert any(
+        isinstance(e, tuple) and getattr(e[1], "cmd", None) == "hostname"
+        for e in inner.log
+    )
+
+
+def test_scp_remote_sudo_stages_via_tmpfile(monkeypatch):
+    from jepsen_tpu.control import scp as cscp
+
+    spy = _ScpSpy()
+    monkeypatch.setattr(cscp.subprocess, "run", spy)
+    inner = DummyRemote()
+    r = cscp.remote(inner, username="admin", sudo="postgres").connect("n2", {})
+    r.upload("/local/conf", "/etc/db")
+    # one scp into the staging dir, then chown + mv as root over the
+    # command remote (reference: control/scp.clj:100-110).  The dummy
+    # remote answers exit 0 to the `test -d` probe, so the dest counts
+    # as a directory and the source keeps its basename.
+    (up,) = spy.calls
+    assert up[-1].startswith("admin@n2:" + cscp.TMP_DIR)
+    cmds = [getattr(e[1], "cmd", "") for e in inner.log if isinstance(e, tuple)]
+    assert any(c.startswith("chown -R postgres") for c in cmds)
+    assert any(c.startswith("mv ") and c.endswith("/etc/db/conf") for c in cmds)
