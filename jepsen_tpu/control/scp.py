@@ -12,6 +12,7 @@ and are chown/mv'd into place, mirroring scp.clj:95-140.
 
 from __future__ import annotations
 
+import os
 import random
 import subprocess
 from typing import Any, Optional, Sequence, Union
@@ -41,12 +42,14 @@ class SCPRemote(Remote):
         port: int = 22,
         private_key_path: Optional[str] = None,
         sudo: Optional[str] = None,
+        strict_host_key_checking: bool = False,
     ):
         self.cmd_remote = cmd_remote
         self.username = username
         self.port = port
         self.private_key_path = private_key_path
         self.sudo = sudo
+        self.strict = strict_host_key_checking
         self.node: Optional[str] = None
         self._tmp_dir_ready = False
 
@@ -58,6 +61,9 @@ class SCPRemote(Remote):
             port=ssh.get("port", self.port),
             private_key_path=ssh.get("private-key-path", self.private_key_path),
             sudo=self.sudo,
+            strict_host_key_checking=ssh.get(
+                "strict-host-key-checking", self.strict
+            ),
         )
         r.node = str(node)
         return r
@@ -75,16 +81,16 @@ class SCPRemote(Remote):
         args = ["scp", "-rpC", "-P", str(self.port)]
         if self.private_key_path:
             args += ["-i", self.private_key_path]
-        args += [
-            "-o",
-            "StrictHostKeyChecking=no",
-            "-o",
-            "UserKnownHostsFile=/dev/null",
-            "-o",
-            "LogLevel=ERROR",
-            "-o",
-            "BatchMode=yes",
-        ]
+        if not self.strict:
+            args += [
+                "-o",
+                "StrictHostKeyChecking=no",
+                "-o",
+                "UserKnownHostsFile=/dev/null",
+                "-o",
+                "LogLevel=ERROR",
+            ]
+        args += ["-o", "BatchMode=yes"]
         proc = subprocess.run(
             args + [str(s) for s in sources] + [dest],
             capture_output=True,
@@ -126,10 +132,22 @@ class SCPRemote(Remote):
             self._tmp_dir_ready = True
         return f"{TMP_DIR}/{random.randrange(2**31)}"
 
+    def _cleanup(self, tmp: str) -> None:
+        """Best-effort staging cleanup: a node that just got partitioned
+        must not let the rm failure mask the transfer error in flight."""
+        try:
+            self._exec_root("rm", "-rf", tmp)
+        except Exception:
+            pass
+
     # -- operations --------------------------------------------------------
 
     def upload(self, local_paths: Union[str, Sequence[str]], remote_path: str) -> None:
-        paths = [local_paths] if isinstance(local_paths, str) else list(local_paths)
+        paths = (
+            [local_paths]
+            if isinstance(local_paths, (str, os.PathLike))
+            else list(local_paths)
+        )
         if self.sudo is None or self.sudo == self.username:
             self._scp(paths, self._remote_path(remote_path))
             return
@@ -160,10 +178,14 @@ class SCPRemote(Remote):
                 self._exec_root("chown", "-R", self.sudo, tmp)
                 self._exec_root("mv", tmp, dest)
             finally:
-                self._exec_root("rm", "-rf", tmp)
+                self._cleanup(tmp)
 
     def download(self, remote_paths: Union[str, Sequence[str]], local_path: str) -> None:
-        paths = [remote_paths] if isinstance(remote_paths, str) else list(remote_paths)
+        paths = (
+            [remote_paths]
+            if isinstance(remote_paths, (str, os.PathLike))
+            else list(remote_paths)
+        )
         if self.sudo is None or self.sudo == self.username:
             self._scp([self._remote_path(p) for p in paths], str(local_path))
             return
@@ -189,7 +211,7 @@ class SCPRemote(Remote):
                 self._exec_root("chown", "-R", self.username, tmp)
                 self._scp([self._remote_path(staged)], str(local_path))
             finally:
-                self._exec_root("rm", "-rf", tmp)
+                self._cleanup(tmp)
 
 
 def remote(cmd_remote: Remote, **kw) -> SCPRemote:

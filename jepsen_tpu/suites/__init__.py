@@ -1,0 +1,61 @@
+"""Per-database test suites.
+
+The reference ships 29 sibling leiningen projects, each bundling DB
+automation (install/start/stop over the control DSL), a client over the
+database's wire protocol, workload wiring, and a CLI runner (reference:
+SURVEY §2.5; e.g. consul/src/jepsen/consul.clj, consul/db.clj,
+consul/client.clj).  Here each suite is a module (or package, for the
+larger ones) under ``jepsen_tpu.suites``, and all wire protocols are
+implemented from scratch on the Python stdlib in
+``jepsen_tpu.suites.proto`` — no DB driver dependencies.
+
+``suite(name)`` returns the suite module; each suite exposes:
+
+- ``db(opts)``        → a jepsen_tpu.db.DB automating install/teardown
+- ``client(opts)``    → a jepsen_tpu.client.Client over the wire protocol
+- ``workloads(opts)`` → {name: partial test map}
+- ``test(opts)``      → a full runnable test map
+- ``cli()``           → argparse-ready command table (optional)
+"""
+
+from __future__ import annotations
+
+import importlib
+
+SUITES = (
+    "aerospike",
+    "chronos",
+    "cockroachdb",
+    "consul",
+    "crate",
+    "dgraph",
+    "disque",
+    "elasticsearch",
+    "etcd",
+    "faunadb",
+    "galera",
+    "hazelcast",
+    "ignite",
+    "logcabin",
+    "mongodb_rocks",
+    "mongodb_smartos",
+    "mysql_cluster",
+    "percona",
+    "postgres_rds",
+    "rabbitmq",
+    "raftis",
+    "rethinkdb",
+    "robustirc",
+    "stolon",
+    "tidb",
+    "yugabyte",
+    "zookeeper",
+)
+
+
+def suite(name: str):
+    """Import and return the suite module for ``name`` (dashes ok)."""
+    name = name.replace("-", "_")
+    if name not in SUITES:
+        raise KeyError(f"unknown suite {name!r}; known: {sorted(SUITES)}")
+    return importlib.import_module(f"jepsen_tpu.suites.{name}")

@@ -1,0 +1,39 @@
+"""Wire-protocol clients, from scratch on the Python stdlib.
+
+The reference suites pull in a JVM driver per database (JDBC, the
+Aerospike Java client, carmine for Redis, …).  Since this framework's
+clients are Python and the environment forbids new dependencies, each
+protocol the suites need is implemented here directly:
+
+- :mod:`resp`    — Redis serialization protocol (disque, raftis)
+- :mod:`http`    — JSON-over-HTTP helper (etcd, consul, elasticsearch,
+                   crate, dgraph, faunadb, chronos, hazelcast, ignite)
+- :mod:`pgwire`  — PostgreSQL wire protocol v3 (postgres-rds, stolon,
+                   cockroachdb, yugabyte YSQL)
+- :mod:`mysql`   — MySQL client/server protocol (tidb, galera, percona,
+                   mysql-cluster)
+- :mod:`zk`      — ZooKeeper jute protocol (zookeeper)
+- :mod:`mongo`   — MongoDB OP_MSG + a minimal BSON codec (mongodb-*)
+- :mod:`cql`     — Cassandra CQL binary protocol v4 (yugabyte YCQL)
+- :mod:`irc`     — line-oriented IRC (robustirc)
+
+Each client is deliberately small: connect, authenticate, issue the
+handful of statements the workloads need, and surface errors as
+:class:`ProtocolError` with enough detail for clients to classify
+ok/fail/info.
+"""
+
+from __future__ import annotations
+
+
+class ProtocolError(Exception):
+    """A database-reported error (definite failure)."""
+
+    def __init__(self, message: str, code=None):
+        super().__init__(message)
+        self.code = code
+
+
+class IndeterminateError(Exception):
+    """The connection died mid-request: the op may or may not have
+    applied (maps to a :type :info completion)."""

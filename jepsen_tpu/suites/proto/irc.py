@@ -1,0 +1,124 @@
+"""Minimal IRC client (RFC 1459 line protocol).
+
+Backs the robustirc suite (the reference drives RobustIRC through a
+Java IRC client: robustirc/src/jepsen/robustirc.clj).  The workload
+needs only: register (NICK/USER), JOIN a channel, PRIVMSG, and read
+delivered messages — message delivery/ordering is what the checker
+verifies.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Tuple
+
+from . import IndeterminateError
+
+
+class IrcTimeout(IndeterminateError):
+    """A read deadline elapsed with no data (distinct from EOF/partition
+    so drain loops can end cleanly without masking dead connections)."""
+
+
+class IrcClient:
+    def __init__(self, host: str, port: int = 6667, nick: str = "jepsen", timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.nick = nick
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self._buf = b""
+
+    def connect(self) -> "IrcClient":
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self._send(f"NICK {self.nick}")
+        self._send(f"USER {self.nick} 0 * :{self.nick}")
+        # wait for 001 welcome
+        self._await(lambda p, c, a: c == "001")
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self._send("QUIT :bye")
+            except Exception:
+                pass
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def _send(self, line: str) -> None:
+        try:
+            self.sock.sendall(line.encode() + b"\r\n")
+        except OSError as e:
+            raise IndeterminateError(f"send failed: {e}") from e
+
+    def _read_line(self) -> str:
+        while b"\r\n" not in self._buf:
+            try:
+                chunk = self.sock.recv(65536)
+            except socket.timeout as e:
+                raise IrcTimeout(f"read timed out: {e}") from e
+            except OSError as e:
+                raise IndeterminateError(f"recv failed: {e}") from e
+            if not chunk:
+                raise IndeterminateError("connection closed by server")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line.decode(errors="replace")
+
+    @staticmethod
+    def parse(line: str) -> Tuple[Optional[str], str, List[str]]:
+        """':prefix CMD a b :trailing' → (prefix, CMD, [a, b, trailing])."""
+        prefix = None
+        if line.startswith(":"):
+            prefix, line = line[1:].split(" ", 1)
+        if " :" in line:
+            line, trailing = line.split(" :", 1)
+            args = line.split() + [trailing]
+        else:
+            args = line.split()
+        return prefix, args[0], args[1:]
+
+    def _await(self, pred) -> Tuple[Optional[str], str, List[str]]:
+        """Read (answering PINGs) until pred(prefix, cmd, args) is true."""
+        while True:
+            prefix, cmd, args = self.parse(self._read_line())
+            if cmd == "PING":
+                self._send(f"PONG {args[0] if args else ''}")
+                continue
+            if pred(prefix, cmd, args):
+                return prefix, cmd, args
+
+    def join(self, channel: str) -> None:
+        self._send(f"JOIN {channel}")
+        self._await(lambda p, c, a: c == "JOIN" and a and a[-1] == channel)
+
+    def privmsg(self, target: str, message: str) -> None:
+        self._send(f"PRIVMSG {target} :{message}")
+
+    def read_messages(self, max_lines: int = 100) -> List[Tuple[str, str, str]]:
+        """Drain pending PRIVMSGs → [(sender-nick, target, text)].
+        Returns when the drain deadline passes or after max_lines; a
+        severed connection still raises IndeterminateError so callers
+        never mistake a dead link for an empty mailbox."""
+        out = []
+        if self.sock is None:
+            return out
+        self.sock.settimeout(0.2)
+        try:
+            for _ in range(max_lines):
+                prefix, cmd, args = self.parse(self._read_line())
+                if cmd == "PING":
+                    self._send(f"PONG {args[0] if args else ''}")
+                elif cmd == "PRIVMSG" and len(args) >= 2:
+                    nick = (prefix or "").split("!", 1)[0]
+                    out.append((nick, args[0], args[1]))
+        except IrcTimeout:
+            pass
+        finally:
+            self.sock.settimeout(self.timeout)
+        return out

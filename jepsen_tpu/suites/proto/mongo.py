@@ -1,0 +1,227 @@
+"""MongoDB wire protocol (OP_MSG) with a from-scratch BSON codec.
+
+Backs the mongodb-rocks and mongodb-smartos suites (the reference uses
+the Monger/Java driver: mongodb-rocks/src/jepsen/mongodb/core.clj).
+Implements the BSON subset the workloads need (double, string, doc,
+array, bool, null, int32, int64) and the modern OP_MSG request cycle:
+one kind-0 body section per message, commands insert/find/update/
+delete/findAndModify addressed via ``$db``.
+
+Write/read concerns ride in the command documents, so
+majority-read/majority-write semantics are expressible exactly like the
+reference's ``:write-concern :majority`` options.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import IndeterminateError, ProtocolError
+
+# ---------------------------------------------------------------------------
+# BSON
+# ---------------------------------------------------------------------------
+
+
+def _encode_value(name: str, v: Any) -> bytes:
+    key = name.encode() + b"\0"
+    if isinstance(v, bool):  # before int: bool is an int subclass
+        return b"\x08" + key + (b"\x01" if v else b"\x00")
+    if isinstance(v, float):
+        return b"\x01" + key + struct.pack("<d", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + key + struct.pack("<i", len(b) + 1) + b + b"\0"
+    if isinstance(v, dict):
+        return b"\x03" + key + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        return b"\x04" + key + bson_encode(
+            {str(i): x for i, x in enumerate(v)}
+        )
+    if v is None:
+        return b"\x0a" + key
+    if isinstance(v, int):
+        if -(2**31) <= v < 2**31:
+            return b"\x10" + key + struct.pack("<i", v)
+        return b"\x12" + key + struct.pack("<q", v)
+    raise TypeError(f"cannot BSON-encode {type(v)}: {v!r}")
+
+
+def bson_encode(doc: Dict[str, Any]) -> bytes:
+    body = b"".join(_encode_value(k, v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\0"
+
+
+def _decode_value(t: int, data: bytes, off: int) -> Tuple[Any, int]:
+    if t == 0x01:
+        return struct.unpack("<d", data[off : off + 8])[0], off + 8
+    if t == 0x02:
+        (n,) = struct.unpack("<i", data[off : off + 4])
+        return data[off + 4 : off + 3 + n].decode(), off + 4 + n
+    if t in (0x03, 0x04):
+        (n,) = struct.unpack("<i", data[off : off + 4])
+        sub = bson_decode(data[off : off + n])
+        if t == 0x04:
+            sub = [sub[k] for k in sorted(sub, key=int)]
+        return sub, off + n
+    if t == 0x08:
+        return data[off] != 0, off + 1
+    if t == 0x0A:
+        return None, off
+    if t == 0x10:
+        return struct.unpack("<i", data[off : off + 4])[0], off + 4
+    if t == 0x12:
+        return struct.unpack("<q", data[off : off + 8])[0], off + 8
+    if t == 0x11:  # timestamp
+        return struct.unpack("<Q", data[off : off + 8])[0], off + 8
+    if t == 0x07:  # ObjectId
+        return data[off : off + 12].hex(), off + 12
+    raise ProtocolError(f"cannot BSON-decode element type {t:#x}")
+
+
+def bson_decode(data: bytes) -> Dict[str, Any]:
+    (total,) = struct.unpack("<i", data[:4])
+    off, out = 4, {}
+    while off < total - 1:
+        t = data[off]
+        off += 1
+        end = data.index(b"\0", off)
+        name = data[off:end].decode()
+        off = end + 1
+        out[name], off = _decode_value(t, data, off)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OP_MSG
+# ---------------------------------------------------------------------------
+
+OP_MSG = 2013
+
+
+class MongoError(ProtocolError):
+    """Command returned ok: 0 (or a writeErrors array)."""
+
+
+class MongoClient:
+    def __init__(
+        self,
+        host: str,
+        port: int = 27017,
+        database: str = "test",
+        timeout: float = 10.0,
+    ):
+        self.host = host
+        self.port = port
+        self.database = database
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._request_id = 0
+        self._lock = threading.Lock()
+
+    def connect(self) -> "MongoClient":
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                chunk = self.sock.recv(65536)
+            except (OSError, socket.timeout) as e:
+                raise IndeterminateError(f"recv failed: {e}") from e
+            if not chunk:
+                raise IndeterminateError("connection closed by server")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n:]
+        return data
+
+    def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Run one command document; raises MongoError on ok: 0."""
+        if self.sock is None:
+            self.connect()
+        with self._lock:
+            self._request_id += 1
+            doc = {**doc, "$db": self.database}
+            body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
+            header = struct.pack(
+                "<iiii", 16 + len(body), self._request_id, 0, OP_MSG
+            )
+            try:
+                self.sock.sendall(header + body)
+            except OSError as e:
+                raise IndeterminateError(f"send failed: {e}") from e
+            ln, _rid, _rto, opcode = struct.unpack("<iiii", self._recv_exact(16))
+            payload = self._recv_exact(ln - 16)
+        if opcode != OP_MSG:
+            raise ProtocolError(f"unexpected reply opcode {opcode}")
+        # flagBits(4) + kind byte(1) + doc
+        reply = bson_decode(payload[5:])
+        if not reply.get("ok"):
+            raise MongoError(
+                reply.get("errmsg", str(reply)), code=reply.get("code")
+            )
+        if reply.get("writeErrors"):
+            we = reply["writeErrors"][0]
+            raise MongoError(we.get("errmsg", str(we)), code=we.get("code"))
+        return reply
+
+    # -- convenience CRUD --------------------------------------------------
+
+    def insert(self, coll: str, docs: List[dict], write_concern=None) -> dict:
+        cmd = {"insert": coll, "documents": docs}
+        if write_concern:
+            cmd["writeConcern"] = write_concern
+        return self.command(cmd)
+
+    def find(self, coll: str, filter: dict, read_concern=None) -> List[dict]:
+        cmd: Dict[str, Any] = {"find": coll, "filter": filter}
+        if read_concern:
+            cmd["readConcern"] = read_concern
+        reply = self.command(cmd)
+        cursor = reply["cursor"]
+        out = list(cursor["firstBatch"])
+        # drain the cursor: firstBatch caps at ~101 docs on a real mongod
+        while cursor.get("id"):
+            reply = self.command({"getMore": cursor["id"], "collection": coll})
+            cursor = reply["cursor"]
+            out.extend(cursor["nextBatch"])
+        return out
+
+    def update(
+        self, coll: str, filter: dict, update: dict, upsert=False, write_concern=None
+    ) -> dict:
+        cmd: Dict[str, Any] = {
+            "update": coll,
+            "updates": [{"q": filter, "u": update, "upsert": upsert}],
+        }
+        if write_concern:
+            cmd["writeConcern"] = write_concern
+        return self.command(cmd)
+
+    def find_and_modify(
+        self, coll: str, query: dict, update: dict, new=True, upsert=False
+    ) -> Optional[dict]:
+        reply = self.command(
+            {
+                "findAndModify": coll,
+                "query": query,
+                "update": update,
+                "new": new,
+                "upsert": upsert,
+            }
+        )
+        return reply.get("value")

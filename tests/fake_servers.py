@@ -1,0 +1,962 @@
+"""In-process fake database servers speaking real wire protocols.
+
+The reference gets integration coverage without a cluster via in-JVM
+fakes (jepsen/src/jepsen/tests.clj:27-66 atom-db/atom-client); here the
+fakes additionally speak each suite's actual wire protocol over
+loopback TCP, so the from-scratch protocol clients in
+jepsen_tpu.suites.proto get end-to-end exercise in unit tests.
+
+Every fake serves a tiny linearizable KV (a dict under a lock) — enough
+for register/set/bank workloads to run against them.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import re as _re
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class _Store:
+    """Shared KV behind every fake server."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv: Dict[str, str] = {}
+
+
+class FakeServer:
+    """TCP server harness: start() binds an ephemeral loopback port."""
+
+    handler_class: type = None
+
+    def __init__(self):
+        self.store = _Store()
+        self.active = set()  # live per-connection sockets
+        self._active_lock = threading.Lock()
+        store = self.store
+        outer = self
+
+        class Handler(self.handler_class):
+            fake_store = store
+            server_ref = self
+
+            def setup(inner):
+                with outer._active_lock:
+                    outer.active.add(inner.request)
+                super().setup()
+
+            def finish(inner):
+                with outer._active_lock:
+                    outer.active.discard(inner.request)
+                super().finish()
+
+        self.server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), Handler, bind_and_activate=True
+        )
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "FakeServer":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        with self._active_lock:
+            conns = list(self.active)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# RESP (redis/disque/raftis)
+# ---------------------------------------------------------------------------
+
+
+class _RespHandler(socketserver.StreamRequestHandler):
+    def _read_command(self) -> Optional[list]:
+        line = self.rfile.readline()
+        if not line:
+            return None
+        assert line[:1] == b"*", line
+        n = int(line[1:].strip())
+        args = []
+        for _ in range(n):
+            ln = int(self.rfile.readline()[1:].strip())
+            args.append(self.rfile.read(ln).decode())
+            self.rfile.read(2)
+        return args
+
+    def handle(self):
+        while True:
+            try:
+                cmd = self._read_command()
+            except Exception:
+                return
+            if cmd is None:
+                return
+            op, args = cmd[0].upper(), cmd[1:]
+            kv, lock = self.fake_store.kv, self.fake_store.lock
+            with lock:
+                if op == "PING":
+                    self.wfile.write(b"+PONG\r\n")
+                elif op == "SET":
+                    kv[args[0]] = args[1]
+                    self.wfile.write(b"+OK\r\n")
+                elif op == "GET":
+                    v = kv.get(args[0])
+                    if v is None:
+                        self.wfile.write(b"$-1\r\n")
+                    else:
+                        b = v.encode()
+                        self.wfile.write(b"$%d\r\n%s\r\n" % (len(b), b))
+                elif op == "INCR":
+                    v = int(kv.get(args[0], "0")) + 1
+                    kv[args[0]] = str(v)
+                    self.wfile.write(b":%d\r\n" % v)
+                elif op == "DEL":
+                    n = sum(1 for k in args if kv.pop(k, None) is not None)
+                    self.wfile.write(b":%d\r\n" % n)
+                elif op == "SADD":
+                    s = set(json.loads(kv.get(args[0], "[]")))
+                    added = sum(1 for m in args[1:] if m not in s)
+                    s.update(args[1:])
+                    kv[args[0]] = json.dumps(sorted(s))
+                    self.wfile.write(b":%d\r\n" % added)
+                elif op == "SMEMBERS":
+                    s = sorted(set(json.loads(kv.get(args[0], "[]"))))
+                    out = b"*%d\r\n" % len(s)
+                    for m in s:
+                        mb = str(m).encode()
+                        out += b"$%d\r\n%s\r\n" % (len(mb), mb)
+                    self.wfile.write(out)
+                # disque-style queue commands
+                elif op == "ADDJOB":
+                    q = json.loads(kv.get("q:" + args[0], "[]"))
+                    q.append(args[1])
+                    kv["q:" + args[0]] = json.dumps(q)
+                    self.wfile.write(b"+DI-fake-job\r\n")
+                elif op == "GETJOB":
+                    # GETJOB FROM q
+                    qname = args[args.index("FROM") + 1] if "FROM" in args else args[-1]
+                    q = json.loads(kv.get("q:" + qname, "[]"))
+                    if not q:
+                        self.wfile.write(b"*-1\r\n")
+                    else:
+                        body = q.pop(0)
+                        kv["q:" + qname] = json.dumps(q)
+                        bb = body.encode()
+                        qb = qname.encode()
+                        self.wfile.write(
+                            b"*1\r\n*3\r\n$%d\r\n%s\r\n$10\r\nDI-fake-id\r\n$%d\r\n%s\r\n"
+                            % (len(qb), qb, len(bb), bb)
+                        )
+                else:
+                    self.wfile.write(b"-ERR unknown command '%s'\r\n" % op.encode())
+
+
+class FakeRedis(FakeServer):
+    handler_class = _RespHandler
+
+
+# ---------------------------------------------------------------------------
+# PostgreSQL wire v3
+# ---------------------------------------------------------------------------
+
+
+class _PgHandler(socketserver.BaseRequestHandler):
+    """Simple-query-protocol server with pluggable auth and a tiny SQL
+    dialect: SELECT val FROM kv WHERE key='k' / INSERT ... / UPDATE ...,
+    plus 'SELECT 1' and an error trigger."""
+
+    auth_mode = "trust"  # overridden per-server: trust|cleartext|md5|scram
+    password = "pw"
+
+    def _send(self, t: bytes, payload: bytes = b""):
+        self.request.sendall(t + struct.pack("!I", len(payload) + 4) + payload)
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _read_msg(self) -> Tuple[bytes, bytes]:
+        head = self._recv_exact(5)
+        ln = struct.unpack("!I", head[1:])[0]
+        return head[:1], self._recv_exact(ln - 4)
+
+    def _error(self, sqlstate: str, msg: str):
+        fields = b"SERROR\0" + b"C" + sqlstate.encode() + b"\0M" + msg.encode() + b"\0\0"
+        self._send(b"E", fields)
+
+    def _ready(self):
+        self._send(b"Z", b"I")
+
+    def _rows(self, cols, rows):
+        desc = struct.pack("!H", len(cols))
+        for c in cols:
+            desc += c.encode() + b"\0" + struct.pack("!IHIHIH", 0, 0, 25, -1 & 0xFFFF, 0, 0)
+        self._send(b"T", desc)
+        for row in rows:
+            data = struct.pack("!H", len(row))
+            for v in row:
+                if v is None:
+                    data += struct.pack("!i", -1)
+                else:
+                    vb = str(v).encode()
+                    data += struct.pack("!i", len(vb)) + vb
+            self._send(b"D", data)
+        self._send(b"C", b"SELECT %d\0" % len(rows))
+
+    def handle(self):
+        try:
+            head = self._recv_exact(8)
+            ln, code = struct.unpack("!II", head)
+            body = self._recv_exact(ln - 8)
+            if code == 80877103:  # SSLRequest → refuse
+                self.request.sendall(b"N")
+                head = self._recv_exact(8)
+                ln, code = struct.unpack("!II", head)
+                body = self._recv_exact(ln - 8)
+            params = body.split(b"\0")
+            user = ""
+            for i in range(0, len(params) - 1, 2):
+                if params[i] == b"user":
+                    user = params[i + 1].decode()
+            if not self._authenticate(user):
+                return
+            self._send(b"R", struct.pack("!I", 0))  # AuthenticationOk
+            self._send(b"S", b"server_version\0fake-14.0\0")
+            self._ready()
+            while True:
+                t, payload = self._read_msg()
+                if t == b"X":
+                    return
+                if t != b"Q":
+                    continue
+                self._query(payload.rstrip(b"\0").decode())
+        except ConnectionError:
+            return
+        except Exception:
+            return
+
+    def _authenticate(self, user: str) -> bool:
+        if self.auth_mode == "trust":
+            return True
+        if self.auth_mode == "cleartext":
+            self._send(b"R", struct.pack("!I", 3))
+            t, payload = self._read_msg()
+            ok = payload.rstrip(b"\0").decode() == self.password
+        elif self.auth_mode == "md5":
+            salt = b"salt"
+            self._send(b"R", struct.pack("!I", 5) + salt)
+            t, payload = self._read_msg()
+            inner = hashlib.md5(
+                self.password.encode() + user.encode()
+            ).hexdigest()
+            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            ok = payload.rstrip(b"\0").decode() == want
+        elif self.auth_mode == "scram":
+            ok = self._scram_server(user)
+        else:
+            ok = False
+        if not ok:
+            self._error("28P01", f'password authentication failed for user "{user}"')
+            return False
+        return True
+
+    def _scram_server(self, user: str) -> bool:
+        self._send(b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\0\0")
+        t, payload = self._read_msg()
+        # SASLInitialResponse: mech \0 int32 len, client-first
+        mech_end = payload.index(b"\0")
+        ln = struct.unpack("!I", payload[mech_end + 1 : mech_end + 5])[0]
+        client_first = payload[mech_end + 5 : mech_end + 5 + ln].decode()
+        bare = client_first.split(",", 2)[2]
+        cnonce = dict(f.split("=", 1) for f in bare.split(","))["r"]
+        snonce = cnonce + base64.b64encode(os.urandom(9)).decode()
+        salt, iters = b"saltsalt", 4096
+        server_first = (
+            f"r={snonce},s={base64.b64encode(salt).decode()},i={iters}"
+        )
+        self._send(b"R", struct.pack("!I", 11) + server_first.encode())
+        t, payload = self._read_msg()
+        client_final = payload.decode()
+        parts = dict(f.split("=", 1) for f in client_final.split(","))
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(), salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(client_key).digest()
+        wo_proof = client_final.rsplit(",p=", 1)[0]
+        auth_msg = f"{bare},{server_first},{wo_proof}".encode()
+        sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+        want = base64.b64encode(
+            bytes(a ^ b for a, b in zip(client_key, sig))
+        ).decode()
+        if parts.get("p") != want:
+            return False
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        v = base64.b64encode(
+            hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+        ).decode()
+        self._send(b"R", struct.pack("!I", 12) + f"v={v}".encode())
+        return True
+
+    def _query(self, sql: str):
+        kv, lock = self.fake_store.kv, self.fake_store.lock
+        s = sql.strip().rstrip(";")
+        low = s.lower()
+        with lock:
+            if low == "select 1":
+                self._rows(["?column?"], [[1]])
+            elif low == "select boom":
+                self._error("40001", "restart transaction: forced serialization failure")
+            elif low.startswith("select val from kv where key="):
+                key = s.split("=", 1)[1].strip().strip("'")
+                v = kv.get(key)
+                self._rows(["val"], [[v]] if v is not None else [])
+            elif low.startswith("insert into kv"):
+                # INSERT INTO kv (key, val) VALUES ('k', 'v') [ON CONFLICT ...]
+                vals = s[s.lower().index("values") + 6 :].strip()
+                inner = vals[vals.index("(") + 1 : vals.index(")")]
+                k, v = [x.strip().strip("'") for x in inner.split(",", 1)]
+                if k in kv and "on conflict" not in low:
+                    self._error("23505", "duplicate key value violates unique constraint")
+                    self._ready()
+                    return
+                kv[k] = v
+                self._send(b"C", b"INSERT 0 1\0")
+            elif low.startswith("update kv set val="):
+                rest = s[len("update kv set val=") :]
+                v, where = _re.split(r"\s+where\s+", rest, 1, flags=_re.I)
+                v = v.strip().strip("'")
+                key = where.split("=", 1)[1].strip().strip("'")
+                n = 1 if key in kv else 0
+                if n:
+                    kv[key] = v
+                self._send(b"C", b"UPDATE %d\0" % n)
+            elif low in ("begin", "commit", "rollback") or low.startswith(
+                ("create", "drop", "set ")
+            ):
+                self._send(b"C", s.split()[0].upper().encode() + b"\0")
+            else:
+                self._error("42601", f"syntax error in fake pg: {s!r}")
+        self._ready()
+
+
+class FakePg(FakeServer):
+    handler_class = _PgHandler
+
+    def __init__(self, auth_mode="trust", password="pw"):
+        self.auth_mode = auth_mode
+        self.password = password
+        super().__init__()
+        self.server.RequestHandlerClass.auth_mode = auth_mode
+        self.server.RequestHandlerClass.password = password
+
+
+# ---------------------------------------------------------------------------
+# MySQL protocol
+# ---------------------------------------------------------------------------
+
+
+class _MysqlHandler(socketserver.BaseRequestHandler):
+    password = "pw"
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _read_packet(self):
+        head = self._recv_exact(4)
+        ln = int.from_bytes(head[:3], "little")
+        self.seq = (head[3] + 1) & 0xFF
+        return self._recv_exact(ln)
+
+    def _send_packet(self, payload: bytes):
+        self.request.sendall(
+            len(payload).to_bytes(3, "little") + bytes([self.seq]) + payload
+        )
+        self.seq = (self.seq + 1) & 0xFF
+
+    def _ok(self, affected=0):
+        self._send_packet(b"\x00" + bytes([affected]) + b"\x00" + b"\x02\x00\x00\x00")
+
+    def _err(self, code, msg):
+        self._send_packet(
+            b"\xff"
+            + struct.pack("<H", code)
+            + b"#40001"
+            + msg.encode()
+        )
+
+    @staticmethod
+    def _lenenc_str(b: bytes) -> bytes:
+        return bytes([len(b)]) + b
+
+    def _resultset(self, cols, rows):
+        self._send_packet(bytes([len(cols)]))
+        for c in cols:
+            cb = c.encode()
+            coldef = (
+                self._lenenc_str(b"def")
+                + self._lenenc_str(b"")
+                + self._lenenc_str(b"kv")
+                + self._lenenc_str(b"kv")
+                + self._lenenc_str(cb)
+                + self._lenenc_str(cb)
+                + b"\x0c"
+                + struct.pack("<HIBHB", 33, 255, 0xFD, 0, 0)
+                + b"\x00\x00"
+            )
+            self._send_packet(coldef)
+        self._send_packet(b"\xfe\x00\x00\x02\x00")  # EOF
+        for row in rows:
+            out = b""
+            for v in row:
+                if v is None:
+                    out += b"\xfb"
+                else:
+                    vb = str(v).encode()
+                    out += self._lenenc_str(vb)
+            self._send_packet(out)
+        self._send_packet(b"\xfe\x00\x00\x02\x00")  # EOF
+
+    def handle(self):
+        try:
+            self.seq = 0
+            scramble = b"12345678" + b"901234567890"  # 20 bytes
+            greeting = (
+                b"\x0a"  # protocol 10
+                + b"5.7.fake\0"
+                + struct.pack("<I", 1)
+                + scramble[:8]
+                + b"\0"
+                + struct.pack("<H", 0xF7FF)
+                + b"\x21"
+                + struct.pack("<H", 2)
+                + struct.pack("<H", 0x8000 | 0x0008)
+                + bytes([len(scramble) + 1])
+                + b"\0" * 10
+                + scramble[8:]
+                + b"\0"
+                + b"mysql_native_password\0"
+            )
+            self._send_packet(greeting)
+            resp = self._read_packet()
+            # parse HandshakeResponse41: caps(4) maxpkt(4) charset(1) 23x
+            off = 32
+            end = resp.index(b"\0", off)
+            user = resp[off:end].decode()
+            off = end + 1
+            alen = resp[off]
+            auth = resp[off + 1 : off + 1 + alen]
+            want = b""
+            if self.password:
+                h1 = hashlib.sha1(self.password.encode()).digest()
+                h2 = hashlib.sha1(h1).digest()
+                h3 = hashlib.sha1(scramble + h2).digest()
+                want = bytes(a ^ b for a, b in zip(h1, h3))
+            if auth != want:
+                self._err(1045, f"Access denied for user '{user}'")
+                return
+            self._ok()
+            while True:
+                self.seq = 0
+                pkt = self._read_packet()
+                if pkt[:1] == b"\x01":  # COM_QUIT
+                    return
+                if pkt[:1] != b"\x03":
+                    self._err(1047, "unknown command")
+                    continue
+                self._query(pkt[1:].decode())
+        except ConnectionError:
+            return
+        except Exception:
+            return
+
+    def _query(self, sql: str):
+        kv, lock = self.fake_store.kv, self.fake_store.lock
+        s = sql.strip().rstrip(";")
+        low = s.lower()
+        with lock:
+            if low == "select 1":
+                self._resultset(["1"], [[1]])
+            elif low == "select boom":
+                self._err(1213, "Deadlock found when trying to get lock")
+            elif low.startswith("select val from kv where key="):
+                key = s.split("=", 1)[1].strip().strip("'")
+                v = kv.get(key)
+                self._resultset(["val"], [[v]] if v is not None else [])
+            elif low.startswith("insert into kv"):
+                vals = s[low.index("values") + 6 :].strip()
+                inner = vals[vals.index("(") + 1 : vals.index(")")]
+                k, v = [x.strip().strip("'") for x in inner.split(",", 1)]
+                if k in kv and "duplicate" not in low:
+                    self._err(1062, f"Duplicate entry '{k}' for key 'PRIMARY'")
+                    return
+                kv[k] = v
+                self._ok(affected=1)
+            elif low.startswith("update kv set val="):
+                rest = s[len("update kv set val=") :]
+                v, where = _re.split(r"\s+where\s+", rest, 1, flags=_re.I)
+                v = v.strip().strip("'")
+                key = where.split("=", 1)[1].strip().strip("'")
+                if key in kv:
+                    kv[key] = v
+                    self._ok(affected=1)
+                else:
+                    self._ok(affected=0)
+            elif low.startswith(("begin", "commit", "rollback", "create", "drop", "set ", "use ")):
+                self._ok()
+            else:
+                self._err(1064, f"You have an error in your SQL syntax: {s!r}")
+
+
+class FakeMysql(FakeServer):
+    handler_class = _MysqlHandler
+
+    def __init__(self, password="pw"):
+        self.password = password
+        super().__init__()
+        self.server.RequestHandlerClass.password = password
+
+
+# ---------------------------------------------------------------------------
+# ZooKeeper jute
+# ---------------------------------------------------------------------------
+
+
+class _ZkHandler(socketserver.BaseRequestHandler):
+    ZK_OK, NO_NODE, BAD_VERSION, NODE_EXISTS = 0, -101, -103, -110
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _read_frame(self):
+        (n,) = struct.unpack("!i", self._recv_exact(4))
+        return self._recv_exact(n)
+
+    def _send_frame(self, payload):
+        self.request.sendall(struct.pack("!i", len(payload)) + payload)
+
+    @staticmethod
+    def _buffer(b):
+        if b is None:
+            return struct.pack("!i", -1)
+        return struct.pack("!i", len(b)) + b
+
+    @staticmethod
+    def _read_buffer(data, off):
+        (n,) = struct.unpack("!i", data[off : off + 4])
+        off += 4
+        if n < 0:
+            return None, off
+        return data[off : off + n], off + n
+
+    def _stat(self, version):
+        # czxid mzxid ctime mtime version cversion aversion
+        # ephemeralOwner dataLength numChildren pzxid
+        return struct.pack("!qqqqiiiqiiq", 1, 1, 0, 0, version, 0, 0, 0, 0, 0, 1)
+
+    def handle(self):
+        try:
+            self._read_frame()  # ConnectRequest
+            self._send_frame(
+                struct.pack("!iiq", 0, 10000, 0x1234) + self._buffer(b"\0" * 16)
+            )
+            nodes = self.fake_store.kv  # path → json {data(hexbytes), version}
+            lock = self.fake_store.lock
+            while True:
+                frame = self._read_frame()
+                xid, op = struct.unpack("!ii", frame[:8])
+                body = frame[8:]
+                if op == -11:  # close
+                    self._send_frame(struct.pack("!iqi", xid, 1, 0))
+                    return
+                with lock:
+                    err, payload = self._op(op, body, nodes)
+                self._send_frame(struct.pack("!iqi", xid, 1, err) + payload)
+        except ConnectionError:
+            return
+        except Exception:
+            return
+
+    def _op(self, op, body, nodes):
+        path_b, off = self._read_buffer(body, 0)
+        path = path_b.decode()
+        if op == 1:  # create
+            data, off = self._read_buffer(body, off)
+            if path in nodes:
+                return self.NODE_EXISTS, b""
+            nodes[path] = json.dumps({"data": (data or b"").hex(), "version": 0})
+            return self.ZK_OK, self._buffer(path.encode())
+        if op == 2:  # delete
+            (version,) = struct.unpack("!i", body[off : off + 4])
+            if path not in nodes:
+                return self.NO_NODE, b""
+            node = json.loads(nodes[path])
+            if version != -1 and version != node["version"]:
+                return self.BAD_VERSION, b""
+            del nodes[path]
+            return self.ZK_OK, b""
+        if op == 3:  # exists
+            if path not in nodes:
+                return self.NO_NODE, b""
+            node = json.loads(nodes[path])
+            return self.ZK_OK, self._stat(node["version"])
+        if op == 4:  # getData
+            if path not in nodes:
+                return self.NO_NODE, b""
+            node = json.loads(nodes[path])
+            return (
+                self.ZK_OK,
+                self._buffer(bytes.fromhex(node["data"])) + self._stat(node["version"]),
+            )
+        if op == 5:  # setData
+            data, off = self._read_buffer(body, off)
+            (version,) = struct.unpack("!i", body[off : off + 4])
+            if path not in nodes:
+                return self.NO_NODE, b""
+            node = json.loads(nodes[path])
+            if version != -1 and version != node["version"]:
+                return self.BAD_VERSION, b""
+            node = {"data": (data or b"").hex(), "version": node["version"] + 1}
+            nodes[path] = json.dumps(node)
+            return self.ZK_OK, self._stat(node["version"])
+        if op == 8:  # getChildren
+            prefix = path.rstrip("/") + "/"
+            kids = sorted(
+                p[len(prefix) :]
+                for p in nodes
+                if p.startswith(prefix) and "/" not in p[len(prefix) :]
+            )
+            out = struct.pack("!i", len(kids))
+            for k in kids:
+                out += self._buffer(k.encode())
+            return self.ZK_OK, out
+        return -6, b""  # unimplemented
+
+
+class FakeZk(FakeServer):
+    handler_class = _ZkHandler
+
+
+# ---------------------------------------------------------------------------
+# MongoDB OP_MSG
+# ---------------------------------------------------------------------------
+
+
+class _MongoHandler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def handle(self):
+        from jepsen_tpu.suites.proto.mongo import bson_decode, bson_encode
+
+        if not hasattr(self.fake_store, "docs"):
+            self.fake_store.docs = {}
+        try:
+            while True:
+                ln, rid, _rto, opcode = struct.unpack("<iiii", self._recv_exact(16))
+                payload = self._recv_exact(ln - 16)
+                cmd = bson_decode(payload[5:])
+                with self.fake_store.lock:
+                    reply = self._command(cmd)
+                body = struct.pack("<I", 0) + b"\x00" + bson_encode(reply)
+                self.request.sendall(
+                    struct.pack("<iiii", 16 + len(body), 1, rid, 2013) + body
+                )
+        except ConnectionError:
+            return
+        except Exception:
+            return
+
+    def _command(self, cmd):
+        docs = self.fake_store.docs
+        # the command name is the first key of an OP_MSG body
+        name = next(iter(cmd))
+        cmd = {name: cmd[name], **{k: v for k, v in cmd.items() if k != name}}
+        if name == "insert":
+            coll = docs.setdefault(cmd["insert"], [])
+            for d in cmd["documents"]:
+                if any(x.get("_id") == d.get("_id") for x in coll):
+                    return {
+                        "ok": 1,
+                        "n": 0,
+                        "writeErrors": [
+                            {"index": 0, "code": 11000, "errmsg": "duplicate key"}
+                        ],
+                    }
+                coll.append(dict(d))
+            return {"ok": 1, "n": len(cmd["documents"])}
+        if name == "find":
+            coll = docs.get(cmd["find"], [])
+            flt = cmd.get("filter", {})
+            out = [d for d in coll if all(d.get(k) == v for k, v in flt.items())]
+            return {
+                "ok": 1,
+                "cursor": {"id": 0, "ns": "test." + cmd["find"], "firstBatch": out},
+            }
+        if name == "update":
+            coll = docs.setdefault(cmd["update"], [])
+            n = 0
+            for u in cmd["updates"]:
+                q, mod = u["q"], u["u"]
+                matched = [
+                    d for d in coll if all(d.get(k) == v for k, v in q.items())
+                ]
+                if not matched and u.get("upsert"):
+                    nd = dict(q)
+                    nd.update(mod.get("$set", {}))
+                    coll.append(nd)
+                    n += 1
+                for d in matched:
+                    for k, v in mod.get("$set", {}).items():
+                        d[k] = v
+                    for k, v in mod.get("$inc", {}).items():
+                        d[k] = d.get(k, 0) + v
+                    n += 1
+            return {"ok": 1, "n": n}
+        if name == "findAndModify":
+            coll = docs.setdefault(cmd["findAndModify"], [])
+            q = cmd["query"]
+            matched = [d for d in coll if all(d.get(k) == v for k, v in q.items())]
+            if not matched:
+                if cmd.get("upsert"):
+                    nd = dict(q)
+                    nd.update(cmd["update"].get("$set", {}))
+                    coll.append(nd)
+                    return {"ok": 1, "value": nd if cmd.get("new") else None}
+                return {"ok": 1, "value": None}
+            d = matched[0]
+            for k, v in cmd["update"].get("$set", {}).items():
+                d[k] = v
+            for k, v in cmd["update"].get("$inc", {}).items():
+                d[k] = d.get(k, 0) + v
+            return {"ok": 1, "value": d}
+        if name in ("ismaster", "hello"):
+            return {"ok": 1, "ismaster": True, "maxWireVersion": 13}
+        return {"ok": 0, "errmsg": f"no such command: {list(cmd)[0]}", "code": 59}
+
+
+class FakeMongo(FakeServer):
+    handler_class = _MongoHandler
+
+
+# ---------------------------------------------------------------------------
+# CQL v4
+# ---------------------------------------------------------------------------
+
+
+class _CqlHandler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _send(self, stream, opcode, body):
+        self.request.sendall(
+            struct.pack("!BBhBI", 0x84, 0, stream, opcode, len(body)) + body
+        )
+
+    def _error(self, stream, code, msg):
+        mb = msg.encode()
+        self._send(stream, 0x00, struct.pack("!IH", code, len(mb)) + mb)
+
+    def _rows(self, stream, cols, rows):
+        # metadata: flags=1 (global spec), ncols, ks, table, then per-col
+        # name + type varchar(0x000D)
+        body = struct.pack("!II", 1, len(cols))
+        for name in ("ks", "t"):
+            nb = name.encode()
+            body += struct.pack("!H", len(nb)) + nb
+        for c in cols:
+            cb = c.encode()
+            body += struct.pack("!H", len(cb)) + cb + struct.pack("!H", 0x000D)
+        body += struct.pack("!I", len(rows))
+        for row in rows:
+            for v in row:
+                if v is None:
+                    body += struct.pack("!i", -1)
+                else:
+                    vb = str(v).encode()
+                    body += struct.pack("!i", len(vb)) + vb
+        self._send(stream, 0x08, struct.pack("!I", 2) + body)
+
+    def handle(self):
+        try:
+            while True:
+                header = self._recv_exact(9)
+                _v, _f, stream, opcode, ln = struct.unpack("!BBhBI", header)
+                body = self._recv_exact(ln)
+                if opcode == 0x01:  # STARTUP
+                    self._send(stream, 0x02, b"")
+                    continue
+                if opcode != 0x07:  # QUERY
+                    self._error(stream, 0x000A, "protocol error")
+                    continue
+                (qlen,) = struct.unpack("!I", body[:4])
+                cql = body[4 : 4 + qlen].decode()
+                with self.fake_store.lock:
+                    self._query(stream, cql)
+        except ConnectionError:
+            return
+        except Exception:
+            return
+
+    def _query(self, stream, cql):
+        kv = self.fake_store.kv
+        s = cql.strip().rstrip(";")
+        low = s.lower()
+        if low == "select boom":
+            self._error(stream, 0x1100, "Operation timed out")
+        elif low.startswith("select val from kv where key="):
+            key = s.split("=", 1)[1].strip().strip("'")
+            v = kv.get(key)
+            self._rows(stream, ["val"], [[v]] if v is not None else [])
+        elif low.startswith("insert into kv"):
+            vals = s[low.index("values") + 6 :].strip()
+            inner = vals[vals.index("(") + 1 : vals.rindex(")")]
+            k, v = [x.strip().strip("'") for x in inner.split(",", 1)]
+            if low.endswith("if not exists") and k in kv:
+                self._rows(stream, ["[applied]"], [["false"]])
+                return
+            kv[k] = v.split("'")[0] if "'" in v else v
+            if "if not exists" in low:
+                self._rows(stream, ["[applied]"], [["true"]])
+            else:
+                self._send(stream, 0x08, struct.pack("!I", 1))  # void
+        elif low.startswith("update kv set val="):
+            rest = s[len("update kv set val=") :]
+            v, where = _re.split(r"\s+where\s+", rest, 1, flags=_re.I)
+            v = v.strip().strip("'")
+            # LWT: UPDATE ... WHERE key='k' IF val='x'
+            m = _re.split(r"\s+if\s+val\s*=\s*", where, 1, flags=_re.I)
+            key = m[0].split("=", 1)[1].strip().strip("'")
+            if len(m) == 2:
+                cond = m[1].strip().strip("'")
+                if kv.get(key) == cond:
+                    kv[key] = v
+                    self._rows(stream, ["[applied]"], [["true"]])
+                else:
+                    self._rows(stream, ["[applied]"], [["false"]])
+                return
+            kv[key] = v
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        elif low.startswith(("create", "drop", "use ", "truncate")):
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        else:
+            self._error(stream, 0x2000, f"Invalid CQL: {s!r}")
+
+
+class FakeCql(FakeServer):
+    handler_class = _CqlHandler
+
+
+# ---------------------------------------------------------------------------
+# IRC
+# ---------------------------------------------------------------------------
+
+
+class _IrcHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.fake_store
+        if not hasattr(store, "irc_members"):
+            store.irc_members = {}  # channel → {nick: wfile}
+        nick = None
+        try:
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                line = line.decode(errors="replace").strip()
+                if not line:
+                    continue
+                parts = line.split(" ", 1)
+                cmd = parts[0].upper()
+                rest = parts[1] if len(parts) > 1 else ""
+                if cmd == "NICK":
+                    nick = rest.strip()
+                elif cmd == "USER":
+                    self.wfile.write(
+                        f":fake 001 {nick} :Welcome\r\n".encode()
+                    )
+                elif cmd == "JOIN":
+                    chan = rest.strip()
+                    with store.lock:
+                        store.irc_members.setdefault(chan, {})[nick] = self.wfile
+                    self.wfile.write(f":{nick}!u@h JOIN {chan}\r\n".encode())
+                elif cmd == "PRIVMSG":
+                    target, msg = rest.split(" :", 1)
+                    # write under the lock: BufferedWriter is not
+                    # thread-safe and concurrent senders must not
+                    # interleave bytes within a line
+                    with store.lock:
+                        members = store.irc_members.get(target.strip(), {})
+                        for other, wf in members.items():
+                            if other != nick:
+                                try:
+                                    wf.write(
+                                        f":{nick}!u@h PRIVMSG {target} :{msg}\r\n".encode()
+                                    )
+                                    wf.flush()
+                                except Exception:
+                                    pass
+                elif cmd == "QUIT":
+                    return
+        except Exception:
+            return
+        finally:
+            if nick:
+                with store.lock:
+                    for members in getattr(store, "irc_members", {}).values():
+                        members.pop(nick, None)
+
+
+class FakeIrc(FakeServer):
+    handler_class = _IrcHandler

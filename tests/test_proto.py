@@ -1,0 +1,368 @@
+"""Wire-protocol client tests against in-process fake servers
+(tests/fake_servers.py) speaking the real protocols over loopback."""
+
+import pytest
+
+from fake_servers import FakeMysql, FakePg, FakeRedis
+from jepsen_tpu.suites.proto import IndeterminateError, ProtocolError
+from jepsen_tpu.suites.proto.mysql import MysqlClient, MysqlError
+from jepsen_tpu.suites.proto.pgwire import PgClient, PgError
+from jepsen_tpu.suites.proto.resp import RespClient
+
+
+# -- RESP -------------------------------------------------------------------
+
+
+@pytest.fixture
+def redis():
+    srv = FakeRedis().start()
+    yield srv
+    srv.stop()
+
+
+def test_resp_roundtrip(redis):
+    c = RespClient("127.0.0.1", redis.port).connect()
+    assert c.call("PING") == "PONG"
+    assert c.call("SET", "x", "1") == "OK"
+    assert c.call("GET", "x") == "1"
+    assert c.call("GET", "missing") is None
+    assert c.call("INCR", "x") == 2
+    assert c.call("DEL", "x") == 1
+    c.close()
+
+
+def test_resp_sets_and_errors(redis):
+    c = RespClient("127.0.0.1", redis.port).connect()
+    assert c.call("SADD", "s", "a", "b") == 2
+    assert c.call("SADD", "s", "b") == 0
+    assert c.call("SMEMBERS", "s") == ["a", "b"]
+    with pytest.raises(ProtocolError) as ei:
+        c.call("NOPE")
+    assert ei.value.code == "ERR"
+    c.close()
+
+
+def test_resp_disque_jobs(redis):
+    c = RespClient("127.0.0.1", redis.port).connect()
+    assert c.call("ADDJOB", "q1", "payload-1").startswith("DI-")
+    got = c.call("GETJOB", "FROM", "q1")
+    assert got[0][0] == "q1" and got[0][2] == "payload-1"
+    assert c.call("GETJOB", "FROM", "q1") is None
+    c.close()
+
+
+def test_resp_dead_server_is_indeterminate(redis):
+    c = RespClient("127.0.0.1", redis.port).connect()
+    redis.stop()
+    with pytest.raises((IndeterminateError, OSError)):
+        for _ in range(3):  # first send may land in the OS buffer
+            c.call("SET", "x", "1")
+
+
+# -- Postgres wire ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("auth", ["trust", "cleartext", "md5", "scram"])
+def test_pg_auth_modes(auth):
+    srv = FakePg(auth_mode=auth, password="sekrit").start()
+    try:
+        c = PgClient(
+            "127.0.0.1", srv.port, user="alice", password="sekrit"
+        ).connect()
+        res = c.query("SELECT 1")
+        assert res.rows == [["1"]]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_pg_bad_password_rejected():
+    srv = FakePg(auth_mode="md5", password="right").start()
+    try:
+        with pytest.raises(PgError) as ei:
+            PgClient("127.0.0.1", srv.port, password="wrong").connect()
+        assert ei.value.code == "28P01"
+    finally:
+        srv.stop()
+
+
+@pytest.fixture
+def pg():
+    srv = FakePg().start()
+    c = PgClient("127.0.0.1", srv.port).connect()
+    yield c
+    c.close()
+    srv.stop()
+
+
+def test_pg_kv_roundtrip(pg):
+    assert pg.query("INSERT INTO kv (key, val) VALUES ('a', '10')").command == "INSERT 0 1"
+    r = pg.query("SELECT val FROM kv WHERE key='a'")
+    assert r.columns == ["val"] and r.rows == [["10"]]
+    assert pg.query("SELECT val FROM kv WHERE key='nope'").rows == []
+    assert pg.query("UPDATE kv SET val='11' WHERE key='a'").command == "UPDATE 1"
+    assert pg.query("SELECT val FROM kv WHERE key='a'").rows == [["11"]]
+
+
+def test_pg_errors_carry_sqlstate(pg):
+    pg.query("INSERT INTO kv (key, val) VALUES ('dup', '1')")
+    with pytest.raises(PgError) as ei:
+        pg.query("INSERT INTO kv (key, val) VALUES ('dup', '2')")
+    assert ei.value.code == "23505"
+    with pytest.raises(PgError) as ei:
+        pg.query("SELECT boom")
+    assert ei.value.serialization_failure
+    # connection still usable after an error
+    assert pg.query("SELECT 1").rows == [["1"]]
+
+
+# -- MySQL ------------------------------------------------------------------
+
+
+@pytest.fixture
+def my():
+    srv = FakeMysql(password="pw").start()
+    c = MysqlClient("127.0.0.1", srv.port, user="root", password="pw").connect()
+    yield c
+    c.close()
+    srv.stop()
+
+
+def test_mysql_auth_and_select(my):
+    r = my.query("SELECT 1")
+    assert r.rows == [["1"]]
+
+
+def test_mysql_bad_password():
+    srv = FakeMysql(password="right").start()
+    try:
+        with pytest.raises(MysqlError) as ei:
+            MysqlClient("127.0.0.1", srv.port, password="wrong").connect()
+        assert ei.value.code == 1045
+    finally:
+        srv.stop()
+
+
+def test_mysql_kv_roundtrip(my):
+    r = my.query("INSERT INTO kv (key, val) VALUES ('a', '5')")
+    assert r.affected_rows == 1
+    r = my.query("SELECT val FROM kv WHERE key='a'")
+    assert r.columns == ["val"] and r.rows == [["5"]]
+    assert my.query("SELECT val FROM kv WHERE key='zzz'").rows == []
+    assert my.query("UPDATE kv SET val='6' WHERE key='a'").affected_rows == 1
+
+
+def test_mysql_errors_classified(my):
+    with pytest.raises(MysqlError) as ei:
+        my.query("SELECT boom")
+    assert ei.value.code == 1213 and ei.value.retriable
+    my.query("INSERT INTO kv (key, val) VALUES ('d', '1')")
+    with pytest.raises(MysqlError) as ei:
+        my.query("INSERT INTO kv (key, val) VALUES ('d', '2')")
+    assert ei.value.code == 1062 and not ei.value.retriable
+    # connection survives errors
+    assert my.query("SELECT 1").rows == [["1"]]
+
+
+# -- ZooKeeper --------------------------------------------------------------
+
+
+@pytest.fixture
+def zk():
+    from fake_servers import FakeZk
+
+    srv = FakeZk().start()
+    from jepsen_tpu.suites.proto.zk import ZkClient
+
+    c = ZkClient("127.0.0.1", srv.port).connect()
+    yield c
+    c.close()
+    srv.stop()
+
+
+def test_zk_session_and_crud(zk):
+    from jepsen_tpu.suites.proto.zk import NO_NODE, NODE_EXISTS, ZkError
+
+    assert zk.session_id != 0
+    assert zk.create("/jepsen", b"0") == "/jepsen"
+    with pytest.raises(ZkError) as ei:
+        zk.create("/jepsen", b"1")
+    assert ei.value.code == NODE_EXISTS
+    data, stat = zk.get_data("/jepsen")
+    assert data == b"0" and stat.version == 0
+    stat2 = zk.set_data("/jepsen", b"5", version=0)
+    assert stat2.version == 1
+    assert zk.get_data("/jepsen")[0] == b"5"
+    with pytest.raises(ZkError) as ei:
+        zk.get_data("/none")
+    assert ei.value.code == NO_NODE
+
+
+def test_zk_cas_via_version(zk):
+    from jepsen_tpu.suites.proto.zk import BAD_VERSION, ZkError
+
+    zk.create("/r", b"a")
+    zk.set_data("/r", b"b", version=0)
+    # stale version CAS fails
+    with pytest.raises(ZkError) as ei:
+        zk.set_data("/r", b"c", version=0)
+    assert ei.value.code == BAD_VERSION
+    assert zk.get_data("/r")[0] == b"b"
+
+
+def test_zk_children_and_delete(zk):
+    zk.create("/q", b"")
+    zk.create("/q/a", b"1")
+    zk.create("/q/b", b"2")
+    assert zk.get_children("/q") == ["a", "b"]
+    zk.delete("/q/a")
+    assert zk.get_children("/q") == ["b"]
+    assert zk.exists("/q/a") is None
+    assert zk.exists("/q/b") is not None
+
+
+# -- BSON / MongoDB ---------------------------------------------------------
+
+
+def test_bson_roundtrip():
+    from jepsen_tpu.suites.proto.mongo import bson_decode, bson_encode
+
+    doc = {
+        "str": "hello",
+        "int": 42,
+        "big": 2**40,
+        "float": 1.5,
+        "bool": True,
+        "none": None,
+        "nested": {"a": 1},
+        "arr": [1, "two", {"three": 3}],
+    }
+    assert bson_decode(bson_encode(doc)) == doc
+
+
+@pytest.fixture
+def mongo():
+    from fake_servers import FakeMongo
+
+    srv = FakeMongo().start()
+    from jepsen_tpu.suites.proto.mongo import MongoClient
+
+    c = MongoClient("127.0.0.1", srv.port).connect()
+    yield c
+    c.close()
+    srv.stop()
+
+
+def test_mongo_insert_find_update(mongo):
+    mongo.insert("reg", [{"_id": 0, "value": 1}], write_concern={"w": "majority"})
+    assert mongo.find("reg", {"_id": 0}) == [{"_id": 0, "value": 1}]
+    mongo.update("reg", {"_id": 0}, {"$set": {"value": 9}})
+    assert mongo.find("reg", {"_id": 0})[0]["value"] == 9
+    assert mongo.find("reg", {"_id": 1}) == []
+
+
+def test_mongo_duplicate_key_and_cas(mongo):
+    from jepsen_tpu.suites.proto.mongo import MongoError
+
+    mongo.insert("reg", [{"_id": 0, "value": 1}])
+    with pytest.raises(MongoError) as ei:
+        mongo.insert("reg", [{"_id": 0, "value": 2}])
+    assert ei.value.code == 11000
+    # CAS via findAndModify on (id, expected value)
+    out = mongo.find_and_modify(
+        "reg", {"_id": 0, "value": 1}, {"$set": {"value": 3}}, new=True
+    )
+    assert out["value"] == 3
+    assert (
+        mongo.find_and_modify("reg", {"_id": 0, "value": 99}, {"$set": {"value": 4}})
+        is None
+    )
+
+
+# -- CQL --------------------------------------------------------------------
+
+
+@pytest.fixture
+def cql():
+    from fake_servers import FakeCql
+
+    srv = FakeCql().start()
+    from jepsen_tpu.suites.proto.cql import CqlClient
+
+    c = CqlClient("127.0.0.1", srv.port).connect()
+    yield c
+    c.close()
+    srv.stop()
+
+
+def test_cql_roundtrip(cql):
+    from jepsen_tpu.suites.proto.cql import text_value
+
+    r = cql.query("INSERT INTO kv (key, val) VALUES ('a', '7')")
+    assert r.kind == "void"
+    r = cql.query("SELECT val FROM kv WHERE key='a'")
+    assert r.columns == ["val"] and text_value(r.rows[0][0]) == "7"
+    assert cql.query("SELECT val FROM kv WHERE key='x'").rows == []
+
+
+def test_cql_lwt_and_timeout(cql):
+    from jepsen_tpu.suites.proto.cql import CqlError
+
+    r = cql.query("INSERT INTO kv (key, val) VALUES ('k', '1') IF NOT EXISTS")
+    assert r.rows[0][0] == b"true"
+    r = cql.query("INSERT INTO kv (key, val) VALUES ('k', '2') IF NOT EXISTS")
+    assert r.rows[0][0] == b"false"
+    with pytest.raises(CqlError) as ei:
+        cql.query("SELECT boom")
+    assert ei.value.timeout  # write-timeout class → indeterminate
+
+
+# -- IRC --------------------------------------------------------------------
+
+
+def test_irc_join_and_message_delivery():
+    from fake_servers import FakeIrc
+
+    from jepsen_tpu.suites.proto.irc import IrcClient
+
+    srv = FakeIrc().start()
+    try:
+        a = IrcClient("127.0.0.1", srv.port, nick="alice").connect()
+        b = IrcClient("127.0.0.1", srv.port, nick="bob").connect()
+        a.join("#jepsen")
+        b.join("#jepsen")
+        a.privmsg("#jepsen", "msg-1")
+        a.privmsg("#jepsen", "msg-2")
+        import time
+
+        time.sleep(0.2)
+        got = b.read_messages()
+        assert [(n, t) for n, t, _ in got] == [("alice", "#jepsen")] * 2
+        assert [m for _, _, m in got] == ["msg-1", "msg-2"]
+        a.close()
+        b.close()
+    finally:
+        srv.stop()
+
+
+def test_cql_lwt_update_condition(cql):
+    cql.query("INSERT INTO kv (key, val) VALUES ('r', '1')")
+    r = cql.query("UPDATE kv SET val='2' WHERE key='r' IF val='1'")
+    assert r.rows[0][0] == b"true"
+    r = cql.query("UPDATE kv SET val='9' WHERE key='r' IF val='999'")
+    assert r.rows[0][0] == b"false"
+    from jepsen_tpu.suites.proto.cql import text_value
+
+    assert text_value(cql.query("SELECT val FROM kv WHERE key='r'").rows[0][0]) == "2"
+
+
+def test_irc_dead_connection_raises_not_empty():
+    from fake_servers import FakeIrc
+    from jepsen_tpu.suites.proto.irc import IrcClient
+
+    srv = FakeIrc().start()
+    a = IrcClient("127.0.0.1", srv.port, nick="alice").connect()
+    a.join("#x")
+    srv.stop()
+    with pytest.raises(IndeterminateError):
+        a.read_messages()
