@@ -1,0 +1,337 @@
+"""Chronos (Mesos job scheduler) suite.
+
+Reference: chronos/src/jepsen/chronos.clj + chronos/checker.clj +
+mesosphere.clj — install mesos + zookeeper + chronos from the
+mesosphere apt repo (mesosphere.clj / chronos.clj:56-77), submit
+repeating ISO8601 jobs (``R<count>/<start>/PT<interval>S``,
+chronos.clj:103-131) whose shell command logs invocation/completion
+times into ``/tmp/chronos-test/`` (chronos.clj:109-117), then read the
+run files off every node and check that each job ran inside each of its
+target windows ``[start + k*interval, + epsilon + duration]``
+(checker.clj:30-213).
+
+The checker here uses greedy interval matching per job (the reference
+solves the same matching with a backtracking solution search —
+checker.clj:78-191; greedy is exact for non-overlapping target
+windows, and we flag overlapping windows as :unknown rather than
+mis-assign runs).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+from typing import Any, Dict, List, Optional
+
+from .. import checker as checker_mod
+from .. import client as client_mod
+from .. import control
+from .. import generator as gen
+from ..control import util as cu
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+PORT = 4400
+JOB_DIR = "/tmp/chronos-test/"  # (reference: chronos.clj:26)
+
+
+def interval_str(job: dict) -> str:
+    """R<count>/<ISO start>/PT<interval>S (reference: chronos.clj:103-108)"""
+    start = job["start"].strftime("%Y-%m-%dT%H:%M:%S.000Z")
+    return f"R{job['count']}/{start}/PT{job['interval']}S"
+
+
+def command(job: dict) -> str:
+    """Shell command logging job name + invocation/completion times.
+    (reference: chronos.clj:110-117)"""
+    return (
+        f"MEW=$(mktemp -p {JOB_DIR}); "
+        f"echo \"{job['name']}\" >> $MEW; "
+        "date -u -Ins >> $MEW; "
+        f"sleep {job['duration']}; "
+        "date -u -Ins >> $MEW;"
+    )
+
+
+def job_to_json(job: dict) -> dict:
+    """(reference: chronos.clj:119-131 job->json)"""
+    return {
+        "name": str(job["name"]),
+        "command": command(job),
+        "schedule": interval_str(job),
+        "scheduleTimeZone": "UTC",
+        "owner": "jepsen@jepsen.io",
+        "epsilon": f"PT{job['epsilon']}S",
+        "mem": 1,
+        "disk": 1,
+        "cpus": 0.001,
+        "async": False,
+    }
+
+
+class ChronosDB(common.DaemonDB):
+    """Installs zookeeper + mesos + chronos from the mesosphere repo.
+    (reference: chronos.clj:56-77 db, mesosphere.clj)"""
+
+    logfile = "/var/log/chronos.log"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+
+    def install(self, test, node):
+        with control.su():
+            control.execute(
+                "apt-key", "adv", "--keyserver", "keyserver.ubuntu.com",
+                "--recv", "E56151BF", check=False,
+            )
+            cu.write_file(
+                "deb http://repos.mesosphere.com/debian jessie main\n",
+                "/etc/apt/sources.list.d/mesosphere.list",
+            )
+            control.execute("apt-get", "update", check=False)
+        debian.install(["zookeeper", "mesos", "chronos"])
+        with control.su():
+            control.execute("mkdir", "-p", JOB_DIR)
+
+    def setup(self, test, node):
+        self.install(test, node)
+        with control.su():
+            for svc in ("zookeeper", "mesos-master", "mesos-slave", "chronos"):
+                control.execute("service", svc, "start", check=False)
+        cu.await_tcp_port(PORT, timeout_s=120)
+
+    def teardown(self, test, node):
+        with control.su():
+            for svc in ("chronos", "mesos-slave", "mesos-master", "zookeeper"):
+                control.execute("service", svc, "stop", check=False)
+            control.execute("rm", "-rf", JOB_DIR)
+
+    # Process: chronos runs under service management
+    def start(self, test, node):
+        with control.su():
+            control.execute("service", "chronos", "start", check=False)
+
+    def kill(self, test, node):
+        with control.su():
+            control.execute("service", "chronos", "stop", check=False)
+            cu.grepkill("chronos")
+
+    def pause(self, test, node):
+        cu.signal("chronos", "STOP")
+
+    def resume(self, test, node):
+        cu.signal("chronos", "CONT")
+
+    def log_files(self, test, node):
+        return ["/var/log/mesos/mesos-master.INFO", self.logfile]
+
+
+def read_runs(test: dict) -> List[dict]:
+    """Collect {node, name, start, end} run records from every node's
+    job dir.  (reference: chronos.clj:160-171 read-runs)"""
+    def per_node(test, node):
+        runs = []
+        for f in cu.ls_full(JOB_DIR):
+            raw = cu.file_contents(f)
+            lines = raw.strip().split("\n")
+            if not lines or not lines[0].strip():
+                continue
+            name = int(lines[0])
+            times = [
+                _parse_time(t) for t in lines[1:3] if t.strip()
+            ]
+            runs.append(
+                {
+                    "node": control.current_node(),
+                    "name": name,
+                    "start": times[0] if times else None,
+                    "end": times[1] if len(times) > 1 else None,
+                }
+            )
+        return runs
+
+    out = control.on_nodes(test, per_node)
+    return [r for rs in out.values() for r in rs]
+
+
+def _parse_time(t: str) -> Optional[dt.datetime]:
+    # date -u -Ins may emit comma fractional separators
+    # (reference: chronos.clj:143-149 parse-file-time)
+    t = t.strip().replace(",", ".")
+    try:
+        return dt.datetime.fromisoformat(t)
+    except ValueError:
+        return None
+
+
+class ChronosClient(client_mod.Client):
+    """add-job → POST /scheduler/iso8601; read → read-runs off nodes.
+    (reference: chronos.clj:173-198)"""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add-job":
+                self.conn.post(
+                    "/scheduler/iso8601", job_to_json(op["value"]),
+                    ok=(200, 204),
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                return {**op, "type": "ok", "value": read_runs(test)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+# ---------------------------------------------------------------------
+# Checker (reference: chronos/checker.clj)
+# ---------------------------------------------------------------------
+
+
+def job_targets(job: dict, final_time: dt.datetime) -> List[tuple]:
+    """Target windows [start + k*interval, + epsilon + duration] for
+    runs scheduled before final_time.  (reference: checker.clj:30-47)"""
+    out = []
+    for k in range(job["count"]):
+        lo = job["start"] + dt.timedelta(seconds=k * job["interval"])
+        if lo > final_time:
+            break
+        hi = lo + dt.timedelta(seconds=job["epsilon"] + job["duration"])
+        out.append((lo, hi))
+    return out
+
+
+class _ChronosChecker(checker_mod.Checker):
+    def check(self, test, history, opts=None):
+        jobs: Dict[int, dict] = {}
+        runs: List[dict] = []
+        final_time = None
+        for op in history:
+            if op["type"] == "ok" and op["f"] == "add-job":
+                j = op["value"]
+                jobs[j["name"]] = j
+            elif op["type"] == "ok" and op["f"] == "read":
+                runs = op["value"]
+                final_time = _nanos_to_time(test, op.get("time", 0))
+        if final_time is None:
+            return {"valid?": "unknown", "error": "no final read"}
+
+        bad_jobs = []
+        unknown = False
+        for name, job in sorted(jobs.items()):
+            targets = job_targets(job, final_time)
+            # overlapping windows would need the reference's solver
+            for (a, b), (c, d) in zip(targets, targets[1:]):
+                if b > c:
+                    unknown = True
+            mine = sorted(
+                (r["start"] for r in runs
+                 if r["name"] == name and r["start"] is not None),
+            )
+            hits, i = 0, 0
+            for lo, hi in targets:
+                while i < len(mine) and mine[i] < lo:
+                    i += 1
+                if i < len(mine) and mine[i] <= hi:
+                    hits += 1
+                    i += 1
+            if hits < len(targets):
+                bad_jobs.append(
+                    {"name": name, "targets": len(targets), "hits": hits}
+                )
+        valid = "unknown" if unknown and not bad_jobs else not bad_jobs
+        return {
+            "valid?": valid,
+            "job-count": len(jobs),
+            "run-count": len(runs),
+            "bad-jobs": bad_jobs,
+        }
+
+
+def _nanos_to_time(test: dict, nanos: int) -> dt.datetime:
+    base = test.get("start-time") or dt.datetime.now(dt.timezone.utc)
+    if isinstance(base, (int, float)):
+        base = dt.datetime.fromtimestamp(base, dt.timezone.utc)
+    return base + dt.timedelta(seconds=nanos / 1e9)
+
+
+def checker() -> checker_mod.Checker:
+    return _ChronosChecker()
+
+
+def generator_jobs(opts: Optional[dict] = None):
+    """Emit add-job ops with increasing names and randomized schedules.
+    (reference: chronos.clj:204-221)"""
+    opts = opts or {}
+    state = {"n": 0}
+
+    def next_job(test, ctx):
+        state["n"] += 1
+        now = dt.datetime.now(dt.timezone.utc)
+        return {
+            "type": "invoke",
+            "f": "add-job",
+            "value": {
+                "name": state["n"],
+                "start": now + dt.timedelta(seconds=gen.rng.randrange(30)),
+                "count": gen.rng.randrange(1, 5),
+                "interval": gen.rng.randrange(30, 120),
+                "epsilon": gen.rng.randrange(5, 30),
+                "duration": gen.rng.randrange(1, 10),
+            },
+        }
+
+    return next_job
+
+
+def db(opts: Optional[dict] = None):
+    return ChronosDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return ChronosClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    final = gen.clients(
+        gen.each_thread(gen.once({"type": "invoke", "f": "read",
+                                  "value": None}))
+    )
+    return {
+        "jobs": {
+            "generator": gen.stagger(10, generator_jobs(opts)),
+            "final-generator": final,
+            "checker": checker(),
+        }
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["jobs"]
+    return common.build_test(
+        "chronos", opts, db=ChronosDB(opts), client=ChronosClient(opts),
+        workload=w,
+    )

@@ -1,0 +1,283 @@
+"""Shared scaffolding for the per-database test suites.
+
+Every reference suite repeats the same skeleton: a ``db/DB`` reification
+that installs a tarball/deb, writes a config, and runs the server under
+``start-daemon!``; a client over the DB's wire protocol; a workload
+table; and a runner merging CLI opts into a test map (e.g.
+consul/src/jepsen/consul/db.clj:23-95, tidb/src/tidb/db.clj,
+doc/tutorial/02-db.md).  This module factors that skeleton once.
+
+Suites provide:
+
+- a :class:`DaemonDB` subclass (install/config/start hooks), and
+- workload builders composed from :mod:`jepsen_tpu.workloads` plus the
+  generic set/counter/sets builders below, and
+- :func:`build_test` merges it all into a runnable test map with the
+  standard nemesis packages.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from .. import checker as checker_mod
+from .. import client as client_mod
+from .. import db as db_mod
+from .. import generator as gen
+from ..checker import timeline
+from ..control import util as cu
+from ..nemesis import combined
+from ..workloads import noop_test
+
+log = logging.getLogger("jepsen_tpu.suites")
+
+
+class DaemonDB(db_mod.DB, db_mod.Process, db_mod.Pause, db_mod.LogFiles):
+    """A DB whose server runs as a single daemon per node.
+
+    Subclasses set ``dir``, ``binary``, ``logfile``, ``pidfile`` and
+    implement :meth:`install` and :meth:`start_args`; the base class
+    wires setup/teardown/start/kill/pause/resume through the control
+    DSL's daemon helpers, exactly as reference suites do with
+    ``cu/start-daemon!``/``stop-daemon!``/``grepkill!``
+    (jepsen/src/jepsen/control/util.clj:286-399).
+    """
+
+    dir: str = "/opt/db"
+    binary: str = "db"
+    logfile: str = "/opt/db/db.log"
+    pidfile: str = "/opt/db/db.pid"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = dict(opts or {})
+
+    # -- hooks ---------------------------------------------------------
+    def install(self, test: dict, node: Any) -> None:
+        """Fetch + unpack the server onto the node."""
+        raise NotImplementedError
+
+    def configure(self, test: dict, node: Any) -> None:
+        """Write config files (optional hook)."""
+
+    def start_args(self, test: dict, node: Any) -> List[Any]:
+        """argv tail after the binary."""
+        return []
+
+    def start_env(self, test: dict, node: Any) -> Dict[str, str]:
+        return {}
+
+    def await_ready(self, test: dict, node: Any) -> None:
+        """Block until the server answers (optional hook)."""
+
+    def wipe(self, test: dict, node: Any) -> None:
+        """Remove data directories on teardown (optional hook)."""
+
+    # -- DB ------------------------------------------------------------
+    def setup(self, test: dict, node: Any) -> None:
+        self.install(test, node)
+        self.configure(test, node)
+        self.start(test, node)
+        self.await_ready(test, node)
+
+    def teardown(self, test: dict, node: Any) -> None:
+        cu.meh(lambda: self.kill(test, node))
+        self.wipe(test, node)
+
+    @property
+    def proc_name(self) -> str:
+        """Process comm name for killall/pkill — the binary's basename
+        (``binary`` may be a path like ``bin/crate``)."""
+        return os.path.basename(self.binary)
+
+    # -- Process -------------------------------------------------------
+    def start(self, test: dict, node: Any) -> None:
+        cu.start_daemon(
+            {
+                "logfile": self.logfile,
+                "pidfile": self.pidfile,
+                "chdir": self.dir,
+                "env": self.start_env(test, node),
+            },
+            f"{self.dir}/{self.binary}",
+            *self.start_args(test, node),
+        )
+
+    def kill(self, test: dict, node: Any) -> None:
+        cu.stop_daemon(pidfile=self.pidfile, cmd=self.proc_name)
+
+    # -- Pause ---------------------------------------------------------
+    def pause(self, test: dict, node: Any) -> None:
+        cu.signal(self.proc_name, "STOP")
+
+    def resume(self, test: dict, node: Any) -> None:
+        cu.signal(self.proc_name, "CONT")
+
+    # -- LogFiles ------------------------------------------------------
+    def log_files(self, test: dict, node: Any) -> Iterable[str]:
+        return [self.logfile]
+
+
+# ---------------------------------------------------------------------
+# Generic workloads shared by many suites
+# ---------------------------------------------------------------------
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    """Unique-element set: clients add distinct integers, then a final
+    read checks for lost/duplicated elements.  The shape every suite's
+    "set"/"sets" workload follows (e.g. elasticsearch/src/jepsen/
+    elasticsearch/sets.clj, yugabyte set.clj, tidb sets.clj).
+    """
+    opts = opts or {}
+    counter = {"n": 0}
+
+    def add(test, ctx):
+        v = counter["n"]
+        counter["n"] += 1
+        return {"type": "invoke", "f": "add", "value": v}
+
+    final = gen.clients(
+        gen.each_thread(gen.once({"type": "invoke", "f": "read", "value": None}))
+    )
+    return {
+        "generator": add,
+        "final-generator": final,
+        "checker": checker_mod.set_full(
+            linearizable=bool(opts.get("linearizable?", False))
+        ),
+    }
+
+
+def counter_workload(opts: Optional[dict] = None) -> dict:
+    """Eventually-consistent counter: increments (and optionally
+    decrements) mixed with reads, verified by the bounds-interval
+    counter checker (reference: checker.clj:737-795; e.g.
+    aerospike/src/aerospike/counter.clj, yugabyte counter.clj)."""
+    opts = opts or {}
+
+    def inc(test, ctx):
+        return {"type": "invoke", "f": "add", "value": 1}
+
+    def dec(test, ctx):
+        return {"type": "invoke", "f": "add", "value": -1}
+
+    def read(test, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    mixed = [inc, read] if not opts.get("decrements?") else [inc, dec, read]
+    return {
+        "generator": gen.mix(mixed),
+        "checker": checker_mod.counter(),
+    }
+
+
+def register_workload(opts: Optional[dict] = None) -> dict:
+    """Per-key linearizable CAS registers (the flagship workload);
+    delegates to workloads.linearizable_register.  Declares the 2n
+    concurrency its per-key thread groups need (reference:
+    linearizable_register.clj:40-43)."""
+    from ..workloads import linearizable_register
+
+    opts = opts or {}
+    w = linearizable_register.test(opts)
+    w["concurrency"] = 2 * len(opts.get("nodes", ["n1"]))
+    return w
+
+
+WORKLOAD_BUILDERS: Dict[str, Callable[[dict], dict]] = {}
+
+
+def generic_workload(name: str, opts: Optional[dict] = None) -> dict:
+    """Look up a workload by name across the generic + core tables."""
+    from .. import workloads as w
+
+    opts = opts or {}
+    table = {
+        "set": set_workload,
+        "counter": counter_workload,
+        "register": register_workload,
+        "linearizable-register": register_workload,
+    }
+    if name in table:
+        return table[name](opts)
+    return w.workload(name, opts)
+
+
+# ---------------------------------------------------------------------
+# Test assembly
+# ---------------------------------------------------------------------
+
+
+def build_test(
+    name: str,
+    opts: Optional[dict],
+    *,
+    db: db_mod.DB,
+    client: client_mod.Client,
+    workload: dict,
+) -> dict:
+    """Merge a suite's db + client + workload (+ standard nemesis
+    packages from opts["faults"]) into a full runnable test map — the
+    per-suite runner every reference suite ends with (e.g.
+    cockroachdb/src/jepsen/cockroach/runner.clj,
+    yugabyte/src/yugabyte/runner.clj).
+
+    opts keys honoured: nodes, time-limit, concurrency, faults (list of
+    fault keywords for nemesis/combined), interval, rate.
+    """
+    opts = dict(opts or {})
+    test = noop_test()
+    test.update(
+        {
+            "name": name,
+            "db": db,
+            "client": client,
+            "store?": opts.get("store?", False),
+        }
+    )
+    if "nodes" in opts:
+        test["nodes"] = list(opts["nodes"])
+    test.update({k: v for k, v in workload.items() if k not in ("generator", "final-generator", "checker")})
+    if "concurrency" in opts:
+        test["concurrency"] = opts["concurrency"]
+
+    checker = workload.get("checker") or checker_mod.unbridled_optimism()
+    test["checker"] = checker_mod.compose(
+        {
+            "workload": checker,
+            "stats": checker_mod.stats(),
+            "exceptions": checker_mod.unhandled_exceptions(),
+        }
+    )
+
+    # Nemesis package from fault spec (reference: nemesis/combined.clj:328)
+    pkg_opts = {
+        "db": db,
+        "faults": opts.get("faults", []),
+        "interval": opts.get("interval", combined.DEFAULT_INTERVAL),
+    }
+    if opts.get("partition-targets"):
+        pkg_opts["partition"] = {"targets": opts["partition-targets"]}
+    pkg = combined.nemesis_package(pkg_opts)
+    test["nemesis"] = pkg.get("nemesis") or test["nemesis"]
+
+    # Generator: rate-staggered client ops raced with the nemesis
+    # schedule, bounded by time-limit, then nemesis final + workload
+    # final reads (reference runner shape: e.g. tidb/src/tidb/run.clj).
+    body = gen.clients(workload.get("generator"))
+    rate = opts.get("rate")
+    if rate:
+        body = gen.stagger(1.0 / rate, body)
+    if pkg.get("generator") is not None:
+        body = gen.any(body, gen.nemesis(pkg["generator"]))
+    body = gen.time_limit(opts.get("time-limit", 60), body)
+
+    parts: List[Any] = [body]
+    if pkg.get("final_generator"):
+        parts.append(gen.nemesis(pkg["final_generator"]))
+    if workload.get("final-generator") is not None:
+        parts.append(workload["final-generator"])
+    test["generator"] = gen.phases(*parts) if len(parts) > 1 else body
+    return test

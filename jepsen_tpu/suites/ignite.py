@@ -1,0 +1,186 @@
+"""Apache Ignite suite.
+
+Reference: ignite/src/jepsen/ignite.clj (+ ignite/{register,bank,
+nemesis,runner}.clj) — install the Apache Ignite binary distribution
+(ignite-url :62-67), generate a Spring XML config whose
+TcpDiscoveryVmIpFinder lists every test node, start ``ignite.sh``, and
+run register/bank workloads (the reference drives the Java thin
+client).  Here the client uses Ignite's REST API
+(``/ignite?cmd=get|put|cas``), which exposes the same atomic cache ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from ..control import util as cu
+from ..control import execute, sudo
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.http import HttpError, JsonHttpClient
+
+VERSION = "2.7.0"
+DIR = "/opt/ignite"
+REST_PORT = 8080
+DISCOVERY_PORT = 47500
+
+CONFIG_PATH = f"{DIR}/config/jepsen.xml"
+
+_CONFIG_TEMPLATE = """<?xml version="1.0" encoding="UTF-8"?>
+<beans xmlns="http://www.springframework.org/schema/beans"
+       xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance"
+       xsi:schemaLocation="http://www.springframework.org/schema/beans
+       http://www.springframework.org/schema/beans/spring-beans.xsd">
+  <bean id="ignite.cfg"
+        class="org.apache.ignite.configuration.IgniteConfiguration">
+    <property name="discoverySpi">
+      <bean class="org.apache.ignite.spi.discovery.tcp.TcpDiscoverySpi">
+        <property name="ipFinder">
+          <bean class="org.apache.ignite.spi.discovery.tcp.ipfinder.vm.TcpDiscoveryVmIpFinder">
+            <property name="addresses">
+              <list>
+{addresses}
+              </list>
+            </property>
+          </bean>
+        </property>
+      </bean>
+    </property>
+  </bean>
+</beans>
+"""
+
+
+class IgniteDB(common.DaemonDB):
+    dir = DIR
+    binary = "bin/ignite.sh"
+    logfile = f"{DIR}/ignite.log"
+    pidfile = f"{DIR}/ignite.pid"
+    proc_name = "java"  # the server runs under the JVM
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", VERSION)
+        self.url = (opts or {}).get(
+            "url",
+            "https://archive.apache.org/dist/ignite/"
+            f"{self.version}/apache-ignite-{self.version}-bin.zip",
+        )
+
+    def install(self, test, node):
+        debian.install(["openjdk-8-jre-headless"])
+        with sudo():
+            cu.install_archive(self.url, DIR)
+
+    def configure(self, test, node):
+        addresses = "\n".join(
+            f"                <value>{n}:{DISCOVERY_PORT}</value>"
+            for n in test["nodes"]
+        )
+        with sudo():
+            cu.write_file(
+                _CONFIG_TEMPLATE.format(addresses=addresses), CONFIG_PATH
+            )
+
+    def start_args(self, test, node):
+        return [CONFIG_PATH]
+
+    def start_env(self, test, node):
+        return {"IGNITE_HOME": DIR}
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(REST_PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/work")
+
+
+class IgniteClient(client_mod.Client):
+    """CAS register over the Ignite REST API: cmd=get/put/cas against
+    an atomic REPLICATED cache (the semantics the reference's register
+    workload gets from cache.get/put/compareAndSet;
+    ignite/register.clj)."""
+
+    CACHE = "jepsen"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", REST_PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def _cmd(self, params: dict):
+        params = {"cacheName": self.CACHE, **params}
+        _, body = self.conn.get("/ignite", params=params, ok=(200,))
+        if isinstance(body, dict):
+            if body.get("successStatus", 0) != 0:
+                raise HttpError(200, body.get("error"))
+            return body.get("response")
+        return body
+
+    def invoke(self, test, op):
+        k, v = op["value"] if isinstance(op["value"], (list, tuple)) else (
+            0, op["value"])
+        try:
+            if op["f"] == "read":
+                raw = self._cmd({"cmd": "get", "key": str(k)})
+                val = int(raw) if raw is not None else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self._cmd({"cmd": "put", "key": str(k), "val": str(v)})
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                ok = self._cmd(
+                    {"cmd": "cas", "key": str(k), "val": str(new),
+                     "val2": str(old)}
+                )
+                if ok in (True, "true"):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return IgniteDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return IgniteClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        "bank": common.generic_workload("bank", opts),
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"ignite-{wname}", opts, db=IgniteDB(opts), client=IgniteClient(opts),
+        workload=w,
+    )

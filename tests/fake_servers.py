@@ -960,3 +960,159 @@ class _IrcHandler(socketserver.StreamRequestHandler):
 
 class FakeIrc(FakeServer):
     handler_class = _IrcHandler
+
+
+# ---------------------------------------------------------------------------
+# HTTP KV (etcd v2 keys API + consul KV + generic JSON endpoints)
+# ---------------------------------------------------------------------------
+
+from http.server import BaseHTTPRequestHandler
+from urllib.parse import parse_qs, urlparse
+
+
+class _HttpKvHandler(BaseHTTPRequestHandler):
+    """Speaks just enough of the etcd v2 keys API and the consul KV API
+    for the suite clients: quorum GETs, prevValue/prevIndex/prevExist
+    CAS (etcd), ?cas= index CAS and base64 values (consul)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, status: int, obj, headers=None) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    # -- etcd v2 --------------------------------------------------------
+    def _etcd_node(self, key, rec) -> dict:
+        return {"key": key, "value": rec[0], "modifiedIndex": rec[1]}
+
+    def _etcd(self, method: str) -> None:
+        st = self.fake_store
+        u = urlparse(self.path)
+        key = u.path[len("/v2/keys"):]
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        form = {k: v[0] for k, v in parse_qs(self._body().decode()).items()}
+        with st.lock:
+            idx = getattr(st, "etcd_index", 0)
+            if method == "GET":
+                rec = st.kv.get(key)
+                if rec is None:
+                    self._send(404, {"errorCode": 100, "cause": key})
+                else:
+                    self._send(200, {"action": "get",
+                                     "node": self._etcd_node(key, rec)})
+                return
+            if method == "PUT":
+                value = form.get("value", "")
+                rec = st.kv.get(key)
+                if form.get("prevExist") == "false" and rec is not None:
+                    self._send(412, {"errorCode": 105, "cause": key})
+                    return
+                if "prevValue" in form:
+                    if rec is None:
+                        self._send(404, {"errorCode": 100, "cause": key})
+                        return
+                    if rec[0] != form["prevValue"]:
+                        self._send(412, {"errorCode": 101, "cause": key})
+                        return
+                if "prevIndex" in form:
+                    if rec is None or rec[1] != int(form["prevIndex"]):
+                        self._send(412, {"errorCode": 101, "cause": key})
+                        return
+                st.etcd_index = idx + 1
+                st.kv[key] = (value, st.etcd_index)
+                self._send(201 if rec is None else 200,
+                           {"action": "set",
+                            "node": self._etcd_node(key, st.kv[key])})
+                return
+            if method == "DELETE":
+                st.kv.pop(key, None)
+                self._send(200, {"action": "delete"})
+                return
+        self._send(405, {"error": "bad method"})
+
+    # -- consul KV ------------------------------------------------------
+    def _consul(self, method: str) -> None:
+        st = self.fake_store
+        u = urlparse(self.path)
+        key = u.path[len("/v1/kv/"):]
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        with st.lock:
+            idx = getattr(st, "etcd_index", 0)
+            rec = st.kv.get("consul/" + key)
+            if method == "GET":
+                if rec is None:
+                    self._send(404, None)
+                    return
+                self._send(
+                    200,
+                    [{
+                        "Key": key,
+                        "Value": base64.b64encode(rec[0].encode()).decode(),
+                        "ModifyIndex": rec[1],
+                        "CreateIndex": rec[1],
+                        "Flags": 0,
+                    }],
+                    headers={"X-Consul-Index": str(rec[1])},
+                )
+                return
+            if method == "PUT":
+                body = self._body().decode()
+                if "cas" in q:
+                    want = int(q["cas"])
+                    have = rec[1] if rec is not None else 0
+                    if want != have:
+                        self._send(200, False)
+                        return
+                st.etcd_index = idx + 1
+                st.kv["consul/" + key] = (body, st.etcd_index)
+                self._send(200, True)
+                return
+            if method == "DELETE":
+                st.kv.pop("consul/" + key, None)
+                self._send(200, True)
+                return
+        self._send(405, None)
+
+    def _route(self, method: str) -> None:
+        try:
+            if self.path.startswith("/v2/keys"):
+                self._etcd(method)
+            elif self.path.startswith("/v1/kv/"):
+                self._consul(method)
+            else:
+                handler = getattr(self.server_ref, "extra_routes", None)
+                if handler and handler(self, method):
+                    return
+                self._send(404, {"error": f"no route {self.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+
+class FakeHttpKv(FakeServer):
+    handler_class = _HttpKvHandler
+    extra_routes = None

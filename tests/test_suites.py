@@ -1,0 +1,147 @@
+"""Suite tests: clients driven against in-process fake servers, plus
+full in-interpreter runs with the fake DB (no SSH, no real database) —
+the reference's in-JVM integration style (core_test.clj:62-120)."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu import db as db_mod
+from fake_servers import FakeHttpKv
+
+
+@pytest.fixture()
+def httpkv():
+    s = FakeHttpKv().start()
+    yield s
+    s.stop()
+
+
+def _open(client, opts, test=None):
+    return client.open(test or {"nodes": ["n1"]}, "n1")
+
+
+# -- etcd -------------------------------------------------------------
+
+
+def test_etcd_register_ops(httpkv):
+    from jepsen_tpu.suites import etcd
+
+    c = _open(etcd.EtcdClient({"host": "127.0.0.1", "port": httpkv.port}), {})
+    r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+    assert r["type"] == "ok" and tuple(r["value"]) == (0, None)
+
+    w = c.invoke({}, {"f": "write", "value": [0, 3], "type": "invoke"})
+    assert w["type"] == "ok"
+    r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+    assert tuple(r["value"]) == (0, 3)
+
+    ok = c.invoke({}, {"f": "cas", "value": [0, [3, 4]], "type": "invoke"})
+    assert ok["type"] == "ok"
+    bad = c.invoke({}, {"f": "cas", "value": [0, [3, 5]], "type": "invoke"})
+    assert bad["type"] == "fail"
+    r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+    assert tuple(r["value"]) == (0, 4)
+    c.close({})
+
+
+def test_etcd_set_adds(httpkv):
+    from jepsen_tpu.suites import etcd
+
+    opts = {"host": "127.0.0.1", "port": httpkv.port}
+    c = _open(etcd._SetReadClient(opts), {})
+    for i in range(5):
+        assert c.invoke({}, {"f": "add", "value": i, "type": "invoke"})[
+            "type"] == "ok"
+    r = c.invoke({}, {"f": "read", "value": None, "type": "invoke"})
+    assert r["type"] == "ok" and sorted(r["value"]) == [0, 1, 2, 3, 4]
+    c.close({})
+
+
+def test_etcd_full_test_in_process(httpkv):
+    """Full lifecycle: generator → interpreter → history → checker, with
+    the real etcd client talking to the fake server."""
+    from jepsen_tpu.suites import etcd
+
+    t = etcd.test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1",
+            "port": httpkv.port,
+            "time-limit": 2,
+            "rate": 50,
+            "workload": "register",
+            "faults": [],
+        }
+    )
+    t["db"] = db_mod.noop()  # no real node to install onto
+    t["ssh"] = {"dummy?": True}
+    result = core.run(t)
+    assert result["history"], "expected a non-empty history"
+    assert result["results"]["valid?"] in (True, "unknown")
+    oks = [op for op in result["history"] if op["type"] == "ok"]
+    assert oks, "expected some ok completions through the fake server"
+
+
+def test_etcd_set_full_test_in_process(httpkv):
+    from jepsen_tpu.suites import etcd
+
+    t = etcd.test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1",
+            "port": httpkv.port,
+            "time-limit": 2,
+            "rate": 50,
+            "workload": "set",
+            "faults": [],
+        }
+    )
+    t["db"] = db_mod.noop()
+    t["ssh"] = {"dummy?": True}
+    result = core.run(t)
+    assert result["results"]["valid?"] is True, result["results"]
+
+
+# -- consul -----------------------------------------------------------
+
+
+def test_consul_register_ops(httpkv):
+    from jepsen_tpu.suites import consul
+
+    c = _open(consul.ConsulClient({"host": "127.0.0.1", "port": httpkv.port}), {})
+    r = c.invoke({}, {"f": "read", "value": [1, None], "type": "invoke"})
+    assert r["type"] == "ok" and tuple(r["value"]) == (1, None)
+    assert c.invoke({}, {"f": "write", "value": [1, 7], "type": "invoke"})[
+        "type"] == "ok"
+    assert c.invoke({}, {"f": "read", "value": [1, None], "type": "invoke"})[
+        "value"] == (1, 7)
+    assert c.invoke({}, {"f": "cas", "value": [1, [7, 8]], "type": "invoke"})[
+        "type"] == "ok"
+    assert c.invoke({}, {"f": "cas", "value": [1, [7, 9]], "type": "invoke"})[
+        "type"] == "fail"
+    assert c.invoke({}, {"f": "read", "value": [1, None], "type": "invoke"})[
+        "value"] == (1, 8)
+    c.close({})
+
+
+# -- assembly smoke test over every implemented suite ------------------
+
+
+def test_all_suites_assemble():
+    from jepsen_tpu import suites
+
+    missing = []
+    for name in suites.SUITES:
+        try:
+            mod = suites.suite(name)
+        except (ImportError, ModuleNotFoundError):
+            missing.append(name)
+            continue
+        t = mod.test({"nodes": ["n1", "n2", "n3"],
+                      "faults": ["partition", "kill"]})
+        for key in ("db", "client", "generator", "checker", "nemesis"):
+            assert key in t, f"{name} missing {key}"
+    if missing:
+        pytest.xfail(f"suites not yet implemented: {missing}")
