@@ -1,0 +1,104 @@
+"""CockroachDB suite.
+
+Reference: cockroachdb/src/jepsen/cockroach.clj + cockroach/{auto,
+client,register,bank,sets,monotonic,sequential,adya,comments,nemesis,
+runner}.clj — install a cockroach tarball to /opt/cockroach
+(auto.clj:143-150), start ``cockroach start --insecure --join=…`` on
+every node (auto.clj:49-77), and run register/bank/sets/monotonic/g2
+workloads over JDBC with retry handling (client.clj).  Clients here
+ride the pgwire protocol (cockroach speaks it natively) via
+:mod:`.sql`, dialect ``cockroach`` (UPSERT, 40001 retry errors).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..control import util as cu
+from ..control import execute, sudo
+from . import common, sql
+
+DIR = "/opt/cockroach"  # (reference: auto.clj:33)
+PORT = 26257
+HTTP_PORT = 8080
+DEFAULT_TARBALL = (
+    "https://binaries.cockroachdb.com/cockroach-v2.1.7.linux-amd64.tgz"
+)
+
+
+class CockroachDB(common.DaemonDB):
+    dir = DIR
+    binary = "cockroach"
+    logfile = f"{DIR}/logs/cockroach.stderr"
+    pidfile = f"{DIR}/cockroach.pid"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.tarball = (opts or {}).get("tarball", DEFAULT_TARBALL)
+
+    def install(self, test, node):
+        with sudo():
+            cu.install_archive(self.tarball, DIR)
+            execute("mkdir", "-p", f"{DIR}/logs")
+
+    def start_args(self, test, node):
+        # (reference: auto.clj:49-77 start!/join flags)
+        join = ",".join(f"{n}:{PORT}" for n in test["nodes"])
+        return [
+            "start", "--insecure",
+            "--store", f"path={DIR}/data",
+            "--listen-addr", f"0.0.0.0:{PORT}",
+            "--advertise-addr", f"{node}:{PORT}",
+            "--http-addr", f"0.0.0.0:{HTTP_PORT}",
+            "--join", join,
+            "--background",
+        ]
+
+    def setup(self, test, node):
+        super().setup(test, node)
+        if node == test["nodes"][0]:
+            # first node bootstraps the cluster
+            execute(f"{DIR}/cockroach", "init", "--insecure",
+                    "--host", f"{node}:{PORT}", check=False)
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/data", f"{DIR}/logs")
+
+
+def _opts(opts: Optional[dict]) -> dict:
+    o = dict(opts or {})
+    o.setdefault("dialect", "cockroach")
+    o.setdefault("port", PORT)
+    o.setdefault("user", "root")
+    o.setdefault("database", "defaultdb")
+    return o
+
+
+def db(opts: Optional[dict] = None):
+    return CockroachDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return sql.RegisterClient(_opts(opts))
+
+
+WORKLOADS = ("register", "bank", "set", "list-append")
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = _opts(opts)
+    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = _opts(opts)
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"cockroachdb-{wname}", opts, db=CockroachDB(opts),
+        client=sql.client_for(wname, opts), workload=w,
+    )

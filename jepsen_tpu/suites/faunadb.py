@@ -202,12 +202,9 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    opts = dict(opts or {})
-    return {
-        "register": common.register_workload(opts),
-        "bank": common.generic_workload("bank", opts),
-        "set": common.set_workload(opts),
-    }
+    # bank/set/pages/monotonic need FQL pagination + index queries the
+    # wire client doesn't model yet; the register workload is complete
+    return {"register": common.register_workload(dict(opts or {}))}
 
 
 def test(opts: Optional[dict] = None) -> dict:

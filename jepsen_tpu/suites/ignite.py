@@ -142,8 +142,9 @@ class IgniteClient(client_mod.Client):
                 return {**op, "type": "ok"}
             if op["f"] == "cas":
                 old, new = v
+                # REST cas: put val1 if current value == val2
                 ok = self._cmd(
-                    {"cmd": "cas", "key": str(k), "val": str(new),
+                    {"cmd": "cas", "key": str(k), "val1": str(new),
                      "val2": str(old)}
                 )
                 if ok in (True, "true"):
@@ -169,11 +170,9 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    opts = dict(opts or {})
-    return {
-        "register": common.register_workload(opts),
-        "bank": common.generic_workload("bank", opts),
-    }
+    # the reference's bank workload runs over Ignite transactions,
+    # which the REST API doesn't expose; register covers the CAS path
+    return {"register": common.register_workload(dict(opts or {}))}
 
 
 def test(opts: Optional[dict] = None) -> dict:

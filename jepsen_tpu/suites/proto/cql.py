@@ -42,8 +42,29 @@ class CqlError(ProtocolError):
 class CqlResult:
     def __init__(self):
         self.columns: List[str] = []
+        self.col_types: List[int] = []  # CQL option ids per column
         self.rows: List[List[Optional[bytes]]] = []
         self.kind: str = "void"
+
+    def cell_int(self, row: List[Optional[bytes]], i: int) -> Optional[int]:
+        """Decode column i of a row as an integer, honouring the
+        column's wire type (fixed-width ints vs text)."""
+        cell = row[i]
+        if cell is None:
+            return None
+        t = self.col_types[i] if i < len(self.col_types) else 0x000D
+        if t in (0x0002, 0x0009, 0x0013, 0x0014):  # bigint/int/small/tiny
+            return int.from_bytes(cell, "big", signed=True)
+        return int(cell.decode())
+
+    def cell_bool(self, row: List[Optional[bytes]], i: int) -> Optional[bool]:
+        cell = row[i]
+        if cell is None:
+            return None
+        t = self.col_types[i] if i < len(self.col_types) else 0x000D
+        if t == 0x0004:  # boolean
+            return cell != b"\x00"
+        return cell.decode().lower() in ("true", "1")
 
 
 class CqlClient:
@@ -161,6 +182,7 @@ class CqlClient:
             off += 2 + n
             (t,) = struct.unpack("!H", payload[off : off + 2])
             off += 2
+            res.col_types.append(t)
             if t == 0x0000:  # custom: string class name
                 (n,) = struct.unpack("!H", payload[off : off + 2])
                 off += 2 + n

@@ -20,6 +20,7 @@ from typing import Any, List, Optional, Tuple
 from . import IndeterminateError, ProtocolError
 
 CLIENT_LONG_PASSWORD = 0x1
+CLIENT_FOUND_ROWS = 0x2  # affected_rows counts matched, not changed, rows
 CLIENT_PROTOCOL_41 = 0x200
 CLIENT_TRANSACTIONS = 0x2000
 CLIENT_SECURE_CONNECTION = 0x8000
@@ -147,8 +148,13 @@ class MysqlClient:
         scramble += greeting[off : off + max(13, auth_len - 8)].rstrip(b"\0")
         scramble = scramble[:20]
 
+        # FOUND_ROWS makes `UPDATE … WHERE val = old` report matched
+        # rows, so a CAS to the same value still counts as applied —
+        # without it the SQL register clients would report false
+        # linearizability violations when old == new.
         caps = (
             CLIENT_LONG_PASSWORD
+            | CLIENT_FOUND_ROWS
             | CLIENT_PROTOCOL_41
             | CLIENT_TRANSACTIONS
             | CLIENT_SECURE_CONNECTION

@@ -1,0 +1,147 @@
+"""Stolon (HA PostgreSQL) suite.
+
+Reference: stolon/src/jepsen/stolon/{db,client,append,set,bank}.clj —
+install PostgreSQL from the pgdg apt repo (db.clj:44-60) plus the
+stolon release tarball; each node runs a ``stolon-keeper`` (manages the
+local postgres), a ``stolon-sentinel`` (leader election via the store),
+and a ``stolon-proxy`` (routes clients to the master, port 25432);
+cluster state lives in an etcd/consul store (db.clj:27-43,62-150).
+Clients speak pgwire through the proxy via :mod:`.sql` (dialect
+``pg``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..control import util as cu
+from ..control import execute, sudo
+from ..os_setup import debian
+from . import common, sql
+
+DIR = "/opt/stolon"
+PROXY_PORT = 25432
+CLUSTER = "jepsen"
+STORE_PORT = 2379  # etcd store endpoints (reference: db.clj:62-70)
+DEFAULT_TARBALL = (
+    "https://github.com/sorintlab/stolon/releases/download/v0.16.0/"
+    "stolon-v0.16.0-linux-amd64.tar.gz"
+)
+
+
+class StolonDB(common.DaemonDB):
+    dir = DIR
+    binary = "bin/stolon-keeper"
+    logfile = f"{DIR}/keeper.log"    # (reference: db.clj:31-33)
+    pidfile = f"{DIR}/keeper.pid"
+    sentinel_logfile = f"{DIR}/sentinel.log"
+    sentinel_pidfile = f"{DIR}/sentinel.pid"
+    proxy_logfile = f"{DIR}/proxy.log"
+    proxy_pidfile = f"{DIR}/proxy.pid"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.tarball = (opts or {}).get("tarball", DEFAULT_TARBALL)
+
+    def install(self, test, node):
+        # (reference: db.clj:44-60 install-pg! — pgdg apt repo)
+        debian.install(["postgresql-12", "postgresql-client-12"])
+        with sudo():
+            execute("systemctl", "stop", "postgresql", check=False)
+            cu.install_archive(self.tarball, DIR)
+
+    def _store_endpoints(self, test) -> str:
+        return ",".join(
+            f"http://{n}:{STORE_PORT}" for n in test["nodes"]
+        )
+
+    def start(self, test, node):
+        store = [
+            "--store-backend", "etcdv3",
+            "--store-endpoints", self._store_endpoints(test),
+        ]
+        if node == test["nodes"][0]:
+            execute(
+                f"{DIR}/bin/stolonctl", "init", "--cluster-name", CLUSTER,
+                *store, "-y", check=False,
+            )
+        cu.start_daemon(
+            {"logfile": self.sentinel_logfile,
+             "pidfile": self.sentinel_pidfile, "chdir": DIR},
+            f"{DIR}/bin/stolon-sentinel",
+            "--cluster-name", CLUSTER, *store,
+        )
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
+            f"{DIR}/bin/stolon-keeper",
+            "--cluster-name", CLUSTER, *store,
+            "--uid", f"keeper{test['nodes'].index(node)}",
+            "--data-dir", f"{DIR}/data",
+            "--pg-listen-address", str(node),
+            "--pg-su-password", "pw",
+            "--pg-repl-username", "repl",
+            "--pg-repl-password", "pw",
+            "--pg-bin-path", "/usr/lib/postgresql/12/bin",
+        )
+        cu.start_daemon(
+            {"logfile": self.proxy_logfile, "pidfile": self.proxy_pidfile,
+             "chdir": DIR},
+            f"{DIR}/bin/stolon-proxy",
+            "--cluster-name", CLUSTER, *store,
+            "--listen-address", "0.0.0.0",
+            "--port", str(PROXY_PORT),
+        )
+
+    def kill(self, test, node):
+        for pidfile, name in [
+            (self.proxy_pidfile, "stolon-proxy"),
+            (self.pidfile, "stolon-keeper"),
+            (self.sentinel_pidfile, "stolon-sentinel"),
+        ]:
+            cu.stop_daemon(pidfile=pidfile, cmd=name)
+        cu.grepkill("postgres")
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PROXY_PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/data")
+
+    def log_files(self, test, node):
+        return [self.logfile, self.sentinel_logfile, self.proxy_logfile]
+
+
+def _opts(opts: Optional[dict]) -> dict:
+    o = dict(opts or {})
+    o.setdefault("dialect", "pg")
+    o.setdefault("port", PROXY_PORT)
+    o.setdefault("user", "postgres")
+    o.setdefault("password", "pw")
+    return o
+
+
+def db(opts: Optional[dict] = None):
+    return StolonDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return sql.RegisterClient(_opts(opts))
+
+
+WORKLOADS = ("register", "bank", "set", "list-append")
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = _opts(opts)
+    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = _opts(opts)
+    wname = opts.get("workload", "list-append")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"stolon-{wname}", opts, db=StolonDB(opts),
+        client=sql.client_for(wname, opts), workload=w,
+    )

@@ -1,0 +1,149 @@
+"""TiDB suite.
+
+Reference: tidb/src/tidb/{db,sql,core,bank,register,sets,txn,long_fork,
+monotonic,sequential,table}.clj — each node runs all three components:
+``pd-server`` (placement driver, peer port 2380 / client 2379),
+``tikv-server`` (port 20160), and ``tidb-server`` (MySQL protocol, port
+4000), installed from a tarball and started in dependency order with
+config files written per node (db.clj:19-170).  Clients speak the MySQL
+protocol via :mod:`.sql` (dialect ``mysql``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import db as db_mod
+from ..control import util as cu
+from ..control import execute, sudo
+from . import common, sql
+
+DIR = "/opt/tidb"          # (reference: db.clj tidb-dir)
+PD_PEER_PORT = 2380
+PD_CLIENT_PORT = 2379
+KV_PORT = 20160
+DB_PORT = 4000
+DEFAULT_TARBALL = (
+    "https://download.pingcap.org/tidb-v3.0.0-linux-amd64.tar.gz"
+)
+
+
+class TiDB(common.DaemonDB):
+    """pd → tikv → tidb on every node (reference: db.clj:180-260)."""
+
+    dir = DIR
+    binary = "bin/tidb-server"
+    logfile = f"{DIR}/tidb.log"
+    pidfile = f"{DIR}/tidb.pid"
+
+    pd_logfile = f"{DIR}/pd.log"      # (reference: db.clj:30-33)
+    pd_pidfile = f"{DIR}/pd.pid"
+    kv_logfile = f"{DIR}/tikv.log"
+    kv_pidfile = f"{DIR}/tikv.pid"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.tarball = (opts or {}).get("tarball", DEFAULT_TARBALL)
+
+    def install(self, test, node):
+        with sudo():
+            cu.install_archive(self.tarball, DIR)
+
+    def _pd_name(self, test, node) -> str:
+        return f"pd{test['nodes'].index(node) + 1}"  # (reference: db.clj:53)
+
+    def start(self, test, node):
+        nodes = test["nodes"]
+        initial = ",".join(
+            f"{self._pd_name(test, n)}=http://{n}:{PD_PEER_PORT}"
+            for n in nodes
+        )
+        pd_endpoints = ",".join(f"{n}:{PD_CLIENT_PORT}" for n in nodes)
+        cu.start_daemon(
+            {"logfile": self.pd_logfile, "pidfile": self.pd_pidfile,
+             "chdir": DIR},
+            f"{DIR}/bin/pd-server",
+            "--name", self._pd_name(test, node),
+            "--data-dir", f"{DIR}/data/pd",
+            "--client-urls", f"http://0.0.0.0:{PD_CLIENT_PORT}",
+            "--advertise-client-urls", f"http://{node}:{PD_CLIENT_PORT}",
+            "--peer-urls", f"http://0.0.0.0:{PD_PEER_PORT}",
+            "--advertise-peer-urls", f"http://{node}:{PD_PEER_PORT}",
+            "--initial-cluster", initial,
+            "--log-file", f"{DIR}/pd.app.log",
+        )
+        cu.await_tcp_port(PD_CLIENT_PORT, timeout_s=120)
+        cu.start_daemon(
+            {"logfile": self.kv_logfile, "pidfile": self.kv_pidfile,
+             "chdir": DIR},
+            f"{DIR}/bin/tikv-server",
+            "--pd", pd_endpoints,
+            "--addr", f"0.0.0.0:{KV_PORT}",
+            "--advertise-addr", f"{node}:{KV_PORT}",
+            "--data-dir", f"{DIR}/data/tikv",
+            "--log-file", f"{DIR}/tikv.app.log",
+        )
+        cu.await_tcp_port(KV_PORT, timeout_s=120)
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
+            f"{DIR}/bin/tidb-server",
+            "--store", "tikv",
+            "--path", pd_endpoints,
+            "-P", str(DB_PORT),
+            "--log-file", f"{DIR}/tidb.app.log",
+        )
+
+    def kill(self, test, node):
+        for pidfile, name in [
+            (self.pidfile, "tidb-server"),
+            (self.kv_pidfile, "tikv-server"),
+            (self.pd_pidfile, "pd-server"),
+        ]:
+            cu.stop_daemon(pidfile=pidfile, cmd=name)
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(DB_PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/data")
+
+    def log_files(self, test, node):
+        return [self.logfile, self.kv_logfile, self.pd_logfile]
+
+
+def _opts(opts: Optional[dict]) -> dict:
+    o = dict(opts or {})
+    o.setdefault("dialect", "mysql")
+    o.setdefault("port", DB_PORT)
+    o.setdefault("user", "root")
+    o.setdefault("database", "test")
+    return o
+
+
+def db(opts: Optional[dict] = None):
+    return TiDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return sql.RegisterClient(_opts(opts))
+
+
+WORKLOADS = ("register", "bank", "set", "list-append", "long-fork")
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = _opts(opts)
+    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = _opts(opts)
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"tidb-{wname}", opts, db=TiDB(opts),
+        client=sql.client_for(
+            wname if wname in sql.CLIENTS else "register", opts),
+        workload=w,
+    )

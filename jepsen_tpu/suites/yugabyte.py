@@ -1,0 +1,341 @@
+"""YugabyteDB suite.
+
+Reference: yugabyte/src/yugabyte/* — the largest reference suite
+(~3.6k LoC): a tarball install with ``yb-master`` processes on the
+first ``replication-factor`` nodes and ``yb-tserver`` everywhere
+(auto.clj:49-140), and two API families for every workload:
+
+- **YCQL** (Cassandra protocol, port 9042): bank, counter, set,
+  single/multi-key-acid, long-fork (yugabyte/ycql/*.clj)
+- **YSQL** (PostgreSQL protocol, port 5433): bank, append, long-fork,
+  default-value (yugabyte/ysql/*.clj)
+
+YCQL clients here ride :mod:`.proto.cql` (LWT ``IF`` conditions give
+CAS); YSQL clients reuse the shared :mod:`.sql` pgwire clients.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from ..control import util as cu
+from ..control import execute, sudo
+from . import common, sql
+from .proto import IndeterminateError
+from .proto.cql import CqlClient, CqlError
+
+DIR = "/opt/yugabyte"  # (reference: auto.clj dir)
+MASTER_RPC_PORT = 7100
+TSERVER_RPC_PORT = 9100
+YCQL_PORT = 9042
+YSQL_PORT = 5433
+DEFAULT_TARBALL = (
+    "https://downloads.yugabyte.com/yugabyte-2.1.2.0-linux.tar.gz"
+)
+KEYSPACE = "jepsen"
+
+
+class YugabyteDB(common.DaemonDB):
+    """yb-master on the first RF nodes, yb-tserver everywhere.
+    (reference: auto.clj:57-76 master-nodes, 90-140 start!)"""
+
+    dir = DIR
+    binary = "bin/yb-tserver"
+    logfile = f"{DIR}/tserver.log"
+    pidfile = f"{DIR}/tserver.pid"
+    master_logfile = f"{DIR}/master.log"
+    master_pidfile = f"{DIR}/master.pid"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.tarball = (opts or {}).get("tarball", DEFAULT_TARBALL)
+        self.rf = (opts or {}).get("replication-factor", 3)
+
+    def master_nodes(self, test):
+        return test["nodes"][: min(self.rf, len(test["nodes"]))]
+
+    def master_addresses(self, test) -> str:
+        return ",".join(
+            f"{n}:{MASTER_RPC_PORT}" for n in self.master_nodes(test)
+        )
+
+    def install(self, test, node):
+        with sudo():
+            cu.install_archive(self.tarball, DIR)
+            execute(f"{DIR}/bin/post_install.sh", check=False)
+
+    def start(self, test, node):
+        masters = self.master_addresses(test)
+        if node in self.master_nodes(test):
+            cu.start_daemon(
+                {"logfile": self.master_logfile,
+                 "pidfile": self.master_pidfile, "chdir": DIR},
+                f"{DIR}/bin/yb-master",
+                "--master_addresses", masters,
+                "--rpc_bind_addresses", f"{node}:{MASTER_RPC_PORT}",
+                "--fs_data_dirs", f"{DIR}/data/master",
+                "--replication_factor", str(self.rf),
+            )
+            cu.await_tcp_port(MASTER_RPC_PORT, timeout_s=120)
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
+            f"{DIR}/bin/yb-tserver",
+            "--tserver_master_addrs", masters,
+            "--rpc_bind_addresses", f"{node}:{TSERVER_RPC_PORT}",
+            "--fs_data_dirs", f"{DIR}/data/tserver",
+            "--start_pgsql_proxy",
+            "--pgsql_proxy_bind_address", f"0.0.0.0:{YSQL_PORT}",
+            "--cql_proxy_bind_address", f"0.0.0.0:{YCQL_PORT}",
+        )
+
+    def kill(self, test, node):
+        cu.stop_daemon(pidfile=self.pidfile, cmd="yb-tserver")
+        cu.stop_daemon(pidfile=self.master_pidfile, cmd="yb-master")
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(YCQL_PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with sudo():
+            execute("rm", "-rf", f"{DIR}/data")
+
+    def log_files(self, test, node):
+        return [self.logfile, self.master_logfile]
+
+
+# ---------------------------------------------------------------------
+# YCQL clients (reference: yugabyte/ycql/*.clj)
+# ---------------------------------------------------------------------
+
+
+class YcqlRegisterClient(client_mod.Client):
+    """Per-key CAS registers with LWT: writes unconditional, CAS via
+    ``IF val = old`` (reference: ycql/single_key_acid.clj)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[CqlClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = CqlClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", YCQL_PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def setup(self, test):
+        for stmt in (
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+            f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.registers "
+            "(id int PRIMARY KEY, val int)",
+        ):
+            try:
+                self.conn.query(stmt)
+            except (CqlError, IndeterminateError):
+                pass
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        t = f"{KEYSPACE}.registers"
+        try:
+            if op["f"] == "read":
+                res = self.conn.query(
+                    f"SELECT val FROM {t} WHERE id = {int(k)}",
+                    consistency="quorum",
+                )
+                val = res.cell_int(res.rows[0], 0) if res.rows else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.conn.query(
+                    f"INSERT INTO {t} (id, val) VALUES ({int(k)}, {int(v)})"
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                res = self.conn.query(
+                    f"UPDATE {t} SET val = {int(new)} WHERE id = {int(k)} "
+                    f"IF val = {int(old)}"
+                )
+                applied = bool(res.rows) and res.cell_bool(res.rows[0], 0)
+                if applied:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except CqlError as e:
+            if e.timeout:
+                return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+class YcqlSetClient(client_mod.Client):
+    """Set workload: one row per element (reference: ycql/set.clj)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[CqlClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = CqlClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", YCQL_PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def setup(self, test):
+        for stmt in (
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+            f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.elements "
+            "(val int PRIMARY KEY)",
+        ):
+            try:
+                self.conn.query(stmt)
+            except (CqlError, IndeterminateError):
+                pass
+
+    def invoke(self, test, op):
+        t = f"{KEYSPACE}.elements"
+        try:
+            if op["f"] == "add":
+                self.conn.query(
+                    f"INSERT INTO {t} (val) VALUES ({int(op['value'])})"
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                res = self.conn.query(f"SELECT val FROM {t}",
+                                      consistency="quorum")
+                return {**op, "type": "ok",
+                        "value": sorted(res.cell_int(r, 0) for r in res.rows)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except CqlError as e:
+            if e.timeout:
+                return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+# ---------------------------------------------------------------------
+
+
+def _ysql_opts(opts: Optional[dict]) -> dict:
+    o = dict(opts or {})
+    o.setdefault("dialect", "pg")
+    o.setdefault("port", YSQL_PORT)
+    o.setdefault("user", "postgres")
+    return o
+
+
+def db(opts: Optional[dict] = None):
+    return YugabyteDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return YcqlRegisterClient(opts)
+
+
+class YcqlCounterClient(client_mod.Client):
+    """Counter column increments (reference: ycql/counter.clj)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[CqlClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = CqlClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", YCQL_PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def setup(self, test):
+        for stmt in (
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+            f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.counters "
+            "(id int PRIMARY KEY, val counter)",
+        ):
+            try:
+                self.conn.query(stmt)
+            except (CqlError, IndeterminateError):
+                pass
+
+    def invoke(self, test, op):
+        t = f"{KEYSPACE}.counters"
+        try:
+            if op["f"] == "add":
+                self.conn.query(
+                    f"UPDATE {t} SET val = val + {int(op['value'])} "
+                    "WHERE id = 0"
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                res = self.conn.query(
+                    f"SELECT val FROM {t} WHERE id = 0",
+                    consistency="quorum",
+                )
+                val = res.cell_int(res.rows[0], 0) if res.rows else 0
+                return {**op, "type": "ok", "value": val or 0}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except CqlError as e:
+            if e.timeout:
+                return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    """ycql.* and ysql.* workload names, like the reference's
+    workload-per-API naming (runner.clj)."""
+    opts = dict(opts or {})
+    out = {}
+    for w in ("register", "set", "counter"):
+        out[f"ycql.{w}"] = common.generic_workload(w, opts)
+    for w in ("register", "bank", "set", "list-append", "long-fork"):
+        out[f"ysql.{w}"] = common.generic_workload(w, _ysql_opts(opts))
+    return out
+
+
+_YCQL_CLIENTS = {
+    "register": YcqlRegisterClient,
+    "set": YcqlSetClient,
+    "counter": YcqlCounterClient,
+}
+
+
+def _client_for(wname: str, opts: dict) -> client_mod.Client:
+    api, _, w = wname.partition(".")
+    if api == "ycql":
+        return _YCQL_CLIENTS[w](opts)
+    return sql.client_for(w, _ysql_opts(opts))
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    wname = opts.get("workload", "ycql.register")
+    w = workloads(opts)[wname]
+    return common.build_test(
+        f"yugabyte-{wname}", opts, db=YugabyteDB(opts),
+        client=_client_for(wname, opts), workload=w,
+    )

@@ -359,12 +359,38 @@ class _PgHandler(socketserver.BaseRequestHandler):
                     kv[key] = v
                 self._send(b"C", b"UPDATE %d\0" % n)
             elif low in ("begin", "commit", "rollback") or low.startswith(
-                ("create", "drop", "set ")
+                ("drop", "set ")
             ):
+                try:
+                    self._backend().execute(s)
+                except SqlBackendError:
+                    pass
                 self._send(b"C", s.split()[0].upper().encode() + b"\0")
             else:
-                self._error("42601", f"syntax error in fake pg: {s!r}")
+                self._backend_query(s)
         self._ready()
+
+    def _backend(self):
+        if not hasattr(self, "_sql_be"):
+            self._sql_be = _SqlBackend(self.fake_store)
+        return self._sql_be
+
+    def _backend_query(self, s: str):
+        try:
+            cols, rows, affected = self._backend().execute(s)
+        except SqlBackendError as e:
+            code = {"conflict": "40001", "duplicate": "23505"}.get(
+                e.kind, "42601")
+            self._error(code, str(e))
+            return
+        if cols:
+            self._rows(cols, [[None if v is None else str(v) for v in r]
+                              for r in rows])
+        else:
+            verb = s.split()[0].upper()
+            tag = (f"INSERT 0 {affected}" if verb == "INSERT"
+                   else f"{verb} {affected}")
+            self._send(b"C", tag.encode() + b"\0")
 
 
 class FakePg(FakeServer):
@@ -535,10 +561,36 @@ class _MysqlHandler(socketserver.BaseRequestHandler):
                     self._ok(affected=1)
                 else:
                     self._ok(affected=0)
-            elif low.startswith(("begin", "commit", "rollback", "create", "drop", "set ", "use ")):
+            elif low.startswith(("begin", "commit", "rollback", "drop",
+                                 "set ", "use ")):
+                try:
+                    self._backend().execute(s)
+                except SqlBackendError:
+                    pass
                 self._ok()
             else:
-                self._err(1064, f"You have an error in your SQL syntax: {s!r}")
+                self._backend_query(s)
+
+
+    def _backend(self):
+        if not hasattr(self, "_sql_be"):
+            self._sql_be = _SqlBackend(self.fake_store)
+        return self._sql_be
+
+    def _backend_query(self, s: str):
+        try:
+            cols, rows, affected = self._backend().execute(s)
+        except SqlBackendError as e:
+            code = {"conflict": 1213, "duplicate": 1062}.get(e.kind, 1064)
+            self._err(code, str(e))
+            return
+        if cols:
+            self._resultset(
+                cols,
+                [[None if v is None else str(v) for v in r] for r in rows],
+            )
+        else:
+            self._ok(affected=min(affected, 250))
 
 
 class FakeMysql(FakeServer):
@@ -890,6 +942,44 @@ class _CqlHandler(socketserver.BaseRequestHandler):
             self._send(stream, 0x08, struct.pack("!I", 1))
         elif low.startswith(("create", "drop", "use ", "truncate")):
             self._send(stream, 0x08, struct.pack("!I", 1))
+        # yugabyte-style int tables: <ks>.registers (id, val) and
+        # <ks>.elements (val) with LWT "IF val ="
+        elif _re.match(r"select val from \S+\.registers where id\s*=", low):
+            key = "reg:" + s.split("=", 1)[1].strip()
+            v = kv.get(key)
+            self._rows(stream, ["val"], [[v]] if v is not None else [])
+        elif _re.match(r"insert into \S+\.registers", low):
+            inner = s[s.index("(", s.lower().index("values")) + 1:
+                      s.rindex(")")]
+            k, v = [x.strip() for x in inner.split(",", 1)]
+            kv["reg:" + k] = v
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        elif _re.match(r"update \S+\.registers set val\s*=", low):
+            m = _re.match(
+                r"update \S+\.registers set val\s*=\s*(\S+)\s+where\s+id\s*="
+                r"\s*(\S+)(?:\s+if\s+val\s*=\s*(\S+))?",
+                low,
+            )
+            new, k, cond = m.group(1), m.group(2), m.group(3)
+            if cond is not None:
+                if kv.get("reg:" + k) == cond:
+                    kv["reg:" + k] = new
+                    self._rows(stream, ["[applied]"], [["true"]])
+                else:
+                    self._rows(stream, ["[applied]"], [["false"]])
+            else:
+                kv["reg:" + k] = new
+                self._send(stream, 0x08, struct.pack("!I", 1))
+        elif _re.match(r"insert into \S+\.elements", low):
+            inner = s[s.index("(", s.lower().index("values")) + 1:
+                      s.rindex(")")]
+            kv["elem:" + inner.strip()] = "1"
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        elif _re.match(r"select val from \S+\.elements", low):
+            vals = sorted(
+                int(k[5:]) for k in kv if k.startswith("elem:")
+            )
+            self._rows(stream, ["val"], [[str(v)] for v in vals])
         else:
             self._error(stream, 0x2000, f"Invalid CQL: {s!r}")
 
@@ -1116,3 +1206,118 @@ class _HttpKvHandler(BaseHTTPRequestHandler):
 class FakeHttpKv(FakeServer):
     handler_class = _HttpKvHandler
     extra_routes = None
+
+
+# ---------------------------------------------------------------------------
+# Generic SQL backend for the pg/mysql fakes: an in-memory shared-cache
+# sqlite database per store, so the suite SQL clients (registers, bank
+# accounts, sets, list-append) exercise real DDL/DML + transactions.
+# Concurrent write conflicts surface as lock errors, which the handlers
+# map to serialization-failure codes (pg 40001 / mysql 1213) — the same
+# clean-abort semantics real engines give the reference's clients.
+# ---------------------------------------------------------------------------
+
+import itertools as _it
+import sqlite3
+
+_sql_db_ids = _it.count()
+_sql_setup_lock = threading.Lock()  # NOT store.lock: callers may hold it
+
+
+class SqlBackendError(Exception):
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind  # "conflict" | "duplicate" | "syntax"
+
+
+class _SqlBackend:
+    """One per TCP connection; all connections share the store's DB."""
+
+    def __init__(self, store):
+        with _sql_setup_lock:
+            if not hasattr(store, "sql_uri"):
+                store.sql_uri = (
+                    f"file:fakesql{next(_sql_db_ids)}"
+                    "?mode=memory&cache=shared"
+                )
+                # anchor connection keeps the shared DB alive
+                store.sql_anchor = sqlite3.connect(
+                    store.sql_uri, uri=True, check_same_thread=False
+                )
+        self.conn = sqlite3.connect(
+            store.sql_uri, uri=True, check_same_thread=False, timeout=0.2
+        )
+        self.conn.isolation_level = None  # explicit BEGIN/COMMIT only
+
+    _RE_UPSERT = _re.compile(
+        r"^UPSERT\s+INTO\s+(\w+)\s*\(\s*(\w+)\s*,\s*(\w+)\s*\)\s*"
+        r"VALUES\s*\((.+)\)\s*$",
+        _re.I | _re.S,
+    )
+    _RE_ON_DUP = _re.compile(
+        r"\s+ON\s+DUPLICATE\s+KEY\s+UPDATE\s+(.*)$", _re.I | _re.S
+    )
+    _RE_CONCAT = _re.compile(r"concat\(([^()]*)\)", _re.I)
+
+    def _translate(self, sql: str) -> str:
+        s = sql.strip().rstrip(";")
+        m = self._RE_UPSERT.match(s)
+        if m:  # cockroach UPSERT
+            t, c1, c2, vals = m.groups()
+            s = (
+                f"INSERT INTO {t} ({c1}, {c2}) VALUES ({vals}) "
+                f"ON CONFLICT ({c1}) DO UPDATE SET {c2} = excluded.{c2}"
+            )
+        m = self._RE_ON_DUP.search(s)
+        if m:  # mysql upsert → sqlite ON CONFLICT on the first column
+            update = m.group(1)
+            head = s[: m.start()]
+            cols = head[head.index("(") + 1 : head.index(")")]
+            first_col = cols.split(",")[0].strip()
+            s = f"{head} ON CONFLICT ({first_col}) DO UPDATE SET {update}"
+        # concat(a, b, c) → (a || b || c); split args outside quotes
+        while True:
+            m = self._RE_CONCAT.search(s)
+            if not m:
+                break
+            parts, cur, in_q = [], "", False
+            for ch in m.group(1):
+                if ch == "'":
+                    in_q = not in_q
+                    cur += ch
+                elif ch == "," and not in_q:
+                    parts.append(cur.strip())
+                    cur = ""
+                else:
+                    cur += ch
+            if cur.strip():
+                parts.append(cur.strip())
+            s = s[: m.start()] + "(" + " || ".join(parts) + ")" + s[m.end():]
+        return s
+
+    def execute(self, sql: str):
+        """→ (columns, rows, affected) or raises SqlBackendError."""
+        s = self._translate(sql)
+        try:
+            cur = self.conn.execute(s)
+            rows = cur.fetchall() if cur.description else []
+            cols = ([d[0] for d in cur.description]
+                    if cur.description else [])
+            return cols, rows, max(cur.rowcount, 0)
+        except sqlite3.IntegrityError as e:
+            raise SqlBackendError("duplicate", str(e))
+        except sqlite3.OperationalError as e:
+            msg = str(e)
+            if "locked" in msg or "busy" in msg:
+                try:
+                    self.conn.execute("ROLLBACK")
+                except sqlite3.Error:
+                    pass
+                raise SqlBackendError("conflict", msg)
+            raise SqlBackendError("syntax", msg)
+
+    def close(self):
+        try:
+            self.conn.close()
+        except sqlite3.Error:
+            pass

@@ -145,3 +145,129 @@ def test_all_suites_assemble():
             assert key in t, f"{name} missing {key}"
     if missing:
         pytest.xfail(f"suites not yet implemented: {missing}")
+
+
+# -- SQL family (pg / cockroach / mysql dialects over sqlite-backed fakes)
+
+
+import itertools as _it
+
+from fake_servers import FakeCql, FakeMysql, FakePg
+
+_DIALECTS = [
+    ("pg", FakePg, {"user": "postgres"}),
+    ("cockroach", FakePg, {"user": "postgres"}),
+    ("mysql", FakeMysql, {"user": "root", "password": "pw"}),
+]
+
+
+@pytest.mark.parametrize("dialect,fake,extra",
+                         _DIALECTS, ids=[d[0] for d in _DIALECTS])
+def test_sql_clients_roundtrip(dialect, fake, extra):
+    from jepsen_tpu.suites import sql
+
+    s = fake().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": dialect,
+                **extra}
+        c = sql.RegisterClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "value": [0, 5],
+                             "type": "invoke"})["type"] == "ok"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 5)
+        assert c.invoke({}, {"f": "cas", "value": [0, [5, 6]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [0, [5, 7]],
+                             "type": "invoke"})["type"] == "fail"
+        c.close({})
+
+        t = {"accounts": [0, 1, 2], "total-amount": 30, "max-transfer": 5}
+        b = sql.BankClient(opts).open({"nodes": ["n1"]}, "n1")
+        b.setup(t)
+        assert b.invoke(t, {"f": "transfer", "type": "invoke",
+                            "value": {"from": 0, "to": 1, "amount": 3}}
+                        )["type"] == "ok"
+        r = b.invoke(t, {"f": "read", "type": "invoke", "value": None})
+        assert sum(r["value"].values()) == 30 and r["value"][1] == 13
+        b.close({})
+
+        a = sql.AppendClient(opts).open({"nodes": ["n1"]}, "n1")
+        a.setup({})
+        r = a.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["append", 1, 10], ["r", 1, None]]})
+        assert r["type"] == "ok" and r["value"][1] == ["r", 1, [10]]
+        r = a.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["append", 1, 11], ["r", 1, None]]})
+        assert r["value"][1] == ["r", 1, [10, 11]]
+        a.close({})
+
+        x = sql.TxnClient(opts).open({"nodes": ["n1"]}, "n1")
+        x.setup({})
+        r = x.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["w", 3, 9], ["r", 3, None]]})
+        assert r["type"] == "ok" and r["value"][1] == ["r", 3, 9]
+        x.close({})
+    finally:
+        s.stop()
+
+
+def test_ycql_register_roundtrip():
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakeCql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = yugabyte.YcqlRegisterClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (0, None)
+        assert c.invoke({}, {"f": "write", "value": [0, 4],
+                             "type": "invoke"})["type"] == "ok"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 4)
+        assert c.invoke({}, {"f": "cas", "value": [0, [4, 5]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [0, [4, 6]],
+                             "type": "invoke"})["type"] == "fail"
+        c.close({})
+
+        sc = yugabyte.YcqlSetClient(opts).open({"nodes": ["n1"]}, "n1")
+        sc.setup({})
+        for i in range(3):
+            assert sc.invoke({}, {"f": "add", "value": i,
+                                  "type": "invoke"})["type"] == "ok"
+        r = sc.invoke({}, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == [0, 1, 2]
+        sc.close({})
+    finally:
+        s.stop()
+
+
+def test_sql_full_register_test_in_process():
+    """Full interpreter run: cockroach-dialect register workload against
+    the sqlite-backed fake pg."""
+    from jepsen_tpu.suites import cockroachdb
+
+    s = FakePg().start()
+    try:
+        t = cockroachdb.test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 50,
+                "workload": "register",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        oks = [op for op in result["history"] if op["type"] == "ok"]
+        assert oks, "expected ok completions"
+        assert result["results"]["valid?"] in (True, "unknown")
+    finally:
+        s.stop()
