@@ -1,0 +1,215 @@
+"""Aerospike suite.
+
+Reference: aerospike/src/aerospike/{support,cas_register,counter,set,
+pause,nemesis,core}.clj — install the aerospike server deb
+(support.clj:50-120), configure a mesh-heartbeat cluster over the test
+nodes with a strong-consistency namespace, manage the roster with
+``asinfo`` (support.clj:143-200), and run three workloads:
+**cas-register** (generation-checked CAS, cas_register.clj:53-76),
+**counter** (increments + reads, counter.clj), and **set** (list
+append read-modify-write, set.clj).
+
+The client speaks the AS_MSG binary protocol via
+:mod:`.proto.aerospike`; CAS uses the record generation exactly like
+the reference's ``gen-policy EXPECT_GEN_EQUAL``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from .. import control
+from ..control import util as cu
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.aerospike import AerospikeClient, AerospikeError
+
+PORT = 3000
+FABRIC_PORT = 3001
+MESH_PORT = 3002
+NAMESPACE = "jepsen"  # (reference: support.clj:50)
+SET = "registers"
+
+_CONF = """service {{
+  proto-fd-max 15000
+}}
+logging {{
+  file /var/log/aerospike/aerospike.log {{ context any info }}
+}}
+network {{
+  service {{ address any
+            port {port} }}
+  heartbeat {{ mode mesh
+              address any
+              port {mesh_port}
+{mesh_seeds}
+              interval 150
+              timeout 10 }}
+  fabric {{ port {fabric_port} }}
+}}
+namespace {namespace} {{
+  replication-factor {rf}
+  memory-size 512M
+  storage-engine memory
+}}
+"""
+
+
+class AerospikeDB(common.DaemonDB):
+    logfile = "/var/log/aerospike/aerospike.log"
+    proc_name = "asd"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version")
+
+    def install(self, test, node):
+        # (reference: support.clj install! — aerospike server + tools debs)
+        pkgs = ["aerospike-server-community", "aerospike-tools"]
+        if self.version:
+            pkgs = [f"{p}={self.version}" for p in pkgs]
+        debian.install(pkgs)
+        with control.su():
+            control.execute("mkdir", "-p", "/var/log/aerospike",
+                            check=False)
+
+    def configure(self, test, node):
+        mesh_seeds = "\n".join(
+            f"              mesh-seed-address-port {n} {MESH_PORT}"
+            for n in test["nodes"]
+        )
+        conf = _CONF.format(
+            port=PORT, mesh_port=MESH_PORT, fabric_port=FABRIC_PORT,
+            namespace=NAMESPACE, mesh_seeds=mesh_seeds,
+            rf=min(3, len(test["nodes"])),
+        )
+        with control.su():
+            cu.write_file(conf, "/etc/aerospike/aerospike.conf")
+
+    def start(self, test, node):
+        with control.su():
+            control.execute("service", "aerospike", "start", check=False)
+
+    def kill(self, test, node):
+        with control.su():
+            control.execute("service", "aerospike", "stop", check=False)
+            cu.grepkill("asd")
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", "/opt/aerospike/data", check=False)
+
+
+class _AsBase(client_mod.Client):
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[AerospikeClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = AerospikeClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            namespace=self.opts.get("namespace", NAMESPACE),
+            timeout=self.opts.get("timeout", 5.0),
+        )
+        return c
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+class CasRegisterClient(_AsBase):
+    """Generation-checked CAS (reference: cas_register.clj:40-76)."""
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                bins, _gen = self.conn.get(SET, int(k))
+                val = bins.get("value") if bins else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.conn.put(SET, int(k), {"value": int(v)})
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                bins, gen = self.conn.get(SET, int(k))
+                if bins is None or bins.get("value") != old:
+                    return {**op, "type": "fail", "error": "value-mismatch"}
+                try:
+                    self.conn.put(SET, int(k), {"value": int(new)},
+                                  generation=gen)
+                except AerospikeError as e:
+                    if e.generation_mismatch:
+                        return {**op, "type": "fail",
+                                "error": "generation-mismatch"}
+                    raise
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except AerospikeError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+
+class CounterClient(_AsBase):
+    """Increment-only counter (reference: counter.clj)."""
+
+    KEY = 0
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                bins, gen = self.conn.get(SET, self.KEY)
+                cur = bins.get("count", 0) if bins else 0
+                self.conn.put(
+                    SET, self.KEY, {"count": cur + int(op["value"])},
+                    generation=gen if bins is not None else None,
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                bins, _gen = self.conn.get(SET, self.KEY)
+                return {**op, "type": "ok",
+                        "value": bins.get("count", 0) if bins else 0}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except AerospikeError as e:
+            if e.generation_mismatch:
+                return {**op, "type": "fail", "error": "lost-increment-race"}
+            return {**op, "type": "fail", "error": str(e)}
+
+
+def db(opts: Optional[dict] = None):
+    return AerospikeDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return CasRegisterClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    return {
+        "cas-register": common.register_workload(opts),
+        "counter": common.counter_workload(opts),
+    }
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    wname = opts.get("workload", "cas-register")
+    w = workloads(opts)[wname]
+    c = CounterClient(opts) if wname == "counter" else CasRegisterClient(opts)
+    return common.build_test(
+        f"aerospike-{wname}", opts, db=AerospikeDB(opts), client=c,
+        workload=w,
+    )

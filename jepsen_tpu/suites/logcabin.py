@@ -1,0 +1,181 @@
+"""LogCabin (Raft reference implementation) suite.
+
+Reference: logcabin/src/jepsen/logcabin.clj — build LogCabin from
+source with scons (:30-45), bootstrap the Raft log on node 1
+(:76-83), start daemons with per-node server ids (:85-91), grow the
+cluster via the ``Reconfigure`` example binary (:100-115), and drive a
+CAS register **through the ``TreeOps`` example binary executed on the
+nodes over SSH** (:163-207) — LogCabin's client protocol is not a
+stable wire format, so the reference shells out, and this suite does
+the same through the control DSL.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from .. import control
+from ..control import util as cu
+from ..control.core import RemoteError
+from ..os_setup import debian
+from . import common
+
+CONFIG_FILE = "/root/logcabin.conf"  # (reference: logcabin.clj:55-62)
+LOG_FILE = "/root/logcabin.log"
+PID_FILE = "/root/logcabin.pid"
+STORE_DIR = "/root/storage"
+PORT = 5254
+LOGCABIN_BIN = "/root/LogCabin"
+RECONFIGURE_BIN = "/root/Reconfigure"
+TREEOPS_BIN = "/root/TreeOps"
+KEY = "/jepsen"
+
+
+def server_addrs(test: dict) -> str:
+    return ",".join(f"{n}:{PORT}" for n in test["nodes"])
+
+
+class LogCabinDB(common.DaemonDB):
+    logfile = LOG_FILE
+    pidfile = PID_FILE
+    proc_name = "LogCabin"
+
+    def install(self, test, node):
+        # (reference: logcabin.clj:30-45 — scons build from git)
+        debian.install(["git-core", "build-essential", "scons",
+                        "protobuf-compiler", "libprotobuf-dev",
+                        "libcrypto++-dev"])
+        with control.su():
+            control.execute(
+                "bash", "-c",
+                "test -d /logcabin || git clone --depth 1 "
+                "https://github.com/logcabin/logcabin.git /logcabin",
+            )
+            with control.cd("/logcabin"):
+                control.execute("git", "submodule", "update", "--init",
+                                check=False)
+                control.execute("scons", check=False)
+            for b in ("LogCabin", "Examples/Reconfigure", "Examples/TreeOps"):
+                control.execute("cp", "-f", f"/logcabin/build/{b}", "/root",
+                                check=False)
+
+    def configure(self, test, node):
+        # (reference: logcabin.clj:64-74)
+        sid = test["nodes"].index(node) + 1
+        with control.su():
+            cu.write_file(
+                f"serverId = {sid}\nlistenAddresses = {node}:{PORT}\n",
+                CONFIG_FILE,
+            )
+
+    def start(self, test, node):
+        with control.su(), control.cd("/root"):
+            if node == test["nodes"][0] and not cu.exists(STORE_DIR):
+                # (reference: logcabin.clj:76-83 bootstrap!)
+                control.execute(LOGCABIN_BIN, "-c", CONFIG_FILE,
+                                "-l", LOG_FILE, "--bootstrap")
+            control.execute(LOGCABIN_BIN, "-c", CONFIG_FILE, "-d",
+                            "-l", LOG_FILE, "-p", PID_FILE)
+
+    def setup(self, test, node):
+        super().setup(test, node)
+        if node == test["nodes"][0]:
+            # grow the cluster to all nodes (reference: :100-115)
+            with control.su(), control.cd("/root"):
+                control.execute(
+                    RECONFIGURE_BIN, "-c", server_addrs(test), "set",
+                    *[f"{n}:{PORT}" for n in test["nodes"]], check=False,
+                )
+
+    def kill(self, test, node):
+        cu.grepkill("LogCabin")
+        control.execute("rm", "-f", PID_FILE, check=False)
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", STORE_DIR, check=False)
+
+
+class LogCabinClient(client_mod.Client):
+    """CAS register through TreeOps on the node
+    (reference: logcabin.clj:163-237 CASClient)."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.node = None
+        self.test = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.node = node
+        c.test = test
+        return c
+
+    def _treeops(self, *args: str, stdin: Optional[str] = None) -> str:
+        def run():
+            with control.su(), control.cd("/root"):
+                res = control.execute(
+                    TREEOPS_BIN, "-c", server_addrs(self.test),
+                    "-q", *args, stdin=stdin,
+                )
+                return res.out if hasattr(res, "out") else str(res)
+
+        return control.with_node(self.node, run)
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        path = f"{KEY}-{k}"
+        try:
+            if op["f"] == "read":
+                out = self._treeops("read", path)
+                val = json.loads(out) if out.strip() else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self._treeops("write", path, stdin=json.dumps(v))
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                try:
+                    self._treeops(
+                        "write", path, "-p", f"{path}:{json.dumps(old)}",
+                        stdin=json.dumps(new),
+                    )
+                    return {**op, "type": "ok"}
+                except RemoteError as e:
+                    return {**op, "type": "fail", "error": str(e)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except RemoteError as e:
+            msg = str(e)
+            if "timed out" in msg.lower() or "timeout" in msg.lower():
+                return {**op, "type": "info", "error": msg}
+            return {**op, "type": "fail", "error": msg}
+
+    def close(self, test):
+        pass
+
+
+def db(opts: Optional[dict] = None):
+    return LogCabinDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return LogCabinClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"register": common.register_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["register"]
+    return common.build_test(
+        "logcabin-register", opts, db=LogCabinDB(opts),
+        client=LogCabinClient(opts), workload=w,
+    )

@@ -1,0 +1,161 @@
+"""MongoDB (RocksDB storage engine) suite.
+
+Reference: mongodb-rocks/src/jepsen/mongodb_rocks.clj — install the
+parse-built mongodb-org-server deb (:29-40), run mongod with
+``--storageEngine rocksdb`` and a replica set spanning the test nodes,
+``replSetInitiate`` from node 1, and run a CAS-register workload over
+the wire protocol with majority write concern / linearizable-ish reads
+(the reference layers atop the jepsen.mongodb suite's document CAS via
+findAndModify).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from .. import control
+from ..control import util as cu
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.mongo import MongoClient, MongoError
+
+PORT = 27017
+RS = "jepsen"
+DB_DIR = "/var/lib/mongodb"
+STORAGE_ENGINE = "rocksdb"
+
+
+class MongoDB(common.DaemonDB):
+    logfile = "/var/log/mongodb/mongod.log"
+    pidfile = "/var/run/mongod.pid"
+    proc_name = "mongod"
+    storage_engine = STORAGE_ENGINE
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", "3.0.6")
+        self.storage_engine = (opts or {}).get(
+            "storage-engine", type(self).storage_engine)
+
+    def install(self, test, node):
+        # (reference: mongodb_rocks.clj:29-40 install!)
+        url = (
+            "https://s3.amazonaws.com/parse-mongodb-builds/debs/"
+            f"mongodb-org-server_{self.version}_amd64.deb"
+        )
+        with control.su():
+            deb = cu.cached_wget(url)
+            control.execute("dpkg", "-i", deb, check=False)
+            control.execute("mkdir", "-p", DB_DIR, "/var/log/mongodb")
+
+    def start(self, test, node):
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile,
+             "chdir": DB_DIR},
+            "/usr/bin/mongod",
+            "--dbpath", DB_DIR,
+            "--port", str(PORT),
+            "--bind_ip", "0.0.0.0",
+            "--replSet", RS,
+            "--storageEngine", self.storage_engine,
+        )
+
+    def setup(self, test, node):
+        super().setup(test, node)
+        if node == test["nodes"][0]:
+            members = ", ".join(
+                f'{{_id: {i}, host: "{n}:{PORT}"}}'
+                for i, n in enumerate(test["nodes"])
+            )
+            control.execute(
+                "mongo", "--port", str(PORT), "--eval",
+                f'rs.initiate({{_id: "{RS}", members: [{members}]}})',
+                check=False,
+            )
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", DB_DIR)
+
+
+class MongoRegisterClient(client_mod.Client):
+    """Document CAS via findAndModify with majority write concern
+    (reference: the jepsen.mongodb document-cas client the rocks suite
+    reuses)."""
+
+    COLL = "registers"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[MongoClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = MongoClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            database=self.opts.get("database", "jepsen"),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                docs = self.conn.find(self.COLL, {"_id": int(k)})
+                val = docs[0].get("value") if docs else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.conn.update(
+                    self.COLL, {"_id": int(k)},
+                    {"$set": {"value": int(v)}}, upsert=True,
+                    write_concern={"w": "majority"},
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                doc = self.conn.find_and_modify(
+                    self.COLL,
+                    {"_id": int(k), "value": int(old)},
+                    {"$set": {"value": int(new)}},
+                )
+                if doc is None:
+                    return {**op, "type": "fail", "error": "cas-miss"}
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except MongoError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return MongoDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return MongoRegisterClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"register": common.register_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["register"]
+    return common.build_test(
+        "mongodb-rocks-register", opts, db=MongoDB(opts),
+        client=MongoRegisterClient(opts), workload=w,
+    )

@@ -1,0 +1,98 @@
+"""MongoDB-on-SmartOS suite.
+
+Reference: mongodb-smartos/src/jepsen/mongodb_smartos/{core,
+document_cas,transfer}.clj — the same mongodb replica-set test family
+run on SmartOS: pkgin-installed mongodb managed with ``svcadm``
+(core.clj uses jepsen.os.smartos), a document-CAS register workload
+(document_cas.clj) and a bank-style transfer workload (transfer.clj).
+
+The wire client and workloads are shared with :mod:`.mongodb_rocks`;
+only the DB automation differs (pkgin/svcadm instead of dpkg/daemon).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import control
+from ..control import util as cu
+from . import common
+from .mongodb_rocks import RS, PORT, MongoRegisterClient
+
+
+class SmartosMongoDB(common.DaemonDB):
+    logfile = "/var/log/mongodb/mongod.log"
+    proc_name = "mongod"
+    conf = "/opt/local/etc/mongod.conf"
+
+    def __init__(self, opts=None):
+        super().__init__(opts)
+
+    def install(self, test, node):
+        # (reference: core.clj via jepsen.os.smartos — pkgin packages)
+        with control.su():
+            control.execute("pkgin", "-y", "install", "mongodb",
+                            check=False)
+
+    def configure(self, test, node):
+        with control.su():
+            cu.write_file(
+                "\n".join([
+                    f"replSet = {RS}",
+                    f"port = {PORT}",
+                    "bind_ip = 0.0.0.0",
+                    "dbpath = /var/mongodb",
+                ]) + "\n",
+                self.conf,
+            )
+            control.execute("mkdir", "-p", "/var/mongodb", check=False)
+
+    def start(self, test, node):
+        with control.su():
+            control.execute("svcadm", "enable", "mongodb", check=False)
+
+    def kill(self, test, node):
+        with control.su():
+            control.execute("svcadm", "disable", "mongodb", check=False)
+            cu.grepkill("mongod")
+
+    def setup(self, test, node):
+        super().setup(test, node)
+        if node == test["nodes"][0]:
+            members = ", ".join(
+                f'{{_id: {i}, host: "{n}:{PORT}"}}'
+                for i, n in enumerate(test["nodes"])
+            )
+            control.execute(
+                "mongo", "--port", str(PORT), "--eval",
+                f'rs.initiate({{_id: "{RS}", members: [{members}]}})',
+                check=False,
+            )
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", "/var/mongodb", check=False)
+
+
+def db(opts: Optional[dict] = None):
+    return SmartosMongoDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return MongoRegisterClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"register": common.register_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["register"]
+    return common.build_test(
+        "mongodb-smartos-register", opts, db=SmartosMongoDB(opts),
+        client=MongoRegisterClient(opts), workload=w,
+    )

@@ -1,0 +1,201 @@
+"""Aerospike wire protocol (AS_MSG), from scratch.
+
+The reference drives Aerospike through the official Java client
+(aerospike/src/aerospike/support.clj); its workloads need get/put with
+generation-checked writes (optimistic CAS), integer bins, and list
+append emulated via read-modify-write.  This implements that slice of
+the protocol:
+
+- 8-byte proto header: version=2, type=3 (AS_MSG), 48-bit length
+- 22-byte message header: header_sz, info1/2/3, result_code,
+  generation, record_ttl, transaction_ttl, n_fields, n_ops
+- fields: namespace (0), set (1), user key (2, with 1-byte type
+  prefix: 1=int, 3=string); a RIPEMD-160 digest field (4) computed
+  from set+key, which the server uses for partition routing
+- ops: size, op (1=read, 2=write), bin type (1=int, 3=string),
+  version, name-len, name, value
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import IndeterminateError, ProtocolError
+
+AS_MSG_TYPE = 3
+
+INFO1_READ = 0x01
+INFO1_GET_ALL = 0x02
+INFO2_WRITE = 0x01
+INFO2_GENERATION = 0x04   # write only if generation matches
+
+OP_READ, OP_WRITE = 1, 2
+
+PARTICLE_INT, PARTICLE_STR = 1, 3
+
+FIELD_NAMESPACE, FIELD_SET, FIELD_KEY, FIELD_DIGEST = 0, 1, 2, 4
+
+RESULT_OK = 0
+RESULT_KEY_NOT_FOUND = 2
+RESULT_GENERATION = 3
+RESULT_TIMEOUT = 9
+
+
+class AerospikeError(ProtocolError):
+    @property
+    def not_found(self) -> bool:
+        return self.code == RESULT_KEY_NOT_FOUND
+
+    @property
+    def generation_mismatch(self) -> bool:
+        return self.code == RESULT_GENERATION
+
+
+def _digest(set_name: str, key: Any) -> bytes:
+    """RIPEMD-160 over set + key-with-type, per the Aerospike client."""
+    h = hashlib.new("ripemd160")
+    h.update(set_name.encode())
+    if isinstance(key, int):
+        h.update(bytes([PARTICLE_INT]) + struct.pack(">q", key))
+    else:
+        h.update(bytes([PARTICLE_STR]) + str(key).encode())
+    return h.digest()
+
+
+def _field(ftype: int, data: bytes) -> bytes:
+    return struct.pack(">IB", len(data) + 1, ftype) + data
+
+
+def _op(op: int, bin_name: str, value: Optional[bytes],
+        particle: int = 0) -> bytes:
+    name = bin_name.encode()
+    vlen = len(value) if value else 0
+    return (
+        struct.pack(">IBBBB", 4 + len(name) + vlen, op, particle, 0,
+                    len(name))
+        + name + (value or b"")
+    )
+
+
+def _int_particle(v: int) -> bytes:
+    return struct.pack(">q", v)
+
+
+class AerospikeClient:
+    def __init__(self, host: str, port: int = 3000,
+                 namespace: str = "jepsen", timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.namespace = namespace
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self._buf = b""
+
+    def connect(self) -> "AerospikeClient":
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                chunk = self.sock.recv(65536)
+            except OSError as e:
+                self.close()
+                raise IndeterminateError(f"recv failed: {e}") from e
+            if not chunk:
+                self.close()
+                raise IndeterminateError("connection closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _call(self, info1: int, info2: int, generation: int,
+              set_name: str, key: Any, ops: List[bytes]
+              ) -> Tuple[int, int, Dict[str, Any]]:
+        """→ (result_code, generation, bins)."""
+        if self.sock is None:
+            self.connect()
+        fields = [
+            _field(FIELD_NAMESPACE, self.namespace.encode()),
+            _field(FIELD_SET, set_name.encode()),
+            _field(FIELD_DIGEST, _digest(set_name, key)),
+        ]
+        body = struct.pack(
+            ">BBBBBBIIIHH",
+            22, info1, info2, 0, 0, 0,
+            generation, 0, 1000,  # record_ttl=0, transaction_ttl
+            len(fields), len(ops),
+        ) + b"".join(fields) + b"".join(ops)
+        header = struct.pack(">Q", (2 << 56) | (AS_MSG_TYPE << 48) | len(body))
+        try:
+            self.sock.sendall(header + body)
+        except OSError as e:
+            self.close()
+            raise IndeterminateError(f"send failed: {e}") from e
+
+        (proto,) = struct.unpack(">Q", self._recv_exact(8))
+        length = proto & 0xFFFFFFFFFFFF
+        payload = self._recv_exact(length)
+        result_code = payload[5]
+        (gen,) = struct.unpack_from(">I", payload, 6)
+        n_fields, n_ops = struct.unpack_from(">HH", payload, 18)
+        off = payload[0]  # header_sz
+        for _ in range(n_fields):
+            (sz,) = struct.unpack_from(">I", payload, off)
+            off += 4 + sz
+        bins: Dict[str, Any] = {}
+        for _ in range(n_ops):
+            (sz,) = struct.unpack_from(">I", payload, off)
+            _opid, particle, _ver, nlen = struct.unpack_from(
+                ">BBBB", payload, off + 4)
+            name = payload[off + 8 : off + 8 + nlen].decode()
+            val_raw = payload[off + 8 + nlen : off + 4 + sz]
+            if particle == PARTICLE_INT and len(val_raw) == 8:
+                bins[name] = struct.unpack(">q", val_raw)[0]
+            else:
+                bins[name] = val_raw.decode(errors="replace")
+            off += 4 + sz
+        return result_code, gen, bins
+
+    # -- public ops ----------------------------------------------------
+    def get(self, set_name: str, key: Any) -> Tuple[Optional[dict], int]:
+        """→ (bins or None, generation)."""
+        code, gen, bins = self._call(
+            INFO1_READ | INFO1_GET_ALL, 0, 0, set_name, key, [])
+        if code == RESULT_KEY_NOT_FOUND:
+            return None, 0
+        if code != RESULT_OK:
+            raise AerospikeError(f"get failed: code {code}", code=code)
+        return bins, gen
+
+    def put(self, set_name: str, key: Any, bins: Dict[str, int],
+            generation: Optional[int] = None) -> None:
+        """Write integer bins; with generation, the write applies only
+        if the record's generation matches (CAS)."""
+        info2 = INFO2_WRITE
+        gen = 0
+        if generation is not None:
+            info2 |= INFO2_GENERATION
+            gen = generation
+        ops = [
+            _op(OP_WRITE, name, _int_particle(v), PARTICLE_INT)
+            for name, v in bins.items()
+        ]
+        code, _g, _b = self._call(0, info2, gen, set_name, key, ops)
+        if code == RESULT_TIMEOUT:
+            raise IndeterminateError("server-side timeout")
+        if code != RESULT_OK:
+            raise AerospikeError(f"put failed: code {code}", code=code)

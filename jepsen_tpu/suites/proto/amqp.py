@@ -1,0 +1,291 @@
+"""AMQP 0-9-1 client (the subset the rabbitmq suite needs).
+
+The reference drives RabbitMQ through Langohr (rabbitmq/src/jepsen/
+rabbitmq.clj:18-24): queue.declare with durability args, basic.publish
+with persistent delivery mode, basic.get + basic.ack for dequeues.
+This module implements exactly that slice of AMQP 0-9-1 from scratch:
+PLAIN auth handshake, one channel, queue.declare/purge,
+basic.publish/get/ack.
+
+Framing: frame = type(1) channel(2) size(4) payload frame-end(0xCE).
+Method payload = class-id(2) method-id(2) arguments.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+from . import IndeterminateError, ProtocolError
+
+FRAME_METHOD, FRAME_HEADER, FRAME_BODY, FRAME_HEARTBEAT = 1, 2, 3, 8
+FRAME_END = 0xCE
+
+
+class AmqpError(ProtocolError):
+    pass
+
+
+def _short_str(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _long_str(b: bytes) -> bytes:
+    return struct.pack("!I", len(b)) + b
+
+
+def _field_table(d: Dict[str, Any]) -> bytes:
+    out = b""
+    for k, v in d.items():
+        out += _short_str(k)
+        if isinstance(v, bool):
+            out += b"t" + (b"\x01" if v else b"\x00")
+        elif isinstance(v, int):
+            out += b"I" + struct.pack("!i", v)
+        elif isinstance(v, str):
+            out += b"S" + _long_str(v.encode())
+        else:
+            raise ValueError(f"unsupported table value {v!r}")
+    return _long_str(out)
+
+
+def _parse_field_table(data: bytes, off: int) -> Tuple[dict, int]:
+    (n,) = struct.unpack_from("!I", data, off)
+    off += 4
+    end = off + n
+    out = {}
+    while off < end:
+        ln = data[off]
+        key = data[off + 1 : off + 1 + ln].decode()
+        off += 1 + ln
+        t = data[off : off + 1]
+        off += 1
+        if t == b"t":
+            out[key] = bool(data[off]); off += 1
+        elif t == b"I":
+            (out[key],) = struct.unpack_from("!i", data, off); off += 4
+        elif t == b"S":
+            (sl,) = struct.unpack_from("!I", data, off)
+            out[key] = data[off + 4 : off + 4 + sl].decode(errors="replace")
+            off += 4 + sl
+        elif t == b"F":
+            out[key], off = _parse_field_table(data, off)
+        elif t == b"l":
+            (out[key],) = struct.unpack_from("!q", data, off); off += 8
+        else:
+            raise AmqpError(f"unsupported field type {t!r}")
+    return out, end
+
+
+class AmqpClient:
+    def __init__(
+        self,
+        host: str,
+        port: int = 5672,
+        user: str = "guest",
+        password: str = "guest",
+        vhost: str = "/",
+        timeout: float = 10.0,
+    ):
+        self.host = host
+        self.port = port
+        self.user = user
+        self.password = password
+        self.vhost = vhost
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self._buf = b""
+
+    # -- framing -------------------------------------------------------
+    def _send(self, data: bytes) -> None:
+        try:
+            self.sock.sendall(data)
+        except OSError as e:
+            self.close()
+            raise IndeterminateError(f"send failed: {e}") from e
+
+    def _send_method(self, channel: int, class_id: int, method_id: int,
+                     args: bytes) -> None:
+        payload = struct.pack("!HH", class_id, method_id) + args
+        self._send(
+            struct.pack("!BHI", FRAME_METHOD, channel, len(payload))
+            + payload + bytes([FRAME_END])
+        )
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                chunk = self.sock.recv(65536)
+            except OSError as e:
+                self.close()
+                raise IndeterminateError(f"recv failed: {e}") from e
+            if not chunk:
+                self.close()
+                raise IndeterminateError("connection closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_frame(self) -> Tuple[int, int, bytes]:
+        t, ch, size = struct.unpack("!BHI", self._recv_exact(7))
+        payload = self._recv_exact(size)
+        end = self._recv_exact(1)
+        if end[0] != FRAME_END:
+            raise AmqpError(f"bad frame end {end!r}")
+        return t, ch, payload
+
+    def _read_method(self) -> Tuple[int, int, int, bytes]:
+        """Skip heartbeats → (channel, class, method, args)."""
+        while True:
+            t, ch, payload = self._read_frame()
+            if t == FRAME_HEARTBEAT:
+                continue
+            if t != FRAME_METHOD:
+                raise AmqpError(f"expected method frame, got type {t}")
+            cid, mid = struct.unpack_from("!HH", payload, 0)
+            if cid == 10 and mid == 50:  # connection.close
+                self._reply_close_ok(0)
+                raise self._close_error(payload[4:])
+            if cid == 20 and mid == 40:  # channel.close
+                self._send_method(ch, 20, 41, b"")
+                raise self._close_error(payload[4:])
+            return ch, cid, mid, payload[4:]
+
+    def _close_error(self, args: bytes) -> AmqpError:
+        (code,) = struct.unpack_from("!H", args, 0)
+        ln = args[2]
+        text = args[3 : 3 + ln].decode(errors="replace")
+        return AmqpError(f"{code}: {text}", code=code)
+
+    def _reply_close_ok(self, ch: int) -> None:
+        try:
+            self._send_method(ch, 10, 51, b"")
+        except IndeterminateError:
+            pass
+
+    # -- connection ----------------------------------------------------
+    def connect(self) -> "AmqpClient":
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+        self._send(b"AMQP\x00\x00\x09\x01")
+        _, cid, mid, _args = self._read_method()
+        if (cid, mid) != (10, 10):  # connection.start
+            raise AmqpError(f"expected connection.start, got {cid}.{mid}")
+        response = b"\x00" + self.user.encode() + b"\x00" + self.password.encode()
+        self._send_method(
+            0, 10, 11,  # connection.start-ok
+            _field_table({"product": "jepsen-tpu"})
+            + _short_str("PLAIN")
+            + _long_str(response)
+            + _short_str("en_US"),
+        )
+        _, cid, mid, args = self._read_method()
+        if (cid, mid) == (10, 30):  # connection.tune
+            channel_max, frame_max, heartbeat = struct.unpack_from(
+                "!HIH", args, 0)
+            frame_max = frame_max or 131072
+            self._send_method(
+                0, 10, 31, struct.pack("!HIH", channel_max, frame_max, 0)
+            )
+        self._send_method(
+            0, 10, 40, _short_str(self.vhost) + b"\x00\x00"
+        )  # connection.open
+        _, cid, mid, _args = self._read_method()
+        if (cid, mid) != (10, 41):
+            raise AmqpError(f"expected connection.open-ok, got {cid}.{mid}")
+        # channel.open
+        self._send_method(1, 20, 10, b"\x00")
+        ch, cid, mid, _args = self._read_method()
+        if (cid, mid) != (20, 11):
+            raise AmqpError(f"expected channel.open-ok, got {cid}.{mid}")
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    # -- queue ops -----------------------------------------------------
+    def queue_declare(self, queue: str, durable: bool = True,
+                      args: Optional[dict] = None) -> Tuple[str, int, int]:
+        """→ (queue, message-count, consumer-count)."""
+        bits = 0b00010 if durable else 0  # durable flag is bit 1
+        self._send_method(
+            1, 50, 10,
+            b"\x00\x00" + _short_str(queue) + bytes([bits])
+            + _field_table(args or {}),
+        )
+        _, cid, mid, rargs = self._read_method()
+        if (cid, mid) != (50, 11):
+            raise AmqpError(f"expected queue.declare-ok, got {cid}.{mid}")
+        ln = rargs[0]
+        name = rargs[1 : 1 + ln].decode()
+        msgs, consumers = struct.unpack_from("!II", rargs, 1 + ln)
+        return name, msgs, consumers
+
+    def queue_purge(self, queue: str) -> int:
+        self._send_method(1, 50, 30, b"\x00\x00" + _short_str(queue) + b"\x00")
+        _, cid, mid, rargs = self._read_method()
+        if (cid, mid) != (50, 31):
+            raise AmqpError(f"expected queue.purge-ok, got {cid}.{mid}")
+        (count,) = struct.unpack_from("!I", rargs, 0)
+        return count
+
+    # -- basic ops -----------------------------------------------------
+    def basic_publish(self, body: bytes, routing_key: str,
+                      exchange: str = "", persistent: bool = True) -> None:
+        self._send_method(
+            1, 60, 40,
+            b"\x00\x00" + _short_str(exchange) + _short_str(routing_key)
+            + b"\x00",
+        )
+        # content header: class 60, weight 0, body size, flags, props
+        flags = 0x1000  # delivery-mode present
+        props = bytes([2 if persistent else 1])
+        header = struct.pack("!HHQH", 60, 0, len(body), flags) + props
+        self._send(
+            struct.pack("!BHI", FRAME_HEADER, 1, len(header))
+            + header + bytes([FRAME_END])
+        )
+        self._send(
+            struct.pack("!BHI", FRAME_BODY, 1, len(body))
+            + body + bytes([FRAME_END])
+        )
+
+    def basic_get(self, queue: str, no_ack: bool = False
+                  ) -> Optional[Tuple[int, bytes]]:
+        """→ (delivery-tag, body) or None if the queue is empty."""
+        self._send_method(
+            1, 60, 70,
+            b"\x00\x00" + _short_str(queue) + (b"\x01" if no_ack else b"\x00"),
+        )
+        _, cid, mid, rargs = self._read_method()
+        if (cid, mid) == (60, 72):  # get-empty
+            return None
+        if (cid, mid) != (60, 71):  # get-ok
+            raise AmqpError(f"expected basic.get-ok, got {cid}.{mid}")
+        (tag,) = struct.unpack_from("!Q", rargs, 0)
+        # content header + body frames follow
+        t, _ch, payload = self._read_frame()
+        if t != FRAME_HEADER:
+            raise AmqpError("expected content header")
+        (body_size,) = struct.unpack_from("!Q", payload, 4)
+        body = b""
+        while len(body) < body_size:
+            t, _ch, chunk = self._read_frame()
+            if t != FRAME_BODY:
+                raise AmqpError("expected content body")
+            body += chunk
+        return tag, body
+
+    def basic_ack(self, delivery_tag: int) -> None:
+        self._send_method(
+            1, 60, 80, struct.pack("!Q", delivery_tag) + b"\x00"
+        )

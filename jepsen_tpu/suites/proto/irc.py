@@ -100,8 +100,13 @@ class IrcClient:
     def privmsg(self, target: str, message: str) -> None:
         self._send(f"PRIVMSG {target} :{message}")
 
+    def topic(self, channel: str, text: str) -> None:
+        """Set the channel topic (robustirc's set workload writes
+        elements as topic changes)."""
+        self._send(f"TOPIC {channel} :{text}")
+
     def read_messages(self, max_lines: int = 100) -> List[Tuple[str, str, str]]:
-        """Drain pending PRIVMSGs → [(sender-nick, target, text)].
+        """Drain pending PRIVMSGs/TOPICs → [(sender-nick, target, text)].
         Returns when the drain deadline passes or after max_lines; a
         severed connection still raises IndeterminateError so callers
         never mistake a dead link for an empty mailbox."""
@@ -114,7 +119,7 @@ class IrcClient:
                 prefix, cmd, args = self.parse(self._read_line())
                 if cmd == "PING":
                     self._send(f"PONG {args[0] if args else ''}")
-                elif cmd == "PRIVMSG" and len(args) >= 2:
+                elif cmd in ("PRIVMSG", "TOPIC") and len(args) >= 2:
                     nick = (prefix or "").split("!", 1)[0]
                     out.append((nick, args[0], args[1]))
         except IrcTimeout:

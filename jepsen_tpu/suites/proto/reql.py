@@ -1,0 +1,187 @@
+"""RethinkDB ReQL wire protocol (V0_4, JSON serialization).
+
+The reference drives RethinkDB through the official Clojure driver
+(rethinkdb/src/jepsen/rethinkdb.clj + rethinkdb/document_cas.clj).
+This implements the driver's wire format from scratch: the V0_4
+handshake (magic + auth key + JSON protocol marker), then
+length-prefixed JSON queries ``[START, term, optargs]`` with 8-byte
+tokens, and enough ReQL term constructors for the document-CAS
+workload: db/table create, get, insert, update with branch/eq row
+functions.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, List, Optional
+
+from . import IndeterminateError, ProtocolError
+
+V0_4 = 0x400C2D20
+PROTOCOL_JSON = 0x7E6970C7
+
+START = 1
+
+# response types
+SUCCESS_ATOM, SUCCESS_SEQUENCE, SUCCESS_PARTIAL = 1, 2, 3
+WAIT_COMPLETE = 4
+CLIENT_ERROR, COMPILE_ERROR, RUNTIME_ERROR = 16, 17, 18
+
+# term ids (ql2.proto)
+DATUM, MAKE_ARRAY, VAR, ERROR = 1, 2, 10, 12
+DB, TABLE, GET, EQ = 14, 15, 16, 17
+GET_FIELD = 31
+UPDATE = 53
+INSERT = 56
+DB_CREATE, TABLE_CREATE = 57, 60
+BRANCH = 65
+FUNC = 69
+
+
+class ReqlError(ProtocolError):
+    pass
+
+
+# -- term constructors -------------------------------------------------
+
+
+def db(name: str) -> list:
+    return [DB, [name]]
+
+
+def table(dbname: str, name: str) -> list:
+    return [TABLE, [db(dbname), name]]
+
+
+def get(tbl: list, key: Any) -> list:
+    return [GET, [tbl, key]]
+
+
+def insert(tbl: list, doc: dict, conflict: str = "error") -> list:
+    return [INSERT, [tbl, {"__literal__": doc}], {"conflict": conflict}]
+
+
+def update(sel: list, value: Any) -> list:
+    return [UPDATE, [sel, value]]
+
+
+def func(body: list) -> list:
+    """One-arg row function; the row is VAR 1."""
+    return [FUNC, [[MAKE_ARRAY, [1]], body]]
+
+
+def var() -> list:
+    return [VAR, [1]]
+
+
+def get_field(row: list, name: str) -> list:
+    return [GET_FIELD, [row, name]]
+
+
+def eq(a: Any, b: Any) -> list:
+    return [EQ, [a, b]]
+
+
+def branch(cond: list, then: Any, otherwise: Any) -> list:
+    return [BRANCH, [cond, then, otherwise]]
+
+
+def error(msg: str) -> list:
+    return [ERROR, [msg]]
+
+
+def _serialize(term: Any) -> Any:
+    """Plain dicts inside terms are object literals; mark insert docs
+    with __literal__ so nested dicts aren't mistaken for optargs."""
+    if isinstance(term, dict):
+        if "__literal__" in term:
+            return {k: _serialize(v) for k, v in term["__literal__"].items()}
+        return {k: _serialize(v) for k, v in term.items()}
+    if isinstance(term, list):
+        return [_serialize(t) for t in term]
+    return term
+
+
+class ReqlClient:
+    def __init__(self, host: str, port: int = 28015, auth_key: str = "",
+                 timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.auth_key = auth_key
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._token = 0
+
+    def connect(self) -> "ReqlClient":
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        key = self.auth_key.encode()
+        self.sock.sendall(
+            struct.pack("<I", V0_4)
+            + struct.pack("<I", len(key)) + key
+            + struct.pack("<I", PROTOCOL_JSON)
+        )
+        # null-terminated handshake reply
+        reply = b""
+        while not reply.endswith(b"\x00"):
+            chunk = self.sock.recv(64)
+            if not chunk:
+                raise IndeterminateError("handshake: connection closed")
+            reply += chunk
+        if not reply.startswith(b"SUCCESS"):
+            raise ReqlError(f"handshake failed: {reply[:-1].decode(errors='replace')}")
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                chunk = self.sock.recv(65536)
+            except OSError as e:
+                self.close()
+                raise IndeterminateError(f"recv failed: {e}") from e
+            if not chunk:
+                self.close()
+                raise IndeterminateError("connection closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def run(self, term: list, optargs: Optional[Dict[str, Any]] = None) -> Any:
+        """START a query, return the decoded result payload."""
+        if self.sock is None:
+            self.connect()
+        self._token += 1
+        token = self._token
+        q = json.dumps([START, _serialize(term), optargs or {}]).encode()
+        try:
+            self.sock.sendall(
+                struct.pack("<q", token) + struct.pack("<I", len(q)) + q
+            )
+        except OSError as e:
+            self.close()
+            raise IndeterminateError(f"send failed: {e}") from e
+        rtoken = struct.unpack("<q", self._recv_exact(8))[0]
+        if rtoken != token:
+            raise ReqlError(f"token mismatch: sent {token}, got {rtoken}")
+        (ln,) = struct.unpack("<I", self._recv_exact(4))
+        payload = json.loads(self._recv_exact(ln))
+        t = payload.get("t")
+        if t in (SUCCESS_ATOM, SUCCESS_SEQUENCE, SUCCESS_PARTIAL):
+            r = payload.get("r", [])
+            return r[0] if t == SUCCESS_ATOM else r
+        if t == RUNTIME_ERROR:
+            raise ReqlError(str(payload.get("r", ["runtime error"])[0]),
+                            code=t)
+        raise ReqlError(f"response type {t}: {payload.get('r')!r}", code=t)

@@ -1,0 +1,198 @@
+"""RabbitMQ suite.
+
+Reference: rabbitmq/src/jepsen/rabbitmq.clj — install the
+rabbitmq-server deb + erlang (:25-42), share an erlang cookie so nodes
+can cluster (:43-50), ``rabbitmqctl join_cluster`` the nodes, and run
+a **total-queue** workload over AMQP: durable queue declare (:137-141),
+persistent publishes (:152-160), basic.get + ack dequeues with an
+``:empty`` failure when the queue has nothing (:104-115), and a final
+drain (:165-170).
+
+The client rides the from-scratch AMQP 0-9-1 implementation in
+:mod:`.proto.amqp`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import checker as checker_mod
+from .. import client as client_mod
+from .. import codec
+from .. import control
+from .. import generator as gen
+from ..control import util as cu
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.amqp import AmqpClient, AmqpError
+
+PORT = 5672
+QUEUE = "jepsen.queue"  # (reference: rabbitmq.clj:102)
+VERSION = "3.5.6"
+COOKIE = "jepsen-rabbitmq"
+
+
+class RabbitDB(common.DaemonDB):
+    logfile = "/var/log/rabbitmq/rabbit.log"
+    proc_name = "beam.smp"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", VERSION)
+
+    def install(self, test, node):
+        # (reference: rabbitmq.clj:25-42)
+        debian.install(["erlang-nox"])
+        url = (
+            "http://www.rabbitmq.com/releases/rabbitmq-server/"
+            f"v{self.version}/rabbitmq-server_{self.version}-1_all.deb"
+        )
+        with control.su():
+            deb = cu.cached_wget(url)
+            control.execute("dpkg", "-i", deb, check=False)
+            # shared cookie for clustering (reference: :43-50)
+            control.execute("service", "rabbitmq-server", "stop",
+                            check=False)
+            cu.write_file(COOKIE, "/var/lib/rabbitmq/.erlang.cookie")
+            control.execute("chown", "rabbitmq:rabbitmq",
+                            "/var/lib/rabbitmq/.erlang.cookie", check=False)
+            control.execute("chmod", "400",
+                            "/var/lib/rabbitmq/.erlang.cookie", check=False)
+
+    def start(self, test, node):
+        with control.su():
+            control.execute("service", "rabbitmq-server", "start",
+                            check=False)
+
+    def setup(self, test, node):
+        super().setup(test, node)
+        primary = test["nodes"][0]
+        if node != primary:
+            with control.su():
+                control.execute("rabbitmqctl", "stop_app", check=False)
+                control.execute("rabbitmqctl", "join_cluster",
+                                f"rabbit@{primary}", check=False)
+                control.execute("rabbitmqctl", "start_app", check=False)
+
+    def kill(self, test, node):
+        with control.su():
+            control.execute("service", "rabbitmq-server", "stop",
+                            check=False)
+            cu.grepkill("beam.smp")
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", "/var/lib/rabbitmq/mnesia",
+                            check=False)
+
+
+class RabbitQueueClient(client_mod.Client):
+    """(reference: rabbitmq.clj:118-170 QueueClient)"""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[AmqpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = AmqpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            user=self.opts.get("user", "guest"),
+            password=self.opts.get("password", "guest"),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        c.conn.connect()
+        return c
+
+    def setup(self, test):
+        try:
+            self.conn.queue_declare(QUEUE, durable=True)
+        except (AmqpError, IndeterminateError):
+            pass
+
+    def teardown(self, test):
+        try:
+            self.conn.queue_purge(QUEUE)
+        except (AmqpError, IndeterminateError):
+            pass
+
+    def _dequeue(self, op):
+        got = self.conn.basic_get(QUEUE)
+        if got is None:
+            return {**op, "type": "fail", "error": "empty"}
+        tag, body = got
+        self.conn.basic_ack(tag)
+        return {**op, "type": "ok", "value": codec.decode(body)}
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "enqueue":
+                self.conn.basic_publish(
+                    codec.encode(op["value"]), QUEUE, persistent=True
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "dequeue":
+                return self._dequeue(op)
+            if op["f"] == "drain":
+                values = []
+                while True:
+                    r = self._dequeue(op)
+                    if r["type"] != "ok":
+                        return {**op, "type": "ok", "value": values}
+                    values.append(r["value"])
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except AmqpError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    counter = {"n": 0}
+
+    def enq(test, ctx):
+        counter["n"] += 1
+        return {"type": "invoke", "f": "enqueue", "value": counter["n"]}
+
+    def deq(test, ctx):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    final = gen.clients(
+        gen.each_thread(gen.once({"type": "invoke", "f": "drain",
+                                  "value": None}))
+    )
+    return {
+        "generator": gen.mix([enq, deq]),
+        "final-generator": final,
+        "checker": checker_mod.total_queue(),
+    }
+
+
+def db(opts: Optional[dict] = None):
+    return RabbitDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return RabbitQueueClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"queue": queue_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["queue"]
+    return common.build_test(
+        "rabbitmq-queue", opts, db=RabbitDB(opts),
+        client=RabbitQueueClient(opts), workload=w,
+    )

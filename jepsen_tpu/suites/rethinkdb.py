@@ -1,0 +1,193 @@
+"""RethinkDB suite.
+
+Reference: rethinkdb/src/jepsen/rethinkdb.clj + rethinkdb/
+document_cas.clj — install rethinkdb from its apt repo (:52-60), write
+a config whose ``join=`` lines span the cluster (:67-75), and run
+**document-cas**: a table with ``replicas = all nodes``, tunable
+``write_acks``/``read_mode``, a register document per key, and CAS as
+an atomic conditional update — a row function branching on the current
+value, erroring to abort (document_cas.clj:52-110).
+
+The client speaks the ReQL JSON wire protocol via :mod:`.proto.reql`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from .. import control
+from ..control import util as cu
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.reql import ReqlClient, ReqlError
+from .proto import reql as r
+
+CLIENT_PORT = 28015
+CLUSTER_PORT = 29015
+DB = "jepsen"
+TABLE = "cas"
+
+
+class RethinkDB(common.DaemonDB):
+    logfile = "/var/log/rethinkdb.log"
+    pidfile = "/var/run/rethinkdb.pid"
+    proc_name = "rethinkdb"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version", "2.1.5+2~0jessie")
+
+    def install(self, test, node):
+        # (reference: rethinkdb.clj:52-60)
+        with control.su():
+            cu.write_file(
+                "deb http://download.rethinkdb.com/apt jessie main\n",
+                "/etc/apt/sources.list.d/rethinkdb.list",
+            )
+            control.execute(
+                "bash", "-c",
+                "wget -qO- https://download.rethinkdb.com/apt/pubkey.gpg"
+                " | apt-key add -", check=False,
+            )
+            control.execute("apt-get", "update", check=False)
+        debian.install([f"rethinkdb={self.version}"])
+
+    def configure(self, test, node):
+        # (reference: rethinkdb.clj:67-85 — join lines per node)
+        joins = "\n".join(
+            f"join={n}:{CLUSTER_PORT}" for n in test["nodes"] if n != node
+        )
+        config = "\n".join([
+            "bind=all",
+            f"server-name={node}",
+            f"directory=/var/lib/rethinkdb/jepsen",
+            joins,
+        ])
+        with control.su():
+            cu.write_file(config, "/etc/rethinkdb/instances.d/jepsen.conf")
+
+    def start(self, test, node):
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile,
+             "chdir": "/var/lib/rethinkdb"},
+            "/usr/bin/rethinkdb",
+            "--config-file", "/etc/rethinkdb/instances.d/jepsen.conf",
+            "--pid-file", self.pidfile,
+        )
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(CLIENT_PORT, timeout_s=300)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", "/var/lib/rethinkdb/jepsen",
+                            check=False)
+
+
+class RethinkCasClient(client_mod.Client):
+    """Document CAS (reference: document_cas.clj:52-110).
+
+    Each key is a document {id: k, val: v}; CAS runs as
+    ``get(k).update(row -> branch(row.val == old, {val: new},
+    error("abort")))`` so the condition and write are one atomic
+    operation on the primary."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[ReqlClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = ReqlClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", CLIENT_PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def setup(self, test):
+        for term in (
+            [r.DB_CREATE, [DB]],
+            [r.TABLE_CREATE, [r.db(DB), TABLE]],
+        ):
+            try:
+                self.conn.run(term)
+            except (ReqlError, IndeterminateError):
+                pass  # already exists
+
+    def _tbl(self):
+        return r.table(DB, TABLE)
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        read_mode = self.opts.get("read-mode", "majority")
+        try:
+            if op["f"] == "read":
+                doc = self.conn.run(
+                    [r.GET, [[r.TABLE, [r.db(DB), TABLE],
+                              {"read_mode": read_mode}], int(k)]]
+                )
+                val = doc.get("val") if doc else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.conn.run(
+                    r.insert(self._tbl(), {"id": int(k), "val": int(v)},
+                             conflict="update"),
+                    {"durability": "hard"},
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                res = self.conn.run(
+                    r.update(
+                        r.get(self._tbl(), int(k)),
+                        r.func(
+                            r.branch(
+                                r.eq(r.get_field(r.var(), "val"), int(old)),
+                                {"__literal__": {"val": int(new)}},
+                                r.error("cas-abort"),
+                            )
+                        ),
+                    ),
+                    {"durability": "hard"},
+                )
+                applied = (res.get("replaced", 0) + res.get("unchanged", 0)) == 1
+                if applied and not res.get("errors"):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail",
+                        "error": res.get("first_error", "cas-miss")}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except ReqlError as e:
+            if "cas-abort" in str(e):
+                return {**op, "type": "fail", "error": "cas-miss"}
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return RethinkDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return RethinkCasClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"register": common.register_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["register"]
+    return common.build_test(
+        "rethinkdb-register", opts, db=RethinkDB(opts),
+        client=RethinkCasClient(opts), workload=w,
+    )

@@ -1,0 +1,151 @@
+"""RobustIRC suite.
+
+Reference: robustirc/src/jepsen/robustirc.clj — install the robustirc
+server binaries, form a 3+-node Raft network, and run a **set workload
+over IRC topics**: each add posts ``TOPIC #jepsen :<element>``
+(:163-176), and the final read collects every topic message the
+session observed, checked with the set checker (:176-210).
+
+The client here speaks RFC-1459 IRC directly (the bridge protocol);
+each client accumulates topics it has seen across invocations, exactly
+like the reference's robustsession message backlog.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Set
+
+from .. import checker as checker_mod
+from .. import client as client_mod
+from .. import control
+from .. import generator as gen
+from ..control import util as cu
+from . import common
+from .proto import IndeterminateError
+from .proto.irc import IrcClient
+
+DIR = "/opt/robustirc"
+PORT = 6667
+HTTPS_PORT = 13001
+CHANNEL = "#jepsen"
+
+_ids = iter(range(10**9))
+
+
+class RobustIrcDB(common.DaemonDB):
+    dir = DIR
+    binary = "robustirc"
+    logfile = f"{DIR}/robustirc.log"
+    pidfile = f"{DIR}/robustirc.pid"
+
+    def install(self, test, node):
+        with control.su():
+            control.execute(
+                "bash", "-c",
+                f"test -f {DIR}/{self.binary} || "
+                f"(mkdir -p {DIR} && cd {DIR} && "
+                "go install github.com/robustirc/robustirc@latest || true)",
+                check=False,
+            )
+
+    def start_args(self, test, node):
+        primary = test["nodes"][0]
+        args = [
+            "-network_name", "jepsen.net",
+            "-peer_addr", f"{node}:{HTTPS_PORT}",
+            "-listen", f":{HTTPS_PORT}",
+        ]
+        if node != primary:
+            args += ["-join", f"{primary}:{HTTPS_PORT}"]
+        return args
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(HTTPS_PORT, timeout_s=120)
+
+
+class RobustIrcSetClient(client_mod.Client):
+    """add → TOPIC change; read → all topics this session observed.
+    (reference: robustirc.clj:150-176 SetClient)"""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[IrcClient] = None
+        self.seen: Set[int] = set()
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = IrcClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            nick=f"jepsen{next(_ids)}",
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        c.conn.connect()
+        c.conn.join(CHANNEL)
+        return c
+
+    def _drain(self):
+        for _nick, target, text in self.conn.read_messages():
+            if target == CHANNEL:
+                try:
+                    self.seen.add(int(text))
+                except ValueError:
+                    pass
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                self.conn.topic(CHANNEL, str(op["value"]))
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                self._drain()
+                return {**op, "type": "ok", "value": sorted(self.seen)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: robustirc.clj:185-210 sets-test; plain set checker —
+    reads observe messages, not a stored collection)"""
+    counter = {"n": 0}
+
+    def add(test, ctx):
+        v = counter["n"]
+        counter["n"] += 1
+        return {"type": "invoke", "f": "add", "value": v}
+
+    final = gen.clients(
+        gen.each_thread(gen.once({"type": "invoke", "f": "read",
+                                  "value": None}))
+    )
+    return {
+        "generator": gen.stagger(0.1, add),
+        "final-generator": final,
+        "checker": checker_mod.set_checker(),
+    }
+
+
+def db(opts: Optional[dict] = None):
+    return RobustIrcDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return RobustIrcSetClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"set": set_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["set"]
+    return common.build_test(
+        "robustirc-set", opts, db=RobustIrcDB(opts),
+        client=RobustIrcSetClient(opts), workload=w,
+    )

@@ -1,0 +1,186 @@
+"""ZooKeeper suite.
+
+Reference: zookeeper/src/jepsen/zookeeper.clj — install the zookeeper
+debs (:46-49), write ``/etc/zookeeper/conf/myid`` from the node's index
+(:50-51) and a zoo.cfg whose ``server.N=node:2888:3888`` lines span the
+test nodes (:32-43,52-56), restart the service, and run a linearizable
+compare-and-set register over a znode (the reference drives an avout
+distributed atom; here the client uses the ZAB wire protocol directly
+with version-checked ``setData`` for CAS).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import client as client_mod
+from .. import independent
+from .. import control
+from ..control import util as cu
+from ..os_setup import debian
+from . import common
+from .proto import IndeterminateError
+from .proto.zk import ZkClient, ZkError
+
+PORT = 2181
+
+
+def zk_node_id(test: dict, node: Any) -> int:
+    """(reference: zookeeper.clj:26-30)"""
+    return test["nodes"].index(node)
+
+
+def zoo_cfg_servers(test: dict) -> str:
+    """(reference: zookeeper.clj:32-43)"""
+    return "\n".join(
+        f"server.{i}={n}:2888:3888" for i, n in enumerate(test["nodes"])
+    )
+
+
+_ZOO_CFG = """tickTime=2000
+initLimit=10
+syncLimit=5
+dataDir=/var/lib/zookeeper
+clientPort=2181
+"""
+
+
+class ZookeeperDB(common.DaemonDB):
+    logfile = "/var/log/zookeeper/zookeeper.log"
+    proc_name = "java"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.version = (opts or {}).get("version")
+
+    def install(self, test, node):
+        # (reference: zookeeper.clj:46-49)
+        pkgs = (
+            [f"zookeeper={self.version}", f"zookeeperd={self.version}"]
+            if self.version else ["zookeeper", "zookeeperd"]
+        )
+        debian.install(pkgs)
+
+    def configure(self, test, node):
+        with control.su():
+            cu.write_file(str(zk_node_id(test, node)),
+                          "/etc/zookeeper/conf/myid")
+            cu.write_file(_ZOO_CFG + zoo_cfg_servers(test) + "\n",
+                          "/etc/zookeeper/conf/zoo.cfg")
+
+    def start(self, test, node):
+        with control.su():
+            control.execute("service", "zookeeper", "restart", check=False)
+
+    def kill(self, test, node):
+        with control.su():
+            control.execute("service", "zookeeper", "stop", check=False)
+            cu.grepkill("zookeeper")
+
+    def await_ready(self, test, node):
+        cu.await_tcp_port(PORT, timeout_s=120)
+
+    def wipe(self, test, node):
+        with control.su():
+            control.execute("rm", "-rf", "/var/lib/zookeeper/version-2",
+                            check=False)
+
+
+class ZkRegisterClient(client_mod.Client):
+    """CAS register on a znode: read via getData, write via versioned
+    create/set, CAS via read-version + conditional setData (the znode
+    version is the optimistic lock).  One znode per independent key."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[ZkClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = ZkClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def _path(self, k) -> str:
+        return f"/jepsen-{k}"
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        path = self._path(k)
+        try:
+            if op["f"] == "read":
+                try:
+                    data, _ = self.conn.get_data(path)
+                    val = json.loads(data.decode())
+                except ZkError as e:
+                    if e.code == -101:  # NONODE
+                        val = None
+                    else:
+                        raise
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                data = json.dumps(v).encode()
+                try:
+                    self.conn.set_data(path, data)
+                except ZkError as e:
+                    if e.code != -101:
+                        raise
+                    try:
+                        self.conn.create(path, data)
+                    except ZkError as e2:
+                        if e2.code != -110:  # NODEEXISTS: lost a race
+                            raise
+                        self.conn.set_data(path, data)
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                try:
+                    data, stat = self.conn.get_data(path)
+                except ZkError as e:
+                    if e.code == -101:
+                        return {**op, "type": "fail", "error": "no-node"}
+                    raise
+                if json.loads(data.decode()) != old:
+                    return {**op, "type": "fail", "error": "value-mismatch"}
+                try:
+                    self.conn.set_data(path, json.dumps(new).encode(),
+                                       version=stat.version)
+                except ZkError as e:
+                    if e.code == -103:  # BADVERSION: lost the race
+                        return {**op, "type": "fail", "error": "bad-version"}
+                    raise
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except ZkError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def db(opts: Optional[dict] = None):
+    return ZookeeperDB(opts)
+
+
+def client(opts: Optional[dict] = None):
+    return ZkRegisterClient(opts)
+
+
+def workloads(opts: Optional[dict] = None) -> dict:
+    return {"register": common.register_workload(dict(opts or {}))}
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    w = workloads(opts)["register"]
+    return common.build_test(
+        "zookeeper-register", opts, db=ZookeeperDB(opts),
+        client=ZkRegisterClient(opts), workload=w,
+    )

@@ -58,7 +58,9 @@ def test(opts: Optional[dict] = None) -> dict:
         lin = independent.checker(checker_mod.linearizable(model))
 
     def fgen(k):
-        g = gen.reserve(n, r, gen.mix([w, cas, cas]))
+        # cas? False for systems exposing only get/set (e.g. raftis)
+        mixed = [w, cas, cas] if opts.get("cas?", True) else [w]
+        g = gen.reserve(n, r, gen.mix(mixed))
         pkl = opts.get("per-key-limit")
         if pkl:
             # Jitter the limit so keys drift off Significant Event

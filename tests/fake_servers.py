@@ -33,6 +33,20 @@ class _Store:
         self.kv: Dict[str, str] = {}
 
 
+
+class _RecvExact:
+    """Shared exact-n recv loop for the binary-protocol handlers."""
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+
 class FakeServer:
     """TCP server harness: start() binds an ephemeral loopback port."""
 
@@ -184,7 +198,7 @@ class FakeRedis(FakeServer):
 # ---------------------------------------------------------------------------
 
 
-class _PgHandler(socketserver.BaseRequestHandler):
+class _PgHandler(_RecvExact, socketserver.BaseRequestHandler):
     """Simple-query-protocol server with pluggable auth and a tiny SQL
     dialect: SELECT val FROM kv WHERE key='k' / INSERT ... / UPDATE ...,
     plus 'SELECT 1' and an error trigger."""
@@ -194,15 +208,6 @@ class _PgHandler(socketserver.BaseRequestHandler):
 
     def _send(self, t: bytes, payload: bytes = b""):
         self.request.sendall(t + struct.pack("!I", len(payload) + 4) + payload)
-
-    def _recv_exact(self, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError
-            buf += chunk
-        return buf
 
     def _read_msg(self) -> Tuple[bytes, bytes]:
         head = self._recv_exact(5)
@@ -409,17 +414,8 @@ class FakePg(FakeServer):
 # ---------------------------------------------------------------------------
 
 
-class _MysqlHandler(socketserver.BaseRequestHandler):
+class _MysqlHandler(_RecvExact, socketserver.BaseRequestHandler):
     password = "pw"
-
-    def _recv_exact(self, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError
-            buf += chunk
-        return buf
 
     def _read_packet(self):
         head = self._recv_exact(4)
@@ -607,17 +603,8 @@ class FakeMysql(FakeServer):
 # ---------------------------------------------------------------------------
 
 
-class _ZkHandler(socketserver.BaseRequestHandler):
+class _ZkHandler(_RecvExact, socketserver.BaseRequestHandler):
     ZK_OK, NO_NODE, BAD_VERSION, NODE_EXISTS = 0, -101, -103, -110
-
-    def _recv_exact(self, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError
-            buf += chunk
-        return buf
 
     def _read_frame(self):
         (n,) = struct.unpack("!i", self._recv_exact(4))
@@ -733,16 +720,7 @@ class FakeZk(FakeServer):
 # ---------------------------------------------------------------------------
 
 
-class _MongoHandler(socketserver.BaseRequestHandler):
-    def _recv_exact(self, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError
-            buf += chunk
-        return buf
-
+class _MongoHandler(_RecvExact, socketserver.BaseRequestHandler):
     def handle(self):
         from jepsen_tpu.suites.proto.mongo import bson_decode, bson_encode
 
@@ -841,16 +819,7 @@ class FakeMongo(FakeServer):
 # ---------------------------------------------------------------------------
 
 
-class _CqlHandler(socketserver.BaseRequestHandler):
-    def _recv_exact(self, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError
-            buf += chunk
-        return buf
-
+class _CqlHandler(_RecvExact, socketserver.BaseRequestHandler):
     def _send(self, stream, opcode, body):
         self.request.sendall(
             struct.pack("!BBhBI", 0x84, 0, stream, opcode, len(body)) + body
@@ -1037,6 +1006,20 @@ class _IrcHandler(socketserver.StreamRequestHandler):
                                     wf.flush()
                                 except Exception:
                                     pass
+                elif cmd == "TOPIC":
+                    target, msg = rest.split(" :", 1)
+                    # topic changes broadcast to every member, sender
+                    # included (RFC 1459 §4.2.4)
+                    with store.lock:
+                        members = store.irc_members.get(target.strip(), {})
+                        for other, wf in members.items():
+                            try:
+                                wf.write(
+                                    f":{nick}!u@h TOPIC {target} :{msg}\r\n".encode()
+                                )
+                                wf.flush()
+                            except Exception:
+                                pass
                 elif cmd == "QUIT":
                     return
         except Exception:
@@ -1321,3 +1304,325 @@ class _SqlBackend:
             self.conn.close()
         except sqlite3.Error:
             pass
+
+
+# ---------------------------------------------------------------------------
+# AMQP 0-9-1 (rabbitmq)
+# ---------------------------------------------------------------------------
+
+
+class _AmqpHandler(_RecvExact, socketserver.BaseRequestHandler):
+    def _send_method(self, channel, cid, mid, args=b""):
+        payload = struct.pack("!HH", cid, mid) + args
+        self.request.sendall(
+            struct.pack("!BHI", 1, channel, len(payload)) + payload + b"\xce"
+        )
+
+    def _read_frame(self):
+        t, ch, size = struct.unpack("!BHI", self._recv_exact(7))
+        payload = self._recv_exact(size)
+        assert self._recv_exact(1) == b"\xce"
+        return t, ch, payload
+
+    @staticmethod
+    def _short_str(s):
+        b = s.encode()
+        return bytes([len(b)]) + b
+
+    def handle(self):
+        store = self.fake_store
+        with store.lock:
+            if not hasattr(store, "amqp_queues"):
+                store.amqp_queues = {}   # name -> list of bodies
+                store.amqp_tag = 0
+        self.unacked = {}  # this connection's tag -> (queue, body)
+        try:
+            assert self._recv_exact(8) == b"AMQP\x00\x00\x09\x01"
+            # connection.start: version 0.9, empty server-props table,
+            # mechanisms PLAIN, locales en_US
+            self._send_method(
+                0, 10, 10,
+                b"\x00\x09" + struct.pack("!I", 0)
+                + struct.pack("!I", 5) + b"PLAIN"
+                + struct.pack("!I", 5) + b"en_US",
+            )
+            while True:
+                t, ch, payload = self._read_frame()
+                if t != 1:
+                    continue
+                cid, mid = struct.unpack_from("!HH", payload, 0)
+                args = payload[4:]
+                if (cid, mid) == (10, 11):    # start-ok
+                    self._send_method(0, 10, 30,
+                                      struct.pack("!HIH", 0, 131072, 0))
+                elif (cid, mid) == (10, 31):  # tune-ok
+                    pass
+                elif (cid, mid) == (10, 40):  # connection.open
+                    self._send_method(0, 10, 41, b"\x00")
+                elif (cid, mid) == (20, 10):  # channel.open
+                    self._send_method(ch, 20, 11, struct.pack("!I", 0))
+                elif (cid, mid) == (50, 10):  # queue.declare
+                    ln = args[2]
+                    qname = args[3:3 + ln].decode()
+                    with store.lock:
+                        store.amqp_queues.setdefault(qname, [])
+                        n = len(store.amqp_queues[qname])
+                    self._send_method(
+                        ch, 50, 11,
+                        self._short_str(qname) + struct.pack("!II", n, 0),
+                    )
+                elif (cid, mid) == (50, 30):  # queue.purge
+                    ln = args[2]
+                    qname = args[3:3 + ln].decode()
+                    with store.lock:
+                        n = len(store.amqp_queues.get(qname, []))
+                        store.amqp_queues[qname] = []
+                    self._send_method(ch, 50, 31, struct.pack("!I", n))
+                elif (cid, mid) == (60, 40):  # basic.publish
+                    off = 2
+                    eln = args[off]; off += 1 + eln
+                    rln = args[off]
+                    routing = args[off + 1: off + 1 + rln].decode()
+                    # content header + body frames follow
+                    t2, _c2, hdr = self._read_frame()
+                    assert t2 == 2
+                    (body_size,) = struct.unpack_from("!Q", hdr, 4)
+                    body = b""
+                    while len(body) < body_size:
+                        t3, _c3, chunk = self._read_frame()
+                        assert t3 == 3
+                        body += chunk
+                    with store.lock:
+                        store.amqp_queues.setdefault(routing, []).append(body)
+                elif (cid, mid) == (60, 70):  # basic.get
+                    ln = args[2]
+                    qname = args[3:3 + ln].decode()
+                    with store.lock:
+                        q = store.amqp_queues.get(qname, [])
+                        if not q:
+                            self._send_method(ch, 60, 72,
+                                              self._short_str(""))
+                            continue
+                        body = q.pop(0)
+                        store.amqp_tag += 1
+                        tag = store.amqp_tag
+                        self.unacked[tag] = (qname, body)
+                    getok = (struct.pack("!QB", tag, 0)
+                             + self._short_str("") + self._short_str(qname)
+                             + struct.pack("!I", len(q)))
+                    self._send_method(ch, 60, 71, getok)
+                    header = (struct.pack("!HHQH", 60, 0, len(body), 0x1000)
+                              + b"\x02")
+                    self.request.sendall(
+                        struct.pack("!BHI", 2, ch, len(header))
+                        + header + b"\xce")
+                    self.request.sendall(
+                        struct.pack("!BHI", 3, ch, len(body))
+                        + body + b"\xce")
+                elif (cid, mid) == (60, 80):  # basic.ack
+                    (tag,) = struct.unpack_from("!Q", args, 0)
+                    self.unacked.pop(tag, None)
+                elif (cid, mid) == (10, 50):  # connection.close
+                    self._send_method(0, 10, 51)
+                    return
+        except (ConnectionError, OSError, AssertionError, struct.error):
+            # this connection's unacked messages redeliver on loss
+            with store.lock:
+                for _tag, (qname, body) in self.unacked.items():
+                    store.amqp_queues.setdefault(qname, []).insert(0, body)
+            self.unacked = {}
+            return
+
+
+class FakeAmqp(FakeServer):
+    handler_class = _AmqpHandler
+
+
+# ---------------------------------------------------------------------------
+# ReQL (rethinkdb) — V0_4 JSON protocol, document store semantics
+# ---------------------------------------------------------------------------
+
+
+class _ReqlHandler(_RecvExact, socketserver.BaseRequestHandler):
+    def _eval(self, term, row=None):
+        """Evaluate the ReQL term subset the suite clients emit."""
+        store = self.fake_store
+        if not isinstance(term, list):
+            if isinstance(term, dict):
+                return {k: self._eval(v, row) for k, v in term.items()}
+            return term
+        tid = term[0]
+        args = term[1] if len(term) > 1 else []
+        opts = term[2] if len(term) > 2 else {}
+        if tid == 14:   # DB
+            return ("db", args[0])
+        if tid == 57:   # DB_CREATE
+            return {"dbs_created": 1}
+        if tid == 60:   # TABLE_CREATE
+            return {"tables_created": 1}
+        if tid == 15:   # TABLE
+            return ("table", args[1])
+        if tid == 16:   # GET
+            tbl = self._eval(args[0], row)
+            key = self._eval(args[1], row)
+            return store.kv.get(f"reql:{tbl[1]}:{key}")
+        if tid == 56:   # INSERT
+            tbl = self._eval(args[0], row)
+            doc = self._eval(args[1], row)
+            k = f"reql:{tbl[1]}:{doc['id']}"
+            existed = k in store.kv
+            if existed and opts.get("conflict") != "update":
+                return {"inserted": 0, "errors": 1,
+                        "first_error": "Duplicate primary key"}
+            store.kv[k] = doc
+            return {"inserted": 0 if existed else 1,
+                    "replaced": 1 if existed else 0, "errors": 0}
+        if tid == 53:   # UPDATE
+            sel = args[0]
+            # selector must be GET
+            tbl = self._eval(sel[1][0], row)
+            key = self._eval(sel[1][1], row)
+            k = f"reql:{tbl[1]}:{key}"
+            doc = store.kv.get(k)
+            if doc is None:
+                return {"skipped": 1, "replaced": 0, "unchanged": 0,
+                        "errors": 0}
+            updater = args[1]
+            try:
+                if isinstance(updater, list) and updater[0] == 69:  # FUNC
+                    patch = self._eval(updater[1][1], row=doc)
+                else:
+                    patch = self._eval(updater, row=doc)
+            except _ReqlAbort as e:
+                return {"replaced": 0, "unchanged": 0, "errors": 1,
+                        "first_error": str(e)}
+            new = {**doc, **patch}
+            if new == doc:
+                return {"replaced": 0, "unchanged": 1, "errors": 0}
+            store.kv[k] = new
+            return {"replaced": 1, "unchanged": 0, "errors": 0}
+        if tid == 65:   # BRANCH
+            cond = self._eval(args[0], row)
+            return self._eval(args[1] if cond else args[2], row)
+        if tid == 17:   # EQ
+            return self._eval(args[0], row) == self._eval(args[1], row)
+        if tid == 31:   # GET_FIELD
+            base = self._eval(args[0], row)
+            return (base or {}).get(args[1])
+        if tid == 10:   # VAR
+            return row
+        if tid == 12:   # ERROR
+            raise _ReqlAbort(args[0])
+        if tid == 2:    # MAKE_ARRAY
+            return [self._eval(a, row) for a in args]
+        raise _ReqlAbort(f"unsupported term {tid}")
+
+    def handle(self):
+        try:
+            magic = struct.unpack("<I", self._recv_exact(4))[0]
+            (keylen,) = struct.unpack("<I", self._recv_exact(4))
+            self._recv_exact(keylen)
+            self._recv_exact(4)  # protocol marker
+            self.request.sendall(b"SUCCESS\x00")
+            while True:
+                token = struct.unpack("<q", self._recv_exact(8))[0]
+                (ln,) = struct.unpack("<I", self._recv_exact(4))
+                q = json.loads(self._recv_exact(ln))
+                with self.fake_store.lock:
+                    try:
+                        result = self._eval(q[1])
+                        reply = {"t": 1, "r": [result]}
+                    except _ReqlAbort as e:
+                        reply = {"t": 18, "r": [str(e)]}
+                out = json.dumps(reply).encode()
+                self.request.sendall(
+                    struct.pack("<q", token) + struct.pack("<I", len(out))
+                    + out)
+        except (ConnectionError, OSError):
+            return
+
+
+class _ReqlAbort(Exception):
+    pass
+
+
+class FakeReql(FakeServer):
+    handler_class = _ReqlHandler
+
+
+# ---------------------------------------------------------------------------
+# Aerospike AS_MSG
+# ---------------------------------------------------------------------------
+
+
+class _AerospikeHandler(_RecvExact, socketserver.BaseRequestHandler):
+    def _reply(self, result_code, generation, bins):
+        ops = b""
+        for name, val in bins.items():
+            nb = name.encode()
+            vb = struct.pack(">q", val)
+            ops += struct.pack(">IBBBB", 4 + len(nb) + len(vb), 1, 1, 0,
+                               len(nb)) + nb + vb
+        body = struct.pack(
+            ">BBBBBBIIIHH", 22, 0, 0, 0, 0, result_code, generation, 0, 0,
+            0, len(bins)) + ops
+        self.request.sendall(
+            struct.pack(">Q", (2 << 56) | (3 << 48) | len(body)) + body)
+
+    def handle(self):
+        store = self.fake_store
+        with store.lock:
+            if not hasattr(store, "as_records"):
+                store.as_records = {}  # digest -> (bins dict, generation)
+        try:
+            while True:
+                (proto,) = struct.unpack(">Q", self._recv_exact(8))
+                payload = self._recv_exact(proto & 0xFFFFFFFFFFFF)
+                info1, info2 = payload[1], payload[2]
+                (gen_req,) = struct.unpack_from(">I", payload, 6)
+                n_fields, n_ops = struct.unpack_from(">HH", payload, 18)
+                off = payload[0]
+                digest = None
+                for _ in range(n_fields):
+                    (sz,) = struct.unpack_from(">I", payload, off)
+                    ftype = payload[off + 4]
+                    if ftype == 4:
+                        digest = payload[off + 5 : off + 4 + sz]
+                    off += 4 + sz
+                ops = []
+                for _ in range(n_ops):
+                    (sz,) = struct.unpack_from(">I", payload, off)
+                    opid, particle, _v, nlen = struct.unpack_from(
+                        ">BBBB", payload, off + 4)
+                    name = payload[off + 8 : off + 8 + nlen].decode()
+                    raw = payload[off + 8 + nlen : off + 4 + sz]
+                    ops.append((opid, name, raw))
+                    off += 4 + sz
+                with store.lock:
+                    rec = store.as_records.get(digest)
+                    if info2 & 0x01:  # write
+                        if info2 & 0x04:  # generation check
+                            cur_gen = rec[1] if rec else 0
+                            if cur_gen != gen_req:
+                                self._reply(3, cur_gen, {})
+                                continue
+                        bins = dict(rec[0]) if rec else {}
+                        for opid, name, raw in ops:
+                            if opid == 2:
+                                bins[name] = struct.unpack(">q", raw)[0]
+                        gen = (rec[1] if rec else 0) + 1
+                        store.as_records[digest] = (bins, gen)
+                        self._reply(0, gen, {})
+                    elif info1 & 0x01:  # read
+                        if rec is None:
+                            self._reply(2, 0, {})
+                        else:
+                            self._reply(0, rec[1], rec[0])
+                    else:
+                        self._reply(4, 0, {})
+        except (ConnectionError, OSError):
+            return
+
+
+class FakeAerospike(FakeServer):
+    handler_class = _AerospikeHandler

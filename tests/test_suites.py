@@ -271,3 +271,137 @@ def test_sql_full_register_test_in_process():
         assert result["results"]["valid?"] in (True, "unknown")
     finally:
         s.stop()
+
+
+# -- new wire protocols: AMQP, ReQL, Aerospike ------------------------
+
+
+def test_amqp_rabbitmq_queue_roundtrip():
+    from fake_servers import FakeAmqp
+    from jepsen_tpu.suites import rabbitmq
+
+    s = FakeAmqp().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = rabbitmq.RabbitQueueClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        for i in (1, 2, 3):
+            assert c.invoke({}, {"f": "enqueue", "value": i,
+                                 "type": "invoke"})["type"] == "ok"
+        r = c.invoke({}, {"f": "dequeue", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == 1
+        r = c.invoke({}, {"f": "drain", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == [2, 3]
+        r = c.invoke({}, {"f": "dequeue", "value": None, "type": "invoke"})
+        assert r["type"] == "fail" and r["error"] == "empty"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_reql_rethinkdb_cas_roundtrip():
+    from fake_servers import FakeReql
+    from jepsen_tpu.suites import rethinkdb
+
+    s = FakeReql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = rethinkdb.RethinkCasClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (0, None)
+        assert c.invoke({}, {"f": "write", "value": [0, 3],
+                             "type": "invoke"})["type"] == "ok"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 3)
+        assert c.invoke({}, {"f": "cas", "value": [0, [3, 4]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [0, [3, 5]],
+                             "type": "invoke"})["type"] == "fail"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 4)
+        # same-value CAS must count as applied
+        assert c.invoke({}, {"f": "cas", "value": [0, [4, 4]],
+                             "type": "invoke"})["type"] == "ok"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_aerospike_cas_roundtrip():
+    from fake_servers import FakeAerospike
+    from jepsen_tpu.suites import aerospike
+
+    s = FakeAerospike().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = aerospike.CasRegisterClient(opts).open({"nodes": ["n1"]}, "n1")
+        r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (0, None)
+        assert c.invoke({}, {"f": "write", "value": [0, 7],
+                             "type": "invoke"})["type"] == "ok"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 7)
+        assert c.invoke({}, {"f": "cas", "value": [0, [7, 8]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [0, [7, 9]],
+                             "type": "invoke"})["type"] == "fail"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 8)
+        c.close({})
+
+        cc = aerospike.CounterClient(opts).open({"nodes": ["n1"]}, "n1")
+        for _ in range(3):
+            assert cc.invoke({}, {"f": "add", "value": 1,
+                                  "type": "invoke"})["type"] == "ok"
+        assert cc.invoke({}, {"f": "read", "value": None,
+                              "type": "invoke"})["value"] == 3
+        cc.close({})
+    finally:
+        s.stop()
+
+
+def test_zk_register_roundtrip():
+    from fake_servers import FakeZk
+    from jepsen_tpu.suites import zookeeper
+
+    s = FakeZk().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = zookeeper.ZkRegisterClient(opts).open({"nodes": ["n1"]}, "n1")
+        r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (0, None)
+        assert c.invoke({}, {"f": "write", "value": [0, 2],
+                             "type": "invoke"})["type"] == "ok"
+        assert tuple(c.invoke({}, {"f": "read", "value": [0, None],
+                                   "type": "invoke"})["value"]) == (0, 2)
+        assert c.invoke({}, {"f": "cas", "value": [0, [2, 3]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [0, [2, 4]],
+                             "type": "invoke"})["type"] == "fail"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_robustirc_set_roundtrip():
+    from fake_servers import FakeIrc
+    from jepsen_tpu.suites import robustirc
+
+    s = FakeIrc().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"nodes": ["n1"]}
+        c1 = robustirc.RobustIrcSetClient(opts).open(t, "n1")
+        c2 = robustirc.RobustIrcSetClient(opts).open(t, "n1")
+        for i in (10, 11):
+            assert c1.invoke(t, {"f": "add", "value": i,
+                                 "type": "invoke"})["type"] == "ok"
+        import time
+        time.sleep(0.3)
+        r = c2.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and set(r["value"]) >= {10, 11}, r
+        c1.close(t)
+        c2.close(t)
+    finally:
+        s.stop()
