@@ -1,0 +1,144 @@
+"""Disk-fault injection via the faultfs FUSE filesystem.
+
+The TPU-native equivalent of the reference's CharybdeFS wrapper
+(charybdefs/src/jepsen/charybdefs.clj): install build deps and compile
+``native/faultfs.cc`` **on each DB node** (:40-65 — the reference
+builds ScyllaDB's charybdefs + thrift there), mount ``/faulty`` as a
+fault-injectable view of ``/real`` (:66-70), and flip faults at
+runtime: ``break_all`` (every op → EIO), ``break_one_percent``
+(probabilistic), ``clear`` (:72-85 cookbook recipes).  The control
+channel is faultfs's own TCP command port instead of thrift.
+
+Typical use: point the DB's data directory at /faulty and drive
+``nemesis()`` ops ``{"f": "break-disk", "value": node-spec}`` /
+``{"f": "heal-disk"}``.
+"""
+
+from __future__ import annotations
+
+import errno as errno_mod
+import os
+from typing import Any, Iterable, Optional
+
+from . import control
+from .control import util as cu
+from .nemesis import Nemesis
+from .os_setup import debian
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+DIR = "/opt/faultfs"
+BIN = f"{DIR}/faultfs"
+REAL = "/real"      # (reference: charybdefs.clj:66-69)
+FAULTY = "/faulty"
+CTL_PORT = 7656
+
+
+def _source() -> str:
+    with open(os.path.join(NATIVE_DIR, "faultfs.cc")) as f:
+        return f.read()
+
+
+def install() -> None:
+    """Build faultfs on the node and mount /faulty over /real.
+    (reference: charybdefs.clj:41-70 install!)"""
+    debian.install(["build-essential", "pkg-config", "libfuse-dev", "fuse"])
+    with control.su():
+        control.execute("mkdir", "-p", DIR, REAL, FAULTY)
+        src = f"{DIR}/faultfs.cc"
+        cu.write_file(_source(), src)
+        control.execute(
+            "bash", "-c",
+            f"g++ -O2 -Wall {src} -o {BIN} "
+            "$(pkg-config fuse --cflags --libs) -lpthread",
+        )
+        control.execute("modprobe", "fuse", check=False)
+        control.execute("umount", FAULTY, check=False)
+        control.execute(
+            BIN, FAULTY, "-oallow_other,nonempty", "-r", REAL,
+            "-p", str(CTL_PORT),
+        )
+        control.execute("chmod", "777", REAL, FAULTY)
+
+
+def remove() -> None:
+    with control.su():
+        control.execute("umount", FAULTY, check=False)
+        cu.grepkill("faultfs")
+
+
+def _command(cmd: str) -> str:
+    """Send one control command to the node-local faultfs."""
+    res = control.execute(
+        "python3", "-c",
+        (
+            "import socket,sys;"
+            f"s=socket.create_connection(('127.0.0.1',{CTL_PORT}),timeout=5);"
+            f"s.sendall({cmd!r}.encode()+b'\\n');"
+            "print(s.recv(128).decode().strip())"
+        ),
+    )
+    out = res.out.strip() if hasattr(res, "out") else str(res).strip()
+    if not out.startswith(("OK", "mode=")):
+        raise RuntimeError(f"faultfs control failed: {out!r}")
+    return out
+
+
+def break_all(errno: int = errno_mod.EIO) -> None:
+    """All operations fail.  (reference: charybdefs.clj:72-75)"""
+    _command(f"all {errno}")
+
+
+def break_one_percent(errno: int = errno_mod.EIO) -> None:
+    """1% of disk operations fail.  (reference: charybdefs.clj:77-80)"""
+    _command(f"prob 10000 {errno}")
+
+
+def break_probability(ppm: int, errno: int = errno_mod.EIO) -> None:
+    """Fail ppm-per-million ops with errno."""
+    _command(f"prob {ppm} {errno}")
+
+
+def clear() -> None:
+    """Remove fault injection.  (reference: charybdefs.clj:82-85)"""
+    _command("clear")
+
+
+def status() -> str:
+    return _command("status")
+
+
+class FaultFsNemesis(Nemesis):
+    """Nemesis breaking/healing disks on a subset of nodes.
+
+    Ops: {"f": "break-disk", "value": [nodes...] | None (all)},
+         {"f": "break-disk-slow", ...} (1% probabilistic),
+         {"f": "heal-disk", "value": ...}.
+    """
+
+    def setup(self, test):
+        control.on_nodes(test, lambda t, n: install())
+        return self
+
+    def _targets(self, test, value) -> Iterable[Any]:
+        return list(value) if value else list(test["nodes"])
+
+    def invoke(self, test, op):
+        nodes = self._targets(test, op.get("value"))
+        if op["f"] == "break-disk":
+            fn = lambda t, n: break_all()
+        elif op["f"] == "break-disk-slow":
+            fn = lambda t, n: break_one_percent()
+        elif op["f"] == "heal-disk":
+            fn = lambda t, n: clear()
+        else:
+            raise ValueError(f"unknown faultfs op {op['f']!r}")
+        control.on_nodes(test, nodes, fn)
+        return {**op, "value": {"disk": op["f"], "nodes": nodes}}
+
+    def teardown(self, test):
+        control.on_nodes(test, lambda t, n: cu.meh(remove))
+
+    def fs(self):
+        return {"break-disk", "break-disk-slow", "heal-disk"}

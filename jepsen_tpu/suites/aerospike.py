@@ -165,16 +165,31 @@ class CounterClient(_AsBase):
 
     KEY = 0
 
+    RETRIES = 5
+
     def invoke(self, test, op):
         try:
             if op["f"] == "add":
-                bins, gen = self.conn.get(SET, self.KEY)
-                cur = bins.get("count", 0) if bins else 0
-                self.conn.put(
-                    SET, self.KEY, {"count": cur + int(op["value"])},
-                    generation=gen if bins is not None else None,
-                )
-                return {**op, "type": "ok"}
+                # read-modify-write, guarded both ways: generation check
+                # on existing records, create-only on first increment —
+                # otherwise two concurrent first adds both write {count:1}
+                # and one increment is silently lost
+                for _ in range(self.RETRIES):
+                    bins, gen = self.conn.get(SET, self.KEY)
+                    cur = bins.get("count", 0) if bins else 0
+                    try:
+                        self.conn.put(
+                            SET, self.KEY,
+                            {"count": cur + int(op["value"])},
+                            generation=gen if bins is not None else None,
+                            create_only=bins is None,
+                        )
+                        return {**op, "type": "ok"}
+                    except AerospikeError as e:
+                        if e.generation_mismatch or e.key_exists:
+                            continue  # lost a race; re-read and retry
+                        raise
+                return {**op, "type": "fail", "error": "rmw-retries-exhausted"}
             if op["f"] == "read":
                 bins, _gen = self.conn.get(SET, self.KEY)
                 return {**op, "type": "ok",

@@ -173,6 +173,31 @@ def counter_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    """Total-queue: enqueues/dequeues raced with faults, then every
+    thread drains (reference: e.g. rabbitmq.clj queue workload +
+    checker.clj:628 total-queue).  Shared by the rabbitmq, disque, and
+    hazelcast suites."""
+    counter = {"n": 0}
+
+    def enq(test, ctx):
+        counter["n"] += 1
+        return {"type": "invoke", "f": "enqueue", "value": counter["n"]}
+
+    def deq(test, ctx):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    final = gen.clients(
+        gen.each_thread(gen.once({"type": "invoke", "f": "drain",
+                                  "value": None}))
+    )
+    return {
+        "generator": gen.mix([enq, deq]),
+        "final-generator": final,
+        "checker": checker_mod.total_queue(),
+    }
+
+
 def register_workload(opts: Optional[dict] = None) -> dict:
     """Per-key linearizable CAS registers (the flagship workload);
     delegates to workloads.linearizable_register.  Declares the 2n

@@ -89,7 +89,7 @@ class DisqueClient(client_mod.Client):
         c = type(self)(self.opts)
         c.conn = RespClient(
             self.opts.get("host", str(node)),
-            self.opts.get("port", self.opts.get("port", PORT)),
+            self.opts.get("port", PORT),
             timeout=self.opts.get("timeout", 5.0),
         )
         return c
@@ -136,28 +136,6 @@ class DisqueClient(client_mod.Client):
             self.conn.close()
 
 
-def queue_workload(opts: Optional[dict] = None) -> dict:
-    """(reference: disque.clj queue workload + total-queue checker)"""
-    counter = {"n": 0}
-
-    def enq(test, ctx):
-        counter["n"] += 1
-        return {"type": "invoke", "f": "enqueue", "value": counter["n"]}
-
-    def deq(test, ctx):
-        return {"type": "invoke", "f": "dequeue", "value": None}
-
-    final = gen.clients(
-        gen.each_thread(gen.once({"type": "invoke", "f": "drain",
-                                  "value": None}))
-    )
-    return {
-        "generator": gen.mix([enq, deq]),
-        "final-generator": final,
-        "checker": checker_mod.total_queue(),
-    }
-
-
 def db(opts: Optional[dict] = None):
     return DisqueDB(opts)
 
@@ -167,7 +145,7 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"queue": queue_workload(dict(opts or {}))}
+    return {"queue": common.queue_workload(dict(opts or {}))}
 
 
 def test(opts: Optional[dict] = None) -> dict:

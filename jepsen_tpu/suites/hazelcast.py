@@ -146,29 +146,6 @@ class HazelcastQueueClient(client_mod.Client):
             self.conn.close()
 
 
-def queue_workload(opts: Optional[dict] = None) -> dict:
-    """total-queue: enqueues/dequeues + final drain (reference:
-    hazelcast.clj queue-workload; checker.clj:628 total-queue)."""
-    counter = {"n": 0}
-
-    def enq(test, ctx):
-        counter["n"] += 1
-        return {"type": "invoke", "f": "enqueue", "value": counter["n"]}
-
-    def deq(test, ctx):
-        return {"type": "invoke", "f": "dequeue", "value": None}
-
-    final = gen.clients(
-        gen.each_thread(gen.once({"type": "invoke", "f": "drain",
-                                  "value": None}))
-    )
-    return {
-        "generator": gen.mix([enq, deq]),
-        "final-generator": final,
-        "checker": checker_mod.total_queue(),
-    }
-
-
 class HazelcastIdClient(client_mod.Client):
     """unique-ids via a REST map used as an atomic counter per node —
     each client reserves blocks by writing node-scoped keys.
@@ -233,7 +210,7 @@ def client(opts: Optional[dict] = None):
 def workloads(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     return {
-        "queue": queue_workload(opts),
+        "queue": common.queue_workload(opts),
         "unique-ids": unique_ids_workload(opts),
     }
 

@@ -141,14 +141,11 @@ class LogCabinClient(client_mod.Client):
                 return {**op, "type": "ok"}
             if op["f"] == "cas":
                 old, new = v
-                try:
-                    self._treeops(
-                        "write", path, "-p", f"{path}:{json.dumps(old)}",
-                        stdin=json.dumps(new),
-                    )
-                    return {**op, "type": "ok"}
-                except RemoteError as e:
-                    return {**op, "type": "fail", "error": str(e)}
+                self._treeops(
+                    "write", path, "-p", f"{path}:{json.dumps(old)}",
+                    stdin=json.dumps(new),
+                )
+                return {**op, "type": "ok"}
             raise ValueError(f"unknown f {op['f']!r}")
         except RemoteError as e:
             msg = str(e)

@@ -125,6 +125,7 @@ class MongoRegisterClient(client_mod.Client):
                     self.COLL,
                     {"_id": int(k), "value": int(old)},
                     {"$set": {"value": int(new)}},
+                    write_concern={"w": "majority"},
                 )
                 if doc is None:
                     return {**op, "type": "fail", "error": "cas-miss"}

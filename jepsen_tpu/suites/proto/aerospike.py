@@ -31,6 +31,7 @@ INFO1_READ = 0x01
 INFO1_GET_ALL = 0x02
 INFO2_WRITE = 0x01
 INFO2_GENERATION = 0x04   # write only if generation matches
+INFO2_CREATE_ONLY = 0x20  # write only if the record does not exist
 
 OP_READ, OP_WRITE = 1, 2
 
@@ -41,6 +42,7 @@ FIELD_NAMESPACE, FIELD_SET, FIELD_KEY, FIELD_DIGEST = 0, 1, 2, 4
 RESULT_OK = 0
 RESULT_KEY_NOT_FOUND = 2
 RESULT_GENERATION = 3
+RESULT_KEY_EXISTS = 5
 RESULT_TIMEOUT = 9
 
 
@@ -52,6 +54,10 @@ class AerospikeError(ProtocolError):
     @property
     def generation_mismatch(self) -> bool:
         return self.code == RESULT_GENERATION
+
+    @property
+    def key_exists(self) -> bool:
+        return self.code == RESULT_KEY_EXISTS
 
 
 def _digest(set_name: str, key: Any) -> bytes:
@@ -182,14 +188,18 @@ class AerospikeClient:
         return bins, gen
 
     def put(self, set_name: str, key: Any, bins: Dict[str, int],
-            generation: Optional[int] = None) -> None:
+            generation: Optional[int] = None,
+            create_only: bool = False) -> None:
         """Write integer bins; with generation, the write applies only
-        if the record's generation matches (CAS)."""
+        if the record's generation matches (CAS); with create_only, the
+        write fails with KEY_EXISTS if the record is already there."""
         info2 = INFO2_WRITE
         gen = 0
         if generation is not None:
             info2 |= INFO2_GENERATION
             gen = generation
+        if create_only:
+            info2 |= INFO2_CREATE_ONLY
         ops = [
             _op(OP_WRITE, name, _int_particle(v), PARTICLE_INT)
             for name, v in bins.items()

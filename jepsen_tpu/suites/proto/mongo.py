@@ -213,15 +213,17 @@ class MongoClient:
         return self.command(cmd)
 
     def find_and_modify(
-        self, coll: str, query: dict, update: dict, new=True, upsert=False
+        self, coll: str, query: dict, update: dict, new=True, upsert=False,
+        write_concern=None,
     ) -> Optional[dict]:
-        reply = self.command(
-            {
-                "findAndModify": coll,
-                "query": query,
-                "update": update,
-                "new": new,
-                "upsert": upsert,
-            }
-        )
+        cmd = {
+            "findAndModify": coll,
+            "query": query,
+            "update": update,
+            "new": new,
+            "upsert": upsert,
+        }
+        if write_concern:
+            cmd["writeConcern"] = write_concern
+        reply = self.command(cmd)
         return reply.get("value")

@@ -38,6 +38,7 @@ INSERT = 56
 DB_CREATE, TABLE_CREATE = 57, 60
 BRANCH = 65
 FUNC = 69
+CONFIG = 174  # table.config() → single-selection over table_config
 
 
 class ReqlError(ProtocolError):

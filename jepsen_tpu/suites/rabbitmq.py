@@ -156,27 +156,6 @@ class RabbitQueueClient(client_mod.Client):
             self.conn.close()
 
 
-def queue_workload(opts: Optional[dict] = None) -> dict:
-    counter = {"n": 0}
-
-    def enq(test, ctx):
-        counter["n"] += 1
-        return {"type": "invoke", "f": "enqueue", "value": counter["n"]}
-
-    def deq(test, ctx):
-        return {"type": "invoke", "f": "dequeue", "value": None}
-
-    final = gen.clients(
-        gen.each_thread(gen.once({"type": "invoke", "f": "drain",
-                                  "value": None}))
-    )
-    return {
-        "generator": gen.mix([enq, deq]),
-        "final-generator": final,
-        "checker": checker_mod.total_queue(),
-    }
-
-
 def db(opts: Optional[dict] = None):
     return RabbitDB(opts)
 
@@ -186,7 +165,7 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"queue": queue_workload(dict(opts or {}))}
+    return {"queue": common.queue_workload(dict(opts or {}))}
 
 
 def test(opts: Optional[dict] = None) -> dict:

@@ -109,14 +109,21 @@ class RethinkCasClient(client_mod.Client):
         return c
 
     def setup(self, test):
+        # replicate to every node with tunable write_acks, the
+        # configuration the reference applies (document_cas.clj:30-47
+        # set-write-acks! + table-create {:replicas N})
+        n = len(test.get("nodes", ["n1"]))
+        write_acks = self.opts.get("write-acks", "majority")
         for term in (
             [r.DB_CREATE, [DB]],
-            [r.TABLE_CREATE, [r.db(DB), TABLE]],
+            [r.TABLE_CREATE, [r.db(DB), TABLE], {"replicas": n}],
+            r.update([r.CONFIG, [self._tbl()]],
+                     {"__literal__": {"write_acks": write_acks}}),
         ):
             try:
                 self.conn.run(term)
             except (ReqlError, IndeterminateError):
-                pass  # already exists
+                pass  # already exists / config unsupported on old fakes
 
     def _tbl(self):
         return r.table(DB, TABLE)

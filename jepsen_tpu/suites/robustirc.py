@@ -39,12 +39,14 @@ class RobustIrcDB(common.DaemonDB):
     pidfile = f"{DIR}/robustirc.pid"
 
     def install(self, test, node):
+        # GOBIN pins the installed binary into DIR so start() finds it
         with control.su():
             control.execute(
                 "bash", "-c",
                 f"test -f {DIR}/{self.binary} || "
                 f"(mkdir -p {DIR} && cd {DIR} && "
-                "go install github.com/robustirc/robustirc@latest || true)",
+                f"GOBIN={DIR} go install "
+                "github.com/robustirc/robustirc@latest)",
                 check=False,
             )
 

@@ -1,0 +1,335 @@
+// faultfs: FUSE passthrough filesystem with runtime fault injection.
+//
+// The TPU-native equivalent of CharybdeFS (reference:
+// charybdefs/src/jepsen/charybdefs.clj:40-85, which builds ScyllaDB's
+// thrift-controlled FUSE fs on each DB node and mounts /faulty over
+// /real).  Same mechanism, no thrift: a control thread listens on a
+// TCP port for newline-delimited commands and every filesystem op
+// consults the fault table first.
+//
+// Control protocol (port 7656, one command per line, replies "OK"):
+//   all <errno>            every op fails with -errno
+//   prob <ppm> <errno>     each op fails with probability ppm/1e6
+//   clear                  passthrough (no faults)
+//
+// Build on the node (the wrapper does this):
+//   g++ -O2 -Wall faultfs.cc -o faultfs $(pkg-config fuse --cflags --libs) -lpthread
+// Mount:
+//   ./faultfs /faulty -oallow_other,nonempty -r /real
+
+#define FUSE_USE_VERSION 29
+#include <fuse.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <atomic>
+#include <random>
+#include <string>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+static std::string g_root;                 // backing directory (/real)
+static std::atomic<int> g_mode{0};         // 0=off 1=all 2=probability
+static std::atomic<int> g_errno{EIO};
+static std::atomic<int> g_ppm{0};          // failures per million ops
+static int g_ctl_port = 7656;
+
+static thread_local std::mt19937 tls_rng{std::random_device{}()};
+
+// Returns 0 to proceed, or a negative errno to inject.
+static int maybe_fail() {
+  int mode = g_mode.load(std::memory_order_relaxed);
+  if (mode == 0) return 0;
+  if (mode == 1) return -g_errno.load(std::memory_order_relaxed);
+  std::uniform_int_distribution<int> d(0, 999999);
+  if (d(tls_rng) < g_ppm.load(std::memory_order_relaxed))
+    return -g_errno.load(std::memory_order_relaxed);
+  return 0;
+}
+
+static std::string real_path(const char *path) { return g_root + path; }
+
+#define INJECT()                 \
+  do {                           \
+    int _f = maybe_fail();       \
+    if (_f != 0) return _f;      \
+  } while (0)
+
+// ---- passthrough ops -------------------------------------------------
+
+static int ff_getattr(const char *path, struct stat *st) {
+  INJECT();
+  return lstat(real_path(path).c_str(), st) == -1 ? -errno : 0;
+}
+
+static int ff_readlink(const char *path, char *buf, size_t size) {
+  INJECT();
+  ssize_t n = readlink(real_path(path).c_str(), buf, size - 1);
+  if (n == -1) return -errno;
+  buf[n] = '\0';
+  return 0;
+}
+
+static int ff_mknod(const char *path, mode_t mode, dev_t rdev) {
+  INJECT();
+  return mknod(real_path(path).c_str(), mode, rdev) == -1 ? -errno : 0;
+}
+
+static int ff_mkdir(const char *path, mode_t mode) {
+  INJECT();
+  return mkdir(real_path(path).c_str(), mode) == -1 ? -errno : 0;
+}
+
+static int ff_unlink(const char *path) {
+  INJECT();
+  return unlink(real_path(path).c_str()) == -1 ? -errno : 0;
+}
+
+static int ff_rmdir(const char *path) {
+  INJECT();
+  return rmdir(real_path(path).c_str()) == -1 ? -errno : 0;
+}
+
+static int ff_symlink(const char *from, const char *to) {
+  INJECT();
+  return symlink(from, real_path(to).c_str()) == -1 ? -errno : 0;
+}
+
+static int ff_rename(const char *from, const char *to) {
+  INJECT();
+  return rename(real_path(from).c_str(), real_path(to).c_str()) == -1
+             ? -errno : 0;
+}
+
+static int ff_link(const char *from, const char *to) {
+  INJECT();
+  return link(real_path(from).c_str(), real_path(to).c_str()) == -1
+             ? -errno : 0;
+}
+
+static int ff_chmod(const char *path, mode_t mode) {
+  INJECT();
+  return chmod(real_path(path).c_str(), mode) == -1 ? -errno : 0;
+}
+
+static int ff_chown(const char *path, uid_t uid, gid_t gid) {
+  INJECT();
+  return lchown(real_path(path).c_str(), uid, gid) == -1 ? -errno : 0;
+}
+
+static int ff_truncate(const char *path, off_t size) {
+  INJECT();
+  return truncate(real_path(path).c_str(), size) == -1 ? -errno : 0;
+}
+
+static int ff_utimens(const char *path, const struct timespec ts[2]) {
+  INJECT();
+  return utimensat(AT_FDCWD, real_path(path).c_str(), ts,
+                   AT_SYMLINK_NOFOLLOW) == -1 ? -errno : 0;
+}
+
+static int ff_open(const char *path, struct fuse_file_info *fi) {
+  INJECT();
+  int fd = open(real_path(path).c_str(), fi->flags);
+  if (fd == -1) return -errno;
+  fi->fh = fd;
+  return 0;
+}
+
+static int ff_create(const char *path, mode_t mode,
+                     struct fuse_file_info *fi) {
+  INJECT();
+  int fd = open(real_path(path).c_str(), fi->flags, mode);
+  if (fd == -1) return -errno;
+  fi->fh = fd;
+  return 0;
+}
+
+static int ff_read(const char *path, char *buf, size_t size, off_t off,
+                   struct fuse_file_info *fi) {
+  (void)path;
+  INJECT();
+  ssize_t n = pread(fi->fh, buf, size, off);
+  return n == -1 ? -errno : (int)n;
+}
+
+static int ff_write(const char *path, const char *buf, size_t size,
+                    off_t off, struct fuse_file_info *fi) {
+  (void)path;
+  INJECT();
+  ssize_t n = pwrite(fi->fh, buf, size, off);
+  return n == -1 ? -errno : (int)n;
+}
+
+static int ff_statfs(const char *path, struct statvfs *st) {
+  INJECT();
+  return statvfs(real_path(path).c_str(), st) == -1 ? -errno : 0;
+}
+
+static int ff_flush(const char *path, struct fuse_file_info *fi) {
+  (void)path;
+  INJECT();
+  return 0;
+}
+
+static int ff_release(const char *path, struct fuse_file_info *fi) {
+  (void)path;
+  close(fi->fh);
+  return 0;
+}
+
+static int ff_fsync(const char *path, int datasync,
+                    struct fuse_file_info *fi) {
+  (void)path;
+  INJECT();
+  int r = datasync ? fdatasync(fi->fh) : fsync(fi->fh);
+  return r == -1 ? -errno : 0;
+}
+
+static int ff_readdir(const char *path, void *buf, fuse_fill_dir_t filler,
+                      off_t off, struct fuse_file_info *fi) {
+  (void)off;
+  (void)fi;
+  INJECT();
+  DIR *dp = opendir(real_path(path).c_str());
+  if (dp == nullptr) return -errno;
+  struct dirent *de;
+  while ((de = readdir(dp)) != nullptr) {
+    struct stat st;
+    memset(&st, 0, sizeof(st));
+    st.st_ino = de->d_ino;
+    st.st_mode = (mode_t)(de->d_type << 12);
+    if (filler(buf, de->d_name, &st, 0)) break;
+  }
+  closedir(dp);
+  return 0;
+}
+
+static int ff_access(const char *path, int mask) {
+  INJECT();
+  return access(real_path(path).c_str(), mask) == -1 ? -errno : 0;
+}
+
+// ---- control thread --------------------------------------------------
+
+static void handle_command(char *line, char *reply, size_t reply_sz) {
+  char cmd[16] = {0};
+  long a = 0, b = 0;
+  int n = sscanf(line, "%15s %ld %ld", cmd, &a, &b);
+  if (n >= 1 && strcmp(cmd, "clear") == 0) {
+    g_mode.store(0);
+    snprintf(reply, reply_sz, "OK\n");
+  } else if (n >= 2 && strcmp(cmd, "all") == 0) {
+    g_errno.store((int)a);
+    g_mode.store(1);
+    snprintf(reply, reply_sz, "OK\n");
+  } else if (n >= 3 && strcmp(cmd, "prob") == 0) {
+    g_ppm.store((int)a);
+    g_errno.store((int)b);
+    g_mode.store(2);
+    snprintf(reply, reply_sz, "OK\n");
+  } else if (n >= 1 && strcmp(cmd, "status") == 0) {
+    snprintf(reply, reply_sz, "mode=%d errno=%d ppm=%d\n", g_mode.load(),
+             g_errno.load(), g_ppm.load());
+  } else {
+    snprintf(reply, reply_sz, "ERR unknown command\n");
+  }
+}
+
+static void *control_thread(void *) {
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) return nullptr;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)g_ctl_port);
+  if (bind(srv, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
+    fprintf(stderr, "faultfs: control bind failed: %s\n", strerror(errno));
+    close(srv);
+    return nullptr;
+  }
+  listen(srv, 8);
+  for (;;) {
+    int c = accept(srv, nullptr, nullptr);
+    if (c < 0) continue;
+    char buf[256] = {0};
+    ssize_t n = read(c, buf, sizeof(buf) - 1);
+    if (n > 0) {
+      char reply[128];
+      handle_command(buf, reply, sizeof(reply));
+      ssize_t w = write(c, reply, strlen(reply));
+      (void)w;
+    }
+    close(c);
+  }
+  return nullptr;
+}
+
+// ---- main ------------------------------------------------------------
+
+static struct fuse_operations ff_ops;
+
+int main(int argc, char *argv[]) {
+  // usage: faultfs <mountpoint> [fuse opts] -r <rootdir> [-p <ctl port>]
+  char *fuse_argv[32];
+  int fuse_argc = 0;
+  for (int i = 0; i < argc && fuse_argc < 30; i++) {
+    if (strcmp(argv[i], "-r") == 0 && i + 1 < argc) {
+      g_root = argv[++i];
+    } else if (strcmp(argv[i], "-p") == 0 && i + 1 < argc) {
+      g_ctl_port = atoi(argv[++i]);
+    } else {
+      fuse_argv[fuse_argc++] = argv[i];
+    }
+  }
+  if (g_root.empty()) {
+    fprintf(stderr, "usage: %s <mount> [-o opts] -r <rootdir> [-p port]\n",
+            argv[0]);
+    return 2;
+  }
+
+  memset(&ff_ops, 0, sizeof(ff_ops));
+  ff_ops.getattr = ff_getattr;
+  ff_ops.readlink = ff_readlink;
+  ff_ops.mknod = ff_mknod;
+  ff_ops.mkdir = ff_mkdir;
+  ff_ops.unlink = ff_unlink;
+  ff_ops.rmdir = ff_rmdir;
+  ff_ops.symlink = ff_symlink;
+  ff_ops.rename = ff_rename;
+  ff_ops.link = ff_link;
+  ff_ops.chmod = ff_chmod;
+  ff_ops.chown = ff_chown;
+  ff_ops.truncate = ff_truncate;
+  ff_ops.utimens = ff_utimens;
+  ff_ops.open = ff_open;
+  ff_ops.create = ff_create;
+  ff_ops.read = ff_read;
+  ff_ops.write = ff_write;
+  ff_ops.statfs = ff_statfs;
+  ff_ops.flush = ff_flush;
+  ff_ops.release = ff_release;
+  ff_ops.fsync = ff_fsync;
+  ff_ops.readdir = ff_readdir;
+  ff_ops.access = ff_access;
+
+  pthread_t ctl;
+  pthread_create(&ctl, nullptr, control_thread, nullptr);
+  pthread_detach(ctl);
+
+  return fuse_main(fuse_argc, fuse_argv, &ff_ops, nullptr);
+}

@@ -1479,7 +1479,10 @@ class _ReqlHandler(_RecvExact, socketserver.BaseRequestHandler):
                     "replaced": 1 if existed else 0, "errors": 0}
         if tid == 53:   # UPDATE
             sel = args[0]
-            # selector must be GET
+            if isinstance(sel, list) and sel[0] == 174:  # CONFIG update
+                return {"replaced": 1, "errors": 0}
+            if not (isinstance(sel, list) and sel[0] == 16):
+                raise _ReqlAbort("fake reql: UPDATE selector must be GET")
             tbl = self._eval(sel[1][0], row)
             key = self._eval(sel[1][1], row)
             k = f"reql:{tbl[1]}:{key}"
@@ -1534,6 +1537,8 @@ class _ReqlHandler(_RecvExact, socketserver.BaseRequestHandler):
                         reply = {"t": 1, "r": [result]}
                     except _ReqlAbort as e:
                         reply = {"t": 18, "r": [str(e)]}
+                    except Exception as e:  # keep the connection alive
+                        reply = {"t": 18, "r": [f"fake reql error: {e!r}"]}
                 out = json.dumps(reply).encode()
                 self.request.sendall(
                     struct.pack("<q", token) + struct.pack("<I", len(out))
@@ -1601,6 +1606,9 @@ class _AerospikeHandler(_RecvExact, socketserver.BaseRequestHandler):
                 with store.lock:
                     rec = store.as_records.get(digest)
                     if info2 & 0x01:  # write
+                        if info2 & 0x20 and rec is not None:  # create-only
+                            self._reply(5, rec[1], {})
+                            continue
                         if info2 & 0x04:  # generation check
                             cur_gen = rec[1] if rec else 0
                             if cur_gen != gen_req:

@@ -1,0 +1,124 @@
+"""faultfs wrapper tests: the C++ source compile-checks against a FUSE
+API stub, and the control-channel plumbing runs for real over the local
+remote against an in-process fault-table server speaking the faultfs
+control protocol."""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import subprocess
+import threading
+
+import pytest
+
+from jepsen_tpu import control, faultfs
+from jepsen_tpu.control.local import LocalRemote
+
+FUSE_STUB = """
+#pragma once
+#include <sys/types.h>
+#include <sys/stat.h>
+#include <cstdint>
+struct fuse_file_info { int flags; uint64_t fh; };
+typedef int (*fuse_fill_dir_t)(void *, const char *, const struct stat *,
+                               off_t);
+struct fuse_operations {
+  int (*getattr)(const char *, struct stat *);
+  int (*readlink)(const char *, char *, size_t);
+  int (*mknod)(const char *, mode_t, dev_t);
+  int (*mkdir)(const char *, mode_t);
+  int (*unlink)(const char *);
+  int (*rmdir)(const char *);
+  int (*symlink)(const char *, const char *);
+  int (*rename)(const char *, const char *);
+  int (*link)(const char *, const char *);
+  int (*chmod)(const char *, mode_t);
+  int (*chown)(const char *, uid_t, gid_t);
+  int (*truncate)(const char *, off_t);
+  int (*utimens)(const char *, const struct timespec [2]);
+  int (*open)(const char *, struct fuse_file_info *);
+  int (*create)(const char *, mode_t, struct fuse_file_info *);
+  int (*read)(const char *, char *, size_t, off_t, struct fuse_file_info *);
+  int (*write)(const char *, const char *, size_t, off_t,
+               struct fuse_file_info *);
+  int (*statfs)(const char *, struct statvfs *);
+  int (*flush)(const char *, struct fuse_file_info *);
+  int (*release)(const char *, struct fuse_file_info *);
+  int (*fsync)(const char *, int, struct fuse_file_info *);
+  int (*readdir)(const char *, void *, fuse_fill_dir_t, off_t,
+                 struct fuse_file_info *);
+  int (*access)(const char *, int);
+};
+static inline int fuse_main(int, char **, const struct fuse_operations *,
+                            void *) { return 0; }
+"""
+
+
+def test_faultfs_source_compiles(tmp_path):
+    """g++ syntax/type check against the FUSE 2.9 API surface (real
+    libfuse headers only exist on DB nodes, where install() builds it —
+    reference: charybdefs.clj:41-65)."""
+    stub_dir = tmp_path / "fuse"
+    stub_dir.mkdir()
+    (stub_dir / "fuse.h").write_text(FUSE_STUB)
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "faultfs.cc")
+    res = subprocess.run(
+        ["g++", "-fsyntax-only", "-Wall", "-Werror", f"-I{stub_dir}", src],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stderr
+
+
+class _FaultTable(socketserver.StreamRequestHandler):
+    """Speaks the faultfs control protocol, mirroring handle_command."""
+
+    def handle(self):
+        line = self.rfile.readline().decode().split()
+        state = self.server.state
+        if not line:
+            return
+        if line[0] == "clear":
+            state.update(mode=0)
+            self.wfile.write(b"OK\n")
+        elif line[0] == "all" and len(line) == 2:
+            state.update(mode=1, errno=int(line[1]))
+            self.wfile.write(b"OK\n")
+        elif line[0] == "prob" and len(line) == 3:
+            state.update(mode=2, ppm=int(line[1]), errno=int(line[2]))
+            self.wfile.write(b"OK\n")
+        elif line[0] == "status":
+            self.wfile.write(
+                f"mode={state['mode']} errno={state.get('errno', 5)} "
+                f"ppm={state.get('ppm', 0)}\n".encode())
+        else:
+            self.wfile.write(b"ERR unknown command\n")
+
+
+@pytest.fixture()
+def fault_table(monkeypatch):
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _FaultTable)
+    srv.state = {"mode": 0}
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setattr(faultfs, "CTL_PORT", srv.server_address[1])
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_faultfs_control_commands(fault_table):
+    test = {"nodes": ["n1"], "ssh": {}}
+    with control.with_session(test, LocalRemote()):
+        def run():
+            faultfs.break_all()
+            assert fault_table.state["mode"] == 1
+            assert fault_table.state["errno"] == 5
+            faultfs.break_one_percent()
+            assert fault_table.state["mode"] == 2
+            assert fault_table.state["ppm"] == 10000
+            assert "mode=2" in faultfs.status()
+            faultfs.clear()
+            assert fault_table.state["mode"] == 0
+        control.with_node("n1", run)
