@@ -1,0 +1,14 @@
+#!/bin/bash
+# Generate the cluster SSH keypair, publish the public half to the
+# shared volume for the nodes, then idle so bin/console can attach.
+# (reference: docker/control/init.sh)
+set -eu
+if [ ! -f /root/.ssh/id_rsa ]; then
+  mkdir -p /root/.ssh
+  ssh-keygen -t rsa -N "" -f /root/.ssh/id_rsa
+  printf 'Host n*\n  StrictHostKeyChecking no\n  User root\n' \
+    > /root/.ssh/config
+fi
+cp /root/.ssh/id_rsa.pub /var/jepsen/shared/id_rsa.pub
+echo "jepsen_tpu control node ready; DB nodes: n1..nN"
+exec sleep infinity

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Wait for the control node's SSH public key in the shared volume, then
+# authorize it and run sshd in the foreground.
+# (reference: docker/node/setup-jepsen.sh)
+set -eu
+mkdir -p /root/.ssh
+chmod 700 /root/.ssh
+for i in $(seq 1 120); do
+  if [ -f /var/jepsen/shared/id_rsa.pub ]; then
+    cat /var/jepsen/shared/id_rsa.pub >> /root/.ssh/authorized_keys
+    chmod 600 /root/.ssh/authorized_keys
+    break
+  fi
+  sleep 1
+done
+exec /usr/sbin/sshd -D

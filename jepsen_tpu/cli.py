@@ -60,8 +60,9 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--nodes-file", help="file with one node per line")
     p.add_argument(
         "--concurrency",
-        default="1n",
-        help='number of workers, or "<k>n" for k × node count (default 1n)',
+        default=None,
+        help='number of workers, or "<k>n" for k × node count '
+        "(default 1n, unless the workload needs more)",
     )
     p.add_argument(
         "--time-limit",
@@ -100,7 +101,6 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
     nodes = parse_nodes(args)
     test = {
         "nodes": nodes,
-        "concurrency": parse_concurrency(args.concurrency, len(nodes)),
         "time-limit": args.time_limit,
         "store-base": args.store_base,
         "leave-db-running?": args.leave_db_running,
@@ -111,6 +111,8 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
             "private-key-path": args.ssh_private_key,
         },
     }
+    if args.concurrency is not None:
+        test["concurrency"] = parse_concurrency(args.concurrency, len(nodes))
     if args.dummy:
         from .control.core import DummyRemote
 
@@ -284,15 +286,42 @@ def default_commands() -> Dict[str, dict]:
 
     def add_workload_opt(p):
         p.add_argument(
+            "--suite",
+            help="DB suite to run against real nodes (e.g. etcd, "
+            "cockroachdb; see jepsen_tpu.suites.SUITES).  Without "
+            "--suite, the workload runs in-process against the "
+            "in-memory fake client.",
+        )
+        p.add_argument(
             "--workload",
-            default="linearizable-register",
-            help="workload name (see jepsen_tpu.workloads.workload)",
+            default=None,
+            help="workload name (suite-specific with --suite; see "
+            "jepsen_tpu.workloads.workload otherwise)",
+        )
+        p.add_argument(
+            "--faults",
+            help="comma-separated nemesis faults for --suite runs "
+            "(partition,kill,pause,clock,disk)",
+        )
+        p.add_argument(
+            "--rate",
+            type=float,
+            help="target ops/sec for --suite runs",
         )
         p.add_argument(
             "--per-key-limit",
             type=int,
             default=32,
             help="ops per independent key before rotating to a fresh one",
+        )
+        p.add_argument(
+            "-o",
+            "--opt",
+            action="append",
+            default=[],
+            metavar="KEY=VALUE",
+            help="extra suite option (repeatable), e.g. -o version=v3.1.5 "
+            "-o port=2379; ints parse as ints",
         )
 
     def make_test(opts: dict) -> dict:
@@ -303,6 +332,28 @@ def default_commands() -> Dict[str, dict]:
         opts = dict(opts)
         if "per_key_limit" in opts:
             opts.setdefault("per-key-limit", opts.pop("per_key_limit"))
+
+        for kv in opts.pop("opt", []) or []:
+            k, _, v = kv.partition("=")
+            try:
+                opts[k] = int(v)
+            except ValueError:
+                opts[k] = v
+
+        if opts.get("suite"):
+            from . import suites
+
+            if opts.get("faults"):
+                opts["faults"] = [
+                    f for f in str(opts["faults"]).split(",") if f
+                ]
+            else:
+                opts["faults"] = []
+            if not opts.get("workload"):
+                opts.pop("workload", None)  # let the suite pick its default
+            return suites.suite(opts["suite"]).test(opts)
+
+        opts.setdefault("workload", "linearizable-register")
         wl = workloads.workload(opts["workload"], opts)
         g = wl.get("generator")
         if opts.get("time-limit"):

@@ -122,7 +122,11 @@ class FaultFsNemesis(Nemesis):
         return self
 
     def _targets(self, test, value) -> Iterable[Any]:
-        return list(value) if value else list(test["nodes"])
+        if not value:
+            return list(test["nodes"])
+        if isinstance(value, str):  # a single node name, not a list
+            return [value]
+        return list(value)
 
     def invoke(self, test, op):
         nodes = self._targets(test, op.get("value"))

@@ -333,11 +333,47 @@ def compose_packages(packages: Iterable[dict]) -> dict:
     }
 
 
+def disk_package(opts: dict) -> dict:
+    """Disk faults via the faultfs FUSE filesystem: probabilistic
+    breakage flip-flopped with heals, everything healed at the end.
+    (no reference analogue in combined.clj — charybdefs is wired
+    manually there; here "disk" is a first-class fault name)"""
+    faults = set(opts.get("faults", ()))
+    if "disk" not in faults:
+        return dict(NOOP_PACKAGE)
+    from .. import faultfs
+
+    targets = opts.get("disk", {}).get("targets")
+
+    def break_op(test, ctx):
+        nodes = targets or random_nonempty_subset(test["nodes"], _rng())
+        return {"type": "info", "f": "break-disk-slow", "value": list(nodes)}
+
+    heal = {"type": "info", "f": "heal-disk", "value": None}
+    return {
+        "generator": gen.stagger(
+            opts.get("interval", DEFAULT_INTERVAL),
+            gen.flip_flop(break_op, gen.repeat(heal)),
+        ),
+        "final_generator": [heal],
+        "nemesis": faultfs.FaultFsNemesis(),
+        "perf": {
+            ("disk", frozenset({"break-disk", "break-disk-slow"}),
+             frozenset({"heal-disk"}), "#E9D3A0"),
+        },
+    }
+
+
 def nemesis_packages(opts: dict) -> List[dict]:
     """(reference: combined.clj:318-326)"""
     faults = set(opts.get("faults", ["partition", "kill", "pause", "clock"]))
     opts = {**opts, "faults": faults}
-    return [partition_package(opts), clock_package(opts), db_package(opts)]
+    return [
+        partition_package(opts),
+        clock_package(opts),
+        db_package(opts),
+        disk_package(opts),
+    ]
 
 
 def nemesis_package(opts: dict) -> dict:

@@ -198,8 +198,6 @@ class CounterClient(_AsBase):
         except IndeterminateError as e:
             return {**op, "type": "info", "error": str(e)}
         except AerospikeError as e:
-            if e.generation_mismatch:
-                return {**op, "type": "fail", "error": "lost-increment-race"}
             return {**op, "type": "fail", "error": str(e)}
 
 

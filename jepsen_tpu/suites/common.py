@@ -265,7 +265,7 @@ def build_test(
     if "nodes" in opts:
         test["nodes"] = list(opts["nodes"])
     test.update({k: v for k, v in workload.items() if k not in ("generator", "final-generator", "checker")})
-    if "concurrency" in opts:
+    if opts.get("concurrency") is not None:
         test["concurrency"] = opts["concurrency"]
 
     checker = workload.get("checker") or checker_mod.unbridled_optimism()

@@ -22,7 +22,6 @@ from typing import Any, Optional
 
 from .. import checker as checker_mod
 from .. import client as client_mod
-from .. import generator as gen
 from ..control import util as cu
 from ..control import execute, sudo
 from ..os_setup import debian

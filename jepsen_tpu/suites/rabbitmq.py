@@ -16,11 +16,9 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from .. import checker as checker_mod
 from .. import client as client_mod
 from .. import codec
 from .. import control
-from .. import generator as gen
 from ..control import util as cu
 from ..os_setup import debian
 from . import common

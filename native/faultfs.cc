@@ -255,7 +255,9 @@ static void *control_thread(void *) {
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  // loopback only: the wrapper always connects from the node itself,
+  // and an open fault port would let anyone break/heal disks mid-test
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
   addr.sin_port = htons((uint16_t)g_ctl_port);
   if (bind(srv, (struct sockaddr *)&addr, sizeof(addr)) != 0) {
     fprintf(stderr, "faultfs: control bind failed: %s\n", strerror(errno));

@@ -149,3 +149,22 @@ def test_report_to(tmp_path, capsys):
         print("report line")
     assert "report line" in open(p).read()
     assert "report line" in capsys.readouterr().out
+
+
+def test_cli_suite_run(tmp_path):
+    """`test --suite etcd` drives a real suite client against the fake
+    server through the full CLI path, exiting 0 on a valid run."""
+    from fake_servers import FakeHttpKv
+    from jepsen_tpu import cli
+
+    s = FakeHttpKv().start()
+    try:
+        rc = cli.run_cli(cli.default_commands(), [
+            "test", "--suite", "etcd", "--workload", "register",
+            "--nodes", "n1,n2,n3", "--dummy", "--time-limit", "1",
+            "--rate", "40", "--store-base", str(tmp_path),
+            "-o", "host=127.0.0.1", "-o", f"port={s.port}",
+        ])
+    finally:
+        s.stop()
+    assert rc == 0
