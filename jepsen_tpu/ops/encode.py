@@ -36,16 +36,23 @@ from .step_kernels import ModelSpec, spec_for
 
 DEFAULT_SLOT_CAP = 32
 
+#: value ids ride int16 lanes to halve HBM/PCIe traffic for the event
+#: stream; histories with more distinct values fall back to the oracle
+MAX_VALUE_ID = 32_000
+
 
 @dataclass
 class EncodedHistory:
     init_state: int
     ev_slot: np.ndarray      # [E] int32
-    cand_slot: np.ndarray    # [E, C] int32
-    cand_f: np.ndarray       # [E, C] int32
-    cand_a: np.ndarray       # [E, C] int32
-    cand_b: np.ndarray       # [E, C] int32
+    cand_slot: np.ndarray    # [E, C] int8 (-1 = unused lane)
+    cand_f: np.ndarray       # [E, C] int8
+    cand_a: np.ndarray       # [E, C] int16
+    cand_b: np.ndarray       # [E, C] int16
     n_ops: int
+    #: peak concurrently-open op count — every slot id used is < this, so
+    #: the batch can trim candidate lanes (and linset bits) down to it
+    max_open: int = 0
 
 
 @dataclass
@@ -83,23 +90,27 @@ def encode_history(
         enc_ops = [spec.encode_op(op, valmap) for op in ops]
     except ValueError:
         return None
+    if len(valmap) > MAX_VALUE_ID:
+        return None  # value ids would overflow the int16 lanes
 
     E = sum(1 for kind, _ in events if kind == "ok")
     C = slot_cap
     ev_slot_arr = np.full((E,), -1, np.int32)
-    cand_slot = np.full((E, C), -1, np.int32)
-    cand_f = np.zeros((E, C), np.int32)
-    cand_a = np.zeros((E, C), np.int32)
-    cand_b = np.zeros((E, C), np.int32)
+    cand_slot = np.full((E, C), -1, np.int8)
+    cand_f = np.zeros((E, C), np.int8)
+    cand_a = np.zeros((E, C), np.int16)
+    cand_b = np.zeros((E, C), np.int16)
 
     slot_of: Dict[int, int] = {}
     free = sorted(range(slot_cap), reverse=True)  # pop() takes smallest
     row = 0
+    max_open = 0
     for kind, op_id in events:
         if kind == "invoke":
             if not free:
                 return None  # too many concurrently-open ops
             slot_of[op_id] = free.pop()
+            max_open = max(max_open, len(slot_of))
         elif kind == "ok":
             # snapshot of open ops (incl. the completing one) BEFORE filter
             for lane, oid in enumerate(sorted(slot_of.keys())):
@@ -122,6 +133,7 @@ def encode_history(
         cand_a=cand_a,
         cand_b=cand_b,
         n_ops=len(ops),
+        max_open=max_open,
     )
 
 
@@ -156,32 +168,35 @@ def batch_encode(
         return EncodedBatch(
             init_state=np.zeros((0,), np.int32),
             ev_slot=np.zeros((0, 0), np.int32),
-            cand_slot=np.zeros((0, 0, slot_cap), np.int32),
-            cand_f=np.zeros((0, 0, slot_cap), np.int32),
-            cand_a=np.zeros((0, 0, slot_cap), np.int32),
-            cand_b=np.zeros((0, 0, slot_cap), np.int32),
+            cand_slot=np.zeros((0, 0, slot_cap), np.int8),
+            cand_f=np.zeros((0, 0, slot_cap), np.int8),
+            cand_a=np.zeros((0, 0, slot_cap), np.int16),
+            cand_b=np.zeros((0, 0, slot_cap), np.int16),
             fallback=fallback,
             row_history=rows,
         )
 
     E = round_up(max(e.ev_slot.shape[0] for e in encoded), event_bucket)
     B = len(encoded)
-    C = slot_cap
+    # candidate lanes bucket to the batch's actual peak concurrency (every
+    # slot id used is < max_open), not the slot cap — this shrinks the
+    # frontier-expansion width and sort size, usually the dominant cost
+    C = min(slot_cap, round_up(max(e.max_open for e in encoded), 4))
 
     init_state = np.zeros((B,), np.int32)
     ev_slot = np.full((B, E), -1, np.int32)
-    cand_slot = np.full((B, E, C), -1, np.int32)
-    cand_f = np.zeros((B, E, C), np.int32)
-    cand_a = np.zeros((B, E, C), np.int32)
-    cand_b = np.zeros((B, E, C), np.int32)
+    cand_slot = np.full((B, E, C), -1, np.int8)
+    cand_f = np.zeros((B, E, C), np.int8)
+    cand_a = np.zeros((B, E, C), np.int16)
+    cand_b = np.zeros((B, E, C), np.int16)
     for bi, e in enumerate(encoded):
         n = e.ev_slot.shape[0]
         init_state[bi] = e.init_state
         ev_slot[bi, :n] = e.ev_slot
-        cand_slot[bi, :n] = e.cand_slot
-        cand_f[bi, :n] = e.cand_f
-        cand_a[bi, :n] = e.cand_a
-        cand_b[bi, :n] = e.cand_b
+        cand_slot[bi, :n] = e.cand_slot[:, :C]
+        cand_f[bi, :n] = e.cand_f[:, :C]
+        cand_a[bi, :n] = e.cand_a[:, :C]
+        cand_b[bi, :n] = e.cand_b[:, :C]
 
     return EncodedBatch(
         init_state=init_state,

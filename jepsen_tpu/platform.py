@@ -1,0 +1,39 @@
+"""Backend/platform selection guards.
+
+The environment's TPU plugin (a sitecustomize hook) forces
+``JAX_PLATFORMS`` to its own platform regardless of env vars, so a plain
+environment override cannot select the CPU backend.  The working recipe,
+shared by the test conftest, the driver entrypoints, and the benchmark's
+fallback path, is:
+
+1. set ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` *before*
+   the first backend use (required for virtual CPU devices to apply), and
+2. override ``jax_platforms`` via ``jax.config`` *after* importing jax.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Force jax onto the CPU backend, optionally with ``n_devices``
+    virtual host devices.  Must run before the first jax backend use
+    (device queries, array ops); importing jax beforehand is fine."""
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            # rewrite a stale value (e.g. =1 inherited from another harness)
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

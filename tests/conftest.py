@@ -13,17 +13,11 @@ backend use for the virtual device count to apply.
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 

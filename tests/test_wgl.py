@@ -169,6 +169,17 @@ def test_truncated_closure_reports_unknown_not_invalid():
     assert out["valid?"] is True
 
 
+def test_overflow_escalates_on_device_before_oracle():
+    # tiny frontier overflows; the escalation ladder (frontier*4) must
+    # resolve it on-device with the right verdict
+    rng = random.Random(7)
+    hists = [_gen(rng, n_procs=5, n_ops=30) for _ in range(6)]
+    model = m.cas_register(0)
+    outs = wgl.check_batch(model, hists, frontier=2, escalation=(4, 16))
+    oracle = [linear.analysis(model, h0, pure_fs=("read",))["valid?"] for h0 in hists]
+    assert [o["valid?"] for o in outs] == oracle
+
+
 def test_batch_with_fallback_rows():
     # a history that exceeds the slot cap rides the oracle instead
     model = m.register(None)
