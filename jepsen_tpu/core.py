@@ -97,6 +97,66 @@ def run_case(test: dict) -> History:
             real_pmap(teardown_and_close, opened)
 
 
+_snarf_lock = threading.Lock()
+
+
+def snarf_logs(test: dict) -> None:
+    """Download every DB log file (db.LogFiles) from every node into the
+    test's store directory under ``<store>/<node>/<short-path>``, where
+    short paths drop the nodes' common directory prefix.  Worker crashes
+    and missing files are tolerated per-file so one broken node can't
+    lose the others' logs.  (reference: core.clj:102-135 snarf-logs!)"""
+    from . import control
+    from . import db as db_mod
+    from . import store as store_mod
+
+    db = test.get("db")
+    if not isinstance(db, db_mod.LogFiles) or not test.get("store?", True):
+        return
+
+    with _snarf_lock:
+        log.info("Snarfing log files")
+
+        def snarf_node(test, node):
+            try:
+                full_paths = [str(p) for p in db.log_files(test, node)]
+            except Exception:
+                log.exception("couldn't list log files on %s", node)
+                return
+            if not full_paths:
+                return
+            from .util import drop_common_proper_prefix
+
+            shorts = [
+                "/".join(parts)
+                for parts in drop_common_proper_prefix(
+                    [p.split("/") for p in full_paths]
+                )
+            ]
+            for remote, short in zip(full_paths, shorts):
+                dest = store_mod.path_(
+                    test, str(node), short.lstrip("/")
+                )
+                try:
+                    control.download(remote, dest)
+                except Exception as e:
+                    # tolerate vanished files / broken pipes: logs are
+                    # best-effort diagnostics, never a reason to fail
+                    log.info("couldn't download %s from %s: %s", remote, node, e)
+
+        control.on_nodes(test, snarf_node)
+
+
+def maybe_snarf_logs(test: dict) -> None:
+    """snarf_logs, swallowing everything — used on the abort path where
+    a snarf error must not supersede the root cause.
+    (reference: core.clj:137-148 maybe-snarf-logs!)"""
+    try:
+        snarf_logs(test)
+    except Exception:
+        log.exception("Error snarfing logs")
+
+
 def analyze(test: dict) -> dict:
     """Index the history, run checkers, attach results.
     (reference: core.clj:221-237)"""
@@ -167,12 +227,18 @@ def _run_body(test: dict) -> dict:
         if db is not None:
             db_mod.cycle(test)
         try:
-            with with_relative_time():
-                history = run_case(test)
-            test = {**test, "history": history}
-            if storing:
-                test = store_mod.save_1(test)
-            return analyze(test)
+            try:
+                with with_relative_time():
+                    history = run_case(test)
+                test = {**test, "history": history}
+                if storing:
+                    test = store_mod.save_1(test)
+                return analyze(test)
+            finally:
+                # before DB teardown (which may delete the logs), on both
+                # success and abort (reference: core.clj:150-170
+                # with-log-snarfing)
+                maybe_snarf_logs(test)
         finally:
             if db is not None and not test.get("leave-db-running?"):
                 _on_nodes(test, lambda node: db.teardown(test, node))

@@ -91,7 +91,7 @@ def _compact(states, linsets, valid, F):
 
 def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
     """Build the (unjitted) vmapped checker for fixed shapes; jit it
-    yourself or use _make_check_fn for the cached jitted version."""
+    yourself or use make_check_fn for the cached jitted version."""
     spec = next(s for s in _all_specs() if s.name == spec_name)
     step = spec.step
 
@@ -191,10 +191,6 @@ def make_check_fn(spec_name: str, E: int, C: int, F: int, max_closure: int):
     """Jitted, cached version of build_batched — repeat batches at the
     same bucket sizes reuse the compiled executable."""
     return jax.jit(build_batched(spec_name, E, C, F, max_closure))
-
-
-# backwards-compatible private alias
-_make_check_fn = make_check_fn
 
 
 def _all_specs():

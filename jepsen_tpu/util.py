@@ -270,3 +270,17 @@ def log_op(op) -> str:
 def fraction(a: float, b: float) -> float:
     """a/b, but 0 when b is 0."""
     return a / b if b else 0.0
+
+
+def drop_common_proper_prefix(colls):
+    """Drop the longest common *proper* prefix from each collection: at
+    least one element of every collection is always kept.
+    (reference: util.clj drop-common-proper-prefix, used by snarf-logs!)"""
+    colls = [list(c) for c in colls]
+    if not colls:
+        return []
+    limit = min(len(c) for c in colls) - 1
+    k = 0
+    while k < limit and all(c[k] == colls[0][k] for c in colls):
+        k += 1
+    return [c[k:] for c in colls]

@@ -259,3 +259,44 @@ def test_core_run_persists(tmp_path):
         os.path.join(str(tmp_path / "store"), "persisted",
                      result["start-time"], "jepsen.log")
     )
+
+
+def test_core_run_snarfs_db_logs(tmp_path):
+    """After a run, every db.LogFiles path is downloaded into the store
+    dir under <node>/<short-path> — including when one node's listing
+    crashes (reference: core.clj:102-135 snarf-logs!)."""
+    from jepsen_tpu import core, db as db_mod, fake
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import checker as checker_mod
+    from jepsen_tpu.control import local as local_mod
+
+    logdir = tmp_path / "dblogs"
+    logdir.mkdir()
+
+    class LoggingDB(db_mod.DB, db_mod.LogFiles):
+        def setup(self, test, node):
+            (logdir / f"{node}.log").write_text(f"log of {node}\n")
+
+        def log_files(self, test, node):
+            if node == "n2":
+                raise RuntimeError("node n2 exploded")
+            return [str(logdir / f"{node}.log")]
+
+    state = fake.AtomState(0)
+    t = {
+        "name": "snarfed",
+        "store-base": str(tmp_path / "store"),
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 3,
+        "db": LoggingDB(),
+        "remote": local_mod.local(),
+        "client": fake.AtomClient(state, latency=0.0),
+        "generator": gen.clients(gen.limit(6, gen.repeat({"f": "read"}))),
+        "checker": checker_mod.stats(),
+    }
+    result = core.run(t)
+    base = os.path.join(str(tmp_path / "store"), "snarfed", result["start-time"])
+    assert open(os.path.join(base, "n1", "n1.log")).read() == "log of n1\n"
+    assert open(os.path.join(base, "n3", "n3.log")).read() == "log of n3\n"
+    # the crashing node is tolerated and simply has no logs
+    assert not os.path.exists(os.path.join(base, "n2", "n2.log"))
