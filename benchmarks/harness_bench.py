@@ -1,0 +1,102 @@
+"""Microbenchmark: harness-loop throughput.
+
+Two numbers, mirroring the reference's anchor of >20k ops/sec through
+the pure generator on one thread (jepsen/src/jepsen/generator.clj:67-70):
+
+1. pure-generator ops/sec — op/update cycles through a realistic
+   combinator stack with a synthetic context, no threads.
+2. interpreter ops/sec — the real event loop (worker threads, queues)
+   against a zero-latency in-memory client.
+
+Run: python benchmarks/harness_bench.py [n_ops]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.platform import force_cpu_platform
+
+force_cpu_platform()
+
+from jepsen_tpu import fake, interpreter
+from jepsen_tpu import generator as gen
+
+
+def bench_pure_generator(n_ops: int) -> float:
+    """Drive op/update by hand with an immediately-completing fake
+    scheduler, like the reference's claim measures the generator alone."""
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"], "concurrency": 10}
+    g = gen.clients(
+        gen.limit(
+            n_ops,
+            gen.mix(
+                [
+                    gen.repeat({"f": "read"}),
+                    gen.repeat({"f": "write", "value": 3}),
+                ]
+            ),
+        )
+    )
+    ctx = gen.context(test)
+    done = 0
+    t0 = time.perf_counter()
+    while True:
+        res = gen.op(g, test, ctx)
+        if res is None:
+            break
+        op, g = res
+        if op == gen.PENDING:
+            # all threads busy: complete every outstanding op
+            raise RuntimeError("unexpected pending in immediate-mode bench")
+        thread = gen.process_to_thread(ctx, op["process"])
+        ctx = {
+            **ctx,
+            "time": op["time"],
+            "free_threads": tuple(t for t in ctx["free_threads"] if t != thread),
+        }
+        g = gen.update(g, test, ctx, op)
+        # immediate completion
+        done_op = {**op, "type": "ok", "time": op["time"] + 1}
+        ctx = {
+            **ctx,
+            "time": done_op["time"],
+            "free_threads": tuple(ctx["free_threads"]) + (thread,),
+        }
+        g = gen.update(g, test, ctx, done_op)
+        done += 2  # invoke + complete both flow through update
+    elapsed = time.perf_counter() - t0
+    return done / elapsed
+
+
+def bench_interpreter(n_ops: int) -> float:
+    state = fake.AtomState(0)
+    test = {
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 10,
+        "client": fake.AtomClient(state, latency=0.0),
+        "nemesis": None,
+        "generator": gen.clients(
+            gen.limit(n_ops, gen.repeat({"f": "read"}))
+        ),
+    }
+    from jepsen_tpu import core
+
+    test = core.prepare_test(test)
+    from jepsen_tpu.util import with_relative_time
+
+    t0 = time.perf_counter()
+    with with_relative_time():
+        history = interpreter.run(test)
+    elapsed = time.perf_counter() - t0
+    return len(history) / elapsed
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+    pure = bench_pure_generator(n)
+    interp = bench_interpreter(n)
+    print(f"pure-generator: {pure:,.0f} events/sec (target >40k = 20k ops with invoke+complete)")
+    print(f"interpreter:    {interp:,.0f} history-events/sec")

@@ -133,18 +133,25 @@ def snarf_logs(test: dict) -> None:
                     [p.split("/") for p in full_paths]
                 )
             ]
+            from .control import RemoteError
+
             for remote, short in zip(full_paths, shorts):
                 dest = store_mod.path_(
                     test, str(node), short.lstrip("/")
                 )
                 try:
                     control.download(remote, dest)
-                except Exception as e:
-                    # tolerate vanished files / broken pipes: logs are
-                    # best-effort diagnostics, never a reason to fail
+                except (FileNotFoundError, RemoteError) as e:
+                    # tolerate vanished remote files / broken transfers
+                    # (reference tolerates pipe-closed and not-yet-created
+                    # files, core.clj:119-134); local store errors like a
+                    # full disk still propagate
                     log.info("couldn't download %s from %s: %s", remote, node, e)
 
         control.on_nodes(test, snarf_node)
+        # an aborted run never reaches save_1, so refresh the symlinks
+        # here too (reference: core.clj:135 update-symlinks!)
+        store_mod.update_symlinks(test)
 
 
 def maybe_snarf_logs(test: dict) -> None:
@@ -233,12 +240,17 @@ def _run_body(test: dict) -> dict:
                 test = {**test, "history": history}
                 if storing:
                     test = store_mod.save_1(test)
-                return analyze(test)
-            finally:
-                # before DB teardown (which may delete the logs), on both
-                # success and abort (reference: core.clj:150-170
+                result = analyze(test)
+                # success path: snarf errors (e.g. unwritable store)
+                # propagate rather than silently losing all DB logs
+                snarf_logs(test)
+                return result
+            except BaseException:
+                # abort path, before DB teardown deletes the logs; must
+                # not supersede the root cause (reference: core.clj:150-170
                 # with-log-snarfing)
                 maybe_snarf_logs(test)
+                raise
         finally:
             if db is not None and not test.get("leave-db-running?"):
                 _on_nodes(test, lambda node: db.teardown(test, node))
