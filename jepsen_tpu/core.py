@@ -133,15 +133,23 @@ def snarf_logs(test: dict) -> None:
                     [p.split("/") for p in full_paths]
                 )
             ]
+            import subprocess
+
             from .control import RemoteError
 
+            transfer_errors = (
+                FileNotFoundError,
+                RemoteError,
+                # docker/k8s remotes raise CalledProcessError when cp fails
+                subprocess.CalledProcessError,
+            )
             for remote, short in zip(full_paths, shorts):
                 dest = store_mod.path_(
                     test, str(node), short.lstrip("/")
                 )
                 try:
                     control.download(remote, dest)
-                except (FileNotFoundError, RemoteError) as e:
+                except transfer_errors as e:
                     # tolerate vanished remote files / broken transfers
                     # (reference tolerates pipe-closed and not-yet-created
                     # files, core.clj:119-134); local store errors like a
@@ -241,16 +249,17 @@ def _run_body(test: dict) -> dict:
                 if storing:
                     test = store_mod.save_1(test)
                 result = analyze(test)
-                # success path: snarf errors (e.g. unwritable store)
-                # propagate rather than silently losing all DB logs
-                snarf_logs(test)
-                return result
             except BaseException:
                 # abort path, before DB teardown deletes the logs; must
                 # not supersede the root cause (reference: core.clj:150-170
                 # with-log-snarfing)
                 maybe_snarf_logs(test)
                 raise
+            # success path: snarf errors (e.g. unwritable store) propagate
+            # rather than silently losing all DB logs — but outside the
+            # except above, so they can't trigger a second snarf
+            snarf_logs(test)
+            return result
         finally:
             if db is not None and not test.get("leave-db-running?"):
                 _on_nodes(test, lambda node: db.teardown(test, node))

@@ -66,6 +66,29 @@ def mutex_step(state, f, a, b):
     return state2, ok
 
 
+#: multi-register packing: up to 4 registers, 8-bit value ids each, in
+#: one int32 state word.  Wider maps fall back to the CPU oracle.
+MR_REGISTERS = 4
+MR_VALUE_BITS = 8
+MR_MAX_VALUE_ID = (1 << MR_VALUE_BITS) - 1
+
+
+def multi_register_step(state, f, a, b):
+    """Single-mop multi-register: b = register index, a = value id; the
+    int32 state packs MR_REGISTERS byte-wide registers.
+    (oracle: models.MultiRegister)"""
+    sh = (b.astype(jnp.int32) & (MR_REGISTERS - 1)) * MR_VALUE_BITS
+    mask = jnp.int32(MR_MAX_VALUE_ID) << sh
+    cur = (state >> sh) & MR_MAX_VALUE_ID
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_read_any = f == F_READ_ANY
+    ok = is_write | is_read_any | (is_read & (cur == a))
+    written = (state & ~mask) | ((a.astype(jnp.int32) & MR_MAX_VALUE_ID) << sh)
+    state2 = jnp.where(is_write, written, state)
+    return state2, ok
+
+
 @dataclass(frozen=True)
 class ModelSpec:
     """Host-side description of how a model maps onto the kernel."""
@@ -122,6 +145,65 @@ def _register_init(model, valmap) -> int:
     return _value_id(model.value, valmap)
 
 
+def _mr_reg_id(k, valmap: Dict[Any, int]) -> int:
+    """Register index for key k; at most MR_REGISTERS distinct keys."""
+    key = ("mrreg", k)
+    r = valmap.get(key)
+    if r is None:
+        r = valmap.get("__mr_nreg__", 0)
+        if r >= MR_REGISTERS:
+            raise ValueError("too many registers for the packed kernel")
+        valmap[key] = r
+        valmap["__mr_nreg__"] = r + 1
+    return r
+
+
+def _mr_value_id(reg: int, v, valmap: Dict[Any, int]) -> int:
+    """Per-register value ids so each stays within MR_VALUE_BITS."""
+    if v is None:
+        return V_UNKNOWN
+    key = ("mrval", reg, v)
+    vid = valmap.get(key)
+    if vid is None:
+        nkey = ("mrn", reg)
+        vid = valmap.get(nkey, 0) + 1
+        if vid > MR_MAX_VALUE_ID:
+            raise ValueError("too many distinct values for one register")
+        valmap[key] = vid
+        valmap[nkey] = vid
+    return vid
+
+
+def _encode_multi_register_op(op, valmap) -> Tuple[int, int, int]:
+    """Single-mop [(f, k, v)] transactions; multi-mop ones fall back to
+    the oracle (models.MultiRegister handles arbitrary mop lists)."""
+    mops = list(op.value or [])
+    if not mops:
+        return F_READ_ANY, 0, 0
+    if len(mops) != 1:
+        raise ValueError("multi-mop transactions ride the oracle")
+    mf, k, v = mops[0]
+    reg = _mr_reg_id(k, valmap)
+    if mf in ("w", "write"):
+        if v is None:
+            raise ValueError("write of nil is never linearizable")
+        return F_WRITE, _mr_value_id(reg, v, valmap), reg
+    if mf in ("r", "read"):
+        if v is None:
+            return F_READ_ANY, 0, reg
+        return F_READ, _mr_value_id(reg, v, valmap), reg
+    raise ValueError(f"multi-register cannot encode mop f={mf!r}")
+
+
+def _mr_init(model, valmap) -> int:
+    state = 0
+    for k, v in dict(model.values).items():
+        reg = _mr_reg_id(k, valmap)
+        vid = _mr_value_id(reg, v, valmap)
+        state |= vid << (reg * MR_VALUE_BITS)
+    return state
+
+
 SPECS: Dict[type, ModelSpec] = {
     m.Register: ModelSpec(
         name="register",
@@ -142,6 +224,13 @@ SPECS: Dict[type, ModelSpec] = {
         step=mutex_step,
         encode_op=_encode_mutex_op,
         init_state=lambda model, valmap: 1 if model.locked else 0,
+        pure_fs=(),
+    ),
+    m.MultiRegister: ModelSpec(
+        name="multi-register",
+        step=multi_register_step,
+        encode_op=_encode_multi_register_op,
+        init_state=_mr_init,
         pure_fs=(),
     ),
 }

@@ -50,34 +50,36 @@ def supported(model: m.Model) -> bool:
     return spec_for(model) is not None
 
 
-def _hash_cfg(state, linset):
-    """31-bit mix of (state, linset); 0xFFFFFFFF is reserved for invalid."""
+def _hash_cfg(state, words):
+    """31-bit mix of (state, *linset words); 0xFFFFFFFF is reserved for
+    invalid lanes."""
     h = state.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
     h = h ^ (h >> 16)
-    h = h + linset * jnp.uint32(0x85EBCA6B)
-    h = h ^ (h >> 13)
-    h = h * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> 16)
+    for w in words:
+        h = h + w * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
     return h & jnp.uint32(0x7FFFFFFF)
 
 
-def _compact(states, linsets, valid, F):
+def _compact(states, words, valid, F):
     """Dedup + compact K candidate configs down to F slots.
-    Returns (states[F], linsets[F], valid[F], overflowed?).
+    ``words`` is the tuple of linset words (one uint32 array per 32
+    slots).  Returns (states[F], words[F]×W, valid[F], overflowed?).
 
-    One 3-operand sort groups duplicates (invalid lanes sort to the end
-    via the reserved key); survivors are then compacted by *rank*: the
-    j-th output slot gathers the entry whose survivor-prefix-count equals
-    j — a [F, K] compare-reduce plus one gather, which vectorizes far
-    better on the VPU than a second full sort."""
+    One multi-operand sort groups duplicates (invalid lanes sort to the
+    end via the reserved key); survivors are then compacted by *rank*:
+    the j-th output slot gathers the entry whose survivor-prefix-count
+    equals j — a [F, K] compare-reduce plus one gather, which vectorizes
+    far better on the VPU than a second full sort."""
     K = states.shape[0]
-    key = jnp.where(valid, _hash_cfg(states, linsets), _INVALID_KEY)
-    key_s, st_s, ls_s = lax.sort((key, states, linsets), num_keys=1)
-    same = (
-        (key_s[1:] == key_s[:-1])
-        & (st_s[1:] == st_s[:-1])
-        & (ls_s[1:] == ls_s[:-1])
-    )
+    key = jnp.where(valid, _hash_cfg(states, words), _INVALID_KEY)
+    sorted_ops = lax.sort((key, states) + tuple(words), num_keys=1)
+    key_s, st_s, ws_s = sorted_ops[0], sorted_ops[1], sorted_ops[2:]
+    same = (key_s[1:] == key_s[:-1]) & (st_s[1:] == st_s[:-1])
+    for w in ws_s:
+        same = same & (w[1:] == w[:-1])
     dup = jnp.concatenate([jnp.zeros((1,), bool), same])
     v2 = (key_s != _INVALID_KEY) & ~dup
     prefix = jnp.cumsum(v2.astype(jnp.int32))
@@ -86,7 +88,39 @@ def _compact(states, linsets, valid, F):
     # index of the j-th survivor = #entries with prefix <= j
     src = jnp.sum(prefix[None, :] <= j[:, None], axis=1, dtype=jnp.int32)
     src = jnp.minimum(src, K - 1)
-    return st_s[src], ls_s[src], j < count, count > F
+    return st_s[src], tuple(w[src] for w in ws_s), j < count, count > F
+
+
+def _get_bit(words, slot_u):
+    """Extract the linset bit for uint32 slot ids; ``words[w]`` holds
+    slots [32w, 32w+32).  Broadcasting follows the operands'."""
+    sh = slot_u & jnp.uint32(31)
+    word_ix = slot_u >> jnp.uint32(5)
+    bit = jnp.zeros_like(words[0] >> sh)
+    for w, word in enumerate(words):
+        bit = jnp.where(word_ix == w, (word >> sh) & jnp.uint32(1), bit)
+    return bit
+
+
+def _set_bit(words, slot_u):
+    """Return words with the bit for each slot id set."""
+    sh = slot_u & jnp.uint32(31)
+    word_ix = slot_u >> jnp.uint32(5)
+    mask = jnp.uint32(1) << sh
+    return tuple(
+        jnp.where(word_ix == w, word | mask, word)
+        for w, word in enumerate(words)
+    )
+
+
+def _clear_bit(words, slot_u):
+    sh = slot_u & jnp.uint32(31)
+    word_ix = slot_u >> jnp.uint32(5)
+    mask = ~(jnp.uint32(1) << sh)
+    return tuple(
+        jnp.where(word_ix == w, word & mask, word)
+        for w, word in enumerate(words)
+    )
 
 
 def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
@@ -94,14 +128,15 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
     yourself or use make_check_fn for the cached jitted version."""
     spec = next(s for s in _all_specs() if s.name == spec_name)
     step = spec.step
+    W = (C + 31) // 32  # linset words: one uint32 per 32 open-op slots
 
     def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
         states0 = jnp.zeros((F,), jnp.int32).at[0].set(init_state)
-        linsets0 = jnp.zeros((F,), jnp.uint32)
+        words0 = tuple(jnp.zeros((F,), jnp.uint32) for _ in range(W))
         valid0 = jnp.zeros((F,), bool).at[0].set(True)
 
         def event_body(carry, ev):
-            states, linsets, valid, done, failed_at, overflow, idx = carry
+            states, words, valid, done, failed_at, overflow, idx = carry
             e_slot, c_slot, c_f, c_a, c_b = ev
             is_pad = e_slot < 0
 
@@ -111,36 +146,41 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
                 return changed & ~ovf & (i < max_closure)
 
             def body(c):
-                st, ls, vl, count, _, ovf, i = c
+                st, ws, vl, count, _, ovf, i = c
                 active = c_slot >= 0
                 slot_safe = jnp.where(active, c_slot, 0).astype(jnp.uint32)
-                already = (ls[:, None] >> slot_safe[None, :]) & jnp.uint32(1)
+                ws_b = tuple(w[:, None] for w in ws)  # [F,1] vs [1,C]
+                already = _get_bit(ws_b, slot_safe[None, :])
                 st2, ok2 = step(
                     st[:, None], c_f[None, :], c_a[None, :], c_b[None, :]
                 )
                 st2 = jnp.broadcast_to(st2, (F, C)).astype(jnp.int32)
                 ok2 = jnp.broadcast_to(ok2, (F, C))
                 nv = vl[:, None] & active[None, :] & (already == 0) & ok2
-                nl = jnp.broadcast_to(
-                    ls[:, None] | (jnp.uint32(1) << slot_safe[None, :]), (F, C)
+                nws = tuple(
+                    jnp.broadcast_to(w, (F, C))
+                    for w in _set_bit(ws_b, slot_safe[None, :])
                 )
                 all_st = jnp.concatenate([st, st2.reshape(-1)])
-                all_ls = jnp.concatenate([ls, nl.reshape(-1)])
+                all_ws = tuple(
+                    jnp.concatenate([w, nw.reshape(-1)])
+                    for w, nw in zip(ws, nws)
+                )
                 all_vl = jnp.concatenate([vl, nv.reshape(-1)])
-                s3, l3, v3, o3 = _compact(all_st, all_ls, all_vl, F)
+                s3, w3, v3, o3 = _compact(all_st, all_ws, all_vl, F)
                 count2 = v3.sum()
-                return (s3, l3, v3, count2, count2 != count, ovf | o3, i + 1)
+                return (s3, w3, v3, count2, count2 != count, ovf | o3, i + 1)
 
             init = (
                 states,
-                linsets,
+                words,
                 valid,
                 valid.sum(),
                 jnp.bool_(True),
                 jnp.bool_(False),
                 0,
             )
-            st_c, ls_c, vl_c, _, chg_c, ovf_c, it_c = lax.while_loop(
+            st_c, ws_c, vl_c, _, chg_c, ovf_c, it_c = lax.while_loop(
                 cond, body, init
             )
             # exiting on the iteration cap while still growing means the
@@ -150,33 +190,35 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
 
             # --- filter on the completing op; promote it ---
             slot_u = jnp.where(is_pad, 0, e_slot).astype(jnp.uint32)
-            has_bit = ((ls_c >> slot_u) & jnp.uint32(1)) == 1
+            has_bit = _get_bit(ws_c, slot_u) == 1
             vl_f = vl_c & has_bit
-            ls_f = ls_c & ~(jnp.uint32(1) << slot_u)
+            ws_f = _clear_bit(ws_c, slot_u)
             empty = ~vl_f.any()
 
             # select: pad or already-done events pass through unchanged
             skip = is_pad | done
             states2 = jnp.where(skip, states, st_c)
-            linsets2 = jnp.where(skip, linsets, ls_f)
+            words2 = tuple(
+                jnp.where(skip, w0, wf) for w0, wf in zip(words, ws_f)
+            )
             valid2 = jnp.where(skip, valid, vl_f)
             done2 = done | (~is_pad & empty)
             failed_at2 = jnp.where(
                 done | is_pad | ~empty, failed_at, idx
             )
             overflow2 = overflow | (~skip & ovf_c)
-            return (states2, linsets2, valid2, done2, failed_at2, overflow2, idx + 1), None
+            return (states2, words2, valid2, done2, failed_at2, overflow2, idx + 1), None
 
         carry0 = (
             states0,
-            linsets0,
+            words0,
             valid0,
             jnp.bool_(False),
             jnp.int32(-1),
             jnp.bool_(False),
             jnp.int32(0),
         )
-        (states, linsets, valid, done, failed_at, overflow, _), _ = lax.scan(
+        (states, words, valid, done, failed_at, overflow, _), _ = lax.scan(
             event_body,
             carry0,
             (ev_slot, cand_slot, cand_f, cand_a, cand_b),

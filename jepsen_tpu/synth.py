@@ -22,6 +22,8 @@ def generate_history(
     crash_p: float = 0.1,
     corrupt: bool = False,
     n_values: int = 5,
+    replace_crashed: bool = False,
+    op_weights=None,
 ) -> History:
     """One simulated concurrent CAS-register execution.
 
@@ -29,11 +31,17 @@ def generate_history(
     linearizes at its completion point; crashed ops apply secretly with
     probability 1/2).  corrupt=True flips one completion value, usually
     (not always) making the history non-linearizable.
+
+    replace_crashed=True mirrors the interpreter's process retirement
+    (interpreter.clj:233-236): a crash frees the logical worker under a
+    fresh process id, so open (crashed) ops accumulate beyond n_procs.
+    op_weights biases the (read, write, cas) mix.
     """
     state = 0
     hist = []
     pending = {}
     idle = list(range(n_procs))
+    next_pid = n_procs
     values = list(range(1, n_values + 1))
     ops_done = 0
     while ops_done < n_ops or pending:
@@ -41,7 +49,13 @@ def generate_history(
         if do_invoke:
             p = rng.choice(idle)
             idle.remove(p)
-            f = rng.choice(["read", "write", "cas"])
+            # plain choice when unweighted: rng.choices consumes a
+            # different PRNG stream, which would silently regenerate
+            # every fixed-seed corpus
+            if op_weights is None:
+                f = rng.choice(["read", "write", "cas"])
+            else:
+                f = rng.choices(["read", "write", "cas"], weights=op_weights)[0]
             if f == "read":
                 hist.append(invoke_op(p, "read"))
                 pending[p] = ("read", None)
@@ -66,6 +80,9 @@ def generate_history(
                 elif f == "cas" and rng.random() < 0.5 and state == v[0]:
                     state = v[1]
                 hist.append(info_op(p, f, v))
+                if replace_crashed:
+                    idle.append(next_pid)
+                    next_pid += 1
             else:
                 if f == "read":
                     v = state
@@ -114,4 +131,71 @@ def generate_batch(
                 rng, n_procs=n_procs, n_ops=n_ops, crash_p=crash_p, corrupt=corrupt
             )
         )
+    return out
+
+
+def generate_mr_history(
+    rng: random.Random,
+    n_procs: int = 4,
+    n_ops: int = 40,
+    n_keys: int = 3,
+    n_values: int = 4,
+    crash_p: float = 0.1,
+    corrupt: bool = False,
+) -> History:
+    """One simulated concurrent execution over a multi-register: ops are
+    single-mop transactions ``[("r"|"w", key, value)]`` against keys
+    0..n_keys-1, each initially 0 (pair with models.multi_register({k: 0
+    for k in range(n_keys)})).  Valid by construction unless corrupt."""
+    state = {k: 0 for k in range(n_keys)}
+    hist = []
+    pending = {}
+    idle = list(range(n_procs))
+    values = list(range(1, n_values + 1))
+    ops_done = 0
+    while ops_done < n_ops or pending:
+        do_invoke = idle and (ops_done < n_ops) and (not pending or rng.random() < 0.6)
+        if do_invoke:
+            p = rng.choice(idle)
+            idle.remove(p)
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.5:
+                hist.append(invoke_op(p, "txn", [("r", k, None)]))
+                pending[p] = ("r", k, None)
+            else:
+                v = rng.choice(values)
+                hist.append(invoke_op(p, "txn", [("w", k, v)]))
+                pending[p] = ("w", k, v)
+            ops_done += 1
+        else:
+            p = rng.choice(list(pending.keys()))
+            mf, k, v = pending.pop(p)
+            if rng.random() < crash_p:
+                if mf == "w" and rng.random() < 0.5:
+                    state[k] = v
+                hist.append(info_op(p, "txn", [(mf, k, v)]))
+            else:
+                if mf == "r":
+                    v = state[k]
+                else:
+                    state[k] = v
+                hist.append(ok_op(p, "txn", [(mf, k, v)]))
+                idle.append(p)
+        if not idle and not pending:
+            break  # every process crashed
+    out = History(hist)
+    if corrupt and len(out) > 2:
+        reads = [
+            i
+            for i, op in enumerate(out)
+            if op.type == "ok" and op.value and op.value[0][0] == "r"
+        ]
+        if reads:
+            i = rng.choice(reads)
+            op = out[i]
+            _mf, k, _v = op.value[0]
+            out[i] = op.copy(value=[("r", k, rng.choice([7, 8, 9]))])
+    for i, op in enumerate(out):
+        op.index = i
+        op.time = i
     return out

@@ -229,3 +229,109 @@ def test_differential_high_crash_rate():
     oracle = [linear.analysis(model, h0, pure_fs=("read",))["valid?"] for h0 in hists]
     kernel = [o["valid?"] for o in wgl.check_batch(model, hists)]
     assert oracle == kernel
+
+
+# ---------------------------------------------------------------------------
+# two-word linsets (slot_cap > 32) + multi-register kernel
+# ---------------------------------------------------------------------------
+
+
+def test_encode_slot_cap_64():
+    # 40 concurrently-open ops fit under slot_cap=64 (two linset words)
+    ops = [invoke_op(i, "write", 1) for i in range(40)]
+    ops.append(ok_op(39, "write", 1))
+    e = encode.encode_history(h(*ops), m.register(0), slot_cap=64)
+    assert e is not None
+    assert e.max_open == 40
+
+
+def test_differential_two_word_linsets():
+    """Exercise the second linset word: encode at slot_cap=64, then shift
+    every slot id up by 32 so all bits land in word 1.  The C=64 (W=2)
+    kernel must agree with the oracle on the standard fuzz corpus.
+
+    (Histories that *genuinely* hold >32 open state-changing ops are
+    intractable for exact WGL search in any engine — the frontier is the
+    power set of freely-linearizable open ops, which is why the reference
+    caps per-key processes at 20, linearizable_register.clj:52.  The
+    wide-slot capacity instead serves long histories that *accumulate*
+    crashed ops over time.)"""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = random.Random(4242)
+    model = m.cas_register(0)
+    hists = [_gen(rng, n_procs=5, n_ops=40, corrupt=(i % 2 == 0)) for i in range(12)]
+    oracle = [
+        linear.analysis(model, h0, pure_fs=("read",))["valid?"] for h0 in hists
+    ]
+    encs = [encode.encode_history(h0, model, slot_cap=64) for h0 in hists]
+    assert all(e is not None for e in encs)
+    E = max(e.ev_slot.shape[0] for e in encs)
+    C = 64
+    B = len(encs)
+    ev = np.full((B, E), -1, np.int32)
+    cs = np.full((B, E, C), -1, np.int8)
+    cf = np.zeros((B, E, C), np.int8)
+    ca = np.zeros((B, E, C), np.int16)
+    cb = np.zeros((B, E, C), np.int16)
+    init = np.zeros((B,), np.int32)
+    for i, e in enumerate(encs):
+        n = e.ev_slot.shape[0]
+        init[i] = e.init_state
+        ev[i, :n] = np.where(e.ev_slot >= 0, e.ev_slot + 32, e.ev_slot)
+        cs[i, :n] = np.where(e.cand_slot >= 0, e.cand_slot + 32, e.cand_slot)
+        cf[i, :n] = e.cand_f
+        ca[i, :n] = e.cand_a
+        cb[i, :n] = e.cand_b
+    fn = wgl.make_check_fn("cas-register", E, C, 128, C + 1)
+    ok, _failed, overflow = fn(*(jnp.asarray(x) for x in (init, ev, cs, cf, ca, cb)))
+    ok, overflow = np.asarray(ok), np.asarray(overflow)
+    assert not overflow.any()
+    assert [bool(v) for v in ok] == [v is True for v in oracle]
+
+
+def test_multi_register_golden():
+    model = m.multi_register({0: 0, 1: 0})
+    good = h(
+        invoke_op(0, "txn", [("w", 0, 5)]),
+        ok_op(0, "txn", [("w", 0, 5)]),
+        invoke_op(0, "txn", [("r", 0, None)]),
+        ok_op(0, "txn", [("r", 0, 5)]),
+        invoke_op(0, "txn", [("r", 1, None)]),
+        ok_op(0, "txn", [("r", 1, 0)]),
+    )
+    bad = h(
+        invoke_op(0, "txn", [("w", 0, 5)]),
+        ok_op(0, "txn", [("w", 0, 5)]),
+        invoke_op(0, "txn", [("r", 1, None)]),
+        ok_op(0, "txn", [("r", 1, 5)]),  # key 1 was never written
+    )
+    assert wgl.supported(model)
+    assert wgl.analysis(model, good)["valid?"] is True
+    assert wgl.analysis(model, bad)["valid?"] is False
+
+
+def test_multi_register_multi_mop_falls_back():
+    model = m.multi_register({0: 0, 1: 0})
+    txn = h(
+        invoke_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        ok_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+    )
+    out = wgl.analysis(model, txn)
+    assert out["engine"] == "oracle-fallback"
+    assert out["valid?"] is True
+
+
+def test_differential_multi_register():
+    from jepsen_tpu.synth import generate_mr_history
+
+    rng = random.Random(777)
+    model = m.multi_register({k: 0 for k in range(3)})
+    hists = [
+        generate_mr_history(rng, corrupt=(i % 3 == 0)) for i in range(30)
+    ]
+    oracle = [linear.analysis(model, h0)["valid?"] for h0 in hists]
+    kernel = [o["valid?"] for o in wgl.check_batch(model, hists)]
+    assert oracle == kernel
+    assert True in oracle and False in oracle
