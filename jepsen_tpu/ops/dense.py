@@ -1,0 +1,264 @@
+"""Dense subset-automaton linearizability kernel for register-family
+models — the TPU-first fast path.
+
+The generic WGL kernel (jepsen_tpu.ops.wgl) keeps an explicit frontier of
+``(state, linset)`` configs and pays a sort-based dedup/compaction on
+every closure step; its capacity F can overflow, degrading to "unknown".
+For models whose state enumerates to a small integer domain — read/write
+registers, CAS registers, mutexes (the knossos models the reference's
+linearizable checker actually runs, jepsen/src/jepsen/checker.clj:19-26)
+— there is a representation that maps far better onto a vector machine:
+
+    D[v, s] = 1  iff some linearization order of the ops in subset ``s``
+              (of the ≤C currently-open slots) takes the register from
+              the promoted prefix to value id ``v``.
+
+``D`` is a *dense* boolean tensor over (value id × linset subset), bit-
+packed along the subset axis into uint32 words.  Every WGL operation
+becomes a static, branch-free tensor op:
+
+- *value transition*: per event a [C, V, V] one-hot transition matrix is
+  built from the candidate op codes (read keeps one value row, write
+  folds every row into one, cas moves row a to row b, mutex ops are cas
+  in disguise); applying it is a short OR-tree of selects.
+- *closure* (linearize open op j): the subset map ``s → s | bit_j`` is,
+  on the packed axis, a masked word shift for j < 5 and a static word
+  permutation for j ≥ 5 — all C slots advance in ONE vectorized step per
+  pass.  No sort, no dedup (the set representation dedups for free), and
+  **overflow cannot happen**.
+- *completion of slot s* (filter configs that linearized s, promote it):
+  the inverse map ``s' → s' \\ bit_s``, a masked shift/permutation again,
+  selected among C static variants by the completing slot id.
+
+Per event the closure runs to fixpoint in ≤C passes (a chain linearizes
+each open op at most once), so ``lax.while_loop`` capped at C+2 is exact
+— there is no truncation/"unknown" path at all.  The whole search is a
+``lax.scan`` over events, vmapped over histories, sharded over the
+device mesh like the generic kernel.
+
+Cost per event is a handful of fused vector ops on [C, V, 2^C/32]
+uint32 tensors — for the practical C ≤ 12, V ≤ 32 envelope a few KB per
+history — versus the generic kernel's two O((F + F·C) log) sorts per
+closure pass.  Measured on one TPU chip this is orders of magnitude
+faster (see bench.py).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .step_kernels import (
+    F_READ,
+    F_WRITE,
+    F_CAS,
+    F_READ_ANY,
+    F_ACQUIRE,
+    F_RELEASE,
+)
+
+#: specs whose state is exactly "current value id" (mutex: 0=free 1=held)
+DENSE_SPECS = ("register", "cas-register", "mutex")
+
+#: dense envelope: beyond these the generic frontier kernel takes over
+MAX_C = 12   # 2^12 subsets = 128 packed words
+MAX_V = 32
+
+#: _LOMASK[j]: bits of a 32-subset word whose subset index has bit j clear
+_LOMASK = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
+
+
+def applicable(spec_name: str, C: int, V: int) -> bool:
+    return spec_name in DENSE_SPECS and C <= MAX_C and V <= MAX_V
+
+
+def _n_words(C: int) -> int:
+    return max(1, (1 << C) // 32)
+
+
+def _subset_maps(C: int):
+    """Static per-slot tables for the packed-axis subset maps.
+
+    union (``s → s | bit_j``, image restricted to s ∋ j):
+        out[k] = (x[uidx[j,k]] & umask[j,k]) << ushl[j]
+    drop (``s → s \\ bit_j``, image restricted to s ∌ j):
+        out[k] = (x[didx[j,k]] >> dshr[j]) & dmask[j,k]
+
+    For j < 5 the map moves bits inside a word (mask + shift); for j ≥ 5
+    it permutes whole words (static gather + output mask).
+    """
+    W = _n_words(C)
+    k = np.arange(W)
+    uidx = np.zeros((C, W), np.int32)
+    umask = np.zeros((C, W), np.uint32)
+    ushl = np.zeros((C,), np.uint32)
+    didx = np.zeros((C, W), np.int32)
+    dmask = np.zeros((C, W), np.uint32)
+    dshr = np.zeros((C,), np.uint32)
+    for j in range(C):
+        if j < 5:
+            uidx[j] = k
+            umask[j] = _LOMASK[j]
+            ushl[j] = 1 << j
+            didx[j] = k
+            dmask[j] = _LOMASK[j]
+            dshr[j] = 1 << j
+        else:
+            wb = 1 << (j - 5)
+            uidx[j] = k ^ wb
+            umask[j] = np.where((k & wb) != 0, 0xFFFFFFFF, 0)
+            didx[j] = k | wb
+            dmask[j] = np.where((k & wb) == 0, 0xFFFFFFFF, 0)
+    return (
+        jnp.asarray(uidx),
+        jnp.asarray(umask),
+        jnp.asarray(ushl),
+        jnp.asarray(didx),
+        jnp.asarray(dmask),
+        jnp.asarray(dshr),
+    )
+
+
+def _or_fold(terms):
+    """Tree-OR a static list of equal-shaped uint32 arrays."""
+    terms = list(terms)
+    while len(terms) > 1:
+        terms = [
+            terms[i] | terms[i + 1] if i + 1 < len(terms) else terms[i]
+            for i in range(0, len(terms), 2)
+        ]
+    return terms[0]
+
+
+def build_dense(spec_name: str, E: int, C: int, V: int):
+    """Build the (unjitted) vmapped dense checker for fixed shapes.
+    Signature matches wgl.build_batched's result: ``fn(init_state,
+    ev_slot, cand_slot, cand_f, cand_a, cand_b) -> (ok, failed_at,
+    overflow)`` — with ``overflow`` identically False."""
+    if spec_name not in DENSE_SPECS:
+        raise ValueError(f"no dense kernel for spec {spec_name!r}")
+    W = _n_words(C)
+    max_closure = C + 2  # ≤C passes reach fixpoint; headroom is free
+    uidx, umask, ushl, didx, dmask, dshr = _subset_maps(C)
+    uidx_b = jnp.broadcast_to(uidx[:, None, :], (C, V, W))
+    didx_b = jnp.broadcast_to(didx[:, None, :], (C, V, W))
+
+    def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
+        D0 = jnp.zeros((V, W), jnp.uint32)
+        # one config: prefix value = init, empty linset (subset 0, bit 0)
+        D0 = lax.dynamic_update_index_in_dim(
+            D0, jnp.zeros((W,), jnp.uint32).at[0].set(1), init_state, 0
+        )
+
+        def event_body(carry, ev):
+            D, done, failed_at, idx = carry
+            e_slot, c_slot, c_f, c_a, c_b = ev
+            is_pad = e_slot < 0
+            c_f = c_f.astype(jnp.int32)
+            c_a = c_a.astype(jnp.int32)
+            c_b = c_b.astype(jnp.int32)
+
+            # regroup candidate lanes by SLOT id (lanes are sorted by op
+            # id, so slot j can sit at any lane; at most one lane holds
+            # it) — the packed subset maps need the slot as the index
+            eq = c_slot[None, :] == jnp.arange(C, dtype=c_slot.dtype)[:, None]
+            active_s = eq.any(axis=1)
+            f_s = jnp.sum(jnp.where(eq, c_f[None, :], 0), axis=1)
+            a_s = jnp.sum(jnp.where(eq, c_a[None, :], 0), axis=1)
+            b_s = jnp.sum(jnp.where(eq, c_b[None, :], 0), axis=1)
+
+            # per-slot [C, V, V] transition matrix T[j, v', v]: does
+            # linearizing slot j move value v to v'?  (mutex ops are cas
+            # in disguise: acquire=cas(0,1), release=cas(1,0))
+            is_acq = f_s == F_ACQUIRE
+            is_rel = f_s == F_RELEASE
+            a_eff = jnp.where(is_acq, 0, jnp.where(is_rel, 1, a_s))
+            b_eff = jnp.where(is_acq, 1, jnp.where(is_rel, 0, b_s))
+            is_write = f_s == F_WRITE
+            is_ra = f_s == F_READ_ANY
+            cas_like = (f_s == F_CAS) | is_acq | is_rel
+            vp = jnp.arange(V, dtype=jnp.int32)[None, :, None]  # v'
+            vv = jnp.arange(V, dtype=jnp.int32)[None, None, :]  # v
+            am = a_eff[:, None, None]
+            bm = b_eff[:, None, None]
+            T = jnp.where(
+                is_write[:, None, None],
+                vp == am,
+                jnp.where(
+                    is_ra[:, None, None],
+                    vp == vv,
+                    jnp.where(
+                        cas_like[:, None, None],
+                        (vp == bm) & (vv == am),
+                        (vp == am) & (vv == am),  # read
+                    ),
+                ),
+            ) & active_s[:, None, None]
+
+            # --- closure: linearize open ops until fixpoint; every slot
+            # advances in one vectorized pass ---
+            def cond(c):
+                _, changed, i = c
+                return changed & (i < max_closure)
+
+            def body(c):
+                Dc, _, i = c
+                # X[j, v', w] = OR_v (T[j, v', v] & Dc[v, w])
+                X = _or_fold(
+                    jnp.where(T[:, :, v, None], Dc[v][None, None, :], jnp.uint32(0))
+                    for v in range(V)
+                )
+                # subset-union map s → s | bit_j, packed axis
+                U = jnp.take_along_axis(X, uidx_b, axis=2)
+                U = (U & umask[:, None, :]) << ushl[:, None, None]
+                add = _or_fold(U[j] for j in range(C))
+                Dn = Dc | add
+                changed = (Dn != Dc).any()
+                return (Dn, changed, i + 1)
+
+            Dc, _, _ = lax.while_loop(
+                cond, body, (D, jnp.bool_(True), jnp.int32(0))
+            )
+
+            # --- completion: keep configs that linearized e_slot, then
+            # promote it out of the linset (slot frees for reuse) ---
+            Ds = jnp.take_along_axis(
+                jnp.broadcast_to(Dc[None], (C, V, W)), didx_b, axis=2
+            )
+            Dvar = (Ds >> dshr[:, None, None]) & dmask[:, None, :]
+            onehot = (e_slot == jnp.arange(C))[:, None, None]
+            Df = _or_fold(
+                jnp.where(onehot[j], Dvar[j], jnp.uint32(0)) for j in range(C)
+            )
+            empty = ~(Df != 0).any()
+
+            done2 = done | (~is_pad & empty)
+            # dead rows park on an empty frontier: the closure on zeros
+            # converges in one pass, so finished histories stop dragging
+            # the batch-synchronized while_loop
+            D2 = jnp.where(
+                done2, jnp.uint32(0), jnp.where(is_pad, D, Df)
+            )
+            failed_at2 = jnp.where(done | is_pad | ~empty, failed_at, idx)
+            return (D2, done2, failed_at2, idx + 1), None
+
+        carry0 = (D0, jnp.bool_(False), jnp.int32(-1), jnp.int32(0))
+        (_, done, failed_at, _), _ = lax.scan(
+            event_body,
+            carry0,
+            (ev_slot, cand_slot, cand_f, cand_a, cand_b),
+        )
+        return ~done, failed_at, jnp.bool_(False)
+
+    return jax.vmap(check_one)
+
+
+@lru_cache(maxsize=64)
+def make_dense_fn(spec_name: str, E: int, C: int, V: int):
+    """Jitted, cached dense checker (same contract as wgl.make_check_fn)."""
+    return jax.jit(build_dense(spec_name, E, C, V))

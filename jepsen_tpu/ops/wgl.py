@@ -235,6 +235,27 @@ def make_check_fn(spec_name: str, E: int, C: int, F: int, max_closure: int):
     return jax.jit(build_batched(spec_name, E, C, F, max_closure))
 
 
+def make_best_check_fn(
+    spec_name: str,
+    E: int,
+    C: int,
+    F: int,
+    max_closure: int,
+    n_values: Optional[int] = None,
+):
+    """Pick the fastest kernel for the shape: the dense subset-automaton
+    (ops.dense — no sorts, no overflow) when the model's value domain and
+    concurrency fit its envelope, else the generic frontier kernel.
+    ``n_values`` is the exclusive upper bound on value ids (init/a/b)."""
+    from . import dense as dense_mod
+
+    if n_values is not None:
+        V = encode_mod.round_up(n_values, 4)
+        if dense_mod.applicable(spec_name, C, V):
+            return dense_mod.make_dense_fn(spec_name, E, C, V)
+    return make_check_fn(spec_name, E, C, F, max_closure)
+
+
 def _all_specs():
     from .step_kernels import SPECS
 
@@ -289,7 +310,19 @@ def check_batch(
         # fixpoint-confirming iteration, so legitimate closures are never
         # cut short and flagged unknown
         mc = max_closure if max_closure is not None else C + 1
-        fn = make_check_fn(spec.name, E, C, frontier, mc)
+        n_values = 1 + int(
+            max(
+                batch.init_state.max(),
+                batch.cand_a.max(),
+                batch.cand_b.max(),
+            )
+        )
+        if max_closure is None:
+            fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
+        else:
+            # an explicit closure cap asks for the generic kernel's
+            # truncation semantics; the dense kernel has no such cap
+            fn = make_check_fn(spec.name, E, C, frontier, mc)
         # np.array (not asarray): jax outputs are read-only views and the
         # escalation pass writes back into these
         ok, failed_at, overflow = (
