@@ -209,9 +209,15 @@ def run_bench(on_accelerator, warnings):
         "encode_fallback": n_fallback,
         "invalid": int((~ok).sum()),
         "platform": jax.devices()[0].platform,
+        # applicable() guard first: out-of-envelope shapes must not
+        # construct a dense kernel just to label the diag line
         "kernel": (
             "dense"
-            if fn is dense.make_dense_fn(
+            if dense.applicable(
+                "cas-register", C, encode.round_up(vmax + 1, 4)
+            )
+            and fn
+            is dense.make_dense_fn(
                 "cas-register", E, C, encode.round_up(vmax + 1, 4)
             )
             else "frontier"

@@ -90,8 +90,25 @@ WORKLOADS = ("register", "bank", "set", "list-append")
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
+    from . import monotonic, sequential
+
     opts = _opts(opts)
-    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    # suite-specific probes (reference: cockroach/monotonic.clj,
+    # sequential.clj, adya.clj g2 via the generic list-append/elle path)
+    out["monotonic"] = monotonic.workload(opts)
+    out["sequential"] = sequential.workload(opts)
+    return out
+
+
+def _client_for(wname: str, opts: dict):
+    from . import monotonic, sequential
+
+    if wname == "monotonic":
+        return monotonic.MonotonicClient(opts)
+    if wname == "sequential":
+        return sequential.SequentialClient(opts)
+    return sql.client_for(wname, opts)
 
 
 def test(opts: Optional[dict] = None) -> dict:
@@ -100,5 +117,5 @@ def test(opts: Optional[dict] = None) -> dict:
     w = workloads(opts)[wname]
     return common.build_test(
         f"cockroachdb-{wname}", opts, db=CockroachDB(opts),
-        client=sql.client_for(wname, opts), workload=w,
+        client=_client_for(wname, opts), workload=w,
     )

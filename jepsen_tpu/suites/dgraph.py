@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 from typing import Any, Optional
 
+from .. import checker as checker_mod
 from .. import client as client_mod
 from .. import independent
 from ..control import util as cu
@@ -224,6 +225,7 @@ def workloads(opts: Optional[dict] = None) -> dict:
     return {
         "register": common.register_workload(opts),
         "set": common.set_workload(opts),
+        "upsert": upsert_workload(opts),
     }
 
 
@@ -231,7 +233,108 @@ def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "register")
     w = workloads(opts)[wname]
-    c = DgraphSetClient(opts) if wname == "set" else DgraphClient(opts)
+    c = {
+        "set": DgraphSetClient,
+        "upsert": DgraphUpsertClient,
+    }.get(wname, DgraphClient)(opts)
     return common.build_test(
         f"dgraph-{wname}", opts, db=DgraphDB(opts), client=c, workload=w,
     )
+
+
+# ---------------------------------------------------------------------
+# upsert workload
+# ---------------------------------------------------------------------
+
+UPSERT_SCHEMA = "email: string @index(exact) @upsert .\n"
+
+
+class DgraphUpsertClient(DgraphClient):
+    """Concurrent insert-if-absent on an indexed predicate; at most one
+    node per key may ever be created.
+
+    Reference: dgraph/src/jepsen/dgraph/upsert.clj:13-55 — :upsert
+    creates an email node unless an index read finds one (ok iff it
+    inserted); :read returns the sorted uids matching the key.
+    """
+
+    def setup(self, test):
+        try:
+            self.conn.post("/alter", UPSERT_SCHEMA, ok=(200,))
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        k, _v = op["value"]
+        try:
+            if op["f"] == "upsert":
+                out = self._upsert(
+                    f'{{ q(func: eq(email, "{k}")) {{ u as uid }} }}',
+                    [{"cond": "@if(eq(len(u), 0))",
+                      "set_nquads": f'_:n <email> "{k}" .'}],
+                )
+                uids = (out.get("data") or {}).get("uids") or {}
+                if uids:
+                    return {**op, "type": "ok",
+                            "value": independent.kv(k, sorted(uids.values()))}
+                return {**op, "type": "fail", "error": "exists"}
+            if op["f"] == "read":
+                data = self._query(
+                    f'{{ q(func: eq(email, "{k}")) {{ uid }} }}'
+                )
+                uids = sorted(r["uid"] for r in data.get("q", []))
+                return {**op, "type": "ok", "value": independent.kv(k, uids)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+class UpsertChecker(checker_mod.Checker):
+    """At most one uid may ever be read, and at most one upsert may
+    succeed, per key.  (reference: upsert.clj:57-71)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK as _OK
+
+        reads = [op for op in history if op.type == _OK and op.f == "read"]
+        upserts = [op for op in history if op.type == _OK and op.f == "upsert"]
+        bad_reads = [
+            {"index": op.index, "value": list(op.value)}
+            for op in reads
+            if op.value is not None and len(op.value) > 1
+        ]
+        return {
+            "valid?": not bad_reads and len(upserts) <= 1,
+            "bad-reads": bad_reads,
+            "ok-upsert-count": len(upserts),
+        }
+
+
+def upsert_workload(opts: Optional[dict] = None) -> dict:
+    """Per key: every thread races one upsert, then every thread reads.
+    (reference: upsert.clj:73-86)"""
+
+    from .. import generator as gen_mod
+
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+
+    def fgen(k):
+        return gen_mod.phases(
+            gen_mod.each_thread(
+                gen_mod.once({"type": "invoke", "f": "upsert", "value": None})
+            ),
+            gen_mod.each_thread(
+                gen_mod.once({"type": "invoke", "f": "read", "value": None})
+            ),
+        )
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * n, range(100_000), fgen
+        ),
+        "checker": independent.checker(UpsertChecker()),
+        "concurrency": 4 * n,
+    }

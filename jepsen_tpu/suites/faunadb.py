@@ -202,16 +202,101 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    # bank/set/pages/monotonic need FQL pagination + index queries the
-    # wire client doesn't model yet; the register workload is complete
-    return {"register": common.register_workload(dict(opts or {}))}
+    # bank/set/pages/monotonic need FQL pagination the wire client
+    # doesn't model yet; register and g2 are complete
+    from ..workloads import adya
+
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        "g2": {
+            "generator": adya.g2_gen(),
+            "checker": adya.g2_checker(),
+            "concurrency": 2,
+        },
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "register")
     w = workloads(opts)[wname]
+    c = FaunaG2Client(opts) if wname == "g2" else FaunaClient(opts)
     return common.build_test(
-        f"faunadb-{wname}", opts, db=FaunaDB(opts), client=FaunaClient(opts),
-        workload=w,
+        f"faunadb-{wname}", opts, db=FaunaDB(opts), client=c, workload=w,
     )
+
+
+# ---------------------------------------------------------------------
+# g2 (anti-dependency cycle) workload
+# ---------------------------------------------------------------------
+
+G2_CLASSES = ("g2a", "g2b")
+
+
+class FaunaG2Client(FaunaClient):
+    """Paired predicate inserts: create into class a (or b) only if the
+    *other* class's index has no entry for the key — under
+    serializability at most one of each pair commits.
+
+    Reference: faunadb/src/jepsen/faunadb/g2.clj:33-76 — setup upserts
+    classes a/b plus key-term indexes; :insert runs
+    ``when (not (exists (match other-index k))) (create (ref class id))``
+    and reuses jepsen.tests.adya's generator/checker.
+    """
+
+    def setup(self, test):
+        for cls in G2_CLASSES:
+            try:
+                self.query({"create_class": {"object": {"name": cls}}})
+            except (HttpError, IndeterminateError):
+                pass
+            try:
+                self.query(
+                    {
+                        "create_index": {
+                            "object": {
+                                "name": f"{cls}-index",
+                                "source": class_ref(cls),
+                                "terms": [{"field": ["data", "key"]}],
+                                "active": True,
+                            }
+                        }
+                    }
+                )
+            except (HttpError, IndeterminateError):
+                pass
+
+    def invoke(self, test, op):
+        assert op["f"] == "insert", op
+        k, ids = op["value"]
+        a_id, b_id = ids
+        id_ = a_id if a_id is not None else b_id
+        cls = G2_CLASSES[0] if a_id is not None else G2_CLASSES[1]
+        other = G2_CLASSES[1] if a_id is not None else G2_CLASSES[0]
+        try:
+            res = self.query(
+                {
+                    "if": {
+                        "not": {
+                            "exists": {
+                                "match": {"index": f"{other}-index"},
+                                "terms": [k],
+                            }
+                        }
+                    },
+                    "then": {
+                        "create": ref(cls, id_),
+                        "params": {"object": {"data": {
+                            "object": {"key": k}}}},
+                    },
+                    "else": None,
+                }
+            )
+            if res:
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": "conflict"}
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}

@@ -314,6 +314,7 @@ def workloads(opts: Optional[dict] = None) -> dict:
         out[f"ycql.{w}"] = common.generic_workload(w, opts)
     for w in ("register", "bank", "set", "list-append", "long-fork"):
         out[f"ysql.{w}"] = common.generic_workload(w, _ysql_opts(opts))
+    out["ysql.multi-key-acid"] = multi_key_acid_workload(opts)
     return out
 
 
@@ -328,6 +329,8 @@ def _client_for(wname: str, opts: dict) -> client_mod.Client:
     api, _, w = wname.partition(".")
     if api == "ycql":
         return _YCQL_CLIENTS[w](opts)
+    if w == "multi-key-acid":
+        return MultiKeyAcidClient(_ysql_opts(opts))
     return sql.client_for(w, _ysql_opts(opts))
 
 
@@ -339,3 +342,130 @@ def test(opts: Optional[dict] = None) -> dict:
         f"yugabyte-{wname}", opts, db=YugabyteDB(opts),
         client=_client_for(wname, opts), workload=w,
     )
+
+
+# ---------------------------------------------------------------------
+# multi-key ACID (YSQL)
+# ---------------------------------------------------------------------
+
+MKA_TABLE = "multi_key_acid"
+MKA_KEYS = (0, 1, 2)
+
+
+class MultiKeyAcidClient(sql._Base):
+    """Transactional writes over a composite-key table, checked as a
+    linearizable multi-register per independent key.
+
+    Reference: yugabyte/src/yugabyte/ysql/multi_key_acid.clj:14-52 — one
+    table (k1, k2, val, PK (k1, k2)); :write runs every [w k1 v] mop as
+    an upsert inside one transaction, :read selects the k1s of its mops
+    and rewrites them with the observed values.
+    """
+
+    dialect = "pg"
+
+    def setup(self, test):
+        self._exec_ddl(
+            f"CREATE TABLE IF NOT EXISTS {MKA_TABLE} "
+            "(k1 INT, k2 INT, val INT, PRIMARY KEY (k1, k2))"
+        )
+
+    def _upsert(self, k1: int, k2: int, v: int) -> str:
+        if self.dialect == "cockroach":
+            return (
+                f"UPSERT INTO {MKA_TABLE} (k1, k2, val) "
+                f"VALUES ({k1}, {k2}, {v})"
+            )
+        if self.dialect == "mysql":
+            return (
+                f"INSERT INTO {MKA_TABLE} (k1, k2, val) "
+                f"VALUES ({k1}, {k2}, {v}) "
+                f"ON DUPLICATE KEY UPDATE val = {v}"
+            )
+        return (
+            f"INSERT INTO {MKA_TABLE} (k1, k2, val) "
+            f"VALUES ({k1}, {k2}, {v}) "
+            f"ON CONFLICT (k1, k2) DO UPDATE SET val = {v}"
+        )
+
+    def invoke(self, test, op):
+        k2, mops = op["value"]
+        try:
+            if op["f"] == "read":
+                k1s = sorted({k for _f, k, _v in mops})
+                in_list = ", ".join(str(k) for k in k1s)
+                res = self.conn.query(
+                    f"SELECT k1, val FROM {MKA_TABLE} "
+                    f"WHERE k2 = {k2} AND k1 IN ({in_list})"
+                )
+                got = {int(r[0]): (None if r[1] is None else int(r[1]))
+                       for r in res.rows}
+                out = [[f, k, got.get(k)] for f, k, _v in mops]
+                return {**op, "type": "ok",
+                        "value": independent.kv(k2, out)}
+            if op["f"] == "write":
+                self.conn.query("BEGIN")
+                try:
+                    for f, k1, v in mops:
+                        assert f == "w", f
+                        self.conn.query(self._upsert(k1, k2, v))
+                    self.conn.query("COMMIT")
+                except Exception:
+                    try:
+                        self.conn.query("ROLLBACK")
+                    except Exception:
+                        pass
+                    raise
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return self._info(op, e)
+        except (sql.PgError, sql.MysqlError) as e:
+            return self._fail(op, e)
+
+
+def multi_key_acid_workload(opts: Optional[dict] = None) -> dict:
+    """Random read/write transactions over 3 sub-keys per independent
+    key, checked linearizable against the multi-register model.
+    (reference: yugabyte/src/yugabyte/multi_key_acid.clj:40-72)
+
+    Reads of absent rows surface as None mops, which the model treats
+    as always-legal — the same semantics as the reference's
+    MultiRegister ("Nil reads are always legal",
+    multi_key_acid.clj:22-27), so a vanished row is only caught once a
+    non-None read of that key disagrees with the model state."""
+    import random as _random
+
+    from .. import checker as checker_mod
+    from .. import models
+    from .. import util as util_mod
+
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+
+    def r(test, ctx):
+        ks = util_mod.random_nonempty_subset(MKA_KEYS)
+        return {"type": "invoke", "f": "read",
+                "value": [["r", k, None] for k in sorted(ks)]}
+
+    def w(test, ctx):
+        ks = util_mod.random_nonempty_subset(MKA_KEYS)
+        return {"type": "invoke", "f": "write",
+                "value": [["w", k, _random.randint(0, 4)] for k in sorted(ks)]}
+
+    from .. import generator as gen_mod
+
+    def fgen(k):
+        return gen_mod.process_limit(
+            20, gen_mod.stagger(1 / 20, gen_mod.reserve(n, r, w))
+        )
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * n, range(100_000), fgen
+        ),
+        "checker": independent.checker(
+            checker_mod.linearizable(models.multi_register({}), pure_fs=())
+        ),
+        "concurrency": 4 * n,
+    }

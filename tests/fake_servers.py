@@ -1231,6 +1231,21 @@ class _SqlBackend:
             store.sql_uri, uri=True, check_same_thread=False, timeout=0.2
         )
         self.conn.isolation_level = None  # explicit BEGIN/COMMIT only
+        # DB-assigned logical timestamp for the monotonic workload: a
+        # store-wide counter standing in for cockroach's
+        # cluster_logical_timestamp() / pg's clock_timestamp()
+        with _sql_setup_lock:
+            if not hasattr(store, "sql_ts"):
+                store.sql_ts = _it.count(1)
+        self.conn.create_function(
+            "cluster_logical_timestamp", 0, lambda: next(store.sql_ts)
+        )
+
+    _RE_TS = _re.compile(
+        r"extract\(epoch from clock_timestamp\(\)\)"
+        r"|unix_timestamp\(now\(6\)\)",
+        _re.I,
+    )
 
     _RE_UPSERT = _re.compile(
         r"^UPSERT\s+INTO\s+(\w+)\s*\(\s*(\w+)\s*,\s*(\w+)\s*\)\s*"
@@ -1244,6 +1259,7 @@ class _SqlBackend:
 
     def _translate(self, sql: str) -> str:
         s = sql.strip().rstrip(";")
+        s = self._RE_TS.sub("cluster_logical_timestamp()", s)
         m = self._RE_UPSERT.match(s)
         if m:  # cockroach UPSERT
             t, c1, c2, vals = m.groups()
@@ -1634,3 +1650,264 @@ class _AerospikeHandler(_RecvExact, socketserver.BaseRequestHandler):
 
 class FakeAerospike(FakeServer):
     handler_class = _AerospikeHandler
+
+
+# ---------------------------------------------------------------------------
+# Dgraph alpha HTTP API (alter/query/mutate with upsert blocks) — enough
+# for the dgraph suite's register and upsert clients.
+# ---------------------------------------------------------------------------
+
+_RE_DG_FUNC = _re.compile(
+    r"q\(func:\s*eq\((\w+),\s*\"?([^\")]+)\"?\)\)"
+    r"(?:\s*@filter\(eq\((\w+),\s*\"?([^\")]+)\"?\)\))?",
+)
+_RE_DG_NQUAD = _re.compile(
+    r"^(uid\(u\)|_:\w+)\s+<(\w+)>\s+\"([^\"]*)\"\s+\.$"
+)
+
+
+class _DgraphHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _match(self, nodes, query: str):
+        """uids matching the query's eq(func) (+ optional filter)."""
+        m = _RE_DG_FUNC.search(query)
+        if not m:
+            return []
+        pred, val, fpred, fval = m.groups()
+        out = []
+        for uid, preds in sorted(nodes.items()):
+            if str(preds.get(pred)) != val:
+                continue
+            if fpred and str(preds.get(fpred)) != fval:
+                continue
+            out.append(uid)
+        return out
+
+    def do_POST(self):
+        st = self.fake_store
+        path = urlparse(self.path).path
+        raw = self._body().decode()
+        with st.lock:
+            nodes = st.kv.setdefault("dgraph_nodes", {})
+            if path == "/alter":
+                self._send({"data": {"code": "Success"}})
+                return
+            if path == "/query":
+                uids = self._match(nodes, raw)
+                # which fields does the block request?
+                fields = []
+                for f in ("uid", "value", "key", "email"):
+                    if _re.search(rf"\b{f}\b(?!\()", raw.split("{", 2)[-1]):
+                        fields.append(f)
+                rows = []
+                for uid in uids:
+                    row = {}
+                    for f in fields:
+                        row[f] = uid if f == "uid" else nodes[uid].get(f)
+                    rows.append(row)
+                self._send({"data": {"q": rows}})
+                return
+            if path.startswith("/mutate"):
+                payload = json.loads(raw)
+                uids = self._match(nodes, payload.get("query", ""))
+                created = {}
+                for mut in payload.get("mutations", []):
+                    cond = mut.get("cond", "")
+                    n = len(uids)
+                    if "eq(len(u), 0)" in cond and n != 0:
+                        continue
+                    if "gt(len(u), 0)" in cond and n == 0:
+                        continue
+                    for line in mut.get("set_nquads", "").splitlines():
+                        line = line.strip()
+                        if not line:
+                            continue
+                        m = _RE_DG_NQUAD.match(line)
+                        if not m:
+                            continue
+                        subj, pred, val = m.groups()
+                        if subj == "uid(u)":
+                            for uid in uids:
+                                nodes[uid][pred] = val
+                        else:
+                            blank = subj[2:]
+                            uid = created.get(blank)
+                            if uid is None:
+                                n_id = st.kv.setdefault("dgraph_next", [1])
+                                uid = f"0x{n_id[0]:x}"
+                                n_id[0] += 1
+                                nodes[uid] = {}
+                                created[blank] = uid
+                            nodes[uid][pred] = val
+                self._send(
+                    {
+                        "data": {
+                            "code": "Success",
+                            "queries": {"q": [{"uid": u} for u in uids]},
+                            "uids": created,
+                        }
+                    }
+                )
+                return
+        self._send({"errors": [{"message": f"no route {path}"}]}, 400)
+
+
+class FakeDgraph(FakeServer):
+    handler_class = _DgraphHandler
+
+
+# ---------------------------------------------------------------------------
+# FaunaDB JSON wire API — evaluates the FQL-as-JSON subset the faunadb
+# suite's register and g2 clients emit.  Everything runs under the store
+# lock, so the fake is serializable by construction.
+# ---------------------------------------------------------------------------
+
+
+class _FaunaHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    # -- FQL-JSON evaluation ------------------------------------------
+    def _ref_parts(self, r):
+        # {"ref": {"@ref": "classes/cls/id"}} or {"@ref": "classes/cls/id"}
+        if isinstance(r, dict):
+            inner = r.get("ref", r)
+            path = inner.get("@ref", "")
+            parts = path.split("/")
+            if len(parts) == 3 and parts[0] == "classes":
+                return parts[1], parts[2]
+            if len(parts) == 2 and parts[0] == "classes":
+                return parts[1], None
+        return None, None
+
+    def _eval(self, docs, indexes, x):
+        if not isinstance(x, (dict, list)):
+            return x
+        if isinstance(x, list):
+            return [self._eval(docs, indexes, e) for e in x]
+        if "create_class" in x:
+            return {"ref": x["create_class"]["object"]["name"]}
+        if "create_index" in x:
+            obj = x["create_index"]["object"]
+            cls, _ = self._ref_parts({"ref": obj["source"]})
+            indexes[obj["name"]] = cls or obj["source"]
+            return {"ref": obj["name"]}
+        if "if" in x:
+            cond = self._eval(docs, indexes, x["if"])
+            branch = x["then"] if cond else x.get("else")
+            return self._eval(docs, indexes, branch)
+        if "not" in x:
+            return not self._eval(docs, indexes, x["not"])
+        if "equals" in x:
+            vals = [self._eval(docs, indexes, v) for v in x["equals"]]
+            return all(v == vals[0] for v in vals)
+        if "exists" in x:
+            tgt = x["exists"]
+            if isinstance(tgt, dict) and "match" in tgt:
+                idx = tgt["match"]["index"]
+                terms = self._eval(docs, indexes, tgt.get("terms", []))
+                cls = indexes.get(idx)
+                if isinstance(cls, dict):
+                    cls = self._ref_parts({"ref": cls})[0]
+                term = terms[0] if terms else None
+                return any(
+                    c == cls and d.get("key") == term
+                    for (c, _i), d in docs.items()
+                )
+            cls, id_ = self._ref_parts(tgt)
+            return (cls, id_) in docs
+        if "match" in x:
+            return x  # only consumed via exists
+        if "create" in x:
+            cls, id_ = self._ref_parts(x["create"])
+            data = (
+                x.get("params", {}).get("object", {}).get("data", {})
+                .get("object", {})
+            )
+            docs[(cls, id_)] = dict(data)
+            return {"ref": {"@ref": f"classes/{cls}/{id_}"}}
+        if "update" in x:
+            cls, id_ = self._ref_parts(x["update"])
+            data = (
+                x.get("params", {}).get("object", {}).get("data", {})
+                .get("object", {})
+            )
+            if (cls, id_) not in docs:
+                raise KeyError("instance not found")
+            docs[(cls, id_)].update(data)
+            return {"ref": {"@ref": f"classes/{cls}/{id_}"}}
+        if "select" in x:
+            path = x["select"]
+            src = x["from"]
+            if isinstance(src, dict) and "get" in src:
+                cls, id_ = self._ref_parts(src["get"])
+                doc = docs.get((cls, id_))
+                if doc is None:
+                    return x.get("default")
+                cur = {"data": doc}
+            else:
+                cur = self._eval(docs, indexes, src)
+            for p in path:
+                if not isinstance(cur, dict) or p not in cur:
+                    return x.get("default")
+                cur = cur[p]
+            return cur
+        if "get" in x:
+            cls, id_ = self._ref_parts(x["get"])
+            doc = docs.get((cls, id_))
+            if doc is None:
+                raise KeyError("instance not found")
+            return {"data": doc}
+        return x
+
+    def do_POST(self):
+        st = self.fake_store
+        raw = self._body().decode()
+        with st.lock:
+            docs = st.kv.setdefault("fauna_docs", {})
+            indexes = st.kv.setdefault("fauna_indexes", {})
+            try:
+                expr = json.loads(raw)
+                out = self._eval(docs, indexes, expr)
+            except KeyError as e:
+                self._send({"errors": [{"code": "instance not found",
+                                        "description": str(e)}]})
+                return
+            except Exception as e:  # noqa: BLE001 - fake returns errors
+                self._send({"errors": [{"description": repr(e)}]})
+                return
+        self._send({"resource": out})
+
+
+class FakeFauna(FakeServer):
+    handler_class = _FaunaHandler

@@ -1,0 +1,451 @@
+"""Suite-specific workload tests: cockroach monotonic + sequential,
+yugabyte multi-key ACID, dgraph upsert, faunadb g2 — each driven
+end-to-end against its fake server, plus checker unit tests on crafted
+histories (reference workloads: cockroach/monotonic.clj,
+cockroach/sequential.clj, yugabyte/ysql/multi_key_acid.clj,
+dgraph/upsert.clj, faunadb/g2.clj)."""
+
+import pytest
+
+from jepsen_tpu import core, independent
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+
+from fake_servers import FakeDgraph, FakeFauna, FakePg
+
+
+def h(*ops) -> History:
+    hist = History(ops)
+    for i, op in enumerate(hist):
+        op.index = i
+        op.time = i
+    return hist
+
+
+# -- cockroach monotonic ----------------------------------------------------
+
+
+def test_monotonic_client_roundtrip():
+    from jepsen_tpu.suites import monotonic
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": "cockroach",
+                "user": "postgres"}
+        c = monotonic.MonotonicClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        for v in range(6):
+            r = c.invoke({}, {"f": "add", "value": v, "type": "invoke",
+                              "process": v % 2})
+            assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok"
+        rows = r["value"]
+        assert [row[0] for row in rows] == list(range(6))
+        # DB timestamps strictly increase with insertion order
+        stss = [float(row[1]) for row in rows]
+        assert stss == sorted(stss)
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_monotonic_checker_valid_and_invalid():
+    from jepsen_tpu.suites.monotonic import MonotonicChecker
+
+    ok_rows = [[0, "1", 0, 0], [1, "2", 0, 1], [2, "3", 1, 0]]
+    hist = h(
+        invoke_op(0, "add", 0), ok_op(0, "add", 0),
+        invoke_op(0, "add", 1), ok_op(0, "add", 1),
+        invoke_op(1, "add", 2), ok_op(1, "add", 2),
+        invoke_op(0, "read"), ok_op(0, "read", ok_rows),
+    )
+    assert MonotonicChecker().check({}, hist)["valid?"] is True
+
+    # lost: value 1 added but missing from the final read
+    lost_hist = h(
+        invoke_op(0, "add", 0), ok_op(0, "add", 0),
+        invoke_op(0, "add", 1), ok_op(0, "add", 1),
+        invoke_op(0, "read"),
+        ok_op(0, "read", [[0, "1", 0, 0]]),
+    )
+    res = MonotonicChecker().check({}, lost_hist)
+    assert res["valid?"] is False and res["lost"] == [1]
+
+    # per-process value reorder: proc 0 saw 5 then 3
+    bad_rows = [[5, "1", 0, 0], [3, "2", 0, 1]]
+    reorder_hist = h(
+        invoke_op(0, "add", 5), ok_op(0, "add", 5),
+        invoke_op(0, "add", 3), ok_op(0, "add", 3),
+        invoke_op(0, "read"), ok_op(0, "read", bad_rows),
+    )
+    res = MonotonicChecker().check({}, reorder_hist)
+    assert res["valid?"] is False
+    assert res["value-reorders-per-process"]
+
+    # revived: a failed add shows up anyway
+    revived_hist = h(
+        invoke_op(0, "add", 0), ok_op(0, "add", 0),
+        invoke_op(0, "add", 9), fail_op(0, "add", 9),
+        invoke_op(0, "read"),
+        ok_op(0, "read", [[0, "1", 0, 0], [9, "2", 0, 1]]),
+    )
+    res = MonotonicChecker().check({}, revived_hist)
+    assert res["valid?"] is False and res["revived"] == [9]
+
+    # recovered (indeterminate seen) is informational, not an error
+    rec_hist = h(
+        invoke_op(0, "add", 0), ok_op(0, "add", 0),
+        invoke_op(0, "add", 4), info_op(0, "add", 4),
+        invoke_op(0, "read"),
+        ok_op(0, "read", [[0, "1", 0, 0], [4, "2", 0, 1]]),
+    )
+    res = MonotonicChecker().check({}, rec_hist)
+    assert res["valid?"] is True and res["recovered"] == [4]
+
+    # no final read → unknown
+    res = MonotonicChecker().check({}, h(invoke_op(0, "add", 0),
+                                         ok_op(0, "add", 0)))
+    assert res["valid?"] == "unknown"
+
+
+def test_monotonic_full_test_in_process():
+    from jepsen_tpu.suites import cockroachdb
+
+    s = FakePg().start()
+    try:
+        t = cockroachdb.test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 50,
+                "workload": "monotonic",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- cockroach sequential ---------------------------------------------------
+
+
+def test_sequential_trailing_nil():
+    from jepsen_tpu.suites.sequential import trailing_nil
+
+    assert not trailing_nil([None, None, "a", "b"])
+    assert not trailing_nil(["a", "b"])
+    assert not trailing_nil([None, None])
+    assert trailing_nil(["a", None])
+    assert trailing_nil([None, "a", None, "b"])
+
+
+def test_sequential_client_and_checker():
+    from jepsen_tpu.suites import sequential as seq
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": "cockroach",
+                "user": "postgres", "key-count": 3}
+        c = seq.SequentialClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "value": 7,
+                             "type": "invoke"})["type"] == "ok"
+        r = c.invoke({}, {"f": "read", "value": 7, "type": "invoke"})
+        assert r["type"] == "ok"
+        k, ks = r["value"]
+        assert k == 7 and ks == ["7_2", "7_1", "7_0"]
+        # unwritten key reads all-nil (legal)
+        r2 = c.invoke({}, {"f": "read", "value": 99, "type": "invoke"})
+        assert r2["value"][1] == [None, None, None]
+        c.close({})
+
+        chk = seq.SequentialChecker(key_count=3)
+        good = h(
+            invoke_op(0, "read", 7),
+            ok_op(0, "read", [7, ["7_2", "7_1", "7_0"]]),
+            invoke_op(0, "read", 9),
+            ok_op(0, "read", [9, [None, "9_1", "9_0"]]),
+        )
+        res = chk.check({}, good)
+        assert res["valid?"] is True and res["all-count"] == 1
+        bad = h(
+            invoke_op(0, "read", 7),
+            ok_op(0, "read", [7, ["7_2", None, "7_0"]]),
+        )
+        res = chk.check({}, bad)
+        assert res["valid?"] is False and res["bad-count"] == 1
+    finally:
+        s.stop()
+
+
+def test_sequential_full_test_in_process():
+    from jepsen_tpu.suites import cockroachdb
+
+    s = FakePg().start()
+    try:
+        t = cockroachdb.test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 50,
+                "workload": "sequential",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- yugabyte multi-key ACID ------------------------------------------------
+
+
+def test_multi_key_acid_client_roundtrip():
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": "pg",
+                "user": "postgres"}
+        c = yugabyte.MultiKeyAcidClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        w = c.invoke({}, {
+            "f": "write", "type": "invoke",
+            "value": independent.kv(5, [["w", 0, 3], ["w", 2, 4]]),
+        })
+        assert w["type"] == "ok", w
+        r = c.invoke({}, {
+            "f": "read", "type": "invoke",
+            "value": independent.kv(5, [["r", 0, None], ["r", 1, None],
+                                        ["r", 2, None]]),
+        })
+        assert r["type"] == "ok"
+        k, mops = r["value"]
+        assert k == 5
+        assert mops == [["r", 0, 3], ["r", 1, None], ["r", 2, 4]]
+        # overwrite via upsert inside a txn
+        w2 = c.invoke({}, {
+            "f": "write", "type": "invoke",
+            "value": independent.kv(5, [["w", 0, 9]]),
+        })
+        assert w2["type"] == "ok"
+        r2 = c.invoke({}, {
+            "f": "read", "type": "invoke",
+            "value": independent.kv(5, [["r", 0, None]]),
+        })
+        assert r2["value"][1] == [["r", 0, 9]]
+        # other independent keys are isolated
+        r3 = c.invoke({}, {
+            "f": "read", "type": "invoke",
+            "value": independent.kv(6, [["r", 0, None]]),
+        })
+        assert r3["value"][1] == [["r", 0, None]]
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_multi_key_acid_checker():
+    from jepsen_tpu import checker as checker_mod
+    from jepsen_tpu import models
+
+    chk = checker_mod.linearizable(models.multi_register({}), pure_fs=())
+    good = h(
+        invoke_op(0, "write", [["w", 0, 1], ["w", 1, 2]]),
+        ok_op(0, "write", [["w", 0, 1], ["w", 1, 2]]),
+        invoke_op(1, "read", [["r", 0, None], ["r", 1, None]]),
+        ok_op(1, "read", [["r", 0, 1], ["r", 1, 2]]),
+    )
+    assert chk.check({}, good)["valid?"] is True
+    bad = h(
+        invoke_op(0, "write", [["w", 0, 1], ["w", 1, 2]]),
+        ok_op(0, "write", [["w", 0, 1], ["w", 1, 2]]),
+        invoke_op(1, "read", [["r", 0, 1], ["r", 1, 7]]),
+        ok_op(1, "read", [["r", 0, 1], ["r", 1, 7]]),
+    )
+    assert chk.check({}, bad)["valid?"] is False
+
+
+def test_multi_key_acid_workload_shape():
+    from jepsen_tpu.suites import yugabyte
+
+    w = yugabyte.workloads({"nodes": ["n1", "n2", "n3"]})
+    assert "ysql.multi-key-acid" in w
+    assert "generator" in w["ysql.multi-key-acid"]
+    assert "checker" in w["ysql.multi-key-acid"]
+
+
+# -- dgraph upsert ----------------------------------------------------------
+
+
+def test_dgraph_register_client_roundtrip():
+    """The fake alpha also unlocks the existing register client."""
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = dgraph.DgraphClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "value": [1, 5],
+                             "type": "invoke"})["type"] == "ok"
+        r = c.invoke({}, {"f": "read", "value": [1, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (1, "5")
+        assert c.invoke({}, {"f": "cas", "value": [1, [5, 6]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [1, [5, 7]],
+                             "type": "invoke"})["type"] == "fail"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_dgraph_upsert_client_and_checker():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = dgraph.DgraphUpsertClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r1 = c.invoke({}, {"f": "upsert", "type": "invoke",
+                           "value": independent.kv("a@x", None)})
+        assert r1["type"] == "ok", r1
+        # second upsert of the same key must lose
+        r2 = c.invoke({}, {"f": "upsert", "type": "invoke",
+                           "value": independent.kv("a@x", None)})
+        assert r2["type"] == "fail"
+        rr = c.invoke({}, {"f": "read", "type": "invoke",
+                           "value": independent.kv("a@x", None)})
+        assert rr["type"] == "ok"
+        k, uids = rr["value"]
+        assert k == "a@x" and len(uids) == 1
+        c.close({})
+
+        chk = dgraph.UpsertChecker()
+        good = h(
+            invoke_op(0, "upsert"), ok_op(0, "upsert"),
+            invoke_op(1, "upsert"), fail_op(1, "upsert"),
+            invoke_op(0, "read"), ok_op(0, "read", ["0x1"]),
+        )
+        assert chk.check({}, good)["valid?"] is True
+        bad = h(
+            invoke_op(0, "upsert"), ok_op(0, "upsert"),
+            invoke_op(1, "upsert"), ok_op(1, "upsert"),
+            invoke_op(0, "read"), ok_op(0, "read", ["0x1", "0x2"]),
+        )
+        res = chk.check({}, bad)
+        assert res["valid?"] is False and res["bad-reads"]
+    finally:
+        s.stop()
+
+
+def test_dgraph_upsert_full_test_in_process():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        t = dgraph.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "upsert",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- faunadb g2 -------------------------------------------------------------
+
+
+def test_fauna_register_client_roundtrip():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = faunadb.FaunaClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "value": [0, 3],
+                             "type": "invoke"})["type"] == "ok"
+        r = c.invoke({}, {"f": "read", "value": [0, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (0, 3)
+        assert c.invoke({}, {"f": "cas", "value": [0, [3, 4]],
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "cas", "value": [0, [3, 9]],
+                             "type": "invoke"})["type"] == "fail"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_fauna_g2_client():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = faunadb.FaunaG2Client(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        # first insert of the pair commits...
+        r1 = c.invoke({}, {"f": "insert", "type": "invoke",
+                           "value": independent.kv(1, [10, None])})
+        assert r1["type"] == "ok", r1
+        # ...the partner (other class, same key) must be refused
+        r2 = c.invoke({}, {"f": "insert", "type": "invoke",
+                           "value": independent.kv(1, [None, 11])})
+        assert r2["type"] == "fail"
+        # a different key is free to insert
+        r3 = c.invoke({}, {"f": "insert", "type": "invoke",
+                           "value": independent.kv(2, [None, 12])})
+        assert r3["type"] == "ok"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_fauna_g2_full_test_in_process():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        t = faunadb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "g2",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
