@@ -16,15 +16,16 @@ _BASE: Dict[str, Set[str]] = {
     "read-uncommitted": {"G0", "dirty-update", "duplicate-elements",
                          "incompatible-order"},
     "read-committed": {"G1a", "G1b", "G1c", "internal"},
-    "repeatable-read": {"G2-item"},
-    "snapshot-isolation": {"G-single"},
-    "serializable": {"G-single", "G2-item"},
+    "repeatable-read": {"G2-item", "lost-update"},
+    "snapshot-isolation": {"G-single", "G-nonadjacent", "lost-update"},
+    "serializable": {"G-single", "G-nonadjacent", "G2-item"},
     "strict-serializable": {
         "G0-realtime", "G1c-realtime", "G-single-realtime",
-        "G2-item-realtime",
+        "G-nonadjacent-realtime", "G2-item-realtime",
     },
     "sequential": {
-        "G0-process", "G1c-process", "G-single-process", "G2-item-process",
+        "G0-process", "G1c-process", "G-single-process",
+        "G-nonadjacent-process", "G2-item-process",
     },
 }
 
@@ -43,11 +44,13 @@ KNOWN_MODELS = sorted(_BASE)
 #: Cycle anomalies implied by others (a G0 is also a G1c profile etc.) —
 #: used only for reporting, not detection.
 SEVERITY = [
-    "G0", "G1c", "G-single", "G2-item",
-    "G0-process", "G1c-process", "G-single-process", "G2-item-process",
-    "G0-realtime", "G1c-realtime", "G-single-realtime", "G2-item-realtime",
-    "G1a", "G1b", "dirty-update", "internal", "duplicate-elements",
-    "incompatible-order",
+    "G0", "G1c", "G-single", "G-nonadjacent", "G2-item",
+    "G0-process", "G1c-process", "G-single-process",
+    "G-nonadjacent-process", "G2-item-process",
+    "G0-realtime", "G1c-realtime", "G-single-realtime",
+    "G-nonadjacent-realtime", "G2-item-realtime",
+    "G1a", "G1b", "lost-update", "dirty-update", "internal",
+    "duplicate-elements", "incompatible-order",
 ]
 
 
@@ -69,7 +72,7 @@ def proscribed(opts: dict) -> Set[str]:
         if a == "G1":
             out |= {"G1a", "G1b", "G1c"}
         elif a == "G2":
-            out |= {"G-single", "G2-item"}
+            out |= {"G-single", "G-nonadjacent", "G2-item"}
         else:
             out.add(a)
     for m in opts.get("consistency-models") or (
@@ -79,13 +82,37 @@ def proscribed(opts: dict) -> Set[str]:
     return out
 
 
+#: classify() names each cycle by its most-specific profile, but a
+#: specific profile is still an *instance* of the general ones — a
+#: single-rw cycle is also a nonadjacent-rw cycle and an item
+#: anti-dependency cycle.  A model proscribing the general name must
+#: therefore reject the specific finding too (Elle's implied-anomalies).
+_INSTANCE_OF: Dict[str, Sequence[str]] = {
+    "G-single": ("G-nonadjacent", "G2-item"),
+    "G-nonadjacent": ("G2-item",),
+    "G-single-process": ("G-nonadjacent-process", "G2-item-process"),
+    "G-nonadjacent-process": ("G2-item-process",),
+    "G-single-realtime": ("G-nonadjacent-realtime", "G2-item-realtime"),
+    "G-nonadjacent-realtime": ("G2-item-realtime",),
+    "G0": ("G1c",),
+    "G0-process": ("G1c-process",),
+    "G0-realtime": ("G1c-realtime",),
+}
+
+
+def _proscribed_name(name: str, wanted: Set[str]) -> bool:
+    return name in wanted or any(
+        g in wanted for g in _INSTANCE_OF.get(name, ())
+    )
+
+
 def result(
     anomalies: Dict[str, list], wanted: Set[str], txn_count: int = 0
 ) -> dict:
     """Shape the final verdict: valid iff no *proscribed* anomaly was
     found; unproscribed findings are reported under also-anomalies."""
-    bad = {k: v for k, v in anomalies.items() if k in wanted}
-    also = {k: v for k, v in anomalies.items() if k not in wanted}
+    bad = {k: v for k, v in anomalies.items() if _proscribed_name(k, wanted)}
+    also = {k: v for k, v in anomalies.items() if k not in bad}
     out: dict = {
         "valid?": not bad,
         "txn-count": txn_count,

@@ -2,10 +2,13 @@
 
 Adya's phenomena as edge-type profiles over the dependency graph:
 
-- G0         cycle of only ww edges
-- G1c        cycle of ww/wr edges (not G0)
-- G-single   cycle with exactly one rw edge, rest ww/wr
-- G2-item    cycle with ≥1 rw edges (≥2 once G-single is excluded)
+- G0            cycle of only ww edges
+- G1c           cycle of ww/wr edges (not G0)
+- G-single      cycle with exactly one rw edge, rest ww/wr
+- G-nonadjacent cycle with ≥2 rw edges, no two cyclically adjacent —
+                still impossible under snapshot isolation
+- G2-item       cycle with ≥1 rw edges (≥2, some adjacent, once the
+                previous two are excluded)
 
 With realtime/process graphs unioned in, the same profiles allowing
 those edges yield the -realtime / -process variants (e.g. a cycle of ww
@@ -27,6 +30,7 @@ from .graph import (
     cycle_rels,
     find_cycle,
     find_cycle_with,
+    find_nonadjacent_cycle,
     strongly_connected_components,
 )
 
@@ -86,6 +90,18 @@ def classify(g: Graph) -> Dict[str, list]:
             record("G-single", cyc)
             continue
 
+        # G-nonadjacent: ≥2 rw edges, none cyclically adjacent — still a
+        # snapshot-isolation violation (SI cycles need two adjacent rws)
+        cyc = find_nonadjacent_cycle(
+            g,
+            scc,
+            want=has_rw,
+            rest=lambda rels: bool(rels & {WW, WR}),
+        )
+        if cyc is not None:
+            record("G-nonadjacent", cyc)
+            continue
+
         sub = g.filtered(lambda rels: bool(rels & {WW, WR, RW}))
         cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
         if cyc is not None:
@@ -98,6 +114,7 @@ def classify(g: Graph) -> Dict[str, list]:
             ({WW}, "G0"),
             ({WW, WR}, "G1c"),
             (None, "G-single"),
+            ("nonadjacent", "G-nonadjacent"),
             ({WW, WR, RW}, "G2-item"),
         ):
             if name == "G-single":
@@ -107,6 +124,13 @@ def classify(g: Graph) -> Dict[str, list]:
                     want=has_rw,
                     rest=lambda rels: bool(rels & {WW, WR, PROCESS, REALTIME}),
                     want_count=1,
+                )
+            elif name == "G-nonadjacent":
+                cyc = find_nonadjacent_cycle(
+                    g,
+                    scc,
+                    want=has_rw,
+                    rest=lambda rels: bool(rels & {WW, WR, PROCESS, REALTIME}),
                 )
             else:
                 sub = g.filtered(

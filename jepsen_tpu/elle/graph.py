@@ -217,3 +217,73 @@ def find_cycle_with(
 def cycle_rels(g: Graph, cycle: List[Any]) -> List[Set[str]]:
     """The rel-sets along a cycle path [v1 v2 … v1]."""
     return [g.edge_rels(a, b) for a, b in zip(cycle, cycle[1:])]
+
+
+def find_nonadjacent_cycle(
+    g: Graph,
+    scc: List[Any],
+    want: Callable[[Set[str]], bool],
+    rest: Callable[[Set[str]], bool],
+) -> Optional[List[Any]]:
+    """Find a cycle containing ≥1 ``want`` edges, no two of them
+    adjacent (cyclically — the wrap-around pair counts), every other
+    edge satisfying ``rest``.  Used for G-nonadjacent: under snapshot
+    isolation every dependency cycle must contain two *adjacent* rw
+    anti-dependency edges, so a cycle whose rw edges are all isolated is
+    a genuine SI violation (Adya G-SI / Cerone's SI characterization).
+
+    Any qualifying cycle can be rotated to start with a want edge, so
+    trying every start vertex with a forced want first edge is complete.
+    BFS over the product graph state (vertex, last-edge-was-want); a
+    want edge is only traversable when the previous edge was not, and
+    the closing edge back to start must be non-want (it precedes the
+    first, want, edge in the rotation)."""
+    members = set(scc)
+
+    def bfs(start: Any) -> Optional[List[Any]]:
+        parent: Dict[Tuple[Any, bool], Tuple[Any, bool]] = {}
+        q: deque = deque()
+        seen: Set[Tuple[Any, bool]] = set()
+        # seed: the forced want first edge out of start
+        for w in g.successors(start):
+            if w not in members or w == start:
+                continue
+            if want(g.edge_rels(start, w)):
+                st = (w, True)
+                if st not in seen:
+                    seen.add(st)
+                    q.append(st)
+        while q:
+            v, last = q.popleft()
+            for w in g.successors(v):
+                if w not in members:
+                    continue
+                rels = g.edge_rels(v, w)
+                if w == start:
+                    # closing edge must be non-want (wrap adjacency)
+                    if rest(rels):
+                        back = []
+                        cur: Optional[Tuple[Any, bool]] = (v, last)
+                        while cur is not None:
+                            back.append(cur[0])
+                            cur = parent.get(cur)
+                        return [start] + back[::-1] + [start]
+                    continue
+                steps = []
+                if want(rels) and not last:
+                    steps.append(True)
+                if rest(rels):
+                    steps.append(False)
+                for is_want in steps:
+                    st = (w, is_want)
+                    if st not in seen:
+                        seen.add(st)
+                        parent[st] = (v, last)
+                        q.append(st)
+        return None
+
+    for start in scc:
+        cyc = bfs(start)
+        if cyc is not None:
+            return cyc
+    return None

@@ -103,6 +103,36 @@ def g1b_cases(txns: List[Txn]) -> List[dict]:
     return cases
 
 
+def lost_update_cases(txns: List[Txn]) -> List[dict]:
+    """Two (or more) committed txns that both externally read version v
+    of key k and both externally wrote k: only one of those updates can
+    have seen the other, so an update was lost.  (Elle's
+    elle.txn/lost-update-cases; proscribed from cursor stability /
+    snapshot isolation upward.)"""
+    groups: Dict[Tuple[Any, Any], List[Txn]] = defaultdict(list)
+    for t in txns:
+        if not t.ok:
+            continue
+        written = {k for f, k, _v in mops(t) if f == W}
+        seen: Set[Any] = set()
+        for f, k, v in mops(t):
+            if f == W:
+                seen.add(k)
+            elif f == R and k not in seen:
+                seen.add(k)
+                if k in written:  # external read + external write of k
+                    groups[(k, v)].append(t)
+    return [
+        {
+            "key": k,
+            "value": v,
+            "txns": [t.complete.to_dict() for t in ts],
+        }
+        for (k, v), ts in sorted(groups.items(), key=lambda kv: str(kv[0]))
+        if len(ts) > 1
+    ]
+
+
 def _ext_write(t: Txn, k: Any) -> Optional[Any]:
     """The txn's final (externally visible) write of k, or None."""
     out = None
@@ -219,6 +249,9 @@ def graph_and_anomalies(
     g1b = g1b_cases(txns)
     if g1b:
         anomalies["G1b"] = g1b
+    lost = lost_update_cases(txns)
+    if lost:
+        anomalies["lost-update"] = lost
 
     vgraphs, cyclic = version_graphs(txns, extra_graphs)
     if cyclic:

@@ -391,3 +391,212 @@ def test_cycle_workload_checker_defaults_survive_generator_opts():
     assert t["checker"].opts.get("anomalies") == ["G1", "G2"]
     t2 = cycle_append.test({"consistency-models": ["serializable"]})
     assert "anomalies" not in t2["checker"].opts
+
+
+# ---------------------------------------------------------------------------
+# lost-update + G-nonadjacent (elle parity: wr.clj anomaly breadth)
+# ---------------------------------------------------------------------------
+
+
+def test_rw_register_lost_update():
+    # T1 and T2 both read x=1 and both write x: one update must be lost
+    h = hist(
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0),
+        txn_pair(
+            1,
+            [["r", "x", None], ["w", "x", 2]],
+            [["r", "x", 1], ["w", "x", 2]],
+            10,
+        ),
+        txn_pair(
+            2,
+            [["r", "x", None], ["w", "x", 3]],
+            [["r", "x", 1], ["w", "x", 3]],
+            12,
+        ),
+    )
+    res = rw_register.check(h, {"consistency-models": ["snapshot-isolation"]})
+    assert res["valid?"] is False
+    assert "lost-update" in res["anomaly-types"]
+    case = res["anomalies"]["lost-update"][0]
+    assert case["key"] == "x" and case["value"] == 1
+    assert len(case["txns"]) == 2
+    # read-committed does not proscribe lost update: reported only as also
+    res_rc = rw_register.check(h, {"consistency-models": ["read-committed"]})
+    assert res_rc["valid?"] is not False or "lost-update" not in res_rc[
+        "anomaly-types"
+    ]
+
+
+def test_rw_register_no_lost_update_when_reads_differ():
+    # T2 read version 2 (T1's write) — a chain, not a lost update
+    h = hist(
+        txn_pair(0, [["w", "x", 1]], [["w", "x", 1]], 0),
+        txn_pair(
+            1,
+            [["r", "x", None], ["w", "x", 2]],
+            [["r", "x", 1], ["w", "x", 2]],
+            10,
+        ),
+        txn_pair(
+            2,
+            [["r", "x", None], ["w", "x", 3]],
+            [["r", "x", 2], ["w", "x", 3]],
+            20,
+        ),
+    )
+    res = rw_register.check(h, {"consistency-models": ["snapshot-isolation"]})
+    assert "lost-update" not in res.get("anomaly-types", [])
+    assert "lost-update" not in res.get("also-anomaly-types", [])
+
+
+def test_find_nonadjacent_cycle():
+    # rw → wr → rw → wr: qualifies (rws separated)
+    g = Graph()
+    g.add_edge("a", "b", RW)
+    g.add_edge("b", "c", WR)
+    g.add_edge("c", "d", RW)
+    g.add_edge("d", "a", WR)
+    cyc = g_mod.find_nonadjacent_cycle(
+        g, ["a", "b", "c", "d"],
+        want=lambda r: RW in r,
+        rest=lambda r: bool(r & {WW, WR}),
+    )
+    assert cyc is not None and cyc[0] == cyc[-1] and len(cyc) == 5
+
+    # pure write-skew (two adjacent rws) must NOT qualify
+    g2 = Graph()
+    g2.add_edge("a", "b", RW)
+    g2.add_edge("b", "a", RW)
+    assert (
+        g_mod.find_nonadjacent_cycle(
+            g2, ["a", "b"],
+            want=lambda r: RW in r,
+            rest=lambda r: bool(r & {WW, WR}),
+        )
+        is None
+    )
+
+
+def test_rw_register_g_nonadjacent_vs_write_skew():
+    # Non-adjacent rw cycle: T1 -rw(x)-> T2 -wr(a)-> T3 -rw(y)-> T4
+    # -wr(b)-> T1.  Snapshot isolation must flag it as G-nonadjacent.
+    h = hist(
+        txn_pair(
+            0,
+            [["r", "x", None], ["r", "b", None]],
+            [["r", "x", None], ["r", "b", 1]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["w", "x", 1], ["w", "a", 1]],
+            [["w", "x", 1], ["w", "a", 1]],
+            2,
+        ),
+        txn_pair(
+            2,
+            [["r", "a", None], ["r", "y", None]],
+            [["r", "a", 1], ["r", "y", None]],
+            4,
+        ),
+        txn_pair(
+            3,
+            [["w", "y", 1], ["w", "b", 1]],
+            [["w", "y", 1], ["w", "b", 1]],
+            6,
+        ),
+    )
+    res = rw_register.check(h, {"consistency-models": ["snapshot-isolation"]})
+    assert res["valid?"] is False, res
+    assert "G-nonadjacent" in res["anomaly-types"], res
+
+    # Classic write skew: T1 reads x writes y; T2 reads y writes x —
+    # adjacent rws, classified G2-item, LEGAL under snapshot isolation.
+    skew = hist(
+        txn_pair(
+            0,
+            [["r", "x", None], ["w", "y", 1]],
+            [["r", "x", None], ["w", "y", 1]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["r", "y", None], ["w", "x", 1]],
+            [["r", "y", None], ["w", "x", 1]],
+            1,
+        ),
+    )
+    res_si = rw_register.check(
+        skew, {"consistency-models": ["snapshot-isolation"]}
+    )
+    assert res_si["valid?"] is True, res_si
+    assert "G2-item" in res_si.get("also-anomaly-types", []), res_si
+    # ...but serializability proscribes it
+    res_ser = rw_register.check(
+        skew, {"consistency-models": ["serializable"]}
+    )
+    assert res_ser["valid?"] is False
+    assert "G2-item" in res_ser["anomaly-types"]
+
+
+def test_specific_cycle_names_do_not_shadow_general_proscriptions():
+    """A G-nonadjacent (or G-single) cycle is still a G2-item instance:
+    repeatable-read must reject it even though classify() reports the
+    more specific name."""
+    # 4-txn nonadjacent rw cycle (same shape as the SI test above)
+    h = hist(
+        txn_pair(
+            0,
+            [["r", "x", None], ["r", "b", None]],
+            [["r", "x", None], ["r", "b", 1]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["w", "x", 1], ["w", "a", 1]],
+            [["w", "x", 1], ["w", "a", 1]],
+            2,
+        ),
+        txn_pair(
+            2,
+            [["r", "a", None], ["r", "y", None]],
+            [["r", "a", 1], ["r", "y", None]],
+            4,
+        ),
+        txn_pair(
+            3,
+            [["w", "y", 1], ["w", "b", 1]],
+            [["w", "y", 1], ["w", "b", 1]],
+            6,
+        ),
+    )
+    for opts in (
+        {"consistency-models": ["repeatable-read"]},
+        {"anomalies": ["G2-item"]},
+        {"anomalies": ["G2"]},
+    ):
+        res = rw_register.check(h, opts)
+        assert res["valid?"] is False, (opts, res)
+        assert "G-nonadjacent" in res["anomaly-types"]
+
+    # single-rw cycle: T1 -rw-> T2 -wr-> T1
+    single = hist(
+        txn_pair(
+            0,
+            [["r", "x", None], ["r", "a", None]],
+            [["r", "x", None], ["r", "a", 1]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["w", "x", 1], ["w", "a", 1]],
+            [["w", "x", 1], ["w", "a", 1]],
+            2,
+        ),
+    )
+    res = rw_register.check(
+        single, {"consistency-models": ["repeatable-read"]}
+    )
+    assert res["valid?"] is False, res
+    assert "G-single" in res["anomaly-types"]
