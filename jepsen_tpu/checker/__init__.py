@@ -586,12 +586,44 @@ class _Linearizable(Checker):
 
             a = wgl.analysis(self.model, history)
         else:
-            a = linear.analysis(self.model, history, pure_fs=self.pure_fs)
+            # witness=True tracks parent pointers (one dict insert per
+            # new config, reset per completed op) so a failing analysis
+            # already carries final-paths/ops — render_witness would
+            # otherwise rerun the whole exponential search from scratch
+            a = linear.analysis(
+                self.model, history, pure_fs=self.pure_fs, witness=True
+            )
+        # Failure witness: linear.svg with final configs/paths around the
+        # non-linearizable op (reference: checker.clj:206-210, where
+        # knossos.linear.report renders the same artifact).  Only when
+        # the test has a real store identity — unit checks on bare test
+        # maps should not litter the working directory.
+        if (
+            a.get("valid?") is False
+            and test
+            and test.get("name")
+            and test.get("start-time")
+        ):
+            from .. import store as store_mod
+            from . import linear_svg
+
+            try:
+                out = store_mod.path_(
+                    test, *(opts or {}).get("subdirectory", []), "linear.svg"
+                )
+                if linear_svg.render_witness(
+                    self.model, history, a, out, pure_fs=self.pure_fs
+                ):
+                    a["witness"] = out
+            except Exception as e:  # noqa: BLE001 — never mask the verdict
+                a["witness-error"] = repr(e)
         # Truncate potentially huge fields (reference: checker.clj:213-216)
         if "configs" in a:
             a["configs"] = a["configs"][:10]
         if "final-paths" in a:
             a["final-paths"] = a["final-paths"][:10]
+        if "ops" in a:
+            del a["ops"]  # witness-renderer context; huge on long tests
         return a
 
 

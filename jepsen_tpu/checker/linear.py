@@ -96,9 +96,12 @@ def _closure(
     open_ops: Set[int],
     ops: list,
     max_configs: int,
+    parents: Optional[Dict] = None,
 ) -> Tuple[Set[Tuple[Model, FrozenSet[int]]], bool]:
     """Expand configs by linearizing open ops until fixpoint.
-    Returns (configs, overflowed?)."""
+    Returns (configs, overflowed?).  When ``parents`` is given, each
+    newly reached config records (parent-config, op-id) so a witness
+    path can be reconstructed for failure reports."""
     frontier = configs
     seen = set(configs)
     while frontier:
@@ -115,10 +118,55 @@ def _closure(
                 if cfg not in seen:
                     seen.add(cfg)
                     new.add(cfg)
+                    if parents is not None:
+                        parents[cfg] = ((model, linset), op_id)
                     if len(seen) > max_configs:
                         return seen, True
         frontier = new
     return seen, False
+
+
+def _final_paths(
+    configs: Set[Tuple[Model, FrozenSet[int]]],
+    parents: Dict,
+    ops: list,
+    failing_op: Op,
+    limit: int = 10,
+) -> list:
+    """Representative linearization paths (since the previous completed
+    op) leading to each final config — the knossos-report
+    ``:final-paths`` equivalent.  ``why`` records the model's exact
+    complaint when the failing op steps from that config's state."""
+    paths = []
+    for cfg in sorted(configs, key=lambda c: repr(c))[:limit]:
+        stepped = cfg[0].step(failing_op)
+        why = (
+            str(getattr(stepped, "msg", "inconsistent"))
+            if stepped.is_inconsistent
+            else "op not linearizable here"
+        )
+        steps = []
+        cur = cfg
+        while cur in parents:
+            (pcfg, op_id) = parents[cur]
+            steps.append(
+                {
+                    "op": ops[op_id].to_dict(),
+                    "op-id": op_id,
+                    "model": repr(cur[0]),
+                }
+            )
+            cur = pcfg
+        steps.reverse()
+        paths.append(
+            {
+                "init": repr(cur[0]),
+                "steps": steps,
+                "pending": sorted(cfg[1]),
+                "why": why,
+            }
+        )
+    return paths
 
 
 def analysis(
@@ -126,21 +174,28 @@ def analysis(
     history: History,
     pure_fs: Iterable[Any] = (),
     max_configs: int = DEFAULT_MAX_CONFIGS,
+    witness: bool = False,
 ) -> dict:
     """Check history against model. Returns
     {"valid?": True|False|"unknown", ...} with a witness :op on failure
     and sample :configs (truncated to 10, as the reference does at
-    checker.clj:213-216)."""
+    checker.clj:213-216).  ``witness=True`` additionally reconstructs
+    ``final-paths`` (one linearization path per surviving config since
+    the last completed op) and ``op-ids``/``ops`` context for the
+    failure-witness renderer."""
     events, ops = prepare(history, pure_fs)
 
     configs: Set[Tuple[Model, FrozenSet[int]]] = {(model, frozenset())}
     open_ops: Set[int] = set()
+    parents: Optional[Dict] = {} if witness else None
 
     for kind, op_id in events:
         if kind == INVOKE:
             open_ops.add(op_id)
         elif kind == OK:
-            configs, overflow = _closure(configs, open_ops, ops, max_configs)
+            configs, overflow = _closure(
+                configs, open_ops, ops, max_configs, parents
+            )
             if overflow:
                 return {
                     "valid?": "unknown",
@@ -152,7 +207,7 @@ def analysis(
                 (m, linset - {op_id}) for (m, linset) in configs if op_id in linset
             }
             if not survivors:
-                return {
+                out = {
                     "valid?": False,
                     "op": ops[op_id].to_dict(),
                     "configs": [
@@ -160,7 +215,17 @@ def analysis(
                         for m, linset in list(configs)[:10]
                     ],
                 }
+                if witness:
+                    out["final-paths"] = _final_paths(
+                        configs, parents, ops, ops[op_id]
+                    )
+                    out["failed-op-id"] = op_id
+                    out["ops"] = [o.to_dict() for o in ops]
+                    out["open-ops"] = sorted(open_ops)
+                return out
             configs = survivors
+            if parents is not None:
+                parents = {}  # re-root paths at the new common prefix
             open_ops.discard(op_id)
         elif kind == INFO:
             # stays open forever; nothing to do

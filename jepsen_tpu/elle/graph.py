@@ -284,6 +284,12 @@ def find_nonadjacent_cycle(
 
     for start in scc:
         cyc = bfs(start)
-        if cyc is not None:
+        if cyc is not None and len(set(cyc[:-1])) == len(cyc) - 1:
+            # accept only simple cycles: the product-graph BFS can close
+            # a walk that revisits a vertex under the other flag, and a
+            # non-simple walk is not a sound nonadjacent witness (its
+            # simple decomposition may contain only adjacent-rw cycles).
+            # Rejecting it here just drops the SCC to the G2-item rung —
+            # conservative, never a false G-nonadjacent claim.
             return cyc
     return None

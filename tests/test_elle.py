@@ -600,3 +600,21 @@ def test_specific_cycle_names_do_not_shadow_general_proscriptions():
     )
     assert res["valid?"] is False, res
     assert "G-single" in res["anomaly-types"]
+
+
+def test_find_nonadjacent_cycle_rejects_nonsimple_walks():
+    # s-rw->v, v-ww->x, x-ww->v, v-rw->y, y-ww->s: the product-graph BFS
+    # can close the walk s,v,x,v,y,s — but the only simple cycles are a
+    # ww-ww pair and an adjacent-rw triangle, neither a valid witness
+    g = Graph()
+    g.add_edge("s", "v", RW)
+    g.add_edge("v", "x", WW)
+    g.add_edge("x", "v", WW)
+    g.add_edge("v", "y", RW)
+    g.add_edge("y", "s", WW)
+    cyc = g_mod.find_nonadjacent_cycle(
+        g, ["s", "v", "x", "y"],
+        want=lambda r: RW in r,
+        rest=lambda r: bool(r & {WW, WR}),
+    )
+    assert cyc is None or len(set(cyc[:-1])) == len(cyc) - 1

@@ -137,3 +137,97 @@ def test_bank_plotter(tmp_path):
     res = bank.plotter().check(test, h, {})
     assert res["valid?"] is True
     assert (tmp_path / "render-test" / "t0" / "bank.svg").exists()
+
+
+# ---------------------------------------------------------------------------
+# linearizability failure witness (knossos linear.svg equivalent,
+# reference: checker.clj:206-210)
+# ---------------------------------------------------------------------------
+
+
+def _bad_register_history():
+    from jepsen_tpu.history import invoke_op
+
+    ops = [
+        invoke_op(0, "write", 1, time=0),
+        ok_op(0, "write", 1, time=1),
+        invoke_op(1, "write", 2, time=2),   # concurrent with the read
+        invoke_op(2, "read", None, time=3),
+        Op("ok", 2, "read", 7, time=4),     # 7 was never written
+        Op("ok", 1, "write", 2, time=5),
+    ]
+    return History(ops).index_ops()
+
+
+def test_linear_final_paths_witness():
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker import linear
+
+    res = linear.analysis(
+        m.register(0), _bad_register_history(), pure_fs=("read",),
+        witness=True,
+    )
+    assert res["valid?"] is False
+    assert res["op"]["f"] == "read"
+    paths = res["final-paths"]
+    assert paths, res
+    # every path starts at the last promoted prefix state (value 1)
+    assert all(p["init"] == "Register(1)" for p in paths)
+    # some path linearizes the concurrent write 2
+    assert any(
+        s["op"]["f"] == "write" and s["op"]["value"] == 2
+        for p in paths
+        for s in p["steps"]
+    )
+
+
+def test_linear_witness_svg_renders(tmp_path):
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker import linear_svg
+
+    out = str(tmp_path / "linear.svg")
+    got = linear_svg.render_witness(
+        m.register(0), _bad_register_history(), {"valid?": False}, out,
+        pure_fs=("read",),
+    )
+    assert got == out and os.path.exists(out)
+    svg_text = open(out).read()
+    assert svg_text.startswith("<svg")
+    assert "read 7" in svg_text            # the failing op appears
+    assert "Register(1)" in svg_text       # prefix state appears
+    assert "✗" in svg_text                 # failure annotation
+
+
+def test_linear_witness_not_rendered_when_valid(tmp_path):
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker import linear_svg
+    from jepsen_tpu.history import invoke_op
+
+    good = History([
+        invoke_op(0, "write", 1, time=0),
+        ok_op(0, "write", 1, time=1),
+    ]).index_ops()
+    out = str(tmp_path / "linear.svg")
+    assert linear_svg.render_witness(
+        m.register(0), good, {"valid?": True}, out) is None
+    assert not os.path.exists(out)
+
+
+def test_linearizable_checker_writes_witness_into_store(tmp_path):
+    from jepsen_tpu import models as m
+
+    test = {"name": "wit", "start-time": "t0", "store-base": str(tmp_path)}
+    res = chk.linearizable(m.register(0), algorithm="oracle").check(
+        test, _bad_register_history()
+    )
+    assert res["valid?"] is False
+    assert "witness" in res, res
+    assert os.path.exists(res["witness"])
+    assert "ops" not in res  # renderer context stripped from the result
+    # the TPU algorithm path re-derives the witness via the oracle
+    res2 = chk.linearizable(m.register(0), algorithm="tpu").check(
+        {"name": "wit2", "start-time": "t0", "store-base": str(tmp_path)},
+        _bad_register_history(),
+    )
+    assert res2["valid?"] is False
+    assert "witness" in res2 and os.path.exists(res2["witness"])
