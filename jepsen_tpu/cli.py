@@ -80,6 +80,13 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--password", help="ssh password")
     p.add_argument("--ssh-private-key", help="path to an ssh identity file")
     p.add_argument(
+        "--ssh-transport",
+        choices=("ssh", "agent-ssh"),
+        help="use a real SSH transport: 'ssh' (key-only, ControlMaster"
+        " multiplexed) or 'agent-ssh' (sshj-style auth ladder: key,"
+        " agent, default identities, password)",
+    )
+    p.add_argument(
         "--dummy",
         action="store_true",
         help="use the no-IO dummy remote (in-process runs)",
@@ -117,6 +124,14 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
         from .control.core import DummyRemote
 
         test["remote"] = DummyRemote()
+    elif getattr(args, "ssh_transport", None) == "agent-ssh":
+        from .control.agent_ssh import AgentSSHRemote
+
+        test["remote"] = AgentSSHRemote.from_test(test)
+    elif getattr(args, "ssh_transport", None) == "ssh":
+        from .control.ssh import SSHRemote
+
+        test["remote"] = SSHRemote.from_test(test)
     return test
 
 
@@ -169,7 +184,13 @@ def single_test_cmd(
         from . import store as store_mod
 
         stored = (
-            store_mod.load(args.test_name, args.test_time)
+            store_mod.load(
+                {
+                    "name": args.test_name,
+                    "start-time": args.test_time,
+                    "store-base": args.store_base,
+                }
+            )
             if args.test_name
             else store_mod.latest(args.store_base)
         )

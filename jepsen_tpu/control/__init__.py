@@ -113,6 +113,29 @@ def sudo(user: str = "root", password: Optional[str] = None):
 
 su = sudo  # reference aliases su to sudo-as-root
 
+#: process-wide default for command tracing, the `*trace*` dynamic var
+#: (reference: control.clj:43); the `trace` context manager overrides it
+#: per thread.
+TRACE = False
+
+
+@contextmanager
+def trace(enabled: bool = True):
+    """Log every command (with its node) before it runs in the body.
+    (reference: control.clj:43 *trace* + :115-119 wrap-trace)"""
+    # restore by deletion when previously unset: leaving `None` behind
+    # would shadow the module-level TRACE default on this thread
+    had = hasattr(_local, "trace")
+    prev = _dyn("trace")
+    _local.trace = enabled
+    try:
+        yield
+    finally:
+        if had:
+            _local.trace = prev
+        else:
+            del _local.trace
+
 
 @contextmanager
 def cd(dir: str):
@@ -138,6 +161,12 @@ def execute(*args, stdin: Optional[str] = None, check: bool = True):
             "use with_session/on_nodes"
         )
     cmd = " ".join(escape(a) for a in args)
+    if _dyn("trace", TRACE):
+        import logging
+
+        logging.getLogger(__name__).info(
+            "Host: %s cmd: %s", current_node(), cmd
+        )
     command = Command(
         cmd=cmd,
         stdin=stdin,
@@ -178,19 +207,28 @@ def _binding_snapshot() -> dict:
         "sudo": _dyn("sudo"),
         "dir": _dyn("dir"),
         "sudo_password": _dyn("sudo_password"),
+        "trace": _dyn("trace", TRACE),
     }
 
 
 @contextmanager
 def _with_bindings(snapshot: dict):
+    # restore-by-deletion for keys that weren't set: leaving e.g.
+    # trace=None behind would shadow its module-level default (the
+    # worker may be the calling thread itself when the pool runs a task
+    # inline)
+    had = {k: hasattr(_local, k) for k in snapshot}
     prev = {k: _dyn(k) for k in snapshot}
     for k, v in snapshot.items():
         setattr(_local, k, v)
     try:
         yield
     finally:
-        for k, v in prev.items():
-            setattr(_local, k, v)
+        for k in snapshot:
+            if had[k]:
+                setattr(_local, k, prev[k])
+            else:
+                delattr(_local, k)
 
 
 def on_nodes(test: dict, fn_or_nodes, maybe_fn=None) -> Dict[Any, Any]:

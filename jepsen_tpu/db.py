@@ -11,8 +11,11 @@
 from __future__ import annotations
 
 import logging
+import socket
+import time
 from typing import Any, Dict, Iterable, List, Optional
 
+from . import control
 from .util import real_pmap
 
 log = logging.getLogger("jepsen_tpu.db")
@@ -100,3 +103,99 @@ def cycle(test: dict, retries: int = SETUP_RETRIES) -> None:
                 raise
             log.exception("DB setup failed; retrying (%d/%d)", attempt, retries)
             continue
+
+
+def control_ip() -> str:
+    """This (control) host's primary outbound IPv4 address.
+    (reference: jepsen/src/jepsen/control/net.clj control-ip)"""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets sent; just picks a route
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+class TcpdumpDB(DB, LogFiles):
+    """A DB that runs a tcpdump capture on every node from setup to
+    teardown and yields the capture + daemon log as log files.  Compose
+    it alongside the real DB to record a test's network traffic.
+
+    Options (reference: db.clj:49-115 tcpdump):
+
+    - ``ports``: capture only traffic on these ports
+    - ``clients-only?``: capture only traffic to/from the control node
+    - ``filter``: an extra pcap filter string, ANDed in
+    """
+
+    DIR = "/tmp/jepsen/tcpdump"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = dict(opts or {})
+        self.logfile = f"{self.DIR}/log"
+        self.capfile = f"{self.DIR}/tcpdump"
+        self.pidfile = f"{self.DIR}/pid"
+
+    def _filter_str(self) -> str:
+        parts = []
+        ports = self.opts.get("ports") or ()
+        if ports:
+            # parenthesized: pcap 'and' binds tighter than 'or', so the
+            # bare join would attach later filters to the last port only
+            disj = " or ".join(f"port {p}" for p in ports)
+            parts.append(f"({disj})" if len(ports) > 1 else disj)
+        if self.opts.get("clients-only?"):
+            # the control node's IP as the DB node sees it (reference:
+            # control/net.clj control-ip — the address of the machine
+            # running the harness)
+            parts.append(f"host {control_ip()}")
+        if self.opts.get("filter"):
+            parts.append(self.opts["filter"])
+        return " and ".join(parts)
+
+    def setup(self, test: dict, node: Any) -> None:
+        from .control import util as cu
+
+        with control.su():
+            control.execute("mkdir", "-p", self.DIR)
+            cu.start_daemon(
+                {"logfile": self.logfile, "pidfile": self.pidfile,
+                 "chdir": self.DIR},
+                "/usr/sbin/tcpdump",
+                "-w", self.capfile,
+                "-s", "65535",
+                "-B", "16384",
+                # unbuffered: killing tcpdump mid-buffer loses the most
+                # interesting packets (the ones right before the failure)
+                "-U",
+                self._filter_str(),
+            )
+
+    def teardown(self, test: dict, node: Any) -> None:
+        from .control import util as cu
+
+        with control.su():
+            pid = control.execute("cat", self.pidfile, check=False)
+            if pid:
+                # SIGINT first so tcpdump flushes its capture cleanly
+                control.execute("kill", "-s", "INT", pid, check=False)
+                for _ in range(100):
+                    # `ps -o pid= -p` prints nothing (no header) for a
+                    # dead pid, unlike bare `ps -p`
+                    if not control.execute(
+                        "ps", "-o", "pid=", "-p", pid, check=False
+                    ):
+                        break
+                    time.sleep(0.05)
+            cu.stop_daemon(pidfile=self.pidfile, cmd="tcpdump")
+            control.execute("rm", "-rf", self.DIR)
+
+    def log_files(self, test: dict, node: Any) -> Iterable[str]:
+        return [self.logfile, self.capfile]
+
+
+def tcpdump(opts: Optional[dict] = None) -> TcpdumpDB:
+    """(reference: db.clj:49-115)"""
+    return TcpdumpDB(opts)

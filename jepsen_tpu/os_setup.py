@@ -8,7 +8,7 @@ the pre-DB groundwork each node needs.
 from __future__ import annotations
 
 import logging
-from typing import Any, Iterable, List
+from typing import Any, Iterable, List, Optional
 
 from . import control
 from .control.core import RemoteError, lit
@@ -147,3 +147,123 @@ class Ubuntu(Debian):
 
 
 ubuntu = Ubuntu()
+
+
+class SmartOS(OS):
+    """SmartOS (illumos) boxes: pkgin package management, svcadm-managed
+    ipfilter, and a /etc/hosts loopback entry for the local hostname.
+    (reference: os/smartos.clj)"""
+
+    base_packages = [
+        "wget",
+        "curl",
+        "vim",
+        "unzip",
+        "rsyslog",
+        "logrotate",
+    ]
+
+    #: re-run `pkgin update` when the package DB is older than a day
+    update_interval_s = 86_400
+
+    def _setup_hostfile(self) -> None:
+        """Append the local hostname to the 127.0.0.1 line if missing.
+        (reference: smartos.clj:12-25 setup-hostfile!)"""
+        name = control.execute("hostname")
+        hosts = control.execute("cat", "/etc/hosts")
+        out = []
+        for line in hosts.splitlines():
+            if line.startswith("127.0.0.1\t") and name not in line:
+                line = f"{line} {name}"
+            out.append(line)
+        with control.su():
+            from .control.util import write_file
+
+            write_file("\n".join(out) + "\n", "/etc/hosts")
+
+    def _maybe_update(self) -> None:
+        """pkgin update unless done recently.
+        (reference: smartos.clj:27-43)"""
+        try:
+            now = int(control.execute("date", "+%s"))
+            last = int(
+                control.execute("stat", "-c", "%Y", "/var/db/pkgin/sql.log")
+            )
+            stale = self.update_interval_s < now - last
+        except Exception:
+            stale = True
+        if stale:
+            with control.su():
+                control.execute("pkgin", "update")
+
+    def installed(self, packages: Iterable[str]) -> set:
+        """Subset of ``packages`` already installed, by pkgin list.
+        (reference: smartos.clj:45-56 installed)"""
+        want = {str(p) for p in packages}
+        got = set()
+        for line in control.execute("pkgin", "-p", "list").splitlines():
+            pkg = line.split(";", 1)[0]
+            # strip the trailing -<version> suffix
+            name = pkg.rsplit("-", 1)[0] if "-" in pkg else pkg
+            if name in want:
+                got.add(name)
+        return got
+
+    def _versions(self) -> dict:
+        """{package: installed version} from one pkgin list fetch."""
+        out = {}
+        for line in control.execute("pkgin", "-p", "list").splitlines():
+            pkg = line.split(";", 1)[0]
+            if "-" not in pkg:
+                continue
+            name, version = pkg.rsplit("-", 1)
+            out[name] = version
+        return out
+
+    def installed_version(self, package: str) -> Optional[str]:
+        """(reference: smartos.clj:72-84)"""
+        return self._versions().get(str(package))
+
+    def install(self, packages) -> None:
+        """Install a collection of packages, or a {package: version}
+        map.  (reference: smartos.clj:86-107)"""
+        if isinstance(packages, dict):
+            # one pkgin list fetch for all the version comparisons, not
+            # one remote round-trip per package
+            versions = self._versions()
+            todo = [
+                f"{pkg}-{version}"
+                for pkg, version in packages.items()
+                if versions.get(str(pkg)) != version
+            ]
+            if todo:
+                with control.su():
+                    control.execute("pkgin", "-y", "install", *todo)
+            return
+        missing = {str(p) for p in packages} - self.installed(packages)
+        if missing:
+            with control.su():
+                control.execute("pkgin", "-y", "install", *sorted(missing))
+
+    def uninstall(self, packages) -> None:
+        """(reference: smartos.clj:58-63)"""
+        pkgs = packages if isinstance(packages, (list, tuple, set)) else [packages]
+        present = self.installed(pkgs)
+        if present:
+            with control.su():
+                control.execute("pkgin", "-y", "remove", *sorted(present))
+
+    def setup(self, test, node):
+        self._setup_hostfile()
+        self._maybe_update()
+        self.install(self.base_packages)
+        with control.su():
+            control.execute("svcadm", "enable", "-r", "ipfilter")
+        if test.get("net") is not None:
+            meh(lambda: test["net"].heal(test))
+
+    def teardown(self, test, node):
+        pass
+
+
+smartos = SmartOS()

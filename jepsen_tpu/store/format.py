@@ -49,9 +49,17 @@ INDEX = 1
 JSON_BLOCK = 2
 PARTIAL_MAP = 3
 HISTORY = 4
+HISTORY_CHUNK = 5
+CHUNKED_HISTORY = 6
 
 BLOCK_TYPES = {INDEX: "index", JSON_BLOCK: "json", PARTIAL_MAP: "partial-map",
-               HISTORY: "history"}
+               HISTORY: "history", HISTORY_CHUNK: "history-chunk",
+               CHUNKED_HISTORY: "chunked-history"}
+
+#: ops per chunk when a history is large enough to split — the lazy-load
+#: granularity (reference: store/format.clj's chunked BigVector history,
+#: whose incremental loading is what makes multi-GB histories workable)
+HISTORY_CHUNK_SIZE = 8192
 
 
 def _frame(type_: int, data: bytes) -> bytes:
@@ -141,14 +149,36 @@ class Writer:
         data = struct.pack("<I", rest_id) + _dumps(head)
         return self.write_block(PARTIAL_MAP, data)
 
-    def write_history(self, history, jsonl: Optional[bytes] = None) -> int:
+    def write_history(
+        self,
+        history,
+        jsonl: Optional[bytes] = None,
+        chunk_size: int = HISTORY_CHUNK_SIZE,
+    ) -> int:
         """History block: JSONL + the packed device encoding.  Callers
         that already serialized the history (store.save_1 shares one
-        pass with history.jsonl) pass the bytes in."""
+        pass with history.jsonl) pass the bytes in.
+
+        Histories longer than ``chunk_size`` ops split into
+        HISTORY_CHUNK blocks under one CHUNKED_HISTORY root, so readers
+        can load (and iterate) them incrementally instead of decoding
+        the whole run at once."""
         if jsonl is None:
             jsonl = "\n".join(
                 json.dumps(op.to_dict(), default=repr) for op in history
             ).encode()
+        if len(history) > chunk_size > 0:
+            lines = jsonl.splitlines()
+            chunks = []
+            for i in range(0, len(lines), chunk_size):
+                part = lines[i : i + chunk_size]
+                cid = self.write_block(HISTORY_CHUNK, b"\n".join(part))
+                chunks.append((cid, len(part)))
+            packed = _pack_history(history)
+            head = struct.pack("<I", len(chunks)) + b"".join(
+                struct.pack("<II", cid, n) for cid, n in chunks
+            )
+            return self.write_block(CHUNKED_HISTORY, head + packed)
         packed = _pack_history(history)
         data = struct.pack("<I", len(jsonl)) + jsonl + packed
         return self.write_block(HISTORY, data)
@@ -283,30 +313,76 @@ class Reader:
                 rest = self.read_value(rest_id)
                 return {**rest, **head}
             return head
-        if type_ == HISTORY:
+        if type_ in (HISTORY, CHUNKED_HISTORY):
             return self.read_history(block_id)
         raise IOError(f"cannot decode block type {type_}")
+
+    def _chunk_table(self, data: bytes):
+        """Parse a CHUNKED_HISTORY head: [(chunk-id, op-count)…], and
+        the offset where the packed section starts."""
+        (n,) = struct.unpack("<I", data[:4])
+        chunks = [
+            struct.unpack("<II", data[4 + 8 * i : 12 + 8 * i])
+            for i in range(n)
+        ]
+        return chunks, 4 + 8 * n
+
+    def history_len(self, block_id: int) -> int:
+        """Op count without decoding any chunk."""
+        type_, data = self.read_id(block_id)
+        if type_ == CHUNKED_HISTORY:
+            chunks, _ = self._chunk_table(data)
+            return sum(n for _cid, n in chunks)
+        if type_ == HISTORY:
+            (jsonl_len,) = struct.unpack("<I", data[:4])
+            return data[4 : 4 + jsonl_len].count(b"\n") + (
+                1 if jsonl_len else 0
+            )
+        raise IOError(f"block {block_id} is {type_}, not history")
+
+    def iter_history(self, block_id: int):
+        """Yield Ops lazily, one chunk in memory at a time — the
+        incremental path for multi-GB histories (reference:
+        store/format.clj's chunked history loading)."""
+        from ..history import Op
+
+        type_, data = self.read_id(block_id)
+        if type_ == CHUNKED_HISTORY:
+            chunks, _ = self._chunk_table(data)
+            del data
+            for cid, _n in chunks:
+                ctype, cdata = self.read_id(cid)
+                if ctype != HISTORY_CHUNK:
+                    raise IOError(f"chunk {cid} has type {ctype}")
+                for line in cdata.decode().splitlines():
+                    if line:
+                        yield Op.from_dict(json.loads(line))
+        elif type_ == HISTORY:
+            (jsonl_len,) = struct.unpack("<I", data[:4])
+            for line in data[4 : 4 + jsonl_len].decode().splitlines():
+                if line:
+                    yield Op.from_dict(json.loads(line))
+        else:
+            raise IOError(f"block {block_id} is {type_}, not history")
 
     def read_history(self, block_id: int):
         from ..history import History
 
-        type_, data = self.read_id(block_id)
-        if type_ != HISTORY:
-            raise IOError(f"block {block_id} is {type_}, not history")
-        (jsonl_len,) = struct.unpack("<I", data[:4])
-        jsonl = data[4 : 4 + jsonl_len].decode()
-        dicts = [json.loads(line) for line in jsonl.splitlines() if line]
-        return History.from_dicts(dicts)
+        return History(self.iter_history(block_id))
 
     def read_packed_history(self, block_id: int) -> dict:
         """The device-feed arrays without touching the JSONL section."""
         import numpy as np
 
         type_, data = self.read_id(block_id)
-        if type_ != HISTORY:
+        if type_ == CHUNKED_HISTORY:
+            _chunks, off = self._chunk_table(data)
+            rest = data[off:]
+        elif type_ == HISTORY:
+            (jsonl_len,) = struct.unpack("<I", data[:4])
+            rest = data[4 + jsonl_len :]
+        else:
             raise IOError(f"block {block_id} is {type_}, not history")
-        (jsonl_len,) = struct.unpack("<I", data[:4])
-        rest = data[4 + jsonl_len :]
         npz_len, tables_len = struct.unpack("<II", rest[:8])
         npz = np.load(io.BytesIO(rest[8 : 8 + npz_len]))
         tables = json.loads(rest[8 + npz_len : 8 + npz_len + tables_len])

@@ -1,0 +1,315 @@
+"""Small parity items (reference gap-closing): tcpdump DB, control
+command tracing, SmartOS, the agent-ssh auth-ladder transport, and
+chunked lazy history storage."""
+
+import logging
+import os
+import struct
+
+import pytest
+
+from jepsen_tpu import control, db as db_mod
+from jepsen_tpu.control.core import DummyRemote
+from jepsen_tpu.history import History, invoke_op, ok_op
+
+
+# -- control tracing (reference: control.clj:43, 115-119) -------------------
+
+
+def test_trace_logs_commands(caplog):
+    test = {"nodes": ["n1"], "ssh": {"dummy?": True}}
+    with control.dummy_session(test):
+        def body():
+            with caplog.at_level(logging.INFO, logger="jepsen_tpu.control"):
+                with control.trace():
+                    control.execute("echo", "hello")
+                caplog_traced = [
+                    r for r in caplog.records if "cmd:" in r.getMessage()
+                ]
+                assert caplog_traced, "trace() must log the command"
+                assert "echo hello" in caplog_traced[0].getMessage()
+                caplog.clear()
+                control.execute("echo", "quiet")
+                assert not [
+                    r for r in caplog.records if "cmd:" in r.getMessage()
+                ], "no tracing outside the context"
+        control.with_node("n1", body)
+
+
+# -- tcpdump DB (reference: db.clj:49-115) ----------------------------------
+
+
+def test_tcpdump_filter_and_logfiles():
+    t = db_mod.tcpdump({"ports": [2379, 2380], "filter": "tcp"})
+    fs = t._filter_str()
+    assert fs == "(port 2379 or port 2380) and tcp"
+    assert list(t.log_files({}, "n1")) == [
+        "/tmp/jepsen/tcpdump/log",
+        "/tmp/jepsen/tcpdump/tcpdump",
+    ]
+    assert db_mod.tcpdump({"ports": [9042]})._filter_str() == "port 9042"
+    only = db_mod.tcpdump({"clients-only?": True})._filter_str()
+    assert only.startswith("host ")
+
+
+def test_tcpdump_setup_teardown_on_dummy():
+    # commands flow through the control DSL without error on the dummy
+    test = {"nodes": ["n1"], "ssh": {"dummy?": True}}
+    t = db_mod.tcpdump({"ports": [1234]})
+    with control.dummy_session(test):
+        control.with_node("n1", lambda: t.setup(test, "n1"))
+        control.with_node("n1", lambda: t.teardown(test, "n1"))
+
+
+# -- SmartOS (reference: os/smartos.clj) ------------------------------------
+
+
+class _ScriptedRemote(DummyRemote):
+    """Dummy remote that answers specific commands from a script."""
+
+    def __init__(self, responses):
+        super().__init__()
+        self.responses = responses
+        self.commands = []
+
+    def connect(self, node, test=None):
+        r = _ScriptedRemote(self.responses)
+        r.commands = self.commands
+        r.node = node
+        return r
+
+    def execute(self, command):
+        from jepsen_tpu.control.core import Result
+
+        self.commands.append(command.cmd)
+        for prefix, out in self.responses.items():
+            if command.cmd.startswith(prefix):
+                return Result(cmd=command.cmd, exit=0, out=out, err="",
+                              node=self.node)
+        return Result(cmd=command.cmd, exit=0, out="", err="", node=self.node)
+
+
+def test_smartos_package_parsing():
+    from jepsen_tpu.os_setup import SmartOS
+
+    remote = _ScriptedRemote({
+        "pkgin -p list": "curl-8.1.2;x\nwget-1.21nb1;y\nvim-9.0.1;z",
+    })
+    test = {"nodes": ["n1"]}
+    with control.with_session(test, remote):
+        def body():
+            os_ = SmartOS()
+            got = os_.installed(["curl", "wget", "rsyslog"])
+            assert got == {"curl", "wget"}
+            assert os_.installed_version("curl") == "8.1.2"
+            assert os_.installed_version("wget") == "1.21nb1"
+            assert os_.installed_version("nope") is None
+            os_.install(["curl", "rsyslog"])  # only rsyslog is missing
+            installs = [c for c in remote.commands if "pkgin -y install" in c]
+            assert installs and "rsyslog" in installs[-1]
+            assert "curl" not in installs[-1]
+        control.with_node("n1", body)
+
+
+def test_smartos_setup_runs_on_dummy():
+    from jepsen_tpu.os_setup import smartos
+
+    remote = _ScriptedRemote({
+        "hostname": "smarty",
+        "cat /etc/hosts": "127.0.0.1\tlocalhost",
+        "date +%s": "1000000",
+        "stat -c %Y": "999999",
+        "pkgin -p list": "",
+    })
+    test = {"nodes": ["n1"]}
+    with control.with_session(test, remote):
+        control.with_node("n1", lambda: smartos.setup(test, "n1"))
+    joined = "\n".join(remote.commands)
+    assert "svcadm enable -r ipfilter" in joined
+    assert "pkgin -y install" in joined
+
+
+# -- agent-ssh transport (reference: control/sshj.clj:43-70) ----------------
+
+
+def test_agent_ssh_auth_ladder_order(tmp_path, monkeypatch):
+    from jepsen_tpu.control.agent_ssh import AgentSSHRemote
+
+    monkeypatch.setenv("SSH_AUTH_SOCK", "/tmp/fake-agent.sock")
+    r = AgentSSHRemote(
+        username="u", password="pw", private_key_path="/k/id", port=2222
+    )
+    r.node = "n1"
+    r._tmpdir = str(tmp_path)
+    rungs = r.auth_rungs()
+    # key first, then agent, then default identities, then password
+    assert len(rungs) == 4
+    assert "/k/id" in rungs[0][0] and "IdentitiesOnly=yes" in rungs[0][0]
+    assert any("IdentityAgent=" in a for a in rungs[1][0])
+    assert rungs[2][0] == ["-o", "BatchMode=yes"]
+    args, env = rungs[3]
+    assert "SSH_ASKPASS" in env and env["SSH_ASKPASS_REQUIRE"] == "force"
+    script = open(env["SSH_ASKPASS"]).read()
+    assert "pw" in script
+    assert os.stat(env["SSH_ASKPASS"]).st_mode & 0o077 == 0  # private
+
+    # without agent/key/password: only the default-identities rung
+    monkeypatch.delenv("SSH_AUTH_SOCK", raising=False)
+    r2 = AgentSSHRemote(username="u")
+    r2._tmpdir = str(tmp_path)
+    assert len(r2.auth_rungs()) == 1
+
+
+def test_agent_ssh_remembers_first_working_rung(monkeypatch):
+    from jepsen_tpu.control.agent_ssh import AgentSSHRemote
+
+    r = AgentSSHRemote(username="u", private_key_path="/k/id")
+    r.node = "n1"
+    r._tmpdir = "/tmp"
+    calls = []
+
+    class FakeProc:
+        def __init__(self, rc):
+            self.returncode = rc
+            self.stdout = b""
+            self.stderr = b"denied"
+
+    def fake_run(args, env, cmd, stdin):
+        calls.append((tuple(args), cmd))
+        # first rung (pinned key) fails; second (default ids) works
+        return FakeProc(255 if "IdentitiesOnly=yes" in args else 0)
+
+    monkeypatch.setattr(r, "_run_ssh", fake_run)
+    args, env = r._authed()
+    assert "IdentitiesOnly=yes" not in args
+    n = len(calls)
+    # subsequent auth lookups don't re-probe
+    assert r._authed() == (args, env)
+    assert len(calls) == n
+
+
+def test_cli_ssh_transport_flag():
+    import argparse
+
+    from jepsen_tpu import cli
+    from jepsen_tpu.control.agent_ssh import AgentSSHRemote
+    from jepsen_tpu.control.core import DummyRemote as DR
+    from jepsen_tpu.control.ssh import SSHRemote
+
+    def build(argv):
+        p = argparse.ArgumentParser()
+        cli.add_test_opts(p)
+        return cli.test_opts_to_map(p.parse_args(argv))
+
+    t = build(["--nodes", "n1", "--ssh-transport", "agent-ssh",
+               "--password", "pw"])
+    assert isinstance(t["remote"], AgentSSHRemote)
+    assert t["remote"].password == "pw"
+    t2 = build(["--nodes", "n1", "--ssh-transport", "ssh"])
+    assert isinstance(t2["remote"], SSHRemote)
+    t3 = build(["--nodes", "n1", "--dummy"])
+    assert isinstance(t3["remote"], DR)
+
+
+# -- chunked lazy history (reference: store/format.clj chunked loading) -----
+
+
+def _mk_history(n):
+    ops = []
+    for i in range(n):
+        ops.append(invoke_op(i % 5, "write", i, time=2 * i))
+        ops.append(ok_op(i % 5, "write", i, time=2 * i + 1))
+    return History(ops).index_ops()
+
+
+def test_chunked_history_roundtrip(tmp_path):
+    from jepsen_tpu.store import format as fmt
+
+    h = _mk_history(300)  # 600 ops > chunk_size=128
+    p = str(tmp_path / "t.jtpu")
+    with fmt.Writer(p) as w:
+        hid = w.write_history(h, chunk_size=128)
+        w.set_root(w.write_json({"history": fmt.block_ref(hid)}))
+        w.save_index()
+    r = fmt.Reader(p)
+    # the root block id resolved the chunked history transparently
+    assert r.read_id(hid)[0] == fmt.CHUNKED_HISTORY
+    got = r.read_history(hid)
+    assert len(got) == len(h)
+    assert [op.value for op in got] == [op.value for op in h]
+    assert got[0].type == "invoke" and got[1].type == "ok"
+    # lazy iteration yields the same ops without a full materialize
+    it = r.iter_history(hid)
+    first = next(it)
+    assert first.value == 0
+    assert r.history_len(hid) == len(h)
+    # packed device arrays survive chunking
+    packed = r.read_packed_history(hid)
+    assert packed["arrays"]["process"].shape[0] == len(h)
+
+
+def test_small_history_stays_single_block(tmp_path):
+    from jepsen_tpu.store import format as fmt
+
+    h = _mk_history(10)
+    p = str(tmp_path / "s.jtpu")
+    with fmt.Writer(p) as w:
+        hid = w.write_history(h)
+        w.set_root(hid)
+        w.save_index()
+    r = fmt.Reader(p)
+    assert r.read_id(hid)[0] == fmt.HISTORY
+    assert len(r.read_history(hid)) == 20
+    assert r.history_len(hid) == 20
+
+
+def test_store_save_roundtrips_large_history(tmp_path):
+    """The full store save path writes chunked histories that load()
+    transparently reassembles."""
+    from jepsen_tpu import store as store_mod
+    from jepsen_tpu.store import format as fmt
+
+    h = _mk_history(fmt.HISTORY_CHUNK_SIZE)  # 2× chunk size in ops
+    test = {
+        "name": "chunky",
+        "start-time": "t0",
+        "store-base": str(tmp_path),
+        "nodes": [],
+        "history": h,
+    }
+    with store_mod.with_writer(test) as test_w:
+        test_w = store_mod.save_1(test_w)
+    loaded = store_mod.load(test)
+    assert len(loaded["history"]) == len(h)
+    assert loaded["history"][0].value == h[0].value
+
+
+def test_trace_restore_preserves_module_default(caplog):
+    # exiting trace() must not shadow control.TRACE with a stale None
+    test = {"nodes": ["n1"], "ssh": {"dummy?": True}}
+    with control.dummy_session(test):
+        def body():
+            with control.trace(False):
+                pass
+            control.TRACE = True
+            try:
+                with caplog.at_level(
+                    logging.INFO, logger="jepsen_tpu.control"
+                ):
+                    control.execute("echo", "default-on")
+                assert any(
+                    "cmd:" in r.getMessage() for r in caplog.records
+                )
+            finally:
+                control.TRACE = False
+        control.with_node("n1", body)
+
+
+def test_trace_conveys_to_on_nodes_workers(caplog):
+    test = {"nodes": ["n1", "n2"], "ssh": {"dummy?": True}}
+    with control.dummy_session(test):
+        with caplog.at_level(logging.INFO, logger="jepsen_tpu.control"):
+            with control.trace():
+                control.on_nodes(test, lambda t, n: control.execute("true"))
+    traced = [r for r in caplog.records if "cmd:" in r.getMessage()]
+    assert len(traced) == 2  # one per worker thread
