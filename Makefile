@@ -1,0 +1,22 @@
+# Convenience targets.  The docker-* targets require docker + compose on
+# the host (not available in the build image — run them on a docker-
+# capable machine).
+
+.PHONY: test bench docker-smoke docker-up docker-down
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+# BASELINE config 2: etcd register + partition nemesis over real SSH in
+# the dockerized 5-node cluster; artifacts land in docker/smoke-store/.
+docker-smoke:
+	docker/bin/smoke
+
+docker-up:
+	docker/bin/up
+
+docker-down:
+	cd docker && docker compose down -v

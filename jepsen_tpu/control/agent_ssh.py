@@ -26,6 +26,7 @@ import tempfile
 from typing import List, Optional, Tuple
 
 from .core import Command, Remote, Result, effective_stdin, wrap_sudo
+from .ssh import _as_paths, run_scp
 
 
 class AgentSSHRemote(Remote):
@@ -188,46 +189,22 @@ class AgentSSHRemote(Remote):
             node=self.node,
         )
 
-    def _scp(self, sources: list, dest: str) -> None:
-        args, env = self._authed()
-        scp_args = self._common_args() + args
-        try:
-            i = scp_args.index("-p")
-            scp_args[i] = "-P"
-        except ValueError:
-            pass
-        proc = subprocess.run(
-            ["scp", "-r"] + scp_args + sources + [dest],
-            capture_output=True,
-            timeout=600,
-            env={**os.environ, **env},
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"scp to {dest} failed: "
-                f"{proc.stderr.decode(errors='replace')}"
-            )
-
     def upload(self, local_paths, remote_path):
-        paths = (
-            [local_paths]
-            if isinstance(local_paths, (str, os.PathLike))
-            else list(local_paths)
-        )
-        self._scp(
-            [str(p) for p in paths],
+        args, env = self._authed()
+        run_scp(
+            self._common_args() + args,
+            _as_paths(local_paths),
             f"{self.username}@{self.node}:{remote_path}",
+            env={**os.environ, **env},
         )
 
     def download(self, remote_paths, local_path):
-        paths = (
-            [remote_paths]
-            if isinstance(remote_paths, (str, os.PathLike))
-            else list(remote_paths)
-        )
-        self._scp(
-            [f"{self.username}@{self.node}:{p}" for p in paths],
+        args, env = self._authed()
+        run_scp(
+            self._common_args() + args,
+            [f"{self.username}@{self.node}:{p}" for p in _as_paths(remote_paths)],
             str(local_path),
+            env={**os.environ, **env},
         )
 
 

@@ -16,6 +16,35 @@ from typing import Any, Optional, Sequence, Union
 from .core import Command, Remote, Result, effective_stdin, wrap_sudo
 
 
+def _as_paths(paths) -> list:
+    """Normalize one-or-many path arguments to a list of strings."""
+    if isinstance(paths, (str, os.PathLike)):
+        return [str(paths)]
+    return [str(p) for p in paths]
+
+
+def run_scp(ssh_args: list, sources: list, dest: str, env=None) -> None:
+    """Run one scp transfer with ssh-style args (the ``-p`` port flag is
+    rewritten to scp's ``-P``); raises RuntimeError on failure.  Shared
+    by both SSH transports so fixes land in one place."""
+    args = list(ssh_args)
+    try:
+        i = args.index("-p")
+        args[i] = "-P"
+    except ValueError:
+        pass
+    proc = subprocess.run(
+        ["scp", "-r"] + args + list(sources) + [dest],
+        capture_output=True,
+        timeout=600,
+        env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"scp to {dest} failed: {proc.stderr.decode(errors='replace')}"
+        )
+
+
 class SSHRemote(Remote):
     """One connected SSH session per node, multiplexed over a
     ControlMaster socket so repeated execs don't re-handshake."""
@@ -127,49 +156,19 @@ class SSHRemote(Remote):
             node=self.node,
         )
 
-    def _scp_args(self) -> list:
-        # scp uses -P for port
-        args = self._base_args()
-        try:
-            i = args.index("-p")
-            args[i] = "-P"
-        except ValueError:
-            pass
-        return args
-
     def upload(self, local_paths, remote_path):
-        paths = (
-            [local_paths] if isinstance(local_paths, (str, os.PathLike)) else list(local_paths)
+        run_scp(
+            self._base_args(),
+            _as_paths(local_paths),
+            f"{self.username}@{self.node}:{remote_path}",
         )
-        proc = subprocess.run(
-            ["scp", "-r"]
-            + self._scp_args()
-            + [str(p) for p in paths]
-            + [f"{self.username}@{self.node}:{remote_path}"],
-            capture_output=True,
-            timeout=600,
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"scp upload to {self.node} failed: {proc.stderr.decode(errors='replace')}"
-            )
 
     def download(self, remote_paths, local_path):
-        paths = (
-            [remote_paths] if isinstance(remote_paths, (str, os.PathLike)) else list(remote_paths)
+        run_scp(
+            self._base_args(),
+            [f"{self.username}@{self.node}:{p}" for p in _as_paths(remote_paths)],
+            str(local_path),
         )
-        proc = subprocess.run(
-            ["scp", "-r"]
-            + self._scp_args()
-            + [f"{self.username}@{self.node}:{p}" for p in paths]
-            + [str(local_path)],
-            capture_output=True,
-            timeout=600,
-        )
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"scp download from {self.node} failed: {proc.stderr.decode(errors='replace')}"
-            )
 
 
 def ssh(test: Optional[dict] = None) -> SSHRemote:

@@ -140,8 +140,11 @@ def snarf_logs(test: dict) -> None:
             transfer_errors = (
                 FileNotFoundError,
                 RemoteError,
-                # docker/k8s remotes raise CalledProcessError when cp fails
+                # docker/k8s remotes raise CalledProcessError when cp
+                # fails; the ssh transports wrap scp failures in
+                # RuntimeError
                 subprocess.CalledProcessError,
+                RuntimeError,
             )
             for remote, short in zip(full_paths, shorts):
                 dest = store_mod.path_(

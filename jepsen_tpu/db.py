@@ -105,12 +105,21 @@ def cycle(test: dict, retries: int = SETUP_RETRIES) -> None:
             continue
 
 
-def control_ip() -> str:
-    """This (control) host's primary outbound IPv4 address.
+def control_ip(via: Any = None) -> str:
+    """This (control) host's outbound IPv4 address — as seen on the
+    route toward ``via`` (a DB node) when given, else the default route.
+    Routing toward the node matters on multi-homed control hosts: the
+    internet-facing address would match none of the client traffic.
     (reference: jepsen/src/jepsen/control/net.clj control-ip)"""
+    target = "8.8.8.8"
+    if via is not None:
+        try:
+            target = socket.gethostbyname(str(via))
+        except OSError:
+            pass
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
-        s.connect(("8.8.8.8", 80))  # no packets sent; just picks a route
+        s.connect((target, 80))  # no packets sent; just picks a route
         return s.getsockname()[0]
     except OSError:
         return "127.0.0.1"
@@ -138,7 +147,7 @@ class TcpdumpDB(DB, LogFiles):
         self.capfile = f"{self.DIR}/tcpdump"
         self.pidfile = f"{self.DIR}/pid"
 
-    def _filter_str(self) -> str:
+    def _filter_str(self, node: Any = None) -> str:
         parts = []
         ports = self.opts.get("ports") or ()
         if ports:
@@ -150,7 +159,7 @@ class TcpdumpDB(DB, LogFiles):
             # the control node's IP as the DB node sees it (reference:
             # control/net.clj control-ip — the address of the machine
             # running the harness)
-            parts.append(f"host {control_ip()}")
+            parts.append(f"host {control_ip(via=node)}")
         if self.opts.get("filter"):
             parts.append(self.opts["filter"])
         return " and ".join(parts)
@@ -170,7 +179,7 @@ class TcpdumpDB(DB, LogFiles):
                 # unbuffered: killing tcpdump mid-buffer loses the most
                 # interesting packets (the ones right before the failure)
                 "-U",
-                self._filter_str(),
+                self._filter_str(node),
             )
 
     def teardown(self, test: dict, node: Any) -> None:

@@ -169,11 +169,15 @@ class SmartOS(OS):
     def _setup_hostfile(self) -> None:
         """Append the local hostname to the 127.0.0.1 line if missing.
         (reference: smartos.clj:12-25 setup-hostfile!)"""
+        import re as _re
+
         name = control.execute("hostname")
         hosts = control.execute("cat", "/etc/hosts")
         out = []
         for line in hosts.splitlines():
-            if line.startswith("127.0.0.1\t") and name not in line:
+            # whole-token comparison: a hostname that happens to be a
+            # substring of an alias must still be appended
+            if _re.match(r"^127\.0\.0\.1\s", line) and name not in line.split():
                 line = f"{line} {name}"
             out.append(line)
         with control.su():
@@ -199,15 +203,7 @@ class SmartOS(OS):
     def installed(self, packages: Iterable[str]) -> set:
         """Subset of ``packages`` already installed, by pkgin list.
         (reference: smartos.clj:45-56 installed)"""
-        want = {str(p) for p in packages}
-        got = set()
-        for line in control.execute("pkgin", "-p", "list").splitlines():
-            pkg = line.split(";", 1)[0]
-            # strip the trailing -<version> suffix
-            name = pkg.rsplit("-", 1)[0] if "-" in pkg else pkg
-            if name in want:
-                got.add(name)
-        return got
+        return {str(p) for p in packages} & set(self._versions())
 
     def _versions(self) -> dict:
         """{package: installed version} from one pkgin list fetch."""

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""A tiny persistent linearizable register server — the integration-test
+DB daemon (tests/test_local_cluster.py runs it under start-stop-daemon
+through the LocalRemote transport).
+
+Line protocol on a TCP port:  ``R`` → value | ``W <v>`` → ``OK`` |
+``CAS <old> <new>`` → ``OK``/``MISS``.  Every mutation fsyncs to a state
+file before acking, so a SIGKILL never loses an acknowledged write —
+which is exactly what keeps kill-fault histories linearizable.
+"""
+
+import os
+import socketserver
+import sys
+import threading
+
+
+def main(port: int, state_path: str) -> None:
+    lock = threading.Lock()
+
+    def load() -> int:
+        try:
+            with open(state_path) as f:
+                return int(f.read().strip() or 0)
+        except FileNotFoundError:
+            return 0
+
+    def store(v: int) -> None:
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(v))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, state_path)
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                parts = line.decode().split()
+                with lock:
+                    v = load()
+                    if not parts:
+                        out = "ERR"
+                    elif parts[0] == "R":
+                        out = str(v)
+                    elif parts[0] == "W":
+                        store(int(parts[1]))
+                        out = "OK"
+                    elif parts[0] == "CAS":
+                        if v == int(parts[1]):
+                            store(int(parts[2]))
+                            out = "OK"
+                        else:
+                            out = "MISS"
+                    else:
+                        out = "ERR"
+                self.wfile.write((out + "\n").encode())
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    print(f"regserverd listening on {port}, state {state_path}", flush=True)
+    Server(("127.0.0.1", port), Handler).serve_forever()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), sys.argv[2])

@@ -159,10 +159,14 @@ def test_cli_suite_run(tmp_path):
 
     s = FakeHttpKv().start()
     try:
+        # long enough that every op type succeeds at least once: the
+        # stats checker (correctly, like the reference's) fails a run
+        # where e.g. every random CAS missed — at rate 40 x 1s that had
+        # a ~7% chance, flaking CI
         rc = cli.run_cli(cli.default_commands(), [
             "test", "--suite", "etcd", "--workload", "register",
-            "--nodes", "n1,n2,n3", "--dummy", "--time-limit", "1",
-            "--rate", "40", "--store-base", str(tmp_path),
+            "--nodes", "n1,n2,n3", "--dummy", "--time-limit", "3",
+            "--rate", "75", "--store-base", str(tmp_path),
             "-o", "host=127.0.0.1", "-o", f"port={s.port}",
         ])
     finally:

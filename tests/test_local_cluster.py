@@ -1,0 +1,203 @@
+"""Real-process cluster integration: the closest thing to a live SSH
+cluster this image supports (no docker, no sshd — see docker/bin/smoke
+for the full BASELINE config-2 run on a docker-capable host).
+
+A persistent TCP register server (tests/regserverd.py) runs as a REAL
+daemon under start-stop-daemon through the LocalRemote transport; the
+test drives the whole lifecycle through core.run — OS-level daemon
+start, TCP await, a kill nemesis delivering real SIGKILLs mid-workload,
+client reconnects, post-run log snarfing into the store, and a
+linearizability verdict over the resulting history.  The server fsyncs
+every acknowledged write, so the verdict must be valid even under
+kill faults."""
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, db as db_mod, models
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nemesis_mod
+from jepsen_tpu.control.local import LocalRemote
+from jepsen_tpu.control import util as cu
+from jepsen_tpu import control
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SERVER = os.path.join(HERE, "regserverd.py")
+DIR = "/tmp/jepsen-regserver"
+PORT = 47831
+
+needs_ssd = pytest.mark.skipif(
+    shutil.which("start-stop-daemon") is None,
+    reason="start-stop-daemon not installed",
+)
+
+
+class RegServerDB(db_mod.DB, db_mod.Process, db_mod.LogFiles):
+    """Installs and runs regserverd as a managed daemon."""
+
+    logfile = f"{DIR}/server.log"
+    pidfile = f"{DIR}/server.pid"
+    statefile = f"{DIR}/state"
+
+    def setup(self, test, node):
+        control.execute("mkdir", "-p", DIR)
+        control.upload(SERVER, f"{DIR}/regserverd.py")
+        self.start(test, node)
+        cu.await_tcp_port(PORT, host="127.0.0.1", timeout_s=30)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        control.execute("rm", "-rf", DIR, check=False)
+
+    def start(self, test, node):
+        cu.start_daemon(
+            {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR,
+             "match-executable?": False},
+            "/usr/bin/env",
+            "python3",
+            f"{DIR}/regserverd.py",
+            str(PORT),
+            self.statefile,
+        )
+
+    def kill(self, test, node):
+        cu.grepkill("regserverd", 9)
+        cu.stop_daemon(pidfile=self.pidfile)
+
+    def log_files(self, test, node):
+        return [self.logfile]
+
+
+class RegClient(client_mod.Client):
+    """Line-protocol client with reconnect-on-crash."""
+
+    def __init__(self):
+        self.sock = None
+        self.f = None
+
+    def open(self, test, node):
+        c = RegClient()
+        c._connect()
+        return c
+
+    def _connect(self):
+        self.sock = socket.create_connection(("127.0.0.1", PORT), timeout=5)
+        self.f = self.sock.makefile("rw")
+
+    def _ask(self, line):
+        self.f.write(line + "\n")
+        self.f.flush()
+        out = self.f.readline().strip()
+        if not out:
+            raise ConnectionError("server went away")
+        return out
+
+    def invoke(self, test, op):
+        try:
+            if self.sock is None:
+                self._connect()
+            if op["f"] == "read":
+                out = self._ask("R")
+                return {**op, "type": "ok", "value": int(out)}
+            if op["f"] == "write":
+                self._ask(f"W {op['value']}")
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = op["value"]
+                out = self._ask(f"CAS {old} {new}")
+                if out == "OK":
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(op["f"])
+        except (OSError, ConnectionError, ValueError) as e:
+            self.sock = None
+            # a request cut off mid-flight is indeterminate for writes,
+            # safe-fail for reads
+            t = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": t, "error": repr(e)}
+
+    def close(self, test):
+        if self.sock is not None:
+            self.sock.close()
+            self.sock = None
+
+
+@needs_ssd
+def test_real_daemon_cluster_run(tmp_path):
+    import random
+
+    db = RegServerDB()
+
+    def rw(test, ctx):
+        r = random.random()
+        if r < 0.4:
+            return {"type": "invoke", "f": "read", "value": None}
+        if r < 0.8:
+            return {"type": "invoke", "f": "write",
+                    "value": random.randint(1, 4)}
+        return {"type": "invoke", "f": "cas",
+                "value": [random.randint(1, 4), random.randint(1, 4)]}
+
+    kill_restart = nemesis_mod.node_start_stopper(
+        lambda nodes: nodes,
+        lambda test, node: db.kill(test, node),
+        lambda test, node: (
+            db.start(test, node),
+            cu.await_tcp_port(PORT, timeout_s=30),
+        ),
+    )
+
+    nemesis_gen = gen.cycle(
+        [
+            gen.sleep(0.6),
+            {"type": "info", "f": "start", "value": None},
+            gen.sleep(0.6),
+            {"type": "info", "f": "stop", "value": None},
+        ]
+    )
+
+    test = {
+        "name": "local-cluster",
+        "start-time": "t0",
+        "store-base": str(tmp_path),
+        "nodes": ["n1"],
+        "remote": LocalRemote(),
+        "db": db,
+        "client": RegClient(),
+        "nemesis": kill_restart,
+        "concurrency": 5,
+        "generator": gen.time_limit(
+            6,
+            gen.nemesis(
+                nemesis_gen,
+                gen.stagger(0.02, rw),
+            ),
+        ),
+        "time-limit": 6,
+        "checker": checker_mod.linearizable(models.cas_register(0)),
+    }
+    result = core.run(test)
+    r = result["results"]
+    hist = result["history"]
+    oks = [op for op in hist if op["type"] == "ok"
+           and isinstance(op["process"], int)]
+    kills = [op for op in hist if op["process"] == "nemesis"
+             and op["f"] == "start" and op["type"] == "info"]
+    assert len(oks) > 20, "workload barely ran"
+    assert kills, "nemesis never killed the server"
+    assert r["valid?"] is True, r
+    # post-run log snarfing downloaded the daemon's log into the store
+    log_copy = os.path.join(
+        str(tmp_path), "local-cluster", "t0", "n1", "server.log"
+    )
+    assert os.path.exists(log_copy), os.listdir(
+        os.path.join(str(tmp_path), "local-cluster", "t0")
+    )
+    assert "regserverd" in open(log_copy).read()
