@@ -90,24 +90,31 @@ WORKLOADS = ("register", "bank", "set", "list-append")
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    from . import monotonic, sequential
+    from ..workloads import adya
+    from . import comments, monotonic, sequential
 
     opts = _opts(opts)
     out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
     # suite-specific probes (reference: cockroach/monotonic.clj,
-    # sequential.clj, adya.clj g2 via the generic list-append/elle path)
+    # sequential.clj, comments.clj, adya.clj)
     out["monotonic"] = monotonic.workload(opts)
     out["sequential"] = sequential.workload(opts)
+    out["comments"] = comments.workload(opts)
+    out["g2"] = adya.workload(opts)
     return out
 
 
 def _client_for(wname: str, opts: dict):
-    from . import monotonic, sequential
+    from . import comments, g2_sql, monotonic, sequential
 
     if wname == "monotonic":
         return monotonic.MonotonicClient(opts)
     if wname == "sequential":
         return sequential.SequentialClient(opts)
+    if wname == "comments":
+        return comments.CommentsClient(opts)
+    if wname == "g2":
+        return g2_sql.G2Client(opts)
     return sql.client_for(wname, opts)
 
 

@@ -209,11 +209,7 @@ def workloads(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     return {
         "register": common.register_workload(opts),
-        "g2": {
-            "generator": adya.g2_gen(),
-            "checker": adya.g2_checker(),
-            "concurrency": 2,
-        },
+        "g2": adya.workload(opts),
     }
 
 

@@ -74,3 +74,14 @@ class _G2Checker(Checker):
 def g2_checker() -> Checker:
     """(reference: adya.clj:60-87)"""
     return _G2Checker()
+
+
+def workload(opts=None) -> dict:
+    """The paired-insert G2 workload package, shared by every suite that
+    wires a predicate-insert client (faunadb g2, cockroach adya).
+    (reference: jepsen/src/jepsen/tests/adya.clj:12-87)"""
+    return {
+        "generator": g2_gen(),
+        "checker": g2_checker(),
+        "concurrency": 2,
+    }

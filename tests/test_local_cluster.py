@@ -30,8 +30,14 @@ from jepsen_tpu import control
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 SERVER = os.path.join(HERE, "regserverd.py")
-DIR = "/tmp/jepsen-regserver"
-PORT = 47831
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 needs_ssd = pytest.mark.skipif(
     shutil.which("start-stop-daemon") is None,
@@ -40,35 +46,42 @@ needs_ssd = pytest.mark.skipif(
 
 
 class RegServerDB(db_mod.DB, db_mod.Process, db_mod.LogFiles):
-    """Installs and runs regserverd as a managed daemon."""
+    """Installs and runs regserverd as a managed daemon.  Port and
+    directory are per-instance so concurrent runs on one host (two CI
+    checkouts, say) cannot kill each other's daemons or state."""
 
-    logfile = f"{DIR}/server.log"
-    pidfile = f"{DIR}/server.pid"
-    statefile = f"{DIR}/state"
+    def __init__(self, dir_: str, port: int):
+        self.dir = dir_
+        self.port = port
+        self.logfile = f"{dir_}/server.log"
+        self.pidfile = f"{dir_}/server.pid"
+        self.statefile = f"{dir_}/state"
 
     def setup(self, test, node):
-        control.execute("mkdir", "-p", DIR)
-        control.upload(SERVER, f"{DIR}/regserverd.py")
+        control.execute("mkdir", "-p", self.dir)
+        control.upload(SERVER, f"{self.dir}/regserverd.py")
         self.start(test, node)
-        cu.await_tcp_port(PORT, host="127.0.0.1", timeout_s=30)
+        cu.await_tcp_port(self.port, host="127.0.0.1", timeout_s=30)
 
     def teardown(self, test, node):
         self.kill(test, node)
-        control.execute("rm", "-rf", DIR, check=False)
+        control.execute("rm", "-rf", self.dir, check=False)
 
     def start(self, test, node):
         cu.start_daemon(
-            {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR,
-             "match-executable?": False},
+            {"logfile": self.logfile, "pidfile": self.pidfile,
+             "chdir": self.dir, "match-executable?": False},
             "/usr/bin/env",
             "python3",
-            f"{DIR}/regserverd.py",
-            str(PORT),
+            f"{self.dir}/regserverd.py",
+            str(self.port),
             self.statefile,
         )
 
     def kill(self, test, node):
-        cu.grepkill("regserverd", 9)
+        # match on this instance's unique dir, not a generic name, so
+        # other runs' daemons survive
+        cu.grepkill(f"{self.dir}/regserverd.py", 9)
         cu.stop_daemon(pidfile=self.pidfile)
 
     def log_files(self, test, node):
@@ -78,17 +91,18 @@ class RegServerDB(db_mod.DB, db_mod.Process, db_mod.LogFiles):
 class RegClient(client_mod.Client):
     """Line-protocol client with reconnect-on-crash."""
 
-    def __init__(self):
+    def __init__(self, port: int):
+        self.port = port
         self.sock = None
         self.f = None
 
     def open(self, test, node):
-        c = RegClient()
+        c = RegClient(self.port)
         c._connect()
         return c
 
     def _connect(self):
-        self.sock = socket.create_connection(("127.0.0.1", PORT), timeout=5)
+        self.sock = socket.create_connection(("127.0.0.1", self.port), timeout=5)
         self.f = self.sock.makefile("rw")
 
     def _ask(self, line):
@@ -133,7 +147,8 @@ class RegClient(client_mod.Client):
 def test_real_daemon_cluster_run(tmp_path):
     import random
 
-    db = RegServerDB()
+    port = _free_port()
+    db = RegServerDB(str(tmp_path / "regserver"), port)
 
     def rw(test, ctx):
         r = random.random()
@@ -150,7 +165,7 @@ def test_real_daemon_cluster_run(tmp_path):
         lambda test, node: db.kill(test, node),
         lambda test, node: (
             db.start(test, node),
-            cu.await_tcp_port(PORT, timeout_s=30),
+            cu.await_tcp_port(port, timeout_s=30),
         ),
     )
 
@@ -170,7 +185,7 @@ def test_real_daemon_cluster_run(tmp_path):
         "nodes": ["n1"],
         "remote": LocalRemote(),
         "db": db,
-        "client": RegClient(),
+        "client": RegClient(port),
         "nemesis": kill_restart,
         "concurrency": 5,
         "generator": gen.time_limit(

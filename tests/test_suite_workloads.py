@@ -449,3 +449,134 @@ def test_fauna_g2_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- cockroach comments ------------------------------------------------------
+
+
+def test_comments_client_and_checker():
+    from jepsen_tpu.suites import comments
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": "cockroach",
+                "user": "postgres"}
+        c = comments.CommentsClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        for i in (1, 2, 3):
+            r = c.invoke({}, {"f": "write", "type": "invoke",
+                              "value": independent.kv(0, i)})
+            assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "type": "invoke",
+                          "value": independent.kv(0, None)})
+        assert r["type"] == "ok" and list(r["value"][1]) == [1, 2, 3]
+        # other keys see nothing
+        r2 = c.invoke({}, {"f": "read", "type": "invoke",
+                           "value": independent.kv(9, None)})
+        assert r2["value"][1] == []
+        c.close({})
+    finally:
+        s.stop()
+
+    chk = comments.CommentsChecker()
+    good = h(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), ok_op(1, "write", 2),
+        invoke_op(2, "read"), ok_op(2, "read", [1, 2]),
+    )
+    assert chk.check({}, good)["valid?"] is True
+    # write 2 invoked AFTER write 1 completed; a read seeing 2 but not 1
+    # violates strict serializability
+    bad = h(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "write", 2), ok_op(1, "write", 2),
+        invoke_op(2, "read"), ok_op(2, "read", [2]),
+    )
+    res = chk.check({}, bad)
+    assert res["valid?"] is False and res["errors"][0]["missing"] == [1]
+    # concurrent writes have no mutual expectation: seeing one alone is OK
+    conc = h(
+        invoke_op(0, "write", 1),
+        invoke_op(1, "write", 2),
+        ok_op(0, "write", 1), ok_op(1, "write", 2),
+        invoke_op(2, "read"), ok_op(2, "read", [2]),
+    )
+    assert chk.check({}, conc)["valid?"] is True
+
+
+def test_comments_full_test_in_process():
+    from jepsen_tpu.suites import cockroachdb
+
+    s = FakePg().start()
+    try:
+        t = cockroachdb.test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 50,
+                "workload": "comments",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- cockroach g2 (predicate anti-dependency) --------------------------------
+
+
+def test_g2_sql_client():
+    from jepsen_tpu.suites import g2_sql
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": "cockroach",
+                "user": "postgres"}
+        c = g2_sql.G2Client(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r1 = c.invoke({}, {"f": "insert", "type": "invoke",
+                           "value": independent.kv(1, [10, None])})
+        assert r1["type"] == "ok", r1
+        # the pair partner sees the predicate hit and must refuse
+        r2 = c.invoke({}, {"f": "insert", "type": "invoke",
+                           "value": independent.kv(1, [None, 11])})
+        assert r2["type"] == "fail"
+        # other keys unaffected
+        r3 = c.invoke({}, {"f": "insert", "type": "invoke",
+                           "value": independent.kv(2, [None, 12])})
+        assert r3["type"] == "ok"
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_g2_full_test_in_process():
+    from jepsen_tpu.suites import cockroachdb
+
+    s = FakePg().start()
+    try:
+        t = cockroachdb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 40,
+                "workload": "g2",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
