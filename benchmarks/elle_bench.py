@@ -1,0 +1,83 @@
+"""Microbenchmark: the Elle cycle screen, device vs CPU.
+
+The transactional checkers' hot screening step asks, for thousands of
+per-key version graphs at once, "does any cycle exist?"
+(jepsen_tpu.elle.cycles.cyclic_graph_mask).  On device this is a
+batched boolean matrix closure (ops.cycles.has_cycle_batch); on CPU it
+is per-graph Tarjan SCC.  This prints both throughputs at a few graph
+sizes so the dispatch threshold's perf claim has evidence.
+
+Run: python benchmarks/elle_bench.py            # device (if present)
+     JAX_PLATFORMS=cpu python ... (pytest-style CPU forcing needs the
+     platform override, see jepsen_tpu.platform)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def random_graphs(rng, count: int, n: int, p: float):
+    """Random digraph adjacency matrices, ~half with cycles (DAG-ified
+    by upper-triangular masking on the other half)."""
+    mats = []
+    for i in range(count):
+        m = rng.random((n, n)) < p
+        np.fill_diagonal(m, False)
+        if i % 2 == 0:
+            m = np.triu(m)  # acyclic
+        mats.append(m)
+    return mats
+
+
+def bench(label, fn, mats, reps=3):
+    fn(mats)  # warm (compile)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(mats)
+    dt = (time.perf_counter() - t0) / reps
+    rate = len(mats) / dt
+    print(f"{label}: {rate:,.0f} graphs/sec ({dt * 1e3:.1f} ms/batch)")
+    return out, rate
+
+
+def main():
+    from jepsen_tpu.elle.graph import Graph, strongly_connected_components
+    from jepsen_tpu.ops import cycles as ops_cycles
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(7)
+    print(f"platform={platform}")
+
+    def cpu_scc(mats):
+        out = []
+        for m in mats:
+            g = Graph()
+            n = m.shape[0]
+            for a in range(n):
+                g.add_vertex(a)
+                for b in np.flatnonzero(m[a]):
+                    g.add_edge(a, int(b), "ww")
+            out.append(bool(strongly_connected_components(g)))
+        return np.array(out)
+
+    for count, n, p in ((4096, 16, 0.15), (2048, 64, 0.05), (256, 256, 0.02)):
+        mats = random_graphs(rng, count, n, p)
+        dev, dev_rate = bench(
+            f"device  n={n:<4} B={count:<5}", ops_cycles.has_cycle_batch, mats
+        )
+        cpu, cpu_rate = bench(f"cpu-scc n={n:<4} B={count:<5}", cpu_scc, mats)
+        agree = (np.asarray(dev) == cpu).all()
+        print(f"  agree={bool(agree)}  speedup={dev_rate / cpu_rate:.1f}x")
+        if not agree:
+            raise SystemExit("device and CPU disagree!")
+
+
+if __name__ == "__main__":
+    main()

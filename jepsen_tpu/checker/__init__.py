@@ -561,6 +561,63 @@ def counter() -> Checker:
 
 
 class _Linearizable(Checker):
+    def _race(self, test, history) -> dict:
+        """Run the device kernel and the CPU oracle concurrently; the
+        first DEFINITE (non-unknown) verdict wins.  Both arms tag their
+        result so the report says who won.  Arms run on daemon threads:
+        a hung accelerator backend must never pin process exit (the
+        atexit join in concurrent.futures would), and the loser's
+        result is simply dropped."""
+        import queue
+        import threading
+
+        from . import linear
+        from ..ops import encode as encode_mod
+        from ..ops import wgl
+
+        def kernel():
+            if not wgl.supported(self.model):
+                return None
+            # concede unencodable histories outright: wgl would fall
+            # back to the oracle internally, duplicating the exact
+            # worst-case exponential search the other arm already runs
+            if (
+                encode_mod.encode_history(history, self.model) is None
+            ):
+                return None
+            out = wgl.analysis(self.model, history)
+            out.setdefault("engine", "tpu")
+            return out
+
+        def oracle():
+            out = linear.analysis(
+                self.model, history, pure_fs=self.pure_fs, witness=True
+            )
+            out["engine"] = "oracle"
+            return out
+
+        results: "queue.Queue" = queue.Queue()
+
+        def run(arm):
+            try:
+                results.put(("ok", arm()))
+            except Exception as e:  # noqa: BLE001 — other arm decides
+                results.put(("err", e))
+
+        n_arms = 2
+        for arm in (kernel, oracle):
+            threading.Thread(target=run, args=(arm,), daemon=True).start()
+        last = None
+        for _ in range(n_arms):
+            status, out = results.get()
+            if status == "err":
+                last = {"valid?": "unknown", "error": repr(out)}
+                continue
+            if out is not None and out.get("valid?") != "unknown":
+                return out
+            last = out or last
+        return last or {"valid?": "unknown", "error": "no arm finished"}
+
     def __init__(self, model, algorithm: str = "auto", pure_fs=("read",)):
         if model is None:
             raise ValueError(
@@ -581,7 +638,15 @@ class _Linearizable(Checker):
                 algorithm = "tpu"
             else:
                 algorithm = "oracle"
-        if algorithm == "tpu":
+        if algorithm == "race":
+            # knossos-style competition: device kernel and CPU oracle run
+            # concurrently, first definite verdict wins (knossos.core
+            # races its linear/wgl searches the same way; consumed by the
+            # reference at checker.clj:199-203).  Worth it when histories
+            # are small enough that jit compilation could lose to the
+            # oracle, or models fall off the kernel's fast path.
+            a = self._race(test, history)
+        elif algorithm == "tpu":
             from ..ops import wgl
 
             a = wgl.analysis(self.model, history)
@@ -629,7 +694,9 @@ class _Linearizable(Checker):
 
 def linearizable(model, algorithm: str = "auto", pure_fs=("read",)) -> Checker:
     """Validate linearizability against a model.  algorithm: "auto"
-    (TPU kernel when the model has one, else oracle), "tpu", or "oracle".
+    (TPU kernel when the model has one, else oracle), "tpu", "oracle",
+    or "race" (kernel vs oracle concurrently, first definite verdict
+    wins — knossos's competition mode).
     (reference: checker.clj:185-216)"""
     return _Linearizable(model, algorithm, pure_fs)
 

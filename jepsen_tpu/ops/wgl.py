@@ -356,6 +356,7 @@ def check_batch(
                 results[hist_idx] = linear.analysis(
                     model, histories[hist_idx], pure_fs=spec.pure_fs
                 )
+                results[hist_idx]["engine"] = "oracle-overflow"
             elif ok[row]:
                 results[hist_idx] = {"valid?": True, "engine": "tpu"}
             else:
@@ -371,6 +372,24 @@ def check_batch(
         results[hist_idx]["engine"] = "oracle-fallback"
 
     return results  # type: ignore[return-value]
+
+
+def batch_stats(results: Sequence[dict]) -> dict:
+    """Engine breakdown for a check_batch result list — the
+    overflow→oracle fallback rate the device path's throughput claims
+    rest on (an "unknown"-heavy batch is oracle-bound regardless of
+    kernel speed)."""
+    counts: dict = {}
+    for r in results:
+        counts[r.get("engine", "?")] = counts.get(r.get("engine", "?"), 0) + 1
+    n = max(1, len(results))
+    return {
+        "engines": counts,
+        "device-rate": counts.get("tpu", 0) / n,
+        "oracle-rate": sum(
+            v for k, v in counts.items() if k.startswith("oracle")
+        ) / n,
+    }
 
 
 def analysis(model: m.Model, history: History, **kw) -> dict:

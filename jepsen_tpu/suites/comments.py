@@ -53,9 +53,10 @@ class CommentsClient(sql._Base):
         k, v = op["value"]
         try:
             if op["f"] == "write":
+                v = int(v)
                 self.conn.query(
                     f"INSERT INTO {table_for(v, self.table_count)} "
-                    f"(id, key) VALUES ({v}, {k})"
+                    f"(id, key) VALUES ({v}, {int(k)})"
                 )
                 return {**op, "type": "ok"}
             if op["f"] == "read":
@@ -65,7 +66,7 @@ class CommentsClient(sql._Base):
                     for i in range(self.table_count):
                         res = self.conn.query(
                             f"SELECT id FROM {TABLE_PREFIX}{i} "
-                            f"WHERE key = {k}"
+                            f"WHERE key = {int(k)}"
                         )
                         seen.extend(int(r[0]) for r in res.rows)
                     self.conn.query("COMMIT")

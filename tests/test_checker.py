@@ -435,3 +435,33 @@ def test_log_file_pattern(tmp_path):
     assert out["matches"][0]["node"] == "n1"
     out2 = c.log_file_pattern(r"unfindable", "db.log").check(test, h(), {})
     assert out2["valid?"] is True
+
+
+def test_linearizable_race_mode():
+    good = h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 1),
+    )
+    bad = h(
+        invoke_op(0, "write", 1),
+        ok_op(0, "write", 1),
+        invoke_op(1, "read"),
+        ok_op(1, "read", 9),
+    )
+    race = c.linearizable(m.register(0), algorithm="race")
+    rg = race.check({}, good)
+    assert rg["valid?"] is True and rg["engine"] in ("tpu", "oracle")
+    rb = race.check({}, bad)
+    assert rb["valid?"] is False and rb["engine"] in ("tpu", "oracle")
+    # models with no kernel still get a verdict (oracle arm wins)
+    q = c.linearizable(m.fifo_queue(), algorithm="race")
+    qh = h(
+        invoke_op(0, "enqueue", 5),
+        ok_op(0, "enqueue", 5),
+        invoke_op(1, "dequeue"),
+        ok_op(1, "dequeue", 5),
+    )
+    rq = q.check({}, qh)
+    assert rq["valid?"] is True and rq["engine"] == "oracle"

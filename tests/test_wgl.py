@@ -335,3 +335,28 @@ def test_differential_multi_register():
     kernel = [o["valid?"] for o in wgl.check_batch(model, hists)]
     assert oracle == kernel
     assert True in oracle and False in oracle
+
+
+def test_batch_stats_engine_breakdown():
+    model = m.register(0)
+    good = h(invoke_op(0, "read"), ok_op(0, "read", 0))
+    wide = h(*[invoke_op(i, "write", i) for i in range(40)])
+    outs = wgl.check_batch(model, [good, wide], slot_cap=32)
+    stats = wgl.batch_stats(outs)
+    assert stats["engines"].get("tpu", 0) == 1
+    assert stats["engines"].get("oracle-fallback", 0) == 1
+    assert stats["oracle-rate"] == 0.5 and stats["device-rate"] == 0.5
+
+
+def test_overflow_fallback_tagged_engine():
+    # frontier 1 with no escalation: overflow rows go to the oracle and
+    # must be tagged oracle-overflow in the result + stats
+    rng = random.Random(11)
+    hists = [_gen(rng, n_procs=5, n_ops=25) for _ in range(4)]
+    model = m.cas_register(0)
+    outs = wgl.check_batch(
+        model, hists, frontier=1, escalation=(), max_closure=1
+    )
+    stats = wgl.batch_stats(outs)
+    assert stats["engines"].get("oracle-overflow", 0) > 0
+    assert all(o["valid?"] is True for o in outs)
