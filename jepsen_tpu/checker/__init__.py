@@ -560,6 +560,11 @@ def counter() -> Checker:
     return _CounterChecker()
 
 
+#: after one race arm answers, how long a wedged straggler may hold up
+#: an indefinite ("unknown") verdict before we settle for it
+RACE_LOSER_WAIT_S = 60.0
+
+
 class _Linearizable(Checker):
     def _race(self, test, history) -> dict:
         """Run the device kernel and the CPU oracle concurrently; the
@@ -572,20 +577,15 @@ class _Linearizable(Checker):
         import threading
 
         from . import linear
-        from ..ops import encode as encode_mod
         from ..ops import wgl
 
         def kernel():
             if not wgl.supported(self.model):
                 return None
-            # concede unencodable histories outright: wgl would fall
-            # back to the oracle internally, duplicating the exact
-            # worst-case exponential search the other arm already runs
-            if (
-                encode_mod.encode_history(history, self.model) is None
-            ):
-                return None
-            out = wgl.analysis(self.model, history)
+            # oracle_fallback=False: unencodable/overflowing histories
+            # come back "unknown" (conceding the race) instead of
+            # silently duplicating the oracle arm's exponential search
+            out = wgl.analysis(self.model, history, oracle_fallback=False)
             out.setdefault("engine", "tpu")
             return out
 
@@ -608,8 +608,18 @@ class _Linearizable(Checker):
         for arm in (kernel, oracle):
             threading.Thread(target=run, args=(arm,), daemon=True).start()
         last = None
-        for _ in range(n_arms):
-            status, out = results.get()
+        for i in range(n_arms):
+            try:
+                # the first answer may wait as long as it needs (with
+                # both arms hung there is nothing better to return);
+                # once one arm has spoken, a wedged straggler only gets
+                # a bounded grace period before we settle for the
+                # indefinite result we have
+                status, out = results.get(
+                    timeout=None if i == 0 else RACE_LOSER_WAIT_S
+                )
+            except queue.Empty:
+                break
             if status == "err":
                 last = {"valid?": "unknown", "error": repr(out)}
                 continue

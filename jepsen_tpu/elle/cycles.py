@@ -159,7 +159,12 @@ def cyclic_graph_mask(graphs: List[Graph], use_device: Optional[bool] = None):
     if not graphs:
         return np.zeros((0,), dtype=bool)
     if use_device is None:
-        use_device = max(len(g.vertices) for g in graphs) >= 16
+        # device wins by ~20x on the small, numerous per-key graphs and
+        # loses to CPU SCC past a couple hundred vertices (measured in
+        # benchmarks/elle_bench.py: 19.7x at n=16, 3.9x at n=64, 0.6x at
+        # n=256) — dispatch only inside the winning band
+        biggest = max(len(g.vertices) for g in graphs)
+        use_device = 16 <= biggest <= 128
     if not use_device:
         return np.array(
             [bool(strongly_connected_components(g)) for g in graphs]

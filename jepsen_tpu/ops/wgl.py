@@ -283,12 +283,16 @@ def check_batch(
     max_closure: Optional[int] = None,
     mesh=None,
     escalation=ESCALATION_FACTORS,
+    oracle_fallback: bool = True,
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
     over multiple devices.  Unencodable histories fall back to the CPU
     oracle; device-side overflows first retry on-device with escalated
-    frontier capacity, then fall back to the oracle."""
+    frontier capacity, then fall back to the oracle.  With
+    ``oracle_fallback=False`` those rows report ``"unknown"`` instead —
+    for callers (like the race-mode checker) already running the oracle
+    themselves."""
     from ..checker import linear
 
     spec = spec_for(model)
@@ -353,6 +357,12 @@ def check_batch(
         for row, hist_idx in enumerate(batch.row_history):
             if overflow[row]:
                 # still overflowed after escalation: CPU oracle decides
+                if not oracle_fallback:
+                    results[hist_idx] = {
+                        "valid?": "unknown",
+                        "engine": "overflow",
+                    }
+                    continue
                 results[hist_idx] = linear.analysis(
                     model, histories[hist_idx], pure_fs=spec.pure_fs
                 )
@@ -367,6 +377,9 @@ def check_batch(
                 }
 
     for hist_idx in batch.fallback:
+        if not oracle_fallback:
+            results[hist_idx] = {"valid?": "unknown", "engine": "unencodable"}
+            continue
         pure = spec.pure_fs if spec else ()
         results[hist_idx] = linear.analysis(model, histories[hist_idx], pure_fs=pure)
         results[hist_idx]["engine"] = "oracle-fallback"

@@ -226,6 +226,7 @@ def workloads(opts: Optional[dict] = None) -> dict:
         "register": common.register_workload(opts),
         "set": common.set_workload(opts),
         "upsert": upsert_workload(opts),
+        "delete": delete_workload(opts),
     }
 
 
@@ -236,6 +237,7 @@ def test(opts: Optional[dict] = None) -> dict:
     c = {
         "set": DgraphSetClient,
         "upsert": DgraphUpsertClient,
+        "delete": DgraphDeleteClient,
     }.get(wname, DgraphClient)(opts)
     return common.build_test(
         f"dgraph-{wname}", opts, db=DgraphDB(opts), client=c, workload=w,
@@ -336,5 +338,103 @@ def upsert_workload(opts: Optional[dict] = None) -> dict:
             2 * n, range(100_000), fgen
         ),
         "checker": independent.checker(UpsertChecker()),
+        "concurrency": 4 * n,
+    }
+
+
+# ---------------------------------------------------------------------
+# delete workload
+# ---------------------------------------------------------------------
+
+
+class DgraphDeleteClient(DgraphClient):
+    """Create and delete indexed records per key; reads must never see
+    a half-indexed state (zero records or exactly one well-formed one).
+
+    Reference: dgraph/src/jepsen/dgraph/delete.clj:22-62 — :upsert
+    creates {key k} unless present, :delete removes the record found by
+    an index read, :read returns the matching records.  Both mutations
+    ride one transactional upsert block.
+    """
+
+    def invoke(self, test, op):
+        k, _v = op["value"]
+        q = f'{{ q(func: eq(key, {int(k)})) {{ u as uid }} }}'
+        try:
+            if op["f"] == "read":
+                data = self._query(
+                    f'{{ q(func: eq(key, {int(k)})) {{ uid key }} }}'
+                )
+                return {**op, "type": "ok",
+                        "value": independent.kv(k, data.get("q", []))}
+            if op["f"] == "upsert":
+                out = self._upsert(q, [
+                    {"cond": "@if(eq(len(u), 0))",
+                     "set_nquads": f'_:n <key> "{int(k)}" .'},
+                ])
+                if (out.get("data") or {}).get("uids"):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "present"}
+            if op["f"] == "delete":
+                out = self._upsert(q, [
+                    {"cond": "@if(gt(len(u), 0))",
+                     "del_nquads": "uid(u) * * ."},
+                ])
+                matched = (out.get("data") or {}).get("queries", {}).get("q")
+                if matched:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "not-found"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+class DeleteChecker(checker_mod.Checker):
+    """Every ok read sees zero records, or exactly one {uid, key=k}.
+    (reference: delete.clj:64-90)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK as _OK
+
+        k = (opts or {}).get("history-key")
+        bad = []
+        for op in history:
+            if op.type != _OK or op.f != "read" or op.value is None:
+                continue
+            recs = op.value
+            well_formed = len(recs) == 0 or (
+                len(recs) == 1
+                and set(recs[0].keys()) == {"uid", "key"}
+                and (k is None or str(recs[0]["key"]) == str(k))
+            )
+            if not well_formed:
+                bad.append({"index": op.index, "value": recs})
+        return {"valid?": not bad, "bad-reads": bad}
+
+
+def delete_workload(opts: Optional[dict] = None) -> dict:
+    """Mixed upsert/delete/read ops per independent key.
+    (reference: delete.clj:92-103)"""
+    import random as _random
+
+    from .. import generator as gen_mod
+
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+
+    def rw(test, ctx):
+        f = _random.choice(["read", "upsert", "delete"])
+        return {"type": "invoke", "f": f, "value": None}
+
+    def fgen(k):
+        return gen_mod.limit(12, rw)
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * n, range(100_000), fgen
+        ),
+        "checker": independent.checker(DeleteChecker()),
         "concurrency": 4 * n,
     }

@@ -1734,6 +1734,21 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                         continue
                     if "gt(len(u), 0)" in cond and n == 0:
                         continue
+                    for line in mut.get("del_nquads", "").splitlines():
+                        line = line.strip()
+                        if not line:
+                            continue
+                        # `uid(u) * * .` deletes matched nodes wholesale;
+                        # `uid(u) <pred> * .` deletes one predicate
+                        if line.startswith("uid(u)"):
+                            parts = line.split()
+                            for uid in uids:
+                                if parts[1] == "*":
+                                    nodes.pop(uid, None)
+                                else:
+                                    nodes.get(uid, {}).pop(
+                                        parts[1].strip("<>"), None
+                                    )
                     for line in mut.get("set_nquads", "").splitlines():
                         line = line.strip()
                         if not line:

@@ -580,3 +580,84 @@ def test_g2_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- dgraph delete -----------------------------------------------------------
+
+
+def test_dgraph_delete_client_and_checker():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = dgraph.DgraphDeleteClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        # create, read (one well-formed record), delete, read (empty)
+        r1 = c.invoke({}, {"f": "upsert", "type": "invoke",
+                           "value": independent.kv(5, None)})
+        assert r1["type"] == "ok", r1
+        r2 = c.invoke({}, {"f": "upsert", "type": "invoke",
+                           "value": independent.kv(5, None)})
+        assert r2["type"] == "fail" and r2["error"] == "present"
+        rr = c.invoke({}, {"f": "read", "type": "invoke",
+                           "value": independent.kv(5, None)})
+        assert rr["type"] == "ok"
+        recs = rr["value"][1]
+        assert len(recs) == 1 and set(recs[0]) == {"uid", "key"}
+        rd = c.invoke({}, {"f": "delete", "type": "invoke",
+                           "value": independent.kv(5, None)})
+        assert rd["type"] == "ok", rd
+        rd2 = c.invoke({}, {"f": "delete", "type": "invoke",
+                            "value": independent.kv(5, None)})
+        assert rd2["type"] == "fail" and rd2["error"] == "not-found"
+        rr2 = c.invoke({}, {"f": "read", "type": "invoke",
+                            "value": independent.kv(5, None)})
+        assert rr2["value"][1] == []
+        c.close({})
+
+        chk = dgraph.DeleteChecker()
+        good = h(
+            invoke_op(0, "read"), ok_op(0, "read", []),
+            invoke_op(0, "read"),
+            ok_op(0, "read", [{"uid": "0x1", "key": "5"}]),
+        )
+        assert chk.check({}, good, {"history-key": 5})["valid?"] is True
+        bad = h(
+            invoke_op(0, "read"),
+            ok_op(0, "read", [{"uid": "0x1", "key": "5"},
+                              {"uid": "0x2", "key": "5"}]),
+        )
+        res = chk.check({}, bad, {"history-key": 5})
+        assert res["valid?"] is False and res["bad-reads"]
+        # a record missing its key predicate (half-indexed) is bad too
+        half = h(
+            invoke_op(0, "read"), ok_op(0, "read", [{"uid": "0x1"}]),
+        )
+        assert chk.check({}, half, {"history-key": 5})["valid?"] is False
+    finally:
+        s.stop()
+
+
+def test_dgraph_delete_full_test_in_process():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        t = dgraph.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 40,
+                "workload": "delete",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
