@@ -22,7 +22,6 @@ expected verdicts stay known for a correctness spot-check.
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -41,48 +40,17 @@ def default_shapes(on_accelerator):
         return dict(B=16384, L=1000, REPS=3)
     return dict(B=64, L=200, REPS=1)
 
-_PROBE = (
-    "import jax, sys; ds = jax.devices(); "
-    "sys.exit(0 if any(d.platform not in ('cpu',) for d in ds) else 3)"
-)
-
-
 def _emit(payload):
     sys.stdout.write(json.dumps(payload) + "\n")
     sys.stdout.flush()
 
 
 def probe_accelerator(retries=None, timeout_s=None, backoff_s=5):
-    """Check (in a subprocess, so hangs can't kill the bench) whether a
-    non-CPU jax backend initializes.  Returns (ok, error_message).
-    Retries cover crashes/hangs only; a clean "no accelerator present"
-    answer (exit 3) is deterministic and returns immediately."""
-    if retries is None:
-        retries = int(os.environ.get("JEPSEN_TPU_PROBE_RETRIES", 3))
-    if timeout_s is None:
-        timeout_s = int(os.environ.get("JEPSEN_TPU_PROBE_TIMEOUT", 90))
-    err = None
-    for attempt in range(retries):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE],
-                timeout=timeout_s,
-                capture_output=True,
-                text=True,
-            )
-            if r.returncode == 0:
-                return True, None
-            if r.returncode == 3:
-                return False, "no accelerator device present"
-            tail = (r.stderr or "").strip().splitlines()
-            err = tail[-1][:300] if tail else f"probe exit {r.returncode}"
-        except subprocess.TimeoutExpired:
-            err = f"backend init timed out after {timeout_s}s"
-        except Exception as e:  # noqa: BLE001 - must never crash the bench
-            err = repr(e)[:300]
-        if attempt < retries - 1:
-            time.sleep(backoff_s * (attempt + 1))
-    return False, err or "probe never ran"
+    """Shared execute-a-jitted-op probe (jepsen_tpu.platform): hangs
+    can't kill the bench, the same verdict the checker/CLI path uses."""
+    from jepsen_tpu.platform import probe_accelerator as _probe
+
+    return _probe(retries=retries, timeout_s=timeout_s, backoff_s=backoff_s)
 
 
 def run_bench(on_accelerator, warnings):

@@ -643,8 +643,14 @@ class _Linearizable(Checker):
         algorithm = self.algorithm
         if algorithm == "auto":
             from ..ops import wgl
+            from ..platform import ensure_usable_backend
 
             if wgl.supported(self.model):
+                # a wedged accelerator tunnel hangs the first in-process
+                # backend query forever; probe in a subprocess and pin
+                # the CPU platform (where the same kernel still runs)
+                # before dispatching
+                ensure_usable_backend()
                 algorithm = "tpu"
             else:
                 algorithm = "oracle"

@@ -147,7 +147,13 @@ def _exit_code(results: dict) -> int:
 def run_test(test: dict) -> int:
     """Run one prepared test map; returns its exit code."""
     from . import core
+    from .platform import ensure_usable_backend
 
+    # pin the platform ONCE, before checker worker threads exist: a
+    # wedged accelerator tunnel hangs the first in-process backend use,
+    # and racing threads could reach a dispatch before any of them
+    # finishes probing
+    ensure_usable_backend()
     result = core.run(test)
     return _exit_code(result.get("results", {}))
 

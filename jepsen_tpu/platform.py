@@ -37,3 +37,108 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+#: the probe EXECUTES a computation, not just a device query: a wedged
+#: tunnel can initialize its backend fine and then hang at remote
+#: compile, so listing devices reports healthy while every dispatch
+#: blocks forever
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp, sys; "
+    "ds = jax.devices(); "
+    "accel = any(d.platform not in ('cpu',) for d in ds); "
+    "jax.jit(lambda x: x + 1)(jnp.ones((8, 8))).block_until_ready(); "
+    "sys.exit(0 if accel else 3)"
+)
+
+import threading as _threading
+
+#: memoized accelerator probe verdict (None = not probed yet)
+_accelerator_ok: Optional[bool] = None
+_accelerator_error: Optional[str] = None
+_probe_lock = _threading.Lock()
+
+
+def probe_accelerator(
+    retries: Optional[int] = None,
+    timeout_s: Optional[float] = None,
+    backoff_s: float = 5.0,
+):
+    """Probe (in subprocesses, so a hung backend can't wedge us) whether
+    a non-CPU jax backend initializes AND executes.  Returns
+    ``(ok, error_message)``; the verdict is memoized process-wide and
+    thread-safe — concurrent callers share one probe.
+
+    Crashes and hangs retry with backoff (the environment's device
+    plugin can flake once at init); a clean "no accelerator present"
+    answer (exit 3) is deterministic and returns immediately."""
+    global _accelerator_ok, _accelerator_error
+    if _accelerator_ok is not None:
+        return _accelerator_ok, _accelerator_error
+    with _probe_lock:
+        if _accelerator_ok is not None:
+            return _accelerator_ok, _accelerator_error
+        import subprocess
+        import sys
+        import time
+
+        if retries is None:
+            retries = int(os.environ.get("JEPSEN_TPU_PROBE_RETRIES", 3))
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("JEPSEN_TPU_PROBE_TIMEOUT", 90))
+        err = None
+        for attempt in range(retries):
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", _PROBE_SRC],
+                    timeout=timeout_s,
+                    capture_output=True,
+                    text=True,
+                )
+                if r.returncode == 0:
+                    _accelerator_ok, _accelerator_error = True, None
+                    return True, None
+                if r.returncode == 3:
+                    _accelerator_ok = False
+                    _accelerator_error = "no accelerator device present"
+                    return False, _accelerator_error
+                tail = (r.stderr or "").strip().splitlines()
+                err = tail[-1][:300] if tail else f"probe exit {r.returncode}"
+            except subprocess.TimeoutExpired:
+                err = f"backend init timed out after {timeout_s:g}s"
+            except Exception as e:  # noqa: BLE001 — must never raise
+                err = repr(e)[:300]
+            if attempt < retries - 1:
+                time.sleep(backoff_s * (attempt + 1))
+        _accelerator_ok, _accelerator_error = False, err or "probe never ran"
+        return False, _accelerator_error
+
+
+def accelerator_usable(timeout_s: Optional[float] = None) -> bool:
+    """Boolean view of :func:`probe_accelerator`."""
+    return probe_accelerator(timeout_s=timeout_s)[0]
+
+
+def ensure_usable_backend() -> None:
+    """Force the CPU platform when no usable accelerator is present.
+    Safe to call repeatedly; a no-op when the platform is already
+    pinned to CPU (no probe cost) or the backend is initialized with a
+    live accelerator."""
+    try:
+        import jax
+
+        if jax.config.jax_platforms == "cpu":
+            return  # already pinned (e.g. by the test conftest)
+    except Exception:
+        pass
+    ok, err = probe_accelerator()
+    if not ok:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "accelerator unusable (%s); analysis plane pinned to CPU", err
+        )
+        try:
+            force_cpu_platform()
+        except Exception:
+            pass  # backend already initialized; nothing to rescue

@@ -315,6 +315,7 @@ def workloads(opts: Optional[dict] = None) -> dict:
     for w in ("register", "bank", "set", "list-append", "long-fork"):
         out[f"ysql.{w}"] = common.generic_workload(w, _ysql_opts(opts))
     out["ysql.multi-key-acid"] = multi_key_acid_workload(opts)
+    out["ycql.multi-key-acid"] = multi_key_acid_workload(opts)
     return out
 
 
@@ -328,6 +329,8 @@ _YCQL_CLIENTS = {
 def _client_for(wname: str, opts: dict) -> client_mod.Client:
     api, _, w = wname.partition(".")
     if api == "ycql":
+        if w == "multi-key-acid":
+            return YcqlMultiKeyAcidClient(opts)
         return _YCQL_CLIENTS[w](opts)
     if w == "multi-key-acid":
         return MultiKeyAcidClient(_ysql_opts(opts))
@@ -469,3 +472,81 @@ def multi_key_acid_workload(opts: Optional[dict] = None) -> dict:
         ),
         "concurrency": 4 * n,
     }
+
+
+class YcqlMultiKeyAcidClient(client_mod.Client):
+    """The CQL flavor of multi-key ACID: writes ride one
+    ``BEGIN TRANSACTION … END TRANSACTION`` statement (YCQL's
+    distributed-transaction syntax), reads select the sub-keys with an
+    ``IN`` predicate.  Checked by the same linearizable multi-register
+    workload as the YSQL flavor.
+
+    Reference: yugabyte/src/yugabyte/ycql/multi_key_acid.clj:13-61 —
+    a transactional table (id, ik, val, PK (id, ik)); :write stitches
+    its inserts into a single transaction statement, :read rewrites its
+    mops with the observed values.
+    """
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[CqlClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = CqlClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", YCQL_PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def setup(self, test):
+        for stmt in (
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+            f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.multi_key_acid "
+            "(id int, ik int, val int, PRIMARY KEY (id, ik)) "
+            "WITH transactions = {'enabled': 'true'}",
+        ):
+            try:
+                self.conn.query(stmt)
+            except (CqlError, IndeterminateError):
+                pass
+
+    def invoke(self, test, op):
+        ik, mops = op["value"]
+        t = f"{KEYSPACE}.multi_key_acid"
+        try:
+            if op["f"] == "read":
+                ids = sorted({k for _f, k, _v in mops})
+                in_list = ", ".join(str(i) for i in ids)
+                res = self.conn.query(
+                    f"SELECT id, val FROM {t} "
+                    f"WHERE ik = {int(ik)} AND id IN ({in_list})",
+                    consistency="quorum",
+                )
+                got = {
+                    res.cell_int(r, 0): res.cell_int(r, 1) for r in res.rows
+                }
+                out = [[f, k, got.get(k)] for f, k, _v in mops]
+                return {**op, "type": "ok", "value": independent.kv(ik, out)}
+            if op["f"] == "write":
+                inserts = "".join(
+                    f"INSERT INTO {t} (id, ik, val) "
+                    f"VALUES ({int(k)}, {int(ik)}, {int(v)}); "
+                    for f, k, v in mops
+                )
+                self.conn.query(
+                    f"BEGIN TRANSACTION {inserts}END TRANSACTION"
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except CqlError as e:
+            if e.timeout:
+                return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()

@@ -939,6 +939,44 @@ class _CqlHandler(_RecvExact, socketserver.BaseRequestHandler):
             else:
                 kv["reg:" + k] = new
                 self._send(stream, 0x08, struct.pack("!I", 1))
+        # yugabyte distributed transactions: BEGIN TRANSACTION
+        # <stmt>; <stmt>; END TRANSACTION — the handler already runs
+        # under the store lock, so the whole block applies atomically
+        # (multi_key_acid writes)
+        elif low.startswith("begin transaction"):
+            inner = s[len("begin transaction"):]
+            if inner.lower().rstrip().endswith("end transaction"):
+                inner = inner.rstrip()[: -len("end transaction")]
+            staged = {}
+            for stmt in inner.split(";"):
+                stmt = stmt.strip()
+                if not stmt:
+                    continue
+                m = _re.match(
+                    r"insert into \S+\.multi_key_acid\s*"
+                    r"\(id, ik, val\)\s*values\s*"
+                    r"\((\d+),\s*(\d+),\s*(\d+)\)",
+                    stmt, _re.I,
+                )
+                if not m:
+                    self._error(stream, 0x2000,
+                                f"Invalid txn stmt: {stmt!r}")
+                    return
+                id_, ik, val = m.groups()
+                staged[f"mka:{id_}:{ik}"] = val
+            kv.update(staged)  # all-or-nothing: parse fully, then apply
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        elif _re.match(r"select id, val from \S+\.multi_key_acid", low):
+            m = _re.search(r"ik\s*=\s*(\d+)\s+and\s+id\s+in\s*\(([^)]*)\)",
+                           low)
+            ik = m.group(1)
+            ids = [x.strip() for x in m.group(2).split(",") if x.strip()]
+            rows = [
+                [i, kv[f"mka:{i}:{ik}"]]
+                for i in ids
+                if f"mka:{i}:{ik}" in kv
+            ]
+            self._rows(stream, ["id", "val"], rows)
         elif _re.match(r"insert into \S+\.elements", low):
             inner = s[s.index("(", s.lower().index("values")) + 1:
                       s.rindex(")")]

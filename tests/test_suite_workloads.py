@@ -661,3 +661,40 @@ def test_dgraph_delete_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+def test_ycql_multi_key_acid_roundtrip():
+    from fake_servers import FakeCql
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakeCql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = yugabyte.YcqlMultiKeyAcidClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        w = c.invoke({}, {
+            "f": "write", "type": "invoke",
+            "value": independent.kv(3, [["w", 0, 7], ["w", 2, 8]]),
+        })
+        assert w["type"] == "ok", w
+        r = c.invoke({}, {
+            "f": "read", "type": "invoke",
+            "value": independent.kv(3, [["r", 0, None], ["r", 1, None],
+                                        ["r", 2, None]]),
+        })
+        assert r["type"] == "ok"
+        ik, mops = r["value"]
+        assert ik == 3
+        assert mops == [["r", 0, 7], ["r", 1, None], ["r", 2, 8]]
+        # other independent keys isolated
+        r2 = c.invoke({}, {
+            "f": "read", "type": "invoke",
+            "value": independent.kv(4, [["r", 0, None]]),
+        })
+        assert r2["value"][1] == [["r", 0, None]]
+        c.close({})
+        # the workload table exposes both flavors
+        w = yugabyte.workloads({"nodes": ["n1", "n2", "n3"]})
+        assert "ycql.multi-key-acid" in w and "ysql.multi-key-acid" in w
+    finally:
+        s.stop()
