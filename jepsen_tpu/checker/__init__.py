@@ -643,14 +643,11 @@ class _Linearizable(Checker):
         algorithm = self.algorithm
         if algorithm == "auto":
             from ..ops import wgl
-            from ..platform import ensure_usable_backend
 
+            # wgl.check_batch itself guards against a wedged accelerator
+            # tunnel (subprocess probe + CPU pin), covering every
+            # dispatch path including explicit algorithm="tpu"
             if wgl.supported(self.model):
-                # a wedged accelerator tunnel hangs the first in-process
-                # backend query forever; probe in a subprocess and pin
-                # the CPU platform (where the same kernel still runs)
-                # before dispatching
-                ensure_usable_backend()
                 algorithm = "tpu"
             else:
                 algorithm = "oracle"

@@ -43,7 +43,9 @@ from .step_kernels import ModelSpec, spec_for
 DEFAULT_FRONTIER = 128
 DEFAULT_SLOT_CAP = encode_mod.DEFAULT_SLOT_CAP
 
-_INVALID_KEY = jnp.uint32(0xFFFFFFFF)
+#: plain int, converted at trace time — a module-level jnp scalar would
+#: initialize the device backend at IMPORT, hanging on a wedged tunnel
+_INVALID_KEY = 0xFFFFFFFF
 
 
 def supported(model: m.Model) -> bool:
@@ -74,14 +76,14 @@ def _compact(states, words, valid, F):
     equals j — a [F, K] compare-reduce plus one gather, which vectorizes
     far better on the VPU than a second full sort."""
     K = states.shape[0]
-    key = jnp.where(valid, _hash_cfg(states, words), _INVALID_KEY)
+    key = jnp.where(valid, _hash_cfg(states, words), jnp.uint32(_INVALID_KEY))
     sorted_ops = lax.sort((key, states) + tuple(words), num_keys=1)
     key_s, st_s, ws_s = sorted_ops[0], sorted_ops[1], sorted_ops[2:]
     same = (key_s[1:] == key_s[:-1]) & (st_s[1:] == st_s[:-1])
     for w in ws_s:
         same = same & (w[1:] == w[:-1])
     dup = jnp.concatenate([jnp.zeros((1,), bool), same])
-    v2 = (key_s != _INVALID_KEY) & ~dup
+    v2 = (key_s != jnp.uint32(_INVALID_KEY)) & ~dup
     prefix = jnp.cumsum(v2.astype(jnp.int32))
     count = prefix[-1]
     j = jnp.arange(F, dtype=jnp.int32)
@@ -294,7 +296,13 @@ def check_batch(
     for callers (like the race-mode checker) already running the oracle
     themselves."""
     from ..checker import linear
+    from ..platform import ensure_usable_backend
 
+    # guard at the dispatch layer so EVERY caller (checker algorithms,
+    # batched_linearizable, library users) survives a wedged accelerator
+    # tunnel: probe in a subprocess, pin CPU if the device is unusable.
+    # Memoized; a no-op when the platform is already pinned.
+    ensure_usable_backend()
     spec = spec_for(model)
     batch = encode_mod.batch_encode(histories, model, slot_cap=slot_cap)
     results: List[Optional[dict]] = [None] * len(histories)
