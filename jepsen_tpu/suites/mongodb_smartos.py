@@ -29,10 +29,16 @@ class SmartosMongoDB(common.DaemonDB):
         super().__init__(opts)
 
     def install(self, test, node):
-        # (reference: core.clj via jepsen.os.smartos — pkgin packages)
-        with control.su():
-            control.execute("pkgin", "-y", "install", "mongodb",
-                            check=False)
+        # (reference: core.clj via jepsen.os.smartos — pkgin packages;
+        # install-if-missing via the SmartOS package helpers)
+        from ..os_setup import smartos
+
+        try:
+            smartos.install(["mongodb"])
+        except Exception:
+            with control.su():
+                control.execute("pkgin", "-y", "install", "mongodb",
+                                check=False)
 
     def configure(self, test, node):
         with control.su():
@@ -92,7 +98,13 @@ def workloads(opts: Optional[dict] = None) -> dict:
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     w = workloads(opts)["register"]
-    return common.build_test(
+    t = common.build_test(
         "mongodb-smartos-register", opts, db=SmartosMongoDB(opts),
         client=MongoRegisterClient(opts), workload=w,
     )
+    # node OS lifecycle: pkgin bootstrap + ipfilter, like the
+    # reference's (jepsen.os.smartos) binding in core.clj
+    from ..os_setup import smartos
+
+    t["os"] = smartos
+    return t
