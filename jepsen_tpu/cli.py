@@ -144,6 +144,13 @@ def _exit_code(results: dict) -> int:
     return EXIT_UNKNOWN
 
 
+def given_opts(args: argparse.Namespace) -> dict:
+    """vars(args) minus the not-given options: argparse leaves those as
+    None, and merging them verbatim into the test map would shadow the
+    downstream setdefaults (e.g. core.run's concurrency = 1×nodes)."""
+    return {k: v for k, v in vars(args).items() if v is not None}
+
+
 def run_test(test: dict) -> int:
     """Run one prepared test map; returns its exit code."""
     from . import core
@@ -178,7 +185,7 @@ def single_test_cmd(
     def run(args) -> int:
         worst = EXIT_VALID
         for _ in range(args.test_count):
-            test = test_fn({**vars(args), **test_opts_to_map(args)})
+            test = test_fn({**given_opts(args), **test_opts_to_map(args)})
             code = run_test(test)
             worst = max(worst, code)
             if code != EXIT_VALID:
@@ -203,7 +210,7 @@ def single_test_cmd(
         if stored is None:
             print("no stored test found", file=sys.stderr)
             return EXIT_USAGE
-        test = test_fn({**vars(args), **test_opts_to_map(args), **stored})
+        test = test_fn({**given_opts(args), **test_opts_to_map(args), **stored})
         history = stored.get("history")
         results = checker_mod.check_safe(test["checker"], test, history, {})
         print_results = {
@@ -263,7 +270,7 @@ def test_all_cmd(
 
     def run(args) -> int:
         worst = EXIT_VALID
-        for test in tests_fn({**vars(args), **test_opts_to_map(args)}):
+        for test in tests_fn({**given_opts(args), **test_opts_to_map(args)}):
             code = run_test(test)
             worst = max(worst, code)
         return worst
@@ -385,13 +392,19 @@ def default_commands() -> Dict[str, dict]:
         g = wl.get("generator")
         if opts.get("time-limit"):
             g = gen.time_limit(opts["time-limit"], g)
-        return {
+        test = {
             **{k: v for k, v in opts.items() if not callable(v)},
             "name": opts["workload"],
             "client": KeyedAtomClient(),
             "generator": g,
             "checker": wl.get("checker"),
         }
+        # a workload that needs more workers than the 1n default says so
+        # (e.g. linearizable-register's 2n-thread key groups); an
+        # explicit --concurrency still wins
+        if "concurrency" in wl and "concurrency" not in opts:
+            test["concurrency"] = wl["concurrency"]
+        return test
 
     cmds: Dict[str, dict] = {}
     cmds.update(single_test_cmd(make_test, add_workload_opt))

@@ -122,6 +122,21 @@ def result(
     if also:
         out["also-anomaly-types"] = sorted(also, key=_severity_key)
         out["also-anomalies"] = also
+    if out["valid?"] is True:
+        # "-indeterminate" markers mean a bounded search gave up before
+        # confirming or refuting the base anomaly (e.g. G-nonadjacent's
+        # simple-cycle budget).  If the model proscribes that anomaly —
+        # by exact name or any suffixed variant — a clean pass is not
+        # provable: report unknown, never a false valid.
+        for k in anomalies:
+            if not k.endswith("-indeterminate"):
+                continue
+            base = k[: -len("-indeterminate")]
+            if _proscribed_name(base, wanted) or any(
+                w.startswith(base) for w in wanted
+            ):
+                out["valid?"] = "unknown"
+                break
     return out
 
 

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from .graph import (
     Graph,
+    INDETERMINATE,
     WW,
     WR,
     RW,
@@ -98,7 +99,18 @@ def classify(g: Graph) -> Dict[str, list]:
             want=has_rw,
             rest=lambda rels: bool(rels & {WW, WR}),
         )
-        if cyc is not None:
+        if cyc is INDETERMINATE:
+            # simple-cycle search budget exhausted: a G-nonadjacent may
+            # exist in this SCC.  Record the uncertainty (result() turns
+            # it into valid?=unknown for models that proscribe the
+            # anomaly) and fall through to the definite G2-item witness.
+            anomalies.setdefault("G-nonadjacent-indeterminate", []).append(
+                {
+                    "scc-size": len(scc),
+                    "reason": "simple-cycle search budget exhausted",
+                }
+            )
+        elif cyc is not None:
             record("G-nonadjacent", cyc)
             continue
 
@@ -132,6 +144,26 @@ def classify(g: Graph) -> Dict[str, list]:
                     want=has_rw,
                     rest=lambda rels: bool(rels & {WW, WR, PROCESS, REALTIME}),
                 )
+                if cyc is INDETERMINATE:
+                    # this rung's hypothetical cycle needs process or
+                    # realtime edges (the plain rung already answered
+                    # definitively or recorded its own marker), so only
+                    # the suffixed variants are uncertain — the plain
+                    # marker would wrongly degrade serializable/SI
+                    # verdicts that are provably clean
+                    for suffixed in (
+                        "G-nonadjacent-process-indeterminate",
+                        "G-nonadjacent-realtime-indeterminate",
+                    ):
+                        anomalies.setdefault(suffixed, []).append(
+                            {
+                                "scc-size": len(scc),
+                                "reason": (
+                                    "simple-cycle search budget exhausted"
+                                ),
+                            }
+                        )
+                    cyc = None
             else:
                 sub = g.filtered(
                     lambda rels, wr=want_rels: bool(

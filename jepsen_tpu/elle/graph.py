@@ -219,25 +219,51 @@ def cycle_rels(g: Graph, cycle: List[Any]) -> List[Set[str]]:
     return [g.edge_rels(a, b) for a, b in zip(cycle, cycle[1:])]
 
 
+#: Sentinel returned by :func:`find_nonadjacent_cycle` when the bounded
+#: simple-cycle search ran out of budget before reaching a verdict: a
+#: nonadjacent witness *walk* exists but no simple witness was confirmed
+#: or refuted.  Callers must not treat this as "no cycle" — under
+#: snapshot isolation that would be a silent false negative.
+INDETERMINATE = object()
+
+#: Default expansion budget for the bounded simple-cycle search (DFS
+#: node expansions across the whole SCC).  Simple-cycle enumeration is
+#: exponential in the worst case; the budget keeps classify() bounded
+#: while letting it answer definitively on real-world SCC sizes.
+NONADJ_BUDGET = 200_000
+
+
 def find_nonadjacent_cycle(
     g: Graph,
     scc: List[Any],
     want: Callable[[Set[str]], bool],
     rest: Callable[[Set[str]], bool],
-) -> Optional[List[Any]]:
-    """Find a cycle containing ≥1 ``want`` edges, no two of them
-    adjacent (cyclically — the wrap-around pair counts), every other
-    edge satisfying ``rest``.  Used for G-nonadjacent: under snapshot
-    isolation every dependency cycle must contain two *adjacent* rw
-    anti-dependency edges, so a cycle whose rw edges are all isolated is
-    a genuine SI violation (Adya G-SI / Cerone's SI characterization).
+    budget: Optional[int] = None,
+):
+    """Find a *simple* cycle containing ≥1 ``want`` edges, no two of
+    them adjacent (cyclically — the wrap-around pair counts), every
+    other edge satisfying ``rest``.  Used for G-nonadjacent: under
+    snapshot isolation every dependency cycle must contain two
+    *adjacent* rw anti-dependency edges, so a cycle whose rw edges are
+    all isolated is a genuine SI violation (Adya G-SI / Cerone's SI
+    characterization).
 
     Any qualifying cycle can be rotated to start with a want edge, so
     trying every start vertex with a forced want first edge is complete.
-    BFS over the product graph state (vertex, last-edge-was-want); a
-    want edge is only traversable when the previous edge was not, and
-    the closing edge back to start must be non-want (it precedes the
-    first, want, edge in the rotation)."""
+    Fast path: BFS over the product graph state
+    (vertex, last-edge-was-want); a want edge is only traversable when
+    the previous edge was not, and the closing edge back to start must
+    be non-want (it precedes the first, want, edge in the rotation).
+    The BFS decides *walk* existence exactly, so a no-walk answer is a
+    sound "no cycle".  A walk witness can be non-simple, though, and a
+    non-simple walk is not a sound nonadjacent witness (its simple
+    decomposition may contain only adjacent-rw cycles) — in that case a
+    budgeted DFS enumerates simple cycles directly.
+
+    Returns the cycle path ``[v1 v2 … v1]``, ``None`` (definitely no
+    qualifying simple cycle), or :data:`INDETERMINATE` when the DFS
+    budget ran out first — callers must surface that as an unknown
+    verdict, not a pass."""
     members = set(scc)
 
     def bfs(start: Any) -> Optional[List[Any]]:
@@ -282,14 +308,87 @@ def find_nonadjacent_cycle(
                         q.append(st)
         return None
 
+    saw_walk = False
     for start in scc:
         cyc = bfs(start)
-        if cyc is not None and len(set(cyc[:-1])) == len(cyc) - 1:
-            # accept only simple cycles: the product-graph BFS can close
-            # a walk that revisits a vertex under the other flag, and a
-            # non-simple walk is not a sound nonadjacent witness (its
-            # simple decomposition may contain only adjacent-rw cycles).
-            # Rejecting it here just drops the SCC to the G2-item rung —
-            # conservative, never a false G-nonadjacent claim.
+        if cyc is None:
+            continue
+        saw_walk = True
+        if len(set(cyc[:-1])) == len(cyc) - 1:
             return cyc
-    return None
+    if not saw_walk:
+        # BFS is complete over walks, and every simple cycle is a walk:
+        # no closing walk from any start ⇒ no qualifying cycle at all.
+        return None
+    # Some witness walk exists but every first-found one was non-simple.
+    # Enumerate simple cycles directly with a budgeted DFS; exhausting
+    # the budget yields INDETERMINATE rather than a silent downgrade to
+    # the (SI-permitted) G2-item rung.
+    if budget is None:
+        budget = NONADJ_BUDGET
+    found, exhausted = _simple_nonadjacent_dfs(g, members, scc, want, rest, budget)
+    if found is not None:
+        return found
+    return INDETERMINATE if exhausted else None
+
+
+def _simple_nonadjacent_dfs(
+    g: Graph,
+    members: Set[Any],
+    scc: List[Any],
+    want: Callable[[Set[str]], bool],
+    rest: Callable[[Set[str]], bool],
+    budget: int,
+) -> Tuple[Optional[List[Any]], bool]:
+    """Bounded DFS enumeration of simple nonadjacent-want cycles.
+    Returns ``(cycle_or_None, budget_exhausted)``.  The first edge out
+    of each start is forced to be a want edge (rotation completeness);
+    interior vertices are never revisited, so every found cycle is
+    simple by construction."""
+    steps = 0
+
+    def options(v: Any, last_want: bool, start: Any, on_path: Set[Any]):
+        for w in g.successors(v):
+            if w not in members:
+                continue
+            rels = g.edge_rels(v, w)
+            if w == start:
+                # closing edge precedes the first (want) edge in the
+                # rotation, so it must be non-want
+                if rest(rels):
+                    yield (w, False)
+                continue
+            if w in on_path:
+                continue
+            if rest(rels):
+                yield (w, False)
+            if not last_want and want(rels):
+                yield (w, True)
+
+    for start in scc:
+        for first in g.successors(start):
+            if (
+                first not in members
+                or first == start
+                or not want(g.edge_rels(start, first))
+            ):
+                continue
+            path = [start, first]
+            on_path = {start, first}
+            stack = [options(first, True, start, on_path)]
+            while stack:
+                steps += 1
+                if steps > budget:
+                    return None, True
+                try:
+                    w, is_want = next(stack[-1])
+                except StopIteration:
+                    stack.pop()
+                    on_path.discard(path.pop())
+                    continue
+                if w == start:
+                    return path + [start], False
+                path.append(w)
+                on_path.add(w)
+                stack.append(options(w, is_want, start, on_path))
+    return None, False

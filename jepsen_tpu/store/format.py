@@ -167,6 +167,20 @@ class Writer:
             jsonl = "\n".join(
                 json.dumps(op.to_dict(), default=repr) for op in history
             ).encode()
+        else:
+            # normalize caller-supplied bytes BEFORE either branch: blank
+            # lines (trailing newline, interior gaps) would inflate the
+            # chunk table's op counts AND the non-chunked
+            # history_len()'s newline count, both of which readers treat
+            # as authoritative
+            lines = [ln for ln in jsonl.splitlines() if ln]
+            if len(lines) != len(history):
+                raise ValueError(
+                    f"jsonl has {len(lines)} non-empty lines for "
+                    f"{len(history)} ops — refusing to write a history "
+                    "block with wrong op counts"
+                )
+            jsonl = b"\n".join(lines)
         if len(history) > chunk_size > 0:
             lines = jsonl.splitlines()
             chunks = []

@@ -205,10 +205,7 @@ def register_workload(opts: Optional[dict] = None) -> dict:
     linearizable_register.clj:40-43)."""
     from ..workloads import linearizable_register
 
-    opts = opts or {}
-    w = linearizable_register.test(opts)
-    w["concurrency"] = 2 * len(opts.get("nodes", ["n1"]))
-    return w
+    return linearizable_register.test(opts or {})
 
 
 WORKLOAD_BUILDERS: Dict[str, Callable[[dict], dict]] = {}

@@ -75,4 +75,8 @@ def test(opts: Optional[dict] = None) -> dict:
         "generator": independent.concurrent_generator(
             2 * n, list(range(100_000)), fgen
         ),
+        # concurrent-generator runs each key on a 2n-thread group, so
+        # the test needs at least that many workers (reference:
+        # linearizable_register.clj:40-43 via independent.clj:103-121)
+        "concurrency": 2 * n,
     }

@@ -602,6 +602,211 @@ def test_specific_cycle_names_do_not_shadow_general_proscriptions():
     assert "G-single" in res["anomaly-types"]
 
 
+def _want_rw(rels):
+    return RW in rels
+
+
+def _rest_wwwr(rels):
+    return bool(rels & {WW, WR})
+
+
+def _brute_nonadjacent_exists(g, members):
+    """Reference oracle: does a simple cycle with ≥1 rw edge, no two
+    cyclically adjacent, all other edges ww/wr, exist within members?
+    Exhaustive DFS over simple paths + exhaustive role assignment."""
+    members = set(members)
+
+    def assignable(edge_rels):
+        k = len(edge_rels)
+        for mask in range(1, 1 << k):
+            ok = True
+            for i, rels in enumerate(edge_rels):
+                if mask >> i & 1:
+                    if not _want_rw(rels):
+                        ok = False
+                        break
+                else:
+                    if not _rest_wwwr(rels):
+                        ok = False
+                        break
+            if not ok:
+                continue
+            if any(
+                (mask >> i & 1) and (mask >> ((i + 1) % k) & 1)
+                for i in range(k)
+            ):
+                continue
+            return True
+        return False
+
+    order = sorted(members, key=str)
+    for si, start in enumerate(order):
+        # canonical start = smallest vertex in the cycle
+        allowed = set(order[si:])
+
+        def dfs(v, path):
+            for w in g.successors(v):
+                if w not in allowed:
+                    continue
+                if w == start and len(path) >= 2:
+                    rels = [
+                        g.edge_rels(a, b)
+                        for a, b in zip(path + [start], (path + [start])[1:])
+                    ]
+                    if assignable(rels):
+                        return True
+                if w in path:
+                    continue
+                if dfs(w, path + [w]):
+                    return True
+            return False
+
+        if dfs(start, [start]):
+            return True
+    return False
+
+
+def _verify_nonadjacent_witness(g, cyc):
+    """The returned path must be a real, simple, nonadjacent witness."""
+    assert cyc[0] == cyc[-1]
+    assert len(set(cyc[:-1])) == len(cyc) - 1, f"non-simple witness {cyc}"
+    rels = [g.edge_rels(a, b) for a, b in zip(cyc, cyc[1:])]
+    assert all(r for r in rels), f"missing edge in {cyc}"
+    k = len(rels)
+    # exhaustive role assignment, same as the oracle
+    for mask in range(1, 1 << k):
+        if any(
+            (mask >> i & 1) and not _want_rw(rels[i])
+            or not (mask >> i & 1) and not _rest_wwwr(rels[i])
+            for i in range(k)
+        ):
+            continue
+        if any(
+            (mask >> i & 1) and (mask >> ((i + 1) % k) & 1) for i in range(k)
+        ):
+            continue
+        return
+    raise AssertionError(f"cycle {cyc} admits no nonadjacent assignment")
+
+
+def test_find_nonadjacent_cycle_differential_random():
+    """Randomized differential test vs a brute-force simple-cycle
+    oracle: the finder must agree on existence for every SCC of random
+    small graphs (this is the completeness the advisor flagged — the
+    old first-BFS-walk-only version missed witnesses whose shortest
+    closing walks were non-simple)."""
+    import random
+
+    rng = random.Random(45100)
+    labels = [
+        {RW}, {WW}, {WR}, {RW, WW}, {WW, WR},
+    ]
+    disagreements = 0
+    for trial in range(300):
+        n = rng.randint(3, 7)
+        g = Graph()
+        verts = [f"t{i}" for i in range(n)]
+        for v in verts:
+            g.add_vertex(v)
+        for a in verts:
+            for b in verts:
+                if a != b and rng.random() < 0.35:
+                    for r in rng.choice(labels):
+                        g.add_edge(a, b, r)
+        for scc in g_mod.strongly_connected_components(g):
+            got = g_mod.find_nonadjacent_cycle(
+                g, scc, want=_want_rw, rest=_rest_wwwr
+            )
+            assert got is not g_mod.INDETERMINATE, (
+                f"budget exhausted on a {len(scc)}-vertex SCC"
+            )
+            want = _brute_nonadjacent_exists(g, scc)
+            if (got is not None) != want:
+                disagreements += 1
+                raise AssertionError(
+                    f"trial {trial}: finder={'hit' if got else 'miss'} "
+                    f"oracle={'hit' if want else 'miss'} scc={scc} "
+                    f"edges={dict(g.out)}"
+                )
+            if got is not None:
+                _verify_nonadjacent_witness(g, got)
+    assert disagreements == 0
+
+
+def test_find_nonadjacent_cycle_budget_exhaustion_is_indeterminate(monkeypatch):
+    # a graph with a witness walk but (under budget=0 expansions) no
+    # simple-cycle verdict: must return INDETERMINATE, never None
+    g = Graph()
+    g.add_edge("s", "v", RW)
+    g.add_edge("v", "x", WW)
+    g.add_edge("x", "v", WW)
+    g.add_edge("v", "y", RW)
+    g.add_edge("y", "s", WW)
+    got = g_mod.find_nonadjacent_cycle(
+        g, ["s", "v", "x", "y"], want=_want_rw, rest=_rest_wwwr, budget=0
+    )
+    assert got is g_mod.INDETERMINATE
+
+
+def test_classify_indeterminate_escalates_to_unknown(monkeypatch):
+    """When the nonadjacent search gives up, SI models must report
+    valid?=unknown (not a clean pass); models that don't proscribe
+    G-nonadjacent keep their definite verdict."""
+    from jepsen_tpu.elle import consistency
+
+    monkeypatch.setattr(g_mod, "NONADJ_BUDGET", 0)
+    # walk-but-maybe-no-simple-witness graph (same shape as above)
+    h = hist(
+        # T0 reads x (missing T1's write) and reads b=1: T0 -rw(x)-> T1
+        txn_pair(
+            0,
+            [["r", "x", None], ["r", "b", None]],
+            [["r", "x", None], ["r", "b", 1]],
+            0,
+        ),
+        txn_pair(
+            1,
+            [["w", "x", 1], ["w", "a", 1]],
+            [["w", "x", 1], ["w", "a", 1]],
+            2,
+        ),
+        txn_pair(
+            2,
+            [["r", "a", None], ["r", "y", None]],
+            [["r", "a", 1], ["r", "y", None]],
+            4,
+        ),
+        txn_pair(
+            3,
+            [["w", "y", 1], ["w", "b", 1]],
+            [["w", "y", 1], ["w", "b", 1]],
+            6,
+        ),
+    )
+    res = rw_register.check(h, {"consistency-models": ["snapshot-isolation"]})
+    # the definite G-nonadjacent can no longer be confirmed under a zero
+    # budget; the verdict must degrade to unknown, not to valid
+    assert res["valid?"] in (False, "unknown"), res
+    if res["valid?"] == "unknown":
+        assert "G-nonadjacent-indeterminate" in res.get(
+            "also-anomaly-types", []
+        ), res
+
+    # synthetic: marker alone must flip valid only for proscribing models
+    out_si = consistency.result(
+        {"G-nonadjacent-indeterminate": [{"reason": "budget"}]},
+        consistency.proscribed(
+            {"consistency-models": ["snapshot-isolation"]}
+        ),
+    )
+    assert out_si["valid?"] == "unknown"
+    out_rc = consistency.result(
+        {"G-nonadjacent-indeterminate": [{"reason": "budget"}]},
+        consistency.proscribed({"consistency-models": ["read-committed"]}),
+    )
+    assert out_rc["valid?"] is True
+
+
 def test_find_nonadjacent_cycle_rejects_nonsimple_walks():
     # s-rw->v, v-ww->x, x-ww->v, v-rw->y, y-ww->s: the product-graph BFS
     # can close the walk s,v,x,v,y,s — but the only simple cycles are a

@@ -248,6 +248,50 @@ def test_chunked_history_roundtrip(tmp_path):
     assert packed["arrays"]["process"].shape[0] == len(h)
 
 
+def test_chunked_history_jsonl_blank_lines_do_not_inflate_counts(tmp_path):
+    """Caller-supplied jsonl with stray blank lines must not skew the
+    chunk table's op counts (history_len treats them as authoritative);
+    a genuine line/op mismatch must be refused, not silently written."""
+    import json as _json
+
+    import pytest
+
+    from jepsen_tpu.store import format as fmt
+
+    h = _mk_history(150)  # 300 ops
+    lines = [_json.dumps(op.to_dict(), default=repr) for op in h]
+    # interior blank line + trailing newline
+    jsonl = ("\n".join(lines[:100]) + "\n\n" + "\n".join(lines[100:]) + "\n").encode()
+    p = str(tmp_path / "b.jtpu")
+    with fmt.Writer(p) as w:
+        hid = w.write_history(h, jsonl=jsonl, chunk_size=128)
+        w.set_root(hid)
+        w.save_index()
+    r = fmt.Reader(p)
+    assert r.history_len(hid) == len(h)
+    assert len(r.read_history(hid)) == len(h)
+
+    # the non-chunked branch normalizes too: a trailing newline must not
+    # skew the newline-count history_len
+    small = _mk_history(5)  # 10 ops, stays single-block
+    small_lines = [_json.dumps(op.to_dict(), default=repr) for op in small]
+    with fmt.Writer(str(tmp_path / "s.jtpu")) as w:
+        hid2 = w.write_history(
+            small, jsonl=("\n".join(small_lines) + "\n").encode()
+        )
+        w.set_root(hid2)
+        w.save_index()
+    r2 = fmt.Reader(str(tmp_path / "s.jtpu"))
+    assert r2.history_len(hid2) == len(small)
+
+    # a real mismatch (missing line) is an error in either branch
+    bad = "\n".join(lines[:-1]).encode()
+    for cs in (128, 10_000):
+        with fmt.Writer(str(tmp_path / f"c{cs}.jtpu")) as w:
+            with pytest.raises(ValueError, match="refusing"):
+                w.write_history(h, jsonl=bad, chunk_size=cs)
+
+
 def test_small_history_stays_single_block(tmp_path):
     from jepsen_tpu.store import format as fmt
 
