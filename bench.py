@@ -9,10 +9,17 @@ of ≥10,000 histories/sec (BASELINE.md).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
 "error"/diagnostic fields when the accelerator is unusable).  It never
 crashes without emitting that line: the accelerator backend is probed in
-a subprocess with retries + backoff (the environment's axon plugin can
-hang or fail to initialize), and if it is unusable the bench falls back
-to the CPU platform at reduced shapes so a real number is still
-recorded.
+subprocesses with retries + backoff over a long horizon (the
+environment's axon plugin can hang or wedge for stretches — this is a
+once-per-round artifact, so patience is correct), every probe attempt is
+appended to ``bench_probe_trail.jsonl``, and if the chip is unusable the
+bench falls back to the CPU platform sharded across virtual host devices
+so a real, honest host number is still recorded.
+
+Whenever an on-chip run succeeds the result is persisted to
+``BENCH_tpu_latest.json`` (platform, shapes, h/s, timestamp); a later
+CPU-fallback run reports that artifact alongside its live number, so one
+live-chip window anywhere in a round leaves durable perf evidence.
 
 The batch is built from distinct random templates (valid + corrupted
 executions) expanded by per-history random value relabelings — a
@@ -27,42 +34,90 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
 
 NORTH_STAR = 10_000.0  # 1000-op histories/sec on the target hardware
 BASELINE_L = 1000
 
+#: durable evidence of the most recent successful on-chip bench
+ARTIFACT = os.path.join(_HERE, "BENCH_tpu_latest.json")
+#: per-attempt probe diagnostics (JSONL, appended across runs)
+PROBE_TRAIL = os.path.join(_HERE, "bench_probe_trail.jsonl")
 
-def default_shapes(on_accelerator):
-    """Single source of truth for bench shape defaults (CPU fallback uses
-    small shapes so the bench finishes; that number is a floor)."""
+
+def default_shapes(on_accelerator, n_devices=1):
+    """Single source of truth for bench shape defaults.  The CPU
+    fallback runs the full 1000-op history length sharded across the
+    virtual host devices — a smaller batch, but the same shape class as
+    the on-chip run, so vs_baseline comparisons stay apples-to-apples."""
     if on_accelerator:
         return dict(B=16384, L=1000, REPS=3)
-    return dict(B=64, L=200, REPS=1)
+    return dict(B=128 * max(1, n_devices), L=1000, REPS=1)
+
 
 def _emit(payload):
     sys.stdout.write(json.dumps(payload) + "\n")
     sys.stdout.flush()
 
 
-def probe_accelerator(retries=None, timeout_s=None, backoff_s=5):
+def _utcnow():
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+
+
+def probe_accelerator(retries=None, timeout_s=None, backoff_s=None):
     """Shared execute-a-jitted-op probe (jepsen_tpu.platform): hangs
-    can't kill the bench, the same verdict the checker/CLI path uses."""
+    can't kill the bench, the same verdict the checker/CLI path uses.
+    The bench stretches the horizon well past the checker's default —
+    this is a once-per-round artifact, so retrying over ~10-15 minutes
+    (JEPSEN_TPU_BENCH_PROBE_RETRIES × JEPSEN_TPU_PROBE_TIMEOUT plus
+    backoff) beats giving up at 4.5 minutes."""
     from jepsen_tpu.platform import probe_accelerator as _probe
 
+    if retries is None:
+        retries = int(os.environ.get("JEPSEN_TPU_BENCH_PROBE_RETRIES", 6))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("JEPSEN_TPU_BENCH_PROBE_BACKOFF", 20))
     return _probe(retries=retries, timeout_s=timeout_s, backoff_s=backoff_s)
 
 
 def run_bench(on_accelerator, warnings):
+    n_devices = 1
+    if not on_accelerator:
+        # shard the fallback across virtual host devices through the
+        # same mesh path the multichip dryrun validates — an 8-core box
+        # should beat a single-core run ~linearly
+        from jepsen_tpu.platform import force_cpu_platform
+
+        n_devices = int(
+            os.environ.get(
+                "JEPSEN_TPU_BENCH_CPU_DEVICES", min(8, os.cpu_count() or 1)
+            )
+        )
+        force_cpu_platform(n_devices)
+
     import jax
-    import jax.numpy as jnp
 
     from jepsen_tpu import models as m
     from jepsen_tpu import synth
     from jepsen_tpu.ops import dense, encode, wgl
+    from jepsen_tpu.parallel import mesh as mesh_mod
 
-    defaults = default_shapes(on_accelerator)
+    mesh = None
+    if not on_accelerator:
+        devs = jax.devices("cpu")[:n_devices]
+        n_devices = len(devs)
+        if n_devices > 1:
+            mesh = mesh_mod.default_mesh(devs)
+
+    defaults = default_shapes(on_accelerator, n_devices)
     B = int(os.environ.get("JEPSEN_TPU_BENCH_B", defaults["B"]))
+    if mesh is not None and B % n_devices:
+        B = max(n_devices, B - B % n_devices)  # shard evenly
     L = int(os.environ.get("JEPSEN_TPU_BENCH_L", defaults["L"]))
     K = int(os.environ.get("JEPSEN_TPU_BENCH_TEMPLATES", min(32, B)))
     REPS = int(os.environ.get("JEPSEN_TPU_BENCH_REPS", defaults["REPS"]))
@@ -121,9 +176,19 @@ def run_bench(on_accelerator, warnings):
     # arguments (not closed over): closed-over concrete arrays bake into
     # the HLO as constants, and at these shapes the serialized program
     # blows past remote-compile request limits (observed HTTP 413).
-    d_ev = jnp.asarray(ev_slot)
-    d_cs = jnp.asarray(cand_slot)
-    d_cf = jnp.asarray(cand_f)
+    import jax.numpy as jnp
+
+    if mesh is None:
+        d_ev = jnp.asarray(ev_slot)
+        d_cs = jnp.asarray(cand_slot)
+        d_cf = jnp.asarray(cand_f)
+    else:
+        # mesh path: the loop-invariant tensors are sharded over the
+        # hist axis once, here, for the same keep-upload-out-of-the-
+        # timed-loop reason as the single-device path above
+        d_ev, d_cs, d_cf = mesh_mod.shard_batch(
+            mesh, ev_slot, cand_slot, cand_f
+        )
 
     def relabel(seed):
         r = np.random.default_rng(seed)
@@ -131,17 +196,22 @@ def run_bench(on_accelerator, warnings):
         table = np.concatenate([np.zeros((B, 1), np.int16), perm], axis=1)
         a2 = np.take_along_axis(table, base_a.reshape(B, -1), axis=1)
         b2 = np.take_along_axis(table, base_b.reshape(B, -1), axis=1)
-        return (
-            jnp.asarray(table[np.arange(B), init_state].astype(np.int32)),
-            jnp.asarray(a2.reshape(base_a.shape)),
-            jnp.asarray(b2.reshape(base_b.shape)),
-        )
+        init2 = table[np.arange(B), init_state].astype(np.int32)
+        a2 = a2.reshape(base_a.shape)
+        b2 = b2.reshape(base_b.shape)
+        if mesh is None:
+            return (jnp.asarray(init2), jnp.asarray(a2), jnp.asarray(b2))
+        return mesh_mod.shard_batch(mesh, init2, a2, b2)
 
     rep_inputs = [relabel(seed) for seed in range(REPS + 1)]
 
     def run(rep):
         init2, a2, b2 = rep_inputs[rep]
-        ok, _failed, overflow = fn(init2, d_ev, d_cs, d_cf, a2, b2)
+        if mesh is None:
+            ok, _failed, overflow = fn(init2, d_ev, d_cs, d_cf, a2, b2)
+        else:
+            with mesh:
+                ok, _failed, overflow = fn(init2, d_ev, d_cs, d_cf, a2, b2)
         return np.asarray(ok), np.asarray(overflow)
 
     # 3. Warmup (compile) + verdict-consistency check: all non-overflow
@@ -172,6 +242,7 @@ def run_bench(on_accelerator, warnings):
         "slots": C,
         "frontier": FRONTIER,
         "reps": REPS,
+        "n_devices": n_devices,
         "elapsed_s": round(elapsed, 2),
         "overflow_unknown": n_unknown,
         "encode_fallback": n_fallback,
@@ -194,14 +265,29 @@ def run_bench(on_accelerator, warnings):
     return value, L, diag
 
 
+def _persist_artifact(payload, diag):
+    try:
+        with open(ARTIFACT, "w") as f:
+            json.dump({"captured_at": _utcnow(), **payload, "diag": diag}, f)
+            f.write("\n")
+    except OSError as e:
+        print(f"artifact write failed: {e!r}", file=sys.stderr)
+
+
+def _load_artifact():
+    try:
+        with open(ARTIFACT) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def main():
     warnings = []
+    os.environ.setdefault("JEPSEN_TPU_PROBE_TRAIL", PROBE_TRAIL)
     on_accel, probe_err = probe_accelerator()
     if not on_accel:
         warnings.append(f"accelerator unusable ({probe_err}); CPU fallback")
-        from jepsen_tpu.platform import force_cpu_platform
-
-        force_cpu_platform()
 
     L = default_shapes(on_accel)["L"]
     try:
@@ -209,7 +295,7 @@ def main():
         value, L, diag = run_bench(on_accel, warnings)
         # vs_baseline normalizes to 1000-op-equivalent throughput (checker
         # cost is linear in history length — a scan over events), so a
-        # reduced-L CPU fallback is not compared apples-to-oranges
+        # reduced-L fallback is not compared apples-to-oranges
         equiv = value * (L / BASELINE_L)
         payload = {
             "metric": f"cas_register_{L}op_histories_per_sec",
@@ -217,9 +303,17 @@ def main():
             "unit": "histories/sec",
             "vs_baseline": round(equiv / NORTH_STAR, 4),
         }
-        if not on_accel:
+        if on_accel:
+            _persist_artifact(payload, diag)
+        else:
             payload["error"] = warnings[0]
             warnings = warnings[1:]
+            prior = _load_artifact()
+            if prior is not None:
+                # durable evidence from the last live-chip window — the
+                # live value above is the host fallback, this is the
+                # most recent real on-chip measurement
+                payload["onchip_latest"] = prior
         if warnings:
             payload["warnings"] = "; ".join(warnings)
         _emit(payload)
@@ -229,15 +323,17 @@ def main():
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        _emit(
-            {
-                "metric": f"cas_register_{L}op_histories_per_sec",
-                "value": 0.0,
-                "unit": "histories/sec",
-                "vs_baseline": 0.0,
-                "error": "; ".join(warnings + [repr(e)[:300]]),
-            }
-        )
+        payload = {
+            "metric": f"cas_register_{L}op_histories_per_sec",
+            "value": 0.0,
+            "unit": "histories/sec",
+            "vs_baseline": 0.0,
+            "error": "; ".join(warnings + [repr(e)[:300]]),
+        }
+        prior = _load_artifact()
+        if prior is not None:
+            payload["onchip_latest"] = prior
+        _emit(payload)
 
 
 if __name__ == "__main__":

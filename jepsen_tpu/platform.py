@@ -88,6 +88,7 @@ def probe_accelerator(
             timeout_s = float(os.environ.get("JEPSEN_TPU_PROBE_TIMEOUT", 90))
         err = None
         for attempt in range(retries):
+            t0 = time.time()
             try:
                 r = subprocess.run(
                     [sys.executable, "-c", _PROBE_SRC],
@@ -96,9 +97,11 @@ def probe_accelerator(
                     text=True,
                 )
                 if r.returncode == 0:
+                    _trail(attempt, "ok", time.time() - t0)
                     _accelerator_ok, _accelerator_error = True, None
                     return True, None
                 if r.returncode == 3:
+                    _trail(attempt, "no-accelerator", time.time() - t0)
                     _accelerator_ok = False
                     _accelerator_error = "no accelerator device present"
                     return False, _accelerator_error
@@ -108,10 +111,42 @@ def probe_accelerator(
                 err = f"backend init timed out after {timeout_s:g}s"
             except Exception as e:  # noqa: BLE001 — must never raise
                 err = repr(e)[:300]
+            _trail(attempt, err, time.time() - t0)
             if attempt < retries - 1:
                 time.sleep(backoff_s * (attempt + 1))
         _accelerator_ok, _accelerator_error = False, err or "probe never ran"
         return False, _accelerator_error
+
+
+def _trail(attempt: int, outcome: str, elapsed_s: float) -> None:
+    """Append one probe-attempt record to the JSONL diagnostics trail
+    (JEPSEN_TPU_PROBE_TRAIL=path to enable).  The bench points this at
+    a per-round file so a wedged-tunnel round leaves evidence of every
+    attempt, not one terse error string."""
+    path = os.environ.get("JEPSEN_TPU_PROBE_TRAIL")
+    if not path:
+        return
+    try:
+        import datetime
+        import json
+
+        with open(path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "ts": datetime.datetime.now(
+                            datetime.timezone.utc
+                        ).isoformat(timespec="seconds"),
+                        "attempt": attempt,
+                        "outcome": str(outcome)[:300],
+                        "elapsed_s": round(elapsed_s, 1),
+                        "pid": os.getpid(),
+                    }
+                )
+                + "\n"
+            )
+    except OSError:
+        pass
 
 
 def accelerator_usable(timeout_s: Optional[float] = None) -> bool:
