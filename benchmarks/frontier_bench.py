@@ -1,0 +1,246 @@
+"""Microbenchmark: the generic frontier kernel outside the dense envelope.
+
+The flagship bench (bench.py) always lands on the dense subset-automaton
+kernel — its envelope (C ≤ 12 open-op slots, small value domains,
+ops/dense.py) covers the default register workloads.  Real tests can
+drift outside it: "3n" concurrency on 5 nodes is 15 worker threads, and
+a multi-register model's (register, value) domain outgrows the dense
+state space quickly.  Those shapes run the generic sort-compacted
+frontier kernel (ops/wgl.py), whose throughput this script measures:
+
+- cas-register at peak concurrency C ∈ {8, 16, 32}, frontier capacity
+  F ∈ {64, 128}, forced through make_check_fn (no dense dispatch);
+- the dense kernel at the same C (where applicable) for the crossover;
+- a multi-register arm (the model the per-key independent lift feeds).
+
+Prints one human table and writes ``benchmarks/frontier_results.json``.
+Overflow ("unknown") shares are reported per config: a high overflow
+rate means that config's effective throughput is oracle-bound no matter
+how fast the kernel runs (wgl.check_batch reruns overflows on CPU).
+
+Run: python benchmarks/frontier_bench.py          # real device if alive
+     JEPSEN_TPU_FRONTIER_B=256 ... for a quicker pass
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+RESULTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "frontier_results.json"
+)
+
+
+def _batch_arrays(hists, model, slot_cap):
+    from jepsen_tpu.ops import encode
+
+    batch = encode.batch_encode(hists, model, slot_cap=slot_cap)
+    assert batch.init_state.shape[0] > 0, "nothing encodable"
+    return batch
+
+
+def _expand(batch, B, rng):
+    idx = rng.integers(0, batch.init_state.shape[0], size=B)
+    return tuple(
+        a[idx]
+        for a in (
+            batch.init_state,
+            batch.ev_slot,
+            batch.cand_slot,
+            batch.cand_f,
+            batch.cand_a,
+            batch.cand_b,
+        )
+    )
+
+
+def _time_fn(fn, arrays, reps):
+    import jax.numpy as jnp
+
+    dev = tuple(jnp.asarray(a) for a in arrays)
+    ok, _failed, ovf = fn(*dev)  # warm/compile
+    np.asarray(ok)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ok, _failed, ovf = fn(*dev)
+        ok_h = np.asarray(ok)
+    dt = (time.perf_counter() - t0) / reps
+    return dt, ok_h, np.asarray(ovf)
+
+
+#: (n_procs, history_ops, frontier_caps, batch) — long histories only at
+#: low concurrency (the frontier state space explodes past that; the
+#: realistic frontier workload is short per-key subhistories, the shape
+#: jepsen.independent + per-key-limit produce on purpose — SURVEY.md §5
+#: long-history scaling, linearizable_register.clj:40-52)
+CAS_SHAPES = (
+    (8, 1000, (64, 128), 1024),
+    (8, 100, (64, 256), 1024),
+    (16, 50, (64, 256), 1024),
+    (32, 30, (64, 256), 512),
+)
+
+
+def cas_register_arm(results, reps):
+    """cas-register at rising peak concurrency; frontier vs dense."""
+    import jax
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import encode, wgl
+
+    rng = np.random.default_rng(45100)
+    for n_procs, L, Fs, B in CAS_SHAPES:
+        py_rng = random.Random(45100 + n_procs)
+        hists = [
+            synth.generate_history(
+                py_rng,
+                n_procs=n_procs,
+                n_ops=L,
+                crash_p=0.001,
+                corrupt=(i % 4 == 0),
+            )
+            for i in range(16)
+        ]
+        model = m.cas_register(0)
+        batch = _batch_arrays(hists, model, slot_cap=n_procs)
+        E = batch.ev_slot.shape[1]
+        C = batch.cand_slot.shape[2]
+        arrays = _expand(batch, B, rng)
+        vmax = int(
+            max(arrays[0].max(), arrays[4].max(), arrays[5].max())
+        )
+        for F in Fs:
+            fn = wgl.make_check_fn("cas-register", E, C, F, C + 1)
+            dt, ok, ovf = _time_fn(fn, arrays, reps)
+            row = {
+                "arm": "cas-register",
+                "kernel": "frontier",
+                "C": C,
+                "F": F,
+                "L": L,
+                "B": B,
+                "events": E,
+                "hps": round(B / dt, 1),
+                "overflow_rate": round(float(ovf.mean()), 4),
+                "invalid": int((~ok).sum()),
+                "platform": jax.devices()[0].platform,
+            }
+            results.append(row)
+            print(
+                f"cas-register C={C:<3} L={L:<5} F={F:<4} frontier: "
+                f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
+            )
+        if wgl.kernel_choice("cas-register", C, vmax + 1) == "dense":
+            from jepsen_tpu.ops import dense
+
+            V = encode.round_up(vmax + 1, 4)
+            fn = dense.make_dense_fn("cas-register", E, C, V)
+            dt, ok, ovf = _time_fn(fn, arrays, reps)
+            row = {
+                "arm": "cas-register",
+                "kernel": "dense",
+                "C": C,
+                "F": None,
+                "L": L,
+                "B": B,
+                "events": E,
+                "hps": round(B / dt, 1),
+                "overflow_rate": 0.0,
+                "invalid": int((~ok).sum()),
+                "platform": jax.devices()[0].platform,
+            }
+            results.append(row)
+            print(
+                f"cas-register C={C:<3} L={L:<5} dense:        "
+                f"{row['hps']:>10,.0f} h/s"
+            )
+
+
+def multi_register_arm(results, B, reps):
+    """Multi-register transactions — the model independent-key lifts
+    feed; its (register, value) ids outgrow the dense envelope, so this
+    is a frontier-kernel workload in practice."""
+    import jax
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import wgl
+
+    rng = np.random.default_rng(45100)
+    py_rng = random.Random(45100)
+    n_keys, L = 3, 200
+    hists = [
+        synth.generate_mr_history(
+            py_rng,
+            n_procs=5,
+            n_ops=L,
+            n_keys=n_keys,
+            crash_p=0.01,
+            corrupt=(i % 4 == 0),
+        )
+        for i in range(16)
+    ]
+    model = m.multi_register({k: 0 for k in range(n_keys)})
+    batch = _batch_arrays(hists, model, slot_cap=8)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    arrays = _expand(batch, B, rng)
+    vmax = int(max(arrays[0].max(), arrays[4].max(), arrays[5].max()))
+    choice = wgl.kernel_choice("multi-register", C, vmax + 1)
+    for F in (64, 128):
+        fn = wgl.make_check_fn("multi-register", E, C, F, C + 1)
+        dt, ok, ovf = _time_fn(fn, arrays, reps)
+        row = {
+            "arm": "multi-register",
+            "kernel": "frontier",
+            "C": C,
+            "F": F,
+            "L": L,
+            "B": B,
+            "events": E,
+            "auto_choice": choice,
+            "hps": round(B / dt, 1),
+            "overflow_rate": round(float(ovf.mean()), 4),
+            "invalid": int((~ok).sum()),
+            "platform": jax.devices()[0].platform,
+        }
+        results.append(row)
+        print(
+            f"multi-register C={C:<3} F={F:<4} frontier: "
+            f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
+            f"  (auto kernel_choice: {choice})"
+        )
+
+
+def main():
+    from jepsen_tpu.platform import ensure_usable_backend
+
+    ensure_usable_backend()
+    reps = int(os.environ.get("JEPSEN_TPU_FRONTIER_REPS", 1))
+    B = int(os.environ.get("JEPSEN_TPU_FRONTIER_B", 1024))
+    results = []
+    cas_register_arm(results, reps)
+    multi_register_arm(results, B, reps)
+    import datetime
+
+    payload = {
+        "measured_at": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+        "results": results,
+    }
+    with open(RESULTS_PATH, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {RESULTS_PATH}")
+
+
+if __name__ == "__main__":
+    main()

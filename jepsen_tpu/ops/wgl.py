@@ -237,6 +237,22 @@ def make_check_fn(spec_name: str, E: int, C: int, F: int, max_closure: int):
     return jax.jit(build_batched(spec_name, E, C, F, max_closure))
 
 
+def kernel_choice(spec_name: str, C: int, n_values: Optional[int]) -> str:
+    """Which kernel make_best_check_fn would pick for this shape —
+    "dense" (subset automaton, no sorts, no overflow) or "frontier"
+    (generic sort-compacted search).  Callers report this so a workload
+    silently drifting outside the dense envelope (e.g. "3n" concurrency
+    pushing peak open ops past its slot cap) is visible in stats rather
+    than a mystery slowdown."""
+    from . import dense as dense_mod
+
+    if n_values is not None:
+        V = encode_mod.round_up(n_values, 4)
+        if dense_mod.applicable(spec_name, C, V):
+            return "dense"
+    return "frontier"
+
+
 def make_best_check_fn(
     spec_name: str,
     E: int,
@@ -251,10 +267,9 @@ def make_best_check_fn(
     ``n_values`` is the exclusive upper bound on value ids (init/a/b)."""
     from . import dense as dense_mod
 
-    if n_values is not None:
+    if kernel_choice(spec_name, C, n_values) == "dense":
         V = encode_mod.round_up(n_values, 4)
-        if dense_mod.applicable(spec_name, C, V):
-            return dense_mod.make_dense_fn(spec_name, E, C, V)
+        return dense_mod.make_dense_fn(spec_name, E, C, V)
     return make_check_fn(spec_name, E, C, F, max_closure)
 
 
@@ -331,10 +346,12 @@ def check_batch(
         )
         if max_closure is None:
             fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
+            kernel = kernel_choice(spec.name, C, n_values)
         else:
             # an explicit closure cap asks for the generic kernel's
             # truncation semantics; the dense kernel has no such cap
             fn = make_check_fn(spec.name, E, C, frontier, mc)
+            kernel = "frontier"
         # np.array (not asarray): jax outputs are read-only views and the
         # escalation pass writes back into these
         ok, failed_at, overflow = (
@@ -376,11 +393,16 @@ def check_batch(
                 )
                 results[hist_idx]["engine"] = "oracle-overflow"
             elif ok[row]:
-                results[hist_idx] = {"valid?": True, "engine": "tpu"}
+                results[hist_idx] = {
+                    "valid?": True,
+                    "engine": "tpu",
+                    "kernel": kernel,
+                }
             else:
                 results[hist_idx] = {
                     "valid?": False,
                     "engine": "tpu",
+                    "kernel": kernel,
                     "failed-event": int(failed_at[row]),
                 }
 
@@ -401,11 +423,16 @@ def batch_stats(results: Sequence[dict]) -> dict:
     rest on (an "unknown"-heavy batch is oracle-bound regardless of
     kernel speed)."""
     counts: dict = {}
+    kernels: dict = {}
     for r in results:
         counts[r.get("engine", "?")] = counts.get(r.get("engine", "?"), 0) + 1
+        if r.get("engine") == "tpu":
+            k = r.get("kernel", "?")
+            kernels[k] = kernels.get(k, 0) + 1
     n = max(1, len(results))
     return {
         "engines": counts,
+        "kernels": kernels,
         "device-rate": counts.get("tpu", 0) / n,
         "oracle-rate": sum(
             v for k, v in counts.items() if k.startswith("oracle")

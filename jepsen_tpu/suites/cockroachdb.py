@@ -91,16 +91,17 @@ WORKLOADS = ("register", "bank", "set", "list-append")
 
 def workloads(opts: Optional[dict] = None) -> dict:
     from ..workloads import adya
-    from . import comments, monotonic, sequential
+    from . import comments, crdb_sets, monotonic, sequential
 
     opts = _opts(opts)
     out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
     # suite-specific probes (reference: cockroach/monotonic.clj,
-    # sequential.clj, comments.clj, adya.clj)
+    # sequential.clj, comments.clj, adya.clj, sets.clj)
     out["monotonic"] = monotonic.workload(opts)
     out["sequential"] = sequential.workload(opts)
     out["comments"] = comments.workload(opts)
     out["g2"] = adya.workload(opts)
+    out["sets"] = crdb_sets.workload(opts)
     return out
 
 
@@ -115,6 +116,10 @@ def _client_for(wname: str, opts: dict):
         return comments.CommentsClient(opts)
     if wname == "g2":
         return g2_sql.G2Client(opts)
+    if wname == "sets":
+        # cockroach's SetsClient shape == the shared SQL set client
+        # (sets.clj:96-131); only the checker differs
+        return sql.client_for("set", opts)
     return sql.client_for(wname, opts)
 
 

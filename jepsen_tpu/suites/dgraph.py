@@ -222,11 +222,18 @@ class DgraphSetClient(DgraphClient):
 
 def workloads(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
+    from ..workloads import bank as bank_wl
+
     return {
         "register": common.register_workload(opts),
         "set": common.set_workload(opts),
         "upsert": upsert_workload(opts),
         "delete": delete_workload(opts),
+        # flagship probes (reference: dgraph/bank.clj, wr.clj,
+        # long_fork.clj)
+        "bank": bank_wl.test(opts),
+        "wr": common.generic_workload("rw-register", opts),
+        "long-fork": common.generic_workload("long-fork", opts),
     }
 
 
@@ -238,6 +245,9 @@ def test(opts: Optional[dict] = None) -> dict:
         "set": DgraphSetClient,
         "upsert": DgraphUpsertClient,
         "delete": DgraphDeleteClient,
+        "bank": DgraphBankClient,
+        "wr": DgraphTxnClient,
+        "long-fork": DgraphTxnClient,
     }.get(wname, DgraphClient)(opts)
     return common.build_test(
         f"dgraph-{wname}", opts, db=DgraphDB(opts), client=c, workload=w,
@@ -438,3 +448,298 @@ def delete_workload(opts: Optional[dict] = None) -> dict:
         "checker": independent.checker(DeleteChecker()),
         "concurrency": 4 * n,
     }
+
+
+# ---------------------------------------------------------------------
+# Multi-op transactions over the HTTP txn protocol
+# ---------------------------------------------------------------------
+
+
+class TxnAborted(Exception):
+    """Commit-time conflict — the TxnConflictException of the HTTP API
+    (reference: dgraph/client.clj catches io.dgraph.TxnConflictException
+    and fails the op; bank.clj imports it at :12)."""
+
+
+class _DgraphTxn:
+    """One read-modify-write transaction: queries and mutations carry a
+    shared startTs; /commit applies them atomically or aborts.  This is
+    Dgraph's native HTTP transaction flow (the gRPC client the reference
+    uses does the same under the hood: begin ts from the first response,
+    staged mutations, commit with the accumulated keys/preds)."""
+
+    def __init__(self, conn: JsonHttpClient):
+        self.conn = conn
+        self.start_ts = 0
+        self.keys: list = []
+        self.preds: list = []
+
+    def _merge_txn(self, body: dict) -> None:
+        txn = (body or {}).get("extensions", {}).get("txn", {})
+        if txn.get("start_ts"):
+            self.start_ts = txn["start_ts"]
+        self.keys += txn.get("keys", [])
+        self.preds += txn.get("preds", [])
+
+    def query(self, q: str) -> dict:
+        path = "/query"
+        if self.start_ts:
+            path += f"?startTs={self.start_ts}"
+        _, body = self.conn.post(
+            path, q, headers={"Content-Type": "application/graphql+-"},
+            ok=(200,),
+        )
+        if "errors" in (body or {}):
+            raise HttpError(200, body["errors"])
+        self._merge_txn(body)
+        return body.get("data", {})
+
+    def mutate(self, set_nquads: str = "", del_nquads: str = "") -> dict:
+        path = "/mutate"
+        if self.start_ts:
+            path += f"?startTs={self.start_ts}"
+        payload: dict = {}
+        if set_nquads:
+            payload["set_nquads"] = set_nquads
+        if del_nquads:
+            payload["del_nquads"] = del_nquads
+        _, body = self.conn.post(
+            path, json.dumps(payload),
+            headers={"Content-Type": "application/json"}, ok=(200,),
+        )
+        if "errors" in (body or {}):
+            raise HttpError(200, body["errors"])
+        self._merge_txn(body)
+        return body
+
+    def commit(self) -> None:
+        status, body = self.conn.request(
+            "POST",
+            f"/commit?startTs={self.start_ts}",
+            body={"keys": self.keys, "preds": self.preds},
+            ok=(200,),
+            raise_on_error=False,
+        )
+        if status == 409 or "errors" in (body or {}):
+            raise TxnAborted(str(body))
+
+
+# ---------------------------------------------------------------------
+# bank workload (reference: dgraph/src/jepsen/dgraph/bank.clj:1-199)
+# ---------------------------------------------------------------------
+
+PRED_COUNT = 7  # (reference: bank.clj:15-16)
+
+
+def gen_pred(prefix: str, k: int) -> str:
+    """Key-striped predicate name (reference: client.clj gen-pred,
+    consumed at bank.clj:63-66)."""
+    return f"{prefix}_{int(k) % PRED_COUNT}"
+
+
+def gen_preds(prefix: str) -> list:
+    return [f"{prefix}_{i}" for i in range(PRED_COUNT)]
+
+
+BANK_SCHEMA = "\n".join(
+    f"{p}: int @index(int) .\n" for p in gen_preds("key") + gen_preds("amount")
+) + "\n".join(f"{p}: string @index(exact) .\n" for p in gen_preds("type"))
+
+
+class DgraphBankClient(DgraphClient):
+    """Transfers as read-modify-write transactions over key-striped
+    predicates; commit conflicts fail the op.
+
+    Reference: dgraph/bank.clj — striped preds (:15-16, gen-pred via
+    client.clj), read-accounts merging per-type-predicate queries
+    (:36-57), find-account by key (:59-80), write-account! deleting
+    zero-amount nodes (:82-103), transfer as one txn (:105-140)."""
+
+    def setup(self, test):
+        try:
+            self.conn.post("/alter", BANK_SCHEMA, ok=(200,))
+        except (HttpError, IndeterminateError):
+            pass
+        accounts = list(test.get("accounts", range(8)))
+        total = int(test.get("total-amount", 100))
+        if not accounts:
+            return
+        k = accounts[0]
+        try:
+            self._upsert(
+                f'{{ q(func: eq({gen_pred("key", k)}, {int(k)})) '
+                "{ u as uid } }",
+                [{"cond": "@if(eq(len(u), 0))",
+                  "set_nquads": (
+                      f'_:a <{gen_pred("key", k)}> "{int(k)}" .\n'
+                      f'_:a <{gen_pred("amount", k)}> "{total}" .\n'
+                      f'_:a <{gen_pred("type", k)}> "account" .'
+                  )}],
+            )
+        except (HttpError, IndeterminateError):
+            pass
+
+    def _find_account(self, txn: _DgraphTxn, k: int) -> dict:
+        """(reference: bank.clj:59-80 find-account)"""
+        kp, ap = gen_pred("key", k), gen_pred("amount", k)
+        data = txn.query(
+            f"{{ q(func: eq({kp}, {int(k)})) {{ uid {kp} {ap} }} }}"
+        )
+        rows = data.get("q", [])
+        if rows:
+            r = rows[0]
+            return {"uid": r["uid"], "key": k,
+                    "amount": int(r.get(ap) or 0)}
+        return {"uid": None, "key": k, "amount": 0}
+
+    def _write_account(self, txn: _DgraphTxn, acct: dict) -> None:
+        """(reference: bank.clj:82-103 write-account!)"""
+        k = acct["key"]
+        kp, ap, tp = (
+            gen_pred("key", k), gen_pred("amount", k), gen_pred("type", k)
+        )
+        if acct["uid"] is None:
+            txn.mutate(set_nquads=(
+                f'_:a <{kp}> "{int(k)}" .\n'
+                f'_:a <{ap}> "{acct["amount"]}" .\n'
+                f'_:a <{tp}> "account" .'
+            ))
+        elif acct["amount"] == 0:
+            txn.mutate(del_nquads=f'<{acct["uid"]}> * * .')
+        else:
+            txn.mutate(set_nquads=(
+                f'<{acct["uid"]}> <{ap}> "{acct["amount"]}" .'
+            ))
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                txn = _DgraphTxn(self.conn)
+                out: dict = {}
+                for tp in gen_preds("type"):
+                    fields = " ".join(
+                        gen_preds("key") + gen_preds("amount")
+                    )
+                    data = txn.query(
+                        f'{{ q(func: eq({tp}, "account")) {{ {fields} }} }}'
+                    )
+                    for row in data.get("q", []):
+                        key = amount = None
+                        for pred, value in row.items():
+                            if value is None:
+                                continue
+                            if pred.startswith("key_"):
+                                key = int(value)
+                            elif pred.startswith("amount_"):
+                                amount = int(value)
+                        if key is not None:
+                            out[key] = amount
+                # commit the read-only txn: validates the read set, so a
+                # transfer landing between the per-predicate scans
+                # aborts this read instead of yielding a torn total
+                txn.commit()
+                return {**op, "type": "ok", "value": out}
+            if op["f"] == "transfer":
+                frm = int(op["value"]["from"])
+                to = int(op["value"]["to"])
+                amt = int(op["value"]["amount"])
+                txn = _DgraphTxn(self.conn)
+                a = self._find_account(txn, frm)
+                b = self._find_account(txn, to)
+                a2 = {**a, "amount": a["amount"] - amt}
+                b2 = {**b, "amount": b["amount"] + amt}
+                if a2["amount"] < 0 and not test.get("negative-balances?"):
+                    return {**op, "type": "fail",
+                            "error": "insufficient funds"}
+                self._write_account(txn, a2)
+                self._write_account(txn, b2)
+                txn.commit()
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except TxnAborted as e:
+            return {**op, "type": "fail", "error": f"conflict: {e}"}
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+# ---------------------------------------------------------------------
+# wr (rw-register) + long-fork txn client
+# (reference: dgraph/src/jepsen/dgraph/wr.clj:1-32, long_fork.clj)
+# ---------------------------------------------------------------------
+
+WR_SCHEMA = (
+    "key: int @index(int) @upsert .\n"
+    "value: int .\n"
+)
+
+
+class DgraphTxnClient(DgraphClient):
+    """Micro-op transactions ([f k v] lists) through one Dgraph txn:
+    reads by key index, writes upserting value nodes; commit conflicts
+    fail the whole txn.  Serves the wr (Elle rw-register) and long-fork
+    workloads.  (reference: wr.clj:13-27 — mop execution in one
+    (c/with-txn), conflicts → :fail via client.clj)"""
+
+    def setup(self, test):
+        try:
+            self.conn.post("/alter", WR_SCHEMA, ok=(200,))
+        except (HttpError, IndeterminateError):
+            pass
+
+    def _mop(self, txn: _DgraphTxn, local: dict, f, k, v):
+        if f == "r":
+            # read-your-writes inside the txn: the gRPC client's staged
+            # mutations are visible to its own queries; the HTTP staging
+            # is not, so mirror it client-side
+            if k in local:
+                return ["r", k, local[k]]
+            data = txn.query(
+                f"{{ q(func: eq(key, {int(k)})) {{ value }} }}"
+            )
+            rows = data.get("q", [])
+            val = int(rows[0]["value"]) if rows and rows[0].get("value") is not None else None
+            return ["r", k, val]
+        if f == "w":
+            # a second write to the same key in this txn must hit the
+            # node staged by the first, not create a duplicate: the
+            # committed store has no row yet, so consult the txn-local
+            # uid map before querying (staged blank-node uids come back
+            # in the mutate response's data.uids)
+            uid = local.get(("uid", k))
+            if uid is None:
+                data = txn.query(
+                    f"{{ q(func: eq(key, {int(k)})) {{ uid }} }}"
+                )
+                rows = data.get("q", [])
+                uid = rows[0]["uid"] if rows else None
+            if uid is not None:
+                txn.mutate(set_nquads=(
+                    f'<{uid}> <value> "{int(v)}" .'
+                ))
+            else:
+                body = txn.mutate(set_nquads=(
+                    f'_:n <key> "{int(k)}" .\n_:n <value> "{int(v)}" .'
+                ))
+                uid = (body.get("data", {}).get("uids") or {}).get("n")
+            if uid is not None:
+                local[("uid", k)] = uid
+            local[k] = v
+            return ["w", k, v]
+        raise ValueError(f"unknown micro-op {f!r}")
+
+    def invoke(self, test, op):
+        txn_value = op["value"]
+        try:
+            txn = _DgraphTxn(self.conn)
+            local: dict = {}
+            out = [self._mop(txn, local, f, k, v) for f, k, v in txn_value]
+            txn.commit()
+            return {**op, "type": "ok", "value": out}
+        except TxnAborted as e:
+            return {**op, "type": "fail", "error": f"conflict: {e}"}
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}

@@ -14,6 +14,8 @@ from __future__ import annotations
 from typing import Optional
 
 from .. import db as db_mod
+from .. import generator as gen_mod_base
+from ..checker import Checker
 from ..control import util as cu
 from ..control import execute, sudo
 from . import common, sql
@@ -134,7 +136,25 @@ WORKLOADS = ("register", "bank", "set", "list-append", "long-fork")
 
 def workloads(opts: Optional[dict] = None) -> dict:
     opts = _opts(opts)
-    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    # suite-specific probes (reference: tidb/txn.clj, table.clj)
+    out["txn"] = common.generic_workload("rw-register", opts)
+    out["table"] = table_workload(opts)
+    return out
+
+
+def _client_for(wname: str, opts: dict):
+    if wname == "txn":
+        return TidbTxnClient(opts)
+    if wname == "list-append":
+        # the reference serves append through the striped txn client
+        # (txn.clj:41-49); val must be a string column for CONCAT
+        return TidbTxnClient({**opts, "val-type": "text"})
+    if wname == "table":
+        return TableClient(opts)
+    return sql.client_for(
+        wname if wname in sql.CLIENTS else "register", opts
+    )
 
 
 def test(opts: Optional[dict] = None) -> dict:
@@ -143,7 +163,209 @@ def test(opts: Optional[dict] = None) -> dict:
     w = workloads(opts)[wname]
     return common.build_test(
         f"tidb-{wname}", opts, db=TiDB(opts),
-        client=sql.client_for(
-            wname if wname in sql.CLIENTS else "register", opts),
+        client=_client_for(wname, opts),
         workload=w,
     )
+
+
+# ---------------------------------------------------------------------
+# Striped transactional client (reference: tidb/src/tidb/txn.clj:1-92)
+# ---------------------------------------------------------------------
+
+TXN_TABLE_COUNT = 7  # (reference: txn.clj:92 table-count default)
+
+
+class TidbTxnClient(sql._Base):
+    """Micro-op transactions striped over ``txn0``..``txnN`` tables with
+    a secondary ``sk`` column, serving the wr (rw-register) and
+    list-append workloads.
+
+    Reference: tidb/src/tidb/txn.clj — table-for striping by key hash
+    (:13-16), mop! executing r (by id, or sk under use-index /
+    predicate-read, with an optional read-lock suffix) / w (upsert) /
+    append (CONCAT upsert) (:18-49), single-mop transactions skipping
+    BEGIN (:58-66), and the (sk, val) index under use-index (:55-57).
+    """
+
+    dialect = "mysql"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.table_count = int(self.opts.get("table-count", TXN_TABLE_COUNT))
+        self.val_type = self.opts.get("val-type", "int")
+        self.use_index = bool(self.opts.get("use-index"))
+        self.read_lock = self.opts.get("read-lock", "")
+
+    def table_for(self, k) -> str:
+        return f"txn{hash(k) % self.table_count}"
+
+    def setup(self, test):
+        for i in range(self.table_count):
+            self._exec_ddl(
+                f"CREATE TABLE IF NOT EXISTS txn{i} "
+                "(id INT NOT NULL PRIMARY KEY, sk INT NOT NULL, "
+                f"val {self.val_type})"
+            )
+            if self.use_index:
+                self._exec_ddl(
+                    f"CREATE INDEX txn{i}_sk_val ON txn{i} (sk, val)"
+                )
+
+    def _mop(self, f, k, v):
+        t = self.table_for(k)
+        if f == "r":
+            col = "sk" if self.use_index else "id"
+            lock = f" {self.read_lock}" if self.read_lock else ""
+            res = self.conn.query(
+                f"SELECT val FROM {t} WHERE {col} = {int(k)}{lock}"
+            )
+            raw = res.rows[0][0] if res.rows else None
+            if self.val_type == "int":
+                return ["r", k, None if raw is None else int(raw)]
+            vals = [int(x) for x in str(raw).split(",") if x != ""] if raw else []
+            return ["r", k, vals]
+        if f == "w":
+            self.conn.query(
+                f"INSERT INTO {t} (id, sk, val) "
+                f"VALUES ({int(k)}, {int(k)}, {int(v)}) "
+                f"ON DUPLICATE KEY UPDATE val = {int(v)}"
+            )
+            return ["w", k, v]
+        if f == "append":
+            self.conn.query(
+                f"INSERT INTO {t} (id, sk, val) "
+                f"VALUES ({int(k)}, {int(k)}, '{int(v)}') "
+                f"ON DUPLICATE KEY UPDATE val = CONCAT(val, ',', '{int(v)}')"
+            )
+            return ["append", k, v]
+        raise ValueError(f"unknown micro-op {f!r}")
+
+    def invoke(self, test, op):
+        txn = op["value"]
+        use_txn = len(txn) > 1
+        try:
+            if use_txn:
+                self.conn.query("BEGIN")
+            try:
+                out = [self._mop(f, k, v) for f, k, v in txn]
+                if use_txn:
+                    self.conn.query("COMMIT")
+                return {**op, "type": "ok", "value": out}
+            except (sql.PgError, sql.MysqlError) as e:
+                if use_txn:
+                    try:
+                        self.conn.query("ROLLBACK")
+                    except Exception:
+                        pass
+                return self._fail(op, e)
+        except sql.IndeterminateError as e:
+            return self._info(op, e)
+
+
+# ---------------------------------------------------------------------
+# Table-creation workload (reference: tidb/src/tidb/table.clj)
+# ---------------------------------------------------------------------
+
+
+class TableClient(sql._Base):
+    """create-table / insert racing DDL visibility: inserting into a
+    table whose creation was acknowledged must never fail with
+    "doesn't exist".  (reference: table.clj:16-51 TableClient)"""
+
+    dialect = "mysql"
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "create-table":
+                self.conn.query(
+                    f"CREATE TABLE IF NOT EXISTS t{int(op['value'])} "
+                    "(id INT NOT NULL PRIMARY KEY, val INT)"
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "insert":
+                table, k = op["value"]
+                try:
+                    self.conn.query(
+                        f"INSERT INTO t{int(table)} (id) VALUES ({int(k)})"
+                    )
+                    return {**op, "type": "ok"}
+                except (sql.PgError, sql.MysqlError) as e:
+                    msg = str(e)
+                    if "doesn't exist" in msg or "no such table" in msg:
+                        return {**op, "type": "fail",
+                                "error": "doesn't-exist"}
+                    if "Duplicate" in msg or "UNIQUE" in msg:
+                        return {**op, "type": "fail",
+                                "error": "duplicate-key"}
+                    raise
+            raise ValueError(f"unknown f {op['f']!r}")
+        except sql.IndeterminateError as e:
+            return self._info(op, e)
+        except (sql.PgError, sql.MysqlError) as e:
+            return self._fail(op, e)
+
+
+class _TableGen(gen_mod_base.Generator):
+    """80% insert into the last *acknowledged* table, else create the
+    next one; acks tracked through generator update events — the same
+    bookkeeping the reference keeps in a shared atom
+    (table.clj:60-68 generator, :28-33 ack in invoke!)."""
+
+    def __init__(self):
+        self.last_created = None
+        self.next_create = 0
+        self.next_insert = 0
+
+    def op(self, test, ctx):
+        from .. import generator as gen_mod
+
+        if self.last_created is not None and gen_mod.rng.random() < 0.8:
+            # distinct ids per insert (the reference's fixed id 0 makes
+            # every insert after the first a duplicate-key failure;
+            # fresh ids keep the DDL-visibility race exercised all run
+            # and the stats checker meaningful)
+            self.next_insert += 1
+            return (
+                gen_mod.fill_in_op(
+                    {"f": "insert",
+                     "value": [self.last_created, self.next_insert]}, ctx
+                ),
+                self,
+            )
+        self.next_create += 1
+        return (
+            gen_mod.fill_in_op(
+                {"f": "create-table", "value": self.next_create}, ctx
+            ),
+            self,
+        )
+
+    def update(self, test, ctx, event):
+        if (
+            event.get("type") == "ok"
+            and event.get("f") == "create-table"
+        ):
+            v = event.get("value")
+            if self.last_created is None or v > self.last_created:
+                self.last_created = v
+        return self
+
+
+class TableChecker(Checker):
+    """No insert may fail with doesn't-exist.  (reference:
+    table.clj:69-77 checker)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import FAIL
+
+        bad = [
+            {"op-index": op.index, "value": op.value}
+            for op in history
+            if op.type == FAIL and op.error == "doesn't-exist"
+        ]
+        return {"valid?": not bad, "errors": bad[:10]}
+
+
+def table_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: table.clj:79-84 workload)"""
+    return {"generator": _TableGen(), "checker": TableChecker()}

@@ -307,20 +307,38 @@ class YcqlCounterClient(client_mod.Client):
 
 def workloads(opts: Optional[dict] = None) -> dict:
     """ycql.* and ysql.* workload names, like the reference's
-    workload-per-API naming (runner.clj)."""
+    workload-per-API naming (runner.clj).  single-key-acid is the
+    reference's name for the per-key linearizable CAS-register probe
+    (single_key_acid.clj:1-45: 2n-thread key groups, half writers/CAS,
+    half readers) — the same shape as the register workload, exposed
+    under both names so reference users find it."""
     opts = dict(opts or {})
     out = {}
     for w in ("register", "set", "counter"):
         out[f"ycql.{w}"] = common.generic_workload(w, opts)
     for w in ("register", "bank", "set", "list-append", "long-fork"):
         out[f"ysql.{w}"] = common.generic_workload(w, _ysql_opts(opts))
+    out["ycql.single-key-acid"] = common.generic_workload("register", opts)
+    out["ysql.single-key-acid"] = common.generic_workload(
+        "register", _ysql_opts(opts)
+    )
+    # the CQL transfer is unconditional balance arithmetic (no read
+    # inside the txn), so balances legitimately go negative — the
+    # reference pairs it with the allow-negative bank workload
+    # (yugabyte/bank.clj:13-14 workload-allow-neg, bank.clj:183)
+    out["ycql.bank"] = common.generic_workload(
+        "bank", {**opts, "negative-balances?": True}
+    )
+    out["ycql.long-fork"] = common.generic_workload("long-fork", opts)
     out["ysql.multi-key-acid"] = multi_key_acid_workload(opts)
     out["ycql.multi-key-acid"] = multi_key_acid_workload(opts)
+    out["ysql.default-value"] = default_value_workload(opts)
     return out
 
 
 _YCQL_CLIENTS = {
     "register": YcqlRegisterClient,
+    "single-key-acid": YcqlRegisterClient,
     "set": YcqlSetClient,
     "counter": YcqlCounterClient,
 }
@@ -331,9 +349,17 @@ def _client_for(wname: str, opts: dict) -> client_mod.Client:
     if api == "ycql":
         if w == "multi-key-acid":
             return YcqlMultiKeyAcidClient(opts)
+        if w == "bank":
+            return YcqlBankClient(opts)
+        if w == "long-fork":
+            return YcqlLongForkClient(opts)
         return _YCQL_CLIENTS[w](opts)
     if w == "multi-key-acid":
         return MultiKeyAcidClient(_ysql_opts(opts))
+    if w == "default-value":
+        return DefaultValueClient(_ysql_opts(opts))
+    if w == "single-key-acid":
+        w = "register"
     return sql.client_for(w, _ysql_opts(opts))
 
 
@@ -550,3 +576,244 @@ class YcqlMultiKeyAcidClient(client_mod.Client):
     def close(self, test):
         if self.conn:
             self.conn.close()
+
+
+# ---------------------------------------------------------------------
+# YCQL bank (reference: yugabyte/src/yugabyte/ycql/bank.clj:20-58)
+# ---------------------------------------------------------------------
+
+
+class _YcqlBase(client_mod.Client):
+    """Shared CQL connection plumbing for the YCQL workload clients."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[CqlClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = CqlClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", YCQL_PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def _ddl(self, *stmts: str) -> None:
+        for stmt in stmts:
+            try:
+                self.conn.query(stmt)
+            except (CqlError, IndeterminateError):
+                pass
+
+
+class YcqlBankClient(_YcqlBase):
+    """Bank transfers as one YCQL distributed transaction: two
+    balance-arithmetic UPDATEs inside BEGIN/END TRANSACTION; reads are a
+    full-table scan.  (reference: ycql/bank.clj:20-58 CQLBank — the
+    transfer statement shape at :46-56)"""
+
+    def setup(self, test):
+        self._ddl(
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+            f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.accounts "
+            "(id int PRIMARY KEY, balance bigint) "
+            "WITH transactions = {'enabled': 'true'}",
+        )
+        accounts = list(test.get("accounts", range(8)))
+        total = test.get("total-amount", 100)
+        t = f"{KEYSPACE}.accounts"
+        for i, acct in enumerate(accounts):
+            bal = total if i == 0 else 0
+            self._ddl(
+                f"INSERT INTO {t} (id, balance) "
+                f"VALUES ({int(acct)}, {int(bal)})"
+            )
+
+    def invoke(self, test, op):
+        t = f"{KEYSPACE}.accounts"
+        try:
+            if op["f"] == "read":
+                res = self.conn.query(
+                    f"SELECT id, balance FROM {t}", consistency="quorum"
+                )
+                value = {
+                    res.cell_int(r, 0): res.cell_int(r, 1) for r in res.rows
+                }
+                return {**op, "type": "ok", "value": value}
+            if op["f"] == "transfer":
+                frm = int(op["value"]["from"])
+                to = int(op["value"]["to"])
+                amt = int(op["value"]["amount"])
+                self.conn.query(
+                    "BEGIN TRANSACTION "
+                    f"UPDATE {t} SET balance = balance - {amt} "
+                    f"WHERE id = {frm}; "
+                    f"UPDATE {t} SET balance = balance + {amt} "
+                    f"WHERE id = {to}; "
+                    "END TRANSACTION"
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except CqlError as e:
+            if e.timeout:
+                return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "fail", "error": str(e)}
+
+
+class YcqlLongForkClient(_YcqlBase):
+    """Long-fork txns over an indexed table: single-row writes, group
+    reads through the key2 value index rewritten into the txn mops.
+    (reference: ycql/long_fork.clj:13-55 — the index-backed read at
+    :31-44, the insert at :46-50)"""
+
+    def setup(self, test):
+        self._ddl(
+            f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+            f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.long_fork "
+            "(key int PRIMARY KEY, key2 int, val int) "
+            "WITH transactions = {'enabled': 'true'}",
+            f"CREATE INDEX IF NOT EXISTS long_forks "
+            f"ON {KEYSPACE}.long_fork (key2) INCLUDE (val)",
+        )
+
+    def invoke(self, test, op):
+        t = f"{KEYSPACE}.long_fork"
+        txn = op["value"]
+        try:
+            if op["f"] == "read":
+                ks = sorted({k for _f, k, _v in txn})
+                in_list = ", ".join(str(k) for k in ks)
+                res = self.conn.query(
+                    f"SELECT key2, val FROM {t} WHERE key2 IN ({in_list})",
+                    consistency="quorum",
+                )
+                got = {
+                    res.cell_int(r, 0): res.cell_int(r, 1) for r in res.rows
+                }
+                out = [[f, k, got.get(k)] for f, k, _v in txn]
+                return {**op, "type": "ok", "value": out}
+            if op["f"] == "write":
+                [[_f, k, v]] = txn
+                self.conn.query(
+                    f"INSERT INTO {t} (key, key2, val) "
+                    f"VALUES ({int(k)}, {int(k)}, {int(v)})"
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except CqlError as e:
+            if e.timeout:
+                return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "fail", "error": str(e)}
+
+
+# ---------------------------------------------------------------------
+# YSQL default-value (reference: yugabyte/src/yugabyte/default_value.clj
+# and ysql/default_value.clj)
+# ---------------------------------------------------------------------
+
+DV_TABLE = "foo"
+
+
+class DefaultValueClient(sql._Base):
+    """Concurrent create/drop-table churn against inserts and reads of a
+    table whose second column carries DEFAULT 0; any read observing a
+    NULL there is the anomaly.  (reference: ysql/default_value.clj:
+    create-table!:41-52, insert!:25-28, read-natural:36-39,
+    invoke-op!:104-117 — missing-relation errors fail the op rather
+    than crash, like the reference's with-table/catch-dne handling)"""
+
+    dialect = "pg"
+
+    def setup(self, test):
+        # the probe simulates a migration against an *existing* table
+        # (default_value.clj:1-11); seeding it also keeps short runs
+        # from recording zero ok reads/inserts when the generator's
+        # 1-in-26 create-table draw comes late
+        self._exec_ddl(
+            f"CREATE TABLE IF NOT EXISTS {DV_TABLE} "
+            "(dummy INT, v INT DEFAULT 0)"
+        )
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "create-table":
+                self.conn.query(
+                    f"CREATE TABLE IF NOT EXISTS {DV_TABLE} "
+                    "(dummy INT, v INT DEFAULT 0)"
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "drop-table":
+                self.conn.query(f"DROP TABLE IF EXISTS {DV_TABLE}")
+                return {**op, "type": "ok"}
+            if op["f"] == "insert":
+                self.conn.query(f"INSERT INTO {DV_TABLE} (dummy) VALUES (1)")
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                res = self.conn.query(f"SELECT v FROM {DV_TABLE}")
+                rows = [
+                    None if r[0] is None else int(r[0]) for r in res.rows
+                ]
+                return {**op, "type": "ok", "value": rows}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except sql.IndeterminateError as e:
+            return self._info(op, e)
+        except (sql.PgError, sql.MysqlError) as e:
+            # a read/insert racing a drop-table legitimately fails with
+            # "does not exist" — an op failure, not a harness crash
+            return self._fail(op, e)
+
+
+class DefaultValueChecker(common.checker_mod.Checker):
+    """valid? iff no ok read observed a NULL in the defaulted column.
+    (reference: default_value.clj:70-103 bad-row/bad-read/checker)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK
+
+        reads = [
+            op for op in history if op.type == OK and op.f == "read"
+        ]
+        bad = [
+            {"op-index": op.index, "bad-rows": [v for v in (op.value or []) if v is None]}
+            for op in reads
+            if any(v is None for v in (op.value or []))
+        ]
+        return {
+            "valid?": not bad,
+            "read-count": len(reads),
+            "bad-read-count": len(bad),
+            "bad-reads": bad[:10],
+        }
+
+
+def default_value_workload(opts: Optional[dict] = None) -> dict:
+    """DDL churn (create/drop) mixed 1:25 with reads and inserts,
+    staggered tightly.  (reference: default_value.clj:generator:60-68)"""
+    from .. import generator as gen_mod
+
+    def r(test, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def i(test, ctx):
+        return {"type": "invoke", "f": "insert", "value": None}
+
+    def create(test, ctx):
+        return {"type": "invoke", "f": "create-table", "value": None}
+
+    def drop(test, ctx):
+        return {"type": "invoke", "f": "drop-table", "value": None}
+
+    mix = [create, drop] + [r, i] * 25
+    return {
+        "generator": gen_mod.stagger(1 / 100, gen_mod.mix(mix)),
+        "checker": DefaultValueChecker(),
+    }

@@ -942,7 +942,7 @@ class _CqlHandler(_RecvExact, socketserver.BaseRequestHandler):
         # yugabyte distributed transactions: BEGIN TRANSACTION
         # <stmt>; <stmt>; END TRANSACTION — the handler already runs
         # under the store lock, so the whole block applies atomically
-        # (multi_key_acid writes)
+        # (multi_key_acid writes, bank balance-arithmetic transfers)
         elif low.startswith("begin transaction"):
             inner = s[len("begin transaction"):]
             if inner.lower().rstrip().endswith("end transaction"):
@@ -958,12 +958,25 @@ class _CqlHandler(_RecvExact, socketserver.BaseRequestHandler):
                     r"\((\d+),\s*(\d+),\s*(\d+)\)",
                     stmt, _re.I,
                 )
-                if not m:
-                    self._error(stream, 0x2000,
-                                f"Invalid txn stmt: {stmt!r}")
-                    return
-                id_, ik, val = m.groups()
-                staged[f"mka:{id_}:{ik}"] = val
+                if m:
+                    id_, ik, val = m.groups()
+                    staged[f"mka:{id_}:{ik}"] = val
+                    continue
+                m = _re.match(
+                    r"update \S+\.accounts set balance\s*=\s*"
+                    r"balance\s*([+-])\s*(\d+)\s+where\s+id\s*=\s*(\d+)",
+                    stmt, _re.I,
+                )
+                if m:
+                    sign, amt, id_ = m.groups()
+                    key = f"acct:{id_}"
+                    cur = int(staged.get(key, kv.get(key, 0)))
+                    delta = int(amt) if sign == "+" else -int(amt)
+                    staged[key] = str(cur + delta)
+                    continue
+                self._error(stream, 0x2000,
+                            f"Invalid txn stmt: {stmt!r}")
+                return
             kv.update(staged)  # all-or-nothing: parse fully, then apply
             self._send(stream, 0x08, struct.pack("!I", 1))
         elif _re.match(r"select id, val from \S+\.multi_key_acid", low):
@@ -977,6 +990,31 @@ class _CqlHandler(_RecvExact, socketserver.BaseRequestHandler):
                 if f"mka:{i}:{ik}" in kv
             ]
             self._rows(stream, ["id", "val"], rows)
+        # yugabyte ycql bank: <ks>.accounts (id, balance)
+        elif _re.match(r"insert into \S+\.accounts", low):
+            inner = s[s.index("(", s.lower().index("values")) + 1:
+                      s.rindex(")")]
+            id_, bal = [x.strip() for x in inner.split(",", 1)]
+            kv[f"acct:{id_}"] = bal
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        elif _re.match(r"select id, balance from \S+\.accounts", low):
+            rows = sorted(
+                (int(k[5:]), kv[k]) for k in kv if k.startswith("acct:")
+            )
+            self._rows(stream, ["id", "balance"],
+                       [[str(i), b] for i, b in rows])
+        # yugabyte ycql long-fork: <ks>.long_fork (key, key2, val)
+        elif _re.match(r"insert into \S+\.long_fork", low):
+            inner = s[s.index("(", s.lower().index("values")) + 1:
+                      s.rindex(")")]
+            k, _k2, v = [x.strip() for x in inner.split(",")]
+            kv[f"lf:{k}"] = v
+            self._send(stream, 0x08, struct.pack("!I", 1))
+        elif _re.match(r"select key2, val from \S+\.long_fork", low):
+            m = _re.search(r"key2\s+in\s*\(([^)]*)\)", low)
+            ks = [x.strip() for x in m.group(1).split(",") if x.strip()]
+            rows = [[k, kv[f"lf:{k}"]] for k in ks if f"lf:{k}" in kv]
+            self._rows(stream, ["key2", "val"], rows)
         elif _re.match(r"insert into \S+\.elements", low):
             inner = s[s.index("(", s.lower().index("values")) + 1:
                       s.rindex(")")]
@@ -1700,7 +1738,7 @@ _RE_DG_FUNC = _re.compile(
     r"(?:\s*@filter\(eq\((\w+),\s*\"?([^\")]+)\"?\)\))?",
 )
 _RE_DG_NQUAD = _re.compile(
-    r"^(uid\(u\)|_:\w+)\s+<(\w+)>\s+\"([^\"]*)\"\s+\.$"
+    r"^(uid\(u\)|_:\w+|<\w+>)\s+<(\w+)>\s+\"([^\"]*)\"\s+\.$"
 )
 
 
@@ -1737,34 +1775,183 @@ class _DgraphHandler(BaseHTTPRequestHandler):
             out.append(uid)
         return out
 
+    def _fields_for(self, raw):
+        """Field names the query block requests: the identifier tokens
+        inside the innermost block (striped preds like key_3 included)."""
+        body = raw.split("{", 2)[-1]
+        var_names = set(_re.findall(r"\b(\w+)\s+as\b", body))
+        fields = []
+        for tok in _re.findall(r"\b([A-Za-z_]\w*)\b(?!\s*\()", body):
+            if (
+                tok not in fields
+                and tok not in ("as", "q", "func", "var")
+                and tok not in var_names
+            ):
+                fields.append(tok)
+        return fields
+
+    # -- txn-protocol plumbing (OCC, first-committer-wins) -------------
+    # Versions are tracked per (uid, pred) and per (pred, value) index
+    # entry; a txn's reads and writes are validated against them at
+    # commit — the same conflict surface dgraph's real transactions
+    # expose (TxnConflictException on racing upserts).
+
+    def _txn(self, st, start_ts):
+        txns = st.kv.setdefault("dgraph_txns", {})
+        return txns.get(start_ts)
+
+    def _new_ts(self, st) -> int:
+        box = st.kv.setdefault("dgraph_ts", [1])
+        box[0] += 1
+        return box[0]
+
+    def _bump(self, st, keys, commit_ts):
+        vers = st.kv.setdefault("dgraph_vers", {})
+        for k in keys:
+            vers[k] = commit_ts
+
     def do_POST(self):
         st = self.fake_store
-        path = urlparse(self.path).path
+        parsed = urlparse(self.path)
+        path = parsed.path
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         raw = self._body().decode()
         with st.lock:
             nodes = st.kv.setdefault("dgraph_nodes", {})
+            vers = st.kv.setdefault("dgraph_vers", {})
+            txns = st.kv.setdefault("dgraph_txns", {})
             if path == "/alter":
                 self._send({"data": {"code": "Success"}})
                 return
             if path == "/query":
+                start_ts = int(params.get("startTs", 0))
+                if not start_ts:
+                    start_ts = self._new_ts(st)
+                    txns[start_ts] = {"staged": [], "reads": set(),
+                                      "writes": set()}
+                txn = self._txn(st, start_ts)
+                m = _RE_DG_FUNC.search(raw)
                 uids = self._match(nodes, raw)
-                # which fields does the block request?
-                fields = []
-                for f in ("uid", "value", "key", "email"):
-                    if _re.search(rf"\b{f}\b(?!\()", raw.split("{", 2)[-1]):
-                        fields.append(f)
+                fields = self._fields_for(raw)
                 rows = []
                 for uid in uids:
                     row = {}
                     for f in fields:
                         row[f] = uid if f == "uid" else nodes[uid].get(f)
                     rows.append(row)
-                self._send({"data": {"q": rows}})
+                if txn is not None and m:
+                    pred, val = m.group(1), m.group(2)
+                    txn["reads"].add(f"idx|{pred}|{val}")
+                    for uid in uids:
+                        for f in fields:
+                            if f != "uid":
+                                txn["reads"].add(f"{uid}|{f}")
+                self._send({
+                    "data": {"q": rows},
+                    "extensions": {"txn": {"start_ts": start_ts}},
+                })
                 return
+            if path == "/commit":
+                start_ts = int(params.get("startTs", 0))
+                txn = txns.pop(start_ts, None)
+                if txn is None:
+                    self._send(
+                        {"errors": [{"message": "unknown transaction"}]},
+                        409,
+                    )
+                    return
+                touched = txn["reads"] | txn["writes"]
+                if any(vers.get(k, 0) > start_ts for k in touched):
+                    self._send(
+                        {"errors": [{"message":
+                                     "Transaction has been aborted. "
+                                     "Please retry"}]},
+                        409,
+                    )
+                    return
+                commit_ts = self._new_ts(st)
+                write_keys = set(txn["writes"])
+                for action in txn["staged"]:
+                    kind = action[0]
+                    if kind == "set":
+                        _, uid, pred, val = action
+                        nodes.setdefault(uid, {})[pred] = val
+                        write_keys.add(f"{uid}|{pred}")
+                        write_keys.add(f"idx|{pred}|{val}")
+                    elif kind == "delnode":
+                        _, uid = action
+                        for pred, val in nodes.pop(uid, {}).items():
+                            write_keys.add(f"{uid}|{pred}")
+                            write_keys.add(f"idx|{pred}|{val}")
+                    elif kind == "delpred":
+                        _, uid, pred = action
+                        val = nodes.get(uid, {}).pop(pred, None)
+                        write_keys.add(f"{uid}|{pred}")
+                        if val is not None:
+                            write_keys.add(f"idx|{pred}|{val}")
+                self._bump(st, write_keys, commit_ts)
+                self._send({"data": {"code": "Success",
+                                     "commit_ts": commit_ts}})
+                return
+            if path.startswith("/mutate") and "commitNow" not in params:
+                # staged (transactional) mutation
+                payload = json.loads(raw)
+                if "mutations" not in payload and (
+                    "set_nquads" in payload or "del_nquads" in payload
+                ):
+                    start_ts = int(params.get("startTs", 0))
+                    if not start_ts:
+                        start_ts = self._new_ts(st)
+                        txns[start_ts] = {"staged": [], "reads": set(),
+                                          "writes": set()}
+                    txn = self._txn(st, start_ts)
+                    created = {}
+                    for line in payload.get("del_nquads", "").splitlines():
+                        line = line.strip()
+                        if not line:
+                            continue
+                        parts = line.split()
+                        uid = parts[0].strip("<>")
+                        if parts[1] == "*":
+                            txn["staged"].append(("delnode", uid))
+                            for pred, val in nodes.get(uid, {}).items():
+                                txn["writes"].add(f"{uid}|{pred}")
+                                txn["writes"].add(f"idx|{pred}|{val}")
+                        else:
+                            pred = parts[1].strip("<>")
+                            txn["staged"].append(("delpred", uid, pred))
+                            txn["writes"].add(f"{uid}|{pred}")
+                    for line in payload.get("set_nquads", "").splitlines():
+                        line = line.strip()
+                        if not line:
+                            continue
+                        m = _RE_DG_NQUAD.match(line)
+                        if not m:
+                            continue
+                        subj, pred, val = m.groups()
+                        if subj.startswith("<"):
+                            uid = subj.strip("<>")
+                        else:
+                            blank = subj[2:]
+                            uid = created.get(blank)
+                            if uid is None:
+                                n_id = st.kv.setdefault("dgraph_next", [1])
+                                uid = f"0x{n_id[0]:x}"
+                                n_id[0] += 1
+                                created[blank] = uid
+                        txn["staged"].append(("set", uid, pred, val))
+                        txn["writes"].add(f"{uid}|{pred}")
+                        txn["writes"].add(f"idx|{pred}|{val}")
+                    self._send({
+                        "data": {"code": "Success", "uids": created},
+                        "extensions": {"txn": {"start_ts": start_ts}},
+                    })
+                    return
             if path.startswith("/mutate"):
                 payload = json.loads(raw)
                 uids = self._match(nodes, payload.get("query", ""))
                 created = {}
+                written = set()
                 for mut in payload.get("mutations", []):
                     cond = mut.get("cond", "")
                     n = len(uids)
@@ -1782,11 +1969,17 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                             parts = line.split()
                             for uid in uids:
                                 if parts[1] == "*":
-                                    nodes.pop(uid, None)
+                                    for pred, val in nodes.pop(
+                                        uid, {}
+                                    ).items():
+                                        written.add(f"{uid}|{pred}")
+                                        written.add(f"idx|{pred}|{val}")
                                 else:
-                                    nodes.get(uid, {}).pop(
-                                        parts[1].strip("<>"), None
-                                    )
+                                    pred = parts[1].strip("<>")
+                                    val = nodes.get(uid, {}).pop(pred, None)
+                                    written.add(f"{uid}|{pred}")
+                                    if val is not None:
+                                        written.add(f"idx|{pred}|{val}")
                     for line in mut.get("set_nquads", "").splitlines():
                         line = line.strip()
                         if not line:
@@ -1798,6 +1991,8 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                         if subj == "uid(u)":
                             for uid in uids:
                                 nodes[uid][pred] = val
+                                written.add(f"{uid}|{pred}")
+                                written.add(f"idx|{pred}|{val}")
                         else:
                             blank = subj[2:]
                             uid = created.get(blank)
@@ -1808,6 +2003,10 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                                 nodes[uid] = {}
                                 created[blank] = uid
                             nodes[uid][pred] = val
+                            written.add(f"{uid}|{pred}")
+                            written.add(f"idx|{pred}|{val}")
+                if written:
+                    self._bump(st, written, self._new_ts(st))
                 self._send(
                     {
                         "data": {

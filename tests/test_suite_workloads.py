@@ -698,3 +698,544 @@ def test_ycql_multi_key_acid_roundtrip():
         assert "ycql.multi-key-acid" in w and "ysql.multi-key-acid" in w
     finally:
         s.stop()
+
+
+# -- yugabyte ycql bank / long-fork / ysql default-value --------------------
+
+
+def test_ycql_bank_roundtrip():
+    """Transfers ride one BEGIN/END TRANSACTION statement; balances move
+    atomically (reference: ycql/bank.clj:46-56)."""
+    from fake_servers import FakeCql
+
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakeCql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"nodes": ["n1"], "accounts": [0, 1, 2, 3], "total-amount": 20}
+        c = yugabyte.YcqlBankClient(opts).open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and sum(r["value"].values()) == 20, r
+        assert r["value"][0] == 20
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 2, "amount": 7}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == {0: 13, 1: 0, 2: 7, 3: 0}
+        c.close(t)
+    finally:
+        s.stop()
+
+
+def test_ycql_bank_full_test_in_process():
+    from fake_servers import FakeCql
+
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakeCql().start()
+    try:
+        t = yugabyte.test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "ycql.bank",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_ycql_long_fork_roundtrip():
+    from fake_servers import FakeCql
+
+    from jepsen_tpu.suites import yugabyte
+    from jepsen_tpu.txn import R, W
+
+    s = FakeCql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = yugabyte.YcqlLongForkClient(opts).open({}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "write", "type": "invoke",
+                          "value": [[W, 0, 1]]})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "type": "invoke",
+                          "value": [[R, 0, None], [R, 1, None]]})
+        assert r["type"] == "ok"
+        assert r["value"] == [[R, 0, 1], [R, 1, None]]
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_ycql_long_fork_full_test_in_process():
+    from fake_servers import FakeCql
+
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakeCql().start()
+    try:
+        t = yugabyte.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "ycql.long-fork",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_ysql_default_value_client_and_checker():
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "user": "postgres"}
+        c = yugabyte.DefaultValueClient(opts).open({"nodes": ["n1"]}, "n1")
+        r = c.invoke({}, {"f": "create-table", "type": "invoke", "value": None})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "insert", "type": "invoke", "value": None})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+        assert r["type"] == "ok" and r["value"] == [0], r
+        r = c.invoke({}, {"f": "drop-table", "type": "invoke", "value": None})
+        assert r["type"] == "ok", r
+        # racing reads of a dropped table fail, not crash
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+        assert r["type"] == "fail", r
+        c.close({})
+    finally:
+        s.stop()
+
+    ck = yugabyte.DefaultValueChecker()
+    good = h(
+        invoke_op(0, "read"), ok_op(0, "read", [0, 0, 0]),
+    )
+    assert ck.check({}, good)["valid?"] is True
+    bad = h(
+        invoke_op(0, "read"), ok_op(0, "read", [0, None, 0]),
+    )
+    res = ck.check({}, bad)
+    assert res["valid?"] is False and res["bad-read-count"] == 1
+
+
+def test_ysql_default_value_full_test_in_process():
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakePg().start()
+    try:
+        t = yugabyte.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "ysql.default-value",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_yugabyte_flagship_workload_names():
+    from jepsen_tpu.suites import yugabyte
+
+    w = yugabyte.workloads({"nodes": ["n1", "n2", "n3"]})
+    for name in ("ycql.single-key-acid", "ysql.single-key-acid",
+                 "ycql.bank", "ycql.long-fork", "ysql.default-value"):
+        assert name in w, name
+
+
+# -- cockroach sets ---------------------------------------------------------
+
+
+def test_crdb_sets_checker():
+    from jepsen_tpu.suites.crdb_sets import SetsChecker
+
+    ck = SetsChecker()
+    good = h(
+        invoke_op(0, "add", 0), ok_op(0, "add", 0),
+        invoke_op(0, "add", 1), ok_op(0, "add", 1),
+        invoke_op(1, "add", 2), info_op(1, "add", 2),
+        invoke_op(0, "read"), ok_op(0, "read", [0, 1, 2]),
+    )
+    res = ck.check({}, good)
+    assert res["valid?"] is True, res
+    assert res["recovered"] == "#{2}"
+    assert res["ok"] == "#{0 1}"
+
+    # lost + revived + duplicate + unexpected all fail
+    bad = h(
+        invoke_op(0, "add", 0), ok_op(0, "add", 0),
+        invoke_op(0, "add", 1), fail_op(0, "add", 1),
+        invoke_op(0, "read"), ok_op(0, "read", [1, 1, 9]),
+    )
+    res = ck.check({}, bad)
+    assert res["valid?"] is False
+    assert res["lost"] == "#{0}" and res["revived"] == "#{1}"
+    assert res["duplicates"] == [1] and res["unexpected"] == "#{9}"
+
+    res = ck.check({}, h(invoke_op(0, "add", 0), ok_op(0, "add", 0)))
+    assert res["valid?"] == "unknown"
+
+
+def test_crdb_sets_full_test_in_process():
+    from jepsen_tpu.suites import cockroachdb
+
+    s = FakePg().start()
+    try:
+        t = cockroachdb.test(
+            {
+                "nodes": ["n1", "n2", "n3"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 50,
+                "workload": "sets",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- tidb txn + table -------------------------------------------------------
+
+
+def test_tidb_txn_client_roundtrip():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "user": "root",
+                "password": "pw", "dialect": "mysql"}
+        c = tidb.TidbTxnClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["w", 5, 3], ["r", 5, None]]})
+        assert r["type"] == "ok" and r["value"] == [["w", 5, 3], ["r", 5, 3]], r
+        # single-mop txns skip BEGIN (reference txn.clj:58-66)
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["r", 5, None]]})
+        assert r["type"] == "ok" and r["value"] == [["r", 5, 3]]
+        # striping: different keys land on txn<hash % 7> tables
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["w", 12, 9], ["r", 12, None]]})
+        assert r["type"] == "ok" and r["value"][1] == ["r", 12, 9]
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_tidb_txn_append_mops():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "user": "root",
+                "password": "pw", "dialect": "mysql", "val-type": "text"}
+        c = tidb.TidbTxnClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        for v in (1, 2):
+            r = c.invoke({}, {"f": "txn", "type": "invoke",
+                              "value": [["append", 3, v]]})
+            assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["r", 3, None]]})
+        assert r["type"] == "ok" and r["value"] == [["r", 3, [1, 2]]], r
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_tidb_table_client_and_checker():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "user": "root",
+                "password": "pw", "dialect": "mysql"}
+        c = tidb.TableClient(opts).open({"nodes": ["n1"]}, "n1")
+        r = c.invoke({}, {"f": "insert", "type": "invoke", "value": [1, 0]})
+        assert r["type"] == "fail" and r["error"] == "doesn't-exist", r
+        r = c.invoke({}, {"f": "create-table", "type": "invoke", "value": 1})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "insert", "type": "invoke", "value": [1, 0]})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "insert", "type": "invoke", "value": [1, 0]})
+        assert r["type"] == "fail" and r["error"] == "duplicate-key", r
+        c.close({})
+    finally:
+        s.stop()
+
+    from jepsen_tpu.suites.tidb import TableChecker
+
+    ck = TableChecker()
+    ok_hist = h(
+        invoke_op(0, "create-table", 1), ok_op(0, "create-table", 1),
+        invoke_op(0, "insert", [1, 0]), ok_op(0, "insert", [1, 0]),
+    )
+    assert ck.check({}, ok_hist)["valid?"] is True
+    bad = h(
+        invoke_op(0, "insert", [1, 0]),
+        fail_op(0, "insert", [1, 0], error="doesn't-exist"),
+    )
+    assert ck.check({}, bad)["valid?"] is False
+
+
+def test_tidb_table_full_test_in_process():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        t = tidb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "root",
+                "password": "pw",
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "table",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_tidb_txn_full_test_in_process():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        t = tidb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "root",
+                "password": "pw",
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "txn",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- dgraph bank / wr / long-fork -------------------------------------------
+
+
+def test_dgraph_bank_client_roundtrip():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"nodes": ["n1"], "accounts": [0, 1, 2], "total-amount": 30}
+        c = dgraph.DgraphBankClient(opts).open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == {0: 30}, r
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 1, "amount": 10}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == {0: 20, 1: 10}, r
+        # draining an account deletes its node (write-account! zero path)
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 1, "to": 0, "amount": 10}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == {0: 30}, r
+        # insufficient funds fails without mutating
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 2, "to": 0, "amount": 5}})
+        assert r["type"] == "fail", r
+        c.close(t)
+    finally:
+        s.stop()
+
+
+def test_dgraph_txn_client_occ_conflict():
+    """Two overlapping transactions on one key: the second commit must
+    abort (first-committer-wins), mirroring TxnConflictException."""
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c1 = dgraph.DgraphTxnClient(opts).open({}, "n1")
+        c1.setup({})
+        # seed key 5
+        r = c1.invoke({}, {"f": "txn", "type": "invoke",
+                           "value": [["w", 5, 1]]})
+        assert r["type"] == "ok", r
+
+        t1 = dgraph._DgraphTxn(c1.conn)
+        local1: dict = {}
+        c1._mop(t1, local1, "r", 5, None)
+        c1._mop(t1, local1, "w", 5, 2)
+
+        c2 = dgraph.DgraphTxnClient(opts).open({}, "n1")
+        t2 = dgraph._DgraphTxn(c2.conn)
+        local2: dict = {}
+        c2._mop(t2, local2, "r", 5, None)
+        c2._mop(t2, local2, "w", 5, 3)
+
+        t1.commit()  # first wins
+        with pytest.raises(dgraph.TxnAborted):
+            t2.commit()
+        # committed state reflects only t1
+        r = c1.invoke({}, {"f": "txn", "type": "invoke",
+                           "value": [["r", 5, None]]})
+        assert r["value"] == [["r", 5, 2]], r
+        c1.close({})
+        c2.close({})
+    finally:
+        s.stop()
+
+
+def test_dgraph_txn_read_your_writes():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = dgraph.DgraphTxnClient(opts).open({}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["w", 9, 4], ["r", 9, None]]})
+        assert r["type"] == "ok" and r["value"] == [["w", 9, 4], ["r", 9, 4]], r
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_dgraph_bank_full_test_in_process():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        t = dgraph.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 3,
+                "rate": 20,
+                "workload": "bank",
+                "faults": [],
+            }
+        )
+        # two accounts keep every transfer direction viable, so the run
+        # can't flake with zero ok transfers (stats checker needs >=1)
+        t["accounts"] = [0, 1]
+        t["total-amount"] = 20
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_dgraph_wr_full_test_in_process():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        t = dgraph.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 20,
+                "workload": "wr",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_dgraph_long_fork_full_test_in_process():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        t = dgraph.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 20,
+                "workload": "long-fork",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
