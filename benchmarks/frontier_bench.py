@@ -19,7 +19,10 @@ rate means that config's effective throughput is oracle-bound no matter
 how fast the kernel runs (wgl.check_batch reruns overflows on CPU).
 
 Run: python benchmarks/frontier_bench.py          # real device if alive
-     JEPSEN_TPU_FRONTIER_B=256 ... for a quicker pass
+     (JEPSEN_TPU_FRONTIER_B sizes the multi-register arm only — the
+     cas-register arm's shapes are pinned in CAS_SHAPES so recorded
+     numbers stay comparable across runs; JEPSEN_TPU_FRONTIER_REPS
+     scales timing reps)
 """
 
 import json

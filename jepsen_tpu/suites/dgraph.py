@@ -520,8 +520,19 @@ class _DgraphTxn:
             ok=(200,),
             raise_on_error=False,
         )
-        if status == 409 or "errors" in (body or {}):
+        if status == 409 or (
+            isinstance(body, dict) and "errors" in body
+        ):
+            # definite abort: the commit did not apply
             raise TxnAborted(str(body))
+        if status != 200:
+            # anything else (5xx through a faulted proxy, truncated
+            # body, …) leaves the commit outcome UNKNOWN — acking it as
+            # ok would corrupt the history exactly in the faulted runs
+            # this suite exists to test
+            raise IndeterminateError(
+                f"commit status {status}: {str(body)[:200]}"
+            )
 
 
 # ---------------------------------------------------------------------

@@ -20,6 +20,7 @@ import base64
 import json
 from typing import Any, Optional
 
+from .. import checker as checker_mod
 from .. import client as client_mod
 from .. import independent
 from ..control import util as cu
@@ -202,14 +203,15 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    # bank/set/pages/monotonic need FQL pagination the wire client
-    # doesn't model yet; register and g2 are complete
     from ..workloads import adya
 
     opts = dict(opts or {})
     return {
         "register": common.register_workload(opts),
         "g2": adya.workload(opts),
+        # flagship probes (reference: faunadb/pages.clj, monotonic.clj)
+        "pages": pages_workload(opts),
+        "monotonic": monotonic_workload(opts),
     }
 
 
@@ -217,7 +219,11 @@ def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "register")
     w = workloads(opts)[wname]
-    c = FaunaG2Client(opts) if wname == "g2" else FaunaClient(opts)
+    c = {
+        "g2": FaunaG2Client,
+        "pages": FaunaPagesClient,
+        "monotonic": FaunaMonotonicClient,
+    }.get(wname, FaunaClient)(opts)
     return common.build_test(
         f"faunadb-{wname}", opts, db=FaunaDB(opts), client=c, workload=w,
     )
@@ -296,3 +302,368 @@ class FaunaG2Client(FaunaClient):
             return {**op, "type": "info", "error": str(e)}
         except HttpError as e:
             return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+# ---------------------------------------------------------------------
+# pages workload (reference: faunadb/src/jepsen/faunadb/pages.clj)
+# ---------------------------------------------------------------------
+
+ELEMENTS_CLASS = "elements"
+ELEMENTS_INDEX = "all-elements"
+
+
+class FaunaPagesClient(FaunaClient):
+    """Grouped inserts vs paginated index reads: every element of a
+    group must appear with all its companions or not at all.
+    (reference: pages.clj — setup:32-42 class+index, add/read:45-60)"""
+
+    def setup(self, test):
+        try:
+            self.query({"create_class": {"object": {"name": ELEMENTS_CLASS}}})
+            self.query({"create_index": {"object": {
+                "name": ELEMENTS_INDEX,
+                "source": {"@ref": f"classes/{ELEMENTS_CLASS}"},
+                "active": True,
+                "serialized": bool(test.get("serialized-indices", True)),
+                "terms": [{"field": ["data", "key"]}],
+                "values": [{"field": ["data", "value"]}],
+            }}})
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "add":
+                # one request = one transaction: all group members land
+                # together (pages.clj:50-56 q/do* of creates)
+                self.query([
+                    {"create": {"@ref": f"classes/{ELEMENTS_CLASS}"},
+                     "params": {"object": {"data": {"object": {
+                         "key": int(k), "value": int(x)}}}}}
+                    for x in v
+                ])
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                out = self.query({
+                    "paginate": {"match": {
+                        "index": ELEMENTS_INDEX, "terms": [int(k)]}}
+                })
+                vals = list((out or {}).get("data", []))
+                return {**op, "type": "ok", "value": independent.kv(k, vals)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+class PagesChecker(checker_mod.Checker):
+    """Each read must be a union of whole add-groups.
+    (reference: pages.clj:68-94 read-errs, :96-141 checker)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import INVOKE, OK, FAIL
+
+        invokes, fails = set(), set()
+        ok_reads = []
+        for op in history:
+            if op.f == "add":
+                group = tuple(op.value)
+                if op.type == INVOKE:
+                    invokes.add(group)
+                elif op.type == FAIL:
+                    fails.add(group)
+            elif op.f == "read" and op.type == OK:
+                ok_reads.append(op)
+        adds = invokes - fails
+        idx = {}
+        for group in adds:
+            xs = frozenset(group)
+            for x in xs:
+                if x in idx:
+                    return {
+                        "valid?": "unknown",
+                        "error": f"element {x} added by two groups",
+                    }
+                idx[x] = xs
+        errs = []
+        for op in ok_reads:
+            vals = list(op.value or [])
+            read = set(vals)
+            if len(vals) != len(read):
+                errs.append({"op-index": op.index,
+                             "errors": ["duplicate-items"]})
+                continue
+            op_errs = []
+            while read:
+                e = next(iter(read))
+                group = idx.get(e)
+                if group is None:
+                    # not in any possibly-successful add: either a
+                    # phantom value or a definitely-failed add showing
+                    # up anyway (the reference's invokes-minus-fails
+                    # index makes these unaccountable; reporting them
+                    # beats passing them)
+                    op_errs.append({"unexpected": e})
+                    read = read - {e}
+                    continue
+                if not group <= read:
+                    op_errs.append({
+                        "expected": sorted(group),
+                        "found": sorted(read & group),
+                    })
+                read = read - group
+            if op_errs:
+                errs.append({"op-index": op.index, "errors": op_errs})
+        return {
+            "valid?": not errs,
+            "ok-read-count": len(ok_reads),
+            "error-count": len(errs),
+            "first-error": errs[0] if errs else None,
+        }
+
+
+def pages_workload(opts: Optional[dict] = None) -> dict:
+    """Group adds mixed 4:1 with reads, lifted over independent keys.
+    (reference: pages.clj:143-169 workload — group-size 4, limit 256,
+    stagger 1/5; limits scaled by opts for short runs)"""
+    from .. import generator as gen_mod
+
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+    group_size = int(opts.get("group-size", 4))
+    per_key = int(opts.get("per-key-limit", 64))
+    value_range = int(opts.get("value-range", 10_000))
+
+    def fgen(k):
+        vals = list(range(-value_range, value_range))
+        gen_mod.rng.shuffle(vals)
+        groups = [
+            vals[i : i + group_size]
+            for i in range(0, len(vals), group_size)
+        ]
+        it = iter(groups)
+
+        def g(test, ctx):
+            if gen_mod.rng.random() < 0.8:
+                try:
+                    return {"type": "invoke", "f": "add",
+                            "value": next(it)}
+                except StopIteration:
+                    pass
+            return {"type": "invoke", "f": "read", "value": None}
+
+        return gen_mod.limit(
+            per_key, gen_mod.stagger(1 / 50, g)
+        )
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * n, range(100_000), fgen
+        ),
+        "checker": independent.checker(PagesChecker()),
+        "concurrency": 2 * n,
+    }
+
+
+# ---------------------------------------------------------------------
+# monotonic workload (reference: faunadb/src/jepsen/faunadb/monotonic.clj)
+# ---------------------------------------------------------------------
+
+REGISTERS_CLASS = "registers"
+MONO_KEY = 0
+
+
+class FaunaMonotonicClient(FaunaClient):
+    """A single incrementing register queried with Time() stamps and
+    At() temporal reads.
+
+    Reference: monotonic.clj:84-146 — inc returns [ts, old-value] via an
+    if/exists/create-or-update transaction; read returns [ts, value];
+    read-at evaluates the read At() a (jittered) past timestamp."""
+
+    def setup(self, test):
+        try:
+            self.query({"create_class": {"object": {"name": REGISTERS_CLASS}}})
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        r = {"@ref": f"classes/{REGISTERS_CLASS}/{MONO_KEY}"}
+        sel = {"select": ["data", "value"], "from": {"get": r},
+               "default": 0}
+        try:
+            if op["f"] == "inc":
+                res = self.query([
+                    {"time": "now"},
+                    {"if": {"exists": r},
+                     # old value first, then the increment — list exprs
+                     # evaluate in order inside one transaction
+                     "then": [sel,
+                              {"update": r,
+                               "params": {"object": {"data": {"object": {
+                                   "value": {"add": [sel, 1]}}}}}}],
+                     "else": [{"create": r,
+                               "params": {"object": {"data": {"object": {
+                                   "value": 1}}}}},
+                              0]},
+                ])
+                ts, branch = res
+                v = next(x for x in branch if isinstance(x, int))
+                return {**op, "type": "ok", "value": [ts, v]}
+            if op["f"] == "read":
+                res = self.query([
+                    {"time": "now"},
+                    {"if": {"exists": r}, "then": sel, "else": 0},
+                ])
+                return {**op, "type": "ok", "value": [res[0], res[1]]}
+            if op["f"] == "read-at":
+                ts = (op.get("value") or [None, None])[0]
+                if ts is None:
+                    now = self.query({"time": "now"})
+                    # jitter a few ticks into the past
+                    # (reference: f/jitter-time, monotonic.clj:115-119)
+                    import random as _random
+
+                    ts = f"{max(1, int(now) - _random.randint(0, 4)):012d}"
+                v = self.query({
+                    "at": ts,
+                    "expr": {"if": {"exists": r}, "then": sel, "else": 0},
+                })
+                return {**op, "type": "ok", "value": [ts, v]}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            if "not found" in str(e.body):
+                return {**op, "type": "fail", "error": "not-found"}
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+def _non_monotonic_pairs_by_process(extract, history):
+    """(reference: monotonic.clj:152-173)"""
+    from ..history import OK
+
+    last: dict = {}
+    errs = []
+    for op in history:
+        if op.type != OK:
+            continue
+        p = op.process
+        v = extract(op)
+        lv = extract(last[p]) if p in last else None
+        if lv is not None and lv > v:
+            errs.append([last[p].index, op.index])
+        last[p] = op
+    return errs
+
+
+class MonotonicChecker(checker_mod.Checker):
+    """Per-process monotonic values and timestamps over inc/read ops.
+    (reference: monotonic.clj:175-193 checker)"""
+
+    def check(self, test, history, opts=None):
+        hist = [op for op in history if op.f in ("inc", "read")]
+        value_errs = _non_monotonic_pairs_by_process(
+            lambda op: op.value[1], hist
+        )
+        ts_errs = _non_monotonic_pairs_by_process(
+            lambda op: op.value[0], hist
+        )
+        return {
+            "valid?": not (value_errs or ts_errs),
+            "value-errors": value_errs[:10],
+            "ts-errors": ts_errs[:10],
+        }
+
+
+class TimestampValueChecker(checker_mod.Checker):
+    """Globally: sorted by timestamp, values never decrease.
+    (reference: monotonic.clj:195-218 timestamp-value-checker)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK
+
+        ops = sorted(
+            (op for op in history
+             if op.type == OK and op.f in ("read-at", "inc")),
+            key=lambda op: op.value[0],
+        )
+        errs = [
+            [a.index, b.index]
+            for a, b in zip(ops, ops[1:])
+            if a.value[1] > b.value[1]
+        ]
+        return {"valid?": not errs, "errors": errs[:10]}
+
+
+class NotFoundChecker(checker_mod.Checker):
+    """Existence is checked inside every transaction, so a not-found
+    failure is itself a bug.  (reference: monotonic.clj:335-347)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import FAIL
+
+        errs = [
+            op.index
+            for op in history
+            if op.type == FAIL and op.error == "not-found"
+        ]
+        return {"valid?": not errs, "error-count": len(errs),
+                "first": errs[0] if errs else None}
+
+
+class _MonotonicPlotter(checker_mod.Checker):
+    """Register value over DB timestamps, one series per process — the
+    SVG stand-in for the reference's gnuplot timestamp-value plot
+    (monotonic.clj:246-292)."""
+
+    def check(self, test, history, opts=None):
+        from ..checker import perf
+        from ..history import OK
+
+        series: dict = {}
+        for op in history:
+            if op.type == OK and op.f in ("inc", "read", "read-at"):
+                series.setdefault(op.process, []).append(
+                    (int(op.value[0]), op.value[1])
+                )
+        if not any(series.values()):
+            return {"valid?": True}
+        perf.scatter_plot(
+            test,
+            series,
+            path_components=list((opts or {}).get("subdirectory", []))
+            + ["monotonic.svg"],
+            title=f"{test.get('name', 'test')} value by timestamp",
+            ylabel="register value",
+            history=history,
+        )
+        return {"valid?": True}
+
+
+def monotonic_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: monotonic.clj:349-372 workload; the :events final
+    generator is omitted — the reference marks Fauna's event-history
+    traversal as broken, monotonic.clj:130-131)"""
+    from .. import generator as gen_mod
+
+    def inc_gen(test, ctx):
+        return {"type": "invoke", "f": "inc", "value": None}
+
+    def read_gen(test, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def read_at_gen(test, ctx):
+        return {"type": "invoke", "f": "read-at", "value": [None, None]}
+
+    return {
+        "generator": gen_mod.mix([inc_gen, read_gen, read_at_gen]),
+        "checker": checker_mod.compose({
+            "monotonic": MonotonicChecker(),
+            "not-found": NotFoundChecker(),
+            "timestamp-value": TimestampValueChecker(),
+            "timestamp-value-plot": _MonotonicPlotter(),
+        }),
+    }

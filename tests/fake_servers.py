@@ -2072,7 +2072,13 @@ class _FaunaHandler(BaseHTTPRequestHandler):
         if "create_index" in x:
             obj = x["create_index"]["object"]
             cls, _ = self._ref_parts({"ref": obj["source"]})
-            indexes[obj["name"]] = cls or obj["source"]
+            terms = obj.get("terms") or [{"field": ["data", "key"]}]
+            values = obj.get("values") or [{"field": ["data", "value"]}]
+            indexes[obj["name"]] = {
+                "cls": cls or obj["source"],
+                "terms": terms[0]["field"][-1],
+                "values": values[0]["field"][-1],
+            }
             return {"ref": obj["name"]}
         if "if" in x:
             cond = self._eval(docs, indexes, x["if"])
@@ -2088,25 +2094,60 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             if isinstance(tgt, dict) and "match" in tgt:
                 idx = tgt["match"]["index"]
                 terms = self._eval(docs, indexes, tgt.get("terms", []))
-                cls = indexes.get(idx)
-                if isinstance(cls, dict):
-                    cls = self._ref_parts({"ref": cls})[0]
+                entry = indexes.get(idx)
+                if isinstance(entry, dict) and "cls" in entry:
+                    cls, tfield = entry["cls"], entry["terms"]
+                elif isinstance(entry, dict):
+                    cls, tfield = self._ref_parts({"ref": entry})[0], "key"
+                else:
+                    cls, tfield = entry, "key"
                 term = terms[0] if terms else None
                 return any(
-                    c == cls and d.get("key") == term
+                    c == cls and d.get(tfield) == term
                     for (c, _i), d in docs.items()
                 )
             cls, id_ = self._ref_parts(tgt)
             return (cls, id_) in docs
+        if "paginate" in x:
+            tgt = x["paginate"]
+            if isinstance(tgt, dict) and "match" in tgt:
+                idx = tgt["match"]["index"]
+                terms = self._eval(docs, indexes, tgt["match"].get("terms", []))
+                entry = indexes.get(idx) or {}
+                cls = entry.get("cls") if isinstance(entry, dict) else entry
+                tfield = entry.get("terms", "key") if isinstance(entry, dict) else "key"
+                vfield = entry.get("values", "value") if isinstance(entry, dict) else "value"
+                term = terms[0] if terms else None
+                rows = [
+                    d.get(vfield)
+                    for (c, _i), d in sorted(docs.items(), key=lambda kv: str(kv[0]))
+                    if c == cls and (term is None or d.get(tfield) == term)
+                ]
+                return {"data": rows}
+            return {"data": []}
         if "match" in x:
-            return x  # only consumed via exists
+            return x  # only consumed via exists/paginate
+        if "time" in x:
+            return self._now_ts()
+        if "add" in x:
+            return sum(self._eval(docs, indexes, v) for v in x["add"])
+        if "at" in x:
+            ts = self._eval(docs, indexes, x["at"])
+            snap = self._snapshot(ts)
+            return self._eval(snap, indexes, x["expr"])
         if "create" in x:
             cls, id_ = self._ref_parts(x["create"])
+            if id_ is None:  # class-only ref: the DB assigns the id
+                box = self._st.kv.setdefault("fauna_ids", [0])
+                box[0] += 1
+                id_ = str(box[0])
             data = (
                 x.get("params", {}).get("object", {}).get("data", {})
                 .get("object", {})
             )
+            data = {k: self._eval(docs, indexes, v) for k, v in data.items()}
             docs[(cls, id_)] = dict(data)
+            self._log_version(cls, id_, docs[(cls, id_)])
             return {"ref": {"@ref": f"classes/{cls}/{id_}"}}
         if "update" in x:
             cls, id_ = self._ref_parts(x["update"])
@@ -2116,7 +2157,9 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             )
             if (cls, id_) not in docs:
                 raise KeyError("instance not found")
+            data = {k: self._eval(docs, indexes, v) for k, v in data.items()}
             docs[(cls, id_)].update(data)
+            self._log_version(cls, id_, docs[(cls, id_)])
             return {"ref": {"@ref": f"classes/{cls}/{id_}"}}
         if "select" in x:
             path = x["select"]
@@ -2142,9 +2185,35 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             return {"data": doc}
         return x
 
+    # -- time + versioned snapshots -----------------------------------
+    # One timestamp per request (allocated lazily by the first Time()
+    # or mutation); every create/update logs the doc state at that ts,
+    # so At(ts, …) reads evaluate against a historical snapshot — the
+    # temporal-query surface the monotonic workload exercises.
+
+    def _now_ts(self) -> str:
+        if self._req_ts is None:
+            box = self._st.kv.setdefault("fauna_ts", [0])
+            box[0] += 1
+            self._req_ts = f"{box[0]:012d}"
+        return self._req_ts
+
+    def _log_version(self, cls, id_, data) -> None:
+        log = self._st.kv.setdefault("fauna_log", [])
+        log.append((self._now_ts(), cls, id_, dict(data)))
+
+    def _snapshot(self, ts: str) -> dict:
+        snap: dict = {}
+        for t, cls, id_, data in self._st.kv.get("fauna_log", []):
+            if t <= str(ts):
+                snap[(cls, id_)] = data
+        return snap
+
     def do_POST(self):
         st = self.fake_store
         raw = self._body().decode()
+        self._st = st
+        self._req_ts = None
         with st.lock:
             docs = st.kv.setdefault("fauna_docs", {})
             indexes = st.kv.setdefault("fauna_indexes", {})

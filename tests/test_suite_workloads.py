@@ -1239,3 +1239,165 @@ def test_dgraph_long_fork_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- fauna pages + monotonic ------------------------------------------------
+
+
+def test_fauna_pages_client_and_checker():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = faunadb.FaunaPagesClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "add", "type": "invoke",
+                          "value": (7, [1, 5, -15, 23])})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (7, None)})
+        assert r["type"] == "ok"
+        assert sorted(r["value"][1]) == [-15, 1, 5, 23], r
+        # a different key reads empty
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (8, None)})
+        assert r["type"] == "ok" and r["value"][1] == [], r
+        c.close({})
+    finally:
+        s.stop()
+
+    ck = faunadb.PagesChecker()
+    good = h(
+        invoke_op(0, "add", (1, 2, 3)), ok_op(0, "add", (1, 2, 3)),
+        invoke_op(0, "read"), ok_op(0, "read", [1, 2, 3]),
+        invoke_op(0, "read"), ok_op(0, "read", []),
+    )
+    assert ck.check({}, good)["valid?"] is True
+
+    # torn group: read observed only part of an atomic add
+    torn = h(
+        invoke_op(0, "add", (1, 2, 3)), ok_op(0, "add", (1, 2, 3)),
+        invoke_op(0, "read"), ok_op(0, "read", [1, 3]),
+    )
+    res = ck.check({}, torn)
+    assert res["valid?"] is False and res["error-count"] == 1, res
+
+    # duplicates
+    dup = h(
+        invoke_op(0, "add", (1, 2)), ok_op(0, "add", (1, 2)),
+        invoke_op(0, "read"), ok_op(0, "read", [1, 1, 2]),
+    )
+    assert ck.check({}, dup)["valid?"] is False
+
+    # a definitely-failed add showing up in a read is an error, not a
+    # silently-accepted singleton
+    revived = h(
+        invoke_op(0, "add", (1, 2, 3)), fail_op(0, "add", (1, 2, 3)),
+        invoke_op(0, "read"), ok_op(0, "read", [1, 3]),
+    )
+    res = ck.check({}, revived)
+    assert res["valid?"] is False, res
+    assert any(
+        "unexpected" in e for e in res["first-error"]["errors"]
+    ), res
+
+
+def test_fauna_monotonic_client_and_checkers():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = faunadb.FaunaMonotonicClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "inc", "type": "invoke", "value": None})
+        assert r["type"] == "ok" and r["value"][1] == 0, r
+        r = c.invoke({}, {"f": "inc", "type": "invoke", "value": None})
+        assert r["type"] == "ok" and r["value"][1] == 1, r
+        ts1 = r["value"][0]
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+        assert r["type"] == "ok" and r["value"][1] == 2, r
+        # temporal read at the captured past ts sees the old value
+        r = c.invoke({}, {"f": "read-at", "type": "invoke",
+                          "value": [ts1, None]})
+        assert r["type"] == "ok" and r["value"] == [ts1, 2], r
+        # read-at with nil ts picks a jittered recent ts
+        r = c.invoke({}, {"f": "read-at", "type": "invoke",
+                          "value": [None, None]})
+        assert r["type"] == "ok" and isinstance(r["value"][1], int), r
+        c.close({})
+    finally:
+        s.stop()
+
+    mono = faunadb.MonotonicChecker()
+    good = h(
+        invoke_op(0, "inc"), ok_op(0, "inc", ["000000000001", 0]),
+        invoke_op(0, "read"), ok_op(0, "read", ["000000000002", 1]),
+    )
+    assert mono.check({}, good)["valid?"] is True
+    bad = h(
+        invoke_op(0, "read"), ok_op(0, "read", ["000000000002", 5]),
+        invoke_op(0, "read"), ok_op(0, "read", ["000000000003", 3]),
+    )
+    res = mono.check({}, bad)
+    assert res["valid?"] is False and res["value-errors"], res
+
+    tsv = faunadb.TimestampValueChecker()
+    bad_ts = h(
+        invoke_op(0, "read-at"), ok_op(0, "read-at", ["000000000001", 5]),
+        invoke_op(1, "read-at"), ok_op(1, "read-at", ["000000000002", 3]),
+    )
+    assert tsv.check({}, bad_ts)["valid?"] is False
+
+    nf = faunadb.NotFoundChecker()
+    assert nf.check({}, h(
+        invoke_op(0, "read"), fail_op(0, "read", error="not-found"),
+    ))["valid?"] is False
+
+
+def test_fauna_pages_full_test_in_process():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        t = faunadb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "workload": "pages",
+                "per-key-limit": 24,
+                "value-range": 200,
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_fauna_monotonic_full_test_in_process():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        t = faunadb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "monotonic",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
