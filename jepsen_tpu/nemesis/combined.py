@@ -376,7 +376,13 @@ def nemesis_packages(opts: dict) -> List[dict]:
     ]
 
 
-def nemesis_package(opts: dict) -> dict:
-    """The standard broad-spectrum fault package.
+def nemesis_package(opts: dict, only_active: bool = False) -> dict:
+    """The standard broad-spectrum fault package.  With ``only_active``,
+    drop sub-packages whose faults weren't requested (their generators
+    are None) — needed when composing with a suite's own fault menu,
+    whose op names would otherwise collide with the idle sub-nemeses.
     (reference: combined.clj:328-374)"""
-    return compose_packages(nemesis_packages(opts))
+    pkgs = nemesis_packages(opts)
+    if only_active:
+        pkgs = [p for p in pkgs if p.get("generator") is not None]
+    return compose_packages(pkgs)

@@ -68,6 +68,24 @@ def strobe_time(delta_ms: float, period_ms: float, duration_s: float) -> str:
         )
 
 
+def strobe_time_experiment(
+    delta_ms: float, period_ms: float, duration_s: float
+) -> str:
+    """The experimental one-sided strobe (true vs true+delta) that
+    reports its adjustment count; compiled on first use — it's not part
+    of the standard clock-nemesis toolkit.  (reference:
+    jepsen/resources/strobe-time-experiment.c, shipped but unwired
+    there too; native/strobe-time-experiment.c here)"""
+    with control.su():
+        compile_tool("strobe-time-experiment.c", "strobe-time-experiment")
+        return control.execute(
+            f"{BIN_DIR}/strobe-time-experiment",
+            str(int(delta_ms)),
+            str(int(period_ms)),
+            str(int(duration_s)),
+        )
+
+
 def reset_time() -> None:
     """Reset via ntpdate, falling back to date -s from the control
     host's clock.  (reference: nemesis/time.clj reset-time!)"""
@@ -121,6 +139,10 @@ class ClockNemesis(Nemesis):
                     value[node]["duration"],
                 ),
             )
+        elif f == "check-offsets":
+            # observation-only op: the offsets map IS the value
+            # (reference: nemesis/time.clj:108,126-130)
+            res = control.on_nodes(test, lambda t, n: current_offset())
         else:
             raise ValueError(f"clock nemesis cannot handle f={f!r}")
         clock_offsets = control.on_nodes(test, lambda t, n: current_offset())
@@ -130,7 +152,7 @@ class ClockNemesis(Nemesis):
         control.on_nodes(test, lambda t, n: reset_time())
 
     def fs(self):
-        return {"reset", "bump", "strobe"}
+        return {"reset", "bump", "strobe", "check-offsets"}
 
 
 def current_offset() -> Optional[float]:
@@ -203,8 +225,14 @@ def strobe_gen(test, ctx):
     }
 
 
+def check_offsets_gen(test, ctx):
+    """(reference: nemesis/time.clj:204)"""
+    return {"type": "info", "f": "check-offsets", "value": None}
+
+
 def clock_gen():
-    """Mix of reset/bump/strobe ops.  (reference: nemesis/time.clj:194-205)"""
+    """Mix of reset/bump/strobe/check-offsets ops.
+    (reference: nemesis/time.clj:194-205)"""
     from .. import generator as gen
 
-    return gen.mix([reset_gen, bump_gen, strobe_gen])
+    return gen.mix([reset_gen, bump_gen, strobe_gen, check_offsets_gen])

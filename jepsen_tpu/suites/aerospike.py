@@ -222,7 +222,179 @@ def test(opts: Optional[dict] = None) -> dict:
     wname = opts.get("workload", "cas-register")
     w = workloads(opts)[wname]
     c = CounterClient(opts) if wname == "counter" else CasRegisterClient(opts)
+    # the suite fault menu (capped kills + revive/recluster recovery)
+    # takes over when its fault names are requested
+    pkg = None
+    faults = set(opts.get("faults", ()))
+    if faults & KNOWN_FAULTS:
+        pkg = common.suite_nemesis_package(
+            opts, AerospikeDB(opts),
+            nemesis_package({
+                **opts,
+                "no-clocks": "clock-skew" not in faults,
+                "no-kills": not (faults & {"kill", "revive-recluster"}),
+                "no-partitions": "partition" not in faults,
+            }),
+            KNOWN_FAULTS,
+        )
     return common.build_test(
         f"aerospike-{wname}", opts, db=AerospikeDB(opts), client=c,
-        workload=w,
+        workload=w, nemesis_package=pkg,
     )
+
+
+# ---------------------------------------------------------------------
+# Suite nemesis: kills capped at max-dead, revive + recluster recovery
+# (reference: aerospike/src/aerospike/nemesis.clj:1-145)
+# ---------------------------------------------------------------------
+
+import threading as _threading
+
+from .. import generator as gen_mod
+from ..nemesis import Nemesis, compose, partition_random_halves
+from ..nemesis import time as nt
+from ..util import random_nonempty_subset
+
+
+class AsKillNemesis(Nemesis):
+    """kill (capped at ``max_dead`` simultaneously-dead nodes),
+    restart, and the asinfo revive/recluster recovery pair.
+    (reference: nemesis.clj:17-57 kill-nemesis; revive!/recluster! from
+    support.clj:142-152)"""
+
+    def __init__(self, signal: int = 9, max_dead: int = 2):
+        self.signal = signal
+        self.max_dead = max_dead
+        self.dead: set = set()
+        self._lock = _threading.Lock()
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        from .. import control
+        from ..control import execute, sudo
+
+        f = op["f"]
+        targets = op.get("value") or list(test["nodes"])
+
+        def act(test, node):
+            if f == "kill":
+                with self._lock:
+                    # the cap keeps a quorum alive (capped-conj,
+                    # nemesis.clj:11-15)
+                    if node not in self.dead and len(self.dead) >= self.max_dead:
+                        return "still-alive"
+                    self.dead.add(node)
+                with sudo():
+                    execute("killall", f"-{self.signal}", "asd", check=False)
+                return "killed"
+            if f == "restart":
+                with sudo():
+                    execute("service", "aerospike", "restart", check=False)
+                with self._lock:
+                    self.dead.discard(node)
+                return "started"
+            if f == "revive":
+                with sudo():
+                    return execute(
+                        "asinfo", "-v", f"revive:namespace={NAMESPACE}",
+                        check=False,
+                    )
+            if f == "recluster":
+                with sudo():
+                    return execute("asinfo", "-v", "recluster:", check=False)
+            raise ValueError(f"unknown f {f!r}")
+
+        res = control.on_nodes(test, targets, act)
+        return {**op, "type": "info",
+                "value": {str(k): str(v) for k, v in res.items()}}
+
+    def teardown(self, test):
+        pass
+
+    def fs(self):
+        return {"kill", "restart", "revive", "recluster"}
+
+
+def full_nemesis(opts: dict) -> Nemesis:
+    """(reference: nemesis.clj:97-111 full-nemesis)"""
+    return compose([
+        ({"partition-start": "start", "partition-stop": "stop"},
+         partition_random_halves()),
+        ({"kill", "restart", "revive", "recluster"},
+         AsKillNemesis(
+             signal=15 if opts.get("clean-kill") else 9,
+             max_dead=opts.get("max-dead-nodes", 2),
+         )),
+        ({"clock-reset": "reset", "clock-bump": "bump",
+          "clock-strobe": "strobe"},
+         nt.clock_nemesis()),
+    ])
+
+
+def _killer_gen(test, ctx):
+    """One random step of the kill / restart / revive+recluster dance.
+    (reference: nemesis.clj:59-94 killer-gen)"""
+    r = gen_mod.rng.random()
+    nodes = list(test["nodes"])
+    if r < 1 / 3:
+        return {"type": "info", "f": "kill",
+                "value": random_nonempty_subset(nodes, gen_mod.rng)}
+    if r < 2 / 3:
+        return {"type": "info", "f": "restart",
+                "value": random_nonempty_subset(nodes, gen_mod.rng)}
+    return {"type": "info", "f": "revive", "value": nodes}
+
+
+def full_gen(opts: dict):
+    """(reference: nemesis.clj:113-126 full-gen)"""
+    mix = []
+    if not opts.get("no-clocks"):
+        mix.append(gen_mod.f_map(
+            {"strobe": "clock-strobe", "reset": "clock-reset",
+             "bump": "clock-bump"},
+            nt.clock_gen(),
+        ))
+    if not opts.get("no-kills"):
+        # revive is followed by recluster via flip-flop so the pair
+        # lands together like the reference's [revive-gen recluster-gen]
+        mix.append(gen_mod.flip_flop(
+            _killer_gen,
+            gen_mod.repeat({"type": "info", "f": "recluster", "value": None}),
+        ))
+    if not opts.get("no-partitions"):
+        mix.append(gen_mod.cycle([
+            {"type": "info", "f": "partition-start", "value": None},
+            {"type": "info", "f": "partition-stop", "value": None},
+        ]))
+    if not mix:
+        return None
+    return gen_mod.stagger(
+        opts.get("interval", 10), gen_mod.mix(mix)
+    )
+
+
+def nemesis_package(opts: dict) -> dict:
+    """(reference: nemesis.clj:128-145 full)"""
+    return {
+        "nemesis": full_nemesis(opts),
+        "generator": full_gen(opts),
+        "final_generator": [
+            {"type": "info", "f": "partition-stop", "value": None},
+            {"type": "info", "f": "clock-reset", "value": None},
+            {"type": "info", "f": "restart", "value": None},
+            {"type": "info", "f": "revive", "value": None},
+            {"type": "info", "f": "recluster", "value": None},
+        ],
+        "perf": {
+            ("kill", frozenset({"kill"}), frozenset({"restart"}),
+             "#E9A4A0"),
+            ("partition", frozenset({"partition-start"}),
+             frozenset({"partition-stop"}), "#A0E9DB"),
+        },
+    }
+
+
+#: fault names routing test() to the suite package
+KNOWN_FAULTS = {"kill", "partition", "clock-skew", "revive-recluster"}

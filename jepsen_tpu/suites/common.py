@@ -232,6 +232,43 @@ def generic_workload(name: str, opts: Optional[dict] = None) -> dict:
 # ---------------------------------------------------------------------
 
 
+def suite_nemesis_package(
+    opts: dict, db, suite_pkg: dict, known: set
+) -> dict:
+    """Combine a suite's own fault menu with the generic packages for
+    any requested faults the menu doesn't cover.  Silently dropping the
+    leftovers would report results for fault scenarios never exercised;
+    if the two packages' op namespaces collide, this raises instead.
+    """
+    faults = set(opts.get("faults", ()))
+    claimed = faults & known
+    if opts.get("partition-targets") and claimed & {
+        "partition", "partition-one", "partition-half", "partition-ring"
+    }:
+        raise ValueError(
+            "partition-targets is not supported by this suite's fault "
+            "menu; use the generic partition fault without the suite's "
+            "partition names"
+        )
+    leftover = sorted(faults - known)
+    if not leftover:
+        return suite_pkg
+    rest_opts = {
+        **{k: v for k, v in opts.items() if k != "faults"},
+        "db": db,
+        "faults": leftover,
+        "interval": opts.get("interval", combined.DEFAULT_INTERVAL),
+    }
+    rest = combined.nemesis_package(rest_opts, only_active=True)
+    try:
+        return combined.compose_packages([suite_pkg, rest])
+    except ValueError as e:
+        raise ValueError(
+            f"faults {leftover} cannot run alongside this suite's fault "
+            f"menu ({sorted(claimed)}): {e}"
+        ) from e
+
+
 def build_test(
     name: str,
     opts: Optional[dict],
@@ -239,6 +276,7 @@ def build_test(
     db: db_mod.DB,
     client: client_mod.Client,
     workload: dict,
+    nemesis_package: Optional[dict] = None,
 ) -> dict:
     """Merge a suite's db + client + workload (+ standard nemesis
     packages from opts["faults"]) into a full runnable test map — the
@@ -259,6 +297,13 @@ def build_test(
             "store?": opts.get("store?", False),
         }
     )
+    # standard harness opts must flow through, or suite runs lose their
+    # store location / logging flags (the CLI merges these into opts;
+    # reference: cli.clj test-opt-fn feeding every suite's test map)
+    for k in ("store-base", "leave-db-running?", "logging-json?", "ssh",
+              "remote", "time-limit"):
+        if k in opts:
+            test[k] = opts[k]
     if "nodes" in opts:
         test["nodes"] = list(opts["nodes"])
     test.update({k: v for k, v in workload.items() if k not in ("generator", "final-generator", "checker")})
@@ -274,15 +319,20 @@ def build_test(
         }
     )
 
-    # Nemesis package from fault spec (reference: nemesis/combined.clj:328)
-    pkg_opts = {
-        "db": db,
-        "faults": opts.get("faults", []),
-        "interval": opts.get("interval", combined.DEFAULT_INTERVAL),
-    }
-    if opts.get("partition-targets"):
-        pkg_opts["partition"] = {"targets": opts["partition-targets"]}
-    pkg = combined.nemesis_package(pkg_opts)
+    # Nemesis package from fault spec (reference: nemesis/combined.clj:328);
+    # suites with their own fault menus (e.g. yugabyte's master/tserver
+    # targeting) pass a pre-built package instead
+    if nemesis_package is not None:
+        pkg = nemesis_package
+    else:
+        pkg_opts = {
+            "db": db,
+            "faults": opts.get("faults", []),
+            "interval": opts.get("interval", combined.DEFAULT_INTERVAL),
+        }
+        if opts.get("partition-targets"):
+            pkg_opts["partition"] = {"targets": opts["partition-targets"]}
+        pkg = combined.nemesis_package(pkg_opts)
     test["nemesis"] = pkg.get("nemesis") or test["nemesis"]
 
     # Generator: rate-staggered client ops raced with the nemesis

@@ -1,0 +1,192 @@
+"""FaunaDB topology churn as a membership state machine: nodes join and
+leave replicas while the workload runs, with the invariant that no
+replica is ever emptied.
+
+Reference: faunadb/src/jepsen/faunadb/topology.clj — initial-topology
+(:12-28: nodes round-robined over ``replica-<i>`` names), add-ops
+(:103-113: any test node not in the active topology may join at a random
+active node), remove-ops (:115-137: only nodes whose replica keeps ≥1
+other node are removable), rand-op's even add/remove mixing (:165-180),
+and apply-op's best-effort state transitions (:182-207).  The cluster
+actions ride faunadb-admin the way the reference's topology nemesis does
+(faunadb/nemesis.clj join!/remove!).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .. import control
+from .. import generator as gen
+from ..control import execute, sudo
+from ..nemesis.membership import MembershipGenerator, MembershipNemesis, State
+
+
+def replica_name(i: int) -> str:
+    return f"replica-{i}"
+
+
+class FaunaTopology(State):
+    """The membership State implementation.  ``topo`` is
+    {replica_count, nodes: [{node, state, replica}]}; invoke applies
+    join/leave via faunadb-admin and evolves the model."""
+
+    def __init__(self, replicas: int = 2):
+        self.replicas = replicas
+        self.topo: Dict[str, Any] = {}
+
+    # -- model helpers (reference: topology.clj:30-101) ----------------
+
+    def active_nodes(self) -> List[Any]:
+        return [
+            n["node"] for n in self.topo["nodes"] if n["state"] == "active"
+        ]
+
+    def nodes_by_replica(self) -> Dict[str, List[Any]]:
+        out: Dict[str, List[Any]] = {}
+        for n in self.topo["nodes"]:
+            if n["state"] == "active":
+                out.setdefault(n["replica"], []).append(n["node"])
+        return out
+
+    # -- State protocol ------------------------------------------------
+
+    def setup(self, test):
+        self.topo = {
+            "replica_count": self.replicas,
+            "nodes": [
+                {"node": node, "state": "active",
+                 "replica": replica_name(i % self.replicas)}
+                for i, node in enumerate(test["nodes"])
+            ],
+        }
+        return self
+
+    def fs(self):
+        return {"add-node", "remove-node"}
+
+    def node_view(self, test, node):
+        # best-effort: ask the node for its cluster status; unreachable
+        # or dummy nodes report None (unknown), like the reference's
+        # status parsing (faunadb/auto.clj status)
+        try:
+            out = execute("faunadb-admin", "status", check=False)
+            return str(out) or None
+        except Exception:  # noqa: BLE001 — view refresh must not crash
+            return None
+
+    def merge_views(self, test):
+        return self.topo
+
+    def op(self, test):
+        """An add or remove op, mixed evenly by *type* like rand-op
+        (topology.clj:165-180); "pending" when neither is possible."""
+        adds = self._add_ops(test)
+        removes = self._remove_ops()
+        choices = [ops for ops in (adds, removes) if ops]
+        if not choices:
+            return "pending"
+        ops = gen.rng.choice(choices)
+        return gen.rng.choice(ops)
+
+    def _add_ops(self, test):
+        active = set(self.active_nodes())
+        if not active:
+            return []
+        joinable = sorted(set(test["nodes"]) - {
+            n["node"] for n in self.topo["nodes"]
+        })
+        return [
+            {"type": "info", "f": "add-node",
+             "value": {"node": node,
+                       "join": gen.rng.choice(sorted(active))}}
+            for node in joinable
+        ]
+
+    def _remove_ops(self):
+        removable = [
+            node
+            for nodes in self.nodes_by_replica().values()
+            if len(nodes) > 1
+            for node in nodes
+        ]
+        return [
+            {"type": "info", "f": "remove-node", "value": node}
+            for node in sorted(removable)
+        ]
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "add-node":
+            node = op["value"]["node"]
+            join_target = op["value"]["join"]
+
+            def join(test, n):
+                with sudo():
+                    return execute(
+                        "faunadb-admin", "join", str(join_target),
+                        check=False,
+                    )
+
+            res = control.on_nodes(test, [node], join)
+            topo = dict(self.topo)
+            topo["nodes"] = list(topo["nodes"]) + [{
+                "node": node, "state": "active",
+                "replica": replica_name(
+                    gen.rng.randrange(topo["replica_count"])
+                ),
+            }]
+            self.topo = topo
+            return {**op, "type": "info",
+                    "value": {**op["value"],
+                              "result": str(res.get(node))}}
+        if f == "remove-node":
+            node = op["value"]
+            # issue the removal from a surviving active node
+            others = [n for n in self.active_nodes() if n != node]
+            if not others:
+                return {**op, "type": "fail", "error": "no active peer"}
+
+            def remove(test, n):
+                with sudo():
+                    return execute(
+                        "faunadb-admin", "remove", str(node), check=False
+                    )
+
+            res = control.on_nodes(test, [others[0]], remove)
+            topo = dict(self.topo)
+            topo["nodes"] = [
+                n for n in topo["nodes"] if n["node"] != node
+            ]
+            self.topo = topo
+            return {**op, "type": "info",
+                    "value": {"node": node,
+                              "result": str(res.get(others[0]))}}
+        raise ValueError(f"unknown f {f!r}")
+
+    def resolve(self, test):
+        return self
+
+    def resolve_op(self, test, op_pair):
+        # transitions apply optimistically in invoke(); ops resolve
+        # immediately (the reference calls this whole dance
+        # "best-effort", topology.clj:188-196)
+        return self
+
+    def teardown(self, test):
+        pass
+
+
+def package(opts: dict, replicas: Optional[int] = None) -> dict:
+    """A {nemesis, generator} bundle for build_test.
+    (reference: faunadb topology nemesis wiring in faunadb/runner.clj)"""
+    state = FaunaTopology(replicas or opts.get("replicas", 2))
+    nem = MembershipNemesis(state, opts)
+    return {
+        "nemesis": nem,
+        "generator": gen.stagger(
+            opts.get("interval", 10), MembershipGenerator(nem)
+        ),
+        "final_generator": None,
+        "perf": set(),
+    }

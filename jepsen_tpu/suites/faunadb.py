@@ -224,8 +224,18 @@ def test(opts: Optional[dict] = None) -> dict:
         "pages": FaunaPagesClient,
         "monotonic": FaunaMonotonicClient,
     }.get(wname, FaunaClient)(opts)
+    # topology churn rides the membership state machine
+    # (reference: faunadb/topology.clj via nemesis.clj)
+    pkg = None
+    if "topology" in set(opts.get("faults", ())):
+        from . import fauna_topology
+
+        pkg = common.suite_nemesis_package(
+            opts, FaunaDB(opts), fauna_topology.package(opts), {"topology"}
+        )
     return common.build_test(
         f"faunadb-{wname}", opts, db=FaunaDB(opts), client=c, workload=w,
+        nemesis_package=pkg,
     )
 
 

@@ -22,7 +22,7 @@ from .. import client as client_mod
 from .. import independent
 from ..control import util as cu
 from ..control import execute, sudo
-from . import common, sql
+from . import common, sql, yb_nemesis
 from .proto import IndeterminateError
 from .proto.cql import CqlClient, CqlError
 
@@ -67,18 +67,33 @@ class YugabyteDB(common.DaemonDB):
             execute(f"{DIR}/bin/post_install.sh", check=False)
 
     def start(self, test, node):
-        masters = self.master_addresses(test)
         if node in self.master_nodes(test):
-            cu.start_daemon(
-                {"logfile": self.master_logfile,
-                 "pidfile": self.master_pidfile, "chdir": DIR},
-                f"{DIR}/bin/yb-master",
-                "--master_addresses", masters,
-                "--rpc_bind_addresses", f"{node}:{MASTER_RPC_PORT}",
-                "--fs_data_dirs", f"{DIR}/data/master",
-                "--replication_factor", str(self.rf),
-            )
+            self.start_master(test, node)
             cu.await_tcp_port(MASTER_RPC_PORT, timeout_s=120)
+        self.start_tserver(test, node)
+
+    # granular component control — the per-suite nemesis targets
+    # masters and tservers separately (reference: auto.clj
+    # start-master!/start-tserver!/stop-*/kill-*, consumed by
+    # yugabyte/nemesis.clj:12-46 process-nemesis)
+
+    def start_master(self, test, node):
+        if node not in self.master_nodes(test):
+            return "not a master node"
+        masters = self.master_addresses(test)
+        cu.start_daemon(
+            {"logfile": self.master_logfile,
+             "pidfile": self.master_pidfile, "chdir": DIR},
+            f"{DIR}/bin/yb-master",
+            "--master_addresses", masters,
+            "--rpc_bind_addresses", f"{node}:{MASTER_RPC_PORT}",
+            "--fs_data_dirs", f"{DIR}/data/master",
+            "--replication_factor", str(self.rf),
+        )
+        return "started"
+
+    def start_tserver(self, test, node):
+        masters = self.master_addresses(test)
         cu.start_daemon(
             {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
             f"{DIR}/bin/yb-tserver",
@@ -89,6 +104,23 @@ class YugabyteDB(common.DaemonDB):
             "--pgsql_proxy_bind_address", f"0.0.0.0:{YSQL_PORT}",
             "--cql_proxy_bind_address", f"0.0.0.0:{YCQL_PORT}",
         )
+        return "started"
+
+    def stop_master(self, test, node):
+        cu.stop_daemon(pidfile=self.master_pidfile, cmd="yb-master")
+        return "stopped"
+
+    def stop_tserver(self, test, node):
+        cu.stop_daemon(pidfile=self.pidfile, cmd="yb-tserver")
+        return "stopped"
+
+    def kill_master(self, test, node):
+        cu.grepkill("yb-master", 9)
+        return "killed"
+
+    def kill_tserver(self, test, node):
+        cu.grepkill("yb-tserver", 9)
+        return "killed"
 
     def kill(self, test, node):
         cu.stop_daemon(pidfile=self.pidfile, cmd="yb-tserver")
@@ -367,9 +399,20 @@ def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "ycql.register")
     w = workloads(opts)[wname]
+    db_ = YugabyteDB(opts)
+    # the suite fault menu (master/tserver targeting, partition
+    # geometries, clock skew) takes over when any of its fault names is
+    # requested (reference: yugabyte/nemesis.clj:240-247)
+    pkg = None
+    if set(opts.get("faults", ())) & yb_nemesis.KNOWN_FAULTS:
+        pkg = common.suite_nemesis_package(
+            opts, db_, yb_nemesis.package(opts, db_),
+            yb_nemesis.KNOWN_FAULTS,
+        )
     return common.build_test(
-        f"yugabyte-{wname}", opts, db=YugabyteDB(opts),
+        f"yugabyte-{wname}", opts, db=db_,
         client=_client_for(wname, opts), workload=w,
+        nemesis_package=pkg,
     )
 
 

@@ -13,6 +13,59 @@ from ... import generator as gen
 from ...checker import Checker
 
 
+def _fmt_anomaly_item(item: Any) -> str:
+    """One anomaly instance as readable text: witness cycles render as
+    step chains, everything else as indented JSON."""
+    import json
+
+    if isinstance(item, dict) and "steps" in item:
+        lines = ["Cycle:"]
+        for s in item["steps"]:
+            rels = ",".join(s.get("rels", []))
+            lines.append(f"  {s.get('from')} -[{rels}]-> {s.get('to')}")
+        return "\n".join(lines)
+    return json.dumps(item, indent=2, default=repr)
+
+
+def write_anomaly_artifacts(test, result: dict, opts=None) -> None:
+    """Persist one explanation file per anomaly type under
+    ``<store>/<test>/<time>/elle/`` so the web UI's directory browser
+    surfaces them next to results.json — the artifact the reference
+    gets from Elle's :directory option (consumed at
+    jepsen/src/jepsen/tests/cycle.clj:10-16).  Only runs when the test
+    has a real store identity; adds the written paths to the result as
+    "anomaly-files"."""
+    if not (test and test.get("name") and test.get("start-time")):
+        return
+    anomalies = {
+        **(result.get("anomalies") or {}),
+        **(result.get("also-anomalies") or {}),
+    }
+    if not anomalies:
+        return
+    from ... import store as store_mod
+
+    paths: List[str] = []
+    try:
+        for name, items in sorted(anomalies.items()):
+            p = store_mod.path_(
+                test,
+                *(opts or {}).get("subdirectory", []),
+                "elle",
+                f"{name}.txt",
+            )
+            with open(p, "w") as f:
+                f.write(f"{name}: {len(items)} instance(s)\n\n")
+                for i, item in enumerate(items):
+                    f.write(f"--- instance {i} ---\n")
+                    f.write(_fmt_anomaly_item(item))
+                    f.write("\n\n")
+            paths.append(p)
+        result["anomaly-files"] = paths
+    except Exception as e:  # noqa: BLE001 — never mask the verdict
+        result["anomaly-files-error"] = repr(e)
+
+
 class _ElleChecker(Checker):
     def __init__(self, workload: str, opts: Optional[dict]):
         self.workload = workload
@@ -21,9 +74,11 @@ class _ElleChecker(Checker):
     def check(self, test, history, opts=None):
         from ... import elle
 
-        return elle.check(
+        out = elle.check(
             {**self.opts, "workload": self.workload}, history
         )
+        write_anomaly_artifacts(test, out, opts)
+        return out
 
 
 def checker(workload: str, opts: Optional[dict] = None) -> Checker:

@@ -1,0 +1,86 @@
+/* strobe-time-experiment: experimental strobe variant that toggles the
+ * wall clock between its true offset and true+delta every period ms for
+ * duration seconds, then restores the clock and prints how many
+ * adjustments it made.
+ *
+ * Usage: strobe-time-experiment <delta-ms> <period-ms> <duration-s>
+ *
+ * Differs from strobe-time in two ways it inherits from the reference's
+ * resources/strobe-time-experiment.c (re-implemented): the oscillation
+ * is one-sided (true vs true+delta, not +/-delta around true), and the
+ * adjustment count is reported on stdout so callers can confirm the
+ * strobe actually ran.  Requires CAP_SYS_TIME.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+#include <sys/time.h>
+
+static long long now_ns(clockid_t clk) {
+  struct timespec ts;
+  clock_gettime(clk, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+/* wall = monotonic + offset_ns */
+static int set_wall_from_mono(long long offset_ns) {
+  long long wall = now_ns(CLOCK_MONOTONIC) + offset_ns;
+  struct timeval tv;
+  tv.tv_sec = wall / 1000000000LL;
+  tv.tv_usec = (wall % 1000000000LL) / 1000;
+  return settimeofday(&tv, NULL);
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <delta-ms> <period-ms> <duration-s>\n"
+            "Every period ms, toggles the clock between true time and\n"
+            "true+delta, for duration seconds; prints the number of\n"
+            "adjustments made.\n",
+            argv[0]);
+    return 1;
+  }
+  long long delta_ns = (long long)(atof(argv[1]) * 1e6);
+  long long period_ns = (long long)(atof(argv[2]) * 1e6);
+  long long duration_ns = (long long)(atof(argv[3]) * 1e9);
+  if (period_ns <= 0) {
+    fprintf(stderr, "period must be positive\n");
+    return 1;
+  }
+
+  /* The clock's honest relationship to the monotonic timeline, captured
+   * once up front so we can both strobe around it and restore it. */
+  long long true_offset = now_ns(CLOCK_REALTIME) - now_ns(CLOCK_MONOTONIC);
+  long long end = now_ns(CLOCK_MONOTONIC) + duration_ns;
+
+  struct timespec period = {
+    .tv_sec = period_ns / 1000000000LL,
+    .tv_nsec = period_ns % 1000000000LL,
+  };
+  int weird = 0;
+  long long count = 0;
+
+  while (now_ns(CLOCK_MONOTONIC) < end) {
+    if (0 != set_wall_from_mono(weird ? true_offset
+                                      : true_offset + delta_ns)) {
+      perror("settimeofday");
+      return 2;
+    }
+    weird = !weird;
+    count++;
+    if (0 != nanosleep(&period, NULL)) {
+      perror("nanosleep");
+      return 3;
+    }
+  }
+
+  if (0 != set_wall_from_mono(true_offset)) {
+    perror("settimeofday");
+    return 2;
+  }
+  printf("%lld\n", count);
+  return 0;
+}

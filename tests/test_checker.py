@@ -465,3 +465,79 @@ def test_linearizable_race_mode():
     )
     rq = q.check({}, qh)
     assert rq["valid?"] is True and rq["engine"] == "oracle"
+
+
+# -- race-mode hung-arm behavior --------------------------------------------
+
+
+def _small_valid_history():
+    from jepsen_tpu.history import History, invoke_op, ok_op
+
+    ops = [
+        invoke_op(0, "write", 1, time=0), ok_op(0, "write", 1, time=1),
+        invoke_op(1, "read", None, time=2), ok_op(1, "read", 1, time=3),
+    ]
+    h = History(ops)
+    return h.index_ops()
+
+
+def test_race_hung_kernel_arm_oracle_wins_promptly(monkeypatch):
+    """A kernel arm that blocks forever must not delay the oracle's
+    definite verdict, and must leak no non-daemon thread."""
+    import threading
+    import time
+
+    from jepsen_tpu import checker as checker_mod
+    from jepsen_tpu import models
+    from jepsen_tpu.ops import wgl
+
+    def hang_forever(*a, **kw):
+        threading.Event().wait()
+
+    monkeypatch.setattr(wgl, "analysis", hang_forever)
+    before = set(threading.enumerate())
+    ck = checker_mod.linearizable(models.cas_register(0), algorithm="race")
+    t0 = time.perf_counter()
+    res = ck.check({}, _small_valid_history())
+    elapsed = time.perf_counter() - t0
+    assert res["valid?"] is True, res
+    assert res.get("engine") == "oracle"
+    assert elapsed < 10, f"oracle win took {elapsed:.1f}s"
+    leaked = [
+        t for t in set(threading.enumerate()) - before if not t.daemon
+    ]
+    assert not leaked, leaked
+
+
+def test_race_hung_arm_with_indefinite_winner_respects_loser_wait(monkeypatch):
+    """When the only answer in hand is indefinite ("unknown") and the
+    other arm hangs, the race must settle after the (overridden)
+    loser-wait rather than stalling the full 60 s default."""
+    import threading
+    import time
+
+    from jepsen_tpu import checker as checker_mod
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    def hang_forever(*a, **kw):
+        threading.Event().wait()
+
+    def unknown_analysis(*a, **kw):
+        return {"valid?": "unknown", "error": "synthetic"}
+
+    monkeypatch.setattr(wgl, "analysis", hang_forever)
+    monkeypatch.setattr(linear, "analysis", unknown_analysis)
+    monkeypatch.setattr(checker_mod, "RACE_LOSER_WAIT_S", 0.3)
+    before = set(threading.enumerate())
+    ck = checker_mod.linearizable(models.cas_register(0), algorithm="race")
+    t0 = time.perf_counter()
+    res = ck.check({}, _small_valid_history())
+    elapsed = time.perf_counter() - t0
+    assert res["valid?"] == "unknown", res
+    assert elapsed < 5, f"hung loser stalled the race {elapsed:.1f}s"
+    leaked = [
+        t for t in set(threading.enumerate()) - before if not t.daemon
+    ]
+    assert not leaked, leaked

@@ -823,3 +823,40 @@ def test_find_nonadjacent_cycle_rejects_nonsimple_walks():
         rest=lambda r: bool(r & {WW, WR}),
     )
     assert cyc is None or len(set(cyc[:-1])) == len(cyc) - 1
+
+
+def test_elle_checker_writes_anomaly_artifacts(tmp_path):
+    """Anomaly explanations land as per-type files under the test's
+    store dir (reference consumption: tests/cycle.clj:10-16 via Elle's
+    :directory option), where the web UI's dir browser lists them."""
+    import os
+
+    from jepsen_tpu.workloads.cycle import checker as elle_checker
+
+    # G1c: T1 writes x=1 and reads y=1; T2 writes y=1 and reads x=1 —
+    # wr cycle between them
+    h = hist(
+        txn_pair(0, [["w", "x", 1], ["r", "y", None]],
+                 [["w", "x", 1], ["r", "y", 2]], 0),
+        txn_pair(1, [["w", "y", 2], ["r", "x", None]],
+                 [["w", "y", 2], ["r", "x", 1]], 1),
+    )
+    test = {
+        "name": "elle-artifacts",
+        "start-time": "20260730T000000",
+        "store-base": str(tmp_path),
+    }
+    ck = elle_checker("rw-register", {"consistency-models": ["serializable"]})
+    res = ck.check(test, h)
+    assert res["valid?"] is False
+    files = res.get("anomaly-files")
+    assert files, res
+    for p in files:
+        assert os.path.exists(p)
+        assert f"{os.sep}elle{os.sep}" in p
+    body = open(files[0]).read()
+    assert "Cycle:" in body and "-[" in body
+
+    # unit-style checks on bare test maps write nothing
+    res2 = ck.check({}, h)
+    assert "anomaly-files" not in res2

@@ -1,0 +1,254 @@
+"""Per-suite nemesis tests: yugabyte master/tserver targeting, fauna
+topology churn on the membership state machine, aerospike capped kills
+with revive/recluster — all against dummy remotes (reference:
+yugabyte/nemesis.clj, faunadb/topology.clj, aerospike/nemesis.clj)."""
+
+import contextlib
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control.core import DummyRemote
+
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def dummy_test(**extra):
+    return {"nodes": list(NODES), "remote": DummyRemote(),
+            "ssh": {"dummy?": True}, **extra}
+
+
+@contextlib.contextmanager
+def sessions(test):
+    with control.with_session(test, test["remote"]):
+        yield
+
+
+# -- yugabyte ---------------------------------------------------------------
+
+
+def test_yb_process_nemesis_targets_components():
+    from jepsen_tpu.suites import yb_nemesis, yugabyte
+
+    db = yugabyte.YugabyteDB({"replication-factor": 3})
+    t = dummy_test(db=db)
+    with sessions(t):
+        nem = yb_nemesis.YbProcessNemesis(db).setup(t)
+        masters = db.master_nodes(t)
+        assert masters == ["n1", "n2", "n3"]
+
+        res = nem.invoke(t, {"type": "info", "f": "kill-master",
+                             "value": None})
+        assert res["type"] == "info"
+        assert set(res["value"]) <= set(masters), res
+
+        res = nem.invoke(t, {"type": "info", "f": "kill-tserver",
+                             "value": None})
+        assert set(res["value"]) <= set(NODES)
+
+        # recovery ops hit every relevant node
+        res = nem.invoke(t, {"type": "info", "f": "start-master",
+                             "value": None})
+        assert sorted(res["value"]) == masters
+        res = nem.invoke(t, {"type": "info", "f": "start-tserver",
+                             "value": None})
+        assert sorted(res["value"]) == NODES
+
+        res = nem.invoke(t, {"type": "info", "f": "pause-tserver",
+                             "value": None})
+        assert res["type"] == "info"
+
+
+def test_yb_full_nemesis_routes_partitions_and_clock():
+    from jepsen_tpu.suites import yb_nemesis, yugabyte
+
+    db = yugabyte.YugabyteDB({})
+    t = dummy_test(db=db)
+    nem = yb_nemesis.full_nemesis(db)
+    fs = nem.fs()
+    for f in ("kill-master", "start-partition", "stop-partition",
+              "bump-clock", "reset-clock"):
+        assert f in fs, f
+    with sessions(t):
+        nem = nem.setup(t)
+        grudge = {"n1": {"n2"}, "n2": {"n1"}}
+        res = nem.invoke(t, {"type": "info", "f": "start-partition",
+                             "value": grudge})
+        assert res["f"] == "start-partition"
+        res = nem.invoke(t, {"type": "info", "f": "stop-partition",
+                             "value": None})
+        assert res["f"] == "stop-partition"
+        nem.teardown(t)
+
+
+def test_yb_generators_expand_and_recover():
+    from jepsen_tpu.suites import yb_nemesis
+
+    n = yb_nemesis.expand_options({"kill": True, "partition": True,
+                                   "clock-skew": True, "interval": 0.01})
+    assert n["kill-master"] and n["kill-tserver"]
+    assert n["partition-ring"]
+    g = yb_nemesis.full_generator(n)
+    assert g is not None
+    final = yb_nemesis.final_generator(n)
+    fs = [op["f"] for op in final]
+    assert "start-tserver" in fs and "start-master" in fs
+    assert "stop-partition" in fs and "reset-clock" in fs
+
+    # partition generators produce grudges over the test's nodes
+    t = dummy_test()
+    op = yb_nemesis.partition_ring_gen(t, {})
+    assert op["f"] == "start-partition"
+    assert set(op["value"]) == set(NODES)
+
+
+def test_yb_suite_test_uses_fault_menu():
+    from jepsen_tpu.suites import yugabyte
+
+    t = yugabyte.test({
+        "nodes": NODES, "workload": "ycql.register",
+        "faults": ["kill-master", "partition-one"], "time-limit": 5,
+    })
+    fs = t["nemesis"].fs()
+    assert "kill-master" in fs and "start-partition" in fs
+    assert t["generator"] is not None
+
+
+# -- fauna topology ---------------------------------------------------------
+
+
+def test_fauna_topology_state_machine():
+    from jepsen_tpu.suites.fauna_topology import FaunaTopology
+
+    t = dummy_test(replicas=2)
+    with sessions(t):
+        st = FaunaTopology(replicas=2).setup(t)
+        by_rep = st.nodes_by_replica()
+        assert set(by_rep) == {"replica-0", "replica-1"}
+        assert sorted(sum(by_rep.values(), [])) == NODES
+
+        # with every node active, only removes are possible
+        op = st.op(t)
+        assert op["f"] == "remove-node"
+
+        # removing nodes never empties a replica
+        while True:
+            removes = st._remove_ops()
+            if not removes:
+                break
+            res = st.invoke(t, removes[0])
+            assert res["type"] == "info"
+            for nodes in st.nodes_by_replica().values():
+                assert len(nodes) >= 1
+        # converged: every replica is at its 1-node floor
+        assert all(
+            len(ns) == 1 for ns in st.nodes_by_replica().values()
+        )
+        # removed nodes can now rejoin
+        adds = st._add_ops(t)
+        assert adds
+        res = st.invoke(t, adds[0])
+        assert res["type"] == "info"
+        assert adds[0]["value"]["node"] in {
+            n["node"] for n in st.topo["nodes"]
+        }
+
+
+def test_fauna_topology_package_multi_node_dummy_run():
+    """Drive the membership nemesis end-to-end against dummy remotes:
+    ops flow through MembershipNemesis.invoke and the topology keeps
+    its invariants."""
+    from jepsen_tpu.suites import fauna_topology
+
+    t = dummy_test(replicas=2)
+    pkg = fauna_topology.package({"interval": 0.01, "replicas": 2})
+    with sessions(t):
+        nem = pkg["nemesis"].setup(t)
+        try:
+            state = nem.state
+            for _ in range(8):
+                op = state.op(t)
+                if op == "pending":
+                    break
+                out = nem.invoke(t, dict(op))
+                assert out["type"] in ("info", "fail"), out
+                for nodes in state.nodes_by_replica().values():
+                    assert len(nodes) >= 1
+        finally:
+            nem.teardown(t)
+
+
+def test_fauna_suite_test_wires_topology_package():
+    from jepsen_tpu.suites import faunadb
+
+    t = faunadb.test({
+        "nodes": NODES, "workload": "register",
+        "faults": ["topology"], "time-limit": 5,
+    })
+    assert "add-node" in t["nemesis"].fs()
+    assert t["generator"] is not None
+
+
+# -- aerospike --------------------------------------------------------------
+
+
+def test_aerospike_kill_nemesis_caps_dead_nodes():
+    from jepsen_tpu.suites import aerospike
+
+    t = dummy_test()
+    with sessions(t):
+        nem = aerospike.AsKillNemesis(max_dead=2).setup(t)
+        res = nem.invoke(t, {"type": "info", "f": "kill",
+                             "value": ["n1", "n2", "n3", "n4"]})
+        vals = res["value"]
+        assert sum(1 for v in vals.values() if v == "killed") == 2
+        assert sum(1 for v in vals.values() if v == "still-alive") == 2
+        assert len(nem.dead) == 2
+
+        # restart frees the cap
+        res = nem.invoke(t, {"type": "info", "f": "restart",
+                             "value": sorted(nem.dead)})
+        assert all(v == "started" for v in res["value"].values())
+        assert not nem.dead
+
+        # revive/recluster run on every node without error
+        res = nem.invoke(t, {"type": "info", "f": "revive", "value": None})
+        assert sorted(res["value"]) == NODES
+        res = nem.invoke(t, {"type": "info", "f": "recluster",
+                             "value": None})
+        assert sorted(res["value"]) == NODES
+
+
+def test_aerospike_full_nemesis_and_package():
+    from jepsen_tpu.suites import aerospike
+
+    t = dummy_test()
+    pkg = aerospike.nemesis_package({"max-dead-nodes": 2, "interval": 0.01})
+    with sessions(t):
+        nem = pkg["nemesis"].setup(t)
+        fs = nem.fs()
+        for f in ("kill", "restart", "revive", "recluster",
+                  "partition-start", "partition-stop", "clock-reset"):
+            assert f in fs, f
+        res = nem.invoke(t, {"type": "info", "f": "partition-start",
+                             "value": None})
+        assert res["f"] == "partition-start"
+        nem.invoke(t, {"type": "info", "f": "partition-stop",
+                       "value": None})
+        nem.teardown(t)
+    assert pkg["generator"] is not None
+    finals = [op["f"] for op in pkg["final_generator"]]
+    assert finals[-2:] == ["revive", "recluster"]
+
+
+def test_aerospike_suite_test_uses_fault_menu():
+    from jepsen_tpu.suites import aerospike
+
+    t = aerospike.test({
+        "nodes": NODES, "workload": "cas-register",
+        "faults": ["kill", "partition"], "time-limit": 5,
+    })
+    fs = t["nemesis"].fs()
+    assert "revive" in fs and "partition-start" in fs
