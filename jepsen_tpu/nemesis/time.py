@@ -140,12 +140,14 @@ class ClockNemesis(Nemesis):
                 ),
             )
         elif f == "check-offsets":
-            # observation-only op: the offsets map IS the value
-            # (reference: nemesis/time.clj:108,126-130)
-            res = control.on_nodes(test, lambda t, n: current_offset())
+            # observation-only op: the shared post-op sweep below IS the
+            # value (reference: nemesis/time.clj:108,126-130)
+            res = None
         else:
             raise ValueError(f"clock nemesis cannot handle f={f!r}")
         clock_offsets = control.on_nodes(test, lambda t, n: current_offset())
+        if f == "check-offsets":
+            res = clock_offsets
         return {**op, "type": "info", "value": res, "clock-offsets": clock_offsets}
 
     def teardown(self, test):

@@ -201,3 +201,198 @@ def drop_all(test: dict, grudge: Dict[Any, Iterable[Any]]) -> None:
 def heal(test: dict) -> None:
     net = test.get("net", iptables)
     net.heal(test)
+
+
+class LoopbackProxyNet(Net):
+    """Real connection-severing partitions on one host, no
+    iptables/root: every (src, dst) node edge gets a localhost TCP
+    forwarder; dropping the edge kills its live connections (clients
+    see genuine resets, not polite errors) and refuses new ones until
+    healed.  The loopback analogue of the iptables Net for integration
+    tests and CI (reference behavior contract: net.clj:15-44 — drop!
+    blocks src→dst traffic, heal! restores everything).
+
+    Routes are registered up front with :meth:`add_route`; clients on
+    node ``src`` talking to the service on node ``dst`` must connect to
+    ``port(src, dst)``.
+    """
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._routes: Dict[tuple, "_Forwarder"] = {}
+
+    def add_route(self, src: Any, dst: Any, target_host: str,
+                  target_port: int) -> int:
+        """Start a forwarder for the src→dst edge; returns its port."""
+        fwd = _Forwarder(target_host, target_port)
+        with self._lock:
+            self._routes[(src, dst)] = fwd
+        return fwd.port
+
+    def port(self, src: Any, dst: Any) -> int:
+        return self._routes[(src, dst)].port
+
+    def close(self) -> None:
+        for fwd in self._routes.values():
+            fwd.close()
+
+    def drop(self, test, src, dest):
+        fwd = self._routes.get((src, dest))
+        if fwd is not None:
+            fwd.block()
+
+    def heal(self, test):
+        for fwd in self._routes.values():
+            fwd.unblock()
+
+    def slow(self, test, opts=None):
+        # mean is in MILLISECONDS, matching the tc-backed Net impls
+        # (IPTables.slow default mean=50 → "50ms")
+        delay_ms = float((opts or {}).get("mean", 50))
+        for fwd in self._routes.values():
+            fwd.delay = delay_ms / 1000.0
+
+    def flaky(self, test):
+        for fwd in self._routes.values():
+            fwd.loss = 0.2
+
+    def fast(self, test):
+        for fwd in self._routes.values():
+            fwd.delay = 0.0
+            fwd.loss = 0.0
+
+
+class _Forwarder:
+    """One TCP forwarder: accept on a loopback port, pump bytes to the
+    target; blocking kills live connections and refuses new ones."""
+
+    def __init__(self, target_host: str, target_port: int):
+        import socket
+        import threading
+
+        self.target = (target_host, target_port)
+        self.blocked = False
+        self.delay = 0.0
+        self.loss = 0.0
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._listener = self._listen(0)
+        self.port = self._listener.getsockname()[1]
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _listen(self, port: int):
+        import socket
+
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+        s.listen(32)
+        return s
+
+    def _accept_loop(self):
+        import socket
+        import threading
+
+        listener = self._listener
+        while not self._closed:
+            try:
+                client, _addr = listener.accept()
+            except OSError:
+                # block()/close() shut the listener down; we own the fd,
+                # so close it here — closing from another thread while
+                # accept() blocks on it races fd reuse in-process
+                try:
+                    listener.close()
+                except OSError:
+                    pass
+                return
+            if self.blocked or self._closed:
+                client.close()
+                continue
+            try:
+                upstream = socket.create_connection(self.target, timeout=5)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._conns.append((client, upstream))
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(
+                    target=self._pump, args=(a, b), daemon=True
+                ).start()
+
+    def _pump(self, src, dst):
+        import random as _random
+        import time as _time
+
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                if self.blocked:
+                    break
+                if self.delay:
+                    _time.sleep(self.delay)
+                if self.loss and _random.random() < self.loss:
+                    # the proxy terminates TCP, so silently dropping
+                    # bytes would CORRUPT the stream (they were already
+                    # ACKed to the sender) — flakiness at this layer
+                    # means the connection dies, which clients see as a
+                    # clean reset/indeterminate op
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def block(self):
+        import socket
+
+        self.blocked = True
+        # shut the listener down so NEW connection attempts are refused
+        # outright (a definite, safe failure for clients) rather than
+        # accepted-then-reset (which reads as an indeterminate cut).
+        # shutdown — not close — wakes the accept thread, which then
+        # closes the fd it owns.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for a, b in conns:
+            for s in (a, b):
+                try:
+                    s.close()  # live connections die mid-flight
+                except OSError:
+                    pass
+
+    def unblock(self):
+        import threading
+
+        if not self.blocked or self._closed:
+            self.blocked = False
+            return
+        self.blocked = False
+        self._listener = self._listen(self.port)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def close(self):
+        import socket
+
+        self._closed = True
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.block()
+        self.blocked = False

@@ -328,7 +328,8 @@ def full_nemesis(opts: dict) -> Nemesis:
              max_dead=opts.get("max-dead-nodes", 2),
          )),
         ({"clock-reset": "reset", "clock-bump": "bump",
-          "clock-strobe": "strobe"},
+          "clock-strobe": "strobe",
+          "clock-check-offsets": "check-offsets"},
          nt.clock_nemesis()),
     ])
 
@@ -353,7 +354,8 @@ def full_gen(opts: dict):
     if not opts.get("no-clocks"):
         mix.append(gen_mod.f_map(
             {"strobe": "clock-strobe", "reset": "clock-reset",
-             "bump": "clock-bump"},
+             "bump": "clock-bump",
+             "check-offsets": "clock-check-offsets"},
             nt.clock_gen(),
         ))
     if not opts.get("no-kills"):

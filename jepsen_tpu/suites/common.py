@@ -259,6 +259,10 @@ def suite_nemesis_package(
         "faults": leftover,
         "interval": opts.get("interval", combined.DEFAULT_INTERVAL),
     }
+    if opts.get("partition-targets"):
+        # same translation build_test's default path performs —
+        # combined.partition_package reads opts["partition"]["targets"]
+        rest_opts["partition"] = {"targets": opts["partition-targets"]}
     rest = combined.nemesis_package(rest_opts, only_active=True)
     try:
         return combined.compose_packages([suite_pkg, rest])

@@ -117,6 +117,14 @@ class RegClient(client_mod.Client):
         try:
             if self.sock is None:
                 self._connect()
+        except OSError as e:
+            # failing to even connect means the request never reached
+            # the server: a DEFINITE failure for every op type.  (This
+            # also keeps partition tests checkable: a refused-connection
+            # storm must not mint hundreds of forever-open info writes.)
+            self.sock = None
+            return {**op, "type": "fail", "error": f"connect: {e!r}"}
+        try:
             if op["f"] == "read":
                 out = self._ask("R")
                 return {**op, "type": "ok", "value": int(out)}
@@ -216,3 +224,125 @@ def test_real_daemon_cluster_run(tmp_path):
         os.path.join(str(tmp_path), "local-cluster", "t0")
     )
     assert "regserverd" in open(log_copy).read()
+
+
+class ProxiedRegClient(RegClient):
+    """RegClient whose connections route through the per-node proxy for
+    its worker's node — so partitioning that node's edge severs this
+    client's live TCP connection mid-request."""
+
+    def __init__(self, ports_by_node, node=None):
+        super().__init__(0)
+        self.ports_by_node = ports_by_node
+        self.node = node
+
+    def open(self, test, node):
+        c = ProxiedRegClient(self.ports_by_node, node)
+        c.port = self.ports_by_node[node]
+        c._connect()
+        return c
+
+
+@needs_ssd
+def test_real_partition_end_to_end(tmp_path):
+    """VERDICT round-2 item: nemesis → net fault → heal → verdict against
+    live processes.  A real regserverd daemon runs on "n1"; workers on
+    n1/n2 reach it through per-node loopback proxies (net.LoopbackProxyNet);
+    the standard partitioner nemesis isolates n2 mid-workload (its live
+    TCP connections are genuinely cut), heals, and the history must
+    still be linearizable with real op failures during the partition."""
+    import random
+
+    from jepsen_tpu import net as net_mod
+    from jepsen_tpu.nemesis import complete_grudge, partitioner
+
+    port = _free_port()
+
+    class OneNodeDB(RegServerDB):
+        """The service lives on n1 only; other nodes are client-side
+        vantage points (everything shares one host here, so a second
+        daemon would race the first for the pidfile and port)."""
+
+        def setup(self, test, node):
+            if node == "n1":
+                super().setup(test, node)
+
+        def teardown(self, test, node):
+            if node == "n1":
+                super().teardown(test, node)
+
+        def log_files(self, test, node):
+            return super().log_files(test, node) if node == "n1" else []
+
+    db = OneNodeDB(str(tmp_path / "regserver"), port)
+
+    proxy_net = net_mod.LoopbackProxyNet()
+    nodes = ["n1", "n2"]
+    ports_by_node = {
+        n: proxy_net.add_route(n, "n1", "127.0.0.1", port) for n in nodes
+    }
+
+    # unique write values keep the linearizability search tractable
+    # even with many partition-crashed (forever-open) writes: a read's
+    # value pins exactly which write it observed
+    counter = {"n": 0}
+
+    def rw(test, ctx):
+        if random.random() < 0.5:
+            return {"type": "invoke", "f": "read", "value": None}
+        counter["n"] += 1
+        return {"type": "invoke", "f": "write", "value": counter["n"]}
+
+    # isolate n2 from n1 (grudge: n1 drops traffic FROM n2 — the edge
+    # n2→n1 carries every request from n2's workers)
+    part = partitioner(lambda ns: complete_grudge([["n1"], ["n2"]]))
+
+    nemesis_gen = gen.cycle(
+        [
+            gen.sleep(0.8),
+            {"type": "info", "f": "start", "value": None},
+            gen.sleep(0.8),
+            {"type": "info", "f": "stop", "value": None},
+        ]
+    )
+
+    test = {
+        "name": "local-partition",
+        "start-time": "t0",
+        "store-base": str(tmp_path),
+        "nodes": nodes,
+        "remote": LocalRemote(),
+        "net": proxy_net,
+        "db": db,
+        "client": ProxiedRegClient(ports_by_node),
+        "nemesis": part,
+        "concurrency": 4,
+        "generator": gen.time_limit(
+            5,
+            gen.nemesis(
+                nemesis_gen,
+                gen.stagger(0.02, rw),
+            ),
+        ),
+        "time-limit": 5,
+        "checker": checker_mod.linearizable(models.cas_register(0)),
+    }
+    try:
+        result = core.run(test)
+    finally:
+        proxy_net.close()
+    r = result["results"]
+    hist = result["history"]
+    oks = [op for op in hist if op["type"] == "ok"
+           and isinstance(op["process"], int)]
+    starts = [op for op in hist if op["process"] == "nemesis"
+              and op["f"] == "start" and op["type"] == "info"]
+    stops = [op for op in hist if op["process"] == "nemesis"
+             and op["f"] == "stop" and op["type"] == "info"]
+    failures = [op for op in hist if op["type"] in ("fail", "info")
+                and isinstance(op["process"], int)]
+    assert len(oks) > 20, "workload barely ran"
+    assert starts and stops, "partition never started/healed"
+    # the partition genuinely cut connections: some ops failed
+    assert failures, "no op ever failed during the partition"
+    assert r["valid?"] is True, r
