@@ -278,51 +278,78 @@ class _Forwarder:
         self.loss = 0.0
         self._conns: list = []
         self._lock = threading.Lock()
+        self._accept_done = threading.Event()
         self._listener = self._listen(0)
         self.port = self._listener.getsockname()[1]
         self._closed = False
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self._start_accepting()
 
     def _listen(self, port: int):
         import socket
+        import time as _time
 
-        s = socket.socket()
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", port))
-        s.listen(32)
-        return s
+        # the previous accept thread closes its listener asynchronously
+        # (see _accept_loop); tolerate a brief EADDRINUSE window when
+        # rebinding the same port
+        deadline = _time.monotonic() + 2.0
+        while True:
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", port))
+                s.listen(32)
+                return s
+            except OSError:
+                s.close()
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.01)
+
+    def _start_accepting(self):
+        import threading
+
+        self._accept_done.clear()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def _accept_loop(self):
         import socket
         import threading
 
         listener = self._listener
-        while not self._closed:
-            try:
-                client, _addr = listener.accept()
-            except OSError:
-                # block()/close() shut the listener down; we own the fd,
-                # so close it here — closing from another thread while
-                # accept() blocks on it races fd reuse in-process
+        try:
+            while not (self._closed or self.blocked):
                 try:
-                    listener.close()
+                    client, _addr = listener.accept()
                 except OSError:
-                    pass
-                return
-            if self.blocked or self._closed:
-                client.close()
-                continue
+                    break  # block()/close() shut the listener down
+                if self.blocked or self._closed:
+                    # woken by block()'s self-connect poke on platforms
+                    # where shutdown() on a listener is ENOTCONN
+                    # (BSD/macOS)
+                    client.close()
+                    break
+                try:
+                    upstream = socket.create_connection(
+                        self.target, timeout=5
+                    )
+                except OSError:
+                    client.close()
+                    continue
+                with self._lock:
+                    self._conns.append((client, upstream))
+                for a, b in ((client, upstream), (upstream, client)):
+                    threading.Thread(
+                        target=self._pump, args=(a, b), daemon=True
+                    ).start()
+        finally:
+            # the accept thread owns the fd: closing it from another
+            # thread while accept() blocks on it races in-process fd
+            # reuse
             try:
-                upstream = socket.create_connection(self.target, timeout=5)
+                listener.close()
             except OSError:
-                client.close()
-                continue
-            with self._lock:
-                self._conns.append((client, upstream))
-            for a, b in ((client, upstream), (upstream, client)):
-                threading.Thread(
-                    target=self._pump, args=(a, b), daemon=True
-                ).start()
+                pass
+            self._accept_done.set()
 
     def _pump(self, src, dst):
         import random as _random
@@ -366,7 +393,15 @@ class _Forwarder:
         try:
             self._listener.shutdown(socket.SHUT_RDWR)
         except OSError:
-            pass
+            # BSD/macOS: shutdown on a listener is ENOTCONN; poke the
+            # accept loop awake with a throwaway connection instead
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=1
+                ).close()
+            except OSError:
+                pass
+        self._accept_done.wait(timeout=2)
         with self._lock:
             conns, self._conns = self._conns, []
         for a, b in conns:
@@ -377,14 +412,12 @@ class _Forwarder:
                     pass
 
     def unblock(self):
-        import threading
-
         if not self.blocked or self._closed:
             self.blocked = False
             return
         self.blocked = False
         self._listener = self._listen(self.port)
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        self._start_accepting()
 
     def close(self):
         import socket

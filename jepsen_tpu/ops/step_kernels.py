@@ -11,7 +11,10 @@ differentially tested against) gets:
 
 Covers the knossos.model set the reference's linearizable checker uses
 (jepsen/src/jepsen/checker.clj:19-26,185-216): register, cas-register,
-mutex.  Richer-state models (queues) stay on the CPU oracle path.
+mutex, multi-register, and unordered-queue (as a unique-element bitset —
+see unordered_queue_step for the envelope).  FIFO queues stay on the CPU
+oracle: their state is the pending *sequence*, which depends on the
+linearization order itself and admits no fixed-width encoding.
 """
 
 from __future__ import annotations
@@ -30,6 +33,8 @@ F_CAS = 2         # a = expected old value id, b = new value id
 F_READ_ANY = 3    # read with unknown value: always ok, no state change
 F_ACQUIRE = 4     # mutex
 F_RELEASE = 5     # mutex
+F_ENQUEUE = 6     # unordered queue: a = value id
+F_DEQUEUE = 7     # unordered queue: a = observed value id
 
 #: Value id reserved for "unknown/None". Known values are 1-based.
 V_UNKNOWN = 0
@@ -86,6 +91,33 @@ def multi_register_step(state, f, a, b):
     ok = is_write | is_read_any | (is_read & (cur == a))
     written = (state & ~mask) | ((a.astype(jnp.int32) & MR_MAX_VALUE_ID) << sh)
     state2 = jnp.where(is_write, written, state)
+    return state2, ok
+
+
+#: unordered-queue packing: a bitset of present values in one int32 —
+#: sound only when every value appears at most once (initial contents +
+#: enqueues), which IS the shape real queue workloads generate (unique
+#: elements, e.g. suites/common.py queue_workload); histories breaking
+#: it fall back to the oracle at encode time.  A FIFO queue's state is
+#: the *sequence* of pending values — it depends on the linearization
+#: order itself, so no fixed-width encoding exists without bounding the
+#: whole history; FIFOQueue therefore stays on the CPU oracle
+#: (models.FIFOQueue), like knossos's queue model effectively does for
+#: all but tiny histories.
+UQ_MAX_VALUES = 31  # ids 1..31 → bits 0..30, sign bit untouched
+
+
+def unordered_queue_step(state, f, a, b):
+    """Bag of unique values as a bitset.  (oracle: models.UnorderedQueue
+    restricted to multiplicity ≤ 1)"""
+    bit = jnp.int32(1) << (a.astype(jnp.int32) - 1)
+    present = (state & bit) != 0
+    is_enq = f == F_ENQUEUE
+    is_deq = f == F_DEQUEUE
+    ok = (is_enq & ~present) | (is_deq & present)
+    state2 = jnp.where(
+        is_enq, state | bit, jnp.where(is_deq, state & ~bit, state)
+    ).astype(state.dtype)
     return state2, ok
 
 
@@ -204,6 +236,52 @@ def _mr_init(model, valmap) -> int:
     return state
 
 
+def _uq_value_id(v, valmap: Dict[Any, int]) -> int:
+    """Namespaced ids with their own counter (like _mr_value_id) —
+    sharing _value_id's len(valmap)-based counter would double-count
+    the bookkeeping keys below and halve the usable envelope."""
+    if v is None:
+        raise ValueError("queue op with unknown value rides the oracle")
+    key = ("uqval", v)
+    vid = valmap.get(key)
+    if vid is None:
+        vid = valmap.get("__uq_n__", 0) + 1
+        if vid > UQ_MAX_VALUES:
+            raise ValueError(
+                "too many distinct values for the bitset kernel"
+            )
+        valmap[key] = vid
+        valmap["__uq_n__"] = vid
+    return vid
+
+
+def _encode_unordered_queue_op(op, valmap) -> Tuple[int, int, int]:
+    if op.f == "enqueue":
+        vid = _uq_value_id(op.value, valmap)
+        key = ("uq-enq", vid)
+        if valmap.get(key):
+            raise ValueError(
+                "value enqueued more than once; multiset histories ride "
+                "the oracle"
+            )
+        valmap[key] = 1
+        return F_ENQUEUE, vid, 0
+    if op.f == "dequeue":
+        return F_DEQUEUE, _uq_value_id(op.value, valmap), 0
+    raise ValueError(f"unordered-queue cannot encode op f={op.f!r}")
+
+
+def _uq_init(model, valmap) -> int:
+    state = 0
+    for v, count in dict(model.items).items():
+        if count != 1:
+            raise ValueError("initial multiplicities >1 ride the oracle")
+        vid = _uq_value_id(v, valmap)
+        valmap[("uq-enq", vid)] = 1  # counts against the once-only rule
+        state |= 1 << (vid - 1)
+    return state
+
+
 SPECS: Dict[type, ModelSpec] = {
     m.Register: ModelSpec(
         name="register",
@@ -231,6 +309,13 @@ SPECS: Dict[type, ModelSpec] = {
         step=multi_register_step,
         encode_op=_encode_multi_register_op,
         init_state=_mr_init,
+        pure_fs=(),
+    ),
+    m.UnorderedQueue: ModelSpec(
+        name="unordered-queue",
+        step=unordered_queue_step,
+        encode_op=_encode_unordered_queue_op,
+        init_state=_uq_init,
         pure_fs=(),
     ),
 }

@@ -173,11 +173,9 @@ def counter_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
-def queue_workload(opts: Optional[dict] = None) -> dict:
-    """Total-queue: enqueues/dequeues raced with faults, then every
-    thread drains (reference: e.g. rabbitmq.clj queue workload +
-    checker.clj:628 total-queue).  Shared by the rabbitmq, disque, and
-    hazelcast suites."""
+def _queue_ops():
+    """Unique-value enqueue + unknown-value dequeue op fns — the op
+    shape both queue workloads share."""
     counter = {"n": 0}
 
     def enq(test, ctx):
@@ -187,6 +185,15 @@ def queue_workload(opts: Optional[dict] = None) -> dict:
     def deq(test, ctx):
         return {"type": "invoke", "f": "dequeue", "value": None}
 
+    return enq, deq
+
+
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    """Total-queue: enqueues/dequeues raced with faults, then every
+    thread drains (reference: e.g. rabbitmq.clj queue workload +
+    checker.clj:628 total-queue).  Shared by the rabbitmq, disque, and
+    hazelcast suites."""
+    enq, deq = _queue_ops()
     final = gen.clients(
         gen.each_thread(gen.once({"type": "invoke", "f": "drain",
                                   "value": None}))
@@ -195,6 +202,29 @@ def queue_workload(opts: Optional[dict] = None) -> dict:
         "generator": gen.mix([enq, deq]),
         "final-generator": final,
         "checker": checker_mod.total_queue(),
+    }
+
+
+def linearizable_queue_workload(opts: Optional[dict] = None) -> dict:
+    """Queue ops checked for full linearizability against the
+    unordered-queue model (the knossos model the reference's checker
+    consumes, jepsen/src/jepsen/checker.clj:19-26,218-239).  Unique
+    elements keep the history inside the device bitset kernel's
+    envelope (ops/step_kernels.py unordered_queue_step); total-queue
+    (queue_workload) remains the O(n) default for unbounded runs."""
+    from .. import models
+
+    opts = opts or {}
+    enq, deq = _queue_ops()
+    g = gen.mix([enq, deq])
+    limit = opts.get("op-limit", opts.get("per-key-limit", 40))
+    if limit:
+        g = gen.limit(int(limit), g)
+    return {
+        "generator": g,
+        "checker": checker_mod.linearizable(
+            models.unordered_queue(), pure_fs=()
+        ),
     }
 
 

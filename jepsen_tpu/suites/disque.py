@@ -143,13 +143,19 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"queue": common.queue_workload(dict(opts or {}))}
+    return {
+        "queue": common.queue_workload(dict(opts or {})),
+        "linearizable-queue": common.linearizable_queue_workload(
+            dict(opts or {})
+        ),
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
-    w = workloads(opts)["queue"]
+    wname = opts.get("workload", "queue")
+    w = workloads(opts)[wname]
     return common.build_test(
-        "disque-queue", opts, db=DisqueDB(opts), client=DisqueClient(opts),
+        f"disque-{wname}", opts, db=DisqueDB(opts), client=DisqueClient(opts),
         workload=w,
     )

@@ -210,6 +210,7 @@ def workloads(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     return {
         "queue": common.queue_workload(opts),
+        "linearizable-queue": common.linearizable_queue_workload(opts),
         "unique-ids": unique_ids_workload(opts),
     }
 

@@ -163,13 +163,19 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"queue": common.queue_workload(dict(opts or {}))}
+    return {
+        "queue": common.queue_workload(dict(opts or {})),
+        "linearizable-queue": common.linearizable_queue_workload(
+            dict(opts or {})
+        ),
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
-    w = workloads(opts)["queue"]
+    wname = opts.get("workload", "queue")
+    w = workloads(opts)[wname]
     return common.build_test(
-        "rabbitmq-queue", opts, db=RabbitDB(opts),
+        f"rabbitmq-{wname}", opts, db=RabbitDB(opts),
         client=RabbitQueueClient(opts), workload=w,
     )

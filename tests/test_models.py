@@ -73,3 +73,151 @@ def test_models_hashable_for_dedup():
     assert len({m.register(1), m.register(1), m.register(2)}) == 2
     assert len({m.Mutex(True), m.Mutex(True)}) == 1
     assert hash(m.cas_register(3)) == hash(m.cas_register(3))
+
+
+# -- unordered-queue device kernel ------------------------------------------
+
+
+def _gen_queue_history(rng, n_procs=4, n_ops=24, corrupt=False):
+    """A simulated concurrent unique-element unordered queue: enqueues
+    of fresh values, dequeues returning any present element; ops
+    linearize at completion.  corrupt=True makes one dequeue claim a
+    value that was never (or no longer) in the queue."""
+    from jepsen_tpu.history import History, invoke_op, ok_op, fail_op
+
+    present = set()
+    next_v = 1
+    pending = {}
+    idle = list(range(n_procs))
+    hist = []
+    done = 0
+    while done < n_ops or pending:
+        if idle and done < n_ops and (not pending or rng.random() < 0.6):
+            p = idle.pop(rng.randrange(len(idle)))
+            if present and rng.random() < 0.45:
+                hist.append(invoke_op(p, "dequeue", None))
+                pending[p] = ("dequeue", None)
+            else:
+                v = next_v
+                next_v += 1
+                hist.append(invoke_op(p, "enqueue", v))
+                pending[p] = ("enqueue", v)
+            done += 1
+        else:
+            p = rng.choice(list(pending))
+            f, v = pending.pop(p)
+            idle.append(p)
+            if f == "enqueue":
+                present.add(v)
+                hist.append(ok_op(p, "enqueue", v))
+            else:
+                if present:
+                    got = rng.choice(sorted(present))
+                    present.discard(got)
+                    hist.append(ok_op(p, "dequeue", got))
+                else:
+                    hist.append(fail_op(p, "dequeue", None, error="empty"))
+    h = History(hist)
+    if corrupt and len(h) > 4:
+        deqs = [i for i, op in enumerate(h)
+                if op.type == "ok" and op.f == "dequeue"]
+        if deqs:
+            i = rng.choice(deqs)
+            h[i] = h[i].copy(value=next_v + 7)  # never enqueued
+    for i, op in enumerate(h):
+        op.index = i
+        op.time = i
+    return h.index_ops()
+
+
+def test_unordered_queue_kernel_differential():
+    """Device verdicts must match the CPU oracle on random queue
+    histories — the knossos model-set parity item
+    (jepsen/src/jepsen/checker.clj:19-26)."""
+    import random
+
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    rng = random.Random(45100)
+    hists = [
+        _gen_queue_history(rng, corrupt=(i % 3 == 0)) for i in range(24)
+    ]
+    model = models.unordered_queue()
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    outs = wgl.check_batch(model, hists)
+    got = [o["valid?"] for o in outs]
+    assert got == oracle, list(zip(got, oracle))
+    # the device actually served (at least) the clean histories
+    engines = {o["engine"] for o in outs}
+    assert "tpu" in engines, engines
+    assert any(v is False for v in oracle), "no corrupted history failed"
+
+
+def test_unordered_queue_kernel_envelope_fallbacks():
+    """Histories outside the bitset envelope (duplicate enqueues, >31
+    values, unknown dequeue values) ride the oracle, not a wrong
+    device verdict."""
+    from jepsen_tpu import models
+    from jepsen_tpu.history import History, invoke_op, ok_op
+    from jepsen_tpu.ops import wgl
+
+    def mk(ops):
+        h = History(ops)
+        for i, op in enumerate(h):
+            op.index = i
+            op.time = i
+        return h.index_ops()
+
+    model = models.unordered_queue()
+
+    # duplicate enqueue of one value: multiset semantics, oracle-only
+    dup = mk([
+        invoke_op(0, "enqueue", 5), ok_op(0, "enqueue", 5),
+        invoke_op(0, "enqueue", 5), ok_op(0, "enqueue", 5),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 5),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 5),
+    ])
+    out = wgl.check_batch(model, [dup])[0]
+    assert out["valid?"] is True
+    assert out["engine"].startswith("oracle"), out
+
+    # too many distinct values for the 31-bit set
+    wide = []
+    for v in range(1, 40):
+        wide += [invoke_op(0, "enqueue", v), ok_op(0, "enqueue", v)]
+    out = wgl.check_batch(model, [mk(wide)])[0]
+    assert out["valid?"] is True
+    assert out["engine"].startswith("oracle"), out
+
+
+def test_unordered_queue_kernel_basics():
+    from jepsen_tpu import models
+    from jepsen_tpu.history import History, invoke_op, ok_op
+    from jepsen_tpu.ops import wgl
+
+    def mk(ops):
+        h = History(ops)
+        for i, op in enumerate(h):
+            op.index = i
+            op.time = i
+        return h.index_ops()
+
+    model = models.unordered_queue()
+    good = mk([
+        invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+        invoke_op(1, "enqueue", 2), ok_op(1, "enqueue", 2),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 2),
+        invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 1),
+    ])
+    out = wgl.check_batch(model, [good])[0]
+    assert out["valid?"] is True and out["engine"] == "tpu", out
+
+    # dequeue of a value never enqueued
+    bad = mk([
+        invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 3),
+    ])
+    out = wgl.check_batch(model, [bad])[0]
+    assert out["valid?"] is False and out["engine"] == "tpu", out
