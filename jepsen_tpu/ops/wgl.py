@@ -283,6 +283,37 @@ def _all_specs():
 #: CPU oracle gets them — a device rerun is orders of magnitude cheaper
 ESCALATION_FACTORS = (4,)
 
+#: largest frontier the guaranteed-sufficient escalation may allocate;
+#: above this the oracle takes the leftovers (K = F·(1+C) working lanes
+#: per history bounds device memory)
+MAX_SUFFICIENT_FRONTIER = 8192
+
+
+def sufficient_frontier(n_values: int, C: int) -> Optional[int]:
+    """A frontier capacity that can NEVER overflow, when affordable.
+
+    A config is (state, linset): for the register-family models state
+    is a value id < n_values and linset ⊆ the C open-op slots, so at
+    most n_values·2^C distinct configs exist — the exact space the
+    dense kernel enumerates bit-packed.  A frontier that large makes
+    the compaction lossless by construction, so one rerun at it
+    resolves every overflow row on-device instead of handing the
+    exponential search back to the CPU oracle.  Returns None when the
+    bound is unaffordable.  For models whose state outgrows value ids
+    (mutex held-state, queue bitsets, multi-register packing) the bound
+    is a heuristic only — overflow is still tracked on the rerun, so an
+    undersized capacity just falls through to the oracle as before."""
+    if C >= 31:
+        return None
+    bound = n_values << C
+    if bound <= 0 or bound > MAX_SUFFICIENT_FRONTIER:
+        return None
+    # quantize to a power of two: the escalated checker is jit-compiled
+    # per capacity, so a data-dependent F (n_values drifts per batch)
+    # would mint a fresh executable every time — the ladder caps the
+    # compile variants at log2(MAX_SUFFICIENT_FRONTIER)
+    return 1 << (bound - 1).bit_length()
+
 
 def _run_rows(fn, mesh, arrays):
     if mesh is not None:
@@ -301,15 +332,20 @@ def check_batch(
     mesh=None,
     escalation=ESCALATION_FACTORS,
     oracle_fallback: bool = True,
+    sufficient_rung: bool = True,
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
     over multiple devices.  Unencodable histories fall back to the CPU
-    oracle; device-side overflows first retry on-device with escalated
-    frontier capacity, then fall back to the oracle.  With
-    ``oracle_fallback=False`` those rows report ``"unknown"`` instead —
-    for callers (like the race-mode checker) already running the oracle
-    themselves."""
+    oracle; device-side overflows first retry on-device at
+    frontier × each ``escalation`` factor, then — when
+    ``sufficient_rung`` (default) and the n_values·2^C bound is
+    affordable — once more at a provably-overflow-free capacity, and
+    only then fall back to the oracle.  Pass ``escalation=()`` with
+    ``sufficient_rung=False`` to disable device reruns entirely.  With
+    ``oracle_fallback=False`` unresolved rows report ``"unknown"``
+    instead — for callers (like the race-mode checker) already running
+    the oracle themselves."""
     from ..checker import linear
     from ..platform import ensure_usable_backend
 
@@ -358,7 +394,14 @@ def check_batch(
             np.array(x) for x in _run_rows(fn, mesh, arrays)
         )
 
-        for factor in escalation:
+        capacities = [frontier * factor for factor in escalation]
+        # final escalation rung: the provably-sufficient capacity, when
+        # affordable — a lossless-compaction rerun that settles the row
+        # on-device instead of handing it to the exponential oracle
+        suff = sufficient_frontier(n_values, C) if sufficient_rung else None
+        if suff is not None and suff > max([frontier] + capacities):
+            capacities.append(suff)
+        for capacity in capacities:
             bad = np.flatnonzero(overflow)
             if bad.size == 0:
                 break
@@ -371,7 +414,7 @@ def check_batch(
             sub = tuple(a[idx] for a in arrays)
             if n_pad:
                 sub[1][n_bad:] = -1  # ev_slot: every event padding
-            fn2 = make_check_fn(spec.name, E, C, frontier * factor, mc)
+            fn2 = make_check_fn(spec.name, E, C, capacity, mc)
             ok2, failed2, ovf2 = (
                 np.asarray(x)[:n_bad] for x in _run_rows(fn2, mesh, sub)
             )

@@ -360,3 +360,45 @@ def test_overflow_fallback_tagged_engine():
     stats = wgl.batch_stats(outs)
     assert stats["engines"].get("oracle-overflow", 0) > 0
     assert all(o["valid?"] is True for o in outs)
+
+
+def test_sufficient_frontier_escalation_resolves_on_device():
+    """Rows that overflow the default frontier must settle on the
+    guaranteed-sufficient rerun (n_values · 2^C configs) instead of
+    falling back to the CPU oracle — lossless compaction by
+    construction."""
+    import random
+
+    import numpy as np
+
+    from jepsen_tpu import models, synth
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    assert wgl.sufficient_frontier(8, 8) == 2048  # 8·256 → pow2
+    assert wgl.sufficient_frontier(5, 6) == 512  # 320 → pow2 ladder
+    assert wgl.sufficient_frontier(16, 12) is None  # 65536 > cap
+    assert wgl.sufficient_frontier(4, 40) is None
+
+    rng = random.Random(3)
+    hists = [
+        synth.generate_history(rng, n_procs=6, n_ops=30, crash_p=0.01,
+                               corrupt=(i % 3 == 0))
+        for i in range(6)
+    ]
+    model = models.cas_register(0)
+    # tiny starting frontier + no factor escalation + an explicit
+    # max_closure (which forces the generic kernel, not dense): every
+    # row must be rescued by the sufficient-capacity rung alone
+    C = 6
+    outs = wgl.check_batch(
+        model, hists, frontier=16, escalation=(), max_closure=C + 1,
+        slot_cap=C,
+    )
+    engines = [o["engine"] for o in outs]
+    assert all(e == "tpu" for e in engines), engines
+    kernels = {o.get("kernel") for o in outs}
+    assert kernels == {"frontier"}, kernels
+    oracle = [linear.analysis(model, h, pure_fs=("read",))["valid?"]
+              for h in hists]
+    assert [o["valid?"] for o in outs] == oracle
