@@ -72,14 +72,16 @@ def _utcnow():
 def probe_accelerator(retries=None, timeout_s=None, backoff_s=None):
     """Shared execute-a-jitted-op probe (jepsen_tpu.platform): hangs
     can't kill the bench, the same verdict the checker/CLI path uses.
-    The bench stretches the horizon well past the checker's default —
-    this is a once-per-round artifact, so retrying over ~10-15 minutes
-    (JEPSEN_TPU_BENCH_PROBE_RETRIES × JEPSEN_TPU_PROBE_TIMEOUT plus
-    backoff) beats giving up at 4.5 minutes."""
+    The bench stretches the horizon past the checker's default — this is
+    a once-per-round artifact, so the default 4 retries × 90 s plus
+    backoff (~7-8 minutes; JEPSEN_TPU_BENCH_PROBE_RETRIES /
+    JEPSEN_TPU_PROBE_TIMEOUT / JEPSEN_TPU_BENCH_PROBE_BACKOFF to tune)
+    beats the checker path's quicker give-up, while still leaving room
+    for the CPU fallback to finish within a driver-capture budget."""
     from jepsen_tpu.platform import probe_accelerator as _probe
 
     if retries is None:
-        retries = int(os.environ.get("JEPSEN_TPU_BENCH_PROBE_RETRIES", 6))
+        retries = int(os.environ.get("JEPSEN_TPU_BENCH_PROBE_RETRIES", 4))
     if backoff_s is None:
         backoff_s = float(os.environ.get("JEPSEN_TPU_BENCH_PROBE_BACKOFF", 20))
     return _probe(retries=retries, timeout_s=timeout_s, backoff_s=backoff_s)
@@ -104,7 +106,7 @@ def run_bench(on_accelerator, warnings):
 
     from jepsen_tpu import models as m
     from jepsen_tpu import synth
-    from jepsen_tpu.ops import dense, encode, wgl
+    from jepsen_tpu.ops import encode, wgl
     from jepsen_tpu.parallel import mesh as mesh_mod
 
     mesh = None
@@ -248,19 +250,7 @@ def run_bench(on_accelerator, warnings):
         "encode_fallback": n_fallback,
         "invalid": int((~ok).sum()),
         "platform": jax.devices()[0].platform,
-        # applicable() guard first: out-of-envelope shapes must not
-        # construct a dense kernel just to label the diag line
-        "kernel": (
-            "dense"
-            if dense.applicable(
-                "cas-register", C, encode.round_up(vmax + 1, 4)
-            )
-            and fn
-            is dense.make_dense_fn(
-                "cas-register", E, C, encode.round_up(vmax + 1, 4)
-            )
-            else "frontier"
-        ),
+        "kernel": wgl.kernel_choice("cas-register", C, vmax + 1),
     }
     return value, L, diag
 

@@ -81,6 +81,8 @@ class FaunaTopology(State):
     def op(self, test):
         """An add or remove op, mixed evenly by *type* like rand-op
         (topology.clj:165-180); "pending" when neither is possible."""
+        if not self.topo:
+            return "pending"  # setup() hasn't populated the model yet
         adds = self._add_ops(test)
         removes = self._remove_ops()
         choices = [ops for ops in (adds, removes) if ops]

@@ -252,3 +252,123 @@ def test_aerospike_suite_test_uses_fault_menu():
     })
     fs = t["nemesis"].fs()
     assert "revive" in fs and "partition-start" in fs
+
+
+# -- integration + edge cases -----------------------------------------------
+
+
+def test_yb_long_recovery_alternates_windows():
+    """long-recovery mode cycles fault windows with recovery + calm —
+    the generator must keep producing ops after the first 120 s fault
+    window ends (reference: nemesis.clj:211-223 full-generator).
+    Virtual time advances 10 s per drawn op so the run actually crosses
+    window boundaries."""
+    from jepsen_tpu.suites import yb_nemesis
+
+    n = yb_nemesis.expand_options(
+        {"kill": True, "interval": 0.001, "long-recovery": True}
+    )
+    g = yb_nemesis.full_generator(n)
+    t = dummy_test()
+    ctx = gen.context({"concurrency": 1, "nodes": NODES})
+    fs_with_time = []
+    guard = 0
+    while len(fs_with_time) < 60 and guard < 10_000:
+        guard += 1
+        res = gen.op(g, t, ctx)
+        if res is None:
+            break
+        o, g = res
+        if o == gen.PENDING:
+            # jump virtual time past the pending wait (sleep phases)
+            ctx = {**ctx, "time": ctx["time"] + int(10e9)}
+            continue
+        if isinstance(o, dict) and o.get("f"):
+            fs_with_time.append((ctx["time"], o["f"]))
+        ctx = {**ctx, "time": ctx["time"] + int(10e9)}
+    fs = [f for _, f in fs_with_time]
+    assert fs.count("start-tserver") >= 1, fs
+    assert any(f in ("kill-tserver", "kill-master") for f in fs), fs
+    # ops continue PAST the first 120 s fault window: the cycle/phases
+    # machinery restarted a fresh window rather than ending the gen
+    window_ns = 120 * 1_000_000_000
+    assert any(ts > 2 * window_ns for ts, _ in fs_with_time), (
+        fs_with_time[-3:]
+    )
+
+
+def test_partition_targets_flow_to_leftover_package():
+    """partition-targets must reach the generic partition package when
+    partition runs alongside a suite menu: its start-partition ops carry
+    the requested target spec, not the defaults."""
+    from jepsen_tpu.suites import common, fauna_topology
+    from jepsen_tpu.suites.faunadb import FaunaDB
+
+    opts = {
+        "nodes": NODES,
+        "faults": ["topology", "partition"],
+        "partition-targets": ["one"],
+        "interval": 0.001,
+    }
+    db = FaunaDB(opts)
+    pkg = common.suite_nemesis_package(
+        opts, db, fauna_topology.package(opts), {"topology"}
+    )
+    assert "start-partition" in pkg["nemesis"].fs()
+    # pull ops until a start-partition appears; its value must be the
+    # requested "one" spec (the package default would draw from the
+    # full spec list)
+    t = dummy_test(db=db)
+    with sessions(t):
+        pkg["nemesis"].setup(t)
+    ctx = gen.context({"concurrency": 1, "nodes": NODES})
+    g = pkg["generator"]
+    values = []
+    guard = 0
+    while len(values) < 8 and guard < 10_000:
+        guard += 1
+        res = gen.op(g, t, ctx)
+        if res is None:
+            break
+        o, g = res
+        if o == gen.PENDING:
+            ctx = {**ctx, "time": ctx["time"] + int(1e9)}
+            continue
+        if isinstance(o, dict) and o.get("f") == "start-partition":
+            values.append(o["value"])
+        ctx = {**ctx, "time": ctx["time"] + int(1e9)}
+    assert values, "no start-partition op ever drawn"
+    assert set(values) == {"one"}, values
+
+
+def test_aerospike_full_run_under_fault_menu():
+    """An in-process aerospike run with the suite fault menu active:
+    kills/restarts/revives flow through the whole loop against the fake
+    server and the verdict holds."""
+    from fake_servers import FakeAerospike
+
+    from jepsen_tpu import core
+    from jepsen_tpu import db as db_mod
+    from jepsen_tpu.suites import aerospike
+
+    s = FakeAerospike().start()
+    try:
+        t = aerospike.test({
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1",
+            "port": s.port,
+            "time-limit": 3,
+            "rate": 30,
+            "interval": 0.5,
+            "workload": "cas-register",
+            "faults": ["kill"],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        hist = result["history"]
+        nem_fs = {op["f"] for op in hist if op["process"] == "nemesis"}
+        assert nem_fs & {"kill", "restart", "revive", "recluster"}, nem_fs
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
