@@ -289,23 +289,33 @@ ESCALATION_FACTORS = (4,)
 MAX_SUFFICIENT_FRONTIER = 8192
 
 
-def sufficient_frontier(n_values: int, C: int) -> Optional[int]:
+def sufficient_frontier(
+    n_values: int, C: int, spec_name: Optional[str] = None
+) -> Optional[int]:
     """A frontier capacity that can NEVER overflow, when affordable.
 
     A config is (state, linset): for the register-family models state
     is a value id < n_values and linset ⊆ the C open-op slots, so at
     most n_values·2^C distinct configs exist — the exact space the
-    dense kernel enumerates bit-packed.  A frontier that large makes
-    the compaction lossless by construction, so one rerun at it
+    dense kernel enumerates bit-packed.  For the unordered queue the
+    bound is tighter still: unique-value enqueues/dequeues commute, so
+    every surviving config's state is a pure function of its linset
+    (completed ops are common to all survivors) and 2^C configs bound
+    the space regardless of the value count.  A frontier that large
+    makes the compaction lossless by construction, so one rerun at it
     resolves every overflow row on-device instead of handing the
     exponential search back to the CPU oracle.  Returns None when the
     bound is unaffordable.  For models whose state outgrows value ids
-    (mutex held-state, queue bitsets, multi-register packing) the bound
-    is a heuristic only — overflow is still tracked on the rerun, so an
-    undersized capacity just falls through to the oracle as before."""
+    (mutex held-state past n_values=1, multi-register packing) the
+    bound is a heuristic only — overflow is still tracked on the
+    rerun, so an undersized capacity just falls through to the oracle
+    as before."""
     if C >= 31:
         return None
-    bound = n_values << C
+    if spec_name == "unordered-queue":
+        bound = 1 << C
+    else:
+        bound = n_values << C
     if bound <= 0 or bound > MAX_SUFFICIENT_FRONTIER:
         return None
     # quantize to a power of two: the escalated checker is jit-compiled
@@ -339,9 +349,11 @@ def check_batch(
     over multiple devices.  Unencodable histories fall back to the CPU
     oracle; device-side overflows first retry on-device at
     frontier × each ``escalation`` factor, then — when
-    ``sufficient_rung`` (default) and the n_values·2^C bound is
-    affordable — once more at a provably-overflow-free capacity, and
-    only then fall back to the oracle.  Pass ``escalation=()`` with
+    ``sufficient_rung`` (default) and the model's config-space bound is
+    affordable (see :func:`sufficient_frontier`: n_values·2^C for the
+    register family, 2^C for the unordered queue) — once more at a
+    provably-overflow-free capacity, and only then fall back to the
+    oracle.  Pass ``escalation=()`` with
     ``sufficient_rung=False`` to disable device reruns entirely.  With
     ``oracle_fallback=False`` unresolved rows report ``"unknown"``
     instead — for callers (like the race-mode checker) already running
@@ -398,7 +410,11 @@ def check_batch(
         # final escalation rung: the provably-sufficient capacity, when
         # affordable — a lossless-compaction rerun that settles the row
         # on-device instead of handing it to the exponential oracle
-        suff = sufficient_frontier(n_values, C) if sufficient_rung else None
+        suff = (
+            sufficient_frontier(n_values, C, spec.name)
+            if sufficient_rung
+            else None
+        )
         if suff is not None and suff > max([frontier] + capacities):
             capacities.append(suff)
         for capacity in capacities:

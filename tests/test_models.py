@@ -221,3 +221,32 @@ def test_unordered_queue_kernel_basics():
     ])
     out = wgl.check_batch(model, [bad])[0]
     assert out["valid?"] is False and out["engine"] == "tpu", out
+
+
+def test_unordered_queue_sufficient_rung_keeps_device():
+    """The queue's 2^C sufficient bound: many distinct values at modest
+    concurrency must resolve on-device even from a tiny frontier —
+    never the oracle (state is a function of the linset, so 2^C configs
+    bound the space)."""
+    import random
+
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    assert wgl.sufficient_frontier(30, 8, "unordered-queue") == 256
+    assert wgl.sufficient_frontier(40, 8) is None  # 40·256 > cap
+
+    rng = random.Random(5)
+    hists = [
+        _gen_queue_history(rng, n_procs=6, n_ops=24,
+                           corrupt=(i % 3 == 0))
+        for i in range(8)
+    ]
+    model = models.unordered_queue()
+    outs = wgl.check_batch(model, hists, frontier=8, escalation=())
+    assert all(o["engine"] == "tpu" for o in outs), [
+        o["engine"] for o in outs
+    ]
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    assert [o["valid?"] for o in outs] == oracle
