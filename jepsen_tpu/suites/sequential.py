@@ -50,7 +50,9 @@ class SequentialClient(sql._Base):
         self._exec_ddl(
             *(
                 f"CREATE TABLE IF NOT EXISTS {TABLE_PREFIX}{i} "
-                "(key VARCHAR(255) PRIMARY KEY)"
+                # "sk", not "key": KEY is reserved in MySQL/TiDB, and a
+                # dialect-neutral name beats per-dialect quoting
+                "(sk VARCHAR(255) PRIMARY KEY)"
                 for i in range(self.table_count)
             )
         )
@@ -64,15 +66,15 @@ class SequentialClient(sql._Base):
                 for sk in ks:
                     self.conn.query(
                         f"INSERT INTO {table_for(sk, self.table_count)} "
-                        f"(key) VALUES ('{sk}')"
+                        f"(sk) VALUES ('{sk}')"
                     )
                 return {**op, "type": "ok"}
             if op["f"] == "read":
                 out = []
                 for sk in reversed(ks):
                     res = self.conn.query(
-                        f"SELECT key FROM {table_for(sk, self.table_count)} "
-                        f"WHERE key = '{sk}'"
+                        f"SELECT sk FROM {table_for(sk, self.table_count)} "
+                        f"WHERE sk = '{sk}'"
                     )
                     out.append(str(res.rows[0][0]) if res.rows else None)
                 return {**op, "type": "ok", "value": [k, out]}

@@ -135,15 +135,23 @@ WORKLOADS = ("register", "bank", "set", "list-append", "long-fork")
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
+    from . import monotonic, sequential
+
     opts = _opts(opts)
     out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
-    # suite-specific probes (reference: tidb/txn.clj, table.clj)
+    # suite-specific probes (reference: tidb/txn.clj, table.clj,
+    # monotonic.clj, sequential.clj — the latter two ride the shared
+    # dialect-generic SQL implementations)
     out["txn"] = common.generic_workload("rw-register", opts)
     out["table"] = table_workload(opts)
+    out["monotonic"] = monotonic.workload(opts)
+    out["sequential"] = sequential.workload(opts)
     return out
 
 
 def _client_for(wname: str, opts: dict):
+    from . import monotonic, sequential
+
     if wname == "txn":
         return TidbTxnClient(opts)
     if wname == "list-append":
@@ -152,6 +160,10 @@ def _client_for(wname: str, opts: dict):
         return TidbTxnClient({**opts, "val-type": "text"})
     if wname == "table":
         return TableClient(opts)
+    if wname == "monotonic":
+        return monotonic.MonotonicClient(opts)
+    if wname == "sequential":
+        return sequential.SequentialClient(opts)
     return sql.client_for(
         wname if wname in sql.CLIENTS else "register", opts
     )

@@ -1401,3 +1401,64 @@ def test_fauna_monotonic_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- tidb monotonic + sequential (dialect-generic over mysql) ---------------
+
+
+def test_tidb_monotonic_full_test_in_process():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        t = tidb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "root",
+                "password": "pw",
+                "time-limit": 2,
+                "rate": 40,
+                "workload": "monotonic",
+                "faults": [],
+            }
+        )
+        # mysql's now(6) is wall-clock, so the strict global check must
+        # stay off even if linearizable? is requested
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_tidb_sequential_full_test_in_process():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import tidb
+
+    s = FakeMysql().start()
+    try:
+        t = tidb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "root",
+                "password": "pw",
+                "time-limit": 2,
+                "rate": 40,
+                "workload": "sequential",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
