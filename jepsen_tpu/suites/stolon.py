@@ -12,6 +12,9 @@ Clients speak pgwire through the proxy via :mod:`.sql` (dialect
 
 from __future__ import annotations
 
+import itertools as _itertools
+import threading as _threading
+
 from typing import Optional
 
 from ..control import util as cu
@@ -134,14 +137,170 @@ WORKLOADS = ("register", "bank", "set", "list-append")
 
 def workloads(opts: Optional[dict] = None) -> dict:
     opts = _opts(opts)
-    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    # the double-spend probe (reference: stolon/ledger.clj)
+    out["ledger"] = ledger_workload(opts)
+    return out
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = _opts(opts)
     wname = opts.get("workload", "list-append")
     w = workloads(opts)[wname]
+    c = (
+        LedgerClient(opts)
+        if wname == "ledger"
+        else sql.client_for(wname, opts)
+    )
     return common.build_test(
         f"stolon-{wname}", opts, db=StolonDB(opts),
-        client=sql.client_for(wname, opts), workload=w,
+        client=c, workload=w,
     )
+
+
+# ---------------------------------------------------------------------
+# ledger: the double-spend probe
+# (reference: stolon/src/jepsen/stolon/ledger.clj)
+# ---------------------------------------------------------------------
+
+LEDGER_TABLE = "ledger"
+
+
+class LedgerClient(sql._Base):
+    """A bank ledger where each transfer is a row; withdrawals insert
+    only if the account's balance (summed from the other rows, inside
+    the same transaction) stays non-negative — so a double-spend race
+    is exactly a G2-item anomaly made concrete.
+
+    Reference: ledger.clj — add-entry!/balance-select (:27-52),
+    transfer!'s read-then-conditionally-insert with a jitter sleep
+    between (:54-69), per-client unique row ids (:74-131)."""
+
+    dialect = "pg"
+
+    #: row-id counter shared across worker clones (class-level so every
+    #: open()ed copy draws from one sequence; CPython's itertools.count
+    #: is safe under the GIL but the lock keeps that explicit)
+    _ids = _itertools.count(1)
+    _ids_lock = _threading.Lock()
+
+    def _next_id(self) -> int:
+        with LedgerClient._ids_lock:
+            return next(LedgerClient._ids)
+
+    def setup(self, test):
+        self._exec_ddl(
+            f"CREATE TABLE IF NOT EXISTS {LEDGER_TABLE} "
+            "(id INT PRIMARY KEY, account INT NOT NULL, "
+            "amount INT NOT NULL)"
+        )
+
+    def invoke(self, test, op):
+        import random as _random
+        import time as _time
+
+        account, amount = op["value"]
+        rid = self._next_id()
+        # the double-spend is only an anomaly under serializability —
+        # at read committed two concurrent balance checks passing is
+        # LEGAL, so without this the checker would flag healthy
+        # clusters (reference: ledger.clj:117-121 sets the test's
+        # isolation on every connection)
+        isolation = str(
+            self.opts.get("isolation", "serializable")
+        ).upper()
+        try:
+            self.conn.query(f"BEGIN ISOLATION LEVEL {isolation}")
+            try:
+                if amount > 0:
+                    self.conn.query(
+                        f"INSERT INTO {LEDGER_TABLE} (id, account, amount) "
+                        f"VALUES ({rid}, {int(account)}, {int(amount)})"
+                    )
+                    ok = True
+                else:
+                    res = self.conn.query(
+                        f"SELECT amount FROM {LEDGER_TABLE} "
+                        f"WHERE account = {int(account)} AND id != {rid}"
+                    )
+                    balance = sum(int(r[0]) for r in res.rows)
+                    if balance + amount < 0:
+                        ok = False
+                    else:
+                        # the jitter widens the double-spend window
+                        # (reference: ledger.clj:66)
+                        _time.sleep(_random.random() * 0.01)
+                        self.conn.query(
+                            f"INSERT INTO {LEDGER_TABLE} "
+                            "(id, account, amount) "
+                            f"VALUES ({rid}, {int(account)}, {int(amount)})"
+                        )
+                        ok = True
+                self.conn.query("COMMIT")
+                return {**op, "type": "ok" if ok else "fail"}
+            except (sql.PgError, sql.MysqlError) as e:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:
+                    pass
+                return self._fail(op, e)
+        except sql.IndeterminateError as e:
+            return self._info(op, e)
+
+
+class LedgerChecker(common.checker_mod.Checker):
+    """Every account's most-charitable balance (deposits count even
+    when indeterminate; withdrawals only when acknowledged) must be
+    exactly zero-or-positive... the reference flags ANY nonzero
+    balance, since its generator funds then fully drains each account.
+    We flag only negative balances — a double-spend's signature — so
+    the checker also serves the random-transfer generator.
+    (reference: ledger.clj:139-163 check-account/checker)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK, INFO
+
+        per_account: dict = {}
+        for op in history:
+            if op.f != "transfer" or op.type not in (OK, INFO):
+                continue
+            account, amount = op.value
+            if amount > 0 or op.type == OK:
+                per_account[account] = per_account.get(account, 0) + amount
+        errs = [
+            {"account": a, "balance": b}
+            for a, b in sorted(per_account.items())
+            if b < 0
+        ]
+        return {"valid?": not errs, "errors": errs[:10]}
+
+
+class _LedgerGen(common.gen.Generator):
+    """Fund each account, then attempt a burst of double-spends.
+    (reference: ledger.clj:165-173 fund-then-double-spend-gen)"""
+
+    def __init__(self, account: int = 0, queue: tuple = ()):
+        self.account = account
+        self.queue = queue
+
+    def op(self, test, ctx):
+        queue = self.queue
+        account = self.account
+        if not queue:
+            burst = 2 ** common.gen.rng.randrange(5)
+            queue = ((account, 10),) + ((account, -9),) * burst
+            account += 1
+        filled = common.gen.fill_in_op(
+            {"f": "transfer", "value": list(queue[0])}, ctx
+        )
+        if filled == common.gen.PENDING:
+            return (common.gen.PENDING, self)
+        return (filled, _LedgerGen(account, queue[1:]))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def ledger_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: ledger.clj:184-189 workload)"""
+    return {"generator": _LedgerGen(), "checker": LedgerChecker()}

@@ -364,10 +364,12 @@ class _PgHandler(_RecvExact, socketserver.BaseRequestHandler):
                     kv[key] = v
                 self._send(b"C", b"UPDATE %d\0" % n)
             elif low in ("begin", "commit", "rollback") or low.startswith(
-                ("drop", "set ")
+                ("begin ", "drop", "set ")
             ):
+                # "begin isolation level ..." → plain BEGIN for sqlite
+                stmt = "BEGIN" if low.startswith("begin ") else s
                 try:
-                    self._backend().execute(s)
+                    self._backend().execute(stmt)
                 except SqlBackendError:
                     pass
                 self._send(b"C", s.split()[0].upper().encode() + b"\0")

@@ -1462,3 +1462,80 @@ def test_tidb_sequential_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- stolon ledger (double-spend) -------------------------------------------
+
+
+def test_stolon_ledger_client_and_checker():
+    from jepsen_tpu.suites import stolon
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "user": "postgres",
+                "dialect": "pg"}
+        c = stolon.LedgerClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "transfer", "type": "invoke",
+                          "value": [0, 10]})
+        assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "transfer", "type": "invoke",
+                          "value": [0, -9]})
+        assert r["type"] == "ok", r
+        # second withdrawal must fail: only 1 left
+        r = c.invoke({}, {"f": "transfer", "type": "invoke",
+                          "value": [0, -9]})
+        assert r["type"] == "fail", r
+        c.close({})
+    finally:
+        s.stop()
+
+    ck = stolon.LedgerChecker()
+    good = h(
+        invoke_op(0, "transfer", [0, 10]), ok_op(0, "transfer", [0, 10]),
+        invoke_op(0, "transfer", [0, -9]), ok_op(0, "transfer", [0, -9]),
+        invoke_op(1, "transfer", [0, -9]), fail_op(1, "transfer", [0, -9]),
+    )
+    assert ck.check({}, good)["valid?"] is True
+
+    # the double-spend: both withdrawals acknowledged
+    bad = h(
+        invoke_op(0, "transfer", [0, 10]), ok_op(0, "transfer", [0, 10]),
+        invoke_op(0, "transfer", [0, -9]), ok_op(0, "transfer", [0, -9]),
+        invoke_op(1, "transfer", [0, -9]), ok_op(1, "transfer", [0, -9]),
+    )
+    res = ck.check({}, bad)
+    assert res["valid?"] is False and res["errors"][0]["balance"] == -8
+
+    # charitable reading: indeterminate withdrawals don't count,
+    # indeterminate deposits do
+    charitable = h(
+        invoke_op(0, "transfer", [0, 10]), info_op(0, "transfer", [0, 10]),
+        invoke_op(1, "transfer", [0, -9]), info_op(1, "transfer", [0, -9]),
+    )
+    assert ck.check({}, charitable)["valid?"] is True
+
+
+def test_stolon_ledger_full_test_in_process():
+    from jepsen_tpu.suites import stolon
+
+    s = FakePg().start()
+    try:
+        t = stolon.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "user": "postgres",
+                "time-limit": 2,
+                "rate": 40,
+                "workload": "ledger",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
