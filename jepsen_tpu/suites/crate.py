@@ -10,6 +10,9 @@ client posts SQL to Crate's HTTP ``_sql`` endpoint.
 
 from __future__ import annotations
 
+import itertools as _itertools
+import json as _json
+
 from typing import Any, List, Optional
 
 from .. import client as client_mod
@@ -157,13 +160,353 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"register": common.register_workload(dict(opts or {}))}
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        # the suite's signature probes (reference: crate/dirty_read.clj,
+        # lost_updates.clj, version_divergence.clj)
+        "dirty-read": dirty_read_workload(opts),
+        "lost-updates": lost_updates_workload(opts),
+        "version-divergence": version_divergence_workload(opts),
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
-    w = workloads(opts)[opts.get("workload", "register")]
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    c = {
+        "dirty-read": CrateDirtyReadClient,
+        "lost-updates": CrateLostUpdatesClient,
+        "version-divergence": CrateVersionClient,
+    }.get(wname, CrateSqlClient)(opts)
     return common.build_test(
-        "crate-register", opts, db=CrateDB(opts), client=CrateSqlClient(opts),
+        f"crate-{wname}", opts, db=CrateDB(opts), client=c,
         workload=w,
     )
+
+
+# ---------------------------------------------------------------------
+# dirty-read (reference: crate/src/jepsen/crate/dirty_read.clj)
+# ---------------------------------------------------------------------
+
+
+class CrateDirtyReadClient(CrateSqlClient):
+    """Sequential-id inserts vs single-id reads vs a final strong read.
+    (reference: dirty_read.clj:31-90 — read by id ok/fail, refresh,
+    strong-read with a write-count-scaled limit, write)"""
+
+    #: acknowledged-write counter shared across worker clones so the
+    #: strong read's LIMIT always covers every insert (the reference's
+    #: `limit` atom, dirty_read.clj:31,86)
+    _writes = _itertools.count(1)
+    _high_water = 0
+
+    def setup(self, test):
+        try:
+            self.sql(
+                "create table if not exists dirty_read (id int primary key) "
+                "with (number_of_replicas = 'all')"
+            )
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self.sql(
+                    "select id from dirty_read where id = ?", [op["value"]]
+                )
+                found = bool(out.get("rows"))
+                return {**op, "type": "ok" if found else "fail"}
+            if op["f"] == "refresh":
+                self.sql("refresh table dirty_read")
+                return {**op, "type": "ok"}
+            if op["f"] == "strong-read":
+                out = self.sql(
+                    "select id from dirty_read limit ?",
+                    [100 + CrateDirtyReadClient._high_water],
+                )
+                ids = sorted(int(r[0]) for r in (out.get("rows") or []))
+                return {**op, "type": "ok", "value": ids}
+            if op["f"] == "write":
+                n = next(CrateDirtyReadClient._writes)
+                CrateDirtyReadClient._high_water = max(
+                    CrateDirtyReadClient._high_water, n
+                )
+                self.sql(
+                    "insert into dirty_read (id) values (?)", [op["value"]]
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+class DirtyReadChecker(checker_mod.Checker):
+    """No successful read of an id that the final strong reads don't
+    contain (a dirty read of uncommitted state), and no acknowledged
+    write missing from them (a lost write).
+    (reference: dirty_read.clj:143-190 checker)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK
+
+        writes, reads, strong = set(), set(), set()
+        saw_strong = False
+        for op in history:
+            if op.type != OK:
+                continue
+            if op.f == "write":
+                writes.add(op.value)
+            elif op.f == "read":
+                reads.add(op.value)
+            elif op.f == "strong-read":
+                saw_strong = True
+                strong |= set(op.value or [])
+        if not saw_strong:
+            return {"valid?": "unknown", "error": "no strong read"}
+        dirty = sorted(reads - strong)
+        lost = sorted(writes - strong)
+        return {
+            "valid?": not (dirty or lost),
+            "dirty": dirty[:10],
+            "lost": lost[:10],
+            "read-count": len(reads),
+            "write-count": len(writes),
+            "strong-count": len(strong),
+        }
+
+
+def dirty_read_workload(opts: Optional[dict] = None) -> dict:
+    """Writers insert sequential ids; readers probe recently-written
+    ids; a final refresh + strong read per thread settles the verdict.
+    (reference: dirty_read.clj:196-250 test)"""
+    state = {"next": 0}
+
+    def w(test, ctx):
+        v = state["next"]
+        state["next"] += 1
+        return {"type": "invoke", "f": "write", "value": v}
+
+    def r(test, ctx):
+        hi = max(1, state["next"])
+        return {"type": "invoke", "f": "read",
+                "value": gen.rng.randrange(hi)}
+
+    final = gen.clients(gen.phases(
+        gen.each_thread(
+            gen.once({"type": "invoke", "f": "refresh", "value": None})
+        ),
+        gen.each_thread(
+            gen.once(
+                {"type": "invoke", "f": "strong-read", "value": None}
+            )
+        ),
+    ))
+    return {
+        "generator": gen.mix([w, r]),
+        "final-generator": final,
+        "checker": DirtyReadChecker(),
+    }
+
+
+# ---------------------------------------------------------------------
+# lost-updates (reference: crate/src/jepsen/crate/lost_updates.clj)
+# ---------------------------------------------------------------------
+
+
+class CrateLostUpdatesClient(CrateSqlClient):
+    """Per-key sets grown by read + version-checked write-back (crate's
+    _version optimistic concurrency); a losing CAS is a clean :fail.
+    (reference: lost_updates.clj:32-104)"""
+
+    def setup(self, test):
+        try:
+            self.sql(
+                "create table if not exists sets "
+                "(id int primary key, elements string) "
+                "with (number_of_replicas = 'all')"
+            )
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                out = self.sql(
+                    "select elements from sets where id = ?", [k]
+                )
+                rows = out.get("rows") or []
+                els = sorted(_json.loads(rows[0][0])) if rows else []
+                return {**op, "type": "ok",
+                        "value": independent.kv(k, els)}
+            if op["f"] == "add":
+                out = self.sql(
+                    "select elements, _version from sets where id = ?", [k]
+                )
+                rows = out.get("rows") or []
+                if rows:
+                    els = _json.loads(rows[0][0])
+                    version = rows[0][1]
+                    els2 = _json.dumps(els + [v])
+                    res = self.sql(
+                        "update sets set elements = ? "
+                        "where id = ? and _version = ?",
+                        [els2, k, version],
+                    )
+                    if res.get("rowcount", 0) == 1:
+                        return {**op, "type": "ok"}
+                    return {**op, "type": "fail", "error": "version-miss"}
+                self.sql(
+                    "insert into sets (id, elements) values (?, ?)",
+                    [k, _json.dumps([v])],
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+def lost_updates_workload(opts: Optional[dict] = None) -> dict:
+    """Per-key adds then a final read per key, lifted over independent
+    keys with the set checker — lost updates show up as adds missing
+    from the final read.  (reference: lost_updates.clj:106-160 test)"""
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+    counter = {"n": 0}
+
+    def fgen(k):
+        def add(test, ctx):
+            counter["n"] += 1
+            return {"type": "invoke", "f": "add", "value": counter["n"]}
+
+        return gen.phases(
+            gen.limit(
+                int(opts.get("per-key-limit", 20)),
+                gen.stagger(1 / 50, add),
+            ),
+            gen.each_thread(
+                gen.once({"type": "invoke", "f": "read", "value": None})
+            ),
+        )
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * n, range(100_000), fgen
+        ),
+        "checker": independent.checker(_UnreadOkSetChecker()),
+        "concurrency": 2 * n,
+    }
+
+
+class _UnreadOkSetChecker(checker_mod.Checker):
+    """The per-key set checker, except a key whose final read was never
+    even *invoked* (the time limit cut the key's schedule before its
+    read phase) is vacuously valid with a marker instead of poisoning
+    the whole run with "unknown".  A key whose reads were invoked but
+    all FAILED keeps its unknown verdict — that's real evidence of an
+    unreachable key, not a scheduling artifact."""
+
+    def __init__(self):
+        self.inner = checker_mod.set_checker()
+
+    def check(self, test, history, opts=None):
+        out = self.inner.check(test, history, opts)
+        if out.get("valid?") == "unknown":
+            read_invoked = any(op.f == "read" for op in history)
+            if not read_invoked:
+                return {"valid?": True, "unread?": True}
+        return out
+
+
+# ---------------------------------------------------------------------
+# version-divergence
+# (reference: crate/src/jepsen/crate/version_divergence.clj)
+# ---------------------------------------------------------------------
+
+
+class CrateVersionClient(CrateSqlClient):
+    """Reads return [value, _version]; upsert writes.
+    (reference: version_divergence.clj:53-73)"""
+
+    def invoke(self, test, op):
+        k, v = op["value"] if isinstance(op["value"], (list, tuple)) else (
+            0, op["value"])
+        try:
+            if op["f"] == "read":
+                out = self.sql(
+                    "select value, _version from registers where id = ?",
+                    [k],
+                )
+                rows = out.get("rows") or []
+                val = list(rows[0]) if rows else None
+                return {**op, "type": "ok", "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.sql(
+                    "insert into registers (id, value) values (?, ?) "
+                    "on duplicate key update value = ?",
+                    [k, v, v],
+                )
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+class MultiversionChecker(checker_mod.Checker):
+    """Every read of one _version must observe the same value —
+    divergent values under a single version are replica divergence.
+    (reference: version_divergence.clj:95-110)"""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK
+
+        by_version: dict = {}
+        for op in history:
+            if op.type == OK and op.f == "read" and op.value is not None:
+                if op.value[0] is None:
+                    continue
+                value, version = op.value
+                by_version.setdefault(version, set()).add(value)
+        multis = {
+            str(ver): sorted(vals)
+            for ver, vals in by_version.items()
+            if len(vals) > 1
+        }
+        return {"valid?": not multis, "multis": multis}
+
+
+def version_divergence_workload(opts: Optional[dict] = None) -> dict:
+    """Reads/writes lifted over independent keys; the per-key
+    subhistories feed the multiversion checker.
+    (reference: version_divergence.clj:112-140 test)"""
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+
+    def fgen(k):
+        def r(test, ctx):
+            return {"type": "invoke", "f": "read", "value": None}
+
+        def w(test, ctx):
+            return {"type": "invoke", "f": "write",
+                    "value": gen.rng.randrange(5)}
+
+        return gen.limit(
+            int(opts.get("per-key-limit", 20)), gen.mix([r, w])
+        )
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * n, range(100_000), fgen
+        ),
+        "checker": independent.checker(MultiversionChecker()),
+        "concurrency": 2 * n,
+    }

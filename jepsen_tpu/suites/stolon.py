@@ -206,11 +206,23 @@ class LedgerClient(sql._Base):
         # LEGAL, so without this the checker would flag healthy
         # clusters (reference: ledger.clj:117-121 sets the test's
         # isolation on every connection)
-        isolation = str(
-            self.opts.get("isolation", "serializable")
-        ).upper()
+        isolation = (
+            str(self.opts.get("isolation", "serializable"))
+            .upper()
+            .replace("-", " ")
+        )
+        if isolation not in (
+            "SERIALIZABLE", "REPEATABLE READ",
+            "READ COMMITTED", "READ UNCOMMITTED",
+        ):
+            raise ValueError(f"unknown isolation {isolation!r}")
         try:
-            self.conn.query(f"BEGIN ISOLATION LEVEL {isolation}")
+            try:
+                self.conn.query(f"BEGIN ISOLATION LEVEL {isolation}")
+            except (sql.PgError, sql.MysqlError) as e:
+                # a refused BEGIN is a definite failure, like every
+                # other sql client's error path
+                return self._fail(op, e)
             try:
                 if amount > 0:
                     self.conn.query(

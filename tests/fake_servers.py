@@ -2234,3 +2234,102 @@ class _FaunaHandler(BaseHTTPRequestHandler):
 
 class FakeFauna(FakeServer):
     handler_class = _FaunaHandler
+
+
+# ---------------------------------------------------------------------------
+# CrateDB HTTP _sql endpoint — evaluates the statement shapes the crate
+# suite's register/dirty-read/lost-updates/version-divergence clients
+# emit, with crate's _version optimistic-concurrency semantics.
+# ---------------------------------------------------------------------------
+
+
+class _CrateHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        st = self.fake_store
+        n = int(self.headers.get("Content-Length") or 0)
+        payload = json.loads(self.rfile.read(n).decode() or "{}")
+        stmt = (payload.get("stmt") or "").strip().rstrip(";")
+        args = list(payload.get("args") or [])
+        low = stmt.lower()
+        with st.lock:
+            # registers: {id: [value, version]}
+            regs = st.kv.setdefault("crate_regs", {})
+            # dirty_read: set of ids
+            dr = st.kv.setdefault("crate_dirty", set())
+            # sets: {id: [elements_json, version]}
+            sets_ = st.kv.setdefault("crate_sets", {})
+            try:
+                self._send(self._eval(low, args, regs, dr, sets_))
+            except Exception as e:  # noqa: BLE001 - fake returns errors
+                self._send({"error": {"message": repr(e)}}, 400)
+
+    def _eval(self, low, args, regs, dr, sets_):
+        if low.startswith(("create table", "refresh table", "alter table")):
+            return {"rowcount": 1, "rows": []}
+        if low.startswith("select value, _version from registers"):
+            row = regs.get(args[0])
+            return {"cols": ["value", "_version"],
+                    "rows": [list(row)] if row else []}
+        if low.startswith("select value from registers"):
+            row = regs.get(args[0])
+            return {"cols": ["value"], "rows": [[row[0]]] if row else []}
+        if low.startswith("insert into registers"):
+            k, v = args[0], args[1]
+            if k in regs:
+                if "on duplicate key" not in low:
+                    raise ValueError("duplicate key")
+                regs[k] = [args[2], regs[k][1] + 1]
+            else:
+                regs[k] = [v, 1]
+            return {"rowcount": 1}
+        if low.startswith("update registers set value"):
+            new, k, old = args[0], args[1], args[2]
+            if k in regs and regs[k][0] == old:
+                regs[k] = [new, regs[k][1] + 1]
+                return {"rowcount": 1}
+            return {"rowcount": 0}
+        if low.startswith("insert into dirty_read"):
+            dr.add(args[0])
+            return {"rowcount": 1}
+        if low.startswith("select id from dirty_read where"):
+            return {"cols": ["id"],
+                    "rows": [[args[0]]] if args[0] in dr else []}
+        if low.startswith("select id from dirty_read"):
+            return {"cols": ["id"], "rows": [[i] for i in sorted(dr)]}
+        if low.startswith("select elements, _version from sets"):
+            row = sets_.get(args[0])
+            return {"cols": ["elements", "_version"],
+                    "rows": [list(row)] if row else []}
+        if low.startswith("select elements from sets"):
+            row = sets_.get(args[0])
+            return {"cols": ["elements"], "rows": [[row[0]]] if row else []}
+        if low.startswith("insert into sets"):
+            k, els = args[0], args[1]
+            if k in sets_:
+                raise ValueError("duplicate key")
+            sets_[k] = [els, 1]
+            return {"rowcount": 1}
+        if low.startswith("update sets set elements"):
+            els2, k, version = args[0], args[1], args[2]
+            if k in sets_ and sets_[k][1] == version:
+                sets_[k] = [els2, version + 1]
+                return {"rowcount": 1}
+            return {"rowcount": 0}
+        raise ValueError(f"unhandled stmt: {low!r}")
+
+
+class FakeCrate(FakeServer):
+    handler_class = _CrateHandler

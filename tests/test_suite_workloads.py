@@ -1539,3 +1539,141 @@ def test_stolon_ledger_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- crate dirty-read / lost-updates / version-divergence -------------------
+
+
+def test_crate_dirty_read_client_and_checker():
+    from fake_servers import FakeCrate
+
+    from jepsen_tpu.suites import crate
+
+    s = FakeCrate().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = crate.CrateDirtyReadClient(opts).open({}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "type": "invoke",
+                             "value": 0})["type"] == "ok"
+        assert c.invoke({}, {"f": "read", "type": "invoke",
+                             "value": 0})["type"] == "ok"
+        assert c.invoke({}, {"f": "read", "type": "invoke",
+                             "value": 99})["type"] == "fail"
+        assert c.invoke({}, {"f": "refresh", "type": "invoke",
+                             "value": None})["type"] == "ok"
+        r = c.invoke({}, {"f": "strong-read", "type": "invoke",
+                          "value": None})
+        assert r["type"] == "ok" and r["value"] == [0], r
+        c.close({})
+    finally:
+        s.stop()
+
+    ck = crate.DirtyReadChecker()
+    good = h(
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(0, "read", 1), ok_op(0, "read", 1),
+        invoke_op(0, "strong-read"), ok_op(0, "strong-read", [1]),
+    )
+    assert ck.check({}, good)["valid?"] is True
+    # dirty: read saw id 2 which no strong read contains
+    dirty = h(
+        invoke_op(0, "read", 2), ok_op(0, "read", 2),
+        invoke_op(0, "strong-read"), ok_op(0, "strong-read", [1]),
+    )
+    res = ck.check({}, dirty)
+    assert res["valid?"] is False and res["dirty"] == [2]
+    # lost: acknowledged write missing from strong reads
+    lost = h(
+        invoke_op(0, "write", 3), ok_op(0, "write", 3),
+        invoke_op(0, "strong-read"), ok_op(0, "strong-read", []),
+    )
+    res = ck.check({}, lost)
+    assert res["valid?"] is False and res["lost"] == [3]
+    assert ck.check({}, h(invoke_op(0, "write", 1),
+                          ok_op(0, "write", 1)))["valid?"] == "unknown"
+
+
+def test_crate_lost_updates_client_roundtrip():
+    from fake_servers import FakeCrate
+
+    from jepsen_tpu.suites import crate
+
+    s = FakeCrate().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = crate.CrateLostUpdatesClient(opts).open({}, "n1")
+        c.setup({})
+        for v in (1, 2, 3):
+            r = c.invoke({}, {"f": "add", "type": "invoke", "value": (7, v)})
+            assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (7, None)})
+        assert r["type"] == "ok" and r["value"][1] == [1, 2, 3], r
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_crate_version_divergence_client_and_checker():
+    from fake_servers import FakeCrate
+
+    from jepsen_tpu.suites import crate
+
+    s = FakeCrate().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = crate.CrateVersionClient(opts).open({}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "type": "invoke",
+                             "value": (3, 5)})["type"] == "ok"
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (3, None)})
+        assert r["type"] == "ok" and r["value"][1] == [5, 1], r
+        assert c.invoke({}, {"f": "write", "type": "invoke",
+                             "value": (3, 6)})["type"] == "ok"
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (3, None)})
+        assert r["value"][1] == [6, 2], r
+        c.close({})
+    finally:
+        s.stop()
+
+    ck = crate.MultiversionChecker()
+    good = h(
+        invoke_op(0, "read"), ok_op(0, "read", [5, 1]),
+        invoke_op(1, "read"), ok_op(1, "read", [5, 1]),
+        invoke_op(0, "read"), ok_op(0, "read", [6, 2]),
+    )
+    assert ck.check({}, good)["valid?"] is True
+    # two different values under ONE version: replica divergence
+    bad = h(
+        invoke_op(0, "read"), ok_op(0, "read", [5, 1]),
+        invoke_op(1, "read"), ok_op(1, "read", [9, 1]),
+    )
+    res = ck.check({}, bad)
+    assert res["valid?"] is False and "1" in res["multis"], res
+
+
+def test_crate_full_tests_in_process():
+    from fake_servers import FakeCrate
+
+    from jepsen_tpu.suites import crate
+
+    for wl, extra in (("dirty-read", {"rate": 40}),
+                      ("lost-updates", {"per-key-limit": 8}),
+                      ("version-divergence", {"per-key-limit": 10})):
+        s = FakeCrate().start()
+        try:
+            t = crate.test({
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "workload": wl,
+                "faults": [],
+                **extra,
+            })
+            t["db"] = db_mod.noop()
+            t["ssh"] = {"dummy?": True}
+            result = core.run(t)
+            assert result["results"]["valid?"] is True, (wl, result["results"])
+        finally:
+            s.stop()
