@@ -152,13 +152,116 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"set": common.set_workload(dict(opts or {}))}
+    # dirty-read shares crate's workload/checker shape — the reference's
+    # es dirty-read client is literally wrapped by crate's
+    # (crate/dirty_read.clj:97-141 es-client)
+    from . import crate as crate_suite
+
+    opts = dict(opts or {})
+    return {
+        "set": common.set_workload(opts),
+        "dirty-read": crate_suite.dirty_read_workload(opts),
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
-    w = workloads(opts)[opts.get("workload", "set")]
-    return common.build_test(
-        "elasticsearch-set", opts, db=ElasticsearchDB(opts),
-        client=EsSetClient(opts), workload=w,
+    wname = opts.get("workload", "set")
+    w = workloads(opts)[wname]
+    c = (
+        EsDirtyReadClient(opts)
+        if wname == "dirty-read"
+        else EsSetClient(opts)
     )
+    return common.build_test(
+        f"elasticsearch-{wname}", opts, db=ElasticsearchDB(opts),
+        client=c, workload=w,
+    )
+
+
+# ---------------------------------------------------------------------
+# dirty-read
+# (reference: elasticsearch/src/jepsen/elasticsearch/dirty_read.clj)
+# ---------------------------------------------------------------------
+
+DR_INDEX = "dirty_read"
+
+
+class EsDirtyReadClient(EsSetClient):
+    """Index-by-id writes vs GET-by-id reads vs a refresh + search-all
+    strong read — the probe that found Elasticsearch's dirty/lost reads.
+    (reference: dirty_read.clj:30-105 — write indexes {id}, read GETs
+    the doc ok/fail, refresh must succeed on all shards, strong-read
+    collects every id; the workload/checker shape is shared with
+    crate's dirty-read, whose client the reference literally wraps)"""
+
+    def setup(self, test):
+        # the probe is about replica visibility: the index must span
+        # every node (reference dirty_read.clj creates it up front;
+        # crate's sibling sets number_of_replicas = "0-all")
+        try:
+            self.conn.put(
+                f"/{DR_INDEX}",
+                {"settings": {"index": {"auto_expand_replicas": "0-all"}}},
+                ok=(200, 201),
+            )
+        except (HttpError, IndeterminateError):
+            pass  # already exists
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "write":
+                self.conn.put(
+                    f"/{DR_INDEX}/default/{int(op['value'])}",
+                    {"id": int(op["value"])},
+                    ok=(200, 201),
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                status, _body = self.conn.request(
+                    "GET",
+                    f"/{DR_INDEX}/default/{int(op['value'])}",
+                    ok=(200,),
+                    raise_on_error=False,
+                )
+                return {**op, "type": "ok" if status == 200 else "fail"}
+            if op["f"] == "refresh":
+                _, body = self.conn.post(f"/{DR_INDEX}/_refresh", ok=(200,))
+                shards = (body or {}).get("_shards", {})
+                if shards.get("successful") != shards.get("total"):
+                    # a partial refresh means the strong read may miss
+                    # docs — reporting ok here would turn that into
+                    # false "lost" findings (reference: dirty_read.clj
+                    # retries until successful == total)
+                    return {**op, "type": "fail",
+                            "error": f"partial refresh: {shards}"}
+                return {**op, "type": "ok"}
+            if op["f"] == "strong-read":
+                # scroll, don't one-shot: a plain search is capped by
+                # index.max_result_window (10k) — same pagination as
+                # EsSetClient.read above
+                _, body = self.conn.post(
+                    f"/{DR_INDEX}/_search",
+                    {"size": 1000, "query": {"match_all": {}}},
+                    params={"scroll": "1m"},
+                    ok=(200,),
+                )
+                ids = [int(h["_source"]["id"]) for h in body["hits"]["hits"]]
+                scroll_id = body.get("_scroll_id")
+                while scroll_id:
+                    _, body = self.conn.post(
+                        "/_search/scroll",
+                        {"scroll": "1m", "scroll_id": scroll_id},
+                        ok=(200,),
+                    )
+                    hits = body["hits"]["hits"]
+                    if not hits:
+                        break
+                    ids.extend(int(h["_source"]["id"]) for h in hits)
+                    scroll_id = body.get("_scroll_id")
+                return {**op, "type": "ok", "value": sorted(ids)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}

@@ -184,6 +184,27 @@ class LedgerClient(sql._Base):
     _ids = _itertools.count(1)
     _ids_lock = _threading.Lock()
 
+    ISOLATION_LEVELS = (
+        "SERIALIZABLE", "REPEATABLE READ",
+        "READ COMMITTED", "READ UNCOMMITTED",
+    )
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        # validate ONCE, where a raise aborts test construction — a
+        # per-invoke raise would be downgraded to info ops and the
+        # misconfigured run would pass vacuously
+        self.isolation = (
+            str(self.opts.get("isolation", "serializable"))
+            .upper()
+            .replace("-", " ")
+        )
+        if self.isolation not in self.ISOLATION_LEVELS:
+            raise ValueError(
+                f"unknown isolation {self.isolation!r}; "
+                f"expected one of {self.ISOLATION_LEVELS}"
+            )
+
     def _next_id(self) -> int:
         with LedgerClient._ids_lock:
             return next(LedgerClient._ids)
@@ -205,20 +226,12 @@ class LedgerClient(sql._Base):
         # at read committed two concurrent balance checks passing is
         # LEGAL, so without this the checker would flag healthy
         # clusters (reference: ledger.clj:117-121 sets the test's
-        # isolation on every connection)
-        isolation = (
-            str(self.opts.get("isolation", "serializable"))
-            .upper()
-            .replace("-", " ")
-        )
-        if isolation not in (
-            "SERIALIZABLE", "REPEATABLE READ",
-            "READ COMMITTED", "READ UNCOMMITTED",
-        ):
-            raise ValueError(f"unknown isolation {isolation!r}")
+        # isolation on every connection; validated in __init__)
         try:
             try:
-                self.conn.query(f"BEGIN ISOLATION LEVEL {isolation}")
+                self.conn.query(
+                    f"BEGIN ISOLATION LEVEL {self.isolation}"
+                )
             except (sql.PgError, sql.MysqlError) as e:
                 # a refused BEGIN is a definite failure, like every
                 # other sql client's error path

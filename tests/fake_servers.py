@@ -2333,3 +2333,82 @@ class _CrateHandler(BaseHTTPRequestHandler):
 
 class FakeCrate(FakeServer):
     handler_class = _CrateHandler
+
+
+# ---------------------------------------------------------------------------
+# Elasticsearch HTTP subset — index-by-id PUT, GET-by-id, _refresh, and
+# _search (match_all; single page, no scroll) for the es suite's set and
+# dirty-read clients.
+# ---------------------------------------------------------------------------
+
+
+class _EsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _docs(self):
+        return self.fake_store.kv.setdefault("es_docs", {})
+
+    def do_PUT(self):
+        parts = urlparse(self.path).path.strip("/").split("/")
+        n = int(self.headers.get("Content-Length") or 0)
+        doc = json.loads(self.rfile.read(n).decode() or "{}")
+        with self.fake_store.lock:
+            if len(parts) == 3:
+                index, _type, id_ = parts
+                self._docs()[(index, id_)] = doc
+                self._send({"result": "created"}, 201)
+                return
+            if len(parts) == 1:  # index creation with settings
+                self._send({"acknowledged": True})
+                return
+        self._send({"error": "bad path"}, 400)
+
+    def do_GET(self):
+        parts = urlparse(self.path).path.strip("/").split("/")
+        with self.fake_store.lock:
+            if len(parts) == 3:
+                index, _type, id_ = parts
+                doc = self._docs().get((index, id_))
+                if doc is None:
+                    self._send({"found": False}, 404)
+                else:
+                    self._send({"found": True, "_source": doc})
+                return
+        self._send({"error": "bad path"}, 400)
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        with self.fake_store.lock:
+            if path.endswith("/_refresh"):
+                self._send({"_shards": {"total": 1, "successful": 1}})
+                return
+            if path.endswith("/_search"):
+                index = path.strip("/").split("/")[0]
+                hits = [
+                    {"_id": id_, "_source": doc}
+                    for (ix, id_), doc in sorted(self._docs().items())
+                    if ix == index
+                ]
+                self._send({"hits": {"hits": hits}})
+                return
+            if path == "/_search/scroll":
+                self._send({"hits": {"hits": []}})
+                return
+        self._send({"error": f"no route {path}"}, 400)
+
+
+class FakeEs(FakeServer):
+    handler_class = _EsHandler

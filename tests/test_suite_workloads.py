@@ -1677,3 +1677,55 @@ def test_crate_full_tests_in_process():
             assert result["results"]["valid?"] is True, (wl, result["results"])
         finally:
             s.stop()
+
+
+# -- elasticsearch dirty-read -----------------------------------------------
+
+
+def test_es_dirty_read_client_roundtrip():
+    from fake_servers import FakeEs
+
+    from jepsen_tpu.suites import elasticsearch as es
+
+    s = FakeEs().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = es.EsDirtyReadClient(opts).open({}, "n1")
+        assert c.invoke({}, {"f": "write", "type": "invoke",
+                             "value": 3})["type"] == "ok"
+        assert c.invoke({}, {"f": "read", "type": "invoke",
+                             "value": 3})["type"] == "ok"
+        assert c.invoke({}, {"f": "read", "type": "invoke",
+                             "value": 9})["type"] == "fail"
+        assert c.invoke({}, {"f": "refresh", "type": "invoke",
+                             "value": None})["type"] == "ok"
+        r = c.invoke({}, {"f": "strong-read", "type": "invoke",
+                          "value": None})
+        assert r["type"] == "ok" and r["value"] == [3], r
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_es_dirty_read_full_test_in_process():
+    from fake_servers import FakeEs
+
+    from jepsen_tpu.suites import elasticsearch as es
+
+    s = FakeEs().start()
+    try:
+        t = es.test({
+            "nodes": ["n1", "n2"],
+            "host": "127.0.0.1",
+            "port": s.port,
+            "time-limit": 2,
+            "rate": 40,
+            "workload": "dirty-read",
+            "faults": [],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
