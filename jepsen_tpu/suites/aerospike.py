@@ -209,11 +209,46 @@ def client(opts: Optional[dict] = None):
     return CasRegisterClient(opts)
 
 
+class SetClient(_AsBase):
+    """A set as CAS-appends to one record's string bin: add appends
+    " v", read splits the bin back into integers.
+    (reference: aerospike/set.clj:12-41 — single key "cats", append!,
+    space-split parse)"""
+
+    BIN = "value"
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                bins, _gen = self.conn.get(SET, int(k))
+                raw = str((bins or {}).get(self.BIN, ""))
+                vals = sorted(
+                    int(x) for x in raw.split(" ") if x.strip()
+                )
+                return {**op, "type": "ok", "value": independent.kv(k, vals)}
+            if op["f"] == "add":
+                self.conn.append_str(SET, int(k), self.BIN, f" {int(v)}")
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except AerospikeError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: set.clj:43-66 workload — shared independent-set
+    shape)"""
+    return common.independent_set_workload(opts)
+
+
 def workloads(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     return {
         "cas-register": common.register_workload(opts),
         "counter": common.counter_workload(opts),
+        "set": set_workload(opts),
     }
 
 
@@ -221,7 +256,10 @@ def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "cas-register")
     w = workloads(opts)[wname]
-    c = CounterClient(opts) if wname == "counter" else CasRegisterClient(opts)
+    c = {
+        "counter": CounterClient,
+        "set": SetClient,
+    }.get(wname, CasRegisterClient)(opts)
     # the suite fault menu (capped kills + revive/recluster recovery)
     # takes over when its fault names are requested
     pkg = None

@@ -374,55 +374,10 @@ class CrateLostUpdatesClient(CrateSqlClient):
 
 
 def lost_updates_workload(opts: Optional[dict] = None) -> dict:
-    """Per-key adds then a final read per key, lifted over independent
-    keys with the set checker — lost updates show up as adds missing
-    from the final read.  (reference: lost_updates.clj:106-160 test)"""
-    opts = dict(opts or {})
-    n = max(1, len(opts.get("nodes", ["n1"])))
-    counter = {"n": 0}
-
-    def fgen(k):
-        def add(test, ctx):
-            counter["n"] += 1
-            return {"type": "invoke", "f": "add", "value": counter["n"]}
-
-        return gen.phases(
-            gen.limit(
-                int(opts.get("per-key-limit", 20)),
-                gen.stagger(1 / 50, add),
-            ),
-            gen.each_thread(
-                gen.once({"type": "invoke", "f": "read", "value": None})
-            ),
-        )
-
-    return {
-        "generator": independent.concurrent_generator(
-            2 * n, range(100_000), fgen
-        ),
-        "checker": independent.checker(_UnreadOkSetChecker()),
-        "concurrency": 2 * n,
-    }
-
-
-class _UnreadOkSetChecker(checker_mod.Checker):
-    """The per-key set checker, except a key whose final read was never
-    even *invoked* (the time limit cut the key's schedule before its
-    read phase) is vacuously valid with a marker instead of poisoning
-    the whole run with "unknown".  A key whose reads were invoked but
-    all FAILED keeps its unknown verdict — that's real evidence of an
-    unreachable key, not a scheduling artifact."""
-
-    def __init__(self):
-        self.inner = checker_mod.set_checker()
-
-    def check(self, test, history, opts=None):
-        out = self.inner.check(test, history, opts)
-        if out.get("valid?") == "unknown":
-            read_invoked = any(op.f == "read" for op in history)
-            if not read_invoked:
-                return {"valid?": True, "unread?": True}
-        return out
+    """Per-key adds then a final read per key — lost updates show up as
+    adds missing from the final read.  Delegates to the shared
+    independent-set builder.  (reference: lost_updates.clj:106-160)"""
+    return common.independent_set_workload(opts)
 
 
 # ---------------------------------------------------------------------

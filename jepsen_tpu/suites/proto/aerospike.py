@@ -33,7 +33,7 @@ INFO2_WRITE = 0x01
 INFO2_GENERATION = 0x04   # write only if generation matches
 INFO2_CREATE_ONLY = 0x20  # write only if the record does not exist
 
-OP_READ, OP_WRITE = 1, 2
+OP_READ, OP_WRITE, OP_APPEND = 1, 2, 9
 
 PARTICLE_INT, PARTICLE_STR = 1, 3
 
@@ -209,3 +209,15 @@ class AerospikeClient:
             raise IndeterminateError("server-side timeout")
         if code != RESULT_OK:
             raise AerospikeError(f"put failed: code {code}", code=code)
+
+    def append_str(self, set_name: str, key: Any, bin_name: str,
+                   s: str) -> None:
+        """Append a string to a string bin (creating the record if
+        absent) — the primitive the reference's set workload rides
+        (aerospike/set.clj:35 s/append!)."""
+        ops = [_op(OP_APPEND, bin_name, s.encode(), PARTICLE_STR)]
+        code, _g, _b = self._call(0, INFO2_WRITE, 0, set_name, key, ops)
+        if code == RESULT_TIMEOUT:
+            raise IndeterminateError("server-side timeout")
+        if code != RESULT_OK:
+            raise AerospikeError(f"append failed: code {code}", code=code)

@@ -1659,9 +1659,12 @@ class _AerospikeHandler(_RecvExact, socketserver.BaseRequestHandler):
         ops = b""
         for name, val in bins.items():
             nb = name.encode()
-            vb = struct.pack(">q", val)
-            ops += struct.pack(">IBBBB", 4 + len(nb) + len(vb), 1, 1, 0,
-                               len(nb)) + nb + vb
+            if isinstance(val, str):
+                vb, particle = val.encode(), 3  # string bin
+            else:
+                vb, particle = struct.pack(">q", val), 1
+            ops += struct.pack(">IBBBB", 4 + len(nb) + len(vb), 1,
+                               particle, 0, len(nb)) + nb + vb
         body = struct.pack(
             ">BBBBBBIIIHH", 22, 0, 0, 0, 0, result_code, generation, 0, 0,
             0, len(bins)) + ops
@@ -1712,6 +1715,10 @@ class _AerospikeHandler(_RecvExact, socketserver.BaseRequestHandler):
                         for opid, name, raw in ops:
                             if opid == 2:
                                 bins[name] = struct.unpack(">q", raw)[0]
+                            elif opid == 9:  # append to a string bin
+                                bins[name] = (
+                                    str(bins.get(name, "")) + raw.decode()
+                                )
                         gen = (rec[1] if rec else 0) + 1
                         store.as_records[digest] = (bins, gen)
                         self._reply(0, gen, {})

@@ -405,3 +405,53 @@ def test_robustirc_set_roundtrip():
         c2.close(t)
     finally:
         s.stop()
+
+
+def test_aerospike_set_append_roundtrip():
+    """The set client's string-bin appends accumulate and parse back.
+    (reference: aerospike/set.clj:12-41)"""
+    from fake_servers import FakeAerospike
+
+    from jepsen_tpu.suites import aerospike
+
+    s = FakeAerospike().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = aerospike.SetClient(opts).open({}, "n1")
+        for v in (5, 2, 9):
+            r = c.invoke({}, {"f": "add", "type": "invoke", "value": (3, v)})
+            assert r["type"] == "ok", r
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (3, None)})
+        assert r["type"] == "ok" and r["value"][1] == [2, 5, 9], r
+        # a different key is empty
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": (4, None)})
+        assert r["type"] == "ok" and r["value"][1] == [], r
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_aerospike_set_full_test_in_process():
+    from fake_servers import FakeAerospike
+
+    from jepsen_tpu import core
+    from jepsen_tpu import db as db_mod
+    from jepsen_tpu.suites import aerospike
+
+    s = FakeAerospike().start()
+    try:
+        t = aerospike.test({
+            "nodes": ["n1", "n2"],
+            "host": "127.0.0.1",
+            "port": s.port,
+            "time-limit": 2,
+            "workload": "set",
+            "per-key-limit": 8,
+            "faults": [],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
