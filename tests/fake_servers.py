@@ -765,7 +765,7 @@ class _MongoHandler(_RecvExact, socketserver.BaseRequestHandler):
         if name == "find":
             coll = docs.get(cmd["find"], [])
             flt = cmd.get("filter", {})
-            out = [d for d in coll if all(d.get(k) == v for k, v in flt.items())]
+            out = [d for d in coll if _mongo_match(d, flt)]
             return {
                 "ok": 1,
                 "cursor": {"id": 0, "ns": "test." + cmd["find"], "firstBatch": out},
@@ -775,11 +775,12 @@ class _MongoHandler(_RecvExact, socketserver.BaseRequestHandler):
             n = 0
             for u in cmd["updates"]:
                 q, mod = u["q"], u["u"]
-                matched = [
-                    d for d in coll if all(d.get(k) == v for k, v in q.items())
-                ]
+                matched = [d for d in coll if _mongo_match(d, q)]
                 if not matched and u.get("upsert"):
-                    nd = dict(q)
+                    nd = {
+                        k: v for k, v in q.items()
+                        if not isinstance(v, dict)
+                    }
                     nd.update(mod.get("$set", {}))
                     coll.append(nd)
                     n += 1
@@ -788,6 +789,10 @@ class _MongoHandler(_RecvExact, socketserver.BaseRequestHandler):
                         d[k] = v
                     for k, v in mod.get("$inc", {}).items():
                         d[k] = d.get(k, 0) + v
+                    for k, v in mod.get("$push", {}).items():
+                        d.setdefault(k, []).append(v)
+                    for k, v in mod.get("$pull", {}).items():
+                        d[k] = [x for x in d.get(k, []) if x != v]
                     n += 1
             return {"ok": 1, "n": n}
         if name == "findAndModify":
@@ -810,6 +815,38 @@ class _MongoHandler(_RecvExact, socketserver.BaseRequestHandler):
         if name in ("ismaster", "hello"):
             return {"ok": 1, "ismaster": True, "maxWireVersion": 13}
         return {"ok": 0, "errmsg": f"no such command: {list(cmd)[0]}", "code": 59}
+
+
+def _mongo_match(doc, query) -> bool:
+    """Mongo filter semantics for the subset the suites use: scalar
+    equality (with array-contains for list fields), $ne (for arrays:
+    does-not-contain), and $size."""
+    for k, v in query.items():
+        cur = doc.get(k)
+        if isinstance(v, dict):
+            unsupported = set(v) - {"$ne", "$size"}
+            if unsupported:
+                # fail LOUDLY: silently matching everything would let a
+                # future suite filter corrupt state without a trace
+                raise ValueError(
+                    f"fake mongo: unsupported operators {unsupported}"
+                )
+            if "$ne" in v:
+                ne = v["$ne"]
+                if isinstance(cur, list):
+                    if ne in cur:
+                        return False
+                elif cur == ne:
+                    return False
+            if "$size" in v:
+                if not isinstance(cur, list) or len(cur) != v["$size"]:
+                    return False
+        elif isinstance(cur, list):
+            if v != cur and v not in cur:
+                return False
+        elif cur != v:
+            return False
+    return True
 
 
 class FakeMongo(FakeServer):

@@ -1729,3 +1729,100 @@ def test_es_dirty_read_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- mongodb-smartos transfer (two-phase commit) ----------------------------
+
+
+def test_mongo_transfer_client_roundtrip():
+    from fake_servers import FakeMongo
+
+    from jepsen_tpu.suites import mongodb_smartos as ms
+
+    s = FakeMongo().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"nodes": ["n1"], "accounts": [0, 1], "starting-balance": 10}
+        c = ms.TransferClient(opts).open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"f": "read", "type": "invoke", "value": None})
+        assert r["type"] == "ok" and r["value"] == {0: 10, 1: 10}, r
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 1, "amount": 3}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "type": "invoke", "value": None})
+        assert r["value"] == {0: 7, 1: 13}, r
+        c.close(t)
+    finally:
+        s.stop()
+
+
+def test_mongo_transfer_checker():
+    from jepsen_tpu.suites.mongodb_smartos import TransferChecker
+
+    t = {"accounts": [0, 1], "starting-balance": 10}
+    ck = TransferChecker()
+    good = h(
+        invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        ok_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        invoke_op(1, "read"), ok_op(1, "read", {0: 7, 1: 13}),
+    )
+    assert ck.check(t, good)["valid?"] is True
+    # a torn final total (half-applied transfer) fails
+    torn = h(
+        invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        ok_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        invoke_op(1, "read"), ok_op(1, "read", {0: 7, 1: 10}),
+    )
+    res = ck.check(t, torn)
+    assert res["valid?"] is False and res["errors"][0]["total"] == 17
+    # mid-run reads are not judged
+    midrun = h(
+        invoke_op(1, "read"), ok_op(1, "read", {0: 7, 1: 10}),
+        invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        ok_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        invoke_op(1, "read"), ok_op(1, "read", {0: 4, 1: 16}),
+    )
+    assert ck.check(t, midrun)["valid?"] is True
+    assert ck.check(t, h(
+        invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 1}),
+        ok_op(0, "transfer", {"from": 0, "to": 1, "amount": 1}),
+    ))["valid?"] == "unknown"
+    # an indeterminate transfer may have half-applied: totals within the
+    # slack envelope pass, beyond it fail
+    half = h(
+        invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        info_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        invoke_op(1, "read"), ok_op(1, "read", {0: 7, 1: 10}),
+    )
+    assert ck.check(t, half)["valid?"] is True
+    beyond = h(
+        invoke_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        info_op(0, "transfer", {"from": 0, "to": 1, "amount": 3}),
+        invoke_op(1, "read"), ok_op(1, "read", {0: 2, 1: 10}),
+    )
+    assert ck.check(t, beyond)["valid?"] is False
+
+
+def test_mongo_transfer_full_test_in_process():
+    from fake_servers import FakeMongo
+
+    from jepsen_tpu.suites import mongodb_smartos as ms
+
+    s = FakeMongo().start()
+    try:
+        t = ms.test({
+            "nodes": ["n1", "n2"],
+            "host": "127.0.0.1",
+            "port": s.port,
+            "time-limit": 2,
+            "rate": 30,
+            "workload": "transfer",
+            "faults": [],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
