@@ -60,6 +60,8 @@ from .step_kernels import (
     F_READ_ANY,
     F_ACQUIRE,
     F_RELEASE,
+    F_ENQUEUE,
+    F_DEQUEUE,
 )
 
 #: specs whose state is exactly "current value id" (mutex: 0=free 1=held)
@@ -69,12 +71,18 @@ DENSE_SPECS = ("register", "cas-register", "mutex")
 MAX_C = 12   # 2^12 subsets = 128 packed words
 MAX_V = 32
 
-#: _LOMASK[j]: bits of a 32-subset word whose subset index has bit j clear
-_LOMASK = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
-
 
 def applicable(spec_name: str, C: int, V: int) -> bool:
+    if spec_name == "unordered-queue":
+        # the queue kernel has no V dimension: its state is a pure
+        # function of the linset (unique-value ops commute), so only C
+        # bounds it — value ids are capped by the encoder at 31 anyway
+        return C <= MAX_C
     return spec_name in DENSE_SPECS and C <= MAX_C and V <= MAX_V
+
+
+#: _LOMASK[j]: bits of a 32-subset word whose subset index has bit j clear
+_LOMASK = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
 
 
 def _n_words(C: int) -> int:
@@ -122,6 +130,20 @@ def _subset_maps(C: int):
         jnp.asarray(dmask),
         jnp.asarray(dshr),
     )
+
+
+def _subset_has(C: int):
+    """has[j]: [W] uint32 mask of packed bits whose subset index has
+    bit j SET — the "configs that linearized slot j" selector."""
+    W = _n_words(C)
+    k = np.arange(W)
+    has = np.zeros((C, W), np.uint32)
+    for j in range(C):
+        if j < 5:
+            has[j] = np.uint32(0xFFFFFFFF ^ _LOMASK[j])
+        else:
+            has[j] = np.where((k & (1 << (j - 5))) != 0, 0xFFFFFFFF, 0)
+    return jnp.asarray(has)
 
 
 def _or_fold(terms):
@@ -258,7 +280,153 @@ def build_dense(spec_name: str, E: int, C: int, V: int):
     return jax.vmap(check_one)
 
 
-@lru_cache(maxsize=64)
+def build_dense_queue(E: int, C: int):
+    """Dense unordered-queue kernel: unique-value enqueues/dequeues
+    commute, so a config's multiset state is a pure function of its
+    linset — the search state collapses to ONE packed bitset over the
+    2^C subsets (the register kernel with its value axis removed), plus
+    two carried uint32 value-bitsets for the promoted prefix:
+
+        enqC bit v: v was enqueued by a completed op (or initially)
+        deqC bit v: v was dequeued by a completed op
+
+    Per candidate the legal-source-subset mask is static algebra:
+    enqueues are always legal; a dequeue of v may linearize from
+    subsets where v is present — (enq completed, or the open enqueue's
+    slot bit is set) and no other open dequeue of v's bit is set and v
+    wasn't already dequeued by the prefix.  Closure/completion are the
+    same masked-shift subset maps as the register kernel; no sorts,
+    no overflow."""
+    W = _n_words(C)
+    max_closure = C + 2
+    uidx, umask, ushl, didx, dmask, dshr = _subset_maps(C)
+    has = _subset_has(C)
+    ones = jnp.full((W,), 0xFFFFFFFF, jnp.uint32)
+    zeros = jnp.zeros((W,), jnp.uint32)
+
+    def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
+        D0 = jnp.zeros((W,), jnp.uint32).at[0].set(1)  # empty linset
+        enqC0 = init_state.astype(jnp.uint32)  # initial contents bitset
+        deqC0 = jnp.uint32(0)
+
+        def event_body(carry, ev):
+            D, enqC, deqC, done, failed_at, idx = carry
+            e_slot, c_slot, c_f, c_a, c_b = ev
+            is_pad = e_slot < 0
+
+            # regroup candidate lanes by slot id (cf. register kernel)
+            eq = c_slot[None, :] == jnp.arange(C, dtype=c_slot.dtype)[:, None]
+            active_s = eq.any(axis=1)
+            f_s = jnp.sum(jnp.where(eq, c_f[None, :], 0), axis=1)
+            a_s = jnp.sum(jnp.where(eq, c_a[None, :], 0), axis=1)
+
+            is_enq = active_s & (f_s == F_ENQUEUE)
+            is_deq = active_s & (f_s == F_DEQUEUE)
+            # value ids are 1-based; clamp inactive lanes' shift to 0
+            shift = jnp.where(active_s, a_s - 1, 0).astype(jnp.uint32)
+            vbit = jnp.where(
+                active_s, jnp.uint32(1) << shift, jnp.uint32(0)
+            )
+
+            # per-slot-pair value match: does slot k hold the open
+            # enqueue (resp. another open dequeue) of slot j's value?
+            same_val = a_s[:, None] == a_s[None, :]
+            enq_at = same_val & is_enq[None, :] & is_deq[:, None]
+            other_deq = (
+                same_val & is_deq[None, :] & is_deq[:, None]
+                & ~jnp.eye(C, dtype=bool)
+            )
+            # [C, W] masks via one-hot folds over the static has-table
+            e_mask = _or_fold(
+                jnp.where(enq_at[:, k, None], has[k][None, :], jnp.uint32(0))
+                for k in range(C)
+            )
+            forbid = _or_fold(
+                jnp.where(
+                    other_deq[:, k, None], has[k][None, :], jnp.uint32(0)
+                )
+                for k in range(C)
+            )
+
+            enq_done = (enqC & vbit) != 0   # [C] per-slot: v in prefix
+            deq_done = (deqC & vbit) != 0
+            enq_part = jnp.where(
+                enq_done[:, None], ones[None, :], e_mask
+            )
+            valid = jnp.where(
+                is_deq[:, None],
+                jnp.where(
+                    deq_done[:, None], zeros[None, :], enq_part & ~forbid
+                ),
+                jnp.where(is_enq[:, None], ones[None, :], zeros[None, :]),
+            )
+
+            # --- closure to fixpoint ---
+            def cond(c):
+                _, changed, i = c
+                return changed & (i < max_closure)
+
+            def body(c):
+                Dc, _, i = c
+                X = Dc[None, :] & valid           # [C, W] legal sources
+                U = jnp.take_along_axis(X, uidx, axis=1)
+                U = (U & umask) << ushl[:, None]
+                Dn = Dc | _or_fold(U[j] for j in range(C))
+                return (Dn, (Dn != Dc).any(), i + 1)
+
+            Dc, _, _ = lax.while_loop(
+                cond, body, (D, jnp.bool_(True), jnp.int32(0))
+            )
+
+            # --- completion: filter + promote e_slot ---
+            Ds = jnp.take_along_axis(
+                jnp.broadcast_to(Dc[None], (C, W)), didx, axis=1
+            )
+            Dvar = (Ds >> dshr[:, None]) & dmask
+            onehot = e_slot == jnp.arange(C)
+            Df = _or_fold(
+                jnp.where(onehot[j], Dvar[j], jnp.uint32(0)) for j in range(C)
+            )
+            empty = ~(Df != 0).any()
+
+            # bake the completing op's effect into the prefix bitsets
+            comp_enq = (onehot & is_enq).any()
+            comp_deq = (onehot & is_deq).any()
+            comp_vbit = jnp.sum(jnp.where(onehot, vbit, jnp.uint32(0)))
+            enqC2 = jnp.where(~is_pad & comp_enq, enqC | comp_vbit, enqC)
+            deqC2 = jnp.where(~is_pad & comp_deq, deqC | comp_vbit, deqC)
+
+            done2 = done | (~is_pad & empty)
+            D2 = jnp.where(done2, jnp.uint32(0), jnp.where(is_pad, D, Df))
+            failed_at2 = jnp.where(done | is_pad | ~empty, failed_at, idx)
+            return (D2, enqC2, deqC2, done2, failed_at2, idx + 1), None
+
+        carry0 = (
+            D0, enqC0, deqC0, jnp.bool_(False), jnp.int32(-1), jnp.int32(0)
+        )
+        (_, _, _, done, failed_at, _), _ = lax.scan(
+            event_body,
+            carry0,
+            (ev_slot, cand_slot, cand_f, cand_a, cand_b),
+        )
+        return ~done, failed_at, jnp.bool_(False)
+
+    return jax.vmap(check_one)
+
+
 def make_dense_fn(spec_name: str, E: int, C: int, V: int):
-    """Jitted, cached dense checker (same contract as wgl.make_check_fn)."""
+    """Jitted, cached dense checker (same contract as wgl.make_check_fn).
+    The queue kernel has no value axis, so V is normalized out of its
+    cache key — otherwise every distinct value-domain (and any initial
+    bitset contents, whose numeric max can be huge) would re-jit a
+    byte-identical kernel."""
+    if spec_name == "unordered-queue":
+        V = 0
+    return _make_dense_fn_cached(spec_name, E, C, V)
+
+
+@lru_cache(maxsize=64)
+def _make_dense_fn_cached(spec_name: str, E: int, C: int, V: int):
+    if spec_name == "unordered-queue":
+        return jax.jit(build_dense_queue(E, C))
     return jax.jit(build_dense(spec_name, E, C, V))

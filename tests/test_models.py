@@ -244,9 +244,57 @@ def test_unordered_queue_sufficient_rung_keeps_device():
         for i in range(8)
     ]
     model = models.unordered_queue()
-    outs = wgl.check_batch(model, hists, frontier=8, escalation=())
+    # max_closure forces the GENERIC kernel (auto dispatch now picks the
+    # dense queue kernel): the 2^C rung must still rescue its overflows
+    C = 6
+    outs = wgl.check_batch(model, hists, frontier=8, escalation=(),
+                           max_closure=C + 1, slot_cap=C)
     assert all(o["engine"] == "tpu" for o in outs), [
         o["engine"] for o in outs
     ]
+    assert {o.get("kernel") for o in outs} == {"frontier"}
     oracle = [linear.analysis(model, h)["valid?"] for h in hists]
     assert [o["valid?"] for o in outs] == oracle
+
+
+def test_unordered_queue_dense_kernel_three_way_differential():
+    """The dense queue kernel (bitset over 2^C linsets, no sorts) must
+    agree with both the generic frontier kernel and the CPU oracle on
+    random queue histories, including double-dequeue corruptions."""
+    import random
+
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    rng = random.Random(77)
+    hists = []
+    for i in range(30):
+        h = _gen_queue_history(rng, n_procs=5, n_ops=20,
+                               corrupt=(i % 3 == 0))
+        hists.append(h)
+    # a targeted double-dequeue corruption: two dequeues claim one value
+    from jepsen_tpu.history import History, invoke_op, ok_op
+
+    dd = History([
+        invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 1),
+        invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 1),
+    ])
+    for i, op in enumerate(dd):
+        op.index = i
+        op.time = i
+    hists.append(dd.index_ops())
+
+    model = models.unordered_queue()
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    auto = wgl.check_batch(model, hists)  # dense dispatch
+    assert {o.get("kernel") for o in auto} == {"dense"}, (
+        wgl.batch_stats(auto)
+    )
+    assert [o["valid?"] for o in auto] == oracle
+    assert oracle[-1] is False  # the double dequeue is caught
+    # generic kernel agreement at the same shapes
+    generic = wgl.check_batch(model, hists, max_closure=9, slot_cap=8,
+                              frontier=512)
+    assert [o["valid?"] for o in generic] == oracle
