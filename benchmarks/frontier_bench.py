@@ -222,6 +222,88 @@ def multi_register_arm(results, B, reps):
         )
 
 
+def _gen_queue_history(rng, n_procs, n_ops):
+    """Unique-element unordered-queue history (same simulation as
+    tests/test_models.py's generator, inlined so the bench has no test
+    dependency)."""
+    from jepsen_tpu.history import History, invoke_op, ok_op, fail_op
+
+    present, next_v, pending, hist = set(), 1, {}, []
+    idle = list(range(n_procs))
+    done = 0
+    while done < n_ops or pending:
+        if idle and done < n_ops and (not pending or rng.random() < 0.6):
+            p = idle.pop(rng.randrange(len(idle)))
+            if present and rng.random() < 0.45:
+                hist.append(invoke_op(p, "dequeue", None))
+                pending[p] = ("dequeue", None)
+            else:
+                v, next_v = next_v, next_v + 1
+                hist.append(invoke_op(p, "enqueue", v))
+                pending[p] = ("enqueue", v)
+            done += 1
+        else:
+            p = rng.choice(list(pending))
+            f, v = pending.pop(p)
+            idle.append(p)
+            if f == "enqueue":
+                present.add(v)
+                hist.append(ok_op(p, "enqueue", v))
+            elif present:
+                got = rng.choice(sorted(present))
+                present.discard(got)
+                hist.append(ok_op(p, "dequeue", got))
+            else:
+                hist.append(fail_op(p, "dequeue", None, error="empty"))
+    h = History(hist)
+    for i, op in enumerate(h):
+        op.index = i
+        op.time = i
+    return h.index_ops()
+
+
+def queue_arm(results, B, reps):
+    """Dense bitset queue kernel vs the generic frontier kernel."""
+    import jax
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.ops import dense, wgl
+
+    rng = np.random.default_rng(45100)
+    py_rng = random.Random(45100)
+    hists = [
+        _gen_queue_history(py_rng, n_procs=8, n_ops=24) for _ in range(16)
+    ]
+    model = m.unordered_queue()
+    batch = _batch_arrays(hists, model, slot_cap=8)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    arrays = _expand(batch, B, rng)
+    for name, fn in (
+        ("dense", dense.make_dense_fn("unordered-queue", E, C, 0)),
+        ("frontier", wgl.make_check_fn("unordered-queue", E, C, 256, C + 1)),
+    ):
+        dt, ok, ovf = _time_fn(fn, arrays, reps)
+        row = {
+            "arm": "unordered-queue",
+            "kernel": name,
+            "C": C,
+            "F": None if name == "dense" else 256,
+            "L": 24,
+            "B": B,
+            "events": E,
+            "hps": round(B / dt, 1),
+            "overflow_rate": round(float(ovf.mean()), 4),
+            "invalid": int((~ok).sum()),
+            "platform": jax.devices()[0].platform,
+        }
+        results.append(row)
+        print(
+            f"unordered-queue C={C:<3} {name:<9}: "
+            f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
+        )
+
+
 def main():
     from jepsen_tpu.platform import ensure_usable_backend
 
@@ -230,6 +312,7 @@ def main():
     B = int(os.environ.get("JEPSEN_TPU_FRONTIER_B", 1024))
     results = []
     cas_register_arm(results, reps)
+    queue_arm(results, min(B, 512), reps)
     multi_register_arm(results, B, reps)
     import datetime
 
