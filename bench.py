@@ -17,9 +17,13 @@ bench falls back to the CPU platform sharded across virtual host devices
 so a real, honest host number is still recorded.
 
 Whenever an on-chip run succeeds the result is persisted to
-``BENCH_tpu_latest.json`` (platform, shapes, h/s, timestamp); a later
-CPU-fallback run reports that artifact alongside its live number, so one
-live-chip window anywhere in a round leaves durable perf evidence.
+``BENCH_tpu_latest.json`` (platform, shapes, h/s, timestamp) AND
+appended to ``BENCH_tpu_windows.jsonl`` — an append-only history of
+every live-chip capture window, each with per-rep dispersion
+(min/median/max h/s) at B ∈ {8192, 16384}.  A later CPU-fallback run
+reports the latest artifact plus the window count and spread, so the
+round record rests on every window the round managed to catch, not just
+the last one.
 
 The batch is built from distinct random templates (valid + corrupted
 executions) expanded by per-history random value relabelings — a
@@ -42,6 +46,8 @@ BASELINE_L = 1000
 
 #: durable evidence of the most recent successful on-chip bench
 ARTIFACT = os.path.join(_HERE, "BENCH_tpu_latest.json")
+#: append-only history of every on-chip capture window (JSONL)
+WINDOWS = os.path.join(_HERE, "BENCH_tpu_windows.jsonl")
 #: per-attempt probe diagnostics (JSONL, appended across runs)
 PROBE_TRAIL = os.path.join(_HERE, "bench_probe_trail.jsonl")
 
@@ -50,10 +56,12 @@ def default_shapes(on_accelerator, n_devices=1):
     """Single source of truth for bench shape defaults.  The CPU
     fallback runs the full 1000-op history length sharded across the
     virtual host devices — a smaller batch, but the same shape class as
-    the on-chip run, so vs_baseline comparisons stay apples-to-apples."""
+    the on-chip run, so vs_baseline comparisons stay apples-to-apples.
+    On the accelerator the bench measures BOTH batch sizes in ``Bs``
+    (headline = the largest) with per-rep dispersion."""
     if on_accelerator:
-        return dict(B=16384, L=1000, REPS=3)
-    return dict(B=128 * max(1, n_devices), L=1000, REPS=1)
+        return dict(Bs=(8192, 16384), L=1000, REPS=5)
+    return dict(Bs=(128 * max(1, n_devices),), L=1000, REPS=1)
 
 
 def _emit(payload):
@@ -117,11 +125,16 @@ def run_bench(on_accelerator, warnings):
             mesh = mesh_mod.default_mesh(devs)
 
     defaults = default_shapes(on_accelerator, n_devices)
-    B = int(os.environ.get("JEPSEN_TPU_BENCH_B", defaults["B"]))
-    if mesh is not None and B % n_devices:
-        B = max(n_devices, B - B % n_devices)  # shard evenly
+    if "JEPSEN_TPU_BENCH_B" in os.environ:
+        Bs = (int(os.environ["JEPSEN_TPU_BENCH_B"]),)
+    else:
+        Bs = defaults["Bs"]
+    if mesh is not None:
+        Bs = tuple(
+            max(n_devices, B - B % n_devices) for B in Bs
+        )  # shard evenly
     L = int(os.environ.get("JEPSEN_TPU_BENCH_L", defaults["L"]))
-    K = int(os.environ.get("JEPSEN_TPU_BENCH_TEMPLATES", min(32, B)))
+    K = int(os.environ.get("JEPSEN_TPU_BENCH_TEMPLATES", min(32, min(Bs))))
     REPS = int(os.environ.get("JEPSEN_TPU_BENCH_REPS", defaults["REPS"]))
     SLOT_CAP = int(os.environ.get("JEPSEN_TPU_BENCH_SLOTS", 16))
     FRONTIER = int(os.environ.get("JEPSEN_TPU_BENCH_FRONTIER", 64))
@@ -153,115 +166,160 @@ def run_bench(on_accelerator, warnings):
     E = batch.ev_slot.shape[1]
     C = batch.cand_slot.shape[2]  # bucketed to actual peak concurrency
 
-    # 2. Expand templates to B rows.
-    reps_idx = rng.integers(0, K_live, size=B)
-    init_state = batch.init_state[reps_idx]
-    ev_slot = batch.ev_slot[reps_idx]
-    cand_slot = batch.cand_slot[reps_idx]
-    cand_f = batch.cand_f[reps_idx]
-    base_a = batch.cand_a[reps_idx]
-    base_b = batch.cand_b[reps_idx]
-
-    vmax = int(max(base_a.max(), base_b.max(), init_state.max()))
+    vmax = int(
+        max(batch.cand_a.max(), batch.cand_b.max(), batch.init_state.max())
+    )
     # value relabeling permutes {1..vmax}, so vmax+1 bounds ids before and
     # after; the dense automaton kernel engages when it fits the envelope
     fn = wgl.make_best_check_fn(
         "cas-register", E, C, FRONTIER, C + 1, n_values=vmax + 1
     )
 
-    # 3. Per-rep value relabelings are prepared host-side and uploaded
-    # BEFORE the timed loop: the bench measures checker throughput (in
-    # production batch_encode emits these tensors directly), and mixing a
-    # second jitted program into the loop costs a ~2.6 s executable swap
-    # per dispatch through this environment's TPU tunnel — measured to
-    # dominate the checker itself.  The big tensors are passed as jit
-    # arguments (not closed over): closed-over concrete arrays bake into
-    # the HLO as constants, and at these shapes the serialized program
-    # blows past remote-compile request limits (observed HTTP 413).
     import jax.numpy as jnp
 
-    if mesh is None:
-        d_ev = jnp.asarray(ev_slot)
-        d_cs = jnp.asarray(cand_slot)
-        d_cf = jnp.asarray(cand_f)
-    else:
-        # mesh path: the loop-invariant tensors are sharded over the
-        # hist axis once, here, for the same keep-upload-out-of-the-
-        # timed-loop reason as the single-device path above
-        d_ev, d_cs, d_cf = mesh_mod.shard_batch(
-            mesh, ev_slot, cand_slot, cand_f
-        )
+    def one_batch_size(B):
+        """Measure one batch size: expand templates to B rows, REPS
+        timed dispatches with per-rep dispersion."""
+        # Expand templates to B rows.
+        reps_idx = rng.integers(0, K_live, size=B)
+        init_state = batch.init_state[reps_idx]
+        ev_slot = batch.ev_slot[reps_idx]
+        cand_slot = batch.cand_slot[reps_idx]
+        cand_f = batch.cand_f[reps_idx]
+        base_a = batch.cand_a[reps_idx]
+        base_b = batch.cand_b[reps_idx]
 
-    def relabel(seed):
-        r = np.random.default_rng(seed)
-        perm = np.argsort(r.random((B, vmax)), axis=1).astype(np.int16) + 1
-        table = np.concatenate([np.zeros((B, 1), np.int16), perm], axis=1)
-        a2 = np.take_along_axis(table, base_a.reshape(B, -1), axis=1)
-        b2 = np.take_along_axis(table, base_b.reshape(B, -1), axis=1)
-        init2 = table[np.arange(B), init_state].astype(np.int32)
-        a2 = a2.reshape(base_a.shape)
-        b2 = b2.reshape(base_b.shape)
+        # Per-rep value relabelings are prepared host-side and uploaded
+        # BEFORE the timed loop: the bench measures checker throughput
+        # (in production batch_encode emits these tensors directly), and
+        # mixing a second jitted program into the loop costs a ~2.6 s
+        # executable swap per dispatch through this environment's TPU
+        # tunnel — measured to dominate the checker itself.  The big
+        # tensors are passed as jit arguments (not closed over):
+        # closed-over concrete arrays bake into the HLO as constants,
+        # and at these shapes the serialized program blows past
+        # remote-compile request limits (observed HTTP 413).
         if mesh is None:
-            return (jnp.asarray(init2), jnp.asarray(a2), jnp.asarray(b2))
-        return mesh_mod.shard_batch(mesh, init2, a2, b2)
-
-    rep_inputs = [relabel(seed) for seed in range(REPS + 1)]
-
-    def run(rep):
-        init2, a2, b2 = rep_inputs[rep]
-        if mesh is None:
-            ok, _failed, overflow = fn(init2, d_ev, d_cs, d_cf, a2, b2)
+            d_ev = jnp.asarray(ev_slot)
+            d_cs = jnp.asarray(cand_slot)
+            d_cf = jnp.asarray(cand_f)
         else:
-            with mesh:
+            # mesh path: the loop-invariant tensors are sharded over the
+            # hist axis once, here, for the same keep-upload-out-of-the-
+            # timed-loop reason as the single-device path above
+            d_ev, d_cs, d_cf = mesh_mod.shard_batch(
+                mesh, ev_slot, cand_slot, cand_f
+            )
+
+        def relabel(seed):
+            r = np.random.default_rng(seed)
+            perm = (
+                np.argsort(r.random((B, vmax)), axis=1).astype(np.int16) + 1
+            )
+            table = np.concatenate([np.zeros((B, 1), np.int16), perm], axis=1)
+            a2 = np.take_along_axis(table, base_a.reshape(B, -1), axis=1)
+            b2 = np.take_along_axis(table, base_b.reshape(B, -1), axis=1)
+            init2 = table[np.arange(B), init_state].astype(np.int32)
+            a2 = a2.reshape(base_a.shape)
+            b2 = b2.reshape(base_b.shape)
+            if mesh is None:
+                return (jnp.asarray(init2), jnp.asarray(a2), jnp.asarray(b2))
+            return mesh_mod.shard_batch(mesh, init2, a2, b2)
+
+        rep_inputs = [relabel(seed) for seed in range(REPS + 1)]
+
+        def run(rep):
+            init2, a2, b2 = rep_inputs[rep]
+            if mesh is None:
                 ok, _failed, overflow = fn(init2, d_ev, d_cs, d_cf, a2, b2)
-        return np.asarray(ok), np.asarray(overflow)
+            else:
+                with mesh:
+                    ok, _failed, overflow = fn(
+                        init2, d_ev, d_cs, d_cf, a2, b2
+                    )
+            return np.asarray(ok), np.asarray(overflow)
 
-    # 3. Warmup (compile) + verdict-consistency check: all non-overflow
-    # rows built from the same template must agree (relabeling preserves
-    # verdicts).  Overflow rows report "unknown" — the production API
-    # (wgl.check_batch) reruns those on the CPU oracle.
-    ok, overflow = run(0)
-    for t in range(K_live):
-        mask = (reps_idx == t) & ~overflow
-        rows = ok[mask]
-        if rows.size and rows.all() != rows.any():
-            warnings.append(f"template {t} verdicts diverged under relabeling")
-    n_unknown = int(overflow.sum())
+        # Warmup (compile) + verdict-consistency check: all non-overflow
+        # rows built from the same template must agree (relabeling
+        # preserves verdicts).  Overflow rows report "unknown" — the
+        # production API (wgl.check_batch) reruns those on the oracle.
+        ok, overflow = run(0)
+        for t in range(K_live):
+            mask = (reps_idx == t) & ~overflow
+            rows = ok[mask]
+            if rows.size and rows.all() != rows.any():
+                warnings.append(
+                    f"template {t} verdicts diverged under relabeling"
+                )
 
-    # 4. Timed reps (distinct pre-uploaded relabelings per rep).
-    t0 = time.perf_counter()
-    total = 0
-    for rep in range(REPS):
-        ok, overflow = run(rep + 1)
-        total += B
-    elapsed = time.perf_counter() - t0
-    value = total / elapsed
+        # Timed reps (distinct pre-uploaded relabelings per rep), each
+        # timed individually so the record carries dispersion, not just
+        # a mean that could hide a straggler.
+        rep_hps = []
+        for rep in range(REPS):
+            t0 = time.perf_counter()
+            ok, overflow = run(rep + 1)
+            rep_hps.append(B / (time.perf_counter() - t0))
+        if not rep_hps:  # REPS=0: compile/consistency-check-only run
+            rep_hps = [0.0]
+        return {
+            "B": B,
+            "hps_min": round(min(rep_hps), 2),
+            "hps_median": round(float(np.median(rep_hps)), 2),
+            "hps_max": round(max(rep_hps), 2),
+            "rep_hps": [round(v, 1) for v in rep_hps],
+            "overflow_unknown": int(overflow.sum()),
+            "invalid": int((~ok).sum()),
+        }
+
+    # largest (headline) batch first, and salvage partial windows: if
+    # the tunnel drops mid-window, the samples already measured still
+    # get persisted instead of being discarded with the exception
+    samples = []
+    for B in sorted(Bs, reverse=True):
+        try:
+            samples.append(one_batch_size(B))
+        except Exception as e:  # noqa: BLE001
+            if not samples:
+                raise
+            warnings.append(f"sample B={B} lost ({repr(e)[:120]})")
+            break
+    headline = samples[0]  # largest B
+    value = headline["hps_median"]
 
     diag = {
-        "batch": B,
+        "batch": headline["B"],
         "history_len": L,
         "events": E,
         "slots": C,
         "frontier": FRONTIER,
         "reps": REPS,
         "n_devices": n_devices,
-        "elapsed_s": round(elapsed, 2),
-        "overflow_unknown": n_unknown,
+        "overflow_unknown": headline["overflow_unknown"],
         "encode_fallback": n_fallback,
-        "invalid": int((~ok).sum()),
+        "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
         "kernel": wgl.kernel_choice("cas-register", C, vmax + 1),
+        "samples": samples,
     }
     return value, L, diag
 
 
 def _persist_artifact(payload, diag):
+    record = {"captured_at": _utcnow(), **payload, "diag": diag}
     try:
         with open(ARTIFACT, "w") as f:
-            json.dump({"captured_at": _utcnow(), **payload, "diag": diag}, f)
+            json.dump(record, f)
             f.write("\n")
     except OSError as e:
         print(f"artifact write failed: {e!r}", file=sys.stderr)
+    # append-only window history: every live-chip capture survives, so
+    # the round record carries N windows with dispersion, not one
+    try:
+        with open(WINDOWS, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError as e:
+        print(f"window append failed: {e!r}", file=sys.stderr)
 
 
 def _load_artifact():
@@ -270,6 +328,34 @@ def _load_artifact():
             return json.load(f)
     except (OSError, ValueError):
         return None
+
+
+def _windows_summary():
+    """Count + spread of all recorded on-chip capture windows.  Parses
+    per line and skips unparsable ones — a process dying mid-append
+    (the TPU tunnel drops intermittently) must not erase the record of
+    every *other* window."""
+    try:
+        with open(WINDOWS) as f:
+            recs = []
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return None
+    if not recs:
+        return None
+    medians = [r.get("value") for r in recs if r.get("value") is not None]
+    return {
+        "count": len(recs),
+        "median_hps_per_window": medians,
+        "first": recs[0].get("captured_at"),
+        "last": recs[-1].get("captured_at"),
+    }
 
 
 def main():
@@ -293,7 +379,9 @@ def main():
             "unit": "histories/sec",
             "vs_baseline": round(equiv / NORTH_STAR, 4),
         }
-        if on_accel:
+        if on_accel and value > 0:
+            # REPS=0 compile-only runs must not overwrite the last real
+            # on-chip measurement or pollute the window history
             _persist_artifact(payload, diag)
         else:
             payload["error"] = warnings[0]
@@ -304,6 +392,9 @@ def main():
                 # live value above is the host fallback, this is the
                 # most recent real on-chip measurement
                 payload["onchip_latest"] = prior
+            windows = _windows_summary()
+            if windows is not None:
+                payload["onchip_windows"] = windows
         if warnings:
             payload["warnings"] = "; ".join(warnings)
         _emit(payload)

@@ -9,9 +9,21 @@ state space quickly.  Those shapes run the generic sort-compacted
 frontier kernel (ops/wgl.py), whose throughput this script measures:
 
 - cas-register at peak concurrency C ∈ {8, 16, 32}, frontier capacity
-  F ∈ {64, 128}, forced through make_check_fn (no dense dispatch);
+  F ∈ {64, 128, 256} (the monotone triple pins the compaction's
+  F-scaling), forced through make_check_fn (no dense dispatch);
 - the dense kernel at the same C (where applicable) for the crossover;
-- a multi-register arm (the model the per-key independent lift feeds).
+- a multi-register arm (the model the per-key independent lift feeds);
+- a mutex-contention arm at C ∈ {16, 32} — PAST the dense envelope
+  (dense.MAX_C = 12) yet with an intrinsically small frontier (at most
+  one open acquire can linearize before a release completes, so configs
+  grow linearly in C, not exponentially): the generic kernel's home
+  turf, where it must beat the oracle outright;
+- a CPU-oracle row per arm shape (same corpus, per-history Python
+  search with a time cutoff) so kernel-vs-oracle ratios are recorded
+  numbers, not claims;
+- hash-vs-sort compaction pairs at a pinned (C, L) shape across
+  F ∈ {64, 128, 256}, recording both the speedup of the O(K) scatter
+  dedup over the exact-sort dedup and each mode's F-scaling.
 
 Prints one human table and writes ``benchmarks/frontier_results.json``.
 Overflow ("unknown") shares are reported per config: a high overflow
@@ -83,11 +95,76 @@ def _time_fn(fn, arrays, reps):
 #: jepsen.independent + per-key-limit produce on purpose — SURVEY.md §5
 #: long-history scaling, linearizable_register.clj:40-52)
 CAS_SHAPES = (
-    (8, 1000, (64, 128), 1024),
-    (8, 100, (64, 256), 1024),
-    (16, 50, (64, 256), 1024),
-    (32, 30, (64, 256), 512),
+    (8, 1000, (64, 128, 256), 1024),
+    (8, 100, (64, 128, 256), 1024),
+    (16, 50, (64, 128, 256), 1024),
+    (32, 30, (64, 128, 256), 512),
 )
+
+#: per-history oracle time budget, seconds — corrupted histories can
+#: send the exponential search off a cliff; the cutoff records an
+#: upper-bound h/s ("oracle at least this slow") instead of hanging
+ORACLE_BUDGET_S = 30.0
+
+
+def _device_row(results, arm, kernel, C, F, L, B, E, dt, ok, ovf, **extra):
+    """Shared device-kernel result row: one schema, one print format —
+    every arm goes through here so frontier_results.json rows can't
+    silently diverge."""
+    import jax
+
+    row = {
+        "arm": arm,
+        "kernel": kernel,
+        "C": C,
+        "F": F,
+        "L": L,
+        "B": B,
+        "events": E,
+        "hps": round(B / dt, 1),
+        "overflow_rate": round(float(ovf.mean()), 4),
+        "invalid": int((~ok).sum()),
+        "platform": jax.devices()[0].platform,
+        **extra,
+    }
+    results.append(row)
+    print(
+        f"{arm} C={C:<3} L={L:<5} F={str(F):<5} {kernel:<14}: "
+        f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
+    )
+    return row
+
+
+def oracle_row(results, arm, hists, model, C, L, pure_fs=()):
+    """Time the CPU oracle over the template corpus (with a cutoff) so
+    every device row has a recorded denominator."""
+    from jepsen_tpu.checker import linear
+
+    t0 = time.perf_counter()
+    n = 0
+    for h0 in hists:
+        linear.analysis(model, h0, pure_fs=pure_fs)
+        n += 1
+        if time.perf_counter() - t0 > ORACLE_BUDGET_S:
+            break
+    dt = time.perf_counter() - t0
+    row = {
+        "arm": arm,
+        "kernel": "oracle",
+        "C": C,
+        "F": None,
+        "L": L,
+        "B": n,
+        "hps": round(n / dt, 2),
+        "truncated": n < len(hists),
+        "platform": "cpu",
+    }
+    results.append(row)
+    print(
+        f"{arm} C={C:<3} L={L:<5} oracle:       "
+        f"{row['hps']:>10,.1f} h/s ({n}/{len(hists)} hists in {dt:.1f}s)"
+    )
+    return row
 
 
 def cas_register_arm(results, reps):
@@ -119,26 +196,14 @@ def cas_register_arm(results, reps):
         vmax = int(
             max(arrays[0].max(), arrays[4].max(), arrays[5].max())
         )
+        oracle_row(
+            results, "cas-register", hists, model, C, L, pure_fs=("read",)
+        )
         for F in Fs:
             fn = wgl.make_check_fn("cas-register", E, C, F, C + 1)
             dt, ok, ovf = _time_fn(fn, arrays, reps)
-            row = {
-                "arm": "cas-register",
-                "kernel": "frontier",
-                "C": C,
-                "F": F,
-                "L": L,
-                "B": B,
-                "events": E,
-                "hps": round(B / dt, 1),
-                "overflow_rate": round(float(ovf.mean()), 4),
-                "invalid": int((~ok).sum()),
-                "platform": jax.devices()[0].platform,
-            }
-            results.append(row)
-            print(
-                f"cas-register C={C:<3} L={L:<5} F={F:<4} frontier: "
-                f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
+            _device_row(
+                results, "cas-register", "frontier", C, F, L, B, E, dt, ok, ovf
             )
         if wgl.kernel_choice("cas-register", C, vmax + 1) == "dense":
             from jepsen_tpu.ops import dense
@@ -146,23 +211,137 @@ def cas_register_arm(results, reps):
             V = encode.round_up(vmax + 1, 4)
             fn = dense.make_dense_fn("cas-register", E, C, V)
             dt, ok, ovf = _time_fn(fn, arrays, reps)
-            row = {
-                "arm": "cas-register",
-                "kernel": "dense",
-                "C": C,
-                "F": None,
-                "L": L,
-                "B": B,
-                "events": E,
-                "hps": round(B / dt, 1),
-                "overflow_rate": 0.0,
-                "invalid": int((~ok).sum()),
-                "platform": jax.devices()[0].platform,
-            }
-            results.append(row)
-            print(
-                f"cas-register C={C:<3} L={L:<5} dense:        "
-                f"{row['hps']:>10,.0f} h/s"
+            _device_row(
+                results, "cas-register", "dense", C, None, L, B, E, dt, ok, ovf
+            )
+
+
+def compaction_arm(results, reps):
+    """hash vs sort compaction at a pinned (C, L) shape, swept over
+    F ∈ {64, 128, 256} — records the O(K) scatter dedup's speedup over
+    the exact-sort dedup and each mode's F-scaling (the round-4 fix for
+    the inverted F-scaling: sort cost grew superlinearly in F)."""
+    import jax
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import wgl
+
+    rng = np.random.default_rng(45100)
+    py_rng = random.Random(45108)
+    n_procs, L = 8, 100
+    B = int(os.environ.get("JEPSEN_TPU_COMPACTION_B", 1024))
+    hists = [
+        synth.generate_history(
+            py_rng,
+            n_procs=n_procs,
+            n_ops=L,
+            crash_p=0.001,
+            corrupt=(i % 4 == 0),
+        )
+        for i in range(16)
+    ]
+    model = m.cas_register(0)
+    batch = _batch_arrays(hists, model, slot_cap=n_procs)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    arrays = _expand(batch, B, rng)
+    for F in (64, 128, 256):
+        for mode in ("hash", "sort"):
+            fn = wgl.make_check_fn("cas-register", E, C, F, C + 1, mode)
+            dt, ok, ovf = _time_fn(fn, arrays, reps)
+            _device_row(
+                results, "compaction", f"frontier-{mode}",
+                C, F, L, B, E, dt, ok, ovf,
+            )
+
+
+def _gen_mutex_history(rng, n_procs, n_events, corrupt=False):
+    """Contended-mutex history: procs invoke acquire, one waiter is
+    granted when the lock frees (the release's linearization point sits
+    between its invoke and ok, so a grant may interleave there — real
+    concurrency, still linearizable).  ``corrupt`` occasionally grants
+    while the lock is held — a double-hold the checker must reject."""
+    from jepsen_tpu.history import History, invoke_op, ok_op
+
+    hist = []
+    idle = list(range(n_procs))
+    waiting = []  # acquire invoked, not granted
+    holding = []  # acquire ok'd, release not invoked
+    releasing = []  # release invoked, not ok'd
+    lock_free = True
+    corrupted = False
+    while len(hist) < n_events or waiting or holding or releasing:
+        moves = []
+        if idle and len(hist) < n_events:
+            moves.append("inv_acq")
+        if waiting and (lock_free or (corrupt and not corrupted)):
+            moves.append("grant")
+        if holding:
+            moves.append("inv_rel")
+        if releasing:
+            moves.append("ok_rel")
+        if not moves:
+            break
+        mv = rng.choice(moves)
+        if mv == "inv_acq":
+            p = idle.pop(rng.randrange(len(idle)))
+            hist.append(invoke_op(p, "acquire", None))
+            waiting.append(p)
+        elif mv == "grant":
+            if not lock_free:
+                corrupted = True  # double-hold injected
+            p = waiting.pop(rng.randrange(len(waiting)))
+            hist.append(ok_op(p, "acquire", None))
+            holding.append(p)
+            lock_free = False
+        elif mv == "inv_rel":
+            p = holding.pop(rng.randrange(len(holding)))
+            hist.append(invoke_op(p, "release", None))
+            releasing.append(p)
+            lock_free = True  # release linearizes here; grants may follow
+        else:
+            p = releasing.pop(rng.randrange(len(releasing)))
+            hist.append(ok_op(p, "release", None))
+            idle.append(p)
+    h = History(hist)
+    for i, op in enumerate(h):
+        op.index = i
+        op.time = i
+    return h.index_ops()
+
+
+def mutex_arm(results, B, reps):
+    """Mutex contention past the dense envelope (C > dense.MAX_C = 12).
+    The mutex frontier is intrinsically small — at most one open acquire
+    linearizes before the next release completes — so this is the shape
+    class where the generic frontier kernel should beat the per-history
+    Python oracle outright, overflow-free."""
+    import jax
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.ops import wgl
+
+    rng = np.random.default_rng(45100)
+    for n_procs, L in ((16, 100), (32, 60)):
+        py_rng = random.Random(45100 + n_procs)
+        hists = [
+            _gen_mutex_history(
+                py_rng, n_procs, n_events=L, corrupt=(i % 4 == 0)
+            )
+            for i in range(16)
+        ]
+        model = m.mutex()
+        batch = _batch_arrays(hists, model, slot_cap=n_procs)
+        E = batch.ev_slot.shape[1]
+        C = batch.cand_slot.shape[2]
+        arrays = _expand(batch, B, rng)
+        oracle_row(results, "mutex", hists, model, C, L)
+        for F in (64,):
+            fn = wgl.make_check_fn("mutex", E, C, F, C + 1)
+            dt, ok, ovf = _time_fn(fn, arrays, reps)
+            _device_row(
+                results, "mutex", "frontier", C, F, L, B, E, dt, ok, ovf
             )
 
 
@@ -196,29 +375,14 @@ def multi_register_arm(results, B, reps):
     C = batch.cand_slot.shape[2]
     arrays = _expand(batch, B, rng)
     vmax = int(max(arrays[0].max(), arrays[4].max(), arrays[5].max()))
+    oracle_row(results, "multi-register", hists, model, C, L)
     choice = wgl.kernel_choice("multi-register", C, vmax + 1)
     for F in (64, 128):
         fn = wgl.make_check_fn("multi-register", E, C, F, C + 1)
         dt, ok, ovf = _time_fn(fn, arrays, reps)
-        row = {
-            "arm": "multi-register",
-            "kernel": "frontier",
-            "C": C,
-            "F": F,
-            "L": L,
-            "B": B,
-            "events": E,
-            "auto_choice": choice,
-            "hps": round(B / dt, 1),
-            "overflow_rate": round(float(ovf.mean()), 4),
-            "invalid": int((~ok).sum()),
-            "platform": jax.devices()[0].platform,
-        }
-        results.append(row)
-        print(
-            f"multi-register C={C:<3} F={F:<4} frontier: "
-            f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
-            f"  (auto kernel_choice: {choice})"
+        _device_row(
+            results, "multi-register", "frontier", C, F, L, B, E, dt, ok, ovf,
+            auto_choice=choice,
         )
 
 
@@ -279,28 +443,15 @@ def queue_arm(results, B, reps):
     E = batch.ev_slot.shape[1]
     C = batch.cand_slot.shape[2]
     arrays = _expand(batch, B, rng)
+    oracle_row(results, "unordered-queue", hists, model, C, 24)
     for name, fn in (
         ("dense", dense.make_dense_fn("unordered-queue", E, C, 0)),
         ("frontier", wgl.make_check_fn("unordered-queue", E, C, 256, C + 1)),
     ):
         dt, ok, ovf = _time_fn(fn, arrays, reps)
-        row = {
-            "arm": "unordered-queue",
-            "kernel": name,
-            "C": C,
-            "F": None if name == "dense" else 256,
-            "L": 24,
-            "B": B,
-            "events": E,
-            "hps": round(B / dt, 1),
-            "overflow_rate": round(float(ovf.mean()), 4),
-            "invalid": int((~ok).sum()),
-            "platform": jax.devices()[0].platform,
-        }
-        results.append(row)
-        print(
-            f"unordered-queue C={C:<3} {name:<9}: "
-            f"{row['hps']:>10,.0f} h/s  overflow={row['overflow_rate']:.1%}"
+        _device_row(
+            results, "unordered-queue", name,
+            C, None if name == "dense" else 256, 24, B, E, dt, ok, ovf,
         )
 
 
@@ -314,6 +465,8 @@ def main():
     cas_register_arm(results, reps)
     queue_arm(results, min(B, 512), reps)
     multi_register_arm(results, B, reps)
+    mutex_arm(results, min(B, 1024), reps)
+    compaction_arm(results, reps)
     import datetime
 
     payload = {

@@ -9,9 +9,11 @@ the reference at jepsen/src/jepsen/checker.clj:199-203), recast for SIMD:
   ids (see jepsen_tpu.ops.encode for why one word suffices).
 - The *frontier* is a fixed-capacity array of F configs with a validity
   mask.  All frontier × candidate expansions happen in one broadcast
-  step-kernel call; dedup/compaction is two ``lax.sort`` passes over a
-  31-bit config hash (hash collisions only waste a lane — full-key
-  neighbor comparison keeps correctness exact).
+  step-kernel call; dedup/compaction in the hot path is an O(K)
+  scatter-hash-table pass plus a prefix-sum gather (no sorts — see
+  ``_compact_hash``), so cost scales linearly with frontier capacity.
+  An exact ``lax.sort``-based variant (``_compact_sort``) backs the
+  provably-lossless escalation rung.
 - Each *ok* event runs a closure loop (``lax.while_loop``, converging
   when the config count stops growing) then filters configs that
   linearized the completing op and promotes it into the common prefix.
@@ -65,32 +67,120 @@ def _hash_cfg(state, words):
     return h & jnp.uint32(0x7FFFFFFF)
 
 
-def _compact(states, words, valid, F):
-    """Dedup + compact K candidate configs down to F slots.
+def _compact_sort(states, words, valid, F, n_old):
+    """Exact dedup + compact K candidate configs down to F slots.
     ``words`` is the tuple of linset words (one uint32 array per 32
-    slots).  Returns (states[F], words[F]×W, valid[F], overflowed?).
+    slots); lanes < ``n_old`` are the incoming frontier, lanes ≥ it the
+    newly-expanded candidates.  Returns
+    (states[F], words[F]×W, valid[F], grew?, overflowed?) where *grew*
+    is True iff a lane from the new region survived dedup — i.e. a
+    config not present in the old region exists (the sort is stable, so
+    within a duplicate class the earliest lane survives, and an old
+    twin always precedes its new copies).
 
     One multi-operand sort groups duplicates (invalid lanes sort to the
     end via the reserved key); survivors are then compacted by *rank*:
     the j-th output slot gathers the entry whose survivor-prefix-count
-    equals j — a [F, K] compare-reduce plus one gather, which vectorizes
-    far better on the VPU than a second full sort."""
+    equals j — a [F, K] compare-reduce plus one gather.  Dedup here is
+    EXACT (every duplicate is removed), which is what makes the
+    sufficient-frontier escalation rung lossless by construction — but
+    the sort plus the rank matrix cost O(K log K + F·K), superlinear in
+    F, so the hot path uses ``_compact_hash`` instead."""
     K = states.shape[0]
     key = jnp.where(valid, _hash_cfg(states, words), jnp.uint32(_INVALID_KEY))
-    sorted_ops = lax.sort((key, states) + tuple(words), num_keys=1)
-    key_s, st_s, ws_s = sorted_ops[0], sorted_ops[1], sorted_ops[2:]
+    lane = jnp.arange(K, dtype=jnp.int32)
+    # the FULL config is part of the sort key (not just its 31-bit
+    # hash): with a hash-only key, two identical configs separated by a
+    # hash-colliding distinct config are non-adjacent and the
+    # neighbor-compare would miss the duplicate — breaking the "every
+    # duplicate removed" contract the sufficient rung rests on.  lane
+    # stays a payload so stability keeps old twins before new copies.
+    sorted_ops = lax.sort(
+        (key, states) + tuple(words) + (lane,), num_keys=2 + len(words)
+    )
+    key_s, st_s = sorted_ops[0], sorted_ops[1]
+    ws_s, lane_s = sorted_ops[2:-1], sorted_ops[-1]
     same = (key_s[1:] == key_s[:-1]) & (st_s[1:] == st_s[:-1])
     for w in ws_s:
         same = same & (w[1:] == w[:-1])
     dup = jnp.concatenate([jnp.zeros((1,), bool), same])
     v2 = (key_s != jnp.uint32(_INVALID_KEY)) & ~dup
+    grew = (v2 & (lane_s >= n_old)).any()
     prefix = jnp.cumsum(v2.astype(jnp.int32))
     count = prefix[-1]
     j = jnp.arange(F, dtype=jnp.int32)
     # index of the j-th survivor = #entries with prefix <= j
     src = jnp.sum(prefix[None, :] <= j[:, None], axis=1, dtype=jnp.int32)
     src = jnp.minimum(src, K - 1)
-    return st_s[src], tuple(w[src] for w in ws_s), j < count, count > F
+    return st_s[src], tuple(w[src] for w in ws_s), j < count, grew, count > F
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+#: independent Fibonacci-style multipliers, one scatter table per probe
+_PROBE_MULTS = (0x9E3779B1, 0x85EBCA77)
+
+
+def _compact_hash(states, words, valid, F, n_old):
+    """Best-effort dedup + compact via scatter hash tables and a
+    prefix-sum gather — O(K) work, no sorts, so cost scales *linearly*
+    with frontier capacity (raising F to cut overflow no longer slows
+    the kernel superlinearly the way the sort compaction did).
+
+    Each probe table scatters lane ids by config hash with a
+    min-reduce; a lane whose slot *winner* is an earlier lane holding
+    an identical config is a duplicate and drops out.  The MINIMUM lane
+    of every identical-config class always survives (any equal-config
+    winner is in the class, hence ≥ the class minimum, so the minimum's
+    winner can only be itself) — so a dropped lane always leaves an
+    earlier identical survivor, and old-frontier lanes (< ``n_old``)
+    are never displaced by their new copies.  Distinct configs sharing
+    a slot both survive — missed dedup costs capacity, never
+    correctness, and the only lossy event remains compaction overflow
+    (survivors > F), which is reported as "unknown" exactly as before.
+    Two independent probe tables catch most duplicates one misses.
+
+    Returns (states[F], words[F]×W, valid[F], grew?, overflowed?).
+    *grew* is True iff any lane ≥ ``n_old`` survived dedup.  Dropping
+    is driven by EXACT config equality with the winner, so every
+    dropped new lane provably duplicates an old-region config (or an
+    earlier new lane, transitively): grew == False is an exact
+    certificate that the closure reached its fixpoint, even though
+    dedup itself is best-effort (a missed duplicate only makes grew
+    True spuriously — one wasted iteration, never a wrong verdict)."""
+    K = states.shape[0]
+    T = _next_pow2(2 * K)  # load factor ≤ 0.5 keeps foreign collisions rare
+    shift = jnp.uint32(32 - (T - 1).bit_length())
+    h0 = _hash_cfg(states, words)
+    lane = jnp.arange(K, dtype=jnp.int32)
+    lane_or_big = jnp.where(valid, lane, K)
+    dup = jnp.zeros((K,), bool)
+    for mult in _PROBE_MULTS:
+        hx = ((h0 * jnp.uint32(mult)) >> shift).astype(jnp.int32)
+        tbl = jnp.full((T,), K, jnp.int32).at[hx].min(lane_or_big)
+        w = tbl[hx]
+        w_safe = jnp.minimum(w, K - 1)
+        same = states[w_safe] == states
+        for wd in words:
+            same = same & (wd[w_safe] == wd)
+        dup = dup | (valid & (w < lane) & same)
+    v2 = valid & ~dup
+    grew = (v2 & (lane >= n_old)).any()
+    prefix = jnp.cumsum(v2.astype(jnp.int32))
+    count = prefix[-1]
+    dst = jnp.where(v2, prefix - 1, F)  # F = out of bounds ⇒ dropped
+    out_states = jnp.zeros((F,), jnp.int32).at[dst].set(states, mode="drop")
+    out_words = tuple(
+        jnp.zeros((F,), jnp.uint32).at[dst].set(wd, mode="drop")
+        for wd in words
+    )
+    out_valid = jnp.arange(F, dtype=jnp.int32) < count
+    return out_states, out_words, out_valid, grew, count > F
+
+
+_COMPACTIONS = {"hash": _compact_hash, "sort": _compact_sort}
 
 
 def _get_bit(words, slot_u):
@@ -125,11 +215,22 @@ def _clear_bit(words, slot_u):
     )
 
 
-def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
+def build_batched(
+    spec_name: str,
+    E: int,
+    C: int,
+    F: int,
+    max_closure: int,
+    compaction: str = "hash",
+):
     """Build the (unjitted) vmapped checker for fixed shapes; jit it
-    yourself or use make_check_fn for the cached jitted version."""
+    yourself or use make_check_fn for the cached jitted version.
+    ``compaction``: "hash" (default — O(K) scatter dedup, best-effort)
+    or "sort" (exact dedup; what the sufficient-frontier rung's
+    lossless guarantee rests on)."""
     spec = next(s for s in _all_specs() if s.name == spec_name)
     step = spec.step
+    compact = _COMPACTIONS[compaction]
     W = (C + 31) // 32  # linset words: one uint32 per 32 open-op slots
 
     def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
@@ -143,12 +244,18 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
             is_pad = e_slot < 0
 
             # --- closure expansion (inline while_loop) ---
+            # convergence is certified by ``grew`` from the compaction:
+            # no new-region lane survived exact-equality dedup ⇒ every
+            # expanded config already exists ⇒ fixpoint.  (A survivor
+            # *count* comparison is only sound under exact dedup; with
+            # the best-effort hash dedup a missed duplicate could mask
+            # a genuinely new config at equal count.)
             def cond(c):
-                _, _, _, _, changed, ovf, i = c
+                _, _, _, changed, ovf, i = c
                 return changed & ~ovf & (i < max_closure)
 
             def body(c):
-                st, ws, vl, count, _, ovf, i = c
+                st, ws, vl, _, ovf, i = c
                 active = c_slot >= 0
                 slot_safe = jnp.where(active, c_slot, 0).astype(jnp.uint32)
                 ws_b = tuple(w[:, None] for w in ws)  # [F,1] vs [1,C]
@@ -169,20 +276,18 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
                     for w, nw in zip(ws, nws)
                 )
                 all_vl = jnp.concatenate([vl, nv.reshape(-1)])
-                s3, w3, v3, o3 = _compact(all_st, all_ws, all_vl, F)
-                count2 = v3.sum()
-                return (s3, w3, v3, count2, count2 != count, ovf | o3, i + 1)
+                s3, w3, v3, grew, o3 = compact(all_st, all_ws, all_vl, F, F)
+                return (s3, w3, v3, grew, ovf | o3, i + 1)
 
             init = (
                 states,
                 words,
                 valid,
-                valid.sum(),
                 jnp.bool_(True),
                 jnp.bool_(False),
                 0,
             )
-            st_c, ws_c, vl_c, _, chg_c, ovf_c, it_c = lax.while_loop(
+            st_c, ws_c, vl_c, chg_c, ovf_c, it_c = lax.while_loop(
                 cond, body, init
             )
             # exiting on the iteration cap while still growing means the
@@ -231,10 +336,17 @@ def build_batched(spec_name: str, E: int, C: int, F: int, max_closure: int):
 
 
 @lru_cache(maxsize=64)
-def make_check_fn(spec_name: str, E: int, C: int, F: int, max_closure: int):
+def make_check_fn(
+    spec_name: str,
+    E: int,
+    C: int,
+    F: int,
+    max_closure: int,
+    compaction: str = "hash",
+):
     """Jitted, cached version of build_batched — repeat batches at the
     same bucket sizes reuse the compiled executable."""
-    return jax.jit(build_batched(spec_name, E, C, F, max_closure))
+    return jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
 
 
 def kernel_choice(spec_name: str, C: int, n_values: Optional[int]) -> str:
@@ -409,14 +521,18 @@ def check_batch(
         capacities = [frontier * factor for factor in escalation]
         # final escalation rung: the provably-sufficient capacity, when
         # affordable — a lossless-compaction rerun that settles the row
-        # on-device instead of handing it to the exponential oracle
+        # on-device instead of handing it to the exponential oracle.
+        # The base pass (and intermediate rungs) use best-effort hash
+        # dedup, which can overflow spuriously at ANY capacity — so the
+        # guarantee requires one exact-sort rung at ≥ the sufficient
+        # bound even when the base frontier already exceeds it.
         suff = (
             sufficient_frontier(n_values, C, spec.name)
             if sufficient_rung
             else None
         )
-        if suff is not None and suff > max([frontier] + capacities):
-            capacities.append(suff)
+        if suff is not None and not any(c >= suff for c in capacities):
+            capacities.append(max(suff, frontier))
         for capacity in capacities:
             bad = np.flatnonzero(overflow)
             if bad.size == 0:
@@ -430,7 +546,18 @@ def check_batch(
             sub = tuple(a[idx] for a in arrays)
             if n_pad:
                 sub[1][n_bad:] = -1  # ev_slot: every event padding
-            fn2 = make_check_fn(spec.name, E, C, capacity, mc)
+            # rungs at ≥ the sufficient capacity must use EXACT (sort)
+            # dedup: the lossless-by-construction claim is "all distinct
+            # configs fit in F", which only holds if every duplicate is
+            # actually removed.  Rungs below it keep the fast hash
+            # compaction — a spurious overflow there escalates to the
+            # next rung.
+            fn2 = make_check_fn(
+                spec.name, E, C, capacity, mc,
+                compaction="sort"
+                if (suff is not None and capacity >= suff)
+                else "hash",
+            )
             ok2, failed2, ovf2 = (
                 np.asarray(x)[:n_bad] for x in _run_rows(fn2, mesh, sub)
             )

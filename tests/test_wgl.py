@@ -291,6 +291,41 @@ def test_differential_two_word_linsets():
     assert [bool(v) for v in ok] == [v is True for v in oracle]
 
 
+@pytest.mark.parametrize("compaction", ["hash", "sort"])
+def test_differential_compaction_modes(compaction):
+    """Both frontier compactions (O(K) scatter-hash dedup and exact
+    sort dedup) must agree with the CPU oracle on the fuzz corpus, with
+    no overflow at a comfortable capacity."""
+    import numpy as np
+
+    rng = random.Random(2026)
+    model = m.cas_register(0)
+    hists = [
+        _gen(rng, n_procs=5, n_ops=30, corrupt=(i % 2 == 0))
+        for i in range(20)
+    ]
+    oracle = [
+        linear.analysis(model, h0, pure_fs=("read",))["valid?"]
+        for h0 in hists
+    ]
+    batch = encode.batch_encode(hists, model, slot_cap=8)
+    assert not batch.fallback
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    fn = wgl.make_check_fn("cas-register", E, C, 512, C + 1, compaction)
+    ok, _failed, ovf = fn(
+        batch.init_state,
+        batch.ev_slot,
+        batch.cand_slot,
+        batch.cand_f,
+        batch.cand_a,
+        batch.cand_b,
+    )
+    ok, ovf = np.asarray(ok), np.asarray(ovf)
+    assert not ovf.any()
+    assert [bool(v) for v in ok] == [v is True for v in oracle]
+
+
 def test_multi_register_golden():
     model = m.multi_register({0: 0, 1: 0})
     good = h(
