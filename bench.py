@@ -384,8 +384,12 @@ def main():
             # on-chip measurement or pollute the window history
             _persist_artifact(payload, diag)
         else:
-            payload["error"] = warnings[0]
-            warnings = warnings[1:]
+            # CPU fallback (probe failed: the warning holds the reason)
+            # — or an on-accel REPS=0 compile-only run, which has no
+            # probe warning and needs no error field
+            if warnings:
+                payload["error"] = warnings[0]
+                warnings = warnings[1:]
             prior = _load_artifact()
             if prior is not None:
                 # durable evidence from the last live-chip window — the

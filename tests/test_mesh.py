@@ -1,0 +1,190 @@
+"""Mesh-seam tests: the SHIPPING multi-device path.
+
+These drive the user-facing ``wgl.check_batch(mesh=...)`` seam (not a
+hand-built jit) on the 8-virtual-device CPU mesh the conftest provides —
+the same code path a TPU slice runs:
+
+- both kernels (dense subset-automaton and generic frontier) sharded
+  over the history axis,
+- non-divisible batch sizes through the pad/slice logic in
+  parallel/mesh.py:sharded_check,
+- escalation reruns (hash rungs + the exact-sort sufficient rung)
+  dispatched under the mesh,
+- ``independent.batched_linearizable`` consuming ``test["mesh"]``.
+
+Reference anchor: jepsen.independent's bounded-pmap per-key checking
+(independent.clj:266-317) is the axis these tests shard; the mesh is
+the TPU-native replacement for that thread pool (SURVEY.md §2.4).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import linear
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import mesh as mesh_mod
+from jepsen_tpu.synth import generate_history as _gen
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, "conftest must provide 8 virtual devices"
+    return mesh_mod.default_mesh(devs[:8])
+
+
+def _oracle(model, hists, pure_fs=("read",)):
+    return [
+        linear.analysis(model, h, pure_fs=pure_fs)["valid?"] for h in hists
+    ]
+
+
+def test_sharded_check_pads_and_slices_non_divisible(mesh8):
+    """11 histories over 8 devices: sharded_check must pad to 16,
+    shard, and slice back to 11 — with padding rows never leaking into
+    the returned verdicts."""
+    rng = random.Random(31)
+    hists = [
+        _gen(rng, n_procs=3, n_ops=16, corrupt=(i % 3 == 0))
+        for i in range(11)
+    ]
+    model = m.cas_register(0)
+    from jepsen_tpu.ops import encode
+
+    batch = encode.batch_encode(hists, model, slot_cap=8)
+    assert not batch.fallback
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    fn = wgl.make_check_fn("cas-register", E, C, 64, C + 1)
+    ok, failed_at, overflow = mesh_mod.sharded_check(
+        fn,
+        mesh8,
+        batch.init_state,
+        batch.ev_slot,
+        batch.cand_slot,
+        batch.cand_f,
+        batch.cand_a,
+        batch.cand_b,
+    )
+    assert ok.shape == (11,) == overflow.shape == failed_at.shape
+    assert not np.asarray(overflow).any()
+    assert [bool(v) for v in np.asarray(ok)] == [
+        v is True for v in _oracle(model, hists)
+    ]
+
+
+def test_check_batch_mesh_dense_kernel(mesh8):
+    """The default dispatch (dense kernel) through check_batch(mesh=...)
+    must agree with the oracle and report kernel=dense — the bench's
+    perf path, sharded."""
+    rng = random.Random(45100)
+    hists = [
+        _gen(rng, n_procs=4, n_ops=24, corrupt=(i % 4 == 0))
+        for i in range(13)  # non-divisible on purpose
+    ]
+    model = m.cas_register(0)
+    outs = wgl.check_batch(model, hists, mesh=mesh8)
+    stats = wgl.batch_stats(outs)
+    assert stats["engines"] == {"tpu": 13}
+    assert stats["kernels"] == {"dense": 13}
+    assert [o["valid?"] for o in outs] == _oracle(model, hists)
+
+
+def test_check_batch_mesh_frontier_kernel(mesh8):
+    """An explicit max_closure forces the generic frontier kernel;
+    sharded it must still match the oracle."""
+    rng = random.Random(92)
+    hists = [
+        _gen(rng, n_procs=4, n_ops=20, corrupt=(i % 3 == 0))
+        for i in range(10)
+    ]
+    model = m.cas_register(0)
+    outs = wgl.check_batch(
+        model, hists, mesh=mesh8, frontier=256, max_closure=9, slot_cap=8
+    )
+    assert {o["engine"] for o in outs} == {"tpu"}
+    assert {o["kernel"] for o in outs} == {"frontier"}
+    assert [o["valid?"] for o in outs] == _oracle(model, hists)
+
+
+def test_check_batch_mesh_escalation_reruns(mesh8):
+    """A tiny starting frontier overflows; the escalation ladder (hash
+    rungs, then the exact-sort sufficient rung) must rerun the overflow
+    rows THROUGH THE MESH and settle them on-device."""
+    rng = random.Random(3)
+    hists = [
+        _gen(rng, n_procs=6, n_ops=30, crash_p=0.01, corrupt=(i % 3 == 0))
+        for i in range(9)
+    ]
+    model = m.cas_register(0)
+    outs = wgl.check_batch(
+        model,
+        hists,
+        mesh=mesh8,
+        frontier=8,
+        escalation=(4,),
+        max_closure=7,
+        slot_cap=6,
+    )
+    engines = [o["engine"] for o in outs]
+    assert all(e == "tpu" for e in engines), engines
+    assert [o["valid?"] for o in outs] == _oracle(model, hists)
+
+
+def test_batched_linearizable_consumes_test_mesh(mesh8):
+    """The independent-keys lift must pass test["mesh"] down to the
+    batched dispatch: per-key verdicts over a 5-key tuple history,
+    sharded over the mesh."""
+    from jepsen_tpu import independent
+
+    ops = []
+    proc = 0
+    for k in range(5):
+        ops.append(invoke_op(proc, "write", independent.kv(k, k + 1)))
+        ops.append(ok_op(proc, "write", independent.kv(k, k + 1)))
+        ops.append(invoke_op(proc, "read", independent.kv(k, None)))
+        # key 3 reads a value that was never written: invalid
+        bad = 99 if k == 3 else k + 1
+        ops.append(ok_op(proc, "read", independent.kv(k, bad)))
+    hist = History(ops)
+    for i, op in enumerate(hist):
+        op.index = i
+        op.time = i
+    hist = hist.index_ops()
+
+    chk = independent.batched_linearizable(m.cas_register(0), slot_cap=4)
+    out = chk.check({"mesh": mesh8, "store?": False}, hist)
+    assert out["valid?"] is False
+    assert out["failures"] == [3]
+    assert out["results"][0]["valid?"] is True
+    assert out["results"][3]["valid?"] is False
+
+
+def test_verdict_stats_collective(mesh8):
+    """verdict_stats over mesh-sharded verdict arrays: the one
+    all-reduce in the analysis plane (SURVEY.md §2.4)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ok = np.array([True] * 10 + [False] * 6)
+    ovf = np.array([False] * 12 + [True] * 4)
+    sh = NamedSharding(mesh8, P(mesh_mod.HIST_AXIS))
+    ok_d = jax.device_put(ok, sh)
+    ovf_d = jax.device_put(ovf, sh)
+    stats_fn = jax.jit(
+        mesh_mod.verdict_stats,
+        static_argnums=(),
+        out_shardings={k: NamedSharding(mesh8, P()) for k in
+                       ("valid", "invalid", "unknown")},
+    )
+    with mesh8:
+        stats = stats_fn(ok_d, ovf_d)
+    assert int(stats["valid"]) == 10
+    assert int(stats["invalid"]) == 2
+    assert int(stats["unknown"]) == 4
