@@ -256,6 +256,125 @@ def test_linearizable_register_workload():
     assert res["valid?"] is True
 
 
+class _CrashingKeyedClient:
+    """fake.KeyedAtomClient plus crash injection: every Nth invoke
+    raises BEFORE applying, so the op becomes an indeterminate :info
+    that never took effect (always linearizable as not-linearized) and
+    the interpreter retires the process — piling open-op slots onto the
+    key, the exact pressure the dense-envelope steering must absorb."""
+
+    def __init__(self, crash_every=0, inner=None, calls=None):
+        from jepsen_tpu import fake
+
+        self.inner = inner if inner is not None else fake.KeyedAtomClient()
+        self.crash_every = crash_every
+        self.calls = calls if calls is not None else [0]
+
+    def open(self, test, node):
+        return _CrashingKeyedClient(
+            self.crash_every, self.inner.open(test, node), self.calls
+        )
+
+    def setup(self, test):
+        pass
+
+    def invoke(self, test, op):
+        with self.inner.lock:
+            self.calls[0] += 1
+            if self.crash_every and self.calls[0] % self.crash_every == 0:
+                raise RuntimeError("injected crash")
+        return self.inner.invoke(test, op)
+
+    def teardown(self, test):
+        pass
+
+    def close(self, test):
+        pass
+
+
+def test_linearizable_register_steers_into_dense_envelope():
+    """Dense-envelope steering: at "3n" × 5 nodes (15 worker threads)
+    the workload must size per-key thread groups and the process budget
+    so every per-key subhistory stays within the dense kernel's slot
+    envelope — batch_stats reports kernel=dense for every key, even
+    with crash-retired processes accumulating open ops.  (The TPU
+    analogue of linearizable_register.clj:40-52's tractability caps.)"""
+    from jepsen_tpu import interpreter, models, nemesis as nemesis_mod
+    from jepsen_tpu.ops import dense as dense_mod
+    from jepsen_tpu.util import with_relative_time
+
+    nodes = [f"n{i}" for i in range(1, 6)]
+    t = linearizable_register.test(
+        {
+            "nodes": nodes,
+            "concurrency": "3n",
+            "per-key-limit": 15,
+        }
+    )
+    assert t["concurrency"] == 15
+    # largest divisor of 15 ≤ min(2·5, MAX_C=12) is 5 → 3 key groups
+    assert t["steered-group-size"] == 5
+
+    test = {
+        "name": "steer",
+        "nodes": nodes,
+        "concurrency": 15,
+        "client": _CrashingKeyedClient(crash_every=11),
+        "nemesis": nemesis_mod.noop(),
+        "generator": gen.time_limit(5.0, t["generator"]),
+        "store?": False,
+    }
+    with with_relative_time():
+        h = interpreter.run(test)
+    assert len(h) > 60, "expected a real concurrent run"
+    assert any(op.type == "info" for op in h), "crashes should appear"
+
+    from jepsen_tpu.ops import wgl
+
+    keys = ind.history_keys(h)
+    assert len(keys) >= 3
+    subs = [
+        ind.subhistory(k, h).client_ops().index_ops()
+        for k in sorted(keys, key=str)
+    ]
+    outs = wgl.check_batch(models.cas_register(), subs)
+    stats = wgl.batch_stats(outs)
+    assert stats["engines"] == {"tpu": len(subs)}, stats
+    assert stats["kernels"] == {"dense": len(subs)}, stats
+    assert all(o["valid?"] is True for o in outs)
+    # the steering lever: per-key peak open slots stayed ≤ MAX_C
+    from jepsen_tpu.ops import encode
+
+    batch = encode.batch_encode(
+        subs, models.cas_register(), slot_cap=16
+    )
+    assert batch.cand_slot.shape[2] <= dense_mod.MAX_C
+
+
+def test_linearizable_register_steering_off_keeps_legacy_shape():
+    t = linearizable_register.test({"nodes": ["n1", "n2"], "steer?": False})
+    assert t["concurrency"] == 4
+    assert t["steered-group-size"] == 4
+
+
+def test_linearizable_register_prime_concurrency_shrinks_not_degrades():
+    """13 workers over 5 nodes has no usable divisor ≤ the cap; the
+    steering must shrink the worker count (13 → 10) rather than fall to
+    vacuous 1-thread key groups."""
+    t = linearizable_register.test(
+        {"nodes": [f"n{i}" for i in range(5)], "concurrency": 13}
+    )
+    assert t["steered-group-size"] == 10
+    assert t["concurrency"] == 10
+
+
+def test_linearizable_register_unsteered_rejects_non_divisible():
+    with pytest.raises(ValueError, match="multiple"):
+        linearizable_register.test(
+            {"nodes": ["n1", "n2"], "steer?": False, "concurrency": 6}
+        )
+
+
 # ---------------------------------------------------------------------------
 # txn workloads (cycle/append, cycle/wr)
 # ---------------------------------------------------------------------------
