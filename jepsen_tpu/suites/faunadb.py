@@ -22,6 +22,7 @@ from typing import Any, Optional
 
 from .. import checker as checker_mod
 from .. import client as client_mod
+from .. import generator as gen_base
 from .. import independent
 from ..control import util as cu
 from ..control import execute, sudo
@@ -209,9 +210,14 @@ def workloads(opts: Optional[dict] = None) -> dict:
     return {
         "register": common.register_workload(opts),
         "g2": adya.workload(opts),
-        # flagship probes (reference: faunadb/pages.clj, monotonic.clj)
+        # flagship probes (reference: faunadb/pages.clj, monotonic.clj,
+        # bank.clj, set.clj, multimonotonic.clj)
         "pages": pages_workload(opts),
         "monotonic": monotonic_workload(opts),
+        "bank": bank_workload(opts),
+        "bank-index": bank_workload(opts),
+        "set": set_workload(opts),
+        "multimonotonic": multimonotonic_workload(opts),
     }
 
 
@@ -223,6 +229,10 @@ def test(opts: Optional[dict] = None) -> dict:
         "g2": FaunaG2Client,
         "pages": FaunaPagesClient,
         "monotonic": FaunaMonotonicClient,
+        "bank": FaunaBankClient,
+        "bank-index": FaunaBankIndexClient,
+        "set": FaunaSetClient,
+        "multimonotonic": FaunaMultiMonotonicClient,
     }.get(wname, FaunaClient)(opts)
     # topology churn rides the membership state machine
     # (reference: faunadb/topology.clj via nemesis.clj)
@@ -676,4 +686,513 @@ def monotonic_workload(opts: Optional[dict] = None) -> dict:
             "timestamp-value": TimestampValueChecker(),
             "timestamp-value-plot": _MonotonicPlotter(),
         }),
+    }
+
+
+# ---------------------------------------------------------------------
+# bank workload (reference: faunadb/bank.clj)
+# ---------------------------------------------------------------------
+
+ACCOUNTS_CLASS = "accounts"
+IDX_ALL_ACCOUNTS = "all_accounts"
+
+
+class FaunaBankClient(FaunaClient):
+    """Bank transfers as single FQL transactions (reference:
+    faunadb/bank.clj:69-137): a transfer debits the source inside one
+    query that aborts when the balance would go negative, deletes
+    drained accounts (writes 0 with ``fixed-instances``), and creates
+    the destination on demand; reads fetch every account's balance in
+    one transaction."""
+
+    def _acct(self, i):
+        return {"@ref": f"classes/{ACCOUNTS_CLASS}/{i}"}
+
+    def _opt(self, test, key):
+        """Client opts win; fall back to the test map (build_test merges
+        workload keys but not arbitrary suite opts)."""
+        if key in self.opts:
+            return self.opts[key]
+        return (test or {}).get(key)
+
+    def _balance(self, i, default=None):
+        return {"select": ["data", "balance"],
+                "from": {"get": self._acct(i)}, "default": default}
+
+    def setup(self, test):
+        try:
+            self.query(
+                {"create_class": {"object": {"name": ACCOUNTS_CLASS}}}
+            )
+        except (HttpError, IndeterminateError):
+            pass
+        # the whole total starts in the first account (bank.clj:47-66)
+        accounts = test.get("accounts", list(range(8)))
+        total = test.get("total-amount", 100)
+        first = self._acct(accounts[0])
+        try:
+            self.query({
+                "if": {"exists": first},
+                "then": None,
+                "else": {"create": first,
+                         "params": {"object": {"data": {"object": {
+                             "balance": total}}}}},
+            })
+            if self._opt(test, "fixed-instances"):
+                for i in accounts[1:]:
+                    r = self._acct(i)
+                    self.query({
+                        "if": {"exists": r},
+                        "then": {"update": r,
+                                 "params": {"object": {"data": {"object": {
+                                     "balance": 0}}}}},
+                        "else": {"create": r,
+                                 "params": {"object": {"data": {"object": {
+                                     "balance": 0}}}}},
+                    })
+        except (HttpError, IndeterminateError):
+            pass
+
+    def _read_expr(self, test):
+        return [
+            {"if": {"exists": self._acct(i)},
+             "then": [i, self._balance(i)],
+             "else": None}
+            for i in test.get("accounts", list(range(8)))
+        ]
+
+    def _transfer_expr(self, test, value):
+        frm, to, amount = value["from"], value["to"], value["amount"]
+        debited = {"subtract": [
+            {"if": {"exists": self._acct(frm)},
+             "then": self._balance(frm, 0), "else": 0},
+            amount,
+        ]}
+        if self._opt(test, "fixed-instances"):
+            drained = {"update": self._acct(frm),
+                       "params": {"object": {"data": {"object": {
+                           "balance": 0}}}}}
+        else:
+            drained = {"delete": self._acct(frm)}
+        debit = {
+            "if": {"lt": [debited, 0]},
+            "then": {"abort": "balance would go negative"},
+            "else": {
+                "if": {"equals": [debited, 0]},
+                "then": drained,
+                "else": {"update": self._acct(frm),
+                         "params": {"object": {"data": {"object": {
+                             "balance": debited}}}}},
+            },
+        }
+        credit = {
+            "if": {"exists": self._acct(to)},
+            "then": {"update": self._acct(to),
+                     "params": {"object": {"data": {"object": {
+                         "balance": {"add": [self._balance(to, 0),
+                                             amount]}}}}}},
+            "else": {"create": self._acct(to),
+                     "params": {"object": {"data": {"object": {
+                         "balance": amount}}}}},
+        }
+        return {"do": [debit, credit]}
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                rows = self.query(self._read_expr(test))
+                balances = {r[0]: r[1] for r in rows if r is not None}
+                return {**op, "type": "ok", "value": balances}
+            if op["f"] == "transfer":
+                self.query(self._transfer_expr(test, op["value"]))
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            if "would go negative" in str(e.body):
+                return {**op, "type": "fail", "error": "negative"}
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+class FaunaBankIndexClient(FaunaBankClient):
+    """Bank reads through a covering index over every account instead
+    of per-ref gets (reference: bank.clj:139-171 IndexClient)."""
+
+    def setup(self, test):
+        super().setup(test)
+        try:
+            self.query({"create_index": {"object": {
+                "name": IDX_ALL_ACCOUNTS,
+                "source": class_ref(ACCOUNTS_CLASS),
+                "active": True,
+                "serialized": bool(self._opt(test, "serialized-indices")),
+                "values": [{"field": ["ref"]},
+                           {"field": ["data", "balance"]}],
+            }}})
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        if op["f"] != "read":
+            return super().invoke(test, op)
+        try:
+            out = self.query(
+                {"paginate": {"match": {"index": IDX_ALL_ACCOUNTS}}}
+            )
+            balances = {}
+            for ref_map, balance in (out or {}).get("data", []):
+                id_ = ref_map["@ref"].rsplit("/", 1)[-1]
+                balances[int(id_)] = balance
+            return {**op, "type": "ok", "value": balances}
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+def bank_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: bank.clj:173-187 workload/index-workload; the
+    generic balance-invariant generator/checker come from
+    workloads.bank, staggered like the reference's 1/10s delay)"""
+    from .. import generator as gen_mod
+    from ..workloads import bank as bank_mod
+
+    opts = dict(opts or {})
+    w = bank_mod.test(opts)
+    if "rate" not in opts:
+        # the reference paces fauna bank ops at ~10/s (bank.clj:177-180);
+        # suite runs with an explicit rate are throttled by build_test
+        w["generator"] = gen_mod.stagger(0.1, w["generator"])
+    return w
+
+
+# ---------------------------------------------------------------------
+# set workload (reference: faunadb/set.clj)
+# ---------------------------------------------------------------------
+
+ELEMENTS_CLASS = "elements"
+SIDE_EFFECTS_CLASS = "side-effects"
+IDX_ALL_ELEMENTS = "all-elements"
+
+
+class FaunaSetClient(FaunaClient):
+    """Unique-element inserts + full index reads (reference:
+    set.clj:19-64).  With ``strong-read`` the read transaction also
+    performs a throwaway write, upgrading it from a snapshot index read
+    to a strict-serializable read-write transaction (set.clj:47-56)."""
+
+    def setup(self, test):
+        try:
+            for cls in (ELEMENTS_CLASS, SIDE_EFFECTS_CLASS):
+                self.query({"create_class": {"object": {"name": cls}}})
+            self.query({"create_index": {"object": {
+                "name": IDX_ALL_ELEMENTS,
+                "source": class_ref(ELEMENTS_CLASS),
+                "active": True,
+                "serialized": bool(self.opts.get("serialized-indices")),
+                "values": [{"field": ["data", "value"]}],
+            }}})
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                v = op["value"]
+                self.query({
+                    "create": {"@ref": f"classes/{ELEMENTS_CLASS}/{v}"},
+                    "params": {"object": {"data": {"object": {"value": v}}}},
+                })
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                read = {"paginate": {"match": {"index": IDX_ALL_ELEMENTS}}}
+                if self.opts.get("strong-read"):
+                    # the write rides the same transaction; `do` returns
+                    # its last expression, the index read
+                    read = {"do": [
+                        {"create": {"@ref": f"classes/{SIDE_EFFECTS_CLASS}"},
+                         "params": {"object": {"data": {"object": {}}}}},
+                        read,
+                    ]}
+                out = self.query(read)
+                vals = sorted(
+                    v for v in (out or {}).get("data", []) if v is not None
+                )
+                return {**op, "type": "ok", "value": vals}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: set.clj:66-96 workload: mixed unique adds + index
+    reads, a final read, and set-full — linearizable only when both
+    strong reads and serialized indices are on)"""
+    from .. import generator as gen_mod
+
+    opts = dict(opts or {})
+    counter = {"n": 0}
+
+    def add(test, ctx):
+        counter["n"] += 1
+        return {"type": "invoke", "f": "add", "value": counter["n"]}
+
+    def read(test, ctx):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    return {
+        "generator": gen_mod.stagger(1 / 5, gen_mod.mix([add, read])),
+        "final-generator": gen_mod.once(
+            {"type": "invoke", "f": "read", "value": None}
+        ),
+        "checker": checker_mod.set_full(
+            linearizable=bool(
+                opts.get("strong-read") and opts.get("serialized-indices")
+            )
+        ),
+    }
+
+
+# ---------------------------------------------------------------------
+# multimonotonic workload (reference: faunadb/multimonotonic.clj)
+# ---------------------------------------------------------------------
+
+REGISTERS_CLASS = "registers"
+
+
+class FaunaMultiMonotonicClient(FaunaClient):
+    """Blind single-writer increments + timestamped multi-key reads
+    (reference: multimonotonic.clj:76-110).  Writes upsert {k: v} maps
+    without reading (no OCC read locks); reads fetch a set of registers
+    plus the transaction time, returning
+    ``{"ts": ..., "registers": {k: {"ts": ..., "value": ...}}}``."""
+
+    def _reg(self, k):
+        return {"@ref": f"classes/{REGISTERS_CLASS}/{k}"}
+
+    def setup(self, test):
+        try:
+            self.query(
+                {"create_class": {"object": {"name": REGISTERS_CLASS}}}
+            )
+        except (HttpError, IndeterminateError):
+            pass
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "write":
+                upserts = []
+                for k, v in dict(op["value"]).items():
+                    r = self._reg(k)
+                    params = {"object": {"data": {"object": {"value": v}}}}
+                    upserts.append({
+                        "if": {"exists": r},
+                        "then": {"update": r, "params": params},
+                        "else": {"create": r, "params": params},
+                    })
+                self.query({"do": upserts})
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                ks = list(op["value"] or [])
+                ts, instances = self.query([
+                    {"time": "now"},
+                    [
+                        {"if": {"exists": self._reg(k)},
+                         "then": {"get": self._reg(k)}, "else": None}
+                        for k in ks
+                    ],
+                ])
+                registers = {}
+                for k, inst in zip(ks, instances):
+                    if inst is not None:
+                        registers[k] = {
+                            "ts": inst.get("ts"),
+                            "value": inst.get("data", {}).get("value"),
+                        }
+                return {**op, "type": "ok",
+                        "value": {"ts": ts, "registers": registers}}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+def _mm_read_state(op) -> dict:
+    regs = (op.value or {}).get("registers", {})
+    return {k: r.get("value") for k, r in regs.items()
+            if r.get("value") is not None}
+
+
+def _mm_observation(op, k) -> dict:
+    """What a read op observed for key k (multimonotonic.clj:164-177)."""
+    reg = (op.value or {}).get("registers", {}).get(k, {})
+    return {
+        "read-ts": (op.value or {}).get("ts"),
+        "ts": reg.get("ts"),
+        "value": reg.get("value"),
+        "op-index": op.index,
+    }
+
+
+class TsOrderChecker(checker_mod.Checker):
+    """Replays ok reads in read-timestamp order, tracking the highest
+    observed value per register; a later-timestamped read showing a
+    LOWER value for an increment-only register proves the timestamp
+    order is inconsistent with the data (reference:
+    multimonotonic.clj:253-273 + nonmonotonic-states:181-244)."""
+
+    def check(self, test, history, opts=None):
+        from ..history import OK
+
+        reads = [
+            op for op in history
+            if op.type == OK and op.f == "read"
+            and isinstance(op.value, dict) and op.value.get("ts")
+        ]
+        reads.sort(key=lambda o: str(o.value["ts"]))
+        inferred: dict = {}  # k -> observation with the highest value
+        errors = []
+        for op in reads:
+            state = _mm_read_state(op)
+            nm = {
+                k: v for k, v in state.items()
+                if k in inferred and v < inferred[k]["value"]
+            }
+            if nm:
+                errors.append({
+                    "inferred": {
+                        k: inferred[k]["value"]
+                        for k in state if k in inferred
+                    },
+                    "observed": state,
+                    "op-index": op.index,
+                    "errors": {
+                        k: [inferred[k], _mm_observation(op, k)]
+                        for k in sorted(nm, key=str)
+                    },
+                })
+            for k, v in state.items():
+                if k not in inferred or v >= inferred[k]["value"]:
+                    inferred[k] = _mm_observation(op, k)
+        return {"valid?": not errors, "errors": errors}
+
+
+class ReadSkewChecker(checker_mod.Checker):
+    """Read skew over increment-only registers as cycle detection: for
+    each register k, order reads by their observed value of k (edges to
+    the next-greater value); union the per-key orders and hunt for
+    cycles — a cycle means two reads disagree about time's arrow across
+    two registers.  The reference describes exactly this construction
+    but ships it unimplemented (multimonotonic.clj:274-313 returns
+    valid? true unconditionally); here it runs for real on the shared
+    SCC machinery (elle.graph)."""
+
+    def check(self, test, history, opts=None):
+        from ..elle.graph import (
+            Graph,
+            find_cycle,
+            strongly_connected_components,
+        )
+        from ..history import OK
+
+        reads = [
+            op for op in history
+            if op.type == OK and op.f == "read"
+            and isinstance(op.value, dict)
+        ]
+        by_key: dict = {}  # k -> value -> [op indices]
+        states = {}
+        for op in reads:
+            state = _mm_read_state(op)
+            states[op.index] = state
+            for k, v in state.items():
+                by_key.setdefault(k, {}).setdefault(v, []).append(op.index)
+        g = Graph()
+        for k, val_map in by_key.items():
+            vals = sorted(val_map)
+            for lo, hi in zip(vals, vals[1:]):
+                for a in val_map[lo]:
+                    for b in val_map[hi]:
+                        g.add_edge(a, b, f"k{k}")
+        errors = []
+        for scc in strongly_connected_components(g):
+            if len(scc) < 2:
+                continue
+            cyc = find_cycle(g, list(scc))
+            if cyc is None:
+                continue
+            errors.append({
+                "cycle": [
+                    {"op-index": a,
+                     "state": states.get(a, {}),
+                     "rels": sorted(g.edge_rels(a, b))}
+                    for a, b in zip(cyc, cyc[1:])
+                ],
+            })
+        return {"valid?": not errors, "read-skew": errors}
+
+
+class _MultiMonoWrites(gen_base.Generator):
+    """Per-thread blind-increment write generator: the key IS the
+    executing process id, so no key ever sees concurrent updates and a
+    crash (fresh process) naturally rotates to a fresh key (reference:
+    multimonotonic.clj:315-333, which likewise derives keys from
+    process ids).  Each thread's instance also registers its current
+    key so readers know the active key set — the reference keeps the
+    same registry in an atom."""
+
+    def __init__(self, active: dict, k=None, v=0):
+        self.active = active
+        self.k = k
+        self.v = v
+
+    def op(self, test, ctx):
+        from .. import generator as gen_mod
+
+        free = gen_mod.free_threads(ctx)
+        if not free:
+            return (gen_mod.PENDING, self)
+        t = free[0]
+        p = gen_mod.thread_to_process(ctx, t)
+        v2 = self.v + 1 if p == self.k else 0
+        self.active[t] = p
+        op = gen_mod.fill_in_op(
+            {"f": "write", "value": {p: v2}, "process": p}, ctx
+        )
+        return (op, _MultiMonoWrites(self.active, p, v2))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def multimonotonic_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: multimonotonic.clj:335-352 workload: half the
+    threads write their own registers blind, half read random subsets;
+    ts-order + read-skew checkers)"""
+    from .. import generator as gen_mod
+    from ..util import random_nonempty_subset
+
+    opts = dict(opts or {})
+    n = max(1, len(opts.get("nodes", ["n1"])))
+    active: dict = {}
+
+    def reads(test, ctx):
+        ks = sorted(set(active.values()))
+        value = random_nonempty_subset(ks, gen_mod.rng) if ks else []
+        return {"type": "invoke", "f": "read", "value": value}
+
+    writers = max(1, n)
+    return {
+        "generator": gen_mod.reserve(
+            writers, gen_mod.each_thread(_MultiMonoWrites(active)), reads
+        ),
+        "checker": checker_mod.compose({
+            "ts-order": TsOrderChecker(),
+            "read-skew": ReadSkewChecker(),
+        }),
+        "concurrency": 2 * n,
     }

@@ -162,13 +162,14 @@ def plotter() -> Checker:
 
 
 def test(opts: Optional[dict] = None) -> dict:
-    """A partial test: default accounts/amounts + generator + checker.
-    (reference: bank.clj:179-192)"""
+    """A partial test: default accounts/amounts + generator + checker;
+    ``accounts``/``total-amount``/``max-transfer`` opts override the
+    defaults.  (reference: bank.clj:179-192)"""
     opts = opts or {}
     return {
-        "max-transfer": 5,
-        "total-amount": 100,
-        "accounts": list(range(8)),
+        "max-transfer": opts.get("max-transfer", 5),
+        "total-amount": opts.get("total-amount", 100),
+        "accounts": list(opts.get("accounts", range(8))),
         "checker": checker_mod.compose(
             {"SI": checker(opts), "plot": plotter()}
         ),

@@ -2077,6 +2077,10 @@ class FakeDgraph(FakeServer):
 # ---------------------------------------------------------------------------
 
 
+class _FaunaAbort(Exception):
+    """Raised by the FQL ``abort`` form; rolls the transaction back."""
+
+
 class _FaunaHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -2120,11 +2124,15 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             cls, _ = self._ref_parts({"ref": obj["source"]})
             terms = obj.get("terms") or [{"field": ["data", "key"]}]
             values = obj.get("values") or [{"field": ["data", "value"]}]
-            indexes[obj["name"]] = {
+            entry = {
                 "cls": cls or obj["source"],
                 "terms": terms[0]["field"][-1],
                 "values": values[0]["field"][-1],
             }
+            if len(values) > 1:
+                # multi-value index rows (e.g. bank's [ref, balance])
+                entry["values_multi"] = [v["field"] for v in values]
+            indexes[obj["name"]] = entry
             return {"ref": obj["name"]}
         if "if" in x:
             cond = self._eval(docs, indexes, x["if"])
@@ -2163,12 +2171,27 @@ class _FaunaHandler(BaseHTTPRequestHandler):
                 cls = entry.get("cls") if isinstance(entry, dict) else entry
                 tfield = entry.get("terms", "key") if isinstance(entry, dict) else "key"
                 vfield = entry.get("values", "value") if isinstance(entry, dict) else "value"
+                multi = entry.get("values_multi") if isinstance(entry, dict) else None
                 term = terms[0] if terms else None
-                rows = [
-                    d.get(vfield)
-                    for (c, _i), d in sorted(docs.items(), key=lambda kv: str(kv[0]))
+                matches = [
+                    ((c, i), d)
+                    for (c, i), d in sorted(docs.items(), key=lambda kv: str(kv[0]))
                     if c == cls and (term is None or d.get(tfield) == term)
                 ]
+                if multi:
+                    # one row per doc: ["ref"] fields yield the ref map,
+                    # data fields yield the stored value
+                    rows = [
+                        [
+                            {"@ref": f"classes/{c}/{i}"}
+                            if f == ["ref"]
+                            else d.get(f[-1])
+                            for f in multi
+                        ]
+                        for (c, i), d in matches
+                    ]
+                else:
+                    rows = [d.get(vfield) for _ci, d in matches]
                 return {"data": rows}
             return {"data": []}
         if "match" in x:
@@ -2177,6 +2200,29 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             return self._now_ts()
         if "add" in x:
             return sum(self._eval(docs, indexes, v) for v in x["add"])
+        if "subtract" in x:
+            vals = [self._eval(docs, indexes, v) for v in x["subtract"]]
+            out = vals[0]
+            for v in vals[1:]:
+                out -= v
+            return out
+        if "lt" in x:
+            vals = [self._eval(docs, indexes, v) for v in x["lt"]]
+            return all(a < b for a, b in zip(vals, vals[1:]))
+        if "do" in x:
+            out = None
+            for e in x["do"]:
+                out = self._eval(docs, indexes, e)
+            return out
+        if "abort" in x:
+            raise _FaunaAbort(str(self._eval(docs, indexes, x["abort"])))
+        if "delete" in x:
+            cls, id_ = self._ref_parts(x["delete"])
+            if (cls, id_) not in docs:
+                raise KeyError("instance not found")
+            doc = docs.pop((cls, id_))
+            self._log_version(cls, id_, None)  # tombstone for snapshots
+            return {"data": doc}
         if "at" in x:
             ts = self._eval(docs, indexes, x["at"])
             snap = self._snapshot(ts)
@@ -2204,7 +2250,9 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             if (cls, id_) not in docs:
                 raise KeyError("instance not found")
             data = {k: self._eval(docs, indexes, v) for k, v in data.items()}
-            docs[(cls, id_)].update(data)
+            # replace rather than mutate: rollback keeps a SHALLOW copy
+            # of docs, so doc dicts must be treated as immutable
+            docs[(cls, id_)] = {**docs[(cls, id_)], **data}
             self._log_version(cls, id_, docs[(cls, id_)])
             return {"ref": {"@ref": f"classes/{cls}/{id_}"}}
         if "select" in x:
@@ -2228,7 +2276,12 @@ class _FaunaHandler(BaseHTTPRequestHandler):
             doc = docs.get((cls, id_))
             if doc is None:
                 raise KeyError("instance not found")
-            return {"data": doc}
+            # real Fauna instances carry their last-write timestamp;
+            # the multimonotonic workload reads it
+            return {
+                "data": doc,
+                "ts": self._st.kv.get("fauna_doc_ts", {}).get((cls, id_)),
+            }
         return x
 
     # -- time + versioned snapshots -----------------------------------
@@ -2246,13 +2299,20 @@ class _FaunaHandler(BaseHTTPRequestHandler):
 
     def _log_version(self, cls, id_, data) -> None:
         log = self._st.kv.setdefault("fauna_log", [])
-        log.append((self._now_ts(), cls, id_, dict(data)))
+        log.append(
+            (self._now_ts(), cls, id_, dict(data) if data is not None else None)
+        )
+        doc_ts = self._st.kv.setdefault("fauna_doc_ts", {})
+        doc_ts[(cls, id_)] = self._now_ts()
 
     def _snapshot(self, ts: str) -> dict:
         snap: dict = {}
         for t, cls, id_, data in self._st.kv.get("fauna_log", []):
             if t <= str(ts):
-                snap[(cls, id_)] = data
+                if data is None:  # tombstone: deleted at t
+                    snap.pop((cls, id_), None)
+                else:
+                    snap[(cls, id_)] = data
         return snap
 
     def do_POST(self):
@@ -2263,14 +2323,40 @@ class _FaunaHandler(BaseHTTPRequestHandler):
         with st.lock:
             docs = st.kv.setdefault("fauna_docs", {})
             indexes = st.kv.setdefault("fauna_indexes", {})
+            log = st.kv.setdefault("fauna_log", [])
+            doc_ts = st.kv.setdefault("fauna_doc_ts", {})
+            # transactions are atomic: an abort / error mid-`do` rolls
+            # back earlier effects.  Shallow copies suffice — doc dicts
+            # are replaced, never mutated, and the append-only log just
+            # truncates — so rollback cost is O(live docs), not
+            # O(version history).
+            docs_backup = dict(docs)
+            ts_backup = dict(doc_ts)
+            log_len = len(log)
+
+            def rollback():
+                docs.clear()
+                docs.update(docs_backup)
+                doc_ts.clear()
+                doc_ts.update(ts_backup)
+                del log[log_len:]
+
             try:
                 expr = json.loads(raw)
                 out = self._eval(docs, indexes, expr)
+            except _FaunaAbort as e:
+                rollback()
+                self._send({"errors": [{
+                    "code": "transaction aborted",
+                    "description": f"transaction aborted: {e}"}]})
+                return
             except KeyError as e:
+                rollback()
                 self._send({"errors": [{"code": "instance not found",
                                         "description": str(e)}]})
                 return
             except Exception as e:  # noqa: BLE001 - fake returns errors
+                rollback()
                 self._send({"errors": [{"description": repr(e)}]})
                 return
         self._send({"resource": out})

@@ -1826,3 +1826,198 @@ def test_mongo_transfer_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- faunadb bank / set / multimonotonic -------------------------------------
+
+
+def test_fauna_bank_client_roundtrip():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"nodes": ["n1"], "accounts": [0, 1, 2], "total-amount": 100}
+        c = faunadb.FaunaBankClient(opts).open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == {0: 100}
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 1, "amount": 30}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == {0: 70, 1: 30}
+        # overdraft aborts and rolls back: balances unchanged
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 1, "to": 2, "amount": 31}})
+        assert r["type"] == "fail" and r["error"] == "negative", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == {0: 70, 1: 30}
+        # draining an account deletes it (no fixed-instances)
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 1, "to": 2, "amount": 30}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == {0: 70, 2: 30}
+        c.close(t)
+    finally:
+        s.stop()
+
+
+def test_fauna_bank_index_client_reads_via_index():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"nodes": ["n1"], "accounts": [0, 1], "total-amount": 50,
+             "fixed-instances": True}
+        c = faunadb.FaunaBankIndexClient(opts).open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 1, "amount": 20}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["type"] == "ok" and r["value"] == {0: 30, 1: 20}, r
+        # fixed-instances: draining writes 0 instead of deleting
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 1, "amount": 30}})
+        assert r["type"] == "ok", r
+        r = c.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == {0: 0, 1: 50}, r
+        c.close(t)
+    finally:
+        s.stop()
+
+
+def test_fauna_set_client_and_strong_read():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        for strong in (False, True):
+            opts = {"host": "127.0.0.1", "port": s.port,
+                    "strong-read": strong, "serialized-indices": True}
+            c = faunadb.FaunaSetClient(opts).open({"nodes": ["n1"]}, "n1")
+            c.setup({})
+            base = 100 if strong else 0
+            for v in (base + 1, base + 2, base + 3):
+                r = c.invoke({}, {"f": "add", "value": v, "type": "invoke"})
+                assert r["type"] == "ok", r
+            r = c.invoke({}, {"f": "read", "value": None, "type": "invoke"})
+            assert r["type"] == "ok", r
+            for v in (base + 1, base + 2, base + 3):
+                assert v in r["value"]
+            c.close({})
+    finally:
+        s.stop()
+
+
+def test_fauna_multimonotonic_client_roundtrip():
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = faunadb.FaunaMultiMonotonicClient(opts).open(
+            {"nodes": ["n1"]}, "n1"
+        )
+        c.setup({})
+        assert c.invoke({}, {"f": "write", "value": {3: 0},
+                             "type": "invoke"})["type"] == "ok"
+        assert c.invoke({}, {"f": "write", "value": {3: 1, 4: 0},
+                             "type": "invoke"})["type"] == "ok"
+        r = c.invoke({}, {"f": "read", "value": [3, 4, 9],
+                          "type": "invoke"})
+        assert r["type"] == "ok", r
+        v = r["value"]
+        assert v["ts"]
+        assert v["registers"][3]["value"] == 1
+        assert v["registers"][4]["value"] == 0
+        assert v["registers"][3]["ts"]
+        assert 9 not in v["registers"]
+        c.close({})
+    finally:
+        s.stop()
+
+
+def _mm_read(proc, ts, regs, t):
+    value = {
+        "ts": ts,
+        "registers": {
+            k: {"ts": f"{ts}-w", "value": v} for k, v in regs.items()
+        },
+    }
+    return (
+        invoke_op(proc, "read", None, time=t),
+        ok_op(proc, "read", value, time=t + 1),
+    )
+
+
+def test_ts_order_checker():
+    from jepsen_tpu.suites.faunadb import TsOrderChecker
+
+    good = h(
+        *_mm_read(0, "001", {1: 0, 2: 5}, 0),
+        *_mm_read(1, "002", {1: 1, 2: 5}, 2),
+        *_mm_read(0, "003", {1: 1, 2: 6}, 4),
+    )
+    assert TsOrderChecker().check({}, good)["valid?"] is True
+
+    # a later-timestamped read sees register 1 go BACKWARDS
+    bad = h(
+        *_mm_read(0, "001", {1: 4}, 0),
+        *_mm_read(1, "002", {1: 3, 2: 0}, 2),
+    )
+    out = TsOrderChecker().check({}, bad)
+    assert out["valid?"] is False
+    assert out["errors"][0]["errors"][1][0]["value"] == 4
+    assert out["errors"][0]["errors"][1][1]["value"] == 3
+
+
+def test_read_skew_checker():
+    from jepsen_tpu.suites.faunadb import ReadSkewChecker
+
+    good = h(
+        *_mm_read(0, "001", {1: 0, 2: 0}, 0),
+        *_mm_read(1, "002", {1: 1, 2: 2}, 2),
+    )
+    assert ReadSkewChecker().check({}, good)["valid?"] is True
+
+    # r1 sees x=1,y=2; r2 sees x=2,y=1: incompatible per-key orders
+    bad = h(
+        *_mm_read(0, "001", {"x": 1, "y": 2}, 0),
+        *_mm_read(1, "002", {"x": 2, "y": 1}, 2),
+    )
+    out = ReadSkewChecker().check({}, bad)
+    assert out["valid?"] is False
+    assert out["read-skew"], out
+
+
+@pytest.mark.parametrize("wname", ["bank", "bank-index", "set",
+                                   "multimonotonic"])
+def test_fauna_workload_full_test_in_process(wname):
+    from jepsen_tpu.suites import faunadb
+
+    s = FakeFauna().start()
+    try:
+        t = faunadb.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": wname,
+                "faults": [],
+                # few accounts keep the short window's transfer mix from
+                # all drawing empty sources (bank only; ignored elsewhere)
+                "accounts": [0, 1, 2],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
