@@ -56,17 +56,22 @@ class DgraphDB(common.DaemonDB):
         with sudo():
             cu.install_archive(url, DIR)
 
-    def start(self, test, node):
+    def zero_nodes(self, test) -> list:
+        """Zero runs on the first node (reference: support.clj)."""
+        return [test["nodes"][0]]
+
+    def start_zero(self, test, node):
+        cu.start_daemon(
+            {"logfile": self.zero_logfile, "pidfile": self.zero_pidfile,
+             "chdir": DIR},
+            f"{DIR}/dgraph", "zero",
+            "--my", f"{node}:{ZERO_PORT}",
+            "--replicas", str(len(test["nodes"])),
+        )
+        cu.await_tcp_port(ZERO_PUBLIC_PORT, timeout_s=60)
+
+    def start_alpha(self, test, node):
         zero_node = test["nodes"][0]
-        if node == zero_node:
-            cu.start_daemon(
-                {"logfile": self.zero_logfile, "pidfile": self.zero_pidfile,
-                 "chdir": DIR},
-                f"{DIR}/dgraph", "zero",
-                "--my", f"{node}:{ZERO_PORT}",
-                "--replicas", str(len(test["nodes"])),
-            )
-            cu.await_tcp_port(ZERO_PUBLIC_PORT, timeout_s=60)
         cu.start_daemon(
             {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
             f"{DIR}/dgraph", "alpha",
@@ -74,9 +79,61 @@ class DgraphDB(common.DaemonDB):
             "--zero", f"{zero_node}:{ZERO_PORT}",
         )
 
-    def kill(self, test, node):
+    def stop_alpha(self, test, node):
         cu.stop_daemon(pidfile=self.pidfile, cmd="dgraph")
+
+    def stop_zero(self, test, node):
         cu.stop_daemon(pidfile=self.zero_pidfile, cmd="dgraph")
+
+    def alpha_running(self, test, node):
+        return cu.daemon_running(self.pidfile)
+
+    def start(self, test, node):
+        if node in self.zero_nodes(test):
+            self.start_zero(test, node)
+        self.start_alpha(test, node)
+
+    def kill(self, test, node):
+        self.stop_alpha(test, node)
+        self.stop_zero(test, node)
+
+    # -- zero cluster-management API (reference: support.clj
+    # zero-state / move-tablet! via zero's HTTP port 6080) -------------
+
+    def _zero_http(self, node) -> JsonHttpClient:
+        return JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("zero-public-port", ZERO_PUBLIC_PORT),
+            timeout=5.0,
+        )
+
+    def zero_state(self, test, node):
+        """The zero /state map (groups → tablets, zero leader), or
+        "timeout" when zero is unreachable."""
+        c = self._zero_http(node)
+        try:
+            status, body = c.get("/state", ok=(200,),
+                                 raise_on_error=False)
+            return body if status == 200 else "timeout"
+        except Exception:  # noqa: BLE001 - nemesis probes must not throw
+            return "timeout"
+        finally:
+            c.close()
+
+    def move_tablet(self, test, node, predicate, group):
+        """Ask the zero leader to rebalance one predicate onto a
+        group.  Returns (status, body); (None, error) when zero is
+        unreachable — like zero_state, nemesis probes must not throw."""
+        c = self._zero_http(node)
+        try:
+            return c.get(
+                f"/moveTablet?tablet={predicate}&group={group}",
+                ok=(200,), raise_on_error=False,
+            )
+        except Exception as e:  # noqa: BLE001
+            return None, repr(e)
+        finally:
+            c.close()
 
     def await_ready(self, test, node):
         cu.await_tcp_port(ALPHA_PORT, timeout_s=120)
@@ -230,10 +287,11 @@ def workloads(opts: Optional[dict] = None) -> dict:
         "upsert": upsert_workload(opts),
         "delete": delete_workload(opts),
         # flagship probes (reference: dgraph/bank.clj, wr.clj,
-        # long_fork.clj)
+        # long_fork.clj, sequential.clj)
         "bank": bank_wl.test(opts),
         "wr": common.generic_workload("rw-register", opts),
         "long-fork": common.generic_workload("long-fork", opts),
+        "sequential": sequential_workload(opts),
     }
 
 
@@ -248,9 +306,22 @@ def test(opts: Optional[dict] = None) -> dict:
         "bank": DgraphBankClient,
         "wr": DgraphTxnClient,
         "long-fork": DgraphTxnClient,
+        "sequential": DgraphSequentialClient,
     }.get(wname, DgraphClient)(opts)
+    db_obj = DgraphDB(opts)
+    # per-suite fault menu: alpha/zero targeting, tablet moves, skew
+    # (reference: dgraph/nemesis.clj via runner's nemesis wiring)
+    pkg = None
+    from . import dgraph_nemesis
+
+    if set(opts.get("faults", ())) & dgraph_nemesis.KNOWN_FAULTS:
+        pkg = common.suite_nemesis_package(
+            opts, db_obj, dgraph_nemesis.package(opts, db_obj),
+            dgraph_nemesis.KNOWN_FAULTS,
+        )
     return common.build_test(
-        f"dgraph-{wname}", opts, db=DgraphDB(opts), client=c, workload=w,
+        f"dgraph-{wname}", opts, db=db_obj, client=c, workload=w,
+        nemesis_package=pkg,
     )
 
 
@@ -754,3 +825,179 @@ class DgraphTxnClient(DgraphClient):
             return {**op, "type": "info", "error": str(e)}
         except HttpError as e:
             return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+# ---------------------------------------------------------------------
+# sequential workload (reference: dgraph/sequential.clj)
+# ---------------------------------------------------------------------
+
+
+class DgraphSequentialClient(DgraphClient):
+    """Read and read-increment-write transactions on per-key registers
+    (reference: sequential.clj:64-105).  Restricting transactions to
+    read-only or write-your-whole-read-set shapes makes snapshot-
+    isolation histories serializable, so each process must observe
+    monotonically nondecreasing values of an increment-only register —
+    the sequential-consistency probe of sequential.clj:1-48."""
+
+    def invoke(self, test, op):
+        k, _ = op["value"]
+        try:
+            txn = _DgraphTxn(self.conn)
+            data = txn.query(
+                f"{{ q(func: eq(key, {int(k)})) {{ uid value }} }}"
+            )
+            rows = data.get("q", [])
+            uid = rows[0].get("uid") if rows else None
+            value = (
+                int(rows[0]["value"])
+                if rows and rows[0].get("value") is not None
+                else 0
+            )
+            if op["f"] == "inc":
+                value += 1
+                if uid:
+                    txn.mutate(set_nquads=f'<{uid}> <value> "{value}" .')
+                else:
+                    txn.mutate(set_nquads=(
+                        f'_:n <key> "{int(k)}" .\n_:n <value> "{value}" .'
+                    ))
+                txn.commit()
+                return {**op, "type": "ok",
+                        "value": independent.kv(k, value)}
+            if op["f"] == "read":
+                txn.commit()
+                return {**op, "type": "ok",
+                        "value": independent.kv(k, value)}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except TxnAborted as e:
+            return {**op, "type": "fail", "error": f"conflict: {e}"}
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+
+def sequential_non_monotonic_pairs(history):
+    """Pairs of ok ops on one process whose observed value went DOWN
+    (reference: sequential.clj:107-126)."""
+    from ..history import OK
+
+    last: dict = {}
+    errs = []
+    for op in history:
+        if op.type != OK or not isinstance(op.value, int):
+            continue
+        prev = last.get(op.process)
+        prev_value = prev.value if prev is not None else 0
+        if op.value < prev_value:
+            errs.append([
+                {"op-index": prev.index, "value": prev.value},
+                {"op-index": op.index, "value": op.value},
+            ])
+        last[op.process] = op
+    return errs
+
+
+class SequentialChecker(checker_mod.Checker):
+    """Per-process monotonicity of an increment-only register
+    (reference: sequential.clj:128-136; generalized over keys by
+    independent.checker exactly as the reference does)."""
+
+    def check(self, test, history, opts=None):
+        errs = sequential_non_monotonic_pairs(history)
+        return {"valid?": not errs, "non-monotonic": errs}
+
+
+def merged_windows(s, points):
+    """[lower, upper] windows of s elements around each point, merged
+    when overlapping (reference: sequential.clj:138-158)."""
+    if not points:
+        return []
+    points = sorted(points)
+    windows = []
+    lower, upper = points[0] - s, points[0] + s
+    for p in points[1:]:
+        if p - s >= upper:
+            windows.append([lower, upper])
+            lower, upper = p - s, p + s
+        else:
+            upper = p + s
+    windows.append([lower, upper])
+    return windows
+
+
+class SequentialPlotter(checker_mod.Checker):
+    """Per-process value-over-time SVGs of the ±32-event windows around
+    each non-monotonic spot (reference: sequential.clj:160-227; the
+    gnuplot rendering is replaced by the framework's self-rendered SVG
+    scatter, checker/perf.py)."""
+
+    WINDOW = 32
+
+    def check(self, test, history, opts=None):
+        from ..history import NEMESIS, OK
+        from ..checker import perf
+
+        interesting = [
+            op for op in history
+            if (op.type == OK and isinstance(op.value, int))
+            or op.process == NEMESIS
+        ]
+        last: dict = {}
+        spots = []
+        for i, op in enumerate(interesting):
+            if op.process == NEMESIS:
+                continue
+            prev = last.get(op.process)
+            if op.value < (prev.value if prev is not None else 0):
+                spots.append(i)
+            last[op.process] = op
+        for w, (lower, upper) in enumerate(
+            merged_windows(self.WINDOW, spots)
+        ):
+            window = interesting[max(lower, 0):max(upper, 0)]
+            series: dict = {}
+            for op in window:
+                if op.process == NEMESIS:
+                    continue
+                series.setdefault(op.process, []).append(
+                    (op.time / 1e9, op.value)
+                )
+            if not series:
+                continue
+            perf.scatter_plot(
+                test,
+                series,
+                path_components=list((opts or {}).get("subdirectory", []))
+                + [f"sequential-{w}.svg"],
+                title=f"{test.get('name', 'test')} sequential by process",
+                ylabel="register value",
+                history=history,
+            )
+        return {"valid?": True}
+
+
+def sequential_workload(opts: Optional[dict] = None) -> dict:
+    """(reference: sequential.clj:229-247 workload)"""
+    from .. import generator as gen_mod
+    from ..checker import timeline
+
+    opts = dict(opts or {})
+
+    def inc_gen(test, ctx):
+        return {"type": "invoke", "f": "inc",
+                "value": independent.kv(gen_mod.rng.randrange(8), None)}
+
+    def read_gen(test, ctx):
+        return {"type": "invoke", "f": "read",
+                "value": independent.kv(gen_mod.rng.randrange(8), None)}
+
+    return {
+        "generator": gen_mod.mix([inc_gen, read_gen]),
+        "checker": independent.checker(checker_mod.compose({
+            "sequential": SequentialChecker(),
+            "plot": SequentialPlotter(),
+            "timeline": timeline.html(),
+        })),
+    }

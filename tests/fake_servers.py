@@ -1836,6 +1836,63 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                 fields.append(tok)
         return fields
 
+    # -- zero cluster-management surface (/state, /moveTablet) ---------
+    # A toy two-group tablet map: every predicate seen in a mutation
+    # lands in group "1"; /moveTablet reassigns it (500 for reserved
+    # dgraph.* predicates, like the real zero).
+
+    def _groups(self, st) -> dict:
+        return st.kv.setdefault(
+            "dgraph_groups", {"1": {"tablets": {}}, "2": {"tablets": {}}}
+        )
+
+    def _register_pred(self, st, pred) -> None:
+        groups = self._groups(st)
+        for g in groups.values():
+            if pred in g["tablets"]:
+                return
+        groups["1"]["tablets"][pred] = {
+            "predicate": pred, "groupId": 1,
+        }
+
+    def do_GET(self):
+        st = self.fake_store
+        parsed = urlparse(self.path)
+        params = parse_qs(parsed.query)
+        with st.lock:
+            if parsed.path == "/state":
+                groups = self._groups(st)
+                self._send({
+                    "groups": groups,
+                    "zeros": {"1": {"addr": "n1:5080", "leader": True}},
+                })
+                return
+            if parsed.path == "/moveTablet":
+                pred = (params.get("tablet") or [""])[0]
+                group = (params.get("group") or [""])[0]
+                if pred.startswith("dgraph."):
+                    self._send(
+                        {"errors": [{"message":
+                                     f"Unable to move reserved {pred}"}]},
+                        500,
+                    )
+                    return
+                groups = self._groups(st)
+                tablet = None
+                for g in groups.values():
+                    tablet = g["tablets"].pop(pred, None)
+                    if tablet is not None:
+                        break
+                if tablet is None:
+                    tablet = {"predicate": pred}
+                tablet["groupId"] = int(group) if group.isdigit() else group
+                groups.setdefault(
+                    str(group), {"tablets": {}}
+                )["tablets"][pred] = tablet
+                self._send({"data": f"moved {pred} to {group}"})
+                return
+        self._send({"errors": [{"message": f"no route {parsed.path}"}]}, 400)
+
     # -- txn-protocol plumbing (OCC, first-committer-wins) -------------
     # Versions are tracked per (uid, pred) and per (pred, value) index
     # entry; a txn's reads and writes are validated against them at
@@ -1975,6 +2032,7 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                         if not m:
                             continue
                         subj, pred, val = m.groups()
+                        self._register_pred(st, pred)
                         if subj.startswith("<"):
                             uid = subj.strip("<>")
                         else:
@@ -2034,6 +2092,7 @@ class _DgraphHandler(BaseHTTPRequestHandler):
                         if not m:
                             continue
                         subj, pred, val = m.groups()
+                        self._register_pred(st, pred)
                         if subj == "uid(u)":
                             for uid in uids:
                                 nodes[uid][pred] = val

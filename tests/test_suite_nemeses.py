@@ -372,3 +372,162 @@ def test_aerospike_full_run_under_fault_menu():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- dgraph -----------------------------------------------------------------
+
+
+def test_dgraph_component_nemeses_target_alpha_and_zero():
+    from jepsen_tpu.suites import dgraph, dgraph_nemesis
+
+    db = dgraph.DgraphDB({})
+    t = dummy_test(db=db)
+    with sessions(t):
+        killer = dgraph_nemesis.AlphaKiller(db).setup(t)
+        # alpha kill/restart targets EVERY node (reference targeter is
+        # identity, nemesis.clj:17-23)
+        res = killer.invoke(t, {"type": "info", "f": "kill-alpha",
+                                "value": None})
+        assert sorted(res["value"]) == NODES
+        res = killer.invoke(t, {"type": "info", "f": "restart-alpha",
+                                "value": None})
+        assert sorted(res["value"]) == NODES
+
+        zk = dgraph_nemesis.ZeroKiller(db).setup(t)
+        res = zk.invoke(t, {"type": "info", "f": "kill-zero",
+                            "value": None})
+        # zero runs on the first node only
+        assert set(res["value"]) <= {"n1"}
+        res = zk.invoke(t, {"type": "info", "f": "restart-zero",
+                            "value": None})
+        assert sorted(res["value"]) == ["n1"]
+
+        fixer = dgraph_nemesis.AlphaFixer(db).setup(t)
+        res = fixer.invoke(t, {"type": "info", "f": "fix-alpha",
+                               "value": None})
+        # dummy remotes report no pidfile, so every target restarts
+        assert set(res["value"].values()) <= {"restarted",
+                                              "already-running"}
+
+
+def test_dgraph_tablet_mover_against_fake_zero():
+    from fake_servers import FakeDgraph
+
+    from jepsen_tpu.suites import dgraph, dgraph_nemesis
+    from jepsen_tpu import independent, trace
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port,
+                "zero-public-port": s.port}
+        # write through the real client so predicates register as
+        # tablets in the fake zero's group map
+        c = dgraph.DgraphSequentialClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "inc", "type": "invoke",
+                          "value": independent.kv(0, None)})
+        assert r["type"] == "ok", r
+
+        db = dgraph.DgraphDB(opts)
+        t = dummy_test(db=db)
+        spans = []
+        trace.tracing(exporter=spans.append)
+        try:
+            mover = dgraph_nemesis.TabletMover(db).setup(t)
+            res = mover.invoke(t, {"type": "info", "f": "move-tablet",
+                                   "value": None})
+        finally:
+            trace.tracing()  # sampling back off
+        assert res["type"] == "info"
+        assert isinstance(res["value"], dict), res
+        # the fake zero seeded key/value predicates into group 1; any
+        # executed move is recorded as pred -> [from, to]
+        for pred, (g_from, g_to) in res["value"]["moved"].items():
+            assert g_from != g_to
+        # the move is wrapped in a tracing span like the reference
+        assert any(
+            sp.name == "nemesis.tablet-mover.invoke" for sp in spans
+        )
+        state = db.zero_state(t, "n1")
+        moved = {
+            p: g["tablets"][p]["groupId"]
+            for g in state["groups"].values()
+            for p in g["tablets"]
+        }
+        for pred, (_g_from, g_to) in res["value"]["moved"].items():
+            assert str(moved[pred]) == str(g_to)
+    finally:
+        s.stop()
+
+
+def test_dgraph_generators_expand_and_recover():
+    from jepsen_tpu.suites import dgraph_nemesis
+
+    flags = dgraph_nemesis._flags({
+        "faults": ["kill-alpha", "kill-zero", "partition-ring",
+                   "skew-clock", "move-tablet"],
+        "interval": 0.01, "skew": "big",
+    })
+    assert flags["kill-alpha?"] and flags["move-tablet?"]
+    g = dgraph_nemesis.full_generator(flags)
+    assert g is not None
+    final = dgraph_nemesis.final_generator(flags)
+    fs = [op["f"] for op in final]
+    assert "restart-alpha" in fs and "restart-zero" in fs
+    assert "stop-partition-ring" in fs and "stop-skew" in fs
+
+    op = dgraph_nemesis._partition_ring_gen(dummy_test(), {})
+    assert op["f"] == "start-partition-ring"
+    assert set(op["value"]) == set(NODES)
+
+
+def test_dgraph_suite_test_uses_fault_menu():
+    from jepsen_tpu.suites import dgraph, dgraph_nemesis
+
+    t = dgraph.test({
+        "nodes": NODES,
+        "workload": "sequential",
+        "faults": ["kill-alpha", "move-tablet"],
+    })
+    fs = t["nemesis"].fs()
+    for f in ("kill-alpha", "restart-alpha", "move-tablet"):
+        assert f in fs, f
+    assert t["name"] == "dgraph-sequential"
+
+
+def test_dgraph_skew_presets():
+    from jepsen_tpu.suites import dgraph_nemesis
+
+    assert dgraph_nemesis.skew_nemesis({"skew": "huge"}).dt_ms == 7500
+    assert dgraph_nemesis.skew_nemesis({"skew": "tiny"}).dt_ms == 100
+    assert dgraph_nemesis.skew_nemesis({}).dt_ms == 0
+    # a requested skew-clock fault defaults to a real preset
+    flags = dgraph_nemesis._flags({"faults": ["skew-clock"]})
+    assert flags["skew"] == "small"
+    assert dgraph_nemesis.skew_nemesis(flags).dt_ms == 250
+
+
+def test_trace_spans_nest_and_export():
+    from jepsen_tpu import trace
+
+    spans = []
+    trace.tracing(exporter=spans.append)
+    try:
+        with trace.with_trace("outer"):
+            outer_ctx = trace.context()
+            trace.attribute("k", 1)
+            with trace.with_trace("inner"):
+                trace.annotate("hello")
+                inner_ctx = trace.context()
+    finally:
+        trace.tracing()
+    assert [s.name for s in spans] == ["inner", "outer"]
+    inner, outer = spans
+    assert inner.parent_id == outer.span_id
+    assert inner.trace_id == outer.trace_id == outer_ctx["trace-id"]
+    assert inner_ctx["span-id"] == inner.span_id
+    assert outer.attributes == {"k": "1"}
+    assert inner.annotations[0]["message"] == "hello"
+    # sampling off: with_trace is a no-op and context is the zero ctx
+    with trace.with_trace("ignored"):
+        assert trace.context()["trace-id"] == "0" * 32

@@ -2021,3 +2021,91 @@ def test_fauna_workload_full_test_in_process(wname):
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- dgraph sequential -------------------------------------------------------
+
+
+def test_dgraph_sequential_client_roundtrip():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c = dgraph.DgraphSequentialClient(opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        r = c.invoke({}, {"f": "read", "type": "invoke",
+                          "value": independent.kv(3, None)})
+        assert r["type"] == "ok" and tuple(r["value"]) == (3, 0)
+        for expect in (1, 2, 3):
+            r = c.invoke({}, {"f": "inc", "type": "invoke",
+                              "value": independent.kv(3, None)})
+            assert r["type"] == "ok" and tuple(r["value"]) == (3, expect), r
+        r = c.invoke({}, {"f": "read", "type": "invoke",
+                          "value": independent.kv(3, None)})
+        assert tuple(r["value"]) == (3, 3)
+        # other keys are independent
+        r = c.invoke({}, {"f": "inc", "type": "invoke",
+                          "value": independent.kv(4, None)})
+        assert tuple(r["value"]) == (4, 1)
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_dgraph_sequential_checker():
+    from jepsen_tpu.suites.dgraph import (
+        SequentialChecker,
+        merged_windows,
+        sequential_non_monotonic_pairs,
+    )
+
+    # per-process monotone: valid even when processes interleave
+    good = h(
+        invoke_op(0, "inc", None), ok_op(0, "inc", 1),
+        invoke_op(1, "read", None), ok_op(1, "read", 0),
+        invoke_op(1, "read", None), ok_op(1, "read", 2),
+        invoke_op(0, "read", None), ok_op(0, "read", 2),
+    )
+    assert SequentialChecker().check({}, good)["valid?"] is True
+
+    # process 1 observes 2 then 1: non-monotonic
+    bad = h(
+        invoke_op(0, "inc", None), ok_op(0, "inc", 1),
+        invoke_op(1, "read", None), ok_op(1, "read", 2),
+        invoke_op(1, "read", None), ok_op(1, "read", 1),
+    )
+    out = SequentialChecker().check({}, bad)
+    assert out["valid?"] is False
+    pair = out["non-monotonic"][0]
+    assert pair[0]["value"] == 2 and pair[1]["value"] == 1
+    assert sequential_non_monotonic_pairs(good) == []
+
+    assert merged_windows(2, []) == []
+    assert merged_windows(2, [5]) == [[3, 7]]
+    # overlapping windows merge; distant ones stay separate
+    assert merged_windows(2, [5, 6, 20]) == [[3, 8], [18, 22]]
+
+
+def test_dgraph_sequential_full_test_in_process():
+    from jepsen_tpu.suites import dgraph
+
+    s = FakeDgraph().start()
+    try:
+        t = dgraph.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 30,
+                "workload": "sequential",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
