@@ -80,10 +80,13 @@ class DgraphDB(common.DaemonDB):
         )
 
     def stop_alpha(self, test, node):
-        cu.stop_daemon(pidfile=self.pidfile, cmd="dgraph")
+        # pidfile-only: a killall would take the co-located zero down
+        # as collateral, breaking the fault isolation the targeted
+        # alpha/zero nemeses promise
+        cu.stop_daemon(pidfile=self.pidfile)
 
     def stop_zero(self, test, node):
-        cu.stop_daemon(pidfile=self.zero_pidfile, cmd="dgraph")
+        cu.stop_daemon(pidfile=self.zero_pidfile)
 
     def alpha_running(self, test, node):
         return cu.daemon_running(self.pidfile)
@@ -96,6 +99,8 @@ class DgraphDB(common.DaemonDB):
     def kill(self, test, node):
         self.stop_alpha(test, node)
         self.stop_zero(test, node)
+        # teardown-grade sweep: catch strays the pidfiles don't track
+        cu.stop_daemon(cmd="dgraph")
 
     # -- zero cluster-management API (reference: support.clj
     # zero-state / move-tablet! via zero's HTTP port 6080) -------------
@@ -127,7 +132,8 @@ class DgraphDB(common.DaemonDB):
         c = self._zero_http(node)
         try:
             return c.get(
-                f"/moveTablet?tablet={predicate}&group={group}",
+                "/moveTablet",
+                params={"tablet": str(predicate), "group": str(group)},
                 ok=(200,), raise_on_error=False,
             )
         except Exception as e:  # noqa: BLE001
@@ -956,7 +962,7 @@ class SequentialPlotter(checker_mod.Checker):
         for w, (lower, upper) in enumerate(
             merged_windows(self.WINDOW, spots)
         ):
-            window = interesting[max(lower, 0):max(upper, 0)]
+            window = interesting[max(lower, 0):max(upper + 1, 0)]
             series: dict = {}
             for op in window:
                 if op.process == NEMESIS:
