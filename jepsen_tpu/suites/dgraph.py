@@ -924,7 +924,9 @@ def merged_windows(s, points):
     windows = []
     lower, upper = points[0] - s, points[0] + s
     for p in points[1:]:
-        if p - s >= upper:
+        # bounds are inclusive (the plotter slices upper+1), so
+        # touching windows merge; split only past the boundary
+        if p - s > upper:
             windows.append([lower, upper])
             lower, upper = p - s, p + s
         else:

@@ -1,0 +1,303 @@
+#!/usr/bin/env python3
+"""A replicated linearizable register daemon — the multi-process
+integration-test service with REAL replication state
+(tests/test_local_cluster.py runs three of these under
+start-stop-daemon, with peer links routed through partitionable
+proxies).
+
+Replication is multi-writer ABD over majority quorums:
+
+- every replica persists ``(ts, tiebreak, value)`` with fsync;
+- a write queries a majority for the max timestamp, picks
+  ``(max_ts+1, node_id)``, and stores to a majority before acking;
+- a read queries a majority, takes the max-timestamped value, and
+  WRITES IT BACK to a majority before returning (the read-repair phase
+  that makes concurrent reads linearizable).
+
+Quorum intersection makes this linearizable under crashes, SIGSTOP
+pauses, and partitions — safety never depends on clocks or leases, so
+a paused-then-resumed replica can never ack stale data (its quorum
+replies carry whatever newer timestamps the majority moved to).
+
+On top rides a REAL term-based election (persisted current/voted
+terms, majority votes over the peer links): replicas heartbeat the
+leader, campaign on silence, and step down on seeing a higher term.
+The leader is a coordination hint only — any replica coordinates
+quorum ops — so the election demonstrably runs (terms advance when the
+leader is killed or partitioned away; ``STATUS`` exposes term/leader
+for the test's assertions) without safety ever resting on it.
+
+Line protocol (one port serves clients and peers):
+  clients:  ``R`` → value|ERR…   ``W <v>`` → OK|ERR…   ``STATUS`` →
+            ``<term> <leader>``
+  peers:    ``GET`` → ``<ts> <tb> <v>``   ``SET <ts> <tb> <v>`` → OK
+            ``VOTE <term> <cand>`` → YES|NO   ``COORD <term> <id>`` → OK
+
+Write failures distinguish ``ERR-EARLY`` (no store was attempted —
+definite failure) from ``ERR-MAYBE`` (stores were sent but a majority
+never acked — indeterminate), so the harness can map them to
+:fail/:info correctly.
+"""
+
+import os
+import random
+import socket
+import socketserver
+import sys
+import threading
+import time
+
+PEER_TIMEOUT = 0.25
+ELECTION_MIN_S = 0.4
+ELECTION_JITTER_S = 0.4
+HEARTBEAT_S = 0.15
+
+
+class State:
+    """fsync'd (ts, tiebreak, value, term, voted_term) cell."""
+
+    def __init__(self, path):
+        self.path = path
+        self.lock = threading.Lock()
+        self.ts = 0
+        self.tb = 0
+        self.value = 0
+        self.term = 0
+        self.voted = 0
+        try:
+            with open(path) as f:
+                parts = f.read().split()
+                self.ts, self.tb, self.value, self.term, self.voted = map(
+                    int, parts
+                )
+        except (FileNotFoundError, ValueError):
+            pass
+
+    def _persist(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(
+                f"{self.ts} {self.tb} {self.value} {self.term} {self.voted}"
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def read_local(self):
+        with self.lock:
+            return self.ts, self.tb, self.value
+
+    def store_if_newer(self, ts, tb, value):
+        with self.lock:
+            if (ts, tb) > (self.ts, self.tb):
+                self.ts, self.tb, self.value = ts, tb, value
+                self._persist()
+            return True
+
+    def grant_vote(self, term):
+        with self.lock:
+            if term > self.voted:
+                self.voted = term
+                self._persist()
+                return True
+            return False
+
+    def see_term(self, term):
+        with self.lock:
+            if term > self.term:
+                self.term = term
+                self._persist()
+
+
+class Replica:
+    def __init__(self, node_id, peers, state):
+        self.id = node_id
+        self.peers = peers  # {peer_id: (host, port)} — proxied links
+        self.state = state
+        self.leader = None
+        self.leader_seen = 0.0
+        self.n = len(peers) + 1
+        self.majority = self.n // 2 + 1
+        # MWMR ABD needs a unique (ts, writer) per write; this
+        # replica's id is the writer tiebreak, so concurrent writes
+        # COORDINATED BY THE SAME REPLICA must serialize or two could
+        # pick the same (max_ts+1, id) for different values — an acked
+        # split the reads then disagree on
+        self.write_lock = threading.Lock()
+
+    # -- peer RPC ------------------------------------------------------
+
+    def _call_peer(self, addr, line):
+        try:
+            with socket.create_connection(addr, timeout=PEER_TIMEOUT) as s:
+                s.settimeout(PEER_TIMEOUT)
+                f = s.makefile("rw")
+                f.write(line + "\n")
+                f.flush()
+                return f.readline().strip() or None
+        except OSError:
+            return None
+
+    def _broadcast(self, line):
+        """Ask every peer; list of replies (None for unreachable).
+        Pre-populated so a straggler thread outliving the join timeout
+        updates an existing key instead of resizing the dict under a
+        caller's iteration."""
+        replies = {pid: None for pid in self.peers}
+        threads = []
+
+        def one(pid, addr):
+            replies[pid] = self._call_peer(addr, line)
+
+        for pid, addr in self.peers.items():
+            t = threading.Thread(target=one, args=(pid, addr), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(PEER_TIMEOUT * 2)
+        return replies
+
+    # -- quorum ops (multi-writer ABD) ---------------------------------
+
+    def _quorum_get(self):
+        """(ts, tb, value) of the max-timestamped majority reply, or
+        None without a quorum.  Counts self."""
+        best = self.state.read_local()
+        got = 1
+        for rep in self._broadcast("GET").values():
+            if rep is None:
+                continue
+            try:
+                ts, tb, v = map(int, rep.split())
+            except ValueError:
+                continue
+            got += 1
+            if (ts, tb) > (best[0], best[1]):
+                best = (ts, tb, v)
+        return best if got >= self.majority else None
+
+    def _quorum_set(self, ts, tb, value):
+        self.state.store_if_newer(ts, tb, value)
+        acks = 1
+        for rep in self._broadcast(f"SET {ts} {tb} {value}").values():
+            if rep == "OK":
+                acks += 1
+        return acks >= self.majority
+
+    def client_read(self):
+        best = self._quorum_get()
+        if best is None:
+            return "ERR-EARLY no-quorum"
+        ts, tb, v = best
+        # read repair: the observed value must reach a majority before
+        # the read returns, else a later read could observe an older one
+        if not self._quorum_set(ts, tb, v):
+            return "ERR-EARLY no-quorum"
+        return str(v)
+
+    def client_write(self, v):
+        with self.write_lock:
+            best = self._quorum_get()
+            if best is None:
+                return "ERR-EARLY no-quorum"  # nothing stored anywhere
+            ts = best[0] + 1
+            if self._quorum_set(ts, self.id, v):
+                return "OK"
+            return "ERR-MAYBE no-quorum"  # stored somewhere, maybe visible
+
+    # -- election (coordination hint; safety-free) ---------------------
+
+    def election_loop(self):
+        while True:
+            time.sleep(HEARTBEAT_S)
+            if self.leader == self.id:
+                self._broadcast(f"COORD {self.state.term} {self.id}")
+                continue
+            fresh = time.monotonic() - self.leader_seen
+            if self.leader is not None and fresh < ELECTION_MIN_S:
+                continue
+            time.sleep(random.random() * ELECTION_JITTER_S)
+            if (
+                self.leader is not None
+                and time.monotonic() - self.leader_seen < ELECTION_MIN_S
+            ):
+                continue
+            term = self.state.term + 1
+            self.state.see_term(term)
+            if not self.state.grant_vote(term):
+                continue
+            votes = 1
+            for rep in self._broadcast(f"VOTE {term} {self.id}").values():
+                if rep == "YES":
+                    votes += 1
+            if votes >= self.majority and term >= self.state.term:
+                self.leader = self.id
+                self.leader_seen = time.monotonic()
+                self._broadcast(f"COORD {term} {self.id}")
+
+    # -- request handling ----------------------------------------------
+
+    def handle(self, parts):
+        cmd = parts[0]
+        if cmd == "R":
+            return self.client_read()
+        if cmd == "W":
+            return self.client_write(int(parts[1]))
+        if cmd == "STATUS":
+            return f"{self.state.term} {self.leader if self.leader is not None else -1}"
+        if cmd == "GET":
+            ts, tb, v = self.state.read_local()
+            return f"{ts} {tb} {v}"
+        if cmd == "SET":
+            self.state.store_if_newer(
+                int(parts[1]), int(parts[2]), int(parts[3])
+            )
+            return "OK"
+        if cmd == "VOTE":
+            term = int(parts[1])
+            self.state.see_term(term)
+            return "YES" if self.state.grant_vote(term) else "NO"
+        if cmd == "COORD":
+            term, lid = int(parts[1]), int(parts[2])
+            if term >= self.state.term:
+                self.state.see_term(term)
+                if self.leader == self.id and lid != self.id:
+                    pass  # step down by adopting the announcer
+                self.leader = lid
+                self.leader_seen = time.monotonic()
+            return "OK"
+        return "ERR"
+
+
+def main(node_id, port, state_path, peer_spec):
+    peers = {}
+    if peer_spec:
+        for item in peer_spec.split(","):
+            pid, addr = item.split("=")
+            host, p = addr.rsplit(":", 1)
+            peers[int(pid)] = (host, int(p))
+    replica = Replica(node_id, peers, State(state_path))
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                parts = line.decode().split()
+                out = replica.handle(parts) if parts else "ERR"
+                self.wfile.write((out + "\n").encode())
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    threading.Thread(target=replica.election_loop, daemon=True).start()
+    with Server(("127.0.0.1", port), Handler) as srv:
+        print(f"repregd {node_id} listening on {port}", flush=True)
+        srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+         if len(sys.argv) > 4 else "")
