@@ -385,6 +385,48 @@ def multi_register_arm(results, B, reps):
             auto_choice=choice,
         )
 
+    # dense-envelope corpus: small per-register domains run the
+    # composite-state automaton (round-4 dense-family extension);
+    # 2 keys × small pool keeps S = Vr² inside the cap even with
+    # corrupt-value vids
+    py_rng = random.Random(45101)
+    hists2 = [
+        synth.generate_mr_history(
+            py_rng,
+            n_procs=5,
+            n_ops=L,
+            n_keys=2,
+            n_values=3,
+            crash_p=0.01,
+            corrupt=(i % 4 == 0),
+        )
+        for i in range(16)
+    ]
+    model2 = m.multi_register({k: 0 for k in range(2)})
+    batch2 = _batch_arrays(hists2, model2, slot_cap=8)
+    E2 = batch2.ev_slot.shape[1]
+    C2 = batch2.cand_slot.shape[2]
+    arrays2 = _expand(batch2, B, rng)
+    oracle_row(results, "multi-register-small", hists2, model2, C2, L)
+    from jepsen_tpu.ops import dense
+
+    mr_shape = dense.mr_shape_probe(arrays2[0], arrays2[4], arrays2[5])
+    choice2 = wgl.kernel_choice("multi-register", C2, mr_shape)
+    if dense.applicable("multi-register", C2, mr_shape):
+        fn = dense.make_dense_fn("multi-register", E2, C2, mr_shape)
+        dt, ok, ovf = _time_fn(fn, arrays2, reps)
+        _device_row(
+            results, "multi-register-small", "dense",
+            C2, None, L, B, E2, dt, ok, ovf,
+            auto_choice=choice2, states=mr_shape[0] ** mr_shape[1],
+        )
+    fn = wgl.make_check_fn("multi-register", E2, C2, 128, C2 + 1)
+    dt, ok, ovf = _time_fn(fn, arrays2, reps)
+    _device_row(
+        results, "multi-register-small", "frontier",
+        C2, 128, L, B, E2, dt, ok, ovf, auto_choice=choice2,
+    )
+
 
 def _gen_queue_history(rng, n_procs, n_ops):
     """Unique-element unordered-queue history (same simulation as

@@ -71,13 +71,51 @@ DENSE_SPECS = ("register", "cas-register", "mutex")
 MAX_C = 12   # 2^12 subsets = 128 packed words
 MAX_V = 32
 
+#: multi-register composite-state cap: the K-register automaton runs
+#: dense over S = Vr^K states (digit per register), e.g. a V^4 map at
+#: V ≤ 3, a 2-key map at V ≤ 11 — the small per-key value domains the
+#: causal/monotonic-style workloads produce.  Per-event cost scales
+#: with S², so past this point the frontier kernel's config-adaptive
+#: search wins even though larger S still compiles.
+MR_MAX_STATES = 128
 
-def applicable(spec_name: str, C: int, V: int) -> bool:
+
+def mr_shape_probe(init_state, cand_a, cand_b) -> tuple:
+    """(Vr, K) composite shape of an encoded multi-register batch:
+    a = per-register value id, b = register index, init packs one
+    byte-wide value id per register (step_kernels.py:74-94).  A raw max
+    over the PACKED init would wildly overestimate the domain."""
+    from .step_kernels import MR_REGISTERS, MR_VALUE_BITS
+
+    init = np.asarray(init_state)
+    mask = (1 << MR_VALUE_BITS) - 1
+    dig_max = [
+        int(((init >> (MR_VALUE_BITS * k)) & mask).max())
+        for k in range(MR_REGISTERS)
+    ]
+    kreg = max(
+        int(np.asarray(cand_b).max()) + 1,
+        max((k + 1 for k in range(MR_REGISTERS) if dig_max[k] > 0),
+            default=1),
+    )
+    vr = 1 + max(int(np.asarray(cand_a).max()), max(dig_max))
+    return vr, kreg
+
+
+def applicable(spec_name: str, C: int, V) -> bool:
+    """``V`` is the value-domain size for the register family, or a
+    ``(Vr, K)`` pair (per-register domain, register count) for
+    multi-register."""
     if spec_name == "unordered-queue":
         # the queue kernel has no V dimension: its state is a pure
         # function of the linset (unique-value ops commute), so only C
         # bounds it — value ids are capped by the encoder at 31 anyway
         return C <= MAX_C
+    if spec_name == "multi-register":
+        if not isinstance(V, tuple):
+            return False
+        vr, k = V
+        return C <= MAX_C and vr ** k <= MR_MAX_STATES
     return spec_name in DENSE_SPECS and C <= MAX_C and V <= MAX_V
 
 
@@ -157,12 +195,40 @@ def _or_fold(terms):
     return terms[0]
 
 
-def build_dense(spec_name: str, E: int, C: int, V: int):
+def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
     """Build the (unjitted) vmapped dense checker for fixed shapes.
     Signature matches wgl.build_batched's result: ``fn(init_state,
     ev_slot, cand_slot, cand_f, cand_a, cand_b) -> (ok, failed_at,
-    overflow)`` — with ``overflow`` identically False."""
-    if spec_name not in DENSE_SPECS:
+    overflow)`` — with ``overflow`` identically False.
+
+    For ``multi-register`` pass ``mr_shape=(Vr, K)``: the automaton
+    then runs over the COMPOSITE state space S = Vr^K (one digit per
+    register) with transitions built from the per-register mop codes
+    (a = value id, b = register index, step_kernels.py:81-94); V is
+    ignored and S takes its place."""
+    multi = spec_name == "multi-register"
+    if multi:
+        if mr_shape is None:
+            raise ValueError("multi-register needs mr_shape=(Vr, K)")
+        vr, kreg = mr_shape
+        V = int(vr) ** int(kreg)
+        # static digit tables: digit[s, k] of composite state s, and
+        # same-except-one-register masks for write transitions
+        s_ids = np.arange(V)
+        digits_np = np.stack(
+            [(s_ids // (vr ** k)) % vr for k in range(kreg)], axis=1
+        )  # [S, K]
+        same_ex_np = np.zeros((kreg, V, V), bool)  # [K, s', s]
+        for k in range(kreg):
+            others = np.delete(digits_np, k, axis=1)
+            same_ex_np[k] = (others[:, None, :] == others[None, :, :]).all(
+                axis=2
+            )
+        digits_T = jnp.asarray(digits_np.T)  # [K, S]
+        same_ex = jnp.asarray(same_ex_np)
+        eye_ss = jnp.asarray(np.eye(V, dtype=bool))
+        mr_pow = jnp.asarray([vr ** k for k in range(kreg)], jnp.int32)
+    elif spec_name not in DENSE_SPECS:
         raise ValueError(f"no dense kernel for spec {spec_name!r}")
     W = _n_words(C)
     max_closure = C + 2  # ≤C passes reach fixpoint; headroom is free
@@ -171,6 +237,14 @@ def build_dense(spec_name: str, E: int, C: int, V: int):
     didx_b = jnp.broadcast_to(didx[:, None, :], (C, V, W))
 
     def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
+        if multi:
+            # packed byte-per-register int32 → composite state id
+            from .step_kernels import MR_VALUE_BITS
+
+            digs = (
+                init_state >> (MR_VALUE_BITS * jnp.arange(kreg))
+            ) & ((1 << MR_VALUE_BITS) - 1)
+            init_state = jnp.sum(digs.astype(jnp.int32) * mr_pow)
         D0 = jnp.zeros((V, W), jnp.uint32)
         # one config: prefix value = init, empty linset (subset 0, bit 0)
         D0 = lax.dynamic_update_index_in_dim(
@@ -194,33 +268,54 @@ def build_dense(spec_name: str, E: int, C: int, V: int):
             a_s = jnp.sum(jnp.where(eq, c_a[None, :], 0), axis=1)
             b_s = jnp.sum(jnp.where(eq, c_b[None, :], 0), axis=1)
 
-            # per-slot [C, V, V] transition matrix T[j, v', v]: does
-            # linearizing slot j move value v to v'?  (mutex ops are cas
-            # in disguise: acquire=cas(0,1), release=cas(1,0))
-            is_acq = f_s == F_ACQUIRE
-            is_rel = f_s == F_RELEASE
-            a_eff = jnp.where(is_acq, 0, jnp.where(is_rel, 1, a_s))
-            b_eff = jnp.where(is_acq, 1, jnp.where(is_rel, 0, b_s))
-            is_write = f_s == F_WRITE
-            is_ra = f_s == F_READ_ANY
-            cas_like = (f_s == F_CAS) | is_acq | is_rel
-            vp = jnp.arange(V, dtype=jnp.int32)[None, :, None]  # v'
-            vv = jnp.arange(V, dtype=jnp.int32)[None, None, :]  # v
-            am = a_eff[:, None, None]
-            bm = b_eff[:, None, None]
-            T = jnp.where(
-                is_write[:, None, None],
-                vp == am,
-                jnp.where(
-                    is_ra[:, None, None],
-                    vp == vv,
+            if multi:
+                # T[j, s', s] from per-register mop codes: a = value
+                # id, b = register index.  write: every digit but reg b
+                # unchanged, digit b of s' equals a.  read: s' == s and
+                # digit b of s equals a.  read-any: s' == s.
+                reg = jnp.clip(b_s, 0, kreg - 1)
+                se = jnp.take(same_ex, reg, axis=0)  # [C, S, S]
+                d_b = jnp.take(digits_T, reg, axis=0)  # [C, S]
+                is_write = f_s == F_WRITE
+                is_ra = f_s == F_READ_ANY
+                am = a_s[:, None, None]
+                T = jnp.where(
+                    is_write[:, None, None],
+                    se & (d_b[:, :, None] == am),
                     jnp.where(
-                        cas_like[:, None, None],
-                        (vp == bm) & (vv == am),
-                        (vp == am) & (vv == am),  # read
+                        is_ra[:, None, None],
+                        eye_ss[None],
+                        eye_ss[None] & (d_b[:, None, :] == am),  # read
                     ),
-                ),
-            ) & active_s[:, None, None]
+                ) & active_s[:, None, None]
+            else:
+                # per-slot [C, V, V] transition matrix T[j, v', v]: does
+                # linearizing slot j move value v to v'?  (mutex ops are
+                # cas in disguise: acquire=cas(0,1), release=cas(1,0))
+                is_acq = f_s == F_ACQUIRE
+                is_rel = f_s == F_RELEASE
+                a_eff = jnp.where(is_acq, 0, jnp.where(is_rel, 1, a_s))
+                b_eff = jnp.where(is_acq, 1, jnp.where(is_rel, 0, b_s))
+                is_write = f_s == F_WRITE
+                is_ra = f_s == F_READ_ANY
+                cas_like = (f_s == F_CAS) | is_acq | is_rel
+                vp = jnp.arange(V, dtype=jnp.int32)[None, :, None]  # v'
+                vv = jnp.arange(V, dtype=jnp.int32)[None, None, :]  # v
+                am = a_eff[:, None, None]
+                bm = b_eff[:, None, None]
+                T = jnp.where(
+                    is_write[:, None, None],
+                    vp == am,
+                    jnp.where(
+                        is_ra[:, None, None],
+                        vp == vv,
+                        jnp.where(
+                            cas_like[:, None, None],
+                            (vp == bm) & (vv == am),
+                            (vp == am) & (vv == am),  # read
+                        ),
+                    ),
+                ) & active_s[:, None, None]
 
             # --- closure: linearize open ops until fixpoint; every slot
             # advances in one vectorized pass ---
@@ -414,19 +509,22 @@ def build_dense_queue(E: int, C: int):
     return jax.vmap(check_one)
 
 
-def make_dense_fn(spec_name: str, E: int, C: int, V: int):
+def make_dense_fn(spec_name: str, E: int, C: int, V):
     """Jitted, cached dense checker (same contract as wgl.make_check_fn).
     The queue kernel has no value axis, so V is normalized out of its
     cache key — otherwise every distinct value-domain (and any initial
     bitset contents, whose numeric max can be huge) would re-jit a
-    byte-identical kernel."""
+    byte-identical kernel.  For multi-register, V is the (Vr, K)
+    composite-shape pair."""
     if spec_name == "unordered-queue":
         V = 0
     return _make_dense_fn_cached(spec_name, E, C, V)
 
 
 @lru_cache(maxsize=64)
-def _make_dense_fn_cached(spec_name: str, E: int, C: int, V: int):
+def _make_dense_fn_cached(spec_name: str, E: int, C: int, V):
     if spec_name == "unordered-queue":
         return jax.jit(build_dense_queue(E, C))
+    if spec_name == "multi-register":
+        return jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V))
     return jax.jit(build_dense(spec_name, E, C, V))

@@ -349,17 +349,22 @@ def make_check_fn(
     return jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
 
 
-def kernel_choice(spec_name: str, C: int, n_values: Optional[int]) -> str:
+def kernel_choice(spec_name: str, C: int, n_values) -> str:
     """Which kernel make_best_check_fn would pick for this shape —
     "dense" (subset automaton, no sorts, no overflow) or "frontier"
-    (generic sort-compacted search).  Callers report this so a workload
-    silently drifting outside the dense envelope (e.g. "3n" concurrency
-    pushing peak open ops past its slot cap) is visible in stats rather
-    than a mystery slowdown."""
+    (generic sort-compacted search).  ``n_values`` is the value-domain
+    bound, or a (Vr, K) pair for multi-register's composite automaton.
+    Callers report this so a workload silently drifting outside the
+    dense envelope (e.g. "3n" concurrency pushing peak open ops past
+    its slot cap) is visible in stats rather than a mystery slowdown."""
     from . import dense as dense_mod
 
     if n_values is not None:
-        V = encode_mod.round_up(n_values, 4)
+        V = (
+            tuple(n_values)
+            if isinstance(n_values, (tuple, list))
+            else encode_mod.round_up(n_values, 4)
+        )
         if dense_mod.applicable(spec_name, C, V):
             return "dense"
     return "frontier"
@@ -380,7 +385,11 @@ def make_best_check_fn(
     from . import dense as dense_mod
 
     if kernel_choice(spec_name, C, n_values) == "dense":
-        V = encode_mod.round_up(n_values, 4)
+        V = (
+            tuple(n_values)
+            if isinstance(n_values, (tuple, list))
+            else encode_mod.round_up(n_values, 4)
+        )
         return dense_mod.make_dense_fn(spec_name, E, C, V)
     return make_check_fn(spec_name, E, C, F, max_closure)
 
@@ -424,6 +433,8 @@ def sufficient_frontier(
     as before."""
     if C >= 31:
         return None
+    if isinstance(n_values, (tuple, list)):  # multi-register (Vr, K)
+        n_values = int(n_values[0]) ** int(n_values[1])
     if spec_name == "unordered-queue":
         bound = 1 << C
     else:
@@ -497,13 +508,21 @@ def check_batch(
         # fixpoint-confirming iteration, so legitimate closures are never
         # cut short and flagged unknown
         mc = max_closure if max_closure is not None else C + 1
-        n_values = 1 + int(
-            max(
-                batch.init_state.max(),
-                batch.cand_a.max(),
-                batch.cand_b.max(),
+        if spec.name == "multi-register":
+            # the (Vr, K) composite pair drives the dense automaton
+            from . import dense as dense_mod
+
+            n_values = dense_mod.mr_shape_probe(
+                batch.init_state, batch.cand_a, batch.cand_b
             )
-        )
+        else:
+            n_values = 1 + int(
+                max(
+                    batch.init_state.max(),
+                    batch.cand_a.max(),
+                    batch.cand_b.max(),
+                )
+            )
         if max_closure is None:
             fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
             kernel = kernel_choice(spec.name, C, n_values)

@@ -261,8 +261,7 @@ class Replica:
             term, lid = int(parts[1]), int(parts[2])
             if term >= self.state.term:
                 self.state.see_term(term)
-                if self.leader == self.id and lid != self.id:
-                    pass  # step down by adopting the announcer
+                # adopting the announcer also steps a stale leader down
                 self.leader = lid
                 self.leader_seen = time.monotonic()
             return "OK"

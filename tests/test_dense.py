@@ -218,3 +218,103 @@ def test_failed_event_index_matches_frontier_kernel():
     assert out["valid?"] is False
     assert out["engine"] == "tpu"
     assert out["failed-event"] == 1  # second ok event kills the frontier
+
+
+# ---------------------------------------------------------------------------
+# multi-register composite-state dense kernel
+# ---------------------------------------------------------------------------
+
+
+def test_mr_dense_applicability():
+    from jepsen_tpu.ops import dense
+
+    assert dense.applicable("multi-register", 8, (5, 2))       # 25 states
+    assert dense.applicable("multi-register", 8, (3, 4))       # 81 = V^4
+    assert not dense.applicable("multi-register", 8, (6, 3))   # 216 > cap
+    assert not dense.applicable("multi-register", 16, (2, 2))  # C past cap
+    assert not dense.applicable("multi-register", 8, 25)       # needs pair
+
+
+def test_mr_dense_differential_two_keys():
+    """K=2 composite automaton vs the CPU oracle over the fuzz corpus:
+    the batch must ride kernel=dense and agree everywhere."""
+    import random
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.synth import generate_mr_history
+
+    rng = random.Random(777)
+    model = m.multi_register({k: 0 for k in range(2)})
+    hists = [
+        generate_mr_history(rng, n_keys=2, n_values=3, corrupt=(i % 3 == 0))
+        for i in range(30)
+    ]
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    outs = wgl.check_batch(model, hists)
+    stats = wgl.batch_stats(outs)
+    assert stats["kernels"] == {"dense": 30}, stats
+    assert [o["valid?"] for o in outs] == oracle
+    assert True in oracle and False in oracle
+
+
+def test_mr_dense_v4_four_keys():
+    """The V^4 shape: four registers with a tiny per-register domain
+    run dense (81 composite states at Vr=3)."""
+    import random
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.synth import generate_mr_history
+
+    rng = random.Random(4100)
+    model = m.multi_register({k: 0 for k in range(4)})
+    # valid-only, single-value pool: corrupt/extra values widen the
+    # per-register domain past the composite cap (invalid coverage
+    # lives in the two-key test); Vr = 3 → 81 composite states
+    hists = [
+        generate_mr_history(rng, n_keys=4, n_values=1, n_ops=30)
+        for i in range(20)
+    ]
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    outs = wgl.check_batch(model, hists)
+    stats = wgl.batch_stats(outs)
+    assert stats["kernels"] == {"dense": len(hists)}, stats
+    assert [o["valid?"] for o in outs] == oracle
+
+
+def test_mr_dense_golden_cross_register():
+    """Writes must not bleed across registers in the composite map."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.history import History, invoke_op, ok_op
+    from jepsen_tpu.ops import wgl
+
+    def h(*ops):
+        hist = History(ops)
+        for i, op in enumerate(hist):
+            op.index = i
+            op.time = i
+        return hist
+
+    model = m.multi_register({0: 0, 1: 0})
+    good = h(
+        invoke_op(0, "txn", [("w", 0, 5)]),
+        ok_op(0, "txn", [("w", 0, 5)]),
+        invoke_op(0, "txn", [("r", 1, None)]),
+        ok_op(0, "txn", [("r", 1, 0)]),
+        invoke_op(0, "txn", [("r", 0, None)]),
+        ok_op(0, "txn", [("r", 0, 5)]),
+    )
+    bad = h(
+        invoke_op(0, "txn", [("w", 0, 5)]),
+        ok_op(0, "txn", [("w", 0, 5)]),
+        invoke_op(0, "txn", [("r", 1, None)]),
+        ok_op(0, "txn", [("r", 1, 5)]),  # wrong register
+    )
+    out_good = wgl.check_batch(model, [good])[0]
+    out_bad = wgl.check_batch(model, [bad])[0]
+    assert out_good["kernel"] == "dense", out_good
+    assert out_good["valid?"] is True
+    assert out_bad["valid?"] is False
