@@ -5,7 +5,11 @@ per-key version graphs at once, "does any cycle exist?"
 (jepsen_tpu.elle.cycles.cyclic_graph_mask).  On device this is a
 batched boolean matrix closure (ops.cycles.has_cycle_batch); on CPU it
 is per-graph Tarjan SCC.  This prints both throughputs at a few graph
-sizes so the dispatch threshold's perf claim has evidence.
+sizes so the crossover has recorded evidence.  (Production routing no
+longer hard-codes a band from these numbers: elle.cycles.cyclic_graph_mask
+self-calibrates per size bucket on the backend actually in use, running
+both engines once and cross-checking — this bench remains the
+documented, reproducible measurement.)
 
 Run: python benchmarks/elle_bench.py            # device (if present)
      JAX_PLATFORMS=cpu python ... (pytest-style CPU forcing needs the

@@ -180,28 +180,110 @@ def classify(g: Graph) -> Dict[str, list]:
     return anomalies
 
 
+#: winner cache for the device-vs-CPU cycle screen, keyed by
+#: (vertex-bucket, batch-size-bucket) — one runtime calibration per key
+#: per process (see cyclic_graph_mask).  "cpu" is also the terminal
+#: state when the device path errors or ever disagrees with the SCC
+#: reference.
+_SCREEN_CHOICE: dict = {}
+
+#: never even calibrate the O(n³) closure kernel past this many
+#: vertices: it loses to CPU SCC well before (0.6× at n=256,
+#: benchmarks/elle_bench.py), and a first-touch calibration on a huge
+#: padded matrix would burn minutes proving the obvious
+DEVICE_SCREEN_MAX_VERTICES = 512
+
+
+def _screen_bucket(n: int) -> int:
+    return 1 << max(4, int(n - 1).bit_length())
+
+
+def _cpu_screen(graphs):
+    import numpy as np
+
+    return np.array(
+        [bool(strongly_connected_components(g)) for g in graphs]
+    )
+
+
+def _adjacency_mats(graphs):
+    return [g.adjacency()[1] for g in graphs]
+
+
+def _device_screen(graphs, mats=None):
+    from ..ops import cycles as ops_cycles
+
+    if mats is None:
+        mats = _adjacency_mats(graphs)
+    return ops_cycles.has_cycle_batch(mats)
+
+
 def cyclic_graph_mask(graphs: List[Graph], use_device: Optional[bool] = None):
     """Batched cycle screening: which of these graphs contain a cycle at
     all?  Pads adjacency matrices to a common bucket and runs the
     boolean-closure kernel (jepsen_tpu.ops.cycles) in one dispatch —
-    the Elle-on-TPU formulation from SURVEY.md §7 step 8.  Falls back to
-    CPU SCC when no accelerator is available."""
+    the Elle-on-TPU formulation from SURVEY.md §7 step 8.
+
+    Routing between the device kernel and per-graph CPU SCC is
+    SELF-CALIBRATING: the first batch at each (vertex-count,
+    batch-size) bucket pair runs BOTH paths (the device one twice, so
+    compile time doesn't pollute the measurement), cross-checks their
+    answers, and caches the faster engine for that pair on the backend
+    actually in use — a band measured on this host's CPU would
+    silently misroute on a real chip, where the crossover sits
+    elsewhere, and a 1-graph batch's dispatch overhead says nothing
+    about a 4096-graph batch's.  A device error or a cross-check
+    mismatch pins the pair to CPU permanently (the screen must never
+    trade correctness for speed), and graphs past
+    DEVICE_SCREEN_MAX_VERTICES skip calibration entirely."""
+    import logging
+    import time
+
     import numpy as np
 
     if not graphs:
         return np.zeros((0,), dtype=bool)
-    if use_device is None:
-        # device wins by ~20x on the small, numerous per-key graphs and
-        # loses to CPU SCC past a couple hundred vertices (measured in
-        # benchmarks/elle_bench.py: 19.7x at n=16, 3.9x at n=64, 0.6x at
-        # n=256) — dispatch only inside the winning band
-        biggest = max(len(g.vertices) for g in graphs)
-        use_device = 16 <= biggest <= 128
-    if not use_device:
-        return np.array(
-            [bool(strongly_connected_components(g)) for g in graphs]
+    if use_device is not None:
+        return (
+            _device_screen(graphs) if use_device else _cpu_screen(graphs)
         )
-    from ..ops import cycles as ops_cycles
 
-    mats = [g.adjacency()[1] for g in graphs]
-    return ops_cycles.has_cycle_batch(mats)
+    biggest = max(len(g.vertices) for g in graphs)
+    if biggest > DEVICE_SCREEN_MAX_VERTICES:
+        return _cpu_screen(graphs)
+    key = (_screen_bucket(biggest), _screen_bucket(len(graphs)))
+    choice = _SCREEN_CHOICE.get(key)
+    if choice == "device":
+        return _device_screen(graphs)
+    if choice == "cpu":
+        return _cpu_screen(graphs)
+
+    # calibrate: both engines answer this batch; the winner takes the
+    # bucket pair.  The batch's verdicts come for free (cross-checked).
+    t0 = time.perf_counter()
+    cpu_out = _cpu_screen(graphs)
+    t_cpu = time.perf_counter() - t0
+    try:
+        mats = _adjacency_mats(graphs)
+        _device_screen(graphs, mats)  # warm/compile
+        t0 = time.perf_counter()
+        dev_out = _device_screen(graphs, mats)
+        t_dev = time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 - unusable device pins to CPU
+        logging.getLogger(__name__).warning(
+            "elle cycle-screen device path failed; pinning %s to CPU",
+            key,
+            exc_info=True,
+        )
+        _SCREEN_CHOICE[key] = "cpu"
+        return cpu_out
+    if not np.array_equal(np.asarray(dev_out), cpu_out):
+        logging.getLogger(__name__).warning(
+            "elle cycle-screen device/CPU verdicts diverged; pinning %s "
+            "to CPU",
+            key,
+        )
+        _SCREEN_CHOICE[key] = "cpu"
+        return cpu_out
+    _SCREEN_CHOICE[key] = "device" if t_dev < t_cpu else "cpu"
+    return cpu_out

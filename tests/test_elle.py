@@ -860,3 +860,62 @@ def test_elle_checker_writes_anomaly_artifacts(tmp_path):
     # unit-style checks on bare test maps write nothing
     res2 = ck.check({}, h)
     assert "anomaly-files" not in res2
+
+
+def test_cycle_screen_self_calibrates(monkeypatch):
+    """The device-vs-CPU cycle screen calibrates per size bucket on
+    first use (running both engines, cross-checking), caches the
+    winner, and pins a bucket to CPU when the device path disagrees —
+    never trading correctness for speed."""
+    import numpy as np
+
+    from jepsen_tpu.elle import cycles as c
+    from jepsen_tpu.elle.graph import Graph
+
+    def chain(n, cyc):
+        g = Graph()
+        for i in range(n - 1):
+            g.add_edge(i, i + 1, "ww")
+        if cyc:
+            g.add_edge(n - 1, 0, "ww")
+        else:
+            g.add_vertex(n - 1)
+        return g
+
+    graphs = [chain(9, i % 2 == 0) for i in range(8)]
+    expected = [i % 2 == 0 for i in range(8)]
+
+    monkeypatch.setattr(c, "_SCREEN_CHOICE", {})
+    out = c.cyclic_graph_mask(graphs)
+    assert list(out) == expected
+    key = (c._screen_bucket(9), c._screen_bucket(len(graphs)))
+    assert c._SCREEN_CHOICE.get(key) in ("cpu", "device")
+    # second call rides the cached choice and agrees
+    assert list(c.cyclic_graph_mask(graphs)) == expected
+
+    # a lying device engine must pin the bucket pair to CPU, with the
+    # CPU answer returned
+    monkeypatch.setattr(c, "_SCREEN_CHOICE", {})
+    monkeypatch.setattr(
+        c, "_device_screen", lambda gs, mats=None: np.zeros((len(gs),), bool)
+    )
+    out = c.cyclic_graph_mask(graphs)
+    assert list(out) == expected
+    assert c._SCREEN_CHOICE.get(key) == "cpu"
+
+    # a crashing device engine likewise
+    def boom(gs, mats=None):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(c, "_SCREEN_CHOICE", {})
+    monkeypatch.setattr(c, "_device_screen", boom)
+    out = c.cyclic_graph_mask(graphs)
+    assert list(out) == expected
+    assert c._SCREEN_CHOICE.get(key) == "cpu"
+
+    # huge graphs never touch the device path at all
+    monkeypatch.setattr(c, "_SCREEN_CHOICE", {})
+    monkeypatch.setattr(c, "_device_screen", boom)
+    big = [chain(c.DEVICE_SCREEN_MAX_VERTICES + 1, True)]
+    assert list(c.cyclic_graph_mask(big)) == [True]
+    assert c._SCREEN_CHOICE == {}
