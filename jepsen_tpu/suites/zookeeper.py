@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 from typing import Any, Optional
 
+from .. import checker as checker_mod
 from .. import client as client_mod
 from .. import independent
 from .. import control
@@ -165,6 +166,99 @@ class ZkRegisterClient(client_mod.Client):
             self.conn.close()
 
 
+class ZkLockClient(client_mod.Client):
+    """Distributed try-lock over a well-known znode: acquire = create
+    (NODE_EXISTS → definite fail), release = delete of our own node —
+    the classic ZooKeeper lock recipe, checked against the mutex model
+    exactly as the reference checks its distributed-lock clients
+    (hazelcast.clj:340-449 fenced-lock/lock; the knossos mutex model
+    consumed at jepsen/src/jepsen/checker.clj:19-26).
+
+    The client refuses double-acquires and releases-without-holding
+    locally (definite fails that never touch the wire).  A connection
+    cut mid-acquire is indeterminate: the lock may now be held by a
+    node nobody will release — the history stays linearizable (an
+    :info acquire may linearize forever), later acquires just fail."""
+
+    PATH = "/jepsen-lock"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[ZkClient] = None
+        self.held = False
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = ZkClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            timeout=self.opts.get("timeout", 10.0),
+        )
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "acquire":
+                if self.held:
+                    return {**op, "type": "fail", "error": "already-held"}
+                try:
+                    self.conn.create(self.PATH, b"held")
+                except ZkError as e:
+                    if e.code == -110:  # NODEEXISTS: lock taken
+                        return {**op, "type": "fail", "error": "taken"}
+                    raise
+                self.held = True
+                return {**op, "type": "ok"}
+            if op["f"] == "release":
+                if not self.held:
+                    return {**op, "type": "fail", "error": "not-held"}
+                try:
+                    self.conn.delete(self.PATH)
+                except ZkError as e:
+                    self.held = False
+                    if e.code == -101:
+                        # NONODE: the delete DEFINITELY did not execute
+                        # — report a definite fail so the checker can
+                        # flag the underlying anomaly (our held lock
+                        # vanishing is exactly what a lock test exists
+                        # to catch: a later acquire-ok with no
+                        # intervening release-ok must read as invalid)
+                        return {**op, "type": "fail",
+                                "error": "lock vanished while held"}
+                    raise
+                self.held = False
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            # a cut connection loses track of whether we hold the lock;
+            # assume not (never release what we might not own)
+            self.held = False
+            return {**op, "type": "info", "error": str(e)}
+        except ZkError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def lock_workload(opts: Optional[dict] = None) -> dict:
+    """Contended try-lock/release cycles checked against the mutex
+    model — the product consumer of the mutex linearizability kernel
+    (ops/step_kernels.py mutex spec; dense inside C ≤ 12, the
+    small-frontier generic kernel beyond)."""
+    from .. import generator as gen
+    from .. import models
+
+    return {
+        "generator": gen.each_thread(gen.cycle([
+            {"type": "invoke", "f": "acquire", "value": None},
+            {"type": "invoke", "f": "release", "value": None},
+        ])),
+        "checker": checker_mod.linearizable(models.mutex()),
+    }
+
+
 def db(opts: Optional[dict] = None):
     return ZookeeperDB(opts)
 
@@ -174,13 +268,19 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"register": common.register_workload(dict(opts or {}))}
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        "lock": lock_workload(opts),
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
-    w = workloads(opts)["register"]
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
+    c = {"lock": ZkLockClient}.get(wname, ZkRegisterClient)(opts)
     return common.build_test(
-        "zookeeper-register", opts, db=ZookeeperDB(opts),
-        client=ZkRegisterClient(opts), workload=w,
+        f"zookeeper-{wname}", opts, db=ZookeeperDB(opts),
+        client=c, workload=w,
     )

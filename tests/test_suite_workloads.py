@@ -2109,3 +2109,73 @@ def test_dgraph_sequential_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- zookeeper lock ----------------------------------------------------------
+
+
+def test_zk_lock_client_roundtrip():
+    from fake_servers import FakeZk
+
+    from jepsen_tpu.suites import zookeeper
+
+    s = FakeZk().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        c1 = zookeeper.ZkLockClient(opts).open({"nodes": ["n1"]}, "n1")
+        c2 = zookeeper.ZkLockClient(opts).open({"nodes": ["n1"]}, "n1")
+        r = c1.invoke({}, {"f": "acquire", "value": None, "type": "invoke"})
+        assert r["type"] == "ok", r
+        # contender loses; holder can't double-acquire
+        r = c2.invoke({}, {"f": "acquire", "value": None, "type": "invoke"})
+        assert r["type"] == "fail" and r["error"] == "taken"
+        r = c1.invoke({}, {"f": "acquire", "value": None, "type": "invoke"})
+        assert r["type"] == "fail" and r["error"] == "already-held"
+        # release without holding never touches the wire
+        r = c2.invoke({}, {"f": "release", "value": None, "type": "invoke"})
+        assert r["type"] == "fail" and r["error"] == "not-held"
+        r = c1.invoke({}, {"f": "release", "value": None, "type": "invoke"})
+        assert r["type"] == "ok", r
+        # freed: the contender can take it now
+        r = c2.invoke({}, {"f": "acquire", "value": None, "type": "invoke"})
+        assert r["type"] == "ok", r
+        c1.close({})
+        c2.close({})
+    finally:
+        s.stop()
+
+
+def test_zk_lock_full_test_in_process():
+    from fake_servers import FakeZk
+
+    from jepsen_tpu.suites import zookeeper
+
+    s = FakeZk().start()
+    try:
+        t = zookeeper.test(
+            {
+                "nodes": ["n1", "n2"],
+                "host": "127.0.0.1",
+                "port": s.port,
+                "time-limit": 2,
+                "rate": 40,
+                "workload": "lock",
+                "faults": [],
+            }
+        )
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        r = result["results"]
+        assert r["valid?"] is True, r
+        hist = result["history"]
+        oks = [o for o in hist if o["type"] == "ok"]
+        fails = [o for o in hist if o["type"] == "fail"]
+        assert any(o["f"] == "acquire" for o in oks)
+        assert any(o["f"] == "release" for o in oks)
+        # the lock was genuinely contended
+        assert any(o.get("error") == "taken" for o in fails), (
+            "no contention observed"
+        )
+    finally:
+        s.stop()
