@@ -100,6 +100,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
         "--logging-json", action="store_true", help="JSON-structured logs"
     )
     p.add_argument("--store-base", default="store", help="artifact directory")
+    p.add_argument(
+        "--mesh",
+        dest="mesh_sharding",  # "mesh" is the test-map key for the
+        action="store_true",   # built Mesh object itself
+        help="shard the analysis batch over every visible accelerator "
+        "device (jax.sharding.Mesh on the history axis); single-device "
+        "runs are unaffected",
+    )
 
 
 def test_opts_to_map(args: argparse.Namespace) -> dict:
@@ -120,6 +128,23 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
     }
     if args.concurrency is not None:
         test["concurrency"] = parse_concurrency(args.concurrency, len(nodes))
+    if getattr(args, "mesh_sharding", False):
+        # build lazily at analyze time: probing the backend here would
+        # hang a wedged tunnel before the test even starts, and the
+        # checker seam (batched_linearizable → check_batch(mesh=...))
+        # only reads test["mesh"] once histories exist
+        from .platform import ensure_usable_backend
+
+        def _mesh():
+            ensure_usable_backend()
+            import jax
+
+            from .parallel import mesh as mesh_mod
+
+            devs = jax.devices()
+            return mesh_mod.default_mesh(devs) if len(devs) > 1 else None
+
+        test["mesh-fn"] = _mesh
     if args.dummy:
         from .control.core import DummyRemote
 
@@ -393,7 +418,10 @@ def default_commands() -> Dict[str, dict]:
         if opts.get("time-limit"):
             g = gen.time_limit(opts["time-limit"], g)
         test = {
-            **{k: v for k, v in opts.items() if not callable(v)},
+            # strip stray callables from opts — except the lazy mesh
+            # builder, which the checker seam resolves at analyze time
+            **{k: v for k, v in opts.items()
+               if not callable(v) or k == "mesh-fn"},
             "name": opts["workload"],
             "client": KeyedAtomClient(),
             "generator": g,

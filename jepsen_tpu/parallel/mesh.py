@@ -27,6 +27,18 @@ def default_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.array(devs), (HIST_AXIS,))
 
 
+def resolve_mesh(test: dict) -> Optional[Mesh]:
+    """The test's analysis mesh: an explicit ``test["mesh"]``, or the
+    lazily-built ``test["mesh-fn"]`` (the CLI's --mesh flag installs
+    one so a wedged accelerator tunnel can't hang test STARTUP — the
+    backend is only probed once histories exist and analysis begins)."""
+    m = test.get("mesh")
+    if m is not None:
+        return m
+    fn = test.get("mesh-fn")
+    return fn() if callable(fn) else None
+
+
 def pad_to_multiple(arr: np.ndarray, multiple: int, fill=0) -> np.ndarray:
     """Pad axis 0 up to a multiple of `multiple` with `fill`."""
     b = arr.shape[0]

@@ -34,7 +34,7 @@ BASE = "store"
 #: (reference: store.clj:91-99)
 DEFAULT_NONSERIALIZABLE_KEYS = {
     "barrier", "db", "os", "net", "client", "checker", "nemesis",
-    "generator", "model", "remote", "mesh", "writer",
+    "generator", "model", "remote", "mesh", "mesh-fn", "writer",
 }
 
 

@@ -394,7 +394,7 @@ def build_test(
     # store location / logging flags (the CLI merges these into opts;
     # reference: cli.clj test-opt-fn feeding every suite's test map)
     for k in ("store-base", "leave-db-running?", "logging-json?", "ssh",
-              "remote", "time-limit"):
+              "remote", "time-limit", "mesh", "mesh-fn"):
         if k in opts:
             test[k] = opts[k]
     if "nodes" in opts:
