@@ -59,35 +59,64 @@ def prepare(history: History, pure_fs: Iterable[Any] = ()) -> Tuple[list, list]:
 
     Failed ops are dropped entirely; indeterminate ops whose :f is in
     pure_fs (state-preserving reads) are dropped too.
+
+    One fused pass: pairing, failure/pure-read dropping, and value
+    propagation together, copying only the invocations that survive —
+    this runs per history on the host ingest path (encode + oracle),
+    where the former copy-everything/three-pass pipeline dominated
+    encoding cost (SURVEY.md §7, host↔device feed rate).
     """
-    from ..history import strip_indeterminate_reads
-
-    h = History(op for op in history if isinstance(op.process, int))
-    h = h.complete().without_failures()
-    if pure_fs:
-        h = strip_indeterminate_reads(h, pure_fs)
-
-    events = []
+    pure = set(pure_fs)
+    events: list = []
     ops: list = []
     open_by_process: Dict[Any, int] = {}
-    for op in h:
-        if op.type == INVOKE:
+    dropped: set = set()
+    for op in history:
+        p = op.process
+        if not isinstance(p, int):
+            continue
+        t = op.type
+        if t == INVOKE:
             op_id = len(ops)
-            ops.append(op)
-            open_by_process[op.process] = op_id
+            ops.append(op.copy())
+            open_by_process[p] = op_id
             events.append((INVOKE, op_id))
-        elif op.type == OK:
-            op_id = open_by_process.pop(op.process, None)
+        elif t == OK:
+            op_id = open_by_process.pop(p, None)
             if op_id is not None:
+                if op.value is not None:
+                    ops[op_id].value = op.value
                 events.append((OK, op_id))
-        elif op.type == INFO:
-            op_id = open_by_process.pop(op.process, None)
+        elif t == FAIL:
+            op_id = open_by_process.pop(p, None)
             if op_id is not None:
-                events.append((INFO, op_id))
+                dropped.add(op_id)  # a failed op never took effect
+        elif t == INFO:
+            op_id = open_by_process.pop(p, None)
+            if op_id is not None:
+                if op.f in pure:
+                    # a crashed pure read always linearizes and never
+                    # changes state: drop it to shrink the search
+                    dropped.add(op_id)
+                else:
+                    events.append((INFO, op_id))
     # processes whose invoke never completed at all: same as info (open
     # forever)
     for op_id in open_by_process.values():
         events.append((INFO, op_id))
+    if dropped:
+        # compact ids so dropped ops vanish entirely (their values must
+        # not leak into encoders' value maps or domain probes)
+        remap: Dict[int, int] = {}
+        kept: list = []
+        for op_id, op in enumerate(ops):
+            if op_id not in dropped:
+                remap[op_id] = len(kept)
+                kept.append(op)
+        ops = kept
+        events = [
+            (k, remap[op_id]) for k, op_id in events if op_id not in dropped
+        ]
     return events, ops
 
 

@@ -71,19 +71,10 @@ class EncodedBatch:
     row_history: List[int] = field(default_factory=list)
 
 
-def encode_history(
-    history: History,
-    model: m.Model,
-    slot_cap: int = DEFAULT_SLOT_CAP,
-    spec: Optional[ModelSpec] = None,
-) -> Optional[EncodedHistory]:
-    """Encode one history, or None if unsupported (model has no kernel,
-    open-op count exceeds slot_cap, or an op can't be encoded)."""
-    spec = spec or spec_for(model)
-    if spec is None:
-        return None
+def _prepare_encoding(history, model, spec):
+    """Shared front half: event stream + per-op (f, a, b) codes, or
+    None when the model/ops can't be encoded."""
     events, ops = linear.prepare(history, pure_fs=spec.pure_fs)
-
     valmap: Dict[Any, int] = {}
     try:
         init = spec.init_state(model, valmap)
@@ -92,6 +83,131 @@ def encode_history(
         return None
     if len(valmap) > MAX_VALUE_ID:
         return None  # value ids would overflow the int16 lanes
+    return events, ops, init, enc_ops
+
+
+def encode_history(
+    history: History,
+    model: m.Model,
+    slot_cap: int = DEFAULT_SLOT_CAP,
+    spec: Optional[ModelSpec] = None,
+) -> Optional[EncodedHistory]:
+    """Encode one history, or None if unsupported (model has no kernel,
+    open-op count exceeds slot_cap, or an op can't be encoded).
+
+    The per-event candidate snapshots are built vectorized — an op is a
+    candidate at completion row r iff its invoke precedes r's event
+    position and its own completion doesn't, a CONTIGUOUS row range
+    computed via searchsorted, so work and memory scale with candidate
+    pairs (E × average open ops), never E × n_ops — because host
+    encoding is the production ingest path and per-event Python loops
+    would cap the device's throughput (SURVEY.md §7, host↔device feed
+    rate).  Only slot assignment stays a (cheap, O(n)) sequential
+    pass: which slot an op borrows depends on the free set at its
+    invoke."""
+    import heapq
+
+    spec = spec or spec_for(model)
+    if spec is None:
+        return None
+    pre = _prepare_encoding(history, model, spec)
+    if pre is None:
+        return None
+    events, ops, init, enc_ops = pre
+
+    n = len(ops)
+    T = len(events)
+    # event-position bookkeeping: t_inv[o], t_done[o] (inf if never ok),
+    # and the stream positions of ok events (the kernel's rows)
+    t_inv = np.zeros((n,), np.int64)
+    t_done = np.full((n,), T + 1, np.int64)
+    ok_pos = []
+    ok_op_ids = []
+    slot = np.full((n,), -1, np.int16)
+    free: list = list(range(slot_cap))
+    heapq.heapify(free)
+    open_count = 0
+    max_open = 0
+    for t, (kind, op_id) in enumerate(events):
+        if kind == "invoke":
+            if not free:
+                return None  # too many concurrently-open ops
+            slot[op_id] = heapq.heappop(free)
+            t_inv[op_id] = t
+            open_count += 1
+            max_open = max(max_open, open_count)
+        elif kind == "ok":
+            t_done[op_id] = t
+            ok_pos.append(t)
+            ok_op_ids.append(op_id)
+            heapq.heappush(free, int(slot[op_id]))
+            open_count -= 1
+        # info: op keeps its slot forever
+
+    E = len(ok_pos)
+    C = slot_cap
+    cand_slot = np.full((E, C), -1, np.int8)
+    cand_f = np.zeros((E, C), np.int8)
+    cand_a = np.zeros((E, C), np.int16)
+    cand_b = np.zeros((E, C), np.int16)
+    if E:
+        ok_pos_a = np.asarray(ok_pos, np.int64)
+        # an op is a candidate at completion row r iff r's event
+        # position lies in (t_inv, t_done] — and rows are ordered by
+        # position, so each op's candidacy is one CONTIGUOUS row range:
+        # total work scales with candidate pairs (E × avg open ops),
+        # not E × n_ops
+        r_lo = np.searchsorted(ok_pos_a, t_inv, side="right")
+        r_hi = np.searchsorted(ok_pos_a, t_done, side="right") - 1
+        spans = np.maximum(r_hi - r_lo + 1, 0)
+        op_idx = np.repeat(np.arange(n), spans)
+        span_starts = np.concatenate(([0], np.cumsum(spans[:-1])))
+        within = np.arange(int(spans.sum())) - np.repeat(span_starts, spans)
+        rows = np.repeat(r_lo, spans) + within
+        # lane order: ops ascending within each row (pairs arrive
+        # op-major; resort row-major)
+        order = np.lexsort((op_idx, rows))
+        rows, op_idx = rows[order], op_idx[order]
+        counts = np.bincount(rows, minlength=E)
+        row_starts = np.concatenate(([0], np.cumsum(counts[:-1])))
+        lanes = np.arange(len(op_idx)) - np.repeat(row_starts, counts)
+        fab = np.asarray(enc_ops, np.int32).reshape(n, 3)
+        cand_slot[rows, lanes] = slot[op_idx].astype(np.int8)
+        cand_f[rows, lanes] = fab[op_idx, 0].astype(np.int8)
+        cand_a[rows, lanes] = fab[op_idx, 1].astype(np.int16)
+        cand_b[rows, lanes] = fab[op_idx, 2].astype(np.int16)
+        ev_slot_arr = slot[np.asarray(ok_op_ids, np.int64)].astype(np.int32)
+    else:
+        ev_slot_arr = np.full((0,), -1, np.int32)
+
+    return EncodedHistory(
+        init_state=init,
+        ev_slot=ev_slot_arr,
+        cand_slot=cand_slot,
+        cand_f=cand_f,
+        cand_a=cand_a,
+        cand_b=cand_b,
+        n_ops=n,
+        max_open=max_open,
+    )
+
+
+def _encode_history_loop(
+    history: History,
+    model: m.Model,
+    slot_cap: int = DEFAULT_SLOT_CAP,
+    spec: Optional[ModelSpec] = None,
+) -> Optional[EncodedHistory]:
+    """The straightforward per-event-loop encoder, kept as the
+    differential reference for the vectorized encode_history (the two
+    must agree array-for-array; tests/test_wgl.py pins it)."""
+    spec = spec or spec_for(model)
+    if spec is None:
+        return None
+    pre = _prepare_encoding(history, model, spec)
+    if pre is None:
+        return None
+    events, ops, init, enc_ops = pre
 
     E = sum(1 for kind, _ in events if kind == "ok")
     C = slot_cap

@@ -437,3 +437,50 @@ def test_sufficient_frontier_escalation_resolves_on_device():
     oracle = [linear.analysis(model, h, pure_fs=("read",))["valid?"]
               for h in hists]
     assert [o["valid?"] for o in outs] == oracle
+
+
+def test_vectorized_encoder_matches_loop_reference():
+    """The vectorized encoder must agree array-for-array with the
+    straightforward per-event-loop encoder on every corpus flavor
+    (concurrency, crashes, corruption, multi-register, queue)."""
+    import numpy as np
+
+    from jepsen_tpu.synth import generate_mr_history
+
+    rng = random.Random(8888)
+    corpora = [
+        (m.cas_register(0),
+         [_gen(rng, n_procs=p, n_ops=l, crash_p=cp, corrupt=co)
+          for p, l, cp, co in [(3, 20, 0.0, False), (5, 40, 0.1, True),
+                               (8, 60, 0.3, False), (2, 5, 0.0, True)]]),
+        (m.multi_register({k: 0 for k in range(2)}),
+         [generate_mr_history(rng, n_keys=2, n_values=3,
+                              corrupt=(i % 2 == 0)) for i in range(6)]),
+    ]
+    for model, hists in corpora:
+        for h0 in hists:
+            for cap in (8, 32):
+                fast = encode.encode_history(h0, model, slot_cap=cap)
+                slow = encode._encode_history_loop(h0, model, slot_cap=cap)
+                assert (fast is None) == (slow is None)
+                if fast is None:
+                    continue
+                assert fast.init_state == slow.init_state
+                assert fast.n_ops == slow.n_ops
+                assert fast.max_open == slow.max_open
+                for name in ("ev_slot", "cand_slot", "cand_f",
+                             "cand_a", "cand_b"):
+                    assert np.array_equal(
+                        getattr(fast, name), getattr(slow, name)
+                    ), (name, model)
+
+
+def test_encoder_slot_overflow_and_empty():
+    # overflow detection unchanged
+    ops = [invoke_op(i, "write", i) for i in range(40)]
+    assert encode.encode_history(h(*ops), m.register(0), slot_cap=32) is None
+    # an all-invoke (no completion) history encodes to zero events
+    e = encode.encode_history(
+        h(invoke_op(0, "write", 1)), m.register(0)
+    )
+    assert e is not None and e.ev_slot.shape == (0,)
