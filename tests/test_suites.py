@@ -455,3 +455,29 @@ def test_aerospike_set_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+def test_every_suite_workload_assembles():
+    """Every named workload of every suite must assemble into a full
+    runnable test map — catching client-map omissions and workload
+    builders that break under default opts (the assembly-smoke above
+    only exercises each suite's default workload)."""
+    from jepsen_tpu import suites
+
+    checked = 0
+    for name in suites.SUITES:
+        try:
+            mod = suites.suite(name)
+        except (ImportError, ModuleNotFoundError):
+            continue
+        if not hasattr(mod, "workloads"):
+            continue
+        for wname in mod.workloads({"nodes": ["n1", "n2", "n3"]}):
+            t = mod.test({"nodes": ["n1", "n2", "n3"],
+                          "workload": wname, "faults": []})
+            for key in ("db", "client", "generator", "checker"):
+                assert key in t and t[key] is not None, (
+                    f"{name}/{wname} missing {key}"
+                )
+            checked += 1
+    assert checked > 50, f"only {checked} suite workloads enumerated"
