@@ -27,6 +27,99 @@ def _fmt_anomaly_item(item: Any) -> str:
     return json.dumps(item, indent=2, default=repr)
 
 
+def _esc(s: Any) -> str:
+    import html
+
+    return html.escape(str(s), quote=True)
+
+
+#: edge colors per dependency type (write-write, write-read, read-write,
+#: process, realtime) — matching the conventional elle rendering
+_REL_COLORS = {
+    "ww": "#1f6feb", "wr": "#2da44e", "rw": "#cf222e",
+    "process": "#8250df", "realtime": "#bf8700",
+}
+
+
+def cycle_svg(item: dict) -> Optional[str]:
+    """One witness cycle as a standalone SVG: transactions on a circle,
+    directed edges labeled and colored by dependency type — the
+    graphviz-style anomaly rendering the reference ecosystem gets from
+    Elle's plot-analysis, self-rendered like the rest of this
+    framework's graphics (checker/svg.py replaces gnuplot the same
+    way)."""
+    import math
+
+    steps = item.get("steps") or []
+    if not steps:
+        return None
+    nodes = [s.get("from") for s in steps]
+    n = len(nodes)
+    R, pad = 150, 120
+    cx = cy = R + pad
+    size = 2 * (R + pad)
+    pos = {}
+    for i, node in enumerate(nodes):
+        ang = -math.pi / 2 + 2 * math.pi * i / n
+        pos[i] = (cx + R * math.cos(ang), cy + R * math.sin(ang))
+    # one arrowhead marker per edge color (context-stroke would be
+    # neater but isn't supported by Chromium-family viewers)
+    colors_used = sorted(
+        {
+            _REL_COLORS.get((s.get("rels") or [""])[0], "#57606a")
+            for s in steps
+        }
+    )
+    markers = "".join(
+        f'<marker id="arr{c.lstrip("#")}" viewBox="0 0 10 10" refX="9" '
+        'refY="5" markerWidth="7" markerHeight="7" '
+        f'orient="auto-start-reverse">'
+        f'<path d="M 0 0 L 10 5 L 0 10 z" fill="{c}"/></marker>'
+        for c in colors_used
+    )
+    out = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{size}" '
+        f'height="{size}" viewBox="0 0 {size} {size}" '
+        'font-family="monospace" font-size="11">',
+        f"<defs>{markers}</defs>",
+        f'<rect width="{size}" height="{size}" fill="white"/>',
+    ]
+    node_r = 26
+    for i, s in enumerate(steps):
+        j = (i + 1) % n
+        (x1, y1), (x2, y2) = pos[i], pos[j]
+        # shorten the segment so the arrowhead lands on the node rim
+        dx, dy = x2 - x1, y2 - y1
+        d = math.hypot(dx, dy) or 1.0
+        x1s, y1s = x1 + dx / d * node_r, y1 + dy / d * node_r
+        x2s, y2s = x2 - dx / d * (node_r + 4), y2 - dy / d * (node_r + 4)
+        rels = s.get("rels") or []
+        color = _REL_COLORS.get(rels[0] if rels else "", "#57606a")
+        out.append(
+            f'<line x1="{x1s:.1f}" y1="{y1s:.1f}" x2="{x2s:.1f}" '
+            f'y2="{y2s:.1f}" stroke="{color}" stroke-width="1.6" '
+            f'marker-end="url(#arr{color.lstrip("#")})"/>'
+        )
+        mx, my = (x1s + x2s) / 2, (y1s + y2s) / 2
+        out.append(
+            f'<text x="{mx:.1f}" y="{my - 4:.1f}" fill="{color}" '
+            f'text-anchor="middle">{_esc(",".join(rels))}</text>'
+        )
+    for i, node in enumerate(nodes):
+        x, y = pos[i]
+        label = str(node)
+        short = label if len(label) <= 24 else label[:21] + "…"
+        out.append(
+            f'<g><circle cx="{x:.1f}" cy="{y:.1f}" r="{node_r}" '
+            'fill="#f6f8fa" stroke="#57606a"/>'
+            f"<title>{_esc(label)}</title>"
+            f'<text x="{x:.1f}" y="{y + 4:.1f}" text-anchor="middle">'
+            f"{_esc(short)}</text></g>"
+        )
+    out.append("</svg>")
+    return "\n".join(out)
+
+
 def write_anomaly_artifacts(test, result: dict, opts=None) -> None:
     """Persist one explanation file per anomaly type under
     ``<store>/<test>/<time>/elle/`` so the web UI's directory browser
@@ -61,6 +154,21 @@ def write_anomaly_artifacts(test, result: dict, opts=None) -> None:
                     f.write(_fmt_anomaly_item(item))
                     f.write("\n\n")
             paths.append(p)
+            # first witness cycle per type also renders as an SVG next
+            # to the text file (reference ecosystem: elle plot-analysis)
+            for item in items:
+                svg = cycle_svg(item) if isinstance(item, dict) else None
+                if svg:
+                    sp = store_mod.path_(
+                        test,
+                        *(opts or {}).get("subdirectory", []),
+                        "elle",
+                        f"{name}.svg",
+                    )
+                    with open(sp, "w") as f:
+                        f.write(svg)
+                    paths.append(sp)
+                    break
         result["anomaly-files"] = paths
     except Exception as e:  # noqa: BLE001 — never mask the verdict
         result["anomaly-files-error"] = repr(e)

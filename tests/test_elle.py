@@ -856,6 +856,14 @@ def test_elle_checker_writes_anomaly_artifacts(tmp_path):
         assert f"{os.sep}elle{os.sep}" in p
     body = open(files[0]).read()
     assert "Cycle:" in body and "-[" in body
+    # the first witness cycle per anomaly type also renders as an SVG
+    svgs = [p for p in files if p.endswith(".svg")]
+    assert svgs, files
+    svg_body = open(svgs[0]).read()
+    assert svg_body.startswith("<svg") and "marker-end" in svg_body
+    # one node per cycle step, each carrying a full-label tooltip
+    assert svg_body.count("<circle") >= 2
+    assert "<title>" in svg_body
 
     # unit-style checks on bare test maps write nothing
     res2 = ck.check({}, h)
