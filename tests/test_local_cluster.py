@@ -568,6 +568,15 @@ def test_real_replicated_cluster_kill_pause_partition(tmp_path):
             with control.with_session(test, test["remote"]):
                 control.on_nodes(test, nodes, db.teardown)
         finally:
+            # last-resort sweep FIRST (so a proxy-close error can't
+            # skip it), with SIGKILL (a SIGSTOP-paused daemon never
+            # receives a queued SIGTERM): a teardown exception above
+            # must never leak daemons — three leaked election loops
+            # once pinned this box's only core and flaked other tests
+            subprocess.run(
+                ["pkill", "-9", "-f", str(tmp_path / "repreg")],
+                capture_output=True,
+            )
             proxy_net.close()
 
     r = result["results"]
