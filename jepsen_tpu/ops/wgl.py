@@ -456,6 +456,41 @@ def _run_rows(fn, mesh, arrays):
     return fn(*(jnp.asarray(a) for a in arrays))
 
 
+#: largest row count per device dispatch — bounds HBM for huge
+#: keyspaces (a [B, E, C] event tensor grows without limit otherwise);
+#: the flagship bench shape (16384 × 1000-op histories) fits comfortably
+DEFAULT_MAX_DISPATCH = 16384
+
+
+def _run_chunked(fn, mesh, arrays, max_batch=DEFAULT_MAX_DISPATCH):
+    """Dispatch a batch in ≤ max_batch row chunks, concatenating the
+    per-chunk verdicts.  Every full-size chunk reuses one compiled
+    executable; the tail chunk is padded UP to max_batch with neutral
+    all-padding rows (ev_slot = -1) and sliced back, so a 100k-key
+    batch costs exactly one compile, not one per tail size."""
+    B = arrays[0].shape[0]
+    if B <= max_batch:
+        return _run_rows(fn, mesh, arrays)
+    from ..parallel import mesh as mesh_mod
+
+    #: per-array pad fill — ev_slot/cand_slot use -1 as "padding", the
+    #: same convention sharded_check pads with
+    fills = (0, -1, -1, 0, 0, 0)
+    outs = []
+    for lo in range(0, B, max_batch):
+        hi = min(lo + max_batch, B)
+        n = hi - lo
+        chunk = tuple(
+            mesh_mod.pad_to_multiple(np.asarray(a[lo:hi]), max_batch, fill)
+            for a, fill in zip(arrays, fills)
+        )
+        res = _run_rows(fn, mesh, chunk)
+        outs.append(tuple(np.asarray(x)[:n] for x in res))
+    return tuple(
+        np.concatenate([o[i] for o in outs]) for i in range(3)
+    )
+
+
 def check_batch(
     model: m.Model,
     histories: Sequence[History],
@@ -466,6 +501,7 @@ def check_batch(
     escalation=ESCALATION_FACTORS,
     oracle_fallback: bool = True,
     sufficient_rung: bool = True,
+    max_dispatch: int = DEFAULT_MAX_DISPATCH,
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
@@ -480,7 +516,9 @@ def check_batch(
     ``sufficient_rung=False`` to disable device reruns entirely.  With
     ``oracle_fallback=False`` unresolved rows report ``"unknown"``
     instead — for callers (like the race-mode checker) already running
-    the oracle themselves."""
+    the oracle themselves.  Batches larger than ``max_dispatch`` rows
+    run as bounded chunks (one compile total; HBM use stays capped no
+    matter how many keys the independent lift produces)."""
     from ..checker import linear
     from ..platform import ensure_usable_backend
 
@@ -534,7 +572,8 @@ def check_batch(
         # np.array (not asarray): jax outputs are read-only views and the
         # escalation pass writes back into these
         ok, failed_at, overflow = (
-            np.array(x) for x in _run_rows(fn, mesh, arrays)
+            np.array(x)
+            for x in _run_chunked(fn, mesh, arrays, max_dispatch)
         )
 
         capacities = [frontier * factor for factor in escalation]
@@ -578,7 +617,8 @@ def check_batch(
                 else "hash",
             )
             ok2, failed2, ovf2 = (
-                np.asarray(x)[:n_bad] for x in _run_rows(fn2, mesh, sub)
+                np.asarray(x)[:n_bad]
+                for x in _run_chunked(fn2, mesh, sub, max_dispatch)
             )
             ok[bad] = ok2
             failed_at[bad] = failed2

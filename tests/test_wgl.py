@@ -520,3 +520,35 @@ def test_differential_soak_hash_compaction_small_frontiers():
         # test compares against and the assertion would pass vacuously
         assert wgl.batch_stats(outs)["device-rate"] == 1.0, frontier
     assert True in oracle and False in oracle
+
+
+def test_chunked_dispatch_matches_unchunked():
+    """Huge batches dispatch in bounded chunks (HBM cap); verdicts must
+    be identical to the single-dispatch path, with the tail chunk's
+    neutral padding never leaking into results — including under a mesh
+    and through escalation reruns."""
+    rng = random.Random(61)
+    model = m.cas_register(0)
+    hists = [
+        _gen(rng, n_procs=4, n_ops=20, crash_p=0.05, corrupt=(i % 3 == 0))
+        for i in range(23)  # deliberately not a multiple of the chunk
+    ]
+    base = wgl.check_batch(model, hists)
+    small = wgl.check_batch(model, hists, max_dispatch=8)
+    assert [o["valid?"] for o in small] == [o["valid?"] for o in base]
+    assert wgl.batch_stats(small)["device-rate"] == 1.0
+
+    # escalation under chunking: tiny frontier forces reruns
+    esc = wgl.check_batch(
+        model, hists, frontier=2, escalation=(4,), max_closure=7,
+        slot_cap=6, max_dispatch=8,
+    )
+    assert [o["valid?"] for o in esc] == [o["valid?"] for o in base]
+
+    import jax
+
+    from jepsen_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.default_mesh(jax.devices("cpu")[:4])
+    meshed = wgl.check_batch(model, hists, mesh=mesh, max_dispatch=8)
+    assert [o["valid?"] for o in meshed] == [o["valid?"] for o in base]
