@@ -110,3 +110,8 @@ def test_batched_linearizable():
     assert res["results"]["bad"]["valid?"] is False
     assert res["failures"] == ["bad"]
     assert res["valid?"] is False
+    # the engine/kernel breakdown rides the result so keyspace routing
+    # drift is visible in results.json
+    stats = res["batch-stats"]
+    assert stats["engines"].get("tpu") == 2, stats
+    assert stats["device-rate"] == 1.0 and stats["oracle-rate"] == 0.0
