@@ -27,3 +27,21 @@ def _tmp_cwd(tmp_path, monkeypatch):
     """Run every test in a scratch cwd so store writes (the default
     `store/` directory) never land in the repo."""
     monkeypatch.chdir(tmp_path)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _sweep_stray_daemons(tmp_path_factory):
+    """Belt-and-braces: SIGKILL any real-process test daemons that
+    survive THIS session (leaked election loops once pinned this box's
+    single core and flaked later runs).  SIGKILL because a SIGSTOPped
+    stray never receives anything milder; scoped to this session's own
+    basetemp so concurrent checkouts' daemons are untouched."""
+    yield
+    import re
+    import subprocess
+
+    base = re.escape(str(tmp_path_factory.getbasetemp()))
+    subprocess.run(
+        ["pkill", "-9", "-f", rf"{base}/.*(regserverd|repregd)\.py"],
+        capture_output=True,
+    )
