@@ -59,6 +59,16 @@ _accelerator_error: Optional[str] = None
 _probe_lock = _threading.Lock()
 
 
+def forget_probe() -> None:
+    """Drop the memoized probe verdict so the next probe_accelerator
+    call re-probes.  Long-lived watchers need this: the memoization
+    exists so one *test run* shares a verdict, but a process polling
+    for the TPU tunnel to come back must ask fresh every time."""
+    global _accelerator_ok, _accelerator_error
+    with _probe_lock:
+        _accelerator_ok, _accelerator_error = None, None
+
+
 def probe_accelerator(
     retries: Optional[int] = None,
     timeout_s: Optional[float] = None,

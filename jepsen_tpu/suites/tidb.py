@@ -54,13 +54,15 @@ class TiDB(common.DaemonDB):
     def _pd_name(self, test, node) -> str:
         return f"pd{test['nodes'].index(node) + 1}"  # (reference: db.clj:53)
 
-    def start(self, test, node):
-        nodes = test["nodes"]
+    def _pd_endpoints(self, test) -> str:
+        return ",".join(f"{n}:{PD_CLIENT_PORT}" for n in test["nodes"])
+
+    def start_pd(self, test, node):
+        """(reference: db.clj start-pd!)"""
         initial = ",".join(
             f"{self._pd_name(test, n)}=http://{n}:{PD_PEER_PORT}"
-            for n in nodes
+            for n in test["nodes"]
         )
-        pd_endpoints = ",".join(f"{n}:{PD_CLIENT_PORT}" for n in nodes)
         cu.start_daemon(
             {"logfile": self.pd_logfile, "pidfile": self.pd_pidfile,
              "chdir": DIR},
@@ -74,34 +76,140 @@ class TiDB(common.DaemonDB):
             "--initial-cluster", initial,
             "--log-file", f"{DIR}/pd.app.log",
         )
-        cu.await_tcp_port(PD_CLIENT_PORT, timeout_s=120)
+
+    def start_kv(self, test, node):
+        """(reference: db.clj start-kv!)"""
         cu.start_daemon(
             {"logfile": self.kv_logfile, "pidfile": self.kv_pidfile,
              "chdir": DIR},
             f"{DIR}/bin/tikv-server",
-            "--pd", pd_endpoints,
+            "--pd", self._pd_endpoints(test),
             "--addr", f"0.0.0.0:{KV_PORT}",
             "--advertise-addr", f"{node}:{KV_PORT}",
             "--data-dir", f"{DIR}/data/tikv",
             "--log-file", f"{DIR}/tikv.app.log",
         )
-        cu.await_tcp_port(KV_PORT, timeout_s=120)
+
+    def start_db(self, test, node):
+        """(reference: db.clj start-db!)"""
         cu.start_daemon(
             {"logfile": self.logfile, "pidfile": self.pidfile, "chdir": DIR},
             f"{DIR}/bin/tidb-server",
             "--store", "tikv",
-            "--path", pd_endpoints,
+            "--path", self._pd_endpoints(test),
             "-P", str(DB_PORT),
             "--log-file", f"{DIR}/tidb.app.log",
         )
 
+    def stop_pd(self, test, node):
+        cu.stop_daemon(pidfile=self.pd_pidfile, cmd="pd-server")
+
+    def stop_kv(self, test, node):
+        cu.stop_daemon(pidfile=self.kv_pidfile, cmd="tikv-server")
+
+    def stop_db(self, test, node):
+        cu.stop_daemon(pidfile=self.pidfile, cmd="tidb-server")
+
+    # SIGSTOP/SIGCONT per component (reference: nemesis.clj pause-*/
+    # resume-* via cu/signal!)
+    def pause_pd(self, test, node):
+        cu.signal("pd-server", "STOP")
+
+    def pause_kv(self, test, node):
+        cu.signal("tikv-server", "STOP")
+
+    def pause_db(self, test, node):
+        cu.signal("tidb-server", "STOP")
+
+    def resume_pd(self, test, node):
+        cu.signal("pd-server", "CONT")
+
+    def resume_kv(self, test, node):
+        cu.signal("tikv-server", "CONT")
+
+    def resume_db(self, test, node):
+        cu.signal("tidb-server", "CONT")
+
+    def start(self, test, node):
+        self.start_pd(test, node)
+        cu.await_tcp_port(PD_CLIENT_PORT, timeout_s=120)
+        self.start_kv(test, node)
+        cu.await_tcp_port(KV_PORT, timeout_s=120)
+        self.start_db(test, node)
+
     def kill(self, test, node):
-        for pidfile, name in [
-            (self.pidfile, "tidb-server"),
-            (self.kv_pidfile, "tikv-server"),
-            (self.pd_pidfile, "pd-server"),
-        ]:
-            cu.stop_daemon(pidfile=pidfile, cmd=name)
+        self.stop_db(test, node)
+        self.stop_kv(test, node)
+        self.stop_pd(test, node)
+
+    # -- PD control plane (HTTP API + pd-ctl) ------------------------
+    # The reference drives these through pd-ctl and clj-http against
+    # the PD client port (nemesis.clj slow-primary-nemesis,
+    # schedule-nemesis; db.clj pd-members/pd-leader/pd-transfer-leader!).
+
+    def _pd_http(self, node) -> "JsonHttpClient":
+        from .proto.http import JsonHttpClient
+
+        return JsonHttpClient(str(node), PD_CLIENT_PORT, timeout=5.0)
+
+    def _pd_get(self, node, path):
+        """GET a PD API path → parsed body, or "timeout" — nemesis
+        probes must not throw."""
+        c = self._pd_http(node)
+        try:
+            status, body = c.request(
+                "GET", path, ok=(200,), raise_on_error=False,
+            )
+            return body if status == 200 else "timeout"
+        except Exception:  # noqa: BLE001
+            return "timeout"
+        finally:
+            c.close()
+
+    def pd_members(self, test, node):
+        """The PD membership map ({"members": [{"name": ...}, ...]}),
+        or "timeout"."""
+        return self._pd_get(node, "/pd/api/v1/members")
+
+    def pd_leader(self, test, node):
+        """The PD leader member map, or "timeout"."""
+        return self._pd_get(node, "/pd/api/v1/leader")
+
+    def pd_leader_node(self, test, node):
+        """Map the PD leader's member name (pd1, pd2, …) back to its
+        cluster node, or None."""
+        leader = self.pd_leader(test, node)
+        if not isinstance(leader, dict):
+            return None
+        name = leader.get("name")
+        for n in test["nodes"]:
+            if self._pd_name(test, n) == name:
+                return n
+        return None
+
+    def pd_transfer_leader(self, test, node, member_name):
+        """Ask PD to transfer leadership to ``member_name``.  Returns
+        (status, body); (None, error) when PD is unreachable."""
+        c = self._pd_http(node)
+        try:
+            return c.request(
+                "POST", f"/pd/api/v1/leader/transfer/{member_name}",
+                ok=(200,), raise_on_error=False,
+            )
+        except Exception as e:  # noqa: BLE001
+            return None, repr(e)
+        finally:
+            c.close()
+
+    def pd_ctl(self, test, node, *args):
+        """Run one pd-ctl command on ``node`` (reference:
+        nemesis.clj:63-68 — `echo cmds | pd-ctl -d`)."""
+        from ..control import lit
+
+        return execute(
+            "echo", *args, lit("|"), f"{DIR}/bin/pd-ctl", "-d",
+            "-u", f"http://127.0.0.1:{PD_CLIENT_PORT}",
+        )
 
     def await_ready(self, test, node):
         cu.await_tcp_port(DB_PORT, timeout_s=300)
@@ -170,13 +278,27 @@ def _client_for(wname: str, opts: dict):
 
 
 def test(opts: Optional[dict] = None) -> dict:
+    from . import tidb_nemesis
+
     opts = _opts(opts)
     wname = opts.get("workload", "register")
     w = workloads(opts)[wname]
+    database = TiDB(opts)
+    pkg = None
+    faults = set(opts.get("faults", ()))
+    if faults & tidb_nemesis.KNOWN_FAULTS:
+        # suite-specific fault menu (reference: tidb/nemesis.clj via
+        # run.clj); anything the menu doesn't know rides the generic
+        # packages alongside it
+        pkg = common.suite_nemesis_package(
+            opts, database,
+            tidb_nemesis.package(opts, database),
+            tidb_nemesis.KNOWN_FAULTS,
+        )
     return common.build_test(
-        f"tidb-{wname}", opts, db=TiDB(opts),
+        f"tidb-{wname}", opts, db=database,
         client=_client_for(wname, opts),
-        workload=w,
+        workload=w, nemesis_package=pkg,
     )
 
 

@@ -531,3 +531,150 @@ def test_trace_spans_nest_and_export():
     # sampling off: with_trace is a no-op and context is the zero ctx
     with trace.with_trace("ignored"):
         assert trace.context()["trace-id"] == "0" * 32
+
+
+# -- tidb -------------------------------------------------------------------
+
+
+def test_tidb_process_nemesis_targets_components():
+    from jepsen_tpu.suites import tidb, tidb_nemesis
+
+    db = tidb.TiDB({})
+    t = dummy_test(db=db)
+    with sessions(t):
+        nem = tidb_nemesis.TidbProcessNemesis(db).setup(t)
+        for comp in ("pd", "kv", "db"):
+            res = nem.invoke(t, {"type": "info", "f": f"kill-{comp}",
+                                 "value": None})
+            assert res["type"] == "info"
+            assert set(res["value"]) <= set(NODES)
+            # recovery targets every node
+            res = nem.invoke(t, {"type": "info", "f": f"start-{comp}",
+                                 "value": None})
+            assert sorted(res["value"]) == NODES
+            res = nem.invoke(t, {"type": "info", "f": f"pause-{comp}",
+                                 "value": None})
+            assert set(res["value"]) <= set(NODES)
+            res = nem.invoke(t, {"type": "info", "f": f"resume-{comp}",
+                                 "value": None})
+            assert sorted(res["value"]) == NODES
+        # an op :value overrides the targets (nemesis.clj:31-33)
+        res = nem.invoke(t, {"type": "info", "f": "kill-kv",
+                             "value": ["n2"]})
+        assert sorted(res["value"]) == ["n2"]
+
+
+def test_tidb_schedule_nemesis_runs_pd_ctl():
+    from jepsen_tpu.suites import tidb, tidb_nemesis
+
+    db = tidb.TiDB({})
+    t = dummy_test(db=db)
+    with sessions(t):
+        nem = tidb_nemesis.ScheduleNemesis(db).setup(t)
+        res = nem.invoke(t, {"type": "info", "f": "shuffle-leader",
+                             "value": None})
+        assert res["type"] == "info"
+        assert list(res["value"].values()) == ["ok"]
+        res = nem.invoke(t, {"type": "info", "f": "del-random-merge",
+                             "value": None})
+        assert list(res["value"].values()) == ["ok"]
+
+
+def test_tidb_slow_primary_fails_gracefully_without_pd():
+    from jepsen_tpu.suites import tidb, tidb_nemesis
+
+    db = tidb.TiDB({})
+    t = dummy_test(db=db)
+    with sessions(t):
+        nem = tidb_nemesis.SlowPrimaryNemesis(db).setup(t)
+        res = nem.invoke(t, {"type": "info", "f": "slow-primary",
+                             "value": None})
+        # PD is unreachable on dummy nodes: recorded, never raised
+        assert res["type"] == "info"
+        assert res["value"] == "failed"
+        assert res["error"] == "pd-members-unreachable"
+
+
+def test_tidb_generators_expand_and_recover():
+    from jepsen_tpu.suites import tidb_nemesis
+
+    n = tidb_nemesis.expand_options(
+        {"kill": True, "pause": True, "schedules": True,
+         "partition": True, "clock-skew": True, "interval": 1})
+    assert n["kill-pd"] and n["pause-kv"] and n["random-merge"]
+    assert n["partition-pd-leader"]
+    g = tidb_nemesis.mixed_generator(n)
+    assert g is not None
+    final = tidb_nemesis.final_generator(n)
+    fs = [op["f"] for op in final]
+    # pauses resume before kills restart; partition heals; schedulers drop
+    assert "resume-pd" in fs and "start-kv" in fs
+    assert "stop-partition" in fs and "del-shuffle-leader" in fs
+    assert fs.index("resume-pd") < fs.index("start-pd")
+
+    # pd-leader partition generator falls back when PD is dead
+    op = tidb_nemesis.partition_pd_leader_gen(dummy_test(), {})
+    assert op["f"] == "start-partition"
+    assert op["partition_type"] == "pd-leader"
+    grudge = op["value"]
+    assert set(grudge) == set(NODES)
+    # one loner cut from four followers
+    sizes = sorted(len(v) for v in grudge.values())
+    assert sizes == [1, 1, 1, 1, 4]
+
+
+def _drain_fs(g, t, n_ops, step_ns=int(10e9)):
+    """Draw up to n_ops op f's from g, jumping virtual time past
+    pending waits (sleep phases)."""
+    ctx = gen.context({"concurrency": 1, "nodes": NODES})
+    fs = []
+    guard = 0
+    while len(fs) < n_ops and guard < 10_000:
+        guard += 1
+        res = gen.op(g, t, ctx)
+        if res is None:
+            break
+        o, g = res
+        if o != gen.PENDING and isinstance(o, dict) and o.get("f"):
+            fs.append(o["f"])
+        ctx = {**ctx, "time": ctx["time"] + step_ns}
+    return fs
+
+
+def test_tidb_special_schedules():
+    from jepsen_tpu.suites import tidb_nemesis
+
+    t = dummy_test()
+    # restart-kv-without-pd: kill all kv, pause all pd, start kv,
+    # wait, resume pd — in that order
+    g = tidb_nemesis.full_generator({"restart-kv-without-pd": True})
+    fs = _drain_fs(g, t, 4)
+    assert fs == ["kill-kv", "pause-pd", "start-kv", "resume-pd"], fs
+
+    # slow-primary: alternates slow-primary and partition heals forever
+    g = tidb_nemesis.full_generator({"slow-primary": True})
+    fs = _drain_fs(g, t, 4)
+    assert fs == ["slow-primary", "stop-partition"] * 2, fs
+
+
+def test_tidb_suite_test_uses_fault_menu():
+    from jepsen_tpu.suites import tidb, tidb_nemesis
+
+    t = tidb.test({
+        "nodes": list(NODES),
+        "faults": ["kill-kv", "partition-pd-leader", "clock-skew"],
+        "time-limit": 5,
+    })
+    assert t["name"] == "tidb-register"
+    fs = t["nemesis"].fs()
+    assert "kill-kv" in fs and "start-partition" in fs
+    assert "bump-clock" in fs and "shuffle-leader" in fs
+
+    # a generic-only fault composes the leftover package alongside
+    t = tidb.test({
+        "nodes": list(NODES),
+        "faults": ["kill-kv", "disk"],
+        "time-limit": 5,
+    })
+    fs = t["nemesis"].fs()
+    assert "kill-kv" in fs and "break-disk" in fs
