@@ -59,14 +59,19 @@ SCHEDULE_FS = frozenset({
     "random-merge", "del-random-merge",
 })
 
-#: pd-ctl scheduler names per f (reference: nemesis.clj:74-85)
+#: pd-ctl scheduler commands per f (reference: nemesis.clj:74-85 —
+#: the reference pipes `sched add …`, but pd-ctl's actual command
+#: table spells it `scheduler`; `sched` is rejected, which the
+#: reference's own swallow-the-error handler hides)
 _SCHEDULERS = {
-    "shuffle-leader": ("sched", "add", "shuffle-leader-scheduler"),
-    "del-shuffle-leader": ("sched", "remove", "shuffle-leader-scheduler"),
-    "shuffle-region": ("sched", "add", "shuffle-region-scheduler"),
-    "del-shuffle-region": ("sched", "remove", "shuffle-region-scheduler"),
-    "random-merge": ("sched", "add", "random-merge-scheduler"),
-    "del-random-merge": ("sched", "remove", "random-merge-scheduler"),
+    "shuffle-leader": ("scheduler", "add", "shuffle-leader-scheduler"),
+    "del-shuffle-leader":
+        ("scheduler", "remove", "shuffle-leader-scheduler"),
+    "shuffle-region": ("scheduler", "add", "shuffle-region-scheduler"),
+    "del-shuffle-region":
+        ("scheduler", "remove", "shuffle-region-scheduler"),
+    "random-merge": ("scheduler", "add", "random-merge-scheduler"),
+    "del-random-merge": ("scheduler", "remove", "random-merge-scheduler"),
 }
 
 

@@ -678,3 +678,123 @@ def test_tidb_suite_test_uses_fault_menu():
     })
     fs = t["nemesis"].fs()
     assert "kill-kv" in fs and "break-disk" in fs
+
+
+# -- cockroachdb ------------------------------------------------------------
+
+
+def test_crdb_named_bundles_compose_with_tagged_ops():
+    from jepsen_tpu.suites import cockroachdb, crdb_nemesis
+
+    db = cockroachdb.CockroachDB({})
+    pkg = crdb_nemesis.package(
+        {"nemesis": ["parts", "start-kill-2"]}, db
+    )
+    assert pkg["name"] == "parts+startkill2"
+    t = dummy_test(db=db)
+    with sessions(t):
+        nem = pkg["nemesis"].setup(t)
+        # tagged routing: (name, inner-f) reaches the named client
+        res = nem.invoke(t, {"type": "info",
+                             "f": ("parts", "start"), "value": None})
+        assert res["f"] == ("parts", "start")
+        res = nem.invoke(t, {"type": "info",
+                             "f": ("parts", "stop"), "value": None})
+        assert res["value"] == "network-healed"
+        res = nem.invoke(t, {"type": "info",
+                             "f": ("startkill2", "start"), "value": None})
+        assert res["f"][0] == "startkill2"
+        # two nodes killed
+        assert len(res["value"]) == 2
+        res = nem.invoke(t, {"type": "info",
+                             "f": ("startkill2", "stop"), "value": None})
+        assert len(res["value"]) == 2
+        # untagged / unknown names are hard errors, not silent no-ops
+        with pytest.raises(ValueError):
+            nem.invoke(t, {"type": "info", "f": "start", "value": None})
+        with pytest.raises(ValueError):
+            nem.invoke(t, {"type": "info", "f": ("nope", "start"),
+                           "value": None})
+
+
+def test_crdb_schedules_tag_and_interleave():
+    from jepsen_tpu.suites import crdb_nemesis
+
+    pkg = crdb_nemesis.package({"nemesis": "parts"}, None)
+    t = dummy_test()
+    fs = _drain_fs(pkg["generator"], t, 4)
+    assert fs == [("parts", "start"), ("parts", "stop")] * 2, fs
+    # final stops every bundle
+    finals = crdb_nemesis.package(
+        {"nemesis": ["parts", "small-skews"]}, None
+    )["final_generator"]
+    fin_fs = _drain_fs(finals, t, 10)
+    assert ("parts", "stop") in fin_fs and ("small-skews", "stop") in fin_fs
+
+
+def test_crdb_skew_ladder_and_restarting_wrapper():
+    from jepsen_tpu.suites import cockroachdb, crdb_nemesis
+
+    db = cockroachdb.CockroachDB({})
+    assert crdb_nemesis.small_skews(db)["clocks"] is True
+    assert crdb_nemesis.huge_skews(db)["name"] == "huge-skews"
+    # big/huge skews pair the bump with a netem slowdown wrapper
+    assert isinstance(crdb_nemesis.big_skews(db)["client"],
+                      crdb_nemesis.Slowing)
+
+    t = dummy_test(db=db)
+    with sessions(t):
+        nem = crdb_nemesis.Restarting(
+            crdb_nemesis.BumpTime(0.25), db).setup(t)
+        res = nem.invoke(t, {"type": "info", "f": "stop", "value": None})
+        # after stop, every node's DB got a restart attempt
+        clock_value, restarts = res["value"]
+        assert sorted(restarts) == NODES
+
+
+def test_crdb_split_nemesis_keyrange_paths():
+    from jepsen_tpu.suites import crdb_nemesis
+
+    nem = crdb_nemesis.SplitNemesis({})
+    nem.client = None  # no live cluster: probe path degrades cleanly
+    res = nem.invoke({"nodes": NODES}, {"type": "info", "f": "split",
+                                        "value": None})
+    assert res["value"] == "no-keyrange"
+    res = nem.invoke({"nodes": NODES, "keyrange": {}},
+                     {"type": "info", "f": "split", "value": None})
+    assert res["value"] == "nothing-to-split"
+
+
+def test_crdb_suite_test_wires_menu():
+    from jepsen_tpu.suites import cockroachdb
+
+    t = cockroachdb.test({
+        "nodes": list(NODES), "nemesis": "parts", "time-limit": 5,
+    })
+    assert t["name"] == "cockroachdb-register-parts"
+    assert ("parts", "start") in t["nemesis"].fs()
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        cockroachdb.test({"nodes": list(NODES), "nemesis": "bogus"})
+
+
+def test_crdb_double_schedule_interleaves_two_bundles():
+    from jepsen_tpu.suites import cockroachdb, crdb_nemesis
+
+    db = cockroachdb.CockroachDB({})
+    pkg = crdb_nemesis.package(
+        {"nemesis": ["parts", "start-stop"],
+         "nemesis-schedule": "double"}, db)
+    assert pkg["name"] == "parts~startstop"
+    t = dummy_test(db=db)
+    fs = _drain_fs(pkg["generator"], t, 8, step_ns=int(3e9))
+    # instance windows overlap and alternate which leads
+    assert fs[:4] == [("parts", "start"), ("startstop", "start"),
+                      ("parts", "stop"), ("startstop", "stop")], fs
+    assert fs[4:6] == [("startstop", "start"), ("parts", "start")], fs
+    fin = _drain_fs(pkg["final_generator"], t, 4)
+    assert fin == [("parts", "stop"), ("startstop", "stop")]
+
+    with pytest.raises(ValueError):
+        crdb_nemesis.package(
+            {"nemesis": ["parts"], "nemesis-schedule": "double"}, db)
