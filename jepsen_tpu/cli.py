@@ -118,6 +118,10 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
         "nodes": nodes,
         "time-limit": args.time_limit,
         "store-base": args.store_base,
+        # CLI runs always persist (the reference's `lein run test`
+        # writes store/<name>/<time>/ unconditionally); suite modules
+        # default store? off only for library/in-process use
+        "store?": True,
         "leave-db-running?": args.leave_db_running,
         "logging-json?": args.logging_json,
         "ssh": {

@@ -244,17 +244,29 @@ def set_workload(opts: Optional[dict] = None) -> dict:
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
+    from . import aerospike_pause
+
     opts = dict(opts or {})
     return {
         "cas-register": common.register_workload(opts),
         "counter": common.counter_workload(opts),
         "set": set_workload(opts),
+        # pause-to-lose-writes state machine (reference:
+        # aerospike/pause.clj; test() assembles the full shared-state
+        # client+nemesis wiring via pause_test)
+        "pause": aerospike_pause.pause_workload(opts),
     }
 
 
 def test(opts: Optional[dict] = None) -> dict:
+    from . import aerospike_pause
+
     opts = dict(opts or {})
     wname = opts.get("workload", "cas-register")
+    if wname == "pause":
+        # the pause workload wires client+nemesis+generators through a
+        # shared state machine; it assembles its own test map
+        return aerospike_pause.pause_test(opts)
     w = workloads(opts)[wname]
     c = {
         "counter": CounterClient,

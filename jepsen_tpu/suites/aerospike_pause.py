@@ -1,0 +1,406 @@
+"""Aerospike pause workload: pause a master to trap in-flight writes,
+promote a new master, then resume the old one so it commits the trapped
+writes with a stale view — lost updates a set read exposes.
+
+Reference: aerospike/src/aerospike/pause.clj — a state machine SHARED
+by client, nemesis, and generator cycling healthy → pausing → paused →
+wait (:165-208 docstring), with healthy-delay 5 s / pause-delay 30 s /
+masters-limit 1 (:17-26), three pause modes (:40-82): ``process``
+(SIGSTOP/SIGCONT asd), ``net`` (a self-healing netem delay daemon —
+raising latency would sever our own SSH, so a nohup'd shell undoes it),
+and ``clock`` (bump the clock far ahead and isolate the node so it
+commits locally with future timestamps; resume resets clocks, heals,
+and restarts the others); blind string-append writes per key block
+checked as independent sets (:104-160, :209-233).
+
+Deviation: the reference's generator blocks in Thread/sleep while
+deciding (:145-171).  This framework's scheduler is single-threaded
+and generators must never block, so state deadlines are virtual-time
+timestamps and the generator returns PENDING until they pass — same
+schedule, no blocked scheduler.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .. import checker as checker_mod
+from .. import client as client_mod
+from .. import control
+from .. import generator as gen
+from .. import independent
+from .. import net as net_mod
+from ..control import execute, lit, su
+from ..generator import PENDING, Generator
+from ..nemesis import Nemesis
+from ..nemesis import time as nt
+
+HEALTHY_DELAY_MS = 5_000   # (reference: pause.clj:17-19)
+PAUSE_DELAY_MS = 30_000    # (reference: pause.clj:21-23)
+MASTERS_LIMIT = 1          # (reference: pause.clj:25-26)
+
+
+class PauseState:
+    """The shared machine.  The nemesis moves healthy→pausing→paused
+    and wait→healthy; the first successful client add during paused
+    moves paused→wait (the write that proves a new master got
+    promoted).  Deadlines are owned by the generator (virtual time)."""
+
+    def __init__(self, test: dict, opts: Optional[dict] = None,
+                 rng=None):
+        opts = opts or {}
+        self.lock = threading.Lock()
+        self.rng = rng if rng is not None else gen.rng
+        self.mode = opts.get("pause-mode", "process")
+        self.healthy_delay_ms = opts.get(
+            "healthy-delay", HEALTHY_DELAY_MS)
+        self.pause_delay_ms = opts.get("pause-delay", PAUSE_DELAY_MS)
+        self.masters_limit = opts.get("masters-limit", MASTERS_LIMIT)
+        self.state = "wait"
+        self.masters: list = []
+        self.keys: list = []
+        self.next_key = 0
+        self.deadline_ns: Optional[int] = None
+        self.next_healthy(test)
+
+    def next_healthy(self, test):
+        """Pick a new master set and a fresh key block
+        (reference: pause.clj:28-37 next-healthy)."""
+        with self.lock:
+            nodes = list(test["nodes"])
+            self.rng.shuffle(nodes)
+            self.state = "healthy"
+            self.masters = nodes[: self.masters_limit]
+            n = len(nodes) or 1
+            per = max(1, test.get("concurrency", n) // n)
+            self.keys = list(range(self.next_key, self.next_key + per))
+            self.next_key += per
+            self.deadline_ns = None
+
+    def note(self, state: str):
+        with self.lock:
+            self.state = state
+            self.deadline_ns = None
+
+    def add_succeeded(self):
+        """paused → wait on the first post-pause ack
+        (reference: pause.clj:128-133)."""
+        with self.lock:
+            if self.state == "paused":
+                self.state = "wait"
+                self.deadline_ns = None
+
+
+def pause_node(state: PauseState, test, node):
+    """(reference: pause.clj:39-69 pause!)"""
+    mode = state.mode
+    if mode == "process":
+        with su():
+            execute("killall", "-19", "asd")
+    elif mode == "net":
+        # self-healing: raising latency would sever our own control
+        # connection, so a detached shell restores it after the delay
+        secs = int(state.pause_delay_ms / 1000) + 1
+        with su():
+            execute(
+                "nohup", "bash", "-c",
+                f"tc qdisc add dev eth0 root netem delay "
+                f"{state.pause_delay_ms}ms 1ms distribution normal; "
+                f"sleep {secs}; tc qdisc del dev eth0 root",
+                lit("&"),
+            )
+    elif mode == "clock":
+        nt.bump_time(1000 * state.pause_delay_ms)
+    else:
+        raise ValueError(f"unknown pause-mode {mode!r}")
+    return "paused"
+
+
+def resume_node(state: PauseState, test, node):
+    """(reference: pause.clj:71-82 resume!)"""
+    mode = state.mode
+    if mode == "process":
+        with su():
+            execute("killall", "-18", "asd")
+    elif mode == "clock":
+        nt.reset_time()
+    return "resumed"
+
+
+class PauseNemesis(Nemesis):
+    """Applies pause/resume to the op's nodes and advances the state
+    machine (reference: pause.clj:84-102).  Clock mode adds the
+    isolation partition on pause and heal + restart-the-others on
+    resume (pause.clj:58-69,76-82)."""
+
+    def __init__(self, state: PauseState, db=None):
+        self.state = state
+        self.db = db
+
+    def setup(self, test):
+        if self.state.mode == "clock":
+            control.on_nodes(test, list(test["nodes"]),
+                             lambda t, n: nt.reset_time())
+        return self
+
+    def invoke(self, test, op):
+        state = self.state
+        targets = list(op.get("value") or state.masters)
+        others = [n for n in test["nodes"] if n not in targets]
+        if op["f"] == "pause":
+            res = control.on_nodes(
+                test, targets,
+                lambda t, n: pause_node(state, t, n))
+            if state.mode == "clock":
+                # snub both directions so far-future commits stay local
+                grudge = {t: set(others) for t in targets}
+                for o in others:
+                    grudge[o] = set(targets)
+                net_mod.drop_all(test, grudge)
+            state.note("paused")
+        elif op["f"] == "resume":
+            res = control.on_nodes(
+                test, targets,
+                lambda t, n: resume_node(state, t, n))
+            if state.mode == "clock":
+                net_mod.heal(test)
+                if self.db is not None:
+                    control.on_nodes(
+                        test, others,
+                        lambda t, n: self.db.start(t, n))
+            state.next_healthy(test)
+        else:
+            raise ValueError(f"unknown f {op['f']!r}")
+        return {**op, "type": "info",
+                "value": {str(k): str(v) for k, v in res.items()}}
+
+    def teardown(self, test):
+        pass
+
+    def fs(self):
+        return frozenset({"pause", "resume"})
+
+
+class PauseNemGen(Generator):
+    """Nemesis schedule from the state machine: healthy → (after
+    healthy-delay) pause the masters; wait → (after pause-delay, or
+    immediately in clock mode) resume them (reference: pause.clj
+    :144-163, nemesis branch)."""
+
+    def __init__(self, state: PauseState):
+        self.state = state
+
+    def op(self, test, ctx):
+        s = self.state
+        now = ctx["time"]
+        with s.lock:
+            if s.state == "healthy":
+                if s.deadline_ns is None:
+                    s.deadline_ns = now + s.healthy_delay_ms * 1_000_000
+                if now < s.deadline_ns:
+                    return (PENDING, self)
+                return (
+                    gen.fill_in_op(
+                        {"type": "info", "f": "pause",
+                         "value": list(s.masters)}, ctx),
+                    self,
+                )
+            if s.state == "wait":
+                if s.deadline_ns is None:
+                    delay = 0 if s.mode == "clock" else s.pause_delay_ms
+                    s.deadline_ns = now + delay * 1_000_000
+                if now < s.deadline_ns:
+                    return (PENDING, self)
+                return (
+                    gen.fill_in_op(
+                        {"type": "info", "f": "resume",
+                         "value": list(s.masters)}, ctx),
+                    self,
+                )
+            # pausing/paused: the nemesis op is in flight or clients
+            # are racing toward the first post-pause ack
+            return (PENDING, self)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+class PauseClientGen(Generator):
+    """Client schedule: blind adds against the current key block,
+    ceasing entirely during wait (reference: pause.clj:158-163)."""
+
+    def __init__(self, state: PauseState):
+        self.state = state
+        self.counter = 0
+        self.rr = 0
+
+    def op(self, test, ctx):
+        s = self.state
+        with s.lock:
+            if s.state == "wait" or not s.keys:
+                return (PENDING, self)
+            self.rr += 1
+            k = s.keys[self.rr % len(s.keys)]
+        v = self.counter
+        self.counter += 1
+        return (
+            gen.fill_in_op(
+                {"type": "invoke", "f": "add",
+                 "value": independent.kv(k, v)}, ctx),
+            self,
+        )
+
+    def update(self, test, ctx, event):
+        return self
+
+
+class FinalReadGen(Generator):
+    """One read per key ever written, built lazily at final-phase time
+    (the key range isn't known until the run ends — the reference
+    defers this with gen/derefer + delay, pause.clj:215-223)."""
+
+    def __init__(self, state: PauseState):
+        self.state = state
+        self.inner = None
+        self.built = False
+
+    def _build(self):
+        with self.state.lock:
+            n_keys = self.state.next_key
+        return [
+            {"type": "invoke", "f": "read",
+             "value": independent.kv(k, None)}
+            for k in range(n_keys)
+        ]
+
+    def op(self, test, ctx):
+        if not self.built:
+            self.inner = self._build()
+            self.built = True
+        if self.inner is None:
+            return None
+        res = gen.op(self.inner, test, ctx)
+        if res is None:
+            return None
+        o, g2 = res
+        self.inner = g2
+        return (o, self)
+
+    def update(self, test, ctx, event):
+        return self
+
+
+class PauseClient(client_mod.Client):
+    """Blind string-appends + set reads on the "pause" set, flipping
+    the machine paused→wait on the first successful add (reference:
+    pause.clj:104-141)."""
+
+    SET = "pause"
+    BIN = "value"
+
+    def __init__(self, state: PauseState, opts: Optional[dict] = None):
+        self.state = state
+        self.opts = opts or {}
+        self.conn = None
+
+    def open(self, test, node):
+        from .aerospike import PORT, NAMESPACE
+        from .proto.aerospike import AerospikeClient
+
+        c = type(self)(self.state, self.opts)
+        c.conn = AerospikeClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", PORT),
+            namespace=self.opts.get("namespace", NAMESPACE),
+            timeout=self.opts.get("timeout", 5.0),
+        )
+        return c
+
+    def invoke(self, test, op):
+        from .proto import IndeterminateError
+        from .proto.aerospike import AerospikeError
+
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                bins, _gen = self.conn.get(self.SET, int(k))
+                raw = str((bins or {}).get(self.BIN, ""))
+                vals = sorted(
+                    int(x) for x in raw.split(" ") if x.strip())
+                return {**op, "type": "ok",
+                        "value": independent.kv(k, vals)}
+            if op["f"] == "add":
+                self.conn.append_str(self.SET, int(k), self.BIN,
+                                     f" {int(v)}")
+                self.state.add_succeeded()
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except AerospikeError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
+def pause_workload(opts: Optional[dict] = None) -> dict:
+    """The client-side workload pieces over a fresh, PRIVATE state
+    machine — real runs need pause_test, which wires one SHARED
+    machine through client + nemesis + generators; this entry only
+    satisfies the workloads() registry shape (a private rng keeps
+    registry enumeration from perturbing the seeded module rng other
+    workloads reproduce from)."""
+    import random as _random
+
+    opts = dict(opts or {})
+    state = PauseState(
+        {"nodes": list(opts.get("nodes", [])),
+         "concurrency": opts.get("concurrency", 5)}, opts,
+        rng=_random.Random(0))
+    return {
+        "generator": PauseClientGen(state),
+        "final-generator": gen.clients(FinalReadGen(state)),
+        "checker": independent.checker(checker_mod.set_checker()),
+    }
+
+
+def pause_test(opts: Optional[dict] = None) -> dict:
+    """The assembled test: shared state machine wiring client gen,
+    nemesis gen, final resume, and per-key set checking (reference:
+    pause.clj:162-233 workload+nemesis)."""
+    from . import common
+    from .aerospike import AerospikeDB
+
+    opts = dict(opts or {})
+    seed_test = {"nodes": list(opts.get("nodes", [])),
+                 "concurrency": opts.get("concurrency", 5)}
+    state = PauseState(seed_test, opts)
+    database = opts.get("db") or AerospikeDB(opts)
+
+    pkg = {
+        "nemesis": PauseNemesis(state, database),
+        "generator": PauseNemGen(state),
+        # resume everyone, then let the cluster settle (reference
+        # :225-233)
+        "final_generator": [
+            gen.once(lambda test, ctx: {
+                "type": "info", "f": "resume",
+                "value": list(test["nodes"])}),
+            gen.sleep(opts.get("final-settle", 10)),
+        ],
+        "perf": {("pause", frozenset({"pause"}),
+                  frozenset({"resume"}), "#A0B1E9")},
+    }
+    workload = {
+        "generator": PauseClientGen(state),
+        "final-generator": gen.clients(FinalReadGen(state)),
+        "checker": independent.checker(checker_mod.set_checker()),
+    }
+    return common.build_test(
+        "aerospike-pause", opts, db=database,
+        client=PauseClient(state, opts),
+        workload=workload, nemesis_package=pkg,
+    )

@@ -131,11 +131,15 @@ def test(opts: Optional[dict] = None) -> dict:
     w = workloads(opts)[wname]
     database = CockroachDB(opts)
     pkg = None
+    name = f"cockroachdb-{wname}"
     if opts.get("nemesis"):
         # the named-bundle menu (reference: cockroach/nemesis.clj via
         # runner.clj --nemesis/--nemesis2); generic opts["faults"]
         # still rides build_test's default path when unset
         pkg = crdb_nemesis.package(opts, database)
+        # the suffix comes from the menu package — compose_packages
+        # below strips non-standard keys like "name"
+        name = f"{name}-{pkg['name']}"
         if opts.get("faults"):
             # the menu consumes opts["nemesis"] only — every entry in
             # opts["faults"] is a leftover for the generic packages
@@ -144,9 +148,6 @@ def test(opts: Optional[dict] = None) -> dict:
             pkg = common.suite_nemesis_package(
                 opts, database, pkg, set()
             )
-    name = f"cockroachdb-{wname}"
-    if pkg is not None and pkg.get("name"):
-        name = f"{name}-{pkg['name']}"
     return common.build_test(
         name, opts, db=database,
         client=_client_for(wname, opts), workload=w,

@@ -260,15 +260,31 @@ class BumpTime(Nemesis):
 # ---------------------------------------------------------------------
 
 
+#: (table, key column) the split probe targets per workload — the
+#: shared SQL clients' schemas (suites/sql.py)
+SPLIT_TABLES = {
+    "register": ("registers", "id"),
+    "bank": ("accounts", "id"),
+    "set": ("sets", "val"),
+    "sets": ("sets", "val"),
+    "list-append": ("lists", "id"),
+}
+
+
 class SplitNemesis(Nemesis):
-    """Perform ``ALTER TABLE … SPLIT AT`` just below the most recently
-    written key.  Key sources, in order: the test's ``keyrange`` map
-    ({table: set-of-keys}, maintained by cockroach clients exactly as
-    the reference's atom is), else a live ``SELECT max`` probe on the
-    register table.  Splitting a key twice is recorded, not raised."""
+    """Perform ``ALTER TABLE … SPLIT AT`` at the most recently written
+    key.  Key sources, in order: an optional test-supplied ``keyrange``
+    map ({table: set-of-keys} — the shape of the reference's atom,
+    cockroach clients there maintain it); else a live ``SELECT max``
+    probe on the running workload's table (SPLIT_TABLES maps
+    opts["workload"] to its schema).  Splitting a key twice is
+    recorded, not raised."""
 
     def __init__(self, opts: Optional[dict] = None):
         self.opts = dict(opts or {})
+        self.table, self.column = SPLIT_TABLES.get(
+            self.opts.get("workload", "register"), ("registers", "id")
+        )
         self.already: dict = {}
         self.client = None
 
@@ -304,7 +320,7 @@ class SplitNemesis(Nemesis):
             return None, "no-keyrange"
         try:
             res = self.client.conn.query(
-                "SELECT max(id) FROM registers"
+                f"SELECT max({self.column}) FROM {self.table}"
             )
             k = res.rows[0][0] if res.rows else None
         except Exception:  # noqa: BLE001
@@ -312,9 +328,9 @@ class SplitNemesis(Nemesis):
         if k is None:
             return None, "nothing-to-split"
         k = int(k)
-        if k in self.already.get("registers", set()):
+        if k in self.already.get(self.table, set()):
             return None, "nothing-to-split"
-        return ("registers", k), None
+        return (self.table, k), None
 
     def invoke(self, test, op):
         picked, why = self._pick_key(test)
@@ -548,7 +564,7 @@ def compose_double(bundles: List[dict]) -> dict:
                                   for b in bundles}),
         "generator": _f_map_ops(fmap, sched["during"]),
         "final_generator": _f_map_ops(fmap, sched["final"]),
-        "clocks": builtins_any(b.get("clocks") for b in bundles),
+        "clocks": any(b.get("clocks") for b in bundles),
         "perf": set(),
     }
 
@@ -568,16 +584,9 @@ def compose_named(bundles: List[dict]) -> dict:
         "nemesis": TaggedCompose({b["name"]: b["client"] for b in bundles}),
         "generator": gen.mix(durings) if durings else None,
         "final_generator": finals or None,
-        "clocks": builtins_any(b.get("clocks") for b in bundles),
+        "clocks": any(b.get("clocks") for b in bundles),
         "perf": set(),
     }
-
-
-def builtins_any(it):
-    for x in it:
-        if x:
-            return True
-    return False
 
 
 def package(opts: dict, db) -> dict:

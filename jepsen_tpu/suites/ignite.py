@@ -161,6 +161,105 @@ class IgniteClient(client_mod.Client):
             self.conn.close()
 
 
+class IgniteBankClient(client_mod.Client):
+    """Bank transfers with the reference's atomicity through a single
+    CAS'd cache entry.
+
+    The reference's bank workload (ignite/bank.clj:19-130) runs
+    READ_COMMITTED..SERIALIZABLE cache transactions over n=10 accounts
+    seeded with 100 each and checks every read for wrong-n /
+    wrong-total / negative balances.  The REST API exposes no
+    transactions, so all balances live in ONE serialized entry and a
+    transfer is a compareAndSet of the whole vector — the same
+    atomic-multi-account semantics, checked by the same invariants
+    (workloads/bank.py mirrors the reference's bank-checker)."""
+
+    CACHE = "ACCOUNTS"  # (reference: bank.clj:22 cache-name)
+    KEY = "balances"
+    CAS_RETRIES = 8
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[JsonHttpClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = JsonHttpClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("port", REST_PORT),
+            timeout=10.0,
+        )
+        return c
+
+    def _cmd(self, params: dict):
+        params = {"cacheName": self.CACHE, **params}
+        _, body = self.conn.get("/ignite", params=params, ok=(200,))
+        if isinstance(body, dict):
+            if body.get("successStatus", 0) != 0:
+                raise HttpError(200, body.get("error"))
+            return body.get("response")
+        return body
+
+    @staticmethod
+    def _decode(raw) -> Optional[dict]:
+        if raw in (None, ""):
+            return None
+        return {
+            int(k): int(v)
+            for k, v in (kv.split(":") for kv in str(raw).split(","))
+        }
+
+    @staticmethod
+    def _encode(balances: dict) -> str:
+        return ",".join(f"{k}:{v}" for k, v in sorted(balances.items()))
+
+    def setup(self, test):
+        accounts = test.get("accounts", list(range(8)))
+        total = test.get("total-amount", 80)
+        per = total // len(accounts)
+        init = {a: per for a in accounts}
+        init[accounts[0]] += total - per * len(accounts)
+        # putIfAbsent: first client in seeds, the rest see it
+        self._cmd({"cmd": "add", "key": self.KEY,
+                   "val": self._encode(init)})
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                return {**op, "type": "ok",
+                        "value": self._decode(
+                            self._cmd({"cmd": "get", "key": self.KEY}))}
+            if op["f"] == "transfer":
+                t = op["value"]
+                for _ in range(self.CAS_RETRIES):
+                    raw = self._cmd({"cmd": "get", "key": self.KEY})
+                    balances = self._decode(raw)
+                    if balances is None:
+                        return {**op, "type": "fail", "error": "no-bank"}
+                    if balances[t["from"]] < t["amount"]:
+                        # the reference's transactions abort overdrafts
+                        return {**op, "type": "fail",
+                                "error": "insufficient-funds"}
+                    balances[t["from"]] -= t["amount"]
+                    balances[t["to"]] += t["amount"]
+                    ok = self._cmd({
+                        "cmd": "cas", "key": self.KEY,
+                        "val1": self._encode(balances), "val2": str(raw),
+                    })
+                    if ok in (True, "true"):
+                        return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-contention"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return {**op, "type": "info", "error": str(e)}
+        except HttpError as e:
+            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+
 def db(opts: Optional[dict] = None):
     return IgniteDB(opts)
 
@@ -170,16 +269,24 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    # the reference's bank workload runs over Ignite transactions,
-    # which the REST API doesn't expose; register covers the CAS path
-    return {"register": common.register_workload(dict(opts or {}))}
+    from ..workloads import bank
+
+    opts = dict(opts or {})
+    return {
+        "register": common.register_workload(opts),
+        # reference: ignite/bank.clj (single-entry CAS redesign — see
+        # IgniteBankClient)
+        "bank": bank.test(opts),
+    }
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "register")
     w = workloads(opts)[wname]
+    cl = (IgniteBankClient(opts) if wname == "bank"
+          else IgniteClient(opts))
     return common.build_test(
-        f"ignite-{wname}", opts, db=IgniteDB(opts), client=IgniteClient(opts),
+        f"ignite-{wname}", opts, db=IgniteDB(opts), client=cl,
         workload=w,
     )

@@ -188,13 +188,19 @@ def client(opts: Optional[dict] = None):
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
-    return {"register": common.register_workload(dict(opts or {}))}
+    w = common.register_workload(dict(opts or {}))
+    # the reference names this probe document-cas (rethinkdb/
+    # document_cas.clj:1-185: per-document CAS registers under
+    # write_acks/read_mode combinations); both names resolve so
+    # reference users find it
+    return {"register": w, "document-cas": w}
 
 
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
-    w = workloads(opts)["register"]
+    wname = opts.get("workload", "register")
+    w = workloads(opts)[wname]
     return common.build_test(
-        "rethinkdb-register", opts, db=RethinkDB(opts),
+        f"rethinkdb-{wname}", opts, db=RethinkDB(opts),
         client=RethinkCasClient(opts), workload=w,
     )

@@ -362,6 +362,11 @@ def workloads(opts: Optional[dict] = None) -> dict:
         "bank", {**opts, "negative-balances?": True}
     )
     out["ycql.long-fork"] = common.generic_workload("long-fork", opts)
+    # list-append with one table per key (reference: ysql/append_table
+    # .clj); the txn checker is the shared elle list-append checker
+    out["ysql.append-table"] = common.generic_workload(
+        "list-append", _ysql_opts(opts)
+    )
     out["ysql.multi-key-acid"] = multi_key_acid_workload(opts)
     out["ycql.multi-key-acid"] = multi_key_acid_workload(opts)
     out["ysql.default-value"] = default_value_workload(opts)
@@ -390,6 +395,8 @@ def _client_for(wname: str, opts: dict) -> client_mod.Client:
         return MultiKeyAcidClient(_ysql_opts(opts))
     if w == "default-value":
         return DefaultValueClient(_ysql_opts(opts))
+    if w == "append-table":
+        return AppendTableClient(_ysql_opts(opts))
     if w == "single-key-acid":
         w = "register"
     return sql.client_for(w, _ysql_opts(opts))
@@ -860,3 +867,119 @@ def default_value_workload(opts: Optional[dict] = None) -> dict:
         "generator": gen_mod.stagger(1 / 100, gen_mod.mix(mix)),
         "checker": DefaultValueChecker(),
     }
+
+
+# ---------------------------------------------------------------------
+# ysql.append-table: one TABLE per list key (reference:
+# yugabyte/src/yugabyte/ysql/append_table.clj)
+# ---------------------------------------------------------------------
+
+
+class AppendTableClient(sql._Base):
+    """List-append where each key is its own table and rows are the
+    list elements: append = INSERT, read = SELECT ordered by the key
+    column.  Tables are created lazily when an op hits
+    "relation does not exist" — YB can't CREATE IF NOT EXISTS safely,
+    so the reference swallows already-exists races the same way
+    (append_table.clj:76-120 create-table!/with-table).
+
+    The reference documents that YB offers no safe transactional row
+    order (append_table.clj:10-16) and ships NOW()-keyed inserts
+    (insert!, :44-60) plus a COUNT(*)-keyed variant
+    (insert-using-count!, :34-42); ``append-table-key`` picks
+    ("now"/"count", default "now")."""
+
+    dialect = "pg"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.key_mode = self.opts.get("append-table-key", "now")
+
+    @staticmethod
+    def _table(k) -> str:
+        return f"append{int(k)}"
+
+    def _create(self, table: str):
+        # straight through conn.query, NOT _exec_ddl: that helper
+        # swallows every DDL error, which would hide real CREATE
+        # failures; only the already-exists race is benign here
+        ddl = (
+            f"CREATE TABLE IF NOT EXISTS {table} "
+            "(k TIMESTAMP DEFAULT CURRENT_TIMESTAMP, v INT)"
+            if self.key_mode == "now" else
+            f"CREATE TABLE IF NOT EXISTS {table} (k INT, v INT)"
+        )
+        try:
+            self.conn.query(ddl)
+        except (sql.PgError, sql.MysqlError) as e:
+            if "already exists" not in str(e):
+                raise
+
+    def _mop(self, f, k, v):
+        table = self._table(k)
+        if f == "r":
+            res = self.conn.query(
+                f"SELECT k, v FROM {table} ORDER BY k"
+            )
+            return ["r", k, [int(row[-1]) for row in res.rows]]
+        if f == "append":
+            if self.key_mode == "count":
+                n = int(self.conn.query(
+                    f"SELECT count(*) FROM {table}").rows[0][0])
+                self.conn.query(
+                    f"INSERT INTO {table} (k, v) "
+                    f"VALUES ({n}, {int(v)})"
+                )
+            else:
+                self.conn.query(
+                    f"INSERT INTO {table} (v) VALUES ({int(v)})"
+                )
+            return ["append", k, v]
+        raise ValueError(f"unknown micro-op {f!r}")
+
+    @staticmethod
+    def _missing_table(e) -> bool:
+        msg = str(e)
+        return ("does not exist" in msg or "no such table" in msg
+                or "doesn't exist" in msg)
+
+    def _run_txn(self, txn):
+        """One attempt: BEGIN/COMMIT around multi-statement work like
+        the reference's with-txn (append_table.clj:131-140; count-mode
+        appends are two statements even alone)."""
+        use_txn = len(txn) > 1 or (
+            self.key_mode == "count"
+            and any(f == "append" for f, _k, _v in txn)
+        )
+        if not use_txn:
+            return [self._mop(f, k, v) for f, k, v in txn]
+        self.conn.query("BEGIN")
+        try:
+            out = [self._mop(f, k, v) for f, k, v in txn]
+            self.conn.query("COMMIT")
+            return out
+        except Exception:
+            try:
+                self.conn.query("ROLLBACK")
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+
+    def invoke(self, test, op):
+        txn = op["value"]
+        try:
+            try:
+                out = self._run_txn(txn)
+            except (sql.PgError, sql.MysqlError) as e:
+                if not self._missing_table(e):
+                    raise
+                # lazily create every table the txn touches (outside
+                # the aborted txn), then retry once
+                for _f, k, _v in txn:
+                    self._create(self._table(k))
+                out = self._run_txn(txn)
+            return {**op, "type": "ok", "value": out}
+        except sql.IndeterminateError as e:
+            return self._info(op, e)
+        except (sql.PgError, sql.MysqlError) as e:
+            return self._fail(op, e)

@@ -2601,3 +2601,62 @@ class _EsHandler(BaseHTTPRequestHandler):
 
 class FakeEs(FakeServer):
     handler_class = _EsHandler
+
+
+# ---------------------------------------------------------------------------
+# Ignite REST API fake: /ignite?cmd=get|put|add|cas over per-cache maps
+# ---------------------------------------------------------------------------
+
+
+class _IgniteHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path != "/ignite":
+            self._send({"error": f"no route {url.path}"}, 400)
+            return
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        cmd = q.get("cmd")
+        cache_name = q.get("cacheName", "default")
+        with self.fake_store.lock:
+            cache = self.fake_store.kv.setdefault(cache_name, {})
+            key = q.get("key")
+            if cmd == "get":
+                resp = cache.get(key)
+            elif cmd == "put":
+                cache[key] = q.get("val")
+                resp = True
+            elif cmd == "add":  # putIfAbsent
+                if key in cache:
+                    resp = False
+                else:
+                    cache[key] = q.get("val")
+                    resp = True
+            elif cmd == "cas":  # set val1 if current == val2
+                if cache.get(key) == q.get("val2"):
+                    cache[key] = q.get("val1")
+                    resp = True
+                else:
+                    resp = False
+            else:
+                self._send(
+                    {"successStatus": 1, "error": f"bad cmd {cmd}"}
+                )
+                return
+        self._send({"successStatus": 0, "response": resp})
+
+
+class FakeIgnite(FakeServer):
+    handler_class = _IgniteHandler

@@ -2179,3 +2179,203 @@ def test_zk_lock_full_test_in_process():
         )
     finally:
         s.stop()
+
+
+# -- ignite bank ------------------------------------------------------------
+
+
+def test_ignite_bank_client_roundtrip():
+    from fake_servers import FakeIgnite
+
+    from jepsen_tpu.suites import ignite
+
+    s = FakeIgnite().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port}
+        t = {"accounts": [0, 1, 2, 3], "total-amount": 40}
+        c = ignite.IgniteBankClient(opts).open(t, "n1")
+        c.setup(t)
+        r = c.invoke(t, {"f": "read", "type": "invoke", "value": None})
+        assert r["type"] == "ok"
+        assert r["value"] == {0: 10, 1: 10, 2: 10, 3: 10}
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 3, "amount": 7}})
+        assert r["type"] == "ok"
+        r = c.invoke(t, {"f": "read", "type": "invoke", "value": None})
+        assert r["value"] == {0: 3, 1: 10, 2: 10, 3: 17}
+        assert sum(r["value"].values()) == 40
+        # overdrafts abort like the reference's transactions
+        r = c.invoke(t, {"f": "transfer", "type": "invoke",
+                         "value": {"from": 0, "to": 1, "amount": 9}})
+        assert r["type"] == "fail" and r["error"] == "insufficient-funds"
+        # second client sees the same bank (putIfAbsent init)
+        c2 = ignite.IgniteBankClient(opts).open(t, "n2")
+        c2.setup(t)
+        r = c2.invoke(t, {"f": "read", "type": "invoke", "value": None})
+        assert sum(r["value"].values()) == 40
+        c.close(t)
+        c2.close(t)
+    finally:
+        s.stop()
+
+
+def test_ignite_bank_full_test_in_process():
+    from fake_servers import FakeIgnite
+
+    from jepsen_tpu.suites import ignite
+
+    s = FakeIgnite().start()
+    try:
+        t = ignite.test({
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1",
+            "port": s.port,
+            "workload": "bank",
+            "time-limit": 2,
+            "rate": 50,
+            "faults": [],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+        reads = [o for o in result["history"]
+                 if o["type"] == "ok" and o["f"] == "read"
+                 and isinstance(o["process"], int)]
+        assert reads and all(
+            sum(r["value"].values()) == t["total-amount"] for r in reads
+        )
+    finally:
+        s.stop()
+
+
+# -- aerospike pause --------------------------------------------------------
+
+
+def test_aerospike_pause_state_machine_schedules():
+    from jepsen_tpu import generator as g
+    from jepsen_tpu.suites import aerospike_pause as ap
+
+    t = {"nodes": ["n1", "n2", "n3"], "concurrency": 6}
+    state = ap.PauseState(t, {"healthy-delay": 100, "pause-delay": 200})
+    assert state.state == "healthy"
+    assert len(state.masters) == 1
+    assert state.keys == [0, 1]
+
+    nem_gen = ap.PauseNemGen(state)
+    client_gen = ap.PauseClientGen(state)
+    ctx = g.context({"concurrency": 2, "nodes": t["nodes"]})
+
+    # clients write immediately; nemesis waits out the healthy delay
+    op, _ = client_gen.op(t, ctx)
+    assert op["f"] == "add"
+    k, v = op["value"]
+    assert k in state.keys and v == 0
+    res, _ = nem_gen.op(t, ctx)
+    assert res == g.PENDING
+    ctx2 = {**ctx, "time": ctx["time"] + int(0.2 * 1e9)}
+    op, _ = nem_gen.op(t, ctx2)
+    assert op["f"] == "pause" and op["value"] == state.masters
+
+    # paused: nemesis pends; first acked add flips to wait
+    state.note("paused")
+    assert nem_gen.op(t, ctx2)[0] == g.PENDING
+    state.add_succeeded()
+    assert state.state == "wait"
+    # wait: clients cease; nemesis resumes after the pause delay
+    assert client_gen.op(t, ctx2)[0] == g.PENDING
+    assert nem_gen.op(t, ctx2)[0] == g.PENDING
+    ctx3 = {**ctx2, "time": ctx2["time"] + int(0.4 * 1e9)}
+    op, _ = nem_gen.op(t, ctx3)
+    assert op["f"] == "resume"
+
+    # resume → next healthy block: fresh masters + fresh keys
+    state.next_healthy(t)
+    assert state.state == "healthy"
+    assert state.keys == [2, 3]
+
+
+def test_aerospike_pause_full_run_in_process():
+    from fake_servers import FakeAerospike
+
+    from jepsen_tpu.suites import aerospike_pause as ap
+
+    s = FakeAerospike().start()
+    try:
+        t = ap.pause_test({
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1", "port": s.port,
+            "concurrency": 3,
+            "healthy-delay": 200, "pause-delay": 300,
+            "final-settle": 0.2,
+            "time-limit": 3,
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        hist = result["history"]
+        nem_fs = [o["f"] for o in hist
+                  if o["process"] == "nemesis" and o["type"] == "info"]
+        # the machine cycled: pauses and resumes both fired
+        assert "pause" in nem_fs and "resume" in nem_fs, nem_fs
+        reads = [o for o in hist if o["type"] == "ok"
+                 and o["f"] == "read"]
+        assert reads, "final read phase never ran"
+        # nothing was actually paused (fake server): no lost writes
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+# -- yugabyte ysql.append-table ---------------------------------------------
+
+
+def test_yb_append_table_client_roundtrip():
+    from fake_servers import FakePg
+
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "user": "yugabyte",
+                "append-table-key": "count"}
+        c = yugabyte.AppendTableClient(opts).open({"nodes": ["n1"]}, "n1")
+        # lazy creation: the first txn hits a missing table and retries
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["append", 7, 1], ["r", 7, None]]})
+        assert r["type"] == "ok", r
+        assert r["value"] == [["append", 7, 1], ["r", 7, [1]]]
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["append", 7, 2], ["append", 7, 3],
+                                    ["r", 7, None]]})
+        assert r["value"][-1] == ["r", 7, [1, 2, 3]]
+        # distinct keys live in distinct tables
+        r = c.invoke({}, {"f": "txn", "type": "invoke",
+                          "value": [["r", 8, None]]})
+        assert r["value"] == [["r", 8, []]]
+        c.close({})
+    finally:
+        s.stop()
+
+
+def test_yb_append_table_full_test_in_process():
+    from fake_servers import FakePg
+
+    from jepsen_tpu.suites import yugabyte
+
+    s = FakePg().start()
+    try:
+        t = yugabyte.test({
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1", "port": s.port, "user": "yugabyte",
+            "append-table-key": "count",
+            "workload": "ysql.append-table",
+            "time-limit": 2, "rate": 30, "concurrency": 2,
+            "faults": [],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
