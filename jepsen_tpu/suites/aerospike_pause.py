@@ -140,8 +140,14 @@ class PauseNemesis(Nemesis):
 
     def setup(self, test):
         if self.state.mode == "clock":
-            control.on_nodes(test, list(test["nodes"]),
-                             lambda t, n: nt.reset_time())
+            # compile the bump/strobe tools on every node first —
+            # pause_node's nt.bump_time executes them (the reference
+            # runs nt/install! in its nemesis setup, pause.clj:86-89)
+            def prep(t, n):
+                nt.install()
+                nt.reset_time()
+
+            control.on_nodes(test, list(test["nodes"]), prep)
         return self
 
     def invoke(self, test, op):

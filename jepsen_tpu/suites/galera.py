@@ -106,14 +106,27 @@ WORKLOADS = ("set", "bank", "register")
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
+    from . import dirty_reads_sql
+
     opts = _opts(opts)
-    return {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    out = {w: common.generic_workload(w, opts) for w in WORKLOADS}
+    # the suite's signature probe (reference: galera/
+    # dirty_reads.clj): failed writers' values must never be read
+    out["dirty-reads"] = dirty_reads_sql.workload(opts)
+    return out
 
 
 def test(opts: Optional[dict] = None) -> dict:
+    from . import dirty_reads_sql
+
     opts = _opts(opts)
     wname = opts.get("workload", "bank")
     w = workloads(opts)[wname]
+    if wname == "dirty-reads":
+        return common.build_test(
+            f"galera-{wname}", opts, db=db(opts),
+            client=dirty_reads_sql.DirtyReadsClient(opts), workload=w,
+        )
     return common.build_test(
         f"galera-{wname}", opts, db=GaleraDB(opts),
         client=sql.client_for(wname, opts), workload=w,

@@ -214,8 +214,11 @@ class IgniteBankClient(client_mod.Client):
         return ",".join(f"{k}:{v}" for k, v in sorted(balances.items()))
 
     def setup(self, test):
+        # fallbacks mirror the bank workload's defaults
+        # (workloads/bank.py test(): accounts range(8), total 100) so
+        # a direct-use client seeds what the checker expects
         accounts = test.get("accounts", list(range(8)))
-        total = test.get("total-amount", 80)
+        total = test.get("total-amount", 100)
         per = total // len(accounts)
         init = {a: per for a in accounts}
         init[accounts[0]] += total - per * len(accounts)

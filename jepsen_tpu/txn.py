@@ -71,3 +71,39 @@ def writes_of_key(txn: Iterable[Mop], key: Any) -> List[Any]:
 def op_mops(op) -> List[Tuple[Any, Mop]]:
     """[(op, mop)] pairs for a history op whose value is a txn."""
     return [(op, mop) for mop in (op.value or [])]
+
+
+# ---------------------------------------------------------------------
+# Micro-op accessors (reference: txn/src/jepsen/txn/micro_op.clj:1-35)
+# ---------------------------------------------------------------------
+
+
+def mop_f(mop: Mop) -> Any:
+    """The function a micro-op executes."""
+    return mop[0]
+
+
+def mop_key(mop: Mop) -> Any:
+    """The key a micro-op affects."""
+    return mop[1]
+
+
+def mop_value(mop: Mop) -> Any:
+    """The value a micro-op used."""
+    return mop[2]
+
+
+def is_read(mop: Mop) -> bool:
+    return mop_f(mop) == R
+
+
+def is_write(mop: Mop) -> bool:
+    return mop_f(mop) == W
+
+
+def is_mop(mop: Any) -> bool:
+    """Is this a legal [f k v] micro-op?"""
+    try:
+        return len(mop) == 3 and mop_f(mop) in (R, W)
+    except TypeError:
+        return False

@@ -2379,3 +2379,69 @@ def test_yb_append_table_full_test_in_process():
         assert result["results"]["valid?"] is True, result["results"]
     finally:
         s.stop()
+
+
+# -- galera / percona dirty-reads -------------------------------------------
+
+
+def test_dirty_reads_checker_flags_filthy_and_inconsistent():
+    from jepsen_tpu.suites.dirty_reads_sql import DirtyReadsChecker
+
+    c = DirtyReadsChecker()
+    res = c.check({}, h(invoke_op(0, "read"),
+                        ok_op(0, "read", [3, 3, 3])))
+    assert res["valid?"] is True
+
+    # a failed write's value visible → dirty read
+    res = c.check({}, h(
+        invoke_op(0, "write", 7),
+        fail_op(0, "write", 7),
+        invoke_op(1, "read"),
+        ok_op(1, "read", [7, 7, 7]),
+    ))
+    assert res["valid?"] is False and res["dirty-reads"]
+
+    # rows disagree → inconsistent (recorded, not invalid by itself)
+    res = c.check({}, h(
+        invoke_op(0, "read"),
+        ok_op(0, "read", [1, 2, 1]),
+    ))
+    assert res["valid?"] is True and res["inconsistent-reads"]
+
+
+def test_galera_dirty_reads_full_test_in_process():
+    from fake_servers import FakeMysql
+
+    from jepsen_tpu.suites import galera
+
+    s = FakeMysql().start()
+    try:
+        t = galera.test({
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1", "port": s.port, "user": "root",
+            "password": "pw",
+            "workload": "dirty-reads",
+            "time-limit": 2, "rate": 40, "concurrency": 4,
+            "faults": [],
+        })
+        t["db"] = db_mod.noop()
+        t["ssh"] = {"dummy?": True}
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+        writes = [o for o in result["history"]
+                  if o["type"] == "ok" and o["f"] == "write"]
+        reads = [o for o in result["history"]
+                 if o["type"] == "ok" and o["f"] == "read"]
+        assert writes and reads
+    finally:
+        s.stop()
+
+
+def test_percona_dirty_reads_assembles():
+    from jepsen_tpu.suites import percona
+    from jepsen_tpu.suites.dirty_reads_sql import DirtyReadsClient
+
+    t = percona.test({"nodes": ["n1"], "workload": "dirty-reads",
+                      "faults": []})
+    assert t["name"] == "percona-dirty-reads"
+    assert isinstance(t["client"], DirtyReadsClient)

@@ -32,3 +32,19 @@ def test_key_views():
     t = [("r", "x", 1), ("w", "x", 2), ("append", "x", 3)]
     assert txn.reads_of_key(t, "x") == [1]
     assert txn.writes_of_key(t, "x") == [2, 3]
+
+
+def test_micro_op_accessors():
+    """(reference: txn/src/jepsen/txn/micro_op.clj:1-35)"""
+    from jepsen_tpu import txn
+
+    mop = ["r", 5, None]
+    assert txn.mop_f(mop) == "r"
+    assert txn.mop_key(mop) == 5
+    assert txn.mop_value(mop) is None
+    assert txn.is_read(mop) and not txn.is_write(mop)
+    assert txn.is_write(["w", 1, 2])
+    assert txn.is_mop(["w", 1, 2])
+    assert not txn.is_mop(["w", 1])          # wrong arity
+    assert not txn.is_mop(["append", 1, 2])  # not r/w
+    assert not txn.is_mop(42)                # not a sequence
