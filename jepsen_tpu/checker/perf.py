@@ -40,12 +40,13 @@ def nemesis_regions(test: dict, history: History) -> List[svg.Region]:
             fs_start=spec.get("start", ("start",)),
             fs_stop=spec.get("stop", ("stop",)),
         )
+        color = spec.get("color") or palette[i % len(palette)]
         for start, stop in ivals:
             regions.append(
                 svg.Region(
                     nanos_to_secs(start.time),
                     nanos_to_secs(stop.time) if stop is not None else end_time,
-                    color=palette[i % len(palette)],
+                    color=color,
                     opacity=0.15,
                     label=str(spec.get("name", "")),
                 )

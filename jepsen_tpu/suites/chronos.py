@@ -95,10 +95,56 @@ class ChronosDB(common.DaemonDB):
         with control.su():
             control.execute("mkdir", "-p", JOB_DIR)
 
+    #: masters run on the first MASTER_COUNT nodes (reference:
+    #: mesosphere.clj:17 master-count, :60-67 start-master!)
+    MASTER_COUNT = 3
+
+    def zk_uri(self, test) -> str:
+        """(reference: mesosphere.clj:38-46 zk-uri)"""
+        hosts = ",".join(f"{n}:2181" for n in test["nodes"])
+        return f"zk://{hosts}/mesos"
+
+    def configure(self, test, node):
+        """Mesos + chronos read the zk ensemble URI and master quorum
+        from config files (reference: mesosphere.clj:48-57
+        configure!).  The stock Debian zookeeper starts standalone, so
+        the ensemble itself must be configured too (zoo.cfg server
+        list + per-node myid) or the masters would elect leaders in
+        disjoint ZK namespaces."""
+        nodes = list(test["nodes"])
+        masters = min(self.MASTER_COUNT, len(nodes))
+        ensemble = "".join(
+            f"server.{i + 1}={n}:2888:3888\n"
+            for i, n in enumerate(nodes)
+        )
+        with control.su():
+            cu.write_file(
+                "tickTime=2000\ninitLimit=10\nsyncLimit=5\n"
+                "dataDir=/var/lib/zookeeper\nclientPort=2181\n"
+                + ensemble,
+                "/etc/zookeeper/conf/zoo.cfg",
+            )
+            control.execute("mkdir", "-p", "/var/lib/zookeeper")
+            cu.write_file(f"{nodes.index(node) + 1}\n",
+                          "/var/lib/zookeeper/myid")
+            cu.write_file(self.zk_uri(test) + "\n", "/etc/mesos/zk")
+            cu.write_file(f"{masters // 2 + 1}\n",
+                          "/etc/mesos-master/quorum")
+
+    def master_nodes(self, test):
+        return sorted(test["nodes"])[: self.MASTER_COUNT]
+
     def setup(self, test, node):
         self.install(test, node)
+        self.configure(test, node)
+        services = ["zookeeper"]
+        # masters only on the first master-count sorted nodes
+        # (reference: mesosphere.clj:60-67); every node runs an agent
+        if node in self.master_nodes(test):
+            services.append("mesos-master")
+        services += ["mesos-slave", "chronos"]
         with control.su():
-            for svc in ("zookeeper", "mesos-master", "mesos-slave", "chronos"):
+            for svc in services:
                 control.execute("service", svc, "start", check=False)
         cu.await_tcp_port(PORT, timeout_s=120)
 

@@ -409,6 +409,11 @@ def build_test(
             "workload": checker,
             "stats": checker_mod.stats(),
             "exceptions": checker_mod.unhandled_exceptions(),
+            # latency/rate SVGs with fault-window shading — the
+            # reference's runners compose (checker/perf) into every
+            # run (e.g. cockroach/runner.clj, galera dirty_reads.clj
+            # :117-120)
+            "perf": checker_mod.perf_checker(),
         }
     )
 
@@ -427,6 +432,20 @@ def build_test(
             pkg_opts["partition"] = {"targets": opts["partition-targets"]}
         pkg = combined.nemesis_package(pkg_opts)
     test["nemesis"] = pkg.get("nemesis") or test["nemesis"]
+
+    # Fault-window shading for the latency/rate plots: the package's
+    # perf entries (name, start-fs, stop-fs, color) become the plot
+    # specs checker.perf.nemesis_regions consumes (reference:
+    # nemesis/combined.clj perf sets feeding checker/perf.clj:240-283)
+    perf_specs = [
+        {"name": n, "start": tuple(starts), "stop": tuple(stops),
+         "color": color}
+        for (n, starts, stops, color) in sorted(
+            pkg.get("perf") or (), key=lambda e: str(e[0])
+        )
+    ]
+    if perf_specs:
+        test.setdefault("plot", {})["nemeses"] = perf_specs
 
     # Generator: rate-staggered client ops raced with the nemesis
     # schedule, bounded by time-limit, then nemesis final + workload

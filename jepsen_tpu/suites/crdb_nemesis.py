@@ -534,6 +534,21 @@ def _tag(name: str, g):
     return gen.map(retag, g)
 
 
+#: shading colors for named bundles, cycled by position
+_PERF_COLORS = ("#E9A4A0", "#A0B1E9", "#A0E9DB", "#E9D3A0", "#C9A0E9")
+
+
+def _bundle_perf(bundles):
+    """One plot-shading spec per bundle: its (name, start/stop) tagged
+    fs and a stable color."""
+    return {
+        (b["name"], frozenset({(b["name"], "start")}),
+         frozenset({(b["name"], "stop")}),
+         _PERF_COLORS[i % len(_PERF_COLORS)])
+        for i, b in enumerate(bundles)
+    }
+
+
 def _f_map_ops(fmap: dict, g):
     """f_map that leaves special (sleep/log) ops untouched."""
     if g is None:
@@ -565,7 +580,7 @@ def compose_double(bundles: List[dict]) -> dict:
         "generator": _f_map_ops(fmap, sched["during"]),
         "final_generator": _f_map_ops(fmap, sched["final"]),
         "clocks": any(b.get("clocks") for b in bundles),
-        "perf": set(),
+        "perf": _bundle_perf(bundles),
     }
 
 
@@ -585,7 +600,7 @@ def compose_named(bundles: List[dict]) -> dict:
         "generator": gen.mix(durings) if durings else None,
         "final_generator": finals or None,
         "clocks": any(b.get("clocks") for b in bundles),
-        "perf": set(),
+        "perf": _bundle_perf(bundles),
     }
 
 

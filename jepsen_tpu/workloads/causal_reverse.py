@@ -86,10 +86,11 @@ def workload(opts: Optional[dict] = None) -> dict:
             gen.stagger(1 / 100, gen.mix([reads, writes])),
         )
 
+    # no perf checker here: build_test composes one into every suite
+    # run; a second instance would race the same SVG paths
     return {
         "checker": checker_mod.compose(
             {
-                "perf": checker_mod.perf_checker(),
                 "sequential": independent.checker(checker()),
             }
         ),

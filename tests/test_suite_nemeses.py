@@ -798,3 +798,42 @@ def test_crdb_double_schedule_interleaves_two_bundles():
     with pytest.raises(ValueError):
         crdb_nemesis.package(
             {"nemesis": ["parts"], "nemesis-schedule": "double"}, db)
+
+
+def test_package_perf_specs_reach_plot_regions():
+    """Fault-window shading: a package's perf entries must land in
+    test["plot"]["nemeses"] and produce colored regions (the perf sets
+    were previously built by every package and consumed by nothing)."""
+    from jepsen_tpu.checker.perf import nemesis_regions
+    from jepsen_tpu.history import History, info_op
+    from jepsen_tpu.suites import tidb
+
+    t = tidb.test({
+        "nodes": list(NODES), "faults": ["kill-kv"], "time-limit": 5,
+    })
+    specs = t["plot"]["nemeses"]
+    kill = next(s for s in specs if s["name"] == "kill")
+    assert "kill-kv" in kill["start"] and "start-kv" in kill["stop"]
+    assert kill["color"]
+
+    hist = History([
+        info_op("nemesis", "kill-kv", None),
+        info_op("nemesis", "start-kv", None),
+        info_op("nemesis", "other", None),
+    ])
+    for i, op in enumerate(hist):
+        op.index = i
+        op.time = int(i * 1e9)
+    regions = nemesis_regions(t, hist)
+    assert [r.label for r in regions].count("kill") == 1
+    kill_region = next(r for r in regions if r.label == "kill")
+    assert kill_region.color == kill["color"]
+
+    # cockroach named bundles shade per bundle with tagged fs
+    from jepsen_tpu.suites import cockroachdb
+    t2 = cockroachdb.test({
+        "nodes": list(NODES), "nemesis": ["parts", "start-stop"],
+        "time-limit": 5,
+    })
+    names = {s["name"] for s in t2["plot"]["nemeses"]}
+    assert names == {"parts", "startstop"}

@@ -481,3 +481,22 @@ def test_every_suite_workload_assembles():
                 )
             checked += 1
     assert checked > 50, f"only {checked} suite workloads enumerated"
+
+
+def test_chronos_mesos_cluster_config():
+    """Masters run on the first master-count sorted nodes; mesos reads
+    the zk ensemble + quorum from config files (reference:
+    chronos/src/jepsen/mesosphere.clj:17,38-57,60-67)."""
+    from jepsen_tpu import control
+    from jepsen_tpu.control.core import DummyRemote
+    from jepsen_tpu.suites import chronos
+
+    nodes = ["n5", "n1", "n3", "n2", "n4"]
+    t = {"nodes": nodes, "remote": DummyRemote(), "ssh": {"dummy?": True}}
+    db = chronos.ChronosDB({})
+    assert db.master_nodes(t) == ["n1", "n2", "n3"]
+    assert db.zk_uri(t) == (
+        "zk://n5:2181,n1:2181,n3:2181,n2:2181,n4:2181/mesos"
+    )
+    with control.with_session(t, t["remote"]):
+        control.on_nodes(t, nodes, db.configure)  # dummy: must not raise
