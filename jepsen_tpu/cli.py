@@ -101,6 +101,12 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--store-base", default="store", help="artifact directory")
     p.add_argument(
+        "--tracing",
+        help="enable span tracing and export finished spans to this "
+        "JSONL file (suites wrap client/nemesis calls in spans; "
+        "reference: dgraph --tracing URL)",
+    )
+    p.add_argument(
         "--mesh",
         dest="mesh_sharding",  # "mesh" is the test-map key for the
         action="store_true",   # built Mesh object itself
@@ -132,6 +138,8 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
     }
     if args.concurrency is not None:
         test["concurrency"] = parse_concurrency(args.concurrency, len(nodes))
+    if getattr(args, "tracing", None):
+        test["tracing"] = args.tracing
     if getattr(args, "mesh_sharding", False):
         # build lazily at analyze time: probing the backend here would
         # hang a wedged tunnel before the test even starts, and the
@@ -436,7 +444,9 @@ def default_commands() -> Dict[str, dict]:
         # explicit --concurrency still wins
         if "concurrency" in wl and "concurrency" not in opts:
             test["concurrency"] = wl["concurrency"]
-        return test
+        from . import trace
+
+        return trace.wire(test, opts.get("tracing"))
 
     cmds: Dict[str, dict] = {}
     cmds.update(single_test_cmd(make_test, add_workload_opt))

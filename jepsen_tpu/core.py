@@ -212,6 +212,18 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
     storing = test.get("store?", True)
 
+    # span tracing turns on for the run — not at test-build time, so
+    # building several test maps can't cross-wire each other's
+    # exporters through the process-global tracer — and off again
+    # after it, so later runs in the same process don't inherit a
+    # stale exporter (trace.wire stores the endpoint; the reference
+    # configures its tracer once per run, dgraph/core.clj:118)
+    tracing_endpoint = test.get("tracing")
+    if tracing_endpoint:
+        from . import trace
+
+        trace.tracing(tracing_endpoint)
+
     if storing:
         store_mod.start_logging(test, test.get("logging-json?", False))
     try:
@@ -226,6 +238,8 @@ def run(test: dict) -> dict:
                 test = store_mod.save_2(test)
             return log_results(test)
     finally:
+        if tracing_endpoint:
+            trace.tracing()
         if storing:
             store_mod.stop_logging(test)
 

@@ -464,4 +464,11 @@ def build_test(
     if workload.get("final-generator") is not None:
         parts.append(workload["final-generator"])
     test["generator"] = gen.phases(*parts) if len(parts) > 1 else body
-    return test
+
+    # --tracing ENDPOINT: span every client call, exported to the
+    # endpoint (a JSONL spans file; reference: dgraph/core.clj:118,175
+    # builds its tracer from the --tracing URL and client.clj wraps
+    # each client call in a span)
+    from .. import trace
+
+    return trace.wire(test, opts.get("tracing"))

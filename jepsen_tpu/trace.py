@@ -164,3 +164,65 @@ def attribute(k: Any, v: Any) -> None:
     stack = _span_stack()
     if stack:
         stack[-1].attributes[str(k)] = str(v)
+
+
+class Traced:
+    """Client decorator wrapping every protocol call in a span.
+
+    The reference traces each dgraph client function body individually
+    (dgraph/client.clj:55-377 wraps open!/close!/mutate/query/... in
+    with-trace).  One wrapper at the Client-protocol seam covers every
+    client flavor of a suite instead, and tags invoke spans with the
+    op's :f (and key, when the value is an independent [k v] tuple)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def open(self, test, node):
+        with with_trace("client.open"):
+            attribute("node", node)
+            opened = self.client.open(test, node)
+        return Traced(opened) if opened is not self.client else self
+
+    def setup(self, test):
+        with with_trace("client.setup"):
+            return self.client.setup(test)
+
+    def invoke(self, test, op):
+        with with_trace("client.invoke"):
+            attribute("f", op.get("f"))
+            v = op.get("value")
+            # tag independent [k v] pairs only — a 2-micro-op txn is
+            # also a 2-element sequence, but its head is a micro-op
+            # list, not a scalar key
+            if (
+                isinstance(v, (list, tuple))
+                and len(v) == 2
+                and not isinstance(v[0], (list, tuple, dict))
+            ):
+                attribute("key", v[0])
+            return self.client.invoke(test, op)
+
+    def teardown(self, test):
+        with with_trace("client.teardown"):
+            return self.client.teardown(test)
+
+    def close(self, test):
+        with with_trace("client.close"):
+            return self.client.close(test)
+
+    def reusable(self, test):
+        inner = getattr(self.client, "reusable", None)
+        return bool(inner and inner(test))
+
+
+def wire(test: dict, endpoint: Optional[str]) -> dict:
+    """Wire span tracing into a built test map: record the endpoint
+    (core.run configures the global tracer from it at run start, and
+    unconfigures it at run end) and wrap the client so every protocol
+    call gets a span.  With no endpoint the test map is untouched —
+    untraced runs pay nothing."""
+    if endpoint:
+        test["tracing"] = endpoint
+        test["client"] = Traced(test["client"])
+    return test

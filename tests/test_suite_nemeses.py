@@ -4,6 +4,7 @@ with revive/recluster — all against dummy remotes (reference:
 yugabyte/nemesis.clj, faunadb/topology.clj, aerospike/nemesis.clj)."""
 
 import contextlib
+import os
 
 import pytest
 
@@ -531,6 +532,126 @@ def test_trace_spans_nest_and_export():
     # sampling off: with_trace is a no-op and context is the zero ctx
     with trace.with_trace("ignored"):
         assert trace.context()["trace-id"] == "0" * 32
+
+
+def test_traced_client_wrapper_spans_protocol_calls():
+    """trace.traced wraps every Client call in a span, tagging invokes
+    with the op's f and independent key (reference: dgraph/client.clj
+    wraps open!/close!/query/mutate bodies in with-trace)."""
+    from jepsen_tpu import client as client_mod
+    from jepsen_tpu import trace
+
+    class Probe(client_mod.Client):
+        def open(self, test, node):
+            return self
+
+        def setup(self, test):
+            pass
+
+        def invoke(self, test, op):
+            return {**op, "type": "ok"}
+
+        def close(self, test):
+            pass
+
+    spans = []
+    trace.tracing(exporter=spans.append)
+    try:
+        c = trace.Traced(Probe())
+        opened = c.open({}, "n1")
+        opened.invoke({}, {"f": "read", "value": [3, None]})
+        opened.close({})
+        # a 2-micro-op txn value is NOT an independent [k v] pair
+        c.invoke(
+            {}, {"f": "txn", "value": [["r", 3, None], ["append", 3, 2]]}
+        )
+    finally:
+        trace.tracing()
+    names = [s.name for s in spans]
+    assert names == [
+        "client.open", "client.invoke", "client.close", "client.invoke",
+    ]
+    inv = spans[1]
+    assert inv.attributes["f"] == "read"
+    assert inv.attributes["key"] == "3"
+    assert "key" not in spans[3].attributes
+    # sampling off: spans cost nothing and export nowhere
+    spans.clear()
+    with trace.with_trace("ignored"):
+        pass
+    assert spans == []
+    # wire(): no endpoint → test map untouched; endpoint → wrapped
+    p = Probe()
+    t = {"client": p}
+    assert trace.wire(t, None)["client"] is p
+    assert isinstance(trace.wire(t, "spans.jsonl")["client"], trace.Traced)
+
+
+def test_dgraph_test_wires_tracing_endpoint(tmp_path):
+    """dgraph.test({"tracing": path}) wraps the suite client and
+    records the endpoint; building a test must NOT flip the global
+    tracer (core.run configures it at run start, so building two
+    traced tests can't cross-wire exporters).  (reference:
+    dgraph/core.clj:118,175)"""
+    import json as _json
+
+    from jepsen_tpu import trace
+    from jepsen_tpu.suites import dgraph
+
+    path = str(tmp_path / "spans.jsonl")
+    t = dgraph.test({"tracing": path, "dummy?": True})
+    assert isinstance(t["client"], trace.Traced)
+    assert t["tracing"] == path
+    # building did not enable sampling
+    with trace.with_trace("not-sampled"):
+        pass
+    assert not os.path.exists(path)
+    # run start configures the tracer from the test map's endpoint
+    try:
+        trace.tracing(t["tracing"])
+        with trace.with_trace("probe"):
+            pass
+    finally:
+        trace.tracing()
+    with open(path) as f:
+        recs = [_json.loads(line) for line in f]
+    assert recs and recs[0]["name"] == "probe"
+
+
+def test_run_scopes_tracing_to_the_run(tmp_path):
+    """core.run turns the tracer on from test["tracing"] and OFF again
+    afterwards, so later runs in the same process don't inherit a stale
+    exporter."""
+    from jepsen_tpu import core, trace
+    from jepsen_tpu.fake import AtomClient, AtomState
+    from jepsen_tpu import generator as gen
+
+    path = str(tmp_path / "spans.jsonl")
+
+    def mktest(endpoint=None):
+        return trace.wire(
+            {
+                "name": "trace-scope",
+                "client": AtomClient(AtomState(0)),
+                "generator": gen.limit(
+                    4, gen.clients({"f": "read", "value": None})
+                ),
+                "store?": False,
+                "nodes": ["n1"],
+                "concurrency": 1,
+            },
+            endpoint,
+        )
+
+    core.run(mktest(path))
+    n_traced = sum(1 for _ in open(path))
+    assert n_traced > 0
+    # sampling is off again after the run...
+    with trace.with_trace("after"):
+        pass
+    # ...and an untraced run appends nothing to the old spans file
+    core.run(mktest())
+    assert sum(1 for _ in open(path)) == n_traced
 
 
 # -- tidb -------------------------------------------------------------------
