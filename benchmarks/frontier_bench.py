@@ -76,14 +76,36 @@ def _expand(batch, B, rng):
 
 
 def _time_fn(fn, arrays, reps):
+    """Time ``reps`` full-batch dispatches.  Frontier kernels carry a
+    footprint-safe per-dispatch row cap (``fn.safe_dispatch``, set by
+    wgl.make_check_fn — dispatches past it crash the axon TPU worker);
+    when the batch exceeds it, timing runs the library's chunked path
+    so h/s honestly includes chunking overhead, exactly as check_batch
+    pays it.  Dense kernels (no cap) keep the single-dispatch timing
+    with the device transfer hoisted out of the timed region."""
     import jax.numpy as jnp
 
-    dev = tuple(jnp.asarray(a) for a in arrays)
-    ok, _failed, ovf = fn(*dev)  # warm/compile
-    np.asarray(ok)
+    from jepsen_tpu.ops import wgl as _wgl
+
+    B = arrays[0].shape[0]
+    cap = getattr(fn, "safe_dispatch", None)
+    if cap == 0:
+        raise ValueError("shape exceeds the safe dispatch footprint")
+    if cap is None or cap >= B:
+        dev = tuple(jnp.asarray(a) for a in arrays)
+        ok, _failed, ovf = fn(*dev)  # warm/compile
+        np.asarray(ok)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ok, _failed, ovf = fn(*dev)
+            ok_h = np.asarray(ok)
+        dt = (time.perf_counter() - t0) / reps
+        return dt, ok_h, np.asarray(ovf)
+    ok, _failed, ovf = _wgl._run_chunked(fn, None, arrays, cap)  # warm
+    ok_h = np.asarray(ok)
     t0 = time.perf_counter()
     for _ in range(reps):
-        ok, _failed, ovf = fn(*dev)
+        ok, _failed, ovf = _wgl._run_chunked(fn, None, arrays, cap)
         ok_h = np.asarray(ok)
     dt = (time.perf_counter() - t0) / reps
     return dt, ok_h, np.asarray(ovf)

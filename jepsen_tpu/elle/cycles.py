@@ -188,9 +188,11 @@ def classify(g: Graph) -> Dict[str, list]:
 _SCREEN_CHOICE: dict = {}
 
 #: never even calibrate the O(n³) closure kernel past this many
-#: vertices: it loses to CPU SCC well before (0.6× at n=256,
-#: benchmarks/elle_bench.py), and a first-touch calibration on a huge
-#: padded matrix would burn minutes proving the obvious
+#: vertices: on the CPU backend it loses to SCC well before (0.6× at
+#: n=256, benchmarks/elle_bench.py) — on the real chip it still wins
+#: there (1.6× at n=256, RESULTS.md), which is why the cap sits at 512
+#: and not lower — and a first-touch calibration on a huge padded
+#: matrix would burn minutes proving the obvious
 DEVICE_SCREEN_MAX_VERTICES = 512
 
 

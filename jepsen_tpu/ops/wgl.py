@@ -345,8 +345,14 @@ def make_check_fn(
     compaction: str = "hash",
 ):
     """Jitted, cached version of build_batched — repeat batches at the
-    same bucket sizes reuse the compiled executable."""
-    return jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
+    same bucket sizes reuse the compiled executable.  The returned fn
+    carries its footprint-safe per-dispatch row cap as
+    ``fn.safe_dispatch`` (see frontier_max_dispatch) so every dispatch
+    site — library and benchmarks — reads the same safety bound instead
+    of re-deriving (or forgetting) it."""
+    fn = jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
+    fn.safe_dispatch = frontier_max_dispatch(F, E)
+    return fn
 
 
 def kernel_choice(spec_name: str, C: int, n_values) -> str:
@@ -461,6 +467,31 @@ def _run_rows(fn, mesh, arrays):
 #: the flagship bench shape (16384 × 1000-op histories) fits comfortably
 DEFAULT_MAX_DISPATCH = 16384
 
+#: Frontier-kernel dispatches above ~2M config-bitset words crash the
+#: axon TPU worker outright (observed: cas-register E≈2000, F=64 —
+#: B=256 runs, B=512 kills the worker; deterministic).  The budget is
+#: pinned at the measured-good point with 2× headroom below the fault;
+#: dense-kernel dispatches are unaffected (B=16384 runs clean).
+FRONTIER_DISPATCH_BUDGET = 1_000_000
+
+
+def frontier_max_dispatch(
+    F: int, E: int, max_dispatch: int = DEFAULT_MAX_DISPATCH
+) -> int:
+    """Largest safe per-dispatch row count for a frontier kernel of
+    capacity ``F`` over ``E`` event slots: footprint scales with
+    F × ceil(E/32) bitset words per row, so the cap shrinks as either
+    grows.  Chunked dispatch reuses one executable, so a smaller cap
+    costs extra dispatches, not extra compiles.  Returns 0 when even a
+    single row exceeds the budget — callers must NOT dispatch that
+    shape (check_batch skips the escalation rung; the oracle takes the
+    rows instead)."""
+    words = max(1, -(-E // 32))
+    per_row = F * words
+    if per_row > FRONTIER_DISPATCH_BUDGET:
+        return 0
+    return max(1, min(max_dispatch, FRONTIER_DISPATCH_BUDGET // per_row))
+
 
 def _run_chunked(fn, mesh, arrays, max_batch=DEFAULT_MAX_DISPATCH):
     """Dispatch a batch in ≤ max_batch row chunks, concatenating the
@@ -485,9 +516,13 @@ def _run_chunked(fn, mesh, arrays, max_batch=DEFAULT_MAX_DISPATCH):
             for a, fill in zip(arrays, fills)
         )
         res = _run_rows(fn, mesh, chunk)
-        outs.append(tuple(np.asarray(x)[:n] for x in res))
+        # keep outputs on device (lazy slice): forcing to numpy here
+        # would sync per chunk and leave the device idle while the host
+        # pads the next chunk — dispatches pipeline instead, and one
+        # materialization at the end forces them all
+        outs.append(tuple(x[:n] for x in res))
     return tuple(
-        np.concatenate([o[i] for o in outs]) for i in range(3)
+        np.concatenate([np.asarray(o[i]) for o in outs]) for i in range(3)
     )
 
 
@@ -569,12 +604,23 @@ def check_batch(
             # truncation semantics; the dense kernel has no such cap
             fn = make_check_fn(spec.name, E, C, frontier, mc)
             kernel = "frontier"
-        # np.array (not asarray): jax outputs are read-only views and the
-        # escalation pass writes back into these
-        ok, failed_at, overflow = (
-            np.array(x)
-            for x in _run_chunked(fn, mesh, arrays, max_dispatch)
-        )
+        # frontier dispatches carry their footprint-safe cap on the fn
+        # itself (make_check_fn); dense fns don't and keep the full cap
+        disp = min(max_dispatch, getattr(fn, "safe_dispatch", max_dispatch))
+        if disp == 0:
+            # even one row of this shape would crash the worker: the
+            # whole batch is the oracle's (or reports unknown)
+            B0 = arrays[0].shape[0]
+            ok = np.zeros((B0,), bool)
+            failed_at = np.zeros((B0,), np.int32)
+            overflow = np.ones((B0,), bool)
+        else:
+            # np.array (not asarray): jax outputs are read-only views
+            # and the escalation pass writes back into these
+            ok, failed_at, overflow = (
+                np.array(x)
+                for x in _run_chunked(fn, mesh, arrays, disp)
+            )
 
         capacities = [frontier * factor for factor in escalation]
         # final escalation rung: the provably-sufficient capacity, when
@@ -616,9 +662,14 @@ def check_batch(
                 if (suff is not None and capacity >= suff)
                 else "hash",
             )
+            disp2 = min(max_dispatch, fn2.safe_dispatch)
+            if disp2 == 0:
+                # a single row at this capacity would bust the safe
+                # footprint: skip the rung, leave the rows overflowed
+                continue
             ok2, failed2, ovf2 = (
                 np.asarray(x)[:n_bad]
-                for x in _run_chunked(fn2, mesh, sub, max_dispatch)
+                for x in _run_chunked(fn2, mesh, sub, disp2)
             )
             ok[bad] = ok2
             failed_at[bad] = failed2

@@ -552,3 +552,53 @@ def test_chunked_dispatch_matches_unchunked():
     mesh = mesh_mod.default_mesh(jax.devices("cpu")[:4])
     meshed = wgl.check_batch(model, hists, mesh=mesh, max_dispatch=8)
     assert [o["valid?"] for o in meshed] == [o["valid?"] for o in base]
+
+
+def test_frontier_dispatch_cap_scales_with_footprint():
+    """Frontier dispatches crash the axon TPU worker past a footprint
+    ceiling (B × F × E/32 bitset words); the cap must shrink as
+    capacity or history length grows, never exceed the caller's
+    max_dispatch, and keep a usable floor."""
+    # measured-good point: F=64, E≈2000 → cap ≥ 128 but ≤ 256
+    cap = wgl.frontier_max_dispatch(64, 2000)
+    assert 128 <= cap <= 256
+    # monotone: more capacity or longer histories → smaller caps
+    assert wgl.frontier_max_dispatch(256, 2000) < cap
+    assert wgl.frontier_max_dispatch(64, 8000) < cap
+    # short histories at modest F are not throttled below max_dispatch
+    assert wgl.frontier_max_dispatch(64, 100, max_dispatch=512) == 512
+    # ceiling
+    assert wgl.frontier_max_dispatch(1, 1) == wgl.DEFAULT_MAX_DISPATCH
+    # a shape whose SINGLE row busts the budget returns 0 ("never
+    # dispatch") rather than a small-but-still-fatal floor
+    assert wgl.frontier_max_dispatch(10**6, 10**6) == 0
+    # the compiled fn carries its own cap for every dispatch site
+    fn = wgl.make_check_fn("cas-register", 2000, 8, 64, 9)
+    assert fn.safe_dispatch == wgl.frontier_max_dispatch(64, 2000)
+
+
+def test_check_batch_survives_undispatchable_sufficient_rung():
+    """When the provably-sufficient escalation capacity is too big to
+    dispatch safely (cap 0), check_batch must skip that rung and hand
+    the rows to the oracle — not dispatch a worker-killing shape."""
+    rng = random.Random(9)
+    model = m.cas_register(0)
+    hists = [
+        _gen(rng, n_procs=4, n_ops=16, crash_p=0.0, corrupt=(i % 2 == 0))
+        for i in range(6)
+    ]
+    base = wgl.check_batch(model, hists)
+    # shrink the budget so every frontier shape is undispatchable
+    old = wgl.FRONTIER_DISPATCH_BUDGET
+    wgl.FRONTIER_DISPATCH_BUDGET = 0
+    wgl.make_check_fn.cache_clear()  # cached fns carry stale caps
+    try:
+        # max_closure forces the generic frontier kernel (the dense
+        # automaton would otherwise take this shape and never overflow)
+        out = wgl.check_batch(model, hists, max_closure=8)
+    finally:
+        wgl.FRONTIER_DISPATCH_BUDGET = old
+        wgl.make_check_fn.cache_clear()
+    assert [o["valid?"] for o in out] == [o["valid?"] for o in base]
+    # every row came from the oracle: no frontier dispatch was safe
+    assert all(o["engine"] == "oracle-overflow" for o in out)
