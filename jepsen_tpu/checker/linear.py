@@ -99,6 +99,13 @@ def prepare(history: History, pure_fs: Iterable[Any] = ()) -> Tuple[list, list]:
                     # changes state: drop it to shrink the search
                     dropped.add(op_id)
                 else:
+                    # an info completion may still carry payload the
+                    # invocation lacked (e.g. lock clients stamp WHO
+                    # acted on the way out); without it an owner-aware
+                    # model could never linearize the op and would
+                    # wrongly poison every later legitimate step
+                    if op.value is not None:
+                        ops[op_id].value = op.value
                     events.append((INFO, op_id))
     # processes whose invoke never completed at all: same as info (open
     # forever)

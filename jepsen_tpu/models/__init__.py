@@ -11,7 +11,11 @@ and comparable so searches can deduplicate configurations.
 
 Every model here has a matching branchless TPU step kernel in
 ``jepsen_tpu.ops.step_kernels``; this module is the oracle the kernels are
-differentially tested against.
+differentially tested against.  The owner-aware/reentrant/fenced lock
+and permit models (hazelcast's CP-subsystem probes) live in
+:mod:`.locks`; they carry client identities in op values, stay
+oracle-checked (wgl.supported gates kernel dispatch), and are
+re-exported here.
 """
 
 from __future__ import annotations
@@ -298,3 +302,20 @@ def fifo_queue() -> FIFOQueue:
 
 def unordered_queue() -> UnorderedQueue:
     return UnorderedQueue()
+
+
+# owner-aware / reentrant / fenced locks and permits (hazelcast CP
+# probes) — re-exported so `models.owner_mutex()` etc. work; imported
+# at the bottom because locks.py imports Model/inconsistent from here
+from .locks import (  # noqa: E402
+    AcquiredPermits,
+    FencedMutex,
+    OwnerMutex,
+    ReentrantFencedMutex,
+    ReentrantMutex,
+    acquired_permits,
+    fenced_mutex,
+    owner_mutex,
+    reentrant_fenced_mutex,
+    reentrant_mutex,
+)

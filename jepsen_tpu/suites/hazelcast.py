@@ -6,28 +6,30 @@ starts it with the member list, and drives Java-client workloads:
 distributed lock, unique IDs, atomic-ref CAS, crdt-ish maps, and
 queues.
 
-Without a JVM client, this suite drives Hazelcast's REST endpoints
-(maps + queues), which cover the queue and unique-ids workloads; the
-lock/atomic-ref workloads need the binary client protocol and are
-exposed as a documented gap (`workloads()` omits them).  The server
-here is the stock Hazelcast distribution zip with REST enabled, member
-list templated into hazelcast.xml.
+The full reference workload matrix (hazelcast.clj:652-768) runs over
+a from-scratch open-binary-client-protocol implementation
+(proto/hazelcast.py): map/crdt-map CAS sets, the six lock flavors
+checked against owner-aware/reentrant/fenced mutex models
+(models/locks.py), the 2-permit cp-semaphore, cas over
+AtomicLong/AtomicReference, four unique-id generators, and queues.
+The server is the stock Hazelcast distribution zip, member list
+templated into hazelcast.xml.
 """
 
 from __future__ import annotations
 
-import json
-import uuid
-from typing import Any, Optional
+from typing import Optional
 
 from .. import checker as checker_mod
 from .. import client as client_mod
+from .. import generator as gen
+from .. import independent
 from ..control import util as cu
 from ..control import execute, sudo
 from ..os_setup import debian
 from . import common
 from .proto import IndeterminateError
-from .proto.http import HttpError, JsonHttpClient
+from .proto import hazelcast as hzp
 
 VERSION = "3.12.12"
 DIR = "/opt/hazelcast"
@@ -36,9 +38,6 @@ PORT = 5701
 _XML = """<?xml version="1.0" encoding="UTF-8"?>
 <hazelcast xmlns="http://www.hazelcast.com/schema/config">
   <group><name>jepsen</name></group>
-  <properties>
-    <property name="hazelcast.rest.enabled">true</property>
-  </properties>
   <network>
     <port auto-increment="false">{port}</port>
     <join>
@@ -90,104 +89,6 @@ class HazelcastDB(common.DaemonDB):
             execute("rm", "-f", self.logfile)
 
 
-class HazelcastQueueClient(client_mod.Client):
-    """Queue workload over REST: POST offers, DELETE polls.
-    (reference: hazelcast.clj queue-client — enqueue/dequeue/drain)"""
-
-    QUEUE = "jepsen.queue"
-
-    def __init__(self, opts: Optional[dict] = None):
-        self.opts = opts or {}
-        self.conn: Optional[JsonHttpClient] = None
-
-    def open(self, test, node):
-        c = type(self)(self.opts)
-        c.conn = JsonHttpClient(
-            self.opts.get("host", str(node)),
-            self.opts.get("port", PORT),
-            timeout=10.0,
-        )
-        return c
-
-    def invoke(self, test, op):
-        base = f"/hazelcast/rest/queues/{self.QUEUE}"
-        try:
-            if op["f"] == "enqueue":
-                self.conn.post(base, str(op["value"]), ok=(200, 201, 204))
-                return {**op, "type": "ok"}
-            if op["f"] == "dequeue":
-                status, body = self.conn.request(
-                    "DELETE", f"{base}/2", raise_on_error=False
-                )
-                if status == 204 or body in (None, ""):
-                    return {**op, "type": "fail", "error": "empty"}
-                if status != 200:
-                    raise HttpError(status, body)
-                return {**op, "type": "ok", "value": int(body)}
-            if op["f"] == "drain":
-                got = []
-                while True:
-                    status, body = self.conn.request(
-                        "DELETE", f"{base}/2", raise_on_error=False
-                    )
-                    if status != 200 or body in (None, ""):
-                        break
-                    got.append(int(body))
-                return {**op, "type": "ok", "value": got}
-            raise ValueError(f"unknown f {op['f']!r}")
-        except IndeterminateError as e:
-            return {**op, "type": "info", "error": str(e)}
-        except HttpError as e:
-            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
-
-    def close(self, test):
-        if self.conn:
-            self.conn.close()
-
-
-class HazelcastIdClient(client_mod.Client):
-    """unique-ids via a REST map used as an atomic counter per node —
-    each client reserves blocks by writing node-scoped keys.
-    (reference: hazelcast.clj id-gen-client)"""
-
-    def __init__(self, opts: Optional[dict] = None):
-        self.opts = opts or {}
-        self.conn: Optional[JsonHttpClient] = None
-        self.node = None
-        self.uid = uuid.uuid4().hex[:12]  # survives client churn
-        self.n = 0
-
-    def open(self, test, node):
-        c = type(self)(self.opts)
-        c.node = str(node)
-        c.conn = JsonHttpClient(
-            self.opts.get("host", str(node)),
-            self.opts.get("port", PORT),
-            timeout=10.0,
-        )
-        return c
-
-    def invoke(self, test, op):
-        try:
-            if op["f"] == "generate":
-                self.n += 1
-                val = f"{self.node}-{self.uid}-{self.n}"
-                self.conn.post(
-                    f"/hazelcast/rest/maps/jepsen.ids/{val}", "1",
-                    ok=(200, 201, 204),
-                )
-                return {**op, "type": "ok", "value": val}
-            raise ValueError(f"unknown f {op['f']!r}")
-        except IndeterminateError as e:
-            return {**op, "type": "info", "error": str(e)}
-        except HttpError as e:
-            return {**op, "type": "fail", "error": f"{e.status}: {e.body}"}
-
-    def close(self, test):
-        if self.conn:
-            self.conn.close()
-
-
 def unique_ids_workload(opts: Optional[dict] = None) -> dict:
     def generate(test, ctx):
         return {"type": "invoke", "f": "generate", "value": None}
@@ -198,29 +99,471 @@ def unique_ids_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
+# ---------------------------------------------------------------------
+# binary-protocol clients (proto/hazelcast.py — the reference drives
+# these structures through the official JVM client, hazelcast.clj)
+# ---------------------------------------------------------------------
+
+
+class _HzBinClient(client_mod.Client):
+    """Base for clients over the from-scratch binary protocol."""
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = opts or {}
+        self.conn: Optional[hzp.HzClient] = None
+
+    def open(self, test, node):
+        c = type(self)(self.opts)
+        c.conn = hzp.HzClient(
+            self.opts.get("host", str(node)),
+            self.opts.get("client-port", PORT),
+        ).connect()
+        return c
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def _me(self) -> dict:
+        """Client identity for the owner-aware/fenced lock models; the
+        classic (3.x) protocol exposes no fencing token, so the fence
+        stays INVALID (0 — models.locks.INVALID_FENCE, which every
+        fenced model accepts; a CP-subsystem client supplying real
+        fences plugs in here)."""
+        return {"client": self.conn.uuid, "fence": 0}
+
+    def _guard(self, op, body, info_value=None):
+        """``info_value``: payload to stamp on indeterminate results —
+        the lock/semaphore clients pass their identity so an info op
+        (which stays open forever in the checker) still tells the
+        owner-aware models WHO may have acted; without it the op could
+        never linearize and would poison every later legitimate step."""
+        try:
+            return body()
+        except IndeterminateError as e:
+            out = {**op, "type": "info", "error": str(e)}
+            if info_value is not None:
+                out["value"] = info_value
+            return out
+        except hzp.HzError as e:
+            return {**op, "type": "fail", "error": str(e)}
+
+
+class HzMapClient(_HzBinClient):
+    """Single-key set-in-a-map with CAS updates (reference:
+    hazelcast.clj:453-491 map-client: get → conj → replace, or
+    putIfAbsent when fresh; one attempt per invoke, :cas-failed on a
+    lost race).  Values serialize as a comma-joined sorted string."""
+
+    KEY = hzp.data_string("hi")
+
+    @property
+    def map_name(self) -> str:
+        return (
+            "jepsen.crdt-map" if self.opts.get("crdt?") else "jepsen.map"
+        )
+
+    @staticmethod
+    def _enc(vals) -> bytes:
+        return hzp.data_string(",".join(str(v) for v in sorted(vals)))
+
+    @staticmethod
+    def _dec(data) -> list:
+        s = hzp.parse_data(data)
+        return [int(x) for x in s.split(",")] if s else []
+
+    def invoke(self, test, op):
+        def body():
+            name = self.map_name
+            if op["f"] == "add":
+                cur = self.conn.map_get(name, self.KEY)
+                if cur is None:
+                    prev = self.conn.map_put_if_absent(
+                        name, self.KEY, self._enc({op["value"]})
+                    )
+                    if prev is None:
+                        return {**op, "type": "ok"}
+                    return {**op, "type": "fail", "error": "cas-failed"}
+                new = sorted(set(self._dec(cur)) | {int(op["value"])})
+                if self.conn.map_replace_if_same(
+                    name, self.KEY, cur, self._enc(new)
+                ):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-failed"}
+            if op["f"] == "read":
+                cur = self.conn.map_get(name, self.KEY)
+                vals = self._dec(cur) if cur is not None else []
+                return {**op, "type": "ok", "value": sorted(vals)}
+            raise ValueError(f"unknown f {op['f']!r}")
+
+        return self._guard(op, body)
+
+
+class HzLockClient(_HzBinClient):
+    """acquire/release over a distributed lock; completions carry the
+    session identity so the owner-aware/reentrant/fenced models know
+    WHO acted (reference: hazelcast.clj:117-163 lock-client and
+    :305-371 fenced-lock-client)."""
+
+    @property
+    def lock_name(self) -> str:
+        return self.opts.get("lock-name", "jepsen.lock")
+
+    def invoke(self, test, op):
+        def body():
+            if op["f"] == "acquire":
+                if self.conn.try_lock(self.lock_name, timeout_ms=5000):
+                    return {**op, "type": "ok", "value": self._me()}
+                return {**op, "type": "fail", "error": "timeout"}
+            if op["f"] == "release":
+                self.conn.unlock(self.lock_name)  # HzError → fail
+                return {**op, "type": "ok", "value": self._me()}
+            raise ValueError(f"unknown f {op['f']!r}")
+
+        return self._guard(op, body, info_value=self._me())
+
+
+class HzSemaphoreClient(_HzBinClient):
+    """Permit acquire/release against a 2-permit semaphore (reference:
+    hazelcast.clj:373-400 cp-semaphore-client).  Releases are guarded
+    by a local held-count so a client never hands back a permit it
+    doesn't hold — the server-side over-issue is what the
+    acquired-permits model checks."""
+
+    NAME = "jepsen.semaphore"
+
+    def __init__(self, opts: Optional[dict] = None):
+        super().__init__(opts)
+        self.held = 0
+
+    def setup(self, test):
+        self.conn.semaphore_init(
+            self.NAME, int(self.opts.get("permits", 2))
+        )
+
+    def invoke(self, test, op):
+        def body():
+            if op["f"] == "acquire":
+                if self.conn.semaphore_try_acquire(
+                    self.NAME, timeout_ms=5000
+                ):
+                    self.held += 1
+                    return {**op, "type": "ok", "value": self._me()}
+                return {**op, "type": "fail", "error": "timeout"}
+            if op["f"] == "release":
+                if self.held <= 0:
+                    return {**op, "type": "fail", "error": "no-permit"}
+                self.conn.semaphore_release(self.NAME)
+                self.held -= 1
+                return {**op, "type": "ok", "value": self._me()}
+            raise ValueError(f"unknown f {op['f']!r}")
+
+        return self._guard(op, body, info_value=self._me())
+
+
+class HzCasLongClient(_HzBinClient):
+    """Keyed cas-register over AtomicLongs (reference: hazelcast.clj
+    cp-cas-long-client; lifted over keys so the independent checker
+    feeds the device batch axis)."""
+
+    def _name(self, k) -> str:
+        return f"jepsen.cas-long-{k}"
+
+    def invoke(self, test, op):
+        def body():
+            k, v = op["value"]
+            name = self._name(k)
+            if op["f"] == "read":
+                return {
+                    **op, "type": "ok",
+                    "value": independent.kv(k, self.conn.atomic_get(name)),
+                }
+            if op["f"] == "write":
+                self.conn.atomic_set(name, int(v))
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                if self.conn.atomic_compare_and_set(
+                    name, int(old), int(new)
+                ):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(f"unknown f {op['f']!r}")
+
+        return self._guard(op, body)
+
+
+class HzCasRefClient(_HzBinClient):
+    """Keyed cas-register over AtomicReferences holding boxed longs
+    (reference: hazelcast.clj cp-cas-reference-client).  An unset
+    reference reads as 0, matching the AtomicLong default so the same
+    register model covers both."""
+
+    def _name(self, k) -> str:
+        return f"jepsen.cas-ref-{k}"
+
+    @staticmethod
+    def _box(v) -> Optional[bytes]:
+        return None if int(v) == 0 else hzp.data_long(int(v))
+
+    def invoke(self, test, op):
+        def body():
+            k, v = op["value"]
+            name = self._name(k)
+            if op["f"] == "read":
+                cur = self.conn.ref_get(name)
+                val = hzp.parse_data(cur) if cur is not None else 0
+                return {**op, "type": "ok",
+                        "value": independent.kv(k, val)}
+            if op["f"] == "write":
+                self.conn.ref_set(name, self._box(v))
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = v
+                if self.conn.ref_compare_and_set(
+                    name, self._box(old), self._box(new)
+                ):
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-miss"}
+            raise ValueError(f"unknown f {op['f']!r}")
+
+        return self._guard(op, body)
+
+
+class HzAtomicLongIdClient(_HzBinClient):
+    """Unique ids from an AtomicLong (reference: hazelcast.clj
+    atomic-long-id-client / cp-id-gen-long)."""
+
+    NAME = "jepsen.id.atomic-long"
+
+    def invoke(self, test, op):
+        def body():
+            return {
+                **op, "type": "ok",
+                "value": self.conn.atomic_increment_and_get(self.NAME),
+            }
+
+        return self._guard(op, body)
+
+
+class HzRefIdClient(_HzBinClient):
+    """Unique ids via CAS loop on an AtomicReference (reference:
+    hazelcast.clj atomic-ref-id-client)."""
+
+    NAME = "jepsen.id.atomic-ref"
+    RETRIES = 16
+
+    def invoke(self, test, op):
+        def body():
+            for _ in range(self.RETRIES):
+                cur = self.conn.ref_get(self.NAME)
+                nxt = (hzp.parse_data(cur) if cur is not None else 0) + 1
+                if self.conn.ref_compare_and_set(
+                    self.NAME, cur, hzp.data_long(nxt)
+                ):
+                    return {**op, "type": "ok", "value": nxt}
+            return {**op, "type": "fail", "error": "cas-contention"}
+
+        return self._guard(op, body)
+
+
+class HzFlakeIdClient(_HzBinClient):
+    """Unique ids from a FlakeIdGenerator batch (reference:
+    hazelcast.clj id-gen-client)."""
+
+    NAME = "jepsen.id.flake"
+
+    def invoke(self, test, op):
+        def body():
+            return {
+                **op, "type": "ok",
+                "value": self.conn.new_id_batch(self.NAME, 1)[0],
+            }
+
+        return self._guard(op, body)
+
+
+class HzQueueClient(_HzBinClient):
+    """Queue ops over the binary protocol (reference: hazelcast.clj
+    queue-client: take/offer with drain at the end)."""
+
+    NAME = "jepsen.queue"
+
+    def invoke(self, test, op):
+        def body():
+            if op["f"] == "enqueue":
+                self.conn.queue_offer(self.NAME, hzp.data_long(op["value"]))
+                return {**op, "type": "ok"}
+            if op["f"] == "dequeue":
+                v = self.conn.queue_poll(self.NAME)
+                if v is None:
+                    return {**op, "type": "fail", "error": "empty"}
+                return {**op, "type": "ok", "value": hzp.parse_data(v)}
+            if op["f"] == "drain":
+                got = []
+                while True:
+                    v = self.conn.queue_poll(self.NAME)
+                    if v is None:
+                        break
+                    got.append(hzp.parse_data(v))
+                return {**op, "type": "ok", "value": got}
+            raise ValueError(f"unknown f {op['f']!r}")
+
+        return self._guard(op, body)
+
+
+# ---------------------------------------------------------------------
+# workloads
+# ---------------------------------------------------------------------
+
+
+def map_workload(opts: Optional[dict] = None) -> dict:
+    """CAS-maintained set in a map entry, read at the end (reference:
+    hazelcast.clj:493-507 map-workload; checker/set)."""
+    counter = {"n": 0}
+
+    def add(test, ctx):
+        counter["n"] += 1
+        return {"type": "invoke", "f": "add", "value": counter["n"]}
+
+    final = gen.clients(
+        gen.each_thread(
+            gen.once({"type": "invoke", "f": "read", "value": None})
+        )
+    )
+    return {
+        "generator": gen.stagger(0.05, add),
+        "final-generator": final,
+        "checker": checker_mod.set_checker(),
+    }
+
+
+def lock_workload(
+    model, reentrant: bool = False, opts: Optional[dict] = None
+) -> dict:
+    """acquire/release cycles per thread against a linearizability
+    model (reference: hazelcast.clj:667-725 lock/cp-lock/fenced-lock
+    workload family: per-client cycles of acquire/release — doubled
+    acquires for the reentrant flavors — checker/linearizable)."""
+    opts = opts or {}
+    steps = [{"type": "invoke", "f": "acquire", "value": None}]
+    if reentrant:
+        steps = steps * 2
+    steps += [{"type": "invoke", "f": "release", "value": None}] * (
+        2 if reentrant else 1
+    )
+    g = gen.each_thread(gen.stagger(0.05, gen.cycle(list(steps))))
+    limit = int(opts.get("op-limit", 60))
+    if limit:
+        g = gen.limit(limit, g)
+    return {
+        "generator": g,
+        "checker": checker_mod.linearizable(model, pure_fs=()),
+    }
+
+
+def cas_register_workload(opts: Optional[dict] = None) -> dict:
+    """Keyed cas-register generator + independent linearizable checker
+    (the same probe shape as the generic register workload, backed by
+    hazelcast atomics).  AtomicLongs (and the boxed-long references)
+    initialize to 0, so the model starts at 0, not None — the
+    reference's model/cas-register 0 (hazelcast.clj:745,755)."""
+    from .. import models
+    from ..workloads import linearizable_register as linreg
+
+    o = dict(opts or {})
+    o.setdefault("model", models.cas_register(0))
+    return linreg.test(o)
+
+
 def db(opts: Optional[dict] = None):
     return HazelcastDB(opts)
 
 
 def client(opts: Optional[dict] = None):
-    return HazelcastQueueClient(opts)
+    return HzQueueClient(opts)
 
 
 def workloads(opts: Optional[dict] = None) -> dict:
+    """The full reference matrix (hazelcast.clj:652-768 workloads):
+    map/crdt-map, the six lock flavors, cp-semaphore, cas over
+    AtomicLong/AtomicReference, four unique-id generators, and the
+    queue pair."""
+    from .. import models
+
     opts = dict(opts or {})
     return {
+        "map": map_workload(opts),
+        "crdt-map": map_workload(opts),
+        "lock": lock_workload(models.mutex(), opts=opts),
+        "lock-no-quorum": lock_workload(models.mutex(), opts=opts),
+        "non-reentrant-cp-lock": lock_workload(
+            models.owner_mutex(), opts=opts
+        ),
+        "reentrant-cp-lock": lock_workload(
+            models.reentrant_mutex(), reentrant=True, opts=opts
+        ),
+        "non-reentrant-fenced-lock": lock_workload(
+            models.fenced_mutex(), opts=opts
+        ),
+        "reentrant-fenced-lock": lock_workload(
+            models.reentrant_fenced_mutex(), reentrant=True, opts=opts
+        ),
+        "cp-semaphore": lock_workload(
+            models.acquired_permits(int(opts.get("permits", 2))),
+            opts=opts,
+        ),
+        "cp-cas-long": cas_register_workload(opts),
+        "cp-cas-reference": cas_register_workload(opts),
+        "cp-id-gen-long": unique_ids_workload(opts),
+        "atomic-long-ids": unique_ids_workload(opts),
+        "atomic-ref-ids": unique_ids_workload(opts),
+        "id-gen-ids": unique_ids_workload(opts),
         "queue": common.queue_workload(opts),
         "linearizable-queue": common.linearizable_queue_workload(opts),
         "unique-ids": unique_ids_workload(opts),
     }
 
 
+_CLIENTS = {
+    "map": HzMapClient,
+    "crdt-map": HzMapClient,
+    "lock": HzLockClient,
+    "lock-no-quorum": HzLockClient,
+    "non-reentrant-cp-lock": HzLockClient,
+    "reentrant-cp-lock": HzLockClient,
+    "non-reentrant-fenced-lock": HzLockClient,
+    "reentrant-fenced-lock": HzLockClient,
+    "cp-semaphore": HzSemaphoreClient,
+    "cp-cas-long": HzCasLongClient,
+    "cp-cas-reference": HzCasRefClient,
+    "cp-id-gen-long": HzAtomicLongIdClient,
+    "atomic-long-ids": HzAtomicLongIdClient,
+    "atomic-ref-ids": HzRefIdClient,
+    "id-gen-ids": HzFlakeIdClient,
+    "queue": HzQueueClient,
+    "linearizable-queue": HzQueueClient,
+    "unique-ids": HzFlakeIdClient,
+}
+
+#: per-workload client opt tweaks (distinct lock names mirror the
+#: reference's jepsen.lock / jepsen.lock.no-quorum / cpLock1 / cpLock2)
+_CLIENT_OPTS = {
+    "crdt-map": {"crdt?": True},
+    "lock-no-quorum": {"lock-name": "jepsen.lock.no-quorum"},
+    "non-reentrant-cp-lock": {"lock-name": "jepsen.cpLock1"},
+    "reentrant-cp-lock": {"lock-name": "jepsen.cpLock2"},
+    "non-reentrant-fenced-lock": {"lock-name": "jepsen.cpLock1"},
+    "reentrant-fenced-lock": {"lock-name": "jepsen.cpLock2"},
+}
+
+
 def test(opts: Optional[dict] = None) -> dict:
     opts = dict(opts or {})
     wname = opts.get("workload", "queue")
     w = workloads(opts)[wname]
-    c = (HazelcastIdClient(opts) if wname == "unique-ids"
-         else HazelcastQueueClient(opts))
+    copts = {**opts, **_CLIENT_OPTS.get(wname, {})}
+    c = _CLIENTS.get(wname, HzQueueClient)(copts)
     return common.build_test(
         f"hazelcast-{wname}", opts, db=HazelcastDB(opts), client=c, workload=w,
     )

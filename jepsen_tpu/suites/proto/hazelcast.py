@@ -1,0 +1,453 @@
+"""Hazelcast open binary client protocol (1.x framing, IMDG 3.12).
+
+Backs the hazelcast suite's lock / semaphore / atomic / id-gen / map /
+queue workloads (the reference drives them through the official JVM
+client: hazelcast/src/jepsen/hazelcast.clj:117-445).  This implements
+the client side from scratch:
+
+- **Framing** (little-endian): frameLength:int32 (self-inclusive),
+  version:uint8, flags:uint8 (0xC0 = unfragmented), type:uint16,
+  correlationId:int64, partitionId:int32 (-1 = any), dataOffset:uint16
+  (= header size, 22), then the parameter payload.
+- **Parameters**: str = int32 length + utf8; bool = 1 byte; int/long
+  little-endian fixed width; nullable values carry a 1-byte is-null
+  flag first.
+- **Data** (map/queue keys and values) wraps Hazelcast's default
+  serialization: big-endian int32 type id then the value bytes
+  (CONSTANT_TYPE_LONG = -7 → 8-byte BE long; CONSTANT_TYPE_STRING =
+  -11 → int32 length + utf8).
+
+Message-type ids follow the published hazelcast-client-protocol 1.x
+tables (service byte ‖ method byte).  The ids this module actually
+exercises are pinned by the differential fake server in
+tests/fake_servers.py, which speaks the same spec; drive a live 3.12
+cluster to cross-verify before trusting a new id.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+from . import IndeterminateError, ProtocolError
+
+PROTOCOL_PREFIX = b"CB2"  # client-binary protocol, version 2 handshake
+
+VERSION = 1
+FLAGS_UNFRAGMENTED = 0xC0
+HEADER = struct.Struct("<iBBHqih")  # len, ver, flags, type, corr, part, off
+HEADER_SIZE = HEADER.size  # 22
+
+# -- message types ----------------------------------------------------------
+
+AUTH = 0x0002
+
+# generic response types
+RESP_VOID = 100
+RESP_BOOL = 101
+RESP_INT = 102
+RESP_LONG = 103
+RESP_STRING = 104
+RESP_DATA = 105
+RESP_AUTH = 107
+RESP_ERROR = 109
+
+# map service 0x01
+MAP_PUT = 0x0101
+MAP_GET = 0x0102
+MAP_REMOVE = 0x0103
+MAP_REPLACE = 0x0104
+MAP_REPLACE_IF_SAME = 0x0105
+MAP_PUT_IF_ABSENT = 0x010D
+
+# queue service 0x03
+QUEUE_OFFER = 0x0301
+QUEUE_POLL = 0x0305
+QUEUE_SIZE = 0x0303
+
+# lock service 0x07
+LOCK_LOCK = 0x0705
+LOCK_UNLOCK = 0x0706
+LOCK_TRY_LOCK = 0x0708
+
+# atomic long service 0x0A
+ATOMIC_LONG_ADD_AND_GET = 0x0A05
+ATOMIC_LONG_COMPARE_AND_SET = 0x0A06
+ATOMIC_LONG_GET = 0x0A08
+ATOMIC_LONG_INCREMENT_AND_GET = 0x0A0B
+ATOMIC_LONG_SET = 0x0A0D
+
+# atomic reference service 0x0B
+ATOMIC_REF_COMPARE_AND_SET = 0x0B04
+ATOMIC_REF_GET = 0x0B06
+ATOMIC_REF_SET = 0x0B07
+
+# semaphore service 0x0D
+SEMAPHORE_INIT = 0x0D01
+SEMAPHORE_ACQUIRE = 0x0D02
+SEMAPHORE_RELEASE = 0x0D06
+SEMAPHORE_TRY_ACQUIRE = 0x0D07
+
+# flake id generator service 0x1C
+FLAKE_ID_NEW_BATCH = 0x1C01
+
+# serialization constant type ids (big-endian int32 before the body)
+TYPE_LONG = -7
+TYPE_STRING = -11
+
+
+class HzError(ProtocolError):
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(f"hazelcast error: {msg}", code=code)
+
+
+# -- parameter encoding -----------------------------------------------------
+
+
+def _str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<i", len(b)) + b
+
+
+def _nullable_str(s: Optional[str]) -> bytes:
+    if s is None:
+        return b"\x01"
+    return b"\x00" + _str(s)
+
+
+def _bool(v: bool) -> bytes:
+    return b"\x01" if v else b"\x00"
+
+
+def _long(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+def _int(v: int) -> bytes:
+    return struct.pack("<i", v)
+
+
+def data_long(v: int) -> bytes:
+    """A java.lang.Long as Hazelcast Data."""
+    return struct.pack(">iq", TYPE_LONG, v)
+
+
+def data_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">ii", TYPE_STRING, len(b)) + b
+
+
+def _data(d: bytes) -> bytes:
+    return struct.pack("<i", len(d)) + d
+
+
+def parse_data(d: bytes) -> Any:
+    """Decode a Data blob back to a python value."""
+    (tid,) = struct.unpack_from(">i", d, 0)
+    if tid == TYPE_LONG:
+        return struct.unpack_from(">q", d, 4)[0]
+    if tid == TYPE_STRING:
+        (n,) = struct.unpack_from(">i", d, 4)
+        return d[8 : 8 + n].decode()
+    raise HzError(f"unsupported data type id {tid}")
+
+
+class _Reader:
+    __slots__ = ("buf", "off")
+
+    def __init__(self, buf: bytes, off: int = 0):
+        self.buf = buf
+        self.off = off
+
+    def u8(self) -> int:
+        v = self.buf[self.off]
+        self.off += 1
+        return v
+
+    def i32(self) -> int:
+        (v,) = struct.unpack_from("<i", self.buf, self.off)
+        self.off += 4
+        return v
+
+    def i64(self) -> int:
+        (v,) = struct.unpack_from("<q", self.buf, self.off)
+        self.off += 8
+        return v
+
+    def string(self) -> str:
+        n = self.i32()
+        s = self.buf[self.off : self.off + n].decode()
+        self.off += n
+        return s
+
+    def nullable_string(self) -> Optional[str]:
+        return None if self.u8() else self.string()
+
+    def data(self) -> bytes:
+        n = self.i32()
+        d = self.buf[self.off : self.off + n]
+        self.off += n
+        return d
+
+    def nullable_data(self) -> Optional[bytes]:
+        return None if self.u8() else self.data()
+
+
+class HzClient:
+    """One authenticated client connection.  Logically single-threaded
+    (one outstanding request), like the suite's worker processes."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int = 5701,
+        group: str = "jepsen",
+        password: str = "jepsen-pass",
+        timeout: float = 5.0,
+    ):
+        self.host = host
+        self.port = port
+        self.group = group
+        self.password = password
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self.uuid: Optional[str] = None
+        self.owner_uuid: Optional[str] = None
+        self._corr = 0
+        self._lock = threading.Lock()
+        #: per-connection thread id for lock/semaphore ownership; the
+        #: JVM client uses the calling thread's id — one id per client
+        #: models our logically single-threaded processes
+        self.thread_id = 1
+
+    # -- transport --
+
+    def connect(self) -> "HzClient":
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = s
+        s.sendall(PROTOCOL_PREFIX)
+        r = self._invoke(
+            AUTH,
+            _str(self.group)
+            + _str(self.password)
+            + _nullable_str(None)
+            + _nullable_str(None)
+            + _bool(True)
+            + _str("PYH")  # client type
+            + bytes([1])  # serialization version
+            + _str("3.12"),
+        )
+        status = r.u8()
+        if status != 0:
+            raise HzError(f"authentication failed (status {status})")
+        # address: nullable (host str, port int)
+        if not r.u8():
+            r.string()
+            r.i32()
+        self.uuid = r.nullable_string()
+        self.owner_uuid = r.nullable_string()
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        assert self.sock is not None
+        chunks = b""
+        while len(chunks) < n:
+            try:
+                c = self.sock.recv(n - len(chunks))
+            except socket.timeout as e:
+                raise IndeterminateError(f"hazelcast timeout: {e}") from e
+            except OSError as e:
+                raise IndeterminateError(f"hazelcast conn lost: {e}") from e
+            if not c:
+                raise IndeterminateError("hazelcast connection closed")
+            chunks += c
+        return chunks
+
+    def _invoke(
+        self, msg_type: int, payload: bytes, partition: int = -1
+    ) -> _Reader:
+        if self.sock is None:
+            raise IndeterminateError("hazelcast client not connected")
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            frame = HEADER.pack(
+                HEADER_SIZE + len(payload),
+                VERSION,
+                FLAGS_UNFRAGMENTED,
+                msg_type,
+                corr,
+                partition,
+                HEADER_SIZE,
+            ) + payload
+            try:
+                self.sock.sendall(frame)
+            except OSError as e:
+                raise IndeterminateError(f"hazelcast send failed: {e}") from e
+            head = self._recv_exact(HEADER_SIZE)
+            ln, _ver, _flags, rtype, rcorr, _part, off = HEADER.unpack(head)
+            body = self._recv_exact(ln - HEADER_SIZE)
+        if rcorr != corr:
+            raise HzError(f"correlation mismatch ({rcorr} != {corr})")
+        r = _Reader(head + body, off)
+        if rtype == RESP_ERROR:
+            code = r.i32()
+            cls = r.nullable_string() or "?"
+            msg = r.nullable_string() or ""
+            raise HzError(f"{cls}: {msg}", code=code)
+        return r
+
+    # -- map --
+
+    def map_get(self, name: str, key: bytes) -> Optional[bytes]:
+        r = self._invoke(
+            MAP_GET, _str(name) + _data(key) + _long(self.thread_id)
+        )
+        return r.nullable_data()
+
+    def map_put(self, name: str, key: bytes, value: bytes) -> Optional[bytes]:
+        r = self._invoke(
+            MAP_PUT,
+            _str(name) + _data(key) + _data(value) + _long(self.thread_id)
+            + _long(-1),  # ttl
+        )
+        return r.nullable_data()
+
+    def map_put_if_absent(
+        self, name: str, key: bytes, value: bytes
+    ) -> Optional[bytes]:
+        """Returns the previous value (None = the put won)."""
+        r = self._invoke(
+            MAP_PUT_IF_ABSENT,
+            _str(name) + _data(key) + _data(value) + _long(self.thread_id)
+            + _long(-1),
+        )
+        return r.nullable_data()
+
+    def map_replace_if_same(
+        self, name: str, key: bytes, old: bytes, new: bytes
+    ) -> bool:
+        r = self._invoke(
+            MAP_REPLACE_IF_SAME,
+            _str(name) + _data(key) + _data(old) + _data(new)
+            + _long(self.thread_id),
+        )
+        return bool(r.u8())
+
+    # -- queue --
+
+    def queue_offer(self, name: str, value: bytes, timeout_ms: int = 0) -> bool:
+        r = self._invoke(
+            QUEUE_OFFER, _str(name) + _data(value) + _long(timeout_ms)
+        )
+        return bool(r.u8())
+
+    def queue_poll(self, name: str, timeout_ms: int = 0) -> Optional[bytes]:
+        r = self._invoke(QUEUE_POLL, _str(name) + _long(timeout_ms))
+        return r.nullable_data()
+
+    # -- lock --
+
+    def lock(self, name: str, lease_ms: int = -1) -> None:
+        self._invoke(
+            LOCK_LOCK,
+            _str(name) + _long(lease_ms) + _long(self.thread_id) + _long(0),
+        )
+
+    def try_lock(
+        self, name: str, timeout_ms: int = 0, lease_ms: int = -1
+    ) -> bool:
+        r = self._invoke(
+            LOCK_TRY_LOCK,
+            _str(name) + _long(self.thread_id) + _long(lease_ms)
+            + _long(timeout_ms) + _long(0),
+        )
+        return bool(r.u8())
+
+    def unlock(self, name: str) -> None:
+        self._invoke(
+            LOCK_UNLOCK, _str(name) + _long(self.thread_id) + _long(0)
+        )
+
+    # -- semaphore --
+
+    def semaphore_init(self, name: str, permits: int) -> bool:
+        r = self._invoke(SEMAPHORE_INIT, _str(name) + _int(permits))
+        return bool(r.u8())
+
+    def semaphore_try_acquire(
+        self, name: str, permits: int = 1, timeout_ms: int = 0
+    ) -> bool:
+        r = self._invoke(
+            SEMAPHORE_TRY_ACQUIRE,
+            _str(name) + _int(permits) + _long(timeout_ms),
+        )
+        return bool(r.u8())
+
+    def semaphore_release(self, name: str, permits: int = 1) -> None:
+        self._invoke(SEMAPHORE_RELEASE, _str(name) + _int(permits))
+
+    # -- atomic long --
+
+    def atomic_add_and_get(self, name: str, delta: int) -> int:
+        r = self._invoke(ATOMIC_LONG_ADD_AND_GET, _str(name) + _long(delta))
+        return r.i64()
+
+    def atomic_get(self, name: str) -> int:
+        r = self._invoke(ATOMIC_LONG_GET, _str(name))
+        return r.i64()
+
+    def atomic_set(self, name: str, value: int) -> None:
+        self._invoke(ATOMIC_LONG_SET, _str(name) + _long(value))
+
+    def atomic_compare_and_set(self, name: str, old: int, new: int) -> bool:
+        r = self._invoke(
+            ATOMIC_LONG_COMPARE_AND_SET, _str(name) + _long(old) + _long(new)
+        )
+        return bool(r.u8())
+
+    def atomic_increment_and_get(self, name: str) -> int:
+        r = self._invoke(ATOMIC_LONG_INCREMENT_AND_GET, _str(name))
+        return r.i64()
+
+    # -- atomic reference --
+
+    def ref_get(self, name: str) -> Optional[bytes]:
+        r = self._invoke(ATOMIC_REF_GET, _str(name))
+        return r.nullable_data()
+
+    def ref_set(self, name: str, value: Optional[bytes]) -> None:
+        payload = _str(name)
+        payload += b"\x01" if value is None else b"\x00" + _data(value)
+        self._invoke(ATOMIC_REF_SET, payload)
+
+    def ref_compare_and_set(
+        self, name: str, old: Optional[bytes], new: Optional[bytes]
+    ) -> bool:
+        payload = _str(name)
+        for v in (old, new):
+            payload += b"\x01" if v is None else b"\x00" + _data(v)
+        r = self._invoke(ATOMIC_REF_COMPARE_AND_SET, payload)
+        return bool(r.u8())
+
+    # -- flake id generator --
+
+    def new_id_batch(self, name: str, batch_size: int = 1) -> List[int]:
+        """Returns batch_size unique ids (base + i*increment)."""
+        r = self._invoke(FLAKE_ID_NEW_BATCH, _str(name) + _int(batch_size))
+        base = r.i64()
+        increment = r.i64()
+        n = r.i32()
+        return [base + i * increment for i in range(n)]

@@ -275,6 +275,47 @@ class SetClient(_Base):
             return self._fail(op, e)
 
 
+class CounterClient(_Base):
+    """Plain-int counter: SQL has no counter column type, so a single
+    row's int is bumped with column arithmetic and reads return the
+    current value.  (reference: yugabyte ysql/counter.clj:12-28 —
+    ``UPDATE counter SET count = count + ? WHERE id = 0``)"""
+
+    TABLE = "counters"
+
+    def setup(self, test):
+        self._exec_ddl(
+            f"CREATE TABLE IF NOT EXISTS {self.TABLE} "
+            "(id INT PRIMARY KEY, count INT)"
+        )
+        try:
+            self.conn.query(
+                f"INSERT INTO {self.TABLE} (id, count) VALUES (0, 0)"
+            )
+        except (PgError, MysqlError):
+            pass  # row already seeded by another worker
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                self.conn.query(
+                    f"UPDATE {self.TABLE} SET count = count + "
+                    f"{int(op['value'])} WHERE id = 0"
+                )
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                res = self.conn.query(
+                    f"SELECT count FROM {self.TABLE} WHERE id = 0"
+                )
+                v = int(res.rows[0][0]) if res.rows else 0
+                return {**op, "type": "ok", "value": v}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except IndeterminateError as e:
+            return self._info(op, e)
+        except (PgError, MysqlError) as e:
+            return self._fail(op, e)
+
+
 class AppendClient(_Base):
     """Elle list-append txns over ``lists (id, vals text)``: each micro-op
     batch runs in one transaction; reads parse the comma-joined list.
@@ -379,6 +420,7 @@ CLIENTS = {
     "register": RegisterClient,
     "bank": BankClient,
     "set": SetClient,
+    "counter": CounterClient,
     "list-append": AppendClient,
     "long-fork": TxnClient,
     "rw-register": TxnClient,

@@ -348,7 +348,8 @@ def workloads(opts: Optional[dict] = None) -> dict:
     out = {}
     for w in ("register", "set", "counter"):
         out[f"ycql.{w}"] = common.generic_workload(w, opts)
-    for w in ("register", "bank", "set", "list-append", "long-fork"):
+    for w in ("register", "bank", "set", "counter", "list-append",
+              "long-fork"):
         out[f"ysql.{w}"] = common.generic_workload(w, _ysql_opts(opts))
     out["ycql.single-key-acid"] = common.generic_workload("register", opts)
     out["ysql.single-key-acid"] = common.generic_workload(

@@ -2660,3 +2660,285 @@ class _IgniteHandler(BaseHTTPRequestHandler):
 
 class FakeIgnite(FakeServer):
     handler_class = _IgniteHandler
+
+
+# ---------------------------------------------------------------------------
+# Hazelcast open binary client protocol (1.x framing, IMDG 3.12)
+# ---------------------------------------------------------------------------
+
+
+class _HazelcastHandler(_RecvExact, socketserver.BaseRequestHandler):
+    """Differential peer for jepsen_tpu.suites.proto.hazelcast: same
+    frame spec, implementing maps, queues, locks (per client-uuid +
+    thread-id ownership), semaphores, atomic longs/references, and
+    flake-id batches over the shared store."""
+
+    def _ensure(self):
+        st = self.fake_store
+        if not hasattr(st, "hz_maps"):
+            st.hz_maps = {}        # name -> {key bytes: value bytes}
+            st.hz_queues = {}      # name -> list[bytes]
+            st.hz_locks = {}       # name -> (uuid, thread_id, count)
+            st.hz_sems = {}        # name -> available permits
+            st.hz_longs = {}       # name -> int
+            st.hz_refs = {}        # name -> bytes | None
+            st.hz_flake = {}       # name -> next id
+
+    def _reply(self, corr, rtype, payload=b""):
+        from jepsen_tpu.suites.proto.hazelcast import HEADER, HEADER_SIZE
+
+        self.request.sendall(
+            HEADER.pack(
+                HEADER_SIZE + len(payload), 1, 0xC0, rtype, corr, -1,
+                HEADER_SIZE,
+            )
+            + payload
+        )
+
+    def _error(self, corr, cls, msg):
+        import struct as _s
+
+        from jepsen_tpu.suites.proto import hazelcast as hz
+
+        payload = (
+            _s.pack("<i", 0)
+            + b"\x00" + _s.pack("<i", len(cls)) + cls.encode()
+            + b"\x00" + _s.pack("<i", len(msg)) + msg.encode()
+        )
+        self._reply(corr, hz.RESP_ERROR, payload)
+
+    @staticmethod
+    def _nullable_data(d):
+        import struct as _s
+
+        if d is None:
+            return b"\x01"
+        return b"\x00" + _s.pack("<i", len(d)) + d
+
+    def handle(self):
+        import struct as _s
+        import time as _t
+
+        from jepsen_tpu.suites.proto import hazelcast as hz
+
+        self._ensure()
+        st = self.fake_store
+        try:
+            prefix = self._recv_exact(3)
+            if prefix != hz.PROTOCOL_PREFIX:
+                return
+            client_uuid = f"c-{id(self.request) & 0xFFFF:x}"
+            while True:
+                head = self._recv_exact(hz.HEADER_SIZE)
+                ln, _v, _f, mtype, corr, _part, off = hz.HEADER.unpack(head)
+                body = self._recv_exact(ln - hz.HEADER_SIZE)
+                r = hz._Reader(head + body, off)
+
+                if mtype == hz.AUTH:
+                    group = r.string()
+                    password = r.string()
+                    if group != "jepsen" or password != "jepsen-pass":
+                        self._reply(corr, hz.RESP_AUTH, b"\x01")
+                        continue
+                    payload = (
+                        b"\x00"          # status ok
+                        + b"\x01"        # null address
+                        + b"\x00" + _s.pack("<i", len(client_uuid))
+                        + client_uuid.encode()
+                        + b"\x01"        # null owner uuid
+                    )
+                    self._reply(corr, hz.RESP_AUTH, payload)
+
+                elif mtype == hz.MAP_GET:
+                    name, key = r.string(), r.data()
+                    with st.lock:
+                        v = st.hz_maps.get(name, {}).get(key)
+                    self._reply(corr, hz.RESP_DATA, self._nullable_data(v))
+                elif mtype == hz.MAP_PUT:
+                    name, key, val = r.string(), r.data(), r.data()
+                    with st.lock:
+                        prev = st.hz_maps.setdefault(name, {}).get(key)
+                        st.hz_maps[name][key] = val
+                    self._reply(corr, hz.RESP_DATA, self._nullable_data(prev))
+                elif mtype == hz.MAP_PUT_IF_ABSENT:
+                    name, key, val = r.string(), r.data(), r.data()
+                    with st.lock:
+                        m = st.hz_maps.setdefault(name, {})
+                        prev = m.get(key)
+                        if prev is None:
+                            m[key] = val
+                    self._reply(corr, hz.RESP_DATA, self._nullable_data(prev))
+                elif mtype == hz.MAP_REPLACE_IF_SAME:
+                    name, key = r.string(), r.data()
+                    old, new = r.data(), r.data()
+                    with st.lock:
+                        m = st.hz_maps.setdefault(name, {})
+                        okb = m.get(key) == old
+                        if okb:
+                            m[key] = new
+                    self._reply(corr, hz.RESP_BOOL, bytes([okb]))
+
+                elif mtype == hz.QUEUE_OFFER:
+                    name, val = r.string(), r.data()
+                    with st.lock:
+                        st.hz_queues.setdefault(name, []).append(val)
+                    self._reply(corr, hz.RESP_BOOL, b"\x01")
+                elif mtype == hz.QUEUE_POLL:
+                    name = r.string()
+                    with st.lock:
+                        q = st.hz_queues.setdefault(name, [])
+                        v = q.pop(0) if q else None
+                    self._reply(corr, hz.RESP_DATA, self._nullable_data(v))
+
+                elif mtype in (hz.LOCK_LOCK, hz.LOCK_TRY_LOCK):
+                    name = r.string()
+                    if mtype == hz.LOCK_LOCK:
+                        _lease = r.i64()
+                        tid = r.i64()
+                        deadline = _t.monotonic() + 30.0
+                    else:
+                        tid = r.i64()
+                        _lease = r.i64()
+                        timeout = r.i64()
+                        deadline = _t.monotonic() + timeout / 1000.0
+                    me = (client_uuid, tid)
+                    got = False
+                    while True:
+                        with st.lock:
+                            holder = st.hz_locks.get(name)
+                            if holder is None:
+                                st.hz_locks[name] = (me[0], me[1], 1)
+                                got = True
+                            elif holder[:2] == me:  # reentrant
+                                st.hz_locks[name] = (
+                                    me[0], me[1], holder[2] + 1
+                                )
+                                got = True
+                        if got or _t.monotonic() >= deadline:
+                            break
+                        _t.sleep(0.002)
+                    if mtype == hz.LOCK_LOCK:
+                        self._reply(corr, hz.RESP_VOID)
+                    else:
+                        self._reply(corr, hz.RESP_BOOL, bytes([got]))
+                elif mtype == hz.LOCK_UNLOCK:
+                    name = r.string()
+                    tid = r.i64()
+                    with st.lock:
+                        holder = st.hz_locks.get(name)
+                        if holder is None or holder[:2] != (client_uuid, tid):
+                            err = True
+                        else:
+                            err = False
+                            if holder[2] == 1:
+                                del st.hz_locks[name]
+                            else:
+                                st.hz_locks[name] = (
+                                    holder[0], holder[1], holder[2] - 1
+                                )
+                    if err:
+                        self._error(
+                            corr, "IllegalMonitorStateException",
+                            "not the lock owner",
+                        )
+                    else:
+                        self._reply(corr, hz.RESP_VOID)
+
+                elif mtype == hz.SEMAPHORE_INIT:
+                    name, permits = r.string(), r.i32()
+                    with st.lock:
+                        fresh = name not in st.hz_sems
+                        if fresh:
+                            st.hz_sems[name] = permits
+                    self._reply(corr, hz.RESP_BOOL, bytes([fresh]))
+                elif mtype == hz.SEMAPHORE_TRY_ACQUIRE:
+                    name, permits = r.string(), r.i32()
+                    timeout = r.i64()
+                    deadline = _t.monotonic() + timeout / 1000.0
+                    got = False
+                    while True:
+                        with st.lock:
+                            avail = st.hz_sems.get(name, 0)
+                            if avail >= permits:
+                                st.hz_sems[name] = avail - permits
+                                got = True
+                        if got or _t.monotonic() >= deadline:
+                            break
+                        _t.sleep(0.002)
+                    self._reply(corr, hz.RESP_BOOL, bytes([got]))
+                elif mtype == hz.SEMAPHORE_RELEASE:
+                    name, permits = r.string(), r.i32()
+                    with st.lock:
+                        st.hz_sems[name] = st.hz_sems.get(name, 0) + permits
+                    self._reply(corr, hz.RESP_VOID)
+
+                elif mtype == hz.ATOMIC_LONG_ADD_AND_GET:
+                    name, delta = r.string(), r.i64()
+                    with st.lock:
+                        v = st.hz_longs.get(name, 0) + delta
+                        st.hz_longs[name] = v
+                    self._reply(corr, hz.RESP_LONG, _s.pack("<q", v))
+                elif mtype == hz.ATOMIC_LONG_INCREMENT_AND_GET:
+                    name = r.string()
+                    with st.lock:
+                        v = st.hz_longs.get(name, 0) + 1
+                        st.hz_longs[name] = v
+                    self._reply(corr, hz.RESP_LONG, _s.pack("<q", v))
+                elif mtype == hz.ATOMIC_LONG_GET:
+                    name = r.string()
+                    with st.lock:
+                        v = st.hz_longs.get(name, 0)
+                    self._reply(corr, hz.RESP_LONG, _s.pack("<q", v))
+                elif mtype == hz.ATOMIC_LONG_SET:
+                    name, v = r.string(), r.i64()
+                    with st.lock:
+                        st.hz_longs[name] = v
+                    self._reply(corr, hz.RESP_VOID)
+                elif mtype == hz.ATOMIC_LONG_COMPARE_AND_SET:
+                    name, old, new = r.string(), r.i64(), r.i64()
+                    with st.lock:
+                        okb = st.hz_longs.get(name, 0) == old
+                        if okb:
+                            st.hz_longs[name] = new
+                    self._reply(corr, hz.RESP_BOOL, bytes([okb]))
+
+                elif mtype == hz.ATOMIC_REF_GET:
+                    name = r.string()
+                    with st.lock:
+                        v = st.hz_refs.get(name)
+                    self._reply(corr, hz.RESP_DATA, self._nullable_data(v))
+                elif mtype == hz.ATOMIC_REF_SET:
+                    name = r.string()
+                    v = r.nullable_data()
+                    with st.lock:
+                        st.hz_refs[name] = v
+                    self._reply(corr, hz.RESP_VOID)
+                elif mtype == hz.ATOMIC_REF_COMPARE_AND_SET:
+                    name = r.string()
+                    old, new = r.nullable_data(), r.nullable_data()
+                    with st.lock:
+                        okb = st.hz_refs.get(name) == old
+                        if okb:
+                            st.hz_refs[name] = new
+                    self._reply(corr, hz.RESP_BOOL, bytes([okb]))
+
+                elif mtype == hz.FLAKE_ID_NEW_BATCH:
+                    name, n = r.string(), r.i32()
+                    with st.lock:
+                        base = st.hz_flake.get(name, 0)
+                        st.hz_flake[name] = base + n
+                    self._reply(
+                        corr, hz.RESP_LONG,
+                        _s.pack("<qqi", base, 1, n),
+                    )
+                else:
+                    self._error(
+                        corr, "UnsupportedOperationException",
+                        f"fake hazelcast: message type {mtype:#06x}",
+                    )
+        except ConnectionError:
+            return
+
+
+class FakeHazelcast(FakeServer):
+    handler_class = _HazelcastHandler

@@ -1,0 +1,321 @@
+"""Hazelcast suite: binary protocol roundtrips, the full workload
+matrix run in-process against the fake server, and the lock/permit
+models catching real violations (reference:
+hazelcast/src/jepsen/hazelcast.clj:117-768)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from fake_servers import FakeHazelcast  # noqa: E402
+
+from jepsen_tpu import checker as checker_mod  # noqa: E402
+from jepsen_tpu import core  # noqa: E402
+from jepsen_tpu import db as db_mod  # noqa: E402
+from jepsen_tpu import models  # noqa: E402
+from jepsen_tpu.history import History  # noqa: E402
+from jepsen_tpu.suites import hazelcast  # noqa: E402
+from jepsen_tpu.suites.proto.hazelcast import (  # noqa: E402
+    HzClient,
+    HzError,
+    data_long,
+    data_string,
+    parse_data,
+)
+
+
+def _suite_test(server, workload, **extra):
+    t = hazelcast.test(
+        {
+            "nodes": ["n1", "n2", "n3"],
+            "host": "127.0.0.1",
+            "client-port": server.port,
+            "time-limit": 1.5,
+            "op-limit": 24,
+            "workload": workload,
+            "faults": [],
+            **extra,
+        }
+    )
+    t["db"] = db_mod.noop()
+    t["ssh"] = {"dummy?": True}
+    return t
+
+
+# -- protocol ---------------------------------------------------------------
+
+
+def test_hz_proto_roundtrip():
+    s = FakeHazelcast().start()
+    try:
+        c = HzClient("127.0.0.1", s.port).connect()
+        assert c.uuid
+        # map CAS primitives
+        k = data_string("hi")
+        assert c.map_put_if_absent("m", k, data_long(1)) is None
+        assert parse_data(c.map_get("m", k)) == 1
+        assert c.map_replace_if_same("m", k, data_long(1), data_long(2))
+        assert not c.map_replace_if_same("m", k, data_long(1), data_long(3))
+        # queue
+        assert c.queue_offer("q", data_long(7))
+        assert parse_data(c.queue_poll("q")) == 7
+        assert c.queue_poll("q") is None
+        # lock: exclusivity across sessions, unlock by non-owner errors
+        c2 = HzClient("127.0.0.1", s.port).connect()
+        assert c.try_lock("L")
+        assert not c2.try_lock("L", timeout_ms=10)
+        with pytest.raises(HzError):
+            c2.unlock("L")
+        c.unlock("L")
+        assert c2.try_lock("L")
+        # semaphore: 2 permits
+        assert c.semaphore_init("S", 2)
+        assert c.semaphore_try_acquire("S")
+        assert c2.semaphore_try_acquire("S")
+        assert not c.semaphore_try_acquire("S", timeout_ms=10)
+        c2.semaphore_release("S")
+        assert c.semaphore_try_acquire("S")
+        # atomics
+        assert c.atomic_add_and_get("a", 5) == 5
+        assert c.atomic_compare_and_set("a", 5, 9)
+        assert not c.atomic_compare_and_set("a", 5, 9)
+        assert c.atomic_increment_and_get("a") == 10
+        # atomic reference
+        assert c.ref_get("r") is None
+        c.ref_set("r", data_long(3))
+        assert c.ref_compare_and_set("r", data_long(3), data_long(4))
+        assert parse_data(c.ref_get("r")) == 4
+        # flake ids: disjoint across sessions
+        ids = c.new_id_batch("f", 3) + c2.new_id_batch("f", 3)
+        assert len(set(ids)) == 6
+        c.close()
+        c2.close()
+        # bad credentials (either field) are rejected
+        for group, pw in (("wrong", "jepsen-pass"), ("jepsen", "wrong")):
+            with pytest.raises(HzError):
+                HzClient(
+                    "127.0.0.1", s.port, group=group, password=pw
+                ).connect()
+    finally:
+        s.stop()
+
+
+def test_hz_crdt_map_targets_crdt_map_name():
+    """The crdt-map workload must drive jepsen.crdt-map, not the plain
+    map (reference: hazelcast.clj:450-451 map-name/crdt-map-name)."""
+    t = hazelcast.test({"workload": "crdt-map", "nodes": ["n1"]})
+    assert t["client"].map_name == "jepsen.crdt-map"
+    t2 = hazelcast.test({"workload": "map", "nodes": ["n1"]})
+    assert t2["client"].map_name == "jepsen.map"
+
+
+def test_hz_map_client_cas_race():
+    """Two map clients race an add: the loser reports cas-failed, the
+    final read contains the winner (reference map-client semantics:
+    one CAS attempt per invoke)."""
+    s = FakeHazelcast().start()
+    try:
+        t = {"nodes": ["n1"]}
+        c1 = hazelcast.HzMapClient(
+            {"host": "127.0.0.1", "client-port": s.port}
+        ).open(t, "n1")
+        c2 = hazelcast.HzMapClient(
+            {"host": "127.0.0.1", "client-port": s.port}
+        ).open(t, "n1")
+        assert c1.invoke(t, {"f": "add", "value": 1, "type": "invoke"})[
+            "type"] == "ok"
+        assert c2.invoke(t, {"f": "add", "value": 2, "type": "invoke"})[
+            "type"] == "ok"
+        r = c1.invoke(t, {"f": "read", "value": None, "type": "invoke"})
+        assert r["value"] == [1, 2]
+        # force a lost race: swap the stored value between c2's read
+        # and CAS by writing through c1 concurrently is racy to stage
+        # reliably here; the protocol-level replace_if_same false path
+        # is already pinned in test_hz_proto_roundtrip
+        c1.close(t)
+        c2.close(t)
+    finally:
+        s.stop()
+
+
+# -- full in-process runs ---------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "workload",
+    [
+        "map",
+        "lock",
+        "non-reentrant-cp-lock",
+        "reentrant-cp-lock",
+        "non-reentrant-fenced-lock",
+        "reentrant-fenced-lock",
+        "cp-semaphore",
+        "queue",
+        "atomic-long-ids",
+        "atomic-ref-ids",
+        "id-gen-ids",
+    ],
+)
+def test_hz_workload_full_test_in_process(workload):
+    s = FakeHazelcast().start()
+    try:
+        t = _suite_test(s, workload)
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_hz_cas_long_full_test_in_process():
+    s = FakeHazelcast().start()
+    try:
+        t = _suite_test(s, "cp-cas-long", **{"per-key-limit": 12})
+        result = core.run(t)
+        assert result["results"]["valid?"] is True, result["results"]
+    finally:
+        s.stop()
+
+
+def test_hz_cas_reference_client_roundtrip():
+    s = FakeHazelcast().start()
+    try:
+        t = {"nodes": ["n1"]}
+        c = hazelcast.HzCasRefClient(
+            {"host": "127.0.0.1", "client-port": s.port}
+        ).open(t, "n1")
+        r = c.invoke(t, {"f": "read", "value": [0, None], "type": "invoke"})
+        assert r["type"] == "ok" and tuple(r["value"]) == (0, 0)
+        assert c.invoke(t, {"f": "write", "value": [0, 5],
+                            "type": "invoke"})["type"] == "ok"
+        assert c.invoke(t, {"f": "cas", "value": [0, [5, 6]],
+                            "type": "invoke"})["type"] == "ok"
+        assert c.invoke(t, {"f": "cas", "value": [0, [5, 7]],
+                            "type": "invoke"})["type"] == "fail"
+        assert tuple(
+            c.invoke(t, {"f": "read", "value": [0, None],
+                         "type": "invoke"})["value"]
+        ) == (0, 6)
+        c.close(t)
+    finally:
+        s.stop()
+
+
+# -- the models catch real violations ---------------------------------------
+
+
+def _h(ops):
+    return History.from_dicts(ops)
+
+
+def test_owner_mutex_checker_catches_double_grant():
+    """Two clients both told they hold the lock: no linearization
+    exists, whatever the order."""
+    chk = checker_mod.linearizable(models.owner_mutex(), pure_fs=())
+    bad = _h([
+        {"process": 0, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 0, "type": "ok", "f": "acquire",
+         "value": {"client": "a"}},
+        {"process": 1, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 1, "type": "ok", "f": "acquire",
+         "value": {"client": "b"}},
+    ])
+    assert chk.check({}, bad)["valid?"] is False
+    good = _h([
+        {"process": 0, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 0, "type": "ok", "f": "acquire",
+         "value": {"client": "a"}},
+        {"process": 0, "type": "invoke", "f": "release", "value": None},
+        {"process": 0, "type": "ok", "f": "release",
+         "value": {"client": "a"}},
+        {"process": 1, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 1, "type": "ok", "f": "acquire",
+         "value": {"client": "b"}},
+    ])
+    assert chk.check({}, good)["valid?"] is True
+
+
+def test_owner_mutex_indeterminate_release_stays_checkable():
+    """An indeterminate release (network timeout, op may have applied)
+    must not poison the model: the info completion carries WHO acted,
+    so a later legitimate acquire by another client linearizes (info
+    release happened first).  Regression: info values propagate onto
+    invocations in the oracle's pairing pass."""
+    chk = checker_mod.linearizable(models.owner_mutex(), pure_fs=())
+    h = _h([
+        {"process": 0, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 0, "type": "ok", "f": "acquire",
+         "value": {"client": "a"}},
+        {"process": 0, "type": "invoke", "f": "release", "value": None},
+        {"process": 0, "type": "info", "f": "release",
+         "value": {"client": "a"}},
+        {"process": 1, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 1, "type": "ok", "f": "acquire",
+         "value": {"client": "b"}},
+    ])
+    assert chk.check({}, h)["valid?"] is True
+
+
+def test_owner_mutex_checker_catches_foreign_release():
+    chk = checker_mod.linearizable(models.owner_mutex(), pure_fs=())
+    bad = _h([
+        {"process": 0, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 0, "type": "ok", "f": "acquire",
+         "value": {"client": "a"}},
+        {"process": 1, "type": "invoke", "f": "release", "value": None},
+        {"process": 1, "type": "ok", "f": "release",
+         "value": {"client": "b"}},
+    ])
+    assert chk.check({}, bad)["valid?"] is False
+
+
+def test_fenced_mutex_checker_catches_stale_fence():
+    chk = checker_mod.linearizable(models.fenced_mutex(), pure_fs=())
+    bad = _h([
+        {"process": 0, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 0, "type": "ok", "f": "acquire",
+         "value": {"client": "a", "fence": 7}},
+        {"process": 0, "type": "invoke", "f": "release", "value": None},
+        {"process": 0, "type": "ok", "f": "release",
+         "value": {"client": "a", "fence": 0}},
+        # fence goes backwards: 7 then 7 again
+        {"process": 1, "type": "invoke", "f": "acquire", "value": None},
+        {"process": 1, "type": "ok", "f": "acquire",
+         "value": {"client": "b", "fence": 7}},
+    ])
+    assert chk.check({}, bad)["valid?"] is False
+
+
+def test_acquired_permits_checker_catches_over_issue():
+    """Three grants against two permits can never linearize."""
+    chk = checker_mod.linearizable(
+        models.acquired_permits(2), pure_fs=()
+    )
+    ops = []
+    for p, client in ((0, "a"), (1, "b"), (2, "c")):
+        ops.append({"process": p, "type": "invoke", "f": "acquire",
+                    "value": None})
+        ops.append({"process": p, "type": "ok", "f": "acquire",
+                    "value": {"client": client}})
+    assert chk.check({}, _h(ops))["valid?"] is False
+    # two grants + a release + a third grant is fine
+    ok_ops = ops[:4] + [
+        {"process": 0, "type": "invoke", "f": "release", "value": None},
+        {"process": 0, "type": "ok", "f": "release",
+         "value": {"client": "a"}},
+    ] + ops[4:]
+    assert chk.check({}, _h(ok_ops))["valid?"] is True
+
+
+def test_reentrant_mutex_checker_bounds_reacquires():
+    chk = checker_mod.linearizable(models.reentrant_mutex(), pure_fs=())
+    ops = []
+    for _ in range(3):  # three acquires by the same holder: one too many
+        ops.append({"process": 0, "type": "invoke", "f": "acquire",
+                    "value": None})
+        ops.append({"process": 0, "type": "ok", "f": "acquire",
+                    "value": {"client": "a"}})
+    assert chk.check({}, _h(ops))["valid?"] is False
+    assert chk.check({}, _h(ops[:4]))["valid?"] is True

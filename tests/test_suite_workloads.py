@@ -289,6 +289,37 @@ def test_multi_key_acid_workload_shape():
     assert "checker" in w["ysql.multi-key-acid"]
 
 
+def test_ysql_counter_client_roundtrip():
+    """SQL counter: int-column arithmetic adds + reads (reference:
+    yugabyte/ysql/counter.clj:12-28 — SQL has no counter type, so a
+    single row's int is bumped)."""
+    from jepsen_tpu.suites import sql, yugabyte
+
+    assert "ysql.counter" in yugabyte.workloads({"nodes": ["n1"]})
+    s = FakePg().start()
+    try:
+        opts = {"host": "127.0.0.1", "port": s.port, "dialect": "pg",
+                "user": "postgres"}
+        c = sql.client_for("counter", opts).open({"nodes": ["n1"]}, "n1")
+        c.setup({})
+        assert c.invoke({}, {"f": "add", "type": "invoke", "value": 3})[
+            "type"] == "ok"
+        assert c.invoke({}, {"f": "add", "type": "invoke", "value": 4})[
+            "type"] == "ok"
+        r = c.invoke({}, {"f": "read", "type": "invoke", "value": None})
+        assert r["type"] == "ok" and r["value"] == 7
+        # second client sees the same row (shared backing store),
+        # and setup is idempotent (seed row insert tolerated)
+        c2 = sql.client_for("counter", opts).open({"nodes": ["n1"]}, "n1")
+        c2.setup({})
+        r2 = c2.invoke({}, {"f": "read", "type": "invoke", "value": None})
+        assert r2["value"] == 7
+        c.close({})
+        c2.close({})
+    finally:
+        s.stop()
+
+
 # -- dgraph upsert ----------------------------------------------------------
 
 
