@@ -64,8 +64,10 @@ from .step_kernels import (
     F_DEQUEUE,
 )
 
-#: specs whose state is exactly "current value id" (mutex: 0=free 1=held)
-DENSE_SPECS = ("register", "cas-register", "mutex")
+#: specs whose state is exactly "current value id" (mutex: 0=free
+#: 1=held; owner-mutex: 0=free, else holder's client id — its ops
+#: arrive as cas codes from the encoder)
+DENSE_SPECS = ("register", "cas-register", "mutex", "owner-mutex")
 
 #: dense envelope: beyond these the generic frontier kernel takes over
 MAX_C = 12   # 2^12 subsets = 128 packed words

@@ -173,6 +173,43 @@ def _encode_mutex_op(op, valmap) -> Tuple[int, int, int]:
     raise ValueError(f"mutex cannot encode op f={op.f!r}")
 
 
+def _owner_client(op):
+    # the oracle's identity extraction (models.locks._client) is the
+    # single source of truth: encoder and oracle MUST agree on WHO
+    # acted or device and oracle verdicts diverge
+    from ..models.locks import _client
+
+    client = _client(op)
+    if client is None:
+        # an op that never reported WHO acted (e.g. a crashed acquire
+        # whose client died before stamping) cannot ride the value
+        # automaton; the whole history falls back to the oracle
+        raise ValueError("owner-mutex op without client identity")
+    return client
+
+
+def _encode_owner_mutex_op(op, valmap) -> Tuple[int, int, int]:
+    """The owner-aware mutex IS a cas-register in disguise: state =
+    holder ("free" is its own value id), acquire(c) = cas(free → c),
+    release(c) = cas(c → free) — so the whole cas-register kernel
+    family (dense subset automaton included) applies unchanged.  Client
+    identities ride the value-id map like register values."""
+    client = _owner_client(op)
+    free = _value_id("__free__", valmap)
+    cid = _value_id(("client", client), valmap)
+    if op.f == "acquire":
+        return F_CAS, free, cid
+    if op.f == "release":
+        return F_CAS, cid, free
+    raise ValueError(f"owner-mutex cannot encode op f={op.f!r}")
+
+
+def _owner_mutex_init(model, valmap) -> int:
+    if model.owner is None:
+        return _value_id("__free__", valmap)
+    return _value_id(("client", model.owner), valmap)
+
+
 def _register_init(model, valmap) -> int:
     return _value_id(model.value, valmap)
 
@@ -316,6 +353,21 @@ SPECS: Dict[type, ModelSpec] = {
         step=unordered_queue_step,
         encode_op=_encode_unordered_queue_op,
         init_state=_uq_init,
+        pure_fs=(),
+    ),
+    # the owner-aware mutex reduces to cas-register ops at encode time
+    # (_encode_owner_mutex_op) and reuses that step function, so the
+    # whole kernel family — including the overflow-free dense subset
+    # automaton — applies without a new device step.  The name stays
+    # unique (wgl resolves specs BY name).  The fenced/reentrant/
+    # permit flavors carry state the value automaton can't express
+    # (global fence monotonicity, hold counts, multisets) and stay
+    # oracle-checked.
+    m.OwnerMutex: ModelSpec(
+        name="owner-mutex",
+        step=cas_register_step,
+        encode_op=_encode_owner_mutex_op,
+        init_state=_owner_mutex_init,
         pure_fs=(),
     ),
 }
