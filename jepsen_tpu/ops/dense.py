@@ -62,12 +62,17 @@ from .step_kernels import (
     F_RELEASE,
     F_ENQUEUE,
     F_DEQUEUE,
+    F_RACQUIRE,
+    F_RRELEASE,
 )
 
 #: specs whose state is exactly "current value id" (mutex: 0=free
 #: 1=held; owner-mutex: 0=free, else holder's client id — its ops
-#: arrive as cas codes from the encoder)
-DENSE_SPECS = ("register", "cas-register", "mutex", "owner-mutex")
+#: arrive as cas codes from the encoder; reentrant-mutex: 0=free,
+#: 2c-1/2c = client c holding once/twice)
+DENSE_SPECS = (
+    "register", "cas-register", "mutex", "owner-mutex", "reentrant-mutex"
+)
 
 #: dense envelope: beyond these the generic frontier kernel takes over
 MAX_C = 12   # 2^12 subsets = 128 packed words
@@ -209,6 +214,7 @@ def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
     (a = value id, b = register index, step_kernels.py:81-94); V is
     ignored and S takes its place."""
     multi = spec_name == "multi-register"
+    reentrant = spec_name == "reentrant-mutex"
     if multi:
         if mr_shape is None:
             raise ValueError("multi-register needs mr_shape=(Vr, K)")
@@ -305,19 +311,38 @@ def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
                 vv = jnp.arange(V, dtype=jnp.int32)[None, None, :]  # v
                 am = a_eff[:, None, None]
                 bm = b_eff[:, None, None]
-                T = jnp.where(
-                    is_write[:, None, None],
-                    vp == am,
-                    jnp.where(
-                        is_ra[:, None, None],
-                        vp == vv,
+                if reentrant:
+                    # two-pair transitions over state ids {0 free,
+                    # 2c-1 once, 2c twice} (a = client id c); a
+                    # reentrant batch carries ONLY racq/rrel codes, so
+                    # the register nest below never applies — gated at
+                    # trace time to keep it out of the flagship path
+                    is_racq = f_s == F_RACQUIRE
+                    once = (2 * a_s - 1)[:, None, None]
+                    twice = (2 * a_s)[:, None, None]
+                    racq_T = ((vv == 0) & (vp == once)) | (
+                        (vv == once) & (vp == twice)
+                    )
+                    rrel_T = ((vv == twice) & (vp == once)) | (
+                        (vv == once) & (vp == 0)
+                    )
+                    T = jnp.where(
+                        is_racq[:, None, None], racq_T, rrel_T
+                    ) & active_s[:, None, None]
+                else:
+                    T = jnp.where(
+                        is_write[:, None, None],
+                        vp == am,
                         jnp.where(
-                            cas_like[:, None, None],
-                            (vp == bm) & (vv == am),
-                            (vp == am) & (vv == am),  # read
+                            is_ra[:, None, None],
+                            vp == vv,
+                            jnp.where(
+                                cas_like[:, None, None],
+                                (vp == bm) & (vv == am),
+                                (vp == am) & (vv == am),  # read
+                            ),
                         ),
-                    ),
-                ) & active_s[:, None, None]
+                    ) & active_s[:, None, None]
 
             # --- closure: linearize open ops until fixpoint; every slot
             # advances in one vectorized pass ---

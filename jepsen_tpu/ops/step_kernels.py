@@ -35,6 +35,8 @@ F_ACQUIRE = 4     # mutex
 F_RELEASE = 5     # mutex
 F_ENQUEUE = 6     # unordered queue: a = value id
 F_DEQUEUE = 7     # unordered queue: a = observed value id
+F_RACQUIRE = 8    # reentrant mutex: a = client id (see reentrant_mutex_step)
+F_RRELEASE = 9    # reentrant mutex: a = client id
 
 #: Value id reserved for "unknown/None". Known values are 1-based.
 V_UNKNOWN = 0
@@ -68,6 +70,31 @@ def mutex_step(state, f, a, b):
     is_rel = f == F_RELEASE
     ok = (is_acq & (state == 0)) | (is_rel & (state == 1))
     state2 = jnp.where(is_acq, 1, jnp.where(is_rel, 0, state)).astype(state.dtype)
+    return state2, ok
+
+
+def reentrant_mutex_step(state, f, a, b):
+    """Reentrant owner-aware mutex with hold bound 2 (the hazelcast CP
+    probe's reentrant-lock-acquire-count).  State ids: 0 = free,
+    2c-1 = client c holds once, 2c = client c holds twice (a = client
+    id c ≥ 1).  acquire: free → (c,1) or (c,1) → (c,2); release:
+    (c,2) → (c,1) or (c,1) → free.  (oracle: models.ReentrantMutex)"""
+    is_acq = f == F_RACQUIRE
+    is_rel = f == F_RRELEASE
+    once = 2 * a - 1
+    twice = 2 * a
+    acq_fresh = is_acq & (state == 0)
+    acq_re = is_acq & (state == once)
+    rel_two = is_rel & (state == twice)
+    rel_one = is_rel & (state == once)
+    ok = acq_fresh | acq_re | rel_two | rel_one
+    state2 = jnp.where(
+        acq_fresh, once,
+        jnp.where(
+            acq_re, twice,
+            jnp.where(rel_two, once, jnp.where(rel_one, 0, state)),
+        ),
+    ).astype(state.dtype)
     return state2, ok
 
 
@@ -186,6 +213,46 @@ def _owner_client(op):
         # automaton; the whole history falls back to the oracle
         raise ValueError("owner-mutex op without client identity")
     return client
+
+
+def _rm_client_id(client, valmap: Dict[Any, int]) -> int:
+    """1-based client index (the reentrant encoder interns nothing
+    else, so _value_id stays contiguous over clients); the state
+    domain is 2·N+1 ids for N clients (see reentrant_mutex_step)."""
+    return _value_id(("rm-client", client), valmap)
+
+
+def _encode_reentrant_mutex_op(op, valmap) -> Tuple[int, int, int]:
+    """Reentrant mutex ops: a = client index; the step function owns
+    the (free / once / twice) state algebra.  Only the reference's
+    hold bound of 2 has a kernel; other bounds ride the oracle (the
+    spec's init_state raises)."""
+    client = _owner_client(op)
+    cid = _rm_client_id(client, valmap)
+    if op.f == "acquire":
+        return F_RACQUIRE, cid, 0
+    if op.f == "release":
+        return F_RRELEASE, cid, 0
+    raise ValueError(f"reentrant-mutex cannot encode op f={op.f!r}")
+
+
+def _reentrant_mutex_init(model, valmap) -> int:
+    from ..models.locks import REENTRANT_ACQUIRE_COUNT
+
+    if model.max_count != REENTRANT_ACQUIRE_COUNT:
+        raise ValueError(
+            "reentrant-mutex kernel supports the hold bound of "
+            f"{REENTRANT_ACQUIRE_COUNT} only"
+        )
+    if model.owner is None:
+        return 0
+    if model.count not in (1, 2):
+        # a held owner with a count outside the algebra (count=0 is
+        # constructible) has no state id — oracle fallback, not a
+        # silently-diverging kernel verdict
+        raise ValueError("reentrant-mutex init outside the kernel algebra")
+    cid = _rm_client_id(model.owner, valmap)
+    return 2 * cid - 1 if model.count == 1 else 2 * cid
 
 
 def _encode_owner_mutex_op(op, valmap) -> Tuple[int, int, int]:
@@ -368,6 +435,18 @@ SPECS: Dict[type, ModelSpec] = {
         step=cas_register_step,
         encode_op=_encode_owner_mutex_op,
         init_state=_owner_mutex_init,
+        pure_fs=(),
+    ),
+    # reentrant owner-aware mutex (hold bound 2): its own step algebra
+    # over state ids {0, 2c-1, 2c}; the state DOMAIN is 2·N+1 for N
+    # clients — check_batch widens n_values accordingly.  Fenced and
+    # permit flavors stay oracle-only (global fence monotonicity /
+    # multiset state have no small value automaton).
+    m.ReentrantMutex: ModelSpec(
+        name="reentrant-mutex",
+        step=reentrant_mutex_step,
+        encode_op=_encode_reentrant_mutex_op,
+        init_state=_reentrant_mutex_init,
         pure_fs=(),
     ),
 }

@@ -596,6 +596,11 @@ def check_batch(
                     batch.cand_b.max(),
                 )
             )
+            if spec.name == "reentrant-mutex":
+                # state ids run {0, 2c-1, 2c} for client ids c ≤ the
+                # encoded max, so the automaton's domain is wider than
+                # the raw id bound (see reentrant_mutex_step)
+                n_values = max(n_values, 2 * (n_values - 1) + 1)
         if max_closure is None:
             fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
             kernel = kernel_choice(spec.name, C, n_values)

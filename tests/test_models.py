@@ -418,3 +418,106 @@ def test_owner_mutex_dense_reduction_differential():
     assert stats["kernels"].get("dense", 0) == max(
         stats["kernels"].values()
     ), stats
+
+
+def _gen_reentrant_lock_history(rng, n_procs=4, n_ops=24, corrupt=False):
+    """Simulated reentrant lock (hold bound 2): the holder may
+    re-acquire; releases peel one hold.  corrupt=True fabricates either
+    a grant to a non-holder while held, or a third re-acquire."""
+    from jepsen_tpu.history import History, invoke_op, ok_op, fail_op
+
+    holder = None
+    count = 0
+    pending = {}
+    idle = list(range(n_procs))
+    hist = []
+    done = 0
+    corrupted = False
+    while done < n_ops or pending:
+        if idle and done < n_ops and (not pending or rng.random() < 0.6):
+            p = idle.pop(rng.randrange(len(idle)))
+            wants_release = holder == p and count > 0 and rng.random() < 0.6
+            f = "release" if wants_release else "acquire"
+            hist.append(invoke_op(p, f, None))
+            pending[p] = f
+            done += 1
+        else:
+            p = rng.choice(list(pending))
+            f = pending.pop(p)
+            idle.append(p)
+            me = {"client": f"c{p}"}
+            if f == "acquire":
+                if holder is None:
+                    holder, count = p, 1
+                    hist.append(ok_op(p, f, me))
+                elif holder == p and count < 2:
+                    count += 1
+                    hist.append(ok_op(p, f, me))
+                elif corrupt and not corrupted and not any(
+                    pf == "release" for pp, pf in pending.items()
+                    if pp == holder
+                ):
+                    # fabricate: grant while fully held (foreign or 3rd)
+                    hist.append(ok_op(p, f, me))
+                    corrupted = True
+                else:
+                    hist.append(fail_op(p, f, None, error="held"))
+            else:  # release (only the holder ever invokes one here)
+                if holder == p and count > 0:
+                    count -= 1
+                    if count == 0:
+                        holder = None
+                    hist.append(ok_op(p, f, me))
+                else:
+                    hist.append(fail_op(p, f, None, error="not-owner"))
+    h = History(hist)
+    for i, op in enumerate(h):
+        op.index = i
+        op.time = i
+    return h.index_ops(), corrupted
+
+
+def test_reentrant_mutex_dense_kernel_differential():
+    """ReentrantMutex runs its own dense automaton (state ids 0 free /
+    2c-1 once / 2c twice); device verdicts must match the CPU oracle,
+    fabricated over-grants must be caught, and in-envelope batches land
+    on the dense kernel."""
+    import random
+
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    rng = random.Random(45104)
+    hists = []
+    expect_invalid = []
+    for i in range(24):
+        h, corrupted = _gen_reentrant_lock_history(
+            rng, n_procs=4, n_ops=20, corrupt=(i % 3 == 0)
+        )
+        hists.append(h)
+        expect_invalid.append(corrupted)
+    model = models.reentrant_mutex()
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    outs = wgl.check_batch(model, hists)
+    got = [o["valid?"] for o in outs]
+    assert got == oracle, list(zip(got, oracle))
+    for v, bad in zip(got, expect_invalid):
+        if bad:
+            assert v is False
+    assert any(expect_invalid)
+    stats = wgl.batch_stats(outs)
+    assert stats["device-rate"] > 0.9, stats
+    assert stats["kernels"].get("dense", 0) == max(
+        stats["kernels"].values()
+    ), stats
+    # a non-default hold bound has no kernel: oracle fallback, same
+    # verdicts
+    m3 = models.reentrant_mutex(max_count=3)
+    out3 = wgl.check_batch(m3, hists[:4])
+    assert all(o["engine"].startswith("oracle") for o in out3), out3
+    # a held owner with count outside the {1,2} algebra (count=0 is
+    # constructible) must also fall back, never silently diverge
+    weird = models.ReentrantMutex(owner="c", count=0)
+    outw = wgl.check_batch(weird, hists[:2])
+    assert all(o["engine"].startswith("oracle") for o in outw), outw
