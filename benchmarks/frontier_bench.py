@@ -519,6 +519,44 @@ def queue_arm(results, B, reps):
         )
 
 
+def lock_models_arm(results, B, reps):
+    """Owner-aware and reentrant mutex dense automata (the hazelcast
+    CP-lock probes, models/locks.py) vs the CPU oracle — the round-4
+    dense-family growth, at contended per-key shapes (waiters block
+    until granted, like the suite's try_lock clients).  The oracle rows
+    are budget-capped: contended INVALID lock histories are exactly the
+    exponential blowup class, while the dense automaton cannot
+    overflow."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import dense, encode, wgl
+
+    rng = np.random.default_rng(45105)
+    for name, model, reentrant in (
+        ("owner-mutex", m.owner_mutex(), False),
+        ("reentrant-mutex", m.reentrant_mutex(), True),
+    ):
+        py_rng = random.Random(45105)
+        hists = [
+            synth.generate_lock_history(
+                py_rng, n_procs=8, n_ops=60, reentrant=reentrant,
+                corrupt=(i % 4 == 0),
+            )
+            for i in range(16)
+        ]
+        batch = _batch_arrays(hists, model, slot_cap=8)
+        E = batch.ev_slot.shape[1]
+        C = batch.cand_slot.shape[2]
+        arrays = _expand(batch, B, rng)
+        oracle_row(results, name, hists, model, C, 60)
+        nv = wgl.value_domain(name, arrays[0], arrays[4], arrays[5])
+        if wgl.kernel_choice(name, C, nv) != "dense":
+            continue  # production would not select the dense kernel
+        fn = dense.make_dense_fn(name, E, C, encode.round_up(nv, 4))
+        dt, ok, ovf = _time_fn(fn, arrays, reps)
+        _device_row(results, name, "dense", C, None, 60, B, E, dt, ok, ovf)
+
+
 def main():
     from jepsen_tpu.platform import ensure_usable_backend
 
@@ -530,6 +568,7 @@ def main():
     queue_arm(results, min(B, 512), reps)
     multi_register_arm(results, B, reps)
     mutex_arm(results, min(B, 1024), reps)
+    lock_models_arm(results, min(B, 1024), reps)
     compaction_arm(results, reps)
     import datetime
 

@@ -475,6 +475,24 @@ DEFAULT_MAX_DISPATCH = 16384
 FRONTIER_DISPATCH_BUDGET = 1_000_000
 
 
+def value_domain(spec_name: str, init_state, cand_a, cand_b) -> int:
+    """Exclusive upper bound of the kernel state/value-id domain for a
+    batch — the ONE place that knows spec-specific widenings (the
+    reentrant-mutex automaton runs over {0, 2c-1, 2c}, wider than the
+    raw client-id bound).  check_batch and the benchmarks both read
+    this so they can never disagree about kernel shapes."""
+    n_values = 1 + int(
+        max(
+            np.asarray(init_state).max(),
+            np.asarray(cand_a).max(),
+            np.asarray(cand_b).max(),
+        )
+    )
+    if spec_name == "reentrant-mutex":
+        n_values = max(n_values, 2 * (n_values - 1) + 1)
+    return n_values
+
+
 def frontier_max_dispatch(
     F: int, E: int, max_dispatch: int = DEFAULT_MAX_DISPATCH
 ) -> int:
@@ -589,18 +607,9 @@ def check_batch(
                 batch.init_state, batch.cand_a, batch.cand_b
             )
         else:
-            n_values = 1 + int(
-                max(
-                    batch.init_state.max(),
-                    batch.cand_a.max(),
-                    batch.cand_b.max(),
-                )
+            n_values = value_domain(
+                spec.name, batch.init_state, batch.cand_a, batch.cand_b
             )
-            if spec.name == "reentrant-mutex":
-                # state ids run {0, 2c-1, 2c} for client ids c ≤ the
-                # encoded max, so the automaton's domain is wider than
-                # the raw id bound (see reentrant_mutex_step)
-                n_values = max(n_values, 2 * (n_values - 1) + 1)
         if max_closure is None:
             fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
             kernel = kernel_choice(spec.name, C, n_values)

@@ -199,3 +199,98 @@ def generate_mr_history(
         op.index = i
         op.time = i
     return out
+
+
+def generate_lock_history(
+    rng,
+    n_procs: int = 4,
+    n_ops: int = 40,
+    reentrant: bool = False,
+    corrupt: bool = False,
+):
+    """Simulated owner-aware (optionally reentrant, hold bound 2)
+    distributed lock with real contention: waiters stay pending until
+    the lock frees (like the hazelcast suite's try_lock clients), so
+    histories are dense with successful acquire/release cycles rather
+    than failed probes.  A release's linearization point sits anywhere
+    in its invoke window, so a grant may interleave there — real
+    concurrency, still linearizable.  Completions carry {"client":
+    name} the way suites/hazelcast.py stamps identity.  corrupt=True
+    fabricates one definite violation: a grant while held with no open
+    release that could linearize first."""
+    cap = 2 if reentrant else 1
+    hist = []
+    idle = list(range(n_procs))
+    waiting: list = []      # acquire invoked, not granted
+    holds = {p: 0 for p in range(n_procs)}
+    releasing: list = []    # release invoked, not ok'd
+    eff = 0                 # holds outstanding after in-flight releases
+    corrupted = False
+    done = 0
+    while done < n_ops or waiting or releasing:
+        can_acq = [p for p in idle if holds[p] == 0]
+        can_reacq = [p for p in idle if 0 < holds[p] < cap]
+        can_rel = [p for p in idle if holds[p] > 0]
+        legit_grant = [
+            p for p in waiting
+            if eff == 0 or (0 < holds[p] < cap)
+        ]
+        moves = []
+        if done < n_ops and (can_acq or (reentrant and can_reacq)):
+            moves.append("inv_acq")
+        # releases stay available past the op budget so waiters drain
+        # (holders must free the lock for pending grants to complete)
+        if can_rel and (done < n_ops or waiting):
+            moves.append("inv_rel")
+        if legit_grant:
+            moves.append("grant")
+        elif waiting and corrupt and not corrupted and not releasing:
+            # no legitimate grant exists and no release is open: a
+            # grant here is a definite violation in every ordering
+            moves.append("bad_grant")
+        if releasing:
+            moves.append("ok_rel")
+        if not moves:
+            break  # defensive: the current move set always drains
+        mv = rng.choice(moves)
+        if mv == "inv_acq":
+            pool = can_acq + (can_reacq if reentrant else [])
+            p = pool[rng.randrange(len(pool))]
+            idle.remove(p)
+            hist.append(invoke_op(p, "acquire", None))
+            waiting.append(p)
+            done += 1
+        elif mv == "inv_rel":
+            p = can_rel[rng.randrange(len(can_rel))]
+            idle.remove(p)
+            hist.append(invoke_op(p, "release", None))
+            releasing.append(p)
+            eff -= 1  # the release may linearize from here on
+            done += 1
+        elif mv in ("grant", "bad_grant"):
+            pool = legit_grant if mv == "grant" else waiting
+            p = pool[rng.randrange(len(pool))]
+            waiting.remove(p)
+            holds[p] += 1
+            eff += 1
+            hist.append(ok_op(p, "acquire", {"client": f"c{p}"}))
+            idle.append(p)
+            if mv == "bad_grant":
+                corrupted = True
+        else:  # ok_rel
+            p = releasing.pop(rng.randrange(len(releasing)))
+            holds[p] -= 1
+            hist.append(ok_op(p, "release", {"client": f"c{p}"}))
+            idle.append(p)
+    # Defensive tail (currently unreachable: a move always exists while
+    # waiters remain, so the loop drains them): if a future move-set
+    # change ever strands a waiter, it must leave as an IDENTITY-BEARING
+    # info op — an identity-less open invoke would push the whole
+    # history onto the oracle, which is exponential at contended shapes.
+    for p in waiting:
+        hist.append(info_op(p, "acquire", {"client": f"c{p}"}))
+    h = History(hist)
+    for i, op in enumerate(h):
+        op.index = i
+        op.time = i
+    return h.index_ops()

@@ -521,3 +521,35 @@ def test_reentrant_mutex_dense_kernel_differential():
     weird = models.ReentrantMutex(owner="c", count=0)
     outw = wgl.check_batch(weird, hists[:2])
     assert all(o["engine"].startswith("oracle") for o in outw), outw
+
+
+def test_synth_lock_history_generator():
+    """synth.generate_lock_history (the benchmark corpus): clean
+    histories are valid, corrupt ones definitely invalid, and every
+    history encodes for the device kernels even at contended shapes
+    (engines stay "tpu" — nothing falls back to the oracle)."""
+    import random
+
+    from jepsen_tpu import models, synth
+    from jepsen_tpu.ops import wgl
+
+    rng = random.Random(45105)
+    for reentrant, model in (
+        (False, models.owner_mutex()),
+        (True, models.reentrant_mutex()),
+    ):
+        hists = [
+            synth.generate_lock_history(
+                rng, n_procs=8, n_ops=60, reentrant=reentrant,
+                corrupt=(i % 4 == 0),
+            )
+            for i in range(12)
+        ]
+        # contended: histories are dense with successful cycles
+        assert all(
+            sum(1 for op in h if op.type == "ok") >= 40 for h in hists
+        )
+        out = wgl.check_batch(model, hists)
+        assert {o["engine"] for o in out} == {"tpu"}, wgl.batch_stats(out)
+        got = [o["valid?"] for o in out]
+        assert got == [False if i % 4 == 0 else True for i in range(12)]
