@@ -125,11 +125,10 @@ class _HzBinClient(client_mod.Client):
             self.conn.close()
 
     def _me(self) -> dict:
-        """Client identity for the owner-aware/fenced lock models; the
-        classic (3.x) protocol exposes no fencing token, so the fence
-        stays INVALID (0 — models.locks.INVALID_FENCE, which every
-        fenced model accepts; a CP-subsystem client supplying real
-        fences plugs in here)."""
+        """Client identity for the owner-aware lock models.  The fence
+        here is INVALID (0) — classic lock/semaphore ops carry no
+        token; the fenced workloads' acquires override it with the live
+        CP fencing token (HzLockClient with ``fenced?``)."""
         return {"client": self.conn.uuid, "fence": 0}
 
     def _guard(self, op, body, info_value=None):
@@ -203,20 +202,40 @@ class HzLockClient(_HzBinClient):
     """acquire/release over a distributed lock; completions carry the
     session identity so the owner-aware/reentrant/fenced models know
     WHO acted (reference: hazelcast.clj:117-163 lock-client and
-    :305-371 fenced-lock-client)."""
+    :305-371 fenced-lock-client).  With ``fenced?`` the CP fenced-lock
+    calls are used instead and completions carry the REAL fencing
+    token, so the fence-monotonicity models check live tokens, not the
+    INVALID placeholder."""
 
     @property
     def lock_name(self) -> str:
         return self.opts.get("lock-name", "jepsen.lock")
 
+    @property
+    def fenced(self) -> bool:
+        return bool(self.opts.get("fenced?"))
+
     def invoke(self, test, op):
         def body():
             if op["f"] == "acquire":
+                if self.fenced:
+                    fence = self.conn.try_lock_fenced(
+                        self.lock_name, timeout_ms=5000
+                    )
+                    if fence != hzp.INVALID_FENCE:
+                        return {
+                            **op, "type": "ok",
+                            "value": {**self._me(), "fence": fence},
+                        }
+                    return {**op, "type": "fail", "error": "timeout"}
                 if self.conn.try_lock(self.lock_name, timeout_ms=5000):
                     return {**op, "type": "ok", "value": self._me()}
                 return {**op, "type": "fail", "error": "timeout"}
             if op["f"] == "release":
-                self.conn.unlock(self.lock_name)  # HzError → fail
+                if self.fenced:
+                    self.conn.unlock_fenced(self.lock_name)
+                else:
+                    self.conn.unlock(self.lock_name)  # HzError → fail
                 return {**op, "type": "ok", "value": self._me()}
             raise ValueError(f"unknown f {op['f']!r}")
 
@@ -553,8 +572,10 @@ _CLIENT_OPTS = {
     "lock-no-quorum": {"lock-name": "jepsen.lock.no-quorum"},
     "non-reentrant-cp-lock": {"lock-name": "jepsen.cpLock1"},
     "reentrant-cp-lock": {"lock-name": "jepsen.cpLock2"},
-    "non-reentrant-fenced-lock": {"lock-name": "jepsen.cpLock1"},
-    "reentrant-fenced-lock": {"lock-name": "jepsen.cpLock2"},
+    "non-reentrant-fenced-lock": {"lock-name": "jepsen.cpLock1",
+                                  "fenced?": True},
+    "reentrant-fenced-lock": {"lock-name": "jepsen.cpLock2",
+                              "fenced?": True},
 }
 
 

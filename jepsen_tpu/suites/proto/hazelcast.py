@@ -93,6 +93,15 @@ SEMAPHORE_TRY_ACQUIRE = 0x0D07
 # flake id generator service 0x1C
 FLAKE_ID_NEW_BATCH = 0x1C01
 
+# CP-subsystem fenced lock (4.x CP FencedLock semantics: a successful
+# acquire returns a monotonically increasing fencing token; re-acquires
+# by the holder return the hold's existing token)
+FENCED_LOCK_TRY_LOCK = 0x2603
+FENCED_LOCK_UNLOCK = 0x2604
+
+#: the "acquire failed" fence (CP FencedLock.INVALID_FENCE)
+INVALID_FENCE = 0
+
 # serialization constant type ids (big-endian int32 before the body)
 TYPE_LONG = -7
 TYPE_STRING = -11
@@ -379,6 +388,23 @@ class HzClient:
     def unlock(self, name: str) -> None:
         self._invoke(
             LOCK_UNLOCK, _str(name) + _long(self.thread_id) + _long(0)
+        )
+
+    def try_lock_fenced(
+        self, name: str, timeout_ms: int = 0
+    ) -> int:
+        """CP fenced lock: returns the fencing token on success,
+        INVALID_FENCE (0) on timeout.  A holder's re-acquire returns
+        the hold's existing token."""
+        r = self._invoke(
+            FENCED_LOCK_TRY_LOCK,
+            _str(name) + _long(self.thread_id) + _long(timeout_ms),
+        )
+        return r.i64()
+
+    def unlock_fenced(self, name: str) -> None:
+        self._invoke(
+            FENCED_LOCK_UNLOCK, _str(name) + _long(self.thread_id)
         )
 
     # -- semaphore --

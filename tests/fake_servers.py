@@ -2679,6 +2679,7 @@ class _HazelcastHandler(_RecvExact, socketserver.BaseRequestHandler):
             st.hz_maps = {}        # name -> {key bytes: value bytes}
             st.hz_queues = {}      # name -> list[bytes]
             st.hz_locks = {}       # name -> (uuid, thread_id, count)
+            st.hz_flocks = {}      # name -> [holder|None, count, fence, next_fence]
             st.hz_sems = {}        # name -> available permits
             st.hz_longs = {}       # name -> int
             st.hz_refs = {}        # name -> bytes | None
@@ -2840,6 +2841,50 @@ class _HazelcastHandler(_RecvExact, socketserver.BaseRequestHandler):
                         self._error(
                             corr, "IllegalMonitorStateException",
                             "not the lock owner",
+                        )
+                    else:
+                        self._reply(corr, hz.RESP_VOID)
+
+                elif mtype == hz.FENCED_LOCK_TRY_LOCK:
+                    name = r.string()
+                    tid = r.i64()
+                    timeout = r.i64()
+                    deadline = _t.monotonic() + timeout / 1000.0
+                    me = (client_uuid, tid)
+                    fence = 0
+                    while True:
+                        with st.lock:
+                            lk = st.hz_flocks.setdefault(
+                                name, [None, 0, 0, 1]
+                            )
+                            if lk[0] is None:
+                                lk[0], lk[1] = me, 1
+                                lk[2] = lk[3]  # grant a fresh token
+                                lk[3] += 1
+                                fence = lk[2]
+                            elif lk[0] == me:
+                                lk[1] += 1
+                                fence = lk[2]  # reuse the hold's token
+                        if fence or _t.monotonic() >= deadline:
+                            break
+                        _t.sleep(0.002)
+                    self._reply(corr, hz.RESP_LONG, _s.pack("<q", fence))
+                elif mtype == hz.FENCED_LOCK_UNLOCK:
+                    name = r.string()
+                    tid = r.i64()
+                    me = (client_uuid, tid)
+                    with st.lock:
+                        lk = st.hz_flocks.get(name)
+                        err = lk is None or lk[0] != me
+                        if not err:
+                            lk[1] -= 1
+                            if lk[1] == 0:
+                                lk[0] = None
+                                lk[2] = 0
+                    if err:
+                        self._error(
+                            corr, "IllegalMonitorStateException",
+                            "not the fenced-lock owner",
                         )
                     else:
                         self._reply(corr, hz.RESP_VOID)

@@ -102,6 +102,53 @@ def test_hz_proto_roundtrip():
         s.stop()
 
 
+def test_hz_fenced_lock_tokens_are_monotonic():
+    """CP fenced lock: grants carry strictly increasing tokens across
+    holds; a holder's re-acquire reuses the hold's token; contended
+    tryLock times out with INVALID_FENCE; non-owner unlock errors."""
+    from jepsen_tpu.suites.proto.hazelcast import INVALID_FENCE
+
+    s = FakeHazelcast().start()
+    try:
+        c1 = HzClient("127.0.0.1", s.port).connect()
+        c2 = HzClient("127.0.0.1", s.port).connect()
+        f1 = c1.try_lock_fenced("FL")
+        assert f1 != INVALID_FENCE
+        # re-acquire returns the same token (reentrant hold)
+        assert c1.try_lock_fenced("FL") == f1
+        # contended: invalid fence
+        assert c2.try_lock_fenced("FL", timeout_ms=10) == INVALID_FENCE
+        with pytest.raises(HzError):
+            c2.unlock_fenced("FL")
+        c1.unlock_fenced("FL")
+        c1.unlock_fenced("FL")  # second hold
+        f2 = c2.try_lock_fenced("FL")
+        assert f2 > f1  # strictly increasing across holds
+        c2.unlock_fenced("FL")
+        c1.close()
+        c2.close()
+    finally:
+        s.stop()
+
+
+def test_hz_fenced_workloads_carry_real_tokens():
+    """The fenced workloads' clients stamp live fencing tokens on
+    completions (not the INVALID placeholder), so the
+    fence-monotonicity models check real tokens end-to-end."""
+    s = FakeHazelcast().start()
+    try:
+        t = _suite_test(s, "non-reentrant-fenced-lock")
+        c = t["client"].open(t, "n1")
+        r1 = c.invoke(t, {"f": "acquire", "type": "invoke", "value": None})
+        assert r1["type"] == "ok" and r1["value"]["fence"] >= 1
+        c.invoke(t, {"f": "release", "type": "invoke", "value": None})
+        r2 = c.invoke(t, {"f": "acquire", "type": "invoke", "value": None})
+        assert r2["value"]["fence"] > r1["value"]["fence"]
+        c.close(t)
+    finally:
+        s.stop()
+
+
 def test_hz_crdt_map_targets_crdt_map_name():
     """The crdt-map workload must drive jepsen.crdt-map, not the plain
     map (reference: hazelcast.clj:450-451 map-name/crdt-map-name)."""
