@@ -71,6 +71,7 @@ def main():
             out.append(bool(strongly_connected_components(g)))
         return np.array(out)
 
+    rows = []
     for count, n, p in ((4096, 16, 0.15), (2048, 64, 0.05), (256, 256, 0.02)):
         mats = random_graphs(rng, count, n, p)
         dev, dev_rate = bench(
@@ -79,8 +80,47 @@ def main():
         cpu, cpu_rate = bench(f"cpu-scc n={n:<4} B={count:<5}", cpu_scc, mats)
         agree = (np.asarray(dev) == cpu).all()
         print(f"  agree={bool(agree)}  speedup={dev_rate / cpu_rate:.1f}x")
+        rows.append({
+            "n": n, "B": count, "device_gps": round(dev_rate, 1),
+            "cpu_scc_gps": round(cpu_rate, 1),
+            "speedup": round(dev_rate / cpu_rate, 2),
+            "agree": bool(agree), "platform": platform,
+        })
         if not agree:
-            raise SystemExit("device and CPU disagree!")
+            break  # persist the disagreement row, THEN fail below
+
+    # persist: the watcher keeps only a short stdout tail, and on-chip
+    # windows are too rare to lose.  Per-platform files (a CPU fallback
+    # run must never clobber an on-chip capture), written atomically
+    # (temp + rename) so a mid-write death can't corrupt the previous
+    # capture, and OSError-guarded so a full disk doesn't turn a good
+    # measurement run into a failure.
+    import datetime
+    import json
+
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"elle_results_{platform}.json",
+    )
+    try:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "measured_at": datetime.datetime.now(
+                        datetime.timezone.utc
+                    ).isoformat(timespec="seconds"),
+                    "results": rows,
+                },
+                f, indent=1,
+            )
+            f.write("\n")
+        os.replace(tmp, out_path)
+        print(f"wrote {out_path}")
+    except OSError as e:
+        print(f"persist failed: {e!r}", file=sys.stderr)
+    if rows and not rows[-1]["agree"]:
+        raise SystemExit("device and CPU disagree!")
 
 
 if __name__ == "__main__":
