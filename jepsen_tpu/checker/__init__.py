@@ -591,7 +591,8 @@ class _Linearizable(Checker):
 
         def oracle():
             out = linear.analysis(
-                self.model, history, pure_fs=self.pure_fs, witness=True
+                self.model, history, pure_fs=self.pure_fs, witness=True,
+                budget_s=self.oracle_budget_s,
             )
             out["engine"] = "oracle"
             return out
@@ -628,7 +629,13 @@ class _Linearizable(Checker):
             last = out or last
         return last or {"valid?": "unknown", "error": "no arm finished"}
 
-    def __init__(self, model, algorithm: str = "auto", pure_fs=("read",)):
+    def __init__(
+        self,
+        model,
+        algorithm: str = "auto",
+        pure_fs=("read",),
+        oracle_budget_s=None,
+    ):
         if model is None:
             raise ValueError(
                 "The linearizable checker requires a model. It received None."
@@ -636,6 +643,11 @@ class _Linearizable(Checker):
         self.model = model
         self.algorithm = algorithm
         self.pure_fs = tuple(pure_fs)
+        #: wall-time bound for the exponential CPU oracle search; past
+        #: it the verdict is an honest "unknown" (check-safe semantics,
+        #: checker.clj:74-85) instead of an analysis that hangs for
+        #: hours on one poisoned key (the knossos blowup class)
+        self.oracle_budget_s = oracle_budget_s
 
     def check(self, test, history, opts=None):
         from . import linear
@@ -662,14 +674,17 @@ class _Linearizable(Checker):
         elif algorithm == "tpu":
             from ..ops import wgl
 
-            a = wgl.analysis(self.model, history)
+            a = wgl.analysis(
+                self.model, history, oracle_budget_s=self.oracle_budget_s
+            )
         else:
             # witness=True tracks parent pointers (one dict insert per
             # new config, reset per completed op) so a failing analysis
             # already carries final-paths/ops — render_witness would
             # otherwise rerun the whole exponential search from scratch
             a = linear.analysis(
-                self.model, history, pure_fs=self.pure_fs, witness=True
+                self.model, history, pure_fs=self.pure_fs, witness=True,
+                budget_s=self.oracle_budget_s,
             )
         # Failure witness: linear.svg with final configs/paths around the
         # non-linearizable op (reference: checker.clj:206-210, where
@@ -690,7 +705,8 @@ class _Linearizable(Checker):
                     test, *(opts or {}).get("subdirectory", []), "linear.svg"
                 )
                 if linear_svg.render_witness(
-                    self.model, history, a, out, pure_fs=self.pure_fs
+                    self.model, history, a, out, pure_fs=self.pure_fs,
+                    budget_s=self.oracle_budget_s,
                 ):
                     a["witness"] = out
             except Exception as e:  # noqa: BLE001 — never mask the verdict
@@ -705,13 +721,21 @@ class _Linearizable(Checker):
         return a
 
 
-def linearizable(model, algorithm: str = "auto", pure_fs=("read",)) -> Checker:
+def linearizable(
+    model,
+    algorithm: str = "auto",
+    pure_fs=("read",),
+    oracle_budget_s=None,
+) -> Checker:
     """Validate linearizability against a model.  algorithm: "auto"
     (TPU kernel when the model has one, else oracle), "tpu", "oracle",
     or "race" (kernel vs oracle concurrently, first definite verdict
-    wins — knossos's competition mode).
+    wins — knossos's competition mode).  ``oracle_budget_s`` bounds the
+    exponential CPU search's wall time; past it the verdict is an
+    honest "unknown" (check-safe semantics, checker.clj:74-85) instead
+    of an analysis hanging for hours on one poisoned key.
     (reference: checker.clj:185-216)"""
-    return _Linearizable(model, algorithm, pure_fs)
+    return _Linearizable(model, algorithm, pure_fs, oracle_budget_s)
 
 
 class _LogFilePattern(Checker):

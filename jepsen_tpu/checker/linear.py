@@ -133,14 +133,24 @@ def _closure(
     ops: list,
     max_configs: int,
     parents: Optional[Dict] = None,
+    deadline: Optional[float] = None,
 ) -> Tuple[Set[Tuple[Model, FrozenSet[int]]], bool]:
     """Expand configs by linearizing open ops until fixpoint.
-    Returns (configs, overflowed?).  When ``parents`` is given, each
+    Returns (configs, reason) with reason None (fixpoint reached),
+    "configs" (max_configs blown), or "deadline" (budget blown).  When
+    ``parents`` is given, each
     newly reached config records (parent-config, op-id) so a witness
-    path can be reconstructed for failure reports."""
+    path can be reconstructed for failure reports.  A ``deadline``
+    (time.monotonic timestamp) bounds WALL TIME the way max_configs
+    bounds memory: blown budgets report overflowed, which the caller
+    turns into an honest "unknown"."""
+    import time as _time
+
     frontier = configs
     seen = set(configs)
     while frontier:
+        if deadline is not None and _time.monotonic() > deadline:
+            return seen, "deadline"
         new: Set[Tuple[Model, FrozenSet[int]]] = set()
         for model, linset in frontier:
             for op_id in open_ops:
@@ -157,9 +167,9 @@ def _closure(
                     if parents is not None:
                         parents[cfg] = ((model, linset), op_id)
                     if len(seen) > max_configs:
-                        return seen, True
+                        return seen, "configs"
         frontier = new
-    return seen, False
+    return seen, None
 
 
 def _final_paths(
@@ -211,6 +221,7 @@ def analysis(
     pure_fs: Iterable[Any] = (),
     max_configs: int = DEFAULT_MAX_CONFIGS,
     witness: bool = False,
+    budget_s: Optional[float] = None,
 ) -> dict:
     """Check history against model. Returns
     {"valid?": True|False|"unknown", ...} with a witness :op on failure
@@ -218,7 +229,17 @@ def analysis(
     checker.clj:213-216).  ``witness=True`` additionally reconstructs
     ``final-paths`` (one linearization path per surviving config since
     the last completed op) and ``op-ids``/``ops`` context for the
-    failure-witness renderer."""
+    failure-witness renderer.
+
+    ``budget_s`` bounds wall time: the exponential search (knossos
+    class — its docs warn of runs taking hours) reports an honest
+    "unknown" past the budget instead of hanging a whole analysis on
+    one poisoned key.  None (the default) keeps the search unbounded."""
+    import time as _time
+
+    deadline = (
+        _time.monotonic() + budget_s if budget_s is not None else None
+    )
     events, ops = prepare(history, pure_fs)
 
     configs: Set[Tuple[Model, FrozenSet[int]]] = {(model, frozenset())}
@@ -230,12 +251,18 @@ def analysis(
             open_ops.add(op_id)
         elif kind == OK:
             configs, overflow = _closure(
-                configs, open_ops, ops, max_configs, parents
+                configs, open_ops, ops, max_configs, parents, deadline
             )
             if overflow:
                 return {
                     "valid?": "unknown",
-                    "error": f"config set exceeded {max_configs}; aborting search",
+                    "error": (
+                        f"oracle time budget ({budget_s}s) exceeded; "
+                        "aborting search"
+                        if overflow == "deadline"
+                        else f"config set exceeded {max_configs}; "
+                        "aborting search"
+                    ),
                     "op": ops[op_id].to_dict(),
                 }
             # keep configs that linearized op_id; promote it into the prefix

@@ -55,19 +55,26 @@ def render_witness(
     result: dict,
     path: str,
     pure_fs=(),
+    budget_s=None,
 ) -> Optional[str]:
     """Render the failure witness for an invalid analysis to ``path``.
     Reruns the CPU oracle with witness tracking when ``result`` lacks
-    path data (the TPU kernel reports verdicts only).  Returns the path,
-    or None when the analysis isn't a definite failure."""
+    path data (the TPU kernel reports verdicts only) — under
+    ``budget_s`` when given, so a kernel-found failure on an
+    exponential-class history can't hang witness rendering.  Returns
+    the path, or None when the analysis isn't a definite failure (or
+    the budgeted rerun came back unknown)."""
     from . import linear
 
     if result.get("valid?") is not False:
         return None
     if "final-paths" not in result or "ops" not in result:
-        result = linear.analysis(model, history, pure_fs=pure_fs, witness=True)
+        result = linear.analysis(
+            model, history, pure_fs=pure_fs, witness=True,
+            budget_s=budget_s,
+        )
         if result.get("valid?") is not False:
-            return None  # oracle disagrees (shouldn't happen) — no witness
+            return None  # oracle disagrees or budget blown — no witness
 
     ops: List[dict] = result["ops"]
     failed_id: int = result["failed-op-id"]

@@ -555,6 +555,7 @@ def check_batch(
     oracle_fallback: bool = True,
     sufficient_rung: bool = True,
     max_dispatch: int = DEFAULT_MAX_DISPATCH,
+    oracle_budget_s: Optional[float] = None,
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
@@ -699,7 +700,8 @@ def check_batch(
                     }
                     continue
                 results[hist_idx] = linear.analysis(
-                    model, histories[hist_idx], pure_fs=spec.pure_fs
+                    model, histories[hist_idx], pure_fs=spec.pure_fs,
+                    budget_s=oracle_budget_s,
                 )
                 results[hist_idx]["engine"] = "oracle-overflow"
             elif ok[row]:
@@ -721,7 +723,10 @@ def check_batch(
             results[hist_idx] = {"valid?": "unknown", "engine": "unencodable"}
             continue
         pure = spec.pure_fs if spec else ()
-        results[hist_idx] = linear.analysis(model, histories[hist_idx], pure_fs=pure)
+        results[hist_idx] = linear.analysis(
+            model, histories[hist_idx], pure_fs=pure,
+            budget_s=oracle_budget_s,
+        )
         results[hist_idx]["engine"] = "oracle-fallback"
 
     return results  # type: ignore[return-value]

@@ -477,7 +477,19 @@ def lock_workload(
         g = gen.limit(limit, g)
     return {
         "generator": g,
-        "checker": checker_mod.linearizable(model, pure_fs=()),
+        # the fenced/permit models are oracle-only; a contended INVALID
+        # history is the exponential blowup class, so the search gets a
+        # wall-time budget (verdict "unknown" past it) instead of
+        # hanging the whole analysis
+        "checker": checker_mod.linearizable(
+            model, pure_fs=(),
+            # "oracle-budget": seconds, or None for an unbounded search
+            oracle_budget_s=(
+                float(opts["oracle-budget"])
+                if opts.get("oracle-budget", 300) is not None
+                else None
+            ) if "oracle-budget" in opts else 300.0,
+        ),
     }
 
 

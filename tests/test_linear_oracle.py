@@ -309,3 +309,38 @@ def test_nemesis_ops_ignored():
         ),
     )
     assert out["valid?"] is True
+
+
+def test_oracle_wall_time_budget_returns_unknown():
+    """budget_s bounds the oracle's wall time (the knossos exponential
+    class "can take hours"); past the deadline the verdict is an
+    honest "unknown" — and a generous budget leaves tractable
+    verdicts untouched."""
+    import random
+
+    from jepsen_tpu import checker as checker_mod
+    from jepsen_tpu import models, synth
+    from jepsen_tpu.checker import linear
+
+    rng = random.Random(45105)
+    h = synth.generate_lock_history(
+        rng, n_procs=8, n_ops=60, corrupt=True
+    )
+    # an already-expired deadline: the first closure reports the blown
+    # budget deterministically (no timing races in the test)
+    out = linear.analysis(models.fenced_mutex(), h, budget_s=0.0)
+    assert out["valid?"] == "unknown", out
+    # the error names the blown knob (budget vs max_configs)
+    assert "time budget" in out["error"], out
+
+    # the checker-level opt threads through
+    chk = checker_mod.linearizable(
+        models.fenced_mutex(), pure_fs=(), oracle_budget_s=0.0
+    )
+    assert chk.check({}, h)["valid?"] == "unknown"
+
+    # a generous budget leaves tractable verdicts untouched
+    out3 = linear.analysis(models.fenced_mutex(), h, budget_s=60.0)
+    assert out3["valid?"] is False, out3
+    out4 = linear.analysis(models.owner_mutex(), h, budget_s=60.0)
+    assert out4["valid?"] is False, out4
