@@ -602,3 +602,35 @@ def test_check_batch_survives_undispatchable_sufficient_rung():
     assert [o["valid?"] for o in out] == [o["valid?"] for o in base]
     # every row came from the oracle: no frontier dispatch was safe
     assert all(o["engine"] == "oracle-overflow" for o in out)
+
+
+def test_lock_models_frontier_kernel_matches_oracle():
+    """The lock models' FRONTIER path (max_closure forces the generic
+    kernel; owner-mutex steps via cas codes, reentrant via its own
+    algebra) must agree with the oracle verdict-for-verdict, including
+    through escalation at tiny capacities."""
+    from jepsen_tpu import models, synth
+
+    rng = random.Random(45106)
+    for reentrant, model in (
+        (False, models.owner_mutex()),
+        (True, models.reentrant_mutex()),
+    ):
+        hists = [
+            synth.generate_lock_history(
+                rng, n_procs=5, n_ops=24, reentrant=reentrant,
+                corrupt=(i % 3 == 0),
+            )
+            for i in range(12)
+        ]
+        oracle = [
+            linear.analysis(model, h0)["valid?"] for h0 in hists
+        ]
+        outs = wgl.check_batch(
+            model, hists, frontier=4, escalation=(4,), max_closure=8,
+        )
+        assert [o["valid?"] for o in outs] == oracle, reentrant
+        stats = wgl.batch_stats(outs)
+        assert stats["device-rate"] == 1.0, stats
+        assert stats["kernels"].get("frontier", 0) > 0, stats
+        assert True in oracle and False in oracle
