@@ -233,17 +233,26 @@ def single_test_cmd(
         from . import checker as checker_mod
         from . import store as store_mod
 
-        stored = (
-            store_mod.load(
-                {
-                    "name": args.test_name,
-                    "start-time": args.test_time,
-                    "store-base": args.store_base,
-                }
+        if args.test_name:
+            # --test-name without --test-time means the test's most
+            # recent run (reference: `lein run analyze` defaults to
+            # the latest run the same way)
+            start = args.test_time or store_mod.latest_time(
+                args.store_base, args.test_name
             )
-            if args.test_name
-            else store_mod.latest(args.store_base)
-        )
+            stored = (
+                store_mod.load(
+                    {
+                        "name": args.test_name,
+                        "start-time": start,
+                        "store-base": args.store_base,
+                    }
+                )
+                if start is not None
+                else None
+            )
+        else:
+            stored = store_mod.latest(args.store_base)
         if stored is None:
             print("no stored test found", file=sys.stderr)
             return EXIT_USAGE

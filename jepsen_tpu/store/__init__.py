@@ -248,6 +248,22 @@ def tests(base: str = BASE, name: Optional[str] = None) -> Dict[str, List[str]]:
     return out
 
 
+def latest_time(base: str, name: str) -> Optional[str]:
+    """The most recent start-time recorded for a named test — via the
+    per-test "latest" symlink when present AND still pointing at a run
+    dir (a dangling link falls back to the listing, like latest()),
+    else the newest surviving run dir.  (start-times are ISO-ish
+    timestamps, so lexicographic max = newest)"""
+    link = os.path.join(base, name, "latest")
+    if os.path.islink(link):
+        target = os.path.realpath(link)
+        start = os.path.basename(target)
+        if start and start != "latest" and os.path.isdir(target):
+            return start
+    runs = tests(base, name).get(name, ())
+    return max(runs) if runs else None
+
+
 def latest(base: str = BASE) -> Optional[dict]:
     """The most recently saved test, via the latest symlink or listing.
     (reference: repl.clj:6-15)"""

@@ -394,7 +394,13 @@ def build_test(
     # store location / logging flags (the CLI merges these into opts;
     # reference: cli.clj test-opt-fn feeding every suite's test map)
     for k in ("store-base", "leave-db-running?", "logging-json?", "ssh",
-              "remote", "time-limit", "mesh", "mesh-fn"):
+              "remote", "time-limit", "mesh", "mesh-fn",
+              # persisted so `analyze` can rebuild THIS suite's checker
+              # from the stored map (without them a resumed analysis
+              # would silently run the default workload's checker over
+              # a foreign history; reference: cli.clj:402-431 analyze
+              # re-invokes the same test-fn with the stored opts)
+              "suite", "workload"):
         if k in opts:
             test[k] = opts[k]
     if "nodes" in opts:

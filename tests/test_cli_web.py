@@ -174,6 +174,44 @@ def test_cli_suite_run(tmp_path):
     assert rc == 0
 
 
+def test_cli_analyze_suite_run_rebuilds_suite_checker(tmp_path, capsys):
+    """`analyze --test-name X` (no --test-time) resolves the test's
+    LATEST run, and a suite run's stored map carries suite+workload so
+    the re-analysis rebuilds the SUITE's composed checker — not the
+    default workload's (which would vacuously pass a foreign
+    history)."""
+    from fake_servers import FakeHttpKv
+    from jepsen_tpu import cli
+
+    base = str(tmp_path)
+    s = FakeHttpKv().start()
+    try:
+        rc = cli.run_cli(cli.default_commands(), [
+            "test", "--suite", "etcd", "--workload", "set",
+            "--nodes", "n1", "--dummy", "--time-limit", "1",
+            "--rate", "30", "--store-base", base,
+            "-o", "host=127.0.0.1", "-o", f"port={s.port}",
+        ])
+    finally:
+        s.stop()
+    assert rc == 0
+    stored = store.load({
+        "name": "etcd-set",
+        "start-time": store.latest_time(base, "etcd-set"),
+        "store-base": base,
+    })
+    assert stored["suite"] == "etcd" and stored["workload"] == "set"
+    capsys.readouterr()
+    rc = cli.run_cli(cli.default_commands(), [
+        "analyze", "--test-name", "etcd-set", "--store-base", base,
+    ])
+    assert rc == cli.EXIT_VALID
+    out = capsys.readouterr().out
+    # the suite's composed checker ran (workload/stats/exceptions/perf)
+    for key in ('"workload"', '"stats"', '"exceptions"', '"perf"'):
+        assert key in out, out[:400]
+
+
 def test_cli_mesh_flag_shards_analysis(tmp_path, monkeypatch):
     """--mesh installs a lazy mesh builder; on the 8-virtual-device CPU
     backend the analysis batch genuinely shards over all devices and
