@@ -303,10 +303,13 @@ def serve_cmd() -> Dict[str, dict]:
 
 
 def test_all_cmd(
-    tests_fn: Callable[[dict], List[dict]],
+    tests_fn: Callable[[dict], List[Callable[[], dict]]],
     opt_fn: Optional[Callable[[argparse.ArgumentParser], None]] = None,
 ) -> Dict[str, dict]:
     """Run every test a suite defines; worst exit code wins.
+    ``tests_fn`` returns zero-arg BUILDERS, one per test, so a single
+    test's construction error (like its run-time crash) folds into the
+    worst-wins aggregate instead of aborting the whole sweep.
     (reference: cli.clj:491-519)"""
 
     def add_opts(p):
@@ -316,9 +319,20 @@ def test_all_cmd(
 
     def run(args) -> int:
         worst = EXIT_VALID
-        for test in tests_fn({**given_opts(args), **test_opts_to_map(args)}):
-            code = run_test(test)
-            worst = max(worst, code)
+        for _ in range(getattr(args, "test_count", 1) or 1):
+            for build in tests_fn(
+                {**given_opts(args), **test_opts_to_map(args)}
+            ):
+                try:
+                    code = run_test(build())
+                except Exception:  # noqa: BLE001 — one crash (building
+                    # OR running) must not swallow the remaining tests'
+                    # results; it folds into the worst-wins aggregate
+                    # (reference: cli.clj test-all catches per-test
+                    # throwables and continues)
+                    traceback.print_exc()
+                    code = EXIT_CRASH
+                worst = max(worst, code)
         return worst
 
     return {"test-all": {"help": "run every defined test",
@@ -407,7 +421,14 @@ def default_commands() -> Dict[str, dict]:
     def make_test(opts: dict) -> dict:
         from . import generator as gen
         from . import workloads
-        from .fake import KeyedAtomClient
+        from .fake import (
+            BankAtomClient,
+            CausalAtomClient,
+            InsertOnceAtomClient,
+            KeyedAtomClient,
+            KeyedAtomSetClient,
+            TxnAtomClient,
+        )
 
         opts = dict(opts)
         if "per_key_limit" in opts:
@@ -438,13 +459,32 @@ def default_commands() -> Dict[str, dict]:
         g = wl.get("generator")
         if opts.get("time-limit"):
             g = gen.time_limit(opts["time-limit"], g)
+        # per-workload fake client: the CAS-register fake fits the
+        # keyed register/txn probes, but bank needs transfer/balance
+        # semantics and the causal/sequential probes need reads that
+        # return the SET of observed writes
+        fake_client = {
+            "bank": BankAtomClient,
+            "causal": CausalAtomClient,
+            "causal-reverse": KeyedAtomSetClient,
+            "long-fork": TxnAtomClient,
+            "list-append": TxnAtomClient,
+            "rw-register": TxnAtomClient,
+            "adya-g2": InsertOnceAtomClient,
+        }.get(opts["workload"], KeyedAtomClient)()
         test = {
             # strip stray callables from opts — except the lazy mesh
             # builder, which the checker seam resolves at analyze time
             **{k: v for k, v in opts.items()
                if not callable(v) or k == "mesh-fn"},
+            # workload defaults (e.g. bank's accounts/total-amount)
+            # flow into the test map — generators and checkers read
+            # them from there; explicit opts still win
+            **{k: v for k, v in wl.items()
+               if k not in ("generator", "final-generator", "checker",
+                            "concurrency") and k not in opts},
             "name": opts["workload"],
-            "client": KeyedAtomClient(),
+            "client": fake_client,
             "generator": g,
             "checker": wl.get("checker"),
         }
@@ -457,8 +497,33 @@ def default_commands() -> Dict[str, dict]:
 
         return trace.wire(test, opts.get("tracing"))
 
+    def make_tests(opts: dict) -> List[Callable[[], dict]]:
+        """One test BUILDER per workload: every workload of --suite,
+        or every in-process workload without one.  (reference:
+        cli.clj:491-519 test-all-cmd)"""
+        if opts.get("suite"):
+            from . import suites
+
+            # one eager workloads() build just for the name list (each
+            # make_test→suite.test rebuilds its own) — construction
+            # cost only, accepted for the 10-20 workloads suites carry
+            names = sorted(
+                suites.suite(opts["suite"]).workloads(
+                    {k: v for k, v in opts.items() if k != "workload"}
+                )
+            )
+        else:
+            from . import workloads as workloads_mod
+
+            names = workloads_mod.names()
+        return [
+            (lambda w=w: make_test({**opts, "workload": w}))
+            for w in names
+        ]
+
     cmds: Dict[str, dict] = {}
     cmds.update(single_test_cmd(make_test, add_workload_opt))
+    cmds.update(test_all_cmd(make_tests, add_workload_opt))
     cmds.update(serve_cmd())
     return cmds
 

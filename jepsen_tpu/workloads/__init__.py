@@ -34,6 +34,18 @@ def noop_test() -> dict:
 def workload(name: str, opts: dict | None = None) -> dict:
     """Look up a workload package by name."""
     opts = opts or {}
+    table = _table(opts)
+    if name not in table:
+        raise KeyError(f"unknown workload {name!r}; known: {sorted(table)}")
+    return table[name]()
+
+
+def names() -> list:
+    """Every in-process workload name (for test-all and --help)."""
+    return sorted(_table({}))
+
+
+def _table(opts: dict) -> dict:
     from . import (  # local imports keep startup light
         adya,
         bank,
@@ -50,14 +62,18 @@ def workload(name: str, opts: dict | None = None) -> dict:
         "long-fork": lambda: long_fork.workload(opts.get("group-size", 2)),
         "causal": lambda: causal.test(opts),
         "causal-reverse": lambda: causal_reverse.workload(opts),
+        # the paired-insert generator runs 2 threads per key, so the
+        # worker count must divide evenly (the reference's
+        # concurrent-generator asserts the same, independent.clj);
+        # default 1n x 5 nodes = 5 workers would crash
         "adya-g2": lambda: {
-            "generator": adya.g2_gen(),
-            "checker": adya.g2_checker(),
+            **adya.workload(opts),
+            "concurrency": 2 * len(
+                opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+            ),
         },
         "linearizable-register": lambda: linearizable_register.test(opts),
         "list-append": lambda: cycle_append.test(opts),
         "rw-register": lambda: cycle_wr.test(opts),
     }
-    if name not in table:
-        raise KeyError(f"unknown workload {name!r}; known: {sorted(table)}")
-    return table[name]()
+    return table

@@ -174,6 +174,41 @@ def test_cli_suite_run(tmp_path):
     assert rc == 0
 
 
+def test_cli_test_all_runs_every_in_process_workload(tmp_path):
+    """`test-all --dummy` runs EVERY in-process workload to a valid
+    verdict — each against a semantically matching fake client (bank
+    gets transfers/balances, causal-reverse gets set-reads; reference:
+    cli.clj:491-519 test-all-cmd).  This is the regression net for the
+    workload-default merge (bank's accounts) and the per-workload fake
+    client table."""
+    from jepsen_tpu import workloads as workloads_mod
+
+    base = str(tmp_path)
+    rc = cli.run_cli(cli.default_commands(), [
+        "test-all", "--dummy", "--time-limit", "1", "--store-base", base,
+    ])
+    assert rc == cli.EXIT_VALID
+    ran = {n for n in os.listdir(base) if not n.startswith((".", "latest",
+                                                           "current"))}
+    assert ran == set(workloads_mod.names()), ran
+    # non-vacuous: every workload's history contains SUCCESSFUL ops —
+    # a fake client that rejects a workload's op shapes would crash
+    # every invocation to :info and pass its checker on an empty
+    # ok-history (causal is exempt from a minimum: its generator paces
+    # ops at ~1/s by design, so a 1 s run may complete only a couple)
+    import glob
+    import json as _json
+
+    for w in ran:
+        hist = sorted(glob.glob(os.path.join(base, w, "*",
+                                             "history.jsonl")))[-1]
+        n_ok = sum(
+            1 for line in open(hist)
+            if _json.loads(line)["type"] == "ok"
+        )
+        assert n_ok > 0, f"{w}: no successful ops — wrong fake client?"
+
+
 def test_cli_analyze_suite_run_rebuilds_suite_checker(tmp_path, capsys):
     """`analyze --test-name X` (no --test-time) resolves the test's
     LATEST run, and a suite run's stored map carries suite+workload so
