@@ -12,6 +12,7 @@ with version-checked ``setData`` for CAS).
 from __future__ import annotations
 
 import json
+import uuid
 from typing import Any, Optional
 
 from .. import checker as checker_mod
@@ -169,10 +170,12 @@ class ZkRegisterClient(client_mod.Client):
 class ZkLockClient(client_mod.Client):
     """Distributed try-lock over a well-known znode: acquire = create
     (NODE_EXISTS → definite fail), release = delete of our own node —
-    the classic ZooKeeper lock recipe, checked against the mutex model
-    exactly as the reference checks its distributed-lock clients
-    (hazelcast.clj:340-449 fenced-lock/lock; the knossos mutex model
-    consumed at jepsen/src/jepsen/checker.clj:19-26).
+    the classic ZooKeeper lock recipe, checked against the OWNER-AWARE
+    mutex model: completions carry the ZK session id, so the checker
+    catches not just double grants but releases by a non-holder
+    (reference: hazelcast.clj:340-449 lock clients + the knossos mutex
+    model consumed at jepsen/src/jepsen/checker.clj:19-26; the
+    owner-aware reduction rides the dense device kernel).
 
     The client refuses double-acquires and releases-without-holding
     locally (definite fails that never touch the wire).  A connection
@@ -186,6 +189,7 @@ class ZkLockClient(client_mod.Client):
         self.opts = opts or {}
         self.conn: Optional[ZkClient] = None
         self.held = False
+        self.uid = uuid.uuid4().hex[:8]
 
     def open(self, test, node):
         c = type(self)(self.opts)
@@ -195,6 +199,16 @@ class ZkLockClient(client_mod.Client):
             timeout=self.opts.get("timeout", 10.0),
         )
         return c
+
+    def _me(self) -> dict:
+        """A per-opened-client identity for the owner-aware model.
+        Deliberately NOT the ZK session id: the connection is lazy, so
+        a crash during the handshake would stamp the shared sentinel
+        0 and collide distinct clients on one phantom owner — and an
+        identity must stay stable across ALL of one client's ops.  One
+        client ≈ one session for this recipe, so the per-open id keeps
+        the model's owner semantics faithful."""
+        return {"client": f"zk-{self.uid}"}
 
     def invoke(self, test, op):
         try:
@@ -208,7 +222,7 @@ class ZkLockClient(client_mod.Client):
                         return {**op, "type": "fail", "error": "taken"}
                     raise
                 self.held = True
-                return {**op, "type": "ok"}
+                return {**op, "type": "ok", "value": self._me()}
             if op["f"] == "release":
                 if not self.held:
                     return {**op, "type": "fail", "error": "not-held"}
@@ -227,13 +241,20 @@ class ZkLockClient(client_mod.Client):
                                 "error": "lock vanished while held"}
                     raise
                 self.held = False
-                return {**op, "type": "ok"}
+                return {**op, "type": "ok", "value": self._me()}
             raise ValueError(f"unknown f {op['f']!r}")
-        except IndeterminateError as e:
+        except (IndeterminateError, OSError) as e:
             # a cut connection loses track of whether we hold the lock;
-            # assume not (never release what we might not own)
+            # assume not (never release what we might not own).  OSError
+            # covers the lazy handshake dying raw (ConnectionRefused
+            # etc.) — without this catch the interpreter's crash path
+            # would record an identity-less info op, pushing the WHOLE
+            # history off the kernel onto the exponential oracle.  The
+            # info op still says WHO may have acted, so the model can
+            # linearize it (checker/linear.py info-value propagation)
             self.held = False
-            return {**op, "type": "info", "error": str(e)}
+            return {**op, "type": "info", "error": str(e),
+                    "value": self._me()}
         except ZkError as e:
             return {**op, "type": "fail", "error": str(e)}
 
@@ -243,9 +264,10 @@ class ZkLockClient(client_mod.Client):
 
 
 def lock_workload(opts: Optional[dict] = None) -> dict:
-    """Contended try-lock/release cycles checked against the mutex
-    model — the product consumer of the mutex linearizability kernel
-    (ops/step_kernels.py mutex spec; dense inside C ≤ 12, the
+    """Contended try-lock/release cycles checked against the
+    owner-aware mutex model — which reduces to cas-register codes at
+    encode time (ops/step_kernels.py owner-mutex spec; dense inside
+    C ≤ 12, the
     small-frontier generic kernel beyond)."""
     from .. import generator as gen
     from .. import models
@@ -255,7 +277,9 @@ def lock_workload(opts: Optional[dict] = None) -> dict:
             {"type": "invoke", "f": "acquire", "value": None},
             {"type": "invoke", "f": "release", "value": None},
         ])),
-        "checker": checker_mod.linearizable(models.mutex()),
+        "checker": checker_mod.linearizable(
+            models.owner_mutex(), pure_fs=()
+        ),
     }
 
 

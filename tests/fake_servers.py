@@ -637,8 +637,12 @@ class _ZkHandler(_RecvExact, socketserver.BaseRequestHandler):
     def handle(self):
         try:
             self._read_frame()  # ConnectRequest
+            # unique session ids per connection, like a real ensemble
+            with self.fake_store.lock:
+                sid = getattr(self.fake_store, "zk_next_session", 0x1234)
+                self.fake_store.zk_next_session = sid + 1
             self._send_frame(
-                struct.pack("!iiq", 0, 10000, 0x1234) + self._buffer(b"\0" * 16)
+                struct.pack("!iiq", 0, 10000, sid) + self._buffer(b"\0" * 16)
             )
             nodes = self.fake_store.kv  # path → json {data(hexbytes), version}
             lock = self.fake_store.lock

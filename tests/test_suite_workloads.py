@@ -2157,6 +2157,8 @@ def test_zk_lock_client_roundtrip():
         c2 = zookeeper.ZkLockClient(opts).open({"nodes": ["n1"]}, "n1")
         r = c1.invoke({}, {"f": "acquire", "value": None, "type": "invoke"})
         assert r["type"] == "ok", r
+        # completions carry the session identity (distinct per client)
+        assert r["value"]["client"] != c2._me()["client"]
         # contender loses; holder can't double-acquire
         r = c2.invoke({}, {"f": "acquire", "value": None, "type": "invoke"})
         assert r["type"] == "fail" and r["error"] == "taken"
