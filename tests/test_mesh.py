@@ -188,3 +188,30 @@ def test_verdict_stats_collective(mesh8):
     assert int(stats["valid"]) == 10
     assert int(stats["invalid"]) == 2
     assert int(stats["unknown"]) == 4
+
+
+def test_check_batch_mesh_lock_models(mesh8):
+    """The round-4 lock automata (owner-mutex via the cas reduction,
+    reentrant-mutex's own algebra) shard over the mesh like the
+    register family: verdicts match the oracle, every row dense, batch
+    deliberately non-divisible."""
+    from jepsen_tpu import synth
+
+    rng = random.Random(45107)
+    for reentrant, model in (
+        (False, m.owner_mutex()),
+        (True, m.reentrant_mutex()),
+    ):
+        hists = [
+            synth.generate_lock_history(
+                rng, n_procs=5, n_ops=20, reentrant=reentrant,
+                corrupt=(i % 3 == 0),
+            )
+            for i in range(11)  # non-divisible on purpose
+        ]
+        outs = wgl.check_batch(model, hists, mesh=mesh8)
+        stats = wgl.batch_stats(outs)
+        assert stats["engines"] == {"tpu": 11}, stats
+        assert stats["kernels"] == {"dense": 11}, stats
+        assert [o["valid?"] for o in outs] == _oracle(model, hists)
+        assert False in [o["valid?"] for o in outs]
