@@ -13,9 +13,11 @@ Every model here has a matching branchless TPU step kernel in
 ``jepsen_tpu.ops.step_kernels``; this module is the oracle the kernels are
 differentially tested against.  The owner-aware/reentrant/fenced lock
 and permit models (hazelcast's CP-subsystem probes) live in
-:mod:`.locks`; they carry client identities in op values, stay
-oracle-checked (wgl.supported gates kernel dispatch), and are
-re-exported here.
+:mod:`.locks`; they carry client identities in op values and are
+re-exported here.  Owner/reentrant mutexes and the permit semaphore
+ride dense device automata (encode-time reductions / table-built
+transitions); the fenced flavors stay oracle-checked (unbounded
+fencing tokens admit no small state enumeration).
 """
 
 from __future__ import annotations

@@ -64,6 +64,8 @@ from .step_kernels import (
     F_DEQUEUE,
     F_RACQUIRE,
     F_RRELEASE,
+    F_PACQUIRE,
+    F_PRELEASE,
 )
 
 #: specs whose state is exactly "current value id" (mutex: 0=free
@@ -109,6 +111,36 @@ def mr_shape_probe(init_state, cand_a, cand_b) -> tuple:
     return vr, kreg
 
 
+def permits_tables(N: int, P: int):
+    """Host-side state enumeration + transition tables for the permit
+    (semaphore) automaton: states are multisets of ≤ P client ids
+    (1-based, N clients).  Returns (S, acq, rel) with acq/rel of shape
+    [N+1, S] mapping (client, state) → state' (or -1 = invalid move:
+    acquiring past P total permits, releasing a permit not held)."""
+    states = [()]
+    if P >= 1:
+        states += [(c,) for c in range(1, N + 1)]
+    if P >= 2:
+        states += [
+            (c, d) for c in range(1, N + 1) for d in range(c, N + 1)
+        ]
+    if P > 2:
+        raise ValueError("permit tables support n_permits <= 2")
+    index = {st: i for i, st in enumerate(states)}
+    S = len(states)
+    acq = np.full((N + 1, S), -1, np.int32)
+    rel = np.full((N + 1, S), -1, np.int32)
+    for i, st in enumerate(states):
+        for c in range(1, N + 1):
+            if len(st) < P:
+                acq[c, i] = index[tuple(sorted(st + (c,)))]
+            if c in st:
+                out = list(st)
+                out.remove(c)
+                rel[c, i] = index[tuple(out)]
+    return S, acq, rel
+
+
 def applicable(spec_name: str, C: int, V) -> bool:
     """``V`` is the value-domain size for the register family, or a
     ``(Vr, K)`` pair (per-register domain, register count) for
@@ -123,6 +155,16 @@ def applicable(spec_name: str, C: int, V) -> bool:
             return False
         vr, k = V
         return C <= MAX_C and vr ** k <= MR_MAX_STATES
+    if spec_name == "acquired-permits":
+        if not isinstance(V, tuple):
+            return False
+        n_clients, p = V
+        if p > 2:
+            return False
+        S = 1 + n_clients + (
+            n_clients * (n_clients + 1) // 2 if p >= 2 else 0
+        )
+        return C <= MAX_C and S <= MR_MAX_STATES
     return spec_name in DENSE_SPECS and C <= MAX_C and V <= MAX_V
 
 
@@ -202,7 +244,9 @@ def _or_fold(terms):
     return terms[0]
 
 
-def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
+def build_dense(
+    spec_name: str, E: int, C: int, V, mr_shape=None, permits_shape=None
+):
     """Build the (unjitted) vmapped dense checker for fixed shapes.
     Signature matches wgl.build_batched's result: ``fn(init_state,
     ev_slot, cand_slot, cand_f, cand_a, cand_b) -> (ok, failed_at,
@@ -215,6 +259,14 @@ def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
     ignored and S takes its place."""
     multi = spec_name == "multi-register"
     reentrant = spec_name == "reentrant-mutex"
+    permits = spec_name == "acquired-permits"
+    if permits:
+        if permits_shape is None:
+            raise ValueError("acquired-permits needs permits_shape=(N, P)")
+        n_clients, n_permits = permits_shape
+        V, acq_np, rel_np = permits_tables(int(n_clients), int(n_permits))
+        pm_acq = jnp.asarray(acq_np)  # [N+1, S]
+        pm_rel = jnp.asarray(rel_np)
     if multi:
         if mr_shape is None:
             raise ValueError("multi-register needs mr_shape=(Vr, K)")
@@ -236,7 +288,7 @@ def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
         same_ex = jnp.asarray(same_ex_np)
         eye_ss = jnp.asarray(np.eye(V, dtype=bool))
         mr_pow = jnp.asarray([vr ** k for k in range(kreg)], jnp.int32)
-    elif spec_name not in DENSE_SPECS:
+    elif spec_name not in DENSE_SPECS and not permits:
         raise ValueError(f"no dense kernel for spec {spec_name!r}")
     W = _n_words(C)
     max_closure = C + 2  # ≤C passes reach fixpoint; headroom is free
@@ -311,7 +363,17 @@ def build_dense(spec_name: str, E: int, C: int, V, mr_shape=None):
                 vv = jnp.arange(V, dtype=jnp.int32)[None, None, :]  # v
                 am = a_eff[:, None, None]
                 bm = b_eff[:, None, None]
-                if reentrant:
+                if permits:
+                    # table-driven transitions: tbl[a, s] names the one
+                    # target state; -1 (invalid move) can never equal a
+                    # state id, so no extra validity mask is needed
+                    is_pacq = f_s == F_PACQUIRE
+                    a_idx = jnp.clip(a_s, 0, pm_acq.shape[0] - 1)
+                    acq_t = jnp.take(pm_acq, a_idx, axis=0)  # [C, S]
+                    rel_t = jnp.take(pm_rel, a_idx, axis=0)
+                    tbl = jnp.where(is_pacq[:, None], acq_t, rel_t)
+                    T = (tbl[:, None, :] == vp) & active_s[:, None, None]
+                elif reentrant:
                     # two-pair transitions over state ids {0 free,
                     # 2c-1 once, 2c twice} (a = client id c); a
                     # reentrant batch carries ONLY racq/rrel codes, so
@@ -542,7 +604,8 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
     cache key — otherwise every distinct value-domain (and any initial
     bitset contents, whose numeric max can be huge) would re-jit a
     byte-identical kernel.  For multi-register, V is the (Vr, K)
-    composite-shape pair."""
+    composite-shape pair; for acquired-permits the (N, P) client/permit
+    pair."""
     if spec_name == "unordered-queue":
         V = 0
     return _make_dense_fn_cached(spec_name, E, C, V)
@@ -554,4 +617,6 @@ def _make_dense_fn_cached(spec_name: str, E: int, C: int, V):
         return jax.jit(build_dense_queue(E, C))
     if spec_name == "multi-register":
         return jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V))
+    if spec_name == "acquired-permits":
+        return jax.jit(build_dense(spec_name, E, C, 0, permits_shape=V))
     return jax.jit(build_dense(spec_name, E, C, V))

@@ -37,6 +37,8 @@ F_ENQUEUE = 6     # unordered queue: a = value id
 F_DEQUEUE = 7     # unordered queue: a = observed value id
 F_RACQUIRE = 8    # reentrant mutex: a = client id (see reentrant_mutex_step)
 F_RRELEASE = 9    # reentrant mutex: a = client id
+F_PACQUIRE = 10   # permit (semaphore) acquire: a = client id
+F_PRELEASE = 11   # permit release: a = client id
 
 #: Value id reserved for "unknown/None". Known values are 1-based.
 V_UNKNOWN = 0
@@ -161,6 +163,11 @@ class ModelSpec:
     init_state: Callable[[m.Model, Dict[Any, int]], int]
     #: fs that never change state — indeterminate ones are stripped
     pure_fs: Tuple[str, ...]
+    #: True when only the dense automaton exists for this spec (its
+    #: state enumeration is built from host tables the branchless step
+    #: functions can't express); outside the dense envelope such
+    #: batches go straight to the oracle, never the frontier kernel
+    dense_only: bool = False
 
 
 def _value_id(value, valmap: Dict[Any, int]) -> int:
@@ -253,6 +260,42 @@ def _reentrant_mutex_init(model, valmap) -> int:
         raise ValueError("reentrant-mutex init outside the kernel algebra")
     cid = _rm_client_id(model.owner, valmap)
     return 2 * cid - 1 if model.count == 1 else 2 * cid
+
+
+def _pm_client_id(client, valmap: Dict[Any, int]) -> int:
+    """1-based client index for the permit automaton (the permits
+    encoder interns nothing else, so _value_id stays contiguous)."""
+    return _value_id(("pm-client", client), valmap)
+
+
+def _encode_permits_op(op, valmap) -> Tuple[int, int, int]:
+    """Semaphore permit ops: a = client index.  The state enumeration
+    (multisets of ≤ n_permits client ids) lives in host tables built by
+    the dense kernel (ops/dense.py permits_tables); no branchless step
+    function exists, so the spec is dense_only."""
+    client = _owner_client(op)
+    cid = _pm_client_id(client, valmap)
+    if op.f == "acquire":
+        return F_PACQUIRE, cid, 0
+    if op.f == "release":
+        return F_PRELEASE, cid, 0
+    raise ValueError(f"acquired-permits cannot encode op f={op.f!r}")
+
+
+def _permits_init(model, valmap) -> int:
+    if model.acquired:
+        # a non-empty initial multiset needs the global state
+        # enumeration, which depends on the final client count the
+        # encoder can't know yet — oracle fallback
+        raise ValueError("acquired-permits kernel needs an empty start")
+    return 0
+
+
+def _no_step(state, f, a, b):  # pragma: no cover — gated by dense_only
+    raise NotImplementedError(
+        "acquired-permits has no frontier step; dense_only batches "
+        "outside the dense envelope must go to the oracle"
+    )
 
 
 def _encode_owner_mutex_op(op, valmap) -> Tuple[int, int, int]:
@@ -439,15 +482,27 @@ SPECS: Dict[type, ModelSpec] = {
     ),
     # reentrant owner-aware mutex (hold bound 2): its own step algebra
     # over state ids {0, 2c-1, 2c}; the state DOMAIN is 2·N+1 for N
-    # clients — check_batch widens n_values accordingly.  Fenced and
-    # permit flavors stay oracle-only (global fence monotonicity /
-    # multiset state have no small value automaton).
+    # clients — check_batch widens n_values accordingly.  The fenced
+    # flavors stay oracle-only (global fence monotonicity over
+    # unbounded tokens has no small value automaton).
     m.ReentrantMutex: ModelSpec(
         name="reentrant-mutex",
         step=reentrant_mutex_step,
         encode_op=_encode_reentrant_mutex_op,
         init_state=_reentrant_mutex_init,
         pure_fs=(),
+    ),
+    # semaphore permits: a multiset of ≤ n_permits client ids — the
+    # state enumeration comes from host-precomputed transition tables
+    # (ops/dense.py permits_tables), so only the dense automaton
+    # exists; past its envelope the oracle takes the batch
+    m.AcquiredPermits: ModelSpec(
+        name="acquired-permits",
+        step=_no_step,
+        encode_op=_encode_permits_op,
+        init_state=_permits_init,
+        pure_fs=(),
+        dense_only=True,
     ),
 }
 

@@ -397,6 +397,11 @@ def make_best_check_fn(
             else encode_mod.round_up(n_values, 4)
         )
         return dense_mod.make_dense_fn(spec_name, E, C, V)
+    spec = next(s for s in _all_specs() if s.name == spec_name)
+    if getattr(spec, "dense_only", False):
+        # no frontier step exists (table-built automaton): outside the
+        # dense envelope the caller must route the batch to the oracle
+        return None
     return make_check_fn(spec_name, E, C, F, max_closure)
 
 
@@ -600,7 +605,17 @@ def check_batch(
         # fixpoint-confirming iteration, so legitimate closures are never
         # cut short and flagged unknown
         mc = max_closure if max_closure is not None else C + 1
-        if spec.name == "multi-register":
+        if spec.name == "acquired-permits":
+            # (client count, permit count) drives the table-built
+            # automaton; client ids are contiguous 1..N in cand_a.
+            # N rounds up to a bucket of 4 so drifting per-batch client
+            # counts don't mint a fresh executable each (oversized
+            # tables are a harmless superset; real ids stay ≤ N)
+            n_values = (
+                encode_mod.round_up(int(max(batch.cand_a.max(), 0)), 4),
+                int(getattr(model, "n_permits", 2)),
+            )
+        elif spec.name == "multi-register":
             # the (Vr, K) composite pair drives the dense automaton
             from . import dense as dense_mod
 
@@ -614,6 +629,11 @@ def check_batch(
         if max_closure is None:
             fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
             kernel = kernel_choice(spec.name, C, n_values)
+        elif getattr(spec, "dense_only", False):
+            # an explicit closure cap would force the frontier kernel,
+            # which dense-only specs don't have: oracle takes the batch
+            fn = None
+            kernel = "frontier"
         else:
             # an explicit closure cap asks for the generic kernel's
             # truncation semantics; the dense kernel has no such cap
@@ -621,9 +641,13 @@ def check_batch(
             kernel = "frontier"
         # frontier dispatches carry their footprint-safe cap on the fn
         # itself (make_check_fn); dense fns don't and keep the full cap
-        disp = min(max_dispatch, getattr(fn, "safe_dispatch", max_dispatch))
+        disp = (
+            0 if fn is None
+            else min(max_dispatch, getattr(fn, "safe_dispatch", max_dispatch))
+        )
         if disp == 0:
-            # even one row of this shape would crash the worker: the
+            # no dispatchable kernel (a dense-only spec outside its
+            # envelope) or even one row would crash the worker: the
             # whole batch is the oracle's (or reports unknown)
             B0 = arrays[0].shape[0]
             ok = np.zeros((B0,), bool)
@@ -637,7 +661,13 @@ def check_batch(
                 for x in _run_chunked(fn, mesh, arrays, disp)
             )
 
-        capacities = [frontier * factor for factor in escalation]
+        # dense-only specs have no frontier kernel, so no escalation
+        # rungs exist either — overflowed rows (all of them, when fn is
+        # None) go straight to the oracle
+        capacities = (
+            [] if fn is None or getattr(spec, "dense_only", False)
+            else [frontier * factor for factor in escalation]
+        )
         # final escalation rung: the provably-sufficient capacity, when
         # affordable — a lossless-compaction rerun that settles the row
         # on-device instead of handing it to the exponential oracle.
@@ -648,6 +678,8 @@ def check_batch(
         suff = (
             sufficient_frontier(n_values, C, spec.name)
             if sufficient_rung
+            and fn is not None
+            and not getattr(spec, "dense_only", False)
             else None
         )
         if suff is not None and not any(c >= suff for c in capacities):

@@ -477,10 +477,11 @@ def lock_workload(
         g = gen.limit(limit, g)
     return {
         "generator": g,
-        # the fenced/permit models are oracle-only; a contended INVALID
-        # history is the exponential blowup class, so the search gets a
-        # wall-time budget (verdict "unknown" past it) instead of
-        # hanging the whole analysis
+        # the fenced models are oracle-only (permits ride the dense
+        # table automaton since round 4); a contended INVALID history
+        # is the exponential blowup class for the oracle, so its search
+        # gets a wall-time budget (verdict "unknown" past it) instead
+        # of hanging the whole analysis
         "checker": checker_mod.linearizable(
             model, pure_fs=(),
             # "oracle-budget": seconds, or None for an unbounded search

@@ -294,3 +294,79 @@ def generate_lock_history(
         op.index = i
         op.time = i
     return h.index_ops()
+
+
+def generate_permits_history(
+    rng,
+    n_procs: int = 5,
+    n_ops: int = 40,
+    n_permits: int = 2,
+    corrupt: bool = False,
+):
+    """Simulated semaphore: each process is one client holding at most
+    one permit at a time; waiters block until a permit frees (a
+    release's linearization point sits anywhere in its invoke window).
+    Completions carry {"client": name}.  corrupt=True fabricates one
+    definite over-issue: a grant past n_permits with no open release
+    that could linearize first."""
+    from .history import History, info_op, invoke_op, ok_op
+
+    hist = []
+    idle = list(range(n_procs))
+    waiting: list = []
+    holds = {p: 0 for p in range(n_procs)}
+    releasing: list = []
+    eff = 0  # permits outstanding after in-flight releases linearize
+    corrupted = False
+    done = 0
+    while done < n_ops or waiting or releasing:
+        can_acq = [p for p in idle if holds[p] == 0]
+        can_rel = [p for p in idle if holds[p] > 0]
+        grantable = eff < n_permits
+        moves = []
+        if done < n_ops and can_acq:
+            moves.append("inv_acq")
+        if can_rel and (done < n_ops or waiting):
+            moves.append("inv_rel")
+        if waiting and grantable:
+            moves.append("grant")
+        elif waiting and corrupt and not corrupted and not releasing:
+            moves.append("bad_grant")
+        if releasing:
+            moves.append("ok_rel")
+        if not moves:
+            break  # stranded waiters become open info ops below
+        mv = rng.choice(moves)
+        if mv == "inv_acq":
+            p = can_acq[rng.randrange(len(can_acq))]
+            idle.remove(p)
+            hist.append(invoke_op(p, "acquire", None))
+            waiting.append(p)
+            done += 1
+        elif mv == "inv_rel":
+            p = can_rel[rng.randrange(len(can_rel))]
+            idle.remove(p)
+            hist.append(invoke_op(p, "release", None))
+            releasing.append(p)
+            eff -= 1
+            done += 1
+        elif mv in ("grant", "bad_grant"):
+            p = waiting.pop(rng.randrange(len(waiting)))
+            holds[p] += 1
+            eff += 1
+            hist.append(ok_op(p, "acquire", {"client": f"c{p}"}))
+            idle.append(p)
+            if mv == "bad_grant":
+                corrupted = True
+        else:  # ok_rel
+            p = releasing.pop(rng.randrange(len(releasing)))
+            holds[p] -= 1
+            hist.append(ok_op(p, "release", {"client": f"c{p}"}))
+            idle.append(p)
+    for p in waiting:
+        hist.append(info_op(p, "acquire", {"client": f"c{p}"}))
+    h = History(hist)
+    for i, op in enumerate(h):
+        op.index = i
+        op.time = i
+    return h.index_ops()

@@ -553,3 +553,61 @@ def test_synth_lock_history_generator():
         assert {o["engine"] for o in out} == {"tpu"}, wgl.batch_stats(out)
         got = [o["valid?"] for o in out]
         assert got == [False if i % 4 == 0 else True for i in range(12)]
+
+
+def test_acquired_permits_dense_kernel_differential():
+    """The semaphore (acquired-permits) automaton — table-built state
+    enumeration over client multisets — must match the oracle on
+    random contended permit histories with fabricated over-issues, and
+    serve them dense (the spec is dense_only: no frontier kernel
+    exists)."""
+    import random
+
+    from jepsen_tpu import models, synth
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.ops import wgl
+
+    rng = random.Random(45108)
+    hists = [
+        synth.generate_permits_history(
+            rng, n_procs=5, n_ops=24, corrupt=(i % 3 == 0)
+        )
+        for i in range(16)
+    ]
+    model = models.acquired_permits(2)
+    oracle = [linear.analysis(model, h)["valid?"] for h in hists]
+    outs = wgl.check_batch(model, hists)
+    assert [o["valid?"] for o in outs] == oracle
+    assert False in oracle and True in oracle
+    stats = wgl.batch_stats(outs)
+    assert stats["engines"] == {"tpu": 16}, stats
+    assert stats["kernels"] == {"dense": 16}, stats
+
+
+def test_acquired_permits_dense_only_fallbacks():
+    """Outside the dense envelope the permits spec has NO kernel at
+    all: an explicit max_closure (which would force the frontier
+    kernel) and a non-empty initial multiset both route to the oracle
+    with identical verdicts."""
+    import random
+
+    from jepsen_tpu import models, synth
+    from jepsen_tpu.ops import wgl
+
+    rng = random.Random(45109)
+    hists = [
+        synth.generate_permits_history(
+            rng, n_procs=4, n_ops=16, corrupt=(i % 2 == 0)
+        )
+        for i in range(4)
+    ]
+    model = models.acquired_permits(2)
+    base = [o["valid?"] for o in wgl.check_batch(model, hists)]
+    forced = wgl.check_batch(model, hists, max_closure=6)
+    assert [o["valid?"] for o in forced] == base
+    assert all(o["engine"].startswith("oracle") for o in forced), forced
+    # a non-empty initial multiset has no state id until the client
+    # count is known: encode refuses, oracle answers
+    seeded = models.AcquiredPermits(2, (("c9", 1),))
+    out = wgl.check_batch(seeded, hists[:2])
+    assert all(o["engine"].startswith("oracle") for o in out), out
