@@ -532,27 +532,37 @@ def lock_models_arm(results, B, reps):
     from jepsen_tpu.ops import dense, encode, wgl
 
     rng = np.random.default_rng(45105)
-    for name, model, reentrant in (
-        ("owner-mutex", m.owner_mutex(), False),
-        ("reentrant-mutex", m.reentrant_mutex(), True),
+    for name, model, gen_hists in (
+        ("owner-mutex", m.owner_mutex(),
+         lambda r: [synth.generate_lock_history(
+             r, n_procs=8, n_ops=60, corrupt=(i % 4 == 0))
+             for i in range(16)]),
+        ("reentrant-mutex", m.reentrant_mutex(),
+         lambda r: [synth.generate_lock_history(
+             r, n_procs=8, n_ops=60, reentrant=True,
+             corrupt=(i % 4 == 0)) for i in range(16)]),
+        ("acquired-permits", m.acquired_permits(2),
+         lambda r: [synth.generate_permits_history(
+             r, n_procs=8, n_ops=60, corrupt=(i % 4 == 0))
+             for i in range(16)]),
     ):
         py_rng = random.Random(45105)
-        hists = [
-            synth.generate_lock_history(
-                py_rng, n_procs=8, n_ops=60, reentrant=reentrant,
-                corrupt=(i % 4 == 0),
-            )
-            for i in range(16)
-        ]
+        hists = gen_hists(py_rng)
         batch = _batch_arrays(hists, model, slot_cap=8)
         E = batch.ev_slot.shape[1]
         C = batch.cand_slot.shape[2]
         arrays = _expand(batch, B, rng)
         oracle_row(results, name, hists, model, C, 60)
-        nv = wgl.value_domain(name, arrays[0], arrays[4], arrays[5])
+        if name == "acquired-permits":
+            nv = (encode.round_up(int(arrays[4].max()), 4), 2)
+        else:
+            nv = wgl.value_domain(name, arrays[0], arrays[4], arrays[5])
         if wgl.kernel_choice(name, C, nv) != "dense":
             continue  # production would not select the dense kernel
-        fn = dense.make_dense_fn(name, E, C, encode.round_up(nv, 4))
+        fn = dense.make_dense_fn(
+            name, E, C,
+            nv if isinstance(nv, tuple) else encode.round_up(nv, 4),
+        )
         dt, ok, ovf = _time_fn(fn, arrays, reps)
         _device_row(results, name, "dense", C, None, 60, B, E, dt, ok, ovf)
 
