@@ -198,16 +198,20 @@ def test_check_batch_mesh_lock_models(mesh8):
     from jepsen_tpu import synth
 
     rng = random.Random(45107)
-    for reentrant, model in (
-        (False, m.owner_mutex()),
-        (True, m.reentrant_mutex()),
+    for gen_hist, model in (
+        (lambda r, i: synth.generate_lock_history(
+            r, n_procs=5, n_ops=20, corrupt=(i % 3 == 0)),
+         m.owner_mutex()),
+        (lambda r, i: synth.generate_lock_history(
+            r, n_procs=5, n_ops=20, reentrant=True,
+            corrupt=(i % 3 == 0)),
+         m.reentrant_mutex()),
+        (lambda r, i: synth.generate_permits_history(
+            r, n_procs=5, n_ops=20, corrupt=(i % 3 == 0)),
+         m.acquired_permits(2)),
     ):
         hists = [
-            synth.generate_lock_history(
-                rng, n_procs=5, n_ops=20, reentrant=reentrant,
-                corrupt=(i % 3 == 0),
-            )
-            for i in range(11)  # non-divisible on purpose
+            gen_hist(rng, i) for i in range(11)  # non-divisible
         ]
         outs = wgl.check_batch(model, hists, mesh=mesh8)
         stats = wgl.batch_stats(outs)
