@@ -451,6 +451,44 @@ class RepRegClient(RegClient):
             return {**op, "type": t, "error": repr(e)}
 
 
+def _repreg_cluster(tmp_path, nodes):
+    """Proxied 3-replica repregd scaffolding shared by the replicated
+    cluster tests: every directed peer edge rides its own loopback
+    forwarder, so net faults genuinely hit replication traffic."""
+    from jepsen_tpu import net as net_mod
+
+    ports = {n: _free_port() for n in nodes}
+    proxy_net = net_mod.LoopbackProxyNet()
+    peer_specs = {}
+    for a in nodes:
+        spec = []
+        for b in nodes:
+            if a == b:
+                continue
+            p = proxy_net.add_route(a, b, "127.0.0.1", ports[b])
+            spec.append(f"{str(b).lstrip('n')}=127.0.0.1:{p}")
+        peer_specs[a] = ",".join(spec)
+    db = RepRegDB(str(tmp_path / "repreg"), ports, peer_specs)
+    return ports, proxy_net, db
+
+
+def _teardown_repreg(test, nodes, db, proxy_net, tmp_path):
+    """Teardown + forwarder close + last-resort SIGKILL sweep (a
+    SIGSTOP-paused daemon never receives a queued SIGTERM; leaked
+    election loops once pinned this box's only core)."""
+    try:
+        try:
+            with control.with_session(test, test["remote"]):
+                control.on_nodes(test, nodes, db.teardown)
+        finally:
+            proxy_net.close()
+    finally:
+        subprocess.run(
+            ["pkill", "-9", "-f", str(tmp_path / "repreg")],
+            capture_output=True,
+        )
+
+
 @needs_ssd
 def test_real_replicated_cluster_kill_pause_partition(tmp_path):
     """VERDICT round-3 item: a second real-process service family with
@@ -462,25 +500,10 @@ def test_real_replicated_cluster_kill_pause_partition(tmp_path):
     intersection — never clocks — is what acked every write)."""
     import random
 
-    from jepsen_tpu import net as net_mod
     from jepsen_tpu.nemesis import complete_grudge, compose, partitioner
 
     nodes = ["n1", "n2", "n3"]
-    ports = {n: _free_port() for n in nodes}
-    proxy_net = net_mod.LoopbackProxyNet()
-    # every directed peer edge i->j rides its own proxy, so a grudge
-    # genuinely severs replication/election traffic
-    peer_specs = {}
-    for a in nodes:
-        spec = []
-        for b in nodes:
-            if a == b:
-                continue
-            p = proxy_net.add_route(a, b, "127.0.0.1", ports[b])
-            spec.append(f"{str(b).lstrip('n')}=127.0.0.1:{p}")
-        peer_specs[a] = ",".join(spec)
-
-    db = RepRegDB(str(tmp_path / "repreg"), ports, peer_specs)
+    ports, proxy_net, db = _repreg_cluster(tmp_path, nodes)
 
     counter = {"n": 0}
 
@@ -570,20 +593,7 @@ def test_real_replicated_cluster_kill_pause_partition(tmp_path):
         assert any(t > 0 for t, _l in terms.values()), terms
         assert any(l >= 0 for _t, l in terms.values()), terms
     finally:
-        try:
-            with control.with_session(test, test["remote"]):
-                control.on_nodes(test, nodes, db.teardown)
-        finally:
-            # last-resort sweep FIRST (so a proxy-close error can't
-            # skip it), with SIGKILL (a SIGSTOP-paused daemon never
-            # receives a queued SIGTERM): a teardown exception above
-            # must never leak daemons — three leaked election loops
-            # once pinned this box's only core and flaked other tests
-            subprocess.run(
-                ["pkill", "-9", "-f", str(tmp_path / "repreg")],
-                capture_output=True,
-            )
-            proxy_net.close()
+        _teardown_repreg(test, nodes, db, proxy_net, tmp_path)
 
     r = result["results"]
     hist = result["history"]
@@ -600,3 +610,111 @@ def test_real_replicated_cluster_kill_pause_partition(tmp_path):
         assert f in nem_fs, (f, nem_fs)
     assert failures, "faults never failed a single op"
     assert r["valid?"] is True, r
+
+
+@needs_ssd
+def test_real_replicated_cluster_slow_and_flaky_links(tmp_path):
+    """The Net's latency/loss faults against LIVE replication traffic:
+    slow(mean=120ms) on the peer links makes quorum writes measurably
+    slower (the coordinator waits on a delayed majority ack), flaky
+    (20% loss) injects real connection damage, fast() restores — and
+    the history stays linearizable throughout (slow links reorder
+    nothing; loss only yields fails/indeterminates)."""
+    import random
+
+    nodes = ["n1", "n2", "n3"]
+    ports, proxy_net, db = _repreg_cluster(tmp_path, nodes)
+
+    counter = {"n": 0}
+
+    def rw(test, ctx):
+        if random.random() < 0.4:
+            return {"type": "invoke", "f": "read", "value": None}
+        counter["n"] += 1
+        return {"type": "invoke", "f": "write", "value": counter["n"]}
+
+    class NetShaper(nemesis_mod.Nemesis):
+        def setup(self, test):
+            return self
+
+        def invoke(self, test, op):
+            f = op["f"]
+            if f == "slow":
+                proxy_net.slow(test, {"mean": 120})
+            elif f == "flaky":
+                proxy_net.flaky(test)
+            else:
+                proxy_net.fast(test)
+            return {**op, "type": "info"}
+
+        def teardown(self, test):
+            pass
+
+    def op(f):
+        return {"type": "info", "f": f, "value": None}
+
+    nemesis_gen = [
+        gen.sleep(1.5), op("slow"), gen.sleep(1.5), op("fast"),
+        gen.sleep(0.5), op("flaky"), gen.sleep(1.5), op("fast"),
+    ]
+
+    test = {
+        "name": "local-replicated-netem",
+        "start-time": "t0",
+        "store-base": str(tmp_path),
+        "nodes": nodes,
+        "remote": LocalRemote(),
+        "net": proxy_net,
+        "db": db,
+        "client": RepRegClient(ports),
+        "nemesis": NetShaper(),
+        "concurrency": 3,
+        "generator": gen.any(
+            gen.nemesis(nemesis_gen),
+            gen.clients(gen.time_limit(6.5, gen.stagger(0.05, rw))),
+        ),
+        "time-limit": 6.5,
+        "leave-db-running?": True,
+        "checker": checker_mod.linearizable(models.cas_register(0)),
+    }
+    try:
+        result = core.run(test)
+        assert result["results"]["valid?"] is True, result["results"]
+        hist = result["history"]
+        # latency evidence: completed client WRITES inside the slow
+        # window pay the injected peer delay (quorum ack waits on a
+        # ~120 ms-delayed link); before the window they don't.
+        def window(f):
+            starts = [o["time"] for o in hist
+                      if o["process"] == "nemesis" and o["f"] == f
+                      and o["type"] == "info"]
+            return starts[0] if starts else None
+
+        t_slow = window("slow")
+        t_fast = window("fast")
+        assert t_slow is not None and t_fast is not None
+        inv = {}
+        lat_before, lat_slow = [], []
+        for o in hist:
+            if o["process"] == "nemesis" or o["f"] != "write":
+                continue
+            if o["type"] == "invoke":
+                inv[o["process"]] = o["time"]
+            elif o["type"] == "ok" and o["process"] in inv:
+                t0, t1 = inv.pop(o["process"]), o["time"]
+                lat = (t1 - t0) / 1e9
+                if t1 < t_slow:
+                    lat_before.append(lat)
+                elif t0 > t_slow and t1 < t_fast:
+                    lat_slow.append(lat)
+        assert lat_before and lat_slow, (len(lat_before), len(lat_slow))
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        # absolute: quorum writes inside the slow window pay the
+        # injected peer delay.  (No relative multiplier: under
+        # full-suite load on this one core the baseline itself can
+        # inflate past any fixed ratio even though the fault worked.)
+        assert med(lat_slow) >= 0.05, (med(lat_before), med(lat_slow))
+        assert med(lat_slow) > med(lat_before), (
+            med(lat_before), med(lat_slow))
+    finally:
+        _teardown_repreg(test, nodes, db, proxy_net, tmp_path)
