@@ -303,3 +303,27 @@ def test_resolve_mesh_prefers_explicit_and_calls_fn():
     assert mesh_mod.resolve_mesh({"mesh-fn": fn}) is sentinel
     assert calls == [1]
     assert mesh_mod.resolve_mesh({}) is None
+
+
+def test_cli_test_all_suite_runs_every_suite_workload(tmp_path):
+    """`test-all --suite etcd` runs EVERY workload the suite defines
+    (lazy per-workload builders, worst exit code wins) against the fake
+    server through the full CLI path."""
+    from fake_servers import FakeHttpKv
+    from jepsen_tpu.suites import etcd as etcd_suite
+
+    base = str(tmp_path)
+    s = FakeHttpKv().start()
+    try:
+        rc = cli.run_cli(cli.default_commands(), [
+            "test-all", "--suite", "etcd", "--nodes", "n1", "--dummy",
+            "--time-limit", "1", "--rate", "40", "--store-base", base,
+            "-o", "host=127.0.0.1", "-o", f"port={s.port}",
+        ])
+    finally:
+        s.stop()
+    assert rc == cli.EXIT_VALID
+    ran = {n for n in os.listdir(base)
+           if n.startswith("etcd-")}
+    expected = {f"etcd-{w}" for w in etcd_suite.workloads({})}
+    assert ran == expected, (ran, expected)
