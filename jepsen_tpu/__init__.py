@@ -25,6 +25,9 @@ Capability map (reference: remysaissy/jepsen, studied in SURVEY.md):
 - ``jepsen_tpu.store``      — test persistence
 - ``jepsen_tpu.cli``        — command-line entry points
 - ``jepsen_tpu.elle``       — transactional anomaly (cycle) checking
+- ``jepsen_tpu.trace``      — span tracing with pluggable exporters
+- ``jepsen_tpu.suites``     — 27 database test suites over from-scratch
+  wire protocols
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
