@@ -315,9 +315,12 @@ def test_cli_test_all_suite_runs_every_suite_workload(tmp_path):
     base = str(tmp_path)
     s = FakeHttpKv().start()
     try:
+        # time-limit 3 / rate 75 like test_cli_suite_run: shorter
+        # budgets under full-suite load let a workload finish with an
+        # all-missed op type, which the stats checker correctly fails
         rc = cli.run_cli(cli.default_commands(), [
             "test-all", "--suite", "etcd", "--nodes", "n1", "--dummy",
-            "--time-limit", "1", "--rate", "40", "--store-base", base,
+            "--time-limit", "3", "--rate", "75", "--store-base", base,
             "-o", "host=127.0.0.1", "-o", f"port={s.port}",
         ])
     finally:
