@@ -6,13 +6,16 @@ evidence is only as good as the live-chip windows it manages to catch
 whole round: a cheap subprocess probe (jepsen_tpu.platform, 1 retry)
 every few minutes, and whenever the chip answers it immediately runs
 
-1. ``bench.py``                 → appends a window (with per-rep
+1. ``benchmarks/frontier_bench.py`` → the mutex/short-history/compaction
+                                  sweep on the real chip, persisted
+                                  row-by-row into
+                                  ``frontier_results_tpu.json`` (and the
+                                  unsuffixed headline copy) so even a
+                                  window that closes mid-sweep leaves
+                                  evidence;
+2. ``bench.py``                 → appends a window (with per-rep
                                   dispersion at B ∈ {8192,16384}) to
                                   ``BENCH_tpu_windows.jsonl``;
-2. ``benchmarks/frontier_bench.py`` → the short-history/mutex/compaction
-                                  sweep on the real chip
-                                  (``frontier_results.json`` rows carry
-                                  platform=tpu);
 3. ``benchmarks/elle_bench.py``  → re-pins the cycle-screen dispatch
                                   band on the real backend.
 
@@ -90,12 +93,16 @@ def main():
             time.sleep(INTERVAL)
             continue
         log("probe-hit")
-        rc, dt, tail = run([sys.executable, "bench.py"], 1800)
-        log("bench", rc=rc, elapsed_s=dt, tail=tail)
+        # Frontier first (VERDICT r4 ask #2): its short-history/mutex
+        # rows are the evidence two rounds have now missed; it also
+        # persists per-row, so even a window that closes mid-sweep
+        # leaves frontier_results_tpu.json behind.
         rc, dt, tail = run(
             [sys.executable, os.path.join(HERE, "frontier_bench.py")], 3600
         )
         log("frontier", rc=rc, elapsed_s=dt, tail=tail)
+        rc, dt, tail = run([sys.executable, "bench.py"], 1800)
+        log("bench", rc=rc, elapsed_s=dt, tail=tail)
         rc, dt, tail = run(
             [sys.executable, os.path.join(HERE, "elle_bench.py")], 1800
         )
