@@ -191,6 +191,26 @@ def save_2(test: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _open_reader(path: str):
+    """Open a block file, falling back to torn-write recovery: a file
+    whose tail was lost to a crash still loads from its longest valid
+    block prefix (format.Reader(recover=True)), with a log line so the
+    caller knows the view may predate the crash."""
+    from . import format as fmt
+
+    try:
+        return fmt.Reader(path)
+    except IOError:
+        r = fmt.Reader(path, recover=True)
+        logging.getLogger("jepsen.store").warning(
+            "%s: torn write detected; recovered from the valid block "
+            "prefix ending at byte %s",
+            path,
+            r.valid_prefix_end,
+        )
+        return r
+
+
 def load(name_or_test, start_time: Optional[str] = None) -> dict:
     """Load a stored test by {name, start-time} map or by name + time.
     Resolves block refs for history and results.
@@ -202,12 +222,14 @@ def load(name_or_test, start_time: Optional[str] = None) -> dict:
         test = name_or_test
     else:
         test = {"name": name_or_test, "start-time": start_time}
-    r = fmt.Reader(jtpu_file(test))
+    r = _open_reader(jtpu_file(test))
     out = r.root_value()
     for key in ("history", "results"):
         v = out.get(key)
         if fmt.is_block_ref(v):
             out[key] = r.read_value(v["$block-ref"])
+    if r.recovered:
+        out["recovered"] = True
     return out
 
 
@@ -219,12 +241,16 @@ def load_packed_history(name_or_test, start_time: Optional[str] = None) -> dict:
         test = name_or_test
     else:
         test = {"name": name_or_test, "start-time": start_time}
-    r = fmt.Reader(jtpu_file(test))
+    r = _open_reader(jtpu_file(test))
     root = r.root_value()
     v = root.get("history")
     if not fmt.is_block_ref(v):
         raise IOError("no history block saved")
-    return r.read_packed_history(v["$block-ref"])
+    out = r.read_packed_history(v["$block-ref"])
+    if r.recovered:
+        # same flag load() sets: the arrays may predate a torn tail
+        out["recovered"] = True
+    return out
 
 
 def tests(base: str = BASE, name: Optional[str] = None) -> Dict[str, List[str]]:

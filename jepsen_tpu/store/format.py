@@ -269,26 +269,146 @@ def _pack_history(history) -> bytes:
     return struct.pack("<II", len(npz), len(tables)) + npz + tables
 
 
-class Reader:
-    """Lazy reader over a block file."""
+def scan_valid_prefix(path: str) -> Tuple[List[Tuple[int, int]], int]:
+    """Forward-scan the block stream, returning ``([(offset, type), …],
+    prefix_end)`` for the longest prefix of intact CRC-framed blocks.
 
-    def __init__(self, path: str):
+    This is the torn-write recovery primitive (reference: the
+    append-only design rationale of store/format.clj:1-120 — partial
+    writes must survive crashes): a frame whose length field runs past
+    EOF, whose bytes are truncated, or whose CRC fails ends the scan;
+    everything before it is trustworthy."""
+    size = os.path.getsize(path)
+    frames: List[Tuple[int, int]] = []
+    off = HEADER_SIZE
+    with open(path, "rb") as f:
+        while off + FRAME_SIZE <= size:
+            f.seek(off)
+            head = f.read(FRAME_SIZE)
+            frame_len, want_crc, type_ = struct.unpack("<QIH", head)
+            if frame_len < FRAME_SIZE or off + frame_len > size:
+                break
+            data = f.read(frame_len - FRAME_SIZE)
+            if len(data) != frame_len - FRAME_SIZE:
+                break
+            zeroed = struct.pack("<QIH", frame_len, 0, type_)
+            if zlib.crc32(zeroed, zlib.crc32(data)) != want_crc:
+                break
+            frames.append((off, type_))
+            off += frame_len
+    return frames, off
+
+
+class Reader:
+    """Lazy reader over a block file.
+
+    With ``recover=True`` a file whose tail was torn off (crash mid
+    write, disk full, truncated copy) is opened from its longest valid
+    block prefix instead of raising: the newest intact index block wins;
+    failing that, the id→offset map is rebuilt from append order (data
+    block ids are assigned sequentially, index blocks carry no id) and
+    the newest partial-map block whose reference chain fully resolves
+    becomes the root.  ``reader.recovered`` reports that recovery ran
+    and ``reader.valid_prefix_end`` where the intact prefix stops."""
+
+    def __init__(self, path: str, recover: bool = False):
         self.path = path
+        self.recovered = False
+        self.valid_prefix_end: Optional[int] = None
         with open(path, "rb") as f:
             header = f.read(HEADER_SIZE)
+        # Wrong-format errors are never recoverable-from: a different
+        # magic or version must not be reinterpreted under v1 block
+        # semantics by the recovery scan.
         if header[:4] != MAGIC:
             raise IOError(f"{path}: not a JTPU block file")
+        if len(header) < HEADER_SIZE:
+            if not recover:
+                raise IOError(f"{path}: truncated header")
+            self._recover()
+            return
         version, index_off = struct.unpack("<IQ", header[4:])
         if version != VERSION:
             raise IOError(f"{path}: unsupported version {version}")
-        if index_off == 0:
-            raise IOError(f"{path}: no committed index (crashed before save?)")
-        type_, data = self.read_block_at(index_off)
-        if type_ != INDEX:
-            raise IOError(f"{path}: index offset points at type {type_}")
-        idx = json.loads(data)
-        self.root = idx["root"]
-        self.blocks = {int(k): v for k, v in idx["blocks"].items()}
+        try:
+            if index_off == 0:
+                raise IOError(
+                    f"{path}: no committed index (crashed before save?)"
+                )
+            type_, data = self.read_block_at(index_off)
+            if type_ != INDEX:
+                raise IOError(f"{path}: index offset points at type {type_}")
+            idx = json.loads(data)
+            self.root = idx["root"]
+            self.blocks = {int(k): v for k, v in idx["blocks"].items()}
+        except Exception as e:
+            if not recover:
+                if isinstance(e, OSError):
+                    raise
+                raise IOError(f"{path}: corrupt index ({e!r})") from e
+            self._recover()
+
+    # -- torn-write recovery ----------------------------------------------
+
+    def _recover(self) -> None:
+        frames, prefix_end = scan_valid_prefix(self.path)
+        self.recovered = True
+        self.valid_prefix_end = prefix_end
+        valid_offs = {off for off, _ in frames}
+        # Newest intact index block first: it is the last committed
+        # (or in-flight) view and its offsets are all behind it.
+        for ioff in (off for off, t in reversed(frames) if t == INDEX):
+            try:
+                _, data = self.read_block_at(ioff)
+                idx = json.loads(data)
+                blocks = {
+                    int(k): v
+                    for k, v in idx["blocks"].items()
+                    if v in valid_offs
+                }
+                root = idx.get("root", 0)
+            except Exception:
+                continue
+            if root and root in blocks:
+                self.root, self.blocks = root, blocks
+                if self._root_resolves():
+                    return
+        # No usable index survived: data-block ids are append order
+        # (write_block assigns sequentially; save_index appends the
+        # index frame without consuming an id), so the map is implied
+        # by the scan.  The newest partial-map whose chain resolves is
+        # the best root — exactly the newest completed save phase.
+        data_blocks = [(off, t) for off, t in frames if t != INDEX]
+        self.blocks = {i + 1: off for i, (off, _t) in enumerate(data_blocks)}
+        for bid in range(len(data_blocks), 0, -1):
+            if data_blocks[bid - 1][1] != PARTIAL_MAP:
+                continue
+            self.root = bid
+            if self._root_resolves():
+                return
+        raise IOError(
+            f"{self.path}: no recoverable root in the valid block prefix "
+            f"(0..{prefix_end})"
+        )
+
+    def _root_resolves(self) -> bool:
+        """True when the candidate root decodes and every block ref in
+        its top-level values points into the recovered block map — the
+        recovered view must not hand out dangling references.  Membership
+        is enough: every offset in ``self.blocks`` came from the CRC
+        verified scan, so referenced blocks need not be re-decoded here
+        (a multi-GB history stays lazy through recovery)."""
+        try:
+            out = self.root_value()
+            if not isinstance(out, dict):
+                return False
+            return all(
+                v["$block-ref"] in self.blocks
+                for v in out.values()
+                if is_block_ref(v)
+            )
+        except Exception:
+            return False
 
     def read_block_at(self, offset: int, verify: bool = True) -> Tuple[int, bytes]:
         with open(self.path, "rb") as f:

@@ -116,6 +116,165 @@ def test_index_survives_torn_tail(tmp_path):
     assert r.root_value() == {"x": 1}
 
 
+def _truncate_copy(path, tmp_path, n):
+    out = str(tmp_path / f"torn-{n}.jtpu")
+    with open(path, "rb") as src, open(out, "wb") as dst:
+        dst.write(src.read()[:n])
+    return out
+
+
+def test_recovery_at_every_boundary(tmp_path):
+    """Property: truncate the file at every block boundary ±k bytes;
+    recovery must always load the newest fully-durable save phase from
+    the valid prefix — never crash on, nor hand out, torn data
+    (reference design: store/format.clj:1-120 append-only recovery)."""
+    path = str(tmp_path / "t.jtpu")
+    h = _history()
+    with fmt.Writer(path) as w:
+        base = w.write_partial_map({"name": "torn"})  # save_0
+        w.set_root(base)
+        w.save_index()
+        hid = w.write_history(h)  # save_1
+        head = w.write_partial_map(
+            {"history": fmt.block_ref(hid)}, rest_id=base
+        )
+        w.set_root(head)
+        w.save_index()
+        res = w.write_partial_map({"valid?": True})  # save_2
+        final = w.write_partial_map(
+            {"results": fmt.block_ref(res)}, rest_id=head
+        )
+        w.set_root(final)
+        w.save_index()
+    frames, end = fmt.scan_valid_prefix(path)
+    assert len(frames) == 8  # 5 data blocks + 3 index blocks
+    size = os.path.getsize(path)
+    assert end == size
+    boundaries = [off for off, _t in frames] + [size]
+    # offset of the first index block: recovery below it has no root
+    first_block_end = frames[1][0]
+    for b in boundaries:
+        for k in (-3, -1, 0, 1, 3):
+            n = b + k
+            if not fmt.HEADER_SIZE <= n <= size:
+                continue
+            torn = _truncate_copy(path, tmp_path, n)
+            if n < first_block_end:
+                # save_0's map itself is torn: nothing recoverable
+                with pytest.raises(IOError):
+                    fmt.Reader(torn, recover=True)
+                continue
+            r = fmt.Reader(torn, recover=True)
+            out = r.root_value()
+            assert out["name"] == "torn"
+            if fmt.is_block_ref(out.get("history")):
+                h2 = r.read_history(out["history"]["$block-ref"])
+                assert [op.value for op in h2] == [op.value for op in h]
+            if fmt.is_block_ref(out.get("results")):
+                assert r.read_value(out["results"]["$block-ref"])[
+                    "valid?"
+                ] is True
+            # once the whole file survives, the full view must load
+            if n == size:
+                assert not r.recovered or fmt.is_block_ref(out["results"])
+
+
+def test_recovery_prefers_newest_index(tmp_path):
+    """A torn tail after a committed index falls back to that index —
+    the strict reader already handles this; recovery must agree."""
+    path = str(tmp_path / "t.jtpu")
+    with fmt.Writer(path) as w:
+        bid = w.write_json({"x": 1})
+        root = w.write_partial_map({"data": fmt.block_ref(bid)})
+        w.set_root(root)
+        w.save_index()
+    size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x99" * 40)  # torn garbage past the committed index
+    r = fmt.Reader(path)  # strict path: header index still intact
+    assert r.root_value()["data"] == fmt.block_ref(bid)
+    r2 = fmt.Reader(path, recover=True)
+    assert r2.root_value()["data"] == fmt.block_ref(bid)
+
+
+def test_recovery_without_any_index(tmp_path):
+    """Crash before the first save_index: the header still points at 0,
+    but the data blocks are intact — recovery rebuilds ids from append
+    order and picks the newest resolvable partial map as root."""
+    path = str(tmp_path / "t.jtpu")
+    w = fmt.Writer(path)
+    bid = w.write_json({"payload": [1, 2, 3]})
+    root = w.write_partial_map({"data": fmt.block_ref(bid)})
+    w.flush()
+    w.close()  # never called save_index
+    with pytest.raises(IOError):
+        fmt.Reader(path)
+    r = fmt.Reader(path, recover=True)
+    assert r.recovered
+    assert r.root == root
+    assert r.root_value()["data"] == fmt.block_ref(bid)
+    assert r.read_value(bid) == {"payload": [1, 2, 3]}
+
+
+def test_recovery_refuses_wrong_version(tmp_path):
+    """A future-version file is a format mismatch, not a torn write —
+    recovery must re-raise, never reinterpret under v1 semantics."""
+    path = str(tmp_path / "t.jtpu")
+    with fmt.Writer(path) as w:
+        w.set_root(w.write_json({"x": 1}))
+        w.save_index()
+    with open(path, "r+b") as f:
+        f.seek(4)
+        f.write(struct.pack("<I", fmt.VERSION + 1))
+    with pytest.raises(IOError, match="version"):
+        fmt.Reader(path, recover=True)
+
+
+def test_truncated_header_is_clean_ioerror(tmp_path):
+    """A header cut mid-write must surface as IOError (not a raw
+    struct.error escaping the strict path)."""
+    path = str(tmp_path / "t.jtpu")
+    with open(path, "wb") as f:
+        f.write(fmt.MAGIC + b"\x01\x00")  # 6 bytes: magic + partial
+    with pytest.raises(IOError):
+        fmt.Reader(path)
+    with pytest.raises(IOError):
+        fmt.Reader(path, recover=True)
+
+
+def test_store_load_recovers_torn_file_and_analyze_works(tmp_path):
+    """store.load falls back to recovery on a torn test.jtpu, flags the
+    result, and the recovered history re-checks (the CLI analyze path
+    loads through the same function)."""
+    from jepsen_tpu import checker as checker_mod
+
+    t = _test_map(tmp_path, "torn-live")
+    with store.with_writer(t) as t2:
+        t2 = store.save_0(t2)
+        t2 = {**t2, "history": _history()}
+        t2 = store.save_1(t2)
+        t2 = {**t2, "results": {"valid?": True}}
+        t2 = store.save_2(t2)
+    f = store.jtpu_file(t)
+    # tear off save_2 entirely: truncate to just after save_1's index
+    frames, _ = fmt.scan_valid_prefix(f)
+    index_offs = [off for off, ty in frames if ty == fmt.INDEX]
+    cut = [off for off, _t in frames if off > index_offs[1]][0] + 5
+    with open(f, "r+b") as fh:
+        fh.truncate(cut)
+    loaded = store.load(
+        {"name": "torn-live", "start-time": t["start-time"],
+         "store-base": t["store-base"]}
+    )
+    assert loaded["recovered"] is True
+    assert len(loaded["history"]) == 4
+    assert "results" not in loaded
+    res = checker_mod.check_safe(
+        checker_mod.stats(), loaded, loaded["history"], {}
+    )
+    assert res["valid?"] is True
+
+
 def test_python_and_native_writers_produce_identical_bytes(tmp_path):
     if not native.available():
         pytest.skip("no native lib")
