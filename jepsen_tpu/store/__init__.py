@@ -198,17 +198,15 @@ def _open_reader(path: str):
     caller knows the view may predate the crash."""
     from . import format as fmt
 
-    try:
-        return fmt.Reader(path)
-    except IOError:
-        r = fmt.Reader(path, recover=True)
+    r = fmt.Reader(path, recover=True)
+    if r.recovered:
         logging.getLogger("jepsen.store").warning(
             "%s: torn write detected; recovered from the valid block "
             "prefix ending at byte %s",
             path,
             r.valid_prefix_end,
         )
-        return r
+    return r
 
 
 def load(name_or_test, start_time: Optional[str] = None) -> dict:

@@ -1,0 +1,185 @@
+"""Tests for the control-node artifact cache (fs_cache.py) and the
+libfaketime wrappers (faketime.py) — previously the only untested
+modules (reference behaviors: jepsen/src/jepsen/fs_cache.clj:140-278 and
+jepsen/src/jepsen/faketime.clj:8-65)."""
+
+import math
+import os
+import random
+import stat
+
+import pytest
+
+from jepsen_tpu import control, faketime, fs_cache
+from jepsen_tpu.control.local import LocalRemote
+
+
+@pytest.fixture
+def cache(tmp_path):
+    return fs_cache.Cache(str(tmp_path / "cache"))
+
+
+@pytest.fixture
+def session(tmp_path):
+    test = {"nodes": ["n1"]}
+    with control.with_session(test, LocalRemote()):
+        yield test
+
+
+def _on_node(fn):
+    return control.with_node("n1", fn)
+
+
+# -- fs_cache ----------------------------------------------------------------
+
+
+def test_cache_round_trip_and_key_encoding(cache):
+    assert not cache.cached("etcd-3.5")
+    assert cache.load_bytes("etcd-3.5") is None
+    p = cache.save_bytes(b"tarball-bytes", "etcd-3.5")
+    assert cache.cached("etcd-3.5")
+    assert cache.load_bytes("etcd-3.5") == b"tarball-bytes"
+    # path layout: <base>/<2-hex>/<32-hex>; composite keys hash too
+    rel = os.path.relpath(p, cache.dir)
+    parts = rel.split(os.sep)
+    assert len(parts) == 2 and len(parts[0]) == 2 and len(parts[1]) == 32
+    p2 = cache.path(["etcd", "3.5", "amd64"])
+    assert p2 != cache.path(["etcd", "3.5", "arm64"])
+
+
+def test_atomic_write_crash_leaves_no_partial(cache):
+    """An exception mid-write must leave neither the destination nor the
+    temp file behind (reference: fs_cache.clj:140-170 write-atomic!)."""
+    key = "crashy"
+    with pytest.raises(RuntimeError, match="boom"):
+        with cache.atomic_write(key) as tmp:
+            with open(tmp, "wb") as f:
+                f.write(b"half-written")
+            raise RuntimeError("boom")
+    assert not cache.cached(key)
+    parent = os.path.dirname(cache.path(key))
+    assert os.listdir(parent) == []  # tmp cleaned up
+    # and a successful write replaces any prior value atomically
+    cache.save_bytes(b"v1", key)
+    cache.save_bytes(b"v2", key)
+    assert cache.load_bytes(key) == b"v2"
+
+
+def test_cache_clear(cache):
+    cache.save_bytes(b"x", "k")
+    cache.clear()
+    assert not cache.cached("k")
+    assert not os.path.exists(cache.dir)
+
+
+def test_save_remote_and_deploy_remote_round_trip(cache, session, tmp_path):
+    """save_remote pulls a node file into the cache; deploy_remote pushes
+    it back out — over the real local transport (reference:
+    fs_cache.clj:244-260)."""
+    src = tmp_path / "node-artifact.bin"
+    src.write_bytes(b"remote-data")
+    _on_node(lambda: cache.save_remote(str(src), "artifact"))
+    assert cache.load_bytes("artifact") == b"remote-data"
+    dest = tmp_path / "deployed.bin"
+    _on_node(lambda: cache.deploy_remote("artifact", str(dest)))
+    assert dest.read_bytes() == b"remote-data"
+
+
+def test_save_remote_failure_keeps_cache_clean(cache, session, tmp_path):
+    """A failed download must not register the key as cached."""
+    with pytest.raises(Exception):
+        _on_node(
+            lambda: cache.save_remote(str(tmp_path / "missing"), "nope")
+        )
+    assert not cache.cached("nope")
+
+
+def test_deploy_remote_cache_miss(cache, session):
+    with pytest.raises(FileNotFoundError, match="cache miss"):
+        _on_node(lambda: cache.deploy_remote("never-saved", "/tmp/x"))
+
+
+# -- faketime ----------------------------------------------------------------
+
+
+def test_script_rendering():
+    s = faketime.script(5.0)
+    assert 'FAKETIME="+5.000000s"' in s
+    assert "LD_PRELOAD" in s and "libfaketime.so.1" in s
+    assert "FAKETIME_NO_CACHE=1" in s
+    s = faketime.script(-2.5, rate=3.0)
+    assert 'FAKETIME="-2.500000s x3.0"' in s
+
+
+def test_rand_factor_bounds_and_distribution():
+    rng = random.Random(45100)
+    vals = [faketime.rand_factor(rng) for _ in range(500)]
+    assert all(0.2 <= v <= 5.0 for v in vals)
+    # log-uniform: the geometric mean sits near 1, and both halves of
+    # the log-range actually occur
+    g = math.exp(sum(math.log(v) for v in vals) / len(vals))
+    assert 0.8 < g < 1.25
+    assert any(v < 0.5 for v in vals) and any(v > 2.0 for v in vals)
+
+
+@pytest.fixture
+def sudo_shim(tmp_path, monkeypatch):
+    """This container has no sudo binary; the control DSL's su() wraps
+    commands in `sudo -k -S -u root bash -c …`.  A PATH shim that strips
+    sudo's flags and execs the command keeps the REAL command path under
+    test (we already run as root)."""
+    shim_dir = tmp_path / "shim"
+    shim_dir.mkdir()
+    shim = shim_dir / "sudo"
+    shim.write_text(
+        "#!/bin/bash\n"
+        'while [[ $# -gt 0 ]]; do\n'
+        '  case "$1" in\n'
+        "    -k|-S) shift;;\n"
+        "    -u) shift 2;;\n"
+        "    *) break;;\n"
+        "  esac\n"
+        "done\n"
+        'exec "$@"\n'
+    )
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{shim_dir}:{os.environ['PATH']}")
+
+
+def test_wrap_and_unwrap_round_trip(session, sudo_shim, tmp_path):
+    """wrap() swaps a real binary for a faketime launcher (original at
+    <bin>.real); unwrap() restores it.  Driven over the local remote so
+    the mv/chmod/write command paths really execute (reference:
+    faketime.clj:36-55)."""
+    bin_path = tmp_path / "mydb"
+    bin_path.write_text("#!/bin/bash\necho real-db-output\n")
+    bin_path.chmod(0o755)
+
+    _on_node(lambda: faketime.wrap(str(bin_path), offset_s=60.0, rate=2.0))
+    real = tmp_path / "mydb.real"
+    assert real.exists()
+    assert real.read_text().endswith("echo real-db-output\n")
+    wrapper = bin_path.read_text()
+    assert wrapper.startswith("#!/bin/bash\n")
+    assert 'FAKETIME="+60.000000s x2.0"' in wrapper
+    assert f'exec "{real}" "$@"' in wrapper
+    assert os.stat(bin_path).st_mode & stat.S_IXUSR
+    # the wrapper still launches the real binary (LD_PRELOAD of a
+    # missing .so is a warning, not a failure)
+    import subprocess
+
+    out = subprocess.run(
+        [str(bin_path)], capture_output=True, text=True, timeout=30
+    )
+    assert out.returncode == 0 and "real-db-output" in out.stdout
+
+    # wrapping twice must not clobber the preserved original
+    _on_node(lambda: faketime.wrap(str(bin_path), offset_s=1.0))
+    assert real.read_text().endswith("echo real-db-output\n")
+
+    _on_node(lambda: faketime.unwrap(str(bin_path)))
+    assert not real.exists()
+    assert bin_path.read_text().endswith("echo real-db-output\n")
+    # unwrap with nothing to restore is a no-op
+    _on_node(lambda: faketime.unwrap(str(bin_path)))
+    assert bin_path.read_text().endswith("echo real-db-output\n")
