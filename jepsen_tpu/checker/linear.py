@@ -215,6 +215,176 @@ def _final_paths(
     return paths
 
 
+def _partition_by_key(model: Model, events: list, ops: list):
+    """P-compositionality (knossos-style): a multi-register history whose
+    every op touches exactly one key is linearizable iff each per-key
+    subhistory is linearizable against that key's register.  Returns
+    [(submodel, events, ops)] per key, or None when not decomposable.
+    The per-key searches are exponentially smaller than the product
+    search (the config set factors across keys)."""
+    from ..models import MultiRegister
+
+    if not isinstance(model, MultiRegister):
+        return None
+    op_key: list = []
+    for op in ops:
+        keys = {k for _f, k, _v in (op.value or [])}
+        if len(keys) != 1:
+            return None
+        op_key.append(next(iter(keys)))
+    init = model._as_dict()
+    parts: Dict[Any, Tuple[list, list, Dict[int, int]]] = {}
+    for kind, op_id in events:
+        k = op_key[op_id]
+        if k not in parts:
+            parts[k] = ([], [], {})
+        ev_k, ops_k, remap = parts[k]
+        if op_id not in remap:
+            remap[op_id] = len(ops_k)
+            ops_k.append(ops[op_id])
+        ev_k.append((kind, remap[op_id]))
+    return [
+        (MultiRegister({k: init.get(k)}), ev_k, ops_k)
+        for k, (ev_k, ops_k, _remap) in parts.items()
+    ]
+
+
+def _search_fast(
+    model: Model,
+    events: list,
+    ops: list,
+    max_configs: int,
+    deadline: Optional[float],
+    budget_s: Optional[float],
+) -> dict:
+    """The hot search core: states interned to ints, (state, op) steps
+    memoized, linearized-sets as int bitmasks — configs are (int, int)
+    tuples, so hashing and set algebra cost a fraction of the
+    object-based path.  Mask bits are compact SLOTS recycled as ops
+    complete (bounded by peak concurrency plus never-returning info
+    ops), not global op ids — masks stay machine-word sized on long
+    histories.  Same algorithm and verdicts as the witness path; the
+    step memo is sound because Model.step is a pure function of
+    (state value, op value)."""
+    import time as _time
+
+    states: list = [model]
+    sids: Dict[Model, int] = {model: 0}
+    step_memo: Dict[Tuple[int, int], int] = {}
+    configs: Set[Tuple[int, int]] = {(0, 0)}
+    open_ops: list = []
+    slot_of: Dict[int, int] = {}
+    slot_owner: Dict[int, int] = {}
+    free_slots: list = []
+    next_slot = 0
+
+    def overflow_out(reason: str, op_id: int) -> dict:
+        return {
+            "valid?": "unknown",
+            "error": (
+                f"oracle time budget ({budget_s}s) exceeded; "
+                "aborting search"
+                if reason == "deadline"
+                else f"config set exceeded {max_configs}; aborting search"
+            ),
+            "op": ops[op_id].to_dict(),
+        }
+
+    def sample_configs(cfgs) -> list:
+        out = []
+        for sid, mask in list(cfgs)[:10]:
+            pending = []
+            m = mask
+            while m:
+                low = m & -m
+                pending.append(slot_owner.get(low.bit_length() - 1))
+                m ^= low
+            out.append(
+                {"model": repr(states[sid]), "pending": sorted(pending)}
+            )
+        return out
+
+    for kind, op_id in events:
+        if kind == INVOKE:
+            open_ops.append(op_id)
+            if free_slots:
+                slot = free_slots.pop()
+            else:
+                slot = next_slot
+                next_slot += 1
+            slot_of[op_id] = slot
+            slot_owner[slot] = op_id
+        elif kind == OK:
+            # closure to fixpoint, then filter on op_id's bit
+            frontier = configs
+            seen = set(configs)
+            reason = None
+            while frontier:
+                if deadline is not None and _time.monotonic() > deadline:
+                    reason = "deadline"
+                    break
+                new: Set[Tuple[int, int]] = set()
+                for sid, mask in frontier:
+                    for oid in open_ops:
+                        bit = 1 << slot_of[oid]
+                        if mask & bit:
+                            continue
+                        key = (sid, oid)
+                        nsid = step_memo.get(key)
+                        if nsid is None:
+                            m2 = states[sid].step(ops[oid])
+                            if m2.is_inconsistent:
+                                nsid = -1
+                            else:
+                                nsid = sids.get(m2)
+                                if nsid is None:
+                                    nsid = len(states)
+                                    sids[m2] = nsid
+                                    states.append(m2)
+                            step_memo[key] = nsid
+                        if nsid < 0:
+                            continue
+                        cfg = (nsid, mask | bit)
+                        if cfg not in seen:
+                            seen.add(cfg)
+                            new.add(cfg)
+                            if len(seen) > max_configs:
+                                reason = "configs"
+                                break
+                    if reason:
+                        break
+                if reason:
+                    break
+                frontier = new
+            if reason:
+                return overflow_out(reason, op_id)
+            slot = slot_of[op_id]
+            bit = 1 << slot
+            survivors = {
+                (sid, mask & ~bit) for sid, mask in seen if mask & bit
+            }
+            if not survivors:
+                return {
+                    "valid?": False,
+                    "op": ops[op_id].to_dict(),
+                    "configs": sample_configs(seen),
+                }
+            configs = survivors
+            open_ops.remove(op_id)
+            # no surviving mask holds the bit anymore: recycle the slot
+            del slot_of[op_id]
+            del slot_owner[slot]
+            free_slots.append(slot)
+        elif kind == INFO:
+            pass
+
+    return {
+        "valid?": True,
+        "configs": sample_configs(configs),
+        "op-count": len(ops),
+    }
+
+
 def analysis(
     model: Model,
     history: History,
@@ -241,6 +411,29 @@ def analysis(
         _time.monotonic() + budget_s if budget_s is not None else None
     )
     events, ops = prepare(history, pure_fs)
+
+    if not witness:
+        # Fast path: interned-int configs + step memo; per-key
+        # decomposition first when the model factors (knossos-style
+        # P-compositionality).  The witness path below keeps the
+        # object-based search because it must retain parent pointers.
+        parts = _partition_by_key(model, events, ops)
+        if parts is not None and len(parts) > 1:
+            worst = None
+            for m_k, ev_k, ops_k in parts:
+                r = _search_fast(
+                    m_k, ev_k, ops_k, max_configs, deadline, budget_s
+                )
+                if r["valid?"] is False:
+                    return r
+                if r["valid?"] == "unknown":
+                    worst = r
+            if worst is not None:
+                return worst
+            return {"valid?": True, "op-count": len(ops)}
+        return _search_fast(
+            model, events, ops, max_configs, deadline, budget_s
+        )
 
     configs: Set[Tuple[Model, FrozenSet[int]]] = {(model, frozenset())}
     open_ops: Set[int] = set()

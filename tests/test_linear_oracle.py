@@ -344,3 +344,92 @@ def test_oracle_wall_time_budget_returns_unknown():
     assert out3["valid?"] is False, out3
     out4 = linear.analysis(models.owner_mutex(), h, budget_s=60.0)
     assert out4["valid?"] is False, out4
+
+
+def test_fast_path_matches_witness_path():
+    """The interned/memoized fast search (witness=False, the default)
+    must agree with the object-based witness search on every verdict —
+    valid, invalid, and across model families."""
+    import random
+
+    from jepsen_tpu import models, synth
+    from jepsen_tpu.checker import linear
+
+    rng = random.Random(45107)
+    corpora = []
+    for i in range(8):
+        corpora.append(
+            (
+                models.cas_register(0),
+                synth.generate_history(
+                    rng, n_procs=5, n_ops=120, crash_p=0.01,
+                    corrupt=(i % 2 == 0),
+                ),
+                ("read",),
+            )
+        )
+    for i in range(4):
+        corpora.append(
+            (
+                models.mutex(),
+                synth.generate_lock_history(
+                    rng, n_procs=4, n_ops=40, corrupt=(i % 2 == 0)
+                ),
+                (),
+            )
+        )
+    for model, h, pure in corpora:
+        fast = linear.analysis(model, h, pure_fs=pure)
+        slow = linear.analysis(model, h, pure_fs=pure, witness=True)
+        assert fast["valid?"] == slow["valid?"], (model, fast, slow)
+        if fast["valid?"] is False:
+            # both paths blame a completion of the same process
+            assert fast["op"]["process"] == slow["op"]["process"]
+
+
+def test_multi_register_partitioned_search():
+    """Single-key multi-register histories decompose per key
+    (P-compositionality); a per-key anomaly is still caught, and a
+    cross-key transaction disables the decomposition (falls back to the
+    product-state search) without changing verdicts."""
+    from jepsen_tpu import models
+    from jepsen_tpu.checker import linear
+    from jepsen_tpu.history import History, invoke_op, ok_op
+
+    def h(*ops):
+        return History(list(ops)).index_ops()
+
+    model = models.multi_register({0: 0, 1: 0})
+    good = h(
+        invoke_op(0, "txn", [("w", 0, 5)]),
+        ok_op(0, "txn", [("w", 0, 5)]),
+        invoke_op(1, "txn", [("r", 1, 0)]),
+        ok_op(1, "txn", [("r", 1, 0)]),
+        invoke_op(0, "txn", [("r", 0, 5)]),
+        ok_op(0, "txn", [("r", 0, 5)]),
+    )
+    assert linear.analysis(model, good)["valid?"] is True
+
+    bad = h(
+        invoke_op(0, "txn", [("w", 1, 7)]),
+        ok_op(0, "txn", [("w", 1, 7)]),
+        invoke_op(1, "txn", [("r", 1, 3)]),  # never written
+        ok_op(1, "txn", [("r", 1, 3)]),
+    )
+    out = linear.analysis(model, bad)
+    assert out["valid?"] is False
+    assert out["op"]["process"] == 1
+
+    # cross-key txn: decomposition must NOT apply; product search runs
+    cross = h(
+        invoke_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        ok_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        invoke_op(1, "txn", [("r", 0, 1), ("r", 1, 0)]),  # torn read
+        ok_op(1, "txn", [("r", 0, 1), ("r", 1, 0)]),
+    )
+    parts = linear._partition_by_key(
+        model, *linear.prepare(cross)
+    )
+    assert parts is None
+    out = linear.analysis(model, cross)
+    assert out["valid?"] is False
