@@ -238,6 +238,16 @@ class LoopbackProxyNet(Net):
         for fwd in self._routes.values():
             fwd.close()
 
+    def reset(self) -> None:
+        """Close and forget every forwarder so add_route can wire the
+        same Net instance afresh (a DB cycle tears down, then sets up
+        again — the test map's net reference must stay valid across
+        that)."""
+        with self._lock:
+            for fwd in self._routes.values():
+                fwd.close()
+            self._routes.clear()
+
     def drop(self, test, src, dest):
         fwd = self._routes.get((src, dest))
         if fwd is not None:

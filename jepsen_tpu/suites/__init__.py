@@ -36,6 +36,7 @@ SUITES = (
     "galera",
     "hazelcast",
     "ignite",
+    "localkv",
     "logcabin",
     "mongodb_rocks",
     "mongodb_smartos",

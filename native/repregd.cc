@@ -1,0 +1,363 @@
+// repregd — a single-binary replicated linearizable register daemon.
+//
+// This is the "real database" the localkv suite installs: the harness
+// compiles this file ON THE NODE with g++ through the control layer
+// (the same deploy mechanism the reference uses for its clock helpers,
+// jepsen/src/jepsen/nemesis/time.clj:20-50), runs one replica per node
+// under start-stop-daemon, and partitions the peer links mid-workload.
+//
+// Replication is multi-writer ABD over majority quorums:
+//   * every replica persists (ts, tiebreak, value) with fsync;
+//   * a write asks a majority for the max timestamp, picks
+//     (max_ts+1, node_id), and stores to a majority before acking;
+//   * a read asks a majority, takes the max-timestamped value, and
+//     writes it back to a majority before returning (read repair).
+// Quorum intersection makes the register linearizable under crashes
+// and partitions without clocks or leases.
+//
+// Line protocol, one port for clients and peers:
+//   clients:  "R"            -> <value> | ERR-EARLY ...
+//             "W <v>"        -> OK | ERR-EARLY ... | ERR-MAYBE ...
+//             "STATUS"       -> "<ts> <tb> <value>"
+//   peers:    "GET"          -> "<ts> <tb> <value>"
+//             "SET <ts> <tb> <v>" -> OK
+// ERR-EARLY = no store was attempted (definite failure); ERR-MAYBE =
+// stores went out without a majority ack (indeterminate) — the client
+// maps these to :fail / :info.
+//
+// usage: repregd <node_id> <port> <state_path> [peers "2=host:port,..."]
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+static const int kPeerTimeoutMs = 250;
+
+struct Versioned {
+  long long ts = 0;
+  long long tb = 0;
+  long long value = 0;
+};
+
+// fsync'd (ts, tiebreak, value) cell with atomic-rename persistence.
+class State {
+ public:
+  explicit State(std::string path) : path_(std::move(path)) {
+    FILE* f = std::fopen(path_.c_str(), "r");
+    if (f) {
+      Versioned v;
+      if (std::fscanf(f, "%lld %lld %lld", &v.ts, &v.tb, &v.value) == 3)
+        cell_ = v;
+      std::fclose(f);
+    }
+  }
+
+  Versioned read() {
+    std::lock_guard<std::mutex> g(mu_);
+    return cell_;
+  }
+
+  void store_if_newer(const Versioned& v) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (v.ts > cell_.ts || (v.ts == cell_.ts && v.tb > cell_.tb)) {
+      cell_ = v;
+      persist();
+    }
+  }
+
+ private:
+  void persist() {
+    std::string tmp = path_ + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "w");
+    if (!f) return;
+    std::fprintf(f, "%lld %lld %lld", cell_.ts, cell_.tb, cell_.value);
+    std::fflush(f);
+    fsync(fileno(f));
+    std::fclose(f);
+    rename(tmp.c_str(), path_.c_str());
+  }
+
+  std::string path_;
+  std::mutex mu_;
+  Versioned cell_;
+};
+
+struct Peer {
+  int id;
+  std::string host;
+  int port;
+};
+
+// One peer call: connect with a poll()-bounded timeout, one request
+// line, one reply line.  Returns false on any error.
+static bool call_peer(const Peer& p, const std::string& line,
+                      std::string* reply) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  fcntl(fd, F_SETFL, O_NONBLOCK);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(p.port));
+  if (inet_pton(AF_INET, p.host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return false;
+  }
+  int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, kPeerTimeoutMs) <= 0) {
+      close(fd);
+      return false;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if (err != 0) {
+      close(fd);
+      return false;
+    }
+  } else if (rc < 0) {
+    close(fd);
+    return false;
+  }
+  // blocking IO with timeouts from here on
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL) & ~O_NONBLOCK);
+  timeval tv{0, kPeerTimeoutMs * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  std::string msg = line + "\n";
+  if (send(fd, msg.data(), msg.size(), MSG_NOSIGNAL) !=
+      static_cast<ssize_t>(msg.size())) {
+    close(fd);
+    return false;
+  }
+  char buf[256];
+  std::string out;
+  while (out.find('\n') == std::string::npos) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) {
+      close(fd);
+      return false;
+    }
+    out.append(buf, static_cast<size_t>(n));
+  }
+  close(fd);
+  out.erase(out.find('\n'));
+  *reply = out;
+  return true;
+}
+
+class Replica {
+ public:
+  Replica(int id, std::vector<Peer> peers, State* state)
+      : id_(id), peers_(std::move(peers)), state_(state) {
+    n_ = static_cast<int>(peers_.size()) + 1;
+    majority_ = n_ / 2 + 1;
+  }
+
+  std::string handle(const std::vector<std::string>& parts) {
+    const std::string& cmd = parts[0];
+    if (cmd == "R") return client_read();
+    if (cmd == "W" && parts.size() >= 2)
+      return client_write(std::stoll(parts[1]));
+    if (cmd == "GET") {
+      Versioned v = state_->read();
+      return fmt(v.ts, v.tb, v.value);
+    }
+    if (cmd == "SET" && parts.size() >= 4) {
+      state_->store_if_newer(
+          {std::stoll(parts[1]), std::stoll(parts[2]), std::stoll(parts[3])});
+      return "OK";
+    }
+    if (cmd == "STATUS") {
+      Versioned v = state_->read();
+      return fmt(v.ts, v.tb, v.value);
+    }
+    return "ERR";
+  }
+
+ private:
+  static std::string fmt(long long a, long long b, long long c) {
+    std::ostringstream os;
+    os << a << " " << b << " " << c;
+    return os.str();
+  }
+
+  // Ask every peer in parallel; replies land in a shared vector, and
+  // the caller waits out the per-call timeout on a condvar so a hung
+  // peer cannot stall the quorum op past its budget.
+  std::vector<std::string> broadcast(const std::string& line) {
+    auto n = peers_.size();
+    auto replies = std::make_shared<std::vector<std::string>>(n);
+    auto got = std::make_shared<std::vector<bool>>(n, false);
+    auto mu = std::make_shared<std::mutex>();
+    auto cv = std::make_shared<std::condition_variable>();
+    auto done = std::make_shared<size_t>(0);
+    for (size_t i = 0; i < n; i++) {
+      Peer p = peers_[i];
+      std::thread([=] {
+        std::string rep;
+        bool ok = call_peer(p, line, &rep);
+        std::lock_guard<std::mutex> g(*mu);
+        if (ok) {
+          (*replies)[i] = rep;
+          (*got)[i] = true;
+        }
+        (*done)++;
+        cv->notify_all();
+      }).detach();
+    }
+    std::unique_lock<std::mutex> lk(*mu);
+    cv->wait_for(lk, std::chrono::milliseconds(2 * kPeerTimeoutMs + 100),
+                 [&] { return *done == n; });
+    std::vector<std::string> out;
+    for (size_t i = 0; i < n; i++)
+      out.push_back((*got)[i] ? (*replies)[i] : std::string());
+    return out;
+  }
+
+  // (ts, tb, value) of the max-timestamped majority reply (counting
+  // self), or nullopt-style {found=false}.
+  bool quorum_get(Versioned* best) {
+    *best = state_->read();
+    int got = 1;
+    for (const std::string& rep : broadcast("GET")) {
+      if (rep.empty()) continue;
+      Versioned v;
+      if (std::sscanf(rep.c_str(), "%lld %lld %lld", &v.ts, &v.tb,
+                      &v.value) != 3)
+        continue;
+      got++;
+      if (v.ts > best->ts || (v.ts == best->ts && v.tb > best->tb)) *best = v;
+    }
+    return got >= majority_;
+  }
+
+  bool quorum_set(const Versioned& v) {
+    state_->store_if_newer(v);
+    int acks = 1;
+    std::string line = "SET " + fmt(v.ts, v.tb, v.value);
+    for (const std::string& rep : broadcast(line))
+      if (rep == "OK") acks++;
+    return acks >= majority_;
+  }
+
+  std::string client_read() {
+    Versioned best;
+    if (!quorum_get(&best)) return "ERR-EARLY no-quorum";
+    // read repair: the observed value must reach a majority before the
+    // read returns, else a later read could observe an older value
+    if (!quorum_set(best)) return "ERR-EARLY no-quorum";
+    return std::to_string(best.value);
+  }
+
+  std::string client_write(long long v) {
+    // concurrent writes coordinated by this replica must serialize, or
+    // two could pick the same (max_ts+1, id) for different values
+    std::lock_guard<std::mutex> g(write_mu_);
+    Versioned best;
+    if (!quorum_get(&best)) return "ERR-EARLY no-quorum";
+    Versioned next{best.ts + 1, id_, v};
+    if (quorum_set(next)) return "OK";
+    return "ERR-MAYBE no-quorum";
+  }
+
+  int id_;
+  std::vector<Peer> peers_;
+  State* state_;
+  int n_, majority_;
+  std::mutex write_mu_;
+};
+
+static void serve_conn(int fd, Replica* replica) {
+  std::string buf;
+  char chunk[512];
+  for (;;) {
+    size_t nl;
+    while ((nl = buf.find('\n')) == std::string::npos) {
+      ssize_t n = recv(fd, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        close(fd);
+        return;
+      }
+      buf.append(chunk, static_cast<size_t>(n));
+    }
+    std::string line = buf.substr(0, nl);
+    buf.erase(0, nl + 1);
+    std::istringstream is(line);
+    std::vector<std::string> parts;
+    std::string tok;
+    while (is >> tok) parts.push_back(tok);
+    std::string out = parts.empty() ? "ERR" : replica->handle(parts);
+    out += "\n";
+    if (send(fd, out.data(), out.size(), MSG_NOSIGNAL) !=
+        static_cast<ssize_t>(out.size())) {
+      close(fd);
+      return;
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: repregd <node_id> <port> <state_path> [peers]\n");
+    return 2;
+  }
+  int node_id = std::atoi(argv[1]);
+  int port = std::atoi(argv[2]);
+  State state(argv[3]);
+  std::vector<Peer> peers;
+  if (argc >= 5 && argv[4][0] != '\0') {
+    std::string spec = argv[4];
+    std::istringstream is(spec);
+    std::string item;
+    while (std::getline(is, item, ',')) {
+      auto eq = item.find('=');
+      auto colon = item.rfind(':');
+      if (eq == std::string::npos || colon == std::string::npos) continue;
+      peers.push_back({std::atoi(item.substr(0, eq).c_str()),
+                       item.substr(eq + 1, colon - eq - 1),
+                       std::atoi(item.substr(colon + 1).c_str())});
+    }
+  }
+  Replica replica(node_id, peers, &state);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(srv, 64) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+  std::printf("repregd %d listening on %d (%zu peers)\n", node_id, port,
+              peers.size());
+  std::fflush(stdout);
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_conn, fd, &replica).detach();
+  }
+}
