@@ -229,8 +229,12 @@ INDETERMINATE = object()
 #: Default expansion budget for the bounded simple-cycle search (DFS
 #: node expansions across the whole SCC).  Simple-cycle enumeration is
 #: exponential in the worst case; the budget keeps classify() bounded
-#: while letting it answer definitively on real-world SCC sizes.
-NONADJ_BUDGET = 200_000
+#: while letting it answer definitively on real-world SCC sizes.  The
+#: DFS prunes to vertices that can still reach the cycle's start
+#: (Johnson-style), so realistic per-key dependency graphs resolve in
+#: far fewer steps than this — the bound is a backstop, not a ceiling
+#: histories routinely hit.
+NONADJ_BUDGET = 2_000_000
 
 
 def find_nonadjacent_cycle(
@@ -344,10 +348,25 @@ def _simple_nonadjacent_dfs(
     Returns ``(cycle_or_None, budget_exhausted)``.  The first edge out
     of each start is forced to be a want edge (rotation completeness);
     interior vertices are never revisited, so every found cycle is
-    simple by construction."""
+    simple by construction.  Per start, the walk is pruned to vertices
+    that can still REACH the start over usable edges (Johnson-style):
+    any simple cycle through start lies entirely in that set, so the
+    prune is exact while dead-end subgraphs — the DFS's exponential
+    waste on real dependency graphs — are never entered."""
     steps = 0
 
-    def options(v: Any, last_want: bool, start: Any, on_path: Set[Any]):
+    # usable reverse adjacency within the SCC (edges failing both
+    # predicates can never appear in a qualifying cycle)
+    rpred: Dict[Any, List[Any]] = {v: [] for v in members}
+    for v in members:
+        for w in g.successors(v):
+            if w in members and w != v:
+                rels = g.edge_rels(v, w)
+                if rest(rels) or want(rels):
+                    rpred[w].append(v)
+
+    def options(v: Any, last_want: bool, start: Any, on_path: Set[Any],
+                reach: Set[Any]):
         for w in g.successors(v):
             if w not in members:
                 continue
@@ -358,7 +377,7 @@ def _simple_nonadjacent_dfs(
                 if rest(rels):
                     yield (w, False)
                 continue
-            if w in on_path:
+            if w in on_path or w not in reach:
                 continue
             if rest(rels):
                 yield (w, False)
@@ -366,16 +385,38 @@ def _simple_nonadjacent_dfs(
                 yield (w, True)
 
     for start in scc:
+        # skip the reach BFS entirely for starts with no qualifying
+        # want out-edge — most vertices of a real dependency graph
+        if not any(
+            w in members and w != start and want(g.edge_rels(start, w))
+            for w in g.successors(start)
+        ):
+            continue
+        # vertices that can reach start over usable edges; its pops
+        # count against the same budget as DFS steps so the budget
+        # bounds TOTAL work, not just the enumeration phase
+        reach: Set[Any] = {start}
+        rq: deque = deque([start])
+        while rq:
+            steps += 1
+            if steps > budget:
+                return None, True
+            x = rq.popleft()
+            for p in rpred[x]:
+                if p not in reach:
+                    reach.add(p)
+                    rq.append(p)
         for first in g.successors(start):
             if (
                 first not in members
                 or first == start
+                or first not in reach
                 or not want(g.edge_rels(start, first))
             ):
                 continue
             path = [start, first]
             on_path = {start, first}
-            stack = [options(first, True, start, on_path)]
+            stack = [options(first, True, start, on_path, reach)]
             while stack:
                 steps += 1
                 if steps > budget:
@@ -390,5 +431,5 @@ def _simple_nonadjacent_dfs(
                     return path + [start], False
                 path.append(w)
                 on_path.add(w)
-                stack.append(options(w, is_want, start, on_path))
+                stack.append(options(w, is_want, start, on_path, reach))
     return None, False
