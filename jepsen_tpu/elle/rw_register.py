@@ -195,11 +195,35 @@ def version_graphs(
                 ):
                     graphs[k1].add_edge(u, v, "version")
 
+    # Batched cycle screen over every per-key version graph at once —
+    # the device closure kernel (or per-graph SCC, whichever the
+    # self-calibrating router picks for this backend and size); only
+    # keys the screen flags pay the detailed SCC extraction (cyclic
+    # keys are anomalies, so the double pass is the rare case).  This
+    # is the Elle-on-TPU seam from SURVEY.md §7 step 8 running inside
+    # the production pipeline, not just the benchmark.  Batches the
+    # screen can't win (few graphs, or any graph past the device
+    # vertex cap) keep the direct per-graph SCC pass — routing through
+    # the mask there would compute SCCs and throw them away.
     cyclic = []
-    for k, g in graphs.items():
-        sccs = cycles_mod.strongly_connected_components(g)
-        if sccs:
-            cyclic.append({"key": k, "sccs": [[repr(v) for v in c] for c in sccs]})
+    items = list(graphs.items())
+    use_screen = len(items) >= 16 and all(
+        len(g.vertices) <= cycles_mod.DEVICE_SCREEN_MAX_VERTICES
+        for _k, g in items
+    )
+    if use_screen:
+        mask = cycles_mod.cyclic_graph_mask([g for _k, g in items])
+    else:
+        mask = [
+            bool(cycles_mod.strongly_connected_components(g))
+            for _k, g in items
+        ]
+    for (k, g), has_cycle in zip(items, mask):
+        if has_cycle:
+            sccs = cycles_mod.strongly_connected_components(g)
+            cyclic.append(
+                {"key": k, "sccs": [[repr(v) for v in c] for c in sccs]}
+            )
     return graphs, cyclic
 
 

@@ -988,3 +988,28 @@ def test_nonadjacent_dfs_prunes_dead_ends_at_budget_edge():
         budget=200_000,
     )
     assert found2 is None and not exhausted2
+
+
+def test_cyclic_versions_through_batched_screen():
+    """version_graphs now screens every per-key graph through the
+    batched cyclic_graph_mask router; a contradictory version order
+    (x: 1->2 and 2->1) must still surface as cyclic-versions, and
+    clean keys must not."""
+    h = hist(
+        txn_pair(0, [["w", "x", 1], ["w", "x", 2]],
+                 [["w", "x", 1], ["w", "x", 2]], 0),
+        txn_pair(1, [["w", "x", 2], ["w", "x", 1]],
+                 [["w", "x", 2], ["w", "x", 1]], 10),
+        # a boring healthy key rides along in the same batch
+        txn_pair(0, [["w", "y", 7]], [["w", "y", 7]], 20),
+    )
+    res = rw_register.check(h, {"consistency-models": ["serializable"]})
+    assert "cyclic-versions" in res.get("anomaly-types", []) or (
+        "cyclic-versions" in res.get("also-anomaly-types", [])
+    ), res
+    cases = (res.get("anomalies", {}).get("cyclic-versions")
+             or res["also-anomalies"]["cyclic-versions"])
+    assert any(c["key"] == "x" for c in cases)
+    assert not any(c["key"] == "y" for c in cases)
+    # contradictory version orders make the verdict unprovable, not valid
+    assert res["valid?"] in (False, "unknown")
