@@ -300,6 +300,7 @@ def run_bench(on_accelerator, warnings):
         "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
         "kernel": wgl.kernel_choice("cas-register", C, vmax + 1),
+        "dense_union": os.environ.get("JEPSEN_TPU_DENSE_UNION", "gather"),
         "samples": samples,
     }
     return value, L, diag
@@ -307,12 +308,16 @@ def run_bench(on_accelerator, warnings):
 
 def _persist_artifact(payload, diag):
     record = {"captured_at": _utcnow(), **payload, "diag": diag}
-    try:
-        with open(ARTIFACT, "w") as f:
-            json.dump(record, f)
-            f.write("\n")
-    except OSError as e:
-        print(f"artifact write failed: {e!r}", file=sys.stderr)
+    # BENCH_tpu_latest.json is the default-configuration artifact; an
+    # experimental-lowering run (diag.dense_union != gather) appends a
+    # labeled window below but must not take over the headline record
+    if diag.get("dense_union", "gather") == "gather":
+        try:
+            with open(ARTIFACT, "w") as f:
+                json.dump(record, f)
+                f.write("\n")
+        except OSError as e:
+            print(f"artifact write failed: {e!r}", file=sys.stderr)
     # append-only window history: every live-chip capture survives, so
     # the round record carries N windows with dispersion, not one
     try:

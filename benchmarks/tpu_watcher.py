@@ -68,7 +68,7 @@ def probe():
     return probe_accelerator(retries=1, backoff_s=0)
 
 
-def run(argv, timeout_s):
+def run(argv, timeout_s, env=None):
     t0 = time.monotonic()
     try:
         p = subprocess.run(
@@ -77,6 +77,7 @@ def run(argv, timeout_s):
             timeout=timeout_s,
             capture_output=True,
             text=True,
+            env={**os.environ, **(env or {})},
         )
         return p.returncode, round(time.monotonic() - t0, 1), p.stdout[-500:]
     except subprocess.TimeoutExpired:
@@ -103,6 +104,14 @@ def main():
         log("frontier", rc=rc, elapsed_s=dt, tail=tail)
         rc, dt, tail = run([sys.executable, "bench.py"], 1800)
         log("bench", rc=rc, elapsed_s=dt, tail=tail)
+        # A/B the dense subset-union lowering (RESULTS.md roofline plan):
+        # the unroll variant is bit-equivalent (tests/test_dense.py) and
+        # its window, if faster, is legitimate on-chip evidence
+        rc, dt, tail = run(
+            [sys.executable, "bench.py"], 1800,
+            env={"JEPSEN_TPU_DENSE_UNION": "unroll"},
+        )
+        log("bench-unroll", rc=rc, elapsed_s=dt, tail=tail)
         rc, dt, tail = run(
             [sys.executable, os.path.join(HERE, "elle_bench.py")], 1800
         )

@@ -219,6 +219,39 @@ def _subset_maps(C: int):
     )
 
 
+def _xor_permute(x, wb: int):
+    """x[..., k] → x[..., k ^ wb] along the last axis, as reshape +
+    flip (wb a power of two) — a layout shuffle XLA cannot mistake for
+    a data-dependent gather."""
+    shape = x.shape
+    W = shape[-1]
+    xr = x.reshape(*shape[:-1], W // (2 * wb), 2, wb)
+    return xr[..., ::-1, :].reshape(*shape)
+
+
+def _or_select(x, wb: int):
+    """x[..., k] → x[..., k | wb]: both halves of each 2·wb block read
+    the high half."""
+    shape = x.shape
+    W = shape[-1]
+    xr = x.reshape(*shape[:-1], W // (2 * wb), 2, wb)
+    hi = xr[..., 1:2, :]
+    return jnp.concatenate([hi, hi], axis=-2).reshape(*shape)
+
+
+#: subset-map implementation for the dense kernels: "gather" (default,
+#: take_along_axis over constant index tensors) or "unroll" (per-slot
+#: static shuffles — reshape/flip for the j≥5 word permutations, pure
+#: mask/shift below).  Same results bit-for-bit (differentially
+#: tested); the switch exists because a gather lowering on TPU would
+#: dominate the closure cost (benchmarks/RESULTS.md, dense-kernel
+#: roofline), and only an on-chip A/B can settle which lowering wins.
+def _union_mode() -> str:
+    import os
+
+    return os.environ.get("JEPSEN_TPU_DENSE_UNION", "gather")
+
+
 def _subset_has(C: int):
     """has[j]: [W] uint32 mask of packed bits whose subset index has
     bit j SET — the "configs that linearized slot j" selector."""
@@ -245,7 +278,8 @@ def _or_fold(terms):
 
 
 def build_dense(
-    spec_name: str, E: int, C: int, V, mr_shape=None, permits_shape=None
+    spec_name: str, E: int, C: int, V, mr_shape=None, permits_shape=None,
+    union: str = "gather",
 ):
     """Build the (unjitted) vmapped dense checker for fixed shapes.
     Signature matches wgl.build_batched's result: ``fn(init_state,
@@ -295,6 +329,7 @@ def build_dense(
     uidx, umask, ushl, didx, dmask, dshr = _subset_maps(C)
     uidx_b = jnp.broadcast_to(uidx[:, None, :], (C, V, W))
     didx_b = jnp.broadcast_to(didx[:, None, :], (C, V, W))
+    union_unroll = union == "unroll"
 
     def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
         if multi:
@@ -420,9 +455,16 @@ def build_dense(
                     for v in range(V)
                 )
                 # subset-union map s → s | bit_j, packed axis
-                U = jnp.take_along_axis(X, uidx_b, axis=2)
-                U = (U & umask[:, None, :]) << ushl[:, None, None]
-                add = _or_fold(U[j] for j in range(C))
+                if union_unroll:
+                    add = _or_fold(
+                        ((X[j] if j < 5 else _xor_permute(X[j], 1 << (j - 5)))
+                         & umask[j][None, :]) << ushl[j]
+                        for j in range(C)
+                    )
+                else:
+                    U = jnp.take_along_axis(X, uidx_b, axis=2)
+                    U = (U & umask[:, None, :]) << ushl[:, None, None]
+                    add = _or_fold(U[j] for j in range(C))
                 Dn = Dc | add
                 changed = (Dn != Dc).any()
                 return (Dn, changed, i + 1)
@@ -433,10 +475,19 @@ def build_dense(
 
             # --- completion: keep configs that linearized e_slot, then
             # promote it out of the linset (slot frees for reuse) ---
-            Ds = jnp.take_along_axis(
-                jnp.broadcast_to(Dc[None], (C, V, W)), didx_b, axis=2
-            )
-            Dvar = (Ds >> dshr[:, None, None]) & dmask[:, None, :]
+            if union_unroll:
+                Dvar = jnp.stack(
+                    [
+                        ((Dc if j < 5 else _or_select(Dc, 1 << (j - 5)))
+                         >> dshr[j]) & dmask[j][None, :]
+                        for j in range(C)
+                    ]
+                )
+            else:
+                Ds = jnp.take_along_axis(
+                    jnp.broadcast_to(Dc[None], (C, V, W)), didx_b, axis=2
+                )
+                Dvar = (Ds >> dshr[:, None, None]) & dmask[:, None, :]
             onehot = (e_slot == jnp.arange(C))[:, None, None]
             Df = _or_fold(
                 jnp.where(onehot[j], Dvar[j], jnp.uint32(0)) for j in range(C)
@@ -608,15 +659,19 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
     pair."""
     if spec_name == "unordered-queue":
         V = 0
-    return _make_dense_fn_cached(spec_name, E, C, V)
+    # the union-map mode is part of the cache key: flipping
+    # JEPSEN_TPU_DENSE_UNION must rebuild, not hit the old lowering
+    return _make_dense_fn_cached(spec_name, E, C, V, _union_mode())
 
 
 @lru_cache(maxsize=64)
-def _make_dense_fn_cached(spec_name: str, E: int, C: int, V):
+def _make_dense_fn_cached(spec_name: str, E: int, C: int, V, union="gather"):
     if spec_name == "unordered-queue":
         return jax.jit(build_dense_queue(E, C))
     if spec_name == "multi-register":
-        return jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V))
+        return jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V,
+                                   union=union))
     if spec_name == "acquired-permits":
-        return jax.jit(build_dense(spec_name, E, C, 0, permits_shape=V))
-    return jax.jit(build_dense(spec_name, E, C, V))
+        return jax.jit(build_dense(spec_name, E, C, 0, permits_shape=V,
+                                   union=union))
+    return jax.jit(build_dense(spec_name, E, C, V, union=union))

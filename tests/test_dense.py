@@ -318,3 +318,42 @@ def test_mr_dense_golden_cross_register():
     assert out_good["kernel"] == "dense", out_good
     assert out_good["valid?"] is True
     assert out_bad["valid?"] is False
+
+
+def test_union_unroll_mode_matches_gather(monkeypatch):
+    """The unrolled static-shuffle subset maps
+    (JEPSEN_TPU_DENSE_UNION=unroll) must produce identical verdicts and
+    failure indices to the default take_along_axis path on a corrupted
+    mixed corpus — the on-chip A/B in RESULTS.md's roofline plan is only
+    meaningful if the two lowerings are bit-equivalent."""
+    import random
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import dense, encode
+
+    rng = random.Random(45109)
+    hists = [
+        synth.generate_history(
+            rng, n_procs=8, n_ops=120, crash_p=0.01, corrupt=(i % 3 == 0)
+        )
+        for i in range(12)
+    ]
+    batch = encode.batch_encode(hists, m.cas_register(0), slot_cap=8)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    V = encode.round_up(
+        int(max(batch.cand_a.max(), batch.cand_b.max(),
+                batch.init_state.max())) + 1, 4)
+    args = (batch.init_state, batch.ev_slot, batch.cand_slot,
+            batch.cand_f, batch.cand_a, batch.cand_b)
+
+    monkeypatch.delenv("JEPSEN_TPU_DENSE_UNION", raising=False)
+    ok_g, fail_g, _ = dense.make_dense_fn("cas-register", E, C, V)(*args)
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
+    ok_u, fail_u, _ = dense.make_dense_fn("cas-register", E, C, V)(*args)
+    import numpy as np
+
+    assert (np.asarray(ok_g) == np.asarray(ok_u)).all()
+    assert (np.asarray(fail_g) == np.asarray(fail_u)).all()
+    assert not np.asarray(ok_g).all()  # the corpus really has invalids
