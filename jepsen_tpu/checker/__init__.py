@@ -566,6 +566,38 @@ RACE_LOSER_WAIT_S = 60.0
 
 
 class _Linearizable(Checker):
+    def _oracle_analysis(self, history) -> dict:
+        """Fast interned-int search first; only a FAILING history pays
+        the witness re-run (object-based search with parent pointers,
+        so the report carries final-paths/ops).  The re-run gets only
+        the REMAINING wall budget, and its verdict replaces the fast
+        one only when it also confirms the failure — the whole-history
+        witness search can blow budget/configs on a history the
+        decomposed fast path already decided, and a definite False must
+        never downgrade to unknown."""
+        import time as _time
+
+        from . import linear
+
+        t0 = _time.monotonic()
+        a = linear.analysis(
+            self.model, history, pure_fs=self.pure_fs,
+            budget_s=self.oracle_budget_s,
+        )
+        if a.get("valid?") is False:
+            remaining = None
+            if self.oracle_budget_s is not None:
+                remaining = max(
+                    0.0, self.oracle_budget_s - (_time.monotonic() - t0)
+                )
+            w = linear.analysis(
+                self.model, history, pure_fs=self.pure_fs, witness=True,
+                budget_s=remaining,
+            )
+            if w.get("valid?") is False:
+                a = w  # confirmed, now with the witness report attached
+        return a
+
     def _race(self, test, history) -> dict:
         """Run the device kernel and the CPU oracle concurrently; the
         first DEFINITE (non-unknown) verdict wins.  Both arms tag their
@@ -590,10 +622,7 @@ class _Linearizable(Checker):
             return out
 
         def oracle():
-            out = linear.analysis(
-                self.model, history, pure_fs=self.pure_fs, witness=True,
-                budget_s=self.oracle_budget_s,
-            )
+            out = self._oracle_analysis(history)
             out["engine"] = "oracle"
             return out
 
@@ -678,14 +707,7 @@ class _Linearizable(Checker):
                 self.model, history, oracle_budget_s=self.oracle_budget_s
             )
         else:
-            # witness=True tracks parent pointers (one dict insert per
-            # new config, reset per completed op) so a failing analysis
-            # already carries final-paths/ops — render_witness would
-            # otherwise rerun the whole exponential search from scratch
-            a = linear.analysis(
-                self.model, history, pure_fs=self.pure_fs, witness=True,
-                budget_s=self.oracle_budget_s,
-            )
+            a = self._oracle_analysis(history)
         # Failure witness: linear.svg with final configs/paths around the
         # non-linearizable op (reference: checker.clj:206-210, where
         # knossos.linear.report renders the same artifact).  Only when
