@@ -61,16 +61,27 @@ def prepare(history: History, pure_fs: Iterable[Any] = ()) -> Tuple[list, list]:
     pure_fs (state-preserving reads) are dropped too.
 
     One fused pass: pairing, failure/pure-read dropping, and value
-    propagation together, copying only the invocations that survive —
-    this runs per history on the host ingest path (encode + oracle),
-    where the former copy-everything/three-pass pipeline dominated
-    encoding cost (SURVEY.md §7, host↔device feed rate).
+    propagation together.  The returned ops ALIAS the caller's Op
+    objects except where a completion changed the value (those are
+    copied before mutation) — callers must treat them as read-only;
+    anything needing to mutate must copy first.  The former
+    copy-every-invocation pipeline dominated host encoding cost
+    (SURVEY.md §7, host↔device feed rate).
     """
     pure = set(pure_fs)
     events: list = []
     ops: list = []
     open_by_process: Dict[Any, int] = {}
     dropped: set = set()
+    def propagate(op_id, value):
+        """Copy-on-write value propagation: the ops list holds the
+        caller's Op objects until a completion actually changes one —
+        unconditional copies dominated the host encode path (~30% of
+        batch_encode, SURVEY §7 host↔device feed rate)."""
+        if value is not None and ops[op_id].value != value:
+            ops[op_id] = ops[op_id].copy()
+            ops[op_id].value = value
+
     for op in history:
         p = op.process
         if not isinstance(p, int):
@@ -78,14 +89,13 @@ def prepare(history: History, pure_fs: Iterable[Any] = ()) -> Tuple[list, list]:
         t = op.type
         if t == INVOKE:
             op_id = len(ops)
-            ops.append(op.copy())
+            ops.append(op)
             open_by_process[p] = op_id
             events.append((INVOKE, op_id))
         elif t == OK:
             op_id = open_by_process.pop(p, None)
             if op_id is not None:
-                if op.value is not None:
-                    ops[op_id].value = op.value
+                propagate(op_id, op.value)
                 events.append((OK, op_id))
         elif t == FAIL:
             op_id = open_by_process.pop(p, None)
@@ -104,8 +114,7 @@ def prepare(history: History, pure_fs: Iterable[Any] = ()) -> Tuple[list, list]:
                     # acted on the way out); without it an owner-aware
                     # model could never linearize the op and would
                     # wrongly poison every later legitimate step
-                    if op.value is not None:
-                        ops[op_id].value = op.value
+                    propagate(op_id, op.value)
                     events.append((INFO, op_id))
     # processes whose invoke never completed at all: same as info (open
     # forever)
