@@ -256,6 +256,12 @@ def single_test_cmd(
         if stored is None:
             print("no stored test found", file=sys.stderr)
             return EXIT_USAGE
+        if stored.get("recovered"):
+            print(
+                "note: test.jtpu was torn; analyzing the recovered "
+                "valid prefix (the newest durable save phase)",
+                file=sys.stderr,
+            )
         test = test_fn({**given_opts(args), **test_opts_to_map(args), **stored})
         history = stored.get("history")
         results = checker_mod.check_safe(test["checker"], test, history, {})
