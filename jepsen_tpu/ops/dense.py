@@ -515,7 +515,7 @@ def build_dense(
     return jax.vmap(check_one)
 
 
-def build_dense_queue(E: int, C: int):
+def build_dense_queue(E: int, C: int, union: str = "gather"):
     """Dense unordered-queue kernel: unique-value enqueues/dequeues
     commute, so a config's multiset state is a pure function of its
     linset — the search state collapses to ONE packed bitset over the
@@ -535,6 +535,7 @@ def build_dense_queue(E: int, C: int):
     W = _n_words(C)
     max_closure = C + 2
     uidx, umask, ushl, didx, dmask, dshr = _subset_maps(C)
+    union_unroll = union == "unroll"
     has = _subset_has(C)
     ones = jnp.full((W,), 0xFFFFFFFF, jnp.uint32)
     zeros = jnp.zeros((W,), jnp.uint32)
@@ -604,9 +605,17 @@ def build_dense_queue(E: int, C: int):
             def body(c):
                 Dc, _, i = c
                 X = Dc[None, :] & valid           # [C, W] legal sources
-                U = jnp.take_along_axis(X, uidx, axis=1)
-                U = (U & umask) << ushl[:, None]
-                Dn = Dc | _or_fold(U[j] for j in range(C))
+                if union_unroll:
+                    add = _or_fold(
+                        ((X[j] if j < 5 else _xor_permute(X[j], 1 << (j - 5)))
+                         & umask[j]) << ushl[j]
+                        for j in range(C)
+                    )
+                else:
+                    U = jnp.take_along_axis(X, uidx, axis=1)
+                    U = (U & umask) << ushl[:, None]
+                    add = _or_fold(U[j] for j in range(C))
+                Dn = Dc | add
                 return (Dn, (Dn != Dc).any(), i + 1)
 
             Dc, _, _ = lax.while_loop(
@@ -614,10 +623,19 @@ def build_dense_queue(E: int, C: int):
             )
 
             # --- completion: filter + promote e_slot ---
-            Ds = jnp.take_along_axis(
-                jnp.broadcast_to(Dc[None], (C, W)), didx, axis=1
-            )
-            Dvar = (Ds >> dshr[:, None]) & dmask
+            if union_unroll:
+                Dvar = jnp.stack(
+                    [
+                        ((Dc if j < 5 else _or_select(Dc, 1 << (j - 5)))
+                         >> dshr[j]) & dmask[j]
+                        for j in range(C)
+                    ]
+                )
+            else:
+                Ds = jnp.take_along_axis(
+                    jnp.broadcast_to(Dc[None], (C, W)), didx, axis=1
+                )
+                Dvar = (Ds >> dshr[:, None]) & dmask
             onehot = e_slot == jnp.arange(C)
             Df = _or_fold(
                 jnp.where(onehot[j], Dvar[j], jnp.uint32(0)) for j in range(C)
@@ -667,7 +685,7 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
 @lru_cache(maxsize=64)
 def _make_dense_fn_cached(spec_name: str, E: int, C: int, V, union="gather"):
     if spec_name == "unordered-queue":
-        return jax.jit(build_dense_queue(E, C))
+        return jax.jit(build_dense_queue(E, C, union=union))
     if spec_name == "multi-register":
         return jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V,
                                    union=union))

@@ -357,3 +357,33 @@ def test_union_unroll_mode_matches_gather(monkeypatch):
     assert (np.asarray(ok_g) == np.asarray(ok_u)).all()
     assert (np.asarray(fail_g) == np.asarray(fail_u)).all()
     assert not np.asarray(ok_g).all()  # the corpus really has invalids
+
+
+def test_queue_union_unroll_matches_gather(monkeypatch):
+    """The unroll lowering must also be bit-equivalent on the queue
+    kernel (its own closure/completion use the same subset maps)."""
+    import random
+
+    import numpy as np
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.ops import dense, encode
+
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_models import _gen_queue_history  # noqa: E402
+
+    rng = random.Random(45110)
+    hists = [_gen_queue_history(rng, n_procs=6, n_ops=24) for _ in range(8)]
+    batch = encode.batch_encode(hists, m.unordered_queue(), slot_cap=6)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    args = (batch.init_state, batch.ev_slot, batch.cand_slot,
+            batch.cand_f, batch.cand_a, batch.cand_b)
+    monkeypatch.delenv("JEPSEN_TPU_DENSE_UNION", raising=False)
+    ok_g, fail_g, _ = dense.make_dense_fn("unordered-queue", E, C, 0)(*args)
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
+    ok_u, fail_u, _ = dense.make_dense_fn("unordered-queue", E, C, 0)(*args)
+    assert (np.asarray(ok_g) == np.asarray(ok_u)).all()
+    assert (np.asarray(fail_g) == np.asarray(fail_u)).all()
