@@ -335,7 +335,27 @@ def _load_artifact():
         return None
 
 
-def _best_window():
+def _read_windows():
+    """All parsable records from the window history (shared by the
+    best-window pick and the summary so the file is parsed once).
+    Parses per line and skips unparsable ones — a process dying
+    mid-append must not erase the record of every *other* window."""
+    recs = []
+    try:
+        with open(WINDOWS) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return recs
+
+
+def _best_window(recs):
     """The best recorded on-chip capture window, or None.  When the
     live driver run lands on the CPU fallback, THIS is the number the
     round record should headline — a consumer parsing only the
@@ -349,19 +369,9 @@ def _best_window():
         return vsb if vsb is not None else (rec.get("value") or 0) / NORTH_STAR
 
     best = None
-    try:
-        with open(WINDOWS) as f:
-            for line in f:
-                if not line.strip():
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if rec.get("value") and (best is None or rank(rec) > rank(best)):
-                    best = rec
-    except OSError:
-        pass
+    for rec in recs:
+        if rec.get("value") and (best is None or rank(rec) > rank(best)):
+            best = rec
     if best is None:
         best = _load_artifact()
     return best
@@ -385,23 +395,8 @@ def _headline_best(best, live_payload, reason, wrap_key):
     }
 
 
-def _windows_summary():
-    """Count + spread of all recorded on-chip capture windows.  Parses
-    per line and skips unparsable ones — a process dying mid-append
-    (the TPU tunnel drops intermittently) must not erase the record of
-    every *other* window."""
-    try:
-        with open(WINDOWS) as f:
-            recs = []
-            for line in f:
-                if not line.strip():
-                    continue
-                try:
-                    recs.append(json.loads(line))
-                except ValueError:
-                    continue
-    except OSError:
-        return None
+def _windows_summary(recs):
+    """Count + spread of all recorded on-chip capture windows."""
     if not recs:
         return None
     medians = [r.get("value") for r in recs if r.get("value") is not None]
@@ -445,7 +440,8 @@ def main():
             if warnings:
                 payload["error"] = warnings[0]
                 warnings = warnings[1:]
-            best = None if on_accel else _best_window()
+            recs = _read_windows()
+            best = None if on_accel else _best_window(recs)
             if best is not None:
                 # Headline the round's best recorded on-chip window; the
                 # live host-fallback measurement moves to cpu_fallback so
@@ -461,7 +457,7 @@ def main():
                 # most recent real on-chip measurement (skipped when it
                 # is the very record already headlined above)
                 payload["onchip_latest"] = prior
-            windows = _windows_summary()
+            windows = _windows_summary(recs)
             if windows is not None:
                 payload["onchip_windows"] = windows
         if warnings:
@@ -480,7 +476,7 @@ def main():
             "vs_baseline": 0.0,
             "error": "; ".join(warnings + [repr(e)[:300]]),
         }
-        best = _best_window()
+        best = _best_window(_read_windows())
         if best is not None:
             payload = _headline_best(
                 best, payload, "live driver run errored", "failed_run"

@@ -235,17 +235,17 @@ class LoopbackProxyNet(Net):
         return self._routes[(src, dst)].port
 
     def close(self) -> None:
-        for fwd in self._routes.values():
-            fwd.close()
+        with self._lock:
+            for fwd in self._routes.values():
+                fwd.close()
 
     def reset(self) -> None:
         """Close and forget every forwarder so add_route can wire the
         same Net instance afresh (a DB cycle tears down, then sets up
         again — the test map's net reference must stay valid across
         that)."""
+        self.close()
         with self._lock:
-            for fwd in self._routes.values():
-                fwd.close()
             self._routes.clear()
 
     def drop(self, test, src, dest):
