@@ -26,8 +26,9 @@ Capability map (reference: remysaissy/jepsen, studied in SURVEY.md):
 - ``jepsen_tpu.cli``        — command-line entry points
 - ``jepsen_tpu.elle``       — transactional anomaly (cycle) checking
 - ``jepsen_tpu.trace``      — span tracing with pluggable exporters
-- ``jepsen_tpu.suites``     — 27 database test suites over from-scratch
-  wire protocols
+- ``jepsen_tpu.suites``     — 28 database test suites over from-scratch
+  wire protocols (incl. ``localkv``, a native C++ replicated register
+  compiled on-node — the zero-dependency real-cluster proof)
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
