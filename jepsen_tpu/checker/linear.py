@@ -421,32 +421,53 @@ def analysis(
     )
     events, ops = prepare(history, pure_fs)
 
+    # Per-key decomposition first when the model factors (knossos-style
+    # P-compositionality) — for BOTH paths: the fast search checks each
+    # key, and a witness run then searches ONLY the failing key's
+    # subhistory, so the witness report stays focused and the
+    # object-based search never pays the whole-history state space.
+    parts = _partition_by_key(model, events, ops)
+    if parts is not None and len(parts) > 1:
+        worst = None
+        for m_k, ev_k, ops_k in parts:
+            r = _search_fast(
+                m_k, ev_k, ops_k, max_configs, deadline, budget_s
+            )
+            if r["valid?"] is False:
+                if witness:
+                    return _search_witness(
+                        m_k, ev_k, ops_k, max_configs, deadline, budget_s
+                    )
+                return r
+            if r["valid?"] == "unknown":
+                worst = r
+        if worst is not None:
+            return worst
+        return {"valid?": True, "op-count": len(ops)}
     if not witness:
-        # Fast path: interned-int configs + step memo; per-key
-        # decomposition first when the model factors (knossos-style
-        # P-compositionality).  The witness path below keeps the
-        # object-based search because it must retain parent pointers.
-        parts = _partition_by_key(model, events, ops)
-        if parts is not None and len(parts) > 1:
-            worst = None
-            for m_k, ev_k, ops_k in parts:
-                r = _search_fast(
-                    m_k, ev_k, ops_k, max_configs, deadline, budget_s
-                )
-                if r["valid?"] is False:
-                    return r
-                if r["valid?"] == "unknown":
-                    worst = r
-            if worst is not None:
-                return worst
-            return {"valid?": True, "op-count": len(ops)}
         return _search_fast(
             model, events, ops, max_configs, deadline, budget_s
         )
+    return _search_witness(
+        model, events, ops, max_configs, deadline, budget_s
+    )
 
+
+def _search_witness(
+    model: Model,
+    events: list,
+    ops: list,
+    max_configs: int,
+    deadline: Optional[float],
+    budget_s: Optional[float],
+) -> dict:
+    """The object-based search with parent pointers: slower than
+    :func:`_search_fast`, but a failure carries ``final-paths`` (one
+    linearization path per surviving config since the last completed
+    op) for the witness renderer."""
     configs: Set[Tuple[Model, FrozenSet[int]]] = {(model, frozenset())}
     open_ops: Set[int] = set()
-    parents: Optional[Dict] = {} if witness else None
+    parents: Dict = {}
 
     for kind, op_id in events:
         if kind == INVOKE:
@@ -480,17 +501,15 @@ def analysis(
                         for m, linset in list(configs)[:10]
                     ],
                 }
-                if witness:
-                    out["final-paths"] = _final_paths(
-                        configs, parents, ops, ops[op_id]
-                    )
-                    out["failed-op-id"] = op_id
-                    out["ops"] = [o.to_dict() for o in ops]
-                    out["open-ops"] = sorted(open_ops)
+                out["final-paths"] = _final_paths(
+                    configs, parents, ops, ops[op_id]
+                )
+                out["failed-op-id"] = op_id
+                out["ops"] = [o.to_dict() for o in ops]
+                out["open-ops"] = sorted(open_ops)
                 return out
             configs = survivors
-            if parents is not None:
-                parents = {}  # re-root paths at the new common prefix
+            parents = {}  # re-root paths at the new common prefix
             open_ops.discard(op_id)
         elif kind == INFO:
             # stays open forever; nothing to do
