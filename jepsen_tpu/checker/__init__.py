@@ -567,36 +567,20 @@ RACE_LOSER_WAIT_S = 60.0
 
 class _Linearizable(Checker):
     def _oracle_analysis(self, history) -> dict:
-        """Fast interned-int search first; only a FAILING history pays
-        the witness re-run (object-based search with parent pointers,
-        so the report carries final-paths/ops).  The re-run gets only
-        the REMAINING wall budget, and its verdict replaces the fast
-        one only when it also confirms the failure — the whole-history
-        witness search can blow budget/configs on a history the
-        decomposed fast path already decided, and a definite False must
-        never downgrade to unknown."""
-        import time as _time
-
+        """One call: linear.analysis(witness=True) runs the fast
+        interned-int search (per-key decomposed where the model
+        factors) for every history and re-searches ONLY a failing
+        history's failing partition with parent pointers, keeping the
+        definite False even if the witness pass blows the shared
+        budget — so valid verdicts ride the fast path, failures carry
+        final-paths/ops, and total wall time stays bounded by
+        oracle_budget_s."""
         from . import linear
 
-        t0 = _time.monotonic()
-        a = linear.analysis(
-            self.model, history, pure_fs=self.pure_fs,
+        return linear.analysis(
+            self.model, history, pure_fs=self.pure_fs, witness=True,
             budget_s=self.oracle_budget_s,
         )
-        if a.get("valid?") is False:
-            remaining = None
-            if self.oracle_budget_s is not None:
-                remaining = max(
-                    0.0, self.oracle_budget_s - (_time.monotonic() - t0)
-                )
-            w = linear.analysis(
-                self.model, history, pure_fs=self.pure_fs, witness=True,
-                budget_s=remaining,
-            )
-            if w.get("valid?") is False:
-                a = w  # confirmed, now with the witness report attached
-        return a
 
     def _race(self, test, history) -> dict:
         """Run the device kernel and the CPU oracle concurrently; the

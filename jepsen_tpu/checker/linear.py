@@ -426,6 +426,13 @@ def analysis(
     # key, and a witness run then searches ONLY the failing key's
     # subhistory, so the witness report stays focused and the
     # object-based search never pays the whole-history state space.
+    def witness_confirm(r, m, ev, op_l):
+        """A fast-search failure re-searched with parent pointers so the
+        report carries final-paths; the definite False is KEPT if the
+        witness search cannot confirm within the remaining budget."""
+        w = _search_witness(m, ev, op_l, max_configs, deadline, budget_s)
+        return w if w.get("valid?") is False else r
+
     parts = _partition_by_key(model, events, ops)
     if parts is not None and len(parts) > 1:
         worst = None
@@ -435,22 +442,17 @@ def analysis(
             )
             if r["valid?"] is False:
                 if witness:
-                    return _search_witness(
-                        m_k, ev_k, ops_k, max_configs, deadline, budget_s
-                    )
+                    return witness_confirm(r, m_k, ev_k, ops_k)
                 return r
             if r["valid?"] == "unknown":
                 worst = r
         if worst is not None:
             return worst
         return {"valid?": True, "op-count": len(ops)}
-    if not witness:
-        return _search_fast(
-            model, events, ops, max_configs, deadline, budget_s
-        )
-    return _search_witness(
-        model, events, ops, max_configs, deadline, budget_s
-    )
+    r = _search_fast(model, events, ops, max_configs, deadline, budget_s)
+    if witness and r["valid?"] is False:
+        return witness_confirm(r, model, events, ops)
+    return r
 
 
 def _search_witness(

@@ -380,7 +380,13 @@ def test_fast_path_matches_witness_path():
         )
     for model, h, pure in corpora:
         fast = linear.analysis(model, h, pure_fs=pure)
-        slow = linear.analysis(model, h, pure_fs=pure, witness=True)
+        # the object-based witness search, called directly: with
+        # witness=True the public API now runs fast-first itself, so
+        # the independent cross-check must target the slow engine
+        events, ops = linear.prepare(h, pure)
+        slow = linear._search_witness(
+            model, events, ops, linear.DEFAULT_MAX_CONFIGS, None, None
+        )
         assert fast["valid?"] == slow["valid?"], (model, fast, slow)
         if fast["valid?"] is False:
             # both paths blame a completion of the same process
