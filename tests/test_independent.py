@@ -115,3 +115,32 @@ def test_batched_linearizable():
     stats = res["batch-stats"]
     assert stats["engines"].get("tpu") == 2, stats
     assert stats["device-rate"] == 1.0 and stats["oracle-rate"] == 0.0
+
+
+def test_concurrent_generator_infinite_lazy_keys():
+    """The reference's independent-deadlock-case
+    (generator_test.clj:440): concurrent-generator over an INFINITE
+    lazy key sequence must stream keys on demand — materializing the
+    sequence hung forever before round 5.  The schedule matches the
+    reference: each 2-thread group drains one key per round."""
+    import itertools
+
+    g = gen.limit(
+        5,
+        ind.concurrent_generator(
+            2, itertools.count(), lambda k: gen.each_thread({"f": "meow"})
+        ),
+    )
+    out = sim.perfect(g)
+    got = [
+        (o["time"], o["f"], o["value"].key)
+        for o in out
+        if o["type"] == "invoke"
+    ]
+    assert got == [
+        (0, "meow", 0),
+        (0, "meow", 0),
+        (10, "meow", 1),
+        (10, "meow", 1),
+        (20, "meow", 2),
+    ]
