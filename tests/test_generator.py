@@ -396,3 +396,30 @@ def test_ignore_updates():
     out = sim.perfect(gen.limit(5, g))
     # updates never reach until_ok, so it never stops
     assert len(out) == 5
+
+
+def test_any_stagger_no_starvation():
+    """Mixing two staggers under ``any`` must starve neither side: each
+    keeps its own mean inter-op interval (reference:
+    generator_test.clj any-stagger-test)."""
+    n = 1000
+    h = sim.perfect(
+        gen.clients(
+            gen.limit(
+                n,
+                gen.any(
+                    gen.stagger(3, gen.repeat({"f": "a"})),
+                    gen.stagger(5, gen.repeat({"f": "b"})),
+                ),
+            )
+        )
+    )
+    assert len(h) == n
+
+    def mean_interval(f):
+        times = [o["time"] for o in h if o["f"] == f]
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        return sum(gaps) / len(gaps) / 1e9
+
+    assert 2.5 < mean_interval("a") < 3.5
+    assert 4.5 < mean_interval("b") < 5.5
