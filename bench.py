@@ -300,7 +300,7 @@ def run_bench(on_accelerator, warnings):
         "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
         "kernel": wgl.kernel_choice("cas-register", C, vmax + 1),
-        "dense_union": os.environ.get("JEPSEN_TPU_DENSE_UNION", "gather"),
+        "dense_union": os.environ.get("JEPSEN_TPU_DENSE_UNION", "unroll"),
         "samples": samples,
     }
     return value, L, diag

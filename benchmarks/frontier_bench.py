@@ -224,6 +224,28 @@ def _device_row(results, arm, kernel, C, F, L, B, E, dt, ok, ovf, **extra):
     return row
 
 
+def _error_row(results, arm, exc, **ctx):
+    """Persist the failure itself: a sweep that dies silently reads as
+    'never ran'; an error row is honest evidence of what broke where."""
+    import datetime
+    import traceback
+
+    row = {
+        "arm": arm,
+        "kernel": "error",
+        "error": f"{type(exc).__name__}: {exc}",
+        "trace_tail": traceback.format_exc()[-600:],
+        "measured_at": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+        **ctx,
+    }
+    results.append(row)
+    persist(results)
+    print(f"{arm}: ERROR {row['error']}", file=sys.stderr)
+    return row
+
+
 def oracle_row(results, arm, hists, model, C, L, pure_fs=()):
     """Time the CPU oracle over the template corpus (with a cutoff) so
     every device row has a recorded denominator."""
@@ -295,11 +317,17 @@ def cas_register_arm(results, reps):
             results, "cas-register", hists, model, C, L, pure_fs=("read",)
         )
         for F in Fs:
-            fn = wgl.make_check_fn("cas-register", E, C, F, C + 1)
-            dt, ok, ovf = _time_fn(fn, arrays, reps)
-            _device_row(
-                results, "cas-register", "frontier", C, F, L, B, E, dt, ok, ovf
-            )
+            try:
+                fn = wgl.make_check_fn("cas-register", E, C, F, C + 1, "hash")
+                dt, ok, ovf = _time_fn(fn, arrays, reps)
+                _device_row(
+                    results, "cas-register", "frontier",
+                    C, F, L, B, E, dt, ok, ovf,
+                )
+            except Exception as e:  # noqa: BLE001 - keep the F-sweep alive
+                _error_row(
+                    results, "cas-register", e, C=C, F=F, L=L, B=B,
+                )
         if wgl.kernel_choice("cas-register", C, vmax + 1) == "dense":
             from jepsen_tpu.ops import dense
 
@@ -342,13 +370,18 @@ def compaction_arm(results, reps):
     C = batch.cand_slot.shape[2]
     arrays = _expand(batch, B, rng)
     for F in (64, 128, 256):
-        for mode in ("hash", "sort"):
-            fn = wgl.make_check_fn("cas-register", E, C, F, C + 1, mode)
-            dt, ok, ovf = _time_fn(fn, arrays, reps)
-            _device_row(
-                results, "compaction", f"frontier-{mode}",
-                C, F, L, B, E, dt, ok, ovf,
-            )
+        for mode in ("hash", "sort", "gather", "allpairs"):
+            try:
+                fn = wgl.make_check_fn("cas-register", E, C, F, C + 1, mode)
+                dt, ok, ovf = _time_fn(fn, arrays, reps)
+                _device_row(
+                    results, "compaction", f"frontier-{mode}",
+                    C, F, L, B, E, dt, ok, ovf,
+                )
+            except Exception as e:  # noqa: BLE001
+                _error_row(
+                    results, "compaction", e, C=C, F=F, L=L, B=B, mode=mode,
+                )
 
 
 def _gen_mutex_history(rng, n_procs, n_events, corrupt=False):
@@ -432,12 +465,26 @@ def mutex_arm(results, B, reps):
         C = batch.cand_slot.shape[2]
         arrays = _expand(batch, B, rng)
         oracle_row(results, "mutex", hists, model, C, L)
-        for F in (64,):
-            fn = wgl.make_check_fn("mutex", E, C, F, C + 1)
-            dt, ok, ovf = _time_fn(fn, arrays, reps)
-            _device_row(
-                results, "mutex", "frontier", C, F, L, B, E, dt, ok, ovf
-            )
+        # the mutex frontier is intrinsically tiny (configs grow
+        # linearly in C), so oversized F is pure wasted lane work; the
+        # F sweep finds the knee, and the compaction modes A/B the
+        # scatter-heavy hash lowering against the scatter-free ones on
+        # the shape class where compaction dominates the event cost
+        for F in (8, 16, 64):
+            for mode in ("hash", "gather", "allpairs"):
+                if mode != "hash" and F == 64:
+                    continue  # big-K all-pairs adds nothing here
+                kern = "frontier" if mode == "hash" else f"frontier-{mode}"
+                try:
+                    fn = wgl.make_check_fn("mutex", E, C, F, C + 1, mode)
+                    dt, ok, ovf = _time_fn(fn, arrays, reps)
+                    _device_row(
+                        results, "mutex", kern, C, F, L, B, E, dt, ok, ovf
+                    )
+                except Exception as e:  # noqa: BLE001
+                    _error_row(
+                        results, "mutex", e, C=C, F=F, L=L, B=B, mode=mode,
+                    )
 
 
 def multi_register_arm(results, B, reps):
@@ -650,14 +697,28 @@ def main():
     # Home-turf arms first: the mutex-contention and short-history
     # cas shapes are the frontier kernel's designed territory and the
     # evidence rounds keep missing when the tunnel closes early.
-    mutex_arm(results, min(B, 1024), reps)
-    cas_register_arm(results, reps)
-    lock_models_arm(results, min(B, 1024), reps)
-    queue_arm(results, min(B, 512), reps)
-    multi_register_arm(results, B, reps)
-    compaction_arm(results, reps)
+    arms = (
+        ("mutex", lambda: mutex_arm(results, min(B, 1024), reps)),
+        ("cas-register", lambda: cas_register_arm(results, reps)),
+        ("lock-models", lambda: lock_models_arm(results, min(B, 1024), reps)),
+        ("unordered-queue", lambda: queue_arm(results, min(B, 512), reps)),
+        ("multi-register", lambda: multi_register_arm(results, B, reps)),
+        ("compaction", lambda: compaction_arm(results, reps)),
+    )
+    failures = 0
+    for name, arm in arms:
+        # one bad shape must not erase the remaining arms' evidence —
+        # round 5's first window lost 4 of 6 arms to an uncaught
+        # device error in the cas F-sweep
+        try:
+            arm()
+        except Exception as e:  # noqa: BLE001 - sweep survival
+            failures += 1
+            _error_row(results, name, e)
     for path in persist(results):
         print(f"wrote {path}")
+    if failures:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

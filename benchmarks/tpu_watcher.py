@@ -79,7 +79,12 @@ def run(argv, timeout_s, env=None):
             text=True,
             env={**os.environ, **(env or {})},
         )
-        return p.returncode, round(time.monotonic() - t0, 1), p.stdout[-500:]
+        tail = p.stdout[-500:]
+        if p.returncode != 0 and p.stderr:
+            # the traceback lives on stderr; losing it cost round 5 the
+            # diagnosis of a mid-sweep crash
+            tail += "\nSTDERR: " + p.stderr[-700:]
+        return p.returncode, round(time.monotonic() - t0, 1), tail
     except subprocess.TimeoutExpired:
         return -1, round(time.monotonic() - t0, 1), "TIMEOUT"
 
@@ -104,14 +109,17 @@ def main():
         log("frontier", rc=rc, elapsed_s=dt, tail=tail)
         rc, dt, tail = run([sys.executable, "bench.py"], 1800)
         log("bench", rc=rc, elapsed_s=dt, tail=tail)
-        # A/B the dense subset-union lowering (RESULTS.md roofline plan):
-        # the unroll variant is bit-equivalent (tests/test_dense.py) and
-        # its window, if faster, is legitimate on-chip evidence
+        # A/B the dense subset-union lowering (RESULTS.md roofline
+        # plan).  The 18:15Z/18:17Z windows settled it — unroll 21,299
+        # vs gather 13,451 h/s — so unroll is now the library default
+        # and the alternate arm keeps the gather lowering honest (a
+        # regression or an XLA update flipping the verdict would show
+        # here first).
         rc, dt, tail = run(
             [sys.executable, "bench.py"], 1800,
-            env={"JEPSEN_TPU_DENSE_UNION": "unroll"},
+            env={"JEPSEN_TPU_DENSE_UNION": "gather"},
         )
-        log("bench-unroll", rc=rc, elapsed_s=dt, tail=tail)
+        log("bench-gather", rc=rc, elapsed_s=dt, tail=tail)
         rc, dt, tail = run(
             [sys.executable, os.path.join(HERE, "elle_bench.py")], 1800
         )

@@ -239,17 +239,19 @@ def _or_select(x, wb: int):
     return jnp.concatenate([hi, hi], axis=-2).reshape(*shape)
 
 
-#: subset-map implementation for the dense kernels: "gather" (default,
-#: take_along_axis over constant index tensors) or "unroll" (per-slot
-#: static shuffles — reshape/flip for the j≥5 word permutations, pure
-#: mask/shift below).  Same results bit-for-bit (differentially
-#: tested); the switch exists because a gather lowering on TPU would
-#: dominate the closure cost (benchmarks/RESULTS.md, dense-kernel
-#: roofline), and only an on-chip A/B can settle which lowering wins.
+#: subset-map implementation for the dense kernels: "unroll" (default,
+#: per-slot static shuffles — reshape/flip for the j≥5 word
+#: permutations, pure mask/shift below) or "gather" (take_along_axis
+#: over constant index tensors).  Same results bit-for-bit
+#: (differentially tested).  The on-chip A/B that settled the default
+#: (2026-07-31 window, B=16384 L=1000 flagship): unroll 21,299 h/s vs
+#: gather 13,451 h/s — the gather lowering dominated the closure cost
+#: exactly as the roofline model predicted (benchmarks/RESULTS.md,
+#: dense-kernel roofline; BENCH_tpu_windows.jsonl rows 18:15/18:17Z).
 def _union_mode() -> str:
     import os
 
-    return os.environ.get("JEPSEN_TPU_DENSE_UNION", "gather")
+    return os.environ.get("JEPSEN_TPU_DENSE_UNION", "unroll")
 
 
 def _subset_has(C: int):

@@ -106,13 +106,8 @@ def _compact_sort(states, words, valid, F, n_old):
     dup = jnp.concatenate([jnp.zeros((1,), bool), same])
     v2 = (key_s != jnp.uint32(_INVALID_KEY)) & ~dup
     grew = (v2 & (lane_s >= n_old)).any()
-    prefix = jnp.cumsum(v2.astype(jnp.int32))
-    count = prefix[-1]
-    j = jnp.arange(F, dtype=jnp.int32)
-    # index of the j-th survivor = #entries with prefix <= j
-    src = jnp.sum(prefix[None, :] <= j[:, None], axis=1, dtype=jnp.int32)
-    src = jnp.minimum(src, K - 1)
-    return st_s[src], tuple(w[src] for w in ws_s), j < count, grew, count > F
+    out_states, out_words, out_valid, ovf = _rank_gather(st_s, ws_s, v2, F)
+    return out_states, out_words, out_valid, grew, ovf
 
 
 def _next_pow2(n: int) -> int:
@@ -121,6 +116,32 @@ def _next_pow2(n: int) -> int:
 
 #: independent Fibonacci-style multipliers, one scatter table per probe
 _PROBE_MULTS = (0x9E3779B1, 0x85EBCA77)
+
+
+def _probe_dedup(states, words, valid):
+    """Best-effort duplicate removal via scatter-min probe tables:
+    returns the post-dedup validity mask ``v2`` (see _compact_hash's
+    docstring for the survivor-minimum argument).  Shared by the hash
+    and gather compactions — the 'gather is hash with only the
+    compaction lowering swapped' equivalence depends on the two modes
+    running this exact dedup, so it exists once."""
+    K = states.shape[0]
+    T = _next_pow2(2 * K)  # load factor ≤ 0.5 keeps foreign collisions rare
+    shift = jnp.uint32(32 - (T - 1).bit_length())
+    h0 = _hash_cfg(states, words)
+    lane = jnp.arange(K, dtype=jnp.int32)
+    lane_or_big = jnp.where(valid, lane, K)
+    dup = jnp.zeros((K,), bool)
+    for mult in _PROBE_MULTS:
+        hx = ((h0 * jnp.uint32(mult)) >> shift).astype(jnp.int32)
+        tbl = jnp.full((T,), K, jnp.int32).at[hx].min(lane_or_big)
+        w = tbl[hx]
+        w_safe = jnp.minimum(w, K - 1)
+        same = states[w_safe] == states
+        for wd in words:
+            same = same & (wd[w_safe] == wd)
+        dup = dup | (valid & (w < lane) & same)
+    return valid & ~dup
 
 
 def _compact_hash(states, words, valid, F, n_old):
@@ -151,22 +172,8 @@ def _compact_hash(states, words, valid, F, n_old):
     dedup itself is best-effort (a missed duplicate only makes grew
     True spuriously — one wasted iteration, never a wrong verdict)."""
     K = states.shape[0]
-    T = _next_pow2(2 * K)  # load factor ≤ 0.5 keeps foreign collisions rare
-    shift = jnp.uint32(32 - (T - 1).bit_length())
-    h0 = _hash_cfg(states, words)
+    v2 = _probe_dedup(states, words, valid)
     lane = jnp.arange(K, dtype=jnp.int32)
-    lane_or_big = jnp.where(valid, lane, K)
-    dup = jnp.zeros((K,), bool)
-    for mult in _PROBE_MULTS:
-        hx = ((h0 * jnp.uint32(mult)) >> shift).astype(jnp.int32)
-        tbl = jnp.full((T,), K, jnp.int32).at[hx].min(lane_or_big)
-        w = tbl[hx]
-        w_safe = jnp.minimum(w, K - 1)
-        same = states[w_safe] == states
-        for wd in words:
-            same = same & (wd[w_safe] == wd)
-        dup = dup | (valid & (w < lane) & same)
-    v2 = valid & ~dup
     grew = (v2 & (lane >= n_old)).any()
     prefix = jnp.cumsum(v2.astype(jnp.int32))
     count = prefix[-1]
@@ -180,7 +187,90 @@ def _compact_hash(states, words, valid, F, n_old):
     return out_states, out_words, out_valid, grew, count > F
 
 
-_COMPACTIONS = {"hash": _compact_hash, "sort": _compact_sort}
+def _rank_gather(states, words, v2, F):
+    """Compact the surviving lanes into F slots by *rank* — the j-th
+    output slot gathers the lane whose survivor-prefix-count equals j —
+    as a [F, K] compare-reduce plus gathers.  This is the scatter-free
+    lowering of the prefix-sum compaction: scatters serialize badly on
+    TPU (they lower to sorted per-element updates), while the rank
+    matrix is plain VPU broadcast work and the gathers are contiguous.
+    Survivor order is lane order, identical to the scatter compaction,
+    so verdicts cannot depend on which lowering ran.  Out-of-range
+    slots gather a clamped lane and are masked invalid."""
+    K = states.shape[0]
+    prefix = jnp.cumsum(v2.astype(jnp.int32))
+    count = prefix[-1]
+    j = jnp.arange(F, dtype=jnp.int32)
+    src = jnp.sum(prefix[None, :] <= j[:, None], axis=1, dtype=jnp.int32)
+    src = jnp.minimum(src, K - 1)
+    return (
+        states[src],
+        tuple(w[src] for w in words),
+        j < count,
+        count > F,
+    )
+
+
+def _compact_gather(states, words, valid, F, n_old):
+    """The hash-probe dedup of ``_compact_hash`` (shared via
+    ``_probe_dedup``) with the scatter compaction replaced by the
+    rank-matrix gather (``_rank_gather``).  Same survivors, same order,
+    same certificates — a pure lowering change, A/B-able against
+    "hash" with bit-identical verdicts.  The probe tables keep their
+    scatter-min (there is no cheap gather-only equivalent of a hash
+    table build), so this mode halves, not eliminates, the scatter
+    traffic per closure iteration."""
+    K = states.shape[0]
+    v2 = _probe_dedup(states, words, valid)
+    lane = jnp.arange(K, dtype=jnp.int32)
+    grew = (v2 & (lane >= n_old)).any()
+    out_states, out_words, out_valid, ovf = _rank_gather(states, words, v2, F)
+    return out_states, out_words, out_valid, grew, ovf
+
+
+#: [K, K] equality matrices get big; cap the per-dispatch rows so the
+#: all-pairs mode's broadcast intermediates stay within a bounded HBM
+#: footprint (elements, i.e. K*K booleans per batch row)
+ALLPAIRS_ELEM_BUDGET = 128_000_000
+
+
+def _compact_allpairs(states, words, valid, F, n_old):
+    """EXACT dedup + compact with zero scatter ops: an all-pairs
+    [K, K] config-equality matrix marks every lane that duplicates an
+    earlier valid lane, then the rank-matrix gather compacts.  O(K²)
+    work — asymptotically worse than the hash tables — but every
+    operation is a broadcast compare / reduction / gather, the shapes
+    XLA tiles best on TPU, and there is no hash-collision best-effort
+    caveat: like the sort mode, every duplicate is removed, so the
+    sufficient-frontier escalation rung's lossless-by-construction
+    argument holds, and ``grew`` is an exact fixpoint certificate with
+    no spurious extra iterations.  Intended for small frontiers
+    (K = F·(C+1) up to a few hundred), where K² stays cheaper than the
+    serialized scatters it replaces; ``make_check_fn`` shrinks the
+    safe dispatch cap accordingly (ALLPAIRS_ELEM_BUDGET)."""
+    K = states.shape[0]
+    lane = jnp.arange(K, dtype=jnp.int32)
+    eq = states[:, None] == states[None, :]
+    for w in words:
+        eq = eq & (w[:, None] == w[None, :])
+    earlier = valid[None, :] & (lane[None, :] < lane[:, None])
+    dup = (eq & earlier).any(axis=1)
+    v2 = valid & ~dup
+    grew = (v2 & (lane >= n_old)).any()
+    out_states, out_words, out_valid, ovf = _rank_gather(states, words, v2, F)
+    return out_states, out_words, out_valid, grew, ovf
+
+
+_COMPACTIONS = {
+    "hash": _compact_hash,
+    "sort": _compact_sort,
+    "gather": _compact_gather,
+    "allpairs": _compact_allpairs,
+}
+
+#: compaction modes whose dedup removes EVERY duplicate — the property
+#: the sufficient-frontier escalation rung's lossless claim rests on
+EXACT_COMPACTIONS = frozenset({"sort", "allpairs"})
 
 
 def _get_bit(words, slot_u):
@@ -335,24 +425,55 @@ def build_batched(
     return jax.vmap(check_one)
 
 
-@lru_cache(maxsize=64)
+def default_compaction() -> str:
+    """Hot-path compaction mode: ``JEPSEN_TPU_FRONTIER_COMPACTION`` if
+    set (the A/B switch the capture watcher flips), else "hash"."""
+    import os
+
+    mode = os.environ.get("JEPSEN_TPU_FRONTIER_COMPACTION", "hash")
+    if mode not in _COMPACTIONS:
+        raise ValueError(
+            f"unknown frontier compaction {mode!r}; "
+            f"one of {sorted(_COMPACTIONS)}"
+        )
+    return mode
+
+
 def make_check_fn(
     spec_name: str,
     E: int,
     C: int,
     F: int,
     max_closure: int,
-    compaction: str = "hash",
+    compaction: Optional[str] = None,
 ):
     """Jitted, cached version of build_batched — repeat batches at the
     same bucket sizes reuse the compiled executable.  The returned fn
     carries its footprint-safe per-dispatch row cap as
     ``fn.safe_dispatch`` (see frontier_max_dispatch) so every dispatch
     site — library and benchmarks — reads the same safety bound instead
-    of re-deriving (or forgetting) it."""
+    of re-deriving (or forgetting) it.  ``compaction=None`` resolves
+    through default_compaction() at call time."""
+    if compaction is None:
+        compaction = default_compaction()
+    return _make_check_fn(spec_name, E, C, F, max_closure, compaction)
+
+
+@lru_cache(maxsize=64)
+def _make_check_fn(spec_name, E, C, F, max_closure, compaction):
     fn = jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
-    fn.safe_dispatch = frontier_max_dispatch(F, E)
+    cap = frontier_max_dispatch(F, E)
+    if compaction == "allpairs" and cap:
+        # the [K, K] equality matrix dominates this mode's footprint;
+        # the quotient hitting 0 must propagate — 0 is the documented
+        # "do not dispatch even one row" signal every guard checks
+        K = F * (C + 1)
+        cap = min(cap, ALLPAIRS_ELEM_BUDGET // (K * K))
+    fn.safe_dispatch = cap
     return fn
+
+
+make_check_fn.cache_clear = _make_check_fn.cache_clear
 
 
 def kernel_choice(spec_name: str, C: int, n_values) -> str:
@@ -697,18 +818,16 @@ def check_batch(
             sub = tuple(a[idx] for a in arrays)
             if n_pad:
                 sub[1][n_bad:] = -1  # ev_slot: every event padding
-            # rungs at ≥ the sufficient capacity must use EXACT (sort)
-            # dedup: the lossless-by-construction claim is "all distinct
-            # configs fit in F", which only holds if every duplicate is
-            # actually removed.  Rungs below it keep the fast hash
-            # compaction — a spurious overflow there escalates to the
-            # next rung.
-            fn2 = make_check_fn(
-                spec.name, E, C, capacity, mc,
-                compaction="sort"
-                if (suff is not None and capacity >= suff)
-                else "hash",
-            )
+            # rungs at ≥ the sufficient capacity must use an EXACT
+            # dedup (EXACT_COMPACTIONS): the lossless-by-construction
+            # claim is "all distinct configs fit in F", which only
+            # holds if every duplicate is actually removed.  Rungs
+            # below it keep the configured fast compaction — a spurious
+            # overflow there escalates to the next rung.
+            mode = default_compaction()
+            if suff is not None and capacity >= suff:
+                mode = mode if mode in EXACT_COMPACTIONS else "sort"
+            fn2 = make_check_fn(spec.name, E, C, capacity, mc, mode)
             disp2 = min(max_dispatch, fn2.safe_dispatch)
             if disp2 == 0:
                 # a single row at this capacity would bust the safe

@@ -348,7 +348,7 @@ def test_union_unroll_mode_matches_gather(monkeypatch):
     args = (batch.init_state, batch.ev_slot, batch.cand_slot,
             batch.cand_f, batch.cand_a, batch.cand_b)
 
-    monkeypatch.delenv("JEPSEN_TPU_DENSE_UNION", raising=False)
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "gather")
     ok_g, fail_g, _ = dense.make_dense_fn("cas-register", E, C, V)(*args)
     monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
     ok_u, fail_u, _ = dense.make_dense_fn("cas-register", E, C, V)(*args)
@@ -381,7 +381,7 @@ def test_queue_union_unroll_matches_gather(monkeypatch):
     C = batch.cand_slot.shape[2]
     args = (batch.init_state, batch.ev_slot, batch.cand_slot,
             batch.cand_f, batch.cand_a, batch.cand_b)
-    monkeypatch.delenv("JEPSEN_TPU_DENSE_UNION", raising=False)
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "gather")
     ok_g, fail_g, _ = dense.make_dense_fn("unordered-queue", E, C, 0)(*args)
     monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
     ok_u, fail_u, _ = dense.make_dense_fn("unordered-queue", E, C, 0)(*args)

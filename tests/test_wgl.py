@@ -291,7 +291,7 @@ def test_differential_two_word_linsets():
     assert [bool(v) for v in ok] == [v is True for v in oracle]
 
 
-@pytest.mark.parametrize("compaction", ["hash", "sort"])
+@pytest.mark.parametrize("compaction", ["hash", "sort", "gather", "allpairs"])
 def test_differential_compaction_modes(compaction):
     """Both frontier compactions (O(K) scatter-hash dedup and exact
     sort dedup) must agree with the CPU oracle on the fuzz corpus, with
@@ -324,6 +324,97 @@ def test_differential_compaction_modes(compaction):
     ok, ovf = np.asarray(ok), np.asarray(ovf)
     assert not ovf.any()
     assert [bool(v) for v in ok] == [v is True for v in oracle]
+
+
+def test_gather_compaction_bit_equivalent_to_hash():
+    """"gather" is "hash" with the final scatter replaced by the
+    rank-matrix gather: same probe-table dedup, same survivor order.
+    Verdicts, failure indices, AND overflow flags must be bit-identical
+    on a corpus squeezed through small frontiers (where compaction
+    actually bites) — any divergence means the lowering changed
+    semantics, not just scheduling."""
+    import numpy as np
+
+    rng = random.Random(77)
+    model = m.cas_register(0)
+    hists = [
+        _gen(rng, n_procs=5, n_ops=30, crash_p=0.1, corrupt=(i % 3 == 0))
+        for i in range(24)
+    ]
+    batch = encode.batch_encode(hists, model, slot_cap=8)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    arrays = (
+        batch.init_state,
+        batch.ev_slot,
+        batch.cand_slot,
+        batch.cand_f,
+        batch.cand_a,
+        batch.cand_b,
+    )
+    for F in (4, 8, 64):
+        out_h = wgl.make_check_fn("cas-register", E, C, F, C + 1, "hash")(*arrays)
+        out_g = wgl.make_check_fn("cas-register", E, C, F, C + 1, "gather")(*arrays)
+        for a, b in zip(out_h, out_g):
+            assert (np.asarray(a) == np.asarray(b)).all(), F
+
+
+def test_allpairs_exactness_matches_sort():
+    """The all-pairs dedup claims the same exactness contract as sort
+    (every duplicate removed ⇒ lossless sufficient rung, exact grew
+    certificate).  At a capacity where hash's best-effort dedup could
+    legitimately overflow, allpairs and sort must agree on verdicts AND
+    on which rows overflow."""
+    import numpy as np
+
+    rng = random.Random(78)
+    model = m.cas_register(0)
+    hists = [
+        _gen(rng, n_procs=6, n_ops=24, crash_p=0.2, corrupt=(i % 3 == 0))
+        for i in range(24)
+    ]
+    batch = encode.batch_encode(hists, model, slot_cap=8)
+    E = batch.ev_slot.shape[1]
+    C = batch.cand_slot.shape[2]
+    arrays = (
+        batch.init_state,
+        batch.ev_slot,
+        batch.cand_slot,
+        batch.cand_f,
+        batch.cand_a,
+        batch.cand_b,
+    )
+    for F in (6, 16):
+        ok_s, fa_s, ovf_s = (
+            np.asarray(x)
+            for x in wgl.make_check_fn(
+                "cas-register", E, C, F, C + 1, "sort"
+            )(*arrays)
+        )
+        ok_a, fa_a, ovf_a = (
+            np.asarray(x)
+            for x in wgl.make_check_fn(
+                "cas-register", E, C, F, C + 1, "allpairs"
+            )(*arrays)
+        )
+        assert (ovf_s == ovf_a).all(), F
+        keep = ~ovf_s
+        assert (ok_s[keep] == ok_a[keep]).all(), F
+        assert (fa_s[keep] == fa_a[keep]).all(), F
+
+
+def test_default_compaction_env(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FRONTIER_COMPACTION", "allpairs")
+    assert wgl.default_compaction() == "allpairs"
+    monkeypatch.setenv("JEPSEN_TPU_FRONTIER_COMPACTION", "bogus")
+    with pytest.raises(ValueError):
+        wgl.default_compaction()
+    monkeypatch.delenv("JEPSEN_TPU_FRONTIER_COMPACTION")
+    assert wgl.default_compaction() == "hash"
+    # the allpairs footprint cap shrinks safe_dispatch vs the hash mode
+    fh = wgl.make_check_fn("cas-register", 32, 8, 64, 9, "hash")
+    fa = wgl.make_check_fn("cas-register", 32, 8, 64, 9, "allpairs")
+    assert 0 < fa.safe_dispatch <= fh.safe_dispatch
 
 
 def test_multi_register_golden():
