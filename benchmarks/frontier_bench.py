@@ -246,6 +246,70 @@ def _error_row(results, arm, exc, **ctx):
     return row
 
 
+def effective_row(
+    results, arm, hists, model, C, L, B, slot_cap, mode, **checkkw
+):
+    """Production-path throughput: wgl.check_batch over B histories
+    (the 16 templates replicated), wall-clock including encode, every
+    escalation rung, and the oracle fallback — the only number that
+    can honestly be compared against the oracle row, since per-rung
+    kernel h/s ignores what overflow escalation costs.  ``mode`` sets
+    JEPSEN_TPU_FRONTIER_COMPACTION for the call ("auto" = unset,
+    library default).  Two timed passes: cold (compiles included) and
+    warm (the steady-state number)."""
+    import datetime
+
+    import jax
+
+    from jepsen_tpu.ops import wgl
+
+    reps_h = [hists[i % len(hists)] for i in range(B)]
+    prev = os.environ.pop("JEPSEN_TPU_FRONTIER_COMPACTION", None)
+    if mode != "auto":
+        os.environ["JEPSEN_TPU_FRONTIER_COMPACTION"] = mode
+    try:
+        # the preceding F-sweep warms the very cache keys check_batch
+        # will hit; a "cold" number measured through a warm cache would
+        # silently equal the warm one
+        wgl.make_check_fn.cache_clear()
+        t0 = time.perf_counter()
+        out = wgl.check_batch(model, reps_h, slot_cap=slot_cap, **checkkw)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = wgl.check_batch(model, reps_h, slot_cap=slot_cap, **checkkw)
+        warm = time.perf_counter() - t0
+    finally:
+        if prev is None:
+            os.environ.pop("JEPSEN_TPU_FRONTIER_COMPACTION", None)
+        else:
+            os.environ["JEPSEN_TPU_FRONTIER_COMPACTION"] = prev
+    stats = wgl.batch_stats(out)
+    row = {
+        "arm": arm,
+        "kernel": f"check-batch-{mode}",
+        "C": C,
+        "F": None,
+        "L": L,
+        "B": B,
+        "hps": round(B / warm, 1),
+        "cold_hps": round(B / cold, 1),
+        "device_rate": stats["device-rate"],
+        "unknown": sum(1 for o in out if o["valid?"] == "unknown"),
+        "platform": jax.devices()[0].platform,
+        "measured_at": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+    }
+    results.append(row)
+    persist(results)
+    print(
+        f"{arm} C={C:<3} L={L:<5} check_batch[{mode}]: "
+        f"{row['hps']:>10,.1f} h/s warm ({row['cold_hps']:,.1f} cold)  "
+        f"device-rate={row['device_rate']:.0%}"
+    )
+    return row
+
+
 def oracle_row(results, arm, hists, model, C, L, pure_fs=()):
     """Time the CPU oracle over the template corpus (with a cutoff) so
     every device row has a recorded denominator."""
@@ -317,17 +381,31 @@ def cas_register_arm(results, reps):
             results, "cas-register", hists, model, C, L, pure_fs=("read",)
         )
         for F in Fs:
-            try:
-                fn = wgl.make_check_fn("cas-register", E, C, F, C + 1, "hash")
-                dt, ok, ovf = _time_fn(fn, arrays, reps)
-                _device_row(
-                    results, "cas-register", "frontier",
-                    C, F, L, B, E, dt, ok, ovf,
-                )
-            except Exception as e:  # noqa: BLE001 - keep the F-sweep alive
-                _error_row(
-                    results, "cas-register", e, C=C, F=F, L=L, B=B,
-                )
+            for mode in ("hash", "allpairs"):
+                kern = "frontier" if mode == "hash" else f"frontier-{mode}"
+                try:
+                    fn = wgl.make_check_fn(
+                        "cas-register", E, C, F, C + 1, mode
+                    )
+                    dt, ok, ovf = _time_fn(fn, arrays, reps)
+                    _device_row(
+                        results, "cas-register", kern,
+                        C, F, L, B, E, dt, ok, ovf,
+                    )
+                except Exception as e:  # noqa: BLE001 - keep the sweep alive
+                    _error_row(
+                        results, "cas-register", e,
+                        C=C, F=F, L=L, B=B, mode=mode,
+                    )
+        try:
+            effective_row(
+                results, "cas-register", hists, model, C, L, 128,
+                n_procs, "auto",
+            )
+        except Exception as e:  # noqa: BLE001
+            _error_row(
+                results, "cas-register", e, C=C, L=L, mode="check-batch",
+            )
         if wgl.kernel_choice("cas-register", C, vmax + 1) == "dense":
             from jepsen_tpu.ops import dense
 
@@ -468,12 +546,11 @@ def mutex_arm(results, B, reps):
         # the mutex frontier is intrinsically tiny (configs grow
         # linearly in C), so oversized F is pure wasted lane work; the
         # F sweep finds the knee, and the compaction modes A/B the
-        # scatter-heavy hash lowering against the scatter-free ones on
-        # the shape class where compaction dominates the event cost
+        # scatter-heavy hash lowering against the scatter-free exact
+        # one on the shape class where compaction dominates the event
+        # cost (the 18:30Z window: allpairs 10-27x over hash/gather)
         for F in (8, 16, 64):
-            for mode in ("hash", "gather", "allpairs"):
-                if mode != "hash" and F == 64:
-                    continue  # big-K all-pairs adds nothing here
+            for mode in ("hash", "allpairs"):
                 kern = "frontier" if mode == "hash" else f"frontier-{mode}"
                 try:
                     fn = wgl.make_check_fn("mutex", E, C, F, C + 1, mode)
@@ -485,6 +562,15 @@ def mutex_arm(results, B, reps):
                     _error_row(
                         results, "mutex", e, C=C, F=F, L=L, B=B, mode=mode,
                     )
+        # the number that settles kernel-vs-oracle: the full production
+        # ladder (auto compaction per rung) at this arm's shape
+        try:
+            effective_row(
+                results, "mutex", hists, model, C, L, 256, n_procs, "auto",
+                frontier=8, escalation=(2, 8),
+            )
+        except Exception as e:  # noqa: BLE001
+            _error_row(results, "mutex", e, C=C, L=L, mode="check-batch")
 
 
 def multi_register_arm(results, B, reps):

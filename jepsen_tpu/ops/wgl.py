@@ -425,16 +425,35 @@ def build_batched(
     return jax.vmap(check_one)
 
 
-def default_compaction() -> str:
+#: auto mode picks the exact all-pairs compaction while K = F·(C+1)
+#: stays below this; the on-chip A/B (frontier_results_tpu.json,
+#: 2026-07-31 18:30Z window) showed allpairs 10-27x faster than the
+#: scatter-hash lowering at every K ≤ 1600 measured — scatters
+#: serialize on TPU, [K,K] broadcast compares tile onto the VPU —
+#: while its O(K²) cost and [K,K] footprint must eventually lose to
+#: the O(K) hash tables as K grows.
+ALLPAIRS_AUTO_MAX_K = 2048
+
+
+def default_compaction(F: Optional[int] = None, C: Optional[int] = None) -> str:
     """Hot-path compaction mode: ``JEPSEN_TPU_FRONTIER_COMPACTION`` if
-    set (the A/B switch the capture watcher flips), else "hash"."""
+    set (the A/B switch the capture watcher flips), else "auto" —
+    exact all-pairs for small expansions (K ≤ ALLPAIRS_AUTO_MAX_K),
+    scatter-hash beyond.  Shapeless calls (F or C unknown) resolve
+    "auto" to "hash", the K-independent mode."""
     import os
 
-    mode = os.environ.get("JEPSEN_TPU_FRONTIER_COMPACTION", "hash")
+    mode = os.environ.get("JEPSEN_TPU_FRONTIER_COMPACTION", "auto")
+    if mode == "auto":
+        if F is None or C is None:
+            return "hash"
+        return (
+            "allpairs" if F * (C + 1) <= ALLPAIRS_AUTO_MAX_K else "hash"
+        )
     if mode not in _COMPACTIONS:
         raise ValueError(
             f"unknown frontier compaction {mode!r}; "
-            f"one of {sorted(_COMPACTIONS)}"
+            f"one of {sorted(_COMPACTIONS)} or auto"
         )
     return mode
 
@@ -455,14 +474,14 @@ def make_check_fn(
     of re-deriving (or forgetting) it.  ``compaction=None`` resolves
     through default_compaction() at call time."""
     if compaction is None:
-        compaction = default_compaction()
+        compaction = default_compaction(F, C)
     return _make_check_fn(spec_name, E, C, F, max_closure, compaction)
 
 
 @lru_cache(maxsize=64)
 def _make_check_fn(spec_name, E, C, F, max_closure, compaction):
     fn = jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
-    cap = frontier_max_dispatch(F, E)
+    cap = frontier_max_dispatch(F, E, C)
     if compaction == "allpairs" and cap:
         # the [K, K] equality matrix dominates this mode's footprint;
         # the quotient hitting 0 must propagate — 0 is the documented
@@ -476,14 +495,35 @@ def _make_check_fn(spec_name, E, C, F, max_closure, compaction):
 make_check_fn.cache_clear = _make_check_fn.cache_clear
 
 
+#: single-lock model family whose frontier grows linearly in C — one
+#: lock means at most one blocked acquire can linearize before the
+#: next release completes, so the per-event search is cheap
+#: SEQUENTIALLY and the memoized CPU oracle beats the entire device
+#: ladder once the dense automaton's envelope is exceeded.  Measured
+#: for mutex, 2026-07-31 18:45-18:49Z (frontier_results_tpu.json):
+#: oracle 1,028-1,436 h/s vs check-batch-auto 210-300 h/s at
+#: C ∈ {16, 24} — a ~5x oracle win even with allpairs compaction;
+#: owner/reentrant share the one-lock structure (their step algebra
+#: differs, not their frontier growth).  Routing them to the oracle is
+#: the measured production choice, not a fallback.  NOT in the set:
+#: acquired-permits — a semaphore admits n_permits concurrent holders
+#: (frontier not linear by this argument), and as a dense_only spec it
+#: already takes the oracle outside its envelope.
+LINEAR_FRONTIER_SPECS = frozenset(
+    {"mutex", "owner-mutex", "reentrant-mutex"}
+)
+
+
 def kernel_choice(spec_name: str, C: int, n_values) -> str:
-    """Which kernel make_best_check_fn would pick for this shape —
-    "dense" (subset automaton, no sorts, no overflow) or "frontier"
-    (generic sort-compacted search).  ``n_values`` is the value-domain
-    bound, or a (Vr, K) pair for multi-register's composite automaton.
-    Callers report this so a workload silently drifting outside the
-    dense envelope (e.g. "3n" concurrency pushing peak open ops past
-    its slot cap) is visible in stats rather than a mystery slowdown."""
+    """Which engine check_batch routes this shape to — "dense" (subset
+    automaton, no sorts, no overflow), "oracle" (linear-frontier lock
+    family outside the dense envelope: the CPU search wins there, see
+    LINEAR_FRONTIER_SPECS), or "frontier" (generic compacted device
+    search).  ``n_values`` is the value-domain bound, or a (Vr, K)
+    pair for multi-register's composite automaton.  Callers report
+    this so a workload silently drifting outside the dense envelope
+    (e.g. "3n" concurrency pushing peak open ops past its slot cap) is
+    visible in stats rather than a mystery slowdown."""
     from . import dense as dense_mod
 
     if n_values is not None:
@@ -494,6 +534,8 @@ def kernel_choice(spec_name: str, C: int, n_values) -> str:
         )
         if dense_mod.applicable(spec_name, C, V):
             return "dense"
+    if spec_name in LINEAR_FRONTIER_SPECS:
+        return "oracle"
     return "frontier"
 
 
@@ -593,12 +635,18 @@ def _run_rows(fn, mesh, arrays):
 #: the flagship bench shape (16384 × 1000-op histories) fits comfortably
 DEFAULT_MAX_DISPATCH = 16384
 
-#: Frontier-kernel dispatches above ~2M config-bitset words crash the
-#: axon TPU worker outright (observed: cas-register E≈2000, F=64 —
-#: B=256 runs, B=512 kills the worker; deterministic).  The budget is
-#: pinned at the measured-good point with 2× headroom below the fault;
-#: dense-kernel dispatches are unaffected (B=16384 runs clean).
-FRONTIER_DISPATCH_BUDGET = 1_000_000
+#: Oversized frontier-kernel dispatches crash the axon TPU worker
+#: outright.  Calibration points, in B × F·(C+1) × ceil(E/32) words
+#: (the closure expansion's live footprint):
+#:   SAFE  9.3M — cas E≈2000 C=8  F=64  B=256  (B=512 = 18.6M kills)
+#:   CRASH 8.9M — cas E=64   C=16 F=256 B=1024 (2026-07-31 18:40Z;
+#:                 its 16K-entry hash tables push the true footprint
+#:                 past the word count, hence crashing below 9.3M)
+#:   SAFE  3.3M — mutex E=64 C=24 F=64 B=1024
+#: 4M sits ≥2× under both crash points while keeping every proven-good
+#: single-dispatch shape un-chunked; dense-kernel dispatches are
+#: unaffected (B=16384 runs clean).
+FRONTIER_DISPATCH_BUDGET = 4_000_000
 
 
 def value_domain(spec_name: str, init_state, cand_a, cand_b) -> int:
@@ -620,18 +668,23 @@ def value_domain(spec_name: str, init_state, cand_a, cand_b) -> int:
 
 
 def frontier_max_dispatch(
-    F: int, E: int, max_dispatch: int = DEFAULT_MAX_DISPATCH
+    F: int, E: int, C: int = 0, max_dispatch: int = DEFAULT_MAX_DISPATCH
 ) -> int:
     """Largest safe per-dispatch row count for a frontier kernel of
-    capacity ``F`` over ``E`` event slots: footprint scales with
-    F × ceil(E/32) bitset words per row, so the cap shrinks as either
-    grows.  Chunked dispatch reuses one executable, so a smaller cap
-    costs extra dispatches, not extra compiles.  Returns 0 when even a
-    single row exceeds the budget — callers must NOT dispatch that
-    shape (check_batch skips the escalation rung; the oracle takes the
-    rows instead)."""
+    capacity ``F`` over ``E`` event slots with ``C`` candidate slots.
+    The dominant live footprint is the closure expansion, K = F·(C+1)
+    configs × ceil(E/32) bitset words per row — NOT the F-sized
+    frontier itself: budgeting on F alone under-counted ~17× at
+    C=16/F=256 and reproducibly crashed the axon TPU worker
+    (2026-07-31 18:40Z sweep, frontier_results_tpu.json error rows).
+    C=0 (unknown) keeps the old frontier-only accounting for callers
+    that size conservatively themselves.  Chunked dispatch reuses one
+    executable, so a smaller cap costs extra dispatches, not extra
+    compiles.  Returns 0 when even a single row exceeds the budget —
+    callers must NOT dispatch that shape (check_batch skips the
+    escalation rung; the oracle takes the rows instead)."""
     words = max(1, -(-E // 32))
-    per_row = F * words
+    per_row = F * (C + 1) * words
     if per_row > FRONTIER_DISPATCH_BUDGET:
         return 0
     return max(1, min(max_dispatch, FRONTIER_DISPATCH_BUDGET // per_row))
@@ -748,8 +801,17 @@ def check_batch(
                 spec.name, batch.init_state, batch.cand_a, batch.cand_b
             )
         if max_closure is None:
-            fn = make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
             kernel = kernel_choice(spec.name, C, n_values)
+            # "oracle": the measured-fastest engine for this shape is
+            # the CPU search (LINEAR_FRONTIER_SPECS outside the dense
+            # envelope) — fn=None sends the whole batch down the
+            # oracle path below with no device dispatches
+            fn = (
+                None
+                if kernel == "oracle"
+                else make_best_check_fn(spec.name, E, C, frontier, mc,
+                                        n_values)
+            )
         elif getattr(spec, "dense_only", False):
             # an explicit closure cap would force the frontier kernel,
             # which dense-only specs don't have: oracle takes the batch
@@ -824,7 +886,7 @@ def check_batch(
             # holds if every duplicate is actually removed.  Rungs
             # below it keep the configured fast compaction — a spurious
             # overflow there escalates to the next rung.
-            mode = default_compaction()
+            mode = default_compaction(capacity, C)
             if suff is not None and capacity >= suff:
                 mode = mode if mode in EXACT_COMPACTIONS else "sort"
             fn2 = make_check_fn(spec.name, E, C, capacity, mc, mode)
@@ -841,20 +903,30 @@ def check_batch(
             failed_at[bad] = failed2
             overflow[bad] = ovf2
 
+        overflow_engine = (
+            # routed by choice (the oracle IS the fastest engine for
+            # this shape) vs landed there by escalating off the device
+            "oracle-routed" if kernel == "oracle" else "oracle-overflow"
+        )
         for row, hist_idx in enumerate(batch.row_history):
             if overflow[row]:
                 # still overflowed after escalation: CPU oracle decides
                 if not oracle_fallback:
+                    # "routed": no kernel ran and nothing overflowed —
+                    # the shape belongs to the oracle and this caller
+                    # (e.g. race mode) runs the oracle itself
                     results[hist_idx] = {
                         "valid?": "unknown",
-                        "engine": "overflow",
+                        "engine": (
+                            "routed" if kernel == "oracle" else "overflow"
+                        ),
                     }
                     continue
                 results[hist_idx] = linear.analysis(
                     model, histories[hist_idx], pure_fs=spec.pure_fs,
                     budget_s=oracle_budget_s,
                 )
-                results[hist_idx]["engine"] = "oracle-overflow"
+                results[hist_idx]["engine"] = overflow_engine
             elif ok[row]:
                 results[hist_idx] = {
                     "valid?": True,

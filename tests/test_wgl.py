@@ -403,6 +403,35 @@ def test_allpairs_exactness_matches_sort():
         assert (fa_s[keep] == fa_a[keep]).all(), F
 
 
+def test_linear_frontier_specs_route_to_oracle():
+    """Lock-family models outside the dense envelope route the whole
+    batch to the CPU oracle by measured choice (the oracle beat the
+    full device ladder ~5x on mutex contention, 2026-07-31 on-chip
+    rows): engine must say "oracle-routed", verdicts must match the
+    oracle, and no device kernel may run.  An explicit max_closure
+    still forces the generic frontier kernel (the differential tests'
+    escape hatch)."""
+    # 14 concurrent open acquires: peak concurrency 14 > dense.MAX_C
+    ops = [invoke_op(p, "acquire") for p in range(14)]
+    ops += [ok_op(0, "acquire"), invoke_op(0, "release"),
+            ok_op(0, "release"), ok_op(1, "acquire")]
+    good = h(*ops)
+    bad = h(*(ops + [ok_op(2, "acquire")]))  # double-hold
+    out = wgl.check_batch(m.mutex(), [good, bad], slot_cap=16)
+    assert [o["valid?"] for o in out] == [True, False]
+    assert all(o["engine"] == "oracle-routed" for o in out)
+    # kernel_choice reports the route
+    assert wgl.kernel_choice("mutex", 14, 2) == "oracle"
+    # inside the dense envelope the automaton still takes the batch
+    assert wgl.kernel_choice("mutex", 8, 2) == "dense"
+    # the escape hatch still exercises the device kernel
+    forced = wgl.check_batch(
+        m.mutex(), [good, bad], slot_cap=16, max_closure=15
+    )
+    assert [o["valid?"] for o in forced] == [True, False]
+    assert all(o["engine"] == "tpu" for o in forced)
+
+
 def test_default_compaction_env(monkeypatch):
     monkeypatch.setenv("JEPSEN_TPU_FRONTIER_COMPACTION", "allpairs")
     assert wgl.default_compaction() == "allpairs"
@@ -410,7 +439,14 @@ def test_default_compaction_env(monkeypatch):
     with pytest.raises(ValueError):
         wgl.default_compaction()
     monkeypatch.delenv("JEPSEN_TPU_FRONTIER_COMPACTION")
+    # auto: exact all-pairs while K = F·(C+1) is small (the on-chip
+    # A/B showed it 10-27x faster there), scatter-hash beyond, and the
+    # K-independent mode when the shape is unknown
     assert wgl.default_compaction() == "hash"
+    assert wgl.default_compaction(16, 16) == "allpairs"  # K = 272
+    assert wgl.default_compaction(256, 16) == "hash"  # K = 4352
+    big_f = wgl.ALLPAIRS_AUTO_MAX_K  # K > cap even at C = 0
+    assert wgl.default_compaction(big_f + 1, 0) == "hash"
     # the allpairs footprint cap shrinks safe_dispatch vs the hash mode
     fh = wgl.make_check_fn("cas-register", 32, 8, 64, 9, "hash")
     fa = wgl.make_check_fn("cas-register", 32, 8, 64, 9, "allpairs")
@@ -647,15 +683,24 @@ def test_chunked_dispatch_matches_unchunked():
 
 def test_frontier_dispatch_cap_scales_with_footprint():
     """Frontier dispatches crash the axon TPU worker past a footprint
-    ceiling (B × F × E/32 bitset words); the cap must shrink as
-    capacity or history length grows, never exceed the caller's
-    max_dispatch, and keep a usable floor."""
-    # measured-good point: F=64, E≈2000 → cap ≥ 128 but ≤ 256
+    ceiling — the closure expansion's B × F·(C+1) × E/32 bitset words,
+    not the frontier alone (the F-only accounting under-counted ~17x
+    at C=16/F=256 and crashed the worker mid-sweep on 2026-07-31).
+    The cap must shrink as capacity, history length, or candidate
+    count grows, never exceed the caller's max_dispatch, and keep a
+    usable floor."""
+    # measured-good point (C-aware): cas E≈2000 C=8 F=64 — B=256 runs,
+    # B=512 kills; the cap must keep dispatches at or under that
+    cap8 = wgl.frontier_max_dispatch(64, 2000, C=8)
+    assert 64 <= cap8 <= 256
+    # a shapeless (C unknown) call is less informed, never smaller
     cap = wgl.frontier_max_dispatch(64, 2000)
-    assert 128 <= cap <= 256
-    # monotone: more capacity or longer histories → smaller caps
+    assert cap >= cap8
+    # monotone: more capacity, longer histories, or more candidate
+    # slots → smaller caps
     assert wgl.frontier_max_dispatch(256, 2000) < cap
     assert wgl.frontier_max_dispatch(64, 8000) < cap
+    assert wgl.frontier_max_dispatch(64, 2000, C=16) < cap
     # short histories at modest F are not throttled below max_dispatch
     assert wgl.frontier_max_dispatch(64, 100, max_dispatch=512) == 512
     # ceiling
@@ -663,9 +708,14 @@ def test_frontier_dispatch_cap_scales_with_footprint():
     # a shape whose SINGLE row busts the budget returns 0 ("never
     # dispatch") rather than a small-but-still-fatal floor
     assert wgl.frontier_max_dispatch(10**6, 10**6) == 0
-    # the compiled fn carries its own cap for every dispatch site
-    fn = wgl.make_check_fn("cas-register", 2000, 8, 64, 9)
-    assert fn.safe_dispatch == wgl.frontier_max_dispatch(64, 2000)
+    # the compiled fn carries its own cap, derived from the FULL
+    # expansion footprint, for every dispatch site
+    fn = wgl.make_check_fn("cas-register", 2000, 8, 64, 9, "hash")
+    assert fn.safe_dispatch == wgl.frontier_max_dispatch(64, 2000, C=8)
+    # the crash shape: the expansion-aware cap forces chunking well
+    # below the old frontier-only cap
+    crash = wgl.frontier_max_dispatch(256, 64, C=16)
+    assert 0 < crash < wgl.frontier_max_dispatch(256, 64)
 
 
 def test_check_batch_survives_undispatchable_sufficient_rung():
