@@ -309,9 +309,10 @@ def run_bench(on_accelerator, warnings):
 def _persist_artifact(payload, diag):
     record = {"captured_at": _utcnow(), **payload, "diag": diag}
     # BENCH_tpu_latest.json is the default-configuration artifact; an
-    # experimental-lowering run (diag.dense_union != gather) appends a
-    # labeled window below but must not take over the headline record
-    if diag.get("dense_union", "gather") == "gather":
+    # alternate-lowering run (diag.dense_union != the unroll default)
+    # appends a labeled window below but must not take over the
+    # headline record
+    if diag.get("dense_union", "unroll") == "unroll":
         try:
             with open(ARTIFACT, "w") as f:
                 json.dump(record, f)

@@ -6,18 +6,24 @@ evidence is only as good as the live-chip windows it manages to catch
 whole round: a cheap subprocess probe (jepsen_tpu.platform, 1 retry)
 every few minutes, and whenever the chip answers it immediately runs
 
-1. ``benchmarks/frontier_bench.py`` → the mutex/short-history/compaction
-                                  sweep on the real chip, persisted
-                                  row-by-row into
-                                  ``frontier_results_tpu.json`` (and the
-                                  unsuffixed headline copy) so even a
-                                  window that closes mid-sweep leaves
-                                  evidence;
-2. ``bench.py``                 → appends a window (with per-rep
+1. ``bench.py``                 → appends a window (with per-rep
                                   dispersion at B ∈ {8192,16384}) to
-                                  ``BENCH_tpu_windows.jsonl``;
+                                  ``BENCH_tpu_windows.jsonl``; run
+                                  FIRST since 2026-07-31 — it is
+                                  minutes long, so a short window (or
+                                  a driver-run bench colliding with a
+                                  capture) still gets the flagship;
+2. ``bench.py`` (gather union)  → the dense-lowering regression arm;
 3. ``benchmarks/elle_bench.py``  → re-pins the cycle-screen dispatch
-                                  band on the real backend.
+                                  band on the real backend;
+4. ``benchmarks/frontier_bench.py`` → the hour-class mutex/short-
+                                  history/compaction sweep, LAST (its
+                                  full evidence was recorded in the
+                                  18:05Z-20:00Z windows; rows persist
+                                  one-by-one into
+                                  ``frontier_results_tpu.json``, so a
+                                  window closing mid-sweep still
+                                  leaves fresh rows).
 
 Every action is logged to ``bench_watch.log`` (one JSON line each) so a
 round that never saw a live window still carries an honest probe trail.
@@ -99,14 +105,12 @@ def main():
             time.sleep(INTERVAL)
             continue
         log("probe-hit")
-        # Frontier first (VERDICT r4 ask #2): its short-history/mutex
-        # rows are the evidence two rounds have now missed; it also
-        # persists per-row, so even a window that closes mid-sweep
-        # leaves frontier_results_tpu.json behind.
-        rc, dt, tail = run(
-            [sys.executable, os.path.join(HERE, "frontier_bench.py")], 3600
-        )
-        log("frontier", rc=rc, elapsed_s=dt, tail=tail)
+        # Quick captures first.  The 2026-07-31 18:05Z-20:00Z windows
+        # recorded the complete frontier evidence, so the flagship
+        # bench (minutes) now leads and the hour-long sweep runs LAST:
+        # the chip stays free most of the time, and a driver-run
+        # bench.py colliding with a capture only ever waits on a short
+        # arm.
         rc, dt, tail = run([sys.executable, "bench.py"], 1800)
         log("bench", rc=rc, elapsed_s=dt, tail=tail)
         # A/B the dense subset-union lowering (RESULTS.md roofline
@@ -124,6 +128,13 @@ def main():
             [sys.executable, os.path.join(HERE, "elle_bench.py")], 1800
         )
         log("elle", rc=rc, elapsed_s=dt, tail=tail)
+        # the hour-class frontier sweep runs last (see above); its
+        # per-row persistence means a window closing mid-sweep still
+        # leaves frontier_results_tpu.json rows behind
+        rc, dt, tail = run(
+            [sys.executable, os.path.join(HERE, "frontier_bench.py")], 3600
+        )
+        log("frontier", rc=rc, elapsed_s=dt, tail=tail)
         captures += 1
         log("capture-done", n=captures)
         time.sleep(INTERVAL)

@@ -425,31 +425,27 @@ def build_batched(
     return jax.vmap(check_one)
 
 
-#: auto mode picks the exact all-pairs compaction while K = F·(C+1)
-#: stays below this; the on-chip A/B (frontier_results_tpu.json,
-#: 2026-07-31 18:30Z window) showed allpairs 10-27x faster than the
-#: scatter-hash lowering at every K ≤ 1600 measured — scatters
-#: serialize on TPU, [K,K] broadcast compares tile onto the VPU —
-#: while its O(K²) cost and [K,K] footprint must eventually lose to
-#: the O(K) hash tables as K grows.
-ALLPAIRS_AUTO_MAX_K = 2048
-
-
-def default_compaction(F: Optional[int] = None, C: Optional[int] = None) -> str:
+def default_compaction() -> str:
     """Hot-path compaction mode: ``JEPSEN_TPU_FRONTIER_COMPACTION`` if
     set (the A/B switch the capture watcher flips), else "auto" —
-    exact all-pairs for small expansions (K ≤ ALLPAIRS_AUTO_MAX_K),
-    scatter-hash beyond.  Shapeless calls (F or C unknown) resolve
-    "auto" to "hash", the K-independent mode."""
+    resolved per backend.  The 2026-07-31 on-chip grid
+    (frontier_results_tpu.json compaction + mutex arms) showed the
+    exact lax.sort compaction fastest at EVERY measured K from 136 to
+    2304 — up to 25x over the scatter-hash lowering (TPU scatters
+    serialize; the bitonic sort vectorizes) and ≥ the all-pairs mode
+    past the smallest shapes — so accelerators get "sort", which also
+    makes every rung exact (lossless escalation, exact fixpoint
+    certificates, no hash-collision caveats).  The CPU backend keeps
+    "hash": the round-4 CPU measurements showed the sort's cost
+    growing superlinearly in F there, which is exactly why per-backend
+    resolution exists instead of one pinned mode."""
     import os
 
     mode = os.environ.get("JEPSEN_TPU_FRONTIER_COMPACTION", "auto")
     if mode == "auto":
-        if F is None or C is None:
-            return "hash"
-        return (
-            "allpairs" if F * (C + 1) <= ALLPAIRS_AUTO_MAX_K else "hash"
-        )
+        import jax
+
+        return "hash" if jax.default_backend() == "cpu" else "sort"
     if mode not in _COMPACTIONS:
         raise ValueError(
             f"unknown frontier compaction {mode!r}; "
@@ -474,7 +470,7 @@ def make_check_fn(
     of re-deriving (or forgetting) it.  ``compaction=None`` resolves
     through default_compaction() at call time."""
     if compaction is None:
-        compaction = default_compaction(F, C)
+        compaction = default_compaction()
     return _make_check_fn(spec_name, E, C, F, max_closure, compaction)
 
 
@@ -886,7 +882,7 @@ def check_batch(
             # holds if every duplicate is actually removed.  Rungs
             # below it keep the configured fast compaction — a spurious
             # overflow there escalates to the next rung.
-            mode = default_compaction(capacity, C)
+            mode = default_compaction()
             if suff is not None and capacity >= suff:
                 mode = mode if mode in EXACT_COMPACTIONS else "sort"
             fn2 = make_check_fn(spec.name, E, C, capacity, mc, mode)

@@ -439,14 +439,10 @@ def test_default_compaction_env(monkeypatch):
     with pytest.raises(ValueError):
         wgl.default_compaction()
     monkeypatch.delenv("JEPSEN_TPU_FRONTIER_COMPACTION")
-    # auto: exact all-pairs while K = F·(C+1) is small (the on-chip
-    # A/B showed it 10-27x faster there), scatter-hash beyond, and the
-    # K-independent mode when the shape is unknown
+    # auto resolves per backend: the exact sort won every measured K
+    # on-chip, the CPU backend keeps the hash mode (this test runs on
+    # the CPU backend, so hash is what auto must produce here)
     assert wgl.default_compaction() == "hash"
-    assert wgl.default_compaction(16, 16) == "allpairs"  # K = 272
-    assert wgl.default_compaction(256, 16) == "hash"  # K = 4352
-    big_f = wgl.ALLPAIRS_AUTO_MAX_K  # K > cap even at C = 0
-    assert wgl.default_compaction(big_f + 1, 0) == "hash"
     # the allpairs footprint cap shrinks safe_dispatch vs the hash mode
     fh = wgl.make_check_fn("cas-register", 32, 8, 64, 9, "hash")
     fa = wgl.make_check_fn("cas-register", 32, 8, 64, 9, "allpairs")
