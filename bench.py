@@ -228,7 +228,10 @@ def run_bench(on_accelerator, warnings):
 
         rep_inputs = [relabel(seed) for seed in range(REPS + 1)]
 
-        def run(rep):
+        def dispatch(rep):
+            """Queue one rep's checker dispatch; returns device arrays
+            (no host sync) — shared by the bubble-per-rep and the
+            pipelined measurements so both time the same code path."""
             init2, a2, b2 = rep_inputs[rep]
             if mesh is None:
                 ok, _failed, overflow = fn(init2, d_ev, d_cs, d_cf, a2, b2)
@@ -237,6 +240,10 @@ def run_bench(on_accelerator, warnings):
                     ok, _failed, overflow = fn(
                         init2, d_ev, d_cs, d_cf, a2, b2
                     )
+            return ok, overflow
+
+        def run(rep):
+            ok, overflow = dispatch(rep)
             return np.asarray(ok), np.asarray(overflow)
 
         # Warmup (compile) + verdict-consistency check: all non-overflow
@@ -262,11 +269,28 @@ def run_bench(on_accelerator, warnings):
             rep_hps.append(B / (time.perf_counter() - t0))
         if not rep_hps:  # REPS=0: compile/consistency-check-only run
             rep_hps = [0.0]
+        # Pipelined aggregate: the same REPS dispatches queued
+        # back-to-back with ONE sync at the end — the dispatch pattern
+        # production uses (wgl._run_chunked keeps chunk outputs on
+        # device and materializes once), so this is the steady-state
+        # number a large keyspace actually gets; the per-rep timings
+        # above each pay a full dispatch-sync bubble.
+        hps_pipelined = None
+        if REPS >= 2:
+            t0 = time.perf_counter()
+            oks = [dispatch(rep + 1)[0] for rep in range(REPS)]
+            # the clock includes the host materialization production
+            # pays (_run_chunked's final np.concatenate of np.asarray)
+            oks = [np.asarray(ok) for ok in oks]
+            hps_pipelined = round(
+                REPS * B / (time.perf_counter() - t0), 2
+            )
         return {
             "B": B,
             "hps_min": round(min(rep_hps), 2),
             "hps_median": round(float(np.median(rep_hps)), 2),
             "hps_max": round(max(rep_hps), 2),
+            "hps_pipelined": hps_pipelined,
             "rep_hps": [round(v, 1) for v in rep_hps],
             "overflow_unknown": int(overflow.sum()),
             "invalid": int((~ok).sum()),
@@ -430,6 +454,17 @@ def main():
             "unit": "histories/sec",
             "vs_baseline": round(equiv / NORTH_STAR, 4),
         }
+        # conservative headline = median single-dispatch rep (each rep
+        # pays a full dispatch-sync bubble); the pipelined aggregate —
+        # back-to-back dispatches, one sync, the pattern
+        # wgl._run_chunked actually uses on large keyspaces — rides
+        # along at the top level so both numbers are first-class
+        pipelined = (diag.get("samples") or [{}])[0].get("hps_pipelined")
+        if pipelined:
+            payload["value_pipelined"] = pipelined
+            payload["vs_baseline_pipelined"] = round(
+                pipelined * (L / BASELINE_L) / NORTH_STAR, 4
+            )
         if on_accel and value > 0:
             # REPS=0 compile-only runs must not overwrite the last real
             # on-chip measurement or pollute the window history
