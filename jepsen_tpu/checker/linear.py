@@ -410,6 +410,12 @@ def analysis(
     the last completed op) and ``op-ids``/``ops`` context for the
     failure-witness renderer.
 
+    Exception to the shape: plain-mutex histories decide via the
+    search-free direct checker (``locks_direct``), whose results carry
+    ``algorithm: "direct-mutex"`` and NO ``configs`` key (there is no
+    config set to sample) — ``witness=True`` failures still re-search
+    for the full report.  Treat ``configs`` as optional.
+
     ``budget_s`` bounds wall time: the exponential search (knossos
     class — its docs warn of runs taking hours) reports an honest
     "unknown" past the budget instead of hanging a whole analysis on
@@ -432,6 +438,26 @@ def analysis(
         witness search cannot confirm within the remaining budget."""
         w = _search_witness(m, ev, op_l, max_configs, deadline, budget_s)
         return w if w.get("valid?") is False else r
+
+    # Plain-mutex histories decide in O(n log n) with no search at all
+    # (checker/locks_direct.py: single-lock linearizability reduces to
+    # greedy alternation scheduling) — no config space, no budget, no
+    # "unknown".  Witness requests still re-search a failure so the
+    # final-paths report exists; the direct verdict stands if the
+    # witness search blows its budget.
+    from ..models import Mutex as _Mutex
+
+    if type(model) is _Mutex:
+        from . import locks_direct
+
+        d = locks_direct._check_events(events, ops, bool(model.locked))
+        if d["valid?"] is True:
+            return d
+        if d["valid?"] is False:
+            if witness:
+                return witness_confirm(d, model, events, ops)
+            return d
+        # valid? None: not actually a lock history — generic search
 
     parts = _partition_by_key(model, events, ops)
     if parts is not None and len(parts) > 1:

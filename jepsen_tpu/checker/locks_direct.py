@@ -1,0 +1,138 @@
+"""Polynomial-time direct linearizability checker for plain mutex
+histories.
+
+General linearizability checking is NP-complete (the knossos search the
+reference consumes at jepsen/src/jepsen/checker.clj:199-203 is
+exponential), but a SINGLE plain lock is special: the model state is one
+bit, every acquire is interchangeable with every other acquire (the
+``models.Mutex`` step ignores the process), and likewise every release —
+so a history is linearizable iff the completed ops admit an ALTERNATING
+placement (acquire, release, acquire, …, seeded by the initial state)
+with each op placed inside its invocation→completion window.  That is a
+two-type interval scheduling problem, decidable greedily:
+
+- Sweep ``linear.prepare``'s event list in order (the windows are
+  defined by event positions, so the sweep IS the timeline).
+- Lazy placement: an op is placed at the latest legal moment — its own
+  completion event.  Placing later never hurts (windows constrain
+  order, not absolute time), so any feasible schedule can be deformed
+  into this one.
+- When the lock state blocks the op being placed (acquire while locked
+  / release while free), place ONE pending helper of the opposite kind
+  first — the one with the EARLIEST deadline (completion index;
+  crashed/info ops carry deadline ∞ and are thereby used only when no
+  mandatory helper exists).  The standard EDF exchange argument
+  applies because same-kind ops are interchangeable: if some feasible
+  schedule uses a later-deadline helper here, swapping it with the
+  EDF choice (placed elsewhere ≤ its earlier deadline) stays feasible.
+- Info/crashed ops (knossos semantics: concurrent forever, may
+  linearize once at any point after invocation, or never) sit in the
+  pending pools indefinitely and are consumed only as helpers.
+
+O(n log n) per history versus the exponential config search — this is
+the engine ``wgl.check_batch`` routes mutex batches to (the on-chip
+measurement that motivated oracle routing: frontier_results_tpu.json,
+2026-07-31), now decided without any search at all.  Owner-aware and
+reentrant locks are NOT handled here (their holds are not
+interchangeable, which breaks the exchange argument); ``analysis``
+returns None for them and the caller falls back to the generic oracle.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Optional
+
+from ..history import History, INVOKE, OK
+from .. import models as m
+from . import linear
+
+
+def _check_events(events: list, ops: list, locked0: bool) -> dict:
+    """The greedy sweep over ``linear.prepare`` output.  Returns the
+    analysis dict; ``{"valid?": None}`` is never produced — callers get
+    a definite True/False (this checker has no budget to blow)."""
+    # completion event index per op id = the op's placement deadline;
+    # ops with no OK event (info/crashed) never expire
+    inf = float("inf")
+    deadline = [inf] * len(ops)
+    for idx, (kind, op_id) in enumerate(events):
+        if kind == OK:
+            deadline[op_id] = idx
+
+    pend_acq: list = []  # (deadline, op_id) heaps; lazy deletion
+    pend_rel: list = []
+    placed = [False] * len(ops)
+    locked = locked0
+
+    def pop_helper(heap) -> Optional[int]:
+        while heap:
+            _, cand = heapq.heappop(heap)
+            if not placed[cand]:
+                return cand
+        return None
+
+    for kind, op_id in events:
+        f = ops[op_id].f
+        if f == "acquire":
+            is_acq = True
+        elif f == "release":
+            is_acq = False
+        else:
+            # not a plain-lock history after all — let the caller's
+            # generic search handle it
+            return {"valid?": None}
+        if kind == INVOKE:
+            heapq.heappush(
+                pend_acq if is_acq else pend_rel,
+                (deadline[op_id], op_id),
+            )
+        elif kind == OK:
+            if placed[op_id]:
+                continue  # consumed earlier as a helper
+            if is_acq and locked:
+                helper = pop_helper(pend_rel)
+                if helper is None:
+                    return {
+                        "valid?": False,
+                        "op": ops[op_id].to_dict(),
+                        "error": "cannot acquire a held lock",
+                        "algorithm": "direct-mutex",
+                    }
+                placed[helper] = True
+                locked = False
+            elif not is_acq and not locked:
+                helper = pop_helper(pend_acq)
+                if helper is None:
+                    return {
+                        "valid?": False,
+                        "op": ops[op_id].to_dict(),
+                        "error": "cannot release a free lock",
+                        "algorithm": "direct-mutex",
+                    }
+                placed[helper] = True
+                locked = True
+            placed[op_id] = True
+            locked = is_acq
+        # INFO events carry no obligation: the op stays pending forever
+
+    return {
+        "valid?": True,
+        "op-count": len(ops),
+        "algorithm": "direct-mutex",
+    }
+
+
+def analysis(model, history: History) -> Optional[dict]:
+    """Direct-decision analysis for plain-mutex histories, result-dict
+    compatible with ``linear.analysis``.  Returns None when the model
+    is not exactly ``models.Mutex`` (owner-aware and reentrant locks
+    break the interchangeability the greedy rests on) or the history
+    contains non-lock ops — callers then use the generic search."""
+    if type(model) is not m.Mutex:
+        return None
+    events, ops = linear.prepare(history)
+    out = _check_events(events, ops, bool(model.locked))
+    if out["valid?"] is None:
+        return None
+    return out

@@ -1,0 +1,185 @@
+"""Differential validation of the polynomial plain-mutex checker
+(checker/locks_direct.py) against the generic exponential search —
+the correctness gate for replacing search with greedy alternation
+scheduling (SURVEY.md §4's golden-history + differential strategy)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.checker import linear, locks_direct
+from jepsen_tpu.history import History, invoke_op, ok_op, info_op, fail_op
+
+
+def h(*ops) -> History:
+    hist = History(ops)
+    for i, op in enumerate(hist):
+        op.index = i
+        op.time = i
+    return hist
+
+
+def generic_search(model, history):
+    """The un-hooked exponential search (what linear.analysis runs for
+    every non-plain-mutex model) — the differential reference."""
+    events, ops = linear.prepare(history)
+    return linear._search_fast(
+        model, events, ops, linear.DEFAULT_MAX_CONFIGS, None, None
+    )
+
+
+def gen_mutex_history(rng, n_procs, n_events, corrupt=False, crash_p=0.0):
+    """Contended-lock history with optional double-grant corruption and
+    crashed (info) ops."""
+    hist = []
+    idle = list(range(n_procs))
+    waiting, holding, releasing = [], [], []
+    lock_free = True
+    corrupted = False
+    while len(hist) < n_events or waiting or holding or releasing:
+        moves = []
+        if idle and len(hist) < n_events:
+            moves.append("inv_acq")
+        if waiting and (lock_free or (corrupt and not corrupted)):
+            moves.append("grant")
+        if holding:
+            moves.append("inv_rel")
+        if releasing:
+            moves.append("ok_rel")
+        if not moves:
+            break
+        mv = rng.choice(moves)
+        if mv == "inv_acq":
+            p = idle.pop(rng.randrange(len(idle)))
+            hist.append(invoke_op(p, "acquire", None))
+            waiting.append(p)
+        elif mv == "grant":
+            if not lock_free:
+                corrupted = True
+            p = waiting.pop(rng.randrange(len(waiting)))
+            if crash_p and rng.random() < crash_p:
+                # process crashes mid-acquire and leaves every pool;
+                # the lock state it leaves behind is ambiguous
+                hist.append(info_op(p, "acquire", None))
+            else:
+                hist.append(ok_op(p, "acquire", None))
+                holding.append(p)
+                lock_free = False
+        elif mv == "inv_rel":
+            p = holding.pop(rng.randrange(len(holding)))
+            hist.append(invoke_op(p, "release", None))
+            releasing.append(p)
+            lock_free = True
+        else:
+            p = releasing.pop(rng.randrange(len(releasing)))
+            if crash_p and rng.random() < crash_p:
+                hist.append(info_op(p, "release", None))
+            else:
+                hist.append(ok_op(p, "release", None))
+            idle.append(p)
+    return h(*hist)
+
+
+def test_golden_valid():
+    good = h(
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(1, "acquire"),  # blocks
+        invoke_op(0, "release"), ok_op(0, "release"),
+        ok_op(1, "acquire"),
+        invoke_op(1, "release"), ok_op(1, "release"),
+    )
+    assert locks_direct.analysis(m.mutex(), good)["valid?"] is True
+
+
+def test_golden_double_hold():
+    bad = h(
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+    )
+    out = locks_direct.analysis(m.mutex(), bad)
+    assert out["valid?"] is False
+    assert out["op"]["process"] == 1
+
+
+def test_golden_release_free_lock():
+    bad = h(invoke_op(0, "release"), ok_op(0, "release"))
+    assert locks_direct.analysis(m.mutex(), bad)["valid?"] is False
+
+
+def test_crashed_acquire_enables_release():
+    """An info acquire may linearize (knossos: concurrent forever), so
+    a later completed release IS linearizable."""
+    ok = h(
+        invoke_op(0, "acquire"), info_op(0, "acquire"),
+        invoke_op(1, "release"), ok_op(1, "release"),
+    )
+    assert locks_direct.analysis(m.mutex(), ok)["valid?"] is True
+
+
+def test_failed_ops_dropped():
+    ok = h(
+        invoke_op(0, "acquire"), fail_op(0, "acquire"),
+        invoke_op(1, "release"), ok_op(1, "release"),
+    )
+    # the failed acquire never happened; the release has no lock
+    assert locks_direct.analysis(m.mutex(), ok)["valid?"] is False
+
+
+def test_initial_locked_state():
+    hist = h(invoke_op(0, "release"), ok_op(0, "release"))
+    assert locks_direct.analysis(m.Mutex(True), hist)["valid?"] is True
+
+
+def test_non_lock_history_returns_none():
+    hist = h(invoke_op(0, "write", 1), ok_op(0, "write", 1))
+    assert locks_direct.analysis(m.mutex(), hist) is None
+    assert locks_direct.analysis(m.owner_mutex(), hist) is None
+    # and the owner-aware model is refused even on lock ops
+    lk = h(invoke_op(0, "acquire"), ok_op(0, "acquire"))
+    assert locks_direct.analysis(m.owner_mutex(), lk) is None
+
+
+def test_differential_fuzz_vs_generic_search():
+    """The load-bearing gate: a large mixed corpus (contention,
+    corruption, crashes) must agree verdict-for-verdict with the
+    exponential search."""
+    rng = random.Random(20260731)
+    n_false = n_true = 0
+    for trial in range(1000):
+        n_procs = rng.choice([2, 3, 4, 5, 6, 8, 12])
+        n_events = rng.choice([8, 16, 30, 60, 100])
+        corrupt = trial % 3 == 0
+        crash_p = rng.choice([0.0, 0.0, 0.1, 0.3])
+        hist = gen_mutex_history(
+            rng, n_procs, n_events, corrupt=corrupt, crash_p=crash_p
+        )
+        want = generic_search(m.mutex(), hist)["valid?"]
+        got = locks_direct.analysis(m.mutex(), hist)["valid?"]
+        assert got == want, (trial, n_procs, n_events, corrupt, crash_p)
+        n_false += want is False
+        n_true += want is True
+    # the corpus must actually exercise both verdicts
+    assert n_false > 30 and n_true > 100
+
+
+def test_analysis_hook_routes_mutex():
+    """linear.analysis must answer plain-mutex histories via the direct
+    checker (same verdicts, never 'unknown') and still produce witness
+    reports on failure."""
+    rng = random.Random(7)
+    for _ in range(40):
+        hist = gen_mutex_history(
+            rng, 4, 24, corrupt=rng.random() < 0.5, crash_p=0.1
+        )
+        a = linear.analysis(m.mutex(), hist)
+        b = generic_search(m.mutex(), hist)
+        assert a["valid?"] == b["valid?"]
+        assert a["valid?"] != "unknown"
+    bad = h(
+        invoke_op(0, "acquire"), ok_op(0, "acquire"),
+        invoke_op(1, "acquire"), ok_op(1, "acquire"),
+    )
+    w = linear.analysis(m.mutex(), bad, witness=True)
+    assert w["valid?"] is False
+    assert "final-paths" in w or "op" in w
