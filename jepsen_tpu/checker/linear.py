@@ -439,25 +439,21 @@ def analysis(
         w = _search_witness(m, ev, op_l, max_configs, deadline, budget_s)
         return w if w.get("valid?") is False else r
 
-    # Plain-mutex histories decide in O(n log n) with no search at all
-    # (checker/locks_direct.py: single-lock linearizability reduces to
-    # greedy alternation scheduling) — no config space, no budget, no
-    # "unknown".  Witness requests still re-search a failure so the
-    # final-paths report exists; the direct verdict stands if the
-    # witness search blows its budget.
-    from ..models import Mutex as _Mutex
+    # Single-lock histories decide in O(n log n) with no search at all
+    # (checker/locks_direct.py: plain mutex via greedy alternation
+    # scheduling, owner-aware mutex via disjoint hold cores) — no
+    # config space, no budget, no "unknown".  Witness requests still
+    # re-search a failure so the final-paths report exists; the direct
+    # verdict stands if the witness search blows its budget.  A None
+    # return (uncovered model or structure) falls through to the
+    # generic search.
+    from . import locks_direct
 
-    if type(model) is _Mutex:
-        from . import locks_direct
-
-        d = locks_direct._check_events(events, ops, bool(model.locked))
-        if d["valid?"] is True:
-            return d
-        if d["valid?"] is False:
-            if witness:
-                return witness_confirm(d, model, events, ops)
-            return d
-        # valid? None: not actually a lock history — generic search
+    d = locks_direct.dispatch_events(model, events, ops)
+    if d is not None:
+        if d["valid?"] is False and witness:
+            return witness_confirm(d, model, events, ops)
+        return d
 
     parts = _partition_by_key(model, events, ops)
     if parts is not None and len(parts) > 1:

@@ -123,16 +123,139 @@ def _check_events(events: list, ops: list, locked0: bool) -> dict:
     }
 
 
+def _owner_check_events(events: list, ops: list) -> dict:
+    """Direct decision for OWNER-AWARE mutex histories.
+
+    Owner matching kills the plain-mutex interchangeability, but it
+    buys something stronger: each client's lock ops are sequential in
+    real time (one client = one logical thread), so a completed hold —
+    acquire ok'd at event index ``ao``, matching release invoked at
+    ``ri`` — necessarily occupies the whole span [ao, ri]: the acquire
+    linearizes before its ok, the release after its invocation, and
+    both belong to the SAME hold because only the owner can release.
+    Two holds whose cores overlap would both be held at once →
+    invalid.  Conversely, if all cores are pairwise disjoint, ordering
+    holds by core start gives ri_i < ao_j for consecutive holds, so
+    points can always be chosen (release just after its invocation,
+    acquire just before its ok): VALID ⇔ cores pairwise disjoint.
+
+    Crashed ops keep knossos semantics where a FIXED core still
+    exists: a hold whose release is info (may or may not linearize,
+    any time ≥ ri) keeps core [ao, ri]; an acquire with no release at
+    all holds forever — core [ao, ∞); a TRAILING crashed acquire is
+    optional and never needs placing.  A crashed op followed by more
+    ops from the same client makes that client's holds point-flexible
+    (no fixed core — the crashed op may linearize arbitrarily late),
+    so the sequentiality gate returns ``{"valid?": None}`` and the
+    caller falls back to the generic search: the direct path only
+    ever decides shapes its argument covers."""
+    from ..models.locks import _client as _owner_client
+    inf = float("inf")
+    comp_idx = {}
+    for idx, (kind, op_id) in enumerate(events):
+        if kind == OK:
+            comp_idx[op_id] = idx
+    inv_idx = {}
+    by_client: dict = {}
+    for idx, (kind, op_id) in enumerate(events):
+        if kind != INVOKE:
+            continue
+        inv_idx[op_id] = idx
+        c = _owner_client(ops[op_id])
+        if c is None:
+            return {"valid?": None}
+        by_client.setdefault(c, []).append(op_id)
+
+    cores = []  # (start, end, witness_op_id)
+    for c, ids in by_client.items():
+        # clients must be internally sequential: op k+1 invoked after
+        # op k completed (guaranteed when client==process; bail to the
+        # generic search otherwise)
+        for a, b in zip(ids, ids[1:]):
+            if comp_idx.get(a, inf) > inv_idx[b]:
+                return {"valid?": None}
+        i = 0
+        while i < len(ids):
+            op = ops[ids[i]]
+            acq_done = ids[i] in comp_idx
+            if op.f != "acquire":
+                if op.f != "release":
+                    return {"valid?": None}
+                # a release with no prior acquire by this client: no
+                # linearization can ever satisfy the owner check
+                if ids[i] in comp_idx:
+                    return {
+                        "valid?": False,
+                        "op": op.to_dict(),
+                        "error": (
+                            f"client {c!r} cannot release: never held"
+                        ),
+                        "algorithm": "direct-owner-mutex",
+                    }
+                i += 1  # crashed unmatched release: optional, skip
+                continue
+            rel = ids[i + 1] if i + 1 < len(ids) else None
+            if rel is not None and ops[rel].f != "release":
+                rel = None  # acquire-acquire: second starts a new hold
+            if rel is None:
+                if acq_done:
+                    # completed acquire, never released: holds forever
+                    cores.append((comp_idx[ids[i]], inf, ids[i]))
+                # crashed acquire with nothing after: optional, skip
+                i += 1
+                continue
+            rel_done = rel in comp_idx
+            if not acq_done:
+                # a crashed acquire's hold is point-flexible (it may
+                # linearize arbitrarily late), so it has no FIXED core
+                # and the disjointness argument would over-reject; the
+                # sequentiality gate above already sends these to the
+                # generic search — bail defensively if one slips here
+                return {"valid?": None}
+            cores.append(
+                (comp_idx[ids[i]], inv_idx[rel], rel if rel_done else ids[i])
+            )
+            i += 2
+
+    cores.sort()
+    for (s1, e1, w1), (s2, e2, w2) in zip(cores, cores[1:]):
+        if s2 <= e1:  # cores share an instant: two holds at once
+            return {
+                "valid?": False,
+                "op": ops[w2].to_dict(),
+                "error": "two overlapping holds of a non-reentrant lock",
+                "algorithm": "direct-owner-mutex",
+            }
+    return {
+        "valid?": True,
+        "op-count": len(ops),
+        "algorithm": "direct-owner-mutex",
+    }
+
+
+def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
+    """Events-level entry point — the ONE place that owns which models
+    the direct arguments cover: plain ``models.Mutex`` via greedy
+    alternation scheduling, initially-free ``models.OwnerMutex`` via
+    the disjoint-cores argument (the reentrant lock's nesting counts
+    are not covered).  Shared by :func:`analysis` and
+    ``linear.analysis``'s hook so the two entries cannot diverge.
+    Returns None for uncovered models or histories outside the
+    structure a direct argument covers — callers then use the generic
+    search."""
+    if type(model) is m.Mutex:
+        out = _check_events(events, ops, bool(model.locked))
+    elif type(model) is m.OwnerMutex and model.owner is None:
+        out = _owner_check_events(events, ops)
+    else:
+        return None
+    return None if out["valid?"] is None else out
+
+
 def analysis(model, history: History) -> Optional[dict]:
-    """Direct-decision analysis for plain-mutex histories, result-dict
-    compatible with ``linear.analysis``.  Returns None when the model
-    is not exactly ``models.Mutex`` (owner-aware and reentrant locks
-    break the interchangeability the greedy rests on) or the history
-    contains non-lock ops — callers then use the generic search."""
-    if type(model) is not m.Mutex:
-        return None
+    """History-level wrapper over :func:`dispatch_events`, result-dict
+    compatible with ``linear.analysis``."""
+    if type(model) not in (m.Mutex, m.OwnerMutex):
+        return None  # skip prepare() for models no argument covers
     events, ops = linear.prepare(history)
-    out = _check_events(events, ops, bool(model.locked))
-    if out["valid?"] is None:
-        return None
-    return out
+    return dispatch_events(model, events, ops)
