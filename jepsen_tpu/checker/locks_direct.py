@@ -32,10 +32,16 @@ two-type interval scheduling problem, decidable greedily:
 O(n log n) per history versus the exponential config search — this is
 the engine ``wgl.check_batch`` routes mutex batches to (the on-chip
 measurement that motivated oracle routing: frontier_results_tpu.json,
-2026-07-31), now decided without any search at all.  Owner-aware and
-reentrant locks are NOT handled here (their holds are not
-interchangeable, which breaks the exchange argument); ``analysis``
-returns None for them and the caller falls back to the generic oracle.
+2026-07-31), now decided without any search at all.
+
+Owner-aware locks lose that interchangeability but gain a stronger
+structure instead: a client's ops are sequential in real time, so its
+holds form statically-segmented spans each mandatorily occupying a
+real-time core, and validity reduces to pairwise-disjoint cores plus
+client-local count bounds (``_spans_check_events`` — the reentrant
+argument; the non-reentrant owner-aware mutex is the same argument at
+hold bound 1).  Histories whose crash structure leaves a span without
+a fixed core return None and fall back to the generic search.
 """
 
 from __future__ import annotations
@@ -123,33 +129,44 @@ def _check_events(events: list, ops: list, locked0: bool) -> dict:
     }
 
 
-def _owner_check_events(events: list, ops: list) -> dict:
-    """Direct decision for OWNER-AWARE mutex histories.
+def _spans_check_events(
+    events: list, ops: list, max_count: int, algo: str
+) -> dict:
+    """Direct decision for owner-aware lock histories (reentrant up to
+    ``max_count`` holds; ``max_count=1`` IS the non-reentrant
+    owner-aware mutex).
 
     Owner matching kills the plain-mutex interchangeability, but it
-    buys something stronger: each client's lock ops are sequential in
-    real time (one client = one logical thread), so a completed hold —
-    acquire ok'd at event index ``ao``, matching release invoked at
-    ``ri`` — necessarily occupies the whole span [ao, ri]: the acquire
-    linearizes before its ok, the release after its invocation, and
-    both belong to the SAME hold because only the owner can release.
-    Two holds whose cores overlap would both be held at once →
-    invalid.  Conversely, if all cores are pairwise disjoint, ordering
-    holds by core start gives ri_i < ao_j for consecutive holds, so
-    points can always be chosen (release just after its invocation,
-    acquire just before its ok): VALID ⇔ cores pairwise disjoint.
+    buys something stronger: a client's lock ops are sequential in
+    real time (one client = one logical thread), so its hold-count
+    trajectory is FIXED and holds group into statically-segmented
+    maximal nonzero-count SPANS — a span runs from the acquire that
+    takes the count 0→1 (ok'd at event index ``ao``) to the release
+    that returns it to 0 (invoked at ``ri``).  In-span validity is
+    purely client-local: the count must never exceed ``max_count``,
+    and a completed release at count 0 is unsatisfiable.  Across
+    clients, a span mandatorily occupies the core [ao, ri] — its
+    first acquire linearizes before ``ao``, its last release after
+    ``ri``, and the count never reaches 0 in between — so two
+    overlapping cores mean two owners at once: invalid.  Conversely,
+    disjoint cores order the spans, and consecutive spans can always
+    pick points (release just after its invocation, acquire just
+    before its ok): VALID ⇔ pairwise-disjoint span cores.
 
-    Crashed ops keep knossos semantics where a FIXED core still
-    exists: a hold whose release is info (may or may not linearize,
-    any time ≥ ri) keeps core [ao, ri]; an acquire with no release at
-    all holds forever — core [ao, ∞); a TRAILING crashed acquire is
-    optional and never needs placing.  A crashed op followed by more
-    ops from the same client makes that client's holds point-flexible
-    (no fixed core — the crashed op may linearize arbitrarily late),
-    so the sequentiality gate returns ``{"valid?": None}`` and the
-    caller falls back to the generic search: the direct path only
-    ever decides shapes its argument covers."""
+    Crashed ops keep knossos semantics where a fixed core still
+    exists: a span whose last release is info keeps its core (we may
+    CHOOSE to linearize the release; with more holds outstanding the
+    span stays open forever whether it peels or not, so nothing is
+    ambiguous); a span never closed holds forever — core [ao, ∞); a
+    trailing crashed acquire or unmatched crashed release is optional
+    and never needs placing.  A crashed op followed by more ops from
+    the same client makes that client's spans point-flexible (no
+    fixed core), so the sequentiality gate returns
+    ``{"valid?": None}`` and the caller falls back to the generic
+    search: the direct path only ever decides shapes its argument
+    covers."""
     from ..models.locks import _client as _owner_client
+
     inf = float("inf")
     comp_idx = {}
     for idx, (kind, op_id) in enumerate(events):
@@ -170,83 +187,104 @@ def _owner_check_events(events: list, ops: list) -> dict:
     for c, ids in by_client.items():
         # clients must be internally sequential: op k+1 invoked after
         # op k completed (guaranteed when client==process; bail to the
-        # generic search otherwise)
+        # generic search otherwise — this is also what confines
+        # crashed ops to a client's LAST position below)
         for a, b in zip(ids, ids[1:]):
             if comp_idx.get(a, inf) > inv_idx[b]:
                 return {"valid?": None}
-        i = 0
-        while i < len(ids):
-            op = ops[ids[i]]
-            acq_done = ids[i] in comp_idx
-            if op.f != "acquire":
-                if op.f != "release":
-                    return {"valid?": None}
-                # a release with no prior acquire by this client: no
-                # linearization can ever satisfy the owner check
-                if ids[i] in comp_idx:
+        count = 0
+        span_start = None  # acquire-ok index opening the current span
+        for op_id in ids:
+            op = ops[op_id]
+            done = op_id in comp_idx
+            if op.f == "acquire":
+                if not done:
+                    # trailing crashed acquire: optional, never placed
+                    continue
+                count += 1
+                if count > max_count:
                     return {
                         "valid?": False,
                         "op": op.to_dict(),
                         "error": (
-                            f"client {c!r} cannot release: never held"
+                            f"client {c!r} acquires while already "
+                            f"holding (bound {max_count})"
                         ),
-                        "algorithm": "direct-owner-mutex",
+                        "algorithm": algo,
                     }
-                i += 1  # crashed unmatched release: optional, skip
-                continue
-            rel = ids[i + 1] if i + 1 < len(ids) else None
-            if rel is not None and ops[rel].f != "release":
-                rel = None  # acquire-acquire: second starts a new hold
-            if rel is None:
-                if acq_done:
-                    # completed acquire, never released: holds forever
-                    cores.append((comp_idx[ids[i]], inf, ids[i]))
-                # crashed acquire with nothing after: optional, skip
-                i += 1
-                continue
-            rel_done = rel in comp_idx
-            if not acq_done:
-                # a crashed acquire's hold is point-flexible (it may
-                # linearize arbitrarily late), so it has no FIXED core
-                # and the disjointness argument would over-reject; the
-                # sequentiality gate above already sends these to the
-                # generic search — bail defensively if one slips here
+                if count == 1:
+                    span_start = comp_idx[op_id]
+            elif op.f == "release":
+                if count == 0:
+                    if done:
+                        return {
+                            "valid?": False,
+                            "op": op.to_dict(),
+                            "error": (
+                                f"client {c!r} cannot release: never held"
+                            ),
+                            "algorithm": algo,
+                        }
+                    continue  # crashed unmatched release: optional
+                # a crashed release here is necessarily the client's
+                # LAST op (sequentiality gate); linearizing it is OUR
+                # choice, so count==1 lets the span close at its
+                # invocation, and with more holds outstanding the span
+                # stays open forever whether it peels or not
+                count -= 1
+                if count == 0:
+                    cores.append((span_start, inv_idx[op_id], op_id))
+                    span_start = None
+            else:
                 return {"valid?": None}
-            cores.append(
-                (comp_idx[ids[i]], inv_idx[rel], rel if rel_done else ids[i])
-            )
-            i += 2
+        if span_start is not None:
+            # span never closed: held forever from its first acquire
+            cores.append((span_start, inf, ids[-1]))
 
     cores.sort()
     for (s1, e1, w1), (s2, e2, w2) in zip(cores, cores[1:]):
-        if s2 <= e1:  # cores share an instant: two holds at once
+        if s2 <= e1:  # cores share an instant: two owners at once
             return {
                 "valid?": False,
                 "op": ops[w2].to_dict(),
-                "error": "two overlapping holds of a non-reentrant lock",
-                "algorithm": "direct-owner-mutex",
+                "error": "two clients' hold spans overlap",
+                "algorithm": algo,
             }
-    return {
-        "valid?": True,
-        "op-count": len(ops),
-        "algorithm": "direct-owner-mutex",
-    }
+    return {"valid?": True, "op-count": len(ops), "algorithm": algo}
+
+
+def _owner_check_events(events: list, ops: list) -> dict:
+    """Non-reentrant owner-aware mutex = the spans argument at hold
+    bound 1."""
+    return _spans_check_events(events, ops, 1, "direct-owner-mutex")
+
+
+def _reentrant_check_events(events: list, ops: list, max_count: int) -> dict:
+    return _spans_check_events(
+        events, ops, max_count, "direct-reentrant-mutex"
+    )
 
 
 def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
     """Events-level entry point — the ONE place that owns which models
     the direct arguments cover: plain ``models.Mutex`` via greedy
     alternation scheduling, initially-free ``models.OwnerMutex`` via
-    the disjoint-cores argument (the reentrant lock's nesting counts
-    are not covered).  Shared by :func:`analysis` and
-    ``linear.analysis``'s hook so the two entries cannot diverge.
-    Returns None for uncovered models or histories outside the
-    structure a direct argument covers — callers then use the generic
-    search."""
+    disjoint hold cores, initially-free ``models.ReentrantMutex`` via
+    disjoint span cores plus client-local count bounds.  Shared by
+    :func:`analysis` and ``linear.analysis``'s hook so the two entries
+    cannot diverge.  Returns None for uncovered models or histories
+    outside the structure a direct argument covers — callers then use
+    the generic search."""
     if type(model) is m.Mutex:
         out = _check_events(events, ops, bool(model.locked))
     elif type(model) is m.OwnerMutex and model.owner is None:
         out = _owner_check_events(events, ops)
+    elif (
+        type(model) is m.ReentrantMutex
+        and model.owner is None
+        and model.count == 0
+    ):
+        out = _reentrant_check_events(events, ops, model.max_count)
     else:
         return None
     return None if out["valid?"] is None else out
@@ -255,7 +293,7 @@ def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
 def analysis(model, history: History) -> Optional[dict]:
     """History-level wrapper over :func:`dispatch_events`, result-dict
     compatible with ``linear.analysis``."""
-    if type(model) not in (m.Mutex, m.OwnerMutex):
+    if type(model) not in (m.Mutex, m.OwnerMutex, m.ReentrantMutex):
         return None  # skip prepare() for models no argument covers
     events, ops = linear.prepare(history)
     return dispatch_events(model, events, ops)

@@ -210,9 +210,9 @@ def test_owner_crashed_structures_fall_back():
     )
     out = locks_direct.analysis(m.owner_mutex(), tail)
     assert out is not None and out["valid?"] is True
-    # reentrant and pre-owned locks are out of scope
-    assert locks_direct.analysis(m.reentrant_mutex(), tail) is None
+    # pre-owned locks are out of scope
     assert locks_direct.analysis(m.OwnerMutex("n0"), tail) is None
+    assert locks_direct.analysis(m.ReentrantMutex("n0", 1), tail) is None
 
 
 def test_owner_differential_fuzz_vs_generic_search():
@@ -240,6 +240,117 @@ def test_owner_differential_fuzz_vs_generic_search():
         n_false += want is False
     assert answered > 350  # crash-free corpus: direct must answer
     assert n_false > 50
+
+
+def test_reentrant_golden():
+    c = lambda name: {"client": name}
+    # nested re-acquire within the bound, then fully released
+    good = h(
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "release", c("n0")), ok_op(0, "release", c("n0")),
+        invoke_op(0, "release", c("n0")), ok_op(0, "release", c("n0")),
+        invoke_op(1, "acquire", c("n1")), ok_op(1, "acquire", c("n1")),
+        invoke_op(1, "release", c("n1")), ok_op(1, "release", c("n1")),
+    )
+    out = locks_direct.analysis(m.reentrant_mutex(), good)
+    assert out["valid?"] is True
+    assert out["algorithm"] == "direct-reentrant-mutex"
+    # third acquire exceeds the hold bound (max_count = 2)
+    over = h(
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+    )
+    assert locks_direct.analysis(m.reentrant_mutex(), over)["valid?"] is False
+    # cross-client span overlap while n0 still holds (count 1)
+    cross = h(
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "release", c("n0")), ok_op(0, "release", c("n0")),
+        invoke_op(1, "acquire", c("n1")), ok_op(1, "acquire", c("n1")),
+    )
+    assert locks_direct.analysis(m.reentrant_mutex(), cross)["valid?"] is False
+    # release by a client that never held
+    rel = h(invoke_op(0, "release", c("n0")), ok_op(0, "release", c("n0")))
+    assert locks_direct.analysis(m.reentrant_mutex(), rel)["valid?"] is False
+
+
+def test_reentrant_crashed_structures():
+    """The crashed-op branches of the spans argument: trailing info
+    ops with a fixed core decide directly; mid-sequence crashes fall
+    back — each verdict cross-checked against the generic search."""
+    c = lambda name: {"client": name}
+    # trailing crashed release at count 1: span may close at its
+    # invocation, so a later hold is fine
+    close = h(
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "release", c("n0")), info_op(0, "release", c("n0")),
+        invoke_op(1, "acquire", c("n1")), ok_op(1, "acquire", c("n1")),
+    )
+    out = locks_direct.analysis(m.reentrant_mutex(), close)
+    assert out is not None and out["valid?"] is True
+    assert generic_search(m.reentrant_mutex(), close)["valid?"] is True
+    # trailing crashed release at count 2: the span stays open either
+    # way, so a later hold by another client overlaps it
+    open_span = h(
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "release", c("n0")), info_op(0, "release", c("n0")),
+        invoke_op(1, "acquire", c("n1")), ok_op(1, "acquire", c("n1")),
+    )
+    out = locks_direct.analysis(m.reentrant_mutex(), open_span)
+    assert out is not None and out["valid?"] is False
+    assert generic_search(m.reentrant_mutex(), open_span)["valid?"] is False
+    # trailing crashed acquire: optional, never placed
+    opt = h(
+        invoke_op(0, "acquire", c("n0")), ok_op(0, "acquire", c("n0")),
+        invoke_op(0, "release", c("n0")), ok_op(0, "release", c("n0")),
+        invoke_op(0, "acquire", c("n0")), info_op(0, "acquire", c("n0")),
+        invoke_op(1, "acquire", c("n1")), ok_op(1, "acquire", c("n1")),
+    )
+    out = locks_direct.analysis(m.reentrant_mutex(), opt)
+    assert out is not None and out["valid?"] is True
+    assert generic_search(m.reentrant_mutex(), opt)["valid?"] is True
+    # crashed unmatched release (count 0): optional, skipped
+    stray = h(
+        invoke_op(0, "release", c("n0")), info_op(0, "release", c("n0")),
+        invoke_op(1, "acquire", c("n1")), ok_op(1, "acquire", c("n1")),
+    )
+    out = locks_direct.analysis(m.reentrant_mutex(), stray)
+    assert out is not None and out["valid?"] is True
+    assert generic_search(m.reentrant_mutex(), stray)["valid?"] is True
+    # crashed op mid-sequence: the client's spans lose their fixed
+    # cores, so the direct checker must hand off
+    flex = h(
+        invoke_op(0, "acquire", c("n0")), info_op(0, "acquire", c("n0")),
+        invoke_op(0, "release", c("n0")), ok_op(0, "release", c("n0")),
+    )
+    assert locks_direct.analysis(m.reentrant_mutex(), flex) is None
+
+
+def test_reentrant_differential_fuzz_vs_generic_search():
+    from jepsen_tpu import synth
+
+    rng = random.Random(20260733)
+    answered = n_false = 0
+    for trial in range(400):
+        hist = synth.generate_lock_history(
+            rng,
+            n_procs=rng.choice([2, 3, 4, 6, 8]),
+            n_ops=rng.choice([10, 24, 40, 80]),
+            reentrant=True,
+            corrupt=trial % 3 == 0,
+        )
+        want = generic_search(m.reentrant_mutex(), hist)["valid?"]
+        got = locks_direct.analysis(m.reentrant_mutex(), hist)
+        if got is None:
+            continue
+        answered += 1
+        assert got["valid?"] == want, trial
+        n_false += want is False
+    assert answered > 350
+    assert n_false > 40
 
 
 def test_analysis_hook_routes_mutex():
