@@ -130,7 +130,7 @@ def _check_events(events: list, ops: list, locked0: bool) -> dict:
 
 
 def _spans_check_events(
-    events: list, ops: list, max_count: int, algo: str
+    events: list, ops: list, max_count: int, algo: str, model=None
 ) -> dict:
     """Direct decision for owner-aware lock histories (reentrant up to
     ``max_count`` holds; ``max_count=1`` IS the non-reentrant
@@ -183,7 +183,7 @@ def _spans_check_events(
             return {"valid?": None}
         by_client.setdefault(c, []).append(op_id)
 
-    cores = []  # (start, end, witness_op_id)
+    cores = []  # (start, end, witness_op_id, span_op_ids)
     for c, ids in by_client.items():
         # clients must be internally sequential: op k+1 invoked after
         # op k completed (guaranteed when client==process; bail to the
@@ -194,12 +194,14 @@ def _spans_check_events(
                 return {"valid?": None}
         count = 0
         span_start = None  # acquire-ok index opening the current span
+        span_ops: list = []
         for op_id in ids:
             op = ops[op_id]
             done = op_id in comp_idx
             if op.f == "acquire":
                 if not done:
                     # trailing crashed acquire: optional, never placed
+                    # (placing an acquire only ever adds constraints)
                     continue
                 count += 1
                 if count > max_count:
@@ -214,6 +216,8 @@ def _spans_check_events(
                     }
                 if count == 1:
                     span_start = comp_idx[op_id]
+                if model is not None:  # span ops feed the replay only
+                    span_ops.append(op_id)
             elif op.f == "release":
                 if count == 0:
                     if done:
@@ -232,17 +236,22 @@ def _spans_check_events(
                 # invocation, and with more holds outstanding the span
                 # stays open forever whether it peels or not
                 count -= 1
+                if model is not None:
+                    span_ops.append(op_id)
                 if count == 0:
-                    cores.append((span_start, inv_idx[op_id], op_id))
+                    cores.append(
+                        (span_start, inv_idx[op_id], op_id, span_ops)
+                    )
                     span_start = None
+                    span_ops = []
             else:
                 return {"valid?": None}
         if span_start is not None:
             # span never closed: held forever from its first acquire
-            cores.append((span_start, inf, ids[-1]))
+            cores.append((span_start, inf, ids[-1], span_ops))
 
-    cores.sort()
-    for (s1, e1, w1), (s2, e2, w2) in zip(cores, cores[1:]):
+    cores.sort(key=lambda t: (t[0], t[1]))
+    for (s1, e1, w1, _o1), (s2, e2, w2, _o2) in zip(cores, cores[1:]):
         if s2 <= e1:  # cores share an instant: two owners at once
             return {
                 "valid?": False,
@@ -250,18 +259,57 @@ def _spans_check_events(
                 "error": "two clients' hold spans overlap",
                 "algorithm": algo,
             }
+
+    if model is not None:
+        # Disjoint cores FORCE the linearization order (spans by core,
+        # ops client-sequential within a span), so full semantic
+        # validity — including the fenced models' monotonic-token
+        # rules, which depend on the global observation order — is
+        # decided by replaying the model's own step function over that
+        # one order.  The optional-op choices above (skip trailing
+        # crashed acquires and stray releases, linearize a span-closing
+        # crashed release) are each maximally permissive, so an
+        # inconsistent replay means no linearization exists.
+        state = model
+        for _s, _e, _w, span in cores:
+            for op_id in span:
+                state = state.step(ops[op_id])
+                if state.is_inconsistent:
+                    return {
+                        "valid?": False,
+                        "op": ops[op_id].to_dict(),
+                        "error": str(getattr(state, "msg", "inconsistent")),
+                        "algorithm": algo,
+                    }
     return {"valid?": True, "op-count": len(ops), "algorithm": algo}
 
 
 def _owner_check_events(events: list, ops: list) -> dict:
     """Non-reentrant owner-aware mutex = the spans argument at hold
-    bound 1."""
+    bound 1.  No replay: the count walk already decides these models
+    exactly (differentially validated), so the fast path stays fast."""
     return _spans_check_events(events, ops, 1, "direct-owner-mutex")
 
 
 def _reentrant_check_events(events: list, ops: list, max_count: int) -> dict:
     return _spans_check_events(
         events, ops, max_count, "direct-reentrant-mutex"
+    )
+
+
+def _fenced_check_events(events: list, ops: list, model) -> dict:
+    """Fenced flavors: segmentation + disjoint cores as above, then the
+    forced-order replay carries the monotonic-fence rules via the
+    model's own step function."""
+    return _spans_check_events(
+        events, ops, 1, "direct-fenced-mutex", model
+    )
+
+
+def _reentrant_fenced_check_events(events: list, ops: list, model) -> dict:
+    return _spans_check_events(
+        events, ops, model.max_count, "direct-reentrant-fenced-mutex",
+        model,
     )
 
 
@@ -275,6 +323,8 @@ def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
     cannot diverge.  Returns None for uncovered models or histories
     outside the structure a direct argument covers — callers then use
     the generic search."""
+    from ..models.locks import FencedMutex, ReentrantFencedMutex
+
     if type(model) is m.Mutex:
         out = _check_events(events, ops, bool(model.locked))
     elif type(model) is m.OwnerMutex and model.owner is None:
@@ -285,6 +335,14 @@ def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
         and model.count == 0
     ):
         out = _reentrant_check_events(events, ops, model.max_count)
+    elif type(model) is FencedMutex and model.owner is None:
+        out = _fenced_check_events(events, ops, model)
+    elif (
+        type(model) is ReentrantFencedMutex
+        and model.owner is None
+        and model.count == 0
+    ):
+        out = _reentrant_fenced_check_events(events, ops, model)
     else:
         return None
     return None if out["valid?"] is None else out
@@ -293,7 +351,15 @@ def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
 def analysis(model, history: History) -> Optional[dict]:
     """History-level wrapper over :func:`dispatch_events`, result-dict
     compatible with ``linear.analysis``."""
-    if type(model) not in (m.Mutex, m.OwnerMutex, m.ReentrantMutex):
+    from ..models.locks import FencedMutex, ReentrantFencedMutex
+
+    if type(model) not in (
+        m.Mutex,
+        m.OwnerMutex,
+        m.ReentrantMutex,
+        FencedMutex,
+        ReentrantFencedMutex,
+    ):
         return None  # skip prepare() for models no argument covers
     events, ops = linear.prepare(history)
     return dispatch_events(model, events, ops)

@@ -323,27 +323,44 @@ def test_oracle_wall_time_budget_returns_unknown():
     from jepsen_tpu.checker import linear
 
     rng = random.Random(45105)
-    h = synth.generate_lock_history(
-        rng, n_procs=8, n_ops=60, corrupt=True
+    # the lock family now decides via the search-free direct checkers
+    # (checker/locks_direct.py) and never consults the budget, so the
+    # budget probe uses the cas-register blowup class the knob exists
+    # for (corrupt + concurrency = the exponential config explosion)
+    h = synth.generate_history(
+        rng, n_procs=8, n_ops=60, crash_p=0.0, corrupt=True
     )
     # an already-expired deadline: the first closure reports the blown
     # budget deterministically (no timing races in the test)
-    out = linear.analysis(models.fenced_mutex(), h, budget_s=0.0)
+    out = linear.analysis(models.cas_register(0), h, budget_s=0.0)
     assert out["valid?"] == "unknown", out
     # the error names the blown knob (budget vs max_configs)
     assert "time budget" in out["error"], out
 
-    # the checker-level opt threads through
+    # the checker-level opt threads through (algorithm pinned to the
+    # oracle: "auto" would route cas-register to the device kernel,
+    # which decides exactly and never consults the budget)
     chk = checker_mod.linearizable(
-        models.fenced_mutex(), pure_fs=(), oracle_budget_s=0.0
+        models.cas_register(0), algorithm="oracle", pure_fs=(),
+        oracle_budget_s=0.0,
     )
     assert chk.check({}, h)["valid?"] == "unknown"
 
-    # a generous budget leaves tractable verdicts untouched
-    out3 = linear.analysis(models.fenced_mutex(), h, budget_s=60.0)
-    assert out3["valid?"] is False, out3
-    out4 = linear.analysis(models.owner_mutex(), h, budget_s=60.0)
+    # a generous budget leaves tractable verdicts untouched: same
+    # definite verdict as the unbudgeted search
+    base = linear.analysis(models.cas_register(0), h)
+    out3 = linear.analysis(models.cas_register(0), h, budget_s=60.0)
+    assert out3["valid?"] == base["valid?"] != "unknown", out3
+    # and the direct lock checkers decide instantly regardless of the
+    # budget — an expired deadline cannot force them to "unknown"
+    lk = synth.generate_lock_history(
+        rng, n_procs=8, n_ops=60, corrupt=True
+    )
+    out4 = linear.analysis(models.fenced_mutex(), lk, budget_s=0.0)
     assert out4["valid?"] is False, out4
+    assert out4.get("algorithm") == "direct-fenced-mutex"
+    out5 = linear.analysis(models.owner_mutex(), lk, budget_s=0.0)
+    assert out5["valid?"] is False, out5
 
 
 def test_fast_path_matches_witness_path():

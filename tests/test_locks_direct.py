@@ -353,6 +353,168 @@ def test_reentrant_differential_fuzz_vs_generic_search():
     assert n_false > 40
 
 
+def test_fenced_golden():
+    from jepsen_tpu.models.locks import FencedMutex, ReentrantFencedMutex
+
+    cf = lambda name, fence: {"client": name, "fence": fence}
+    good = h(
+        invoke_op(0, "acquire", cf("n0", 1)), ok_op(0, "acquire", cf("n0", 1)),
+        invoke_op(0, "release", cf("n0", 0)), ok_op(0, "release", cf("n0", 0)),
+        invoke_op(1, "acquire", cf("n1", 5)), ok_op(1, "acquire", cf("n1", 5)),
+        invoke_op(1, "release", cf("n1", 0)), ok_op(1, "release", cf("n1", 0)),
+    )
+    out = locks_direct.analysis(FencedMutex(), good)
+    assert out["valid?"] is True
+    assert out["algorithm"] == "direct-fenced-mutex"
+    # the second hold's fence regresses: stale token
+    stale = h(
+        invoke_op(0, "acquire", cf("n0", 5)), ok_op(0, "acquire", cf("n0", 5)),
+        invoke_op(0, "release", cf("n0", 0)), ok_op(0, "release", cf("n0", 0)),
+        invoke_op(1, "acquire", cf("n1", 3)), ok_op(1, "acquire", cf("n1", 3)),
+    )
+    out = locks_direct.analysis(FencedMutex(), stale)
+    assert out["valid?"] is False
+    assert "fence" in out["error"]
+    assert generic_search(FencedMutex(), stale)["valid?"] is False
+    # reentrant fenced: re-acquire must reuse the hold's fence or none
+    rgood = h(
+        invoke_op(0, "acquire", cf("n0", 2)), ok_op(0, "acquire", cf("n0", 2)),
+        invoke_op(0, "acquire", cf("n0", 2)), ok_op(0, "acquire", cf("n0", 2)),
+        invoke_op(0, "release", cf("n0", 0)), ok_op(0, "release", cf("n0", 0)),
+        invoke_op(0, "release", cf("n0", 0)), ok_op(0, "release", cf("n0", 0)),
+    )
+    out = locks_direct.analysis(ReentrantFencedMutex(), rgood)
+    assert out["valid?"] is True
+    assert out["algorithm"] == "direct-reentrant-fenced-mutex"
+    rbad = h(
+        invoke_op(0, "acquire", cf("n0", 2)), ok_op(0, "acquire", cf("n0", 2)),
+        invoke_op(0, "acquire", cf("n0", 7)), ok_op(0, "acquire", cf("n0", 7)),
+    )
+    out = locks_direct.analysis(ReentrantFencedMutex(), rbad)
+    assert out["valid?"] is False
+    assert generic_search(ReentrantFencedMutex(), rbad)["valid?"] is False
+
+
+def _stamp_fences(rng, hist, corrupt):
+    """Assign fencing tokens to a lock history's acquires: fresh holds
+    in grant order get increasing tokens (sometimes none), re-acquires
+    reuse the hold fence (sometimes none); ``corrupt`` regresses or
+    reuses one token.  Returns a NEW history; verdict correctness is
+    irrelevant here — the differential fuzz compares whatever comes
+    out against the generic search."""
+    from jepsen_tpu.history import History
+
+    next_fence = 1
+    hold_fence: dict = {}
+    ops = []
+    corrupted = False
+    for op in hist:
+        v = op.value if isinstance(op.value, dict) else {"client": op.value}
+        client = v.get("client")
+        op2 = op.copy()
+        fence = 0
+        if op.f == "acquire" and op.type in ("ok", "info"):
+            if client not in hold_fence:  # fresh hold
+                if corrupt and not corrupted and next_fence > 2 \
+                        and rng.random() < 0.5:
+                    fence = rng.randrange(1, next_fence)  # stale token
+                    corrupted = True
+                elif rng.random() < 0.75:
+                    fence = next_fence
+                    next_fence += 1
+                hold_fence[client] = fence
+            else:
+                fence = hold_fence[client] if rng.random() < 0.6 else 0
+        elif op.f == "release" and op.type in ("ok", "info"):
+            hold_fence.pop(client, None)
+        op2.value = {"client": client, "fence": fence}
+        ops.append(op2)
+    out = History(ops)
+    for i, op in enumerate(out):
+        op.index = i
+        op.time = i
+    return out
+
+
+def test_fenced_differential_fuzz_vs_generic_search():
+    from jepsen_tpu import synth
+    from jepsen_tpu.models.locks import FencedMutex, ReentrantFencedMutex
+
+    rng = random.Random(20260734)
+    for reentrant, model_f in ((False, FencedMutex), (True,
+                                                      ReentrantFencedMutex)):
+        answered = n_false = 0
+        for trial in range(200):
+            base = synth.generate_lock_history(
+                rng,
+                n_procs=rng.choice([2, 3, 4, 6]),
+                n_ops=rng.choice([10, 24, 48]),
+                reentrant=reentrant,
+                corrupt=trial % 4 == 0,
+            )
+            hist = _stamp_fences(rng, base, corrupt=trial % 3 == 0)
+            want = generic_search(model_f(), hist)["valid?"]
+            got = locks_direct.analysis(model_f(), hist)
+            if got is None:
+                continue
+            answered += 1
+            assert got["valid?"] == want, (reentrant, trial)
+            n_false += want is False
+        assert answered > 150, reentrant
+        assert n_false > 30, reentrant
+
+
+def test_fenced_crashed_differential_fuzz():
+    """Crash-injecting arm for the fenced replay's crashed-op
+    branches (which synth.generate_lock_history never produces):
+    flip a suffix of completions to info and truncate, then compare
+    whatever the direct checker answers against the generic search."""
+    from jepsen_tpu.history import History
+    from jepsen_tpu.models.locks import FencedMutex, ReentrantFencedMutex
+    from jepsen_tpu import synth
+
+    rng = random.Random(20260735)
+    answered = n_false = 0
+    for trial in range(200):
+        reentrant = trial % 2 == 1
+        base = synth.generate_lock_history(
+            rng,
+            n_procs=rng.choice([2, 3, 4]),
+            n_ops=rng.choice([8, 16, 30]),
+            reentrant=reentrant,
+            corrupt=trial % 4 == 0,
+        )
+        stamped = _stamp_fences(rng, base, corrupt=trial % 3 == 0)
+        # crash-inject TRAILING ops only (a client's LAST completion
+        # flips to info) — mid-sequence crashes would just exercise
+        # the None fallback, which has its own test
+        ops = list(stamped)
+        last_ok = {}
+        for i, op in enumerate(ops):
+            v = op.value if isinstance(op.value, dict) else {}
+            if op.type == "ok":
+                last_ok[v.get("client")] = i
+        for c, i in last_ok.items():
+            if rng.random() < 0.5:
+                op2 = ops[i].copy()
+                op2.type = "info"
+                ops[i] = op2
+        hist = History(ops)
+        for i, op in enumerate(hist):
+            op.index = i
+            op.time = i
+        model_f = ReentrantFencedMutex if reentrant else FencedMutex
+        want = generic_search(model_f(), hist)["valid?"]
+        got = locks_direct.analysis(model_f(), hist)
+        if got is None or want == "unknown":
+            continue
+        answered += 1
+        assert got["valid?"] == want, (trial, reentrant)
+        n_false += want is False
+    assert answered > 100
+    assert n_false > 20
+
+
 def test_analysis_hook_routes_mutex():
     """linear.analysis must answer plain-mutex histories via the direct
     checker (same verdicts, never 'unknown') and still produce witness
