@@ -129,6 +129,38 @@ def _check_events(events: list, ops: list, locked0: bool) -> dict:
     }
 
 
+def _index_and_group(events: list, ops: list):
+    """Shared preamble for the owner-family and semaphore arguments:
+    build completion/invocation indices, group op ids per client, and
+    apply the sequentiality gate (a crashed op followed by more ops
+    from the same client makes that client's structure point-flexible,
+    so every fixed-core/extremal argument must hand off).  Returns
+    (comp_idx, inv_idx, by_client) or None — None means 'fall back to
+    the generic search'."""
+    from ..models.locks import _client as _owner_client
+
+    inf = float("inf")
+    comp_idx = {}
+    for idx, (kind, op_id) in enumerate(events):
+        if kind == OK:
+            comp_idx[op_id] = idx
+    inv_idx = {}
+    by_client: dict = {}
+    for idx, (kind, op_id) in enumerate(events):
+        if kind != INVOKE:
+            continue
+        inv_idx[op_id] = idx
+        c = _owner_client(ops[op_id])
+        if c is None:
+            return None
+        by_client.setdefault(c, []).append(op_id)
+    for ids in by_client.values():
+        for a, b in zip(ids, ids[1:]):
+            if comp_idx.get(a, inf) > inv_idx[b]:
+                return None
+    return comp_idx, inv_idx, by_client
+
+
 def _spans_check_events(
     events: list, ops: list, max_count: int, algo: str, model=None
 ) -> dict:
@@ -165,33 +197,14 @@ def _spans_check_events(
     ``{"valid?": None}`` and the caller falls back to the generic
     search: the direct path only ever decides shapes its argument
     covers."""
-    from ..models.locks import _client as _owner_client
-
     inf = float("inf")
-    comp_idx = {}
-    for idx, (kind, op_id) in enumerate(events):
-        if kind == OK:
-            comp_idx[op_id] = idx
-    inv_idx = {}
-    by_client: dict = {}
-    for idx, (kind, op_id) in enumerate(events):
-        if kind != INVOKE:
-            continue
-        inv_idx[op_id] = idx
-        c = _owner_client(ops[op_id])
-        if c is None:
-            return {"valid?": None}
-        by_client.setdefault(c, []).append(op_id)
+    grouped = _index_and_group(events, ops)
+    if grouped is None:
+        return {"valid?": None}
+    comp_idx, inv_idx, by_client = grouped
 
     cores = []  # (start, end, witness_op_id, span_op_ids)
     for c, ids in by_client.items():
-        # clients must be internally sequential: op k+1 invoked after
-        # op k completed (guaranteed when client==process; bail to the
-        # generic search otherwise — this is also what confines
-        # crashed ops to a client's LAST position below)
-        for a, b in zip(ids, ids[1:]):
-            if comp_idx.get(a, inf) > inv_idx[b]:
-                return {"valid?": None}
         count = 0
         span_start = None  # acquire-ok index opening the current span
         span_ops: list = []
@@ -313,16 +326,94 @@ def _reentrant_fenced_check_events(events: list, ops: list, model) -> dict:
     )
 
 
+def _permits_check_events(events: list, ops: list, n_permits: int) -> dict:
+    """Direct decision for SEMAPHORE (acquired-permits) histories.
+
+    No cores needed here — the exact condition falls out of an
+    extremal placement.  Every completed acquire must linearize by its
+    ok (index ``ao``) and every release may linearize as early as just
+    after its invocation (``ri``), so
+
+        H(t) = #{acquires: ao ≤ t} − #{releases placed: ri ≤ t}
+
+    is a LOWER bound on permits outstanding at time t under ANY
+    placement: H(t) > n_permits anywhere means no linearization
+    exists.  Conversely, placing each acquire just before its ok and
+    each release just after its invocation — in anchor order, which
+    respects every client's sequential op order — realizes exactly H,
+    so H ≤ n_permits everywhere (plus per-client release sanity, which
+    is deterministic because a client's op order is fixed) IS
+    linearizability.  Optional crashed ops resolve maximally
+    permissively: trailing crashed acquires are never placed (placing
+    only raises H), trailing crashed releases are placed whenever the
+    client holds a permit (placing only lowers H and nothing of that
+    client follows).  Crashed ops with successors fall back to the
+    generic search, as in the lock checkers."""
+    algo = "direct-acquired-permits"
+    grouped = _index_and_group(events, ops)
+    if grouped is None:
+        return {"valid?": None}
+    comp_idx, inv_idx, by_client = grouped
+
+    deltas = []  # (anchor_index, +1/-1, op_id)
+    for c, ids in by_client.items():
+        held = 0
+        for op_id in ids:
+            op = ops[op_id]
+            done = op_id in comp_idx
+            if op.f == "acquire":
+                if not done:
+                    continue  # trailing crashed acquire: never placed
+                held += 1
+                deltas.append((comp_idx[op_id], 1, op_id))
+            elif op.f == "release":
+                if held == 0:
+                    if done:
+                        return {
+                            "valid?": False,
+                            "op": op.to_dict(),
+                            "error": (
+                                f"client {c!r} releases a permit it "
+                                "does not hold"
+                            ),
+                            "algorithm": algo,
+                        }
+                    continue  # trailing crashed release, nothing held
+                held -= 1
+                deltas.append((inv_idx[op_id], -1, op_id))
+            else:
+                return {"valid?": None}
+
+    deltas.sort()
+    outstanding = 0
+    for _idx, d, op_id in deltas:
+        outstanding += d
+        if outstanding > n_permits:
+            return {
+                "valid?": False,
+                "op": ops[op_id].to_dict(),
+                "error": (
+                    f"more than {n_permits} permits necessarily "
+                    "outstanding"
+                ),
+                "algorithm": algo,
+            }
+    return {"valid?": True, "op-count": len(ops), "algorithm": algo}
+
+
 def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
     """Events-level entry point — the ONE place that owns which models
     the direct arguments cover: plain ``models.Mutex`` via greedy
-    alternation scheduling, initially-free ``models.OwnerMutex`` via
-    disjoint hold cores, initially-free ``models.ReentrantMutex`` via
-    disjoint span cores plus client-local count bounds.  Shared by
-    :func:`analysis` and ``linear.analysis``'s hook so the two entries
-    cannot diverge.  Returns None for uncovered models or histories
-    outside the structure a direct argument covers — callers then use
-    the generic search."""
+    alternation scheduling; the initially-free owner-aware family
+    (``OwnerMutex``, ``ReentrantMutex``, ``FencedMutex``,
+    ``ReentrantFencedMutex``) via disjoint span cores — with a
+    forced-order model replay carrying the fenced flavors' token
+    rules; initially-empty ``AcquiredPermits`` via the extremal
+    mandatory-count argument.  Shared by :func:`analysis` and
+    ``linear.analysis``'s hook so the two entries cannot diverge.
+    Returns None for uncovered models or histories outside the
+    structure a direct argument covers — callers then use the generic
+    search."""
     from ..models.locks import FencedMutex, ReentrantFencedMutex
 
     if type(model) is m.Mutex:
@@ -343,6 +434,8 @@ def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
         and model.count == 0
     ):
         out = _reentrant_fenced_check_events(events, ops, model)
+    elif type(model) is m.AcquiredPermits and not model.acquired:
+        out = _permits_check_events(events, ops, model.n_permits)
     else:
         return None
     return None if out["valid?"] is None else out
@@ -359,6 +452,7 @@ def analysis(model, history: History) -> Optional[dict]:
         m.ReentrantMutex,
         FencedMutex,
         ReentrantFencedMutex,
+        m.AcquiredPermits,
     ):
         return None  # skip prepare() for models no argument covers
     events, ops = linear.prepare(history)
