@@ -592,6 +592,64 @@ def test_permits_differential_fuzz_vs_generic_search():
     assert n_false > 40
 
 
+def test_opsoup_differential_all_models():
+    """Adversarial differential: ARBITRARY interleavings (not
+    generator-shaped) — random op kinds, crashes anywhere, fail ops,
+    and client names deliberately shared across concurrent processes
+    (two processes acting as one client breaks the sequentiality the
+    spans/permits arguments rest on, so the gate must hand off — and
+    when it does answer, the verdict must match the search)."""
+    from jepsen_tpu.models.locks import FencedMutex, ReentrantFencedMutex
+
+    fenced_val = lambda r, c: {
+        "client": c, "fence": r.choice([0, 0, r.randrange(1, 6)])
+    }
+    rng = random.Random(20260737)
+    models_pool = [
+        (m.mutex, lambda r, c: c),
+        (m.owner_mutex, lambda r, c: {"client": c}),
+        (m.reentrant_mutex, lambda r, c: {"client": c}),
+        (lambda: FencedMutex(), fenced_val),
+        (lambda: ReentrantFencedMutex(), fenced_val),
+        (lambda: m.acquired_permits(2), lambda r, c: {"client": c}),
+    ]
+    ctor = {
+        "invoke": invoke_op, "ok": ok_op, "fail": fail_op, "info": info_op,
+    }
+    stats = {}
+    for trial in range(1800):
+        model_f, val_f = models_pool[trial % len(models_pool)]
+        n_procs = rng.choice([2, 3, 4])
+        n_clients = rng.choice([n_procs, n_procs, max(1, n_procs - 1)])
+        hist_ops, open_f = [], {}
+        for _ in range(rng.randrange(4, 22)):
+            p = rng.randrange(n_procs)
+            c = f"c{rng.randrange(n_clients)}"
+            if p in open_f:
+                kind = rng.choice(["ok", "ok", "info", "fail"])
+                f, v = open_f.pop(p)
+            else:
+                kind = "invoke"
+                f = rng.choice(["acquire", "release"])
+                v = val_f(rng, c)
+                open_f[p] = (f, v)
+            hist_ops.append(ctor[kind](p, f, v))
+        hist = h(*hist_ops)
+        model = model_f()
+        want = generic_search(model, hist)["valid?"]
+        got = locks_direct.analysis(model, hist)
+        key = type(model).__name__
+        a, t = stats.get(key, (0, 0))
+        if got is None or want == "unknown":
+            stats[key] = (a, t + 1)
+            continue
+        stats[key] = (a + 1, t + 1)
+        assert got["valid?"] == want, (trial, key, [o.to_dict() for o in hist])
+    # every model must have been answered a meaningful number of times
+    for key, (answered, total) in stats.items():
+        assert answered >= 20, (key, answered, total)
+
+
 def test_analysis_hook_routes_mutex():
     """linear.analysis must answer plain-mutex histories via the direct
     checker (same verdicts, never 'unknown') and still produce witness
