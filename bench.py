@@ -391,7 +391,14 @@ def _best_window(recs):
 
     def rank(rec):
         vsb = rec.get("vs_baseline")
-        return vsb if vsb is not None else (rec.get("value") or 0) / NORTH_STAR
+        if vsb is None:
+            vsb = (rec.get("value") or 0) / NORTH_STAR
+        # primary key stays the CONSERVATIVE number — the headline
+        # `value` must be the best recorded single-dispatch median, so
+        # ranking by anything else would break the docstring's
+        # consumer contract; carrying the pipelined pair only breaks
+        # ties between equal-conservative windows
+        return (vsb, 1 if rec.get("vs_baseline_pipelined") else 0)
 
     best = None
     for rec in recs:
@@ -409,7 +416,7 @@ def _headline_best(best, live_payload, reason, wrap_key):
     vsb = best.get("vs_baseline")
     if vsb is None:
         vsb = round((best.get("value") or 0.0) / NORTH_STAR, 4)
-    return {
+    out = {
         "metric": best.get("metric", live_payload["metric"]),
         "value": best["value"],
         "unit": best.get("unit", "histories/sec"),
@@ -418,6 +425,11 @@ def _headline_best(best, live_payload, reason, wrap_key):
         f"({best.get('captured_at')}); {reason}",
         wrap_key: live_payload,
     }
+    # the pipelined pair rides along whenever the chosen window has it
+    for k in ("value_pipelined", "vs_baseline_pipelined"):
+        if best.get(k) is not None:
+            out[k] = best[k]
+    return out
 
 
 def _windows_summary(recs):
