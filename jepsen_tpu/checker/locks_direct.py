@@ -401,6 +401,77 @@ def _permits_check_events(events: list, ops: list, n_permits: int) -> dict:
     return {"valid?": True, "op-count": len(ops), "algorithm": algo}
 
 
+def _queue_check_events(events: list, ops: list, init_counts) -> dict:
+    """Direct decision for UNORDERED-QUEUE histories.
+
+    The model factors per value: enqueues never block and dequeue(v)
+    only touches v's count, so constraints exist only WITHIN a value —
+    each completed dequeue of v needs its own enqueue of v linearized
+    before it (or an initial copy of v).  For a dequeue with deadline
+    ``do`` (its ok index) and an enqueue invoked at ``ei``, points
+    satisfying enq < deq exist iff ``ei < do``; distinct pairs share
+    no resource beyond the one-enqueue-per-dequeue injection, so
+    per-value validity is a bipartite matching under that threshold
+    condition — and because later dequeues have later deadlines,
+    greedy assignment in deadline order (consume ANY available
+    enqueue) is exact.  Crashed enqueues are placeable helpers
+    (window (ei, ∞)); crashed dequeues are optional and never consumed
+    (placing one only spends an enqueue).  Unlike the lock checkers
+    this needs no client-sequentiality gate: values, not clients, are
+    the unit of interaction, so every history shape is decidable."""
+    algo = "direct-unordered-queue"
+    comp_idx = {}
+    for idx, (kind, op_id) in enumerate(events):
+        if kind == OK:
+            comp_idx[op_id] = idx
+    enq_by_value: dict = {}
+    deqs = []  # (deadline, value, op_id) — completed dequeues only
+    for idx, (kind, op_id) in enumerate(events):
+        if kind != INVOKE:
+            continue
+        op = ops[op_id]
+        if op.f == "enqueue":
+            # completed or crashed: both may linearize (crashed ones at
+            # any point after invocation — knossos semantics)
+            enq_by_value.setdefault(op.value, []).append(idx)
+        elif op.f == "dequeue":
+            if op_id in comp_idx:
+                deqs.append((comp_idx[op_id], op.value, op_id))
+        else:
+            return {"valid?": None}
+
+    counts = dict(init_counts or {})
+    deqs.sort()
+    cursor: dict = {}  # per-value index of the next unconsumed enqueue
+    for deadline, v, op_id in deqs:
+        if v is None:
+            return {
+                "valid?": False,
+                "op": ops[op_id].to_dict(),
+                "error": "dequeue with unknown value",
+                "algorithm": algo,
+            }
+        if counts.get(v, 0) > 0:
+            counts[v] -= 1  # initial copies serve any dequeue
+            continue
+        pool = enq_by_value.get(v)
+        # any enqueue invoked before this dequeue's deadline works,
+        # and staying available for later (later-deadline) dequeues is
+        # automatic — consume the earliest-invoked, via a cursor so
+        # the matching stays O(n)
+        i = cursor.get(v, 0)
+        if pool and i < len(pool) and pool[i] < deadline:
+            cursor[v] = i + 1
+            continue
+        return {
+            "valid?": False,
+            "op": ops[op_id].to_dict(),
+            "error": f"dequeued {v!r} without a matching enqueue",
+            "algorithm": algo,
+        }
+    return {"valid?": True, "op-count": len(ops), "algorithm": algo}
+
+
 def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
     """Events-level entry point — the ONE place that owns which models
     the direct arguments cover: plain ``models.Mutex`` via greedy
@@ -436,6 +507,8 @@ def dispatch_events(model, events: list, ops: list) -> Optional[dict]:
         out = _reentrant_fenced_check_events(events, ops, model)
     elif type(model) is m.AcquiredPermits and not model.acquired:
         out = _permits_check_events(events, ops, model.n_permits)
+    elif type(model) is m.UnorderedQueue:
+        out = _queue_check_events(events, ops, dict(model.items))
     else:
         return None
     return None if out["valid?"] is None else out
@@ -453,6 +526,7 @@ def analysis(model, history: History) -> Optional[dict]:
         FencedMutex,
         ReentrantFencedMutex,
         m.AcquiredPermits,
+        m.UnorderedQueue,
     ):
         return None  # skip prepare() for models no argument covers
     events, ops = linear.prepare(history)

@@ -514,18 +514,32 @@ LINEAR_FRONTIER_SPECS = frozenset(
 )
 
 
+#: specs the CPU direct checker beats EVERY device kernel on, even
+#: inside the dense envelope: the unordered queue factors per value
+#: into a greedy bipartite matching (checker/locks_direct.py,
+#: _queue_check_events) measured at 34.8k h/s single-core on the
+#: queue-bench corpus vs the dense bitset kernel's 7.5k at B=1024 —
+#: 4.6x — and 204x the generic search.  Routing it off the device
+#: entirely is the measured choice.
+DIRECT_FIRST_SPECS = frozenset({"unordered-queue"})
+
+
 def kernel_choice(spec_name: str, C: int, n_values) -> str:
-    """Which engine check_batch routes this shape to — "dense" (subset
-    automaton, no sorts, no overflow), "oracle" (linear-frontier lock
-    family outside the dense envelope: the CPU search wins there, see
-    LINEAR_FRONTIER_SPECS), or "frontier" (generic compacted device
-    search).  ``n_values`` is the value-domain bound, or a (Vr, K)
-    pair for multi-register's composite automaton.  Callers report
-    this so a workload silently drifting outside the dense envelope
-    (e.g. "3n" concurrency pushing peak open ops past its slot cap) is
-    visible in stats rather than a mystery slowdown."""
+    """Which engine check_batch routes this shape to — "oracle" for
+    specs a CPU direct algorithm dominates outright
+    (DIRECT_FIRST_SPECS) or for the linear-frontier lock family
+    outside the dense envelope (LINEAR_FRONTIER_SPECS), "dense"
+    (subset automaton, no sorts, no overflow), or "frontier" (generic
+    compacted device search).  ``n_values`` is the value-domain bound,
+    or a (Vr, K) pair for multi-register's composite automaton.
+    Callers report this so a workload silently drifting between
+    engines (e.g. "3n" concurrency pushing peak open ops past the
+    dense slot cap) is visible in stats rather than a mystery
+    slowdown."""
     from . import dense as dense_mod
 
+    if spec_name in DIRECT_FIRST_SPECS:
+        return "oracle"
     if n_values is not None:
         V = (
             tuple(n_values)

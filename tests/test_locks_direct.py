@@ -650,6 +650,99 @@ def test_opsoup_differential_all_models():
         assert answered >= 20, (key, answered, total)
 
 
+def test_queue_golden():
+    good = h(
+        invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+    )
+    out = locks_direct.analysis(m.unordered_queue(), good)
+    assert out["valid?"] is True
+    assert out["algorithm"] == "direct-unordered-queue"
+    # dequeue completes before the matching enqueue is even invoked
+    early = h(
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 1),
+        invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+    )
+    assert locks_direct.analysis(m.unordered_queue(), early)["valid?"] is False
+    # concurrent: the enqueue's invocation precedes the dequeue's ok,
+    # so the points can interleave — valid
+    conc = h(
+        invoke_op(1, "dequeue"),
+        invoke_op(0, "enqueue", 1),
+        ok_op(1, "dequeue", 1),
+        ok_op(0, "enqueue", 1),
+    )
+    assert locks_direct.analysis(m.unordered_queue(), conc)["valid?"] is True
+    # two dequeues of one enqueue: non-unique counting must catch it
+    double = h(
+        invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 7),
+        invoke_op(2, "dequeue"), ok_op(2, "dequeue", 7),
+    )
+    assert locks_direct.analysis(m.unordered_queue(), double)["valid?"] is False
+    # ...but two enqueues of the same value serve both
+    twice = h(
+        invoke_op(0, "enqueue", 7), ok_op(0, "enqueue", 7),
+        invoke_op(3, "enqueue", 7), ok_op(3, "enqueue", 7),
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 7),
+        invoke_op(2, "dequeue"), ok_op(2, "dequeue", 7),
+    )
+    assert locks_direct.analysis(m.unordered_queue(), twice)["valid?"] is True
+    # a crashed enqueue may linearize and serve the dequeue
+    crashed = h(
+        invoke_op(0, "enqueue", 5), info_op(0, "enqueue", 5),
+        invoke_op(1, "dequeue"), ok_op(1, "dequeue", 5),
+    )
+    assert locks_direct.analysis(m.unordered_queue(), crashed)["valid?"] is True
+    # initial items serve dequeues with no enqueue at all
+    from jepsen_tpu.models import UnorderedQueue
+
+    seeded = UnorderedQueue(frozenset({(9, 1)}))
+    first = h(invoke_op(1, "dequeue"), ok_op(1, "dequeue", 9))
+    assert locks_direct.analysis(seeded, first)["valid?"] is True
+
+
+def test_queue_differential_fuzz_vs_generic_search():
+    """Queue histories with NON-unique values, crashes, and adversarial
+    interleavings vs the generic search."""
+    from jepsen_tpu.history import History
+
+    rng = random.Random(20260738)
+    answered = n_false = 0
+    for trial in range(600):
+        n_procs = rng.choice([2, 3, 4, 5])
+        n_values = rng.choice([2, 3, 6])
+        hist_ops, open_op = [], {}
+        for _ in range(rng.randrange(4, 26)):
+            p = rng.randrange(n_procs)
+            if p in open_op:
+                kind = rng.choice(["ok", "ok", "ok", "info", "fail"])
+                f, v = open_op.pop(p)
+                if f == "dequeue" and kind == "ok":
+                    v = rng.randrange(n_values)  # observed value
+                hist_ops.append(
+                    {"invoke": invoke_op, "ok": ok_op,
+                     "fail": fail_op, "info": info_op}[kind](p, f, v)
+                )
+            else:
+                if rng.random() < 0.55:
+                    f, v = "enqueue", rng.randrange(n_values)
+                else:
+                    f, v = "dequeue", None
+                open_op[p] = (f, v)
+                hist_ops.append(invoke_op(p, f, v))
+        hist = h(*hist_ops)
+        want = generic_search(m.unordered_queue(), hist)["valid?"]
+        got = locks_direct.analysis(m.unordered_queue(), hist)
+        if got is None or want == "unknown":
+            continue
+        answered += 1
+        assert got["valid?"] == want, (trial, [o.to_dict() for o in hist])
+        n_false += want is False
+    assert answered > 550
+    assert n_false > 100
+
+
 def test_analysis_hook_routes_mutex():
     """linear.analysis must answer plain-mutex histories via the direct
     checker (same verdicts, never 'unknown') and still produce witness
