@@ -598,6 +598,20 @@ class _Linearizable(Checker):
         def kernel():
             if not wgl.supported(self.model):
                 return None
+            from . import locks_direct
+
+            d = locks_direct.analysis(self.model, history)
+            if d is not None:
+                # models a direct polynomial checker covers decide in
+                # microseconds; a True verdict IS this arm's answer
+                # (nothing to witness), while a False CONCEDES so the
+                # oracle arm's witnessed report (final-paths for the
+                # failure renderer) wins the race — encoding a device
+                # batch either way would waste the arm
+                if d["valid?"] is True:
+                    d.setdefault("engine", "direct")
+                    return d
+                return None
             # oracle_fallback=False: unencodable/overflowing histories
             # come back "unknown" (conceding the race) instead of
             # silently duplicating the oracle arm's exponential search
