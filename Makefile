@@ -2,10 +2,19 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench docker-smoke docker-up docker-down
+.PHONY: test bench check trace-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
+
+# the full local gate: unit tests + the observability smoke check
+check: test trace-smoke
+
+# run the in-process CLI path with tracing on and fail unless the
+# store dir holds a valid Chrome trace + Prometheus dump with phase/op
+# spans and engine telemetry (doc/observability.md)
+trace-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.obs.smoke
 
 bench:
 	python bench.py

@@ -114,7 +114,7 @@ def run_bench(on_accelerator, warnings):
 
     from jepsen_tpu import models as m
     from jepsen_tpu import synth
-    from jepsen_tpu.ops import encode, wgl
+    from jepsen_tpu.ops import dense, encode, wgl
     from jepsen_tpu.parallel import mesh as mesh_mod
 
     mesh = None
@@ -324,19 +324,30 @@ def run_bench(on_accelerator, warnings):
         "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
         "kernel": wgl.kernel_choice("cas-register", C, vmax + 1),
-        "dense_union": os.environ.get("JEPSEN_TPU_DENSE_UNION", "unroll"),
+        # the resolved union-mode (dense._union_mode reads the env over
+        # dense.DEFAULT_UNION) — never re-hardcode the default here: a
+        # default flip in dense.py would silently mislabel windows
+        "dense_union": dense._union_mode(),
         "samples": samples,
     }
     return value, L, diag
 
 
+def _headline_config(diag) -> bool:
+    """BENCH_tpu_latest.json is the default-configuration artifact: a
+    window qualifies iff its dense-union lowering is dense.DEFAULT_UNION
+    (the one public default — never re-hardcoded here, so a default
+    flip in dense.py re-routes the headline with it)."""
+    from jepsen_tpu.ops import dense
+
+    return diag.get("dense_union", dense.DEFAULT_UNION) == dense.DEFAULT_UNION
+
+
 def _persist_artifact(payload, diag):
     record = {"captured_at": _utcnow(), **payload, "diag": diag}
-    # BENCH_tpu_latest.json is the default-configuration artifact; an
-    # alternate-lowering run (diag.dense_union != the unroll default)
-    # appends a labeled window below but must not take over the
-    # headline record
-    if diag.get("dense_union", "unroll") == "unroll":
+    # an alternate-lowering run appends a labeled window below but must
+    # not take over the headline record
+    if _headline_config(diag):
         try:
             with open(ARTIFACT, "w") as f:
                 json.dump(record, f)

@@ -74,11 +74,30 @@ def checker(fn: Callable) -> Checker:
     return FnChecker(fn, getattr(fn, "__name__", "fn"))
 
 
+def checker_name(chk: Checker) -> str:
+    """A human-readable name for spans/telemetry: the FnChecker's
+    function name, else the class name without its leading underscore."""
+    name = getattr(chk, "name", None)
+    if name:
+        return str(name)
+    return type(chk).__name__.lstrip("_")
+
+
 def check_safe(chk: Checker, test: dict, history: History, opts: Optional[dict] = None) -> dict:
     """Like check, but returns {"valid?": "unknown", "error": ...} on crash.
-    (reference: checker.clj:74-85)"""
+    (reference: checker.clj:74-85)
+
+    The universal checker seam (core.analyze and compose both funnel
+    through here), so each checker gets its own obs span."""
+    from .. import obs
+
     try:
-        result = chk.check(test, history, opts or {})
+        with obs.span(
+            f"checker/{checker_name(chk)}", cat="checker"
+        ) as sp:
+            result = chk.check(test, history, opts or {})
+            if isinstance(result, dict):
+                sp.set("valid", result.get("valid?"))
         return result if result is not None else {"valid?": True}
     except Exception:
         return {"valid?": UNKNOWN, "error": traceback.format_exc()}

@@ -402,6 +402,41 @@ def analysis(
     witness: bool = False,
     budget_s: Optional[float] = None,
 ) -> dict:
+    """Telemetry wrapper over :func:`_analysis_impl` (the documented
+    entry point — same signature, same result): each oracle run gets an
+    ``engine`` span plus counters/latency so runs report how much work
+    the CPU search absorbed (the fallback rate the device path's
+    throughput claims rest on)."""
+    from .. import obs
+
+    if not obs.enabled():
+        return _analysis_impl(
+            model, history, pure_fs, max_configs, witness, budget_s
+        )
+    with obs.span("engine/oracle", cat="engine") as sp:
+        r = _analysis_impl(
+            model, history, pure_fs, max_configs, witness, budget_s
+        )
+        sp.set("valid", r.get("valid?"))
+        sp.set("algorithm", r.get("algorithm", "search"))
+        sp.set("op-count", r.get("op-count", ""))
+    obs.observe("jepsen_oracle_seconds", sp.duration_s())
+    obs.count(
+        "jepsen_engine_analyses_total",
+        engine="oracle",
+        algorithm=str(r.get("algorithm", "search")),
+    )
+    return r
+
+
+def _analysis_impl(
+    model: Model,
+    history: History,
+    pure_fs: Iterable[Any] = (),
+    max_configs: int = DEFAULT_MAX_CONFIGS,
+    witness: bool = False,
+    budget_s: Optional[float] = None,
+) -> dict:
     """Check history against model. Returns
     {"valid?": True|False|"unknown", ...} with a witness :op on failure
     and sample :configs (truncated to 10, as the reference does at

@@ -54,6 +54,44 @@ def nemesis_regions(test: dict, history: History) -> List[svg.Region]:
     return regions
 
 
+#: run-phase overlay shade (deliberately fainter than nemesis bands —
+#: phases are context, faults are the story)
+PHASE_COLOR = "#4477aa"
+
+
+def phase_regions(test: dict, history: History) -> List[svg.Region]:
+    """Shaded bands for run lifecycle phases (jepsen_tpu.obs spans of
+    category "phase"), aligned with history time via the run anchor.
+    Only phases intersecting the plotted axis [0, last-op-time] appear:
+    setup/db phases straddling t=0 are clamped to it, and phases lying
+    entirely after the history (save-history, analyze — which hasn't
+    even finished when these graphs render) can't be drawn on this
+    axis at all; the full set lives in the exported trace.json."""
+    from .. import obs
+
+    intervals = obs.phase_intervals()
+    if not intervals or not len(history):
+        return []
+    end_time = nanos_to_secs(history[-1].time)
+    regions = []
+    for name, x0, x1 in intervals:
+        if x1 <= 0 or x0 >= end_time:
+            continue  # outside the plotted axis entirely
+        regions.append(
+            svg.Region(
+                max(x0, 0.0), min(x1, end_time),
+                color=PHASE_COLOR, opacity=0.05, label=str(name),
+            )
+        )
+    return regions
+
+
+def graph_regions(test: dict, history: History) -> List[svg.Region]:
+    """Nemesis bands + the obs phase overlay — what every perf graph
+    shades behind its series."""
+    return nemesis_regions(test, history) + phase_regions(test, history)
+
+
 def latencies_to_quantiles(
     dt: float, qs: Sequence[float], points: List[Tuple[float, float]]
 ) -> Dict[float, List[Tuple[float, float]]]:
@@ -114,7 +152,7 @@ def point_graph(test: dict, history: History, opts: dict) -> Optional[str]:
         title=f"{test.get('name', 'test')} latency (raw)",
         ylabel="Latency (ms)",
         log_y=True,
-        regions=nemesis_regions(test, history),
+        regions=graph_regions(test, history),
     )
 
 
@@ -141,7 +179,7 @@ def quantiles_graph(test: dict, history: History, opts: dict) -> Optional[str]:
         title=f"{test.get('name', 'test')} latency (quantiles)",
         ylabel="Latency (ms)",
         log_y=True,
-        regions=nemesis_regions(test, history),
+        regions=graph_regions(test, history),
     )
 
 
@@ -167,7 +205,7 @@ def rate_graph(test: dict, history: History, opts: dict) -> Optional[str]:
         series,
         title=f"{test.get('name', 'test')} rate",
         ylabel="Throughput (hz)",
-        regions=nemesis_regions(test, history),
+        regions=graph_regions(test, history),
     )
 
 
@@ -185,7 +223,7 @@ def scatter_plot(
         for k, pts in sorted(series_map.items(), key=lambda kv: str(kv[0]))
     ]
     regions = (
-        nemesis_regions(test, history) if history is not None and len(history) else []
+        graph_regions(test, history) if history is not None and len(history) else []
     )
     return svg.render(
         store_mod.path_(test, *path_components),

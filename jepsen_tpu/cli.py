@@ -107,6 +107,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
         "reference: dgraph --tracing URL)",
     )
     p.add_argument(
+        "--no-obs",
+        action="store_true",
+        help="disable the run-wide observability layer (phase/op/engine "
+        "spans + metrics, trace.json/metrics.prom store artifacts, the "
+        "post-run breakdown table; doc/observability.md).  Default on; "
+        "JEPSEN_TPU_OBS=0 disables it globally.",
+    )
+    p.add_argument(
         "--mesh",
         dest="mesh_sharding",  # "mesh" is the test-map key for the
         action="store_true",   # built Mesh object itself
@@ -140,6 +148,8 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
         test["concurrency"] = parse_concurrency(args.concurrency, len(nodes))
     if getattr(args, "tracing", None):
         test["tracing"] = args.tracing
+    if getattr(args, "no_obs", False):
+        test["obs?"] = False
     if getattr(args, "mesh_sharding", False):
         # build lazily at analyze time: probing the backend here would
         # hang a wedged tunnel before the test even starts, and the
@@ -199,6 +209,13 @@ def run_test(test: dict) -> int:
     # finishes probing
     ensure_usable_backend()
     result = core.run(test)
+    summary = result.get("obs-summary")
+    if summary:
+        # phase/engine breakdown (doc/observability.md); the same dict
+        # is durable under results.json → "obs"
+        from . import obs
+
+        print(obs.format_summary(summary))
     return _exit_code(result.get("results", {}))
 
 

@@ -174,7 +174,12 @@ def execute(*args, stdin: Optional[str] = None, check: bool = True):
         dir=_dyn("dir"),
         sudo_password=_dyn("sudo_password"),
     )
-    result = session.execute(command)
+    from .. import obs
+
+    with obs.span("control/exec", cat="control") as sp:
+        sp.set("node", current_node())
+        result = session.execute(command)
+    obs.observe("jepsen_control_exec_seconds", sp.duration_s())
     if check:
         throw_on_nonzero_exit(result)
     return result.out.strip()

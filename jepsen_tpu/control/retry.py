@@ -62,6 +62,12 @@ class RetryRemote(Remote):
             except Exception as e:
                 if attempt >= self.retries:
                     raise
+                from .. import obs
+
+                obs.count(
+                    "jepsen_remote_retries_total",
+                    error=type(e).__name__,
+                )
                 log.warning(
                     "remote op failed (%s); retrying %d/%d",
                     e,

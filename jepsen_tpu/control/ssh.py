@@ -140,13 +140,24 @@ class SSHRemote(Remote):
     # -- operations --------------------------------------------------------
 
     def execute(self, command: Command) -> Result:
+        import time as _time
+
+        from .. import obs
+
         cmd = wrap_sudo(command)
         stdin = effective_stdin(command)
+        t0 = _time.perf_counter()
         proc = subprocess.run(
             ["ssh"] + self._base_args() + [f"{self.username}@{self.node}", cmd],
             input=stdin.encode() if stdin else None,
             capture_output=True,
             timeout=600,
+        )
+        # transport-level latency (vs jepsen_control_exec_seconds at the
+        # session seam, which also covers dummy/docker/k8s remotes)
+        obs.observe(
+            "jepsen_ssh_exec_seconds", _time.perf_counter() - t0,
+            node=str(self.node),
         )
         return Result(
             cmd=cmd,

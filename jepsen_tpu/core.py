@@ -24,6 +24,7 @@ from . import checker as checker_mod
 from . import client as client_mod
 from . import interpreter
 from . import nemesis as nemesis_mod
+from . import obs
 from .history import History
 from .util import real_pmap, with_relative_time
 
@@ -78,23 +79,26 @@ def run_case(test: dict) -> History:
         return c
 
     try:
-        real_pmap(open_and_setup, test["nodes"])
-        return interpreter.run(test)
+        with obs.span("setup", cat="phase"):
+            real_pmap(open_and_setup, test["nodes"])
+        with obs.span("generator", cat="phase"):
+            return interpreter.run(test)
     finally:
-        try:
-            nemesis.teardown(test)
-        finally:
+        with obs.span("teardown", cat="phase"):
+            try:
+                nemesis.teardown(test)
+            finally:
 
-            def teardown_and_close(cn):
-                c, _node = cn
-                try:
-                    c.teardown(test)
-                finally:
-                    c.close(test)
+                def teardown_and_close(cn):
+                    c, _node = cn
+                    try:
+                        c.teardown(test)
+                    finally:
+                        c.close(test)
 
-            with clients_lock:
-                opened = list(clients)
-            real_pmap(teardown_and_close, opened)
+                with clients_lock:
+                    opened = list(clients)
+                real_pmap(teardown_and_close, opened)
 
 
 _snarf_lock = threading.Lock()
@@ -114,7 +118,7 @@ def snarf_logs(test: dict) -> None:
     if not isinstance(db, db_mod.LogFiles) or not test.get("store?", True):
         return
 
-    with _snarf_lock:
+    with _snarf_lock, obs.span("snarf-logs", cat="phase"):
         log.info("Snarfing log files")
 
         def snarf_node(test, node):
@@ -178,12 +182,13 @@ def maybe_snarf_logs(test: dict) -> None:
 def analyze(test: dict) -> dict:
     """Index the history, run checkers, attach results.
     (reference: core.clj:221-237)"""
-    history = test["history"]
-    if isinstance(history, History):
-        history.index_ops()
-    results = checker_mod.check_safe(
-        test["checker"], test, history, {}
-    )
+    with obs.span("analyze", cat="phase"):
+        history = test["history"]
+        if isinstance(history, History):
+            history.index_ops()
+        results = checker_mod.check_safe(
+            test["checker"], test, history, {}
+        )
     return {**test, "results": results}
 
 
@@ -212,6 +217,16 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
     storing = test.get("store?", True)
 
+    # observability (jepsen_tpu.obs): default on, per-test opt-out via
+    # obs? (the CLI's --no-obs / JEPSEN_TPU_OBS=0).  Each run resets
+    # the process-global tracer+registry so a prior in-process run's
+    # spans can't leak into this run's exports.
+    observing = bool(test.get("obs?", obs.default_enabled()))
+    if observing:
+        obs.enable(reset=True)
+    else:
+        obs.disable()
+
     # span tracing turns on for the run — not at test-build time, so
     # building several test maps can't cross-wire each other's
     # exporters through the process-global tracer — and off again
@@ -233,7 +248,20 @@ def run(test: dict) -> dict:
         with writer_ctx as test:
             if storing:
                 test = store_mod.save_0(test)
-            test = _run_body(test)
+            try:
+                test = _run_body(test)
+            except BaseException:
+                # abort path: the spans recorded up to the crash are the
+                # flight recorder's whole point — export them best-effort
+                # (like maybe_snarf_logs) without superseding the cause
+                if observing:
+                    try:
+                        _finish_obs(test, storing)
+                    except Exception:
+                        log.exception("obs export failed on abort")
+                raise
+            if observing:
+                test = _finish_obs(test, storing)
             if storing:
                 test = store_mod.save_2(test)
             return log_results(test)
@@ -242,6 +270,30 @@ def run(test: dict) -> dict:
             trace.tracing()
         if storing:
             store_mod.stop_logging(test)
+
+
+def _finish_obs(test: dict, storing: bool) -> dict:
+    """Distill the run's spans+metrics: summary dict into
+    ``results["obs"]`` (durable via save_2) and ``test["obs-summary"]``
+    (for the CLI breakdown table), artifact files (Chrome trace,
+    span JSONL, Prometheus dump) into the store directory."""
+    from . import store as store_mod
+
+    summary = obs.summary()
+    results = test.get("results")
+    if isinstance(results, dict):
+        test = {**test, "results": {**results, "obs": summary}}
+    test = {**test, "obs-summary": summary}
+    if storing:
+        try:
+            paths = obs.export_all(store_mod.test_dir(test))
+            log.info("Wrote trace artifacts: %s", sorted(paths.values()))
+        except Exception:
+            # telemetry must never fail a run that already has results
+            # (any export error — full disk, a serialization surprise —
+            # would otherwise abort before save_2 writes results.json)
+            log.exception("obs export failed")
+    return test
 
 
 def _run_body(test: dict) -> dict:
@@ -255,16 +307,22 @@ def _run_body(test: dict) -> dict:
     control_ctx = _control_context(test)
     with control_ctx:
         if os_ is not None:
-            _on_nodes(test, lambda node: os_.setup(test, node))
+            with obs.span("os-setup", cat="phase"):
+                _on_nodes(test, lambda node: os_.setup(test, node))
         if db is not None:
-            db_mod.cycle(test)
+            with obs.span("db-start", cat="phase"):
+                db_mod.cycle(test)
         try:
             try:
                 with with_relative_time():
+                    # anchor span timestamps to the history's t=0 so
+                    # exports/overlays can align them with op times
+                    obs.set_run_anchor()
                     history = run_case(test)
                 test = {**test, "history": history}
                 if storing:
-                    test = store_mod.save_1(test)
+                    with obs.span("save-history", cat="phase"):
+                        test = store_mod.save_1(test)
                 result = analyze(test)
             except BaseException:
                 # abort path, before DB teardown deletes the logs; must
@@ -279,7 +337,8 @@ def _run_body(test: dict) -> dict:
             return result
         finally:
             if db is not None and not test.get("leave-db-running?"):
-                _on_nodes(test, lambda node: db.teardown(test, node))
+                with obs.span("db-teardown", cat="phase"):
+                    _on_nodes(test, lambda node: db.teardown(test, node))
 
 
 def _control_context(test: dict):

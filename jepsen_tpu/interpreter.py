@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from . import client as client_mod
 from . import generator as gen
+from . import obs
 from .history import History, NEMESIS, Op
 from .util import relative_time_nanos
 
@@ -100,6 +101,11 @@ class _WorkerThread:
 
     def _run(self):
         test, out, worker = self.test, self.out, self.worker
+        # captured once per worker thread: with tracing disabled the
+        # hot loop below pays exactly this one pre-paid branch per op
+        # (no span objects, no counter lookups — tests/test_obs.py
+        # asserts zero records allocated)
+        tracing = obs.enabled()
         try:
             while True:
                 op = self.inq.get()
@@ -117,11 +123,28 @@ class _WorkerThread:
 
                         logging.getLogger("jepsen_tpu").info(op.get("value"))
                         out.put(op)
+                    elif tracing:
+                        with obs.span(
+                            f"op/{op.get('f')}", cat="op"
+                        ) as sp:
+                            res = worker.invoke(test, op)
+                            sp.set("worker", self.id)
+                            # guard non-dict results: telemetry must
+                            # not change how a buggy client fails
+                            t_res = (
+                                res.get("type")
+                                if isinstance(res, dict) else "?"
+                            )
+                            sp.set("type", t_res)
+                        obs.count_op(t_res)
+                        out.put(res)
                     else:
                         out.put(worker.invoke(test, op))
                 except Exception as e:
                     # worker crash ⇒ indeterminate op
                     # (reference: interpreter.clj:142-157)
+                    if tracing:
+                        obs.count_op("info")
                     out.put(
                         {
                             **op,

@@ -57,11 +57,20 @@ class Validate(Nemesis):
         return Validate(inner)
 
     def invoke(self, test, op):
-        res = self.nemesis.invoke(test, op)
+        # every nemesis in a run passes through validate (core.run_case),
+        # so this one seam gives fault start/stop spans to all of them
+        from .. import obs
+
+        with obs.span(f"nemesis/{op.get('f')}", cat="nemesis") as sp:
+            res = self.nemesis.invoke(test, op)
+            sp.set("type", res.get("type") if isinstance(res, dict) else "?")
         if not isinstance(res, dict):
             raise ValidationError(
                 f"Nemesis {self.nemesis!r} returned {res!r} for {op!r}"
             )
+        # counted only for valid completions — an invalid result raises
+        # above and must not inflate the completed-fault count
+        obs.count("jepsen_nemesis_ops_total", f=str(op.get("f")))
         return res
 
     def teardown(self, test):

@@ -1,0 +1,189 @@
+"""Run-wide observability: spans + metrics threaded through every layer.
+
+The answer to "where did this 10-minute run spend its time, and which
+checker engine actually ran?".  One process-global :class:`Tracer`
+(jepsen_tpu.obs.tracer) and :class:`MetricsRegistry`
+(jepsen_tpu.obs.metrics) are fed by hooks at every seam:
+
+- ``core.run`` phases (setup / db-start / generator / teardown /
+  snarf-logs / analyze) — category ``phase``
+- ``interpreter`` worker op invokes + ok/info/fail counters — ``op``
+- ``nemesis`` fault invokes — ``nemesis``
+- ``control`` command latency + transport retries — ``control``
+- per-checker spans (``check_safe``) — ``checker``
+- engine telemetry in ``ops/wgl.py`` / ``ops/dense.py`` /
+  ``checker/linear.py``: routed engine, compile-vs-execute timings,
+  batch shape, frontier high-water mark, dispatch budget — ``engine``
+
+Exports (jepsen_tpu.obs.export) land in the store directory:
+``trace.json`` (Chrome trace_event), ``trace-spans.jsonl``,
+``metrics.prom`` (Prometheus text); a summary dict is embedded in
+``results["obs"]`` and printed by the CLI as a breakdown table.
+
+Everything is stdlib-only.  Default ON; disable with the
+``JEPSEN_TPU_OBS=0`` environment variable, the ``--no-obs`` CLI flag,
+or ``test["obs?"] = False``.  Disabled hooks cost one branch — span()
+returns a shared null context with no allocation, and counters check
+the flag before taking their lock (see ``tests/test_obs.py``'s
+no-allocation guard).
+
+Distinct from :mod:`jepsen_tpu.trace` (the reference-parity
+per-client span exporter wired by ``--tracing``): obs is the
+harness's own flight recorder; trace.py mirrors dgraph's opencensus
+client tracing.  They compose — both can be on.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import export as export_mod
+from .metrics import MetricsRegistry
+from .tracer import NULL_SPAN, SpanRecord, Tracer  # noqa: F401 (re-export)
+
+
+def default_enabled() -> bool:
+    """The environment default: on unless JEPSEN_TPU_OBS is falsy."""
+    return os.environ.get("JEPSEN_TPU_OBS", "1").lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+_tracer = Tracer(enabled=default_enabled())
+_registry = MetricsRegistry(enabled=default_enabled())
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def enable(reset: bool = False) -> None:
+    if reset:
+        _tracer.reset()
+        _registry.reset()
+    _tracer.enabled = True
+    _registry.enabled = True
+
+
+def disable() -> None:
+    _tracer.enabled = False
+    _registry.enabled = False
+
+
+def reset() -> None:
+    _tracer.reset()
+    _registry.reset()
+
+
+# -- span + metric shorthands (the instrumentation surface) -----------------
+
+
+def span(name: str, cat: str = "", **attrs):
+    """Context manager for one span; shared null context when disabled
+    (one branch, zero allocation — safe in hot loops)."""
+    if not _tracer.enabled:
+        return NULL_SPAN
+    return _tracer.span(name, cat, attrs or None)
+
+
+def count(name: str, n: int = 1, **labels) -> None:
+    if not _registry.enabled:
+        return
+    _registry.counter(name, **labels).inc(n)
+
+
+def gauge_set(name: str, v: float, **labels) -> None:
+    if not _registry.enabled:
+        return
+    _registry.gauge(name, **labels).set(v)
+
+
+def gauge_max(name: str, v: float, **labels) -> None:
+    if not _registry.enabled:
+        return
+    _registry.gauge(name, **labels).set_max(v)
+
+
+def observe(name: str, v: float, **labels) -> None:
+    if not _registry.enabled:
+        return
+    _registry.histogram(name, **labels).observe(v)
+
+
+def count_op(completion_type) -> None:
+    """Interpreter hot-loop counter: one branch when disabled."""
+    if not _registry.enabled:
+        return
+    _registry.counter(
+        "jepsen_interpreter_ops_total", type=str(completion_type)
+    ).inc()
+
+
+# -- run anchoring ----------------------------------------------------------
+
+
+def set_run_anchor() -> None:
+    """Record the monotonic instant of the run's t=0 (call inside
+    ``util.with_relative_time``) so exports can align span times with
+    history op times."""
+    if not _tracer.enabled:
+        return
+    import time as _t
+
+    from ..util import relative_time_nanos
+
+    try:
+        _tracer.run_anchor_ns = _t.monotonic_ns() - relative_time_nanos()
+    except RuntimeError:
+        _tracer.run_anchor_ns = None
+
+
+def run_anchor_ns() -> Optional[int]:
+    return _tracer.run_anchor_ns
+
+
+def phase_intervals() -> list:
+    """Completed lifecycle phases as ``(name, start_s, end_s)`` relative
+    to the run anchor (history time axis); empty when no anchor was set
+    or tracing is off.  Used by checker.perf's phase overlay."""
+    if not _tracer.enabled:
+        # disable() doesn't clear the buffer/anchor — without this
+        # check an obs-off run following an obs-on run in the same
+        # process would overlay the PREVIOUS run's stale phases
+        return []
+    anchor = _tracer.run_anchor_ns
+    if anchor is None:
+        return []
+    out = []
+    for rec in _tracer.finished(cat="phase"):
+        if rec.t1 is None:
+            continue
+        out.append(
+            (rec.name, (rec.t0 - anchor) / 1e9, (rec.t1 - anchor) / 1e9)
+        )
+    out.sort(key=lambda t: t[1])
+    return out
+
+
+# -- exports ----------------------------------------------------------------
+
+
+def export_all(directory: str) -> dict:
+    return export_mod.export_all(_tracer, _registry, directory)
+
+
+def summary() -> dict:
+    return export_mod.summary(_tracer, _registry)
+
+
+def format_summary(s: Optional[dict] = None) -> str:
+    return export_mod.format_summary(s if s is not None else summary())

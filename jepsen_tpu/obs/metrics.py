@@ -1,0 +1,250 @@
+"""Dependency-free metrics: counters, gauges, fixed-bucket histograms.
+
+The registry is the numeric half of the observability layer
+(jepsen_tpu.obs): spans answer "where did the time go", these answer
+"how many / how big".  Everything is plain Python + threading — no
+prometheus_client, no opentelemetry — because the harness must run in
+the bare jax_graft container.  The export format IS the Prometheus
+text exposition format (rendered by :func:`MetricsRegistry.prometheus_text`),
+so a real scrape endpoint or push gateway could consume the dump
+unchanged.
+
+Instruments are keyed by (name, sorted label items): the registry
+interns one instrument per key, so hot paths can resolve once and call
+``inc``/``observe`` repeatedly — but only WITHIN one run:
+``MetricsRegistry.reset()`` (invoked via ``obs.enable(reset=True)`` at
+every ``core.run`` start) discards the intern table, so a handle cached
+across runs mutates an orphan no export will ever see.  Resolve per
+run (or per worker loop), never at module import.  Every mutator takes
+the instrument lock — increments are a few hundred ns, far below the
+op latencies they count — and checks the shared enabled flag first, so
+a disabled registry costs one attribute read + branch per call.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Default histogram bucket upper bounds, in seconds — spans the range
+#: from a sub-ms kernel execute to a multi-minute compile/SSH install.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    __slots__ = ("name", "labels", "_lock", "_registry")
+
+    def __init__(self, registry: "MetricsRegistry", name: str, labels: LabelKey):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._registry = registry
+
+
+class Counter(_Instrument):
+    __slots__ = ("value",)
+
+    def __init__(self, registry, name, labels):
+        super().__init__(registry, name, labels)
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self.value += n
+
+
+class Gauge(_Instrument):
+    __slots__ = ("value",)
+
+    def __init__(self, registry, name, labels):
+        super().__init__(registry, name, labels)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            self.value = v
+
+    def set_max(self, v: float) -> None:
+        """Record a high-water mark: keep the larger of current/new."""
+        if not self._registry.enabled:
+            return
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
+
+class Histogram(_Instrument):
+    """Fixed-boundary histogram: per-bucket counts + sum + count.
+    Buckets are cumulative at render time (Prometheus ``le`` semantics);
+    internally each slot counts only its own interval so ``observe`` is
+    one bisect + three increments."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, registry, name, labels,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, labels)
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +inf overflow slot
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        if not self._registry.enabled:
+            return
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def cumulative(self) -> List[int]:
+        """Per-``le`` cumulative counts (the Prometheus rendering)."""
+        out, acc = [], 0
+        with self._lock:
+            for c in self.counts:
+                acc += c
+                out.append(acc)
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide instrument registry with Prometheus text export."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._instruments: Dict[Tuple[str, str, LabelKey], _Instrument] = {}
+
+    def _get(self, kind: str, cls, name: str, labels: Dict[str, str],
+             **kw) -> _Instrument:
+        key = (kind, name, _label_key(labels))
+        inst = self._instruments.get(key)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.get(key)
+                if inst is None:
+                    inst = cls(self, name, key[2], **kw)
+                    self._instruments[key] = inst
+        return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, labels)
+
+    def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        return self._get("histogram", Histogram, name, labels,
+                         buckets=buckets)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        """All instruments as plain dicts (stable name/label order) —
+        the source for both the Prometheus dump and the run summary."""
+        with self._lock:
+            items = sorted(self._instruments.items())
+        out = []
+        for (kind, name, labels), inst in items:
+            d = {"kind": kind, "name": name, "labels": dict(labels)}
+            if kind == "histogram":
+                # one lock acquisition for counts+sum+count: reading
+                # them separately could interleave with a concurrent
+                # observe and render a +Inf bucket SMALLER than the
+                # last le bucket (invalid Prometheus exposition)
+                with inst._lock:
+                    counts = list(inst.counts)
+                    d["sum"] = inst.sum
+                    d["count"] = inst.count
+                cum, acc = [], 0
+                for c in counts:
+                    acc += c
+                    cum.append(acc)
+                d["buckets"] = list(zip(inst.buckets, cum))
+            else:
+                d["value"] = inst.value
+            out.append(d)
+        return out
+
+    def value(self, name: str, **labels) -> Optional[float]:
+        """Read one counter/gauge value (None when never recorded)."""
+        for kind in ("counter", "gauge"):
+            inst = self._instruments.get((kind, name, _label_key(labels)))
+            if inst is not None:
+                return inst.value
+        return None
+
+    def prometheus_text(self) -> str:
+        """The Prometheus text exposition format (one TYPE line per
+        metric family, samples with sorted labels)."""
+        lines: List[str] = []
+        seen_type: set = set()
+        for d in self.snapshot():
+            name, kind = d["name"], d["kind"]
+            if name not in seen_type:
+                lines.append(f"# TYPE {name} {kind}")
+                seen_type.add(name)
+            base_labels = d["labels"]
+            if kind == "histogram":
+                cum = d["buckets"]
+                for le, c in cum:
+                    lines.append(
+                        _sample(name + "_bucket",
+                                {**base_labels, "le": _fmt_le(le)}, c)
+                    )
+                lines.append(
+                    _sample(name + "_bucket",
+                            {**base_labels, "le": "+Inf"}, d["count"])
+                )
+                lines.append(_sample(name + "_sum", base_labels, d["sum"]))
+                lines.append(_sample(name + "_count", base_labels, d["count"]))
+            else:
+                lines.append(_sample(name, base_labels, d["value"]))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt_le(v: float) -> str:
+    s = repr(float(v))
+    return s[:-2] if s.endswith(".0") else s
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _sample(name: str, labels: Dict[str, str], value) -> str:
+    if labels:
+        body = ",".join(
+            f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{body}}} {_fmt_num(value)}"
+    return f"{name} {_fmt_num(value)}"
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return repr(f) if f != int(f) else str(int(f))

@@ -1,0 +1,113 @@
+"""Trace smoke check: ``python -m jepsen_tpu.obs.smoke``.
+
+Runs the in-process CLI path (the localkv-style dummy-remote run:
+``test --workload linearizable-register --dummy``) with observability
+on, then fails loudly unless the store directory holds a VALID Chrome
+``trace_event`` JSON, span JSONL, and Prometheus dump, the trace
+carries the expected phase + op spans, and the results embed the obs
+summary with a linearizability engine.  Wired into ``make
+trace-smoke`` / ``make check`` so a refactor that silently stops
+exporting telemetry breaks CI, not a debugging session three rounds
+later.
+
+Exit codes: 0 ok, 1 artifact missing/malformed, 2 the run itself
+failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main(argv=None) -> int:
+    from .. import cli, store
+    from . import export as export_mod
+
+    workload = "linearizable-register"
+    base = os.path.join(
+        tempfile.mkdtemp(prefix="jepsen-trace-smoke-"), "store"
+    )
+    code = cli.run_cli(
+        cli.default_commands(),
+        [
+            "test",
+            "--workload", workload,
+            "--dummy",
+            "--nodes", "n1",
+            "--concurrency", "2n",
+            "--time-limit", "1",
+            "--store-base", base,
+        ],
+    )
+    if code != cli.EXIT_VALID:
+        print(f"trace-smoke: CLI run failed (exit {code})", file=sys.stderr)
+        return 2
+
+    runs = store.tests(base).get(workload, [])
+    if not runs:
+        print("trace-smoke: no stored run found", file=sys.stderr)
+        return 1
+    d = os.path.join(base, workload, runs[-1])
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    trace_path = os.path.join(d, export_mod.TRACE_JSON)
+    prom_path = os.path.join(d, export_mod.METRICS_PROM)
+    spans_path = os.path.join(d, export_mod.SPANS_JSONL)
+    for p in (trace_path, prom_path, spans_path):
+        check(os.path.exists(p), f"missing artifact {os.path.basename(p)}")
+
+    if os.path.exists(trace_path):
+        err = export_mod.validate_chrome_trace(trace_path)
+        check(err is None, f"trace.json invalid: {err}")
+        with open(trace_path) as f:
+            events = json.load(f).get("traceEvents", [])
+        cats = {e.get("cat") for e in events}
+        names = {e.get("name") for e in events}
+        check("phase" in cats, f"no phase spans in trace (cats={cats})")
+        check("op" in cats, "no op spans in trace")
+        check("generator" in names, "generator phase span missing")
+        check("analyze" in names, "analyze phase span missing")
+
+    if os.path.exists(prom_path):
+        err = export_mod.validate_prometheus(prom_path)
+        check(err is None, f"metrics.prom invalid: {err}")
+        text = open(prom_path).read()
+        check(
+            "jepsen_interpreter_ops_total" in text,
+            "op counters missing from metrics.prom",
+        )
+        check(
+            "jepsen_engine_rows_total" in text,
+            "engine telemetry missing from metrics.prom",
+        )
+
+    with open(os.path.join(d, "results.json")) as f:
+        results = json.load(f)
+    obs_summary = results.get("obs")
+    check(isinstance(obs_summary, dict), "results.json lacks obs summary")
+    if isinstance(obs_summary, dict):
+        check(bool(obs_summary.get("phases")), "summary has no phases")
+        check(
+            bool(obs_summary.get("engines")),
+            "summary names no checker engine",
+        )
+
+    if failures:
+        for f_ in failures:
+            print(f"trace-smoke: FAIL — {f_}", file=sys.stderr)
+        print(f"trace-smoke: artifacts under {d}", file=sys.stderr)
+        return 1
+    print(f"trace-smoke: ok ({d})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -248,10 +248,17 @@ def _or_select(x, wb: int):
 #: gather 13,451 h/s — the gather lowering dominated the closure cost
 #: exactly as the roofline model predicted (benchmarks/RESULTS.md,
 #: dense-kernel roofline; BENCH_tpu_windows.jsonl rows 18:15/18:17Z).
+#: the default subset-union lowering — the ONE definition every
+#: consumer (kernel build, bench diag reporting, headline-artifact
+#: gating) reads, so a future default flip can't silently mislabel
+#: bench windows or misroute the headline artifact
+DEFAULT_UNION = "unroll"
+
+
 def _union_mode() -> str:
     import os
 
-    return os.environ.get("JEPSEN_TPU_DENSE_UNION", "unroll")
+    return os.environ.get("JEPSEN_TPU_DENSE_UNION", DEFAULT_UNION)
 
 
 def _subset_has(C: int):
@@ -681,7 +688,21 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
         V = 0
     # the union-map mode is part of the cache key: flipping
     # JEPSEN_TPU_DENSE_UNION must rebuild, not hit the old lowering
-    return _make_dense_fn_cached(spec_name, E, C, V, _union_mode())
+    union = _union_mode()
+    fn = _make_dense_fn_cached(spec_name, E, C, V, union)
+    from . import wgl as wgl_mod
+
+    if wgl_mod.count_kernel_build(fn):
+        # engine telemetry: a fresh build means a new (shape, lowering)
+        # variant — the jit trace + XLA compile lands on its first
+        # dispatch (wgl._timed_run_chunked records it as compile time)
+        from .. import obs
+
+        obs.count(
+            "jepsen_kernel_builds_total", engine="dense", union=union,
+            spec=spec_name,
+        )
+    return fn
 
 
 @lru_cache(maxsize=64)

@@ -28,6 +28,7 @@ check-safe (checker.clj:74-85).
 
 from __future__ import annotations
 
+import threading
 from functools import lru_cache, partial
 from typing import List, Optional, Sequence
 
@@ -39,6 +40,7 @@ from jax import lax
 
 from ..history import History
 from .. import models as m
+from .. import obs
 from . import encode as encode_mod
 from .step_kernels import ModelSpec, spec_for
 
@@ -170,21 +172,21 @@ def _compact_hash(states, words, valid, F, n_old):
     earlier new lane, transitively): grew == False is an exact
     certificate that the closure reached its fixpoint, even though
     dedup itself is best-effort (a missed duplicate only makes grew
-    True spuriously — one wasted iteration, never a wrong verdict)."""
+    True spuriously — one wasted iteration, never a wrong verdict).
+
+    Compaction goes through :func:`_rank_gather` — the ONE code path
+    every mode compacts through, so the "same survivor order across
+    lowerings" invariant lives in one place (it used to carry an
+    inline scatter copy of the prefix-sum compaction; equivalence with
+    that lowering is pinned by a regression test).  This makes "hash"
+    and "gather" the same lowering — both names stay accepted by the
+    A/B env switch."""
     K = states.shape[0]
     v2 = _probe_dedup(states, words, valid)
     lane = jnp.arange(K, dtype=jnp.int32)
     grew = (v2 & (lane >= n_old)).any()
-    prefix = jnp.cumsum(v2.astype(jnp.int32))
-    count = prefix[-1]
-    dst = jnp.where(v2, prefix - 1, F)  # F = out of bounds ⇒ dropped
-    out_states = jnp.zeros((F,), jnp.int32).at[dst].set(states, mode="drop")
-    out_words = tuple(
-        jnp.zeros((F,), jnp.uint32).at[dst].set(wd, mode="drop")
-        for wd in words
-    )
-    out_valid = jnp.arange(F, dtype=jnp.int32) < count
-    return out_states, out_words, out_valid, grew, count > F
+    out_states, out_words, out_valid, ovf = _rank_gather(states, words, v2, F)
+    return out_states, out_words, out_valid, grew, ovf
 
 
 def _rank_gather(states, words, v2, F):
@@ -211,21 +213,11 @@ def _rank_gather(states, words, v2, F):
     )
 
 
-def _compact_gather(states, words, valid, F, n_old):
-    """The hash-probe dedup of ``_compact_hash`` (shared via
-    ``_probe_dedup``) with the scatter compaction replaced by the
-    rank-matrix gather (``_rank_gather``).  Same survivors, same order,
-    same certificates — a pure lowering change, A/B-able against
-    "hash" with bit-identical verdicts.  The probe tables keep their
-    scatter-min (there is no cheap gather-only equivalent of a hash
-    table build), so this mode halves, not eliminates, the scatter
-    traffic per closure iteration."""
-    K = states.shape[0]
-    v2 = _probe_dedup(states, words, valid)
-    lane = jnp.arange(K, dtype=jnp.int32)
-    grew = (v2 & (lane >= n_old)).any()
-    out_states, out_words, out_valid, ovf = _rank_gather(states, words, v2, F)
-    return out_states, out_words, out_valid, grew, ovf
+#: "gather" was the hash-probe dedup with the scatter compaction
+#: replaced by the rank-matrix gather; since _compact_hash itself now
+#: compacts through _rank_gather the two modes are the SAME lowering —
+#: the name stays accepted so pinned A/B configs keep working
+_compact_gather = _compact_hash
 
 
 #: [K, K] equality matrices get big; cap the per-dispatch rows so the
@@ -471,7 +463,13 @@ def make_check_fn(
     through default_compaction() at call time."""
     if compaction is None:
         compaction = default_compaction()
-    return _make_check_fn(spec_name, E, C, F, max_closure, compaction)
+    fn = _make_check_fn(spec_name, E, C, F, max_closure, compaction)
+    if count_kernel_build(fn):
+        obs.count(
+            "jepsen_kernel_builds_total", engine="frontier",
+            compaction=compaction, spec=spec_name,
+        )
+    return fn
 
 
 @lru_cache(maxsize=64)
@@ -489,6 +487,56 @@ def _make_check_fn(spec_name, E, C, F, max_closure, compaction):
 
 
 make_check_fn.cache_clear = _make_check_fn.cache_clear
+
+
+_claim_lock = threading.Lock()
+
+
+def _claim_once(fn, attr: str) -> bool:
+    """Atomically claim a once-per-object flag on a compiled fn: True
+    for exactly ONE caller across threads.  Parallel checkers (compose
+    → real_pmap) share cached fns, so unlocked getattr-then-setattr
+    would let two threads both claim (double-counted compiles/builds);
+    an unmarkable fn type returns False — skip rather than recount."""
+    with _claim_lock:
+        if getattr(fn, attr, False):
+            return False
+        try:
+            setattr(fn, attr, True)
+        except AttributeError:
+            return False
+        return True
+
+
+def count_kernel_build(fn) -> bool:
+    """True exactly once per compiled-fn object (shared by the dense
+    and frontier build sites): the cache returns one object per live
+    variant, so marking the object counts distinct builds without the
+    cache_info().misses before/after race that parallel checkers
+    could double- or under-count."""
+    return _claim_once(fn, "_obs_build_counted")
+
+
+def _claim_shape(fn, shape) -> bool:
+    """Atomically claim first-dispatch of ``fn`` at a batch shape; jit
+    retraces per shape, so this — not a per-fn flag — is what separates
+    compile-phase from execute-phase dispatches."""
+    with _claim_lock:
+        shapes = getattr(fn, "_obs_dispatched_shapes", None)
+        if shapes is None:
+            try:
+                shapes = fn._obs_dispatched_shapes = set()
+            except AttributeError:
+                return False  # unmarkable fn type: never claim
+        if shape in shapes:
+            return False
+        shapes.add(shape)
+        return True
+
+
+def _shape_dispatched(fn, shape) -> bool:
+    shapes = getattr(fn, "_obs_dispatched_shapes", None)
+    return shapes is not None and shape in shapes
 
 
 #: single-lock model family whose frontier grows linearly in C — one
@@ -564,10 +612,21 @@ def make_best_check_fn(
     """Pick the fastest kernel for the shape: the dense subset-automaton
     (ops.dense — no sorts, no overflow) when the model's value domain and
     concurrency fit its envelope, else the generic frontier kernel.
-    ``n_values`` is the exclusive upper bound on value ids (init/a/b)."""
+    ``n_values`` is the exclusive upper bound on value ids (init/a/b).
+
+    Returns ``None`` when :func:`kernel_choice` routes the shape to
+    "oracle" (a CPU direct algorithm dominates, or a dense-only spec
+    sits outside its envelope) — mirroring check_batch, which sends
+    those batches down the oracle path with no device dispatch.
+    Callers MUST check for None; handing back a compiled frontier fn
+    here would silently give them the engine the routing decided
+    against."""
     from . import dense as dense_mod
 
-    if kernel_choice(spec_name, C, n_values) == "dense":
+    choice = kernel_choice(spec_name, C, n_values)
+    if choice == "oracle":
+        return None
+    if choice == "dense":
         V = (
             tuple(n_values)
             if isinstance(n_values, (tuple, list))
@@ -662,6 +721,14 @@ DEFAULT_MAX_DISPATCH = 16384
 #: unaffected (B=16384 runs clean).
 FRONTIER_DISPATCH_BUDGET = 4_000_000
 
+#: budget for callers that pass NO candidate-slot count (C=0): the
+#: frontier-only accounting can't see the F·(C+1) closure expansion, so
+#: it keeps the previously pinned-safe 1M-word bound — at the cas
+#: calibration shape (F=64, E≈2000) that caps shapeless dispatches at
+#: ~248 rows, at-or-under the measured-safe B=256 (B=512 killed the
+#: worker), where the 4M budget would have allowed ~992
+FRONTIER_ONLY_DISPATCH_BUDGET = 1_000_000
+
 
 def value_domain(spec_name: str, init_state, cand_a, cand_b) -> int:
     """Exclusive upper bound of the kernel state/value-id domain for a
@@ -691,17 +758,32 @@ def frontier_max_dispatch(
     frontier itself: budgeting on F alone under-counted ~17× at
     C=16/F=256 and reproducibly crashed the axon TPU worker
     (2026-07-31 18:40Z sweep, frontier_results_tpu.json error rows).
-    C=0 (unknown) keeps the old frontier-only accounting for callers
-    that size conservatively themselves.  Chunked dispatch reuses one
+    C=0 (unknown) keeps the old frontier-only accounting — against the
+    tighter FRONTIER_ONLY_DISPATCH_BUDGET, so a shapeless caller stays
+    at-or-under the previously measured-safe caps instead of getting
+    the expansion-aware budget without the (C+1) expansion factor.
+    Chunked dispatch reuses one
     executable, so a smaller cap costs extra dispatches, not extra
     compiles.  Returns 0 when even a single row exceeds the budget —
     callers must NOT dispatch that shape (check_batch skips the
     escalation rung; the oracle takes the rows instead)."""
     words = max(1, -(-E // 32))
-    per_row = F * (C + 1) * words
-    if per_row > FRONTIER_DISPATCH_BUDGET:
+    if C <= 0:
+        per_row = F * words
+        budget = FRONTIER_ONLY_DISPATCH_BUDGET
+    else:
+        per_row = F * (C + 1) * words
+        budget = FRONTIER_DISPATCH_BUDGET
+    if per_row > budget:
         return 0
-    return max(1, min(max_dispatch, FRONTIER_DISPATCH_BUDGET // per_row))
+    return max(1, min(max_dispatch, budget // per_row))
+
+
+#: per-array pad fill for chunked dispatch — ev_slot/cand_slot use -1
+#: as "padding", the same convention sharded_check pads with; shared by
+#: _run_chunked and the telemetry head/tail split so both pad tails to
+#: the same chunk shape (one executable, never a per-tail-size compile)
+_PAD_FILLS = (0, -1, -1, 0, 0, 0)
 
 
 def _run_chunked(fn, mesh, arrays, max_batch=DEFAULT_MAX_DISPATCH):
@@ -715,9 +797,7 @@ def _run_chunked(fn, mesh, arrays, max_batch=DEFAULT_MAX_DISPATCH):
         return _run_rows(fn, mesh, arrays)
     from ..parallel import mesh as mesh_mod
 
-    #: per-array pad fill — ev_slot/cand_slot use -1 as "padding", the
-    #: same convention sharded_check pads with
-    fills = (0, -1, -1, 0, 0, 0)
+    fills = _PAD_FILLS
     outs = []
     for lo in range(0, B, max_batch):
         hi = min(lo + max_batch, B)
@@ -735,6 +815,76 @@ def _run_chunked(fn, mesh, arrays, max_batch=DEFAULT_MAX_DISPATCH):
     return tuple(
         np.concatenate([np.asarray(o[i]) for o in outs]) for i in range(3)
     )
+
+
+def _timed_run_chunked(fn, mesh, arrays, disp, engine):
+    """:func:`_run_chunked` with engine telemetry: one ``engine`` span
+    per dispatch call, wall time split into *compile* (the first
+    dispatch of this compiled fn — trace + XLA compile + execute) vs
+    *execute* (every later dispatch, cache-hit).  The timed region
+    forces host materialization so async dispatch can't under-report;
+    check_batch materializes the outputs immediately after anyway, so
+    this moves the sync point rather than adding one."""
+    B = arrays[0].shape[0]
+    # jit retraces PER INPUT SHAPE, not per fn: the dispatch shape is B
+    # itself below the cap, else the disp-row chunk size (tails pad to
+    # it) — so first-dispatch tracking must key on (fn, shape) or a
+    # later new-batch-size compile would be mislabeled "execute"
+    disp_shape = B if B <= disp else disp
+    if not obs.enabled():
+        # still claim first-dispatch: the kernel compiles now either
+        # way, and a later obs-ON run hitting the fn cache must record
+        # its cache-hit dispatch as execute, not a phantom compile
+        _claim_shape(fn, disp_shape)
+        return _run_chunked(fn, mesh, arrays, disp)
+    if B > disp and not _shape_dispatched(fn, disp):
+        # only the FIRST disp-row chunk traces+compiles; timing the
+        # whole chunked call as "compile" would absorb every
+        # steady-state dispatch after it and inflate the split the
+        # metric exists to report.  The head chunk is full-size, so it
+        # dispatches the same executable the chunked tail reuses —
+        # and a short tail is padded to the SAME disp-row shape
+        # (_PAD_FILLS, like _run_chunked's own tail) so the split
+        # never mints a second per-tail-size executable.  (Peek
+        # without claiming: the head recursion claims the compile
+        # slot atomically below.)
+        from ..parallel import mesh as mesh_mod
+
+        n_tail = B - disp
+        head = _timed_run_chunked(
+            fn, mesh, tuple(a[:disp] for a in arrays), disp, engine
+        )
+        tail_arrays = tuple(
+            mesh_mod.pad_to_multiple(np.asarray(a[disp:]), disp, fill)
+            for a, fill in zip(arrays, _PAD_FILLS)
+        )
+        tail = _timed_run_chunked(fn, mesh, tail_arrays, disp, engine)
+        return tuple(
+            np.concatenate([np.asarray(h), np.asarray(t)[:n_tail]])
+            for h, t in zip(head, tail)
+        )
+    # claim-before-dispatch under the lock: concurrent checkers
+    # (compose → real_pmap) sharing one cached fn must record exactly
+    # ONE compile-phase dispatch per shape, the rest execute
+    first = _claim_shape(fn, disp_shape)
+    phase = "compile" if first else "execute"
+    with obs.span(
+        "engine/dispatch", cat="engine",
+        engine=engine, rows=B, phase=phase,
+    ) as sp:
+        out = tuple(
+            np.asarray(x) for x in _run_chunked(fn, mesh, arrays, disp)
+        )
+    obs.observe(f"jepsen_kernel_{phase}_seconds", sp.duration_s(),
+                engine=engine)
+    # per device DISPATCH, not per call: one chunked call issues
+    # ceil(B/disp) dispatches and the metric is documented as the
+    # dispatch count
+    obs.count(
+        "jepsen_kernel_dispatches_total", max(1, -(-B // disp)),
+        engine=engine, phase=phase,
+    )
+    return out
 
 
 def check_batch(
@@ -842,6 +992,34 @@ def check_batch(
             0 if fn is None
             else min(max_dispatch, getattr(fn, "safe_dispatch", max_dispatch))
         )
+        if obs.enabled():
+            B0 = arrays[0].shape[0]
+            # a batch only counts as device traffic when a kernel will
+            # actually dispatch: fn=None (dense-only spec forced onto
+            # the absent frontier path) or disp=0 (even one row would
+            # bust the budget) both send every row to the oracle, and
+            # the routing counter must say so — no phantom frontier
+            # metrics for dispatches that never happen
+            routed = kernel if fn is not None and disp > 0 else "oracle"
+            obs.count(
+                "jepsen_engine_routed_total", engine=routed, spec=spec.name
+            )
+            obs.count("jepsen_engine_batch_rows_total", B0, engine=routed)
+            if routed == "frontier":
+                # TPU-specific telemetry: frontier capacity high-water
+                # and how much of the crash-calibrated dispatch budget
+                # (FRONTIER_DISPATCH_BUDGET words) one dispatch uses
+                words = max(1, -(-E // 32))
+                per_row = frontier * (C + 1) * words
+                obs.gauge_max("jepsen_frontier_high_water", frontier)
+                obs.gauge_set("jepsen_frontier_safe_dispatch", disp)
+                # high-water, not last-write: the run summary must show
+                # the PEAK budget use, not whichever batch came last
+                obs.gauge_max(
+                    "jepsen_frontier_dispatch_budget_used_ratio",
+                    per_row * min(B0, disp)
+                    / max(FRONTIER_DISPATCH_BUDGET, 1),
+                )
         if disp == 0:
             # no dispatchable kernel (a dense-only spec outside its
             # envelope) or even one row would crash the worker: the
@@ -855,7 +1033,7 @@ def check_batch(
             # and the escalation pass writes back into these
             ok, failed_at, overflow = (
                 np.array(x)
-                for x in _run_chunked(fn, mesh, arrays, disp)
+                for x in _timed_run_chunked(fn, mesh, arrays, disp, kernel)
             )
 
         # dense-only specs have no frontier kernel, so no escalation
@@ -909,9 +1087,15 @@ def check_batch(
                 # a single row at this capacity would bust the safe
                 # footprint: skip the rung, leave the rows overflowed
                 continue
+            obs.gauge_max("jepsen_frontier_high_water", capacity)
+            obs.count(
+                "jepsen_engine_escalations_total", n_bad,
+                capacity=str(capacity),
+            )
             ok2, failed2, ovf2 = (
                 np.asarray(x)[:n_bad]
-                for x in _run_chunked(fn2, mesh, sub, disp2)
+                for x in _timed_run_chunked(fn2, mesh, sub, disp2,
+                                            "frontier-escalated")
             )
             ok[bad] = ok2
             failed_at[bad] = failed2
@@ -965,6 +1149,18 @@ def check_batch(
             budget_s=oracle_budget_s,
         )
         results[hist_idx]["engine"] = "oracle-fallback"
+
+    if obs.enabled() and results:
+        # per-subhistory engine outcomes (the observable half of
+        # P-compositional tuning): tpu rows count under their kernel
+        # name, everything else under its engine tag
+        stats = batch_stats([r for r in results if r is not None])
+        for eng, n in stats["engines"].items():
+            if eng == "tpu":
+                continue
+            obs.count("jepsen_engine_rows_total", n, engine=eng)
+        for k, n in stats["kernels"].items():
+            obs.count("jepsen_engine_rows_total", n, engine=k)
 
     return results  # type: ignore[return-value]
 

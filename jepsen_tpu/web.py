@@ -47,7 +47,15 @@ def test_row(base: str, name: str, t: str) -> dict:
             valid = (loaded.get("results") or {}).get("valid?", "unknown")
     except (OSError, ValueError):
         valid = "unknown"
-    return {"name": name, "time": t, "valid": valid, "dir": d}
+    return {
+        "name": name,
+        "time": t,
+        "valid": valid,
+        "dir": d,
+        # per-run trace artifact (jepsen_tpu.obs export): linked from
+        # the home table when the run recorded one
+        "trace": os.path.exists(os.path.join(d, "trace.json")),
+    }
 
 
 def _valid_class(v: Any) -> str:
@@ -66,17 +74,27 @@ def home_page(base: str) -> str:
     rows.sort(key=lambda r: r["time"], reverse=True)
     body = [
         "<h1>Tests</h1>",
-        "<table><tr><th>name</th><th>time</th><th>valid?</th><th></th></tr>",
+        "<table><tr><th>name</th><th>time</th><th>valid?</th>"
+        "<th></th><th></th></tr>",
     ]
     for r in rows:
         link = urllib.parse.quote(f"/files/{r['name']}/{r['time']}/")
         zlink = urllib.parse.quote(f"/zip/{r['name']}/{r['time']}")
+        tlink = urllib.parse.quote(
+            f"/files/{r['name']}/{r['time']}/trace.json"
+        )
+        trace_cell = (
+            f'<td><a href="{tlink}">trace</a></td>'
+            if r.get("trace")
+            else "<td></td>"
+        )
         body.append(
             f'<tr class="{_valid_class(r["valid"])}">'
             f'<td><a href="{link}">{html.escape(r["name"])}</a></td>'
             f'<td><a href="{link}">{html.escape(r["time"])}</a></td>'
             f"<td>{html.escape(str(r['valid']))}</td>"
-            f'<td><a href="{zlink}">zip</a></td></tr>'
+            f'<td><a href="{zlink}">zip</a></td>'
+            f"{trace_cell}</tr>"
         )
     body.append("</table>")
     return _page("Jepsen-TPU", "\n".join(body))
@@ -122,7 +140,7 @@ def zip_bytes(d: str) -> bytes:
 CONTENT_TYPES = {
     ".html": "text/html", ".svg": "image/svg+xml", ".json": "application/json",
     ".txt": "text/plain", ".log": "text/plain", ".jsonl": "text/plain",
-    ".edn": "text/plain",
+    ".edn": "text/plain", ".prom": "text/plain",
 }
 
 

@@ -771,3 +771,115 @@ def test_lock_models_frontier_kernel_matches_oracle():
         assert stats["device-rate"] == 1.0, stats
         assert stats["kernels"].get("frontier", 0) > 0, stats
         assert True in oracle and False in oracle
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r5 regressions
+# ---------------------------------------------------------------------------
+
+
+def test_frontier_dispatch_cap_c0_keeps_frontier_only_budget():
+    """A shapeless (C=0) caller can't see the F·(C+1) closure
+    expansion, so it must stay under the PREVIOUSLY pinned-safe 1M
+    frontier-only budget — not get the expansion-aware 4M budget
+    without the expansion factor (4x looser: ~992 rows at the cas
+    calibration shape, where B=512 was measured to kill the worker)."""
+    assert wgl.FRONTIER_ONLY_DISPATCH_BUDGET == 1_000_000
+    words = -(-2000 // 32)
+    cap = wgl.frontier_max_dispatch(64, 2000)
+    assert cap == min(
+        wgl.DEFAULT_MAX_DISPATCH,
+        wgl.FRONTIER_ONLY_DISPATCH_BUDGET // (64 * words),
+    )
+    # at-or-under the measured-safe B=256 (B=512 killed the worker)
+    assert cap <= 256
+    # C-aware callers keep the expansion-aware budget
+    assert wgl.frontier_max_dispatch(64, 2000, C=8) == min(
+        wgl.DEFAULT_MAX_DISPATCH,
+        wgl.FRONTIER_DISPATCH_BUDGET // (64 * 9 * words),
+    )
+    # a single over-budget row still reports 0 under the C=0 accounting
+    assert wgl.frontier_max_dispatch(10**5, 10**6) == 0
+
+
+def test_compact_hash_compacts_through_rank_gather():
+    """The hash compaction's survivors/order/certificates must match
+    the legacy inline prefix-sum scatter lowering it replaced (the
+    "same survivor order across lowerings" invariant now lives only in
+    _rank_gather).  Invalid output slots may differ — scatter left
+    zeros, the rank gather leaves clamped garbage — but masks gate
+    every downstream read, so equivalence is over the VALID slots plus
+    the grew/overflow certificates."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    # one code path: "gather" is the same lowering by construction
+    assert wgl._COMPACTIONS["gather"] is wgl._COMPACTIONS["hash"]
+
+    def legacy_scatter(states, words, valid, F, n_old):
+        K = states.shape[0]
+        v2 = wgl._probe_dedup(states, words, valid)
+        lane = jnp.arange(K, dtype=jnp.int32)
+        grew = (v2 & (lane >= n_old)).any()
+        prefix = jnp.cumsum(v2.astype(jnp.int32))
+        count = prefix[-1]
+        dst = jnp.where(v2, prefix - 1, F)
+        out_states = (
+            jnp.zeros((F,), jnp.int32).at[dst].set(states, mode="drop")
+        )
+        out_words = tuple(
+            jnp.zeros((F,), jnp.uint32).at[dst].set(wd, mode="drop")
+            for wd in words
+        )
+        out_valid = jnp.arange(F, dtype=jnp.int32) < count
+        return out_states, out_words, out_valid, grew, count > F
+
+    rng = np.random.default_rng(45102)
+    for case in range(20):
+        K, F, W = 48, 12, 2
+        states = jnp.asarray(rng.integers(0, 5, size=K).astype(np.int32))
+        words = tuple(
+            jnp.asarray(rng.integers(0, 3, size=K).astype(np.uint32))
+            for _ in range(W)
+        )
+        valid = jnp.asarray(rng.random(K) < 0.85)
+        n_old = 16
+        s_a, w_a, v_a, g_a, o_a = wgl._compact_hash(
+            states, words, valid, F, n_old
+        )
+        s_b, w_b, v_b, g_b, o_b = legacy_scatter(
+            states, words, valid, F, n_old
+        )
+        mask = np.asarray(v_a)
+        assert np.array_equal(mask, np.asarray(v_b)), case
+        assert bool(g_a) == bool(g_b) and bool(o_a) == bool(o_b), case
+        assert np.array_equal(
+            np.asarray(s_a)[mask], np.asarray(s_b)[mask]
+        ), case
+        for wa, wb in zip(w_a, w_b):
+            assert np.array_equal(
+                np.asarray(wa)[mask], np.asarray(wb)[mask]
+            ), case
+
+
+def test_make_best_check_fn_returns_none_for_oracle_routed():
+    """make_best_check_fn must mirror check_batch's routing: when
+    kernel_choice says "oracle" (direct-first specs, or the
+    linear-frontier lock family outside the dense envelope) it returns
+    None instead of silently handing back a compiled frontier fn the
+    routing decided against."""
+    # mutex at C=14: outside the dense envelope, linear-frontier family
+    assert wgl.kernel_choice("mutex", 14, 2) == "oracle"
+    assert wgl.make_best_check_fn("mutex", 64, 14, 64, 15,
+                                  n_values=2) is None
+    # unordered-queue: direct-first — the oracle wins even in-envelope
+    assert wgl.kernel_choice("unordered-queue", 4, 8) == "oracle"
+    assert wgl.make_best_check_fn("unordered-queue", 64, 4, 64, 5,
+                                  n_values=8) is None
+    # in-envelope mutex still gets the dense automaton
+    assert wgl.make_best_check_fn("mutex", 64, 8, 64, 9,
+                                  n_values=2) is not None
+    # a genuine frontier shape still gets the frontier fn with its cap
+    fn = wgl.make_best_check_fn("cas-register", 64, 13, 64, 14,
+                                n_values=500)
+    assert fn is not None and hasattr(fn, "safe_dispatch")
