@@ -2,19 +2,27 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check trace-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check trace-smoke pipeline-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
-# the full local gate: unit tests + the observability smoke check
-check: test trace-smoke
+# the full local gate: unit tests + the observability and pipeline
+# smoke checks
+check: test trace-smoke pipeline-smoke
 
 # run the in-process CLI path with tracing on and fail unless the
 # store dir holds a valid Chrome trace + Prometheus dump with phase/op
 # spans and engine telemetry (doc/observability.md)
 trace-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.obs.smoke
+
+# mixed-length batch through the pipelined checker engine at window
+# sizes 1 (serial-equivalent) and 4, both kernel routes; fails on
+# verdict divergence or missing pipeline metrics
+# (doc/checker-engines.md "engine pipeline")
+pipeline-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.engine.smoke
 
 bench:
 	python bench.py

@@ -138,6 +138,14 @@ def run_bench(on_accelerator, warnings):
     REPS = int(os.environ.get("JEPSEN_TPU_BENCH_REPS", defaults["REPS"]))
     SLOT_CAP = int(os.environ.get("JEPSEN_TPU_BENCH_SLOTS", 16))
     FRONTIER = int(os.environ.get("JEPSEN_TPU_BENCH_FRONTIER", 64))
+    # the pipelined measurement's in-flight bound: the engine default
+    # (what production check_batch runs) unless explicitly overridden
+    from jepsen_tpu.engine import default_window
+
+    WINDOW = (
+        int(os.environ.get("JEPSEN_TPU_BENCH_WINDOW", 0))
+        or default_window()
+    )
 
     rng = np.random.default_rng(45100)
 
@@ -269,19 +277,24 @@ def run_bench(on_accelerator, warnings):
             rep_hps.append(B / (time.perf_counter() - t0))
         if not rep_hps:  # REPS=0: compile/consistency-check-only run
             rep_hps = [0.0]
-        # Pipelined aggregate: the same REPS dispatches queued
-        # back-to-back with ONE sync at the end — the dispatch pattern
-        # production uses (wgl._run_chunked keeps chunk outputs on
-        # device and materializes once), so this is the steady-state
-        # number a large keyspace actually gets; the per-rep timings
-        # above each pay a full dispatch-sync bubble.
+        # Pipelined aggregate: the same REPS dispatches pushed through
+        # the production engine's bounded DispatchWindow
+        # (jepsen_tpu.engine — the very object check_batch routes its
+        # bucket chunks through), retiring the oldest dispatch only
+        # when the window fills — so this number measures the code
+        # users actually run, not a hand-rolled simulation; the per-rep
+        # timings above each pay a full dispatch-sync bubble.
         hps_pipelined = None
         if REPS >= 2:
+            from jepsen_tpu.engine import DispatchWindow
+
+            win = DispatchWindow(WINDOW)
             t0 = time.perf_counter()
-            oks = [dispatch(rep + 1)[0] for rep in range(REPS)]
-            # the clock includes the host materialization production
-            # pays (_run_chunked's final np.concatenate of np.asarray)
-            oks = [np.asarray(ok) for ok in oks]
+            for rep in range(REPS):
+                win.submit(rep, lambda rep=rep: dispatch(rep + 1)[0])
+            # drain = the host materialization production pays
+            # (DispatchWindow retires via np.asarray), on the clock
+            win.drain()
             hps_pipelined = round(
                 REPS * B / (time.perf_counter() - t0), 2
             )
@@ -320,6 +333,7 @@ def run_bench(on_accelerator, warnings):
         "reps": REPS,
         "n_devices": n_devices,
         "overflow_unknown": headline["overflow_unknown"],
+        "engine_window": WINDOW,
         "encode_fallback": n_fallback,
         "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
@@ -479,8 +493,9 @@ def main():
         }
         # conservative headline = median single-dispatch rep (each rep
         # pays a full dispatch-sync bubble); the pipelined aggregate —
-        # back-to-back dispatches, one sync, the pattern
-        # wgl._run_chunked actually uses on large keyspaces — rides
+        # dispatches through the production engine's bounded in-flight
+        # window (jepsen_tpu.engine.DispatchWindow, the same object
+        # check_batch routes its bucket chunks through) — rides
         # along at the top level so both numbers are first-class
         pipelined = (diag.get("samples") or [{}])[0].get("hps_pipelined")
         if pipelined:

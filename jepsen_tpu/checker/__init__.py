@@ -720,8 +720,12 @@ class _Linearizable(Checker):
         elif algorithm == "tpu":
             from ..ops import wgl
 
+            # routes through the pipelined engine (jepsen_tpu.engine):
+            # test["engine-window"] (the CLI's --engine-window) bounds
+            # its in-flight device dispatches; None takes the default
             a = wgl.analysis(
-                self.model, history, oracle_budget_s=self.oracle_budget_s
+                self.model, history, oracle_budget_s=self.oracle_budget_s,
+                window=(test or {}).get("engine-window"),
             )
         else:
             a = self._oracle_analysis(history)

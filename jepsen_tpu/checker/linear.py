@@ -34,6 +34,7 @@ oracle and the fallback when no accelerator is present.
 
 from __future__ import annotations
 
+import threading as _threading
 from typing import Any, Dict, FrozenSet, Iterable, Optional, Set, Tuple
 
 from ..history import History, INVOKE, OK, FAIL, INFO, Op
@@ -392,6 +393,69 @@ def _search_fast(
         "configs": sample_configs(configs),
         "op-count": len(ops),
     }
+
+
+#: worker-pool width for concurrent oracle searches
+#: (``JEPSEN_TPU_ORACLE_WORKERS`` overrides).  The searches are pure
+#: Python, so threads trade GIL slices among themselves — the win the
+#: pipelined engine buys is overlap with DEVICE wall time (the kernel
+#: computes while the interpreter grinds the fallback searches), which
+#: needs only that the searches run concurrently with dispatch, not
+#: that they parallelize each other.
+DEFAULT_ORACLE_WORKERS = 4
+
+# the guard must pre-exist the first caller: creating it lazily would
+# itself race (two first callers, two locks, two leaked executors)
+_pool_lock = _threading.Lock()
+_pool = None
+
+
+def oracle_workers() -> int:
+    import os
+
+    try:
+        return max(
+            1,
+            int(os.environ.get("JEPSEN_TPU_ORACLE_WORKERS",
+                               DEFAULT_ORACLE_WORKERS)),
+        )
+    except ValueError:
+        return DEFAULT_ORACLE_WORKERS
+
+
+def oracle_pool():
+    """The shared bounded worker pool for oracle fallback searches —
+    one per process, sized by :func:`oracle_workers`.  The pipelined
+    engine (jepsen_tpu.engine.pipeline) submits fallback analyses here
+    so ``_search_fast`` runs concurrently with in-flight device
+    dispatches instead of after the last one settles."""
+    import concurrent.futures
+
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=oracle_workers(),
+                thread_name_prefix="jepsen-oracle",
+            )
+        return _pool
+
+
+def analysis_async(
+    model: Model,
+    history: History,
+    pure_fs: Iterable[Any] = (),
+    max_configs: int = DEFAULT_MAX_CONFIGS,
+    witness: bool = False,
+    budget_s: Optional[float] = None,
+):
+    """:func:`analysis` submitted to the shared oracle worker pool;
+    returns a ``concurrent.futures.Future``.  Safe because the search
+    is a pure function of its arguments (interned states and memos are
+    all call-local) and the obs hooks are thread-aware."""
+    return oracle_pool().submit(
+        analysis, model, history, pure_fs, max_configs, witness, budget_s
+    )
 
 
 def analysis(

@@ -39,6 +39,19 @@ def parse_concurrency(s: str, node_count: int) -> int:
     return int(s)
 
 
+def _engine_window_arg(s: str) -> int:
+    """--engine-window validator: ≥ 1 (1 IS the serial mode; a 0
+    "disable" would otherwise be silently dropped by truthiness and
+    run the default window instead — worse than an error)."""
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(
+            "must be >= 1 (1 = strictly serial; pipelining has no "
+            "setting below serial)"
+        )
+    return v
+
+
 def parse_nodes(args: argparse.Namespace) -> List[str]:
     """--nodes a,b,c / repeated --node / --nodes-file, last wins per
     source precedence (file > node > nodes).  (reference: cli.clj:68-84)"""
@@ -122,6 +135,16 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
         "device (jax.sharding.Mesh on the history axis); single-device "
         "runs are unaffected",
     )
+    p.add_argument(
+        "--engine-window",
+        type=_engine_window_arg,
+        help="max in-flight device dispatches in the pipelined checker "
+        "engine (jepsen_tpu.engine; doc/checker-engines.md).  1 = "
+        "strictly serial dispatch-sync-dispatch (there is no value "
+        "below serial, so 0 is rejected, not a disable switch); "
+        "default 4 (JEPSEN_TPU_ENGINE_WINDOW).  Verdicts never depend "
+        "on it.",
+    )
 
 
 def test_opts_to_map(args: argparse.Namespace) -> dict:
@@ -150,6 +173,13 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
         test["tracing"] = args.tracing
     if getattr(args, "no_obs", False):
         test["obs?"] = False
+    if getattr(args, "engine_window", None) is not None:
+        # consumed by the linearizability checkers (checker.linearizable,
+        # independent.batched_linearizable) on their way into
+        # wgl.check_batch(window=...); run_test additionally exports it
+        # for the run's duration so DispatchWindows with no test-map
+        # access (the Elle cycle screen) honor the same bound
+        test["engine-window"] = args.engine_window
     if getattr(args, "mesh_sharding", False):
         # build lazily at analyze time: probing the backend here would
         # hang a wedged tunnel before the test even starts, and the
@@ -200,6 +230,8 @@ def given_opts(args: argparse.Namespace) -> dict:
 
 def run_test(test: dict) -> int:
     """Run one prepared test map; returns its exit code."""
+    import os
+
     from . import core
     from .platform import ensure_usable_backend
 
@@ -208,7 +240,22 @@ def run_test(test: dict) -> int:
     # and racing threads could reach a dispatch before any of them
     # finishes probing
     ensure_usable_backend()
-    result = core.run(test)
+    # scope the engine window to THIS run: dispatch windows without
+    # test-map access (the Elle cycle screen) resolve the env default,
+    # and --engine-window 1 must mean nothing in the run pipelines —
+    # but a later run in the same process must not inherit it
+    window = test.get("engine-window")
+    prior = os.environ.get("JEPSEN_TPU_ENGINE_WINDOW")
+    if window is not None:
+        os.environ["JEPSEN_TPU_ENGINE_WINDOW"] = str(window)
+    try:
+        result = core.run(test)
+    finally:
+        if window is not None:
+            if prior is None:
+                os.environ.pop("JEPSEN_TPU_ENGINE_WINDOW", None)
+            else:
+                os.environ["JEPSEN_TPU_ENGINE_WINDOW"] = prior
     summary = result.get("obs-summary")
     if summary:
         # phase/engine breakdown (doc/observability.md); the same dict

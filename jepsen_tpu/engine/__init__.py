@@ -1,0 +1,34 @@
+"""Pipelined checker engine: the one production path from histories to
+verdicts.
+
+``wgl.check_batch`` / ``wgl.analysis`` (and everything above them —
+``checker.linearizable``, ``independent.batched_linearizable``) route
+through :mod:`jepsen_tpu.engine.pipeline`, which overlaps the three
+stages the serial path used to run back-to-back:
+
+1. **host encode** — each history encodes (ops/encode.py) and lands in
+   a per-padded-(E, C)-shape bucket, so short histories stop paying the
+   longest history's padding;
+2. **device dispatch** — bucket chunks dispatch asynchronously through
+   a bounded :class:`~jepsen_tpu.engine.pipeline.DispatchWindow` (encode
+   chunk *k+1* while chunk *k* computes; sync only when the window
+   fills);
+3. **oracle fallback** — unencodable/overflowed histories run
+   ``checker.linear`` searches on a worker pool *concurrently* with
+   device work instead of after it.
+
+Verdicts are independent of the window size and bucketing — window=1
+is exactly the historical serial dispatch-sync-dispatch path (pinned
+by ``tests/test_engine.py`` and ``make pipeline-smoke``).  Pipeline
+occupancy, bubble time, in-flight depth, and bucket counts report
+through the ``obs`` metrics registry (doc/observability.md).
+"""
+
+from .pipeline import (  # noqa: F401
+    DEFAULT_FLUSH_ROWS,
+    DEFAULT_WINDOW,
+    DispatchWindow,
+    default_bucketed,
+    default_window,
+    run,
+)

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import math
 from functools import lru_cache
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -26,7 +26,15 @@ def _bucket(n: int) -> int:
     return max(16, 1 << (n - 1).bit_length())
 
 
-@lru_cache(maxsize=None)
+#: compiled-closure cache bound: buckets are powers of two ≥ 16
+#: (2^4, 2^5, …), so 32 distinct entries cover every size to 2^35
+#: vertices — far past anything dispatchable — while adversarial size
+#: streams (one graph per power of two, forever) can no longer leak
+#: compiled executables without limit the way ``maxsize=None`` did
+CLOSURE_CACHE_SIZE = 32
+
+
+@lru_cache(maxsize=CLOSURE_CACHE_SIZE)
 def _closure_fn(n: int):
     rounds = max(1, math.ceil(math.log2(n)))
 
@@ -46,25 +54,43 @@ def _closure_fn(n: int):
     return has_cycle
 
 
-def has_cycle_batch(mats: Sequence[np.ndarray]) -> np.ndarray:
+def has_cycle_batch(
+    mats: Sequence[np.ndarray], window: Optional[int] = None
+) -> np.ndarray:
     """Which of these adjacency matrices contain a cycle?  Matrices are
-    bucketed by padded size so one compile covers many shapes."""
+    bucketed by padded size so one compile covers many shapes, and the
+    per-bucket dispatches ride the engine's bounded
+    :class:`~jepsen_tpu.engine.pipeline.DispatchWindow`: bucket *k+1*
+    packs on the host while bucket *k*'s closure computes, syncing only
+    when the window fills (``window=None`` takes the engine default;
+    1 = the old strictly serial dispatch-sync loop)."""
+    from ..engine import DispatchWindow
+
     out = np.zeros(len(mats), dtype=bool)
     by_bucket: dict = {}
     for i, m in enumerate(mats):
         by_bucket.setdefault(_bucket(m.shape[0]), []).append(i)
+
+    def settle(idxs, verdicts, _t):
+        for row, i in enumerate(idxs):
+            out[i] = bool(verdicts[row])
+
+    win = DispatchWindow(window, on_retire=settle)
     for n, idxs in by_bucket.items():
         batch = np.zeros((len(idxs), n, n), dtype=bool)
         for row, i in enumerate(idxs):
             m = mats[i]
             batch[row, : m.shape[0], : m.shape[1]] = m
-        verdicts = np.asarray(_closure_fn(n)(jnp.asarray(batch)))
-        for row, i in enumerate(idxs):
-            out[i] = bool(verdicts[row])
+        win.submit(
+            tuple(idxs),
+            lambda n=n, batch=batch: _closure_fn(n)(jnp.asarray(batch)),
+            attrs={"engine": "elle-screen", "rows": len(idxs)},
+        )
+    win.drain()
     return out
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=CLOSURE_CACHE_SIZE)
 def _reach_fn(n: int):
     rounds = max(1, math.ceil(math.log2(n)))
 

@@ -692,6 +692,14 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
     fn = _make_dense_fn_cached(spec_name, E, C, V, union)
     from . import wgl as wgl_mod
 
+    if not hasattr(fn, "safe_dispatch"):
+        # dense kernels are overflow-free with no crash-calibrated
+        # footprint ceiling (B=16384 runs clean, wgl.py calibration
+        # notes), so they carry the full default cap — every dispatch
+        # site (check_batch, the pipelined engine) reads ONE
+        # ``fn.safe_dispatch`` attribute instead of special-casing
+        # engines
+        fn.safe_dispatch = wgl_mod.DEFAULT_MAX_DISPATCH
     if wgl_mod.count_kernel_build(fn):
         # engine telemetry: a fresh build means a new (shape, lowering)
         # variant — the jit trace + XLA compile lands on its first

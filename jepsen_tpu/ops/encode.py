@@ -260,45 +260,63 @@ def round_up(n: int, multiple: int = 64) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def batch_encode(
-    histories: Sequence[History],
-    model: m.Model,
-    slot_cap: int = DEFAULT_SLOT_CAP,
-    event_bucket: int = 64,
-) -> EncodedBatch:
-    """Encode histories into one padded batch; unencodable ones land in
-    ``fallback`` for the CPU oracle."""
-    spec = spec_for(model)
-    encoded: List[EncodedHistory] = []
-    rows: List[int] = []
-    fallback: List[int] = []
-    for i, h in enumerate(histories):
-        e = encode_history(h, model, slot_cap, spec) if spec else None
-        if e is None:
-            fallback.append(i)
-        else:
-            encoded.append(e)
-            rows.append(i)
+def bucket_key(
+    e: EncodedHistory, slot_cap: int, event_bucket: int = 64
+) -> tuple:
+    """The padded ``(E, C)`` shape bucket one encoded history stacks
+    into: events round to ``event_bucket`` (bounding recompiles),
+    candidate lanes to the history's own peak concurrency rounded to 4
+    and capped at ``slot_cap``.  Shared by :func:`batch_encode`'s
+    bucketed mode and the streaming bucketer in
+    :mod:`jepsen_tpu.engine.pipeline`, so the two can never disagree
+    about which histories share a compiled shape."""
+    E = round_up(e.ev_slot.shape[0], event_bucket)
+    C = min(slot_cap, round_up(e.max_open, 4))
+    return E, C
 
-    if not encoded:
-        return EncodedBatch(
-            init_state=np.zeros((0,), np.int32),
-            ev_slot=np.zeros((0, 0), np.int32),
-            cand_slot=np.zeros((0, 0, slot_cap), np.int8),
-            cand_f=np.zeros((0, 0, slot_cap), np.int8),
-            cand_a=np.zeros((0, 0, slot_cap), np.int16),
-            cand_b=np.zeros((0, 0, slot_cap), np.int16),
-            fallback=fallback,
-            row_history=rows,
-        )
 
+def global_shape(
+    encoded: Sequence[EncodedHistory], slot_cap: int, event_bucket: int = 64
+) -> tuple:
+    """The historical single-batch padded ``(E, C)``: every history
+    padded to the global max event count, candidate lanes to the
+    batch's peak concurrency (rounded to 4, capped at ``slot_cap``) —
+    this shrinks the frontier-expansion width and sort size, usually
+    the dominant cost.  The ONE definition both ``batch_encode``'s
+    unbucketed mode and the engine's ``bucketed=False`` path read, so
+    "bucketed=False restores the old single-batch behavior" can never
+    silently desynchronize."""
     E = round_up(max(e.ev_slot.shape[0] for e in encoded), event_bucket)
-    B = len(encoded)
-    # candidate lanes bucket to the batch's actual peak concurrency (every
-    # slot id used is < max_open), not the slot cap — this shrinks the
-    # frontier-expansion width and sort size, usually the dominant cost
     C = min(slot_cap, round_up(max(e.max_open for e in encoded), 4))
+    return E, C
 
+
+def empty_batch(slot_cap: int, fallback=(), rows=()) -> EncodedBatch:
+    """A zero-row EncodedBatch (the all-fallback shape)."""
+    return EncodedBatch(
+        init_state=np.zeros((0,), np.int32),
+        ev_slot=np.zeros((0, 0), np.int32),
+        cand_slot=np.zeros((0, 0, slot_cap), np.int8),
+        cand_f=np.zeros((0, 0, slot_cap), np.int8),
+        cand_a=np.zeros((0, 0, slot_cap), np.int16),
+        cand_b=np.zeros((0, 0, slot_cap), np.int16),
+        fallback=list(fallback),
+        row_history=list(rows),
+    )
+
+
+def stack_encoded(
+    encoded: Sequence[EncodedHistory],
+    rows: Sequence[int],
+    E: int,
+    C: int,
+    fallback=(),
+) -> EncodedBatch:
+    """Stack encoded histories into one padded ``[B, E, C]`` batch.
+    Candidate lanes are trimmed to ``C`` — sound because every slot id
+    used is < the history's ``max_open`` ≤ C (the caller derives C from
+    the stack's peak concurrency, see :func:`bucket_key`)."""
+    B = len(encoded)
     init_state = np.zeros((B,), np.int32)
     ev_slot = np.full((B, E), -1, np.int32)
     cand_slot = np.full((B, E, C), -1, np.int8)
@@ -313,7 +331,6 @@ def batch_encode(
         cand_f[bi, :n] = e.cand_f[:, :C]
         cand_a[bi, :n] = e.cand_a[:, :C]
         cand_b[bi, :n] = e.cand_b[:, :C]
-
     return EncodedBatch(
         init_state=init_state,
         ev_slot=ev_slot,
@@ -321,6 +338,60 @@ def batch_encode(
         cand_f=cand_f,
         cand_a=cand_a,
         cand_b=cand_b,
-        fallback=fallback,
-        row_history=rows,
+        fallback=list(fallback),
+        row_history=list(rows),
     )
+
+
+def batch_encode(
+    histories: Sequence[History],
+    model: m.Model,
+    slot_cap: int = DEFAULT_SLOT_CAP,
+    event_bucket: int = 64,
+    bucketed: bool = False,
+):
+    """Encode histories into padded batches; unencodable ones land in
+    ``fallback`` for the CPU oracle.
+
+    ``bucketed=False`` (the default, the historical behavior) returns
+    ONE :class:`EncodedBatch` padded to the global max event count —
+    every short history pays the longest history's padding.
+    ``bucketed=True`` instead returns a ``List[EncodedBatch]``, one per
+    padded ``(E, C)`` shape bucket (:func:`bucket_key`), sorted by
+    shape, so the engine dispatches tight shapes; the global
+    ``fallback`` list rides on the FIRST returned batch (an
+    all-fallback input returns a single zero-row batch carrying it)."""
+    spec = spec_for(model)
+    encoded: List[EncodedHistory] = []
+    rows: List[int] = []
+    fallback: List[int] = []
+    for i, h in enumerate(histories):
+        e = encode_history(h, model, slot_cap, spec) if spec else None
+        if e is None:
+            fallback.append(i)
+        else:
+            encoded.append(e)
+            rows.append(i)
+
+    if not bucketed:
+        if not encoded:
+            return empty_batch(slot_cap, fallback, rows)
+        E, C = global_shape(encoded, slot_cap, event_bucket)
+        return stack_encoded(encoded, rows, E, C, fallback)
+
+    buckets: dict = {}
+    for e, i in zip(encoded, rows):
+        buckets.setdefault(bucket_key(e, slot_cap, event_bucket), []).append(
+            (e, i)
+        )
+    if not buckets:
+        return [empty_batch(slot_cap, fallback, rows)]
+    out: List[EncodedBatch] = []
+    for key in sorted(buckets):
+        E, C = key
+        es = [e for e, _ in buckets[key]]
+        idxs = [i for _, i in buckets[key]]
+        out.append(
+            stack_encoded(es, idxs, E, C, fallback if not out else ())
+        )
+    return out

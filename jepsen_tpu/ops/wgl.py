@@ -887,6 +887,211 @@ def _timed_run_chunked(fn, mesh, arrays, disp, engine):
     return out
 
 
+class BucketPlan:
+    """The routing decision for one encoded ``[B, E, C]`` bucket: which
+    kernel serves the shape, the compiled fn (None = oracle-routed or
+    undispatchable), its safe per-dispatch row cap, and the shape
+    facts (``mc``, ``n_values``) the escalation ladder needs.  Built by
+    :func:`plan_bucket`; consumed by the pipelined engine
+    (:mod:`jepsen_tpu.engine.pipeline`) and :func:`escalate_overflows`."""
+
+    __slots__ = (
+        "spec", "E", "C", "mc", "n_values", "kernel", "fn", "disp",
+        "frontier",
+    )
+
+    def overflow_engine(self) -> str:
+        # routed by choice (the oracle IS the fastest engine for this
+        # shape) vs landed there by escalating off the device
+        return (
+            "oracle-routed" if self.kernel == "oracle" else "oracle-overflow"
+        )
+
+
+def plan_bucket(
+    model: m.Model,
+    spec,
+    arrays,
+    frontier: int = DEFAULT_FRONTIER,
+    max_closure: Optional[int] = None,
+    max_dispatch: int = DEFAULT_MAX_DISPATCH,
+) -> BucketPlan:
+    """Pick the kernel for one encoded bucket's arrays and emit the
+    per-bucket routing telemetry.  ``arrays`` is the 6-tuple
+    ``(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b)`` with
+    at least one row."""
+    init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b = arrays
+    plan = BucketPlan()
+    plan.spec = spec
+    plan.frontier = frontier
+    plan.E = E = ev_slot.shape[1]
+    plan.C = C = cand_slot.shape[2]  # bucketed to actual concurrency
+    # closure depth is bounded by the open-op count (<= C); +1 for the
+    # fixpoint-confirming iteration, so legitimate closures are never
+    # cut short and flagged unknown
+    plan.mc = mc = max_closure if max_closure is not None else C + 1
+    if spec.name == "acquired-permits":
+        # (client count, permit count) drives the table-built
+        # automaton; client ids are contiguous 1..N in cand_a.
+        # N rounds up to a bucket of 4 so drifting per-batch client
+        # counts don't mint a fresh executable each (oversized
+        # tables are a harmless superset; real ids stay ≤ N)
+        n_values = (
+            encode_mod.round_up(int(max(cand_a.max(), 0)), 4),
+            int(getattr(model, "n_permits", 2)),
+        )
+    elif spec.name == "multi-register":
+        # the (Vr, K) composite pair drives the dense automaton
+        from . import dense as dense_mod
+
+        n_values = dense_mod.mr_shape_probe(init_state, cand_a, cand_b)
+    else:
+        n_values = value_domain(spec.name, init_state, cand_a, cand_b)
+    plan.n_values = n_values
+    if max_closure is None:
+        kernel = kernel_choice(spec.name, C, n_values)
+        # "oracle": the measured-fastest engine for this shape is
+        # the CPU search (LINEAR_FRONTIER_SPECS outside the dense
+        # envelope) — fn=None sends the whole bucket down the
+        # oracle path with no device dispatches
+        fn = (
+            None
+            if kernel == "oracle"
+            else make_best_check_fn(spec.name, E, C, frontier, mc, n_values)
+        )
+    elif getattr(spec, "dense_only", False):
+        # an explicit closure cap would force the frontier kernel,
+        # which dense-only specs don't have: oracle takes the bucket
+        fn = None
+        kernel = "frontier"
+    else:
+        # an explicit closure cap asks for the generic kernel's
+        # truncation semantics; the dense kernel has no such cap
+        fn = make_check_fn(spec.name, E, C, frontier, mc)
+        kernel = "frontier"
+    plan.kernel = kernel
+    plan.fn = fn
+    # every compiled fn carries its footprint-safe per-dispatch cap
+    # (make_check_fn derives it from the closure expansion; dense fns
+    # pin the full default — overflow-free kernels have no crash shape)
+    plan.disp = disp = (
+        0 if fn is None
+        else min(max_dispatch, getattr(fn, "safe_dispatch", max_dispatch))
+    )
+    if obs.enabled():
+        B0 = arrays[0].shape[0]
+        # a bucket only counts as device traffic when a kernel will
+        # actually dispatch: fn=None (dense-only spec forced onto
+        # the absent frontier path) or disp=0 (even one row would
+        # bust the budget) both send every row to the oracle, and
+        # the routing counter must say so — no phantom frontier
+        # metrics for dispatches that never happen
+        routed = kernel if fn is not None and disp > 0 else "oracle"
+        obs.count(
+            "jepsen_engine_routed_total", engine=routed, spec=spec.name
+        )
+        obs.count("jepsen_engine_batch_rows_total", B0, engine=routed)
+        if routed == "frontier":
+            # TPU-specific telemetry: frontier capacity high-water
+            # and how much of the crash-calibrated dispatch budget
+            # (FRONTIER_DISPATCH_BUDGET words) one dispatch uses
+            words = max(1, -(-E // 32))
+            per_row = frontier * (C + 1) * words
+            obs.gauge_max("jepsen_frontier_high_water", frontier)
+            obs.gauge_set("jepsen_frontier_safe_dispatch", disp)
+            # high-water, not last-write: the run summary must show
+            # the PEAK budget use, not whichever batch came last
+            obs.gauge_max(
+                "jepsen_frontier_dispatch_budget_used_ratio",
+                per_row * min(B0, disp) / max(FRONTIER_DISPATCH_BUDGET, 1),
+            )
+    return plan
+
+
+def escalate_overflows(
+    plan: BucketPlan,
+    arrays,
+    ok: np.ndarray,
+    failed_at: np.ndarray,
+    overflow: np.ndarray,
+    mesh=None,
+    escalation=ESCALATION_FACTORS,
+    sufficient_rung: bool = True,
+    max_dispatch: int = DEFAULT_MAX_DISPATCH,
+) -> None:
+    """Retry overflowed rows on-device at growing frontier capacities,
+    writing verdicts back into ``ok``/``failed_at``/``overflow`` in
+    place.  Rows still overflowed afterwards are the oracle's.  The
+    dispatch-and-sync here is the rare path, so the pipelined engine
+    runs it inline at chunk-settle time."""
+    spec = plan.spec
+    # dense-only specs have no frontier kernel, so no escalation
+    # rungs exist either — overflowed rows (all of them, when fn is
+    # None) go straight to the oracle
+    capacities = (
+        [] if plan.fn is None or getattr(spec, "dense_only", False)
+        else [plan.frontier * factor for factor in escalation]
+    )
+    # final escalation rung: the provably-sufficient capacity, when
+    # affordable — a lossless-compaction rerun that settles the row
+    # on-device instead of handing it to the exponential oracle.
+    # The base pass (and intermediate rungs) use best-effort hash
+    # dedup, which can overflow spuriously at ANY capacity — so the
+    # guarantee requires one exact-sort rung at ≥ the sufficient
+    # bound even when the base frontier already exceeds it.
+    suff = (
+        sufficient_frontier(plan.n_values, plan.C, spec.name)
+        if sufficient_rung
+        and plan.fn is not None
+        and not getattr(spec, "dense_only", False)
+        else None
+    )
+    if suff is not None and not any(c >= suff for c in capacities):
+        capacities.append(max(suff, plan.frontier))
+    for capacity in capacities:
+        bad = np.flatnonzero(overflow)
+        if bad.size == 0:
+            break
+        # pad the rerun batch to a bucket multiple with neutral rows
+        # (all-padding events report valid) so the escalated checker
+        # compiles once per bucket size, not once per overflow count
+        n_bad = len(bad)
+        n_pad = encode_mod.round_up(n_bad, 8) - n_bad
+        idx = np.concatenate([bad, np.zeros((n_pad,), bad.dtype)])
+        sub = tuple(a[idx] for a in arrays)
+        if n_pad:
+            sub[1][n_bad:] = -1  # ev_slot: every event padding
+        # rungs at ≥ the sufficient capacity must use an EXACT
+        # dedup (EXACT_COMPACTIONS): the lossless-by-construction
+        # claim is "all distinct configs fit in F", which only
+        # holds if every duplicate is actually removed.  Rungs
+        # below it keep the configured fast compaction — a spurious
+        # overflow there escalates to the next rung.
+        mode = default_compaction()
+        if suff is not None and capacity >= suff:
+            mode = mode if mode in EXACT_COMPACTIONS else "sort"
+        fn2 = make_check_fn(spec.name, plan.E, plan.C, capacity, plan.mc,
+                            mode)
+        disp2 = min(max_dispatch, fn2.safe_dispatch)
+        if disp2 == 0:
+            # a single row at this capacity would bust the safe
+            # footprint: skip the rung, leave the rows overflowed
+            continue
+        obs.gauge_max("jepsen_frontier_high_water", capacity)
+        obs.count(
+            "jepsen_engine_escalations_total", n_bad,
+            capacity=str(capacity),
+        )
+        ok2, failed2, ovf2 = (
+            np.asarray(x)[:n_bad]
+            for x in _timed_run_chunked(fn2, mesh, sub, disp2,
+                                        "frontier-escalated")
+        )
+        ok[bad] = ok2
+        failed_at[bad] = failed2
+        overflow[bad] = ovf2
+
+
 def check_batch(
     model: m.Model,
     histories: Sequence[History],
@@ -899,6 +1104,8 @@ def check_batch(
     sufficient_rung: bool = True,
     max_dispatch: int = DEFAULT_MAX_DISPATCH,
     oracle_budget_s: Optional[float] = None,
+    window: Optional[int] = None,
+    bucketed: Optional[bool] = None,
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
@@ -915,8 +1122,18 @@ def check_batch(
     instead — for callers (like the race-mode checker) already running
     the oracle themselves.  Batches larger than ``max_dispatch`` rows
     run as bounded chunks (one compile total; HBM use stays capped no
-    matter how many keys the independent lift produces)."""
-    from ..checker import linear
+    matter how many keys the independent lift produces).
+
+    The production path IS the pipelined engine
+    (:mod:`jepsen_tpu.engine.pipeline`): histories are encoded into
+    tight per-(E, C)-shape buckets, device dispatches ride a bounded
+    in-flight ``window`` (default 4, ``JEPSEN_TPU_ENGINE_WINDOW``; 1 =
+    strictly serial, dispatch-sync-dispatch), and CPU-oracle fallbacks
+    run on a worker pool concurrently with device work.  Verdicts are
+    independent of ``window`` and ``bucketed`` — those knobs only move
+    wall time (``bucketed=False`` restores the historical one-padded-
+    batch encode)."""
+    from ..engine import pipeline as engine_pipeline
     from ..platform import ensure_usable_backend
 
     # guard at the dispatch layer so EVERY caller (checker algorithms,
@@ -924,245 +1141,21 @@ def check_batch(
     # tunnel: probe in a subprocess, pin CPU if the device is unusable.
     # Memoized; a no-op when the platform is already pinned.
     ensure_usable_backend()
-    spec = spec_for(model)
-    batch = encode_mod.batch_encode(histories, model, slot_cap=slot_cap)
-    results: List[Optional[dict]] = [None] * len(histories)
-
-    if batch.init_state.shape[0] > 0:
-        E = batch.ev_slot.shape[1]
-        C = batch.cand_slot.shape[2]  # bucketed to actual concurrency
-        arrays = (
-            batch.init_state,
-            batch.ev_slot,
-            batch.cand_slot,
-            batch.cand_f,
-            batch.cand_a,
-            batch.cand_b,
-        )
-        # closure depth is bounded by the open-op count (<= C); +1 for the
-        # fixpoint-confirming iteration, so legitimate closures are never
-        # cut short and flagged unknown
-        mc = max_closure if max_closure is not None else C + 1
-        if spec.name == "acquired-permits":
-            # (client count, permit count) drives the table-built
-            # automaton; client ids are contiguous 1..N in cand_a.
-            # N rounds up to a bucket of 4 so drifting per-batch client
-            # counts don't mint a fresh executable each (oversized
-            # tables are a harmless superset; real ids stay ≤ N)
-            n_values = (
-                encode_mod.round_up(int(max(batch.cand_a.max(), 0)), 4),
-                int(getattr(model, "n_permits", 2)),
-            )
-        elif spec.name == "multi-register":
-            # the (Vr, K) composite pair drives the dense automaton
-            from . import dense as dense_mod
-
-            n_values = dense_mod.mr_shape_probe(
-                batch.init_state, batch.cand_a, batch.cand_b
-            )
-        else:
-            n_values = value_domain(
-                spec.name, batch.init_state, batch.cand_a, batch.cand_b
-            )
-        if max_closure is None:
-            kernel = kernel_choice(spec.name, C, n_values)
-            # "oracle": the measured-fastest engine for this shape is
-            # the CPU search (LINEAR_FRONTIER_SPECS outside the dense
-            # envelope) — fn=None sends the whole batch down the
-            # oracle path below with no device dispatches
-            fn = (
-                None
-                if kernel == "oracle"
-                else make_best_check_fn(spec.name, E, C, frontier, mc,
-                                        n_values)
-            )
-        elif getattr(spec, "dense_only", False):
-            # an explicit closure cap would force the frontier kernel,
-            # which dense-only specs don't have: oracle takes the batch
-            fn = None
-            kernel = "frontier"
-        else:
-            # an explicit closure cap asks for the generic kernel's
-            # truncation semantics; the dense kernel has no such cap
-            fn = make_check_fn(spec.name, E, C, frontier, mc)
-            kernel = "frontier"
-        # frontier dispatches carry their footprint-safe cap on the fn
-        # itself (make_check_fn); dense fns don't and keep the full cap
-        disp = (
-            0 if fn is None
-            else min(max_dispatch, getattr(fn, "safe_dispatch", max_dispatch))
-        )
-        if obs.enabled():
-            B0 = arrays[0].shape[0]
-            # a batch only counts as device traffic when a kernel will
-            # actually dispatch: fn=None (dense-only spec forced onto
-            # the absent frontier path) or disp=0 (even one row would
-            # bust the budget) both send every row to the oracle, and
-            # the routing counter must say so — no phantom frontier
-            # metrics for dispatches that never happen
-            routed = kernel if fn is not None and disp > 0 else "oracle"
-            obs.count(
-                "jepsen_engine_routed_total", engine=routed, spec=spec.name
-            )
-            obs.count("jepsen_engine_batch_rows_total", B0, engine=routed)
-            if routed == "frontier":
-                # TPU-specific telemetry: frontier capacity high-water
-                # and how much of the crash-calibrated dispatch budget
-                # (FRONTIER_DISPATCH_BUDGET words) one dispatch uses
-                words = max(1, -(-E // 32))
-                per_row = frontier * (C + 1) * words
-                obs.gauge_max("jepsen_frontier_high_water", frontier)
-                obs.gauge_set("jepsen_frontier_safe_dispatch", disp)
-                # high-water, not last-write: the run summary must show
-                # the PEAK budget use, not whichever batch came last
-                obs.gauge_max(
-                    "jepsen_frontier_dispatch_budget_used_ratio",
-                    per_row * min(B0, disp)
-                    / max(FRONTIER_DISPATCH_BUDGET, 1),
-                )
-        if disp == 0:
-            # no dispatchable kernel (a dense-only spec outside its
-            # envelope) or even one row would crash the worker: the
-            # whole batch is the oracle's (or reports unknown)
-            B0 = arrays[0].shape[0]
-            ok = np.zeros((B0,), bool)
-            failed_at = np.zeros((B0,), np.int32)
-            overflow = np.ones((B0,), bool)
-        else:
-            # np.array (not asarray): jax outputs are read-only views
-            # and the escalation pass writes back into these
-            ok, failed_at, overflow = (
-                np.array(x)
-                for x in _timed_run_chunked(fn, mesh, arrays, disp, kernel)
-            )
-
-        # dense-only specs have no frontier kernel, so no escalation
-        # rungs exist either — overflowed rows (all of them, when fn is
-        # None) go straight to the oracle
-        capacities = (
-            [] if fn is None or getattr(spec, "dense_only", False)
-            else [frontier * factor for factor in escalation]
-        )
-        # final escalation rung: the provably-sufficient capacity, when
-        # affordable — a lossless-compaction rerun that settles the row
-        # on-device instead of handing it to the exponential oracle.
-        # The base pass (and intermediate rungs) use best-effort hash
-        # dedup, which can overflow spuriously at ANY capacity — so the
-        # guarantee requires one exact-sort rung at ≥ the sufficient
-        # bound even when the base frontier already exceeds it.
-        suff = (
-            sufficient_frontier(n_values, C, spec.name)
-            if sufficient_rung
-            and fn is not None
-            and not getattr(spec, "dense_only", False)
-            else None
-        )
-        if suff is not None and not any(c >= suff for c in capacities):
-            capacities.append(max(suff, frontier))
-        for capacity in capacities:
-            bad = np.flatnonzero(overflow)
-            if bad.size == 0:
-                break
-            # pad the rerun batch to a bucket multiple with neutral rows
-            # (all-padding events report valid) so the escalated checker
-            # compiles once per bucket size, not once per overflow count
-            n_bad = len(bad)
-            n_pad = encode_mod.round_up(n_bad, 8) - n_bad
-            idx = np.concatenate([bad, np.zeros((n_pad,), bad.dtype)])
-            sub = tuple(a[idx] for a in arrays)
-            if n_pad:
-                sub[1][n_bad:] = -1  # ev_slot: every event padding
-            # rungs at ≥ the sufficient capacity must use an EXACT
-            # dedup (EXACT_COMPACTIONS): the lossless-by-construction
-            # claim is "all distinct configs fit in F", which only
-            # holds if every duplicate is actually removed.  Rungs
-            # below it keep the configured fast compaction — a spurious
-            # overflow there escalates to the next rung.
-            mode = default_compaction()
-            if suff is not None and capacity >= suff:
-                mode = mode if mode in EXACT_COMPACTIONS else "sort"
-            fn2 = make_check_fn(spec.name, E, C, capacity, mc, mode)
-            disp2 = min(max_dispatch, fn2.safe_dispatch)
-            if disp2 == 0:
-                # a single row at this capacity would bust the safe
-                # footprint: skip the rung, leave the rows overflowed
-                continue
-            obs.gauge_max("jepsen_frontier_high_water", capacity)
-            obs.count(
-                "jepsen_engine_escalations_total", n_bad,
-                capacity=str(capacity),
-            )
-            ok2, failed2, ovf2 = (
-                np.asarray(x)[:n_bad]
-                for x in _timed_run_chunked(fn2, mesh, sub, disp2,
-                                            "frontier-escalated")
-            )
-            ok[bad] = ok2
-            failed_at[bad] = failed2
-            overflow[bad] = ovf2
-
-        overflow_engine = (
-            # routed by choice (the oracle IS the fastest engine for
-            # this shape) vs landed there by escalating off the device
-            "oracle-routed" if kernel == "oracle" else "oracle-overflow"
-        )
-        for row, hist_idx in enumerate(batch.row_history):
-            if overflow[row]:
-                # still overflowed after escalation: CPU oracle decides
-                if not oracle_fallback:
-                    # "routed": no kernel ran and nothing overflowed —
-                    # the shape belongs to the oracle and this caller
-                    # (e.g. race mode) runs the oracle itself
-                    results[hist_idx] = {
-                        "valid?": "unknown",
-                        "engine": (
-                            "routed" if kernel == "oracle" else "overflow"
-                        ),
-                    }
-                    continue
-                results[hist_idx] = linear.analysis(
-                    model, histories[hist_idx], pure_fs=spec.pure_fs,
-                    budget_s=oracle_budget_s,
-                )
-                results[hist_idx]["engine"] = overflow_engine
-            elif ok[row]:
-                results[hist_idx] = {
-                    "valid?": True,
-                    "engine": "tpu",
-                    "kernel": kernel,
-                }
-            else:
-                results[hist_idx] = {
-                    "valid?": False,
-                    "engine": "tpu",
-                    "kernel": kernel,
-                    "failed-event": int(failed_at[row]),
-                }
-
-    for hist_idx in batch.fallback:
-        if not oracle_fallback:
-            results[hist_idx] = {"valid?": "unknown", "engine": "unencodable"}
-            continue
-        pure = spec.pure_fs if spec else ()
-        results[hist_idx] = linear.analysis(
-            model, histories[hist_idx], pure_fs=pure,
-            budget_s=oracle_budget_s,
-        )
-        results[hist_idx]["engine"] = "oracle-fallback"
-
-    if obs.enabled() and results:
-        # per-subhistory engine outcomes (the observable half of
-        # P-compositional tuning): tpu rows count under their kernel
-        # name, everything else under its engine tag
-        stats = batch_stats([r for r in results if r is not None])
-        for eng, n in stats["engines"].items():
-            if eng == "tpu":
-                continue
-            obs.count("jepsen_engine_rows_total", n, engine=eng)
-        for k, n in stats["kernels"].items():
-            obs.count("jepsen_engine_rows_total", n, engine=k)
-
-    return results  # type: ignore[return-value]
+    return engine_pipeline.run(
+        model,
+        histories,
+        frontier=frontier,
+        slot_cap=slot_cap,
+        max_closure=max_closure,
+        mesh=mesh,
+        escalation=escalation,
+        oracle_fallback=oracle_fallback,
+        sufficient_rung=sufficient_rung,
+        max_dispatch=max_dispatch,
+        oracle_budget_s=oracle_budget_s,
+        window=window,
+        bucketed=bucketed,
+    )
 
 
 def batch_stats(results: Sequence[dict]) -> dict:
