@@ -2,14 +2,21 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check trace-smoke pipeline-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
-# the full local gate: unit tests + the observability and pipeline
-# smoke checks
-check: test trace-smoke pipeline-smoke
+# the full local gate: static analysis + unit tests + the
+# observability and pipeline smoke checks
+check: lint test trace-smoke pipeline-smoke
+
+# jtlint static analysis (doc/static-analysis.md): trace-safety,
+# lock-discipline, obs-hygiene, protocol conformance.  Fails on any
+# finding not in the committed baseline (jepsen_tpu/lint/baseline.json);
+# lint.json is the machine-readable report for trend tracking.
+lint:
+	python -m jepsen_tpu.lint jepsen_tpu/ --json lint.json
 
 # run the in-process CLI path with tracing on and fail unless the
 # store dir holds a valid Chrome trace + Prometheus dump with phase/op

@@ -407,7 +407,7 @@ DEFAULT_ORACLE_WORKERS = 4
 # the guard must pre-exist the first caller: creating it lazily would
 # itself race (two first callers, two locks, two leaked executors)
 _pool_lock = _threading.Lock()
-_pool = None
+_pool = None  # jt: guarded-by(_pool_lock)
 
 
 def oracle_workers() -> int:

@@ -24,9 +24,11 @@ class RetryRemote(Remote):
         self.remote = remote
         self.retries = retries
         self.backoff = backoff
-        self._node = None
-        self._test = None
-        self._conn: Optional[Remote] = None
+        # one RetryRemote per (node, worker): the connection and its
+        # reconnect cycle live on that worker's thread, never shared
+        self._node = None  # jt: guarded-by(owner-thread)
+        self._test = None  # jt: guarded-by(owner-thread)
+        self._conn: Optional[Remote] = None  # jt: guarded-by(owner-thread)
 
     def connect(self, node, test=None):
         r = RetryRemote(self.remote, self.retries, self.backoff)

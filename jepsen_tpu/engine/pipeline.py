@@ -52,6 +52,7 @@ Pipeline telemetry (obs registry; doc/observability.md):
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -127,6 +128,15 @@ class DispatchWindow:
     ``ops.cycles`` its Elle screen buckets, and ``bench.py`` its
     pipelined measurement, so the benchmark times the code users run.
 
+    A window is **owner-thread confined** (``# jt: guarded-by
+    (owner-thread)`` on its state, checked by the lock-discipline lint
+    pass): the in-flight deque and bubble/peak bookkeeping are
+    deliberately lock-free, so ``submit``/``drain`` refuse calls from
+    any thread but the creating one rather than corrupt them silently
+    — the oracle worker pool must interact with the engine only
+    through Futures (see ``run``'s stage-3 drain), never by driving
+    the window.
+
     Time spent blocked in retirement is recorded as
     ``jepsen_engine_bubble_seconds``; the post-submit depth feeds the
     ``jepsen_engine_inflight_depth`` high-water gauge.
@@ -142,10 +152,19 @@ class DispatchWindow:
         )
         self.on_retire = on_retire
         #: (key, lazy-out, t_dispatch, attrs)
-        self._inflight: deque = deque()
-        self.peak_depth = 0
-        self.bubble_s = 0.0
-        self.submitted = 0
+        self._inflight: deque = deque()  # jt: guarded-by(owner-thread)
+        self.peak_depth = 0  # jt: guarded-by(owner-thread)
+        self.bubble_s = 0.0  # jt: guarded-by(owner-thread)
+        self.submitted = 0  # jt: guarded-by(owner-thread)
+        self._owner = threading.get_ident()
+
+    def _check_owner(self) -> None:
+        if threading.get_ident() != self._owner:
+            raise RuntimeError(
+                "DispatchWindow is owner-thread confined: submit/drain "
+                "must run on the creating thread (oracle workers hand "
+                "results back through Futures, never drive the window)"
+            )
 
     @property
     def depth(self) -> int:
@@ -154,6 +173,7 @@ class DispatchWindow:
     def submit(self, key, thunk, attrs: Optional[dict] = None) -> list:
         """Dispatch one unit of device work; returns entries retired to
         make room (empty until the window fills)."""
+        self._check_owner()
         retired = []
         while len(self._inflight) >= self.window:
             retired.append(self._retire())
@@ -189,6 +209,7 @@ class DispatchWindow:
 
     def drain(self) -> list:
         """Retire every in-flight dispatch, oldest first."""
+        self._check_owner()
         out = []
         while self._inflight:
             out.append(self._retire())

@@ -1,0 +1,31 @@
+"""jtlint: the project-native static-analysis suite.
+
+``python -m jepsen_tpu.lint [paths]`` runs four AST-based passes that
+encode this repo's real invariants (doc/static-analysis.md):
+
+- **trace-safety** — host impurity reachable inside jit/vmap/pmap
+  traced code, and implicit device syncs in the dispatch path.
+- **lock-discipline** — ``# jt: guarded-by(<lock>)`` lockset checking
+  over the multi-threaded engine/obs/control state.
+- **obs-hygiene** — span enter/exit pairing and ``jepsen_*`` metric
+  naming/registration/doc conformance.
+- **protocol** — checker ``check`` seam conformance and suite
+  workload/fault/name-table drift.
+
+Dependency-free (stdlib ``ast`` only — linting ``ops/`` never imports
+JAX), wired into ``make lint`` / ``make check``, non-zero exit on any
+finding not in the committed baseline (``jepsen_tpu/lint/baseline.json``).
+Per-line suppression: ``# jt: allow[rule-id]``.
+"""
+
+from __future__ import annotations
+
+from .core import (DEFAULT_BASELINE, Finding, LintResult,  # noqa: F401
+                   Pass, Project, SourceFile, all_passes, all_rules,
+                   lint_paths, load_baseline, make_baseline, write_baseline)
+
+__all__ = [
+    "DEFAULT_BASELINE", "Finding", "LintResult", "Pass", "Project",
+    "SourceFile", "all_passes", "all_rules", "lint_paths",
+    "load_baseline", "make_baseline", "write_baseline",
+]

@@ -1,0 +1,163 @@
+"""CLI for jtlint: ``python -m jepsen_tpu.lint [paths]``.
+
+Exit status: 0 when every finding is baselined (stale-baseline entries
+warn but never fail — the baseline may only shrink), 1 on any new
+finding, 2 on usage errors.  ``--json [FILE]`` additionally writes a
+machine-readable report (default ``lint.json``) for trend tracking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .core import (DEFAULT_BASELINE, all_passes, all_rules, lint_paths,
+                   load_baseline, make_baseline)
+
+
+def _default_paths() -> List[str]:
+    """The installed package tree (works from any cwd)."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.lint",
+        description="jtlint: trace-safety, lock-discipline, obs-hygiene "
+                    "and protocol-conformance static analysis",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the jepsen_tpu "
+                         "package)")
+    ap.add_argument("--rules", metavar="ID[,ID...]",
+                    help="run only these rule ids")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list every rule id and exit")
+    ap.add_argument("--baseline", metavar="PATH", default=DEFAULT_BASELINE,
+                    help="baseline file (default: the committed "
+                         "jepsen_tpu/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print baselined (grandfathered) findings")
+    ap.add_argument("--json", metavar="FILE", nargs="?", const="lint.json",
+                    default=None,
+                    help="write a JSON report (default file: lint.json)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summary line")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for p in all_passes():
+            for r in p.rules:
+                print(f"{r}  [{p.name}]")
+        return 0
+
+    rules = None
+    if args.rules:
+        if args.write_baseline:
+            # a rule-filtered run sees only a slice of the findings;
+            # writing that slice would drop every other grandfathered
+            # entry from the baseline
+            print("--write-baseline cannot be combined with --rules: "
+                  "the baseline must cover the full rule set",
+                  file=sys.stderr)
+            return 2
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(rules) - set(all_rules()) - {"parse-error"}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"bad baseline {args.baseline!r}: {e}", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    result = lint_paths(paths, rules=rules, baseline=baseline)
+    elapsed = time.perf_counter() - t0
+
+    if args.write_baseline:
+        # merge, don't clobber: a subset run (`lint suites/a.py
+        # --write-baseline`) regenerates entries for the SCANNED files
+        # only and preserves grandfathered entries for everything else
+        everything = result.findings + result.baselined
+        current = make_baseline(everything)["findings"]
+        try:
+            prior = load_baseline(args.baseline) or {"findings": []}
+        except (ValueError, json.JSONDecodeError):
+            prior = {"findings": []}
+        kept_prior = [e for e in prior["findings"]
+                      if e.get("path") not in result.scanned_paths]
+        merged = sorted(kept_prior + current,
+                        key=lambda e: (e.get("path", ""),
+                                       e.get("rule", ""),
+                                       e.get("message", ""), e["fp"]))
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "findings": merged}, fh,
+                      indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {len(merged)} finding(s) to {args.baseline}"
+              + (f" ({len(kept_prior)} preserved for unscanned files)"
+                 if kept_prior else ""))
+        return 0
+
+    for f in result.findings:
+        print(f.render())
+    if args.show_baselined:
+        for f in result.baselined:
+            print(f"{f.render()}  [baselined]")
+    for e in result.stale:
+        print(
+            f"warning: stale baseline entry {e['fp']} "
+            f"({e.get('rule', '?')} in {e.get('path', '?')}): the finding "
+            "no longer exists — remove it (re-run --write-baseline) so "
+            "the baseline keeps shrinking",
+            file=sys.stderr,
+        )
+
+    if args.json is not None:
+        report = {
+            "version": 1,
+            "files": result.n_files,
+            "elapsed_s": round(elapsed, 3),
+            "findings": [f.to_dict() for f in result.findings],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "stale_baseline": list(result.stale),
+            "pass_timings_s": {k: round(v, 4)
+                               for k, v in sorted(result.timings.items())},
+        }
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if not args.quiet:
+        n = len(result.findings)
+        nb = len(result.baselined)
+        extra = f", {nb} baselined" if nb else ""
+        extra += f", {len(result.stale)} stale" if result.stale else ""
+        print(f"jtlint: {result.n_files} files, {n} finding(s){extra} "
+              f"in {elapsed:.2f}s")
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,530 @@
+"""jtlint core: source model, pass registry, suppressions, baseline.
+
+The framework half of :mod:`jepsen_tpu.lint` (the passes live in
+sibling modules).  Everything is stdlib-``ast`` based — no imports of
+the code under analysis, so linting ``ops/`` never initializes JAX and
+a syntax error in one file is one finding, not a crashed run.
+
+Concepts:
+
+- :class:`SourceFile` — one parsed file: text, lines, AST, and the
+  ``# jt: …`` directives found in it.  Parses are cached per
+  ``(path, mtime, size)`` in a module-level table so the common
+  lint-twice pattern (CLI run + self-check test, or ``--write-baseline``
+  followed by a verify run) never re-parses an unchanged file.
+- :class:`Project` — the whole scanned file set plus resolved options;
+  passes that need cross-file context (workload tables, metric name
+  registry, the suite list) read it here.
+- :class:`Pass` — one registered analysis.  A pass owns one or more
+  rule ids; ``lint_paths(rules=…)`` filters at the finding level so a
+  pass may be partially enabled.
+- :class:`Finding` — one diagnostic, with a stable fingerprint
+  (rule + path + enclosing scope + message + occurrence index — line
+  numbers deliberately excluded so unrelated edits above a grandfathered
+  finding don't churn the baseline).
+- Baseline — a committed JSON file of fingerprints for grandfathered
+  findings.  Matching findings are demoted to "baselined" (reported
+  only with ``--show-baselined``, never failing); baseline entries with
+  no matching finding are reported as **stale** warnings so the file
+  monotonically shrinks (see ``doc/static-analysis.md``).
+
+Directive syntax (one trailing comment, same line or the line above):
+
+- ``# jt: allow[rule-id]`` / ``# jt: allow[rule-a, rule-b]`` /
+  ``# jt: allow[*]`` — suppress findings of those rules on that line.
+- ``# jt: guarded-by(<lock>)`` — the attribute assigned on this line is
+  protected by ``self.<lock>`` (or the reserved name ``owner-thread``:
+  single-thread confinement).
+- ``# jt: holds(<lock>)`` — this function runs with ``<lock>`` already
+  held by its caller.
+- ``# jt: thread-entry`` — this function runs on a foreign thread.
+- ``# jt: traced`` — this function is traced by jit/vmap/pmap through
+  an indirection the call-graph builder can't see (e.g. a spec table).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import time
+import tokenize
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: the committed baseline of grandfathered findings (package-relative,
+#: so the CLI finds it from any working directory)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+#: a directive must START its comment (`# jt: …`), so prose comments
+#: *mentioning* the syntax — or string literals containing it — are
+#: never live directives (comments come from the tokenizer, not a
+#: line-level regex, exactly to keep strings out)
+_DIRECTIVE_RE = re.compile(r"^#+\s*jt:\s*(.+?)\s*$")
+_ALLOW_RE = re.compile(r"allow\[([^\]]*)\]")
+_GUARDED_RE = re.compile(r"guarded-by\(([^)]+)\)")
+_HOLDS_RE = re.compile(r"holds\(([^)]+)\)")
+
+#: reserved guarded-by "lock" meaning single-thread confinement
+OWNER_THREAD = "owner-thread"
+
+
+class Finding:
+    """One diagnostic.  ``scope`` is the enclosing class/function
+    qualname (fingerprint stability under line drift); ``occurrence``
+    disambiguates identical findings in one scope."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "scope",
+                 "occurrence", "baselined")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, scope: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.scope = scope
+        self.occurrence = 0
+        self.baselined = False
+
+    def fingerprint(self) -> str:
+        raw = "\x1f".join(
+            (self.rule, self.path, self.scope, self.message,
+             str(self.occurrence))
+        )
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:16]
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "scope": self.scope,
+            "fingerprint": self.fingerprint(),
+            "baselined": self.baselined,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class SourceFile:
+    """One parsed source file plus its ``# jt:`` directives."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        # line -> directive text (the part after "jt:"); real COMMENT
+        # tokens only, so a docstring documenting the syntax or a
+        # string literal containing it can never suppress anything
+        self.directives: Dict[int, str] = {}
+        if "jt:" in text:
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(text).readline):
+                    if tok.type == tokenize.COMMENT:
+                        m = _DIRECTIVE_RE.match(tok.string)
+                        if m:
+                            self.directives[tok.start[0]] = m.group(1)
+            except (tokenize.TokenError, IndentationError, SyntaxError):
+                pass  # unparseable file: parse-error finding, no directives
+
+    # -- directive lookups -------------------------------------------------
+
+    def _at(self, line: int) -> List[str]:
+        """Directives attached to ``line``: its own trailing comment or a
+        standalone comment on the line immediately above."""
+        out = []
+        d = self.directives.get(line)
+        if d is not None:
+            out.append(d)
+        prev = self.directives.get(line - 1)
+        if prev is not None and line - 2 < len(self.lines):
+            if self.lines[line - 2].lstrip().startswith("#"):
+                out.append(prev)
+        return out
+
+    def allowed(self, line: int, rule: str) -> bool:
+        for d in self._at(line):
+            m = _ALLOW_RE.search(d)
+            if not m:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",")}
+            if "*" in ids or rule in ids:
+                return True
+        return False
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        for d in self._at(line):
+            m = _GUARDED_RE.search(d)
+            if m:
+                return m.group(1).strip()
+        return None
+
+    def holds(self, line: int) -> Optional[str]:
+        for d in self._at(line):
+            m = _HOLDS_RE.search(d)
+            if m:
+                return m.group(1).strip()
+        return None
+
+    def marked(self, line: int, word: str) -> bool:
+        return any(
+            word in re.split(r"[\s,]+", d) for d in self._at(line)
+        )
+
+
+#: parse cache: abspath -> (mtime_ns, size, SourceFile)
+_CACHE: Dict[str, Tuple[int, int, SourceFile]] = {}
+
+
+def load_file(path: str, rel: str) -> SourceFile:
+    st = os.stat(path)
+    key = os.path.abspath(path)
+    hit = _CACHE.get(key)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+        return hit[2]
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    sf = SourceFile(path, rel, text)
+    _CACHE[key] = (st.st_mtime_ns, st.st_size, sf)
+    return sf
+
+
+class Project:
+    """The scanned file set plus resolved cross-file context."""
+
+    def __init__(self, files: List[SourceFile], options: Optional[dict] = None):
+        self.files = files
+        self.options = dict(options or {})
+
+    def files_in(self, dirname: str) -> List[SourceFile]:
+        """Files whose path contains a directory component ``dirname``."""
+        out = []
+        for sf in self.files:
+            parts = os.path.normpath(sf.path).split(os.sep)
+            if dirname in parts[:-1]:
+                out.append(sf)
+        return out
+
+    def file_named(self, suffix: str) -> Optional[SourceFile]:
+        suffix = suffix.replace("/", os.sep)
+        for sf in self.files:
+            if sf.path.endswith(suffix):
+                return sf
+        return None
+
+
+class Pass:
+    """One registered analysis pass."""
+
+    name: str = ""
+    rules: Tuple[str, ...] = ()
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+
+_PASSES: List[Pass] = []
+
+
+def register(p: Pass) -> Pass:
+    _PASSES.append(p)
+    return p
+
+
+def all_passes() -> List[Pass]:
+    _ensure_registered()
+    return list(_PASSES)
+
+
+def all_rules() -> List[str]:
+    out = []
+    for p in all_passes():
+        out.extend(p.rules)
+    return sorted(out)
+
+
+_registered = False
+
+
+def _ensure_registered() -> None:
+    global _registered
+    if _registered:
+        return
+    _registered = True
+    # importing the pass modules registers them
+    from . import lock_discipline  # noqa: F401
+    from . import obs_hygiene  # noqa: F401
+    from . import protocol  # noqa: F401
+    from . import trace_safety  # noqa: F401
+
+
+# -- path collection --------------------------------------------------------
+
+
+def _rel_for(path: str) -> str:
+    """Display/baseline path: stable ``jepsen_tpu/…`` for package files
+    regardless of cwd; cwd-relative otherwise; absolute as a last
+    resort."""
+    ap = os.path.abspath(path)
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    for base in (pkg_parent, os.getcwd()):
+        try:
+            rel = os.path.relpath(ap, base)
+        except ValueError:  # pragma: no cover — windows drive mismatch
+            continue
+        if not rel.startswith(".."):
+            return rel.replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def collect_files(paths: Sequence[str]) -> List[SourceFile]:
+    seen = set()
+    out: List[SourceFile] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        fp = os.path.join(dirpath, fn)
+                        ap = os.path.abspath(fp)
+                        if ap not in seen:
+                            seen.add(ap)
+                            out.append(load_file(fp, _rel_for(fp)))
+        elif p.endswith(".py") and os.path.isfile(p):
+            ap = os.path.abspath(p)
+            if ap not in seen:
+                seen.add(ap)
+                out.append(load_file(p, _rel_for(p)))
+    out.sort(key=lambda sf: sf.rel)
+    return out
+
+
+# -- runner -----------------------------------------------------------------
+
+
+class LintResult:
+    def __init__(self, findings: List[Finding], stale: List[dict],
+                 n_files: int, timings: Dict[str, float]):
+        self.findings = findings          # every non-baselined finding
+        self.baselined: List[Finding] = []
+        self.stale = stale                # stale baseline entries
+        self.n_files = n_files
+        self.timings = timings
+        self.scanned_paths: set = set()   # rel paths of scanned files
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def _dedup_occurrences(findings: List[Finding]) -> None:
+    """Assign occurrence indices so identical findings in one scope get
+    distinct fingerprints (keyed in sorted order for determinism)."""
+    counts: Dict[tuple, int] = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        key = (f.rule, f.path, f.scope, f.message)
+        f.occurrence = counts.get(key, 0)
+        counts[key] = f.occurrence + 1
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Iterable[str]] = None,
+    options: Optional[dict] = None,
+    baseline: Optional[dict] = None,
+) -> LintResult:
+    """Run every registered pass over ``paths``; returns the result with
+    baseline matching already applied (``baseline=None`` skips it)."""
+    files = collect_files(paths)
+    project = Project(files, options)
+    enabled = set(rules) if rules is not None else None
+    findings: List[Finding] = []
+    timings: Dict[str, float] = {}
+    for sf in files:
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                "parse-error", sf.rel, sf.parse_error.lineno or 1, 0,
+                f"syntax error: {sf.parse_error.msg}",
+            ))
+    for p in all_passes():
+        if enabled is not None and not (set(p.rules) & enabled):
+            continue
+        t0 = time.perf_counter()
+        for f in p.run(project):
+            if enabled is not None and f.rule not in enabled:
+                continue
+            findings.append(f)
+        timings[p.name] = time.perf_counter() - t0
+    findings.sort(key=Finding.sort_key)
+    _dedup_occurrences(findings)
+
+    scanned = {sf.rel for sf in files}
+    stale: List[dict] = []
+    kept: List[Finding] = []
+    baselined: List[Finding] = []
+    if baseline:
+        entries = {e["fp"]: e for e in baseline.get("findings", ())}
+        matched = set()
+        for f in findings:
+            fp = f.fingerprint()
+            if fp in entries:
+                f.baselined = True
+                matched.add(fp)
+                baselined.append(f)
+            else:
+                kept.append(f)
+        for fp, e in sorted(entries.items()):
+            # an entry is stale only when its FILE was scanned, its
+            # RULE was enabled, and the finding is gone — a subset run
+            # (`lint suites/a.py`, `--rules trace-sync`) must not
+            # report out-of-scope grandfathered entries as stale
+            if (fp not in matched and e.get("path") in scanned
+                    and (enabled is None or e.get("rule") in enabled)):
+                stale.append(e)
+    else:
+        kept = findings
+    res = LintResult(kept, stale, len(files), timings)
+    res.baselined = baselined
+    res.scanned_paths = scanned
+    return res
+
+
+# -- baseline I/O -----------------------------------------------------------
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != 1:
+        raise ValueError(f"unrecognized baseline format in {path!r}")
+    return data
+
+
+def make_baseline(findings: List[Finding]) -> dict:
+    return {
+        "version": 1,
+        "findings": [
+            {
+                "fp": f.fingerprint(),
+                "rule": f.rule,
+                "path": f.path,
+                "message": f.message,
+            }
+            for f in sorted(findings, key=Finding.sort_key)
+        ],
+    }
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(make_baseline(findings), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# -- shared AST helpers (used by several passes) ----------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionIndex:
+    """Every function/method in a module, by qualname, with parents."""
+
+    def __init__(self, tree: ast.Module):
+        self.funcs: Dict[str, ast.AST] = {}
+        self.parents: Dict[str, Optional[str]] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self._walk(tree.body, None)
+
+    def _walk(self, body, scope: Optional[str]) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{scope}.{node.name}" if scope else node.name
+                self.funcs[q] = node
+                self.parents[q] = scope
+                self._walk(node.body, q)
+            elif isinstance(node, ast.ClassDef):
+                q = f"{scope}.{node.name}" if scope else node.name
+                self.classes[q] = node
+                self._walk(node.body, q)
+            else:
+                # descend through compound statements (if/with/try/for)
+                # so conditionally-defined functions are indexed in the
+                # same scope
+                self._walk(list(ast.iter_child_nodes(node)), scope)
+
+    def qualname_at(self, target: ast.AST) -> str:
+        for q, fn in self.funcs.items():
+            if fn is target:
+                return q
+        return ""
+
+    def enclosing(self, tree: ast.Module, node: ast.AST) -> str:
+        """Qualname of the innermost function/class containing ``node``
+        (by position)."""
+        best = ""
+        best_span = None
+        for table in (self.funcs, self.classes):
+            for q, f in table.items():
+                if (f.lineno <= node.lineno
+                        and node.lineno <= (f.end_lineno or f.lineno)):
+                    span = (f.end_lineno or f.lineno) - f.lineno
+                    if best_span is None or span < best_span:
+                        best, best_span = q, span
+        return best
+
+
+def call_targets(fn: ast.AST) -> List[str]:
+    """Simple names called inside ``fn`` (``g(...)`` and
+    ``self.g(...)``), nested defs/lambdas included — a closure defined
+    here runs on behalf of this function as far as reachability is
+    concerned (conservative for both tracing and thread analysis).
+    Bare names merely *referenced* (e.g. passed as a callback) count
+    too, for the same reason."""
+    out: List[str] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                out.append(node.func.id)
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == "self"):
+                out.append(node.func.attr)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.append(node.id)
+    return out

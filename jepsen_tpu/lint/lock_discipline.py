@@ -1,0 +1,281 @@
+"""lock-discipline pass: annotated lockset checking.
+
+After PR 4 the production verdict path is multi-threaded: the engine
+pipeline overlaps device dispatch with a CPU-oracle worker pool, the
+obs tracer/metrics buffers are written from every thread, and
+``RetryRemote`` connections live on worker threads.  A missed lock
+there doesn't crash — it corrupts counters or verdicts occasionally,
+which is the worst possible failure mode for a consistency checker.
+
+The check is **opt-in per module**: only modules containing at least
+one ``# jt: guarded-by(...)`` or ``# jt: thread-entry`` annotation are
+analyzed, so the annotation is both documentation and contract.
+
+Annotations:
+
+- ``self.attr = ...  # jt: guarded-by(<lock>)`` — every later access
+  to ``self.attr`` in this class must be lexically inside a
+  ``with self.<lock>:`` (or ``with <lock>:``) block, or in a function
+  annotated ``# jt: holds(<lock>)`` (lock acquired by the caller).
+  ``__init__`` is exempt: construction precedes sharing.
+- ``GLOBAL = ...  # jt: guarded-by(<lock>)`` at module level — same
+  check for module-global state (reads and writes inside functions).
+- ``# jt: guarded-by(owner-thread)`` — the attribute is confined to
+  the owning thread, never locked.  Accesses are clean *unless* they
+  happen in a thread-entry-reachable function (see below), which would
+  break the confinement.
+- ``# jt: thread-entry`` on a ``def`` — the function runs on a foreign
+  thread.  Also inferred from ``<pool>.submit(f, ...)``,
+  ``threading.Thread(target=f)``, and window-drain callbacks
+  (``on_retire=f``); reachability closes over the module-local call
+  graph.
+
+Rules:
+
+- ``lock-discipline`` — guarded state accessed without the lock held.
+- ``lock-thread-confined`` — owner-thread state touched from a
+  thread-entry-reachable function.
+
+Known limits (by design, documented in doc/static-analysis.md): the
+analysis is lexical and per-module — accesses through a *different*
+object reference (``other._spans``) or from another module aren't
+seen, and a ``with`` block entered in one function doesn't cover
+callees unless they carry ``holds``.  It still catches the bug class
+that matters: a method of the owning class touching its own guarded
+state outside the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (OWNER_THREAD, Finding, FunctionIndex, Pass, Project,
+                   SourceFile, call_targets, dotted_name, register)
+
+
+def _target_attr(stmt: ast.AST) -> Optional[str]:
+    """Attribute/global name assigned by this statement, for annotation
+    attachment: ``self.x = …``, ``self.x: T = …``, ``X = …``,
+    ``X: T = …``."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"):
+            return t.attr
+        if isinstance(t, ast.Name):
+            return t.id
+    return None
+
+
+def _with_locks(stack: List[ast.With]) -> Set[str]:
+    """Lock names held by an enclosing ``with`` stack: the final
+    attribute name of each context expression (``self._lock`` and
+    ``other._lock`` both yield ``_lock``; a bare ``_lock`` yields
+    itself)."""
+    out: Set[str] = set()
+    for w in stack:
+        for item in w.items:
+            expr = item.context_expr
+            # unwrap common wrappers: `with lock:` / `with self.lock:`
+            # / `with contextlib.ExitStack() …` (ignored)
+            if isinstance(expr, ast.Call):
+                continue
+            if isinstance(expr, ast.Attribute):
+                out.add(expr.attr)
+            elif isinstance(expr, ast.Name):
+                out.add(expr.id)
+    return out
+
+
+class _ModuleLockModel:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.index = FunctionIndex(sf.tree)
+        #: class qualname -> {attr: lock}
+        self.guarded_attrs: Dict[str, Dict[str, str]] = {}
+        #: module-global name -> lock
+        self.guarded_globals: Dict[str, str] = {}
+        #: function qualnames running on (or reachable from) foreign threads
+        self.thread_reachable: Set[str] = set()
+        self._collect_guards()
+        self._collect_thread_entries()
+
+    def _collect_guards(self) -> None:
+        sf = self.sf
+        # module-level globals
+        for stmt in sf.tree.body:
+            lock = sf.guarded_by(stmt.lineno)
+            if lock:
+                name = _target_attr(stmt)
+                if name:
+                    self.guarded_globals[name] = lock
+        # class attributes (annotation on any `self.x = …` line in any
+        # method, or on a class-level assignment)
+        for cq, cls in self.index.classes.items():
+            attrs: Dict[str, str] = {}
+            for node in ast.walk(cls):
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    lock = sf.guarded_by(node.lineno)
+                    if lock:
+                        name = _target_attr(node)
+                        if name:
+                            attrs[name] = lock
+            if attrs:
+                self.guarded_attrs[cq] = attrs
+
+    def _collect_thread_entries(self) -> None:
+        sf = self.sf
+        idx = self.index
+        by_name: Dict[str, List[str]] = {}
+        for q in idx.funcs:
+            by_name.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+        entries: Set[str] = set()
+        for q, fn in idx.funcs.items():
+            if sf.marked(fn.lineno, "thread-entry"):
+                entries.add(q)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            target: Optional[ast.AST] = None
+            if fname.endswith(".submit") and node.args:
+                target = node.args[0]
+            elif fname in ("threading.Thread", "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            for kw in node.keywords:
+                if kw.arg == "on_retire":
+                    for q2 in self._resolve(kw.value, by_name):
+                        entries.add(q2)
+            if target is not None:
+                for q2 in self._resolve(target, by_name):
+                    entries.add(q2)
+        # close over the module-local call graph
+        changed = True
+        while changed:
+            changed = False
+            for q in list(entries):
+                fn = idx.funcs.get(q)
+                if fn is None:
+                    continue
+                for callee in call_targets(fn):
+                    for q2 in by_name.get(callee, ()):
+                        if q2 not in entries:
+                            entries.add(q2)
+                            changed = True
+        self.thread_reachable = entries
+
+    def _resolve(self, node: ast.AST, by_name) -> List[str]:
+        if isinstance(node, ast.Name):
+            return by_name.get(node.id, [])
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return by_name.get(node.attr, [])
+        return []
+
+
+class LockDiscipline(Pass):
+    name = "lock-discipline"
+    rules = ("lock-discipline", "lock-thread-confined")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            if not any("guarded-by" in d or "thread-entry" in d
+                       for d in sf.directives.values()):
+                continue
+            model = _ModuleLockModel(sf)
+            self._check(sf, model, out)
+        return out
+
+    def _check(self, sf: SourceFile, model: _ModuleLockModel,
+               out: List[Finding]) -> None:
+        idx = model.index
+        for q, fn in sorted(idx.funcs.items()):
+            cls = self._owning_class(q, idx)
+            attrs = model.guarded_attrs.get(cls, {}) if cls else {}
+            held_by_contract = sf.holds(fn.lineno)
+            is_init = q.rsplit(".", 1)[-1] == "__init__"
+            self._walk_fn(sf, model, q, fn, attrs, held_by_contract,
+                          is_init, out)
+
+    def _owning_class(self, q: str, idx: FunctionIndex) -> Optional[str]:
+        parent = idx.parents.get(q)
+        while parent is not None:
+            if parent in idx.classes:
+                return parent
+            parent = idx.parents.get(parent)
+        # fall back: longest class-qualname prefix
+        best = None
+        for cq in idx.classes:
+            if q.startswith(cq + ".") and (best is None or len(cq) > len(best)):
+                best = cq
+        return best
+
+    def _walk_fn(self, sf, model, q, fn, attrs, held_contract, is_init,
+                 out) -> None:
+        thread_reachable = q in model.thread_reachable
+
+        def visit(node, with_stack: Tuple[ast.With, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # indexed separately with a fresh stack
+                if isinstance(child, ast.With):
+                    visit(child, with_stack + (child,))
+                    continue
+                self._check_node(sf, model, q, child, attrs, held_contract,
+                                 is_init, thread_reachable,
+                                 _with_locks(list(with_stack)), out)
+                visit(child, with_stack)
+
+        visit(fn, ())
+
+    def _check_node(self, sf, model, q, node, attrs, held_contract,
+                    is_init, thread_reachable, held_locks, out) -> None:
+        accesses: List[Tuple[str, str, ast.AST]] = []  # (kind, name, node)
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr in attrs):
+            accesses.append(("attr", node.attr, node))
+        elif (isinstance(node, ast.Name)
+              and node.id in model.guarded_globals
+              and not isinstance(node.ctx, ast.Del)):
+            accesses.append(("global", node.id, node))
+        for kind, name, n in accesses:
+            lock = (attrs[name] if kind == "attr"
+                    else model.guarded_globals[name])
+            if lock == OWNER_THREAD:
+                if thread_reachable:
+                    self._emit(
+                        out, sf, "lock-thread-confined", n, q,
+                        f"`{name}` is owner-thread confined but"
+                        f" `{q}` is reachable from a thread entry point"
+                        " — confinement broken")
+                continue
+            if is_init:
+                continue
+            if lock in held_locks or held_contract == lock:
+                continue
+            self._emit(
+                out, sf, "lock-discipline", n, q,
+                f"`{name}` is guarded by `{lock}` but accessed in `{q}`"
+                f" without holding it (wrap in `with {lock}:` or annotate"
+                " the function `# jt: holds(...)`)")
+
+    def _emit(self, out, sf, rule, node, scope, msg) -> None:
+        if sf.allowed(node.lineno, rule):
+            return
+        out.append(Finding(rule, sf.rel, node.lineno, node.col_offset,
+                           msg, scope))
+
+
+register(LockDiscipline())

@@ -1,0 +1,274 @@
+"""obs-hygiene pass: span pairing + metric naming/registration checks.
+
+The obs subsystem (PR 2) threads spans and metrics through every layer
+seam.  Two failure modes are invisible at runtime: a span started but
+never exited leaks onto the thread-local stack and silently re-parents
+every later span on that thread; and a metric name that drifts from
+the ``jepsen_*`` convention (or is registered under conflicting
+instrument kinds) renders an invalid Prometheus exposition that only a
+scraper would notice.
+
+Rules:
+
+- ``obs-span-discipline`` — a span handle (``obs.span(...)`` /
+  ``tracer.span(...)``) used outside a ``with`` statement without a
+  visible balanced ``__enter__``/``__exit__`` pair in the same
+  function: a bare expression statement discards the context manager
+  (the span never records), and a manual ``__enter__`` without an
+  ``__exit__`` on all paths leaks it.  Returning the handle is fine —
+  pairing becomes the caller's job (that's how ``obs.span`` itself
+  delegates to the tracer).
+- ``obs-metric-name`` — the metric name passed to a recording shorthand
+  (``obs.count/gauge_set/gauge_max/observe``) or registry constructor
+  (``.counter/.gauge/.histogram``) must be a string literal matching
+  ``jepsen_[a-z0-9_]*`` (doc/observability.md's convention), or an
+  f-string whose literal head carries the ``jepsen_`` prefix (the
+  compile/execute-phase pattern).
+- ``obs-metric-kind`` — one metric name used as two different
+  instrument kinds across the scanned tree (e.g. ``obs.count`` in one
+  module, ``obs.observe`` in another): the registry would intern both
+  and the exposition would emit two conflicting TYPE lines.
+- ``obs-metric-doc`` — a literal ``jepsen_*`` metric name recorded in
+  code but missing from doc/observability.md's metric inventory:
+  the doc is the operator contract; undocumented series are drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
+                   dotted_name, register)
+
+METRIC_NAME_RE = re.compile(r"^jepsen_[a-z][a-z0-9_]*$")
+
+#: obs-module shorthands -> instrument kind
+OBS_SHORTHANDS = {
+    "count": "counter",
+    "gauge_set": "gauge",
+    "gauge_max": "gauge",
+    "observe": "histogram",
+}
+#: registry constructor methods -> kind (any receiver)
+REGISTRY_CTORS = {
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+}
+
+#: receivers whose ``.span(...)`` is a tracer span
+SPAN_RECEIVERS = {"obs", "tracer", "_tracer", "self._tracer"}
+
+
+def _default_doc_path() -> Optional[str]:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p = os.path.join(root, "doc", "observability.md")
+    return p if os.path.exists(p) else None
+
+
+def _metric_call(node: ast.Call) -> Optional[str]:
+    """Instrument kind when this call registers/records a metric."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    base = dotted_name(node.func.value)
+    if attr in OBS_SHORTHANDS and base == "obs":
+        return OBS_SHORTHANDS[attr]
+    if attr in REGISTRY_CTORS:
+        # registry method on any receiver — but require the first arg
+        # to be string-ish so `histogram.observe(0.5)` style value
+        # recordings (numeric arg) aren't misread as registrations
+        if node.args and isinstance(node.args[0],
+                                    (ast.Constant, ast.JoinedStr)):
+            if isinstance(node.args[0], ast.JoinedStr):
+                return REGISTRY_CTORS[attr]
+            if isinstance(node.args[0].value, str):
+                return REGISTRY_CTORS[attr]
+        return None
+    return None
+
+
+class ObsHygiene(Pass):
+    name = "obs-hygiene"
+    rules = ("obs-span-discipline", "obs-metric-name", "obs-metric-kind",
+             "obs-metric-doc")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        #: name -> [(kind, sf, node)]
+        sites: Dict[str, List[Tuple[str, SourceFile, ast.AST]]] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            idx = FunctionIndex(sf.tree)
+            self._check_spans(sf, idx, out)
+            self._check_metrics(sf, idx, sites, out)
+        self._check_kinds(sites, out)
+        self._check_doc(project, sites, out)
+        return out
+
+    # -- span pairing ------------------------------------------------------
+
+    def _span_call(self, node: ast.Call) -> bool:
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "span":
+            base = dotted_name(node.func.value)
+            if base in SPAN_RECEIVERS:
+                return True
+            # tracer().span(...)
+            if (isinstance(node.func.value, ast.Call)
+                    and (dotted_name(node.func.value.func) or "").endswith(
+                        "tracer")):
+                return True
+        return False
+
+    def _check_spans(self, sf: SourceFile, idx: FunctionIndex,
+                     out: List[Finding]) -> None:
+        # classify every span call: with-item / returned / assigned /
+        # bare.  Parent links via a single walk.
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and self._span_call(node)):
+                continue
+            parent = parents.get(id(node))
+            if isinstance(parent, ast.withitem):
+                continue
+            if isinstance(parent, ast.Return):
+                continue  # delegation: pairing is the caller's job
+            scope = idx.enclosing(sf.tree, node)
+            if isinstance(parent, ast.Expr):
+                self._emit(out, sf, "obs-span-discipline", node, scope,
+                           "span created and discarded: the context manager"
+                           " is never entered, so the span never records —"
+                           " use `with obs.span(...):`")
+                continue
+            if isinstance(parent, ast.Assign) and all(
+                    isinstance(t, ast.Name) for t in parent.targets):
+                name = parent.targets[0].id
+                fn_q = idx.enclosing(sf.tree, node)
+                fn = idx.funcs.get(fn_q)
+                body = fn if fn is not None else sf.tree
+                entered = exited = False
+                in_finally = False
+                for n in ast.walk(body):
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)
+                            and isinstance(n.func.value, ast.Name)
+                            and n.func.value.id == name):
+                        if n.func.attr == "__enter__":
+                            entered = True
+                        elif n.func.attr == "__exit__":
+                            exited = True
+                    if isinstance(n, ast.Try) and n.finalbody:
+                        for fb in n.finalbody:
+                            for m in ast.walk(fb):
+                                if (isinstance(m, ast.Call)
+                                        and isinstance(m.func, ast.Attribute)
+                                        and isinstance(m.func.value, ast.Name)
+                                        and m.func.value.id == name
+                                        and m.func.attr == "__exit__"):
+                                    in_finally = True
+                    if (isinstance(n, ast.With) and any(
+                            isinstance(it.context_expr, ast.Name)
+                            and it.context_expr.id == name
+                            for it in n.items)):
+                        entered = exited = in_finally = True
+                if entered and not in_finally:
+                    self._emit(out, sf, "obs-span-discipline", node, scope,
+                               f"span `{name}` is entered manually but has"
+                               " no `__exit__` in a finally block — an"
+                               " exception leaks the span onto the"
+                               " thread-local stack")
+                elif not entered and not exited:
+                    self._emit(out, sf, "obs-span-discipline", node, scope,
+                               f"span assigned to `{name}` but never"
+                               " entered/exited in this function — use"
+                               " `with`, or pair __enter__/__exit__ in a"
+                               " try/finally")
+
+    # -- metric naming -----------------------------------------------------
+
+    def _check_metrics(self, sf, idx, sites, out) -> None:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _metric_call(node)
+            if kind is None:
+                continue
+            scope = idx.enclosing(sf.tree, node)
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+                if not METRIC_NAME_RE.match(name):
+                    self._emit(out, sf, "obs-metric-name", node, scope,
+                               f"metric name {name!r} violates the"
+                               " `jepsen_[a-z0-9_]*` naming convention"
+                               " (doc/observability.md)")
+                else:
+                    sites.setdefault(name, []).append((kind, sf, node))
+            elif isinstance(arg, ast.JoinedStr):
+                head = arg.values[0] if arg.values else None
+                if not (isinstance(head, ast.Constant)
+                        and isinstance(head.value, str)
+                        and head.value.startswith("jepsen_")):
+                    self._emit(out, sf, "obs-metric-name", node, scope,
+                               "dynamic metric name must carry a literal"
+                               " `jepsen_` prefix so the family is"
+                               " greppable and convention-checked")
+            else:
+                self._emit(out, sf, "obs-metric-name", node, scope,
+                           "metric name must be a string literal (or a"
+                           " jepsen_-prefixed f-string): non-literal names"
+                           " defeat static registration checks")
+
+    def _check_kinds(self, sites, out) -> None:
+        for name, entries in sorted(sites.items()):
+            entries_sorted = sorted(
+                entries, key=lambda e: (e[1].rel, e[2].lineno, e[2].col_offset)
+            )
+            first_kind = entries_sorted[0][0]
+            for kind, sf, node in entries_sorted[1:]:
+                if kind != first_kind:
+                    # no line number in the message: it feeds the
+                    # baseline fingerprint, which must survive line
+                    # drift at the first site
+                    self._emit(out, sf, "obs-metric-kind", node, "",
+                               f"metric {name!r} recorded as {kind} here but"
+                               f" as {first_kind} in"
+                               f" {entries_sorted[0][1].rel} — one name,"
+                               " one instrument kind")
+
+    def _check_doc(self, project, sites, out) -> None:
+        doc_path = project.options.get("metric_doc", "__default__")
+        if doc_path == "__default__":
+            doc_path = _default_doc_path()
+        if not doc_path or not os.path.exists(doc_path):
+            return
+        with open(doc_path, "r", encoding="utf-8") as f:
+            documented = set(re.findall(r"jepsen_[a-z0-9_]+", f.read()))
+        for name, entries in sorted(sites.items()):
+            if name in documented:
+                continue
+            kind, sf, node = sorted(
+                entries, key=lambda e: (e[1].rel, e[2].lineno))[0]
+            self._emit(out, sf, "obs-metric-doc", node, "",
+                       f"metric {name!r} is recorded here but missing from"
+                       f" {os.path.basename(doc_path)}'s inventory — "
+                       "document the series or drop it")
+
+    def _emit(self, out, sf, rule, node, scope, msg) -> None:
+        if sf.allowed(node.lineno, rule):
+            return
+        out.append(Finding(rule, sf.rel, node.lineno, node.col_offset,
+                           msg, scope))
+
+
+register(ObsHygiene())

@@ -1,0 +1,416 @@
+"""protocol-conformance pass: checker seams and suite reference drift.
+
+The harness is table-driven at its edges: checkers all flow through one
+``check(test, history, opts)`` seam (``checker.check_safe`` is the
+universal funnel), suites look workloads up by name in the
+``workloads``/``suites.common`` tables, and fault menus key the
+``nemesis.combined`` packages.  String-keyed seams drift silently —
+a suite naming a workload that was renamed keeps importing fine and
+only crashes (or worse, silently runs the wrong checker) at run time.
+With 40+ suite modules that drift is a *when*, not an *if*.
+
+Rules:
+
+- ``proto-check-signature`` — a class in ``checker/`` that subclasses
+  ``Checker`` (or is named ``*Checker``) must define
+  ``check(self, test, history, opts=None)``: exactly those four
+  parameters, the last defaulted, no extras.
+- ``proto-check-return`` — inside such a ``check``, a ``return`` of an
+  obviously wrong literal: a dict literal missing ``"valid?"`` (unless
+  it spreads ``**other``), or a list/tuple/str/number.  ``None`` is
+  tolerated (``check_safe`` normalizes it); non-literal returns are
+  assumed correct.
+- ``proto-workload-ref`` — a workload name (literal argument to
+  ``generic_workload``/``workload``, or an element of a module-level
+  ``WORKLOADS`` constant) that exists in neither the generic table
+  (``suites/common.py``) nor the core table
+  (``workloads/__init__.py``).
+- ``proto-fault-ref`` — a fault-name literal (elements of a list/set
+  passed as the ``"faults"`` key or the ``opts.get("faults", …)``
+  default) outside the known vocabulary: the builtin package names
+  (partition/kill/pause/clock/disk) plus every ``KNOWN_FAULTS``
+  constant declared across ``suites/``.
+- ``proto-suite-exports`` — a name listed in ``suites/__init__.py``'s
+  ``SUITES`` tuple whose module is missing or doesn't define the four
+  documented seams (``db``/``client``/``workloads``/``test``).
+- ``proto-unused-import`` — an import in a ``suites/`` module whose
+  name is never referenced (scoped to suites: that's where dead
+  protocol imports accumulate as clients get rewritten).
+
+Suite rules key off directory names (``suites``/``checker``) so the
+pass works identically on fixture trees in tests.  The known
+workload/fault tables are parsed from this repo's own sources by
+default and can be overridden through ``Project.options``
+(``workload_names``/``fault_names``) for fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
+                   dotted_name, load_file, register)
+
+BUILTIN_FAULTS = {"partition", "kill", "pause", "clock", "disk"}
+SUITE_SEAMS = ("db", "client", "workloads", "test")
+CHECK_PARAMS = ("self", "test", "history", "opts")
+
+
+def _pkg_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _literal_strs(node: ast.AST) -> Optional[List[str]]:
+    """Elements of a tuple/list/set literal of string constants."""
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None  # non-literal element: don't judge
+        return out
+    return None
+
+
+def _dict_keys(fn_body: ast.AST, dict_name: str) -> Set[str]:
+    """String keys of every dict literal assigned to ``dict_name``
+    inside ``fn_body`` (the `table = {...}` pattern)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn_body):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == dict_name
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        out.add(k.value)
+    return out
+
+
+def known_workload_names(project: Project) -> Optional[Set[str]]:
+    """The union of the generic table (suites/common.py) and the core
+    table (workloads/__init__.py), parsed statically."""
+    if "workload_names" in project.options:
+        names = project.options["workload_names"]
+        return set(names) if names is not None else None
+    out: Set[str] = set()
+    found = False
+    for rel, fn_name in (
+        (os.path.join("suites", "common.py"), "generic_workload"),
+        (os.path.join("workloads", "__init__.py"), "_table"),
+    ):
+        path = os.path.join(_pkg_root(), rel)
+        if not os.path.exists(path):
+            continue
+        sf = load_file(path, rel)
+        if sf.tree is None:
+            continue
+        idx = FunctionIndex(sf.tree)
+        for q, fn in idx.funcs.items():
+            if q.rsplit(".", 1)[-1] == fn_name:
+                keys = _dict_keys(fn, "table")
+                if keys:
+                    out |= keys
+                    found = True
+    return out if found else None
+
+
+def known_fault_names(project: Project) -> Set[str]:
+    if "fault_names" in project.options:
+        return set(project.options["fault_names"] or ()) | BUILTIN_FAULTS
+    out = set(BUILTIN_FAULTS)
+    # every KNOWN_FAULTS constant across the scanned suites/ files AND
+    # the real package (suites can import each other's menus)
+    roots = [sf for sf in project.files_in("suites")]
+    pkg_suites = os.path.join(_pkg_root(), "suites")
+    if os.path.isdir(pkg_suites):
+        for fn in sorted(os.listdir(pkg_suites)):
+            if fn.endswith(".py"):
+                roots.append(load_file(os.path.join(pkg_suites, fn),
+                                       os.path.join("suites", fn)))
+    for sf in roots:
+        if sf.tree is None:
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and "FAULTS" in t.id
+                    for t in node.targets):
+                vals = node.value
+                if isinstance(vals, ast.Call):  # frozenset((...)) etc.
+                    vals = vals.args[0] if vals.args else vals
+                lits = _literal_strs(vals)
+                if lits:
+                    out |= set(lits)
+    return out
+
+
+class Protocol(Pass):
+    name = "protocol"
+    rules = ("proto-check-signature", "proto-check-return",
+             "proto-workload-ref", "proto-fault-ref",
+             "proto-suite-exports", "proto-unused-import")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in project.files_in("checker"):
+            if sf.tree is not None:
+                self._check_checker(sf, out)
+        suite_files = project.files_in("suites")
+        if suite_files:
+            workloads = known_workload_names(project)
+            faults = known_fault_names(project)
+            for sf in suite_files:
+                if sf.tree is None:
+                    continue
+                if workloads is not None:
+                    self._check_workload_refs(sf, workloads, out)
+                self._check_fault_refs(sf, faults, out)
+                self._check_unused_imports(sf, out)
+            self._check_suite_exports(project, suite_files, out)
+        return out
+
+    # -- checker seam ------------------------------------------------------
+
+    def _checker_classes(self, sf: SourceFile) -> List[Tuple[str, ast.ClassDef]]:
+        idx = FunctionIndex(sf.tree)
+        out = []
+        for q, cls in idx.classes.items():
+            if cls.name == "Checker":
+                continue  # the ABC itself
+            bases = {dotted_name(b) or "" for b in cls.bases}
+            base_tail = {b.rsplit(".", 1)[-1] for b in bases}
+            if "Checker" in base_tail or cls.name.endswith("Checker"):
+                out.append((q, cls))
+        return out
+
+    def _check_checker(self, sf: SourceFile, out: List[Finding]) -> None:
+        for q, cls in sorted(self._checker_classes(sf)):
+            check_fn = None
+            for node in cls.body:
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name == "check"):
+                    check_fn = node
+                    break
+            if check_fn is None:
+                # inheriting check from a parent Checker subclass is
+                # fine; only flag classes that directly subclass the ABC
+                bases = {(dotted_name(b) or "").rsplit(".", 1)[-1]
+                         for b in cls.bases}
+                if bases == {"Checker"}:
+                    self._emit(out, sf, "proto-check-signature", cls, q,
+                               f"checker `{cls.name}` subclasses Checker"
+                               " directly but defines no `check` method")
+                continue
+            self._check_signature(sf, q, check_fn, out)
+            self._check_returns(sf, q, check_fn, out)
+
+    def _check_signature(self, sf, q, fn: ast.FunctionDef, out) -> None:
+        a = fn.args
+        names = tuple(p.arg for p in a.args)
+        ok = (
+            names == CHECK_PARAMS
+            and not a.posonlyargs and not a.kwonlyargs
+            and a.vararg is None and a.kwarg is None
+            and len(a.defaults) >= 1
+            and isinstance(a.defaults[-1], ast.Constant)
+            and a.defaults[-1].value is None
+        )
+        if not ok:
+            self._emit(
+                out, sf, "proto-check-signature", fn, f"{q}.check",
+                f"`{q}.check` must have the universal seam signature"
+                " `check(self, test, history, opts=None)` (check_safe and"
+                f" compose call it positionally); found"
+                f" ({', '.join(names) or 'no args'})")
+
+    def _own_returns(self, fn: ast.FunctionDef) -> List[ast.Return]:
+        """``return`` statements belonging to ``fn`` itself (nested
+        defs/lambdas have their own contracts)."""
+        out: List[ast.Return] = []
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Return):
+                    out.append(child)
+                visit(child)
+
+        visit(fn)
+        return out
+
+    def _check_returns(self, sf, q, fn: ast.FunctionDef, out) -> None:
+        for node in self._own_returns(fn):
+            if node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Dict):
+                keys = [k.value for k in v.keys
+                        if isinstance(k, ast.Constant)]
+                has_spread = any(k is None for k in v.keys)
+                if "valid?" not in keys and not has_spread:
+                    self._emit(
+                        out, sf, "proto-check-return", node, f"{q}.check",
+                        f"`{q}.check` returns a dict literal without a"
+                        " \"valid?\" key — the verdict contract every"
+                        " caller (check_safe, compose, CLI) reads")
+            elif isinstance(v, (ast.List, ast.Tuple)) or (
+                    isinstance(v, ast.Constant)
+                    and v.value is not None
+                    and not isinstance(v.value, dict)):
+                self._emit(
+                    out, sf, "proto-check-return", node, f"{q}.check",
+                    f"`{q}.check` returns a non-dict literal — the seam"
+                    " contract is a {\"valid?\": ...} dict (None is"
+                    " normalized by check_safe)")
+
+    # -- suite references --------------------------------------------------
+
+    def _check_workload_refs(self, sf, known: Set[str], out) -> None:
+        # direct literal calls
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                fname = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                if fname in ("generic_workload", "workload") and node.args:
+                    arg = node.args[0]
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)
+                            and arg.value not in known):
+                        self._emit(
+                            out, sf, "proto-workload-ref", arg, "",
+                            f"workload {arg.value!r} is not in the generic"
+                            " or core workload tables (known:"
+                            f" {', '.join(sorted(known))})")
+        # module-level WORKLOADS constants (iterated into the tables)
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "WORKLOADS"
+                    for t in node.targets):
+                lits = _literal_strs(node.value)
+                for name in lits or ():
+                    if name not in known:
+                        self._emit(
+                            out, sf, "proto-workload-ref", node, "",
+                            f"WORKLOADS entry {name!r} is not in the"
+                            " generic or core workload tables")
+
+    def _check_fault_refs(self, sf, known: Set[str], out) -> None:
+        for node in ast.walk(sf.tree):
+            lists: List[ast.AST] = []
+            if isinstance(node, ast.Call):
+                # opts.get("faults", [...]) defaults
+                fname = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                if (fname == "get" and len(node.args) == 2
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value == "faults"):
+                    lists.append(node.args[1])
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant)
+                            and k.value == "faults"):
+                        lists.append(v)
+            for lst in lists:
+                for name in _literal_strs(lst) or ():
+                    if name not in known:
+                        self._emit(
+                            out, sf, "proto-fault-ref", lst, "",
+                            f"fault {name!r} is not a builtin package name"
+                            f" ({', '.join(sorted(BUILTIN_FAULTS))}) or any"
+                            " suite's KNOWN_FAULTS menu")
+
+    def _check_unused_imports(self, sf, out) -> None:
+        if os.path.basename(sf.path) == "__init__.py":
+            has_all = any(
+                isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in n.targets)
+                for n in sf.tree.body)
+            if has_all:
+                return  # re-export module
+        imported: Dict[str, Tuple[int, int]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    imported[name] = (node.lineno, node.col_offset)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imported[a.asname or a.name] = (node.lineno,
+                                                    node.col_offset)
+        used: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+        for name, (line, col) in sorted(imported.items(),
+                                        key=lambda kv: kv[1]):
+            if name in used:
+                continue
+            if sf.allowed(line, "proto-unused-import"):
+                continue
+            out.append(Finding(
+                "proto-unused-import", sf.rel, line, col,
+                f"`{name}` is imported but never used", ""))
+
+    def _check_suite_exports(self, project, suite_files, out) -> None:
+        init = None
+        for sf in suite_files:
+            if os.path.basename(sf.path) == "__init__.py":
+                init = sf
+                break
+        if init is None or init.tree is None:
+            return
+        suites: List[str] = []
+        decl = None
+        for node in init.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "SUITES"
+                    for t in node.targets):
+                suites = _literal_strs(node.value) or []
+                decl = node
+        if not suites:
+            return
+        base = os.path.dirname(init.path)
+        # resolve SIBLINGS of __init__.py only — a same-named module in
+        # a subpackage (suites/proto/aerospike.py) is not the suite
+        by_path = {os.path.abspath(sf.path): sf for sf in suite_files}
+        for name in suites:
+            fname = f"{name}.py"
+            path = os.path.join(base, fname)
+            sf = by_path.get(os.path.abspath(path))
+            if sf is None and os.path.exists(path):
+                sf = load_file(path, os.path.join("suites", fname))
+            if sf is None or sf.tree is None:
+                if not init.allowed(decl.lineno, "proto-suite-exports"):
+                    out.append(Finding(
+                        "proto-suite-exports", init.rel, decl.lineno, 0,
+                        f"SUITES lists {name!r} but suites/{fname} does"
+                        " not exist", "SUITES"))
+                continue
+            defined = {n.name for n in sf.tree.body
+                       if isinstance(n, ast.FunctionDef)}
+            missing = [s for s in SUITE_SEAMS if s not in defined]
+            if missing and not init.allowed(decl.lineno,
+                                            "proto-suite-exports"):
+                out.append(Finding(
+                    "proto-suite-exports", sf.rel, 1, 0,
+                    f"suite `{name}` is missing the documented seam"
+                    f" function(s): {', '.join(missing)} (suites/__init__"
+                    " contract)", ""))
+
+    def _emit(self, out, sf, rule, node, scope, msg) -> None:
+        line = getattr(node, "lineno", 1)
+        if sf.allowed(line, rule):
+            return
+        out.append(Finding(rule, sf.rel, line,
+                           getattr(node, "col_offset", 0), msg, scope))
+
+
+register(Protocol())

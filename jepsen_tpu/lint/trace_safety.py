@@ -1,0 +1,297 @@
+"""trace-safety pass: host impurity inside traced code, implicit syncs.
+
+The paper's core bet is that model step functions survive translation
+into jit/vmap/pmap kernels — which only holds if the kernel code stays
+*trace-pure*.  Host side effects under ``jax.jit`` run once at trace
+time and silently vanish from every cached re-execution (a verdict
+corrupted without an exception), and an implicit host sync inside the
+dispatch path re-opens exactly the host/device bubble the pipelined
+engine exists to close.
+
+The pass builds a per-module "traced set":
+
+1. roots: functions decorated ``@jax.jit`` / ``@jit`` / ``@jax.vmap``
+   / ``@jax.pmap`` / ``@partial(jax.jit, …)``, functions wrapped at a
+   call site (``jax.jit(f)``, ``jax.vmap(f)``), and functions marked
+   ``# jt: traced`` (for registry indirection the call graph can't
+   see, e.g. ``step_kernels.SPECS``);
+2. closure: functions defined inside traced functions, and module-local
+   functions a traced function calls (name-level fixpoint).
+
+Rules, inside traced code:
+
+- ``trace-host-mutation`` — ``global``/``nonlocal`` declarations: the
+  mutation happens at trace time only.
+- ``trace-impure-call`` — ``time.*`` / ``random.*`` / ``np.random.*``
+  calls: the value is frozen into the compiled executable.
+- ``trace-print`` — ``print(...)``: fires once at trace time (use
+  ``jax.debug.print`` for runtime prints).
+- ``trace-host-convert`` — ``.item()`` / ``.tolist()`` on anything, or
+  ``np.asarray``/``np.array`` applied to a function parameter (a
+  tracer): host conversion of a tracer raises at best, silently
+  constant-folds at worst.
+
+And outside traced code:
+
+- ``trace-sync`` — ``.block_until_ready()`` anywhere, and
+  ``np.asarray``/``np.array`` wrapped directly around a call to a
+  traced function (or a traced-fn *producer* — a builder that returns
+  one): an inline dispatch-and-materialize blocks the host for the
+  full kernel, which inside the engine's dispatch window is exactly
+  the bubble PR 4 removed.  Sanctioned sync points (the window's
+  retirement ``_materialize``, single-item convenience APIs) carry
+  ``# jt: allow[trace-sync]`` with a rationale — that comment IS the
+  allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
+                   call_targets, dotted_name, register)
+
+#: decorator / wrapper dotted names that make a function traced
+TRACING_WRAPPERS = {
+    "jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+}
+
+IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+
+HOST_CONVERT_ATTRS = {"item", "tolist"}
+NP_CONVERT = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+              "onp.asarray", "onp.array"}
+
+
+def _is_tracing_wrapper(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if name in TRACING_WRAPPERS:
+        return True
+    # partial(jax.jit, ...) / functools.partial(jit, static_argnums=...)
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("partial", "functools.partial") and node.args:
+            return _is_tracing_wrapper(node.args[0])
+        # jax.jit(f, static_argnums=...) used as a decorator factory
+        if fname in TRACING_WRAPPERS:
+            return True
+    return False
+
+
+class _ModuleTraceModel:
+    """Traced set + producer set for one module."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.index = FunctionIndex(sf.tree)
+        self.traced: Set[str] = set()
+        self.producers: Set[str] = set()
+        self._build()
+
+    def _build(self) -> None:
+        idx = self.index
+        # 1. decorated / annotated roots
+        for q, fn in idx.funcs.items():
+            for dec in getattr(fn, "decorator_list", ()):
+                if _is_tracing_wrapper(dec):
+                    self.traced.add(q)
+            if self.sf.marked(fn.lineno, "traced"):
+                self.traced.add(q)
+        # 2. wrap-at-call-site roots: jax.jit(f) / jax.vmap(f) with a
+        # plain name argument resolving to a local function
+        by_name: Dict[str, List[str]] = {}
+        for q in idx.funcs:
+            by_name.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+        for node in ast.walk(self.sf.tree):
+            if (isinstance(node, ast.Call)
+                    and _is_tracing_wrapper(node.func) and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                for q in by_name.get(node.args[0].id, ()):
+                    self.traced.add(q)
+        # 3. closure: nested defs of traced fns + called local fns
+        changed = True
+        while changed:
+            changed = False
+            for q in list(self.traced):
+                # nested definitions
+                for q2, parent in idx.parents.items():
+                    if parent == q and q2 not in self.traced:
+                        self.traced.add(q2)
+                        changed = True
+                fn = idx.funcs.get(q)
+                if fn is None:
+                    continue
+                for callee in call_targets(fn):
+                    for q2 in by_name.get(callee, ()):
+                        if q2 not in self.traced:
+                            self.traced.add(q2)
+                            changed = True
+        # 4. producers: functions whose return statement returns a
+        # traced local fn (by name) or a tracing-wrapper call
+        for q, fn in idx.funcs.items():
+            if q in self.traced:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                v = node.value
+                if (isinstance(v, ast.Name)
+                        and any(t.rsplit(".", 1)[-1] == v.id
+                                and idx.parents.get(t) == q
+                                for t in self.traced)):
+                    self.producers.add(q)
+                elif isinstance(v, ast.Call) and _is_tracing_wrapper(v.func):
+                    self.producers.add(q)
+
+    def is_device_call(self, node: ast.AST) -> bool:
+        """Does this expression subtree contain a call that dispatches a
+        traced fn — ``traced(...)`` or ``producer(...)(…)``?"""
+        names = {q.rsplit(".", 1)[-1] for q in self.traced}
+        prod = {q.rsplit(".", 1)[-1] for q in self.producers}
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            if isinstance(n.func, ast.Name) and n.func.id in names:
+                return True
+            if (isinstance(n.func, ast.Call)
+                    and isinstance(n.func.func, ast.Name)
+                    and n.func.func.id in prod):
+                return True
+        return False
+
+
+def _params_of(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    out = {p.arg for p in
+           list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    out.discard("self")
+    return out
+
+
+class TraceSafety(Pass):
+    name = "trace-safety"
+    rules = ("trace-host-mutation", "trace-impure-call", "trace-print",
+             "trace-host-convert", "trace-sync")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            model = _ModuleTraceModel(sf)
+            self._check_traced(sf, model, out)
+            self._check_syncs(sf, model, out)
+        return out
+
+    def _emit(self, out, sf, rule, node, msg, scope) -> None:
+        if sf.allowed(node.lineno, rule):
+            return
+        out.append(Finding(rule, sf.rel, node.lineno,
+                           getattr(node, "col_offset", 0), msg, scope))
+
+    def _own_nodes(self, fn: ast.AST):
+        """Nodes of ``fn`` excluding nested def subtrees — each nested
+        def is in the traced set itself (nesting rule) and reports its
+        own violations exactly once.  Lambdas stay in: they have no
+        qualname of their own."""
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                yield child
+                yield from visit(child)
+        yield from visit(fn)
+
+    def _check_traced(self, sf: SourceFile, model: _ModuleTraceModel,
+                      out: List[Finding]) -> None:
+        idx = model.index
+        for q in sorted(model.traced):
+            fn = idx.funcs.get(q)
+            if fn is None:
+                continue
+            params = _params_of(fn)
+            for node in self._own_nodes(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    self._emit(
+                        out, sf, "trace-host-mutation", node,
+                        f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                        f" {', '.join(node.names)}` inside traced function"
+                        f" `{q}`: the mutation runs once at trace time and"
+                        " is absent from every cached re-execution", q)
+                elif isinstance(node, ast.Call):
+                    name = dotted_name(node.func) or ""
+                    if name == "print":
+                        self._emit(
+                            out, sf, "trace-print", node,
+                            f"print() inside traced function `{q}` fires at"
+                            " trace time only; use jax.debug.print for"
+                            " runtime output", q)
+                    elif any(name.startswith(p) for p in IMPURE_PREFIXES):
+                        self._emit(
+                            out, sf, "trace-impure-call", node,
+                            f"call to `{name}` inside traced function `{q}`:"
+                            " the result is frozen into the compiled"
+                            " executable at trace time", q)
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in HOST_CONVERT_ATTRS
+                          and not node.args):
+                        self._emit(
+                            out, sf, "trace-host-convert", node,
+                            f"`.{node.func.attr}()` inside traced function"
+                            f" `{q}` forces a tracer to the host", q)
+                    elif (name in NP_CONVERT and node.args
+                          and isinstance(node.args[0], ast.Name)
+                          and node.args[0].id in params):
+                        self._emit(
+                            out, sf, "trace-host-convert", node,
+                            f"`{name}({node.args[0].id})` inside traced"
+                            f" function `{q}` converts a traced argument"
+                            " on the host", q)
+
+    def _check_syncs(self, sf: SourceFile, model: _ModuleTraceModel,
+                     out: List[Finding]) -> None:
+        idx = model.index
+        traced_nodes = {id(idx.funcs[q]) for q in model.traced
+                        if q in idx.funcs}
+
+        def in_traced(node: ast.AST) -> bool:
+            q = idx.enclosing(sf.tree, node)
+            while q:
+                f = idx.funcs.get(q)
+                if f is not None and id(f) in traced_nodes:
+                    return True
+                q = q.rsplit(".", 1)[0] if "." in q else ""
+            return False
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                scope = idx.enclosing(sf.tree, node)
+                self._emit(
+                    out, sf, "trace-sync", node,
+                    "explicit `.block_until_ready()` sync: stalls the host"
+                    " on the device — inside the dispatch window this is"
+                    " the bubble the pipelined engine removes", scope)
+                continue
+            name = dotted_name(node.func)
+            if name in NP_CONVERT and node.args:
+                if model.is_device_call(node.args[0]) and not in_traced(node):
+                    scope = idx.enclosing(sf.tree, node)
+                    self._emit(
+                        out, sf, "trace-sync", node,
+                        f"`{name}(...)` materializes a traced-kernel result"
+                        " inline (dispatch-and-sync); route device work"
+                        " through the engine DispatchWindow or annotate the"
+                        " sanctioned sync point", scope)
+        return None
+
+
+register(TraceSafety())

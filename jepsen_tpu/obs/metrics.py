@@ -56,7 +56,7 @@ class Counter(_Instrument):
 
     def __init__(self, registry, name, labels):
         super().__init__(registry, name, labels)
-        self.value = 0
+        self.value = 0  # jt: guarded-by(_lock)
 
     def inc(self, n: int = 1) -> None:
         if not self._registry.enabled:
@@ -70,7 +70,7 @@ class Gauge(_Instrument):
 
     def __init__(self, registry, name, labels):
         super().__init__(registry, name, labels)
-        self.value = 0.0
+        self.value = 0.0  # jt: guarded-by(_lock)
 
     def set(self, v: float) -> None:
         if not self._registry.enabled:
@@ -98,10 +98,10 @@ class Histogram(_Instrument):
     def __init__(self, registry, name, labels,
                  buckets: Sequence[float] = DEFAULT_BUCKETS):
         super().__init__(registry, name, labels)
-        self.buckets = tuple(buckets)
-        self.counts = [0] * (len(self.buckets) + 1)  # +inf overflow slot
-        self.sum = 0.0
-        self.count = 0
+        self.buckets = tuple(buckets)  # immutable after init: no guard
+        self.counts = [0] * (len(self.buckets) + 1)  # jt: guarded-by(_lock)
+        self.sum = 0.0  # jt: guarded-by(_lock)
+        self.count = 0  # jt: guarded-by(_lock)
 
     def observe(self, v: float) -> None:
         if not self._registry.enabled:
@@ -128,12 +128,14 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._instruments: Dict[Tuple[str, str, LabelKey], _Instrument] = {}
+        self._instruments: Dict[Tuple[str, str, LabelKey], _Instrument] = {}  # jt: guarded-by(_lock)
 
     def _get(self, kind: str, cls, name: str, labels: Dict[str, str],
              **kw) -> _Instrument:
         key = (kind, name, _label_key(labels))
-        inst = self._instruments.get(key)
+        # lock-free fast path: a GIL-atomic dict read; double-checked
+        # under the lock below before any insert
+        inst = self._instruments.get(key)  # jt: allow[lock-discipline]
         if inst is None:
             with self._lock:
                 inst = self._instruments.get(key)
@@ -189,9 +191,14 @@ class MetricsRegistry:
     def value(self, name: str, **labels) -> Optional[float]:
         """Read one counter/gauge value (None when never recorded)."""
         for kind in ("counter", "gauge"):
-            inst = self._instruments.get((kind, name, _label_key(labels)))
+            # GIL-atomic dict read, same rationale as _get's fast path;
+            # the value itself is read under the instrument's own lock
+            # (the lock its guarded-by annotation names)
+            inst = self._instruments.get(  # jt: allow[lock-discipline]
+                (kind, name, _label_key(labels)))
             if inst is not None:
-                return inst.value
+                with inst._lock:
+                    return inst.value
         return None
 
     def prometheus_text(self) -> str:

@@ -137,10 +137,10 @@ class Tracer:
         #: entry); lets exports align spans with history op times
         self.run_anchor_ns: Optional[int] = None
         self._lock = threading.Lock()
-        self._spans: List[SpanRecord] = []
-        self._dropped = 0
-        self._next_sid = 0
-        self._local = threading.local()
+        self._spans: List[SpanRecord] = []  # jt: guarded-by(_lock)
+        self._dropped = 0  # jt: guarded-by(_lock)
+        self._next_sid = 0  # jt: guarded-by(_lock)
+        self._local = threading.local()  # per-thread by construction
 
     # -- span lifecycle ----------------------------------------------------
 

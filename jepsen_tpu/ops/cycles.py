@@ -112,6 +112,8 @@ def reachability(adj: np.ndarray) -> np.ndarray:
     n = _bucket(adj.shape[0])
     padded = np.zeros((n, n), dtype=bool)
     padded[: adj.shape[0], : adj.shape[1]] = adj
-    return np.asarray(_reach_fn(n)(jnp.asarray(padded)))[
+    # single-matrix convenience API: the caller wants the closure NOW,
+    # there is no batch to overlap with — sanctioned inline sync
+    return np.asarray(_reach_fn(n)(jnp.asarray(padded)))[  # jt: allow[trace-sync]
         : adj.shape[0], : adj.shape[1]
     ]

@@ -16,7 +16,7 @@ the reference's ``gen-policy EXPECT_GEN_EQUAL``.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent

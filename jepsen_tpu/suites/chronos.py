@@ -20,8 +20,7 @@ mis-assign runs).
 from __future__ import annotations
 
 import datetime as dt
-import json
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from .. import checker as checker_mod
 from .. import client as client_mod

@@ -17,7 +17,7 @@ the union of its seen writes' expectations.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from .. import generator as gen
 from .. import independent

@@ -26,7 +26,6 @@ from .. import checker as checker_mod
 from .. import client as client_mod
 from .. import db as db_mod
 from .. import generator as gen
-from ..checker import timeline
 from ..control import util as cu
 from ..nemesis import combined
 from ..workloads import noop_test

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import base64
 import json
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent

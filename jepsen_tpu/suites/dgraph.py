@@ -16,7 +16,7 @@ API: ``/alter`` for schema, ``/mutate?commitNow=true`` with RDF/JSON,
 from __future__ import annotations
 
 import json
-from typing import Any, Optional
+from typing import Optional
 
 from .. import checker as checker_mod
 from .. import client as client_mod

@@ -10,7 +10,7 @@ The client here speaks disque's RESP protocol directly.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import control

@@ -11,12 +11,9 @@ dirty_read.clj).  The reference's Java client becomes the JSON REST API.
 
 from __future__ import annotations
 
-import json
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
-from .. import generator as gen
-from .. import checker as checker_mod
 from ..control import util as cu
 from ..control import execute, sudo
 from ..os_setup import debian

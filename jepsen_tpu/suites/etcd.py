@@ -16,7 +16,7 @@ checker.
 from __future__ import annotations
 
 import json
-from typing import Any, List, Optional
+from typing import Any, Optional
 
 from .. import client as client_mod
 from .. import independent

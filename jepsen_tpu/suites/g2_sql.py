@@ -17,7 +17,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .. import independent
 from . import sql
 
 TABLES = ("a", "b")

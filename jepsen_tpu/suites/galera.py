@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .. import control
 from ..control import util as cu
 from ..control import execute, sudo
 from ..os_setup import debian

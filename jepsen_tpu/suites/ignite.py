@@ -11,7 +11,7 @@ client).  Here the client uses Ignite's REST API
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent

@@ -13,7 +13,7 @@ the same through the control DSL.
 from __future__ import annotations
 
 import json
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent

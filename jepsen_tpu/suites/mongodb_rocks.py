@@ -11,13 +11,12 @@ findAndModify).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent
 from .. import control
 from ..control import util as cu
-from ..os_setup import debian
 from . import common
 from .proto import IndeterminateError
 from .proto.mongo import MongoClient, MongoError

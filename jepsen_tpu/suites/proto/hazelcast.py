@@ -29,7 +29,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional
 
 from . import IndeterminateError, ProtocolError
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import hashlib
 import socket
 import struct
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from . import IndeterminateError, ProtocolError
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from . import IndeterminateError, ProtocolError
 

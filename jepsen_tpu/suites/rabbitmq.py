@@ -14,7 +14,7 @@ The client rides the from-scratch AMQP 0-9-1 implementation in
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import codec

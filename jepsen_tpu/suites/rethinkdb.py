@@ -13,7 +13,7 @@ The client speaks the ReQL JSON wire protocol via :mod:`.proto.reql`.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent

@@ -13,7 +13,7 @@ like the reference's robustsession message backlog.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Set
+from typing import Optional, Set
 
 from .. import checker as checker_mod
 from .. import client as client_mod

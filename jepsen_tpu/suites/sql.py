@@ -15,7 +15,7 @@ UPSERT), ``mysql`` (tidb, galera, percona, ndb).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Optional
 
 from .. import client as client_mod
 from .. import independent

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-from .. import db as db_mod
 from .. import generator as gen_mod_base
 from ..checker import Checker
 from ..control import util as cu

@@ -21,7 +21,6 @@ schedules (:308-340), full-generator dispatch (:342-359), and the
 from __future__ import annotations
 
 import time as _time
-from typing import Optional
 
 from .. import control
 from .. import faketime

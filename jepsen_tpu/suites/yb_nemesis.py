@@ -13,8 +13,6 @@ flip-flop-per-fault-family shape (:155-191), final-generator recovery
 
 from __future__ import annotations
 
-from typing import Any, Optional
-
 from .. import control
 from .. import generator as gen
 from ..control import util as cu

@@ -16,7 +16,7 @@ CAS); YSQL clients reuse the shared :mod:`.sql` pgwire clients.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 from .. import client as client_mod
 from .. import independent

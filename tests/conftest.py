@@ -45,3 +45,11 @@ def _sweep_stray_daemons(tmp_path_factory):
         ["pkill", "-9", "-f", rf"{base}/.*(regserverd|repregd)\.py"],
         capture_output=True,
     )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (`-m 'not slow'`); still "
+        "runs under plain `make test`",
+    )

@@ -1,0 +1,787 @@
+"""jtlint: the static-analysis suite (jepsen_tpu/lint/).
+
+Each rule gets fixture snippets — at least two positive cases and one
+suppressed case — plus framework tests: determinism across runs,
+baseline matching (including the stale-baseline contract: a vanished
+baselined finding warns but never fails), the JSON report, and the
+self-check that the committed tree is clean modulo the committed
+baseline (the ``make lint`` gate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from jepsen_tpu.lint import (DEFAULT_BASELINE, all_rules, lint_paths,
+                             load_baseline, make_baseline, write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(tmp_path, sources, rules=None, options=None, subdir=""):
+    """Write {relpath: code} fixtures under tmp_path and lint them.
+    Default options disable the repo-doc cross-check so fixture metric
+    names aren't judged against the real observability.md."""
+    base = tmp_path / subdir if subdir else tmp_path
+    for rel, code in sources.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    opts = {"metric_doc": None}
+    opts.update(options or {})
+    return lint_paths([str(base)], rules=rules, options=opts)
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+# ---------------------------------------------------------------------------
+
+
+TRACED_IMPURE = """
+    import time, random
+    import jax
+
+    COUNT = [0]
+    seen = 0
+
+    @jax.jit
+    def bad_decorated(x):
+        global seen
+        seen += 1
+        t = time.time()
+        print("tracing", t)
+        return x + t
+
+    def bad_wrapped(x):
+        r = random.random()
+        return x * r
+
+    bad_wrapped = jax.vmap(bad_wrapped)
+"""
+
+
+def test_trace_host_impurity_positive(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": TRACED_IMPURE})
+    rules = rules_of(res)
+    assert "trace-host-mutation" in rules      # global seen
+    assert "trace-impure-call" in rules        # time.time / random.random
+    assert "trace-print" in rules
+    # both the decorated and the wrap-at-call-site function are caught
+    assert any("bad_decorated" in f.message for f in res.findings)
+    assert any("bad_wrapped" in f.message for f in res.findings)
+
+
+def test_trace_reaches_through_local_call_graph(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": """
+        import time
+        import jax
+
+        def helper(x):
+            return x + time.monotonic()
+
+        @jax.jit
+        def kernel(x):
+            return helper(x)
+    """})
+    assert rules_of(res) == ["trace-impure-call"]
+    assert "helper" in res.findings[0].message
+
+
+def test_trace_jt_traced_annotation_roots_registry_fns(tmp_path):
+    res = run_lint(tmp_path, {"ops/steps.py": """
+        import time
+
+        def register_step(state, f):  # jt: traced
+            return state + time.time()
+    """})
+    assert rules_of(res) == ["trace-impure-call"]
+
+
+def test_trace_host_convert_positive(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def k1(x):
+            return x.item()
+
+        @jax.jit
+        def k2(x):
+            return np.asarray(x)
+    """})
+    assert rules_of(res) == ["trace-host-convert", "trace-host-convert"]
+
+
+def test_trace_sync_positive(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        def dispatch_a(x):
+            return kernel(x).block_until_ready()
+
+        def dispatch_b(x):
+            return np.asarray(kernel(x))
+    """})
+    assert rules_of(res) == ["trace-sync", "trace-sync"]
+
+
+def test_trace_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": """
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            t = time.time()  # jt: allow[trace-impure-call]
+            return x + t
+
+        def single(x):
+            return np.asarray(kernel(x))  # jt: allow[trace-sync]
+    """})
+    assert res.findings == []
+
+
+def test_trace_nested_def_reports_once(tmp_path):
+    # one bug in a nested traced def must be ONE finding, not one per
+    # enclosing traced scope — including defs nested under `if`
+    res = run_lint(tmp_path, {"ops/k.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            def inner(y):
+                def innermost(z):
+                    return z + time.time()
+                return innermost(y)
+            if True:
+                def branchy(y):
+                    return y + time.time()
+            return inner(x) + branchy(x)
+    """})
+    assert rules_of(res) == ["trace-impure-call", "trace-impure-call"]
+    assert {f.scope for f in res.findings} == {
+        "kernel.inner.innermost", "kernel.branchy"}
+
+
+def test_trace_clean_kernel_no_findings(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(x):
+            return jnp.clip(x + jnp.matmul(x, x), 0.0, 1.0)
+    """})
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+
+LOCKED_CLASS = """
+    import threading
+
+    class Buffer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # jt: guarded-by(_lock)
+            self.count = 0  # jt: guarded-by(_lock)
+
+        def add_locked(self, x):
+            with self._lock:
+                self._items.append(x)
+                self.count += 1
+
+        def add_racy(self, x):
+            self._items.append(x)
+
+        def peek_racy(self):
+            return self.count
+"""
+
+
+def test_lock_discipline_positive(tmp_path):
+    res = run_lint(tmp_path, {"m.py": LOCKED_CLASS})
+    assert rules_of(res) == ["lock-discipline", "lock-discipline"]
+    assert any("add_racy" in f.message for f in res.findings)
+    assert any("peek_racy" in f.message for f in res.findings)
+
+
+def test_lock_discipline_holds_and_suppression(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Buffer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # jt: guarded-by(_lock)
+
+            def _append(self, x):  # jt: holds(_lock)
+                self._items.append(x)
+
+            def fast_read(self):
+                return len(self._items)  # jt: allow[lock-discipline]
+    """})
+    assert res.findings == []
+
+
+def test_lock_guarded_module_global(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _pool = None  # jt: guarded-by(_lock)
+
+        def get_good():
+            global _pool
+            with _lock:
+                if _pool is None:
+                    _pool = object()
+                return _pool
+
+        def get_racy():
+            return _pool
+    """})
+    assert rules_of(res) == ["lock-discipline"]
+    assert "get_racy" in res.findings[0].message
+
+
+def test_lock_thread_confined_positive(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Window:
+            def __init__(self):
+                self.inflight = []  # jt: guarded-by(owner-thread)
+
+            def submit(self, x):
+                self.inflight.append(x)
+
+            def worker_body(self):
+                self.inflight.pop()
+
+            def start(self):
+                threading.Thread(target=self.worker_body).start()
+    """})
+    assert rules_of(res) == ["lock-thread-confined"]
+    assert "worker_body" in res.findings[0].message
+
+
+def test_lock_thread_entry_closure_and_suppress(tmp_path):
+    # reachability closes over the local call graph; allow[] silences
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Window:
+            def __init__(self):
+                self.inflight = []  # jt: guarded-by(owner-thread)
+
+            def helper(self):
+                return self.inflight  # jt: allow[lock-thread-confined]
+
+            def worker_body(self):  # jt: thread-entry
+                self.helper()
+    """})
+    assert res.findings == []
+
+
+def test_directives_are_comments_only(tmp_path):
+    # prose comments MENTIONING the syntax, and string literals
+    # containing it, are never live directives
+    res = run_lint(tmp_path, {"m.py": '''
+        import threading
+
+        class Buffer:
+            """Attrs here use `# jt: guarded-by(_lock)` annotations."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # jt: guarded-by(_lock)
+
+            def racy_despite_prose(self, x):
+                # a harmless note that mentions # jt: allow[*] syntax
+                self._items.append(x)
+
+            def racy_despite_string(self):
+                return (self._items, "docs say # jt: allow[*] works")
+    '''})
+    assert rules_of(res) == ["lock-discipline", "lock-discipline"]
+
+
+def test_lock_pass_is_opt_in_per_module(tmp_path):
+    # no annotations -> no analysis, even with naked shared mutation
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Racy:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+    """})
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# obs-hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_obs_span_discipline_positive(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        from jepsen_tpu import obs
+
+        def discarded():
+            obs.span("engine/x", cat="engine")
+
+        def unbalanced():
+            sp = obs.span("engine/y")
+            sp.__enter__()
+            do_work()
+            sp.__exit__(None, None, None)
+    """})
+    assert rules_of(res) == ["obs-span-discipline", "obs-span-discipline"]
+
+
+def test_obs_span_ok_forms(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        from jepsen_tpu import obs
+
+        def good():
+            with obs.span("engine/x") as sp:
+                sp.set("k", "v")
+
+        def delegate():
+            return obs.span("engine/y")
+
+        def balanced():
+            sp = obs.span("engine/z")
+            sp.__enter__()
+            try:
+                do_work()
+            finally:
+                sp.__exit__(None, None, None)
+    """})
+    assert res.findings == []
+
+
+def test_obs_span_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        from jepsen_tpu import obs
+
+        def intentional():
+            obs.span("engine/x")  # jt: allow[obs-span-discipline]
+    """})
+    assert res.findings == []
+
+
+def test_obs_metric_name_positive(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        from jepsen_tpu import obs
+
+        def record(name):
+            obs.count("engine_rows_total", 1)
+            obs.observe("jepsen_BadCase_seconds", 0.5)
+            obs.count(name, 1)
+    """})
+    assert rules_of(res) == ["obs-metric-name"] * 3
+
+
+def test_obs_metric_name_fstring_and_suppress(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        from jepsen_tpu import obs
+
+        def record(phase):
+            obs.observe(f"jepsen_kernel_{phase}_seconds", 0.1)
+            obs.observe(f"{phase}_seconds", 0.1)  # jt: allow[obs-metric-name]
+            obs.count("legacy_total", 1)  # jt: allow[obs-metric-name]
+    """})
+    assert res.findings == []
+
+
+def test_obs_metric_kind_conflict(tmp_path):
+    res = run_lint(tmp_path, {
+        "a.py": """
+            from jepsen_tpu import obs
+
+            def f():
+                obs.count("jepsen_widget_total", 1)
+        """,
+        "b.py": """
+            from jepsen_tpu import obs
+
+            def g():
+                obs.observe("jepsen_widget_total", 0.5)
+
+            def h():
+                obs.gauge_set("jepsen_widget_total", 2.0)
+        """,
+    })
+    assert rules_of(res) == ["obs-metric-kind", "obs-metric-kind"]
+    assert all("jepsen_widget_total" in f.message for f in res.findings)
+
+
+def test_obs_metric_doc_check(tmp_path):
+    doc = tmp_path / "observability.md"
+    doc.write_text("| `jepsen_documented_total` | counter |\n")
+    res = run_lint(
+        tmp_path,
+        {"m.py": """
+            from jepsen_tpu import obs
+
+            def f():
+                obs.count("jepsen_documented_total", 1)
+                obs.count("jepsen_undocumented_total", 1)
+                obs.count("jepsen_also_missing_total", 1)
+                obs.count("jepsen_hush_total", 1)  # jt: allow[obs-metric-doc]
+        """},
+        options={"metric_doc": str(doc)}, subdir="pkg",
+    )
+    assert rules_of(res) == ["obs-metric-doc", "obs-metric-doc"]
+
+
+# ---------------------------------------------------------------------------
+# protocol conformance
+# ---------------------------------------------------------------------------
+
+
+def test_proto_check_signature_positive(tmp_path):
+    res = run_lint(tmp_path, {"checker/x.py": """
+        class Checker:
+            def check(self, test, history, opts=None):
+                raise NotImplementedError
+
+        class BadArgs(Checker):
+            def check(self, test, history):
+                return {"valid?": True}
+
+        class BadNames(Checker):
+            def check(self, test, hist, options=None):
+                return {"valid?": True}
+    """})
+    assert rules_of(res) == ["proto-check-signature"] * 2
+
+
+def test_proto_check_return_positive(tmp_path):
+    res = run_lint(tmp_path, {"checker/x.py": """
+        class Checker:
+            def check(self, test, history, opts=None):
+                raise NotImplementedError
+
+        class NoValid(Checker):
+            def check(self, test, history, opts=None):
+                return {"count": 3}
+
+        class ListReturn(Checker):
+            def check(self, test, history, opts=None):
+                return []
+    """})
+    assert rules_of(res) == ["proto-check-return"] * 2
+
+
+def test_proto_check_seam_tolerated_forms(tmp_path):
+    res = run_lint(tmp_path, {"checker/x.py": """
+        class Checker:
+            def check(self, test, history, opts=None):
+                raise NotImplementedError
+
+        class Good(Checker):
+            def check(self, test, history, opts=None):
+                if not history:
+                    return None          # check_safe normalizes None
+                if opts:
+                    return {**opts, "n": 1}   # spread: can't judge
+                return {"valid?": True}
+
+        class Nested(Checker):
+            def check(self, test, history, opts=None):
+                def helper(node):
+                    return []            # nested fn, its own contract
+                return {"valid?": bool(helper(test))}
+
+        class Suppressed(Checker):
+            def check(self, test, history, opts=None):
+                return {"count": 1}  # jt: allow[proto-check-return]
+    """})
+    assert res.findings == []
+
+
+def test_proto_workload_and_fault_refs(tmp_path):
+    opts = {"workload_names": {"bank", "register"}, "fault_names": set()}
+    res = run_lint(tmp_path, {"suites/mydb.py": """
+        from . import common
+
+        WORKLOADS = ("bank", "bankk")
+
+        def workloads(o):
+            out = {w: common.generic_workload(w, o) for w in WORKLOADS}
+            out["r"] = common.generic_workload("register", o)
+            out["x"] = common.generic_workload("registerr", o)
+            return out
+
+        def test(o):
+            faults = o.get("faults", ["partition", "sharknado"])
+            return {"faults": ["kill", "typhoon"]}
+    """}, options=opts)
+    rules = rules_of(res)
+    assert rules.count("proto-workload-ref") == 2   # bankk + registerr
+    assert rules.count("proto-fault-ref") == 2      # sharknado + typhoon
+
+
+def test_proto_fault_known_fault_constants_extend_vocab(tmp_path):
+    opts = {"workload_names": None, "fault_names": {"master-kill"}}
+    res = run_lint(tmp_path, {"suites/mydb.py": """
+        def test(o):
+            return {"faults": ["master-kill", "partition"]}
+    """}, options=opts)
+    assert res.findings == []
+
+
+def test_proto_suite_exports(tmp_path):
+    res = run_lint(tmp_path, {
+        "suites/__init__.py": 'SUITES = ("gooddb", "incompletedb", "ghostdb")\n',
+        "suites/gooddb.py": """
+            def db(o): ...
+            def client(o): ...
+            def workloads(o): ...
+            def test(o): ...
+        """,
+        "suites/incompletedb.py": """
+            def db(o): ...
+        """,
+    }, options={"workload_names": None, "fault_names": set()})
+    rules = rules_of(res)
+    assert rules.count("proto-suite-exports") == 2  # incomplete + missing
+    msgs = " ".join(f.message for f in res.findings)
+    assert "ghostdb" in msgs and "client" in msgs
+
+
+def test_proto_unused_import_positive_and_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"suites/mydb.py": """
+        import json
+        import os
+        from typing import Any, Optional
+        from . import common  # jt: allow[proto-unused-import]
+
+        def test(o):
+            return {"path": os.sep, "x": Optional}
+    """}, options={"workload_names": None, "fault_names": set()})
+    assert rules_of(res) == ["proto-unused-import"] * 2  # json, Any
+    # unused-import is scoped to suites/: same code elsewhere is clean
+    res2 = run_lint(tmp_path, {"lib/mylib.py": "import json\n"},
+                    options={"workload_names": None, "fault_names": set()},
+                    subdir="elsewhere")
+    assert res2.findings == []
+
+
+# ---------------------------------------------------------------------------
+# framework: determinism, baseline, JSON, CLI
+# ---------------------------------------------------------------------------
+
+
+MIXED_BAD = {
+    "suites/mydb.py": "import json\n\n\ndef test(o): ...\n",
+    "checker/c.py": (
+        "class Checker:\n"
+        "    def check(self, test, history, opts=None): ...\n\n\n"
+        "class Bad(Checker):\n"
+        "    def check(self, test):\n"
+        "        return []\n"
+    ),
+}
+
+
+def test_determinism_two_runs_identical(tmp_path):
+    opts = {"workload_names": None, "fault_names": set()}
+    r1 = run_lint(tmp_path, MIXED_BAD, options=opts)
+    r2 = run_lint(tmp_path, MIXED_BAD, options=opts)
+    assert [f.to_dict() for f in r1.findings] == [
+        f.to_dict() for f in r2.findings]
+    assert len(r1.findings) >= 3
+    # stable ordering: sorted by (path, line, col, rule)
+    keys = [f.sort_key() for f in r1.findings]
+    assert keys == sorted(keys)
+
+
+def test_fingerprints_survive_line_drift(tmp_path):
+    """Edits above a finding (shifting its line) must not churn its
+    fingerprint — that's what keeps the baseline stable."""
+    opts = {"workload_names": None, "fault_names": set()}
+    r1 = run_lint(tmp_path, MIXED_BAD, options=opts)
+    lines1 = [f.line for f in r1.findings]
+    shifted = {k: "# a new leading comment\n# another\n" + v
+               for k, v in MIXED_BAD.items()}
+    r2 = run_lint(tmp_path, shifted, options=opts)  # same paths, rewritten
+    assert [f.line for f in r2.findings] == [ln + 2 for ln in lines1]
+    assert {f.fingerprint() for f in r1.findings} == {
+        f.fingerprint() for f in r2.findings}
+
+
+def test_baseline_roundtrip_and_stale(tmp_path):
+    opts = {"workload_names": None, "fault_names": set()}
+    r1 = run_lint(tmp_path, MIXED_BAD, options=opts)
+    bl_path = tmp_path / "bl.json"
+    write_baseline(str(bl_path), r1.findings)
+    bl = load_baseline(str(bl_path))
+    # all baselined -> clean
+    r2 = lint_paths([str(tmp_path)], options={"metric_doc": None,
+                                              **opts}, baseline=bl)
+    assert r2.ok and len(r2.baselined) == len(r1.findings)
+    assert r2.stale == []
+    # fix one finding -> its baseline entry is STALE (warn, never fail)
+    fixed = dict(MIXED_BAD)
+    fixed["suites/mydb.py"] = "def test(o): ...\n"
+    (tmp_path / "suites" / "mydb.py").write_text(fixed["suites/mydb.py"])
+    r3 = lint_paths([str(tmp_path)], options={"metric_doc": None,
+                                              **opts}, baseline=bl)
+    assert r3.ok
+    assert len(r3.stale) == 1
+    assert r3.stale[0]["rule"] == "proto-unused-import"
+    # a NEW finding still fails even with the baseline present
+    (tmp_path / "suites" / "mydb.py").write_text("import os\n\n\ndef test(o): ...\n")
+    r4 = lint_paths([str(tmp_path)], options={"metric_doc": None,
+                                              **opts}, baseline=bl)
+    assert not r4.ok and len(r4.findings) == 1
+
+
+def test_baseline_subset_run_scopes_stale_and_matching(tmp_path):
+    """A path-subset run must not report unscanned files' baseline
+    entries as stale, and a rules-filtered run must not report other
+    rules' entries as stale."""
+    opts = {"workload_names": None, "fault_names": set()}
+    r_full = run_lint(tmp_path, MIXED_BAD, options=opts)
+    bl_path = tmp_path / "bl.json"
+    write_baseline(str(bl_path), r_full.findings)
+    bl = load_baseline(str(bl_path))
+    # scan only suites/: checker/ entries must not be called stale
+    r_sub = lint_paths([str(tmp_path / "suites")], options={
+        "metric_doc": None, **opts}, baseline=bl)
+    assert r_sub.ok and r_sub.stale == []
+    # rules filter: the unused-import entry (still live) matches; the
+    # checker-rule entries are out of scope, not stale
+    r_rules = lint_paths([str(tmp_path)], rules=["proto-unused-import"],
+                         options={"metric_doc": None, **opts}, baseline=bl)
+    assert r_rules.ok and r_rules.stale == []
+
+
+def test_rules_filter(tmp_path):
+    opts = {"workload_names": None, "fault_names": set()}
+    res = run_lint(tmp_path, MIXED_BAD, rules=["proto-unused-import"],
+                   options=opts)
+    assert set(rules_of(res)) == {"proto-unused-import"}
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    res = run_lint(tmp_path, {"broken.py": "def f(:\n"})
+    assert rules_of(res) == ["parse-error"]
+
+
+def test_all_rules_inventory():
+    rules = all_rules()
+    for expected in ("trace-host-mutation", "trace-impure-call",
+                     "trace-print", "trace-host-convert", "trace-sync",
+                     "lock-discipline", "lock-thread-confined",
+                     "obs-span-discipline", "obs-metric-name",
+                     "obs-metric-kind", "obs-metric-doc",
+                     "proto-check-signature", "proto-check-return",
+                     "proto-workload-ref", "proto-fault-ref",
+                     "proto-suite-exports", "proto-unused-import"):
+        assert expected in rules
+
+
+# ---------------------------------------------------------------------------
+# CLI + self-check
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.lint", *args],
+        capture_output=True, text=True, cwd=cwd or REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+
+
+def test_self_check_committed_tree_is_clean():
+    """`python -m jepsen_tpu.lint jepsen_tpu/` exits 0 modulo the
+    committed baseline — the exact `make lint` gate."""
+    proc = _cli(os.path.join(REPO, "jepsen_tpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # and the committed baseline has no stale entries
+    assert "stale baseline" not in proc.stderr, proc.stderr
+
+
+@pytest.mark.slow
+def test_cli_json_report_and_exit_codes(tmp_path):
+    bad = tmp_path / "suites"
+    bad.mkdir()
+    (bad / "mydb.py").write_text("import json\n\n\ndef test(o): ...\n")
+    out = tmp_path / "lint.json"
+    proc = _cli(str(tmp_path), "--no-baseline", "--json", str(out))
+    assert proc.returncode == 1
+    rep = json.loads(out.read_text())
+    assert rep["files"] == 1
+    assert [f["rule"] for f in rep["findings"]] == ["proto-unused-import"]
+    assert rep["findings"][0]["fingerprint"]
+    # --write-baseline then re-run: clean exit 0
+    bl = tmp_path / "bl.json"
+    proc2 = _cli(str(tmp_path), "--baseline", str(bl), "--write-baseline")
+    assert proc2.returncode == 0
+    proc3 = _cli(str(tmp_path), "--baseline", str(bl))
+    assert proc3.returncode == 0, proc3.stdout + proc3.stderr
+    # --write-baseline under a rule filter would drop every other
+    # rule's grandfathered entries: refused
+    proc4 = _cli(str(tmp_path), "--rules", "trace-sync",
+                 "--write-baseline", "--baseline", str(bl))
+    assert proc4.returncode == 2
+    assert "cannot be combined" in proc4.stderr
+    # --write-baseline on a path SUBSET merges: entries for unscanned
+    # files are preserved, not clobbered
+    other = tmp_path / "checker"
+    other.mkdir()
+    (other / "c.py").write_text(
+        "class Checker:\n"
+        "    def check(self, test, history, opts=None): ...\n\n\n"
+        "class Bad(Checker):\n"
+        "    def check(self, test):\n"
+        "        return {'valid?': True}\n")
+    proc5 = _cli(str(tmp_path), "--baseline", str(bl), "--write-baseline")
+    assert proc5.returncode == 0
+    both = {e["rule"] for e in json.loads(bl.read_text())["findings"]}
+    assert both == {"proto-unused-import", "proto-check-signature"}
+    proc6 = _cli(str(bad), "--baseline", str(bl), "--write-baseline")
+    assert proc6.returncode == 0 and "preserved" in proc6.stdout
+    after = {e["rule"] for e in json.loads(bl.read_text())["findings"]}
+    assert after == both  # checker entry survived the subset rewrite
+
+
+@pytest.mark.slow
+def test_cli_list_rules():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    assert "trace-sync" in proc.stdout
+    assert "proto-suite-exports" in proc.stdout
+
+
+def test_committed_baseline_loads():
+    bl = load_baseline(DEFAULT_BASELINE)
+    assert bl is not None and bl["version"] == 1
