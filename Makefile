@@ -2,14 +2,14 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
-# observability and pipeline smoke checks
-check: lint test trace-smoke pipeline-smoke
+# observability, pipeline, and checker-service smoke checks
+check: lint test trace-smoke pipeline-smoke serve-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -30,6 +30,14 @@ trace-smoke:
 # (doc/checker-engines.md "engine pipeline")
 pipeline-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.engine.smoke
+
+# resident checker daemon (doc/checker-service.md): two concurrent
+# client batches on both kernel routes through an in-process daemon;
+# fails on verdict divergence vs the in-process engine, missing
+# coalescing/warm-hit evidence, an invalid live /metrics exposition,
+# or a shutdown that drops in-flight work
+serve-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.smoke
 
 bench:
 	python bench.py

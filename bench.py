@@ -110,7 +110,15 @@ def run_bench(on_accelerator, warnings):
         )
         force_cpu_platform(n_devices)
 
+    # backend-init cost, measured separately from checker throughput:
+    # THIS is what the resident checker service (jepsen_tpu.serve)
+    # amortizes across runs — the warm path pays it once per daemon,
+    # the cold path once per `cli test` run
+    t_init0 = time.perf_counter()
     import jax
+
+    jax.devices()
+    backend_init_s = time.perf_counter() - t_init0
 
     from jepsen_tpu import models as m
     from jepsen_tpu import synth
@@ -148,6 +156,7 @@ def run_bench(on_accelerator, warnings):
     )
 
     rng = np.random.default_rng(45100)
+    first_jit_s = [None]  # set by the first warmup dispatch
 
     # 1. Templates: distinct concurrent executions, ~25% corrupted.
     hists = synth.generate_batch(
@@ -258,7 +267,14 @@ def run_bench(on_accelerator, warnings):
         # rows built from the same template must agree (relabeling
         # preserves verdicts).  Overflow rows report "unknown" — the
         # production API (wgl.check_batch) reruns those on the oracle.
+        # The first warmup overall is the run's first-jit dispatch:
+        # trace + XLA compile + execute — the OTHER cost the warm
+        # service path skips (its jit cache is resident), recorded as
+        # its own diag field so warm-vs-cold wins stay visible
+        t_jit0 = time.perf_counter()
         ok, overflow = run(0)
+        if first_jit_s[0] is None:
+            first_jit_s[0] = time.perf_counter() - t_jit0
         for t in range(K_live):
             mask = (reps_idx == t) & ~overflow
             rows = ok[mask]
@@ -334,6 +350,9 @@ def run_bench(on_accelerator, warnings):
         "n_devices": n_devices,
         "overflow_unknown": headline["overflow_unknown"],
         "engine_window": WINDOW,
+        "backend_init_s": round(backend_init_s, 4),
+        "first_jit_s": round(first_jit_s[0], 4)
+        if first_jit_s[0] is not None else None,
         "encode_fallback": n_fallback,
         "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
@@ -470,7 +489,106 @@ def _windows_summary(recs):
     }
 
 
+def bench_service():
+    """--against-service: spawn a resident checker daemon, push the
+    template batch through it twice, and report cold (daemon's first
+    jit of these shapes) vs warm (resident cache) throughput plus the
+    daemon-side warm-hit evidence.  Emits ONE JSON line like the main
+    bench; never crashes without it."""
+    t_spawn = time.perf_counter()
+    payload = {"metric": "service_warm_path_histories_per_sec",
+               "value": 0.0, "unit": "histories/sec"}
+    client = None
+    try:
+        from jepsen_tpu import models as m
+        from jepsen_tpu import synth
+        from jepsen_tpu.serve import client as serve_client
+
+        from jepsen_tpu.util import free_port
+
+        port = int(os.environ.get("JEPSEN_TPU_SERVE_PORT", 0)) or free_port()
+        os.environ["JEPSEN_TPU_SERVE_PORT"] = str(port)
+        client = serve_client.spawn_daemon(port=port)
+        daemon_init_s = time.perf_counter() - t_spawn
+
+        K = int(os.environ.get("JEPSEN_TPU_BENCH_SERVICE_K", 64))
+        L = int(os.environ.get("JEPSEN_TPU_BENCH_SERVICE_L", 100))
+        hists = synth.generate_batch(
+            seed=45100, n_histories=K, n_procs=5, n_ops=L,
+            crash_p=0.002, corrupt_fraction=0.25,
+        )
+        model = m.cas_register(0)
+
+        def timed_run():
+            t0 = time.perf_counter()
+            res = client.check_batch(model, hists)
+            return time.perf_counter() - t0, res, dict(client.last_diag)
+
+        cold_s, res_cold, diag_cold = timed_run()
+        warm_s, res_warm, diag_warm = timed_run()
+        if [r.get("valid?") for r in res_cold] != [
+            r.get("valid?") for r in res_warm
+        ]:
+            payload["error"] = "cold/warm verdicts diverged"
+        warm_hps = K / warm_s if warm_s > 0 else 0.0
+        payload.update({
+            "value": round(warm_hps, 2),
+            "history_len": L,
+            "batch": K,
+            # the amortization story in three numbers: daemon init is
+            # paid once per daemon, cold includes the first jit of
+            # these shapes, warm is what every later run pays
+            "daemon_init_s": round(daemon_init_s, 3),
+            "cold_s": round(cold_s, 4),
+            "warm_s": round(warm_s, 4),
+            "cold_hps": round(K / cold_s, 2) if cold_s > 0 else 0.0,
+            "warm_vs_cold": round(cold_s / warm_s, 2)
+            if warm_s > 0 else None,
+            "cold_dispatches": diag_cold.get("cold_dispatches"),
+            "warm_dispatches": diag_warm.get("warm_dispatches"),
+            "warm_run_cold_dispatches": diag_warm.get("cold_dispatches"),
+        })
+        if client.spawned_pid is None:
+            payload["warnings"] = (
+                "attached to a pre-existing daemon (left running; "
+                "cold numbers reflect ITS cache state, not a fresh "
+                "spawn)"
+            )
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        payload["error"] = repr(e)[:300]
+    finally:
+        # stop ONLY a daemon THIS bench spawned — attaching to a
+        # user's resident daemon and killing it would drop every later
+        # run back to the cold path; and stop it even on the error
+        # path, or the NEXT --against-service run would attach to the
+        # stale (warm) leftover and report distorted cold numbers
+        if client is not None and client.spawned_pid is not None:
+            try:
+                client.shutdown()
+            except Exception as e:  # noqa: BLE001 — best-effort stop
+                payload.setdefault("warnings", f"shutdown failed: {e!r}")
+    _emit(payload)
+
+
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--against-service",
+        action="store_true",
+        help="bench through a spawned resident checker daemon "
+        "(jepsen_tpu.serve) instead of in-process: reports cold vs "
+        "warm-path throughput and the daemon's warm-hit evidence",
+    )
+    args, _unknown = ap.parse_known_args()
+    if args.against_service:
+        bench_service()
+        return
+
     warnings = []
     os.environ.setdefault("JEPSEN_TPU_PROBE_TRAIL", PROBE_TRAIL)
     on_accel, probe_err = probe_accelerator()

@@ -706,7 +706,18 @@ class _Linearizable(Checker):
             # tunnel (subprocess probe + CPU pin), covering every
             # dispatch path including explicit algorithm="tpu"
             if wgl.supported(self.model):
-                algorithm = "tpu"
+                # JEPSEN_TPU_SERVICE opts the fleet into the resident
+                # checker daemon (jepsen_tpu.serve) without touching a
+                # single test — the service path falls back to the
+                # in-process engine when no daemon is reachable, so
+                # "auto" stays safe to resolve this way
+                from ..serve import client as serve_client
+
+                algorithm = (
+                    "service"
+                    if serve_client.service_mode() != "off"
+                    else "tpu"
+                )
             else:
                 algorithm = "oracle"
         if algorithm == "race":
@@ -725,6 +736,20 @@ class _Linearizable(Checker):
             # its in-flight device dispatches; None takes the default
             a = wgl.analysis(
                 self.model, history, oracle_budget_s=self.oracle_budget_s,
+                window=(test or {}).get("engine-window"),
+            )
+        elif algorithm == "service":
+            # the resident checker daemon (jepsen_tpu.serve) when one
+            # is reachable, the in-process engine otherwise — the
+            # serve.client seam does the fallback, so this branch can
+            # never strand a verdict on a missing daemon.  Budgeted
+            # searches stay in-process by construction (serve.client
+            # refuses to ship oracle_budget_s — deadline semantics).
+            from ..serve import client as serve_client
+
+            a = serve_client.analysis(
+                self.model, history,
+                oracle_budget_s=self.oracle_budget_s,
                 window=(test or {}).get("engine-window"),
             )
         else:
@@ -771,7 +796,10 @@ def linearizable(
     oracle_budget_s=None,
 ) -> Checker:
     """Validate linearizability against a model.  algorithm: "auto"
-    (TPU kernel when the model has one, else oracle), "tpu", "oracle",
+    (TPU kernel when the model has one — via the resident checker
+    service when ``JEPSEN_TPU_SERVICE`` opts in — else oracle), "tpu",
+    "oracle", "service" (the jepsen_tpu.serve daemon, transparent
+    in-process fallback; also exposed as ``serve.ServiceChecker``),
     or "race" (kernel vs oracle concurrently, first definite verdict
     wins — knossos's competition mode).  ``oracle_budget_s`` bounds the
     exponential CPU search's wall time; past it the verdict is an

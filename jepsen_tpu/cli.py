@@ -355,21 +355,113 @@ def single_test_cmd(
 
 
 def serve_cmd() -> Dict[str, dict]:
-    """(reference: cli.clj:336-354)"""
+    """``serve`` (web UI, or the resident checker daemon with
+    ``--checker``), plus ``status``/``shutdown`` for the daemon.
+    (reference: cli.clj:336-354; the checker daemon is
+    doc/checker-service.md)"""
 
     def add_opts(p):
-        p.add_argument("--host", default="0.0.0.0")
-        p.add_argument("--port", "-b", type=int, default=8080)
+        p.add_argument("--host", default=None)
+        p.add_argument("--port", "-b", type=int, default=None)
         p.add_argument("--store-base", default="store")
+        p.add_argument(
+            "--checker",
+            action="store_true",
+            help="serve the resident checker daemon (device + jit "
+            "cache + oracle pool stay warm across runs; "
+            "doc/checker-service.md) instead of the store web UI",
+        )
+        p.add_argument(
+            "--engine-window",
+            type=_engine_window_arg,
+            help="(--checker) the resident dispatch-window bound",
+        )
+        p.add_argument(
+            "--max-queue",
+            type=int,
+            help="(--checker) queued client runs before /check "
+            "answers 503 backlogged (default 8)",
+        )
 
     def run(args) -> int:
+        if args.checker:
+            from . import serve as serve_mod
+
+            serve_mod.serve(
+                host=args.host or serve_mod.DEFAULT_HOST,
+                port=args.port,
+                window=args.engine_window,
+                max_queue_runs=args.max_queue,
+                block=True,
+            )
+            return EXIT_VALID
         from . import web
 
-        web.serve(host=args.host, port=args.port, base=args.store_base)
+        web.serve(
+            host=args.host or "0.0.0.0",
+            port=args.port if args.port is not None else 8080,
+            base=args.store_base,
+        )
         return EXIT_VALID
 
-    return {"serve": {"help": "serve the store web UI",
-                      "add_opts": add_opts, "run": run}}
+    def add_daemon_opts(p):
+        p.add_argument("--host", default=None,
+                       help="daemon host (default 127.0.0.1)")
+        p.add_argument("--port", "-b", type=int, default=None,
+                       help="daemon port (default JEPSEN_TPU_SERVE_PORT "
+                       "or 8519)")
+
+    def status(args) -> int:
+        from .serve import ServiceClient, ServiceUnavailable, client
+
+        c = ServiceClient(host=args.host, port=args.port)
+        try:
+            print(client.format_status(c.status()))
+        except ServiceUnavailable:
+            print(
+                f"no checker service at http://{c.host}:{c.port}/ "
+                "(start one: jepsen-tpu serve --checker)",
+                file=sys.stderr,
+            )
+            return EXIT_UNKNOWN
+        return EXIT_VALID
+
+    def shutdown(args) -> int:
+        from .serve import ServiceClient, ServiceUnavailable
+
+        c = ServiceClient(host=args.host, port=args.port)
+        try:
+            out = c.shutdown()
+        except ServiceUnavailable:
+            print(
+                f"no checker service at http://{c.host}:{c.port}/",
+                file=sys.stderr,
+            )
+            return EXIT_UNKNOWN
+        print(
+            f"checker service draining ({out.get('draining', 0)} queued "
+            "runs), then stopping"
+        )
+        return EXIT_VALID
+
+    return {
+        "serve": {
+            "help": "serve the store web UI (--checker: the resident "
+            "checker daemon)",
+            "add_opts": add_opts,
+            "run": run,
+        },
+        "status": {
+            "help": "show the resident checker service's status",
+            "add_opts": add_daemon_opts,
+            "run": status,
+        },
+        "shutdown": {
+            "help": "drain and stop the resident checker service",
+            "add_opts": add_daemon_opts,
+            "run": shutdown,
+        },
+    }
 
 
 def test_all_cmd(

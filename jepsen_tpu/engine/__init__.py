@@ -22,13 +22,28 @@ is exactly the historical serial dispatch-sync-dispatch path (pinned
 by ``tests/test_engine.py`` and ``make pipeline-smoke``).  Pipeline
 occupancy, bubble time, in-flight depth, and bucket counts report
 through the ``obs`` metrics registry (doc/observability.md).
+
+The engine is split into a pure per-run **planning** layer
+(:mod:`jepsen_tpu.engine.planning`: ``RunContext``, ``Planner``) and a
+device-owning **execution** layer (:mod:`jepsen_tpu.engine.execution`:
+``DispatchWindow``, ``Executor``); :mod:`~jepsen_tpu.engine.pipeline`
+composes them per run, while the resident checker service
+(:mod:`jepsen_tpu.serve`) shares one executor across concurrent runs.
 """
 
-from .pipeline import (  # noqa: F401
-    DEFAULT_FLUSH_ROWS,
+from .execution import (  # noqa: F401
     DEFAULT_WINDOW,
     DispatchWindow,
-    default_bucketed,
+    Executor,
     default_window,
-    run,
+)
+from .pipeline import run  # noqa: F401
+from .planning import (  # noqa: F401
+    DEFAULT_FLUSH_ROWS,
+    Planner,
+    PlannedBucket,
+    RunContext,
+    default_bucketed,
+    estimated_cost,
+    merge_buckets,
 )

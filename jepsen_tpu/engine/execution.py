@@ -1,0 +1,510 @@
+"""The device-owning **execution** half of the checker engine.
+
+Everything here touches shared, stateful resources: the bounded
+:class:`DispatchWindow` of in-flight device dispatches, the compiled-
+kernel cache (via ``wgl``'s claim helpers), the escalation ladder, and
+the hand-off to the CPU-oracle worker pool.  The pure per-run half —
+encode, bucketing, kernel planning — lives in
+:mod:`jepsen_tpu.engine.planning`.
+
+An :class:`Executor` is the unit of device ownership.  The in-process
+pipeline (:func:`jepsen_tpu.engine.pipeline.run`) creates a private
+one per run; the checker service daemon (:mod:`jepsen_tpu.serve`)
+keeps ONE resident executor alive across runs, feeding it planned
+buckets whose rows come from many concurrent client contexts — the
+jit cache and the window stay warm, and same-shape rows from
+different runs ride the same dispatch.
+
+Both the window and the executor are **owner-thread confined**: all
+``submit``/``drain`` calls must come from the thread that created
+them (runtime-enforced by :meth:`DispatchWindow._check_owner`).  The
+oracle worker pool interacts with execution only through Futures held
+by each run's :class:`~jepsen_tpu.engine.planning.RunContext`, never
+by driving the window.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import obs
+
+
+def row_bucket_target(n: int) -> int:
+    """Row count → its stable dispatch shape: the next power of two,
+    floored at :data:`ROW_BUCKET`."""
+    target = ROW_BUCKET
+    while target < n:
+        target *= 2
+    return target
+
+#: default bound on concurrently in-flight device dispatches; 1 = the
+#: strictly serial dispatch-sync-dispatch path
+DEFAULT_WINDOW = 4
+
+#: minimum dispatch row bucket: row counts round up to the next power
+#: of two ≥ this (never past the chunk cap) with neutral all-padding
+#: rows, so jit executables are keyed by STABLE shapes — two runs of
+#: ~500 subhistories both dispatch at 512 rows and hit one compiled fn
+#: instead of retracing at 506 vs 493.  Geometric buckets bound the
+#: executable count at O(log max_dispatch) per (E, C) shape while
+#: wasting < 2× rows of (cheap, neutral) padding — the trade every
+#: serving stack makes, and what keeps the resident checker service's
+#: warm path warm across real varying-size traffic.  In-process
+#: one-shot runs pay at most one compile either way.
+ROW_BUCKET = 64
+
+
+def default_window() -> int:
+    """Resolved in-flight window: ``JEPSEN_TPU_ENGINE_WINDOW`` if set,
+    else :data:`DEFAULT_WINDOW`."""
+    try:
+        return max(
+            1, int(os.environ.get("JEPSEN_TPU_ENGINE_WINDOW",
+                                  DEFAULT_WINDOW))
+        )
+    except ValueError:
+        return DEFAULT_WINDOW
+
+
+def _materialize(out):
+    """Force device work to the host (the sync point)."""
+    if isinstance(out, (tuple, list)):
+        return tuple(np.asarray(x) for x in out)
+    return np.asarray(out)
+
+
+class DispatchWindow:
+    """A bounded window of in-flight device dispatches.
+
+    ``submit(key, thunk)`` first retires (syncs) the oldest entries
+    until fewer than ``window`` are in flight, then calls ``thunk`` —
+    which must *dispatch* device work and return the lazy device
+    arrays — and enqueues its result.  ``drain()`` retires everything
+    left.  Retirement materializes the arrays via ``np.asarray`` and
+    hands ``(key, materialized, t_dispatch)`` to ``on_retire`` (also
+    returned from ``submit``/``drain`` for callers that prefer pull).
+
+    window=1 is the serial contract: every dispatch fully settles
+    before the next one is issued, reproducing the historical
+    dispatch-sync-dispatch path exactly.  The window is shared
+    machinery — ``check_batch`` dispatches bucket chunks through it,
+    ``ops.cycles`` its Elle screen buckets, and ``bench.py`` its
+    pipelined measurement, so the benchmark times the code users run.
+
+    A window is **owner-thread confined** (``# jt: guarded-by
+    (owner-thread)`` on its state, checked by the lock-discipline lint
+    pass): the in-flight deque and bubble/peak bookkeeping are
+    deliberately lock-free, so ``submit``/``drain`` refuse calls from
+    any thread but the creating one rather than corrupt them silently
+    — the oracle worker pool must interact with the engine only
+    through Futures (see the pipeline's stage-3 drain), never by
+    driving the window.
+
+    Time spent blocked in retirement is recorded as
+    ``jepsen_engine_bubble_seconds``; the post-submit depth feeds the
+    ``jepsen_engine_inflight_depth`` high-water gauge.
+    """
+
+    def __init__(
+        self,
+        window: Optional[int] = None,
+        on_retire: Optional[Callable[[Any, Any, float], None]] = None,
+    ):
+        self.window = max(
+            1, int(window) if window is not None else default_window()
+        )
+        self.on_retire = on_retire
+        #: (key, lazy-out, t_dispatch, attrs)
+        self._inflight: deque = deque()  # jt: guarded-by(owner-thread)
+        self.peak_depth = 0  # jt: guarded-by(owner-thread)
+        self.bubble_s = 0.0  # jt: guarded-by(owner-thread)
+        self.submitted = 0  # jt: guarded-by(owner-thread)
+        self._owner = threading.get_ident()
+
+    def _check_owner(self) -> None:
+        if threading.get_ident() != self._owner:
+            raise RuntimeError(
+                "DispatchWindow is owner-thread confined: submit/drain "
+                "must run on the creating thread (oracle workers hand "
+                "results back through Futures, never drive the window)"
+            )
+
+    @property
+    def depth(self) -> int:
+        return len(self._inflight)
+
+    def submit(self, key, thunk, attrs: Optional[dict] = None) -> list:
+        """Dispatch one unit of device work; returns entries retired to
+        make room (empty until the window fills)."""
+        self._check_owner()
+        retired = []
+        while len(self._inflight) >= self.window:
+            retired.append(self._retire())
+        # stamp BEFORE the thunk: jit trace + XLA compile run
+        # synchronously inside the first dispatch call, and the
+        # compile-vs-execute histograms must keep containing them
+        t_dispatch = time.perf_counter()
+        out = thunk()
+        self._inflight.append((key, out, t_dispatch, attrs))
+        self.submitted += 1
+        depth = len(self._inflight)
+        if depth > self.peak_depth:
+            self.peak_depth = depth
+        obs.gauge_max("jepsen_engine_inflight_depth", depth)
+        return retired
+
+    def _retire(self):
+        key, out, t_dispatch, attrs = self._inflight.popleft()
+        t0 = time.perf_counter()
+        if obs.enabled():
+            with obs.span(
+                "engine/dispatch", cat="engine", **(attrs or {})
+            ):
+                mat = _materialize(out)
+        else:
+            mat = _materialize(out)
+        wait = time.perf_counter() - t0
+        self.bubble_s += wait
+        obs.observe("jepsen_engine_bubble_seconds", wait)
+        if self.on_retire is not None:
+            self.on_retire(key, mat, t_dispatch)
+        return key, mat, t_dispatch
+
+    def drain(self) -> list:
+        """Retire every in-flight dispatch, oldest first."""
+        self._check_owner()
+        out = []
+        while self._inflight:
+            out.append(self._retire())
+        return out
+
+    def abandon(self) -> int:
+        """Drop every in-flight entry WITHOUT retiring (no host sync,
+        no ``on_retire``): the recovery path after a dispatch raised —
+        syncing the survivors could re-raise the same device failure.
+        The dropped device computations finish (or die) on their own
+        and get collected.  Returns the number dropped."""
+        self._check_owner()
+        n = len(self._inflight)
+        self._inflight.clear()
+        return n
+
+
+class Executor:
+    """Device-owning execution of planned buckets.
+
+    ``submit(planned_bucket)`` splits the bucket into footprint-safe
+    chunks and dispatches them through the executor's bounded
+    :class:`DispatchWindow`; ``drain()`` retires everything in flight
+    and runs the deferred escalation ladder.  Row verdicts route
+    through each row's ``(ctx, idx)`` token back to its
+    :class:`~jepsen_tpu.engine.planning.RunContext` — rows from many
+    concurrent runs can share one dispatch (the service's cross-run
+    coalescing) without any result cross-talk.
+
+    Safety under pipelining (inherited verbatim from the pipeline it
+    was factored out of): the frontier footprint budget
+    (``fn.safe_dispatch`` ← ``FRONTIER_DISPATCH_BUDGET``) is
+    crash-calibrated for ONE in-flight dispatch, so with a window of W
+    each frontier chunk takes 1/W of the safe rows — total in-flight
+    HBM stays at the calibrated bound no matter how many client runs
+    coalesce.  Shapes whose cap floors out below W dispatch strictly
+    serially at the full single-dispatch cap.  Dense chunks keep the
+    full cap: the kernel is overflow-free with a small per-row
+    footprint, and multi-in-flight dense dispatch IS the measured
+    flagship bench pattern.  Escalation reruns dispatch only while
+    the window is empty (see :meth:`drain`).
+
+    Owner-thread confined like its window: create it on the thread
+    that will drive it (the service daemon builds its resident
+    executor ON the device thread, never on a request handler).
+    """
+
+    def __init__(
+        self,
+        window: Optional[int] = None,
+        *,
+        mesh=None,
+        escalation=None,
+        sufficient_rung: bool = True,
+        max_dispatch: Optional[int] = None,
+    ):
+        from ..ops import wgl
+
+        self.mesh = mesh
+        self.escalation = (
+            wgl.ESCALATION_FACTORS if escalation is None else escalation
+        )
+        self.sufficient_rung = sufficient_rung
+        self.max_dispatch = (
+            wgl.DEFAULT_MAX_DISPATCH if max_dispatch is None else max_dispatch
+        )
+        self._win = DispatchWindow(window, on_retire=self._settle_chunk)
+        #: chunk_id -> {plan, arrays, rows, n, phase}
+        self._chunks: Dict[int, dict] = {}  # jt: guarded-by(owner-thread)
+        self._next_chunk = 0  # jt: guarded-by(owner-thread)
+        #: chunks whose base pass overflowed, parked until the window
+        #: drains: escalation reruns dispatch at LARGER capacities, and
+        #: stacking one on top of `window` in-flight base dispatches
+        #: would hold more concurrent footprint than the
+        #: crash-calibrated per-dispatch budget was measured for.
+        #: Deferring also matches the serial path's order (escalate
+        #: after the base pass).  Overflow is the rare path; the
+        #: common all-resolved chunk settles immediately.
+        self._pending_escalations: List[tuple] = []  # jt: guarded-by(owner-thread)
+        #: cumulative dispatch phases — the service's warm-hit
+        #: accounting reads (and diffs) these across request batches
+        self.phase_counts = {"compile": 0, "execute": 0}
+
+    # -- stats the pipeline's telemetry reads -----------------------------
+
+    @property
+    def window_size(self) -> int:
+        return self._win.window
+
+    @property
+    def submitted(self) -> int:
+        return self._win.submitted
+
+    @property
+    def peak_depth(self) -> int:
+        return self._win.peak_depth
+
+    @property
+    def bubble_s(self) -> float:
+        return self._win.bubble_s
+
+    # -- settle path (runs inside window retirement, owner thread) -------
+
+    def _settle_chunk(self, chunk_id, mat, t_dispatch):
+        # on_retire runs synchronously inside the owner-checked
+        # submit/drain (DispatchWindow._retire), never on a foreign
+        # thread, so owner-thread state stays confined
+        ch = self._chunks.pop(chunk_id)  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
+        plan = ch["plan"]
+        n_live = ch["n"]
+        if obs.enabled():
+            # dispatch-to-materialized latency, split compile (first
+            # dispatch of this fn at this shape: trace + XLA compile +
+            # execute) vs execute (cache-hit) exactly as the serial
+            # path recorded it — under pipelining these overlap, so
+            # their sum can exceed wall clock by design
+            obs.observe(
+                f"jepsen_kernel_{ch['phase']}_seconds",
+                time.perf_counter() - t_dispatch,
+                engine=plan.kernel,
+            )
+        # np.array (not asarray): jax outputs are read-only views and
+        # the escalation pass writes back into these
+        ok, failed_at, overflow = (np.array(x)[:n_live] for x in mat)
+        if overflow.any():
+            self._pending_escalations.append(  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
+                (plan, ch["arrays"], ch["rows"], ok, failed_at, overflow)
+            )
+        else:
+            self._assign_rows(plan, ch["rows"], ok, failed_at, overflow)
+
+    def _settle_rows(self, plan, arrays, rows, ok, failed_at, overflow):
+        """Escalate a chunk's overflows on-device, then assign verdicts
+        (still-overflowed rows join each row's oracle pool)."""
+        from ..ops import wgl
+
+        wgl.escalate_overflows(
+            plan, arrays, ok, failed_at, overflow,
+            mesh=self.mesh, escalation=self.escalation,
+            sufficient_rung=self.sufficient_rung,
+            max_dispatch=self.max_dispatch,
+        )
+        self._assign_rows(plan, rows, ok, failed_at, overflow)
+
+    def _assign_rows(self, plan, rows, ok, failed_at, overflow):
+        unresolved = "routed" if plan.kernel == "oracle" else "overflow"
+        for row, (ctx, hist_idx) in enumerate(rows):
+            if overflow[row]:
+                # still overflowed after escalation: CPU oracle decides
+                ctx.route_oracle(
+                    hist_idx, plan.overflow_engine(), unresolved
+                )
+            elif ok[row]:
+                ctx.assign(hist_idx, {
+                    "valid?": True,
+                    "engine": "tpu",
+                    "kernel": plan.kernel,
+                })
+            else:
+                ctx.assign(hist_idx, {
+                    "valid?": False,
+                    "engine": "tpu",
+                    "kernel": plan.kernel,
+                    "failed-event": int(failed_at[row]),
+                })
+
+    # -- dispatch path ----------------------------------------------------
+
+    def _dispatch_chunk(self, plan, arrays, rows):
+        """Queue one ≤ plan.disp-row chunk on the device (async)."""
+        from ..ops import wgl
+
+        chunk_id = self._next_chunk
+        self._next_chunk += 1
+        disp_shape = arrays[0].shape[0]
+        # claim-before-dispatch (wgl._claim_shape is lock-protected):
+        # jit retraces per input shape, so the first dispatch at this
+        # (fn, shape) is the compile-phase one, every later one execute
+        first = wgl._claim_shape(plan.fn, disp_shape)
+        phase = "compile" if first else "execute"
+        self.phase_counts[phase] += 1
+        if obs.enabled():
+            obs.count(
+                "jepsen_kernel_dispatches_total", 1,
+                engine=plan.kernel, phase=phase,
+            )
+        self._chunks[chunk_id] = {
+            "plan": plan, "arrays": arrays, "rows": rows,
+            "n": len(rows), "phase": phase,
+        }
+        self._win.submit(
+            chunk_id,
+            lambda: wgl._run_rows(plan.fn, self.mesh, arrays),
+            attrs={"engine": plan.kernel, "rows": len(rows),
+                   "phase": phase},
+        )
+
+    def submit(self, pb) -> None:
+        """Dispatch one planned bucket in footprint-safe chunks through
+        the window (or settle it inline when no kernel can run)."""
+        from ..ops import wgl
+
+        plan, arrays, rows = pb.plan, pb.arrays, pb.rows
+        B = arrays[0].shape[0]
+        if plan.fn is None or plan.disp == 0:
+            # no dispatchable kernel (oracle-routed shape, a dense-only
+            # spec outside its envelope, or even one row would crash
+            # the worker): every escalation rung is equally
+            # undispatchable (caps shrink with capacity), so settling
+            # INLINE is dispatch-free — and it hands the bucket's rows
+            # to the oracle pool NOW, overlapping the remaining device
+            # work instead of waiting for the window to drain
+            ok = np.zeros((B,), bool)
+            failed_at = np.zeros((B,), np.int32)
+            overflow = np.ones((B,), bool)
+            self._settle_rows(plan, arrays, rows, ok, failed_at, overflow)
+            return
+        # the frontier footprint budget (fn.safe_dispatch ←
+        # FRONTIER_DISPATCH_BUDGET) is crash-calibrated for ONE
+        # in-flight dispatch; a window of W holds W dispatches' HBM
+        # concurrently, so each frontier chunk gets 1/W of the rows —
+        # total in-flight stays at the calibrated bound.  When even
+        # that floors out (disp < W: per-row footprint near the whole
+        # budget), the bucket dispatches strictly serially at the full
+        # single-dispatch cap instead — W one-row dispatches in flight
+        # would still overshoot the bound.  Dense chunks keep the full
+        # cap: the kernel is overflow-free with a small per-row
+        # footprint, and multi-in-flight dense dispatch IS the
+        # measured flagship bench pattern (B=16384 × window, on-chip).
+        chunk_cap = plan.disp
+        serialize = False
+        if plan.kernel != "dense" and self._win.window > 1:
+            if plan.disp >= self._win.window:
+                chunk_cap = plan.disp // self._win.window
+            else:
+                serialize = True
+        from ..parallel import mesh as mesh_mod
+
+        if B <= chunk_cap:
+            # stable-shape dispatch: round the row count up to its
+            # power-of-two bucket (capped at the footprint-safe chunk
+            # cap) with neutral all-padding rows — settle slices the
+            # outputs back to the live rows, so verdicts are untouched
+            # while repeat traffic reuses one executable per bucket
+            target = min(chunk_cap, row_bucket_target(B))
+            if target > B:
+                arrays = tuple(
+                    mesh_mod.pad_to_multiple(np.asarray(a), target, fill)
+                    for a, fill in zip(arrays, wgl._PAD_FILLS)
+                )
+            if serialize:
+                self._win.drain()
+            self._dispatch_chunk(plan, arrays, rows)
+            if serialize:
+                self._win.drain()
+            return
+
+        for lo in range(0, B, chunk_cap):
+            hi = min(lo + chunk_cap, B)
+            # every chunk (including the tail, padded with neutral
+            # all-padding rows) dispatches at the same cap-row shape:
+            # one executable, never a per-tail-size compile
+            chunk = tuple(
+                mesh_mod.pad_to_multiple(
+                    np.asarray(a[lo:hi]), chunk_cap, fill
+                )
+                for a, fill in zip(arrays, wgl._PAD_FILLS)
+            )
+            if serialize:
+                self._win.drain()
+            self._dispatch_chunk(plan, chunk, rows[lo:hi])
+        if serialize:
+            self._win.drain()
+
+    def reset(self) -> int:
+        """Discard all transient dispatch state — in-flight window
+        entries (unsynced, see :meth:`DispatchWindow.abandon`), the
+        chunk map, parked escalations — WITHOUT assigning any verdicts.
+        The resident service calls this when a batch raised: reusing
+        the executor with a poisoned window would retire the failed
+        batch's dispatches into the NEXT batch (re-raising its failure
+        against innocent requests) and re-dispatch its parked
+        escalation arrays into dead contexts.  Returns the number of
+        abandoned dispatches."""
+        n = self._win.abandon()
+        self._chunks.clear()
+        self._pending_escalations = []
+        return n
+
+    def drain(self) -> None:
+        """Retire every in-flight dispatch, then run the deferred
+        escalation ladder with the window empty — exactly one
+        in-flight dispatch, the regime the footprint budget was
+        calibrated in (and the serial path's order).  Parked chunks
+        merge per plan first (live rows only — tail chunks carry
+        neutral padding rows that must not interleave), so a bucket
+        pays ONE padded rerun per escalation rung like the serial
+        batch-wide pass did, not one ladder per chunk."""
+        self._win.drain()
+        pending = self._pending_escalations
+        self._pending_escalations = []
+        merged: Dict[int, list] = {}
+        merged_order: List[int] = []
+        for item in pending:
+            pid = id(item[0])
+            if pid not in merged:
+                merged[pid] = []
+                merged_order.append(pid)
+            merged[pid].append(item)
+        for pid in merged_order:
+            group = merged[pid]
+            if len(group) == 1:
+                self._settle_rows(*group[0])
+                continue
+            plan = group[0][0]
+            arrays = tuple(
+                np.concatenate(
+                    [np.asarray(g[1][i][: len(g[2])]) for g in group]
+                )
+                for i in range(6)
+            )
+            rows = [r for g in group for r in g[2]]
+            self._settle_rows(
+                plan, arrays, rows,
+                np.concatenate([g[3] for g in group]),
+                np.concatenate([g[4] for g in group]),
+                np.concatenate([g[5] for g in group]),
+            )

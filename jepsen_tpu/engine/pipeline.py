@@ -23,19 +23,31 @@ accelerators fed (continuous batching / input pipelines):
   ``checker.linear`` worker pool *immediately* (before any dispatch),
   and oracle-routed/undispatchable buckets join at flush time — so
   oracle wall time hides behind device wall time on mixed batches
-  instead of adding to it.  Rows that overflow the device ladder join
-  only after the window drains (their escalation reruns must not stack
-  on in-flight dispatches — see below), and searches with a wall-clock
-  ``oracle_budget_s`` defer to a serial drain pass (GIL-sharing
-  workers would burn the deadline ~workers× faster than the serial
-  path and flip verdicts to "unknown").
+  instead of adding to it.
+
+Since the checker-service split, this module is the **composition**
+of the engine's two halves, not their implementation:
+
+- :mod:`jepsen_tpu.engine.planning` — the pure per-run layer:
+  :class:`~jepsen_tpu.engine.planning.RunContext` (result slots +
+  oracle hand-off) and :class:`~jepsen_tpu.engine.planning.Planner`
+  (streaming encode → shape buckets → ``wgl.plan_bucket``).
+- :mod:`jepsen_tpu.engine.execution` — the device-owning layer:
+  :class:`DispatchWindow` and
+  :class:`~jepsen_tpu.engine.execution.Executor` (chunk dispatch,
+  escalation ladder, footprint-safe chunk caps).
+
+:func:`run` wires one private context/planner/executor per call — the
+in-process path.  The checker service daemon (:mod:`jepsen_tpu.serve`)
+wires the same two halves differently: one *resident* executor shared
+by many concurrent client contexts, with same-shape buckets coalesced
+across runs.  Verdicts are a pure function of the histories in both
+compositions — never of window size, bucketing, interleaving, or
+which composition ran them (``make serve-smoke`` pins the equality).
 
 Kernel routing, escalation rungs, and all result/telemetry contracts
-are unchanged from the serial path: the engine calls
-``wgl.plan_bucket`` / ``wgl.escalate_overflows`` and assembles the
-exact result dicts ``check_batch`` always produced.  Verdicts are a
-pure function of the histories — never of window size, bucketing, or
-oracle interleaving.
+are unchanged from the serial path; the engine assembles the exact
+result dicts ``check_batch`` always produced.
 
 Pipeline telemetry (obs registry; doc/observability.md):
 
@@ -51,169 +63,23 @@ Pipeline telemetry (obs registry; doc/observability.md):
 
 from __future__ import annotations
 
-import os
-import threading
 import time
-from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
-
-import numpy as np
+from typing import List, Optional, Sequence
 
 from .. import obs
-
-#: default bound on concurrently in-flight device dispatches; 1 = the
-#: strictly serial dispatch-sync-dispatch path
-DEFAULT_WINDOW = 4
-
-#: rows a shape bucket accumulates before flushing mid-stream.  Kept at
-#: the default dispatch cap so ordinary batches flush exactly once per
-#: bucket (identical routing/compile behavior to the one-shot encode),
-#: while keyspaces past it stream: encode of flush k+1 overlaps the
-#: device work of flush k.
-DEFAULT_FLUSH_ROWS = 16384
-
-
-def default_window() -> int:
-    """Resolved in-flight window: ``JEPSEN_TPU_ENGINE_WINDOW`` if set,
-    else :data:`DEFAULT_WINDOW`."""
-    try:
-        return max(
-            1, int(os.environ.get("JEPSEN_TPU_ENGINE_WINDOW",
-                                  DEFAULT_WINDOW))
-        )
-    except ValueError:
-        return DEFAULT_WINDOW
-
-
-def default_bucketed() -> bool:
-    """Shape bucketing default: on unless ``JEPSEN_TPU_ENGINE_BUCKETED``
-    is falsy."""
-    return os.environ.get("JEPSEN_TPU_ENGINE_BUCKETED", "1").lower() not in (
-        "0", "false", "off", "no",
-    )
-
-
-def _flush_rows() -> int:
-    try:
-        return max(
-            1, int(os.environ.get("JEPSEN_TPU_ENGINE_FLUSH_ROWS",
-                                  DEFAULT_FLUSH_ROWS))
-        )
-    except ValueError:
-        return DEFAULT_FLUSH_ROWS
-
-
-def _materialize(out):
-    """Force device work to the host (the sync point)."""
-    if isinstance(out, (tuple, list)):
-        return tuple(np.asarray(x) for x in out)
-    return np.asarray(out)
-
-
-class DispatchWindow:
-    """A bounded window of in-flight device dispatches.
-
-    ``submit(key, thunk)`` first retires (syncs) the oldest entries
-    until fewer than ``window`` are in flight, then calls ``thunk`` —
-    which must *dispatch* device work and return the lazy device
-    arrays — and enqueues its result.  ``drain()`` retires everything
-    left.  Retirement materializes the arrays via ``np.asarray`` and
-    hands ``(key, materialized, t_dispatch)`` to ``on_retire`` (also
-    returned from ``submit``/``drain`` for callers that prefer pull).
-
-    window=1 is the serial contract: every dispatch fully settles
-    before the next one is issued, reproducing the historical
-    dispatch-sync-dispatch path exactly.  The window is shared
-    machinery — ``check_batch`` dispatches bucket chunks through it,
-    ``ops.cycles`` its Elle screen buckets, and ``bench.py`` its
-    pipelined measurement, so the benchmark times the code users run.
-
-    A window is **owner-thread confined** (``# jt: guarded-by
-    (owner-thread)`` on its state, checked by the lock-discipline lint
-    pass): the in-flight deque and bubble/peak bookkeeping are
-    deliberately lock-free, so ``submit``/``drain`` refuse calls from
-    any thread but the creating one rather than corrupt them silently
-    — the oracle worker pool must interact with the engine only
-    through Futures (see ``run``'s stage-3 drain), never by driving
-    the window.
-
-    Time spent blocked in retirement is recorded as
-    ``jepsen_engine_bubble_seconds``; the post-submit depth feeds the
-    ``jepsen_engine_inflight_depth`` high-water gauge.
-    """
-
-    def __init__(
-        self,
-        window: Optional[int] = None,
-        on_retire: Optional[Callable[[Any, Any, float], None]] = None,
-    ):
-        self.window = max(
-            1, int(window) if window is not None else default_window()
-        )
-        self.on_retire = on_retire
-        #: (key, lazy-out, t_dispatch, attrs)
-        self._inflight: deque = deque()  # jt: guarded-by(owner-thread)
-        self.peak_depth = 0  # jt: guarded-by(owner-thread)
-        self.bubble_s = 0.0  # jt: guarded-by(owner-thread)
-        self.submitted = 0  # jt: guarded-by(owner-thread)
-        self._owner = threading.get_ident()
-
-    def _check_owner(self) -> None:
-        if threading.get_ident() != self._owner:
-            raise RuntimeError(
-                "DispatchWindow is owner-thread confined: submit/drain "
-                "must run on the creating thread (oracle workers hand "
-                "results back through Futures, never drive the window)"
-            )
-
-    @property
-    def depth(self) -> int:
-        return len(self._inflight)
-
-    def submit(self, key, thunk, attrs: Optional[dict] = None) -> list:
-        """Dispatch one unit of device work; returns entries retired to
-        make room (empty until the window fills)."""
-        self._check_owner()
-        retired = []
-        while len(self._inflight) >= self.window:
-            retired.append(self._retire())
-        # stamp BEFORE the thunk: jit trace + XLA compile run
-        # synchronously inside the first dispatch call, and the
-        # compile-vs-execute histograms must keep containing them
-        t_dispatch = time.perf_counter()
-        out = thunk()
-        self._inflight.append((key, out, t_dispatch, attrs))
-        self.submitted += 1
-        depth = len(self._inflight)
-        if depth > self.peak_depth:
-            self.peak_depth = depth
-        obs.gauge_max("jepsen_engine_inflight_depth", depth)
-        return retired
-
-    def _retire(self):
-        key, out, t_dispatch, attrs = self._inflight.popleft()
-        t0 = time.perf_counter()
-        if obs.enabled():
-            with obs.span(
-                "engine/dispatch", cat="engine", **(attrs or {})
-            ):
-                mat = _materialize(out)
-        else:
-            mat = _materialize(out)
-        wait = time.perf_counter() - t0
-        self.bubble_s += wait
-        obs.observe("jepsen_engine_bubble_seconds", wait)
-        if self.on_retire is not None:
-            self.on_retire(key, mat, t_dispatch)
-        return key, mat, t_dispatch
-
-    def drain(self) -> list:
-        """Retire every in-flight dispatch, oldest first."""
-        self._check_owner()
-        out = []
-        while self._inflight:
-            out.append(self._retire())
-        return out
+from .execution import (  # noqa: F401 — back-compat re-exports
+    DEFAULT_WINDOW,
+    DispatchWindow,
+    Executor,
+    default_window,
+)
+from .planning import (  # noqa: F401 — back-compat re-exports
+    DEFAULT_FLUSH_ROWS,
+    Planner,
+    RunContext,
+    default_bucketed,
+    finish_run_telemetry,
+)
 
 
 def run(
@@ -236,345 +102,57 @@ def run(
     dicts in input order, exactly the shapes ``wgl.check_batch``
     documents.  This is ``check_batch``'s engine — call that, not this,
     unless you are the dispatch layer."""
-    from ..checker import linear
-    from ..ops import encode as encode_mod
-    from ..ops import wgl
-    from ..ops.step_kernels import spec_for
-
-    if escalation is None:
-        escalation = wgl.ESCALATION_FACTORS
-    if max_dispatch is None:
-        max_dispatch = wgl.DEFAULT_MAX_DISPATCH
-    bucketed = default_bucketed() if bucketed is None else bool(bucketed)
-    flush_rows = _flush_rows()
-
-    spec = spec_for(model)
-    results: List[Optional[dict]] = [None] * len(histories)
-    oracle_futs: Dict[int, Tuple[Any, str]] = {}
-    oracle_deferred: List[Tuple[int, str]] = []
-
-    def submit_oracle(idx: int, engine_tag: str, unresolved_tag: str):
-        """Queue one history for the CPU oracle worker pool (running
-        concurrently with device work), or tag it unknown when the
-        caller runs the oracle itself (race mode).
-
-        Budgeted searches (``oracle_budget_s``) are NOT overlapped:
-        the budget is a wall-clock deadline, and GIL-sharing worker
-        threads would burn it ~workers× faster than the serial path —
-        flipping verdicts that passed serially to "unknown".  Those
-        defer to a serial drain pass after device work, exactly the
-        historical order."""
-        if not oracle_fallback:
-            results[idx] = {"valid?": "unknown", "engine": unresolved_tag}
-            return
-        if oracle_budget_s is not None:
-            oracle_deferred.append((idx, engine_tag))
-            return
-        pure = spec.pure_fs if spec else ()
-        oracle_futs[idx] = (
-            linear.analysis_async(
-                model, histories[idx], pure_fs=pure,
-                budget_s=oracle_budget_s,
-            ),
-            engine_tag,
-        )
-
-    #: chunks whose base pass overflowed, parked until the window
-    #: drains: escalation reruns dispatch at LARGER capacities, and
-    #: stacking one on top of `window` in-flight base dispatches would
-    #: hold more concurrent footprint than the crash-calibrated
-    #: per-dispatch budget (FRONTIER_DISPATCH_BUDGET) was measured for.
-    #: Deferring also matches the serial path's order (escalate after
-    #: the base pass).  Overflow is the rare path; the common
-    #: all-resolved chunk settles immediately.
-    pending_escalations: List[tuple] = []
-
-    def settle_rows(plan, arrays, rows, ok, failed_at, overflow):
-        """Escalate a chunk's overflows on-device, then assign verdicts
-        (still-overflowed rows join the oracle pool)."""
-        wgl.escalate_overflows(
-            plan, arrays, ok, failed_at, overflow,
-            mesh=mesh, escalation=escalation,
-            sufficient_rung=sufficient_rung, max_dispatch=max_dispatch,
-        )
-        assign_rows(plan, rows, ok, failed_at, overflow)
-
-    def assign_rows(plan, rows, ok, failed_at, overflow):
-        unresolved = "routed" if plan.kernel == "oracle" else "overflow"
-        for row, hist_idx in enumerate(rows):
-            if overflow[row]:
-                # still overflowed after escalation: CPU oracle decides
-                submit_oracle(hist_idx, plan.overflow_engine(), unresolved)
-            elif ok[row]:
-                results[hist_idx] = {
-                    "valid?": True,
-                    "engine": "tpu",
-                    "kernel": plan.kernel,
-                }
-            else:
-                results[hist_idx] = {
-                    "valid?": False,
-                    "engine": "tpu",
-                    "kernel": plan.kernel,
-                    "failed-event": int(failed_at[row]),
-                }
-
-    chunks: Dict[int, dict] = {}
-    next_chunk = [0]
-
-    def settle_chunk(chunk_id, mat, t_dispatch):
-        ch = chunks.pop(chunk_id)
-        plan = ch["plan"]
-        n_live = ch["n"]
-        if obs.enabled():
-            # dispatch-to-materialized latency, split compile (first
-            # dispatch of this fn at this shape: trace + XLA compile +
-            # execute) vs execute (cache-hit) exactly as the serial
-            # path recorded it — under pipelining these overlap, so
-            # their sum can exceed wall clock by design
-            obs.observe(
-                f"jepsen_kernel_{ch['phase']}_seconds",
-                time.perf_counter() - t_dispatch,
-                engine=plan.kernel,
-            )
-        # np.array (not asarray): jax outputs are read-only views and
-        # the escalation pass writes back into these
-        ok, failed_at, overflow = (np.array(x)[:n_live] for x in mat)
-        if overflow.any():
-            pending_escalations.append(
-                (plan, ch["arrays"], ch["rows"], ok, failed_at, overflow)
-            )
-        else:
-            assign_rows(plan, ch["rows"], ok, failed_at, overflow)
-
-    win = DispatchWindow(window, on_retire=settle_chunk)
-
-    def dispatch_chunk(plan, arrays, rows):
-        """Queue one ≤ plan.disp-row chunk on the device (async)."""
-        chunk_id = next_chunk[0]
-        next_chunk[0] += 1
-        disp_shape = arrays[0].shape[0]
-        # claim-before-dispatch (wgl._claim_shape is lock-protected):
-        # jit retraces per input shape, so the first dispatch at this
-        # (fn, shape) is the compile-phase one, every later one execute
-        first = wgl._claim_shape(plan.fn, disp_shape)
-        phase = "compile" if first else "execute"
-        if obs.enabled():
-            obs.count(
-                "jepsen_kernel_dispatches_total", 1,
-                engine=plan.kernel, phase=phase,
-            )
-        chunks[chunk_id] = {
-            "plan": plan, "arrays": arrays, "rows": rows,
-            "n": len(rows), "phase": phase,
-        }
-        win.submit(
-            chunk_id,
-            lambda: wgl._run_rows(plan.fn, mesh, arrays),
-            attrs={"engine": plan.kernel, "rows": len(rows),
-                   "phase": phase},
-        )
-
-    n_flushes = [0]
-
-    def flush(key, acc):
-        """Stack one bucket's encoded histories, plan its kernel, and
-        dispatch it in safe-cap chunks through the window."""
-        encs, rows = acc
-        if not encs:
-            return
-        if key is not None:
-            E, C = key
-        else:
-            # unbucketed (historical) stacking: one global padded shape
-            E, C = encode_mod.global_shape(encs, slot_cap)
-        batch = encode_mod.stack_encoded(encs, rows, E, C)
-        arrays = (
-            batch.init_state, batch.ev_slot, batch.cand_slot,
-            batch.cand_f, batch.cand_a, batch.cand_b,
-        )
-        n_flushes[0] += 1
-        plan = wgl.plan_bucket(
-            model, spec, arrays, frontier=frontier,
-            max_closure=max_closure, max_dispatch=max_dispatch,
-        )
-        B = arrays[0].shape[0]
-        if plan.fn is None or plan.disp == 0:
-            # no dispatchable kernel (oracle-routed shape, a dense-only
-            # spec outside its envelope, or even one row would crash
-            # the worker): every escalation rung is equally
-            # undispatchable (caps shrink with capacity), so settling
-            # INLINE is dispatch-free — and it hands the bucket's rows
-            # to the oracle pool NOW, overlapping the remaining device
-            # work instead of waiting for the window to drain
-            ok = np.zeros((B,), bool)
-            failed_at = np.zeros((B,), np.int32)
-            overflow = np.ones((B,), bool)
-            settle_rows(plan, arrays, batch.row_history, ok, failed_at,
-                        overflow)
-            return
-        # the frontier footprint budget (fn.safe_dispatch ←
-        # FRONTIER_DISPATCH_BUDGET) is crash-calibrated for ONE
-        # in-flight dispatch; a window of W holds W dispatches' HBM
-        # concurrently, so each frontier chunk gets 1/W of the rows —
-        # total in-flight stays at the calibrated bound.  When even
-        # that floors out (disp < W: per-row footprint near the whole
-        # budget), the bucket dispatches strictly serially at the full
-        # single-dispatch cap instead — W one-row dispatches in flight
-        # would still overshoot the bound.  Dense chunks keep the full
-        # cap: the kernel is overflow-free with a small per-row
-        # footprint, and multi-in-flight dense dispatch IS the
-        # measured flagship bench pattern (B=16384 × window, on-chip).
-        chunk_cap = plan.disp
-        serialize = False
-        if plan.kernel != "dense" and win.window > 1:
-            if plan.disp >= win.window:
-                chunk_cap = plan.disp // win.window
-            else:
-                serialize = True
-        if B <= chunk_cap:
-            if serialize:
-                win.drain()
-            dispatch_chunk(plan, arrays, batch.row_history)
-            if serialize:
-                win.drain()
-            return
-        from ..parallel import mesh as mesh_mod
-
-        for lo in range(0, B, chunk_cap):
-            hi = min(lo + chunk_cap, B)
-            # every chunk (including the tail, padded with neutral
-            # all-padding rows) dispatches at the same cap-row shape:
-            # one executable, never a per-tail-size compile
-            chunk = tuple(
-                mesh_mod.pad_to_multiple(
-                    np.asarray(a[lo:hi]), chunk_cap, fill
-                )
-                for a, fill in zip(arrays, wgl._PAD_FILLS)
-            )
-            if serialize:
-                win.drain()
-            dispatch_chunk(plan, chunk, batch.row_history[lo:hi])
-        if serialize:
-            win.drain()
+    ctx = RunContext(
+        model, histories,
+        oracle_fallback=oracle_fallback, oracle_budget_s=oracle_budget_s,
+    )
+    planner = Planner(
+        model, spec=ctx.spec, slot_cap=slot_cap, frontier=frontier,
+        max_closure=max_closure, max_dispatch=max_dispatch,
+        bucketed=bucketed,
+    )
+    ex = Executor(
+        window, mesh=mesh, escalation=escalation,
+        sufficient_rung=sufficient_rung, max_dispatch=max_dispatch,
+    )
 
     t0 = time.perf_counter()
     with obs.span("engine/pipeline", cat="engine") as sp:
-        # -- stage 1: stream host encode into shape buckets ------------
-        buckets: Dict[Any, Tuple[list, list]] = {}
-        order: List[Any] = []  # first-seen bucket order (deterministic)
-        for idx, hist in enumerate(histories):
-            e = (
-                encode_mod.encode_history(hist, model, slot_cap, spec)
-                if spec is not None
-                else None
-            )
-            if e is None:
-                # stage 3 starts NOW: the oracle search runs on its
-                # worker pool while the device batches are still being
-                # encoded and dispatched
-                submit_oracle(idx, "oracle-fallback", "unencodable")
-                continue
-            key = (
-                encode_mod.bucket_key(e, slot_cap) if bucketed else None
-            )
-            acc = buckets.get(key)
-            if acc is None:
-                acc = buckets[key] = ([], [])
-                order.append(key)
-            acc[0].append(e)
-            acc[1].append(idx)
-            # -- stage 2 interleaves: a full bucket flushes into the
-            # dispatch window while later histories are still encoding
-            if bucketed and len(acc[0]) >= flush_rows:
-                flush(key, acc)
-                buckets[key] = ([], [])
-        for key in order:
-            flush(key, buckets[key])
-        win.drain()
-        # escalation reruns dispatch now, with the window empty —
-        # exactly one in-flight dispatch, the regime the footprint
-        # budget was calibrated in (and the serial path's order).
-        # Parked chunks merge per plan first (live rows only — tail
-        # chunks carry neutral padding rows that must not interleave),
-        # so a bucket pays ONE padded rerun per escalation rung like
-        # the serial batch-wide pass did, not one ladder per chunk.
-        merged: Dict[int, list] = {}
-        merged_order: List[int] = []
-        for item in pending_escalations:
-            pid = id(item[0])
-            if pid not in merged:
-                merged[pid] = []
-                merged_order.append(pid)
-            merged[pid].append(item)
-        for pid in merged_order:
-            group = merged[pid]
-            if len(group) == 1:
-                settle_rows(*group[0])
-                continue
-            plan = group[0][0]
-            arrays = tuple(
-                np.concatenate(
-                    [np.asarray(g[1][i][: len(g[2])]) for g in group]
-                )
-                for i in range(6)
-            )
-            rows = [r for g in group for r in g[2]]
-            settle_rows(
-                plan, arrays, rows,
-                np.concatenate([g[3] for g in group]),
-                np.concatenate([g[4] for g in group]),
-                np.concatenate([g[5] for g in group]),
-            )
+        # -- stage 1+2 interleaved: the planner streams host encode
+        # into shape buckets and yields each planned flush into the
+        # dispatch window while later histories are still encoding;
+        # unencodable histories start stage 3 (the oracle pool)
+        # immediately inside the stream
+        for pb in planner.stream(ctx):
+            ex.submit(pb)
+        ex.drain()
         t_device_end = time.perf_counter()
 
-        # -- stage 3 drain: collect concurrent oracle verdicts ----------
-        for idx, (fut, engine_tag) in oracle_futs.items():
-            r = fut.result()
-            r["engine"] = engine_tag
-            results[idx] = r
-        # budgeted searches run serially here (see submit_oracle)
-        pure = spec.pure_fs if spec else ()
-        for idx, engine_tag in oracle_deferred:
-            r = linear.analysis(
-                model, histories[idx], pure_fs=pure,
-                budget_s=oracle_budget_s,
-            )
-            r["engine"] = engine_tag
-            results[idx] = r
+        # -- stage 3 drain: collect concurrent oracle verdicts
+        ctx.drain_oracles()
 
         if sp:
             # buckets = DISTINCT shape buckets (what the gauge reports);
             # flushes can exceed it when a bucket streams mid-input
-            sp.set("buckets", len(order))
-            sp.set("flushes", n_flushes[0])
-            sp.set("chunks", win.submitted)
-            sp.set("peak-inflight", win.peak_depth)
-            sp.set("window", win.window)
+            sp.set("buckets", planner.n_buckets)
+            sp.set("flushes", planner.n_flushes)
+            sp.set("chunks", ex.submitted)
+            sp.set("peak-inflight", ex.peak_depth)
+            sp.set("window", ex.window_size)
 
     if obs.enabled():
-        if order:
-            obs.gauge_max("jepsen_engine_bucket_count", len(order))
+        if planner.n_buckets:
+            obs.gauge_max("jepsen_engine_bucket_count", planner.n_buckets)
         # occupancy over the DEVICE phase only (encode→dispatch→drain→
         # escalate): including the stage-3 oracle drain would let an
         # oracle-dominated run report near-100% occupancy while the
         # device sat idle — the opposite of what the metric diagnoses
         elapsed = t_device_end - t0
-        if win.submitted and elapsed > 0:
+        if ex.submitted and elapsed > 0:
             obs.gauge_set(
                 "jepsen_engine_occupancy_ratio",
-                max(0.0, 1.0 - win.bubble_s / elapsed),
+                max(0.0, 1.0 - ex.bubble_s / elapsed),
             )
-        if results:
-            # per-subhistory engine outcomes (the observable half of
-            # P-compositional tuning): tpu rows count under their
-            # kernel name, everything else under its engine tag
-            stats = wgl.batch_stats([r for r in results if r is not None])
-            for eng, cnt in stats["engines"].items():
-                if eng == "tpu":
-                    continue
-                obs.count("jepsen_engine_rows_total", cnt, engine=eng)
-            for k, cnt in stats["kernels"].items():
-                obs.count("jepsen_engine_rows_total", cnt, engine=k)
+        finish_run_telemetry(ctx.results)
 
-    return results  # type: ignore[return-value]
+    return ctx.results  # type: ignore[return-value]

@@ -1,0 +1,398 @@
+"""The pure per-run **planning** half of the checker engine.
+
+The pipeline used to be one function owning everything from host
+encode to device dispatch.  Serving many concurrent runs from one
+resident device (jepsen_tpu.serve) forces the split the ROADMAP names:
+everything *per-run and pure* lives here — encoding histories into
+per-(E, C) shape buckets, stacking a bucket into padded arrays, and
+planning its kernel route (``wgl.plan_bucket``) — while everything
+*device-owning and shared* (the dispatch window, chunk dispatch,
+escalation reruns, oracle-pool interaction) lives in
+:mod:`jepsen_tpu.engine.execution`.
+
+Two compositions consume this module:
+
+- :func:`jepsen_tpu.engine.pipeline.run` — one run, one
+  :class:`RunContext`, one private executor: ``Planner.stream`` yields
+  planned buckets as encode proceeds (a full bucket flushes while
+  later histories are still encoding, preserving the encode/device
+  overlap the pipelined engine was built for).
+- the checker service daemon (:mod:`jepsen_tpu.serve.daemon`) — many
+  concurrent runs share ONE resident executor: request handlers call
+  :meth:`Planner.encode_buckets` (pure, parallel-safe), the daemon's
+  device thread merges same-key buckets *across runs* and stacks each
+  merged bucket once via :meth:`Planner.plan_rows`.
+
+Row identity is an opaque token ``(ctx, idx)``: every planned row
+carries the :class:`RunContext` it belongs to, so the execution layer
+can interleave rows from many runs in one dispatch and still route
+each verdict home (per-client result routing is what makes cross-run
+coalescing sound).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: rows a shape bucket accumulates before flushing mid-stream.  Kept at
+#: the default dispatch cap so ordinary batches flush exactly once per
+#: bucket (identical routing/compile behavior to the one-shot encode),
+#: while keyspaces past it stream: encode of flush k+1 overlaps the
+#: device work of flush k.
+DEFAULT_FLUSH_ROWS = 16384
+
+_UNSET = object()
+
+#: sentinel distinct from every bucket key (``None`` is the legitimate
+#: key of unbucketed mode): this history routed to the oracle pool
+_ROUTED_ORACLE = object()
+
+
+def default_bucketed() -> bool:
+    """Shape bucketing default: on unless ``JEPSEN_TPU_ENGINE_BUCKETED``
+    is falsy."""
+    return os.environ.get("JEPSEN_TPU_ENGINE_BUCKETED", "1").lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+def flush_rows_default() -> int:
+    try:
+        return max(
+            1, int(os.environ.get("JEPSEN_TPU_ENGINE_FLUSH_ROWS",
+                                  DEFAULT_FLUSH_ROWS))
+        )
+    except ValueError:
+        return DEFAULT_FLUSH_ROWS
+
+
+class RunContext:
+    """One run's bookkeeping: the histories being checked, their result
+    slots, and the oracle hand-off state.
+
+    Owns no device resources — a resident execution layer can
+    interleave rows from many live contexts into shared dispatches.
+    Thread contract (enforced by phase ordering, not locks): during
+    planning only the planning thread touches the context; during
+    execution only the executor thread assigns results; the consumer
+    may call :meth:`drain_oracles` / read :attr:`results` only after
+    execution for this context has finished (the service daemon
+    signals that with a per-request event, the in-process pipeline by
+    plain sequencing).
+    """
+
+    def __init__(
+        self,
+        model,
+        histories: Sequence,
+        *,
+        spec=_UNSET,
+        oracle_fallback: bool = True,
+        oracle_budget_s: Optional[float] = None,
+    ):
+        from ..ops.step_kernels import spec_for
+
+        self.model = model
+        self.histories = histories
+        self.spec = spec_for(model) if spec is _UNSET else spec
+        self.oracle_fallback = oracle_fallback
+        self.oracle_budget_s = oracle_budget_s
+        self.results: List[Optional[dict]] = [None] * len(histories)
+        self.oracle_futs: Dict[int, Tuple[Any, str]] = {}
+        self.oracle_deferred: List[Tuple[int, str]] = []
+
+    def assign(self, idx: int, result: dict) -> None:
+        self.results[idx] = result
+
+    def route_oracle(self, idx: int, engine_tag: str,
+                     unresolved_tag: str) -> None:
+        """Queue one history for the CPU oracle worker pool (running
+        concurrently with device work), or tag it unknown when the
+        caller runs the oracle itself (race mode).
+
+        Budgeted searches (``oracle_budget_s``) are NOT overlapped:
+        the budget is a wall-clock deadline, and GIL-sharing worker
+        threads would burn it ~workers× faster than the serial path —
+        flipping verdicts that passed serially to "unknown".  Those
+        defer to a serial drain pass after device work, exactly the
+        historical order."""
+        from ..checker import linear
+
+        if not self.oracle_fallback:
+            self.results[idx] = {"valid?": "unknown",
+                                 "engine": unresolved_tag}
+            return
+        if self.oracle_budget_s is not None:
+            self.oracle_deferred.append((idx, engine_tag))
+            return
+        pure = self.spec.pure_fs if self.spec else ()
+        self.oracle_futs[idx] = (
+            linear.analysis_async(
+                self.model, self.histories[idx], pure_fs=pure,
+                budget_s=self.oracle_budget_s,
+            ),
+            engine_tag,
+        )
+
+    def abandon_oracles(self) -> int:
+        """Best-effort cancellation of this run's oracle work — the
+        service calls it when a request is refused or timed out AFTER
+        planning already submitted searches: queued-not-started
+        futures cancel outright (the common case under overload, when
+        the pool is the bottleneck); an already-running exponential
+        search cannot be interrupted and completes into the discarded
+        future (bounded by the pool width).  Returns the number
+        cancelled."""
+        cancelled = 0
+        for fut, _tag in self.oracle_futs.values():
+            if fut.cancel():
+                cancelled += 1
+        self.oracle_futs.clear()
+        self.oracle_deferred.clear()
+        return cancelled
+
+    def drain_oracles(self) -> None:
+        """Collect concurrent oracle verdicts, then run budgeted
+        searches serially (see :meth:`route_oracle`)."""
+        from ..checker import linear
+
+        for idx, (fut, engine_tag) in self.oracle_futs.items():
+            r = fut.result()
+            r["engine"] = engine_tag
+            self.results[idx] = r
+        pure = self.spec.pure_fs if self.spec else ()
+        for idx, engine_tag in self.oracle_deferred:
+            r = linear.analysis(
+                self.model, self.histories[idx], pure_fs=pure,
+                budget_s=self.oracle_budget_s,
+            )
+            r["engine"] = engine_tag
+            self.results[idx] = r
+
+
+class PlannedBucket:
+    """One stacked-and-routed bucket, ready for the execution layer:
+    the :class:`~jepsen_tpu.ops.wgl.BucketPlan`, the padded 6-tuple of
+    arrays, and one ``(ctx, idx)`` row token per array row."""
+
+    __slots__ = ("key", "plan", "arrays", "rows")
+
+    def __init__(self, key, plan, arrays, rows):
+        self.key = key
+        self.plan = plan
+        self.arrays = arrays
+        self.rows = rows
+
+
+class Planner:
+    """Pure per-run planning: stream host encode into per-(E, C) shape
+    buckets and plan each flush's kernel route.  Holds no device
+    state; safe to run on any thread (the service daemon plans on its
+    request-handler threads)."""
+
+    def __init__(
+        self,
+        model,
+        *,
+        slot_cap: int,
+        frontier: int,
+        spec=_UNSET,
+        max_closure: Optional[int] = None,
+        max_dispatch: Optional[int] = None,
+        bucketed: Optional[bool] = None,
+        flush_rows: Optional[int] = None,
+    ):
+        from ..ops import wgl
+        from ..ops.step_kernels import spec_for
+
+        self.model = model
+        self.spec = spec_for(model) if spec is _UNSET else spec
+        self.slot_cap = slot_cap
+        self.frontier = frontier
+        self.max_closure = max_closure
+        self.max_dispatch = (
+            wgl.DEFAULT_MAX_DISPATCH if max_dispatch is None else max_dispatch
+        )
+        self.bucketed = (
+            default_bucketed() if bucketed is None else bool(bucketed)
+        )
+        self.flush_rows = (
+            flush_rows_default() if flush_rows is None else max(1, flush_rows)
+        )
+        #: distinct shape buckets seen (what the bucket-count gauge
+        #: reports); flushes can exceed it when a bucket streams
+        self.n_buckets = 0
+        self.n_flushes = 0
+
+    # -- encoding ---------------------------------------------------------
+
+    def encode_one(self, ctx: RunContext, idx: int):
+        """Encode one history of ``ctx``; ``None`` routes it to the
+        oracle (unencodable — the caller's stage 3 starts NOW)."""
+        from ..ops import encode as encode_mod
+
+        if self.spec is None:
+            return None
+        return encode_mod.encode_history(
+            ctx.histories[idx], self.model, self.slot_cap, self.spec
+        )
+
+    def bucket_key(self, e) -> Optional[tuple]:
+        from ..ops import encode as encode_mod
+
+        return (
+            encode_mod.bucket_key(e, self.slot_cap) if self.bucketed else None
+        )
+
+    def _accumulate(self, ctx: RunContext, idx: int, buckets, order):
+        """Encode one history into its bucket (the ONE shared
+        encode/route/accumulate step — oracle routing and bucket
+        keying cannot diverge between the in-process stream and the
+        service's encode_buckets).  Returns the bucket key the history
+        landed in (``None`` IS a valid key in unbucketed mode), or
+        :data:`_ROUTED_ORACLE` when it went to the oracle instead —
+        that search starts NOW, on the worker pool, overlapping all
+        remaining encode and device work."""
+        e = self.encode_one(ctx, idx)
+        if e is None:
+            ctx.route_oracle(idx, "oracle-fallback", "unencodable")
+            return _ROUTED_ORACLE
+        key = self.bucket_key(e)
+        acc = buckets.get(key)
+        if acc is None:
+            acc = buckets[key] = ([], [])
+            order.append(key)
+        acc[0].append(e)
+        acc[1].append((ctx, idx))
+        return key
+
+    def encode_buckets(self, ctx: RunContext):
+        """Encode every history of ``ctx`` into raw (unstacked) shape
+        buckets: ``(buckets, order)`` with ``buckets[key] = (encs,
+        tokens)``.  Unencodable histories route to the oracle
+        immediately.  This is the service path: raw buckets from many
+        contexts merge by key before a single stack+plan, so
+        same-shape requests share compiled executables AND dispatch
+        rows."""
+        buckets: Dict[Any, Tuple[list, list]] = {}
+        order: List[Any] = []
+        for idx in range(len(ctx.histories)):
+            self._accumulate(ctx, idx, buckets, order)
+        return buckets, order
+
+    # -- planning ---------------------------------------------------------
+
+    def plan_rows(self, key, encs: list, rows: list) -> Optional[PlannedBucket]:
+        """Stack one bucket's encoded histories and plan its kernel
+        route; ``rows`` are opaque ``(ctx, idx)`` tokens aligned with
+        ``encs``.  Returns ``None`` for an empty bucket."""
+        from ..ops import encode as encode_mod
+        from ..ops import wgl
+
+        if not encs:
+            return None
+        if key is not None:
+            E, C = key
+        else:
+            # unbucketed (historical) stacking: one global padded shape
+            E, C = encode_mod.global_shape(encs, self.slot_cap)
+        batch = encode_mod.stack_encoded(encs, rows, E, C)
+        arrays = (
+            batch.init_state, batch.ev_slot, batch.cand_slot,
+            batch.cand_f, batch.cand_a, batch.cand_b,
+        )
+        self.n_flushes += 1
+        plan = wgl.plan_bucket(
+            self.model, self.spec, arrays, frontier=self.frontier,
+            max_closure=self.max_closure, max_dispatch=self.max_dispatch,
+        )
+        return PlannedBucket(key, plan, arrays, batch.row_history)
+
+    # -- the streaming composition (in-process pipeline) ------------------
+
+    def stream(self, ctx: RunContext):
+        """Generator: encode ``ctx``'s histories one at a time and
+        yield a :class:`PlannedBucket` whenever a bucket fills
+        (mid-stream, so the consumer's device work overlaps the
+        remaining encode) or at end-of-input.  Unencodable histories
+        route to the oracle pool immediately, before any yield."""
+        buckets: Dict[Any, Tuple[list, list]] = {}
+        order: List[Any] = []  # first-seen bucket order (deterministic)
+        for idx in range(len(ctx.histories)):
+            key = self._accumulate(ctx, idx, buckets, order)
+            if key is _ROUTED_ORACLE:
+                continue  # the oracle search is already running
+            # a full bucket flushes into the dispatch window while
+            # later histories are still encoding
+            acc = buckets[key]
+            if self.bucketed and len(acc[0]) >= self.flush_rows:
+                pb = self.plan_rows(key, *acc)
+                buckets[key] = ([], [])
+                if pb is not None:
+                    yield pb
+        for key in order:
+            pb = self.plan_rows(key, *buckets[key])
+            if pb is not None:
+                yield pb
+        self.n_buckets += len(order)
+
+
+def estimated_cost(pb: PlannedBucket) -> float:
+    """Per-bucket device-cost estimate — the scheduling hook the
+    checker service orders coalesced work by (largest first → better
+    window occupancy), and the seam where a learned per-shape TPU cost
+    model ("A Learned Performance Model for TPUs", arXiv:2008.01040)
+    plugs in later: replace this analytic proxy with the model's
+    predicted kernel wall time per (E, C, F, rows).
+
+    The proxy is the dominant footprint term of each kernel family:
+    frontier work scales with rows × F·(C+1)·ceil(E/32) state words;
+    dense with rows × E (a fixed-width scan); oracle-routed buckets
+    cost the device nothing."""
+    plan = pb.plan
+    rows = len(pb.rows)
+    if plan.fn is None or plan.disp == 0:
+        return 0.0
+    if plan.kernel == "dense":
+        return float(rows * plan.E)
+    words = max(1, -(-plan.E // 32))
+    return float(rows * plan.frontier * (plan.C + 1) * words)
+
+
+def merge_buckets(runs) -> Tuple[Dict[Any, Tuple[list, list]], List[Any]]:
+    """Coalesce raw per-run buckets across runs: same-key buckets from
+    ``runs`` (an iterable of ``(buckets, order)`` pairs as returned by
+    :meth:`Planner.encode_buckets`) concatenate in arrival order into
+    one merged ``(encs, tokens)`` per key — the cross-run coalescing
+    seam the checker service dispatches through."""
+    merged: Dict[Any, Tuple[list, list]] = {}
+    merged_order: List[Any] = []
+    for buckets, order in runs:
+        for key in order:
+            encs, tokens = buckets[key]
+            acc = merged.get(key)
+            if acc is None:
+                acc = merged[key] = ([], [])
+                merged_order.append(key)
+            acc[0].extend(encs)
+            acc[1].extend(tokens)
+    return merged, merged_order
+
+
+def finish_run_telemetry(results: Sequence[Optional[dict]]) -> None:
+    """Per-subhistory engine-outcome counters (the observable half of
+    P-compositional tuning): tpu rows count under their kernel name,
+    everything else under its engine tag."""
+    from .. import obs
+    from ..ops import wgl
+
+    if not (obs.enabled() and results):
+        return
+    stats = wgl.batch_stats([r for r in results if r is not None])
+    for eng, cnt in stats["engines"].items():
+        if eng == "tpu":
+            continue
+        obs.count("jepsen_engine_rows_total", cnt, engine=eng)
+    for k, cnt in stats["kernels"].items():
+        obs.count("jepsen_engine_rows_total", cnt, engine=k)

@@ -181,6 +181,13 @@ def export_all(directory: str) -> dict:
     return export_mod.export_all(_tracer, _registry, directory)
 
 
+def render_prom() -> str:
+    """Live Prometheus exposition text for the process registry — the
+    same formatter the at-exit ``metrics.prom`` dump uses (served by
+    the checker-service daemon's ``/metrics``)."""
+    return export_mod.render_prom(_registry)
+
+
 def summary() -> dict:
     return export_mod.summary(_tracer, _registry)
 

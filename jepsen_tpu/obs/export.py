@@ -80,9 +80,23 @@ def write_spans_jsonl(tracer: Tracer, path: str) -> str:
     return path
 
 
+def render_prom(registry: Optional[MetricsRegistry] = None) -> str:
+    """Incremental registry → Prometheus text exposition, no file I/O.
+    The ONE formatter behind both the at-exit ``metrics.prom`` store
+    artifact and the checker-service daemon's live ``/metrics``
+    endpoint (jepsen_tpu.serve), so a scrape and a dump can never
+    disagree about the same registry.  Defaults to the process
+    registry."""
+    if registry is None:
+        from . import registry as _live_registry
+
+        registry = _live_registry()
+    return registry.prometheus_text()
+
+
 def write_prometheus(registry: MetricsRegistry, path: str) -> str:
     with open(path, "w") as f:
-        f.write(registry.prometheus_text())
+        f.write(render_prom(registry))
     return path
 
 
@@ -279,6 +293,14 @@ def validate_prometheus(path: str) -> Optional[str]:
             text = f.read()
     except OSError as e:
         return f"unreadable metrics file: {e!r}"
+    return validate_prometheus_text(text)
+
+
+def validate_prometheus_text(text: str) -> Optional[str]:
+    """Sanity-check Prometheus exposition text (a ``render_prom``
+    result or a live ``/metrics`` scrape body): None when valid, else
+    a human-readable reason.  Shared by the trace-smoke file check and
+    the serve-smoke endpoint check."""
     samples = 0
     for line in text.splitlines():
         if not line or line.startswith("#"):

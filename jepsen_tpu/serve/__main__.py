@@ -1,0 +1,44 @@
+"""``python -m jepsen_tpu.serve`` — run the resident checker daemon.
+
+Equivalent to ``jepsen-tpu serve --checker``; exists so the client's
+auto-start (``JEPSEN_TPU_SERVICE=auto``, ``bench.py
+--against-service``) has a suite-independent entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.serve",
+        description="resident checker service (doc/checker-service.md)",
+    )
+    p.add_argument("--host", default=None, help="bind address "
+                   "(default 127.0.0.1 — the seam is local)")
+    p.add_argument("--port", type=int, default=None,
+                   help="TCP port (default JEPSEN_TPU_SERVE_PORT or 8519)")
+    p.add_argument("--window", type=int, default=None,
+                   help="resident dispatch-window bound "
+                   "(default JEPSEN_TPU_ENGINE_WINDOW or 4)")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="admission bound: queued client runs before "
+                   "/check answers 503 (default 8)")
+    args = p.parse_args(argv)
+
+    from . import daemon, protocol
+
+    daemon.serve(
+        host=args.host or protocol.DEFAULT_HOST,
+        port=args.port,
+        window=args.window,
+        max_queue_runs=args.max_queue,
+        block=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

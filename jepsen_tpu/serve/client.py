@@ -1,0 +1,310 @@
+"""Client side of the resident checker service.
+
+:class:`ServiceClient` is the thin HTTP wrapper; :func:`check_batch`
+is the transparent seam — try the daemon, fall back to the in-process
+engine on ANY service problem (no daemon listening, backlogged 503,
+unsupported model, mid-request failure).  The fallback is the same
+``wgl.check_batch`` the daemon itself runs, so verdicts cannot depend
+on which side did the work.
+
+:class:`ServiceChecker` puts the service behind the unchanged
+``check(self, test, history, opts)`` protocol: it IS the
+linearizable checker with ``algorithm="service"`` — the whole
+post-processing tail (failure witness rendering, field truncation) is
+inherited, only the analysis hop changes.  ``checker.linearizable``
+resolves ``algorithm="auto"`` to the service when
+``JEPSEN_TPU_SERVICE`` opts in, so a fleet can flip every run to the
+warm daemon with one environment variable and zero test edits.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from . import protocol
+from .protocol import UnsupportedModel  # noqa: F401 (re-export)
+
+
+#: default socket timeout for client requests: a bit above the
+#: daemon's own device-thread request timeout (600 s), so a healthy
+#: daemon's timeout answer arrives first and a FROZEN daemon (stopped
+#: process, dead keep-alive socket) still bounds the checker run —
+#: the fallback contract covers hangs, not just refusals
+DEFAULT_CLIENT_TIMEOUT_S = 630.0
+
+
+class ServiceError(Exception):
+    """The daemon was reachable but could not serve the request."""
+
+
+class ServiceUnavailable(ServiceError):
+    """No healthy daemon at the configured address."""
+
+
+def service_mode() -> str:
+    """``JEPSEN_TPU_SERVICE``: ``""``/``0`` off (default), ``1``/any
+    truthy = use a reachable daemon, ``auto`` = additionally spawn one
+    when none is listening."""
+    v = os.environ.get("JEPSEN_TPU_SERVICE", "").strip().lower()
+    if v in ("", "0", "false", "off", "no"):
+        return "off"
+    if v == "auto":
+        return "auto"
+    return "on"
+
+
+class ServiceClient:
+    """HTTP client for one daemon address (default: localhost
+    ``JEPSEN_TPU_SERVE_PORT`` / :data:`protocol.DEFAULT_PORT`)."""
+
+    def __init__(
+        self,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ):
+        self.host = host or os.environ.get(
+            "JEPSEN_TPU_SERVE_HOST", protocol.DEFAULT_HOST)
+        try:
+            self.port: Optional[int] = int(
+                port
+                if port is not None
+                else os.environ.get("JEPSEN_TPU_SERVE_PORT",
+                                    protocol.DEFAULT_PORT)
+            )
+        except (TypeError, ValueError):
+            # a mis-set JEPSEN_TPU_SERVE_PORT must degrade like an
+            # absent daemon (the seam promises in-process fallback for
+            # ANY service problem), never crash the checker run —
+            # and silently retargeting the default port could hit a
+            # daemon the user didn't intend
+            self.port = None
+        self.timeout = timeout
+        self.last_diag: dict = {}
+        self.spawned_pid: Optional[int] = None
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _request(self, path: str, body: Optional[bytes] = None,
+                 timeout: Optional[float] = None):
+        if self.port is None:
+            raise ServiceUnavailable(
+                "invalid JEPSEN_TPU_SERVE_PORT "
+                f"({os.environ.get('JEPSEN_TPU_SERVE_PORT')!r})")
+        req = urllib.request.Request(
+            self._url(path),
+            data=body,
+            method="POST" if body is not None else "GET",
+            headers={"Content-Type": "application/json"}
+            if body is not None else {},
+        )
+        try:
+            with urllib.request.urlopen(
+                req,
+                timeout=timeout or self.timeout or DEFAULT_CLIENT_TIMEOUT_S,
+            ) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise ServiceUnavailable(f"no daemon at {self._url('')}: {e}")
+
+    def healthy(self, timeout: float = 0.5) -> bool:
+        try:
+            code, body = self._request("/healthz", timeout=timeout)
+        except ServiceUnavailable:
+            return False
+        try:
+            return code == 200 and bool(protocol.decode_body(body).get("ok"))
+        except ValueError:
+            return False
+
+    def status(self) -> dict:
+        code, body = self._request("/status", timeout=self.timeout or 5)
+        if code != 200:
+            raise ServiceError(f"status returned {code}")
+        return protocol.decode_body(body)
+
+    def metrics_text(self) -> str:
+        code, body = self._request("/metrics", timeout=self.timeout or 5)
+        if code != 200:
+            raise ServiceError(f"metrics returned {code}")
+        return body.decode()
+
+    def shutdown(self) -> dict:
+        code, body = self._request("/shutdown", body=b"{}",
+                                   timeout=self.timeout or 5)
+        if code != 200:
+            raise ServiceError(f"shutdown returned {code}")
+        return protocol.decode_body(body)
+
+    def check_batch(self, model, histories, **opts) -> List[dict]:
+        """Check a batch on the daemon; raises
+        :class:`~jepsen_tpu.serve.protocol.UnsupportedModel` (no wire
+        form / unserviceable opt), :class:`ServiceUnavailable`, or
+        :class:`ServiceError` (backlogged, daemon-side failure) — the
+        caller decides whether to fall back."""
+        body = protocol.check_request(model, histories, opts)
+        code, resp = self._request("/check", body=body)
+        payload = protocol.decode_body(resp)
+        if code == 503:
+            raise ServiceError(
+                f"daemon backlogged: {payload.get('error')}")
+        if code != 200:
+            raise ServiceError(
+                f"/check returned {code}: {payload.get('error')}")
+        results = payload["results"]
+        if len(results) != len(histories):
+            raise ServiceError(
+                f"result count {len(results)} != batch {len(histories)}")
+        self.last_diag = payload.get("diag") or {}
+        return results
+
+
+def spawn_daemon(port: Optional[int] = None,
+                 wait_s: float = 60.0) -> ServiceClient:
+    """Start a daemon subprocess (``python -m jepsen_tpu.serve``) and
+    wait until it answers /healthz.  Used by ``JEPSEN_TPU_SERVICE=auto``
+    and ``bench.py --against-service``."""
+    client = ServiceClient(port=port)
+    if client.port is None:
+        raise ServiceUnavailable("invalid JEPSEN_TPU_SERVE_PORT")
+    if client.healthy():
+        return client
+    argv = [sys.executable, "-m", "jepsen_tpu.serve",
+            "--port", str(client.port)]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        if client.healthy():
+            client.spawned_pid = proc.pid
+            return client
+        if proc.poll() is not None:
+            raise ServiceUnavailable(
+                f"spawned daemon exited with {proc.returncode}")
+        time.sleep(0.25)
+    proc.terminate()
+    try:
+        # reap it: an unwaited child is a zombie for our lifetime, and
+        # a half-initialized daemon surviving SIGTERM would squat the
+        # port in an unknown state for the next auto-start
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=10)
+    raise ServiceUnavailable(f"daemon not healthy within {wait_s}s")
+
+
+def resolve_client(auto_start: Optional[bool] = None
+                   ) -> Optional[ServiceClient]:
+    """A healthy client per the environment policy, or None (caller
+    runs in-process).  ``auto_start`` overrides the ``auto`` half of
+    :func:`service_mode`."""
+    mode = service_mode()
+    if auto_start is None:
+        auto_start = mode == "auto"
+    client = ServiceClient()
+    if client.healthy():
+        return client
+    if auto_start:
+        try:
+            return spawn_daemon()
+        except ServiceUnavailable:
+            return None
+    return None
+
+
+def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
+                auto_start: Optional[bool] = None,
+                require_opt_in: bool = False, **opts) -> List[dict]:
+    """The transparent seam: daemon when reachable, in-process
+    otherwise — same verdicts either way (serve-smoke pins it).
+    ``oracle_budget_s`` and mesh/window opts are engine-side only and
+    force the in-process path (the daemon owns its own window; budget
+    semantics need the run's serial drain — see protocol.py).
+
+    ``require_opt_in=True`` is for default-path callers (the batched
+    linearizable seam): the daemon is only consulted when
+    ``JEPSEN_TPU_SERVICE`` opts the run in, so a stray listener can
+    never silently take another run's traffic.  Explicit service users
+    (``ServiceChecker``, ``algorithm="service"``, a passed ``client``)
+    leave it False."""
+    from ..ops import wgl
+
+    serviceable = (
+        opts.get("oracle_budget_s") is None
+        and opts.get("mesh") is None
+        and opts.get("window") is None
+        and opts.get("bucketed") is not False
+        and not (require_opt_in and client is None
+                 and service_mode() == "off")
+    )
+    if serviceable:
+        if client is None:
+            client = resolve_client(auto_start)
+        if client is not None:
+            wire_opts = {
+                k: v for k, v in opts.items()
+                if k in protocol.CHECK_OPTS and v is not None
+            }
+            try:
+                return client.check_batch(model, histories, **wire_opts)
+            except (UnsupportedModel, ServiceError):
+                pass  # transparent fallback below
+    return wgl.check_batch(model, histories, **opts)
+
+
+def analysis(model, history, **kw) -> dict:
+    """Single-history :func:`check_batch` (the checker-seam shape)."""
+    return check_batch(model, [history], **kw)[0]
+
+
+def ServiceChecker(model, pure_fs=("read",), oracle_budget_s=None):
+    """The resident-service linearizability checker, behind the
+    unchanged ``check(self, test, history, opts)`` seam: connects to
+    (or, under ``JEPSEN_TPU_SERVICE=auto``, starts) the local daemon
+    and falls back transparently to the in-process engine when none is
+    reachable.  This is ``checker.linearizable(algorithm="service")``
+    — witness rendering and result truncation are shared with every
+    other algorithm."""
+    from ..checker import linearizable
+
+    return linearizable(
+        model, algorithm="service", pure_fs=pure_fs,
+        oracle_budget_s=oracle_budget_s,
+    )
+
+
+def format_status(st: dict) -> str:
+    """Render a /status dict as the CLI `status` table."""
+    lines = [
+        "── checker service " + "─" * 29,
+        f"  pid {st.get('pid')} on platform {st.get('platform')}"
+        f" · up {st.get('uptime_s', 0):.0f}s"
+        + (" · DRAINING" if st.get("stopping") else ""),
+        f"  requests: {st.get('requests', 0)}"
+        f" ({st.get('histories', 0)} histories,"
+        f" {st.get('rejected', 0)} rejected,"
+        f" {st.get('errors', 0)} errors)",
+        f"  queue: {st.get('queue_depth', 0)}/{st.get('max_queue_runs')}"
+        f" · coalesced: {st.get('coalesced', 0)}"
+        f" · window: {st.get('window')}",
+    ]
+    ratio = st.get("warm_hit_ratio")
+    warm = (f"{ratio:.0%}" if isinstance(ratio, (int, float)) else "n/a")
+    lines.append(
+        f"  dispatches: {st.get('cold_dispatches', 0)} cold"
+        f" + {st.get('warm_dispatches', 0)} warm"
+        f" (warm-hit ratio {warm})"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,628 @@
+"""The resident checker daemon: one process owns the device, the
+compiled-kernel cache, and the oracle worker pool; many client runs
+share them.
+
+Why a daemon: every ``cli test`` run pays backend init and per-shape
+re-jit from scratch — the r01–r05 bench rows show init alone can eat
+the accelerator win.  Keeping the mesh and jit cache resident
+amortizes both across runs and users; the ``check(...)`` seam stays
+the client API (jepsen_tpu.serve.client), so tests don't change.
+
+Architecture (doc/checker-service.md):
+
+- **Request handlers** (one HTTP thread per client, stdlib
+  ``ThreadingHTTPServer``) do the *pure planning half*: decode the
+  batch, build a :class:`~jepsen_tpu.engine.planning.RunContext`, and
+  encode histories into raw shape buckets
+  (:meth:`~jepsen_tpu.engine.planning.Planner.encode_buckets`) — all
+  parallel-safe host work.  Unencodable histories hit the shared
+  oracle pool immediately, before the request even queues.
+- **The device thread** owns the *execution half*: ONE resident
+  :class:`~jepsen_tpu.engine.execution.Executor` (created on this
+  thread — the dispatch window is owner-thread confined).  It pops
+  whole admission-queue backlogs, groups compatible requests (same
+  wire model + planning opts), **coalesces same-(E, C) buckets across
+  runs** (:func:`~jepsen_tpu.engine.planning.merge_buckets`) into
+  shared dispatch chunks, and signals each request's ``device_done``
+  event when its rows have settled.  Per-row ``(ctx, idx)`` tokens
+  route every verdict back to its own client.
+- **Backpressure**: admission is bounded by queued request count AND
+  queued history rows; past either bound ``/check`` answers 503 and
+  the client falls back to its in-process engine.  In-flight HBM
+  needs no extra policy — the shared executor inherits the
+  footprint-safe chunk caps (frontier chunks take 1/window of
+  ``fn.safe_dispatch``), so coalesced load can never hold more
+  concurrent HBM than the crash-calibrated single-dispatch budget.
+- **Coalescing is backpressure-driven**: a lone request dispatches
+  immediately (zero added latency); requests arriving while the
+  device is busy pile up in the queue and merge into the next device
+  batch.  ``JEPSEN_TPU_SERVE_COALESCE_WAIT`` adds a bounded gather
+  window for deterministic coalescing in tests/smoke.
+
+Shutdown drains: ``POST /shutdown`` stops admission, the device
+thread finishes every queued request, handlers flush their responses,
+then the HTTP server stops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .. import obs
+from ..engine import execution, planning
+from . import protocol
+
+#: admission bounds: queued (not yet device-processed) requests and
+#: histories; past either, /check answers 503 "backlogged" and the
+#: client falls back in-process
+DEFAULT_MAX_QUEUE_RUNS = 8
+DEFAULT_MAX_QUEUE_ROWS = 65536
+
+#: how long a handler waits for the device thread before answering 500
+DEFAULT_REQUEST_TIMEOUT_S = 600.0
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _Request:
+    """One admitted /check batch, in flight between a handler thread
+    and the device thread.  Handler-side state is written before the
+    queue put; device-side results are read only after ``device_done``
+    (the Event provides the happens-before edge)."""
+
+    __slots__ = ("ctx", "buckets", "order", "group_key", "model",
+                 "plan_opts", "exec_opts", "n", "t_admitted",
+                 "device_done", "error", "diag", "abandoned")
+
+    def __init__(self, ctx, buckets, order, group_key, model, plan_opts,
+                 exec_opts, n):
+        self.ctx = ctx
+        self.buckets = buckets
+        self.order = order
+        self.group_key = group_key
+        self.model = model
+        self.plan_opts = plan_opts
+        self.exec_opts = exec_opts
+        self.n = n
+        self.t_admitted = time.perf_counter()
+        self.device_done = threading.Event()
+        self.error: Optional[str] = None
+        self.diag: dict = {}
+        #: handler gave up (refused post-planning, or timed out): the
+        #: device thread must skip it and nobody drains its oracles
+        self.abandoned = False
+
+
+class CheckerDaemon:
+    """The resident service.  ``start(block=False)`` returns once the
+    device thread is ready; ``port`` then holds the bound port (useful
+    with port=0 in tests)."""
+
+    def __init__(
+        self,
+        host: str = protocol.DEFAULT_HOST,
+        port: int = protocol.DEFAULT_PORT,
+        *,
+        window: Optional[int] = None,
+        mesh=None,
+        max_queue_runs: Optional[int] = None,
+        max_queue_rows: Optional[int] = None,
+        coalesce_wait_s: Optional[float] = None,
+        cost_fn=None,
+    ):
+        #: per-bucket device-cost estimator driving largest-first
+        #: dispatch of coalesced work; swap in a learned model here
+        #: (see planning.estimated_cost)
+        self.cost_fn = cost_fn or planning.estimated_cost
+        self.host = host
+        self.port = port
+        self.window = window
+        self.mesh = mesh
+        # `is None`, not truthiness: --max-queue 0 means "refuse all
+        # new work", which must not silently become the default bound
+        self.max_queue_runs = (
+            int(os.environ.get("JEPSEN_TPU_SERVE_MAX_QUEUE",
+                               DEFAULT_MAX_QUEUE_RUNS))
+            if max_queue_runs is None else max_queue_runs
+        )
+        self.max_queue_rows = (
+            DEFAULT_MAX_QUEUE_ROWS if max_queue_rows is None
+            else max_queue_rows
+        )
+        self.coalesce_wait_s = (
+            coalesce_wait_s
+            if coalesce_wait_s is not None
+            else _env_float("JEPSEN_TPU_SERVE_COALESCE_WAIT", 0.0)
+        )
+        self.t_start = time.time()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._device_thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._stopping = threading.Event()
+        #: ONE condition guards every piece of handler/device shared
+        #: state (queue, row budget, stats) — and doubles as the
+        #: device thread's wake-up signal
+        self._wake = threading.Condition()
+        self._queue: List[_Request] = []  # jt: guarded-by(_wake)
+        self._queued_rows = 0  # jt: guarded-by(_wake)
+        self.stats = {  # jt: guarded-by(_wake)
+            "requests": 0, "histories": 0, "rejected": 0,
+            "coalesced": 0, "batches": 0, "warm_dispatches": 0,
+            "cold_dispatches": 0, "errors": 0,
+        }
+        self._platform: Optional[str] = None
+        self._fatal: Optional[str] = None
+
+    # -- admission (handler threads) ---------------------------------------
+
+    def precheck_admit(self, n_rows: int) -> bool:
+        """Cheap capacity check BEFORE the planning half: a request
+        that would be refused must not pay decode+encode (nor submit
+        oracle searches the pool would burn for nobody) just to hear
+        503.  The authoritative check is :meth:`admit` — this one only
+        sheds the obvious overload early, so the race window between
+        the two is a single in-flight planning pass, not the whole
+        backlog."""
+        with self._wake:
+            return not (
+                self._stopping.is_set()
+                or len(self._queue) >= self.max_queue_runs
+                or self._queued_rows + n_rows > self.max_queue_rows
+            )
+
+    def admit(self, req: _Request) -> bool:
+        with self._wake:
+            if self._stopping.is_set():
+                return False
+            if (len(self._queue) >= self.max_queue_runs
+                    or self._queued_rows + req.n > self.max_queue_rows):
+                self.stats["rejected"] += 1
+                obs.count("jepsen_serve_rejected_total")
+                return False
+            self._queue.append(req)
+            self._queued_rows += req.n
+            self.stats["requests"] += 1
+            self.stats["histories"] += req.n
+            obs.count("jepsen_serve_requests_total")
+            obs.count("jepsen_serve_histories_total", req.n)
+            obs.gauge_set("jepsen_serve_queue_depth", len(self._queue))
+            self._wake.notify()
+            return True
+
+    # -- the device thread ---------------------------------------------------
+
+    def _take_batch(self) -> List[_Request]:
+        """Pop the whole current backlog (the coalescing unit), waiting
+        up to ``coalesce_wait_s`` after the first arrival for company."""
+        with self._wake:
+            while not self._queue:
+                if self._stopping.is_set():
+                    return []
+                self._wake.wait(timeout=0.2)
+            if self.coalesce_wait_s > 0:
+                deadline = time.monotonic() + self.coalesce_wait_s
+                while (len(self._queue) < self.max_queue_runs
+                       and not self._stopping.is_set()):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(timeout=remaining)
+            batch = self._queue
+            self._queue = []
+            self._queued_rows = 0
+            obs.gauge_set("jepsen_serve_queue_depth", 0)
+            return batch
+
+    def _device_loop(self) -> None:  # jt: thread-entry
+        """The resident execution half: owns the device, the dispatch
+        window, and the jit cache for the daemon's whole life."""
+        try:
+            from ..platform import ensure_usable_backend
+
+            ensure_usable_backend()
+            import jax
+
+            self._platform = jax.devices()[0].platform
+            # created HERE: the dispatch window is owner-thread
+            # confined to the device thread
+            executor = execution.Executor(self.window, mesh=self.mesh)
+        except Exception as e:  # noqa: BLE001 — surface via /healthz + 500s
+            self._fatal = repr(e)
+            self._ready.set()
+            self._fail_all_queued()
+            return
+        self._ready.set()
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._stopping.is_set():
+                    return  # drained: every admitted request settled
+                continue
+            try:
+                self._process_batch(executor, batch)
+            except Exception as e:  # noqa: BLE001 — one bad batch must
+                # not kill the daemon; its unsettled requests answer 500
+                # (requests whose group already settled keep their
+                # results — their handlers may have responded).  The
+                # resident executor's transient state is discarded:
+                # carrying the failed batch's in-flight dispatches or
+                # parked escalations forward would poison the NEXT
+                # batch (see Executor.reset)
+                executor.reset()
+                n_err = 0
+                for req in batch:
+                    if not req.device_done.is_set():
+                        req.error = repr(e)
+                        # the 500'd client re-runs in-process; cancel
+                        # its queued oracle searches instead of letting
+                        # them burn the shared pool for nobody
+                        req.ctx.abandon_oracles()
+                        req.device_done.set()
+                        n_err += 1
+                with self._wake:
+                    self.stats["errors"] += n_err
+
+    def _fail_all_queued(self) -> None:
+        with self._wake:
+            queued, self._queue = self._queue, []
+            self._queued_rows = 0
+        for req in queued:
+            req.error = f"device thread failed: {self._fatal}"
+            req.device_done.set()
+
+    def _process_batch(self, executor, batch: List[_Request]) -> None:
+        """Group compatible requests, coalesce same-shape buckets across
+        runs, dispatch each group through the shared window."""
+        with self._wake:
+            self.stats["batches"] += 1
+        groups: Dict[Tuple, List[_Request]] = {}
+        group_order: List[Tuple] = []
+        for req in batch:
+            if req.abandoned:
+                # handler gave up (timeout): skip its work and cancel
+                # the oracle searches its planning already submitted —
+                # safe here, the device thread is ctx's only owner now
+                req.ctx.abandon_oracles()
+                continue
+            if req.group_key not in groups:
+                groups[req.group_key] = []
+                group_order.append(req.group_key)
+            groups[req.group_key].append(req)
+        with obs.span("serve/batch", cat="serve", requests=len(batch),
+                      groups=len(group_order)):
+            for gkey in group_order:
+                reqs = groups[gkey]
+                self._process_group(executor, reqs)
+                for req in reqs:
+                    if req.abandoned:
+                        # handler timed out while this group ran: no
+                        # one will drain these futures (a set() after
+                        # this check races only a just-expiring wait —
+                        # bounded to already-submitted futures)
+                        req.ctx.abandon_oracles()
+                    req.device_done.set()
+
+    def _process_group(self, executor, reqs: List[_Request]) -> None:
+        first = reqs[0]
+        if len(reqs) > 1:
+            # counted per COMPATIBLE group, not per backlog pop:
+            # requests that merely shared a device batch but sat in
+            # different groups (different model/opts) shared zero
+            # dispatch rows and must not inflate the coalescing
+            # evidence the serve-smoke gate keys on
+            with self._wake:
+                self.stats["coalesced"] += len(reqs)
+            obs.count("jepsen_serve_coalesced_requests_total", len(reqs))
+        # the resident executor adopts this group's execution policy;
+        # groups run strictly one after another (with a drain between),
+        # so the mutation never races a dispatch
+        executor.escalation = first.exec_opts["escalation"]
+        executor.sufficient_rung = first.exec_opts["sufficient_rung"]
+        executor.max_dispatch = first.exec_opts["max_dispatch"]
+        planner = planning.Planner(
+            first.model, spec=first.ctx.spec, bucketed=True,
+            **first.plan_opts,
+        )
+        merged, order = planning.merge_buckets(
+            (r.buckets, r.order) for r in reqs
+        )
+        pc0 = dict(executor.phase_counts)
+        # plan every merged bucket, then dispatch LARGEST estimated
+        # device cost first: big buckets keep the window occupied
+        # while small ones fill the tail (ROADMAP item 4's scheduling
+        # direction).  The cost fn is the daemon's pluggable seam for
+        # a learned per-shape model (planning.estimated_cost docs);
+        # verdicts are order-independent by the engine contract, so
+        # reordering is purely a throughput decision.
+        planned = []
+        for key in order:
+            encs, tokens = merged[key]
+            pb = planner.plan_rows(key, encs, tokens)
+            if pb is not None:
+                planned.append(pb)
+        planned.sort(key=self.cost_fn, reverse=True)
+        for pb in planned:
+            executor.submit(pb)
+        executor.drain()
+        warm = executor.phase_counts["execute"] - pc0["execute"]
+        cold = executor.phase_counts["compile"] - pc0["compile"]
+        if warm:
+            # a warm hit = a dispatch that reused an already-compiled
+            # (fn, shape) — the re-jit the resident cache saves
+            obs.count("jepsen_serve_warm_hits_total", warm)
+        with self._wake:
+            self.stats["warm_dispatches"] += warm
+            self.stats["cold_dispatches"] += cold
+        for req in reqs:
+            req.diag = {
+                "coalesced_with": len(reqs) - 1,
+                "warm_dispatches": warm,
+                "cold_dispatches": cold,
+                "queue_wait_s": round(
+                    time.perf_counter() - req.t_admitted, 4),
+                "buckets": len(order),
+            }
+
+    # -- status -------------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._wake:
+            stats = dict(self.stats)
+            depth = len(self._queue)
+        total = stats["warm_dispatches"] + stats["cold_dispatches"]
+        return {
+            "ok": self._fatal is None,
+            "error": self._fatal,
+            "pid": os.getpid(),
+            "platform": self._platform,
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "window": self.window or execution.default_window(),
+            "queue_depth": depth,
+            "max_queue_runs": self.max_queue_runs,
+            "max_queue_rows": self.max_queue_rows,
+            "stopping": self._stopping.is_set(),
+            "warm_hit_ratio": round(stats["warm_dispatches"] / total, 4)
+            if total else None,
+            **stats,
+        }
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, block: bool = True) -> "CheckerDaemon":
+        obs.enable()  # live /metrics needs the registry recording
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer((self.host, self.port), handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._device_thread = threading.Thread(
+            target=self._device_loop, name="jepsen-serve-device",
+            daemon=True,
+        )
+        self._device_thread.start()
+        self._ready.wait()
+        if block:
+            print(
+                f"jepsen-tpu checker service on "
+                f"http://{self.host}:{self.port}/ (pid {os.getpid()})"
+            )
+            try:
+                self._server.serve_forever()
+            finally:
+                self.stop()
+        else:
+            threading.Thread(
+                target=self._server.serve_forever, daemon=True
+            ).start()
+        return self
+
+    def request_shutdown(self) -> dict:
+        """Stop admitting, let the device thread drain, then stop the
+        HTTP server from a helper thread (the handler that called this
+        still needs to flush its response)."""
+        with self._wake:
+            already = self._stopping.is_set()
+            self._stopping.set()
+            draining = len(self._queue)
+            self._wake.notify_all()
+        if not already:
+            threading.Thread(target=self._finish_stop, daemon=True).start()
+        return {"ok": True, "draining": draining}
+
+    def _finish_stop(self) -> None:  # jt: thread-entry
+        if self._device_thread is not None:
+            self._device_thread.join(timeout=DEFAULT_REQUEST_TIMEOUT_S)
+        # tiny grace so in-flight handlers (incl. the /shutdown one)
+        # finish writing before the listener dies
+        time.sleep(0.05)
+        if self._server is not None:
+            self._server.shutdown()
+
+    def stop(self) -> None:
+        """Synchronous teardown (tests): drain + stop + join."""
+        self.request_shutdown()
+        if self._device_thread is not None:
+            self._device_thread.join(timeout=30)
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+    # -- the /check entry (handler threads) ----------------------------------
+
+    def handle_check(self, body: bytes) -> Tuple[int, dict]:
+        if self._fatal is not None:
+            return 500, {"error": f"device thread failed: {self._fatal}"}
+        try:
+            payload = protocol.decode_body(body)
+            model = protocol.model_from_wire(payload["model"])
+            histories = protocol.histories_from_wire(payload["histories"])
+            opts = payload.get("opts") or {}
+        except Exception as e:  # noqa: BLE001 — malformed client input
+            return 400, {"error": f"bad request: {e!r}"}
+        if not self.precheck_admit(len(histories)):
+            # overload sheds BEFORE the planning half: no encode, no
+            # oracle-pool submissions for a request we will refuse
+            with self._wake:
+                depth = len(self._queue)
+                self.stats["rejected"] += 1
+            obs.count("jepsen_serve_rejected_total")
+            return 503, {
+                "error": "backlogged",
+                "queue_depth": depth,
+                "stopping": self._stopping.is_set(),
+            }
+        from ..ops import wgl
+
+        plan_opts = {
+            "slot_cap": opts.get("slot_cap", wgl.DEFAULT_SLOT_CAP),
+            "frontier": opts.get("frontier", wgl.DEFAULT_FRONTIER),
+            "max_closure": opts.get("max_closure"),
+            "max_dispatch": opts.get(
+                "max_dispatch", wgl.DEFAULT_MAX_DISPATCH),
+        }
+        esc = opts.get("escalation")
+        exec_opts = {
+            "escalation": (
+                wgl.ESCALATION_FACTORS if esc is None else tuple(esc)
+            ),
+            "sufficient_rung": bool(opts.get("sufficient_rung", True)),
+            "max_dispatch": plan_opts["max_dispatch"],
+        }
+        # compatible-group key: requests coalesce into shared dispatch
+        # chunks only when the model AND every planning/execution
+        # option agree (the wire model dict is canonical-enough: same
+        # construction → same dict)
+        group_key = (
+            json.dumps(payload["model"], sort_keys=True, default=repr),
+            json.dumps(plan_opts, sort_keys=True),
+            json.dumps(
+                {**exec_opts, "escalation": list(exec_opts["escalation"])},
+                sort_keys=True,
+            ),
+        )
+        ctx = planning.RunContext(
+            model, histories,
+            oracle_fallback=bool(opts.get("oracle_fallback", True)),
+        )
+        planner = planning.Planner(
+            model, spec=ctx.spec, bucketed=True, **plan_opts
+        )
+        with obs.span("serve/plan", cat="serve", histories=len(histories)):
+            buckets, order = planner.encode_buckets(ctx)
+        req = _Request(ctx, buckets, order, group_key, model, plan_opts,
+                       exec_opts, len(histories))
+        if not self.admit(req):
+            # planning already submitted this run's unencodable rows
+            # to the oracle pool; cancel what has not started — the
+            # 503'd client re-runs everything in-process anyway
+            req.abandoned = True
+            ctx.abandon_oracles()
+            with self._wake:
+                depth = len(self._queue)
+            return 503, {
+                "error": "backlogged",
+                "queue_depth": depth,
+                "stopping": self._stopping.is_set(),
+            }
+        if not req.device_done.wait(
+            _env_float("JEPSEN_TPU_SERVE_REQUEST_TIMEOUT",
+                       DEFAULT_REQUEST_TIMEOUT_S)
+        ):
+            # nobody will read this request's results.  Only the flag
+            # is set here: the DEVICE thread owns ctx once the request
+            # is queued (it may be settling rows right now), so it —
+            # not this handler — cancels the orphaned oracle futures
+            # when it sees the flag (skip path and post-group check);
+            # a handler-side abandon would race route_oracle's dict
+            # inserts mid-settle
+            req.abandoned = True
+            return 500, {"error": "device thread timed out"}
+        if req.error is not None:
+            return 500, {"error": req.error}
+        ctx.drain_oracles()
+        return 200, {
+            "results": protocol.sanitize_results(ctx.results),
+            "diag": req.diag,
+        }
+
+
+def _make_handler(daemon: CheckerDaemon):
+    class Handler(BaseHTTPRequestHandler):
+        # one daemon per handler class: bound at server build time
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code: int, payload: dict):
+            self._reply(code, protocol.encode_body(payload))
+
+        def do_GET(self):  # noqa: N802 — http.server API, jt: thread-entry
+            try:
+                if self.path == "/healthz":
+                    ok = daemon._fatal is None
+                    self._reply_json(200 if ok else 500, {
+                        "ok": ok,
+                        "error": daemon._fatal,
+                        "platform": daemon._platform,
+                        "uptime_s": round(time.time() - daemon.t_start, 1),
+                    })
+                elif self.path == "/status":
+                    self._reply_json(200, daemon.status())
+                elif self.path == "/metrics":
+                    # live scrape — the SAME formatter as the at-exit
+                    # metrics.prom dump (obs.render_prom)
+                    self._reply(200, obs.render_prom().encode(),
+                                "text/plain; version=0.0.4")
+                else:
+                    self._reply_json(404, {"error": "not found"})
+            except BrokenPipeError:
+                pass
+
+        def do_POST(self):  # noqa: N802 — http.server API, jt: thread-entry
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                if self.path == "/check":
+                    code, payload = daemon.handle_check(body)
+                    self._reply_json(code, payload)
+                elif self.path == "/shutdown":
+                    self._reply_json(200, daemon.request_shutdown())
+                else:
+                    self._reply_json(404, {"error": "not found"})
+            except BrokenPipeError:
+                pass
+
+        def log_message(self, fmt, *args):
+            pass  # the daemon's obs metrics are the log of record
+
+    return Handler
+
+
+def serve(host: str = protocol.DEFAULT_HOST,
+          port: Optional[int] = None,
+          *,
+          window: Optional[int] = None,
+          block: bool = True,
+          **kw) -> CheckerDaemon:
+    """Build and start a checker daemon (the ``cli serve --checker``
+    / ``python -m jepsen_tpu.serve`` entry)."""
+    if port is None:
+        port = int(os.environ.get("JEPSEN_TPU_SERVE_PORT",
+                                  protocol.DEFAULT_PORT))
+    d = CheckerDaemon(host, port, window=window, **kw)
+    return d.start(block=block)

@@ -1,0 +1,256 @@
+"""Checker-service smoke check: ``python -m jepsen_tpu.serve.smoke``.
+
+Brings a resident checker daemon up in-process (ephemeral port, a
+bounded coalesce-gather window so concurrency is deterministic) and
+proves the service acceptance gates on both kernel routes (dense
+automaton, and the generic frontier kernel via an explicit closure
+cap):
+
+- **verdict byte-equality**: the service path returns results
+  byte-identical (canonical JSON) to the in-process
+  ``engine.pipeline.run`` path for the same mixed-shape batches —
+  including the oracle-fallback row;
+- **cross-run coalescing with per-client routing**: two concurrent
+  clients posting DIFFERENT batches coalesce into one shared device
+  batch (``jepsen_serve_coalesced_requests_total`` > 0) and each gets
+  exactly its own verdicts back;
+- **the warm path**: a repeat run against the warm daemon performs
+  zero compile-phase dispatches (``warm-hit`` metric > 0, measured
+  re-jit time ≈ 0) — the amortization the daemon exists for;
+- **footprint safety under coalesced load**: in-flight dispatch depth
+  never exceeds the window and the frontier dispatch-budget ratio
+  stays ≤ 1 — the shared executor inherits the crash-calibrated
+  single-dispatch HBM caps;
+- **live observability**: ``/metrics`` passes the same Prometheus
+  validator as the at-exit ``metrics.prom`` dump (one formatter:
+  ``obs.render_prom``), and ``/healthz`` answers;
+- **clean shutdown**: a request in flight when ``/shutdown`` lands
+  still completes (drain), then the daemon stops answering.
+
+Wired into ``make serve-smoke`` / ``make check``.  Exit codes: 0 ok,
+1 any gate failed.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+import time
+
+
+def _corpus_b():
+    """A second batch, distinct from engine.smoke's corpus, so
+    per-client result routing errors are detectable."""
+    from jepsen_tpu.synth import generate_history
+
+    rng = random.Random(977)
+    hists = []
+    for i in range(6):
+        hists.append(
+            generate_history(
+                rng, n_procs=3, n_ops=12, crash_p=0.02, corrupt=(i % 2 == 0)
+            )
+        )
+    for i in range(4):
+        hists.append(
+            generate_history(
+                rng, n_procs=6, n_ops=60, crash_p=0.01, corrupt=(i == 1)
+            )
+        )
+    return hists
+
+
+def _canon(results) -> str:
+    """Canonical JSON of a result list — the byte-equality the
+    acceptance gate names."""
+    from jepsen_tpu.serve import protocol
+
+    return json.dumps(protocol.sanitize_results(results), sort_keys=True)
+
+
+def _metric_value(text: str, name: str):
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            head = line.split(" ", 1)[0]
+            if head == name or head.startswith(name + "{"):
+                try:
+                    return float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    return None
+    return None
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu import models as m
+    from jepsen_tpu import obs
+    from jepsen_tpu.engine.smoke import _corpus
+    from jepsen_tpu.obs import export as obs_export
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.serve import CheckerDaemon, ServiceClient
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    obs.enable(reset=True)
+    model = m.cas_register(0)
+    batch_a = _corpus()
+    batch_b = _corpus_b()
+    configs = {
+        "dense": dict(slot_cap=32, max_dispatch=4),
+        "frontier": dict(slot_cap=32, max_dispatch=4, max_closure=9),
+    }
+
+    daemon = CheckerDaemon(port=0, coalesce_wait_s=0.75)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        check(client.healthy(), "daemon did not come up healthy")
+
+        for route, kw in configs.items():
+            # -- cold pass: this route's shapes compile exactly once,
+            # in the daemon, for the daemon's whole life
+            t0 = time.perf_counter()
+            cold = client.check_batch(model, batch_a, **kw)
+            cold_s = time.perf_counter() - t0
+            cold_diag = dict(client.last_diag)
+            check(
+                cold_diag.get("cold_dispatches", 0) > 0,
+                f"{route}: first service run should compile "
+                f"(diag {cold_diag})",
+            )
+
+            # -- two concurrent clients, DIFFERENT batches: coalesce
+            # into one device batch, each routed its own verdicts
+            coalesced0 = daemon.status()["coalesced"]
+            out = {}
+            barrier = threading.Barrier(2)
+
+            def post(tag, hists, kw=kw):
+                c = ServiceClient(port=daemon.port)
+                barrier.wait()
+                out[tag] = (c.check_batch(model, hists, **kw),
+                            dict(c.last_diag))
+
+            threads = [
+                threading.Thread(target=post, args=("a", batch_a)),
+                threading.Thread(target=post, args=("b", batch_b)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            check(
+                daemon.status()["coalesced"] - coalesced0 >= 2,
+                f"{route}: concurrent clients did not coalesce "
+                f"(status {daemon.status()})",
+            )
+
+            # -- warm pass: zero compiles, measured re-jit time ≈ 0
+            t0 = time.perf_counter()
+            warm = client.check_batch(model, batch_a, **kw)
+            warm_s = time.perf_counter() - t0
+            warm_diag = dict(client.last_diag)
+            check(
+                warm_diag.get("cold_dispatches", 0) == 0
+                and warm_diag.get("warm_dispatches", 0) > 0,
+                f"{route}: warm run re-jitted (diag {warm_diag})",
+            )
+            check(
+                warm_s < cold_s,
+                f"{route}: warm run ({warm_s:.3f}s) not faster than "
+                f"cold ({cold_s:.3f}s)",
+            )
+
+            # -- byte-equality vs the in-process engine path, every
+            # result of every run above
+            exp_a = wgl.check_batch(model, batch_a, **kw)
+            exp_b = wgl.check_batch(model, batch_b, **kw)
+            for tag, got in (
+                ("cold", cold), ("warm", warm),
+                ("client-a", out["a"][0]), ("client-b", out["b"][0]),
+            ):
+                want = exp_b if tag == "client-b" else exp_a
+                check(
+                    _canon(got) == _canon(want),
+                    f"{route}/{tag}: service verdicts diverged from "
+                    "the in-process engine",
+                )
+            check(
+                cold[-1].get("engine") == "oracle-fallback",
+                f"{route}: slot-cap history should ride the oracle "
+                f"through the service, got {cold[-1].get('engine')}",
+            )
+
+        # -- live observability: one formatter for scrape + dump
+        mtext = client.metrics_text()
+        reason = obs_export.validate_prometheus_text(mtext)
+        check(reason is None, f"/metrics failed validation: {reason}")
+        for name in ("jepsen_serve_requests_total",
+                     "jepsen_serve_coalesced_requests_total",
+                     "jepsen_serve_warm_hits_total"):
+            check(
+                (_metric_value(mtext, name) or 0) > 0,
+                f"/metrics missing live {name}",
+            )
+        # footprint safety under coalesced load: depth bounded by the
+        # window, frontier budget ratio within the calibrated 1.0
+        depth = _metric_value(mtext, "jepsen_engine_inflight_depth")
+        window = daemon.status()["window"]
+        check(
+            depth is not None and depth <= window,
+            f"in-flight depth {depth} exceeded window {window}",
+        )
+        ratio = _metric_value(
+            mtext, "jepsen_frontier_dispatch_budget_used_ratio")
+        check(
+            ratio is None or ratio <= 1.0,
+            f"frontier dispatch budget overshot under coalesced load "
+            f"({ratio})",
+        )
+
+        # -- clean shutdown drains in-flight work
+        drain_out = {}
+
+        def late_post():
+            c = ServiceClient(port=daemon.port)
+            drain_out["res"] = c.check_batch(
+                model, batch_b, **configs["dense"])
+
+        t = threading.Thread(target=late_post)
+        t.start()
+        time.sleep(0.2)  # admitted, sitting in the coalesce window
+        client.shutdown()
+        t.join(timeout=30)
+        check(
+            _canon(drain_out.get("res") or [])
+            == _canon(wgl.check_batch(model, batch_b,
+                                      **configs["dense"])),
+            "in-flight request was not drained correctly on shutdown",
+        )
+        deadline = time.monotonic() + 10
+        while client.healthy(timeout=0.3) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        check(not client.healthy(timeout=0.3),
+              "daemon still answering after shutdown")
+    finally:
+        daemon.stop()
+
+    if failures:
+        for f_ in failures:
+            print(f"serve-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "serve-smoke: ok (dense + frontier routes; coalesced concurrent "
+        "clients, warm-path zero-rejit, live /metrics valid, drained "
+        "shutdown)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

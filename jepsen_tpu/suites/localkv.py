@@ -29,7 +29,7 @@ import socket
 import threading
 from typing import Any, Dict, List, Optional
 
-from .. import client as client_mod
+from .. import client as client_mod, util
 from .. import db as db_mod
 from .. import net as net_mod
 from ..checker import linearizable
@@ -48,11 +48,7 @@ SOURCE = os.path.join(
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return util.free_port()
 
 
 def _node_id(node: Any, nodes: List[Any]) -> int:

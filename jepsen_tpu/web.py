@@ -27,6 +27,7 @@ th, td { padding: 4px 10px; border-bottom: 1px solid #ddd; text-align: left; }
 .valid-false { background: #f0c8c8; }
 .valid-unknown { background: #f0e8c0; }
 a { text-decoration: none; }
+.live { color: #2a2; font-size: 0.8em; }
 """
 
 
@@ -66,6 +67,48 @@ def _valid_class(v: Any) -> str:
     return "valid-unknown"
 
 
+def service_section() -> str:
+    """Live checker-service panel: when a resident daemon
+    (jepsen_tpu.serve) answers on the local seam, the web UI is a thin
+    client of it — live queue/warm-cache numbers and a link to its
+    /metrics scrape; with no daemon it degrades silently to the
+    store-only view this module always served."""
+    try:
+        from .serve import ServiceClient
+
+        # one probe, not healthz-then-status: a failed/absent daemon
+        # lands in the except either way, and home-page renders should
+        # pay a single short round-trip
+        client = ServiceClient(timeout=0.5)
+        st = client.status()
+    except Exception:  # noqa: BLE001 — store-only mode is the fallback
+        return ""
+    ratio = st.get("warm_hit_ratio")
+    warm = f"{ratio:.0%}" if isinstance(ratio, (int, float)) else "n/a"
+    murl = f"http://{client.host}:{client.port}/metrics"
+    rows = [
+        ("platform", st.get("platform")),
+        ("uptime", f"{st.get('uptime_s', 0):.0f} s"),
+        ("requests", f"{st.get('requests', 0)} "
+         f"({st.get('histories', 0)} histories)"),
+        ("queue", f"{st.get('queue_depth', 0)}/{st.get('max_queue_runs')}"
+         + (" — draining" if st.get("stopping") else "")),
+        ("coalesced", st.get("coalesced", 0)),
+        ("warm-hit ratio", warm),
+    ]
+    cells = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>"
+        for k, v in rows
+    )
+    return (
+        '<h2>Checker service <span class="live">●&nbsp;live</span></h2>'
+        f"<table>{cells}</table>"
+        f'<p><a href="{html.escape(murl)}">live metrics</a> '
+        "(Prometheus text)</p>"
+    )
+
+
 def home_page(base: str) -> str:
     rows = []
     for name, runs in sorted(store_mod.tests(base).items()):
@@ -73,6 +116,7 @@ def home_page(base: str) -> str:
             rows.append(test_row(base, name, t))
     rows.sort(key=lambda r: r["time"], reverse=True)
     body = [
+        service_section(),
         "<h1>Tests</h1>",
         "<table><tr><th>name</th><th>time</th><th>valid?</th>"
         "<th></th><th></th></tr>",
