@@ -2,14 +2,15 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
-# observability, pipeline, and checker-service smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke
+# observability, pipeline, checker-service, and slice-dispatch smoke
+# checks
+check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -38,6 +39,17 @@ pipeline-smoke:
 # or a shutdown that drops in-flight work
 serve-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.smoke
+
+# slice-native dispatch gate (doc/checker-engines.md): the production
+# check_batch path sharded over a forced 8-virtual-device host mesh on
+# both kernel routes + escalation; fails on ANY divergence from the
+# single-device result dicts, missing per-device metrics, or a
+# per-chip budget breach.  The second line re-runs the untouched
+# engine parity suite with the mesh forced on — the same tests that
+# pin serial/pipelined equivalence now also pin sharded equivalence.
+mesh-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.parallel.smoke
+	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m pytest tests/test_engine.py tests/test_mesh.py -q -p no:cacheprovider
 
 bench:
 	python bench.py

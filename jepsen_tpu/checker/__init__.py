@@ -730,13 +730,19 @@ class _Linearizable(Checker):
             a = self._race(test, history)
         elif algorithm == "tpu":
             from ..ops import wgl
+            from ..parallel import mesh as mesh_mod
 
             # routes through the pipelined engine (jepsen_tpu.engine):
             # test["engine-window"] (the CLI's --engine-window) bounds
-            # its in-flight device dispatches; None takes the default
+            # its in-flight device dispatches; None takes the default.
+            # An explicit test mesh (CLI --mesh / test["mesh"]) flows
+            # through like the batched seam's; None lets the engine
+            # auto-resolve the slice (doc/checker-engines.md
+            # "Slice-native dispatch")
             a = wgl.analysis(
                 self.model, history, oracle_budget_s=self.oracle_budget_s,
                 window=(test or {}).get("engine-window"),
+                mesh=mesh_mod.resolve_mesh(test or {}),
             )
         elif algorithm == "service":
             # the resident checker daemon (jepsen_tpu.serve) when one

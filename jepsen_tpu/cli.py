@@ -131,9 +131,13 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
         "--mesh",
         dest="mesh_sharding",  # "mesh" is the test-map key for the
         action="store_true",   # built Mesh object itself
-        help="shard the analysis batch over every visible accelerator "
-        "device (jax.sharding.Mesh on the history axis); single-device "
-        "runs are unaffected",
+        help="explicitly shard the analysis batch over every visible "
+        "accelerator device (jax.sharding.Mesh on the history axis); "
+        "single-device runs are unaffected.  Mostly redundant now: the "
+        "engine auto-resolves a mesh whenever >1 accelerator device is "
+        "attached (resolution order: --mesh > test['mesh'] > auto; "
+        "JEPSEN_TPU_ENGINE_MESH=0 disables auto — doc/"
+        "checker-engines.md 'Slice-native dispatch')",
     )
     p.add_argument(
         "--engine-window",

@@ -44,6 +44,29 @@ def row_bucket_target(n: int) -> int:
         target *= 2
     return target
 
+
+def _pow2_at_least(x: int) -> int:
+    return 1 << max(0, int(x) - 1).bit_length()
+
+
+def shard_row_target(n: int, n_shards: int) -> int:
+    """Row count → its stable dispatch shape on an ``n_shards``-device
+    mesh: the PER-SHARD row count rounds to its power-of-two bucket,
+    floored so the GLOBAL shape never drops below :data:`ROW_BUCKET`
+    (the same floor the single-device path uses — a tiny batch pays
+    the same ~64 neutral rows it always did, spread across the slice,
+    not 64 per chip).  Keying the bucket on per-shard rows is what
+    keeps jit executables stable as traffic varies: under
+    ``shard_map`` the traced shape is the per-device shard, so 500
+    rows and 400 rows on 8 devices both trace the 64-row-per-chip
+    kernel.  ``n_shards=1`` degenerates to :func:`row_bucket_target`
+    exactly, and the result is always divisible by ``n_shards``."""
+    if n_shards <= 1:
+        return row_bucket_target(n)
+    per_floor = _pow2_at_least(max(1, -(-ROW_BUCKET // n_shards)))
+    per = max(per_floor, _pow2_at_least(max(1, -(-n // n_shards))))
+    return n_shards * per
+
 #: default bound on concurrently in-flight device dispatches; 1 = the
 #: strictly serial dispatch-sync-dispatch path
 DEFAULT_WINDOW = 4
@@ -212,15 +235,30 @@ class Executor:
     Safety under pipelining (inherited verbatim from the pipeline it
     was factored out of): the frontier footprint budget
     (``fn.safe_dispatch`` ← ``FRONTIER_DISPATCH_BUDGET``) is
-    crash-calibrated for ONE in-flight dispatch, so with a window of W
-    each frontier chunk takes 1/W of the safe rows — total in-flight
-    HBM stays at the calibrated bound no matter how many client runs
-    coalesce.  Shapes whose cap floors out below W dispatch strictly
-    serially at the full single-dispatch cap.  Dense chunks keep the
-    full cap: the kernel is overflow-free with a small per-row
-    footprint, and multi-in-flight dense dispatch IS the measured
-    flagship bench pattern.  Escalation reruns dispatch only while
-    the window is empty (see :meth:`drain`).
+    crash-calibrated for ONE in-flight dispatch **on one chip**, so
+    with a window of W each frontier chunk takes 1/W of the safe rows
+    — total in-flight HBM stays at the calibrated bound no matter how
+    many client runs coalesce.  Shapes whose cap floors out below W
+    dispatch strictly serially at the full single-dispatch cap.  Dense
+    chunks keep the full cap: the kernel is overflow-free with a small
+    per-row footprint, and multi-in-flight dense dispatch IS the
+    measured flagship bench pattern.  Escalation reruns dispatch only
+    while the window is empty (see :meth:`drain`).
+
+    **Slice-native dispatch** (doc/checker-engines.md): with a mesh of
+    n devices (an explicit ``mesh=`` or, when none is passed, the
+    auto-resolved :func:`~jepsen_tpu.parallel.mesh.engine_default_mesh`
+    — every attached device whenever more than one is present), every
+    budget above is PER CHIP: chunk caps scale to ``n × per-chip
+    safe_dispatch`` because ``shard_map`` splits each dispatch's rows
+    evenly across the mesh, so no single chip ever holds more
+    concurrent rows than the crash-calibrated single-chip cap — never
+    a shared global pool that one chip could drain.  Chunk row counts
+    pad to a device multiple (via the per-shard power-of-two bucket,
+    :func:`shard_row_target`) with neutral all-padding rows sliced
+    back at settle, so verdicts are untouched and non-divisible
+    batches never retrace.  Per-chip in-flight rows are tracked in
+    :attr:`chip_row_accounting` — the hook the budget tests assert on.
 
     Owner-thread confined like its window: create it on the thread
     that will drive it (the service daemon builds its resident
@@ -238,6 +276,10 @@ class Executor:
     ):
         from ..ops import wgl
 
+        if mesh is None:
+            from ..parallel import mesh as mesh_mod
+
+            mesh = mesh_mod.engine_default_mesh()
         self.mesh = mesh
         self.escalation = (
             wgl.ESCALATION_FACTORS if escalation is None else escalation
@@ -262,8 +304,23 @@ class Executor:
         #: cumulative dispatch phases — the service's warm-hit
         #: accounting reads (and diffs) these across request batches
         self.phase_counts = {"compile": 0, "execute": 0}
+        #: in-flight PER-CHIP rows and their peaks, keyed by
+        #: (kernel, E, C, frontier, per-chip cap) — the accounting
+        #: hook the per-chip budget acceptance tests assert on: for
+        #: every frontier shape, peak ≤ its single-chip cap at any
+        #: window depth (dense is allowed cap × window by design)
+        self._chip_rows_inflight: Dict[int, int] = {}  # jt: guarded-by(owner-thread)
+        self.chip_row_accounting: Dict[int, dict] = {}  # jt: guarded-by(owner-thread)
+        #: per-device live/dispatched row totals (device occupancy)
+        self._dev_rows_live: List[int] = [0] * self.n_devices
+        self._dev_rows_total: List[int] = [0] * self.n_devices
 
     # -- stats the pipeline's telemetry reads -----------------------------
+
+    @property
+    def n_devices(self) -> int:
+        """Devices the engine shards each dispatch across (1 = no mesh)."""
+        return 1 if self.mesh is None else int(self.mesh.devices.size)
 
     @property
     def window_size(self) -> int:
@@ -290,6 +347,9 @@ class Executor:
         ch = self._chunks.pop(chunk_id)  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
         plan = ch["plan"]
         n_live = ch["n"]
+        fnk = ch["acct_key"]
+        left = self._chip_rows_inflight.get(fnk, 0) - ch["chip_rows"]  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
+        self._chip_rows_inflight[fnk] = max(0, left)  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
         if obs.enabled():
             # dispatch-to-materialized latency, split compile (first
             # dispatch of this fn at this shape: trace + XLA compile +
@@ -349,31 +409,77 @@ class Executor:
     # -- dispatch path ----------------------------------------------------
 
     def _dispatch_chunk(self, plan, arrays, rows):
-        """Queue one ≤ plan.disp-row chunk on the device (async)."""
+        """Queue one footprint-safe chunk on the device (async);
+        ``arrays`` is already padded to the stable dispatch shape (a
+        device multiple under a mesh)."""
         from ..ops import wgl
 
         chunk_id = self._next_chunk
         self._next_chunk += 1
-        disp_shape = arrays[0].shape[0]
-        # claim-before-dispatch (wgl._claim_shape is lock-protected):
-        # jit retraces per input shape, so the first dispatch at this
-        # (fn, shape) is the compile-phase one, every later one execute
+        n_dev = self.n_devices
+        B_pad = arrays[0].shape[0]
+        n_live = len(rows)
+        # under a mesh the executable is the shard_map wrapper: jit
+        # traces the PER-SHARD shape, so the compile/execute phase
+        # split keys on (fn, per-shard rows, mesh width) — a
+        # single-device claim at the same global rows is a different
+        # executable and must not mask a mesh compile (or vice versa)
+        disp_shape = B_pad if n_dev == 1 else (B_pad // n_dev, n_dev)
         first = wgl._claim_shape(plan.fn, disp_shape)
         phase = "compile" if first else "execute"
         self.phase_counts[phase] += 1
+        # per-chip budget accounting: shard_map splits the chunk's rows
+        # evenly, so each chip holds B_pad/n of them while the dispatch
+        # is in flight.  Keyed on the plan's shape facts INCLUDING the
+        # effective cap — not id(fn): an lru-evicted fn's id can be
+        # reused by a new compile (corrupting a resident daemon's
+        # accounting), and the daemon re-points max_dispatch per
+        # request group, so the same kernel at a different cap must be
+        # a different ledger entry, never a stale-cap false breach.
+        chip_rows = -(-B_pad // n_dev)
+        fnk = (plan.kernel, plan.E, plan.C, plan.frontier, plan.disp)
+        acct = self.chip_row_accounting.setdefault(
+            fnk, {"kernel": plan.kernel, "peak_chip_rows": 0,
+                  "chip_cap": plan.disp},
+        )
+        # shard padding + device balance: pads sit at the tail, so the
+        # last shards absorb them — the occupancy gauge makes chronic
+        # imbalance (pad-heavy tails on every dispatch) visible
         if obs.enabled():
             obs.count(
                 "jepsen_kernel_dispatches_total", 1,
                 engine=plan.kernel, phase=phase,
             )
+            if B_pad > n_live:
+                obs.count(
+                    "jepsen_engine_shard_pad_rows_total", B_pad - n_live,
+                )
+        shard = B_pad // n_dev
+        for d in range(n_dev):
+            self._dev_rows_total[d] += shard
+            self._dev_rows_live[d] += min(max(n_live - d * shard, 0), shard)
         self._chunks[chunk_id] = {
             "plan": plan, "arrays": arrays, "rows": rows,
-            "n": len(rows), "phase": phase,
+            "n": n_live, "phase": phase, "chip_rows": chip_rows,
+            "acct_key": fnk,
         }
+
+        def thunk():
+            # the in-flight increment lives INSIDE the thunk: submit
+            # retires older entries (decrementing them via settle)
+            # BEFORE dispatching, so counting earlier would overstate
+            # the peak by one retired chunk.  Runs synchronously on
+            # the owner thread, like everything the window calls.
+            cur = self._chip_rows_inflight.get(fnk, 0) + chip_rows
+            self._chip_rows_inflight[fnk] = cur
+            if cur > acct["peak_chip_rows"]:
+                acct["peak_chip_rows"] = cur
+            return wgl._run_rows(plan.fn, self.mesh, arrays)
+
         self._win.submit(
             chunk_id,
-            lambda: wgl._run_rows(plan.fn, self.mesh, arrays),
-            attrs={"engine": plan.kernel, "rows": len(rows),
+            thunk,
+            attrs={"engine": plan.kernel, "rows": n_live,
                    "phase": phase},
         )
 
@@ -399,32 +505,43 @@ class Executor:
             return
         # the frontier footprint budget (fn.safe_dispatch ←
         # FRONTIER_DISPATCH_BUDGET) is crash-calibrated for ONE
-        # in-flight dispatch; a window of W holds W dispatches' HBM
-        # concurrently, so each frontier chunk gets 1/W of the rows —
-        # total in-flight stays at the calibrated bound.  When even
-        # that floors out (disp < W: per-row footprint near the whole
-        # budget), the bucket dispatches strictly serially at the full
-        # single-dispatch cap instead — W one-row dispatches in flight
-        # would still overshoot the bound.  Dense chunks keep the full
-        # cap: the kernel is overflow-free with a small per-row
-        # footprint, and multi-in-flight dense dispatch IS the
-        # measured flagship bench pattern (B=16384 × window, on-chip).
-        chunk_cap = plan.disp
+        # in-flight dispatch ON ONE CHIP; a window of W holds W
+        # dispatches' HBM concurrently, so each frontier chunk gets
+        # 1/W of the safe rows — total in-flight stays at the
+        # calibrated bound.  When even that floors out (disp < W:
+        # per-row footprint near the whole budget), the bucket
+        # dispatches strictly serially at the full single-dispatch cap
+        # instead — W one-row dispatches in flight would still
+        # overshoot the bound.  Dense chunks keep the full cap: the
+        # kernel is overflow-free with a small per-row footprint, and
+        # multi-in-flight dense dispatch IS the measured flagship
+        # bench pattern (B=16384 × window, on-chip).
+        #
+        # On a mesh every cap is PER CHIP: shard_map splits a chunk's
+        # rows evenly across n devices, so the global chunk cap is
+        # n × the per-chip cap — each chip holds exactly the rows the
+        # single-chip calibration allows, never a share of a global
+        # pool another chip could have drained.
+        n_dev = self.n_devices
+        per_chip = plan.disp
         serialize = False
         if plan.kernel != "dense" and self._win.window > 1:
-            if plan.disp >= self._win.window:
-                chunk_cap = plan.disp // self._win.window
+            if per_chip >= self._win.window:
+                per_chip = per_chip // self._win.window
             else:
                 serialize = True
+        chunk_cap = per_chip * n_dev
         from ..parallel import mesh as mesh_mod
 
         if B <= chunk_cap:
-            # stable-shape dispatch: round the row count up to its
-            # power-of-two bucket (capped at the footprint-safe chunk
-            # cap) with neutral all-padding rows — settle slices the
+            # stable-shape dispatch: round the PER-SHARD row count up
+            # to its power-of-two bucket (shard_row_target; capped at
+            # the footprint-safe chunk cap, itself a device multiple)
+            # with neutral all-padding rows — settle slices the
             # outputs back to the live rows, so verdicts are untouched
             # while repeat traffic reuses one executable per bucket
-            target = min(chunk_cap, row_bucket_target(B))
+            # and non-divisible batches shard cleanly
+            target = min(chunk_cap, shard_row_target(B, n_dev))
             if target > B:
                 arrays = tuple(
                     mesh_mod.pad_to_multiple(np.asarray(a), target, fill)
@@ -441,7 +558,8 @@ class Executor:
             hi = min(lo + chunk_cap, B)
             # every chunk (including the tail, padded with neutral
             # all-padding rows) dispatches at the same cap-row shape:
-            # one executable, never a per-tail-size compile
+            # one executable, never a per-tail-size compile — and the
+            # cap is a device multiple, so every chunk shards evenly
             chunk = tuple(
                 mesh_mod.pad_to_multiple(
                     np.asarray(a[lo:hi]), chunk_cap, fill
@@ -467,6 +585,7 @@ class Executor:
         n = self._win.abandon()
         self._chunks.clear()
         self._pending_escalations = []
+        self._chip_rows_inflight.clear()
         return n
 
     def drain(self) -> None:
@@ -479,6 +598,19 @@ class Executor:
         pays ONE padded rerun per escalation rung like the serial
         batch-wide pass did, not one ladder per chunk."""
         self._win.drain()
+        if obs.enabled() and self.mesh is not None:
+            # per-device occupancy: the live (non-padding) share of the
+            # rows each chip was handed across this executor's
+            # dispatches.  Pads sit at the shard tail, so a chronically
+            # pad-heavy last device reads as low occupancy here — the
+            # shard-balance diagnostic for non-divisible traffic.
+            for d, total in enumerate(self._dev_rows_total):
+                if total:
+                    obs.gauge_set(
+                        "jepsen_engine_device_occupancy_ratio",
+                        self._dev_rows_live[d] / total,
+                        device=str(d),
+                    )
         pending = self._pending_escalations
         self._pending_escalations = []
         merged: Dict[int, list] = {}

@@ -24,6 +24,14 @@ accelerators fed (continuous batching / input pipelines):
   and oracle-routed/undispatchable buckets join at flush time — so
   oracle wall time hides behind device wall time on mixed batches
   instead of adding to it.
+- **Slice-native dispatch.**  With more than one device attached the
+  engine resolves a mesh itself
+  (:func:`jepsen_tpu.parallel.mesh.engine_default_mesh`) and every
+  chunk dispatches through a cached ``shard_map`` wrapper — chunk
+  caps scale to ``n × per-chip cap``, rows pad to device multiples
+  with neutral rows sliced at settle, and verdicts stay byte-identical
+  to the single-device run (``make mesh-smoke`` pins it; see
+  doc/checker-engines.md "Slice-native dispatch").
 
 Since the checker-service split, this module is the **composition**
 of the engine's two halves, not their implementation:
@@ -102,6 +110,14 @@ def run(
     dicts in input order, exactly the shapes ``wgl.check_batch``
     documents.  This is ``check_batch``'s engine — call that, not this,
     unless you are the dispatch layer."""
+    from ..parallel import mesh as mesh_mod
+
+    # slice-native by default: no explicit mesh resolves to every
+    # attached device whenever more than one is present
+    # (doc/checker-engines.md "Slice-native dispatch")
+    if mesh is None:
+        mesh = mesh_mod.engine_default_mesh()
+    n_devices = 1 if mesh is None else int(mesh.devices.size)
     ctx = RunContext(
         model, histories,
         oracle_fallback=oracle_fallback, oracle_budget_s=oracle_budget_s,
@@ -109,7 +125,7 @@ def run(
     planner = Planner(
         model, spec=ctx.spec, slot_cap=slot_cap, frontier=frontier,
         max_closure=max_closure, max_dispatch=max_dispatch,
-        bucketed=bucketed,
+        bucketed=bucketed, n_devices=n_devices,
     )
     ex = Executor(
         window, mesh=mesh, escalation=escalation,
@@ -139,6 +155,7 @@ def run(
             sp.set("chunks", ex.submitted)
             sp.set("peak-inflight", ex.peak_depth)
             sp.set("window", ex.window_size)
+            sp.set("devices", ex.n_devices)
 
     if obs.enabled():
         if planner.n_buckets:

@@ -202,6 +202,7 @@ class Planner:
         max_dispatch: Optional[int] = None,
         bucketed: Optional[bool] = None,
         flush_rows: Optional[int] = None,
+        n_devices: int = 1,
     ):
         from ..ops import wgl
         from ..ops.step_kernels import spec_for
@@ -217,7 +218,12 @@ class Planner:
         self.bucketed = (
             default_bucketed() if bucketed is None else bool(bucketed)
         )
-        self.flush_rows = (
+        # the flush threshold is a PER-DEVICE feed rate: on an n-device
+        # mesh a flush fans its rows out across all n chips, so
+        # flushing at the single-chip row count would hand each chip
+        # 1/n of a full dispatch — the mesh scales the threshold so
+        # every mid-stream flush still saturates the whole slice
+        self.flush_rows = max(1, n_devices) * (
             flush_rows_default() if flush_rows is None else max(1, flush_rows)
         )
         #: distinct shape buckets seen (what the bucket-count gauge

@@ -698,7 +698,11 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
         # notes), so they carry the full default cap — every dispatch
         # site (check_batch, the pipelined engine) reads ONE
         # ``fn.safe_dispatch`` attribute instead of special-casing
-        # engines
+        # engines.  Like the frontier caps this is a PER-CHIP number:
+        # on a mesh the engine dispatches n_devices × this many rows
+        # per chunk through the fn's shard_map variant
+        # (parallel.mesh.shard_fn), each chip holding exactly one cap
+        # worth (doc/checker-engines.md "Slice-native dispatch")
         fn.safe_dispatch = wgl_mod.DEFAULT_MAX_DISPATCH
     if wgl_mod.count_kernel_build(fn):
         # engine telemetry: a fresh build means a new (shape, lowering)

@@ -829,15 +829,21 @@ def _timed_run_chunked(fn, mesh, arrays, disp, engine):
     # jit retraces PER INPUT SHAPE, not per fn: the dispatch shape is B
     # itself below the cap, else the disp-row chunk size (tails pad to
     # it) — so first-dispatch tracking must key on (fn, shape) or a
-    # later new-batch-size compile would be mislabeled "execute"
+    # later new-batch-size compile would be mislabeled "execute".
+    # Under a mesh the executable is the shard_map wrapper traced at
+    # the per-shard shape, a different compile from the single-device
+    # one — the key carries the mesh width so neither masks the other.
     disp_shape = B if B <= disp else disp
+    if mesh is not None:
+        disp_shape = (disp_shape, int(mesh.devices.size))
     if not obs.enabled():
         # still claim first-dispatch: the kernel compiles now either
         # way, and a later obs-ON run hitting the fn cache must record
         # its cache-hit dispatch as execute, not a phantom compile
         _claim_shape(fn, disp_shape)
         return _run_chunked(fn, mesh, arrays, disp)
-    if B > disp and not _shape_dispatched(fn, disp):
+    chunk_shape = disp if mesh is None else (disp, int(mesh.devices.size))
+    if B > disp and not _shape_dispatched(fn, chunk_shape):
         # only the FIRST disp-row chunk traces+compiles; timing the
         # whole chunked call as "compile" would absorb every
         # steady-state dispatch after it and inflate the split the
@@ -1072,7 +1078,12 @@ def escalate_overflows(
             mode = mode if mode in EXACT_COMPACTIONS else "sort"
         fn2 = make_check_fn(spec.name, plan.E, plan.C, capacity, plan.mc,
                             mode)
-        disp2 = min(max_dispatch, fn2.safe_dispatch)
+        # per-chip budget: safe_dispatch (and max_dispatch) bound the
+        # rows ONE chip may hold; a mesh rerun shards its rows evenly,
+        # so the global dispatch scales by the device count while each
+        # chip stays at the crash-calibrated single-chip cap
+        n_dev = 1 if mesh is None else int(mesh.devices.size)
+        disp2 = min(max_dispatch, fn2.safe_dispatch) * n_dev
         if disp2 == 0:
             # a single row at this capacity would bust the safe
             # footprint: skip the rung, leave the rows overflowed
@@ -1109,7 +1120,14 @@ def check_batch(
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
-    over multiple devices.  Unencodable histories fall back to the CPU
+    over multiple devices — with ``mesh=None`` the engine resolves one
+    itself whenever more than one accelerator device is attached
+    (:func:`jepsen_tpu.parallel.mesh.engine_default_mesh`;
+    ``JEPSEN_TPU_ENGINE_MESH=0`` disables, ``=1`` extends the default
+    to virtual host devices).  Sharding never moves a verdict: every
+    budget is per chip and padding rows are neutral (``make
+    mesh-smoke`` pins byte-equality against the single-device run).
+    Unencodable histories fall back to the CPU
     oracle; device-side overflows first retry on-device at
     frontier × each ``escalation`` factor, then — when
     ``sufficient_rung`` (default) and the model's config-space bound is

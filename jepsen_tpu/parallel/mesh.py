@@ -5,16 +5,28 @@ real-pmap over SSH sessions, jepsen/src/jepsen/core.clj:44-57) on the
 *analysis* side with XLA collectives over a jax.sharding.Mesh: histories
 are device-data-parallel; a single psum aggregates verdict statistics
 (SURVEY.md §2.4).
+
+Since the slice-native engine work this module is also the dispatch
+seam the production pipeline runs through: :func:`shard_fn` wraps a
+compiled batched checker in ``shard_map`` (every input and output
+split along :data:`HIST_AXIS`, one cached sharded executable per
+(fn, mesh)), and :func:`engine_default_mesh` resolves the mesh the
+engine adopts when the caller passed none — every attached device
+whenever more than one is present (doc/checker-engines.md
+"Slice-native dispatch": CLI ``--mesh`` → ``test["mesh"]`` → auto).
 """
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 HIST_AXIS = "hist"
@@ -27,11 +39,48 @@ def default_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.array(devs), (HIST_AXIS,))
 
 
+def engine_default_mesh() -> Optional[Mesh]:
+    """The mesh the checker engine adopts when the caller passed none:
+    every attached device, whenever more than one is present — the
+    slice IS the production dispatch target, not an opt-in.
+
+    ``JEPSEN_TPU_ENGINE_MESH`` tunes the resolution: ``0`` disables
+    auto-sharding entirely (single-device dispatch even on a slice),
+    ``1`` extends it to virtual host devices (the CPU backend's
+    ``--xla_force_host_platform_device_count`` emulation — how
+    ``make mesh-smoke`` and the tests force the sharded path without
+    hardware).  Unset/``auto``: accelerator platforms only, because on
+    the CPU backend the virtual devices share the same cores and
+    auto-sharding every host run would tax the common case to exercise
+    an emulation.  Returns None (single-device) when the backend is
+    unreachable — mesh resolution must never be the thing that hangs a
+    checker run."""
+    mode = os.environ.get("JEPSEN_TPU_ENGINE_MESH", "auto").strip().lower()
+    if mode in ("0", "false", "off", "no"):
+        return None
+    try:
+        # local devices only: on a multi-process slice jax.devices()
+        # includes other hosts' chips, which this process cannot
+        # device_put to — each host's engine shards its own addressable
+        # devices (the history batch is already partitioned upstream)
+        devs = jax.local_devices()
+    except Exception:  # noqa: BLE001 — unreachable backend = no mesh
+        return None
+    if len(devs) < 2:
+        return None
+    if devs[0].platform == "cpu" and mode not in ("1", "on", "true", "yes",
+                                                  "force"):
+        return None
+    return default_mesh(devs)
+
+
 def resolve_mesh(test: dict) -> Optional[Mesh]:
     """The test's analysis mesh: an explicit ``test["mesh"]``, or the
     lazily-built ``test["mesh-fn"]`` (the CLI's --mesh flag installs
     one so a wedged accelerator tunnel can't hang test STARTUP — the
-    backend is only probed once histories exist and analysis begins)."""
+    backend is only probed once histories exist and analysis begins).
+    ``None`` falls through to the engine's own resolution
+    (:func:`engine_default_mesh`) at dispatch time."""
     m = test.get("mesh")
     if m is not None:
         return m
@@ -57,6 +106,56 @@ def shard_batch(mesh: Mesh, *arrays: np.ndarray):
     return tuple(jax.device_put(a, sharding) for a in arrays)
 
 
+_shard_lock = threading.Lock()
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    """Cache key for a sharded variant: axis names + the exact device
+    assignment (two meshes over the same devices share an executable;
+    a resized or reordered mesh must not)."""
+    return (mesh.axis_names, tuple(d.id for d in mesh.devices.flat))
+
+
+def shard_fn(check_fn, mesh: Mesh):
+    """The ``shard_map``-wrapped, jitted variant of a compiled batched
+    checker: all six input arrays and all three outputs partition along
+    :data:`HIST_AXIS` (per-history work is embarrassingly parallel —
+    each device runs the unmodified kernel on its row shard, no
+    collectives).  Cached per (fn, mesh) on the fn object itself, the
+    same lifetime as the ``make_check_fn``/``make_dense_fn`` caches, so
+    repeat dispatches at a shape reuse ONE sharded executable — the
+    per-call-site-mesh + sharded-compiled-step-fn pattern (SNIPPETS
+    [2]–[3]).  Inputs' leading dim must be divisible by the mesh size
+    (callers pad with neutral rows; see the engine's shard padding)."""
+    key = _mesh_key(mesh)
+    with _shard_lock:
+        cache = getattr(check_fn, "_sharded_variants", None)
+        if cache is None:
+            try:
+                cache = check_fn._sharded_variants = {}
+            except AttributeError:
+                cache = None
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+    spec = P(HIST_AXIS)
+    # check_rep=False: the kernels' closure loops (lax.while_loop) have
+    # no replication rule in this jax version, and nothing here claims
+    # replication anyway — every output is fully sharded on HIST_AXIS
+    wrapped = jax.jit(
+        shard_map(
+            check_fn, mesh=mesh,
+            in_specs=(spec,) * 6, out_specs=(spec, spec, spec),
+            check_rep=False,
+        )
+    )
+    if cache is not None:
+        with _shard_lock:
+            wrapped = cache.setdefault(key, wrapped)
+    return wrapped
+
+
 def sharded_check(
     check_fn,
     mesh: Mesh,
@@ -67,12 +166,12 @@ def sharded_check(
     cand_a: np.ndarray,
     cand_b: np.ndarray,
 ):
-    """Run a jitted batched checker with inputs sharded over the mesh.
-    The batch is padded to a device multiple — padding rows use
-    ev_slot/cand_slot = -1, which the kernel treats as no-op events, so
-    they report valid and are sliced off by the caller.  XLA partitions
-    the vmapped search across devices; no collectives are needed for the
-    per-history verdicts themselves."""
+    """Run a jitted batched checker sharded over the mesh via
+    :func:`shard_fn`.  The batch is padded to a device multiple —
+    padding rows use ev_slot/cand_slot = -1, which the kernel treats as
+    no-op events, so they report valid and are sliced off by the
+    caller.  Each device executes the kernel on its own row shard; no
+    collectives are needed for the per-history verdicts themselves."""
     n = mesh.devices.size
     b = init_state.shape[0]
     arrays = (
@@ -84,8 +183,7 @@ def sharded_check(
         pad_to_multiple(cand_b, n, 0),
     )
     sharded = shard_batch(mesh, *arrays)
-    with mesh:
-        ok, failed_at, overflow = check_fn(*sharded)
+    ok, failed_at, overflow = shard_fn(check_fn, mesh)(*sharded)
     return ok[:b], failed_at[:b], overflow[:b]
 
 

@@ -1,0 +1,143 @@
+"""Mesh smoke check: ``python -m jepsen_tpu.parallel.smoke``.
+
+The slice-native dispatch gate (doc/checker-engines.md "Slice-native
+dispatch"): forces the CPU backend into 8 virtual host devices (the
+same configuration the test conftest uses — no TPU hardware needed),
+runs the mixed-shape engine-smoke corpus through the production
+``wgl.check_batch`` path once WITHOUT a mesh and once sharded over the
+forced 8-device mesh (``JEPSEN_TPU_ENGINE_MESH``), on both kernel
+routes (dense automaton; generic frontier via an explicit closure
+cap) plus a tiny-frontier escalation config, and fails loudly on:
+
+- ANY divergence between the sharded and single-device result dicts —
+  byte-identical verdicts, engines, kernels, and failure events (the
+  acceptance gate: sharding must never move a verdict);
+- missing per-device telemetry: the sharded run must record a
+  ``jepsen_engine_device_occupancy_ratio`` gauge for every device and
+  a nonzero ``jepsen_engine_shard_pad_rows_total`` (the corpus is
+  deliberately non-divisible);
+- a per-chip budget breach: no compiled fn's peak in-flight per-chip
+  rows may exceed its single-chip cap (the executor's
+  ``chip_row_accounting`` hook — checked here end-to-end and in
+  tests/test_engine.py at the unit level).
+
+Wired into ``make mesh-smoke`` / ``make check`` so a refactor that
+skews sharded verdicts (or silently stops sharding) breaks CI, not a
+multichip capture window rounds later.
+
+Exit codes: 0 ok, 1 divergence or missing metrics.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu.platform import force_cpu_platform
+
+    force_cpu_platform(8)
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import obs
+    from jepsen_tpu.engine.smoke import _corpus
+    from jepsen_tpu.ops import wgl
+
+    hists = _corpus()
+    model = m.cas_register(0)
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    # both kernel routes + the escalation ladder; max_dispatch=4 forces
+    # several chunks per bucket so the window genuinely fills and the
+    # per-chip chunk caps actually engage
+    configs = {
+        "dense": dict(slot_cap=32, max_dispatch=4),
+        "frontier": dict(slot_cap=32, max_dispatch=4, max_closure=9),
+        "escalation": dict(slot_cap=6, frontier=8, escalation=(4,),
+                           max_closure=7),
+    }
+    for name, kw in configs.items():
+        os.environ["JEPSEN_TPU_ENGINE_MESH"] = "0"
+        single = wgl.check_batch(model, hists, **kw)
+
+        os.environ["JEPSEN_TPU_ENGINE_MESH"] = "1"
+        obs.enable(reset=True)
+        sharded = wgl.check_batch(model, hists, **kw)
+        check(
+            sharded == single,
+            f"{name}: sharded result dicts diverge from single-device "
+            f"(first mismatch: "
+            f"{next(((a, b) for a, b in zip(sharded, single) if a != b), None)})",
+        )
+        reg = obs.registry()
+        occ = [
+            reg.value("jepsen_engine_device_occupancy_ratio",
+                      device=str(d))
+            for d in range(8)
+        ]
+        check(
+            all(v is not None for v in occ),
+            f"{name}: missing per-device occupancy gauges (got {occ})",
+        )
+        pad = reg.value("jepsen_engine_shard_pad_rows_total")
+        check(
+            (pad or 0) > 0,
+            f"{name}: non-divisible corpus recorded no shard pad rows",
+        )
+        obs.enable(reset=True)
+
+    # per-chip budget end-to-end: drive the executor directly (the
+    # daemon composition) so its accounting hook is inspectable
+    from jepsen_tpu.engine import execution, planning
+    from jepsen_tpu.parallel import mesh as mesh_mod
+
+    os.environ["JEPSEN_TPU_ENGINE_MESH"] = "0"
+    mesh = mesh_mod.default_mesh()
+    ctx = planning.RunContext(model, hists)
+    planner = planning.Planner(
+        model, spec=ctx.spec, slot_cap=32, frontier=64, max_closure=9,
+        max_dispatch=8, n_devices=mesh.devices.size,
+    )
+    ex = execution.Executor(4, mesh=mesh, max_dispatch=8)
+    for pb in planner.stream(ctx):
+        ex.submit(pb)
+    ex.drain()
+    ctx.drain_oracles()
+    check(ex.n_devices == 8, f"executor mesh lost ({ex.n_devices} devices)")
+    for acct in ex.chip_row_accounting.values():
+        cap = acct["chip_cap"]
+        if acct["kernel"] == "dense":
+            # multi-in-flight dense dispatch is the measured bench
+            # pattern: up to window × the per-chip cap by design
+            cap *= ex.window_size
+        check(
+            acct["peak_chip_rows"] <= cap,
+            f"per-chip budget breached: {acct}",
+        )
+    check(
+        any(a["kernel"] == "frontier"
+            for a in ex.chip_row_accounting.values()),
+        "budget probe never dispatched a frontier chunk",
+    )
+    os.environ.pop("JEPSEN_TPU_ENGINE_MESH", None)
+
+    if failures:
+        for f_ in failures:
+            print(f"mesh-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "mesh-smoke: ok (8-device host mesh, dense + frontier + "
+        f"escalation routes, {len(hists)} mixed-shape histories, "
+        "verdicts byte-identical to single-device, per-chip budgets held)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

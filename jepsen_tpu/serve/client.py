@@ -224,14 +224,44 @@ def resolve_client(auto_start: Optional[bool] = None
     return None
 
 
+def mesh_matches_daemon(client: ServiceClient, mesh) -> bool:
+    """True when the daemon's resident mesh has the same SHAPE as the
+    caller's requested ``mesh`` (the full device grid, compared
+    against ``/status`` ``mesh_shape`` — a same-size mesh with a
+    different axis layout is NOT a match; the daemon would partition
+    differently than the caller asked).  A Mesh object cannot cross
+    the wire, but it doesn't need to: when the shapes agree the
+    daemon's own resident mesh partitions the batch exactly as the
+    client's in-process engine would — so the explicit-mesh opt is
+    droppable, not unserviceable (the PR-6 restriction, lifted
+    shape-wise)."""
+    try:
+        shape = list(mesh.devices.shape)
+    except (AttributeError, TypeError):
+        return False
+    try:
+        st = client.status()
+    except (ServiceError, ServiceUnavailable):
+        return False
+    return st.get("mesh_shape") == shape and st.get("n_devices") == int(
+        mesh.devices.size
+    )
+
+
 def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
                 auto_start: Optional[bool] = None,
                 require_opt_in: bool = False, **opts) -> List[dict]:
     """The transparent seam: daemon when reachable, in-process
     otherwise — same verdicts either way (serve-smoke pins it).
-    ``oracle_budget_s`` and mesh/window opts are engine-side only and
+    ``oracle_budget_s`` and ``window`` opts are engine-side only and
     force the in-process path (the daemon owns its own window; budget
-    semantics need the run's serial drain — see protocol.py).
+    semantics need the run's serial drain — see protocol.py).  An
+    explicit ``mesh`` is serviceable when its shape MATCHES the
+    daemon's resident mesh (``/status`` ``n_devices``): the daemon
+    shards identically through its own mesh, so the opt is dropped
+    from the wire rather than forcing the batch in-process; a
+    mismatched shape still runs in-process — the caller asked for a
+    partitioning the daemon cannot honor.
 
     ``require_opt_in=True`` is for default-path callers (the batched
     linearizable seam): the daemon is only consulted when
@@ -241,9 +271,9 @@ def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
     leave it False."""
     from ..ops import wgl
 
+    mesh = opts.get("mesh")
     serviceable = (
         opts.get("oracle_budget_s") is None
-        and opts.get("mesh") is None
         and opts.get("window") is None
         and opts.get("bucketed") is not False
         and not (require_opt_in and client is None
@@ -252,6 +282,9 @@ def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
     if serviceable:
         if client is None:
             client = resolve_client(auto_start)
+        if (client is not None and mesh is not None
+                and not mesh_matches_daemon(client, mesh)):
+            client = None  # shape mismatch: honor the mesh in-process
         if client is not None:
             wire_opts = {
                 k: v for k, v in opts.items()
@@ -287,9 +320,15 @@ def ServiceChecker(model, pure_fs=("read",), oracle_budget_s=None):
 
 def format_status(st: dict) -> str:
     """Render a /status dict as the CLI `status` table."""
+    mesh_shape = st.get("mesh_shape")
+    devices = (
+        f"{st.get('n_devices')} devices (mesh {mesh_shape})"
+        if mesh_shape else f"{st.get('n_devices') or 1} device"
+    )
     lines = [
         "── checker service " + "─" * 29,
         f"  pid {st.get('pid')} on platform {st.get('platform')}"
+        f" · {devices}"
         f" · up {st.get('uptime_s', 0):.0f}s"
         + (" · DRAINING" if st.get("stopping") else ""),
         f"  requests: {st.get('requests', 0)}"

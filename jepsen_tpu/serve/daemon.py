@@ -162,6 +162,9 @@ class CheckerDaemon:
         }
         self._platform: Optional[str] = None
         self._fatal: Optional[str] = None
+        #: devices the resident executor shards across (set by the
+        #: device thread once the executor exists; None = not ready)
+        self._n_devices: Optional[int] = None
 
     # -- admission (handler threads) ---------------------------------------
 
@@ -236,6 +239,12 @@ class CheckerDaemon:
             # created HERE: the dispatch window is owner-thread
             # confined to the device thread
             executor = execution.Executor(self.window, mesh=self.mesh)
+            # the executor auto-resolves a slice mesh when none was
+            # passed (parallel.mesh.engine_default_mesh); adopt the
+            # RESOLVED mesh so /status advertises what actually runs
+            # and mesh-matched client requests can be serviced
+            self.mesh = executor.mesh
+            self._n_devices = executor.n_devices
         except Exception as e:  # noqa: BLE001 — surface via /healthz + 500s
             self._fatal = repr(e)
             self._ready.set()
@@ -387,6 +396,14 @@ class CheckerDaemon:
             "platform": self._platform,
             "uptime_s": round(time.time() - self.t_start, 1),
             "window": self.window or execution.default_window(),
+            # the resident mesh: what slice-matched clients (serve.
+            # client mesh-shape servicing) compare their request
+            # against; n_devices=1 + mesh_shape=None = single-device
+            "n_devices": self._n_devices,
+            "mesh_shape": (
+                list(self.mesh.devices.shape)
+                if self.mesh is not None else None
+            ),
             "queue_depth": depth,
             "max_queue_runs": self.max_queue_runs,
             "max_queue_rows": self.max_queue_rows,

@@ -190,6 +190,112 @@ def test_verdict_stats_collective(mesh8):
     assert int(stats["unknown"]) == 4
 
 
+def test_engine_auto_mesh_byte_identical_and_metrics(mesh8, monkeypatch):
+    """The slice-native default path (JEPSEN_TPU_ENGINE_MESH=1 forces
+    the auto-resolution onto the virtual host devices): full result
+    dicts — verdicts, engines, kernels, failure events — must be
+    byte-identical to the single-device run on both kernel routes, and
+    the sharded run must record the per-device occupancy gauges plus a
+    nonzero shard-pad counter (the batch is non-divisible)."""
+    from jepsen_tpu import obs
+
+    rng = random.Random(45100)
+    hists = [
+        _gen(rng, n_procs=3, n_ops=16, corrupt=(i % 3 == 0))
+        for i in range(11)  # non-divisible over 8 devices
+    ]
+    model = m.cas_register(0)
+    for kw in (
+        dict(),  # dense route
+        dict(max_closure=9),  # frontier route
+    ):
+        monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "0")
+        single = wgl.check_batch(model, hists, **kw)
+        monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "1")
+        obs.enable(reset=True)
+        sharded = wgl.check_batch(model, hists, **kw)
+        assert sharded == single, kw
+        reg = obs.registry()
+        occ = [
+            reg.value("jepsen_engine_device_occupancy_ratio",
+                      device=str(d))
+            for d in range(8)
+        ]
+        assert all(v is not None and 0.0 <= v <= 1.0 for v in occ), occ
+        assert (reg.value("jepsen_engine_shard_pad_rows_total") or 0) > 0
+        obs.enable(reset=True)
+
+
+def test_engine_mesh_smaller_than_mesh_batch(monkeypatch):
+    """3 histories over 8 devices: pad rows must be verdict-neutral
+    and sliced before any stats — the sharded run equals the
+    single-device run even when most devices hold only padding."""
+    rng = random.Random(7)
+    hists = [
+        _gen(rng, n_procs=3, n_ops=12, corrupt=(i == 1)) for i in range(3)
+    ]
+    model = m.cas_register(0)
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "0")
+    single = wgl.check_batch(model, hists)
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "1")
+    sharded = wgl.check_batch(model, hists)
+    assert sharded == single
+    assert [o["valid?"] for o in sharded] == _oracle(model, hists)
+
+
+def test_engine_mesh_escalation_rerun_verdict_identical(monkeypatch):
+    """The escalation rerun path (frontier overflow → larger-capacity
+    rungs, incl. the exact sufficient rung) under the forced engine
+    mesh: result dicts identical to single-device, every row settled
+    on-device."""
+    rng = random.Random(3)
+    hists = [
+        _gen(rng, n_procs=6, n_ops=30, crash_p=0.01, corrupt=(i % 3 == 0))
+        for i in range(9)
+    ]
+    model = m.cas_register(0)
+    kw = dict(frontier=8, escalation=(4,), max_closure=7, slot_cap=6)
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "0")
+    single = wgl.check_batch(model, hists, **kw)
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "1")
+    sharded = wgl.check_batch(model, hists, **kw)
+    assert sharded == single
+    assert all(o["engine"] == "tpu" for o in sharded)
+    assert [o["valid?"] for o in sharded] == _oracle(model, hists)
+
+
+def test_shard_row_target_stable_and_divisible():
+    """Per-shard power-of-two row bucketing: results are divisible by
+    the shard count, floored at the single-device ROW_BUCKET globally
+    (a tiny batch pays the same total padding as before, not 64 rows
+    per chip), and degenerate to row_bucket_target at n_shards=1."""
+    from jepsen_tpu.engine import execution as ex
+
+    for n in (1, 5, 11, 63, 64, 65, 500, 16384):
+        assert ex.shard_row_target(n, 1) == ex.row_bucket_target(n)
+        for s in (2, 3, 8):
+            t = ex.shard_row_target(n, s)
+            assert t % s == 0 and t >= n, (n, s, t)
+            assert t >= ex.ROW_BUCKET
+    # stability: nearby row counts share a dispatch shape
+    assert ex.shard_row_target(500, 8) == ex.shard_row_target(400, 8)
+    # tiny batches keep the global floor, not a per-chip floor
+    assert ex.shard_row_target(11, 8) == 64
+
+
+def test_engine_default_mesh_resolution(monkeypatch):
+    """Resolution policy: off by default on the CPU backend (virtual
+    devices are an emulation), forced on via JEPSEN_TPU_ENGINE_MESH=1,
+    disabled outright via =0."""
+    monkeypatch.delenv("JEPSEN_TPU_ENGINE_MESH", raising=False)
+    assert mesh_mod.engine_default_mesh() is None  # cpu: opt-in only
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "1")
+    auto = mesh_mod.engine_default_mesh()
+    assert auto is not None and auto.devices.size >= 8
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "0")
+    assert mesh_mod.engine_default_mesh() is None
+
+
 def test_check_batch_mesh_lock_models(mesh8):
     """The round-4 lock automata (owner-mutex via the cas reduction,
     reentrant-mutex's own algebra) shard over the mesh like the

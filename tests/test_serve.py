@@ -482,6 +482,51 @@ def test_auto_algorithm_resolves_to_service_only_when_opted_in(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+def test_status_advertises_mesh_and_mesh_matched_requests_serviced(
+    monkeypatch,
+):
+    """/status must advertise the resident mesh (n_devices +
+    mesh_shape), and an explicit client mesh whose SHAPE matches it is
+    serviceable (the PR-6 unserviceable-mesh restriction, lifted): the
+    opt is dropped and the daemon's own identically-shaped mesh
+    shards the batch.  A mismatched shape still runs in-process."""
+    import jax
+
+    from jepsen_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_MESH", "1")
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=21, n=6, wide=False)
+    expected = wgl.check_batch(model, hists, slot_cap=32)
+
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        st = daemon.status()
+        assert st["n_devices"] == 8
+        assert st["mesh_shape"] == [8]
+        client = ServiceClient(port=daemon.port)
+
+        devs = jax.devices("cpu")
+        mesh8 = mesh_mod.default_mesh(devs[:8])
+        out = serve_client.check_batch(
+            model, hists, client=client, mesh=mesh8, slot_cap=32
+        )
+        assert [sig(r) for r in out] == [sig(r) for r in expected]
+        served = daemon.status()["requests"]
+        assert served == 1  # the mesh-matched batch went to the daemon
+
+        mesh4 = mesh_mod.default_mesh(devs[:4])
+        out4 = serve_client.check_batch(
+            model, hists, client=client, mesh=mesh4, slot_cap=32
+        )
+        assert [r["valid?"] for r in out4] == [r["valid?"] for r in expected]
+        # shape mismatch: honored in-process, daemon saw no new request
+        assert daemon.status()["requests"] == served
+    finally:
+        daemon.stop()
+
+
 def test_render_prom_matches_file_dump(tmp_path):
     from jepsen_tpu.obs import export as obs_export
 
