@@ -2,15 +2,15 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
-# observability, pipeline, checker-service, and slice-dispatch smoke
-# checks
-check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke
+# observability, pipeline, checker-service, slice-dispatch, and
+# decomposition smoke checks
+check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -50,6 +50,17 @@ serve-smoke:
 mesh-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.parallel.smoke
 	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m pytest tests/test_engine.py tests/test_mesh.py -q -p no:cacheprovider
+
+# P-compositionality gate (doc/checker-engines.md "Decomposition
+# front-end"): partitionable corpora (multi-register / multi-mutex /
+# unordered-queue) through check_batch with decomposition on vs off,
+# dense + frontier + oracle-fallback routes, single-device and then
+# sharded over the forced 8-virtual-device mesh; fails on any verdict
+# divergence, a failing partition left unnamed, missing decomposition
+# telemetry, or sub-histories not landing in the dense envelope
+decompose-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.engine.decompose_smoke
+	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m jepsen_tpu.engine.decompose_smoke
 
 bench:
 	python bench.py

@@ -95,20 +95,29 @@ def probe_accelerator(retries=None, timeout_s=None, backoff_s=None):
     return _probe(retries=retries, timeout_s=timeout_s, backoff_s=backoff_s)
 
 
+def _force_cpu_fallback() -> int:
+    """Pin jax to the CPU platform with the configured virtual-device
+    count — the ONE fallback preamble every bench entry (main,
+    --decompose) shares, so a policy change (device-count default, env
+    knob) cannot diverge between them.  Returns the device count."""
+    from jepsen_tpu.platform import force_cpu_platform
+
+    n_devices = int(
+        os.environ.get(
+            "JEPSEN_TPU_BENCH_CPU_DEVICES", min(8, os.cpu_count() or 1)
+        )
+    )
+    force_cpu_platform(n_devices)
+    return n_devices
+
+
 def run_bench(on_accelerator, warnings):
     n_devices = 1
     if not on_accelerator:
         # shard the fallback across virtual host devices through the
         # same mesh path the multichip dryrun validates — an 8-core box
         # should beat a single-core run ~linearly
-        from jepsen_tpu.platform import force_cpu_platform
-
-        n_devices = int(
-            os.environ.get(
-                "JEPSEN_TPU_BENCH_CPU_DEVICES", min(8, os.cpu_count() or 1)
-            )
-        )
-        force_cpu_platform(n_devices)
+        n_devices = _force_cpu_fallback()
 
     # backend-init cost, measured separately from checker throughput:
     # THIS is what the resident checker service (jepsen_tpu.serve)
@@ -498,6 +507,8 @@ def _best_window(recs):
 
     best = None
     for rec in recs:
+        if rec.get("bench"):  # labeled side-benches (e.g. decompose)
+            continue  # never headline the cas-register round record
         if rec.get("value") and (best is None or rank(rec) > rank(best)):
             best = rec
     if best is None:
@@ -529,7 +540,10 @@ def _headline_best(best, live_payload, reason, wrap_key):
 
 
 def _windows_summary(recs):
-    """Count + spread of all recorded on-chip capture windows."""
+    """Count + spread of all recorded on-chip capture windows (labeled
+    side-benches like the decompose headline are excluded — they are
+    not cas-register windows)."""
+    recs = [r for r in recs if not r.get("bench")]
     if not recs:
         return None
     medians = [r.get("value") for r in recs if r.get("value") is not None]
@@ -539,6 +553,130 @@ def _windows_summary(recs):
         "first": recs[0].get("captured_at"),
         "last": recs[-1].get("captured_at"),
     }
+
+
+def bench_decompose():
+    """--decompose: the wide-keyspace P-compositionality headline — a
+    multi-register batch (default 64 keys × 1000 ops on the
+    accelerator; a reduced 16 × 200 shape on the CPU fallback) checked
+    through the production ``check_batch`` path with the decomposition
+    front-end ON vs OFF.  Reports decomposed vs undecomposed
+    histories/s plus ``n_partitions`` and oracle-routing
+    before/after diag fields, and appends a ``"bench": "decompose"``
+    record to BENCH_tpu_windows.jsonl.  Emits ONE JSON line like the
+    main bench; never crashes without it."""
+    payload = {
+        "metric": "decompose_wide_keyspace_histories_per_sec",
+        "value": 0.0,
+        "unit": "histories/sec",
+    }
+    try:
+        import random
+
+        os.environ.setdefault("JEPSEN_TPU_PROBE_TRAIL", PROBE_TRAIL)
+        on_accel, probe_err = probe_accelerator()
+        if not on_accel:
+            _force_cpu_fallback()
+            payload["warnings"] = (
+                f"accelerator unusable ({probe_err}); CPU fallback at "
+                "reduced shape"
+            )
+        import jax
+
+        from jepsen_tpu import models as m
+        from jepsen_tpu import obs
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.synth import generate_mr_history
+
+        if on_accel:
+            keys, L, N = 64, 1000, 64
+        else:
+            # CPU-fallback shape: long histories amortize the per-
+            # partition encode overhead and grow the oracle's per-key
+            # search past the jax-CPU dense cost, so the fallback
+            # record shows the pass's direction (>1×) even without
+            # the dense kernel's TPU:CPU ratio behind it — shorter
+            # shapes bottom out at jax-CPU dispatch overhead instead
+            keys, L, N = 32, 4000, 16
+        keys = int(os.environ.get("JEPSEN_TPU_BENCH_DECOMPOSE_KEYS", keys))
+        L = int(os.environ.get("JEPSEN_TPU_BENCH_DECOMPOSE_L", L))
+        N = int(os.environ.get("JEPSEN_TPU_BENCH_DECOMPOSE_N", N))
+        rng = random.Random(45100)
+        hists = [
+            generate_mr_history(
+                rng, n_procs=8, n_ops=L, n_keys=keys, n_values=4,
+                crash_p=0.002, corrupt=(i % 4 == 0),
+            )
+            for i in range(N)
+        ]
+        model = m.multi_register({k: 0 for k in range(keys)})
+
+        def timed(decomposed):
+            # full warmup pass first: the timed rep measures checker
+            # throughput, not trace+XLA-compile of each (E, C) bucket
+            wgl.check_batch(model, hists, decomposed=decomposed)
+            obs.enable(reset=True)
+            t0 = time.perf_counter()
+            res = wgl.check_batch(model, hists, decomposed=decomposed)
+            dt = time.perf_counter() - t0
+            reg = obs.registry()
+            diag = {
+                # a decomposed history with mixed sub-routes reports
+                # engine="mixed" but carries oracle-partitions — count
+                # it as oracle-routed rather than hiding the load
+                "oracle_routed_histories": sum(
+                    1 for r in res
+                    if str(r.get("engine", "")).startswith("oracle")
+                    or r.get("oracle-partitions")
+                ),
+                "dense_rows": reg.value(
+                    "jepsen_engine_batch_rows_total", engine="dense") or 0,
+                "n_partitions": reg.value(
+                    "jepsen_engine_partitions_total") or 0,
+            }
+            obs.enable(reset=True)
+            return dt, res, diag
+
+        und_s, und_res, und_diag = timed(False)
+        dec_s, dec_res, dec_diag = timed(True)
+        if [r.get("valid?") for r in dec_res] != [
+            r.get("valid?") for r in und_res
+        ]:
+            payload["error"] = "decomposed/undecomposed verdicts diverged"
+        hps_dec = N / dec_s if dec_s > 0 else 0.0
+        hps_und = N / und_s if und_s > 0 else 0.0
+        payload.update({
+            "value": round(hps_dec, 2),
+            "history_len": L,
+            "n_keys": keys,
+            "batch": N,
+            "hps_undecomposed": round(hps_und, 2),
+            "speedup": round(hps_dec / hps_und, 2) if hps_und else None,
+            # the routing story the pass exists for: partitions created,
+            # and oracle traffic / dense-envelope rows before vs after
+            "n_partitions": dec_diag["n_partitions"],
+            "oracle_routed_before": und_diag["oracle_routed_histories"],
+            "oracle_routed_after": dec_diag["oracle_routed_histories"],
+            "dense_rows_before": und_diag["dense_rows"],
+            "dense_rows_after": dec_diag["dense_rows"],
+            "platform": jax.devices()[0].platform,
+        })
+        # append-only evidence, tagged so _best_window/_windows_summary
+        # never confuse it with a main cas-register capture window
+        try:
+            with open(WINDOWS, "a") as f:
+                f.write(json.dumps(
+                    {"captured_at": _utcnow(), "bench": "decompose",
+                     **payload}
+                ) + "\n")
+        except OSError as e:
+            print(f"window append failed: {e!r}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        payload["error"] = repr(e)[:300]
+    _emit(payload)
 
 
 def bench_service():
@@ -636,9 +774,20 @@ def main():
         "(jepsen_tpu.serve) instead of in-process: reports cold vs "
         "warm-path throughput and the daemon's warm-hit evidence",
     )
+    ap.add_argument(
+        "--decompose",
+        action="store_true",
+        help="wide-keyspace P-compositionality headline: multi-register "
+        "batch through check_batch with the decomposition front-end on "
+        "vs off (decomposed vs undecomposed histories/s, n_partitions, "
+        "oracle routing before/after)",
+    )
     args, _unknown = ap.parse_known_args()
     if args.against_service:
         bench_service()
+        return
+    if args.decompose:
+        bench_decompose()
         return
 
     warnings = []

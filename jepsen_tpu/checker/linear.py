@@ -226,36 +226,42 @@ def _final_paths(
 
 
 def _partition_by_key(model: Model, events: list, ops: list):
-    """P-compositionality (knossos-style): a multi-register history whose
-    every op touches exactly one key is linearizable iff each per-key
-    subhistory is linearizable against that key's register.  Returns
-    [(submodel, events, ops)] per key, or None when not decomposable.
-    The per-key searches are exponentially smaller than the product
-    search (the config set factors across keys)."""
-    from ..models import MultiRegister
-
-    if not isinstance(model, MultiRegister):
+    """P-compositionality (knossos-style, arXiv:1504.00204), driven by
+    the models' partition protocol (``partition_key`` /
+    ``subhistory_model`` / ``partition_op`` — the same protocol the
+    engine-side pass :mod:`jepsen_tpu.engine.decompose` consumes):
+    a history whose every op touches exactly one partition is
+    linearizable iff each partition's subhistory is linearizable
+    against that partition's sub-model.  Returns
+    [(submodel, events, ops)] per partition in first-seen order, or
+    None when the model declares no partition or any op's partition is
+    undeterminable.  The per-partition searches are exponentially
+    smaller than the product search (the config set factors across
+    partitions).  Ops here are post-``prepare`` (completion values
+    propagated onto invocations), so a dequeue's value is resolved."""
+    key_fn = getattr(model, "partition_key", None)
+    if not callable(key_fn):
         return None
     op_key: list = []
     for op in ops:
-        keys = {k for _f, k, _v in (op.value or [])}
-        if len(keys) != 1:
+        k = key_fn(op)
+        if k is None:
             return None
-        op_key.append(next(iter(keys)))
-    init = model._as_dict()
+        op_key.append(k)
     parts: Dict[Any, Tuple[list, list, Dict[int, int]]] = {}
+    order: list = []
     for kind, op_id in events:
         k = op_key[op_id]
         if k not in parts:
             parts[k] = ([], [], {})
+            order.append(k)
         ev_k, ops_k, remap = parts[k]
         if op_id not in remap:
             remap[op_id] = len(ops_k)
-            ops_k.append(ops[op_id])
+            ops_k.append(model.partition_op(ops[op_id], k))
         ev_k.append((kind, remap[op_id]))
     return [
-        (MultiRegister({k: init.get(k)}), ev_k, ops_k)
-        for k, (ev_k, ops_k, _remap) in parts.items()
+        (model.subhistory_model(k), parts[k][0], parts[k][1]) for k in order
     ]
 
 
@@ -558,7 +564,11 @@ def _analysis_impl(
     if parts is not None and len(parts) > 1:
         worst = None
         for m_k, ev_k, ops_k in parts:
-            r = _search_fast(
+            # a partition's sub-model may itself have a direct checker
+            # (multi-mutex → per-lock Mutex decides in O(n log n));
+            # fall through to the fast search otherwise
+            d_k = locks_direct.dispatch_events(m_k, ev_k, ops_k)
+            r = d_k if d_k is not None else _search_fast(
                 m_k, ev_k, ops_k, max_configs, deadline, budget_s
             )
             if r["valid?"] is False:

@@ -29,8 +29,19 @@ device-owning **execution** layer (:mod:`jepsen_tpu.engine.execution`:
 ``DispatchWindow``, ``Executor``); :mod:`~jepsen_tpu.engine.pipeline`
 composes them per run, while the resident checker service
 (:mod:`jepsen_tpu.serve`) shares one executor across concurrent runs.
+Ahead of planning, the P-compositionality front-end
+(:mod:`jepsen_tpu.engine.decompose`) splits partitionable models'
+histories into per-partition sub-histories and ANDs the sub-verdicts
+at settle — wide-keyspace workloads check as thousands of tiny dense
+rows instead of one oracle-bound search.
 """
 
+from .decompose import (  # noqa: F401
+    DecomposedRun,
+    SubmodelCache,
+    merge_partition_results,
+    split_history,
+)
 from .execution import (  # noqa: F401
     DEFAULT_WINDOW,
     DispatchWindow,

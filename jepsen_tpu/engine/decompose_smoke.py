@@ -1,0 +1,260 @@
+"""Decomposition smoke check: ``python -m jepsen_tpu.engine.decompose_smoke``.
+
+The P-compositionality gate (doc/checker-engines.md "Decomposition
+front-end"): runs a seeded partitionable corpus — wide-keyspace
+multi-register op-soup (valid + corrupted + cross-key undecomposable +
+one slot-cap-exceeding oracle row), multi-mutex lock soup, and
+unordered-queue traffic — through the production ``check_batch`` path
+with decomposition ON vs OFF, on the dense route, the generic frontier
+route (explicit closure cap), and the oracle-fallback route, and —
+when ``JEPSEN_TPU_ENGINE_MESH=1`` (how ``make check`` invokes the
+second pass) — sharded over the virtual-device mesh.  Fails loudly on:
+
+- any verdict (``valid?``) divergence between the decomposed and
+  pass-through paths, or any divergence in the normalized result dicts
+  (everything except the decomposition-only ``partitions`` /
+  ``failed-partition`` tags and the per-sub-history routing facts —
+  ``engine``/``kernel``/``algorithm``/``failed-event``/witness
+  payloads — which legitimately differ because sub-histories route,
+  and fail, in sub-history coordinates);
+- a failing decomposed history not naming its ``failed-partition``;
+- missing decomposition telemetry: ``jepsen_engine_partitions_total``,
+  the ``jepsen_engine_partition_fanout`` histogram, and both routes of
+  ``jepsen_engine_decomposed_total`` (multi-register and multi-mutex;
+  the unordered queue must instead NOT decompose engine-side — its
+  direct-first routing already factors per value, and the gate
+  regressing would multiply oracle tasks by the fanout);
+- the perf direction inverting: the decomposed run must route FEWER
+  rows to the oracle than the pass-through run on the wide-keyspace
+  corpus (the whole point of the pass).
+
+Wired into ``make decompose-smoke`` / ``make check`` so a refactor
+that silently skews decomposed verdicts (or stops decomposing) breaks
+CI, not a fuzz sweep rounds later.
+
+Exit codes: 0 ok, 1 divergence or missing metrics.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+
+#: result-dict keys the two paths must agree on bit-for-bit; routing
+#: facts and failure coordinates are per-sub-history by design
+_PINNED = ("valid?",)
+
+
+def _normalize(r: dict) -> tuple:
+    return tuple((k, r.get(k)) for k in _PINNED)
+
+
+def _corpus():
+    from jepsen_tpu import models as m
+    from jepsen_tpu.history import History, invoke_op, ok_op
+    from jepsen_tpu.synth import generate_mr_history
+
+    rng = random.Random(45100)
+
+    def h(*ops):
+        return History(list(ops)).index_ops()
+
+    mr_model = m.multi_register({k: 0 for k in range(16)})
+    mr = [
+        generate_mr_history(
+            rng, n_procs=5, n_ops=60, n_keys=16, n_values=4,
+            crash_p=0.02, corrupt=(i % 3 == 0),
+        )
+        for i in range(10)
+    ]
+    # cross-key txn: undecomposable, exercises the pass-through lane
+    mr.append(h(
+        invoke_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        ok_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        invoke_op(1, "txn", [("r", 0, None)]),
+        ok_op(1, "txn", [("r", 0, 1)]),
+    ))
+    # slot-cap-exceeding row: oracle fallback, decomposed or not
+    wide = History(
+        [invoke_op(p, "txn", [("w", p % 16, 1)]) for p in range(40)]
+    ).index_ops()
+    mr.append(wide)
+
+    mm_model = m.multi_mutex()
+    mm = []
+    for i in range(6):
+        ops = []
+        held = set()
+        for _ in range(30):
+            name = rng.choice("abcd")
+            p = rng.randrange(4)
+            if name in held:
+                ops.append(invoke_op(p, "release", name))
+                ops.append(ok_op(p, "release", name))
+                held.discard(name)
+            else:
+                ops.append(invoke_op(p, "acquire", name))
+                ops.append(ok_op(p, "acquire", name))
+                held.add(name)
+        if i % 3 == 0 and ops:
+            # corrupt: double-acquire one held lock
+            name = rng.choice("abcd")
+            ops.append(invoke_op(5, "acquire", name))
+            ops.append(ok_op(5, "acquire", name))
+            ops.append(invoke_op(6, "acquire", name))
+            ops.append(ok_op(6, "acquire", name))
+        mm.append(History(ops).index_ops())
+
+    uq_model = m.unordered_queue()
+    uq = []
+    for i in range(6):
+        ops = []
+        in_q = []
+        for _ in range(24):
+            if in_q and rng.random() < 0.4:
+                v = in_q.pop(rng.randrange(len(in_q)))
+                ops.append(invoke_op(0, "dequeue", None))
+                ops.append(ok_op(0, "dequeue", v))
+            else:
+                v = rng.randrange(8)
+                in_q.append(v)
+                ops.append(invoke_op(0, "enqueue", v))
+                ops.append(ok_op(0, "enqueue", v))
+        if i % 3 == 0:
+            ops.append(invoke_op(1, "dequeue", None))
+            ops.append(ok_op(1, "dequeue", 99))  # never enqueued
+        uq.append(History(ops).index_ops())
+
+    return [(mr_model, mr), (mm_model, mm), (uq_model, uq)]
+
+
+def main(argv=None) -> int:
+    import os
+
+    from jepsen_tpu import obs
+    from jepsen_tpu.ops import wgl
+
+    mesh_forced = os.environ.get("JEPSEN_TPU_ENGINE_MESH") == "1"
+    if mesh_forced:
+        from jepsen_tpu.platform import force_cpu_platform
+
+        force_cpu_platform(8)
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    # dense (default routing), frontier (explicit closure cap), and
+    # oracle-fallback (tiny slot cap: most histories unencodable) —
+    # the three lanes a decomposed sub-history can land in
+    configs = {
+        "dense": dict(slot_cap=32),
+        "frontier": dict(slot_cap=32, max_closure=9),
+        "oracle-fallback": dict(slot_cap=2),
+    }
+    for name, kw in configs.items():
+        for model, hists in _corpus():
+            obs.enable(reset=True)
+            dec = wgl.check_batch(model, hists, decomposed=True, **kw)
+            reg = obs.registry()
+            n_parts = reg.value("jepsen_engine_partitions_total")
+            n_dec = reg.value(
+                "jepsen_engine_decomposed_total", route="decomposed"
+            )
+            fanout_count = next(
+                (d.get("count", 0) for d in reg.snapshot()
+                 if d["name"] == "jepsen_engine_partition_fanout"), 0,
+            )
+            dec_dense = (
+                reg.value("jepsen_engine_batch_rows_total", engine="dense")
+                or 0
+            )
+            obs.enable(reset=True)
+            und = wgl.check_batch(model, hists, decomposed=False, **kw)
+            und_dense = (
+                reg.value("jepsen_engine_batch_rows_total", engine="dense")
+                or 0
+            )
+            obs.enable(reset=True)
+            mname = type(model).__name__
+            check(
+                [_normalize(a) for a in dec]
+                == [_normalize(b) for b in und],
+                f"{name}/{mname}: decomposed verdicts diverge from "
+                f"pass-through: "
+                f"{[(a.get('valid?'), b.get('valid?')) for a, b in zip(dec, und) if a.get('valid?') != b.get('valid?')]}",
+            )
+            check(
+                all(
+                    r.get("failed-partition") is not None
+                    for r in dec
+                    if r.get("valid?") is False and "partitions" in r
+                ),
+                f"{name}/{mname}: failing decomposed history missing "
+                "failed-partition",
+            )
+            if mname == "UnorderedQueue":
+                # direct-first spec: the routing gate must keep the
+                # engine pass OFF (the per-value direct checker already
+                # factors internally; splitting only multiplies oracle
+                # tasks) — a partition here is the ~12x regression
+                # coming back
+                check(
+                    not n_parts and not n_dec,
+                    f"{name}/{mname}: direct-first model decomposed "
+                    f"engine-side (partitions={n_parts} "
+                    f"decomposed={n_dec})",
+                )
+            else:
+                check(
+                    (n_parts or 0) >= 2 and (n_dec or 0) >= 1
+                    and fanout_count >= 1,
+                    f"{name}/{mname}: missing decomposition telemetry "
+                    f"(partitions={n_parts} decomposed={n_dec} "
+                    f"fanout-observations={fanout_count})",
+                )
+            if name == "dense" and mname == "MultiRegister":
+                # the envelope win the pass exists for: the 16-key
+                # product state is far outside the dense automaton's
+                # envelope pass-through (frontier/oracle routes), but
+                # the per-key Register sub-histories land ON the dense
+                # kernel — and the oracle must absorb no more
+                # histories than before
+                check(
+                    dec_dense > und_dense,
+                    f"{name}/{mname}: decomposition did not move rows "
+                    f"into the dense envelope ({dec_dense} vs "
+                    f"{und_dense} dense rows)",
+                )
+                dec_oracle = sum(
+                    1 for r in dec
+                    if str(r.get("engine", "")).startswith("oracle")
+                    or r.get("oracle-partitions")
+                )
+                und_oracle = sum(
+                    1 for r in und
+                    if str(r.get("engine", "")).startswith("oracle")
+                )
+                check(
+                    dec_oracle <= und_oracle,
+                    f"{name}/{mname}: decomposition increased oracle-"
+                    f"routed histories ({dec_oracle} vs {und_oracle})",
+                )
+
+    if failures:
+        for f_ in failures:
+            print(f"decompose-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    mesh_note = "8-device mesh" if mesh_forced else "single device"
+    print(
+        "decompose-smoke: ok (dense + frontier + oracle-fallback routes, "
+        f"multi-register/multi-mutex/unordered-queue corpora, {mesh_note}, "
+        "decomposed ≡ pass-through)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

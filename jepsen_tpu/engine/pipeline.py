@@ -24,6 +24,14 @@ accelerators fed (continuous batching / input pipelines):
   and oracle-routed/undispatchable buckets join at flush time — so
   oracle wall time hides behind device wall time on mixed batches
   instead of adding to it.
+- **P-compositional decomposition.**  Partitionable models (the
+  partition protocol on :mod:`jepsen_tpu.models`) split each history
+  into per-partition sub-histories BEFORE planning
+  (:mod:`jepsen_tpu.engine.decompose`): thousands of small
+  sub-histories land in tight same-(E, C) buckets on the dense kernel
+  instead of one oracle-bound monster, escalation/oracle fallback run
+  per sub-history, and verdicts AND at settle — byte-identical to the
+  undecomposed run (``make decompose-smoke`` pins it).
 - **Slice-native dispatch.**  With more than one device attached the
   engine resolves a mesh itself
   (:func:`jepsen_tpu.parallel.mesh.engine_default_mesh`) and every
@@ -105,12 +113,14 @@ def run(
     oracle_budget_s: Optional[float] = None,
     window: Optional[int] = None,
     bucketed: Optional[bool] = None,
+    decomposed: Optional[bool] = None,
 ) -> List[dict]:
     """Check ``histories`` through the full pipeline; per-history result
     dicts in input order, exactly the shapes ``wgl.check_batch``
     documents.  This is ``check_batch``'s engine — call that, not this,
     unless you are the dispatch layer."""
     from ..parallel import mesh as mesh_mod
+    from . import decompose as decompose_mod
 
     # slice-native by default: no explicit mesh resolves to every
     # attached device whenever more than one is present
@@ -118,14 +128,19 @@ def run(
     if mesh is None:
         mesh = mesh_mod.engine_default_mesh()
     n_devices = 1 if mesh is None else int(mesh.devices.size)
-    ctx = RunContext(
+    # -- stage 0: the P-compositionality front-end splits partitionable
+    # histories into per-partition sub-histories BEFORE any planning
+    # (doc/checker-engines.md "Decomposition front-end"); models
+    # without a declared partition — and ``decomposed=False`` /
+    # JEPSEN_TPU_ENGINE_DECOMPOSE=0 runs — degenerate to the exact
+    # historical single-context run.  The split is a serial host pass
+    # over the whole batch, so first dispatch waits on it; streaming
+    # it into the encode/dispatch overlap is ROADMAP item 3's open
+    # follow-up
+    dec = decompose_mod.DecomposedRun(
         model, histories,
         oracle_fallback=oracle_fallback, oracle_budget_s=oracle_budget_s,
-    )
-    planner = Planner(
-        model, spec=ctx.spec, slot_cap=slot_cap, frontier=frontier,
-        max_closure=max_closure, max_dispatch=max_dispatch,
-        bucketed=bucketed, n_devices=n_devices,
+        enabled=decomposed,
     )
     ex = Executor(
         window, mesh=mesh, escalation=escalation,
@@ -133,33 +148,48 @@ def run(
     )
 
     t0 = time.perf_counter()
+    n_buckets = n_flushes = 0
     with obs.span("engine/pipeline", cat="engine") as sp:
-        # -- stage 1+2 interleaved: the planner streams host encode
-        # into shape buckets and yields each planned flush into the
-        # dispatch window while later histories are still encoding;
-        # unencodable histories start stage 3 (the oracle pool)
-        # immediately inside the stream
-        for pb in planner.stream(ctx):
-            ex.submit(pb)
+        # -- stage 1+2 interleaved: each stream's planner streams host
+        # encode into shape buckets and yields each planned flush into
+        # the dispatch window while later histories are still encoding
+        # (the sub-history stream rides the same window, so pass-through
+        # dispatches overlap sub-history encode); unencodable histories
+        # start stage 3 (the oracle pool) immediately inside the stream
+        for ctx in dec.contexts:
+            planner = Planner(
+                ctx.model, spec=ctx.spec, slot_cap=slot_cap,
+                frontier=frontier, max_closure=max_closure,
+                max_dispatch=max_dispatch, bucketed=bucketed,
+                n_devices=n_devices,
+            )
+            for pb in planner.stream(ctx):
+                ex.submit(pb)
+            n_buckets += planner.n_buckets
+            n_flushes += planner.n_flushes
         ex.drain()
         t_device_end = time.perf_counter()
 
         # -- stage 3 drain: collect concurrent oracle verdicts
-        ctx.drain_oracles()
+        dec.drain_oracles()
 
         if sp:
             # buckets = DISTINCT shape buckets (what the gauge reports);
             # flushes can exceed it when a bucket streams mid-input
-            sp.set("buckets", planner.n_buckets)
-            sp.set("flushes", planner.n_flushes)
+            sp.set("buckets", n_buckets)
+            sp.set("flushes", n_flushes)
             sp.set("chunks", ex.submitted)
             sp.set("peak-inflight", ex.peak_depth)
             sp.set("window", ex.window_size)
             sp.set("devices", ex.n_devices)
+            if dec.n_decomposed:
+                sp.set("decomposed", dec.n_decomposed)
+                sp.set("partitions", dec.n_partitions)
 
+    results = dec.results()
     if obs.enabled():
-        if planner.n_buckets:
-            obs.gauge_max("jepsen_engine_bucket_count", planner.n_buckets)
+        if n_buckets:
+            obs.gauge_max("jepsen_engine_bucket_count", n_buckets)
         # occupancy over the DEVICE phase only (encode→dispatch→drain→
         # escalate): including the stage-3 oracle drain would let an
         # oracle-dominated run report near-100% occupancy while the
@@ -170,6 +200,6 @@ def run(
                 "jepsen_engine_occupancy_ratio",
                 max(0.0, 1.0 - ex.bubble_s / elapsed),
             )
-        finish_run_telemetry(ctx.results)
+        finish_run_telemetry(results)
 
-    return ctx.results  # type: ignore[return-value]
+    return results

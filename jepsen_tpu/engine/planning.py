@@ -88,6 +88,7 @@ class RunContext:
         histories: Sequence,
         *,
         spec=_UNSET,
+        models: Optional[Sequence] = None,
         oracle_fallback: bool = True,
         oracle_budget_s: Optional[float] = None,
     ):
@@ -95,12 +96,23 @@ class RunContext:
 
         self.model = model
         self.histories = histories
+        #: per-history model overrides — the decomposition front-end's
+        #: sub-history contexts carry one seeded sub-model per row
+        #: (same spec family as ``model``, different init state); None
+        #: = every history checks against ``model``
+        self.models = models
         self.spec = spec_for(model) if spec is _UNSET else spec
         self.oracle_fallback = oracle_fallback
         self.oracle_budget_s = oracle_budget_s
         self.results: List[Optional[dict]] = [None] * len(histories)
         self.oracle_futs: Dict[int, Tuple[Any, str]] = {}
         self.oracle_deferred: List[Tuple[int, str]] = []
+
+    def model_for(self, idx: int):
+        """The model history ``idx`` checks against (encode init state
+        and oracle fallback both read this, so the two can never
+        disagree about a sub-history's seeded state)."""
+        return self.model if self.models is None else self.models[idx]
 
     def assign(self, idx: int, result: dict) -> None:
         self.results[idx] = result
@@ -129,7 +141,7 @@ class RunContext:
         pure = self.spec.pure_fs if self.spec else ()
         self.oracle_futs[idx] = (
             linear.analysis_async(
-                self.model, self.histories[idx], pure_fs=pure,
+                self.model_for(idx), self.histories[idx], pure_fs=pure,
                 budget_s=self.oracle_budget_s,
             ),
             engine_tag,
@@ -164,7 +176,7 @@ class RunContext:
         pure = self.spec.pure_fs if self.spec else ()
         for idx, engine_tag in self.oracle_deferred:
             r = linear.analysis(
-                self.model, self.histories[idx], pure_fs=pure,
+                self.model_for(idx), self.histories[idx], pure_fs=pure,
                 budget_s=self.oracle_budget_s,
             )
             r["engine"] = engine_tag
@@ -235,13 +247,16 @@ class Planner:
 
     def encode_one(self, ctx: RunContext, idx: int):
         """Encode one history of ``ctx``; ``None`` routes it to the
-        oracle (unencodable — the caller's stage 3 starts NOW)."""
+        oracle (unencodable — the caller's stage 3 starts NOW).  The
+        per-history model (``ctx.model_for``) seeds the init state —
+        decomposed sub-histories share this planner's spec family but
+        each carry their own partition's seeded sub-model."""
         from ..ops import encode as encode_mod
 
         if self.spec is None:
             return None
         return encode_mod.encode_history(
-            ctx.histories[idx], self.model, self.slot_cap, self.spec
+            ctx.histories[idx], ctx.model_for(idx), self.slot_cap, self.spec
         )
 
     def bucket_key(self, e) -> Optional[tuple]:

@@ -1117,6 +1117,7 @@ def check_batch(
     oracle_budget_s: Optional[float] = None,
     window: Optional[int] = None,
     bucketed: Optional[bool] = None,
+    decomposed: Optional[bool] = None,
 ) -> List[dict]:
     """Check a batch of histories on the accelerator; per-history result
     dicts in input order.  Pass a jax.sharding.Mesh to shard the batch
@@ -1150,7 +1151,18 @@ def check_batch(
     run on a worker pool concurrently with device work.  Verdicts are
     independent of ``window`` and ``bucketed`` — those knobs only move
     wall time (``bucketed=False`` restores the historical one-padded-
-    batch encode)."""
+    batch encode).
+
+    Partitionable models (multi-register per key, multi-mutex per lock
+    name, unordered queue per value — the partition protocol on
+    :mod:`jepsen_tpu.models`) additionally decompose each history into
+    per-partition sub-histories ahead of planning
+    (:mod:`jepsen_tpu.engine.decompose`), with sub-verdicts ANDed at
+    settle; ``decomposed`` overrides the
+    ``JEPSEN_TPU_ENGINE_DECOMPOSE`` default (on).  Decomposition is
+    verdict-preserving by the protocol's soundness contract — the
+    failing partition is surfaced as ``failed-partition`` on False
+    results."""
     from ..engine import pipeline as engine_pipeline
     from ..platform import ensure_usable_backend
 
@@ -1173,6 +1185,7 @@ def check_batch(
         oracle_budget_s=oracle_budget_s,
         window=window,
         bucketed=bucketed,
+        decomposed=decomposed,
     )
 
 

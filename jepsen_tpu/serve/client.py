@@ -253,8 +253,9 @@ def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
                 require_opt_in: bool = False, **opts) -> List[dict]:
     """The transparent seam: daemon when reachable, in-process
     otherwise — same verdicts either way (serve-smoke pins it).
-    ``oracle_budget_s`` and ``window`` opts are engine-side only and
-    force the in-process path (the daemon owns its own window; budget
+    ``oracle_budget_s``, ``window``, and an explicit ``decomposed``
+    override are engine-side only and force the in-process path (the
+    daemon owns its own window and decomposition policy; budget
     semantics need the run's serial drain — see protocol.py).  An
     explicit ``mesh`` is serviceable when its shape MATCHES the
     daemon's resident mesh (``/status`` ``n_devices``): the daemon
@@ -276,6 +277,10 @@ def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
         opts.get("oracle_budget_s") is None
         and opts.get("window") is None
         and opts.get("bucketed") is not False
+        # an explicit decomposed= override is engine-side only (the
+        # daemon decomposes per ITS environment): honoring it means
+        # running in-process, not silently dropping the opt on the wire
+        and opts.get("decomposed") is None
         and not (require_opt_in and client is None
                  and service_mode() == "off")
     )

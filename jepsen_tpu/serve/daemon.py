@@ -12,8 +12,10 @@ Architecture (doc/checker-service.md):
 
 - **Request handlers** (one HTTP thread per client, stdlib
   ``ThreadingHTTPServer``) do the *pure planning half*: decode the
-  batch, build a :class:`~jepsen_tpu.engine.planning.RunContext`, and
-  encode histories into raw shape buckets
+  batch, run the P-compositionality front-end
+  (:class:`~jepsen_tpu.engine.decompose.DecomposedRun` — partitionable
+  histories split into per-partition sub-histories right here), and
+  encode each stream into raw shape buckets
   (:meth:`~jepsen_tpu.engine.planning.Planner.encode_buckets`) — all
   parallel-safe host work.  Unencodable histories hit the shared
   oracle pool immediately, before the request even queues.
@@ -54,7 +56,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs
-from ..engine import execution, planning
+from ..engine import decompose, execution, planning
 from . import protocol
 
 #: admission bounds: queued (not yet device-processed) requests and
@@ -74,21 +76,47 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+class _Stream:
+    """One planning stream of a request: a tag (``"main"`` for
+    pass-through histories under the wire model, ``"sub"`` for the
+    decomposition front-end's per-partition sub-histories), the
+    representative (model, spec) that plans it, and its raw encoded
+    buckets.  Same-tag streams from a compatible group merge across
+    runs — decomposed sub-histories coalesce into shared dispatches
+    exactly like whole histories do."""
+
+    __slots__ = ("tag", "model", "spec", "buckets", "order")
+
+    def __init__(self, tag, model, spec, buckets, order):
+        self.tag = tag
+        self.model = model
+        self.spec = spec
+        self.buckets = buckets
+        self.order = order
+
+
 class _Request:
     """One admitted /check batch, in flight between a handler thread
     and the device thread.  Handler-side state is written before the
     queue put; device-side results are read only after ``device_done``
-    (the Event provides the happens-before edge)."""
+    (the Event provides the happens-before edge).  ``run`` is the
+    batch's :class:`~jepsen_tpu.engine.decompose.DecomposedRun` —
+    result routing, oracle hand-off, and the AND-at-settle merge all
+    live there; ``streams`` carry its encoded buckets."""
 
-    __slots__ = ("ctx", "buckets", "order", "group_key", "model",
-                 "plan_opts", "exec_opts", "n", "t_admitted",
+    __slots__ = ("run", "streams", "group_key", "model",
+                 "plan_opts", "exec_opts", "n", "rows", "t_admitted",
                  "device_done", "error", "diag", "abandoned")
 
-    def __init__(self, ctx, buckets, order, group_key, model, plan_opts,
+    def __init__(self, run, streams, group_key, model, plan_opts,
                  exec_opts, n):
-        self.ctx = ctx
-        self.buckets = buckets
-        self.order = order
+        self.run = run
+        self.streams = streams
+        #: client-visible batch size vs rows actually queued for the
+        #: device thread: decomposition multiplies encoded rows by the
+        #: partition fanout, and the row-budget backpressure must see
+        #: the REAL queue footprint, not the parent count
+        self.rows = sum(len(ctx.histories) for _t, ctx in run.streams())
         self.group_key = group_key
         self.model = model
         self.plan_opts = plan_opts
@@ -175,7 +203,9 @@ class CheckerDaemon:
         503.  The authoritative check is :meth:`admit` — this one only
         sheds the obvious overload early, so the race window between
         the two is a single in-flight planning pass, not the whole
-        backlog."""
+        backlog.  ``n_rows`` here is the parent history count (the
+        decomposition fanout is unknowable pre-planning); admit()
+        re-checks against the real post-decomposition row count."""
         with self._wake:
             return not (
                 self._stopping.is_set()
@@ -187,13 +217,18 @@ class CheckerDaemon:
         with self._wake:
             if self._stopping.is_set():
                 return False
+            # the authoritative row budget counts req.rows — the
+            # encoded rows actually queued (decomposition fans a
+            # parent history out into per-partition sub-rows; see
+            # _Request.rows) — while precheck_admit's pre-planning
+            # estimate can only see the parent count
             if (len(self._queue) >= self.max_queue_runs
-                    or self._queued_rows + req.n > self.max_queue_rows):
+                    or self._queued_rows + req.rows > self.max_queue_rows):
                 self.stats["rejected"] += 1
                 obs.count("jepsen_serve_rejected_total")
                 return False
             self._queue.append(req)
-            self._queued_rows += req.n
+            self._queued_rows += req.rows
             self.stats["requests"] += 1
             self.stats["histories"] += req.n
             obs.count("jepsen_serve_requests_total")
@@ -275,7 +310,7 @@ class CheckerDaemon:
                         # the 500'd client re-runs in-process; cancel
                         # its queued oracle searches instead of letting
                         # them burn the shared pool for nobody
-                        req.ctx.abandon_oracles()
+                        req.run.abandon_oracles()
                         req.device_done.set()
                         n_err += 1
                 with self._wake:
@@ -300,8 +335,8 @@ class CheckerDaemon:
             if req.abandoned:
                 # handler gave up (timeout): skip its work and cancel
                 # the oracle searches its planning already submitted —
-                # safe here, the device thread is ctx's only owner now
-                req.ctx.abandon_oracles()
+                # safe here, the device thread is the run's only owner
+                req.run.abandon_oracles()
                 continue
             if req.group_key not in groups:
                 groups[req.group_key] = []
@@ -318,7 +353,7 @@ class CheckerDaemon:
                         # one will drain these futures (a set() after
                         # this check races only a just-expiring wait —
                         # bounded to already-submitted futures)
-                        req.ctx.abandon_oracles()
+                        req.run.abandon_oracles()
                     req.device_done.set()
 
     def _process_group(self, executor, reqs: List[_Request]) -> None:
@@ -338,27 +373,44 @@ class CheckerDaemon:
         executor.escalation = first.exec_opts["escalation"]
         executor.sufficient_rung = first.exec_opts["sufficient_rung"]
         executor.max_dispatch = first.exec_opts["max_dispatch"]
-        planner = planning.Planner(
-            first.model, spec=first.ctx.spec, bucketed=True,
-            **first.plan_opts,
-        )
-        merged, order = planning.merge_buckets(
-            (r.buckets, r.order) for r in reqs
-        )
         pc0 = dict(executor.phase_counts)
-        # plan every merged bucket, then dispatch LARGEST estimated
-        # device cost first: big buckets keep the window occupied
-        # while small ones fill the tail (ROADMAP item 4's scheduling
-        # direction).  The cost fn is the daemon's pluggable seam for
-        # a learned per-shape model (planning.estimated_cost docs);
-        # verdicts are order-independent by the engine contract, so
-        # reordering is purely a throughput decision.
+        # merge per STREAM TAG: a decomposed request carries a "main"
+        # (pass-through, wire-model spec) and a "sub" (per-partition
+        # sub-model spec) stream, and only same-spec buckets may stack
+        # — but within a tag, buckets coalesce across every run in the
+        # group, so concurrent decomposed requests share dispatch rows
+        # exactly like whole histories do.  Then dispatch EVERY planned
+        # bucket largest-estimated-cost first across both streams: big
+        # buckets keep the window occupied while small ones fill the
+        # tail (ROADMAP item 4's scheduling direction).  The cost fn is
+        # the daemon's pluggable seam for a learned per-shape model
+        # (planning.estimated_cost docs); verdicts are
+        # order-independent by the engine contract, so reordering is
+        # purely a throughput decision.
+        tags: List[str] = []
+        for req in reqs:
+            for st in req.streams:
+                if st.tag not in tags:
+                    tags.append(st.tag)
         planned = []
-        for key in order:
-            encs, tokens = merged[key]
-            pb = planner.plan_rows(key, encs, tokens)
-            if pb is not None:
-                planned.append(pb)
+        n_buckets = 0
+        for tag in tags:
+            streams = [st for req in reqs for st in req.streams
+                       if st.tag == tag]
+            rep = streams[0]
+            planner = planning.Planner(
+                rep.model, spec=rep.spec, bucketed=True,
+                **first.plan_opts,
+            )
+            merged, order = planning.merge_buckets(
+                (st.buckets, st.order) for st in streams
+            )
+            n_buckets += len(order)
+            for key in order:
+                encs, tokens = merged[key]
+                pb = planner.plan_rows(key, encs, tokens)
+                if pb is not None:
+                    planned.append(pb)
         planned.sort(key=self.cost_fn, reverse=True)
         for pb in planned:
             executor.submit(pb)
@@ -379,7 +431,8 @@ class CheckerDaemon:
                 "cold_dispatches": cold,
                 "queue_wait_s": round(
                     time.perf_counter() - req.t_admitted, 4),
-                "buckets": len(order),
+                "buckets": n_buckets,
+                "partitions": req.run.n_partitions,
             }
 
     # -- status -------------------------------------------------------------
@@ -526,23 +579,32 @@ class CheckerDaemon:
                 sort_keys=True,
             ),
         )
-        ctx = planning.RunContext(
+        # the decomposition front-end runs handler-side (pure host
+        # work): partitionable histories split into per-partition
+        # sub-histories whose buckets then coalesce across runs like
+        # any others (see _process_group's per-tag merge)
+        run = decompose.DecomposedRun(
             model, histories,
             oracle_fallback=bool(opts.get("oracle_fallback", True)),
         )
-        planner = planning.Planner(
-            model, spec=ctx.spec, bucketed=True, **plan_opts
-        )
+        streams = []
         with obs.span("serve/plan", cat="serve", histories=len(histories)):
-            buckets, order = planner.encode_buckets(ctx)
-        req = _Request(ctx, buckets, order, group_key, model, plan_opts,
+            for tag, sctx in run.streams():
+                planner = planning.Planner(
+                    sctx.model, spec=sctx.spec, bucketed=True, **plan_opts
+                )
+                buckets, order = planner.encode_buckets(sctx)
+                streams.append(
+                    _Stream(tag, sctx.model, sctx.spec, buckets, order)
+                )
+        req = _Request(run, streams, group_key, model, plan_opts,
                        exec_opts, len(histories))
         if not self.admit(req):
             # planning already submitted this run's unencodable rows
             # to the oracle pool; cancel what has not started — the
             # 503'd client re-runs everything in-process anyway
             req.abandoned = True
-            ctx.abandon_oracles()
+            run.abandon_oracles()
             with self._wake:
                 depth = len(self._queue)
             return 503, {
@@ -565,9 +627,9 @@ class CheckerDaemon:
             return 500, {"error": "device thread timed out"}
         if req.error is not None:
             return 500, {"error": req.error}
-        ctx.drain_oracles()
+        run.drain_oracles()
         return 200, {
-            "results": protocol.sanitize_results(ctx.results),
+            "results": protocol.sanitize_results(run.results()),
             "diag": req.diag,
         }
 

@@ -122,6 +122,9 @@ def model_to_wire(model) -> dict:
     if isinstance(model, m.UnorderedQueue):
         return {"type": "unordered-queue",
                 "items": _plain(model.items)}
+    if type(model) is m.MultiMutex:
+        # held set is order-free, like the unordered queue's multiset
+        return {"type": "multi-mutex", "held": _plain(model.held)}
     if type(model) is lock_models.OwnerMutex:
         return {"type": "owner-mutex", "owner": _plain(model.owner)}
     raise UnsupportedModel(
@@ -147,6 +150,8 @@ def model_from_wire(d: dict):
         return m.FIFOQueue(tuple(d.get("items") or ()))
     if t == "unordered-queue":
         return m.UnorderedQueue(frozenset(d.get("items") or ()))
+    if t == "multi-mutex":
+        return m.MultiMutex(frozenset(d.get("held") or ()))
     if t == "owner-mutex":
         return lock_models.OwnerMutex(d.get("owner"))
     raise UnsupportedModel(f"unknown wire model type {t!r}")

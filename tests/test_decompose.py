@@ -1,0 +1,550 @@
+"""P-compositionality decomposition tests (jepsen_tpu/engine/decompose.py
++ the models partition protocol).
+
+The contract under test: decomposed verdicts are byte-identical to the
+undecomposed path on every partition-declaring model — the partition
+protocol's soundness means the pass may only ever change WHERE a
+history is checked (tight per-partition sub-rows vs one big search),
+never WHAT the verdict is.  A failing decomposed history must name its
+failing partition, deterministically (first partition order, never
+settle order).
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu import obs
+from jepsen_tpu.checker import linear
+from jepsen_tpu.engine import decompose
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.synth import generate_mr_history
+
+
+def h(*ops) -> History:
+    return History(list(ops)).index_ops()
+
+
+def gen_multi_mutex_history(rng, n_locks=3, n_ops=24, corrupt=False):
+    """Lock soup over named locks; valid by construction unless
+    corrupt (a double-acquire on one lock)."""
+    names = [chr(ord("a") + i) for i in range(n_locks)]
+    ops = []
+    held = set()
+    p = 0
+    for _ in range(n_ops):
+        name = rng.choice(names)
+        p = (p + 1) % 5
+        if name in held:
+            ops.append(invoke_op(p, "release", name))
+            ops.append(ok_op(p, "release", name))
+            held.discard(name)
+        else:
+            ops.append(invoke_op(p, "acquire", name))
+            ops.append(ok_op(p, "acquire", name))
+            held.add(name)
+    if corrupt:
+        name = rng.choice(names)
+        if name in held:
+            ops.append(invoke_op(7, "acquire", name))
+            ops.append(ok_op(7, "acquire", name))
+        else:
+            ops.append(invoke_op(7, "release", name))
+            ops.append(ok_op(7, "release", name))
+    return History(ops).index_ops()
+
+
+def gen_mr_multimop_history(rng, n_keys=3, n_ops=10, corrupt=False):
+    """Atomic same-key read-then-write txns (two mops per op) — the
+    shape a plain Register op cannot express but the single-key
+    sub-model can."""
+    state = {k: 0 for k in range(n_keys)}
+    ops = []
+    for _ in range(n_ops):
+        k = rng.randrange(n_keys)
+        v = rng.randrange(1, 4)
+        ops.append(invoke_op(0, "txn", [("r", k, None), ("w", k, v)]))
+        ops.append(ok_op(0, "txn", [("r", k, state[k]), ("w", k, v)]))
+        state[k] = v
+    if corrupt and ops:
+        i = rng.randrange(len(ops) // 2) * 2 + 1
+        op = ops[i]
+        (_r, k, _obs), w = op.value
+        ops[i] = op.copy(value=[("r", k, 7), w])
+    return History(ops).index_ops()
+
+
+def gen_queue_history(rng, n_values=6, n_ops=20, corrupt=False):
+    ops = []
+    in_q = []
+    for _ in range(n_ops):
+        if in_q and rng.random() < 0.45:
+            v = in_q.pop(rng.randrange(len(in_q)))
+            ops.append(invoke_op(0, "dequeue", None))
+            ops.append(ok_op(0, "dequeue", v))
+        else:
+            v = rng.randrange(n_values)
+            in_q.append(v)
+            ops.append(invoke_op(0, "enqueue", v))
+            ops.append(ok_op(0, "enqueue", v))
+    if corrupt:
+        ops.append(invoke_op(1, "dequeue", None))
+        ops.append(ok_op(1, "dequeue", 99))  # never enqueued
+    return History(ops).index_ops()
+
+
+# ---------------------------------------------------------------------------
+# the partition protocol on the models
+# ---------------------------------------------------------------------------
+
+
+def test_base_models_declare_no_partition():
+    for model in (m.register(0), m.cas_register(0), m.mutex(),
+                  m.fifo_queue(), m.NoOp()):
+        assert decompose.partitioner(model) is None
+
+
+def test_multi_register_protocol():
+    model = m.multi_register({0: 7, 1: 0})
+    w = invoke_op(0, "txn", [("w", 0, 5)])
+    r = invoke_op(0, "txn", [("r", 1, 3)])
+    rw_same = invoke_op(0, "txn", [("r", 0, None), ("w", 0, 2)])
+    cross = invoke_op(0, "txn", [("w", 0, 1), ("w", 1, 2)])
+    assert model.partition_key(w) == 0
+    assert model.partition_key(r) == 1
+    # an atomic multi-mop txn still decomposes when every mop touches
+    # the SAME key — only cross-key txns disable decomposition
+    assert model.partition_key(rw_same) == 0
+    assert model.partition_key(cross) is None
+    assert model.partition_key(invoke_op(0, "txn", None)) is None
+    assert model.partition_key(invoke_op(0, "txn", [])) is None
+    # sub-model: the single-key register slice, seeded from this
+    # key's state (K=1 multi-register IS the register automaton)
+    assert model.subhistory_model(0) == m.multi_register({0: 7})
+    assert model.subhistory_model(9) == m.multi_register({9: None})
+    # ops pass through unchanged (a Register op could not express an
+    # atomic read-then-write)
+    assert model.partition_op(w, 0) is w
+
+
+def test_multi_mutex_model_and_protocol():
+    mm = m.multi_mutex()
+    s = mm.step(invoke_op(0, "acquire", "a"))
+    assert not s.is_inconsistent
+    assert s.step(invoke_op(1, "acquire", "a")).is_inconsistent
+    assert not s.step(invoke_op(1, "acquire", "b")).is_inconsistent
+    assert s.step(invoke_op(0, "release", "a")) == m.multi_mutex()
+    assert mm.step(invoke_op(0, "release", "a")).is_inconsistent
+    assert mm.step(invoke_op(0, "acquire", None)).is_inconsistent
+    assert mm.partition_key(invoke_op(0, "acquire", "a")) == "a"
+    assert mm.partition_key(invoke_op(0, "frob", "a")) is None
+    assert s.subhistory_model("a") == m.Mutex(True)
+    assert s.subhistory_model("b") == m.Mutex(False)
+
+
+def test_unordered_queue_protocol():
+    q = m.UnorderedQueue(frozenset({(3, 2), (5, 1)}))
+    assert q.partition_key(invoke_op(0, "enqueue", 3)) == 3
+    assert q.partition_key(invoke_op(0, "dequeue", None)) is None
+    assert q.partition_key(invoke_op(0, "peek", 3)) is None
+    assert q.subhistory_model(3) == m.UnorderedQueue(frozenset({(3, 2)}))
+    assert q.subhistory_model(8) == m.unordered_queue()
+
+
+# ---------------------------------------------------------------------------
+# split_history
+# ---------------------------------------------------------------------------
+
+
+def test_split_history_pairs_and_orders():
+    model = m.multi_register({0: 0, 1: 0})
+    hist = h(
+        invoke_op(0, "txn", [("w", 0, 1)]),
+        invoke_op(1, "txn", [("w", 1, 2)]),
+        ok_op(1, "txn", [("w", 1, 2)]),
+        ok_op(0, "txn", [("w", 0, 1)]),
+        invoke_op(0, "txn", [("r", 0, None)]),
+        ok_op(0, "txn", [("r", 0, 1)]),
+    )
+    parts = decompose.split_history(model, hist)
+    assert [k for k, _sub, _h in parts] == [0, 1]  # first-seen order
+    by_key = {k: sh for k, _sub, sh in parts}
+    assert [op.type for op in by_key[0]] == ["invoke", "ok", "invoke", "ok"]
+    assert [op.value[0][0] for op in by_key[0]] == ["w", "w", "r", "r"]
+    assert all(op.value[0][1] == 0 for op in by_key[0])
+    assert len(by_key[1]) == 2
+
+
+def test_split_history_key_resolves_from_completion():
+    """A dequeue's partition lives on the ok event, not the invoke."""
+    q = m.unordered_queue()
+    hist = h(
+        invoke_op(0, "enqueue", 4), ok_op(0, "enqueue", 4),
+        invoke_op(0, "dequeue", None), ok_op(0, "dequeue", 4),
+    )
+    parts = decompose.split_history(q, hist)
+    assert parts is not None and len(parts) == 1
+    # single partition: the engine passes it through, but the split
+    # itself must have routed the dequeue to value 4's partition
+    assert len(parts[0][2]) == 4
+
+
+def test_split_history_undecomposable_and_dropped_events():
+    model = m.multi_register({0: 0, 1: 0})
+    cross = h(
+        invoke_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        ok_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+    )
+    assert decompose.split_history(model, cross) is None
+    # failed pairs drop; nemesis (non-int process) events are skipped
+    from jepsen_tpu.history import Op
+
+    hist = h(
+        invoke_op(0, "txn", [("w", 0, 1)]),
+        ok_op(0, "txn", [("w", 0, 1)]),
+        invoke_op(1, "txn", [("w", 1, 9)]),
+        Op("fail", 1, "txn", [("w", 1, 9)]),
+        Op("invoke", "nemesis", "kill", None),
+        invoke_op(2, "txn", [("r", 1, None)]),
+        ok_op(2, "txn", [("r", 1, 0)]),
+    )
+    parts = decompose.split_history(model, hist)
+    keys = [k for k, _s, _h in parts]
+    assert keys == [0, 1]
+    by_key = {k: sh for k, _s, sh in parts}
+    # the failed write to key 1 vanished entirely
+    assert [op.type for op in by_key[1]] == ["invoke", "ok"]
+
+
+def test_submodel_cache_bounded_with_eviction_counter():
+    obs.enable(reset=True)
+    model = m.multi_register({k: 0 for k in range(8)})
+    cache = decompose.SubmodelCache(model, cap=4)
+    for k in range(8):
+        cache.get(k)
+    assert cache.evictions == 4
+    assert obs.registry().value(
+        "jepsen_engine_decompose_cache_evictions_total") == 4
+    # evicted entries rebuild correctly
+    assert cache.get(0) == m.multi_register({0: 0})
+    obs.enable(reset=True)
+
+
+def test_oracle_partitions_multi_mop_single_key_txns():
+    """Regression (review finding): atomic same-key multi-mop txns must
+    keep decomposing in the CPU oracle — the pre-protocol
+    _partition_by_key handled them, and the protocol must too."""
+    model = m.multi_register({0: 0, 1: 0})
+    hist = h(
+        invoke_op(0, "txn", [("r", 0, None), ("w", 0, 2)]),
+        ok_op(0, "txn", [("r", 0, 0), ("w", 0, 2)]),
+        invoke_op(1, "txn", [("w", 1, 5)]),
+        ok_op(1, "txn", [("w", 1, 5)]),
+    )
+    parts = linear._partition_by_key(model, *linear.prepare(hist))
+    assert parts is not None and len(parts) == 2
+    assert linear.analysis(model, hist)["valid?"] is True
+    # engine path decomposes it too
+    out = wgl.check_batch(model, [hist])[0]
+    assert out["valid?"] is True and out["partitions"] == 2
+
+
+# ---------------------------------------------------------------------------
+# AND-at-settle merge
+# ---------------------------------------------------------------------------
+
+
+def test_merge_partition_results_first_false_wins():
+    parts = [
+        ("a", {"valid?": True, "engine": "tpu", "kernel": "dense"}),
+        ("b", {"valid?": False, "engine": "tpu", "kernel": "dense",
+               "failed-event": 3}),
+        ("c", {"valid?": False, "engine": "oracle-fallback"}),
+        ("d", {"valid?": "unknown", "engine": "oracle-overflow"}),
+    ]
+    out = decompose.merge_partition_results(parts)
+    assert out["valid?"] is False
+    assert out["failed-partition"] == "b"  # first False in partition order
+    assert out["failed-event"] == 3
+    assert out["partitions"] == 4
+
+
+def test_merge_partition_results_unknown_and_true():
+    unk = decompose.merge_partition_results([
+        ("a", {"valid?": True, "engine": "tpu"}),
+        ("b", {"valid?": "unknown", "engine": "oracle-overflow"}),
+    ])
+    assert unk["valid?"] == "unknown" and unk["failed-partition"] == "b"
+    ok_uniform = decompose.merge_partition_results([
+        ("a", {"valid?": True, "engine": "tpu", "kernel": "dense"}),
+        ("b", {"valid?": True, "engine": "tpu", "kernel": "dense"}),
+    ])
+    assert ok_uniform == {"valid?": True, "engine": "tpu",
+                          "partitions": 2, "kernel": "dense"}
+    mixed = decompose.merge_partition_results([
+        ("a", {"valid?": True, "engine": "tpu", "kernel": "dense"}),
+        ("b", {"valid?": True, "engine": "oracle-routed",
+               "algorithm": "direct-mutex"}),
+    ])
+    assert mixed["engine"] == "mixed" and "kernel" not in mixed
+
+
+def test_failing_partition_named_end_to_end():
+    """The regression the ISSUE pins: a single failing partition yields
+    valid? = False with the partition named — through the full engine
+    path, at both window sizes."""
+    model = m.multi_register({k: 0 for k in range(6)})
+    good_mops = [("w", k, 1) for k in range(6)]
+    ops = []
+    for k, mop in enumerate(good_mops):
+        ops.append(invoke_op(0, "txn", [mop]))
+        ops.append(ok_op(0, "txn", [mop]))
+    ops.append(invoke_op(1, "txn", [("r", 4, 9)]))  # 9 never written to 4
+    ops.append(ok_op(1, "txn", [("r", 4, 9)]))
+    hist = History(ops).index_ops()
+    for window in (1, 4):
+        out = wgl.check_batch(model, [hist], window=window)[0]
+        assert out["valid?"] is False
+        assert out["failed-partition"] == 4
+        assert out["partitions"] == 6
+        assert wgl.check_batch(
+            model, [hist], window=window, decomposed=False
+        )[0]["valid?"] is False
+
+
+# ---------------------------------------------------------------------------
+# verdict identity: decomposed ≡ undecomposed (oracle-level property)
+# ---------------------------------------------------------------------------
+
+
+def _undecomposed_verdict(model, hist):
+    """The pass-through baseline: the fast search on the WHOLE history
+    (deliberately bypassing _partition_by_key)."""
+    events, ops = linear.prepare(hist)
+    return linear._search_fast(
+        model, events, ops, linear.DEFAULT_MAX_CONFIGS, None, None
+    )["valid?"]
+
+
+def _decomposed_verdict(model, hist):
+    parts = decompose.split_history(model, hist)
+    if parts is None:
+        return _undecomposed_verdict(model, hist)
+    sub = [
+        (k, linear.analysis(submodel, sh)) for k, submodel, sh in parts
+    ]
+    return decompose.merge_partition_results(sub)["valid?"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_verdict_identity_oracle_level(seed):
+    """≥ 1k op-soup cases across the three partitionable models: the
+    protocol-decomposed verdict must equal the whole-history search's,
+    case by case."""
+    rng = random.Random(1000 + seed)
+    cases = []
+    mr_model = m.multi_register({k: 0 for k in range(4)})
+    for i in range(100):
+        cases.append((mr_model, generate_mr_history(
+            rng, n_procs=4, n_ops=14, n_keys=4, n_values=3,
+            crash_p=0.1, corrupt=(i % 3 == 0),
+        )))
+    for i in range(40):
+        cases.append((mr_model, gen_mr_multimop_history(
+            rng, n_keys=3, n_ops=8, corrupt=(i % 3 == 0),
+        )))
+    mm_model = m.multi_mutex()
+    for i in range(80):
+        cases.append((mm_model, gen_multi_mutex_history(
+            rng, n_locks=3, n_ops=16, corrupt=(i % 3 == 0),
+        )))
+    uq_model = m.unordered_queue()
+    for i in range(80):
+        cases.append((uq_model, gen_queue_history(
+            rng, n_values=5, n_ops=16, corrupt=(i % 3 == 0),
+        )))
+    n_decomposed = 0
+    for model, hist in cases:
+        dec = _decomposed_verdict(model, hist)
+        und = _undecomposed_verdict(model, hist)
+        assert dec == und, (type(model).__name__, dec, und, list(hist))
+        if decompose.split_history(model, hist) is not None:
+            n_decomposed += 1
+    assert n_decomposed > len(cases) // 2  # the fuzz actually decomposes
+
+
+# ---------------------------------------------------------------------------
+# verdict identity through the full engine (device path)
+# ---------------------------------------------------------------------------
+
+
+def engine_corpus(seed=45100):
+    rng = random.Random(seed)
+    mr_model = m.multi_register({k: 0 for k in range(6)})
+    mr = [
+        generate_mr_history(
+            rng, n_procs=4, n_ops=24, n_keys=6, n_values=3,
+            crash_p=0.05, corrupt=(i % 3 == 0),
+        )
+        for i in range(12)
+    ]
+    # cross-key txn: pass-through lane inside a decomposed batch
+    mr.append(h(
+        invoke_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+        ok_op(0, "txn", [("w", 0, 1), ("w", 1, 2)]),
+    ))
+    # slot-cap buster: oracle-fallback lane
+    mr.append(History(
+        [invoke_op(p, "txn", [("w", p % 6, 1)]) for p in range(40)]
+    ).index_ops())
+    mm = [
+        gen_multi_mutex_history(rng, n_locks=4, n_ops=20,
+                                corrupt=(i % 3 == 0))
+        for i in range(6)
+    ]
+    uq = [
+        gen_queue_history(rng, n_values=6, n_ops=18, corrupt=(i % 3 == 0))
+        for i in range(6)
+    ]
+    return [(mr_model, mr), (m.multi_mutex(), mm),
+            (m.unordered_queue(), uq)]
+
+
+def test_engine_decomposed_verdicts_match_passthrough():
+    for model, hists in engine_corpus():
+        obs.enable(reset=True)
+        dec = wgl.check_batch(model, hists, slot_cap=32)
+        reg = obs.registry()
+        parts_total = reg.value("jepsen_engine_partitions_total")
+        routed_dec = reg.value(
+            "jepsen_engine_decomposed_total", route="decomposed")
+        obs.enable(reset=True)
+        und = wgl.check_batch(
+            model, hists, slot_cap=32, decomposed=False)
+        obs.enable(reset=True)
+        assert [r["valid?"] for r in dec] == [r["valid?"] for r in und], (
+            type(model).__name__
+        )
+        if isinstance(model, m.UnorderedQueue):
+            # direct-first spec: the engine routing gate keeps the
+            # pass OFF (the per-value direct checker already factors
+            # internally; splitting would multiply oracle tasks by
+            # the fanout — measured ~12x slower)
+            assert not parts_total and not routed_dec
+        else:
+            assert (parts_total or 0) >= 2, type(model).__name__
+            assert (routed_dec or 0) >= 1, type(model).__name__
+        assert True in [r["valid?"] for r in dec]
+        assert False in [r["valid?"] for r in dec]
+
+
+def test_direct_first_models_skip_engine_decomposition():
+    """The routing gate itself: a model whose spec is in
+    wgl.DIRECT_FIRST_SPECS never decomposes engine-side even though it
+    declares the partition protocol (the oracle's direct checker
+    already factors per partition internally), while protocol models
+    off that list do."""
+    assert not decompose.routing_gain_possible(m.unordered_queue())
+    assert decompose.routing_gain_possible(m.multi_register({0: 0}))
+    assert decompose.routing_gain_possible(m.multi_mutex())
+    run = decompose.DecomposedRun(
+        m.unordered_queue(),
+        [h(invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+           invoke_op(0, "enqueue", 2), ok_op(0, "enqueue", 2))],
+    )
+    assert run.n_decomposed == 0 and run.sub_ctx is None
+
+
+def test_merge_carries_oracle_partition_count():
+    """A mixed-route decomposed history must not hide its oracle load:
+    merge_partition_results counts oracle-routed sub-verdicts so
+    routing accounting (bench --decompose, decompose-smoke) sees
+    engine='mixed' rows."""
+    merged = decompose.merge_partition_results([
+        ("a", {"valid?": True, "engine": "tpu", "kernel": "dense"}),
+        ("b", {"valid?": True, "engine": "oracle"}),
+    ])
+    assert merged["engine"] == "mixed"
+    assert merged["oracle-partitions"] == 1
+    merged_f = decompose.merge_partition_results([
+        ("a", {"valid?": False, "engine": "oracle-budget"}),
+        ("b", {"valid?": True, "engine": "tpu"}),
+    ])
+    assert merged_f["failed-partition"] == "a"
+    assert merged_f["oracle-partitions"] == 1
+    clean = decompose.merge_partition_results([
+        ("a", {"valid?": True, "engine": "tpu", "kernel": "dense"}),
+    ])
+    assert "oracle-partitions" not in clean
+
+
+def test_engine_decomposition_disabled_by_env(monkeypatch):
+    model = m.multi_register({0: 0, 1: 0})
+    hist = h(
+        invoke_op(0, "txn", [("w", 0, 5)]), ok_op(0, "txn", [("w", 0, 5)]),
+        invoke_op(1, "txn", [("r", 1, 0)]), ok_op(1, "txn", [("r", 1, 0)]),
+    )
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_DECOMPOSE", "0")
+    out = wgl.check_batch(model, [hist])[0]
+    assert "partitions" not in out
+    monkeypatch.delenv("JEPSEN_TPU_ENGINE_DECOMPOSE")
+    out2 = wgl.check_batch(model, [hist])[0]
+    assert out2["partitions"] == 2
+    assert out["valid?"] is out2["valid?"] is True
+
+
+def test_decomposed_wide_keyspace_moves_off_the_oracle():
+    """The routing claim: a keyspace whose product automaton is
+    unencodable (CPU-oracle-bound) checks on the dense kernel once
+    decomposed."""
+    rng = random.Random(9)
+    model = m.multi_register({k: 0 for k in range(12)})
+    hists = [
+        generate_mr_history(rng, n_procs=4, n_ops=30, n_keys=12,
+                            n_values=3, crash_p=0.0)
+        for _ in range(4)
+    ]
+    und = wgl.check_batch(model, hists, decomposed=False)
+    assert all(r["engine"] == "oracle-fallback" for r in und)
+    dec = wgl.check_batch(model, hists)
+    assert all(r["engine"] == "tpu" and r["kernel"] == "dense"
+               for r in dec)
+    assert [r["valid?"] for r in dec] == [r["valid?"] for r in und]
+
+
+# ---------------------------------------------------------------------------
+# the service path
+# ---------------------------------------------------------------------------
+
+
+def test_service_parity_and_wire_form_for_decomposed_models():
+    from jepsen_tpu.serve import CheckerDaemon, ServiceClient, protocol
+
+    mm = m.multi_mutex()
+    wire = protocol.model_from_wire(
+        protocol.decode_body(protocol.encode_body(
+            protocol.model_to_wire(m.MultiMutex(frozenset({"a"})))))
+    )
+    assert wire == m.MultiMutex(frozenset({"a"}))
+
+    rng = random.Random(3)
+    hists = [
+        gen_multi_mutex_history(rng, n_locks=3, n_ops=16,
+                                corrupt=(i % 2 == 0))
+        for i in range(4)
+    ]
+    expected = wgl.check_batch(mm, hists, slot_cap=32)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        got = ServiceClient(port=daemon.port).check_batch(
+            mm, hists, slot_cap=32)
+        assert [(r.get("valid?"), r.get("partitions"),
+                 r.get("failed-partition")) for r in got] == [
+            (r.get("valid?"), r.get("partitions"),
+             r.get("failed-partition")) for r in expected
+        ]
+    finally:
+        daemon.stop()
