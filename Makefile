@@ -2,15 +2,15 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
-# observability, pipeline, checker-service, slice-dispatch, and
-# decomposition smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke
+# observability, pipeline, checker-service, slice-dispatch,
+# decomposition, and auto-tune smoke checks
+check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -61,6 +61,15 @@ mesh-smoke:
 decompose-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.engine.decompose_smoke
 	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m jepsen_tpu.engine.decompose_smoke
+
+# auto-tuned dispatch gate (doc/tuning.md): a tiny bounded sweep on
+# the CPU fallback, then: artifact round-trips byte-identically,
+# corrupt/version-mismatched artifacts fall back to pinned defaults,
+# no proposal exceeds the per-chip safe_dispatch budget, and tuned
+# dispatch is verdict-byte-identical to untuned across the dense,
+# frontier, escalation, decomposed, and service routes
+tune-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.tune.smoke
 
 bench:
 	python bench.py

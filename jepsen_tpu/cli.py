@@ -468,6 +468,55 @@ def serve_cmd() -> Dict[str, dict]:
     }
 
 
+def tune_cmd() -> Dict[str, dict]:
+    """``tune``: the offline autotune pass (doc/tuning.md) — measure
+    the attached device, persist a calibration artifact, and the
+    engine's window / flush-rows / row-bucket / dense-union constants
+    become measured per-chip picks on every later run that loads it."""
+
+    def add_opts(p):
+        p.add_argument(
+            "--out",
+            default=None,
+            help="artifact path (default calibration.json in the "
+            "working directory — the path the engine auto-loads; "
+            "JEPSEN_TPU_CALIBRATION overrides)",
+        )
+        p.add_argument(
+            "--profile",
+            default="default",
+            help="sweep profile: 'default' (the ~2-minute full sweep) "
+            "or 'smoke' (the tiny CI gate)",
+        )
+        p.add_argument(
+            "--budget-s",
+            type=float,
+            default=None,
+            help="wall-clock budget for the sweep; a truncated sweep "
+            "still persists every config it measured",
+        )
+
+    def run(args) -> int:
+        from .tune import __main__ as tune_main
+
+        argv = []
+        if args.out:
+            argv += ["--out", args.out]
+        argv += ["--profile", args.profile]
+        if args.budget_s is not None:
+            argv += ["--budget-s", str(args.budget_s)]
+        return tune_main.main(argv)
+
+    return {
+        "tune": {
+            "help": "measure the attached device and persist a "
+            "calibration artifact (auto-tuned dispatch; doc/tuning.md)",
+            "add_opts": add_opts,
+            "run": run,
+        }
+    }
+
+
 def test_all_cmd(
     tests_fn: Callable[[dict], List[Callable[[], dict]]],
     opt_fn: Optional[Callable[[argparse.ArgumentParser], None]] = None,
@@ -691,6 +740,7 @@ def default_commands() -> Dict[str, dict]:
     cmds.update(single_test_cmd(make_test, add_workload_opt))
     cmds.update(test_all_cmd(make_tests, add_workload_opt))
     cmds.update(serve_cmd())
+    cmds.update(tune_cmd())
     return cmds
 
 

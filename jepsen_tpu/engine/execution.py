@@ -38,8 +38,9 @@ from .. import obs
 
 def row_bucket_target(n: int) -> int:
     """Row count → its stable dispatch shape: the next power of two,
-    floored at :data:`ROW_BUCKET`."""
-    target = ROW_BUCKET
+    floored at :func:`row_bucket_floor` (the calibration-aware floor;
+    :data:`ROW_BUCKET` untuned)."""
+    target = row_bucket_floor()
     while target < n:
         target *= 2
     return target
@@ -52,8 +53,9 @@ def _pow2_at_least(x: int) -> int:
 def shard_row_target(n: int, n_shards: int) -> int:
     """Row count → its stable dispatch shape on an ``n_shards``-device
     mesh: the PER-SHARD row count rounds to its power-of-two bucket,
-    floored so the GLOBAL shape never drops below :data:`ROW_BUCKET`
-    (the same floor the single-device path uses — a tiny batch pays
+    floored so the GLOBAL shape never drops below
+    :func:`row_bucket_floor` (the same floor the single-device path
+    uses — a tiny batch pays
     the same ~64 neutral rows it always did, spread across the slice,
     not 64 per chip).  Keying the bucket on per-shard rows is what
     keeps jit executables stable as traffic varies: under
@@ -63,7 +65,7 @@ def shard_row_target(n: int, n_shards: int) -> int:
     exactly, and the result is always divisible by ``n_shards``."""
     if n_shards <= 1:
         return row_bucket_target(n)
-    per_floor = _pow2_at_least(max(1, -(-ROW_BUCKET // n_shards)))
+    per_floor = _pow2_at_least(max(1, -(-row_bucket_floor() // n_shards)))
     per = max(per_floor, _pow2_at_least(max(1, -(-n // n_shards))))
     return n_shards * per
 
@@ -84,16 +86,33 @@ DEFAULT_WINDOW = 4
 ROW_BUCKET = 64
 
 
+def row_bucket_floor() -> int:
+    """The resolved minimum dispatch row bucket:
+    ``JEPSEN_TPU_ENGINE_ROW_BUCKET`` > active calibration
+    (doc/tuning.md) > :data:`ROW_BUCKET`.  Always a power of two — a
+    non-pow2 override rounds up so the geometric bucket ladder stays
+    intact."""
+    from ..tune import artifact as _cal
+
+    return _cal.resolve_knob(
+        "JEPSEN_TPU_ENGINE_ROW_BUCKET",
+        lambda v: _pow2_at_least(max(1, int(v))),
+        lambda cal: cal.row_bucket(),
+        ROW_BUCKET,
+    )
+
+
 def default_window() -> int:
-    """Resolved in-flight window: ``JEPSEN_TPU_ENGINE_WINDOW`` if set,
-    else :data:`DEFAULT_WINDOW`."""
-    try:
-        return max(
-            1, int(os.environ.get("JEPSEN_TPU_ENGINE_WINDOW",
-                                  DEFAULT_WINDOW))
-        )
-    except ValueError:
-        return DEFAULT_WINDOW
+    """Resolved in-flight window: ``JEPSEN_TPU_ENGINE_WINDOW`` >
+    active calibration (doc/tuning.md) > :data:`DEFAULT_WINDOW`."""
+    from ..tune import artifact as _cal
+
+    return _cal.resolve_knob(
+        "JEPSEN_TPU_ENGINE_WINDOW",
+        lambda v: max(1, int(v)),
+        lambda cal: max(1, cal.window()),
+        DEFAULT_WINDOW,
+    )
 
 
 def _materialize(out):

@@ -129,18 +129,18 @@ def run(
         mesh = mesh_mod.engine_default_mesh()
     n_devices = 1 if mesh is None else int(mesh.devices.size)
     # -- stage 0: the P-compositionality front-end splits partitionable
-    # histories into per-partition sub-histories BEFORE any planning
+    # histories into per-partition sub-histories ahead of planning
     # (doc/checker-engines.md "Decomposition front-end"); models
     # without a declared partition — and ``decomposed=False`` /
     # JEPSEN_TPU_ENGINE_DECOMPOSE=0 runs — degenerate to the exact
-    # historical single-context run.  The split is a serial host pass
-    # over the whole batch, so first dispatch waits on it; streaming
-    # it into the encode/dispatch overlap is ROADMAP item 3's open
-    # follow-up
+    # historical single-context run.  lazy=True: the split STREAMS
+    # through dec.feed() below, interleaved with encode and device
+    # dispatch, instead of running as a serial host preamble over the
+    # whole batch (the ROADMAP item 3 follow-up, closed)
     dec = decompose_mod.DecomposedRun(
         model, histories,
         oracle_fallback=oracle_fallback, oracle_budget_s=oracle_budget_s,
-        enabled=decomposed,
+        enabled=decomposed, lazy=True,
     )
     ex = Executor(
         window, mesh=mesh, escalation=escalation,
@@ -150,20 +150,32 @@ def run(
     t0 = time.perf_counter()
     n_buckets = n_flushes = 0
     with obs.span("engine/pipeline", cat="engine") as sp:
-        # -- stage 1+2 interleaved: each stream's planner streams host
-        # encode into shape buckets and yields each planned flush into
-        # the dispatch window while later histories are still encoding
-        # (the sub-history stream rides the same window, so pass-through
-        # dispatches overlap sub-history encode); unencodable histories
-        # start stage 3 (the oracle pool) immediately inside the stream
-        for ctx in dec.contexts:
-            planner = Planner(
-                ctx.model, spec=ctx.spec, slot_cap=slot_cap,
-                frontier=frontier, max_closure=max_closure,
-                max_dispatch=max_dispatch, bucketed=bucketed,
-                n_devices=n_devices,
-            )
-            for pb in planner.stream(ctx):
+        # -- stage 0+1+2 interleaved: the decomposition front-end's
+        # stage-0 split now STREAMS (dec.feed yields each pass-through
+        # history / sub-history row the moment it is classified), and
+        # each row feeds its stream's planner immediately — so past
+        # flush_rows() the split of later histories overlaps the
+        # device work of earlier flushes instead of running as a
+        # serial host preamble over the whole batch.  Unencodable
+        # histories start stage 3 (the oracle pool) inside the feed.
+        # End-of-input buckets dispatch largest-estimated-cost first
+        # (BucketStream.finish — the per-run half of the daemon's
+        # largest-cost-first scheduling).
+        planners = {}  # id(ctx) -> (planner, BucketStream)
+        for ctx, idx in dec.feed():
+            st = planners.get(id(ctx))
+            if st is None:
+                planner = Planner(
+                    ctx.model, spec=ctx.spec, slot_cap=slot_cap,
+                    frontier=frontier, max_closure=max_closure,
+                    max_dispatch=max_dispatch, bucketed=bucketed,
+                    n_devices=n_devices,
+                )
+                st = planners[id(ctx)] = (planner, planner.open_stream())
+            for pb in st[1].feed(ctx, idx):
+                ex.submit(pb)
+        for planner, stream in planners.values():
+            for pb in stream.finish():
                 ex.submit(pb)
             n_buckets += planner.n_buckets
             n_flushes += planner.n_flushes

@@ -58,13 +58,17 @@ def default_bucketed() -> bool:
 
 
 def flush_rows_default() -> int:
-    try:
-        return max(
-            1, int(os.environ.get("JEPSEN_TPU_ENGINE_FLUSH_ROWS",
-                                  DEFAULT_FLUSH_ROWS))
-        )
-    except ValueError:
-        return DEFAULT_FLUSH_ROWS
+    """Resolved streaming flush threshold:
+    ``JEPSEN_TPU_ENGINE_FLUSH_ROWS`` > active calibration
+    (doc/tuning.md) > :data:`DEFAULT_FLUSH_ROWS`."""
+    from ..tune import artifact as _cal
+
+    return _cal.resolve_knob(
+        "JEPSEN_TPU_ENGINE_FLUSH_ROWS",
+        lambda v: max(1, int(v)),
+        lambda cal: max(1, cal.flush_rows()),
+        DEFAULT_FLUSH_ROWS,
+    )
 
 
 class RunContext:
@@ -113,6 +117,23 @@ class RunContext:
         and oracle fallback both read this, so the two can never
         disagree about a sub-history's seeded state)."""
         return self.model if self.models is None else self.models[idx]
+
+    def append(self, history, model=None) -> int:
+        """Grow the context by one history (its result slot rides
+        along); returns the new index.  This is the streaming-
+        decomposition seam: the front-end's stage-0 split feeds
+        sub-histories in one at a time, interleaved with encode, so
+        the context must accept rows incrementally.  Only valid while
+        planning this context is still in progress (the same
+        phase-ordering contract as the rest of the class)."""
+        idx = len(self.histories)
+        self.histories.append(history)
+        self.results.append(None)
+        if self.models is not None:
+            self.models.append(model if model is not None else self.model)
+        elif model is not None and model is not self.model:
+            self.models = [self.model] * idx + [model]
+        return idx
 
     def assign(self, idx: int, result: dict) -> None:
         self.results[idx] = result
@@ -332,49 +353,110 @@ class Planner:
 
     # -- the streaming composition (in-process pipeline) ------------------
 
+    def open_stream(self) -> "BucketStream":
+        """An incremental feed/finish face over this planner — the
+        seam that lets a producer interleave OTHER host work (the
+        decomposition front-end's stage-0 split) between histories
+        instead of handing :meth:`stream` a fully-materialized list."""
+        return BucketStream(self)
+
     def stream(self, ctx: RunContext):
         """Generator: encode ``ctx``'s histories one at a time and
         yield a :class:`PlannedBucket` whenever a bucket fills
         (mid-stream, so the consumer's device work overlaps the
         remaining encode) or at end-of-input.  Unencodable histories
         route to the oracle pool immediately, before any yield."""
-        buckets: Dict[Any, Tuple[list, list]] = {}
-        order: List[Any] = []  # first-seen bucket order (deterministic)
+        s = self.open_stream()
         for idx in range(len(ctx.histories)):
-            key = self._accumulate(ctx, idx, buckets, order)
-            if key is _ROUTED_ORACLE:
-                continue  # the oracle search is already running
-            # a full bucket flushes into the dispatch window while
-            # later histories are still encoding
-            acc = buckets[key]
-            if self.bucketed and len(acc[0]) >= self.flush_rows:
-                pb = self.plan_rows(key, *acc)
-                buckets[key] = ([], [])
-                if pb is not None:
-                    yield pb
-        for key in order:
-            pb = self.plan_rows(key, *buckets[key])
+            yield from s.feed(ctx, idx)
+        yield from s.finish()
+
+
+class BucketStream:
+    """One in-progress streaming pass over a :class:`Planner`:
+    :meth:`feed` accumulates (and mid-stream-flushes) one history at a
+    time, :meth:`finish` plans the residual buckets and yields them
+    **largest estimated cost first** — big buckets keep the dispatch
+    window occupied while small ones fill the tail (the per-run half
+    of the daemon's largest-cost-first scheduling; verdicts are
+    order-independent by the engine contract, so the reorder is purely
+    a throughput decision, and ties keep first-seen order so the
+    sequence stays deterministic)."""
+
+    __slots__ = ("planner", "buckets", "order", "finished")
+
+    def __init__(self, planner: Planner):
+        self.planner = planner
+        self.buckets: Dict[Any, Tuple[list, list]] = {}
+        self.order: List[Any] = []  # first-seen bucket order
+        self.finished = False
+
+    def feed(self, ctx: RunContext, idx: int):
+        """Encode history ``idx`` of ``ctx``; yields a
+        :class:`PlannedBucket` when its bucket fills mid-stream (so
+        the consumer's device work overlaps the remaining encode).
+        Unencodable histories route to the oracle pool immediately,
+        before any yield."""
+        if self.finished:
+            raise RuntimeError("BucketStream already finished")
+        p = self.planner
+        key = p._accumulate(ctx, idx, self.buckets, self.order)
+        if key is _ROUTED_ORACLE:
+            return  # the oracle search is already running
+        # a full bucket flushes into the dispatch window while later
+        # histories are still encoding
+        acc = self.buckets[key]
+        if p.bucketed and len(acc[0]) >= p.flush_rows:
+            pb = p.plan_rows(key, *acc)
+            self.buckets[key] = ([], [])
             if pb is not None:
                 yield pb
-        self.n_buckets += len(order)
+
+    def finish(self):
+        """Plan every residual bucket, then yield biggest-cost-first."""
+        if self.finished:
+            raise RuntimeError("BucketStream already finished")
+        p = self.planner
+        planned = []
+        for key in self.order:
+            pb = p.plan_rows(key, *self.buckets[key])
+            if pb is not None:
+                planned.append(pb)
+        p.n_buckets += len(self.order)
+        self.finished = True
+        # stable sort: equal-cost buckets keep first-seen order
+        planned.sort(key=estimated_cost, reverse=True)
+        yield from planned
 
 
 def estimated_cost(pb: PlannedBucket) -> float:
-    """Per-bucket device-cost estimate — the scheduling hook the
-    checker service orders coalesced work by (largest first → better
-    window occupancy), and the seam where a learned per-shape TPU cost
-    model ("A Learned Performance Model for TPUs", arXiv:2008.01040)
-    plugs in later: replace this analytic proxy with the model's
-    predicted kernel wall time per (E, C, F, rows).
+    """Per-bucket device-cost estimate — the scheduling hook both
+    compositions order dispatch by (largest first → better window
+    occupancy): the checker service's cross-run coalescer and the
+    per-run :meth:`BucketStream.finish` ordering.
 
-    The proxy is the dominant footprint term of each kernel family:
-    frontier work scales with rows × F·(C+1)·ceil(E/32) state words;
-    dense with rows × E (a fixed-width scan); oracle-routed buckets
-    cost the device nothing."""
+    With a calibration artifact active (doc/tuning.md; the measured
+    per-shape table ``jepsen_tpu tune`` produces — the
+    arXiv:2008.01040 direction, as a direct lookup rather than a
+    trained predictor) this returns the interpolated **measured
+    seconds** for the bucket's (kernel, E, C, F, rows).  Untuned — or
+    for a kernel the table never measured — it falls back to the
+    analytic proxy: the dominant footprint term of each kernel family
+    (frontier work scales with rows × F·(C+1)·ceil(E/32) state words;
+    dense with rows × E, a fixed-width scan).  Oracle-routed buckets
+    cost the device nothing either way.  Both forms only RANK buckets;
+    absolute scale never changes a verdict."""
     plan = pb.plan
     rows = len(pb.rows)
     if plan.fn is None or plan.disp == 0:
         return 0.0
+    from ..tune import artifact as _cal
+
+    cal = _cal.active()
+    if cal is not None:
+        c = cal.cost(plan.kernel, plan.E, plan.C, plan.frontier, rows)
+        if c is not None:
+            return c
     if plan.kernel == "dense":
         return float(rows * plan.E)
     words = max(1, -(-plan.E // 32))

@@ -40,6 +40,9 @@ Directive syntax (one trailing comment, same line or the line above):
 - ``# jt: thread-entry`` — this function runs on a foreign thread.
 - ``# jt: traced`` — this function is traced by jit/vmap/pmap through
   an indirection the call-graph builder can't see (e.g. a spec table).
+- ``# jt: timing`` — this function is a declared measurement loop
+  (the autotuner's dispatch-and-sync harness): ``trace-sync`` findings
+  inside it are sanctioned as a unit, nested defs included.
 """
 
 from __future__ import annotations

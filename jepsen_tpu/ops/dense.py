@@ -256,9 +256,19 @@ DEFAULT_UNION = "unroll"
 
 
 def _union_mode() -> str:
-    import os
+    """Resolved subset-union lowering: ``JEPSEN_TPU_DENSE_UNION`` >
+    active calibration (doc/tuning.md — ``jepsen_tpu tune``
+    re-measures the unroll/gather gap per chip) >
+    :data:`DEFAULT_UNION`.  The mode is part of the kernel cache key,
+    so flipping it can never serve a stale lowering."""
+    from ..tune import artifact as _cal
 
-    return os.environ.get("JEPSEN_TPU_DENSE_UNION", DEFAULT_UNION)
+    return _cal.resolve_knob(
+        "JEPSEN_TPU_DENSE_UNION",
+        lambda v: v.strip() or None,
+        lambda cal: cal.union_mode(),
+        DEFAULT_UNION,
+    )
 
 
 def _subset_has(C: int):

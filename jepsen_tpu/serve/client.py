@@ -342,7 +342,8 @@ def format_status(st: dict) -> str:
         f" {st.get('errors', 0)} errors)",
         f"  queue: {st.get('queue_depth', 0)}/{st.get('max_queue_runs')}"
         f" · coalesced: {st.get('coalesced', 0)}"
-        f" · window: {st.get('window')}",
+        f" · window: {st.get('window')}"
+        f" · calibration: {st.get('calibration') or 'defaults'}",
     ]
     ratio = st.get("warm_hit_ratio")
     warm = (f"{ratio:.0%}" if isinstance(ratio, (int, float)) else "n/a")

@@ -149,8 +149,11 @@ class CheckerDaemon:
         cost_fn=None,
     ):
         #: per-bucket device-cost estimator driving largest-first
-        #: dispatch of coalesced work; swap in a learned model here
-        #: (see planning.estimated_cost)
+        #: dispatch of coalesced work.  The default is the
+        #: calibration-aware planning.estimated_cost: with a tuned
+        #: artifact active (doc/tuning.md) it serves the MEASURED
+        #: per-(kernel, E, C, F, rows) cost table, else the analytic
+        #: proxy — pass cost_fn= to override either
         self.cost_fn = cost_fn or planning.estimated_cost
         self.host = host
         self.port = port
@@ -438,11 +441,21 @@ class CheckerDaemon:
     # -- status -------------------------------------------------------------
 
     def status(self) -> dict:
+        from .. import tune
+
         with self._wake:
             stats = dict(self.stats)
             depth = len(self._queue)
         total = stats["warm_dispatches"] + stats["cold_dispatches"]
+        cal = tune.active()
         return {
+            # the resident calibration (doc/tuning.md): the artifact id
+            # steering this daemon's window / union-mode / cost-ordered
+            # dispatch, or None when running on pinned defaults —
+            # CheckerDaemon(cost_fn=...) defaults to the calibration-
+            # aware planning.estimated_cost, so the tuned cost table
+            # drives largest-cost-first scheduling resident-side
+            "calibration": cal.calibration_id if cal is not None else None,
             "ok": self._fatal is None,
             "error": self._fatal,
             "pid": os.getpid(),

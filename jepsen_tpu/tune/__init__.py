@@ -1,0 +1,39 @@
+"""Auto-tuned dispatch (ROADMAP item 4): an offline ``jepsen_tpu
+tune`` pass measures the attached device and persists a calibration
+artifact; the engine's hand-pinned constants become calibration-aware
+lookups with the pinned values as the untuned fallback.
+
+Two halves:
+
+- :mod:`jepsen_tpu.tune.artifact` — the versioned ``calibration.json``
+  schema (keyed by device kind + device count + code fingerprint),
+  load/validate/fallback, and the process-wide :func:`active`
+  calibration every engine lookup consults.
+- :mod:`jepsen_tpu.tune.calibrate` — the sweep itself: coordinate
+  descent over (union-mode, window, flush-rows, row-bucket) plus the
+  measured per-(kernel, E, C, F) cost table, guarded so no proposal
+  ever exceeds the crash-calibrated per-chip ``fn.safe_dispatch``
+  budget.
+
+See doc/tuning.md.
+"""
+
+from .artifact import (  # noqa: F401
+    Calibration,
+    DEFAULT_PATH,
+    SCHEMA_VERSION,
+    active,
+    build_artifact,
+    code_fingerprint,
+    load_calibration,
+    reset_active,
+    resolved_path,
+    save,
+    set_active,
+    validate,
+)
+from .calibrate import (  # noqa: F401
+    PROFILES,
+    proposal_within_budget,
+    run_tune,
+)

@@ -1,0 +1,60 @@
+"""``python -m jepsen_tpu.tune`` — the offline autotune pass (same
+entry as ``jepsen_tpu tune``; see doc/tuning.md)."""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.tune",
+        description="Measure the attached device and persist a "
+        "calibration artifact the engine loads at startup "
+        "(doc/tuning.md).",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="artifact path (default calibration.json in the working "
+        "directory — the path the engine auto-loads)",
+    )
+    ap.add_argument(
+        "--profile", choices=sorted(__profiles()), default="default",
+        help="sweep profile: candidate sets + corpus sizes "
+        "(default 'default'; 'smoke' is the tiny CI gate)",
+    )
+    ap.add_argument(
+        "--budget-s", type=float, default=None,
+        help="wall-clock budget for the sweep (a truncated sweep still "
+        "persists every config it measured)",
+    )
+    args = ap.parse_args(argv)
+    from . import artifact, calibrate
+
+    out = args.out or artifact.DEFAULT_PATH
+    path, data = calibrate.run_tune(
+        out_path=out, profile=args.profile, budget_s=args.budget_s,
+    )
+    sweep = data.get("sweep", {})
+    print(json.dumps({
+        "calibration": data["calibration_id"],
+        "path": path,
+        "device_kind": data["device_kind"],
+        "n_devices": data["n_devices"],
+        "params": data["params"],
+        "cost_table_entries": len(data.get("cost_table", ())),
+        "measured_configs": sweep.get("measured_configs"),
+        "wall_s": sweep.get("wall_s"),
+        "truncated": sweep.get("truncated"),
+    }))
+    return 0
+
+
+def __profiles():
+    from .calibrate import PROFILES
+
+    return PROFILES
+
+
+if __name__ == "__main__":
+    sys.exit(main())

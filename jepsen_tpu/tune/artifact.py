@@ -1,0 +1,420 @@
+"""The calibration artifact: schema, persistence, and the process-wide
+active-calibration singleton the engine's lookups consult.
+
+A calibration is the durable output of one ``jepsen_tpu tune`` sweep
+(:mod:`jepsen_tpu.tune.calibrate`): the measured-best engine knobs
+(window, flush rows, row-bucket floor, dense union lowering) plus a
+per-(kernel, E, C, F) cost table, keyed by **device kind + device
+count + code fingerprint** so an artifact tuned on one chip (or one
+engine revision) can never silently steer another.  The engine loads
+it lazily at first lookup (:func:`active`) and falls back to the
+pinned defaults — with a warning and a
+``jepsen_engine_calibration_fallback_total`` count — whenever the file
+is missing, corrupt, version-mismatched, or stale.  Verdicts never
+depend on any of this: every calibrated knob only moves wall time
+(``make tune-smoke`` pins byte-equality tuned vs untuned).
+
+Resolution of the artifact path:
+
+- ``JEPSEN_TPU_CALIBRATION=0`` (or ``off``) — calibration disabled.
+- ``JEPSEN_TPU_CALIBRATION=<path>`` — that file.
+- unset — ``calibration.json`` in the working directory (the
+  ``jepsen_tpu tune`` default output), loaded only when it exists.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("jepsen_tpu.tune")
+
+#: artifact schema version — loads refuse any other value (schema
+#: stability is pinned by the tests' round-trip check)
+SCHEMA_VERSION = 1
+
+#: default artifact filename (cwd-relative, like the store dir)
+DEFAULT_PATH = "calibration.json"
+
+#: the engine files whose constants a calibration replaces — the code
+#: fingerprint hashes exactly these, so editing any of them stales
+#: every existing artifact (the knobs' meaning may have moved)
+_FINGERPRINT_FILES = (
+    "engine/execution.py",
+    "engine/planning.py",
+    "ops/dense.py",
+    "ops/wgl.py",
+)
+
+#: params every artifact carries; used by the round-trip/schema tests
+PARAM_KEYS = ("window", "flush_rows", "row_bucket", "union_mode")
+
+_VALID_UNIONS = ("unroll", "gather")
+
+
+def code_fingerprint() -> str:
+    """SHA-1 over the engine sources whose hand-pinned constants the
+    calibration replaces — a tuned artifact is only trusted against
+    the exact code it was measured on."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha1()
+    for rel in _FINGERPRINT_FILES:
+        p = os.path.join(root, rel.replace("/", os.sep))
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"?")
+        h.update(b"\x1f")
+    return h.hexdigest()
+
+
+def device_key() -> Tuple[str, int]:
+    """(device kind, local device count) of the attached backend —
+    the hardware half of the artifact key.  Initializes the backend;
+    callers only reach this when a calibration file actually exists
+    (the common no-artifact case never pays it)."""
+    import jax
+
+    devs = jax.local_devices()
+    kind = getattr(devs[0], "device_kind", None) or devs[0].platform
+    return str(kind), len(devs)
+
+
+class Calibration:
+    """One validated calibration artifact.
+
+    Constructed from the raw artifact dict (already schema-checked by
+    :func:`load_calibration`); exposes the engine-facing lookups —
+    :meth:`window`, :meth:`flush_rows`, :meth:`row_bucket`,
+    :meth:`union_mode`, and the interpolating :meth:`cost` table."""
+
+    def __init__(self, data: Dict[str, Any]):
+        self.data = data
+        self.calibration_id: str = data["calibration_id"]
+        self.device_kind: str = data["device_kind"]
+        self.n_devices: int = int(data["n_devices"])
+        self.code_fingerprint: str = data["code_fingerprint"]
+        p = data["params"]
+        self.params: Dict[str, Any] = {k: p[k] for k in PARAM_KEYS}
+        #: (kernel, E, C, F) -> sorted [(rows, seconds), ...]
+        self._table: Dict[Tuple[str, int, int, int],
+                          List[Tuple[int, float]]] = {}
+        for e in data.get("cost_table", ()):
+            k = (str(e["kernel"]), int(e["E"]), int(e["C"]), int(e["F"]))
+            self._table.setdefault(k, []).append(
+                (int(e["rows"]), float(e["seconds"]))
+            )
+        for pts in self._table.values():
+            pts.sort()
+
+    # -- engine-facing lookups --------------------------------------------
+
+    def window(self) -> int:
+        return int(self.params["window"])
+
+    def flush_rows(self) -> int:
+        return int(self.params["flush_rows"])
+
+    def row_bucket(self) -> int:
+        return int(self.params["row_bucket"])
+
+    def union_mode(self) -> str:
+        return str(self.params["union_mode"])
+
+    def has_cost_table(self) -> bool:
+        return bool(self._table)
+
+    def cost(self, kernel: str, E: int, C: int, F: int,
+             rows: int) -> Optional[float]:
+        """Predicted device seconds for one ``rows``-row dispatch of
+        ``kernel`` at shape (E, C, F) — the measured replacement for
+        ``planning.estimated_cost``'s analytic proxy.  Exact shapes
+        interpolate (piecewise-linearly in rows, through the origin
+        below the first sample); unmeasured shapes scale the nearest
+        measured shape (log-space distance) by the analytic footprint
+        ratio — including ACROSS kernels when the table never measured
+        this kernel at all, so every bucket a sort compares is in the
+        same unit (seconds): a half-covered table must degrade to a
+        cruder estimate, never to proxy-vs-seconds apples-and-oranges
+        ordering.  Returns None only when the table is empty."""
+        key = (kernel, int(E), int(C), int(F))
+        pts = self._table.get(key)
+        if pts is not None:
+            return _interp_rows(pts, rows)
+        pts, ref_key = self._nearest(kernel, E, C, F)
+        if pts is None:  # no same-kernel entry: nearest ANY kernel
+            pts, ref_key = self._nearest(None, E, C, F)
+            if pts is None:
+                return None
+        scale = _proxy(kernel, E, C, F) / max(
+            _proxy(ref_key[0], *ref_key[1:]), 1e-12
+        )
+        return scale * _interp_rows(pts, rows)
+
+    def _nearest(self, kernel: Optional[str], E: int, C: int, F: int):
+        """Closest measured shape by log-space distance; ``kernel=None``
+        searches every kernel's entries."""
+        best = None
+        best_d = None
+        for key in self._table:
+            if kernel is not None and key[0] != kernel:
+                continue
+            d = sum(
+                (math.log2(max(a, 1)) - math.log2(max(b, 1))) ** 2
+                for a, b in zip(key[1:], (E, C, F))
+            )
+            if best_d is None or d < best_d:
+                best, best_d = key, d
+        if best is None:
+            return None, None
+        return self._table[best], best
+
+    # -- matching ----------------------------------------------------------
+
+    def stale_reason(self) -> Optional[str]:
+        """None when this artifact matches the attached device and the
+        current engine code; else a short human reason."""
+        if self.code_fingerprint != code_fingerprint():
+            return "code-fingerprint mismatch (engine sources changed)"
+        kind, n = device_key()
+        if self.device_kind != kind or self.n_devices != n:
+            return (
+                f"device mismatch (tuned on {self.device_kind}"
+                f"×{self.n_devices}, attached {kind}×{n})"
+            )
+        return None
+
+
+def _proxy(kernel: str, E: int, C: int, F: int) -> float:
+    """The analytic per-row footprint proxy (same form as
+    ``planning.estimated_cost``'s fallback), used only to scale a
+    measured neighbor onto an unmeasured shape."""
+    if kernel == "dense":
+        return float(max(E, 1))
+    words = max(1, -(-max(E, 1) // 32))
+    return float(max(F, 1) * (max(C, 0) + 1) * words)
+
+
+def _interp_rows(pts: List[Tuple[int, float]], rows: int) -> float:
+    """Piecewise-linear seconds(rows) through measured points; linear
+    through the origin below the first sample, last-segment slope
+    extrapolation above the last."""
+    if rows <= 0:
+        return 0.0
+    if len(pts) == 1 or rows <= pts[0][0]:
+        r0, s0 = pts[0]
+        return s0 * rows / max(r0, 1)
+    for (r0, s0), (r1, s1) in zip(pts, pts[1:]):
+        if rows <= r1:
+            t = (rows - r0) / max(r1 - r0, 1)
+            return s0 + t * (s1 - s0)
+    (r0, s0), (r1, s1) = pts[-2], pts[-1]
+    slope = (s1 - s0) / max(r1 - r0, 1)
+    return max(0.0, s1 + slope * (rows - r1))
+
+
+# -- schema validation / persistence ----------------------------------------
+
+
+def validate(data: Any) -> Dict[str, Any]:
+    """Structural check of a raw artifact dict; raises ValueError with
+    a reason on any problem (the load path turns that into a warned
+    fallback, never a crash)."""
+    if not isinstance(data, dict):
+        raise ValueError("artifact is not a JSON object")
+    if data.get("version") != SCHEMA_VERSION:
+        raise ValueError(
+            f"schema version {data.get('version')!r} != {SCHEMA_VERSION}"
+        )
+    for k in ("calibration_id", "device_kind", "n_devices",
+              "code_fingerprint", "params"):
+        if k not in data:
+            raise ValueError(f"missing field {k!r}")
+    p = data["params"]
+    if not isinstance(p, dict):
+        raise ValueError("params is not an object")
+    for k in PARAM_KEYS:
+        if k not in p:
+            raise ValueError(f"missing param {k!r}")
+    if int(p["window"]) < 1:
+        raise ValueError("window must be >= 1")
+    if int(p["flush_rows"]) < 1:
+        raise ValueError("flush_rows must be >= 1")
+    rb = int(p["row_bucket"])
+    if rb < 1 or rb & (rb - 1):
+        raise ValueError("row_bucket must be a power of two")
+    if p["union_mode"] not in _VALID_UNIONS:
+        raise ValueError(f"unknown union_mode {p['union_mode']!r}")
+    for e in data.get("cost_table", ()):
+        for k in ("kernel", "E", "C", "F", "rows", "seconds"):
+            if k not in e:
+                raise ValueError(f"cost_table entry missing {k!r}")
+        if float(e["seconds"]) < 0:
+            raise ValueError("negative cost_table seconds")
+    return data
+
+
+def build_artifact(params: Dict[str, Any], cost_table: List[dict],
+                   device_kind: str, n_devices: int,
+                   created_at: str, sweep: Optional[dict] = None) -> dict:
+    """Assemble a schema-valid artifact dict (the tuner's output)."""
+    fp = code_fingerprint()
+    data = {
+        "version": SCHEMA_VERSION,
+        "calibration_id": (
+            f"{device_kind.replace(' ', '-').lower()}x{n_devices}"
+            f"-{fp[:10]}"
+        ),
+        "created_at": created_at,
+        "device_kind": device_kind,
+        "n_devices": int(n_devices),
+        "code_fingerprint": fp,
+        "params": {k: params[k] for k in PARAM_KEYS},
+        "cost_table": list(cost_table),
+    }
+    if sweep is not None:
+        data["sweep"] = sweep
+    return validate(data)
+
+
+def save(data: dict, path: str) -> str:
+    validate(data)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def resolved_path() -> Optional[str]:
+    """The artifact path per the environment policy, or None when
+    calibration is disabled / no default file exists."""
+    v = os.environ.get("JEPSEN_TPU_CALIBRATION")
+    if v is not None:
+        v = v.strip()
+        if v.lower() in ("", "0", "false", "off", "no"):
+            return None
+        return v
+    return DEFAULT_PATH if os.path.exists(DEFAULT_PATH) else None
+
+
+def load_calibration(path: str,
+                     check_stale: bool = True) -> Optional[Calibration]:
+    """Load + validate one artifact file; None (with a logged warning
+    and a ``jepsen_engine_calibration_fallback_total`` count) on ANY
+    problem — a bad artifact must degrade to the pinned defaults, never
+    crash or skew a checker run."""
+    from .. import obs
+
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning(
+            "calibration %s unreadable (%s); using pinned engine "
+            "defaults", path, e,
+        )
+        obs.count("jepsen_engine_calibration_fallback_total",
+                  reason="unreadable")
+        return None
+    try:
+        cal = Calibration(validate(data))
+    except (ValueError, KeyError, TypeError) as e:
+        log.warning(
+            "calibration %s invalid (%s); using pinned engine defaults",
+            path, e,
+        )
+        obs.count("jepsen_engine_calibration_fallback_total",
+                  reason="invalid")
+        return None
+    if check_stale:
+        try:
+            reason = cal.stale_reason()
+        except Exception as e:  # noqa: BLE001 — a backend probe failure
+            # must not take the engine down just to vet a calibration
+            reason = f"device probe failed ({e!r})"
+        if reason is not None:
+            log.warning(
+                "calibration %s stale: %s; using pinned engine defaults",
+                path, reason,
+            )
+            obs.count("jepsen_engine_calibration_fallback_total",
+                      reason="stale")
+            return None
+    return cal
+
+
+# -- the process-wide active calibration -------------------------------------
+
+_lock = threading.Lock()
+_UNRESOLVED = object()
+_active: Any = _UNRESOLVED
+
+
+def active() -> Optional[Calibration]:
+    """The process's active calibration, resolved lazily ONCE from the
+    environment policy (:func:`resolved_path`); None when disabled,
+    absent, or rejected.  This is what every engine lookup consults —
+    the no-artifact fast path is a single ``os.path.exists``."""
+    global _active
+    got = _active
+    if got is not _UNRESOLVED:
+        return got
+    with _lock:
+        if _active is _UNRESOLVED:
+            path = resolved_path()
+            cal = load_calibration(path) if path else None
+            if cal is not None:
+                from .. import obs
+
+                log.info("calibration %s active (from %s)",
+                         cal.calibration_id, path)
+                obs.gauge_set("jepsen_engine_calibration_loaded", 1)
+            _active = cal
+        return _active
+
+
+def resolve_knob(env_var: str, parse, cal_get, default):
+    """The ONE env > calibration > pinned-default ladder every
+    calibrated engine knob resolves through (window, flush rows,
+    row-bucket floor, dense union mode).  ``parse`` maps the raw env
+    string to a usable value or ``None``; an unparseable/empty env
+    value is noise, not a choice — it falls through to the
+    calibration tier, exactly like an unset variable, instead of
+    silently masking a tuned artifact.  ``cal_get`` reads the knob
+    off an active :class:`Calibration`."""
+    v = os.environ.get(env_var)
+    if v is not None:
+        try:
+            parsed = parse(v)
+        except (ValueError, TypeError):
+            parsed = None
+        if parsed is not None:
+            return parsed
+    cal = active()
+    if cal is not None:
+        return cal_get(cal)
+    return default
+
+
+def set_active(cal: Optional[Calibration]) -> None:
+    """Pin the active calibration (tests; the ``tune`` CLI after a
+    fresh write).  ``None`` means "resolved: no calibration"."""
+    global _active
+    with _lock:
+        _active = cal
+
+
+def reset_active() -> None:
+    """Forget the resolution so the next :func:`active` re-reads the
+    environment (tests, and the CLI between runs)."""
+    global _active
+    with _lock:
+        _active = _UNRESOLVED

@@ -1,0 +1,185 @@
+"""Auto-tune smoke check: ``python -m jepsen_tpu.tune.smoke``.
+
+The ``make tune-smoke`` gate (wired into ``make check``): a tiny
+bounded sweep on the CPU fallback, then the four contracts the
+calibration layer must never break —
+
+1. **Artifact round-trip**: the sweep's ``calibration.json`` loads,
+   validates, and re-saves byte-identically (schema stability).
+2. **Budget guardrail**: the sweep recorded per-chip budget evidence
+   with zero breaches, and :func:`~jepsen_tpu.tune.calibrate.
+   proposal_within_budget` rejects an over-cap proposal outright.
+3. **Fallback**: a corrupt artifact and a version-mismatched artifact
+   both load as None (pinned defaults) — no crash.
+4. **Verdict byte-equality tuned vs untuned** across the dense,
+   frontier, escalation, decomposed, and service routes: a calibration
+   may move wall time only, never a result dict.
+
+Exit codes: 0 ok, 1 any contract broken.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def _corpora():
+    import random
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu.synth import generate_history, generate_mr_history
+
+    rng = random.Random(45100)
+    cas = [
+        generate_history(rng, n_procs=3, n_ops=14, crash_p=0.02,
+                         corrupt=(i % 3 == 0))
+        for i in range(8)
+    ]
+    mr = [
+        generate_mr_history(rng, n_procs=4, n_ops=30, n_keys=6,
+                            n_values=4, crash_p=0.02, corrupt=(i % 3 == 0))
+        for i in range(6)
+    ]
+    return m.cas_register(0), cas, m.multi_register(
+        {k: 0 for k in range(6)}), mr
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu import tune
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.serve import client as serve_client
+    from jepsen_tpu.serve import daemon as serve_daemon
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    # pin "no calibration" for the sweep itself: a stray artifact in
+    # the invoking cwd must not steer the gate's measurements
+    tune.set_active(None)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "calibration.json")
+        # 1. the bounded sweep (the artifact is NOT activated yet: the
+        # verdict-equality checks below must control activation)
+        path, data = tune.run_tune(out_path=path, profile="smoke",
+                                   activate=False)
+        sweep = data.get("sweep", {})
+        check(os.path.exists(path), "sweep wrote no artifact")
+        check(sweep.get("budget_breaches") == 0
+              and sweep.get("budget_checks", 0) > 0,
+              f"missing budget evidence: {sweep}")
+        check(len(data.get("cost_table", ())) > 0, "empty cost table")
+
+        # round-trip: load → validate → re-save → identical JSON
+        cal = tune.load_calibration(path)
+        check(cal is not None, "fresh artifact failed to load")
+        path2 = os.path.join(td, "resaved.json")
+        tune.save(data, path2)
+        with open(path) as f1, open(path2) as f2:
+            check(f1.read() == f2.read(),
+                  "artifact did not round-trip byte-identically")
+        reloaded = json.load(open(path2))
+        check(tune.validate(reloaded) is reloaded,
+              "re-saved artifact failed validation")
+
+        # 2. the guardrail rejects over-budget proposals outright
+        from jepsen_tpu.engine import planning
+
+        model, cas, mr_model, mr = _corpora()
+        ctx = planning.RunContext(model, cas, oracle_fallback=False)
+        planner = planning.Planner(model, spec=ctx.spec, slot_cap=32,
+                                   frontier=64, max_closure=9)
+        buckets, order = planner.encode_buckets(ctx)
+        pb = planner.plan_rows(order[0], *buckets[order[0]])
+        check(pb is not None and pb.plan.disp > 0, "no frontier plan")
+        if pb is not None and pb.plan.disp > 0:
+            over = pb.plan.disp * 4 + 1
+            check(not tune.proposal_within_budget(pb.plan, over, window=4),
+                  "guardrail admitted an over-budget frontier proposal")
+            check(tune.proposal_within_budget(pb.plan, 1, window=1),
+                  "guardrail rejected a trivially-safe proposal")
+
+        # 3. corrupt / version-mismatch artifacts fall back to None
+        corrupt = os.path.join(td, "corrupt.json")
+        with open(corrupt, "w") as f:
+            f.write("{not json")
+        check(tune.load_calibration(corrupt) is None,
+              "corrupt artifact did not fall back")
+        vbad = dict(data)
+        vbad["version"] = 999
+        vpath = os.path.join(td, "vbad.json")
+        with open(vpath, "w") as f:
+            json.dump(vbad, f)
+        check(tune.load_calibration(vpath) is None,
+              "version-mismatched artifact did not fall back")
+
+        # 4. verdict byte-equality tuned vs untuned, per route
+        def run_routes(label):
+            out = {
+                # dense automaton route
+                "dense": wgl.check_batch(model, cas, slot_cap=32),
+                # generic frontier kernel (explicit closure cap)
+                "frontier": wgl.check_batch(model, cas, slot_cap=32,
+                                            max_closure=9),
+                # escalation ladder: a starved base frontier overflows
+                # and must rerun at the escalated capacity
+                "escalation": wgl.check_batch(model, cas, slot_cap=32,
+                                              frontier=2, max_closure=9),
+                # decomposition front-end (multi-register per key)
+                "decomposed": wgl.check_batch(mr_model, mr, slot_cap=32),
+            }
+            return out
+
+        tune.set_active(None)  # pinned defaults
+        untuned = run_routes("untuned")
+        tune.set_active(cal)
+        try:
+            tuned = run_routes("tuned")
+            for route in untuned:
+                check(
+                    tuned[route] == untuned[route],
+                    f"{route}: tuned results differ from untuned",
+                )
+
+            # service route: an in-process daemon with the calibration
+            # active must answer byte-identically to the untuned
+            # in-process engine and advertise the calibration id
+            d = serve_daemon.CheckerDaemon("127.0.0.1", 0)
+            d.start(block=False)
+            try:
+                cl = serve_client.ServiceClient(port=d.port)
+                res_service = cl.check_batch(model, cas, slot_cap=32)
+                check(res_service == untuned["dense"],
+                      "service: tuned daemon results differ from untuned "
+                      "in-process")
+                st = cl.status()
+                check(st.get("calibration") == cal.calibration_id,
+                      f"/status calibration {st.get('calibration')!r} != "
+                      f"{cal.calibration_id!r}")
+            finally:
+                d.stop()
+        finally:
+            tune.reset_active()
+
+    if failures:
+        for f_ in failures:
+            print(f"tune-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "tune-smoke: ok (bounded sweep "
+        f"{sweep.get('wall_s')}s, {sweep.get('measured_configs')} configs, "
+        f"{len(data.get('cost_table', ()))} cost points, "
+        "round-trip + budget guardrail + fallback + tuned≡untuned on "
+        "dense/frontier/escalation/decomposed/service routes)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

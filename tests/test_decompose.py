@@ -548,3 +548,58 @@ def test_service_parity_and_wire_form_for_decomposed_models():
         ]
     finally:
         daemon.stop()
+
+
+def test_lazy_feed_is_incremental_and_matches_eager():
+    """The streaming split (pipeline stage 0): a lazy DecomposedRun
+    classifies histories one at a time — after the first feed step
+    only the first history's partitions exist — and a fully-driven
+    lazy run ends in exactly the eager run's state."""
+    rng = random.Random(11)
+    hists = [
+        generate_mr_history(rng, n_procs=3, n_ops=12, n_keys=3,
+                            n_values=4, crash_p=0.0, corrupt=(i == 1))
+        for i in range(4)
+    ]
+    model = m.multi_register({k: 0 for k in range(3)})
+    eager = decompose.DecomposedRun(model, hists)
+
+    lazy = decompose.DecomposedRun(model, hists, lazy=True)
+    feed = lazy.feed()
+    first_ctx, first_idx = next(feed)
+    # only history 0 is split so far: the serial-preamble behavior
+    # (split everything, then plan) is gone
+    assert lazy.n_decomposed + len(lazy._pass_idx) == 1
+    assert first_idx == 0
+    seen = [(first_ctx, first_idx)] + list(feed)
+    # same partition structure, same sub-histories, same order
+    assert lazy.n_decomposed == eager.n_decomposed
+    assert lazy.n_partitions == eager.n_partitions
+    assert lazy._pass_idx == eager._pass_idx
+    assert {k: [s for s in v] for k, v in lazy._parts_of.items()} == {
+        k: [s for s in v] for k, v in eager._parts_of.items()
+    }
+    assert len(seen) == sum(
+        len(c.histories) for c in lazy.contexts
+    )
+    if eager.sub_ctx is not None:
+        assert [list(h) for h in lazy.sub_ctx.histories] == [
+            list(h) for h in eager.sub_ctx.histories
+        ]
+
+
+def test_lazy_feed_abandoned_midway_recovers_via_results():
+    """A consumer that abandons the feed mid-way (error paths) still
+    gets the complete split from results()/streams()."""
+    rng = random.Random(12)
+    hists = [
+        generate_mr_history(rng, n_procs=3, n_ops=12, n_keys=3,
+                            n_values=4, crash_p=0.0)
+        for i in range(3)
+    ]
+    model = m.multi_register({k: 0 for k in range(3)})
+    lazy = decompose.DecomposedRun(model, hists, lazy=True)
+    next(lazy.feed())  # drive one step, then abandon
+    eager = decompose.DecomposedRun(model, hists)
+    assert len(lazy.streams()) == len(eager.streams())
+    assert lazy.n_partitions == eager.n_partitions

@@ -18,7 +18,7 @@ import pytest
 from jepsen_tpu import models as m
 from jepsen_tpu import obs
 from jepsen_tpu.checker import linear
-from jepsen_tpu.engine import DispatchWindow, pipeline
+from jepsen_tpu.engine import DispatchWindow, pipeline, planning
 from jepsen_tpu.history import History, invoke_op, ok_op
 from jepsen_tpu.ops import encode, wgl
 from jepsen_tpu.synth import generate_history as _gen
@@ -535,3 +535,52 @@ def test_batched_linearizable_reads_engine_window():
     )
     assert out["valid?"] is True
     assert set(out["results"]) == {"a", "b"}
+
+
+def test_bucket_stream_finish_orders_big_buckets_first():
+    """End-of-input buckets dispatch largest-estimated-cost first
+    (BucketStream.finish) — the per-run half of the daemon's
+    largest-cost-first scheduling — with first-seen order preserved
+    between equal-cost buckets."""
+    model = m.cas_register(0)
+    # 2 short rows land in a small bucket first, then 6 long rows in a
+    # bigger-cost bucket: first-seen order is small-first, finish must
+    # flip it
+    rng = random.Random(7)
+    hists = [_gen(rng, n_procs=3, n_ops=8, crash_p=0.0) for _ in range(2)]
+    hists += [_gen(rng, n_procs=3, n_ops=75, crash_p=0.0) for _ in range(6)]
+    ctx = planning.RunContext(model, hists)
+    planner = planning.Planner(model, spec=ctx.spec, slot_cap=32,
+                               frontier=64)
+    stream = planner.open_stream()
+    for idx in range(len(hists)):
+        assert list(stream.feed(ctx, idx)) == []  # below flush_rows
+    out = list(stream.finish())
+    assert len(out) >= 2
+    costs = [planning.estimated_cost(pb) for pb in out]
+    assert costs == sorted(costs, reverse=True)
+    assert costs[0] > costs[-1]
+
+
+def test_planner_stream_equals_feed_finish_composition():
+    """Planner.stream is exactly open_stream + feed* + finish: same
+    buckets, same rows, same plans."""
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=3, wide=False)
+    ctx_a = planning.RunContext(model, hists)
+    planner_a = planning.Planner(model, spec=ctx_a.spec, slot_cap=32,
+                                 frontier=64)
+    via_stream = [
+        (pb.key, len(pb.rows)) for pb in planner_a.stream(ctx_a)
+    ]
+    ctx_b = planning.RunContext(model, hists)
+    planner_b = planning.Planner(model, spec=ctx_b.spec, slot_cap=32,
+                                 frontier=64)
+    s = planner_b.open_stream()
+    via_feed = []
+    for idx in range(len(hists)):
+        via_feed.extend((pb.key, len(pb.rows)) for pb in s.feed(ctx_b, idx))
+    via_feed.extend((pb.key, len(pb.rows)) for pb in s.finish())
+    assert via_stream == via_feed
+    assert planner_a.n_buckets == planner_b.n_buckets
+    assert planner_a.n_flushes == planner_b.n_flushes

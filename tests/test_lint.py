@@ -156,6 +156,36 @@ def test_trace_suppressed(tmp_path):
     assert res.findings == []
 
 
+def test_trace_sync_timing_annotation(tmp_path):
+    """`# jt: timing` on a def sanctions every trace-sync inside it
+    (nested defs included) — the autotuner's measurement-loop
+    allowance — without touching syncs in unmarked functions."""
+    res = run_lint(tmp_path, {"tune/t.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        # jt: timing — measurement loop: the sync IS the measurement
+        def measure(x):
+            out = kernel(x)
+            out.block_until_ready()
+            def rep():
+                return np.asarray(kernel(x))
+            return rep()
+
+        def timed(x):  # jt: timing
+            return kernel(x).block_until_ready()
+
+        def leaky(x):
+            return kernel(x).block_until_ready()
+    """})
+    assert rules_of(res) == ["trace-sync"]
+    assert res.findings[0].scope == "leaky"
+
+
 def test_trace_nested_def_reports_once(tmp_path):
     # one bug in a nested traced def must be ONE finding, not one per
     # enclosing traced scope — including defs nested under `if`

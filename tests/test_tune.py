@@ -1,0 +1,336 @@
+"""Auto-tuned dispatch: calibration artifact schema/round-trip, the
+fallback-to-pinned-defaults contract, calibration-aware engine
+lookups, the measured cost table, and the per-chip budget guardrail
+(doc/tuning.md)."""
+
+import json
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu import tune
+from jepsen_tpu.engine import execution, planning
+from jepsen_tpu.ops import dense, wgl
+from jepsen_tpu.synth import generate_history
+from jepsen_tpu.tune import artifact as art
+
+
+@pytest.fixture(autouse=True)
+def _isolated_calibration(monkeypatch):
+    """Every test starts with no resolved calibration and no stray
+    artifact path; the active-calibration singleton is process-wide,
+    so tests must never leak a pin into each other."""
+    monkeypatch.delenv("JEPSEN_TPU_CALIBRATION", raising=False)
+    tune.reset_active()
+    yield
+    tune.reset_active()
+
+
+def make_data(**over):
+    """A schema-valid artifact dict matching THIS process's device and
+    code (loads cleanly unless a test breaks it on purpose)."""
+    kind, n = art.device_key()
+    params = {"window": 7, "flush_rows": 123, "row_bucket": 128,
+              "union_mode": "gather"}
+    params.update(over.pop("params", {}))
+    cost = over.pop("cost_table", [
+        {"kernel": "dense", "E": 64, "C": 4, "F": 64, "rows": 32,
+         "seconds": 0.010},
+        {"kernel": "dense", "E": 64, "C": 4, "F": 64, "rows": 128,
+         "seconds": 0.040},
+        {"kernel": "frontier", "E": 64, "C": 4, "F": 64, "rows": 32,
+         "seconds": 0.200},
+    ])
+    data = art.build_artifact(
+        params, cost, kind, n, created_at="2026-08-04T00:00:00+00:00",
+    )
+    data.update(over)
+    return data
+
+
+def corpus(n=6):
+    rng = random.Random(45100)
+    return [
+        generate_history(rng, n_procs=3, n_ops=12, crash_p=0.02,
+                         corrupt=(i % 3 == 0))
+        for i in range(n)
+    ]
+
+
+# -- schema / round-trip ------------------------------------------------------
+
+
+def test_artifact_round_trip_is_byte_stable(tmp_path):
+    data = make_data()
+    p1 = tmp_path / "cal.json"
+    p2 = tmp_path / "cal2.json"
+    art.save(data, str(p1))
+    loaded_raw = json.loads(p1.read_text())
+    assert loaded_raw == data
+    art.save(loaded_raw, str(p2))
+    assert p1.read_text() == p2.read_text()
+    cal = art.load_calibration(str(p1))
+    assert cal is not None
+    assert cal.calibration_id == data["calibration_id"]
+    assert cal.window() == 7
+    assert cal.flush_rows() == 123
+    assert cal.row_bucket() == 128
+    assert cal.union_mode() == "gather"
+
+
+def test_artifact_schema_pins_param_keys():
+    """The schema-stability pin: an artifact always carries exactly
+    these params (a rename/removal breaks every persisted artifact and
+    must trip this test first)."""
+    data = make_data()
+    assert set(data["params"]) == set(art.PARAM_KEYS)
+    assert art.PARAM_KEYS == ("window", "flush_rows", "row_bucket",
+                              "union_mode")
+    assert data["version"] == art.SCHEMA_VERSION == 1
+    for field in ("calibration_id", "device_kind", "n_devices",
+                  "code_fingerprint", "cost_table"):
+        assert field in data
+
+
+@pytest.mark.parametrize("breaker", [
+    lambda d: d.update(version=2),
+    lambda d: d.pop("params"),
+    lambda d: d["params"].pop("window"),
+    lambda d: d["params"].update(row_bucket=48),   # not a power of two
+    lambda d: d["params"].update(union_mode="zip"),
+    lambda d: d["params"].update(window=0),
+])
+def test_validate_rejects_broken_artifacts(breaker):
+    data = make_data()
+    breaker(data)
+    with pytest.raises(ValueError):
+        art.validate(data)
+
+
+# -- load fallback ------------------------------------------------------------
+
+
+def test_corrupt_artifact_falls_back(tmp_path, caplog):
+    p = tmp_path / "cal.json"
+    p.write_text("{definitely not json")
+    with caplog.at_level("WARNING", logger="jepsen_tpu.tune"):
+        assert art.load_calibration(str(p)) is None
+    assert "pinned engine defaults" in caplog.text
+
+
+def test_version_mismatch_falls_back(tmp_path, caplog):
+    data = make_data()
+    data["version"] = 99
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(data))
+    with caplog.at_level("WARNING", logger="jepsen_tpu.tune"):
+        assert art.load_calibration(str(p)) is None
+    assert "invalid" in caplog.text
+
+
+def test_stale_device_falls_back(tmp_path, caplog):
+    data = make_data()
+    data["device_kind"] = "TPU v9 (imaginary)"
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(data))
+    with caplog.at_level("WARNING", logger="jepsen_tpu.tune"):
+        assert art.load_calibration(str(p)) is None
+    assert "stale" in caplog.text
+
+
+def test_stale_code_fingerprint_falls_back(tmp_path, caplog):
+    data = make_data()
+    data["code_fingerprint"] = "0" * 40
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(data))
+    with caplog.at_level("WARNING", logger="jepsen_tpu.tune"):
+        assert art.load_calibration(str(p)) is None
+    assert "stale" in caplog.text
+
+
+def test_bad_artifact_leaves_engine_on_defaults_no_crash(
+    tmp_path, monkeypatch
+):
+    """The whole point of the fallback: a corrupt calibration.json in
+    the artifact path must leave every lookup on the pinned defaults
+    and verdicts untouched — never crash a run."""
+    p = tmp_path / "cal.json"
+    p.write_text("][")
+    monkeypatch.setenv("JEPSEN_TPU_CALIBRATION", str(p))
+    tune.reset_active()
+    assert tune.active() is None
+    assert execution.default_window() == execution.DEFAULT_WINDOW
+    assert planning.flush_rows_default() == planning.DEFAULT_FLUSH_ROWS
+    assert execution.row_bucket_floor() == execution.ROW_BUCKET
+    assert dense._union_mode() == dense.DEFAULT_UNION
+    model = m.cas_register(0)
+    hists = corpus()
+    got = wgl.check_batch(model, hists, slot_cap=32)
+    tune.set_active(None)
+    assert got == wgl.check_batch(model, hists, slot_cap=32)
+
+
+# -- calibration-aware lookups ------------------------------------------------
+
+
+def test_lookups_serve_calibrated_values():
+    cal = art.Calibration(make_data())
+    tune.set_active(cal)
+    assert execution.default_window() == 7
+    assert planning.flush_rows_default() == 123
+    assert execution.row_bucket_floor() == 128
+    assert dense._union_mode() == "gather"
+
+
+def test_env_beats_calibration(monkeypatch):
+    cal = art.Calibration(make_data())
+    tune.set_active(cal)
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_WINDOW", "2")
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_FLUSH_ROWS", "999")
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_ROW_BUCKET", "32")
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
+    assert execution.default_window() == 2
+    assert planning.flush_rows_default() == 999
+    assert execution.row_bucket_floor() == 32
+    assert dense._union_mode() == "unroll"
+
+
+def test_row_bucket_env_rounds_to_pow2(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_ROW_BUCKET", "48")
+    assert execution.row_bucket_floor() == 64
+    assert execution.row_bucket_target(1) == 64
+
+
+def test_verdicts_identical_tuned_vs_untuned():
+    """A calibration moves wall time only: full result-dict equality
+    under an aggressively different (window=1, gather, tiny flush)
+    artifact."""
+    model = m.cas_register(0)
+    hists = corpus(8)
+    tune.set_active(None)
+    want = wgl.check_batch(model, hists, slot_cap=32)
+    want_f = wgl.check_batch(model, hists, slot_cap=32, max_closure=9)
+    cal = art.Calibration(make_data(params={
+        "window": 1, "flush_rows": 2, "row_bucket": 32,
+        "union_mode": "gather",
+    }))
+    tune.set_active(cal)
+    assert wgl.check_batch(model, hists, slot_cap=32) == want
+    assert (
+        wgl.check_batch(model, hists, slot_cap=32, max_closure=9) == want_f
+    )
+
+
+# -- the measured cost table --------------------------------------------------
+
+
+def _pb(kernel="dense", E=64, C=4, F=64, rows=32, disp=1024):
+    plan = SimpleNamespace(fn=object(), disp=disp, kernel=kernel, E=E,
+                           C=C, frontier=F)
+    return SimpleNamespace(plan=plan, rows=[None] * rows)
+
+
+def test_estimated_cost_serves_measured_table():
+    cal = art.Calibration(make_data())
+    tune.set_active(cal)
+    # exact measured point
+    assert planning.estimated_cost(_pb(rows=32)) == pytest.approx(0.010)
+    # interpolation between 32 and 128 rows
+    mid = planning.estimated_cost(_pb(rows=80))
+    assert 0.010 < mid < 0.040
+    # extrapolation stays monotone past the last sample
+    assert planning.estimated_cost(_pb(rows=512)) > 0.040
+    # below the first sample: linear through the origin
+    assert 0 < planning.estimated_cost(_pb(rows=8)) < 0.010
+
+
+def test_estimated_cost_scales_unmeasured_shapes():
+    cal = art.Calibration(make_data())
+    tune.set_active(cal)
+    small = planning.estimated_cost(_pb(E=64, rows=32))
+    big = planning.estimated_cost(_pb(E=256, rows=32))
+    assert big > small  # nearest-shape scaling keeps the ordering
+
+
+def test_estimated_cost_falls_back_without_table_or_match():
+    # no calibration: the analytic proxy
+    tune.set_active(None)
+    assert planning.estimated_cost(_pb(rows=10)) == float(10 * 64)
+    # empty cost table: proxy again (cost() has nothing to serve)
+    cal = art.Calibration(make_data(cost_table=[]))
+    tune.set_active(cal)
+    assert planning.estimated_cost(_pb(rows=10)) == float(10 * 64)
+    # oracle-routed buckets still cost nothing
+    nothing = _pb(rows=10)
+    nothing.plan.fn = None
+    assert planning.estimated_cost(nothing) == 0.0
+
+
+def test_cost_table_scales_across_kernels_to_keep_units():
+    """A table covering only ONE kernel must not hand a sort measured
+    seconds for dense and a ~1e4x analytic proxy for frontier: the
+    unmeasured kernel scales from the nearest measured entry by the
+    analytic footprint ratio, so both sides stay in seconds and the
+    frontier bucket (bigger footprint) still ranks above the dense
+    one at equal rows."""
+    cal = art.Calibration(make_data(cost_table=[
+        {"kernel": "dense", "E": 64, "C": 4, "F": 64, "rows": 32,
+         "seconds": 0.01},
+    ]))
+    tune.set_active(cal)
+    dense_cost = planning.estimated_cost(_pb(kernel="dense", rows=32))
+    frontier_cost = planning.estimated_cost(_pb(kernel="frontier", rows=32))
+    assert dense_cost == pytest.approx(0.01)
+    assert dense_cost < frontier_cost < 10.0  # seconds, not proxy units
+
+
+# -- budget guardrail ---------------------------------------------------------
+
+
+def test_proposal_within_budget_frontier_window_math():
+    plan = SimpleNamespace(fn=object(), disp=64, kernel="frontier",
+                           E=64, C=4, frontier=64)
+    # full cap fits at window 1
+    assert tune.proposal_within_budget(plan, 64, window=1)
+    # window 4: 4 chunks × 16 rows = 64 in flight, still within
+    assert tune.proposal_within_budget(plan, 64, window=4)
+    assert not tune.proposal_within_budget(plan, 65, window=4)
+    assert not tune.proposal_within_budget(plan, 1000, window=1)
+    # cap below the window: serialized at the full single-dispatch cap
+    tiny = SimpleNamespace(fn=object(), disp=2, kernel="frontier",
+                           E=64, C=4, frontier=64)
+    assert tune.proposal_within_budget(tiny, 2, window=8)
+    assert not tune.proposal_within_budget(tiny, 3, window=8)
+
+
+def test_proposal_within_budget_dense_and_undispatchable():
+    plan = SimpleNamespace(fn=object(), disp=128, kernel="dense",
+                           E=64, C=4, frontier=64)
+    assert tune.proposal_within_budget(plan, 128, window=8)
+    assert not tune.proposal_within_budget(plan, 129, window=1)
+    dead = SimpleNamespace(fn=None, disp=0, kernel="oracle",
+                           E=64, C=4, frontier=64)
+    assert tune.proposal_within_budget(dead, 0, window=4)
+    assert not tune.proposal_within_budget(dead, 1, window=4)
+
+
+def test_tuner_smoke_profile_artifact_is_budget_clean(tmp_path):
+    """A real (tiny) sweep on this host: the persisted artifact loads,
+    carries budget evidence with zero breaches, and its cost table
+    only holds rows the guardrail admits."""
+    out = tmp_path / "calibration.json"
+    path, data = tune.run_tune(out_path=str(out), profile="smoke",
+                               activate=False)
+    try:
+        assert out.exists()
+        sweep = data["sweep"]
+        assert sweep["budget_breaches"] == 0
+        assert sweep["budget_checks"] > 0
+        assert data["cost_table"], "smoke sweep produced no cost points"
+        cal = art.load_calibration(path)
+        assert cal is not None
+        assert cal.has_cost_table()
+    finally:
+        tune.reset_active()
