@@ -2,15 +2,15 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
 # observability, pipeline, checker-service, slice-dispatch,
-# decomposition, and auto-tune smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke
+# decomposition, auto-tune, and transactional-screen smoke checks
+check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -70,6 +70,18 @@ decompose-smoke:
 # frontier, escalation, decomposed, and service routes
 tune-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.tune.smoke
+
+# transactional-screen gate (doc/checker-engines.md "Transactional
+# screens"): list-append + rw-register corpora (mixed graph sizes,
+# cyclic + acyclic, plain + realtime models) through elle.check_batch
+# with device screens forced on vs off, the boolean has-cycle (dense
+# closure) route, and per-chip budget accounting through a capped
+# resident executor; second line re-runs sharded over the forced
+# 8-virtual-device mesh.  Fails on any verdict divergence vs the CPU
+# path, missing screen evidence, or a budget breach.
+elle-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.elle.smoke
+	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m jepsen_tpu.elle.smoke
 
 bench:
 	python bench.py

@@ -908,6 +908,157 @@ def bench_service():
     _emit(payload)
 
 
+def _elle_corpus(mode, n_hists, n_txns, key_count, anomaly_every=4):
+    """A synthetic many-key transaction corpus: workload-generator
+    histories (the same TxnGenerator the cycle workloads run) against
+    the serializable in-memory store, with a handcrafted dependency
+    cycle injected into every ``anomaly_every``-th history so the
+    witness-search fallback path is measured, not just the all-acyclic
+    fast path."""
+    from jepsen_tpu import fake
+    from jepsen_tpu import generator as g
+    from jepsen_tpu.generator import sim
+    from jepsen_tpu.history import History, Op
+    from jepsen_tpu.workloads.cycle import TxnGenerator
+
+    hists = []
+    for h_i in range(n_hists):
+        client = fake.TxnAtomClient()
+
+        def complete(ctx, inv):
+            return {**client.invoke(None, inv), "time": inv["time"] + 10}
+
+        txn_gen = TxnGenerator(
+            mode,
+            {"key-count": key_count, "min-txn-length": 1,
+             "max-txn-length": 4, "max-writes-per-key": 8},
+        )
+        dicts = sim.simulate(g.limit(n_txns, txn_gen), complete)
+        if h_i % anomaly_every == 0:
+            # a committed wr-dependency cycle on fresh keys: T1 writes
+            # kx and reads ky's value from T2, T2 writes ky and reads
+            # kx's value from T1 — a G1c in either workload mode
+            t0 = max((d.get("time") or 0) for d in dicts) + 100
+            kx, ky = "__bx", "__by"
+            if mode == "append":
+                t1 = [["append", kx, 1], ["r", ky, [2]]]
+                t2 = [["append", ky, 2], ["r", kx, [1]]]
+            else:
+                t1 = [["w", kx, 1], ["r", ky, 2]]
+                t2 = [["w", ky, 2], ["r", kx, 1]]
+            for p, txn, dt in ((91, t1, 0), (92, t2, 1)):
+                dicts.append({"process": p, "type": "invoke",
+                              "f": "txn", "value": txn, "time": t0 + dt})
+                dicts.append({"process": p, "type": "ok", "f": "txn",
+                              "value": txn, "time": t0 + 10 + dt})
+        hists.append(History([Op.from_dict(d) for d in dicts]).index_ops())
+    return hists
+
+
+def bench_elle():
+    """--elle: the transactional-screen headline — screened-vs-CPU
+    classify throughput on a synthetic many-key transaction corpus
+    through the production ``elle.check_batch`` path: dependency
+    graphs from every history stack into shared engine dispatches
+    (window, per-chip budget, mesh), and only graphs the device
+    proved cyclic pay the CPU witness search.  Reports graphs/s,
+    screen hit-rate, and the witness-search fallback fraction, and
+    appends a ``"bench": "elle"`` record to BENCH_tpu_windows.jsonl
+    (excluded from _best_window by the existing label rule).  Emits
+    ONE JSON line like the main bench; never crashes without it."""
+    payload = {
+        "metric": "elle_screened_classify_histories_per_sec",
+        "value": 0.0,
+        "unit": "histories/sec",
+    }
+    try:
+        os.environ.setdefault("JEPSEN_TPU_PROBE_TRAIL", PROBE_TRAIL)
+        on_accel, probe_err = probe_accelerator()
+        if not on_accel:
+            _force_cpu_fallback()
+            payload["warnings"] = (
+                f"accelerator unusable ({probe_err}); CPU fallback at "
+                "reduced shape"
+            )
+        import jax
+
+        from jepsen_tpu import elle, obs
+
+        if on_accel:
+            n_hists, n_txns, keys = 64, 400, 32
+        else:
+            n_hists, n_txns, keys = 24, 120, 16
+        n_hists = int(os.environ.get("JEPSEN_TPU_BENCH_ELLE_N", n_hists))
+        n_txns = int(os.environ.get("JEPSEN_TPU_BENCH_ELLE_T", n_txns))
+        mode = os.environ.get("JEPSEN_TPU_BENCH_ELLE_MODE", "rw-register")
+        gen_mode = "append" if mode == "list-append" else "wr"
+        hists = _elle_corpus(gen_mode, n_hists, n_txns, keys)
+        opts = {"workload": mode,
+                "consistency-models": ["serializable"]}
+
+        def timed(route):
+            o = {**opts, "screen-route": route}
+            elle.check_batch(o, hists)  # warm: screen compiles
+            obs.enable(reset=True)
+            t0 = time.perf_counter()
+            res = elle.check_batch(o, hists)
+            dt = time.perf_counter() - t0
+            reg = obs.registry()
+            diag = {
+                "witness_fallbacks": reg.value(
+                    "jepsen_elle_witness_fallback_total") or 0,
+                "screened": reg.value(
+                    "jepsen_elle_screen_route_total", route="device") or 0,
+            }
+            obs.enable(reset=True)
+            return dt, res, diag
+
+        cpu_s, cpu_res, _cpu_diag = timed("cpu")
+        dev_s, dev_res, dev_diag = timed("device")
+        if [r.get("valid?") for r in dev_res] != [
+            r.get("valid?") for r in cpu_res
+        ]:
+            payload["error"] = "screened/CPU verdicts diverged"
+        hps_dev = n_hists / dev_s if dev_s > 0 else 0.0
+        hps_cpu = n_hists / cpu_s if cpu_s > 0 else 0.0
+        screened = dev_diag["screened"] or n_hists
+        fallbacks = dev_diag["witness_fallbacks"]
+        payload.update({
+            "value": round(hps_dev, 2),
+            "hps_cpu_classify": round(hps_cpu, 2),
+            "speedup": round(hps_dev / hps_cpu, 2) if hps_cpu else None,
+            "batch": n_hists,
+            "txns_per_history": n_txns,
+            "n_keys": keys,
+            "workload": mode,
+            "graphs_per_sec": round(screened / dev_s, 2)
+            if dev_s > 0 else 0.0,
+            # hit rate = graphs the screens proved acyclic (no CPU
+            # witness search at all); fallback fraction is its dual
+            "screen_hit_rate": round(1.0 - fallbacks / screened, 4)
+            if screened else None,
+            "witness_fallback_fraction": round(fallbacks / screened, 4)
+            if screened else None,
+            "invalid_histories": sum(
+                1 for r in dev_res if r.get("valid?") is not True
+            ),
+            "platform": jax.devices()[0].platform,
+        })
+        try:
+            with open(WINDOWS, "a") as f:
+                f.write(json.dumps(
+                    {"captured_at": _utcnow(), "bench": "elle", **payload}
+                ) + "\n")
+        except OSError as e:
+            print(f"window append failed: {e!r}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        payload["error"] = repr(e)[:300]
+    _emit(payload)
+
+
 def main():
     import argparse
 
@@ -929,6 +1080,15 @@ def main():
         "BENCH_tpu_windows.jsonl",
     )
     ap.add_argument(
+        "--elle",
+        action="store_true",
+        help="transactional-screen headline: screened-vs-CPU Elle "
+        "classify throughput on a synthetic many-key transaction "
+        "corpus through the engine-routed check_batch path (graphs/s, "
+        "screen hit-rate, witness-search fallback fraction); appends "
+        "an 'elle' record to BENCH_tpu_windows.jsonl",
+    )
+    ap.add_argument(
         "--decompose",
         action="store_true",
         help="wide-keyspace P-compositionality headline: multi-register "
@@ -939,6 +1099,9 @@ def main():
     args, _unknown = ap.parse_known_args()
     if args.against_service:
         bench_service()
+        return
+    if args.elle:
+        bench_elle()
         return
     if args.decompose:
         bench_decompose()

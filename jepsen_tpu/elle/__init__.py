@@ -25,13 +25,37 @@ from ..history import History
 from . import consistency, core, cycles, graph, list_append, rw_register
 
 
+def _workload_module(opts: dict):
+    workload = opts.get("workload", "list-append")
+    if workload == "list-append":
+        return list_append
+    if workload == "rw-register":
+        return rw_register
+    raise KeyError(f"unknown elle workload {workload!r}")
+
+
 def check(opts: Optional[dict], history: History) -> dict:
     """Elle-style entry point: opts include ``workload`` ("list-append"
     or "rw-register"), plus ``consistency-models`` / ``anomalies``."""
     opts = opts or {}
-    workload = opts.get("workload", "list-append")
-    if workload == "list-append":
-        return list_append.check(history, opts)
-    if workload == "rw-register":
-        return rw_register.check(history, opts)
-    raise KeyError(f"unknown elle workload {workload!r}")
+    return _workload_module(opts).check(history, opts)
+
+
+def check_batch(opts: Optional[dict], histories) -> list:
+    """Batched Elle analysis — the engine-routed production shape: all
+    histories' dependency graphs are built first, then screened
+    together through :func:`jepsen_tpu.elle.cycles.classify_graphs` —
+    graphs from many histories (and, via the checker service, many
+    concurrent runs) stack into shared ``(B, n, n)`` device
+    dispatches through the engine Executor, and only graphs (and
+    ladder rungs) the screens proved cyclic pay the CPU Tarjan +
+    witness search.  ``opts["screen-route"]`` forces
+    ``"device"``/``"cpu"`` routing (default: self-calibrating auto).
+    Per-history results are byte-identical to :func:`check`."""
+    opts = opts or {}
+    mod = _workload_module(opts)
+    preps = [mod.prepare(h, opts) for h in histories]
+    cyc = cycles.classify_graphs(
+        [p[0] for p in preps], route=opts.get("screen-route")
+    )
+    return [mod.finish(p, c) for p, c in zip(preps, cyc)]

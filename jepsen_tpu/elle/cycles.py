@@ -55,32 +55,80 @@ def _suffix(rels_used: Set[str]) -> str:
     return ""
 
 
-def classify(g: Graph) -> Dict[str, list]:
-    """Find one witness cycle per anomaly type per SCC."""
+def classify(g: Graph, screen: Optional["GraphScreen"] = None
+             ) -> Dict[str, list]:
+    """Find one witness cycle per anomaly type per SCC.
+
+    With a ``screen`` (the device's per-relation-filter SCC membership
+    masks and nonadjacent-rw walk masks — :func:`screen_for_graphs`),
+    every ladder rung the device has proven empty *under that rung's
+    relation filter* is skipped outright: a skipped search is one the
+    CPU would provably have answered None, so the output is
+    byte-identical to the unscreened run (the fuzz corpus pins it) —
+    Tarjan and the BFS witness searches only run on graphs, and
+    rungs, already proven cyclic."""
+    from . import encode as encode_mod
+
     anomalies: Dict[str, list] = {}
 
     def record(name: str, cyc: List[Any]) -> None:
         anomalies.setdefault(name, []).append(_fmt_cycle(g, cyc))
 
+    if screen is not None:
+        full = screen.members(encode_mod.ALL_MASK)
+        if full is not None and not full:
+            # no vertex sits on any cycle at all: no nontrivial SCCs,
+            # so the whole classify pass (Tarjan included) is free
+            return anomalies
+
     for scc in strongly_connected_components(g):
+        def rung_empty(mask: int) -> bool:
+            """Device-proven: this SCC has no cycle in the subgraph of
+            edges carrying a relation in ``mask``."""
+            if screen is None:
+                return False
+            mem = screen.members(mask)
+            return mem is not None and not any(v in mem for v in scc)
+
+        def walk_empty(rest_mask: int) -> bool:
+            """Device-proven: no nonadjacent-rw closed walk through
+            any vertex of this SCC (⇒ find_nonadjacent_cycle's walk
+            BFS would see nothing and answer None)."""
+            if screen is None:
+                return False
+            w = screen.nonadj(encode_mod.RW_BIT, rest_mask)
+            return w is not None and not any(v in w for v in scc)
+
         # Most-severe-first: G0, then G1c, then G-single, then G2-item.
         ww_only = lambda rels: rels <= {WW}  # noqa: E731
         ww_wr = lambda rels: bool(rels & {WW, WR}) and not (rels & {RW})  # noqa: E731
         has_rw = lambda rels: RW in rels  # noqa: E731
 
-        sub = g.filtered(lambda rels: bool(rels & {WW}))
-        cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+        if rung_empty(encode_mod.WW_BIT):
+            cyc = None
+        else:
+            sub = g.filtered(lambda rels: bool(rels & {WW}))
+            cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
         if cyc is not None:
             record("G0", cyc)
             continue
 
-        sub = g.filtered(lambda rels: bool(rels & {WW, WR}))
-        cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+        if rung_empty(encode_mod.WW_BIT | encode_mod.WR_BIT):
+            cyc = None
+        else:
+            sub = g.filtered(lambda rels: bool(rels & {WW, WR}))
+            cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
         if cyc is not None:
             record("G1c", cyc)
             continue
 
-        cyc = find_cycle_with(
+        # every remaining plain rung needs a cycle inside the
+        # ww|wr|rw subgraph; one device mask screens all three
+        rw_rungs_empty = rung_empty(
+            encode_mod.WW_BIT | encode_mod.WR_BIT | encode_mod.RW_BIT
+        )
+
+        cyc = None if rw_rungs_empty else find_cycle_with(
             g,
             scc,
             want=has_rw,
@@ -93,12 +141,17 @@ def classify(g: Graph) -> Dict[str, list]:
 
         # G-nonadjacent: ≥2 rw edges, none cyclically adjacent — still a
         # snapshot-isolation violation (SI cycles need two adjacent rws)
-        cyc = find_nonadjacent_cycle(
-            g,
-            scc,
-            want=has_rw,
-            rest=lambda rels: bool(rels & {WW, WR}),
-        )
+        if rw_rungs_empty or walk_empty(
+            encode_mod.WW_BIT | encode_mod.WR_BIT
+        ):
+            cyc = None
+        else:
+            cyc = find_nonadjacent_cycle(
+                g,
+                scc,
+                want=has_rw,
+                rest=lambda rels: bool(rels & {WW, WR}),
+            )
         if cyc is INDETERMINATE:
             # simple-cycle search budget exhausted: a G-nonadjacent may
             # exist in this SCC.  Record the uncertainty (result() turns
@@ -114,14 +167,18 @@ def classify(g: Graph) -> Dict[str, list]:
             record("G-nonadjacent", cyc)
             continue
 
-        sub = g.filtered(lambda rels: bool(rels & {WW, WR, RW}))
-        cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+        if rw_rungs_empty:
+            cyc = None
+        else:
+            sub = g.filtered(lambda rels: bool(rels & {WW, WR, RW}))
+            cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
         if cyc is not None:
             record("G2-item", cyc)
             continue
 
         # Cycle requires process/realtime edges: -realtime/-process
         # variants of the same ladder.
+        pr = encode_mod.PR_MASK
         for want_rels, name in (
             ({WW}, "G0"),
             ({WW, WR}, "G1c"),
@@ -130,19 +187,29 @@ def classify(g: Graph) -> Dict[str, list]:
             ({WW, WR, RW}, "G2-item"),
         ):
             if name == "G-single":
-                cyc = find_cycle_with(
-                    g,
-                    scc,
-                    want=has_rw,
-                    rest=lambda rels: bool(rels & {WW, WR, PROCESS, REALTIME}),
-                    want_count=1,
+                cyc = None if rung_empty(encode_mod.ALL_MASK) else (
+                    find_cycle_with(
+                        g,
+                        scc,
+                        want=has_rw,
+                        rest=lambda rels: bool(
+                            rels & {WW, WR, PROCESS, REALTIME}
+                        ),
+                        want_count=1,
+                    )
                 )
             elif name == "G-nonadjacent":
-                cyc = find_nonadjacent_cycle(
-                    g,
-                    scc,
-                    want=has_rw,
-                    rest=lambda rels: bool(rels & {WW, WR, PROCESS, REALTIME}),
+                cyc = (
+                    None
+                    if walk_empty(encode_mod.WW_BIT | encode_mod.WR_BIT | pr)
+                    else find_nonadjacent_cycle(
+                        g,
+                        scc,
+                        want=has_rw,
+                        rest=lambda rels: bool(
+                            rels & {WW, WR, PROCESS, REALTIME}
+                        ),
+                    )
                 )
                 if cyc is INDETERMINATE:
                     # this rung's hypothetical cycle needs process or
@@ -165,12 +232,18 @@ def classify(g: Graph) -> Dict[str, list]:
                         )
                     cyc = None
             else:
-                sub = g.filtered(
-                    lambda rels, wr=want_rels: bool(
-                        rels & (wr | {PROCESS, REALTIME})
+                mask = encode_mod.rel_mask(want_rels) | pr
+                if rung_empty(mask):
+                    cyc = None
+                else:
+                    sub = g.filtered(
+                        lambda rels, wr=want_rels: bool(
+                            rels & (wr | {PROCESS, REALTIME})
+                        )
                     )
-                )
-                cyc = find_cycle(sub, [v for v in scc if v in sub.vertices])
+                    cyc = find_cycle(
+                        sub, [v for v in scc if v in sub.vertices]
+                    )
             if cyc is not None:
                 used: Set[str] = set()
                 for rels in cycle_rels(g, cyc):
@@ -300,3 +373,273 @@ def cyclic_graph_mask(graphs: List[Graph], use_device: Optional[bool] = None):
         return cpu_out
     _SCREEN_CHOICE[key] = "device" if t_dev < t_cpu else "cpu"
     return cpu_out
+
+
+# ---------------------------------------------------------------------------
+# Device-screened classify: batched SCC/relation-filter screens through
+# the production engine (ops.cycles → engine.execution.Executor)
+# ---------------------------------------------------------------------------
+
+#: winner cache for the screened-vs-CPU classify router, keyed like
+#: _SCREEN_CHOICE by (vertex-bucket, batch-size-bucket); "cpu" is the
+#: terminal state after any device error or cross-check mismatch
+_CLASSIFY_CHOICE: dict = {}
+
+#: below this many screenable graphs the auto route stays on CPU —
+#: dispatch overhead says nothing useful about tiny batches (the same
+#: ≥16 gate version_graphs applies to its cycle screen)
+ELLE_SCREEN_MIN_BATCH = 16
+
+
+class GraphScreen:
+    """One graph's device screens, decoded back into vertex space:
+    ``members(mask)`` — the vertices on some cycle of the subgraph of
+    edges carrying a relation in ``mask`` — and ``nonadj(want, rest)``
+    — the vertices with a nonadjacent-want closed walk.  Queries
+    canonicalize masks to the relation bits the graph actually has, so
+    a graph with no process/realtime edges answers its suffixed-ladder
+    rungs from the identical plain-relation closure.  Returns a set
+    (possibly empty — a *definitive* no) or ``None`` for a filter the
+    screen never computed (callers must then search, never skip)."""
+
+    __slots__ = ("order", "present", "_members", "_walks", "_sets",
+                 "_wsets")
+
+    def __init__(self, enc, res):
+        self.order = enc.order
+        self.present = enc.present
+        self._members = res.members
+        self._walks = res.walks
+        self._sets: dict = {}
+        self._wsets: dict = {}
+
+    def _vertex_set(self, arr):
+        return frozenset(
+            v for i, v in enumerate(self.order) if arr[i]
+        )
+
+    def members(self, mask: int):
+        key = mask & self.present
+        if key == 0:
+            return frozenset()
+        got = self._sets.get(key)
+        if got is None:
+            arr = self._members.get(key)
+            if arr is None:
+                return None
+            got = self._sets[key] = self._vertex_set(arr)
+        return got
+
+    def nonadj(self, want: int, rest: int):
+        if not (self.present & want):
+            return frozenset()  # no want edge anywhere: trivially none
+        key = (want, rest & self.present)
+        got = self._wsets.get(key)
+        if got is None:
+            arr = self._walks.get(key)
+            if arr is None:
+                return None
+            got = self._wsets[key] = self._vertex_set(arr)
+        return got
+
+
+def screen_for_graphs(graphs: List[Graph], executor=None):
+    """Encode and screen a batch of dependency graphs through the
+    engine: returns ``(screens, route)`` with one
+    :class:`GraphScreen` (or ``None`` — CPU fallback for that graph)
+    per input.  With the checker service opted in
+    (``JEPSEN_TPU_SERVICE``), screens ride ``POST /elle`` and coalesce
+    with concurrent runs on the daemon's resident executor; otherwise
+    they dispatch through an in-process
+    :class:`~jepsen_tpu.engine.execution.Executor` (window, per-chip
+    budget, mesh)."""
+    from . import encode as encode_mod
+    from ..ops import cycles as ops_cycles
+
+    encs = [encode_mod.encode_graph(g) for g in graphs]
+    results = None
+    route = "device"
+    if executor is None:
+        try:
+            from ..serve import client as serve_client
+
+            if serve_client.service_mode() != "off":
+                results = serve_client.screen_graphs(encs)
+                if results is not None:
+                    route = "service"
+        except Exception:  # noqa: BLE001 — any service trouble → local
+            results = None
+    if results is None:
+        results = ops_cycles.screen_graphs(encs, executor=executor)
+        route = "device"
+    screens = [
+        GraphScreen(enc, res) if res is not None else None
+        for enc, res in zip(encs, results)
+    ]
+    return screens, route
+
+
+def _classify_screened(graphs: List[Graph], executor=None,
+                       count: bool = True) -> List[Dict[str, list]]:
+    """Classify with device screens, recording the route and the
+    witness-search fallback evidence per graph.  ``count=False``
+    suppresses the counters — the calibration probes run this path
+    without *serving* its results, and served-route accounting must
+    reflect what callers actually received."""
+    from . import encode as encode_mod
+    from .. import obs
+
+    screens, route = screen_for_graphs(graphs, executor=executor)
+    out = []
+    n_screened = n_fallback = n_cpu = 0
+    for g, s in zip(graphs, screens):
+        if s is None:
+            n_cpu += 1
+            out.append(classify(g))
+            continue
+        n_screened += 1
+        full = s.members(encode_mod.ALL_MASK)
+        if full:
+            # the screen proved a cycle exists: CPU Tarjan + witness
+            # search still runs for this graph — the measured
+            # "witness-search fallback" fraction of the bench headline
+            n_fallback += 1
+        out.append(classify(g, s))
+    if count and obs.enabled():
+        if n_screened:
+            obs.count("jepsen_elle_screen_route_total", n_screened,
+                      route=route)
+        if n_cpu:
+            obs.count("jepsen_elle_screen_route_total", n_cpu,
+                      route="cpu")
+        if n_fallback:
+            obs.count("jepsen_elle_witness_fallback_total", n_fallback)
+    return out
+
+
+def _classify_route() -> str:
+    import os
+
+    return os.environ.get("JEPSEN_TPU_ELLE_SCREEN", "auto").strip().lower()
+
+
+def classify_graphs(
+    graphs: List[Graph],
+    route: Optional[str] = None,
+    executor=None,
+) -> List[Dict[str, list]]:
+    """Batched :func:`classify`: screen every graph's relation-filter
+    cycle structure on the device in shared engine dispatches, then
+    pay CPU Tarjan + witness search only where the screens proved
+    cycles exist.  ``route``: ``"cpu"`` (pure host path — the
+    byte-identity reference), ``"device"`` (screens forced — smoke,
+    fuzz, bench), or ``None``/``"auto"`` (default; also
+    ``JEPSEN_TPU_ELLE_SCREEN``): SELF-CALIBRATING per (vertex-bucket,
+    batch-bucket) pair exactly like :func:`cyclic_graph_mask` — the
+    first batch at each pair runs both paths, cross-checks anomalies
+    for equality, and pins the faster engine; a device error or
+    mismatch pins the pair to CPU permanently (the screens must never
+    trade correctness for speed).  Graphs past
+    :data:`DEVICE_SCREEN_MAX_VERTICES` (or below 2 vertices) always
+    classify on the CPU."""
+    import logging
+    import time
+
+    from .. import obs
+
+    route = (route or _classify_route()).lower()
+    n = len(graphs)
+    if n == 0:
+        return []
+    if route == "cpu":
+        if obs.enabled():
+            obs.count("jepsen_elle_screen_route_total", n, route="cpu")
+        return [classify(g) for g in graphs]
+
+    screenable = [
+        i for i, g in enumerate(graphs)
+        if 2 <= len(g.vertices) <= DEVICE_SCREEN_MAX_VERTICES
+    ]
+    out: List[Optional[Dict[str, list]]] = [None] * n
+    rest = [i for i in set(range(n)) - set(screenable)]
+    for i in sorted(rest):
+        out[i] = classify(graphs[i])
+    if rest and obs.enabled():
+        obs.count("jepsen_elle_screen_route_total", len(rest), route="cpu")
+    sub = [graphs[i] for i in screenable]
+
+    if route in ("device", "service"):
+        screened = _classify_screened(sub, executor=executor)
+        for i, r in zip(screenable, screened):
+            out[i] = r
+        return out  # type: ignore[return-value]
+
+    # auto: self-calibrating, with the small-batch gate
+    if len(sub) < ELLE_SCREEN_MIN_BATCH:
+        for i in screenable:
+            out[i] = classify(graphs[i])
+        if sub and obs.enabled():
+            obs.count("jepsen_elle_screen_route_total", len(sub),
+                      route="cpu")
+        return out  # type: ignore[return-value]
+    biggest = max(len(g.vertices) for g in sub)
+    key = (_screen_bucket(biggest), _screen_bucket(len(sub)))
+    choice = _CLASSIFY_CHOICE.get(key)
+    if choice == "device":
+        try:
+            screened = _classify_screened(sub, executor=executor)
+        except Exception:  # noqa: BLE001 — device died since calibration
+            logging.getLogger(__name__).warning(
+                "elle classify screens failed after calibration; "
+                "repinning %s to CPU", key, exc_info=True,
+            )
+            _CLASSIFY_CHOICE[key] = "cpu"
+            screened = [classify(g) for g in sub]
+        for i, r in zip(screenable, screened):
+            out[i] = r
+        return out  # type: ignore[return-value]
+    if choice == "cpu":
+        for i in screenable:
+            out[i] = classify(graphs[i])
+        if obs.enabled():
+            obs.count("jepsen_elle_screen_route_total", len(sub),
+                      route="cpu")
+        return out  # type: ignore[return-value]
+
+    # calibrate: both engines classify this batch; the winner takes
+    # the bucket pair, and a cross-check mismatch (or device error)
+    # pins it to CPU — correctness is never traded for speed
+    t0 = time.perf_counter()
+    cpu_out = [classify(g) for g in sub]
+    t_cpu = time.perf_counter() - t0
+    try:
+        # count=False: these are probes — the CPU results below are
+        # what the caller is served, so the route counter must say cpu
+        _classify_screened(sub, executor=executor,
+                           count=False)  # warm/compile
+        t0 = time.perf_counter()
+        dev_out = _classify_screened(sub, executor=executor, count=False)
+        t_dev = time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 — unusable device pins to CPU
+        logging.getLogger(__name__).warning(
+            "elle classify screens failed; pinning %s to CPU", key,
+            exc_info=True,
+        )
+        _CLASSIFY_CHOICE[key] = "cpu"
+        dev_out = None
+    if dev_out is not None:
+        if dev_out != cpu_out:
+            logging.getLogger(__name__).warning(
+                "elle screened/CPU classify diverged; pinning %s to CPU",
+                key,
+            )
+            obs.count("jepsen_elle_screen_mismatch_total")
+            _CLASSIFY_CHOICE[key] = "cpu"
+        else:
+            _CLASSIFY_CHOICE[key] = "device" if t_dev < t_cpu else "cpu"
+    if obs.enabled():
+        # the calibration batch is SERVED the CPU answers
+        obs.count("jepsen_elle_screen_route_total", len(sub), route="cpu")
+    for i, r in zip(screenable, cpu_out):
+        out[i] = r
+    return out  # type: ignore[return-value]

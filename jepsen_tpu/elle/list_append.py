@@ -296,10 +296,12 @@ def cycle_anomalies(g: Graph) -> Dict[str, list]:
     return cycles_mod.classify(g)
 
 
-def check(history: History, opts: Optional[dict] = None) -> dict:
-    """Full list-append analysis.  opts: consistency-models (list of
-    model names, default ["strict-serializable"]), or anomalies (explicit
-    list to look for)."""
+def prepare(history: History, opts: Optional[dict] = None):
+    """The host half of a check, ahead of cycle classification: parse
+    opts, build the dependency graph, and collect the non-cycle
+    anomalies.  Returns ``(g, txns, anomalies, wanted)`` — the batch
+    entry (``elle.check_batch``) prepares every history first so all
+    the graphs screen in ONE engine pass."""
     from . import consistency
 
     opts = opts or {}
@@ -311,5 +313,25 @@ def check(history: History, opts: Optional[dict] = None) -> dict:
         extra += (PROCESS,)
 
     g, txns, anomalies = graph_and_anomalies(history, extra_graphs=extra)
-    anomalies.update(cycle_anomalies(g))
+    return g, txns, anomalies, wanted
+
+
+def finish(prep, cyc_anomalies: Dict[str, list]) -> dict:
+    """Fold classified cycle anomalies into a prepared analysis."""
+    from . import consistency
+
+    g, txns, anomalies, wanted = prep
+    anomalies.update(cyc_anomalies)
     return consistency.result(anomalies, wanted, txn_count=len(txns))
+
+
+def check(history: History, opts: Optional[dict] = None) -> dict:
+    """Full list-append analysis.  opts: consistency-models (list of
+    model names, default ["strict-serializable"]), or anomalies (explicit
+    list to look for); ``screen-route`` forces the cycle screens'
+    device/cpu routing (default: self-calibrating auto)."""
+    prep = prepare(history, opts)
+    cyc = cycles_mod.classify_graphs(
+        [prep[0]], route=(opts or {}).get("screen-route")
+    )[0]
+    return finish(prep, cyc)

@@ -337,8 +337,10 @@ def graph_and_anomalies(
     return g, txns, anomalies
 
 
-def check(history: History, opts: Optional[dict] = None) -> dict:
-    """Full rw-register analysis; same opts as list_append.check."""
+def prepare(history: History, opts: Optional[dict] = None):
+    """The host half of a check, ahead of cycle classification (see
+    ``list_append.prepare``).  Returns ``(g, txns, anomalies,
+    wanted)``."""
     from . import consistency
 
     opts = opts or {}
@@ -350,10 +352,27 @@ def check(history: History, opts: Optional[dict] = None) -> dict:
         extra += (PROCESS,)
 
     g, txns, anomalies = graph_and_anomalies(history, extra_graphs=extra)
-    anomalies.update(cycles_mod.classify(g))
+    return g, txns, anomalies, wanted
+
+
+def finish(prep, cyc_anomalies) -> dict:
+    """Fold classified cycle anomalies into a prepared analysis."""
+    from . import consistency
+
+    g, txns, anomalies, wanted = prep
+    anomalies.update(cyc_anomalies)
     out = consistency.result(anomalies, wanted, txn_count=len(txns))
     # A cyclic version order makes a clean verdict unreachable — but never
     # masks a definite anomaly already found.
     if "cyclic-versions" in anomalies and out["valid?"] is True:
         out["valid?"] = "unknown"
     return out
+
+
+def check(history: History, opts: Optional[dict] = None) -> dict:
+    """Full rw-register analysis; same opts as list_append.check."""
+    prep = prepare(history, opts)
+    cyc = cycles_mod.classify_graphs(
+        [prep[0]], route=(opts or {}).get("screen-route")
+    )[0]
+    return finish(prep, cyc)

@@ -1,0 +1,202 @@
+"""Elle transactional-screen smoke check: ``python -m
+jepsen_tpu.elle.smoke``.
+
+The engine-routed transactional checking gate (doc/checker-engines.md
+"Transactional screens"): a mixed corpus of list-append and
+rw-register transaction histories — mixed sizes (graphs landing in
+different vertex buckets), cyclic and acyclic, valid and anomalous,
+plain and realtime-suffixed consistency models (the lifted
+nonadjacent-rw kernels and the process/realtime filter masks) — runs
+through the production ``elle.check_batch`` path with the device
+screens forced ON and forced OFF, and fails loudly on:
+
+- ANY divergence between screened and pure-CPU result dicts
+  (byte-identical verdicts, anomaly types, witness cycles);
+- the boolean has-cycle route (dense closure) disagreeing with the
+  host reference on mixed-size adjacency batches;
+- missing screen evidence: the device route counter and the
+  graphs-per-dispatch histogram must record;
+- a budget-accounting breach: with a deliberately tiny dispatch cap
+  the engine executor must chunk the screen buckets, and no kernel's
+  peak in-flight per-chip rows may exceed its cap (the same
+  ``chip_row_accounting`` hook the mesh/tune gates assert on).
+
+Run plain for the single-device gate and with
+``JEPSEN_TPU_ENGINE_MESH=1`` for the 8-virtual-device sharded gate
+(the Makefile's ``elle-smoke`` target runs both).
+
+Exit codes: 0 ok, 1 divergence or missing evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _corpus(mode: str):
+    """Seeded mixed-size transaction histories: workload-generator
+    traffic against the serializable in-memory store, with a
+    handcrafted committed wr-dependency cycle injected into every
+    third history (G1c in either workload mode)."""
+    from jepsen_tpu import fake
+    from jepsen_tpu import generator as g
+    from jepsen_tpu.generator import sim
+    from jepsen_tpu.history import History, Op
+    from jepsen_tpu.workloads.cycle import TxnGenerator
+
+    hists = []
+    sizes = [8] * 8 + [20] * 6 + [40] * 4  # buckets 16 / 32 / 64
+    for h_i, n_txns in enumerate(sizes):
+        client = fake.TxnAtomClient()
+
+        def complete(ctx, inv):
+            return {**client.invoke(None, inv), "time": inv["time"] + 10}
+
+        txn_gen = TxnGenerator(
+            mode,
+            {"key-count": 6, "min-txn-length": 1, "max-txn-length": 4,
+             "max-writes-per-key": 8},
+        )
+        dicts = sim.simulate(g.limit(n_txns, txn_gen), complete)
+        if h_i % 3 == 0:
+            t0 = max((d.get("time") or 0) for d in dicts) + 100
+            kx, ky = "__bx", "__by"
+            if mode == "append":
+                t1 = [["append", kx, 1], ["r", ky, [2]]]
+                t2 = [["append", ky, 2], ["r", kx, [1]]]
+            else:
+                t1 = [["w", kx, 1], ["r", ky, 2]]
+                t2 = [["w", ky, 2], ["r", kx, 1]]
+            for p, txn, dt in ((91, t1, 0), (92, t2, 1)):
+                dicts.append({"process": p, "type": "invoke",
+                              "f": "txn", "value": txn, "time": t0 + dt})
+                dicts.append({"process": p, "type": "ok", "f": "txn",
+                              "value": txn, "time": t0 + 10 + dt})
+        hists.append(History([Op.from_dict(d) for d in dicts]).index_ops())
+    return hists
+
+
+def _dumps(results) -> str:
+    return json.dumps(results, sort_keys=True, default=repr)
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu.platform import force_cpu_platform
+
+    force_cpu_platform(8)
+
+    import numpy as np
+
+    from jepsen_tpu import elle, obs
+    from jepsen_tpu.elle import encode as elle_encode
+    from jepsen_tpu.engine import execution
+    from jepsen_tpu.ops import cycles as ops_cycles
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    # -- screened vs CPU byte-equality, both workloads × both model
+    # families (serializable: plain masks; strict-serializable:
+    # realtime graphs → suffixed masks + the second lifted kernel)
+    for mode, workload in (("wr", "rw-register"), ("append", "list-append")):
+        hists = _corpus(mode)
+        for models in (["serializable"], ["strict-serializable"]):
+            opts = {"workload": workload, "consistency-models": models}
+            cpu = elle.check_batch({**opts, "screen-route": "cpu"}, hists)
+            obs.enable(reset=True)
+            dev = elle.check_batch({**opts, "screen-route": "device"}, hists)
+            reg = obs.registry()
+            label = f"{workload}/{models[0]}"
+            check(
+                _dumps(cpu) == _dumps(dev),
+                f"{label}: screened results diverge from CPU",
+            )
+            verdicts = {r["valid?"] for r in cpu}
+            check(
+                verdicts == {True, False},
+                f"{label}: corpus should mix verdicts, got {verdicts}",
+            )
+            check(
+                (reg.value("jepsen_elle_screen_route_total",
+                           route="device") or 0) > 0,
+                f"{label}: no device-routed screens recorded",
+            )
+            check(
+                (reg.value("jepsen_elle_witness_fallback_total") or 0) > 0,
+                f"{label}: no witness-search fallbacks recorded "
+                "(the corpus injects cycles)",
+            )
+            obs.enable(reset=True)
+
+    # -- the boolean has-cycle route on mixed-size adjacency batches
+    rng = np.random.default_rng(45100)
+    mats = []
+    for n in (5, 12, 24, 40, 70):
+        m = rng.random((n, n)) < 0.12
+        np.fill_diagonal(m, False)
+        mats.append(m)
+        mats.append(np.triu(m))  # acyclic twin
+    got = ops_cycles.has_cycle_batch(mats)
+    want = [ops_cycles._np_has_cycle(np.asarray(m, bool)) for m in mats]
+    check(list(got) == want, "has_cycle_batch diverges from host closure")
+    check(True in want and False in want, "has-cycle batch should mix")
+
+    # -- budget accounting through an explicit resident executor: a
+    # tiny dispatch cap must chunk the buckets, and no kernel's peak
+    # in-flight per-chip rows may exceed its cap
+    preps = [elle.rw_register.prepare(h, {"workload": "rw-register"})
+             for h in _corpus("wr")]
+    encs = [elle_encode.encode_graph(p[0]) for p in preps]
+    ex = execution.Executor(4)
+    base = ops_cycles.screen_graphs(encs)
+    capped = ops_cycles.screen_graphs(encs, executor=ex, max_dispatch=4)
+    for a, b in zip(base, capped):
+        same = (a is None) == (b is None) and (
+            a is None or (
+                all(np.array_equal(a.members[k], b.members[k])
+                    for k in a.members)
+                and all(np.array_equal(a.walks[k], b.walks[k])
+                        for k in a.walks)
+            )
+        )
+        check(same, "capped screen masks diverge from uncapped")
+        if not same:
+            break
+    if ex.n_devices == 1:
+        # chunk caps scale ×n_devices on a mesh (per-chip budget ×
+        # slice width), so only the single-device gate pins chunking
+        check(ex.submitted >= len(encs) // 4,
+              f"cap=4 should chunk dispatches, submitted={ex.submitted}")
+    check(ex.submitted > 0, "no screen dispatches reached the executor")
+    for acct in ex.chip_row_accounting.values():
+        cap = acct["chip_cap"]
+        if acct["kernel"] == "dense":
+            cap *= ex.window_size
+        check(
+            acct["peak_chip_rows"] <= cap,
+            f"per-chip budget breach: {acct}",
+        )
+    mesh_mode = os.environ.get("JEPSEN_TPU_ENGINE_MESH", "").strip()
+    if mesh_mode in ("1", "on", "true", "yes", "force"):
+        check(ex.n_devices == 8,
+              f"mesh gate expected 8 devices, got {ex.n_devices}")
+
+    if failures:
+        for f_ in failures:
+            print(f"elle-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "elle-smoke: ok (screened ≡ CPU on list-append + rw-register × "
+        "plain/realtime models; has-cycle route; budget accounting at "
+        f"cap 4 over {ex.n_devices} device(s))"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -380,6 +380,13 @@ class Executor:
                 time.perf_counter() - t_dispatch,
                 engine=plan.kernel,
             )
+        settle = getattr(plan, "settle_rows", None)
+        if settle is not None:
+            # self-settling plan (the Elle cycle screens): the plan
+            # owns its output contract — no escalation ladder, no
+            # ok/failed_at/overflow unpack; it slices live rows itself
+            settle(ch["rows"], mat, n_live)
+            return
         # np.array (not asarray): jax outputs are read-only views and
         # the escalation pass writes back into these
         ok, failed_at, overflow = (np.array(x)[:n_live] for x in mat)
@@ -483,6 +490,11 @@ class Executor:
             "acct_key": fnk,
         }
 
+        # plans may carry their own dispatch lowering (the Elle screen
+        # plans shard a single relation-matrix input; history plans
+        # keep the 6-array sharded_check path)
+        run_rows = getattr(plan, "run_rows", None)
+
         def thunk():
             # the in-flight increment lives INSIDE the thunk: submit
             # retires older entries (decrementing them via settle)
@@ -493,6 +505,8 @@ class Executor:
             self._chip_rows_inflight[fnk] = cur
             if cur > acct["peak_chip_rows"]:
                 acct["peak_chip_rows"] = cur
+            if run_rows is not None:
+                return run_rows(self.mesh, arrays)
             return wgl._run_rows(plan.fn, self.mesh, arrays)
 
         self._win.submit(
@@ -542,6 +556,10 @@ class Executor:
         # single-chip calibration allows, never a share of a global
         # pool another chip could have drained.
         n_dev = self.n_devices
+        # plans that carry their own arrays (the Elle screens' single
+        # relation matrix) declare their own neutral pad fills; the
+        # history kernels keep the shared 6-array convention
+        pad_fills = getattr(plan, "pad_fills", wgl._PAD_FILLS)
         per_chip = plan.disp
         serialize = False
         if plan.kernel != "dense" and self._win.window > 1:
@@ -564,7 +582,7 @@ class Executor:
             if target > B:
                 arrays = tuple(
                     mesh_mod.pad_to_multiple(np.asarray(a), target, fill)
-                    for a, fill in zip(arrays, wgl._PAD_FILLS)
+                    for a, fill in zip(arrays, pad_fills)
                 )
             if serialize:
                 self._win.drain()
@@ -583,7 +601,7 @@ class Executor:
                 mesh_mod.pad_to_multiple(
                     np.asarray(a[lo:hi]), chunk_cap, fill
                 )
-                for a, fill in zip(arrays, wgl._PAD_FILLS)
+                for a, fill in zip(arrays, pad_fills)
             )
             if serialize:
                 self._win.drain()

@@ -94,6 +94,7 @@ from .planning import (  # noqa: F401 — back-compat re-exports
     Planner,
     RunContext,
     default_bucketed,
+    estimated_cost,
     finish_run_telemetry,
 )
 
@@ -174,11 +175,22 @@ def run(
                 st = planners[id(ctx)] = (planner, planner.open_stream())
             for pb in st[1].feed(ctx, idx):
                 ex.submit(pb)
+        # end-of-input buckets order by cost GLOBALLY across every
+        # stream, not per stream: a decomposed run finishes with a
+        # pass-through stream AND a sub-history stream, and a parent's
+        # cost lives in the sum of its sub-bucket rows — finishing the
+        # streams one after another would let a small early stream's
+        # buckets under-schedule a high-fanout run's big sub-buckets
+        # (the ROADMAP items 3+4 leftover).  finish() already sorts
+        # within each stream; the stable global sort composes them.
+        finished = []
         for planner, stream in planners.values():
-            for pb in stream.finish():
-                ex.submit(pb)
+            finished.extend(stream.finish())
             n_buckets += planner.n_buckets
             n_flushes += planner.n_flushes
+        finished.sort(key=estimated_cost, reverse=True)
+        for pb in finished:
+            ex.submit(pb)
         ex.drain()
         t_device_end = time.perf_counter()
 

@@ -459,6 +459,10 @@ def estimated_cost(pb: PlannedBucket) -> float:
             return c
     if plan.kernel == "dense":
         return float(rows * plan.E)
+    if plan.kernel == "cycles":
+        # batched boolean closure (the Elle screens): per-row work is
+        # the n×n matrix squaring ladder, so footprint scales with E²
+        return float(rows) * plan.E * plan.E
     words = max(1, -(-plan.E // 32))
     return float(rows * plan.frontier * (plan.C + 1) * words)
 

@@ -1,19 +1,46 @@
-"""Batched cycle detection on the accelerator.
+"""Batched cycle detection and transactional screens on the accelerator.
 
-Dependency graphs become dense boolean adjacency matrices; transitive
-closure by log₂(N) rounds of boolean matrix squaring — each round one
-batched matmul, which XLA tiles straight onto the MXU in bfloat16 — and
-a graph is cyclic iff its closure has a true diagonal.  This is the
-screening kernel for the Elle-equivalent checker (SURVEY.md §7 step 8):
-thousands of per-key graphs are screened in one dispatch and only the
-cyclic ones get a CPU witness search.
+Dependency graphs become dense matrices; transitive closure by log₂(N)
+rounds of boolean matrix squaring — each round one batched matmul,
+which XLA tiles straight onto the MXU in bfloat16.  Three kernel
+families share that core:
+
+- **has-cycle** (:func:`has_cycle_batch`): a graph is cyclic iff its
+  closure has a true diagonal — the boolean screen the rw-register
+  per-key version graphs ride (SURVEY.md §7 step 8).
+- **SCC membership screens** (:func:`_screen_fn` members): per-vertex
+  forward×backward closure intersection — ``member[v] = ∃j r[v,j] ∧
+  r[j,v]`` — computed per relation-filter mask of the Elle classify
+  ladder (``ww`` for G0, ``ww|wr`` for G1c, ``+rw`` for G2, the
+  process/realtime-suffixed variants), so ``elle.cycles.classify``
+  only pays CPU Tarjan + BFS witness search on graphs (and ladder
+  rungs) the device has already proven cyclic *under that filter*.
+- **nonadjacent-rw walk screens** (:func:`_screen_fn` walks): closure
+  over the 2n×2n lifted product graph (state = vertex × last-edge-was-
+  rw) decides exactly whether a closed walk with no two cyclically
+  adjacent rw edges exists through each vertex — the screening
+  question of the snapshot-isolation cycle test (Adya G-SI); no walk
+  anywhere means ``find_nonadjacent_cycle`` would answer None for
+  every SCC, so the whole rung is skippable.
+
+Since the engine-routing work these kernels no longer dispatch through
+a private loop: every batch is planned into :class:`CyclePlan` /
+:class:`ScreenPlan` buckets (power-of-two vertex buckets ×
+filter-profile, stacked ``(B, n, n)`` uint8 relation matrices — see
+:mod:`jepsen_tpu.elle.encode`) and submitted through the production
+:class:`~jepsen_tpu.engine.execution.Executor`: the bounded
+``DispatchWindow``, the per-chip ``safe_dispatch`` row budget
+(:func:`cycles_max_dispatch`, the crash-avoidance analogue of
+``FRONTIER_DISPATCH_BUDGET``), mesh ``shard_map`` dispatch, and the
+``(kernel="cycles", E=n, C=0, F=1)`` rows of the tune cost table all
+apply to Elle traffic exactly as they do to history checking.
 """
 
 from __future__ import annotations
 
 import math
 from functools import lru_cache
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -33,76 +60,330 @@ def _bucket(n: int) -> int:
 #: compiled executables without limit the way ``maxsize=None`` did
 CLOSURE_CACHE_SIZE = 32
 
+#: per-dispatch footprint budget for the cycle kernels, in bf16 words
+#: of live closure state — the crash-avoidance analogue of
+#: ``wgl.FRONTIER_DISPATCH_BUDGET`` for the matrix-closure family.  A
+#: membership screen holds ~2 n² words per row per filter (adjacency +
+#: closure), a lifted nonadjacent screen 8 n² (the 2n×2n product
+#: graph); 16M words keeps every measured-good elle_bench shape
+#: (B=4096 × n=16 … B=256 × n=256) dispatchable in ≤2 chunks while
+#: bounding in-flight HBM the same way the engine bounds history
+#: kernels — ``has_cycle_batch`` historically had NO such cap, so a
+#: huge graph batch could exceed the per-chip budget the engine
+#: enforces everywhere else (the PR's pinned regression).
+CYCLES_DISPATCH_BUDGET = 16_777_216
+
+#: largest row count per dispatch, shared ceiling with the engine
+DEFAULT_CYCLES_MAX_DISPATCH = 16384
+
+
+def cycles_max_dispatch(
+    n: int,
+    n_filters: int = 1,
+    n_lifted: int = 0,
+    max_dispatch: Optional[int] = None,
+) -> int:
+    """Largest safe per-dispatch row count for a cycle kernel over
+    ``n``-vertex graphs computing ``n_filters`` membership closures and
+    ``n_lifted`` lifted (2n×2n) walk closures.  Returns 0 when even a
+    single row exceeds the budget — callers must route those graphs to
+    the CPU path instead of dispatching."""
+    if max_dispatch is None:
+        max_dispatch = DEFAULT_CYCLES_MAX_DISPATCH
+    per_row = n * n * (2 * max(1, n_filters) + 8 * n_lifted)
+    if per_row > CYCLES_DISPATCH_BUDGET:
+        return 0
+    return max(1, min(max_dispatch, CYCLES_DISPATCH_BUDGET // per_row))
+
+
+def _bool_closure(adj):
+    """Transitive (≥1 step) boolean closure by log₂ rounds of
+    saturated bfloat16 matrix squaring; shape-static, trace-safe."""
+    n = adj.shape[-1]
+    rounds = max(1, math.ceil(math.log2(n)))
+    r = adj.astype(jnp.bfloat16)
+
+    def step(r, _):
+        # r ∪ r·r, saturated to {0,1}; stays in bfloat16 for the MXU
+        rr = jnp.clip(r + jnp.matmul(r, r), 0.0, 1.0)
+        return rr, None
+
+    r, _ = jax.lax.scan(step, r, None, length=rounds)
+    return r > 0.0
+
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
 def _closure_fn(n: int):
-    rounds = max(1, math.ceil(math.log2(n)))
-
     @jax.jit
     def has_cycle(adj):  # adj: (B, n, n) bool
-        r = adj.astype(jnp.bfloat16)
-
-        def step(r, _):
-            # r ∪ r·r, saturated to {0,1}; stays in bfloat16 for the MXU
-            rr = jnp.clip(r + jnp.matmul(r, r), 0.0, 1.0)
-            return rr, None
-
-        r, _ = jax.lax.scan(step, r, None, length=rounds)
+        r = _bool_closure(adj)
         diag = jnp.diagonal(r, axis1=-2, axis2=-1)
-        return jnp.any(diag > 0.0, axis=-1)
+        return jnp.any(diag, axis=-1)
 
     return has_cycle
 
 
+@lru_cache(maxsize=CLOSURE_CACHE_SIZE)
+def _cyclic_fn(n: int):
+    """Engine-facing variant of :func:`_closure_fn`: tuple outputs (the
+    execution layer materializes output *tuples*) and a
+    ``safe_dispatch`` row cap like every other engine kernel."""
+    base = _closure_fn(n)
+    fn = jax.jit(lambda adj: (base(adj),))
+    fn.safe_dispatch = cycles_max_dispatch(n, 1, 0)
+    return fn
+
+
+@lru_cache(maxsize=CLOSURE_CACHE_SIZE)
+def _screen_fn(n: int, masks: Tuple[int, ...],
+               nonadj: Tuple[Tuple[int, int], ...]):
+    """The transactional screen kernel for ``n``-vertex graphs: per
+    relation-filter SCC membership masks plus per-(want, rest) lifted
+    nonadjacent-walk masks, all in ONE dispatch over a ``(B, n, n)``
+    uint8 relation-bit batch (bit assignment:
+    ``jepsen_tpu.elle.encode.REL_BITS``).  Returns
+    ``(members: (B, F, n) bool, walks: (B, Q, n) bool)``."""
+
+    @jax.jit
+    def screen(rel):  # rel: (B, n, n) uint8
+        B = rel.shape[0]
+        members = []
+        for mask in masks:
+            r = _bool_closure((rel & jnp.uint8(mask)) > 0)
+            # v sits on a cycle of this filtered subgraph iff some j
+            # is reachable forward AND backward (j = v covers self
+            # loops, which the graph layer already drops)
+            members.append(jnp.any(r & jnp.swapaxes(r, -1, -2), axis=-1))
+        walks = []
+        for want, rest in nonadj:
+            aw = (rel & jnp.uint8(want)) > 0
+            ar = (rel & jnp.uint8(rest)) > 0
+            # lifted product graph over (vertex, last-edge-was-want):
+            # a want edge is only traversable from state 0 (previous
+            # edge not want) and lands in state 1; rest edges land in
+            # state 0 from either.  A closed walk u →want→ w →…→
+            # (u, state 0) is exactly a walk whose want edges are
+            # never cyclically adjacent (the closing rest edge
+            # precedes the forced first want edge in the rotation).
+            top = jnp.concatenate([ar, aw], axis=-1)
+            bot = jnp.concatenate([ar, jnp.zeros_like(ar)], axis=-1)
+            c = _bool_closure(jnp.concatenate([top, bot], axis=-2))
+            reach = c[:, n:, :n]  # from (·, 1) to (·, 0), ≥1 step
+            walks.append(jnp.any(aw & jnp.swapaxes(reach, -1, -2), axis=-1))
+        m = (jnp.stack(members, axis=1) if members
+             else jnp.zeros((B, 0, n), bool))
+        w = (jnp.stack(walks, axis=1) if walks
+             else jnp.zeros((B, 0, n), bool))
+        return m, w
+
+    screen.safe_dispatch = cycles_max_dispatch(n, len(masks), len(nonadj))
+    return screen
+
+
+def _run_elle(fn, mesh, rel, n_out: int):
+    """Dispatch one stacked relation batch, sharded when a mesh is
+    resident (the executor hands us device-multiple row counts)."""
+    if mesh is None:
+        return fn(jnp.asarray(rel))
+    from ..parallel import mesh as mesh_mod
+
+    return mesh_mod.sharded_elle(fn, mesh, rel, n_out)
+
+
+class ScreenResult:
+    """One graph's device screens, bucket-width: ``members[mask]`` and
+    ``walks[(want, rest)]`` are per-vertex bool arrays over the padded
+    bucket (callers slice by their own vertex count/order)."""
+
+    __slots__ = ("members", "walks")
+
+    def __init__(self, members, walks):
+        self.members = members
+        self.walks = walks
+
+
+class CyclePlan:
+    """Executor-conforming plan for the boolean has-cycle screen: one
+    uint8/bool adjacency input, one cyclic-flag output per row.  Row
+    tokens are ``(sink, idx)`` — settle writes ``sink[idx]``."""
+
+    kernel = "cycles"
+    #: neutral pad rows are all-zero relation matrices — edge-free,
+    #: hence acyclic, hence invisible to every screen (the executor
+    #: pads with these; the plan owns the convention, never borrowing
+    #: the history kernels' 6-array fills)
+    pad_fills = (0,)
+    __slots__ = ("fn", "disp", "E", "C", "frontier")
+
+    def __init__(self, n: int, max_dispatch: Optional[int] = None):
+        self.fn = _cyclic_fn(n)
+        self.E, self.C, self.frontier = n, 0, 1
+        self.disp = cycles_max_dispatch(n, 1, 0, max_dispatch)
+
+    def run_rows(self, mesh, arrays):
+        return _run_elle(self.fn, mesh, arrays[0], 1)
+
+    def settle_rows(self, rows, mat, n_live: int) -> None:
+        flags = np.asarray(mat[0])[:n_live]
+        for row, (sink, idx) in enumerate(rows):
+            sink[idx] = bool(flags[row])
+
+
+class ScreenPlan:
+    """Executor-conforming plan for the full transactional screen of
+    one (vertex bucket, filter profile): settle hands each row token's
+    sink a :class:`ScreenResult` keyed by the profile's masks."""
+
+    kernel = "cycles"
+    pad_fills = (0,)  # see CyclePlan.pad_fills
+    __slots__ = ("fn", "disp", "E", "C", "frontier", "masks", "nonadj")
+
+    def __init__(self, n: int, masks: Tuple[int, ...],
+                 nonadj: Tuple[Tuple[int, int], ...],
+                 max_dispatch: Optional[int] = None):
+        self.masks = tuple(masks)
+        self.nonadj = tuple(nonadj)
+        self.fn = _screen_fn(n, self.masks, self.nonadj)
+        self.E, self.C, self.frontier = n, 0, 1
+        self.disp = cycles_max_dispatch(
+            n, len(self.masks), len(self.nonadj), max_dispatch
+        )
+
+    def run_rows(self, mesh, arrays):
+        return _run_elle(self.fn, mesh, arrays[0], 2)
+
+    def settle_rows(self, rows, mat, n_live: int) -> None:
+        members = np.asarray(mat[0])[:n_live]
+        walks = np.asarray(mat[1])[:n_live]
+        for row, (sink, idx) in enumerate(rows):
+            sink[idx] = ScreenResult(
+                {m: members[row, f] for f, m in enumerate(self.masks)},
+                {q: walks[row, w] for w, q in enumerate(self.nonadj)},
+            )
+
+
+def _submit_elle_buckets(planned, window, executor):
+    """Dispatch planned elle buckets through the production engine:
+    largest estimated cost first (the same scheduling hook history
+    buckets use), bounded window, per-chip budget, mesh — then drain
+    and record the graphs-per-dispatch evidence."""
+    from .. import obs
+    from ..engine import execution, planning
+
+    ex = executor if executor is not None else execution.Executor(window)
+    planned.sort(key=planning.estimated_cost, reverse=True)
+    sub0 = ex.submitted
+    total_rows = 0
+    for pb in planned:
+        total_rows += len(pb.rows)
+        ex.submit(pb)
+    ex.drain()
+    n_disp = ex.submitted - sub0
+    if obs.enabled() and n_disp:
+        obs.registry().histogram(
+            "jepsen_elle_graphs_per_dispatch",
+            buckets=(1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0),
+        ).observe(total_rows / n_disp)
+
+
+def _np_has_cycle(adj: np.ndarray) -> bool:
+    """Host boolean-closure fallback for graphs past the dispatch
+    budget (the engine must never dispatch a shape it cannot cap)."""
+    r = adj.copy()
+    for _ in range(max(1, math.ceil(math.log2(max(2, r.shape[0]))))):
+        r |= r @ r
+    return bool(np.diagonal(r).any())
+
+
 def has_cycle_batch(
-    mats: Sequence[np.ndarray], window: Optional[int] = None
+    mats: Sequence[np.ndarray],
+    window: Optional[int] = None,
+    executor=None,
+    max_dispatch: Optional[int] = None,
 ) -> np.ndarray:
-    """Which of these adjacency matrices contain a cycle?  Matrices are
-    bucketed by padded size so one compile covers many shapes, and the
-    per-bucket dispatches ride the engine's bounded
-    :class:`~jepsen_tpu.engine.pipeline.DispatchWindow`: bucket *k+1*
-    packs on the host while bucket *k*'s closure computes, syncing only
-    when the window fills (``window=None`` takes the engine default;
-    1 = the old strictly serial dispatch-sync loop)."""
-    from ..engine import DispatchWindow
+    """Which of these adjacency matrices contain a cycle?  Matrices
+    bucket by padded size so one compile covers many shapes, and the
+    buckets dispatch through the production engine
+    :class:`~jepsen_tpu.engine.execution.Executor` — the bounded
+    window (``window=None`` takes the engine default; 1 = the old
+    strictly serial dispatch-sync loop), the per-chip
+    :func:`cycles_max_dispatch` row budget (a huge batch chunks
+    instead of exceeding the HBM bound the engine enforces for every
+    other kernel), and mesh sharding when a slice is resident.
+    ``executor=`` lets a resident owner (the serve daemon, smoke
+    checks) supply its own."""
+    from ..engine import planning
 
     out = np.zeros(len(mats), dtype=bool)
     by_bucket: dict = {}
+    order: List[int] = []
     for i, m in enumerate(mats):
-        by_bucket.setdefault(_bucket(m.shape[0]), []).append(i)
+        n = _bucket(max(1, m.shape[0]))
+        if n not in by_bucket:
+            by_bucket[n] = []
+            order.append(n)
+        by_bucket[n].append(i)
 
-    def settle(idxs, verdicts, _t):
-        for row, i in enumerate(idxs):
-            out[i] = bool(verdicts[row])
-
-    win = DispatchWindow(window, on_retire=settle)
-    for n, idxs in by_bucket.items():
-        batch = np.zeros((len(idxs), n, n), dtype=bool)
+    planned = []
+    for n in order:
+        idxs = by_bucket[n]
+        plan = CyclePlan(n, max_dispatch)
+        if plan.disp == 0:
+            # even one row of this vertex bucket busts the dispatch
+            # budget: decide on the host instead of crashing a worker
+            for i in idxs:
+                out[i] = _np_has_cycle(np.asarray(mats[i], dtype=bool))
+            continue
+        batch = np.zeros((len(idxs), n, n), dtype=np.uint8)
         for row, i in enumerate(idxs):
             m = mats[i]
-            batch[row, : m.shape[0], : m.shape[1]] = m
-        win.submit(
-            tuple(idxs),
-            lambda n=n, batch=batch: _closure_fn(n)(jnp.asarray(batch)),
-            attrs={"engine": "elle-screen", "rows": len(idxs)},
-        )
-    win.drain()
+            batch[row, : m.shape[0], : m.shape[1]] = np.asarray(
+                m, dtype=bool
+            ).astype(np.uint8)
+        rows = [(out, i) for i in idxs]
+        planned.append(planning.PlannedBucket(n, plan, (batch,), rows))
+    if planned:
+        _submit_elle_buckets(planned, window, executor)
     return out
+
+
+def screen_graphs(
+    encs: Sequence,
+    window: Optional[int] = None,
+    executor=None,
+    max_dispatch: Optional[int] = None,
+) -> List[Optional[ScreenResult]]:
+    """Run the full transactional screens for a batch of encoded
+    graphs (:class:`jepsen_tpu.elle.encode.EncodedGraph`): bucket by
+    (vertex bucket, canonical filter profile), stack each bucket into
+    one ``(B, n, n)`` relation batch, and dispatch through the engine
+    Executor.  Graphs whose profile exceeds the dispatch budget (cap
+    0) come back ``None`` — the caller keeps those on the CPU path."""
+    from ..elle import encode as encode_mod
+    from ..engine import planning
+
+    results: List[Optional[ScreenResult]] = [None] * len(encs)
+    buckets, order = encode_mod.bucket_graphs(encs)
+    planned = []
+    for key in order:
+        n, masks, nonadj = key
+        plan = ScreenPlan(n, masks, nonadj, max_dispatch)
+        if plan.disp == 0:
+            continue  # beyond the budget even one row at a time: CPU
+        idxs = buckets[key]
+        batch = encode_mod.stack_rel([encs[i] for i in idxs], n)
+        rows = [(results, i) for i in idxs]
+        planned.append(planning.PlannedBucket(key, plan, (batch,), rows))
+    if planned:
+        _submit_elle_buckets(planned, window, executor)
+    return results
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
 def _reach_fn(n: int):
-    rounds = max(1, math.ceil(math.log2(n)))
-
     @jax.jit
     def close(a):
-        r = a.astype(jnp.bfloat16)
-
-        def step(r, _):
-            return jnp.clip(r + jnp.matmul(r, r), 0.0, 1.0), None
-
-        r, _ = jax.lax.scan(step, r, None, length=rounds)
-        return r > 0.0
+        return _bool_closure(a)
 
     return close
 

@@ -116,18 +116,21 @@ def _mesh_key(mesh: Mesh) -> tuple:
     return (mesh.axis_names, tuple(d.id for d in mesh.devices.flat))
 
 
-def shard_fn(check_fn, mesh: Mesh):
+def shard_fn(check_fn, mesh: Mesh, n_in: int = 6, n_out: int = 3):
     """The ``shard_map``-wrapped, jitted variant of a compiled batched
-    checker: all six input arrays and all three outputs partition along
-    :data:`HIST_AXIS` (per-history work is embarrassingly parallel —
-    each device runs the unmodified kernel on its row shard, no
-    collectives).  Cached per (fn, mesh) on the fn object itself, the
-    same lifetime as the ``make_check_fn``/``make_dense_fn`` caches, so
-    repeat dispatches at a shape reuse ONE sharded executable — the
-    per-call-site-mesh + sharded-compiled-step-fn pattern (SNIPPETS
-    [2]–[3]).  Inputs' leading dim must be divisible by the mesh size
-    (callers pad with neutral rows; see the engine's shard padding)."""
-    key = _mesh_key(mesh)
+    kernel: all ``n_in`` input arrays and all ``n_out`` outputs
+    partition along :data:`HIST_AXIS` (per-row work is embarrassingly
+    parallel — each device runs the unmodified kernel on its row
+    shard, no collectives).  The defaults are the history checkers'
+    6-in/3-out contract; the Elle cycle screens ride the same wrapper
+    at 1-in/1- or 2-out.  Cached per (fn, mesh, arity) on the fn
+    object itself, the same lifetime as the
+    ``make_check_fn``/``make_dense_fn`` caches, so repeat dispatches
+    at a shape reuse ONE sharded executable — the per-call-site-mesh +
+    sharded-compiled-step-fn pattern (SNIPPETS [2]–[3]).  Inputs'
+    leading dim must be divisible by the mesh size (callers pad with
+    neutral rows; see the engine's shard padding)."""
+    key = (_mesh_key(mesh), n_in, n_out)
     with _shard_lock:
         cache = getattr(check_fn, "_sharded_variants", None)
         if cache is None:
@@ -146,7 +149,7 @@ def shard_fn(check_fn, mesh: Mesh):
     wrapped = jax.jit(
         shard_map(
             check_fn, mesh=mesh,
-            in_specs=(spec,) * 6, out_specs=(spec, spec, spec),
+            in_specs=(spec,) * n_in, out_specs=(spec,) * n_out,
             check_rep=False,
         )
     )
@@ -185,6 +188,20 @@ def sharded_check(
     sharded = shard_batch(mesh, *arrays)
     ok, failed_at, overflow = shard_fn(check_fn, mesh)(*sharded)
     return ok[:b], failed_at[:b], overflow[:b]
+
+
+def sharded_elle(fn, mesh: Mesh, rel: np.ndarray, n_out: int):
+    """Run an Elle cycle-screen kernel (one ``(B, n, n)`` relation
+    input, ``n_out`` tuple outputs — see ``ops.cycles``) sharded over
+    the mesh via :func:`shard_fn`.  Padding rows are all-zero
+    relation matrices: edge-free, hence acyclic, hence neutral — the
+    caller (the engine executor) slices live rows back at settle."""
+    n = mesh.devices.size
+    b = rel.shape[0]
+    rel = pad_to_multiple(np.asarray(rel), n, 0)
+    (sharded,) = shard_batch(mesh, rel)
+    outs = shard_fn(fn, mesh, n_in=1, n_out=n_out)(sharded)
+    return tuple(o[:b] for o in outs)
 
 
 def verdict_stats(ok: jnp.ndarray, overflow: jnp.ndarray, mesh: Optional[Mesh] = None):

@@ -145,6 +145,28 @@ class ServiceClient:
             raise ServiceError(f"shutdown returned {code}")
         return protocol.decode_body(body)
 
+    def screen_graphs(self, encs) -> list:
+        """Screen encoded dependency graphs on the daemon (``POST
+        /elle``); same ScreenResult shapes the in-process
+        ``ops.cycles.screen_graphs`` returns.  Raises like
+        :meth:`check_batch` — the caller decides whether to fall
+        back."""
+        body = protocol.elle_request(encs)
+        code, resp = self._request("/elle", body=body)
+        payload = protocol.decode_body(resp)
+        if code == 503:
+            raise ServiceError(
+                f"daemon backlogged: {payload.get('error')}")
+        if code != 200:
+            raise ServiceError(
+                f"/elle returned {code}: {payload.get('error')}")
+        results = payload["results"]
+        if len(results) != len(encs):
+            raise ServiceError(
+                f"result count {len(results)} != batch {len(encs)}")
+        self.last_diag = payload.get("diag") or {}
+        return protocol.elle_results_from_wire(results, encs)
+
     def check_batch(self, model, histories, **opts) -> List[dict]:
         """Check a batch on the daemon; raises
         :class:`~jepsen_tpu.serve.protocol.UnsupportedModel` (no wire
@@ -305,6 +327,26 @@ def check_batch(model, histories, *, client: Optional[ServiceClient] = None,
 def analysis(model, history, **kw) -> dict:
     """Single-history :func:`check_batch` (the checker-seam shape)."""
     return check_batch(model, [history], **kw)[0]
+
+
+def screen_graphs(encs, *, client: Optional[ServiceClient] = None,
+                  auto_start: Optional[bool] = None) -> Optional[list]:
+    """The Elle screens' transparent service seam: screen on a
+    reachable daemon (coalescing with concurrent runs' graphs on its
+    resident executor), or return ``None`` so the caller runs the
+    in-process engine path.  Like the batched-linearizable seam this
+    is opt-in by default: with ``JEPSEN_TPU_SERVICE`` off and no
+    explicit client, a stray listener never takes the traffic."""
+    if client is None:
+        if service_mode() == "off":
+            return None
+        client = resolve_client(auto_start)
+    if client is None:
+        return None
+    try:
+        return client.screen_graphs(encs)
+    except (ServiceError, ServiceUnavailable):
+        return None  # transparent in-process fallback
 
 
 def ServiceChecker(model, pure_fs=("read",), oracle_budget_s=None):

@@ -95,6 +95,41 @@ class _Stream:
         self.order = order
 
 
+class _NoOracles:
+    """Oracle-interface stub for requests that never submit oracle
+    work (the Elle screen requests): the batch-failure and abandon
+    paths can treat every queued request uniformly."""
+
+    def abandon_oracles(self) -> int:
+        return 0
+
+
+_NO_ORACLES = _NoOracles()
+
+
+class _ElleRequest:
+    """One admitted /elle screen batch: encoded relation-bit graphs
+    whose (vertex bucket, filter profile) buckets COALESCE ACROSS
+    REQUESTS inside ``ops.cycles.screen_graphs`` — Elle traffic from
+    concurrent runs shares dispatch rows through the resident
+    executor exactly like history buckets do."""
+
+    kind = "elle"
+    __slots__ = ("graphs", "rows", "n", "t_admitted", "device_done",
+                 "error", "diag", "abandoned", "results", "run")
+
+    def __init__(self, graphs):
+        self.graphs = graphs
+        self.rows = self.n = len(graphs)
+        self.t_admitted = time.perf_counter()
+        self.device_done = threading.Event()
+        self.error: Optional[str] = None
+        self.diag: dict = {}
+        self.abandoned = False
+        self.results: Optional[list] = None
+        self.run = _NO_ORACLES
+
+
 class _Request:
     """One admitted /check batch, in flight between a handler thread
     and the device thread.  Handler-side state is written before the
@@ -104,6 +139,7 @@ class _Request:
     result routing, oracle hand-off, and the AND-at-settle merge all
     live there; ``streams`` carry its encoded buckets."""
 
+    kind = "check"
     __slots__ = ("run", "streams", "group_key", "model",
                  "plan_opts", "exec_opts", "n", "rows", "t_admitted",
                  "device_done", "error", "diag", "abandoned")
@@ -190,6 +226,7 @@ class CheckerDaemon:
             "requests": 0, "histories": 0, "rejected": 0,
             "coalesced": 0, "batches": 0, "warm_dispatches": 0,
             "cold_dispatches": 0, "errors": 0,
+            "elle_requests": 0, "elle_graphs": 0,
         }
         self._platform: Optional[str] = None
         self._fatal: Optional[str] = None
@@ -232,10 +269,18 @@ class CheckerDaemon:
                 return False
             self._queue.append(req)
             self._queued_rows += req.rows
-            self.stats["requests"] += 1
-            self.stats["histories"] += req.n
-            obs.count("jepsen_serve_requests_total")
-            obs.count("jepsen_serve_histories_total", req.n)
+            if req.kind == "elle":
+                # graphs are not histories: the /check throughput
+                # accounting must not inflate from screen traffic
+                self.stats["elle_requests"] += 1
+                self.stats["elle_graphs"] += req.n
+                obs.count("jepsen_serve_elle_requests_total")
+                obs.count("jepsen_serve_elle_graphs_total", req.n)
+            else:
+                self.stats["requests"] += 1
+                self.stats["histories"] += req.n
+                obs.count("jepsen_serve_requests_total")
+                obs.count("jepsen_serve_histories_total", req.n)
             obs.gauge_set("jepsen_serve_queue_depth", len(self._queue))
             self._wake.notify()
             return True
@@ -334,6 +379,7 @@ class CheckerDaemon:
             self.stats["batches"] += 1
         groups: Dict[Tuple, List[_Request]] = {}
         group_order: List[Tuple] = []
+        elle_reqs: List[_ElleRequest] = []
         for req in batch:
             if req.abandoned:
                 # handler gave up (timeout): skip its work and cancel
@@ -341,15 +387,37 @@ class CheckerDaemon:
                 # safe here, the device thread is the run's only owner
                 req.run.abandon_oracles()
                 continue
+            if isinstance(req, _ElleRequest):
+                elle_reqs.append(req)
+                continue
             if req.group_key not in groups:
                 groups[req.group_key] = []
                 group_order.append(req.group_key)
             groups[req.group_key].append(req)
         with obs.span("serve/batch", cat="serve", requests=len(batch),
-                      groups=len(group_order)):
+                      groups=len(group_order) + bool(elle_reqs)):
+            if elle_reqs:
+                self._process_elle(executor, elle_reqs)
+                for req in elle_reqs:
+                    req.device_done.set()
+            # plan every group first (pure host work), then dispatch
+            # groups largest summed-estimated-cost first: a group's
+            # cost is the SUM over its planned buckets' rows — so a
+            # high-fanout decomposed request, whose parent cost lives
+            # spread across many per-partition sub-buckets, schedules
+            # by its real total instead of arrival order (the ROADMAP
+            # items 3+4 partition-aware scheduling leftover).  The
+            # stable sort keeps arrival order on ties.
+            planned = {
+                gkey: self._plan_group(groups[gkey]) for gkey in group_order
+            }
+            group_order.sort(
+                key=lambda k: sum(self.cost_fn(pb) for pb in planned[k][0]),
+                reverse=True,
+            )
             for gkey in group_order:
                 reqs = groups[gkey]
-                self._process_group(executor, reqs)
+                self._dispatch_group(executor, reqs, *planned[gkey])
                 for req in reqs:
                     if req.abandoned:
                         # handler timed out while this group ran: no
@@ -359,37 +427,37 @@ class CheckerDaemon:
                         req.run.abandon_oracles()
                     req.device_done.set()
 
-    def _process_group(self, executor, reqs: List[_Request]) -> None:
-        first = reqs[0]
+    def _process_elle(self, executor, reqs: List[_ElleRequest]) -> None:
+        """The Elle screen arm of a device batch: graphs from every
+        queued /elle request screen through ONE ``screen_graphs`` pass
+        over the resident executor, so same-(bucket, profile) buckets
+        coalesce across runs into shared dispatches."""
+        from ..ops import cycles as ops_cycles
+
         if len(reqs) > 1:
-            # counted per COMPATIBLE group, not per backlog pop:
-            # requests that merely shared a device batch but sat in
-            # different groups (different model/opts) shared zero
-            # dispatch rows and must not inflate the coalescing
-            # evidence the serve-smoke gate keys on
-            with self._wake:
-                self.stats["coalesced"] += len(reqs)
-            obs.count("jepsen_serve_coalesced_requests_total", len(reqs))
-        # the resident executor adopts this group's execution policy;
-        # groups run strictly one after another (with a drain between),
-        # so the mutation never races a dispatch
-        executor.escalation = first.exec_opts["escalation"]
-        executor.sufficient_rung = first.exec_opts["sufficient_rung"]
-        executor.max_dispatch = first.exec_opts["max_dispatch"]
-        pc0 = dict(executor.phase_counts)
-        # merge per STREAM TAG: a decomposed request carries a "main"
-        # (pass-through, wire-model spec) and a "sub" (per-partition
-        # sub-model spec) stream, and only same-spec buckets may stack
-        # — but within a tag, buckets coalesce across every run in the
-        # group, so concurrent decomposed requests share dispatch rows
-        # exactly like whole histories do.  Then dispatch EVERY planned
-        # bucket largest-estimated-cost first across both streams: big
-        # buckets keep the window occupied while small ones fill the
-        # tail (ROADMAP item 4's scheduling direction).  The cost fn is
-        # the daemon's pluggable seam for a learned per-shape model
-        # (planning.estimated_cost docs); verdicts are
-        # order-independent by the engine contract, so reordering is
-        # purely a throughput decision.
+            obs.count("jepsen_serve_elle_coalesced_total", len(reqs))
+        encs = [g for req in reqs for g in req.graphs]
+        results = ops_cycles.screen_graphs(encs, executor=executor)
+        lo = 0
+        for req in reqs:
+            req.results = results[lo:lo + req.n]
+            req.diag = {
+                "coalesced_with": len(reqs) - 1,
+                "graphs": req.n,
+                "queue_wait_s": round(
+                    time.perf_counter() - req.t_admitted, 4),
+            }
+            lo += req.n
+
+    def _plan_group(self, reqs: List[_Request]):
+        """The pure planning half of one compatible group: merge per
+        STREAM TAG — a decomposed request carries a "main"
+        (pass-through, wire-model spec) and a "sub" (per-partition
+        sub-model spec) stream, and only same-spec buckets may stack —
+        but within a tag, buckets coalesce across every run in the
+        group, so concurrent decomposed requests share dispatch rows
+        exactly like whole histories do."""
+        first = reqs[0]
         tags: List[str] = []
         for req in reqs:
             for st in req.streams:
@@ -414,6 +482,34 @@ class CheckerDaemon:
                 pb = planner.plan_rows(key, encs, tokens)
                 if pb is not None:
                     planned.append(pb)
+        return planned, n_buckets
+
+    def _dispatch_group(self, executor, reqs: List[_Request],
+                        planned: list, n_buckets: int) -> None:
+        first = reqs[0]
+        if len(reqs) > 1:
+            # counted per COMPATIBLE group, not per backlog pop:
+            # requests that merely shared a device batch but sat in
+            # different groups (different model/opts) shared zero
+            # dispatch rows and must not inflate the coalescing
+            # evidence the serve-smoke gate keys on
+            with self._wake:
+                self.stats["coalesced"] += len(reqs)
+            obs.count("jepsen_serve_coalesced_requests_total", len(reqs))
+        # the resident executor adopts this group's execution policy;
+        # groups run strictly one after another (with a drain between),
+        # so the mutation never races a dispatch
+        executor.escalation = first.exec_opts["escalation"]
+        executor.sufficient_rung = first.exec_opts["sufficient_rung"]
+        executor.max_dispatch = first.exec_opts["max_dispatch"]
+        pc0 = dict(executor.phase_counts)
+        # dispatch EVERY planned bucket largest-estimated-cost first
+        # across both streams: big buckets keep the window occupied
+        # while small ones fill the tail (ROADMAP item 4's scheduling
+        # direction).  The cost fn is the daemon's pluggable seam for
+        # a learned per-shape model (planning.estimated_cost docs);
+        # verdicts are order-independent by the engine contract, so
+        # reordering is purely a throughput decision.
         planned.sort(key=self.cost_fn, reverse=True)
         for pb in planned:
             executor.submit(pb)
@@ -646,6 +742,42 @@ class CheckerDaemon:
             "diag": req.diag,
         }
 
+    # -- the /elle entry (handler threads) -----------------------------------
+
+    def handle_elle(self, body: bytes) -> Tuple[int, dict]:
+        """Screen a batch of encoded dependency graphs (the Elle
+        transactional screens) on the resident executor; concurrent
+        /elle batches coalesce same-(bucket, profile) graphs into
+        shared dispatches (see :meth:`_process_elle`)."""
+        if self._fatal is not None:
+            return 500, {"error": f"device thread failed: {self._fatal}"}
+        try:
+            payload = protocol.decode_body(body)
+            graphs = protocol.elle_graphs_from_wire(payload["graphs"])
+        except Exception as e:  # noqa: BLE001 — malformed client input
+            return 400, {"error": f"bad request: {e!r}"}
+        req = _ElleRequest(graphs)
+        if not self.admit(req):
+            with self._wake:
+                depth = len(self._queue)
+            return 503, {
+                "error": "backlogged",
+                "queue_depth": depth,
+                "stopping": self._stopping.is_set(),
+            }
+        if not req.device_done.wait(
+            _env_float("JEPSEN_TPU_SERVE_REQUEST_TIMEOUT",
+                       DEFAULT_REQUEST_TIMEOUT_S)
+        ):
+            req.abandoned = True
+            return 500, {"error": "device thread timed out"}
+        if req.error is not None:
+            return 500, {"error": req.error}
+        return 200, {
+            "results": protocol.elle_results_to_wire(req.results or []),
+            "diag": req.diag,
+        }
+
 
 def _make_handler(daemon: CheckerDaemon):
     class Handler(BaseHTTPRequestHandler):
@@ -691,6 +823,9 @@ def _make_handler(daemon: CheckerDaemon):
                 body = self.rfile.read(n) if n else b""
                 if self.path == "/check":
                     code, payload = daemon.handle_check(body)
+                    self._reply_json(code, payload)
+                elif self.path == "/elle":
+                    code, payload = daemon.handle_elle(body)
                     self._reply_json(code, payload)
                 elif self.path == "/shutdown":
                     self._reply_json(200, daemon.request_shutdown())

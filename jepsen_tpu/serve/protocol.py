@@ -207,6 +207,92 @@ def decode_body(data: bytes) -> Any:
     return codec.decode(data)
 
 
+class WireGraph:
+    """Server-side view of one encoded screen graph: the same duck
+    shape ``ops.cycles.screen_graphs`` consumes
+    (:class:`jepsen_tpu.elle.encode.EncodedGraph` client-side)."""
+
+    __slots__ = ("rel", "n", "masks", "nonadj")
+
+    def __init__(self, rel, masks, nonadj):
+        import numpy as np
+
+        self.rel = np.asarray(rel, dtype=np.uint8)
+        self.n = self.rel.shape[0]
+        self.masks = tuple(int(m) for m in masks)
+        self.nonadj = tuple((int(w), int(r)) for w, r in nonadj)
+
+
+def elle_request(encs) -> bytes:
+    """Build a ``POST /elle`` body from encoded graphs
+    (:class:`jepsen_tpu.elle.encode.EncodedGraph`): per graph the
+    uint8 relation-bit matrix plus its canonical filter profile."""
+    return encode_body({
+        "graphs": [
+            {
+                "rel": [[int(x) for x in row] for row in enc.rel],
+                "masks": list(enc.masks),
+                "nonadj": [list(p) for p in enc.nonadj],
+            }
+            for enc in encs
+        ],
+    })
+
+
+def elle_graphs_from_wire(items) -> List[WireGraph]:
+    return [
+        WireGraph(g["rel"], g.get("masks") or (),
+                  g.get("nonadj") or ())
+        for g in items
+    ]
+
+
+def elle_results_to_wire(results) -> list:
+    """Per-graph screen masks as JSON: members/walks aligned with the
+    request's canonical (sorted) masks/nonadj tuples; ``None`` (graph
+    past the dispatch budget) crosses as null so the client keeps that
+    graph on its CPU path."""
+    out = []
+    for r in results:
+        if r is None:
+            out.append(None)
+            continue
+        out.append({
+            "members": [
+                [int(b) for b in r.members[m]] for m in sorted(r.members)
+            ],
+            "walks": [
+                [int(b) for b in r.walks[q]] for q in sorted(r.walks)
+            ],
+        })
+    return out
+
+
+def elle_results_from_wire(items, encs) -> list:
+    """Client-side inverse of :func:`elle_results_to_wire`, re-keyed
+    by each graph's own canonical masks (the wire order IS the sorted
+    tuple order both sides computed independently)."""
+    import numpy as np
+
+    from ..ops.cycles import ScreenResult
+
+    out = []
+    for enc, item in zip(encs, items):
+        if item is None:
+            out.append(None)
+            continue
+        members = {
+            m: np.asarray(row, dtype=bool)
+            for m, row in zip(sorted(enc.masks), item["members"])
+        }
+        walks = {
+            q: np.asarray(row, dtype=bool)
+            for q, row in zip(sorted(enc.nonadj), item["walks"])
+        }
+        out.append(ScreenResult(members, walks))
+    return out
+
+
 def check_request(model, histories, opts: Optional[Dict[str, Any]] = None
                   ) -> bytes:
     """Build a ``POST /check`` body; raises :class:`UnsupportedModel`

@@ -197,6 +197,10 @@ def _proxy(kernel: str, E: int, C: int, F: int) -> float:
     measured neighbor onto an unmeasured shape."""
     if kernel == "dense":
         return float(max(E, 1))
+    if kernel == "cycles":
+        # the Elle screens' boolean matrix closure: E is the vertex
+        # bucket, per-row work scales with the E×E matrix
+        return float(max(E, 1)) * max(E, 1)
     words = max(1, -(-max(E, 1) // 32))
     return float(max(F, 1) * (max(C, 0) + 1) * words)
 

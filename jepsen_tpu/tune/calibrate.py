@@ -62,13 +62,13 @@ PROFILES: Dict[str, Dict[str, Any]] = {
         n_hists=32, n_ops=160, n_procs=3, reps=2, passes=2,
         windows=(1, 2, 4, 8), unions=("unroll", "gather"),
         flush_rows=(4096, 16384, 65536), row_buckets=(32, 64, 128),
-        cost_rows=(32, 128), budget_s=100.0,
+        cost_rows=(32, 128), screen_ns=(16, 64), budget_s=100.0,
     ),
     "smoke": dict(
         n_hists=10, n_ops=12, n_procs=3, reps=1, passes=1,
         windows=(1, 4), unions=("unroll", "gather"),
         flush_rows=(16384,), row_buckets=(64,),
-        cost_rows=(8,), budget_s=30.0,
+        cost_rows=(8,), screen_ns=(16,), budget_s=30.0,
     ),
 }
 
@@ -355,6 +355,41 @@ def measure_cost_table(runner: _Runner, corpora, profile: Dict[str, Any],
                             "seconds": round(secs, 6),
                             "corpus": name,
                         })
+    # the Elle transactional screens: (kernel="cycles", E=n, C=0, F=1)
+    # rows, so the measured table ranks screen buckets in the same
+    # seconds unit as history buckets (the daemon's largest-cost-first
+    # ordering compares them directly).  Deterministic ring/chain
+    # relation matrices at the canonical no-suffix filter profile.
+    from ..ops import cycles as ops_cycles
+
+    masks, nonadj = (1, 3, 7), ((4, 3),)
+    for n in profile.get("screen_ns", ()):
+        plan = ops_cycles.ScreenPlan(n, masks, nonadj)
+        if plan.disp == 0:
+            continue
+        for rows in profile["cost_rows"]:
+            if not proposal_within_budget(plan, rows, params["window"]):
+                obs.count("jepsen_tune_budget_rejections_total")
+                continue
+            rel = np.zeros((rows, n, n), np.uint8)
+            for b in range(rows):
+                for i in range(n - 1):
+                    rel[b, i, i + 1] = (1, 2, 4)[(b + i) % 3]
+                if b % 2 == 0:
+                    rel[b, n - 1, 0] = 1  # close into a ring
+            args = jnp.asarray(rel)
+            out = plan.fn(args)  # warmup: trace + compile
+            out[0].block_until_ready()
+            t0 = time.perf_counter()
+            out = plan.fn(args)
+            out[0].block_until_ready()
+            secs = time.perf_counter() - t0
+            obs.count("jepsen_tune_measurements_total", phase="cost")
+            entries.append({
+                "kernel": "cycles", "E": n, "C": 0, "F": 1,
+                "rows": rows, "seconds": round(secs, 6),
+                "corpus": "elle-screen",
+            })
     # one point per (kernel, E, C, F, rows): keep the fastest (least
     # noisy) observation when corpora overlap in shape
     best: Dict[tuple, dict] = {}

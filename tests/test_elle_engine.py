@@ -1,0 +1,504 @@
+"""Engine-routed transactional checking (the Elle screens).
+
+Pins the PR's contracts:
+
+- op-soup fuzz (>500 cases): device-screened ``classify`` /
+  ``consistency`` verdicts byte-identical to the pure-CPU path across
+  list-append and rw-register workloads, cyclic and acyclic, all
+  relation filters (plain, process, realtime);
+- ``has_cycle_batch`` respects the calibrated row budget (the engine's
+  per-chip cap — it historically had none);
+- screen buckets ride the production Executor (window, chunking,
+  accounting) and rank through ``planning.estimated_cost`` /
+  the tune cost table;
+- partition-aware cost scheduling: global largest-cost-first at
+  pipeline finish and across daemon groups;
+- the ``/elle`` service seam round-trips screens byte-identically.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import elle
+from jepsen_tpu.elle import cycles as elle_cycles
+from jepsen_tpu.elle import encode as elle_encode
+from jepsen_tpu.elle.graph import Graph
+from jepsen_tpu.engine import execution, planning
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.ops import cycles as ops_cycles
+
+
+# ---------------------------------------------------------------------------
+# corpus generation: deterministic op soup with seeded corruption
+# ---------------------------------------------------------------------------
+
+
+def _soup_history(rng: random.Random, mode: str, n_txns: int,
+                  n_keys: int, corrupt: bool) -> History:
+    """A transaction history against a serializable in-memory store,
+    with seeded corruptions (stale/duplicated/truncated reads, failed
+    writers whose values leak) and an occasional injected committed
+    wr-dependency cycle — the op-soup style that validated the direct
+    checkers."""
+    lists = {k: [] for k in range(n_keys)}
+    regs = {k: None for k in range(n_keys)}
+    next_val = [1]
+    dicts = []
+    t = [0]
+
+    def emit(process, txn, typ="ok"):
+        dicts.append({"process": process, "type": "invoke", "f": "txn",
+                      "value": [[f, k, None if f == "r" else v]
+                                for f, k, v in txn],
+                      "time": t[0]})
+        t[0] += 5
+        dicts.append({"process": process, "type": typ, "f": "txn",
+                      "value": txn, "time": t[0]})
+        t[0] += 5
+
+    for i in range(n_txns):
+        p = rng.randrange(4)
+        txn = []
+        failed = corrupt and rng.random() < 0.08
+        for _m in range(rng.randrange(1, 4)):
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.5:
+                v = next_val[0]
+                next_val[0] += 1
+                if mode == "list-append":
+                    txn.append(["append", k, v])
+                    if not failed:
+                        lists[k] = lists[k] + [v]
+                else:
+                    txn.append(["w", k, v])
+                    if not failed:
+                        regs[k] = v
+            else:
+                if mode == "list-append":
+                    v = list(lists[k])
+                    if corrupt and v and rng.random() < 0.25:
+                        mut = rng.random()
+                        if mut < 0.3:
+                            v = v[:-1]  # truncated (intermediate) read
+                        elif mut < 0.6:
+                            v = v + [v[-1]]  # duplicate element
+                        else:
+                            v = list(reversed(v))  # incompatible order
+                    txn.append(["r", k, v])
+                else:
+                    v = regs[k]
+                    if corrupt and rng.random() < 0.25:
+                        v = rng.randrange(1, max(2, next_val[0]))  # stale
+                    txn.append(["r", k, v])
+        emit(p, txn, "fail" if failed else "ok")
+
+    if corrupt and rng.random() < 0.35:
+        # guaranteed committed dependency cycle on fresh keys (G1c)
+        kx, ky = n_keys, n_keys + 1
+        if mode == "list-append":
+            t1 = [["append", kx, 1], ["r", ky, [2]]]
+            t2 = [["append", ky, 2], ["r", kx, [1]]]
+        else:
+            t1 = [["w", kx, 1], ["r", ky, 2]]
+            t2 = [["w", ky, 2], ["r", kx, 1]]
+        emit(91, t1)
+        emit(92, t2)
+    return History([Op.from_dict(d) for d in dicts]).index_ops()
+
+
+_MODEL_SETS = (
+    ["serializable"],
+    ["snapshot-isolation"],
+    ["read-committed"],
+    ["strict-serializable"],  # realtime graphs → suffixed filters
+    ["sequential"],           # process graphs → suffixed filters
+)
+
+
+def _dumps(x):
+    return json.dumps(x, sort_keys=True, default=repr)
+
+
+def test_op_soup_fuzz_screened_byte_identical():
+    """≥500 fuzz cases: device-screened classify/consistency verdicts
+    byte-identical to the pure-CPU path across both workloads, cyclic
+    and acyclic corpora, and every relation-filter family."""
+    rng = random.Random(45100)
+    cases = 0
+    mismatches = []
+    for mode in ("list-append", "rw-register"):
+        hists = [
+            _soup_history(rng, mode, rng.randrange(3, 14), 3,
+                          corrupt=(i % 2 == 0))
+            for i in range(52)
+        ]
+        for models in _MODEL_SETS:
+            opts = {"workload": mode, "consistency-models": models}
+            cpu = elle.check_batch({**opts, "screen-route": "cpu"}, hists)
+            dev = elle.check_batch({**opts, "screen-route": "device"},
+                                   hists)
+            cases += len(hists)
+            for h_i, (a, b) in enumerate(zip(cpu, dev)):
+                if _dumps(a) != _dumps(b):
+                    mismatches.append((mode, models[0], h_i))
+        # sanity: the corpus genuinely mixes verdicts
+        base = elle.check_batch(
+            {"workload": mode, "consistency-models": ["serializable"],
+             "screen-route": "cpu"}, hists,
+        )
+        verdicts = {r["valid?"] for r in base}
+        assert True in verdicts and (False in verdicts
+                                     or "unknown" in verdicts), verdicts
+    assert cases >= 500, cases
+    assert not mismatches, mismatches[:5]
+
+
+def test_check_batch_matches_per_history_check():
+    rng = random.Random(7)
+    hists = [_soup_history(rng, "rw-register", 6, 2, corrupt=True)
+             for _ in range(6)]
+    opts = {"workload": "rw-register",
+            "consistency-models": ["serializable"]}
+    batch = elle.check_batch({**opts, "screen-route": "cpu"}, hists)
+    single = [elle.check({**opts, "screen-route": "cpu"}, h)
+              for h in hists]
+    assert _dumps(batch) == _dumps(single)
+
+
+# ---------------------------------------------------------------------------
+# budget + engine routing
+# ---------------------------------------------------------------------------
+
+
+def _ring_mats(count, n):
+    mats = []
+    for i in range(count):
+        a = np.zeros((n, n), bool)
+        for j in range(n - 1):
+            a[j, j + 1] = True
+        if i % 2 == 0:
+            a[n - 1, 0] = True
+        mats.append(a)
+    return mats
+
+
+def test_has_cycle_batch_respects_row_budget(monkeypatch):
+    """The calibrated-row-budget regression: a batch far beyond the
+    per-dispatch cap must chunk through the executor with per-chip
+    in-flight rows never exceeding the cap — has_cycle_batch
+    historically dispatched everything in one unbounded shot."""
+    monkeypatch.setattr(ops_cycles, "CYCLES_DISPATCH_BUDGET", 4096)
+    n = 16  # per_row = 16*16*2 = 512 words → cap 8
+    assert ops_cycles.cycles_max_dispatch(n) == 8
+    mats = _ring_mats(30, n - 3)
+    ex = execution.Executor(1, mesh=None)
+    got = ops_cycles.has_cycle_batch(mats, executor=ex)
+    assert list(got) == [i % 2 == 0 for i in range(30)]
+    assert ex.submitted == 4  # ceil(30 / 8) chunks
+    for acct in ex.chip_row_accounting.values():
+        assert acct["peak_chip_rows"] <= 8, acct
+    # windowed: frontier-style 1/W split keeps total in flight ≤ cap
+    monkeypatch.setattr(ops_cycles, "CYCLES_DISPATCH_BUDGET", 4096)
+    ex4 = execution.Executor(4, mesh=None)
+    got = ops_cycles.has_cycle_batch(mats, executor=ex4)
+    assert list(got) == [i % 2 == 0 for i in range(30)]
+    for acct in ex4.chip_row_accounting.values():
+        assert acct["peak_chip_rows"] <= 8, acct
+
+
+def test_has_cycle_batch_over_budget_falls_to_host(monkeypatch):
+    monkeypatch.setattr(ops_cycles, "CYCLES_DISPATCH_BUDGET", 100)
+    mats = _ring_mats(4, 12)  # cap 0 at every bucket
+    assert ops_cycles.cycles_max_dispatch(16) == 0
+    got = ops_cycles.has_cycle_batch(mats)
+    assert list(got) == [True, False, True, False]
+
+
+def test_screen_plan_budget_and_cost_ranking():
+    small = ops_cycles.ScreenPlan(16, (1, 3, 7), ((4, 3),))
+    big = ops_cycles.ScreenPlan(64, (1, 3, 7), ((4, 3),))
+    assert small.disp > big.disp > 0
+    rows = [(None, i) for i in range(8)]
+    pb_small = planning.PlannedBucket(None, small, None, rows)
+    pb_big = planning.PlannedBucket(None, big, None, rows)
+    assert planning.estimated_cost(pb_big) > planning.estimated_cost(
+        pb_small
+    )
+
+
+def test_calibration_cost_table_serves_cycles_rows(tmp_path):
+    """A calibration artifact with (kernel="cycles", n, B) rows drives
+    estimated_cost for screen buckets — measured seconds, not the
+    analytic proxy — and cross-kernel scaling uses the cycles
+    footprint."""
+    from jepsen_tpu.tune import artifact
+
+    data = artifact.build_artifact(
+        {"window": 4, "flush_rows": 16384, "row_bucket": 64,
+         "union_mode": "unroll"},
+        [{"kernel": "cycles", "E": 16, "C": 0, "F": 1, "rows": 8,
+          "seconds": 0.004},
+         {"kernel": "cycles", "E": 16, "C": 0, "F": 1, "rows": 32,
+          "seconds": 0.01}],
+        "cpu", 1, created_at="2026-08-04T00:00:00+00:00",
+    )
+    cal = artifact.Calibration(data)
+    assert cal.cost("cycles", 16, 0, 1, 8) == pytest.approx(0.004)
+    assert cal.cost("cycles", 16, 0, 1, 20) == pytest.approx(
+        0.004 + (0.01 - 0.004) * 12 / 24
+    )
+    # unmeasured shape scales the measured neighbor by the E² proxy
+    assert cal.cost("cycles", 32, 0, 1, 8) == pytest.approx(
+        0.004 * (32 * 32) / (16 * 16)
+    )
+    artifact.set_active(cal)
+    try:
+        plan = ops_cycles.ScreenPlan(16, (1, 3, 7), ((4, 3),))
+        pb = planning.PlannedBucket(None, plan, None,
+                                    [(None, i) for i in range(8)])
+        assert planning.estimated_cost(pb) == pytest.approx(0.004)
+    finally:
+        artifact.set_active(None)
+
+
+def test_tune_cost_table_measures_cycles(tmp_path):
+    """The offline sweep's cost table gains (kernel="cycles", n, B)
+    rows with the budget guardrail applied."""
+    from jepsen_tpu.tune import calibrate
+
+    runner = calibrate._Runner()
+    prof = dict(calibrate.PROFILES["smoke"])
+    corpora = {}  # the cycles arm needs no history corpus
+    params = {"window": 4, "flush_rows": 16384, "row_bucket": 64,
+              "union_mode": "unroll"}
+    entries = calibrate.measure_cost_table(runner, corpora, prof, params)
+    cyc = [e for e in entries if e["kernel"] == "cycles"]
+    assert cyc, entries
+    assert all(e["C"] == 0 and e["F"] == 1 and e["seconds"] >= 0
+               for e in cyc)
+
+
+# ---------------------------------------------------------------------------
+# screens: canonicalization + router calibration
+# ---------------------------------------------------------------------------
+
+
+def _rw_chain(n, cyc=False):
+    g = Graph()
+    for i in range(n - 1):
+        g.add_edge(i, i + 1, "ww")
+    if cyc:
+        g.add_edge(n - 1, 0, "rw")
+    else:
+        g.add_vertex(n - 1)
+    return g
+
+
+def test_graph_screen_canonicalizes_absent_relations():
+    g = _rw_chain(6, cyc=True)  # ww path closed by one rw edge
+    enc = elle_encode.encode_graph(g)
+    # present bits are ww|rw only: every ladder mask (including the
+    # process/realtime-suffixed ones) canonicalizes onto them, so no
+    # wr or lifted-PR closure is ever built for this graph
+    assert enc.present == 5
+    assert enc.masks == (1, 5)
+    assert enc.nonadj == ((4, 1),)
+    (res,) = ops_cycles.screen_graphs([enc])
+    s = elle_cycles.GraphScreen(enc, res)
+    full = s.members(elle_encode.ALL_MASK)
+    assert full == set(range(6))
+    # suffixed-ladder query (ww|PR) answers from the plain ww closure
+    assert s.members(elle_encode.WW_BIT | elle_encode.PR_MASK) == \
+        frozenset()
+    # nonadjacent walks start at the vertex carrying the rw edge
+    assert s.nonadj(elle_encode.RW_BIT,
+                    elle_encode.WW_BIT | elle_encode.WR_BIT
+                    | elle_encode.PR_MASK) == {5}
+    # a graph with no rw edges answers every nonadjacent query empty
+    g2 = _rw_chain(4, cyc=False)
+    enc2 = elle_encode.encode_graph(g2)
+    assert enc2.nonadj == ()
+    (res2,) = ops_cycles.screen_graphs([enc2])
+    s2 = elle_cycles.GraphScreen(enc2, res2)
+    assert s2.nonadj(elle_encode.RW_BIT, 3) == frozenset()
+
+
+def test_classify_graphs_auto_calibrates_and_pins_cpu_on_mismatch(
+    monkeypatch,
+):
+    graphs = [_rw_chain(9, i % 2 == 0) for i in range(20)]
+    expected = [elle_cycles.classify(g) for g in graphs]
+
+    monkeypatch.setattr(elle_cycles, "_CLASSIFY_CHOICE", {})
+    out = elle_cycles.classify_graphs(graphs)
+    assert out == expected
+    key = (elle_cycles._screen_bucket(9), elle_cycles._screen_bucket(20))
+    assert elle_cycles._CLASSIFY_CHOICE.get(key) in ("cpu", "device")
+    assert elle_cycles.classify_graphs(graphs) == expected
+
+    # a lying screen pins the bucket to CPU, with the CPU answer kept
+    monkeypatch.setattr(elle_cycles, "_CLASSIFY_CHOICE", {})
+    monkeypatch.setattr(
+        elle_cycles, "_classify_screened",
+        lambda gs, executor=None: [{} for _ in gs],
+    )
+    out = elle_cycles.classify_graphs(graphs)
+    assert out == expected
+    assert elle_cycles._CLASSIFY_CHOICE.get(key) == "cpu"
+
+    # a crashing screen path likewise
+    def boom(gs, executor=None):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(elle_cycles, "_CLASSIFY_CHOICE", {})
+    monkeypatch.setattr(elle_cycles, "_classify_screened", boom)
+    out = elle_cycles.classify_graphs(graphs)
+    assert out == expected
+    assert elle_cycles._CLASSIFY_CHOICE.get(key) == "cpu"
+
+    # small batches never calibrate under auto (stay on CPU)
+    monkeypatch.setattr(elle_cycles, "_CLASSIFY_CHOICE", {})
+    monkeypatch.setattr(elle_cycles, "_classify_screened", boom)
+    assert elle_cycles.classify_graphs(graphs[:4]) == expected[:4]
+    assert elle_cycles._CLASSIFY_CHOICE == {}
+
+
+# ---------------------------------------------------------------------------
+# partition-aware cost scheduling
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_finish_orders_buckets_globally_by_cost(monkeypatch):
+    """End-of-input buckets dispatch largest-estimated-cost first
+    ACROSS streams (pass-through + decomposed sub-histories), not
+    merely within each stream."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.synth import generate_mr_history
+
+    rng = random.Random(45100)
+    # two length classes → sub-histories land in different (E, C)
+    # buckets, so finish() has several buckets to order globally
+    hists = [
+        generate_mr_history(rng, n_procs=3, n_ops=n_ops, n_keys=4,
+                            n_values=4, crash_p=0.0,
+                            corrupt=(i % 3 == 0))
+        for i, n_ops in enumerate([40, 40, 40, 220, 220, 220])
+    ]
+    model = m.multi_register({k: 0 for k in range(4)})
+
+    seen = []
+    orig = execution.Executor.submit
+
+    def spy(self, pb):
+        seen.append(planning.estimated_cost(pb))
+        return orig(self, pb)
+
+    monkeypatch.setattr(execution.Executor, "submit", spy)
+    res = wgl.check_batch(model, hists, decomposed=True)
+    assert all(r["valid?"] in (True, False) for r in res)
+    assert len(seen) >= 2
+    assert seen == sorted(seen, reverse=True), seen
+
+
+def test_daemon_dispatches_groups_largest_cost_first(monkeypatch):
+    """The daemon's largest-cost-first ordering now applies ACROSS
+    compatible groups: a group's cost is the sum over its planned
+    (post-decomposition) bucket rows, so high-fanout runs stop being
+    under-scheduled by arrival order."""
+    from jepsen_tpu import models as m
+    from jepsen_tpu.engine import decompose
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.serve import daemon as daemon_mod
+    from jepsen_tpu.synth import generate_batch
+
+    def make_req(model, hists, gkey):
+        plan_opts = {"slot_cap": 32, "frontier": wgl.DEFAULT_FRONTIER,
+                     "max_closure": None,
+                     "max_dispatch": wgl.DEFAULT_MAX_DISPATCH}
+        exec_opts = {"escalation": wgl.ESCALATION_FACTORS,
+                     "sufficient_rung": True,
+                     "max_dispatch": wgl.DEFAULT_MAX_DISPATCH}
+        run = decompose.DecomposedRun(model, hists, oracle_fallback=True)
+        streams = []
+        for tag, sctx in run.streams():
+            planner = planning.Planner(
+                sctx.model, spec=sctx.spec, bucketed=True, **plan_opts
+            )
+            buckets, order = planner.encode_buckets(sctx)
+            streams.append(daemon_mod._Stream(
+                tag, sctx.model, sctx.spec, buckets, order))
+        return daemon_mod._Request(run, streams, gkey, model, plan_opts,
+                                   exec_opts, len(hists))
+
+    small = make_req(
+        m.cas_register(0),
+        generate_batch(seed=1, n_histories=2, n_procs=3, n_ops=8),
+        "small",
+    )
+    big = make_req(
+        m.cas_register(0),
+        generate_batch(seed=2, n_histories=12, n_procs=3, n_ops=60),
+        "big",
+    )
+
+    dispatched = []
+    orig = daemon_mod.CheckerDaemon._dispatch_group
+
+    def spy(self, executor, reqs, planned, n_buckets):
+        dispatched.append(reqs[0].group_key)
+        return orig(self, executor, reqs, planned, n_buckets)
+
+    monkeypatch.setattr(daemon_mod.CheckerDaemon, "_dispatch_group", spy)
+    d = daemon_mod.CheckerDaemon(port=0)
+    ex = execution.Executor(None, mesh=None)
+    d._process_batch(ex, [small, big])  # arrival order: small first
+    assert dispatched == ["big", "small"]
+    assert small.device_done.is_set() and big.device_done.is_set()
+    small.run.drain_oracles()
+    big.run.drain_oracles()
+    assert all(r is not None for r in small.run.results())
+    assert all(r is not None for r in big.run.results())
+
+
+# ---------------------------------------------------------------------------
+# the /elle service seam
+# ---------------------------------------------------------------------------
+
+
+def test_serve_elle_roundtrip_matches_in_process():
+    from jepsen_tpu.serve import client as serve_client
+    from jepsen_tpu.serve.daemon import CheckerDaemon
+
+    graphs = [_rw_chain(7, i % 2 == 0) for i in range(10)]
+    encs = [elle_encode.encode_graph(g) for g in graphs]
+    local = ops_cycles.screen_graphs(encs)
+
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = serve_client.ServiceClient(port=daemon.port)
+        wire = client.screen_graphs(encs)
+        assert len(wire) == len(local)
+        for a, b in zip(local, wire):
+            assert set(a.members) == set(b.members)
+            for k in a.members:
+                assert np.array_equal(a.members[k], b.members[k])
+            for k in a.walks:
+                assert np.array_equal(a.walks[k], b.walks[k])
+        st = daemon.status()
+        assert st["elle_requests"] == 1
+        assert st["elle_graphs"] == len(encs)
+    finally:
+        daemon.stop()
+
+
+def test_serve_screen_seam_requires_opt_in(monkeypatch):
+    from jepsen_tpu.serve import client as serve_client
+
+    monkeypatch.delenv("JEPSEN_TPU_SERVICE", raising=False)
+    encs = [elle_encode.encode_graph(_rw_chain(5, True))]
+    assert serve_client.screen_graphs(encs) is None
