@@ -2,15 +2,16 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
 # observability, pipeline, checker-service, slice-dispatch,
-# decomposition, auto-tune, and transactional-screen smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke
+# decomposition, auto-tune, transactional-screen, and closure/union
+# kernel smoke checks
+check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -82,6 +83,19 @@ tune-smoke:
 elle-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.elle.smoke
 	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m jepsen_tpu.elle.smoke
+
+# peak-FLOP kernel gate (doc/checker-engines.md "Transactional
+# screens"): the plane-packed one-closure screens vs the per-mask
+# reference kernels vs the pure-numpy oracle on plain + suffixed
+# filter profiles, early-exit vs fixed-round closures on both Elle
+# kernel routes, and the matmul subset-union lowering vs gather/unroll
+# on the register + queue dense kernels — all byte-identical — plus
+# per-chip budget accounting for the packed shapes under a tiny
+# dispatch cap; second line re-runs sharded over the forced
+# 8-virtual-device mesh.
+kernels-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.ops.smoke
+	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m jepsen_tpu.ops.smoke
 
 bench:
 	python bench.py

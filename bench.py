@@ -376,6 +376,11 @@ def run_bench(on_accelerator, warnings):
             "scaling_efficiency": scaling_efficiency,
             "overflow_unknown": int(overflow.sum()),
             "invalid": int((~ok).sum()),
+            # summed wall of the timed per-rep dispatches — the
+            # device-dispatch seconds the headline diag reports
+            "dispatch_s": round(
+                sum(B / h for h in rep_hps if h > 0), 4
+            ),
         }
 
     # largest (headline) batch first, and salvage partial windows: if
@@ -392,6 +397,23 @@ def run_bench(on_accelerator, warnings):
             break
     headline = samples[0]  # largest B
     value = headline["hps_median"]
+
+    kern = wgl.kernel_choice("cas-register", C, vmax + 1)
+    union = dense._union_mode()
+    # estimated closure FLOP-rate — matmul-union lowering only (the
+    # unroll/gather closures are shifts and gathers, not MXU flops):
+    # per row the dense automaton runs E events through C+2 union
+    # passes plus one completion, each a one-hot [C,V,W]×[C,W,W]
+    # uint32 matmul over the packed subset axis
+    closure_gflops = None
+    dispatch_s = headline.get("dispatch_s") or 0.0
+    if union == "matmul" and kern == "dense" and dispatch_s > 0:
+        W = dense._n_words(C)
+        flops_row = 2.0 * E * (C + 3) * C * (vmax + 1) * W * W
+        closure_gflops = round(
+            headline["B"] * max(1, REPS) * flops_row / dispatch_s / 1e9,
+            3,
+        )
 
     diag = {
         "batch": headline["B"],
@@ -417,11 +439,13 @@ def run_bench(on_accelerator, warnings):
         "encode_fallback": n_fallback,
         "invalid": headline["invalid"],
         "platform": jax.devices()[0].platform,
-        "kernel": wgl.kernel_choice("cas-register", C, vmax + 1),
+        "kernel": kern,
         # the resolved union-mode (dense._union_mode reads the env over
         # dense.DEFAULT_UNION) — never re-hardcode the default here: a
         # default flip in dense.py would silently mislabel windows
-        "dense_union": dense._union_mode(),
+        "dense_union": union,
+        "device_dispatch_s": headline.get("dispatch_s"),
+        "closure_gflops_per_s_est": closure_gflops,
         "samples": samples,
     }
     return value, L, diag
@@ -962,9 +986,13 @@ def bench_elle():
     graphs from every history stack into shared engine dispatches
     (window, per-chip budget, mesh), and only graphs the device
     proved cyclic pay the CPU witness search.  Reports graphs/s,
-    screen hit-rate, and the witness-search fallback fraction, and
-    appends a ``"bench": "elle"`` record to BENCH_tpu_windows.jsonl
-    (excluded from _best_window by the existing label rule).  Emits
+    screen hit-rate, the witness-search fallback fraction, the
+    device-dispatch seconds (the engine's execute-phase obs sum), and
+    the estimated closure FLOP-rate the packed plane stacks sustained,
+    and appends a ``"bench": "elle"`` record to BENCH_tpu_windows.jsonl
+    (excluded from _best_window by the existing label rule; the record
+    carries ``closure_mode``, so a fixed-vs-earlyexit A/B pair — run
+    via JEPSEN_TPU_CYCLES_CLOSURE — stays distinguishable).  Emits
     ONE JSON line like the main bench; never crashes without it."""
     payload = {
         "metric": "elle_screened_classify_histories_per_sec",
@@ -983,6 +1011,7 @@ def bench_elle():
         import jax
 
         from jepsen_tpu import elle, obs
+        from jepsen_tpu.ops import cycles as ops_cycles
 
         if on_accel:
             n_hists, n_txns, keys = 64, 400, 32
@@ -1004,11 +1033,23 @@ def bench_elle():
             res = elle.check_batch(o, hists)
             dt = time.perf_counter() - t0
             reg = obs.registry()
+            # device-dispatch seconds + closure-flop evidence straight
+            # from the engine's own obs seam (the execute-phase
+            # histogram the tuner reads, and the settle-site flop
+            # counter — no shape re-derivation here)
+            execute_s = closure_flops = 0.0
+            for d in reg.snapshot():
+                if d["name"] == "jepsen_kernel_execute_seconds":
+                    execute_s += d.get("sum", 0.0)
+                elif d["name"] == "jepsen_cycles_closure_flops_total":
+                    closure_flops += d.get("value", 0.0)
             diag = {
                 "witness_fallbacks": reg.value(
                     "jepsen_elle_witness_fallback_total") or 0,
                 "screened": reg.value(
                     "jepsen_elle_screen_route_total", route="device") or 0,
+                "device_dispatch_s": execute_s,
+                "closure_flops": closure_flops,
             }
             obs.enable(reset=True)
             return dt, res, diag
@@ -1042,6 +1083,18 @@ def bench_elle():
             "invalid_histories": sum(
                 1 for r in dev_res if r.get("valid?") is not True
             ),
+            # the resolved closure mode (env > calibration > default),
+            # never re-hardcoded: the same rule as dense_union below
+            "closure_mode": ops_cycles.closure_mode(),
+            "device_dispatch_s": round(
+                dev_diag["device_dispatch_s"], 4),
+            # estimated closure FLOP-rate: the settle-site estimate
+            # (2·E³ per plane per round, counted as it actually ran)
+            # over the engine's execute-phase seconds
+            "closure_gflops_per_s": round(
+                dev_diag["closure_flops"]
+                / dev_diag["device_dispatch_s"] / 1e9, 3)
+            if dev_diag["device_dispatch_s"] > 0 else None,
             "platform": jax.devices()[0].platform,
         })
         try:
@@ -1150,6 +1203,28 @@ def main():
             # CPU fallback (probe failed: the warning holds the reason)
             # — or an on-accel REPS=0 compile-only run, which has no
             # probe warning and needs no error field
+            union = diag.get("dense_union")
+            from jepsen_tpu.ops import dense as dense_mod
+
+            if (not on_accel and value > 0 and union
+                    and union != dense_mod.DEFAULT_UNION):
+                # explicitly-routed union A/B fallback run (e.g.
+                # JEPSEN_TPU_DENSE_UNION=matmul): record the live host
+                # window, tagged so _best_window/_windows_summary never
+                # headline it as a cas-register round record
+                try:
+                    with open(WINDOWS, "a") as f:
+                        f.write(json.dumps({
+                            "captured_at": _utcnow(),
+                            "bench": f"union-{union}",
+                            "metric": payload["metric"],
+                            "value": payload["value"],
+                            "unit": "histories/sec",
+                            "diag": {k: v for k, v in diag.items()
+                                     if k != "samples"},
+                        }) + "\n")
+                except OSError as e:
+                    print(f"window append failed: {e!r}", file=sys.stderr)
             if warnings:
                 payload["error"] = warnings[0]
                 warnings = warnings[1:]

@@ -63,6 +63,22 @@ NONADJ_MASKS = (
 #: kernels and the boolean has-cycle closure share shape discipline
 GRAPH_BUCKET_MIN = 16
 
+#: packed-plane weight of one lifted nonadjacent walk query: its
+#: 2n×2n product graph carries four n×n planes' worth of closure
+#: state, vs one plane per membership filter mask
+LIFTED_PLANE_WEIGHT = 4
+
+
+def plane_weight(masks: Sequence[int],
+                 nonadj: Sequence[Tuple[int, int]]) -> int:
+    """Packed closure planes (n×n-equivalents) one profile expands
+    into on the batch axis — the ``F`` coordinate of a profile's
+    ``(kernel="cycles", E, C, F)`` cost-table key since the
+    plane-packing work: one plane per membership mask,
+    :data:`LIFTED_PLANE_WEIGHT` per lifted walk query.  Floors at 1 so
+    an edge-free profile (no masks, no queries) still ranks."""
+    return max(1, len(masks) + LIFTED_PLANE_WEIGHT * len(nonadj))
+
 
 def rel_mask(rels) -> int:
     """OR of :data:`REL_BITS` over an edge's relation set."""

@@ -461,8 +461,11 @@ def estimated_cost(pb: PlannedBucket) -> float:
         return float(rows * plan.E)
     if plan.kernel == "cycles":
         # batched boolean closure (the Elle screens): per-row work is
-        # the n×n matrix squaring ladder, so footprint scales with E²
-        return float(rows) * plan.E * plan.E
+        # the n×n matrix squaring ladder over the packed plane stack,
+        # so footprint scales with E² × the plane weight (frontier
+        # carries plane_weight(masks, nonadj) on ScreenPlan, 1 on the
+        # plain has-cycle CyclePlan)
+        return float(rows) * plan.E * plan.E * max(1, plan.frontier)
     words = max(1, -(-plan.E // 32))
     return float(rows * plan.frontier * (plan.C + 1) * words)
 

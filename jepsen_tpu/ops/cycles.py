@@ -23,6 +23,27 @@ families share that core:
   anywhere means ``find_nonadjacent_cycle`` would answer None for
   every SCC, so the whole rung is skippable.
 
+**Plane packing** (the peak-FLOP closure work): one screen dispatch no
+longer pays a separate log₂(n)-round closure per ladder filter — the F
+filter masks expand on-device into a ``(B·F, n, n)`` plane stack and
+the Q lifted walk queries into ``(B·Q, 2n, 2n)``, then ONE
+:func:`_bool_closure` runs per shape family.  A 5-rung screen bucket
+therefore lowers to ~log₂(n) large batched matmuls instead of
+5·log₂(n) small ones (pinned by the jaxpr ``dot_general``-count
+regression test); the per-plane arithmetic is untouched, so results
+stay byte-identical to the per-mask lowering (``make kernels-smoke``).
+
+**Closure modes**: :func:`_bool_closure` either runs the full fixed
+log₂(n) squaring ladder (``"fixed"``, a ``lax.scan``) or stops at
+fixpoint (``"earlyexit"``, a ``lax.while_loop`` — byte-identical by
+construction since post-fixpoint squarings are the identity on the
+saturated {0,1} lattice).  The mode is a tuned engine knob
+(``JEPSEN_TPU_CYCLES_CLOSURE`` > calibration ``closure_mode`` >
+:data:`DEFAULT_CLOSURE_MODE`; doc/tuning.md) because the convergence
+check is a device-wide sync whose cost only pays off at large n.
+Rounds actually run come back as a per-row output and settle into
+``jepsen_cycles_closure_rounds_total`` / ``_rounds_saved_total``.
+
 Since the engine-routing work these kernels no longer dispatch through
 a private loop: every batch is planned into :class:`CyclePlan` /
 :class:`ScreenPlan` buckets (power-of-two vertex buckets ×
@@ -32,8 +53,10 @@ filter-profile, stacked ``(B, n, n)`` uint8 relation matrices — see
 ``DispatchWindow``, the per-chip ``safe_dispatch`` row budget
 (:func:`cycles_max_dispatch`, the crash-avoidance analogue of
 ``FRONTIER_DISPATCH_BUDGET``), mesh ``shard_map`` dispatch, and the
-``(kernel="cycles", E=n, C=0, F=1)`` rows of the tune cost table all
-apply to Elle traffic exactly as they do to history checking.
+``(kernel="cycles", E=n, C=0, F=plane-weight)`` rows of the tune cost
+table all apply to Elle traffic exactly as they do to history
+checking (``F`` is the packed plane weight — one n×n plane per filter
+mask plus four per lifted query; :func:`jepsen_tpu.elle.encode.plane_weight`).
 """
 
 from __future__ import annotations
@@ -62,19 +85,54 @@ CLOSURE_CACHE_SIZE = 32
 
 #: per-dispatch footprint budget for the cycle kernels, in bf16 words
 #: of live closure state — the crash-avoidance analogue of
-#: ``wgl.FRONTIER_DISPATCH_BUDGET`` for the matrix-closure family.  A
-#: membership screen holds ~2 n² words per row per filter (adjacency +
-#: closure), a lifted nonadjacent screen 8 n² (the 2n×2n product
-#: graph); 16M words keeps every measured-good elle_bench shape
-#: (B=4096 × n=16 … B=256 × n=256) dispatchable in ≤2 chunks while
-#: bounding in-flight HBM the same way the engine bounds history
-#: kernels — ``has_cycle_batch`` historically had NO such cap, so a
-#: huge graph batch could exceed the per-chip budget the engine
-#: enforces everywhere else (the PR's pinned regression).
+#: ``wgl.FRONTIER_DISPATCH_BUDGET`` for the matrix-closure family.
+#: The packed screen stack holds 2 n² words per row per filter plane
+#: (adjacency + closure) and 8 n² per lifted nonadjacent plane (the
+#: 2n×2n product graph); 16M words keeps every measured-good
+#: elle_bench shape (B=4096 × n=16 … B=256 × n=256) dispatchable in
+#: ≤2 chunks while bounding in-flight HBM the same way the engine
+#: bounds history kernels — ``has_cycle_batch`` historically had NO
+#: such cap, so a huge graph batch could exceed the per-chip budget
+#: the engine enforces everywhere else (the PR's pinned regression).
 CYCLES_DISPATCH_BUDGET = 16_777_216
 
 #: largest row count per dispatch, shared ceiling with the engine
 DEFAULT_CYCLES_MAX_DISPATCH = 16384
+
+#: closure-iteration lowering when neither the environment nor a
+#: calibration picks one: the fixed log₂(n) scan — the earlyexit
+#: while_loop's fixpoint test is a device-wide sync per round, a cost
+#: the tuner must measure before opting in (doc/tuning.md)
+DEFAULT_CLOSURE_MODE = "fixed"
+
+_VALID_CLOSURE_MODES = ("fixed", "earlyexit")
+
+
+def closure_mode() -> str:
+    """Resolved closure-iteration mode for the cycle kernels:
+    ``JEPSEN_TPU_CYCLES_CLOSURE`` > active calibration
+    (``closure_mode`` param — ``jepsen_tpu tune`` measures the
+    fixed/earlyexit gap per chip) > :data:`DEFAULT_CLOSURE_MODE`.
+    Part of every closure-kernel cache key, so flipping it can never
+    serve a stale lowering."""
+    from ..tune import artifact as _cal
+
+    def parse(v: str):
+        v = v.strip().lower()
+        return v if v in _VALID_CLOSURE_MODES else None
+
+    return _cal.resolve_knob(
+        "JEPSEN_TPU_CYCLES_CLOSURE",
+        parse,
+        lambda cal: cal.closure_mode(),
+        DEFAULT_CLOSURE_MODE,
+    )
+
+
+def closure_rounds(n: int) -> int:
+    """Squaring rounds that guarantee full transitive closure of an
+    n-vertex graph (path length doubles per round)."""
+    return max(1, math.ceil(math.log2(max(2, n))))
 
 
 def cycles_max_dispatch(
@@ -84,10 +142,11 @@ def cycles_max_dispatch(
     max_dispatch: Optional[int] = None,
 ) -> int:
     """Largest safe per-dispatch row count for a cycle kernel over
-    ``n``-vertex graphs computing ``n_filters`` membership closures and
-    ``n_lifted`` lifted (2n×2n) walk closures.  Returns 0 when even a
-    single row exceeds the budget — callers must route those graphs to
-    the CPU path instead of dispatching."""
+    ``n``-vertex graphs whose packed stack carries ``n_filters``
+    membership planes and ``n_lifted`` lifted (2n×2n) walk planes per
+    row.  Returns 0 when even a single row exceeds the budget —
+    callers must route those graphs to the CPU path instead of
+    dispatching."""
     if max_dispatch is None:
         max_dispatch = DEFAULT_CYCLES_MAX_DISPATCH
     per_row = n * n * (2 * max(1, n_filters) + 8 * n_lifted)
@@ -96,87 +155,173 @@ def cycles_max_dispatch(
     return max(1, min(max_dispatch, CYCLES_DISPATCH_BUDGET // per_row))
 
 
-def _bool_closure(adj):
-    """Transitive (≥1 step) boolean closure by log₂ rounds of
-    saturated bfloat16 matrix squaring; shape-static, trace-safe."""
+def _bool_closure(adj, mode: str = "fixed"):
+    """Transitive (≥1 step) boolean closure by rounds of saturated
+    bfloat16 matrix squaring; shape-static, trace-safe.  Returns
+    ``(closure bool, rounds-run int32 scalar)``.
+
+    ``mode="fixed"`` always runs the full log₂(n) ladder as a
+    ``lax.scan``; ``mode="earlyexit"`` wraps the same squaring step in
+    a ``lax.while_loop`` that stops once a round changes nothing.
+    Byte-identical by construction: the squaring step is monotone and
+    idempotent at fixpoint on the saturated {0,1} values, so the extra
+    rounds the fixed ladder runs past convergence are the identity."""
     n = adj.shape[-1]
-    rounds = max(1, math.ceil(math.log2(n)))
+    rounds = closure_rounds(n)
     r = adj.astype(jnp.bfloat16)
 
-    def step(r, _):
+    if mode == "earlyexit":
+        def cond(carry):
+            _, changed, i = carry
+            return changed & (i < rounds)
+
+        def body(carry):
+            rc, _, i = carry
+            rr = jnp.clip(rc + jnp.matmul(rc, rc), 0.0, 1.0)
+            return rr, jnp.any(rr != rc), i + jnp.int32(1)
+
+        r, _, used = jax.lax.while_loop(
+            cond, body, (r, jnp.bool_(True), jnp.int32(0))
+        )
+        return r > 0.0, used
+
+    def step(rc, _):
         # r ∪ r·r, saturated to {0,1}; stays in bfloat16 for the MXU
-        rr = jnp.clip(r + jnp.matmul(r, r), 0.0, 1.0)
+        rr = jnp.clip(rc + jnp.matmul(rc, rc), 0.0, 1.0)
         return rr, None
 
     r, _ = jax.lax.scan(step, r, None, length=rounds)
-    return r > 0.0
+    return r > 0.0, jnp.int32(rounds)
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _closure_fn(n: int):
+def _closure_fn(n: int, mode: str = "fixed"):
     @jax.jit
     def has_cycle(adj):  # adj: (B, n, n) bool
-        r = _bool_closure(adj)
+        r, used = _bool_closure(adj, mode)
         diag = jnp.diagonal(r, axis1=-2, axis2=-1)
-        return jnp.any(diag, axis=-1)
+        flags = jnp.any(diag, axis=-1)
+        return flags, jnp.broadcast_to(used, flags.shape)
 
     return has_cycle
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _cyclic_fn(n: int):
+def _cyclic_fn(n: int, mode: str = "fixed"):
     """Engine-facing variant of :func:`_closure_fn`: tuple outputs (the
-    execution layer materializes output *tuples*) and a
-    ``safe_dispatch`` row cap like every other engine kernel."""
-    base = _closure_fn(n)
-    fn = jax.jit(lambda adj: (base(adj),))
+    execution layer materializes output *tuples* — flags plus the
+    per-row rounds-run evidence) and a ``safe_dispatch`` row cap like
+    every other engine kernel."""
+    base = _closure_fn(n, mode)
+    fn = jax.jit(lambda adj: base(adj))
     fn.safe_dispatch = cycles_max_dispatch(n, 1, 0)
     return fn
 
 
-@lru_cache(maxsize=CLOSURE_CACHE_SIZE)
 def _screen_fn(n: int, masks: Tuple[int, ...],
                nonadj: Tuple[Tuple[int, int], ...]):
+    """The production transactional-screen kernel: the packed lowering
+    at the resolved :func:`closure_mode` (see :func:`_screen_fn_variant`
+    for the cache and the per-mask reference lowering)."""
+    return _screen_fn_variant(n, masks, nonadj, True, closure_mode())
+
+
+@lru_cache(maxsize=CLOSURE_CACHE_SIZE)
+def _screen_fn_variant(n: int, masks: Tuple[int, ...],
+                       nonadj: Tuple[Tuple[int, int], ...],
+                       packed: bool, mode: str):
     """The transactional screen kernel for ``n``-vertex graphs: per
     relation-filter SCC membership masks plus per-(want, rest) lifted
     nonadjacent-walk masks, all in ONE dispatch over a ``(B, n, n)``
     uint8 relation-bit batch (bit assignment:
     ``jepsen_tpu.elle.encode.REL_BITS``).  Returns
-    ``(members: (B, F, n) bool, walks: (B, Q, n) bool)``."""
+    ``(members: (B, F, n) bool, walks: (B, Q, n) bool,
+    rounds: (B,) int32)`` — rounds is the closure-squaring count the
+    dispatch actually ran (broadcast per row; settle turns it into the
+    rounds/rounds-saved counters).
+
+    ``packed=True`` (production) folds the F filter planes into the
+    batch axis as a ``(B·F, n, n)`` stack and the Q lifted queries as
+    ``(B·Q, 2n, 2n)``, running ONE :func:`_bool_closure` per shape
+    family — ~log₂(n) large batched matmuls for the whole ladder.
+    ``packed=False`` keeps the historical per-mask loop (F + Q small
+    closures) as the differential reference the equality gates compare
+    against; both produce byte-identical members/walks because batched
+    matmul is independent per batch element."""
+    F, Q = len(masks), len(nonadj)
 
     @jax.jit
     def screen(rel):  # rel: (B, n, n) uint8
         B = rel.shape[0]
-        members = []
-        for mask in masks:
-            r = _bool_closure((rel & jnp.uint8(mask)) > 0)
-            # v sits on a cycle of this filtered subgraph iff some j
-            # is reachable forward AND backward (j = v covers self
-            # loops, which the graph layer already drops)
-            members.append(jnp.any(r & jnp.swapaxes(r, -1, -2), axis=-1))
-        walks = []
-        for want, rest in nonadj:
-            aw = (rel & jnp.uint8(want)) > 0
-            ar = (rel & jnp.uint8(rest)) > 0
-            # lifted product graph over (vertex, last-edge-was-want):
-            # a want edge is only traversable from state 0 (previous
-            # edge not want) and lands in state 1; rest edges land in
-            # state 0 from either.  A closed walk u →want→ w →…→
-            # (u, state 0) is exactly a walk whose want edges are
-            # never cyclically adjacent (the closing rest edge
-            # precedes the forced first want edge in the rotation).
-            top = jnp.concatenate([ar, aw], axis=-1)
-            bot = jnp.concatenate([ar, jnp.zeros_like(ar)], axis=-1)
-            c = _bool_closure(jnp.concatenate([top, bot], axis=-2))
-            reach = c[:, n:, :n]  # from (·, 1) to (·, 0), ≥1 step
-            walks.append(jnp.any(aw & jnp.swapaxes(reach, -1, -2), axis=-1))
-        m = (jnp.stack(members, axis=1) if members
-             else jnp.zeros((B, 0, n), bool))
-        w = (jnp.stack(walks, axis=1) if walks
-             else jnp.zeros((B, 0, n), bool))
-        return m, w
+        used = jnp.int32(0)
+        if packed:
+            if masks:
+                marr = jnp.asarray(masks, jnp.uint8)
+                planes = (rel[:, None] & marr[None, :, None, None]) > 0
+                c, um = _bool_closure(planes.reshape(B * F, n, n), mode)
+                c = c.reshape(B, F, n, n)
+                # v sits on a cycle of this filtered subgraph iff some
+                # j is reachable forward AND backward (j = v covers
+                # self loops, which the graph layer already drops)
+                m = jnp.any(c & jnp.swapaxes(c, -1, -2), axis=-1)
+                used = used + um
+            else:
+                m = jnp.zeros((B, 0, n), bool)
+            if nonadj:
+                wants = jnp.asarray([wq for wq, _ in nonadj], jnp.uint8)
+                rests = jnp.asarray([rq for _, rq in nonadj], jnp.uint8)
+                aw = (rel[:, None] & wants[None, :, None, None]) > 0
+                ar = (rel[:, None] & rests[None, :, None, None]) > 0
+                # lifted product graph over (vertex, last-edge-was-
+                # want): a want edge is only traversable from state 0
+                # (previous edge not want) and lands in state 1; rest
+                # edges land in state 0 from either.  A closed walk
+                # u →want→ w →…→ (u, state 0) is exactly a walk whose
+                # want edges are never cyclically adjacent (the
+                # closing rest edge precedes the forced first want
+                # edge in the rotation).
+                top = jnp.concatenate([ar, aw], axis=-1)
+                bot = jnp.concatenate([ar, jnp.zeros_like(ar)], axis=-1)
+                lifted = jnp.concatenate([top, bot], axis=-2)
+                c, uw = _bool_closure(
+                    lifted.reshape(B * Q, 2 * n, 2 * n), mode
+                )
+                c = c.reshape(B, Q, 2 * n, 2 * n)
+                reach = c[:, :, n:, :n]  # from (·, 1) to (·, 0), ≥1 step
+                w = jnp.any(aw & jnp.swapaxes(reach, -1, -2), axis=-1)
+                used = used + uw
+            else:
+                w = jnp.zeros((B, 0, n), bool)
+        else:
+            members = []
+            for mask in masks:
+                r, u = _bool_closure((rel & jnp.uint8(mask)) > 0, mode)
+                members.append(
+                    jnp.any(r & jnp.swapaxes(r, -1, -2), axis=-1)
+                )
+                used = used + u
+            walks = []
+            for want, rest in nonadj:
+                aw = (rel & jnp.uint8(want)) > 0
+                ar = (rel & jnp.uint8(rest)) > 0
+                top = jnp.concatenate([ar, aw], axis=-1)
+                bot = jnp.concatenate([ar, jnp.zeros_like(ar)], axis=-1)
+                c, u = _bool_closure(
+                    jnp.concatenate([top, bot], axis=-2), mode
+                )
+                reach = c[:, n:, :n]
+                walks.append(
+                    jnp.any(aw & jnp.swapaxes(reach, -1, -2), axis=-1)
+                )
+                used = used + u
+            m = (jnp.stack(members, axis=1) if members
+                 else jnp.zeros((B, 0, n), bool))
+            w = (jnp.stack(walks, axis=1) if walks
+                 else jnp.zeros((B, 0, n), bool))
+        rounds = jnp.broadcast_to(used, (B,)).astype(jnp.int32)
+        return m, w, rounds
 
-    screen.safe_dispatch = cycles_max_dispatch(n, len(masks), len(nonadj))
+    screen.safe_dispatch = cycles_max_dispatch(n, F, Q)
     return screen
 
 
@@ -188,6 +333,34 @@ def _run_elle(fn, mesh, rel, n_out: int):
     from ..parallel import mesh as mesh_mod
 
     return mesh_mod.sharded_elle(fn, mesh, rel, n_out)
+
+
+def _settle_closure_obs(plan, rounds: np.ndarray, n_live: int) -> None:
+    """Record one settled dispatch's closure evidence: rounds actually
+    run vs the plan's full ladder (the earlyexit savings — identically
+    zero under ``"fixed"``), and the packed-plane batch occupancy
+    (live planes / dispatched planes; padding rows are the only dead
+    planes, so the ratio equals live rows / padded rows)."""
+    from .. import obs
+
+    if not obs.enabled() or rounds.size == 0:
+        return
+    live = rounds[: max(1, n_live)]
+    used = int(live.max())
+    obs.count("jepsen_cycles_closure_rounds_total", used,
+              mode=plan.closure_mode)
+    obs.count("jepsen_cycles_closure_rounds_saved_total",
+              max(0, plan.rounds_full - used), mode=plan.closure_mode)
+    obs.gauge_set("jepsen_cycles_packed_plane_occupancy",
+                  n_live / rounds.shape[0])
+    # estimated MXU work this dispatch actually ran: each round squares
+    # every live row's packed plane stack (~2·E³ flops per E-plane;
+    # the lifted 2E-planes ride the plan's frontier weight), so the
+    # bench can report a closure FLOP-rate without re-deriving shapes
+    obs.count("jepsen_cycles_closure_flops_total",
+              int(2.0 * float(plan.E) ** 3 * plan.frontier * used
+                  * max(1, n_live)),
+              mode=plan.closure_mode)
 
 
 class ScreenResult:
@@ -204,8 +377,9 @@ class ScreenResult:
 
 class CyclePlan:
     """Executor-conforming plan for the boolean has-cycle screen: one
-    uint8/bool adjacency input, one cyclic-flag output per row.  Row
-    tokens are ``(sink, idx)`` — settle writes ``sink[idx]``."""
+    uint8/bool adjacency input, one cyclic-flag output per row (plus
+    the rounds-run evidence).  Row tokens are ``(sink, idx)`` — settle
+    writes ``sink[idx]``."""
 
     kernel = "cycles"
     #: neutral pad rows are all-zero relation matrices — edge-free,
@@ -213,18 +387,23 @@ class CyclePlan:
     #: pads with these; the plan owns the convention, never borrowing
     #: the history kernels' 6-array fills)
     pad_fills = (0,)
-    __slots__ = ("fn", "disp", "E", "C", "frontier")
+    __slots__ = ("fn", "disp", "E", "C", "frontier", "closure_mode",
+                 "rounds_full")
 
     def __init__(self, n: int, max_dispatch: Optional[int] = None):
-        self.fn = _cyclic_fn(n)
+        mode = closure_mode()
+        self.closure_mode = mode
+        self.fn = _cyclic_fn(n, mode)
         self.E, self.C, self.frontier = n, 0, 1
+        self.rounds_full = closure_rounds(n)
         self.disp = cycles_max_dispatch(n, 1, 0, max_dispatch)
 
     def run_rows(self, mesh, arrays):
-        return _run_elle(self.fn, mesh, arrays[0], 1)
+        return _run_elle(self.fn, mesh, arrays[0], 2)
 
     def settle_rows(self, rows, mat, n_live: int) -> None:
         flags = np.asarray(mat[0])[:n_live]
+        _settle_closure_obs(self, np.asarray(mat[1]), n_live)
         for row, (sink, idx) in enumerate(rows):
             sink[idx] = bool(flags[row])
 
@@ -232,29 +411,43 @@ class CyclePlan:
 class ScreenPlan:
     """Executor-conforming plan for the full transactional screen of
     one (vertex bucket, filter profile): settle hands each row token's
-    sink a :class:`ScreenResult` keyed by the profile's masks."""
+    sink a :class:`ScreenResult` keyed by the profile's masks.  The
+    cost-table/proxy ``frontier`` axis is the packed plane weight —
+    the batch-axis expansion factor of the one-closure lowering."""
 
     kernel = "cycles"
     pad_fills = (0,)  # see CyclePlan.pad_fills
-    __slots__ = ("fn", "disp", "E", "C", "frontier", "masks", "nonadj")
+    __slots__ = ("fn", "disp", "E", "C", "frontier", "masks", "nonadj",
+                 "closure_mode", "rounds_full")
 
     def __init__(self, n: int, masks: Tuple[int, ...],
                  nonadj: Tuple[Tuple[int, int], ...],
                  max_dispatch: Optional[int] = None):
+        from ..elle import encode as encode_mod
+
         self.masks = tuple(masks)
         self.nonadj = tuple(nonadj)
-        self.fn = _screen_fn(n, self.masks, self.nonadj)
-        self.E, self.C, self.frontier = n, 0, 1
+        mode = closure_mode()
+        self.closure_mode = mode
+        self.fn = _screen_fn_variant(n, self.masks, self.nonadj, True,
+                                     mode)
+        self.E, self.C = n, 0
+        self.frontier = encode_mod.plane_weight(self.masks, self.nonadj)
+        self.rounds_full = (
+            (closure_rounds(n) if self.masks else 0)
+            + (closure_rounds(2 * n) if self.nonadj else 0)
+        )
         self.disp = cycles_max_dispatch(
             n, len(self.masks), len(self.nonadj), max_dispatch
         )
 
     def run_rows(self, mesh, arrays):
-        return _run_elle(self.fn, mesh, arrays[0], 2)
+        return _run_elle(self.fn, mesh, arrays[0], 3)
 
     def settle_rows(self, rows, mat, n_live: int) -> None:
         members = np.asarray(mat[0])[:n_live]
         walks = np.asarray(mat[1])[:n_live]
+        _settle_closure_obs(self, np.asarray(mat[2]), n_live)
         for row, (sink, idx) in enumerate(rows):
             sink[idx] = ScreenResult(
                 {m: members[row, f] for f, m in enumerate(self.masks)},
@@ -286,13 +479,57 @@ def _submit_elle_buckets(planned, window, executor):
         ).observe(total_rows / n_disp)
 
 
-def _np_has_cycle(adj: np.ndarray) -> bool:
+def _np_bool_closure(adj: np.ndarray) -> np.ndarray:
+    """Vectorized host transitive closure: numpy boolean matmul
+    squaring over an arbitrary leading batch shape
+    (``(..., n, n) → (..., n, n)``) — the CPU mirror of
+    :func:`_bool_closure`."""
+    r = np.asarray(adj, dtype=bool)
+    for _ in range(closure_rounds(r.shape[-1])):
+        r = r | (r @ r)
+    return r
+
+
+def _np_has_cycle(adj: np.ndarray):
     """Host boolean-closure fallback for graphs past the dispatch
-    budget (the engine must never dispatch a shape it cannot cap)."""
-    r = adj.copy()
-    for _ in range(max(1, math.ceil(math.log2(max(2, r.shape[0]))))):
-        r |= r @ r
-    return bool(np.diagonal(r).any())
+    budget (the engine must never dispatch a shape it cannot cap).
+    Accepts one ``(n, n)`` matrix (→ bool) or a stacked ``(B, n, n)``
+    batch (→ ``(B,)`` bool) — the batch form is one vectorized
+    matmul-squaring ladder, not a per-matrix loop."""
+    r = _np_bool_closure(adj)
+    any_diag = np.diagonal(r, axis1=-2, axis2=-1).any(axis=-1)
+    return any_diag if any_diag.ndim else bool(any_diag)
+
+
+def _np_screen(rel: np.ndarray, masks: Sequence[int],
+               nonadj: Sequence[Tuple[int, int]]):
+    """Pure-numpy reference of the screen kernel: ``(B, n, n)`` uint8
+    relation batch → ``(members (B, F, n), walks (B, Q, n))`` — the
+    CPU parity oracle the packed/per-mask equality gates compare
+    against (tests and ``make kernels-smoke``)."""
+    rel = np.asarray(rel, np.uint8)
+    B, n = rel.shape[0], rel.shape[-1]
+    members = np.zeros((B, len(masks), n), bool)
+    for f, mask in enumerate(masks):
+        r = _np_bool_closure((rel & np.uint8(mask)) > 0)
+        members[:, f] = (r & np.swapaxes(r, -1, -2)).any(axis=-1)
+    walks = np.zeros((B, len(nonadj), n), bool)
+    for q, (want, rest) in enumerate(nonadj):
+        aw = (rel & np.uint8(want)) > 0
+        ar = (rel & np.uint8(rest)) > 0
+        top = np.concatenate([ar, aw], axis=-1)
+        bot = np.concatenate([ar, np.zeros_like(ar)], axis=-1)
+        c = _np_bool_closure(np.concatenate([top, bot], axis=-2))
+        reach = c[:, n:, :n]
+        walks[:, q] = (aw & np.swapaxes(reach, -1, -2)).any(axis=-1)
+    return members, walks
+
+
+#: host-fallback stacking bound, in bool words: over-budget buckets
+#: batch through :func:`_np_has_cycle` in chunks of this many words so
+#: the vectorized closure never materializes an unbounded (B, n, n)
+#: stack for the very shapes that were too big for the device
+_NP_STACK_BUDGET = 1 << 26
 
 
 def has_cycle_batch(
@@ -331,8 +568,16 @@ def has_cycle_batch(
         if plan.disp == 0:
             # even one row of this vertex bucket busts the dispatch
             # budget: decide on the host instead of crashing a worker
-            for i in idxs:
-                out[i] = _np_has_cycle(np.asarray(mats[i], dtype=bool))
+            # — batched through the vectorized numpy closure, chunked
+            # so the stack footprint stays bounded
+            chunk = max(1, _NP_STACK_BUDGET // (n * n))
+            for lo in range(0, len(idxs), chunk):
+                part = idxs[lo:lo + chunk]
+                stack = np.zeros((len(part), n, n), bool)
+                for row, i in enumerate(part):
+                    m = np.asarray(mats[i], dtype=bool)
+                    stack[row, : m.shape[0], : m.shape[1]] = m
+                out[part] = _np_has_cycle(stack)
             continue
         batch = np.zeros((len(idxs), n, n), dtype=np.uint8)
         for row, i in enumerate(idxs):
@@ -383,7 +628,8 @@ def screen_graphs(
 def _reach_fn(n: int):
     @jax.jit
     def close(a):
-        return _bool_closure(a)
+        r, _ = _bool_closure(a)
+        return r
 
     return close
 

@@ -219,6 +219,37 @@ def _subset_maps(C: int):
     )
 
 
+def _subset_perms(C: int):
+    """One-hot word-permutation matrices for the ``union="matmul"``
+    lowering: ``Pu[j, w, k] = 1`` iff the union map's word ``k`` reads
+    word ``w`` (``w = k ^ wb`` for j ≥ 5, identity below — the j < 5
+    maps move bits inside a word, which no matmul over the packed axis
+    can do), and ``Pd`` likewise for the drop map's ``k | wb``.  Each
+    column holds exactly one 1, so the uint32 matmul is exact: every
+    output word is a single product, never a sum that could wrap."""
+    W = _n_words(C)
+    k = np.arange(W)
+    Pu = np.zeros((C, W, W), np.uint32)
+    Pd = np.zeros((C, W, W), np.uint32)
+    for j in range(C):
+        if j < 5:
+            Pu[j, k, k] = 1
+            Pd[j, k, k] = 1
+        else:
+            wb = 1 << (j - 5)
+            Pu[j, k ^ wb, k] = 1
+            Pd[j, k | wb, k] = 1
+    return jnp.asarray(Pu), jnp.asarray(Pd)
+
+
+VALID_UNIONS = ("unroll", "gather", "matmul")
+
+
+def _check_union(union: str) -> None:
+    if union not in VALID_UNIONS:
+        raise ValueError(f"unknown dense union lowering {union!r}")
+
+
 def _xor_permute(x, wb: int):
     """x[..., k] → x[..., k ^ wb] along the last axis, as reshape +
     flip (wb a power of two) — a layout shuffle XLA cannot mistake for
@@ -241,9 +272,13 @@ def _or_select(x, wb: int):
 
 #: subset-map implementation for the dense kernels: "unroll" (default,
 #: per-slot static shuffles — reshape/flip for the j≥5 word
-#: permutations, pure mask/shift below) or "gather" (take_along_axis
-#: over constant index tensors).  Same results bit-for-bit
-#: (differentially tested).  The on-chip A/B that settled the default
+#: permutations, pure mask/shift below), "gather" (take_along_axis
+#: over constant index tensors), or "matmul" (the j≥5 word
+#: permutations as ONE one-hot batched uint32 matmul over the packed
+#: axis — _subset_perms — so the union/drop maps ride the same
+#: matrix-unit path the closure kernels do).  Same results
+#: bit-for-bit (differentially tested).  The on-chip A/B that settled
+#: the default
 #: (2026-07-31 window, B=16384 L=1000 flagship): unroll 21,299 h/s vs
 #: gather 13,451 h/s — the gather lowering dominated the closure cost
 #: exactly as the roofline model predicted (benchmarks/RESULTS.md,
@@ -258,7 +293,7 @@ DEFAULT_UNION = "unroll"
 def _union_mode() -> str:
     """Resolved subset-union lowering: ``JEPSEN_TPU_DENSE_UNION`` >
     active calibration (doc/tuning.md — ``jepsen_tpu tune``
-    re-measures the unroll/gather gap per chip) >
+    re-measures the unroll/gather/matmul gap per chip) >
     :data:`DEFAULT_UNION`.  The mode is part of the kernel cache key,
     so flipping it can never serve a stale lowering."""
     from ..tune import artifact as _cal
@@ -348,7 +383,11 @@ def build_dense(
     uidx, umask, ushl, didx, dmask, dshr = _subset_maps(C)
     uidx_b = jnp.broadcast_to(uidx[:, None, :], (C, V, W))
     didx_b = jnp.broadcast_to(didx[:, None, :], (C, V, W))
+    _check_union(union)
     union_unroll = union == "unroll"
+    union_matmul = union == "matmul"
+    if union_matmul:
+        Pu, Pd = _subset_perms(C)
 
     def check_one(init_state, ev_slot, cand_slot, cand_f, cand_a, cand_b):
         if multi:
@@ -480,6 +519,12 @@ def build_dense(
                          & umask[j][None, :]) << ushl[j]
                         for j in range(C)
                     )
+                elif union_matmul:
+                    # every slot's word permutation as one batched
+                    # one-hot uint32 matmul over the packed axis
+                    U = jnp.einsum("jvw,jwk->jvk", X, Pu)
+                    U = (U & umask[:, None, :]) << ushl[:, None, None]
+                    add = _or_fold(U[j] for j in range(C))
                 else:
                     U = jnp.take_along_axis(X, uidx_b, axis=2)
                     U = (U & umask[:, None, :]) << ushl[:, None, None]
@@ -502,6 +547,9 @@ def build_dense(
                         for j in range(C)
                     ]
                 )
+            elif union_matmul:
+                Ds = jnp.einsum("vw,jwk->jvk", Dc, Pd)
+                Dvar = (Ds >> dshr[:, None, None]) & dmask[:, None, :]
             else:
                 Ds = jnp.take_along_axis(
                     jnp.broadcast_to(Dc[None], (C, V, W)), didx_b, axis=2
@@ -554,7 +602,11 @@ def build_dense_queue(E: int, C: int, union: str = "gather"):
     W = _n_words(C)
     max_closure = C + 2
     uidx, umask, ushl, didx, dmask, dshr = _subset_maps(C)
+    _check_union(union)
     union_unroll = union == "unroll"
+    union_matmul = union == "matmul"
+    if union_matmul:
+        Pu, Pd = _subset_perms(C)
     has = _subset_has(C)
     ones = jnp.full((W,), 0xFFFFFFFF, jnp.uint32)
     zeros = jnp.zeros((W,), jnp.uint32)
@@ -630,6 +682,10 @@ def build_dense_queue(E: int, C: int, union: str = "gather"):
                          & umask[j]) << ushl[j]
                         for j in range(C)
                     )
+                elif union_matmul:
+                    U = jnp.einsum("jw,jwk->jk", X, Pu)
+                    U = (U & umask) << ushl[:, None]
+                    add = _or_fold(U[j] for j in range(C))
                 else:
                     U = jnp.take_along_axis(X, uidx, axis=1)
                     U = (U & umask) << ushl[:, None]
@@ -650,6 +706,9 @@ def build_dense_queue(E: int, C: int, union: str = "gather"):
                         for j in range(C)
                     ]
                 )
+            elif union_matmul:
+                Ds = jnp.einsum("w,jwk->jk", Dc, Pd)
+                Dvar = (Ds >> dshr[:, None]) & dmask
             else:
                 Ds = jnp.take_along_axis(
                     jnp.broadcast_to(Dc[None], (C, W)), didx, axis=1

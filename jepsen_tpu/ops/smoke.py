@@ -1,0 +1,281 @@
+"""Closure/union kernel smoke check: ``python -m jepsen_tpu.ops.smoke``.
+
+The peak-FLOP kernel gate (doc/checker-engines.md "Transactional
+screens"): the plane-packed one-closure screens, the convergence
+early-exit closure, and the matmul subset-union lowering are pure
+performance work — every one of them must be byte-identical to the
+lowering it replaces.  This gate fails loudly on:
+
+- packed screens diverging from the per-mask reference kernels OR from
+  the pure-numpy ``_np_screen`` oracle, on rw-register-shaped (plain)
+  and list-append/realtime-shaped (suffixed masks + both lifted walk
+  queries) filter profiles, across vertex buckets;
+- the early-exit (``lax.while_loop``) closure diverging from the
+  fixed-round scan on either Elle kernel route (has-cycle flags and
+  full screens) — and the saved rounds not being recorded;
+- ``union="matmul"`` verdicts diverging from gather/unroll on the
+  register AND queue dense kernels;
+- a budget-accounting breach for packed shapes: under a deliberately
+  tiny dispatch cap the executor must chunk the packed screen buckets
+  and no kernel's peak in-flight per-chip rows may exceed its cap.
+
+Run plain for the single-device gate and with
+``JEPSEN_TPU_ENGINE_MESH=1`` for the 8-virtual-device sharded gate
+(the Makefile's ``kernels-smoke`` target runs both).
+
+Exit codes: 0 ok, 1 divergence or missing evidence.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _rel_corpus(rng, n: int, rows: int):
+    """Seeded ``(rows, n, n)`` uint8 relation batches mixing ring,
+    chain, and sparse-random graphs over all five relation bits —
+    cyclic and acyclic rows in every batch."""
+    import numpy as np
+
+    rel = np.zeros((rows, n, n), np.uint8)
+    bits = (1, 2, 4, 8, 16)
+    for b in range(rows):
+        for i in range(n - 1):
+            rel[b, i, i + 1] = bits[(b + i) % 5]
+        if b % 3 == 0:
+            rel[b, n - 1, 0] = bits[b % 5]  # close into a ring
+        extra = rng.random((n, n)) < 0.05
+        np.fill_diagonal(extra, False)
+        rel[b] |= extra.astype(np.uint8) * bits[b % 5]
+    return rel
+
+
+def _queue_corpus(rng, n_hists: int):
+    """Handcrafted unique-element unordered-queue histories (the tests'
+    simulated generator, compacted): enqueues of fresh values, dequeues
+    of any present element, with every third history corrupted by a
+    dequeue of a value never enqueued."""
+    from jepsen_tpu.history import History, fail_op, invoke_op, ok_op
+
+    hists = []
+    for h_i in range(n_hists):
+        present, pending, hist = set(), {}, []
+        idle, next_v, done = list(range(4)), 1, 0
+        while done < 20 or pending:
+            if idle and done < 20 and (not pending or rng.random() < 0.6):
+                p = idle.pop(int(rng.integers(len(idle))))
+                if present and rng.random() < 0.45:
+                    hist.append(invoke_op(p, "dequeue", None))
+                    pending[p] = ("dequeue", None)
+                else:
+                    hist.append(invoke_op(p, "enqueue", next_v))
+                    pending[p] = ("enqueue", next_v)
+                    next_v += 1
+                done += 1
+            else:
+                p = sorted(pending)[int(rng.integers(len(pending)))]
+                f, v = pending.pop(p)
+                idle.append(p)
+                if f == "enqueue":
+                    present.add(v)
+                    hist.append(ok_op(p, "enqueue", v))
+                elif present:
+                    got = sorted(present)[int(rng.integers(len(present)))]
+                    present.discard(got)
+                    if h_i % 3 == 0 and done > 10:
+                        got = 9000 + h_i  # never enqueued
+                    hist.append(ok_op(p, "dequeue", got))
+                else:
+                    hist.append(fail_op(p, "dequeue", None, error="empty"))
+        hists.append(History(hist))
+    return hists
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu.platform import force_cpu_platform
+
+    force_cpu_platform(8)
+
+    import numpy as np
+
+    from jepsen_tpu import obs
+    from jepsen_tpu.elle import encode as elle_encode
+    from jepsen_tpu.engine import execution
+    from jepsen_tpu.ops import cycles as ops_cycles
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    rng = np.random.default_rng(45120)
+
+    # -- packed ≡ per-mask ≡ numpy oracle, plain and suffixed filter
+    # profiles across two vertex buckets (the rw-register canonical
+    # profile and the full list-append/realtime ladder with both
+    # lifted nonadjacent-rw walk queries)
+    profiles = (
+        ("rw-register/plain", (1, 3, 7), ((4, 3),)),
+        ("list-append/realtime", (1, 3, 7, 25, 27, 31),
+         ((4, 3), (4, 27))),
+    )
+    for label, masks, nonadj in profiles:
+        for n in (16, 32):
+            rel = _rel_corpus(rng, n, 12)
+            want_m, want_w = ops_cycles._np_screen(rel, masks, nonadj)
+            outs = {}
+            for packed in (True, False):
+                for mode in ("fixed", "earlyexit"):
+                    fn = ops_cycles._screen_fn_variant(
+                        n, masks, nonadj, packed, mode
+                    )
+                    m, w, rounds = fn(rel)
+                    outs[(packed, mode)] = (
+                        np.asarray(m), np.asarray(w), np.asarray(rounds)
+                    )
+            base = outs[(True, "fixed")]
+            check(
+                np.array_equal(base[0], want_m)
+                and np.array_equal(base[1], want_w),
+                f"{label} n={n}: packed screen diverges from numpy oracle",
+            )
+            for key, (m, w, rounds) in outs.items():
+                check(
+                    np.array_equal(m, base[0])
+                    and np.array_equal(w, base[1]),
+                    f"{label} n={n}: variant {key} diverges from packed",
+                )
+            check(
+                int(outs[(True, "earlyexit")][2].max())
+                <= int(base[2].max()),
+                f"{label} n={n}: earlyexit ran MORE rounds than fixed",
+            )
+
+    # -- early-exit ≡ fixed on the has-cycle route, and the corpus
+    # diameters actually save rounds somewhere
+    mats = [
+        np.asarray(m, bool) if i % 2 == 0
+        else np.triu(np.asarray(m, bool), k=1)  # acyclic twin
+        for i, m in enumerate(_rel_corpus(rng, 24, 10))
+    ]
+    for mode in ("fixed", "earlyexit"):
+        os.environ["JEPSEN_TPU_CYCLES_CLOSURE"] = mode
+        try:
+            got = ops_cycles.has_cycle_batch(mats)
+        finally:
+            os.environ.pop("JEPSEN_TPU_CYCLES_CLOSURE", None)
+        want = ops_cycles._np_has_cycle(np.stack(mats))
+        check(
+            np.array_equal(np.asarray(got), want),
+            f"has_cycle_batch[{mode}] diverges from host closure",
+        )
+        check(bool(want.any()) and not bool(want.all()),
+              "has-cycle corpus should mix verdicts")
+
+    # -- union="matmul" ≡ gather ≡ unroll on the register and queue
+    # dense kernels (mixed valid/corrupt corpora)
+    import random
+
+    from jepsen_tpu import models as m
+    from jepsen_tpu import synth
+    from jepsen_tpu.ops import dense, encode
+
+    prng = random.Random(45121)
+    cas = [synth.generate_history(prng, n_procs=6, n_ops=60, crash_p=0.0,
+                                  corrupt=(i % 3 == 0)) for i in range(8)]
+    batch = encode.batch_encode(cas, m.cas_register(0), slot_cap=8)
+    V = encode.round_up(
+        int(max(batch.cand_a.max(), batch.cand_b.max(),
+                batch.init_state.max())) + 1, 4)
+    qb = encode.batch_encode(_queue_corpus(rng, 6), m.unordered_queue(),
+                             slot_cap=6)
+    for spec, bt, v in (("cas-register", batch, V),
+                        ("unordered-queue", qb, 0)):
+        args = (bt.init_state, bt.ev_slot, bt.cand_slot,
+                bt.cand_f, bt.cand_a, bt.cand_b)
+        outs = {}
+        for union in dense.VALID_UNIONS:
+            os.environ["JEPSEN_TPU_DENSE_UNION"] = union
+            try:
+                fn = dense.make_dense_fn(
+                    spec, bt.ev_slot.shape[1], bt.cand_slot.shape[2], v
+                )
+                ok, fail, _ = fn(*args)
+            finally:
+                os.environ.pop("JEPSEN_TPU_DENSE_UNION", None)
+            outs[union] = (np.asarray(ok), np.asarray(fail))
+        for union in ("unroll", "matmul"):
+            check(
+                np.array_equal(outs["gather"][0], outs[union][0])
+                and np.array_equal(outs["gather"][1], outs[union][1]),
+                f"{spec}: union={union} diverges from gather",
+            )
+        check(not outs["gather"][0].all(),
+              f"{spec}: union corpus should mix verdicts")
+
+    # -- budget accounting for packed shapes through an explicit
+    # resident executor: a tiny dispatch cap must chunk the packed
+    # screen buckets, and no kernel's peak in-flight per-chip rows may
+    # exceed its cap; the rounds metrics must record
+    masks, nonadj = profiles[0][1], profiles[0][2]
+    encs = [
+        elle_encode.EncodedGraph(list(range(nn)), r, 7, masks, nonadj)
+        for nn in (16, 32)
+        for r in _rel_corpus(rng, nn, 8)
+    ]
+    obs.enable(reset=True)
+    base = ops_cycles.screen_graphs(encs)
+    ex = execution.Executor(4)
+    capped = ops_cycles.screen_graphs(encs, executor=ex, max_dispatch=64)
+    reg = obs.registry()
+    for a, b in zip(base, capped):
+        same = (a is None) == (b is None) and (
+            a is None or (
+                all(np.array_equal(a.members[k], b.members[k])
+                    for k in a.members)
+                and all(np.array_equal(a.walks[k], b.walks[k])
+                        for k in a.walks)
+            )
+        )
+        check(same, "capped packed screens diverge from uncapped")
+        if not same:
+            break
+    check(ex.submitted > 0, "no packed dispatches reached the executor")
+    for acct in ex.chip_row_accounting.values():
+        cap = acct["chip_cap"]
+        if acct["kernel"] == "dense":
+            cap *= ex.window_size
+        check(acct["peak_chip_rows"] <= cap,
+              f"per-chip budget breach: {acct}")
+    rounds_seen = sum(
+        reg.value("jepsen_cycles_closure_rounds_total", mode=md) or 0
+        for md in ("fixed", "earlyexit")
+    )
+    check(rounds_seen > 0, "no closure rounds recorded by the screens")
+    check(
+        reg.value("jepsen_cycles_packed_plane_occupancy") is not None,
+        "no packed-plane occupancy gauge recorded",
+    )
+    obs.enable(reset=True)
+    mesh_mode = os.environ.get("JEPSEN_TPU_ENGINE_MESH", "").strip()
+    if mesh_mode in ("1", "on", "true", "yes", "force"):
+        check(ex.n_devices == 8,
+              f"mesh gate expected 8 devices, got {ex.n_devices}")
+
+    if failures:
+        for f_ in failures:
+            print(f"kernels-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "kernels-smoke: ok (packed ≡ per-mask ≡ numpy on plain+suffixed "
+        "profiles; earlyexit ≡ fixed on both routes; matmul ≡ gather ≡ "
+        "unroll on register+queue; packed budget accounting over "
+        f"{ex.n_devices} device(s))"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,7 +123,8 @@ def shard_fn(check_fn, mesh: Mesh, n_in: int = 6, n_out: int = 3):
     parallel — each device runs the unmodified kernel on its row
     shard, no collectives).  The defaults are the history checkers'
     6-in/3-out contract; the Elle cycle screens ride the same wrapper
-    at 1-in/1- or 2-out.  Cached per (fn, mesh, arity) on the fn
+    at 1-in/2- or 3-out (flags or packed screen planes, plus the
+    per-row closure-rounds evidence).  Cached per (fn, mesh, arity) on the fn
     object itself, the same lifetime as the
     ``make_check_fn``/``make_dense_fn`` caches, so repeat dispatches
     at a shape reuse ONE sharded executable — the per-call-site-mesh +

@@ -3,7 +3,8 @@ active-calibration singleton the engine's lookups consult.
 
 A calibration is the durable output of one ``jepsen_tpu tune`` sweep
 (:mod:`jepsen_tpu.tune.calibrate`): the measured-best engine knobs
-(window, flush rows, row-bucket floor, dense union lowering) plus a
+(window, flush rows, row-bucket floor, dense union lowering, closure
+mode for the Elle cycle screens) plus a
 per-(kernel, E, C, F) cost table, keyed by **device kind + device
 count + code fingerprint** so an artifact tuned on one chip (or one
 engine revision) can never silently steer another.  The engine loads
@@ -47,14 +48,18 @@ DEFAULT_PATH = "calibration.json"
 _FINGERPRINT_FILES = (
     "engine/execution.py",
     "engine/planning.py",
+    "ops/cycles.py",
     "ops/dense.py",
     "ops/wgl.py",
 )
 
 #: params every artifact carries; used by the round-trip/schema tests
-PARAM_KEYS = ("window", "flush_rows", "row_bucket", "union_mode")
+PARAM_KEYS = ("window", "flush_rows", "row_bucket", "union_mode",
+              "closure_mode")
 
-_VALID_UNIONS = ("unroll", "gather")
+_VALID_UNIONS = ("unroll", "gather", "matmul")
+
+_VALID_CLOSURES = ("fixed", "earlyexit")
 
 
 def code_fingerprint() -> str:
@@ -92,7 +97,8 @@ class Calibration:
     Constructed from the raw artifact dict (already schema-checked by
     :func:`load_calibration`); exposes the engine-facing lookups —
     :meth:`window`, :meth:`flush_rows`, :meth:`row_bucket`,
-    :meth:`union_mode`, and the interpolating :meth:`cost` table."""
+    :meth:`union_mode`, :meth:`closure_mode`, and the interpolating
+    :meth:`cost` table."""
 
     def __init__(self, data: Dict[str, Any]):
         self.data = data
@@ -126,6 +132,9 @@ class Calibration:
 
     def union_mode(self) -> str:
         return str(self.params["union_mode"])
+
+    def closure_mode(self) -> str:
+        return str(self.params["closure_mode"])
 
     def has_cost_table(self) -> bool:
         return bool(self._table)
@@ -199,8 +208,10 @@ def _proxy(kernel: str, E: int, C: int, F: int) -> float:
         return float(max(E, 1))
     if kernel == "cycles":
         # the Elle screens' boolean matrix closure: E is the vertex
-        # bucket, per-row work scales with the E×E matrix
-        return float(max(E, 1)) * max(E, 1)
+        # bucket, F the packed plane weight (filter masks + lifted
+        # walk queries folded into the batch axis), per-row work
+        # scales with F planes of E×E matmul squaring
+        return float(max(E, 1)) * max(E, 1) * max(F, 1)
     words = max(1, -(-max(E, 1) // 32))
     return float(max(F, 1) * (max(C, 0) + 1) * words)
 
@@ -255,6 +266,8 @@ def validate(data: Any) -> Dict[str, Any]:
         raise ValueError("row_bucket must be a power of two")
     if p["union_mode"] not in _VALID_UNIONS:
         raise ValueError(f"unknown union_mode {p['union_mode']!r}")
+    if p["closure_mode"] not in _VALID_CLOSURES:
+        raise ValueError(f"unknown closure_mode {p['closure_mode']!r}")
     for e in data.get("cost_table", ()):
         for k in ("kernel", "E", "C", "F", "rows", "seconds"):
             if k not in e:

@@ -3,11 +3,15 @@
 Replaces the engine's hand-pinned dispatch constants with measured
 picks for the *attached* device (ROADMAP item 4): a coordinate-descent
 search (the schedule-fine-tuning shape of arXiv:2406.20037, sized for
-our four-knob space) from the current defaults over
+our five-knob space) from the current defaults over
 
 - ``union_mode`` — the dense subset-union lowering (the stable ~1.6×
   unroll/gather gap in BENCH_tpu_windows.jsonl is exactly what this
-  coordinate re-measures per chip),
+  coordinate re-measures per chip; ``matmul`` recasts the subset maps
+  as one-hot MXU matmuls),
+- ``closure_mode`` — fixed-round vs convergence-early-exit boolean
+  closure in the Elle cycle screens (the sync cost of the early-exit
+  ``while_loop`` only pays off at large vertex buckets),
 - ``window`` — the engine's in-flight dispatch bound,
 - ``flush_rows`` — the streaming bucket flush threshold,
 - ``row_bucket`` — the power-of-two dispatch-row floor,
@@ -60,15 +64,18 @@ PROFILES: Dict[str, Dict[str, Any]] = {
     # L=40 sweep corpus picked a union mode 2× slower at L=200)
     "default": dict(
         n_hists=32, n_ops=160, n_procs=3, reps=2, passes=2,
-        windows=(1, 2, 4, 8), unions=("unroll", "gather"),
+        windows=(1, 2, 4, 8), unions=("unroll", "gather", "matmul"),
+        closures=("fixed", "earlyexit"),
         flush_rows=(4096, 16384, 65536), row_buckets=(32, 64, 128),
-        cost_rows=(32, 128), screen_ns=(16, 64), budget_s=100.0,
+        cost_rows=(32, 128), screen_ns=(16, 64), n_graphs=24,
+        budget_s=100.0,
     ),
     "smoke": dict(
         n_hists=10, n_ops=12, n_procs=3, reps=1, passes=1,
-        windows=(1, 4), unions=("unroll", "gather"),
+        windows=(1, 4), unions=("unroll", "gather", "matmul"),
+        closures=("fixed", "earlyexit"),
         flush_rows=(16384,), row_buckets=(64,),
-        cost_rows=(8,), screen_ns=(16,), budget_s=30.0,
+        cost_rows=(8,), screen_ns=(16,), n_graphs=6, budget_s=30.0,
     ),
 }
 
@@ -127,7 +134,9 @@ def _corpora(profile: Dict[str, Any]):
     frontier-routed CAS-register batch (every history encodable, so
     timings are pure device+host pipeline, no oracle noise), plus a
     decomposable multi-register batch for the decomposed route's cost
-    evidence."""
+    evidence, plus an ``"elle"`` list of encoded dependency graphs so
+    the ``closure_mode`` coordinate has screen traffic to rank (NOT a
+    ``(model, hists)`` pair — the history loops skip this key)."""
     import random
 
     from .. import models as m
@@ -148,7 +157,32 @@ def _corpora(profile: Dict[str, Any]):
     return {
         "cas": (m.cas_register(0), cas),
         "multi-register": (m.multi_register({k: 0 for k in range(4)}), mr),
+        "elle": _screen_corpus(profile.get("n_graphs", 8)),
     }
+
+
+def _screen_corpus(n_graphs: int):
+    """Deterministic encoded graphs for the screen timings: ring and
+    chain relation matrices at the canonical no-suffix filter profile
+    (the same shapes the cost-table cycles arm measures), spread over
+    two vertex buckets so packed plane stacks of both shapes warm."""
+    import numpy as np
+
+    from ..elle import encode as encode_mod
+
+    masks, nonadj = (1, 3, 7), ((4, 3),)
+    encs = []
+    for g in range(max(1, n_graphs)):
+        n = 16 if g % 2 == 0 else 32
+        rel = np.zeros((n, n), np.uint8)
+        for i in range(n - 1):
+            rel[i, i + 1] = (1, 2, 4)[(g + i) % 3]
+        if g % 2 == 0:
+            rel[n - 1, 0] = 1  # close into a ring
+        encs.append(encode_mod.EncodedGraph(
+            list(range(n)), rel, 7, masks, nonadj
+        ))
+    return encs
 
 
 def _phase_seconds(reg) -> Tuple[float, float]:
@@ -192,6 +226,29 @@ class _Runner:
             ex.submit(pb)
         ex.drain()
         wall = time.perf_counter() - t0
+        self._collect_budget(ex)
+        return wall
+
+    def timed_screens(self, encs, *, window: int, reps: int) -> float:
+        """Wall seconds of one screen pass over encoded dependency
+        graphs (best of ``reps`` after one un-timed warmup) — the
+        traffic the ``closure_mode`` coordinate ranks on.  Same
+        production Executor, same budget evidence."""
+        from ..engine import execution
+        from ..ops import cycles as ops_cycles
+
+        def one() -> float:
+            ex = execution.Executor(window)
+            t0 = time.perf_counter()
+            ops_cycles.screen_graphs(encs, executor=ex)
+            wall = time.perf_counter() - t0
+            self._collect_budget(ex)
+            return wall
+
+        one()  # warmup: compiles
+        return min(one() for _ in range(reps))
+
+    def _collect_budget(self, ex) -> None:
         for acct in ex.chip_row_accounting.values():
             cap = acct["chip_cap"]
             if acct["kernel"] == "dense":
@@ -206,7 +263,6 @@ class _Runner:
             self.budget_evidence.append(ev)
             if not ev["within_budget"]:  # engine invariant — loudly
                 self.budget_breaches.append(ev)
-        return wall
 
 
 def measure_config(runner: _Runner, corpora, cfg: Dict[str, Any],
@@ -217,7 +273,8 @@ def measure_config(runner: _Runner, corpora, cfg: Dict[str, Any],
     model, cas = corpora["cas"]
     total = 0.0
     with _env(JEPSEN_TPU_DENSE_UNION=cfg["union_mode"],
-              JEPSEN_TPU_ENGINE_ROW_BUCKET=cfg["row_bucket"]):
+              JEPSEN_TPU_ENGINE_ROW_BUCKET=cfg["row_bucket"],
+              JEPSEN_TPU_CYCLES_CLOSURE=cfg["closure_mode"]):
         for max_closure in (None, 9):  # dense route, then frontier
             kw = dict(window=cfg["window"], flush_rows=cfg["flush_rows"],
                       max_closure=max_closure)
@@ -225,6 +282,9 @@ def measure_config(runner: _Runner, corpora, cfg: Dict[str, Any],
             total += min(
                 runner.timed_run(model, cas, **kw) for _ in range(reps)
             )
+        total += runner.timed_screens(
+            corpora["elle"], window=cfg["window"], reps=reps
+        )
     obs.count("jepsen_tune_measurements_total", phase="sweep")
     return total
 
@@ -236,16 +296,19 @@ def coordinate_descent(runner: _Runner, corpora, profile: Dict[str, Any],
     budget runs out — the partial result is still valid: every visited
     config was really measured)."""
     from ..engine import execution, planning
+    from ..ops import cycles as ops_cycles
     from ..ops import dense
 
     space = {
         "union_mode": tuple(profile["unions"]),
+        "closure_mode": tuple(profile["closures"]),
         "window": tuple(profile["windows"]),
         "flush_rows": tuple(profile["flush_rows"]),
         "row_bucket": tuple(profile["row_buckets"]),
     }
     current = {
         "union_mode": dense.DEFAULT_UNION,
+        "closure_mode": ops_cycles.DEFAULT_CLOSURE_MODE,
         "window": execution.DEFAULT_WINDOW,
         "flush_rows": planning.DEFAULT_FLUSH_ROWS,
         "row_bucket": execution.ROW_BUCKET,
@@ -310,8 +373,13 @@ def measure_cost_table(runner: _Runner, corpora, profile: Dict[str, Any],
     from ..engine import planning
 
     entries: List[dict] = []
-    with _env(JEPSEN_TPU_DENSE_UNION=params["union_mode"]):
-        for name, (model, hists) in corpora.items():
+    with _env(JEPSEN_TPU_DENSE_UNION=params["union_mode"],
+              JEPSEN_TPU_CYCLES_CLOSURE=params["closure_mode"]):
+        for name, pair in corpora.items():
+            if name == "elle":
+                continue  # encoded graphs, not (model, hists) — the
+                # screen shapes get their own arm below
+            model, hists = pair
             for max_closure in (None, 9):
                 ctx = planning.RunContext(model, hists,
                                           oracle_fallback=False)
@@ -355,41 +423,45 @@ def measure_cost_table(runner: _Runner, corpora, profile: Dict[str, Any],
                             "seconds": round(secs, 6),
                             "corpus": name,
                         })
-    # the Elle transactional screens: (kernel="cycles", E=n, C=0, F=1)
-    # rows, so the measured table ranks screen buckets in the same
-    # seconds unit as history buckets (the daemon's largest-cost-first
-    # ordering compares them directly).  Deterministic ring/chain
-    # relation matrices at the canonical no-suffix filter profile.
+    # the Elle transactional screens: (kernel="cycles", E=n, C=0,
+    # F=plane weight) rows — F counts the packed closure planes the
+    # profile expands into on the batch axis — so the measured table
+    # ranks screen buckets in the same seconds unit as history buckets
+    # (the daemon's largest-cost-first ordering compares them
+    # directly).  Deterministic ring/chain relation matrices at the
+    # canonical no-suffix filter profile, under the swept closure mode.
     from ..ops import cycles as ops_cycles
 
     masks, nonadj = (1, 3, 7), ((4, 3),)
-    for n in profile.get("screen_ns", ()):
-        plan = ops_cycles.ScreenPlan(n, masks, nonadj)
-        if plan.disp == 0:
-            continue
-        for rows in profile["cost_rows"]:
-            if not proposal_within_budget(plan, rows, params["window"]):
-                obs.count("jepsen_tune_budget_rejections_total")
+    with _env(JEPSEN_TPU_CYCLES_CLOSURE=params["closure_mode"]):
+        for n in profile.get("screen_ns", ()):
+            plan = ops_cycles.ScreenPlan(n, masks, nonadj)
+            if plan.disp == 0:
                 continue
-            rel = np.zeros((rows, n, n), np.uint8)
-            for b in range(rows):
-                for i in range(n - 1):
-                    rel[b, i, i + 1] = (1, 2, 4)[(b + i) % 3]
-                if b % 2 == 0:
-                    rel[b, n - 1, 0] = 1  # close into a ring
-            args = jnp.asarray(rel)
-            out = plan.fn(args)  # warmup: trace + compile
-            out[0].block_until_ready()
-            t0 = time.perf_counter()
-            out = plan.fn(args)
-            out[0].block_until_ready()
-            secs = time.perf_counter() - t0
-            obs.count("jepsen_tune_measurements_total", phase="cost")
-            entries.append({
-                "kernel": "cycles", "E": n, "C": 0, "F": 1,
-                "rows": rows, "seconds": round(secs, 6),
-                "corpus": "elle-screen",
-            })
+            for rows in profile["cost_rows"]:
+                if not proposal_within_budget(plan, rows, params["window"]):
+                    obs.count("jepsen_tune_budget_rejections_total")
+                    continue
+                rel = np.zeros((rows, n, n), np.uint8)
+                for b in range(rows):
+                    for i in range(n - 1):
+                        rel[b, i, i + 1] = (1, 2, 4)[(b + i) % 3]
+                    if b % 2 == 0:
+                        rel[b, n - 1, 0] = 1  # close into a ring
+                args = jnp.asarray(rel)
+                out = plan.fn(args)  # warmup: trace + compile
+                out[0].block_until_ready()
+                t0 = time.perf_counter()
+                out = plan.fn(args)
+                out[0].block_until_ready()
+                secs = time.perf_counter() - t0
+                obs.count("jepsen_tune_measurements_total", phase="cost")
+                entries.append({
+                    "kernel": "cycles", "E": n, "C": 0,
+                    "F": plan.frontier,
+                    "rows": rows, "seconds": round(secs, 6),
+                    "corpus": "elle-screen",
+                })
     # one point per (kernel, E, C, F, rows): keep the fastest (least
     # noisy) observation when corpora overlap in shape
     best: Dict[tuple, dict] = {}

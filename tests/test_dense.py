@@ -320,12 +320,14 @@ def test_mr_dense_golden_cross_register():
     assert out_bad["valid?"] is False
 
 
-def test_union_unroll_mode_matches_gather(monkeypatch):
-    """The unrolled static-shuffle subset maps
-    (JEPSEN_TPU_DENSE_UNION=unroll) must produce identical verdicts and
-    failure indices to the default take_along_axis path on a corrupted
-    mixed corpus — the on-chip A/B in RESULTS.md's roofline plan is only
-    meaningful if the two lowerings are bit-equivalent."""
+@pytest.mark.parametrize("union", ["unroll", "matmul"])
+def test_union_mode_matches_gather(monkeypatch, union):
+    """The unrolled static-shuffle and one-hot-matmul subset maps
+    (JEPSEN_TPU_DENSE_UNION=unroll/matmul) must produce identical
+    verdicts and failure indices to the default take_along_axis path
+    on a corrupted mixed corpus — the on-chip A/B in RESULTS.md's
+    roofline plan is only meaningful if the lowerings are
+    bit-equivalent."""
     import random
 
     from jepsen_tpu import models as m
@@ -350,7 +352,7 @@ def test_union_unroll_mode_matches_gather(monkeypatch):
 
     monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "gather")
     ok_g, fail_g, _ = dense.make_dense_fn("cas-register", E, C, V)(*args)
-    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", union)
     ok_u, fail_u, _ = dense.make_dense_fn("cas-register", E, C, V)(*args)
     import numpy as np
 
@@ -359,9 +361,11 @@ def test_union_unroll_mode_matches_gather(monkeypatch):
     assert not np.asarray(ok_g).all()  # the corpus really has invalids
 
 
-def test_queue_union_unroll_matches_gather(monkeypatch):
-    """The unroll lowering must also be bit-equivalent on the queue
-    kernel (its own closure/completion use the same subset maps)."""
+@pytest.mark.parametrize("union", ["unroll", "matmul"])
+def test_queue_union_mode_matches_gather(monkeypatch, union):
+    """The unroll and matmul lowerings must also be bit-equivalent on
+    the queue kernel (its own closure/completion use the same subset
+    maps)."""
     import random
 
     import numpy as np
@@ -383,7 +387,14 @@ def test_queue_union_unroll_matches_gather(monkeypatch):
             batch.cand_f, batch.cand_a, batch.cand_b)
     monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "gather")
     ok_g, fail_g, _ = dense.make_dense_fn("unordered-queue", E, C, 0)(*args)
-    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
+    monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", union)
     ok_u, fail_u, _ = dense.make_dense_fn("unordered-queue", E, C, 0)(*args)
     assert (np.asarray(ok_g) == np.asarray(ok_u)).all()
     assert (np.asarray(fail_g) == np.asarray(fail_u)).all()
+
+
+def test_unknown_union_mode_rejected():
+    from jepsen_tpu.ops import dense
+
+    with pytest.raises(ValueError):
+        dense.build_dense("cas-register", 8, 4, 8, union="zip")

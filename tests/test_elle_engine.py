@@ -230,33 +230,37 @@ def test_screen_plan_budget_and_cost_ranking():
 
 
 def test_calibration_cost_table_serves_cycles_rows(tmp_path):
-    """A calibration artifact with (kernel="cycles", n, B) rows drives
-    estimated_cost for screen buckets — measured seconds, not the
-    analytic proxy — and cross-kernel scaling uses the cycles
-    footprint."""
+    """A calibration artifact with packed (kernel="cycles", E=n, C=0,
+    F=plane-weight) rows drives estimated_cost for screen buckets —
+    measured seconds, not the analytic proxy — and unmeasured shapes
+    scale by the E²·F packed footprint."""
     from jepsen_tpu.tune import artifact
 
     data = artifact.build_artifact(
         {"window": 4, "flush_rows": 16384, "row_bucket": 64,
-         "union_mode": "unroll"},
-        [{"kernel": "cycles", "E": 16, "C": 0, "F": 1, "rows": 8,
+         "union_mode": "unroll", "closure_mode": "fixed"},
+        [{"kernel": "cycles", "E": 16, "C": 0, "F": 7, "rows": 8,
           "seconds": 0.004},
-         {"kernel": "cycles", "E": 16, "C": 0, "F": 1, "rows": 32,
+         {"kernel": "cycles", "E": 16, "C": 0, "F": 7, "rows": 32,
           "seconds": 0.01}],
         "cpu", 1, created_at="2026-08-04T00:00:00+00:00",
     )
     cal = artifact.Calibration(data)
-    assert cal.cost("cycles", 16, 0, 1, 8) == pytest.approx(0.004)
-    assert cal.cost("cycles", 16, 0, 1, 20) == pytest.approx(
+    assert cal.cost("cycles", 16, 0, 7, 8) == pytest.approx(0.004)
+    assert cal.cost("cycles", 16, 0, 7, 20) == pytest.approx(
         0.004 + (0.01 - 0.004) * 12 / 24
     )
-    # unmeasured shape scales the measured neighbor by the E² proxy
-    assert cal.cost("cycles", 32, 0, 1, 8) == pytest.approx(
+    # unmeasured vertex bucket scales the measured neighbor by the E²
+    # proxy (the shared plane weight cancels)
+    assert cal.cost("cycles", 32, 0, 7, 8) == pytest.approx(
         0.004 * (32 * 32) / (16 * 16)
     )
+    # unmeasured plane weight scales linearly in F
+    assert cal.cost("cycles", 16, 0, 14, 8) == pytest.approx(0.004 * 2)
     artifact.set_active(cal)
     try:
         plan = ops_cycles.ScreenPlan(16, (1, 3, 7), ((4, 3),))
+        assert plan.frontier == 7  # 3 masks + 4 per lifted query
         pb = planning.PlannedBucket(None, plan, None,
                                     [(None, i) for i in range(8)])
         assert planning.estimated_cost(pb) == pytest.approx(0.004)
@@ -265,20 +269,178 @@ def test_calibration_cost_table_serves_cycles_rows(tmp_path):
 
 
 def test_tune_cost_table_measures_cycles(tmp_path):
-    """The offline sweep's cost table gains (kernel="cycles", n, B)
-    rows with the budget guardrail applied."""
+    """The offline sweep's cost table gains packed (kernel="cycles",
+    E=n, C=0, F=plane-weight) rows with the budget guardrail
+    applied."""
     from jepsen_tpu.tune import calibrate
 
     runner = calibrate._Runner()
     prof = dict(calibrate.PROFILES["smoke"])
     corpora = {}  # the cycles arm needs no history corpus
     params = {"window": 4, "flush_rows": 16384, "row_bucket": 64,
-              "union_mode": "unroll"}
+              "union_mode": "unroll", "closure_mode": "fixed"}
     entries = calibrate.measure_cost_table(runner, corpora, prof, params)
     cyc = [e for e in entries if e["kernel"] == "cycles"]
     assert cyc, entries
-    assert all(e["C"] == 0 and e["F"] == 1 and e["seconds"] >= 0
+    assert all(e["C"] == 0 and e["F"] == 7 and e["seconds"] >= 0
                for e in cyc)
+
+
+# ---------------------------------------------------------------------------
+# packed plane closures: equality, dot_general count, early-exit
+# ---------------------------------------------------------------------------
+
+
+def _screen_variants(n, masks, nonadj, rel):
+    """(packed, closure_mode) → (members, walks, rounds) over every
+    lowering of the screen kernel."""
+    out = {}
+    for packed in (True, False):
+        for cm in ("fixed", "earlyexit"):
+            fn = ops_cycles._screen_fn_variant(n, masks, nonadj, packed,
+                                               cm)
+            m_, w_, r_ = fn(rel)
+            out[(packed, cm)] = (np.asarray(m_), np.asarray(w_),
+                                 np.asarray(r_))
+    return out
+
+
+def test_packed_screens_match_per_mask_and_numpy():
+    """Plane-packed one-closure screens ≡ the historical per-mask
+    kernels ≡ the numpy oracle, on op-soup graph buckets from BOTH
+    workloads plus a synthetic all-bits profile covering the suffixed
+    masks and both lifted walk queries — every lowering × both closure
+    modes."""
+    rng = random.Random(45130)
+    encs = []
+    for mode, prep in (("rw-register", elle.rw_register.prepare),
+                       ("list-append", elle.list_append.prepare)):
+        for i in range(10):
+            h = _soup_history(rng, mode, rng.randrange(4, 14), 3,
+                              corrupt=(i % 2 == 0))
+            g = prep(h, {"workload": mode})[0]
+            encs.append(elle_encode.encode_graph(g))
+    buckets, order = elle_encode.bucket_graphs(encs)
+    checked = 0
+    for key in order:
+        n, masks, nonadj = key
+        rel = elle_encode.stack_rel([encs[i] for i in buckets[key]], n)
+        want_m, want_w = ops_cycles._np_screen(rel, masks, nonadj)
+        for var, (m_, w_, _r) in _screen_variants(
+            n, masks, nonadj, rel
+        ).items():
+            assert np.array_equal(m_, want_m), (key, var)
+            assert np.array_equal(w_, want_w), (key, var)
+            checked += 1
+    assert checked >= 8, order
+    # the full suffixed ladder (all five relation bits, both lifted
+    # queries) — op-soup graphs canonicalize PR bits away, so pin the
+    # realtime/process family on synthetic all-bits batches
+    masks, nonadj = (1, 3, 7, 25, 27, 31), ((4, 3), (4, 27))
+    nprng = np.random.default_rng(45131)
+    for n in (16, 32):
+        rel = (nprng.integers(0, 32, size=(6, n, n))
+               * (nprng.random((6, n, n)) < 0.08)).astype(np.uint8)
+        want_m, want_w = ops_cycles._np_screen(rel, masks, nonadj)
+        for var, (m_, w_, _r) in _screen_variants(
+            n, masks, nonadj, rel
+        ).items():
+            assert np.array_equal(m_, want_m), (n, var)
+            assert np.array_equal(w_, want_w), (n, var)
+
+
+def _count_dot_generals(jaxpr) -> int:
+    """Batched-matmul count of a closed jaxpr: dot_general equations,
+    recursing through pjit calls and multiplying scan bodies by their
+    static trip count."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += 1
+        elif name == "pjit":
+            total += _count_dot_generals(eqn.params["jaxpr"].jaxpr)
+        elif name == "scan":
+            total += (eqn.params["length"]
+                      * _count_dot_generals(eqn.params["jaxpr"].jaxpr))
+    return total
+
+
+def test_packed_screen_jaxpr_dot_general_count():
+    """The peak-FLOP pin: a 5-filter packed screen bucket lowers to at
+    most log₂(n)+2 batched dot_generals (one fused closure over the
+    plane stack), where the per-mask reference pays ~5·log₂(n)."""
+    import math
+
+    import jax
+
+    n = 32
+    masks = (1, 3, 7, 25, 31)
+    rel = np.zeros((4, n, n), np.uint8)
+    rounds = math.ceil(math.log2(n))
+    packed = _count_dot_generals(
+        jax.make_jaxpr(
+            ops_cycles._screen_fn_variant(n, masks, (), True, "fixed")
+        )(rel).jaxpr
+    )
+    assert packed <= rounds + 2, packed
+    per_mask = _count_dot_generals(
+        jax.make_jaxpr(
+            ops_cycles._screen_fn_variant(n, masks, (), False, "fixed")
+        )(rel).jaxpr
+    )
+    assert per_mask >= len(masks) * rounds, per_mask
+
+
+def test_earlyexit_closure_identical_across_diameters():
+    """Early-exit ≡ fixed-round has-cycle flags over chain/ring
+    diameters 1..n, with the early exit never running MORE rounds and
+    strictly saving on short-diameter batches."""
+    n = 16
+    fixed_fn = ops_cycles._closure_fn(n, "fixed")
+    early_fn = ops_cycles._closure_fn(n, "earlyexit")
+    saved_somewhere = False
+    for d in range(1, n + 1):
+        adj = np.zeros((2, n, n), bool)
+        for i in range(d):
+            adj[0, i, (i + 1) % n] = True   # d=n closes into a ring
+        for i in range(min(d, n - 1)):
+            adj[1, i, i + 1] = True         # acyclic chain twin
+        f_flags, f_rounds = fixed_fn(adj)
+        e_flags, e_rounds = early_fn(adj)
+        assert np.array_equal(np.asarray(f_flags), np.asarray(e_flags)), d
+        assert int(np.asarray(e_rounds).max()) <= int(
+            np.asarray(f_rounds).max()
+        ), d
+        if int(np.asarray(e_rounds).max()) < int(
+            np.asarray(f_rounds).max()
+        ):
+            saved_somewhere = True
+    assert saved_somewhere
+
+
+def test_screen_settle_records_rounds_metrics():
+    """The engine-routed screens surface per-dispatch closure-rounds
+    evidence: the rounds counter and the saved-rounds counter (labelled
+    by closure mode) plus the packed-plane occupancy gauge."""
+    from jepsen_tpu import obs
+
+    graphs = [_rw_chain(9, i % 2 == 0) for i in range(6)]
+    encs = [elle_encode.encode_graph(g) for g in graphs]
+    obs.enable(reset=True)
+    try:
+        res = ops_cycles.screen_graphs(encs)
+        assert all(r is not None for r in res)
+        reg = obs.registry()
+        mode = ops_cycles.closure_mode()
+        assert (reg.value("jepsen_cycles_closure_rounds_total",
+                          mode=mode) or 0) > 0
+        assert reg.value("jepsen_cycles_closure_rounds_saved_total",
+                         mode=mode) is not None
+        occ = reg.value("jepsen_cycles_packed_plane_occupancy")
+        assert occ is not None and 0.0 < occ <= 1.0, occ
+    finally:
+        obs.enable(reset=True)
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ import pytest
 from jepsen_tpu import models as m
 from jepsen_tpu import tune
 from jepsen_tpu.engine import execution, planning
+from jepsen_tpu.ops import cycles as ops_cycles
 from jepsen_tpu.ops import dense, wgl
 from jepsen_tpu.synth import generate_history
 from jepsen_tpu.tune import artifact as art
@@ -33,7 +34,7 @@ def make_data(**over):
     code (loads cleanly unless a test breaks it on purpose)."""
     kind, n = art.device_key()
     params = {"window": 7, "flush_rows": 123, "row_bucket": 128,
-              "union_mode": "gather"}
+              "union_mode": "gather", "closure_mode": "fixed"}
     params.update(over.pop("params", {}))
     cost = over.pop("cost_table", [
         {"kernel": "dense", "E": 64, "C": 4, "F": 64, "rows": 32,
@@ -78,6 +79,7 @@ def test_artifact_round_trip_is_byte_stable(tmp_path):
     assert cal.flush_rows() == 123
     assert cal.row_bucket() == 128
     assert cal.union_mode() == "gather"
+    assert cal.closure_mode() == "fixed"
 
 
 def test_artifact_schema_pins_param_keys():
@@ -87,7 +89,7 @@ def test_artifact_schema_pins_param_keys():
     data = make_data()
     assert set(data["params"]) == set(art.PARAM_KEYS)
     assert art.PARAM_KEYS == ("window", "flush_rows", "row_bucket",
-                              "union_mode")
+                              "union_mode", "closure_mode")
     assert data["version"] == art.SCHEMA_VERSION == 1
     for field in ("calibration_id", "device_kind", "n_devices",
                   "code_fingerprint", "cost_table"):
@@ -100,6 +102,8 @@ def test_artifact_schema_pins_param_keys():
     lambda d: d["params"].pop("window"),
     lambda d: d["params"].update(row_bucket=48),   # not a power of two
     lambda d: d["params"].update(union_mode="zip"),
+    lambda d: d["params"].update(closure_mode="adaptive"),
+    lambda d: d["params"].pop("closure_mode"),
     lambda d: d["params"].update(window=0),
 ])
 def test_validate_rejects_broken_artifacts(breaker):
@@ -165,6 +169,7 @@ def test_bad_artifact_leaves_engine_on_defaults_no_crash(
     assert planning.flush_rows_default() == planning.DEFAULT_FLUSH_ROWS
     assert execution.row_bucket_floor() == execution.ROW_BUCKET
     assert dense._union_mode() == dense.DEFAULT_UNION
+    assert ops_cycles.closure_mode() == ops_cycles.DEFAULT_CLOSURE_MODE
     model = m.cas_register(0)
     hists = corpus()
     got = wgl.check_batch(model, hists, slot_cap=32)
@@ -182,6 +187,10 @@ def test_lookups_serve_calibrated_values():
     assert planning.flush_rows_default() == 123
     assert execution.row_bucket_floor() == 128
     assert dense._union_mode() == "gather"
+    assert ops_cycles.closure_mode() == "fixed"
+    cal2 = art.Calibration(make_data(params={"closure_mode": "earlyexit"}))
+    tune.set_active(cal2)
+    assert ops_cycles.closure_mode() == "earlyexit"
 
 
 def test_env_beats_calibration(monkeypatch):
@@ -191,10 +200,12 @@ def test_env_beats_calibration(monkeypatch):
     monkeypatch.setenv("JEPSEN_TPU_ENGINE_FLUSH_ROWS", "999")
     monkeypatch.setenv("JEPSEN_TPU_ENGINE_ROW_BUCKET", "32")
     monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
+    monkeypatch.setenv("JEPSEN_TPU_CYCLES_CLOSURE", "earlyexit")
     assert execution.default_window() == 2
     assert planning.flush_rows_default() == 999
     assert execution.row_bucket_floor() == 32
     assert dense._union_mode() == "unroll"
+    assert ops_cycles.closure_mode() == "earlyexit"
 
 
 def test_row_bucket_env_rounds_to_pow2(monkeypatch):
