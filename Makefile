@@ -2,7 +2,7 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
@@ -11,7 +11,7 @@ test:
 # observability, pipeline, checker-service, slice-dispatch,
 # decomposition, auto-tune, transactional-screen, and closure/union
 # kernel smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke
+check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke
 
 # jtlint static analysis (doc/static-analysis.md): trace-safety,
 # lock-discipline, obs-hygiene, protocol conformance.  Fails on any
@@ -96,6 +96,15 @@ elle-smoke:
 kernels-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.ops.smoke
 	env JAX_PLATFORMS=cpu JEPSEN_TPU_ENGINE_MESH=1 python -m jepsen_tpu.ops.smoke
+
+# fleet-telemetry gate (doc/observability.md "Fleet telemetry"): two
+# concurrent service-routed runs through an in-process daemon with a
+# dispatch journal; fails on an unstitched trace (missing cross-seam
+# flow events or a dead /trace endpoint), a schema-invalid or
+# coalescing-blind journal, missing *_rate1m gauges / queue-wait in
+# the live exposition, or a broken `top --once` fleet view
+obs-fleet-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.obs.fleet_smoke
 
 bench:
 	python bench.py

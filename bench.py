@@ -907,6 +907,15 @@ def bench_service():
             "warm_dispatches": diag_warm.get("warm_dispatches"),
             "warm_run_cold_dispatches": diag_warm.get("cold_dispatches"),
         })
+        try:
+            # the daemon's dispatch journal (obs.journal): where the
+            # per-dispatch evidence behind these numbers landed, and
+            # how many rows this bench contributed to it
+            st = client.status()
+            payload["journal_path"] = st.get("journal_path")
+            payload["journal_rows"] = st.get("journal_rows")
+        except Exception:  # noqa: BLE001 — telemetry never fails bench
+            pass
         if client.spawned_pid is None:
             payload["warnings"] = (
                 "attached to a pre-existing daemon (left running; "

@@ -448,6 +448,69 @@ def serve_cmd() -> Dict[str, dict]:
         )
         return EXIT_VALID
 
+    def add_top_opts(p):
+        add_daemon_opts(p)
+        p.add_argument(
+            "--daemon",
+            action="append",
+            default=[],
+            metavar="HOST:PORT",
+            help="additional daemon address (repeatable) — one block "
+            "per daemon in the fleet view",
+        )
+        p.add_argument(
+            "--once",
+            action="store_true",
+            help="render a single frame and exit (scripts/CI)",
+        )
+        p.add_argument(
+            "--interval",
+            type=float,
+            default=2.0,
+            help="refresh period in seconds (default 2)",
+        )
+
+    def top(args) -> int:
+        import time as time_mod
+
+        from .serve import ServiceClient, ServiceError, \
+            ServiceUnavailable, client as client_mod
+
+        clients = [ServiceClient(host=args.host, port=args.port,
+                                 timeout=2.0)]
+        for addr in args.daemon:
+            host, _, port = str(addr).rpartition(":")
+            try:
+                clients.append(
+                    ServiceClient(host=host or None, port=int(port),
+                                  timeout=2.0))
+            except ValueError:
+                print(f"bad --daemon address {addr!r} (want HOST:PORT)",
+                      file=sys.stderr)
+                return EXIT_USAGE
+
+        def frame() -> str:
+            blocks = []
+            for c in clients:
+                try:
+                    blocks.append(
+                        client_mod.format_top(c.host, c.port, c.status()))
+                except (ServiceError, ServiceUnavailable):
+                    blocks.append(f"○ {c.host}:{c.port}  (unreachable)")
+            return "\n".join(blocks)
+
+        if args.once:
+            print(frame())
+            return EXIT_VALID
+        try:
+            while True:
+                # clear + home, then the frame: a refreshing view
+                # without curses (stdlib-only, like the web UI)
+                print("\x1b[2J\x1b[H" + frame(), flush=True)
+                time_mod.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return EXIT_VALID
+
     return {
         "serve": {
             "help": "serve the store web UI (--checker: the resident "
@@ -464,6 +527,13 @@ def serve_cmd() -> Dict[str, dict]:
             "help": "drain and stop the resident checker service",
             "add_opts": add_daemon_opts,
             "run": shutdown,
+        },
+        "top": {
+            "help": "live fleet view of one or more checker daemons "
+            "(last-60s rates, queue wait, journal; --once for one "
+            "frame)",
+            "add_opts": add_top_opts,
+            "run": top,
         },
     }
 

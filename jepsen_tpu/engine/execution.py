@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .. import obs
+from ..obs import journal as obs_journal
 
 
 def row_bucket_target(n: int) -> int:
@@ -333,6 +334,10 @@ class Executor:
         #: per-device live/dispatched row totals (device occupancy)
         self._dev_rows_live: List[int] = [0] * self.n_devices
         self._dev_rows_total: List[int] = [0] * self.n_devices
+        #: extra per-dispatch journal fields the caller owns (the serve
+        #: daemon sets coalesced-run count + trace ids per group; the
+        #: device thread is the only mutator, so no guard)
+        self.journal_context: Dict[str, Any] = {}
 
     # -- stats the pipeline's telemetry reads -----------------------------
 
@@ -369,6 +374,7 @@ class Executor:
         fnk = ch["acct_key"]
         left = self._chip_rows_inflight.get(fnk, 0) - ch["chip_rows"]  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
         self._chip_rows_inflight[fnk] = max(0, left)  # jt: allow[lock-thread-confined] — synchronous on_retire, owner thread
+        elapsed = time.perf_counter() - t_dispatch
         if obs.enabled():
             # dispatch-to-materialized latency, split compile (first
             # dispatch of this fn at this shape: trace + XLA compile +
@@ -377,9 +383,11 @@ class Executor:
             # their sum can exceed wall clock by design
             obs.observe(
                 f"jepsen_kernel_{ch['phase']}_seconds",
-                time.perf_counter() - t_dispatch,
+                elapsed,
                 engine=plan.kernel,
             )
+        if obs_journal.active() is not None:
+            self._journal_dispatch(plan, ch, elapsed)
         settle = getattr(plan, "settle_rows", None)
         if settle is not None:
             # self-settling plan (the Elle cycle screens): the plan
@@ -396,6 +404,37 @@ class Executor:
             )
         else:
             self._assign_rows(plan, ch["rows"], ok, failed_at, overflow)
+
+    def _journal_dispatch(self, plan, ch: dict, elapsed: float) -> None:
+        """One pinned-schema journal row per settled dispatch
+        (obs.journal): the durable per-dispatch telemetry stream behind
+        the learned cost model and on-TPU bench windows.  Best-effort —
+        journal failures never fail a dispatch (emit() swallows them)."""
+        from ..ops import dense
+        from ..tune import artifact as _cal
+
+        cal = _cal.active()
+        compile_hit = ch["phase"] == "compile"
+        ctx = self.journal_context
+        obs_journal.emit(
+            kernel=str(plan.kernel),
+            E=int(getattr(plan, "E", 0) or 0),
+            C=int(getattr(plan, "C", 0) or 0),
+            F=int(getattr(plan, "frontier", 0) or 0),
+            rows=int(ch["n"]),
+            n_devices=int(self.n_devices),
+            mesh_shape=(list(self.mesh.devices.shape)
+                        if self.mesh is not None else [1]),
+            window=int(self.window_size),
+            compile_s=round(elapsed, 6) if compile_hit else 0.0,
+            execute_s=0.0 if compile_hit else round(elapsed, 6),
+            coalesced=int(ctx.get("coalesced", 1)),
+            cache="miss" if compile_hit else "hit",
+            closure_mode=str(getattr(plan, "closure_mode", "") or ""),
+            union=(dense._union_mode() if plan.kernel == "dense" else ""),
+            calibration=(cal.calibration_id if cal is not None else ""),
+            trace_id=str(ctx.get("trace_id", "") or ""),
+        )
 
     def _settle_rows(self, plan, arrays, rows, ok, failed_at, overflow):
         """Escalate a chunk's overflows on-device, then assign verdicts

@@ -31,6 +31,12 @@ Rules:
 - ``obs-metric-doc`` — a literal ``jepsen_*`` metric name recorded in
   code but missing from doc/observability.md's metric inventory:
   the doc is the operator contract; undocumented series are drift.
+- ``obs-rate-kind`` — a ``*_rate1m`` metric name recorded as anything
+  but a gauge: the ``_rate1m`` suffix is RESERVED for the
+  sliding-window gauges ``metrics.prometheus_text`` synthesizes from
+  cumulative instruments (doc/observability.md 'Fleet telemetry');
+  hand-recording one as a counter/histogram would collide with the
+  derived family.
 """
 
 from __future__ import annotations
@@ -95,7 +101,7 @@ def _metric_call(node: ast.Call) -> Optional[str]:
 class ObsHygiene(Pass):
     name = "obs-hygiene"
     rules = ("obs-span-discipline", "obs-metric-name", "obs-metric-kind",
-             "obs-metric-doc")
+             "obs-metric-doc", "obs-rate-kind")
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
@@ -213,6 +219,14 @@ class ObsHygiene(Pass):
                                " `jepsen_[a-z0-9_]*` naming convention"
                                " (doc/observability.md)")
                 else:
+                    if name.endswith("_rate1m") and kind != "gauge":
+                        self._emit(out, sf, "obs-rate-kind", node, scope,
+                                   f"metric {name!r} recorded as {kind}:"
+                                   " the `_rate1m` suffix is reserved for"
+                                   " the sliding-window gauges the"
+                                   " exposition synthesizes — record the"
+                                   " cumulative series and let"
+                                   " prometheus_text derive the rate")
                     sites.setdefault(name, []).append((kind, sf, node))
             elif isinstance(arg, ast.JoinedStr):
                 head = arg.values[0] if arg.values else None

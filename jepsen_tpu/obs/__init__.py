@@ -39,6 +39,7 @@ import os
 from typing import Optional
 
 from . import export as export_mod
+from . import propagate as propagate_mod
 from .metrics import MetricsRegistry
 from .tracer import NULL_SPAN, SpanRecord, Tracer  # noqa: F401 (re-export)
 
@@ -70,6 +71,7 @@ def enable(reset: bool = False) -> None:
     if reset:
         _tracer.reset()
         _registry.reset()
+        propagate_mod.reset()
     _tracer.enabled = True
     _registry.enabled = True
 
@@ -82,6 +84,7 @@ def disable() -> None:
 def reset() -> None:
     _tracer.reset()
     _registry.reset()
+    propagate_mod.reset()
 
 
 # -- span + metric shorthands (the instrumentation surface) -----------------

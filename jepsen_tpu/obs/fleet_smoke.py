@@ -1,0 +1,216 @@
+"""Fleet-telemetry smoke check: ``python -m jepsen_tpu.obs.fleet_smoke``.
+
+Brings a resident checker daemon up in-process with a dispatch
+journal, pushes two concurrent service-routed runs through it, and
+proves the fleet-telemetry acceptance gates (doc/observability.md
+"Fleet telemetry"):
+
+- **stitched traces**: each run's ``trace_ctx`` links the client-side
+  span to the daemon-side spans — the exported Chrome trace carries
+  flow events (``ph`` s/t/f, cat ``trace_ctx``) connecting both
+  sides of every traced run, and ``GET /trace?ctx=`` serves the
+  daemon's span dump for a given trace id;
+- **dispatch journal**: every device dispatch appended one
+  schema-valid row; a coalesced group's rows record ``coalesced >=
+  2``; ``tune.calibrate.journal_rows`` reads them back as cost
+  evidence;
+- **live windowed metrics**: ``/metrics`` still passes the Prometheus
+  validator and now exports ``*_rate1m`` gauges; ``/status`` carries
+  the last-60 s ``live`` view including the queue-wait mean
+  (``jepsen_serve_queue_wait_seconds``);
+- **fleet view**: ``jepsen_tpu top --once`` renders the fleet block
+  from a live daemon.
+
+Wired into ``make obs-fleet-smoke`` / ``make check``.  Exit codes:
+0 ok, 1 any gate failed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+
+
+def _corpus(seed: int, n: int = 8):
+    from jepsen_tpu.synth import generate_history
+
+    rng = random.Random(seed)
+    return [
+        generate_history(rng, n_procs=3, n_ops=12, crash_p=0.02,
+                         corrupt=(i % 2 == 0))
+        for i in range(n)
+    ]
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu import cli, models as m, obs
+    from jepsen_tpu.obs import export as obs_export
+    from jepsen_tpu.obs import journal as obs_journal
+    from jepsen_tpu.obs import propagate
+    from jepsen_tpu.serve import CheckerDaemon, ServiceClient, protocol
+    from jepsen_tpu.tune import calibrate
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    obs.enable(reset=True)
+    tmp = tempfile.mkdtemp(prefix="jt-fleet-smoke-")
+    jpath = os.path.join(tmp, obs_journal.DEFAULT_FILENAME)
+    model = m.cas_register(0)
+    batch_a = _corpus(45100)
+    batch_b = _corpus(977)
+
+    daemon = CheckerDaemon(port=0, coalesce_wait_s=0.75,
+                           journal_path=jpath)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        check(client.healthy(), "daemon did not come up healthy")
+
+        # one solo run (compiles), then two concurrent runs that
+        # coalesce into shared dispatches
+        client.check_batch(model, batch_a, max_dispatch=4)
+        barrier = threading.Barrier(2)
+        out = {}
+
+        def post(tag, hists):
+            c = ServiceClient(port=daemon.port)
+            barrier.wait()
+            out[tag] = c.check_batch(model, hists, max_dispatch=4)
+
+        threads = [
+            threading.Thread(target=post, args=("a", batch_a)),
+            threading.Thread(target=post, args=("b", batch_b)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        check(len(out.get("a") or []) == len(batch_a)
+              and len(out.get("b") or []) == len(batch_b),
+              "concurrent service runs did not return full batches")
+
+        # -- stitched traces: client + daemon spans share trace ids,
+        # and the export carries cross-seam flow events
+        spans = obs.tracer().finished()
+        client_ids = {
+            s.attrs[propagate.ATTR_TRACE_ID]
+            for s in spans
+            if (s.attrs or {}).get(propagate.ATTR_ROLE) == "client"
+        }
+        daemon_ids = {
+            s.attrs[propagate.ATTR_TRACE_ID]
+            for s in spans
+            if (s.attrs or {}).get(propagate.ATTR_ROLE) == "daemon"
+        }
+        check(len(client_ids) >= 3,
+              f"expected >=3 traced client runs, saw {len(client_ids)}")
+        check(client_ids <= daemon_ids or client_ids & daemon_ids,
+              f"daemon spans not linked to client trace ids "
+              f"(client {client_ids}, daemon {daemon_ids})")
+        trace = obs_export.chrome_trace(obs.tracer())
+        events = trace["traceEvents"]
+        tpath = os.path.join(tmp, "trace.json")
+        with open(tpath, "w") as f:
+            import json
+
+            json.dump(trace, f)
+        reason = obs_export.validate_chrome_trace(tpath)
+        check(reason is None, f"chrome trace failed validation: {reason}")
+        flows = [e for e in events if e.get("cat") == "trace_ctx"]
+        flow_ids = {e.get("id") for e in flows}
+        check({e.get("ph") for e in flows} >= {"s", "f"},
+              f"flow events missing start/finish phases: {flows[:4]}")
+        check(client_ids & flow_ids,
+              "no flow event stitched a traced client run")
+
+        # -- the /trace endpoint serves a span dump per trace id
+        tid = sorted(client_ids)[0]
+        code, body = client._request(f"/trace?ctx={tid}")
+        check(code == 200, f"/trace returned {code}")
+        dump = protocol.decode_body(body)
+        check(bool(dump.get("spans"))
+              and all(propagate.span_matches(s, tid)
+                      for s in dump["spans"])
+              and dump.get("pid") == os.getpid()
+              and "wall_origin" in dump and "origin_ns" in dump,
+              f"/trace dump malformed for {tid}: "
+              f"{str(dump)[:200]}")
+        code, _ = client._request("/trace")
+        check(code == 400, f"/trace without ctx should 400, got {code}")
+
+        # -- dispatch journal: schema-valid rows, coalescing evidence,
+        # read-back as cost evidence
+        st = daemon.status()
+        check(st.get("journal_path") == jpath,
+              f"status journal_path {st.get('journal_path')!r}")
+        check((st.get("journal_rows") or 0) >= 1,
+              f"no journal rows written (status {st})")
+        rows = list(obs_journal.read_rows(jpath, strict=True))
+        check(len(rows) >= 1, "journal file empty")
+        check(any(r["coalesced"] >= 2 for r in rows),
+              f"no journal row from a coalesced group "
+              f"(coalesced={[r['coalesced'] for r in rows]})")
+        check(any(r["trace_id"] for r in rows),
+              "no journal row carries a trace id")
+        evidence = calibrate.journal_rows(jpath)
+        check(len(evidence) == len(rows)
+              and all(e["corpus"] == "journal" for e in evidence),
+              "journal_rows() read-back diverged from the journal")
+
+        # -- live windowed metrics: valid exposition + rate1m gauges,
+        # and the /status live view
+        mtext = client.metrics_text()
+        reason = obs_export.validate_prometheus_text(mtext)
+        check(reason is None, f"/metrics failed validation: {reason}")
+        for rname in ("jepsen_serve_requests_rate1m",
+                      "jepsen_serve_histories_rate1m"):
+            check(f"# TYPE {rname} gauge" in mtext,
+                  f"/metrics missing live {rname} gauge")
+        check("jepsen_serve_queue_wait_seconds" in mtext,
+              "/metrics missing the queue-wait histogram")
+        live = st.get("live") or {}
+        check(isinstance(live.get("requests_per_s"), (int, float))
+              and live["requests_per_s"] > 0,
+              f"live view missing request rate: {live}")
+        check(live.get("queue_wait_mean_s") is not None,
+              f"live view missing queue-wait mean: {live}")
+
+        # -- the fleet view renders from a live daemon
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run_cli(cli.serve_cmd(), [
+                "top", "--port", str(daemon.port), "--once"])
+        top_out = buf.getvalue()
+        check(rc == 0, f"top --once exited {rc}")
+        check("last 60s" in top_out and "journal" in top_out,
+              f"top --once frame incomplete: {top_out!r}")
+    finally:
+        daemon.stop()
+        obs_journal.configure(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    if failures:
+        for f_ in failures:
+            print(f"obs-fleet-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "obs-fleet-smoke: ok (stitched cross-seam traces with flow "
+        "events, /trace span dump, schema-valid dispatch journal with "
+        "coalescing evidence + journal_rows read-back, live *_rate1m "
+        "gauges + queue-wait, top --once)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

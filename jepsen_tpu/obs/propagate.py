@@ -1,0 +1,128 @@
+"""Cross-seam trace propagation: trace_ctx wire form + remote-span adoption.
+
+A service-routed run crosses two processes: the client encodes and
+POSTs, the daemon queues/coalesces/dispatches.  Each side has its own
+process-global tracer, so without propagation the run's story shatters
+into two unrelated trace files.  This module is the seam glue:
+
+- ``make_ctx(span)`` mints a ``trace_ctx`` dict — a random 64-bit trace
+  id plus the client-side parent span id — that the serve client stamps
+  onto ``/check`` and ``/elle`` wire frames (serve/protocol.py).
+- ``parse_ctx(obj)`` validates the wire form on the daemon side; the
+  daemon tags its request/batch/dispatch spans with the trace id so a
+  later ``GET /trace?ctx=`` can slice its span buffer per run.
+- ``adopt(rows, ...)`` stores daemon-side span dicts fetched at settle
+  so ``obs.export.chrome_trace`` can merge them into the client's
+  Chrome trace, wall-clock aligned and stitched with flow events.
+
+Everything here is plain dict/JSON plumbing — no sockets, no tracer
+mutation — so both ends can unit-test the round trip without a daemon.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+from typing import Any, Dict, List, Optional
+
+#: wire keys of a trace_ctx frame
+CTX_KEYS = ("trace_id", "parent_sid")
+
+#: span-attribute keys the tracer sides stamp (str-coerced by SpanRecord.set)
+ATTR_TRACE_ID = "trace_id"
+ATTR_TRACE_IDS = "trace_ids"  # comma-joined, on shared/coalesced spans
+ATTR_ROLE = "ctx_role"  # "client" | "daemon"
+
+_lock = threading.Lock()
+#: adopted remote spans: span dicts + alignment metadata, per trace id
+_remote: List[Dict[str, Any]] = []
+
+
+def new_trace_id() -> str:
+    """Random 64-bit hex trace id (Chrome flow-event ``id`` compatible)."""
+    return secrets.token_hex(8)
+
+
+def make_ctx(parent_sid: int = 0, trace_id: Optional[str] = None) -> Dict[str, Any]:
+    """Mint a trace_ctx for one service-routed request."""
+    return {"trace_id": trace_id or new_trace_id(),
+            "parent_sid": int(parent_sid)}
+
+
+def parse_ctx(obj: Any) -> Optional[Dict[str, Any]]:
+    """Validate a wire-side trace_ctx; None when absent or malformed.
+
+    Malformed contexts degrade to untraced rather than erroring: trace
+    propagation must never fail a check request.
+    """
+    if not isinstance(obj, dict):
+        return None
+    tid = obj.get("trace_id")
+    if not isinstance(tid, str) or not (1 <= len(tid) <= 64):
+        return None
+    if not all(c in "0123456789abcdef" for c in tid):
+        return None
+    try:
+        psid = int(obj.get("parent_sid", 0))
+    except (TypeError, ValueError):
+        return None
+    return {"trace_id": tid, "parent_sid": psid}
+
+
+def span_matches(span_dict: Dict[str, Any], trace_id: str) -> bool:
+    """Does a finished-span dict belong to ``trace_id``?
+
+    Matches either the direct ``trace_id`` attr or membership in the
+    comma-joined ``trace_ids`` attr that coalesced daemon spans carry
+    (a shared dispatch appears in every participating run's trace).
+    """
+    attrs = span_dict.get("attrs") or {}
+    if attrs.get(ATTR_TRACE_ID) == trace_id:
+        return True
+    ids = attrs.get(ATTR_TRACE_IDS)
+    if isinstance(ids, str) and trace_id in ids.split(","):
+        return True
+    return False
+
+
+def adopt(rows: List[Dict[str, Any]], *, pid: Optional[int] = None,
+          wall_origin: Optional[float] = None,
+          origin_ns: Optional[int] = None) -> int:
+    """Store remote span dicts for merging into this process's export.
+
+    ``pid``/``wall_origin``/``origin_ns`` come from the daemon's
+    ``/trace`` payload and let the exporter rebase the remote
+    monotonic timestamps onto this process's clock.  Rows from our own
+    pid are skipped: an in-process daemon shares the tracer, so its
+    spans are already in the local buffer and adopting them would
+    duplicate every event.
+
+    Returns the number of rows actually adopted.
+    """
+    if pid is not None and pid == os.getpid():
+        return 0
+    kept = []
+    for r in rows:
+        if not isinstance(r, dict) or "name" not in r:
+            continue
+        rec = dict(r)
+        rec["_remote_pid"] = pid
+        rec["_remote_wall_origin"] = wall_origin
+        rec["_remote_origin_ns"] = origin_ns
+        kept.append(rec)
+    with _lock:
+        _remote.extend(kept)
+    return len(kept)
+
+
+def adopted() -> List[Dict[str, Any]]:
+    """Snapshot of all adopted remote spans."""
+    with _lock:
+        return list(_remote)
+
+
+def reset() -> None:
+    """Drop adopted remote spans (wired into ``obs.reset``/``enable``)."""
+    with _lock:
+        _remote.clear()

@@ -27,6 +27,8 @@ import urllib.error
 import urllib.request
 from typing import List, Optional
 
+from .. import obs
+from ..obs import propagate
 from . import protocol
 from .protocol import UnsupportedModel  # noqa: F401 (re-export)
 
@@ -145,27 +147,65 @@ class ServiceClient:
             raise ServiceError(f"shutdown returned {code}")
         return protocol.decode_body(body)
 
+    def _trace_ctx(self, span) -> Optional[dict]:
+        """Wire ``trace_ctx`` for the current client ``span`` — None
+        when tracing is off (NULL_SPAN has no sid), so untraced runs
+        send exactly the pre-telemetry body."""
+        sid = getattr(span, "sid", None)  # NULL_SPAN has no sid
+        if not obs.enabled() or sid is None:
+            return None
+        ctx = propagate.make_ctx(parent_sid=sid)
+        span.set(propagate.ATTR_TRACE_ID, ctx["trace_id"])
+        span.set(propagate.ATTR_ROLE, "client")
+        return ctx
+
+    def fetch_trace(self, trace_id: str) -> int:
+        """Pull the daemon's span dump for ``trace_id`` (``GET
+        /trace?ctx=``) and adopt it into the local tracer so
+        ``obs.export_all`` stitches one merged Chrome trace.  Degrades
+        silently — telemetry must never fail a checker run."""
+        try:
+            code, body = self._request(
+                f"/trace?ctx={trace_id}", timeout=self.timeout or 5)
+            if code != 200:
+                return 0
+            payload = protocol.decode_body(body)
+            return propagate.adopt(
+                payload.get("spans") or [],
+                pid=payload.get("pid"),
+                wall_origin=payload.get("wall_origin"),
+                origin_ns=payload.get("origin_ns"),
+            )
+        except (ServiceError, ServiceUnavailable, ValueError, KeyError,
+                TypeError):
+            return 0
+
     def screen_graphs(self, encs) -> list:
         """Screen encoded dependency graphs on the daemon (``POST
         /elle``); same ScreenResult shapes the in-process
         ``ops.cycles.screen_graphs`` returns.  Raises like
         :meth:`check_batch` — the caller decides whether to fall
         back."""
-        body = protocol.elle_request(encs)
-        code, resp = self._request("/elle", body=body)
-        payload = protocol.decode_body(resp)
-        if code == 503:
-            raise ServiceError(
-                f"daemon backlogged: {payload.get('error')}")
-        if code != 200:
-            raise ServiceError(
-                f"/elle returned {code}: {payload.get('error')}")
-        results = payload["results"]
-        if len(results) != len(encs):
-            raise ServiceError(
-                f"result count {len(results)} != batch {len(encs)}")
-        self.last_diag = payload.get("diag") or {}
-        return protocol.elle_results_from_wire(results, encs)
+        with obs.span("client/elle", cat="serve", graphs=len(encs)) as sp:
+            ctx = self._trace_ctx(sp)
+            body = protocol.elle_request(encs, trace_ctx=ctx)
+            code, resp = self._request("/elle", body=body)
+            payload = protocol.decode_body(resp)
+            if code == 503:
+                raise ServiceError(
+                    f"daemon backlogged: {payload.get('error')}")
+            if code != 200:
+                raise ServiceError(
+                    f"/elle returned {code}: {payload.get('error')}")
+            results = payload["results"]
+            if len(results) != len(encs):
+                raise ServiceError(
+                    f"result count {len(results)} != batch {len(encs)}")
+            self.last_diag = payload.get("diag") or {}
+            out = protocol.elle_results_from_wire(results, encs)
+        if ctx:
+            self.fetch_trace(ctx["trace_id"])
+        return out
 
     def check_batch(self, model, histories, **opts) -> List[dict]:
         """Check a batch on the daemon; raises
@@ -173,20 +213,28 @@ class ServiceClient:
         form / unserviceable opt), :class:`ServiceUnavailable`, or
         :class:`ServiceError` (backlogged, daemon-side failure) — the
         caller decides whether to fall back."""
-        body = protocol.check_request(model, histories, opts)
-        code, resp = self._request("/check", body=body)
-        payload = protocol.decode_body(resp)
-        if code == 503:
-            raise ServiceError(
-                f"daemon backlogged: {payload.get('error')}")
-        if code != 200:
-            raise ServiceError(
-                f"/check returned {code}: {payload.get('error')}")
-        results = payload["results"]
-        if len(results) != len(histories):
-            raise ServiceError(
-                f"result count {len(results)} != batch {len(histories)}")
-        self.last_diag = payload.get("diag") or {}
+        with obs.span(
+            "client/check", cat="serve", histories=len(histories),
+        ) as sp:
+            ctx = self._trace_ctx(sp)
+            body = protocol.check_request(model, histories, opts,
+                                          trace_ctx=ctx)
+            code, resp = self._request("/check", body=body)
+            payload = protocol.decode_body(resp)
+            if code == 503:
+                raise ServiceError(
+                    f"daemon backlogged: {payload.get('error')}")
+            if code != 200:
+                raise ServiceError(
+                    f"/check returned {code}: {payload.get('error')}")
+            results = payload["results"]
+            if len(results) != len(histories):
+                raise ServiceError(
+                    f"result count {len(results)} != batch"
+                    f" {len(histories)}")
+            self.last_diag = payload.get("diag") or {}
+        if ctx:
+            self.fetch_trace(ctx["trace_id"])
         return results
 
 
@@ -394,4 +442,55 @@ def format_status(st: dict) -> str:
         f" + {st.get('warm_dispatches', 0)} warm"
         f" (warm-hit ratio {warm})"
     )
+    live = st.get("live")
+    if live:
+        lines.append("  " + format_live(live))
+    jp = st.get("journal_path")
+    if jp:
+        lines.append(
+            f"  journal: {st.get('journal_rows', 0)} rows → {jp}")
     return "\n".join(lines)
+
+
+def _rate(live: dict, key: str) -> str:
+    v = live.get(key)
+    return f"{v:.2f}/s" if isinstance(v, (int, float)) else "n/a"
+
+
+def format_live(live: dict) -> str:
+    """One-line last-60 s view of a /status ``live`` dict (the
+    sliding-window rates; doc/observability.md 'Fleet telemetry')."""
+    qw = live.get("queue_wait_mean_s")
+    busy = live.get("device_busy_ratio")
+    return (
+        f"last 60s: req {_rate(live, 'requests_per_s')}"
+        f" · hist {_rate(live, 'histories_per_s')}"
+        f" · elle {_rate(live, 'elle_graphs_per_s')}"
+        f" · disp {_rate(live, 'dispatches_per_s')}"
+        f" · wait "
+        + (f"{qw * 1e3:.1f}ms" if isinstance(qw, (int, float)) else "n/a")
+        + " · busy "
+        + (f"{busy:.0%}" if isinstance(busy, (int, float)) else "n/a")
+    )
+
+
+def format_top(host: str, port, st: dict) -> str:
+    """One daemon's fleet-view block for ``jepsen_tpu top``: identity
+    line, last-60 s rates, queue/journal line."""
+    mesh = st.get("mesh_shape")
+    live = st.get("live") or {}
+    head = (
+        f"● {host}:{port}  pid {st.get('pid')}"
+        f" · {st.get('n_devices') or 1} device(s)"
+        + (f" · mesh {mesh}" if mesh else "")
+        + f" · up {st.get('uptime_s', 0):.0f}s"
+        + (" · DRAINING" if st.get("stopping") else "")
+    )
+    jp = st.get("journal_path")
+    tail = (
+        f"  queue {st.get('queue_depth', 0)}/{st.get('max_queue_runs')}"
+        f" · in-flight {st.get('in_flight', 0)}"
+        f" · coalesced {st.get('coalesced', 0)}"
+        + (f" · journal {st.get('journal_rows', 0)} rows" if jp else "")
+    )
+    return "\n".join([head, "  " + format_live(live), tail])

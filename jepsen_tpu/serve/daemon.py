@@ -57,6 +57,8 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import obs
 from ..engine import decompose, execution, planning
+from ..obs import journal as obs_journal
+from ..obs import propagate
 from . import protocol
 
 #: admission bounds: queued (not yet device-processed) requests and
@@ -116,9 +118,10 @@ class _ElleRequest:
 
     kind = "elle"
     __slots__ = ("graphs", "rows", "n", "t_admitted", "device_done",
-                 "error", "diag", "abandoned", "results", "run")
+                 "error", "diag", "abandoned", "results", "run",
+                 "trace_id")
 
-    def __init__(self, graphs):
+    def __init__(self, graphs, trace_id: Optional[str] = None):
         self.graphs = graphs
         self.rows = self.n = len(graphs)
         self.t_admitted = time.perf_counter()
@@ -128,6 +131,9 @@ class _ElleRequest:
         self.abandoned = False
         self.results: Optional[list] = None
         self.run = _NO_ORACLES
+        #: caller's trace id (obs.propagate) — tags this request's
+        #: daemon-side spans + journal rows; None when untraced
+        self.trace_id = trace_id
 
 
 class _Request:
@@ -142,10 +148,10 @@ class _Request:
     kind = "check"
     __slots__ = ("run", "streams", "group_key", "model",
                  "plan_opts", "exec_opts", "n", "rows", "t_admitted",
-                 "device_done", "error", "diag", "abandoned")
+                 "device_done", "error", "diag", "abandoned", "trace_id")
 
     def __init__(self, run, streams, group_key, model, plan_opts,
-                 exec_opts, n):
+                 exec_opts, n, trace_id: Optional[str] = None):
         self.run = run
         self.streams = streams
         #: client-visible batch size vs rows actually queued for the
@@ -165,6 +171,8 @@ class _Request:
         #: handler gave up (refused post-planning, or timed out): the
         #: device thread must skip it and nobody drains its oracles
         self.abandoned = False
+        #: caller's trace id (obs.propagate); None when untraced
+        self.trace_id = trace_id
 
 
 class CheckerDaemon:
@@ -183,6 +191,8 @@ class CheckerDaemon:
         max_queue_rows: Optional[int] = None,
         coalesce_wait_s: Optional[float] = None,
         cost_fn=None,
+        journal_path: Optional[str] = None,
+        journal_max_bytes: int = obs_journal.DEFAULT_MAX_BYTES,
     ):
         #: per-bucket device-cost estimator driving largest-first
         #: dispatch of coalesced work.  The default is the
@@ -211,6 +221,11 @@ class CheckerDaemon:
             if coalesce_wait_s is not None
             else _env_float("JEPSEN_TPU_SERVE_COALESCE_WAIT", 0.0)
         )
+        #: dispatch-journal destination (obs.journal): None = off — the
+        #: constructor default, so in-process/test daemons never write
+        #: to cwd by accident; the `serve()` CLI entry defaults it ON
+        self.journal_path = journal_path
+        self.journal_max_bytes = journal_max_bytes
         self.t_start = time.time()
         self._server: Optional[ThreadingHTTPServer] = None
         self._device_thread: Optional[threading.Thread] = None
@@ -394,8 +409,13 @@ class CheckerDaemon:
                 groups[req.group_key] = []
                 group_order.append(req.group_key)
             groups[req.group_key].append(req)
-        with obs.span("serve/batch", cat="serve", requests=len(batch),
-                      groups=len(group_order) + bool(elle_reqs)):
+        batch_attrs = {"requests": len(batch),
+                       "groups": len(group_order) + bool(elle_reqs)}
+        batch_ids = ",".join(sorted(
+            {r.trace_id for r in batch if getattr(r, "trace_id", None)}))
+        if batch_ids:
+            batch_attrs[propagate.ATTR_TRACE_IDS] = batch_ids
+        with obs.span("serve/batch", cat="serve", **batch_attrs):
             if elle_reqs:
                 self._process_elle(executor, elle_reqs)
                 for req in elle_reqs:
@@ -436,8 +456,21 @@ class CheckerDaemon:
 
         if len(reqs) > 1:
             obs.count("jepsen_serve_elle_coalesced_total", len(reqs))
+        for req in reqs:
+            # admission→dispatch: the queue-wait the /status live view
+            # and item 3's admission-control signal key on
+            obs.observe("jepsen_serve_queue_wait_seconds",
+                        time.perf_counter() - req.t_admitted)
+        attrs = {"graphs": sum(r.n for r in reqs)}
+        trace_ids = ",".join(
+            sorted({r.trace_id for r in reqs if r.trace_id}))
+        if trace_ids:
+            attrs[propagate.ATTR_TRACE_IDS] = trace_ids
+        executor.journal_context = {
+            "coalesced": len(reqs), "trace_id": trace_ids}
         encs = [g for req in reqs for g in req.graphs]
-        results = ops_cycles.screen_graphs(encs, executor=executor)
+        with obs.span("serve/screen", cat="serve", **attrs):
+            results = ops_cycles.screen_graphs(encs, executor=executor)
         lo = 0
         for req in reqs:
             req.results = results[lo:lo + req.n]
@@ -487,6 +520,11 @@ class CheckerDaemon:
     def _dispatch_group(self, executor, reqs: List[_Request],
                         planned: list, n_buckets: int) -> None:
         first = reqs[0]
+        for req in reqs:
+            # admission→dispatch: the queue-wait the /status live view
+            # and item 3's admission-control signal key on
+            obs.observe("jepsen_serve_queue_wait_seconds",
+                        time.perf_counter() - req.t_admitted)
         if len(reqs) > 1:
             # counted per COMPATIBLE group, not per backlog pop:
             # requests that merely shared a device batch but sat in
@@ -502,6 +540,15 @@ class CheckerDaemon:
         executor.escalation = first.exec_opts["escalation"]
         executor.sufficient_rung = first.exec_opts["sufficient_rung"]
         executor.max_dispatch = first.exec_opts["max_dispatch"]
+        trace_ids = ",".join(
+            sorted({r.trace_id for r in reqs if r.trace_id}))
+        executor.journal_context = {
+            "coalesced": len(reqs), "trace_id": trace_ids}
+        attrs = {"requests": len(reqs), "buckets": n_buckets}
+        if trace_ids:
+            # a shared dispatch belongs to EVERY participating run's
+            # trace: /trace?ctx= matches any member of this attr
+            attrs[propagate.ATTR_TRACE_IDS] = trace_ids
         pc0 = dict(executor.phase_counts)
         # dispatch EVERY planned bucket largest-estimated-cost first
         # across both streams: big buckets keep the window occupied
@@ -511,9 +558,10 @@ class CheckerDaemon:
         # verdicts are order-independent by the engine contract, so
         # reordering is purely a throughput decision.
         planned.sort(key=self.cost_fn, reverse=True)
-        for pb in planned:
-            executor.submit(pb)
-        executor.drain()
+        with obs.span("serve/dispatch", cat="serve", **attrs):
+            for pb in planned:
+                executor.submit(pb)
+            executor.drain()
         warm = executor.phase_counts["execute"] - pc0["execute"]
         cold = executor.phase_counts["compile"] - pc0["compile"]
         if warm:
@@ -544,6 +592,28 @@ class CheckerDaemon:
             depth = len(self._queue)
         total = stats["warm_dispatches"] + stats["cold_dispatches"]
         cal = tune.active()
+        reg = obs.registry()
+        # the live windowed view (obs.metrics slot rings): last-60 s
+        # rates + queue-wait + device-busy fraction — what `top` and
+        # the web panel render, and what a cumulative counter can't say
+        busy_s = (reg.window_seconds_sum("jepsen_kernel_compile_seconds")
+                  + reg.window_seconds_sum("jepsen_kernel_execute_seconds"))
+        qw_mean = reg.window_mean("jepsen_serve_queue_wait_seconds")
+        live = {
+            "requests_per_s": round(
+                reg.window_rate("jepsen_serve_requests_total"), 4),
+            "histories_per_s": round(
+                reg.window_rate("jepsen_serve_histories_total"), 4),
+            "elle_graphs_per_s": round(
+                reg.window_rate("jepsen_serve_elle_graphs_total"), 4),
+            "dispatches_per_s": round(
+                reg.window_rate("jepsen_kernel_dispatches_total"), 4),
+            "queue_wait_mean_s": (
+                round(qw_mean, 4) if qw_mean is not None else None),
+            "device_busy_ratio": round(
+                min(1.0, busy_s / 60.0), 4),
+        }
+        journal = obs_journal.active()
         return {
             # the resident calibration (doc/tuning.md): the artifact id
             # steering this daemon's window / union-mode / cost-ordered
@@ -572,13 +642,31 @@ class CheckerDaemon:
             "stopping": self._stopping.is_set(),
             "warm_hit_ratio": round(stats["warm_dispatches"] / total, 4)
             if total else None,
+            "journal_path": journal.path if journal else None,
+            "journal_rows": journal.written if journal else 0,
+            "live": live,
             **stats,
         }
+
+    def trace_dump(self, trace_id: str) -> dict:
+        """The ``GET /trace?ctx=`` payload: finished daemon spans
+        belonging to one trace (tagged directly, or via the comma-
+        joined trace_ids attr a coalesced dispatch carries), plus the
+        clock metadata (pid, wall_origin, origin_ns) the client's
+        ``obs.propagate.adopt`` needs to rebase them at export."""
+        t = obs.tracer()
+        spans = [d for d in (rec.to_dict() for rec in t.finished())
+                 if propagate.span_matches(d, trace_id)]
+        return {"spans": spans, "pid": os.getpid(),
+                "wall_origin": t.wall_origin, "origin_ns": t.origin_ns}
 
     # -- lifecycle ------------------------------------------------------------
 
     def start(self, block: bool = True) -> "CheckerDaemon":
         obs.enable()  # live /metrics needs the registry recording
+        if self.journal_path:
+            obs_journal.configure(self.journal_path,
+                                  self.journal_max_bytes)
         handler = _make_handler(self)
         self._server = ThreadingHTTPServer((self.host, self.port), handler)
         self._server.daemon_threads = True
@@ -647,6 +735,21 @@ class CheckerDaemon:
             opts = payload.get("opts") or {}
         except Exception as e:  # noqa: BLE001 — malformed client input
             return 400, {"error": f"bad request: {e!r}"}
+        ctx = propagate.parse_ctx(payload.get("trace_ctx"))
+        attrs = {"histories": len(histories)}
+        if ctx:
+            # the daemon half of the cross-seam trace: tagged so a
+            # later GET /trace?ctx= can slice this request's spans out
+            # and obs.export can stitch a flow event to the client span
+            attrs[propagate.ATTR_TRACE_ID] = ctx["trace_id"]
+            attrs[propagate.ATTR_ROLE] = "daemon"
+            attrs["parent_sid"] = ctx["parent_sid"]
+        with obs.span("serve/check", cat="serve", **attrs):
+            return self._check_flow(payload, model, histories, opts,
+                                    ctx["trace_id"] if ctx else None)
+
+    def _check_flow(self, payload, model, histories, opts,
+                    trace_id: Optional[str]) -> Tuple[int, dict]:
         if not self.precheck_admit(len(histories)):
             # overload sheds BEFORE the planning half: no encode, no
             # oracle-pool submissions for a request we will refuse
@@ -707,7 +810,7 @@ class CheckerDaemon:
                     _Stream(tag, sctx.model, sctx.spec, buckets, order)
                 )
         req = _Request(run, streams, group_key, model, plan_opts,
-                       exec_opts, len(histories))
+                       exec_opts, len(histories), trace_id=trace_id)
         if not self.admit(req):
             # planning already submitted this run's unencodable rows
             # to the oracle pool; cancel what has not started — the
@@ -756,7 +859,19 @@ class CheckerDaemon:
             graphs = protocol.elle_graphs_from_wire(payload["graphs"])
         except Exception as e:  # noqa: BLE001 — malformed client input
             return 400, {"error": f"bad request: {e!r}"}
-        req = _ElleRequest(graphs)
+        ctx = propagate.parse_ctx(payload.get("trace_ctx"))
+        attrs = {"graphs": len(graphs)}
+        if ctx:
+            attrs[propagate.ATTR_TRACE_ID] = ctx["trace_id"]
+            attrs[propagate.ATTR_ROLE] = "daemon"
+            attrs["parent_sid"] = ctx["parent_sid"]
+        with obs.span("serve/elle", cat="serve", **attrs):
+            return self._elle_flow(graphs,
+                                   ctx["trace_id"] if ctx else None)
+
+    def _elle_flow(self, graphs,
+                   trace_id: Optional[str]) -> Tuple[int, dict]:
+        req = _ElleRequest(graphs, trace_id=trace_id)
         if not self.admit(req):
             with self._wake:
                 depth = len(self._queue)
@@ -812,6 +927,15 @@ def _make_handler(daemon: CheckerDaemon):
                     # metrics.prom dump (obs.render_prom)
                     self._reply(200, obs.render_prom().encode(),
                                 "text/plain; version=0.0.4")
+                elif self.path.startswith("/trace"):
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    ctx = (q.get("ctx") or [""])[0]
+                    if not ctx:
+                        self._reply_json(400, {"error": "missing ctx"})
+                    else:
+                        self._reply_json(200, daemon.trace_dump(ctx))
                 else:
                     self._reply_json(404, {"error": "not found"})
             except BrokenPipeError:
@@ -851,5 +975,14 @@ def serve(host: str = protocol.DEFAULT_HOST,
     if port is None:
         port = int(os.environ.get("JEPSEN_TPU_SERVE_PORT",
                                   protocol.DEFAULT_PORT))
+    if "journal_path" not in kw:
+        # the production entry journals by default (the constructor
+        # default stays off for in-process/test daemons): path from
+        # JEPSEN_TPU_JOURNAL, falsy values disable
+        jp = os.environ.get("JEPSEN_TPU_JOURNAL",
+                            obs_journal.DEFAULT_FILENAME)
+        if jp.lower() in ("0", "false", "off", "no", ""):
+            jp = None
+        kw["journal_path"] = jp
     d = CheckerDaemon(host, port, window=window, **kw)
     return d.start(block=block)

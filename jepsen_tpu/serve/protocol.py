@@ -223,11 +223,13 @@ class WireGraph:
         self.nonadj = tuple((int(w), int(r)) for w, r in nonadj)
 
 
-def elle_request(encs) -> bytes:
+def elle_request(encs, trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
     """Build a ``POST /elle`` body from encoded graphs
     (:class:`jepsen_tpu.elle.encode.EncodedGraph`): per graph the
-    uint8 relation-bit matrix plus its canonical filter profile."""
-    return encode_body({
+    uint8 relation-bit matrix plus its canonical filter profile.
+    ``trace_ctx`` (obs.propagate) rides along so the daemon's spans
+    link back to the caller's trace."""
+    body = {
         "graphs": [
             {
                 "rel": [[int(x) for x in row] for row in enc.rel],
@@ -236,7 +238,10 @@ def elle_request(encs) -> bytes:
             }
             for enc in encs
         ],
-    })
+    }
+    if trace_ctx:
+        body["trace_ctx"] = dict(trace_ctx)
+    return encode_body(body)
 
 
 def elle_graphs_from_wire(items) -> List[WireGraph]:
@@ -293,10 +298,13 @@ def elle_results_from_wire(items, encs) -> list:
     return out
 
 
-def check_request(model, histories, opts: Optional[Dict[str, Any]] = None
-                  ) -> bytes:
+def check_request(model, histories, opts: Optional[Dict[str, Any]] = None,
+                  trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
     """Build a ``POST /check`` body; raises :class:`UnsupportedModel`
-    when the model (or an opt) has no wire form."""
+    when the model (or an opt) has no wire form.  ``trace_ctx``
+    (obs.propagate ``{"trace_id", "parent_sid"}``) is optional and
+    never affects verdicts: it only tags the daemon-side spans so one
+    service-routed run exports one stitched Chrome trace."""
     wire_opts = {}
     for k, v in (opts or {}).items():
         if k not in CHECK_OPTS:
@@ -304,8 +312,11 @@ def check_request(model, histories, opts: Optional[Dict[str, Any]] = None
         if k == "escalation" and v is not None:
             v = list(v)
         wire_opts[k] = v
-    return encode_body({
+    body = {
         "model": model_to_wire(model),
         "histories": histories_to_wire(histories),
         "opts": wire_opts,
-    })
+    }
+    if trace_ctx:
+        body["trace_ctx"] = dict(trace_ctx)
+    return encode_body(body)

@@ -51,6 +51,7 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import obs
+from ..obs import journal as obs_journal
 from . import artifact
 
 #: sweep profiles: bounded candidate sets + corpus sizes.  "default"
@@ -195,6 +196,36 @@ def _phase_seconds(reg) -> Tuple[float, float]:
         elif d["name"] == "jepsen_kernel_execute_seconds":
             execute_s += d.get("sum", 0.0)
     return compile_s, execute_s
+
+
+def journal_rows(path: Optional[str] = None,
+                 kernel: Optional[str] = None) -> List[dict]:
+    """Production dispatch-journal rows
+    (:mod:`jepsen_tpu.obs.journal`) read back in the cost-table entry
+    shape — real-traffic evidence beside the synthetic
+    :func:`measure_cost_table` points.  ``seconds`` is the warm
+    execute time when the dispatch was a compile-cache hit, else the
+    compile time; ``corpus`` is ``"journal"`` so consumers can tell
+    measured-offline from observed-in-production rows.  Reads the
+    process's configured journal by default (falling back to
+    ``dispatch-journal.jsonl`` in the cwd); bad lines are skipped, a
+    missing file is just an empty list."""
+    p = path or obs_journal.path() or obs_journal.DEFAULT_FILENAME
+    out: List[dict] = []
+    for row in obs_journal.read_rows(p):
+        if kernel is not None and row.get("kernel") != kernel:
+            continue
+        secs = (row["execute_s"] if row["cache"] == "hit"
+                else row["compile_s"])
+        out.append({
+            "kernel": row["kernel"], "E": row["E"], "C": row["C"],
+            "F": row["F"], "rows": row["rows"],
+            "seconds": round(float(secs), 6),
+            "corpus": "journal",
+            "cache": row["cache"],
+            "coalesced": row["coalesced"],
+        })
+    return out
 
 
 class _Runner:

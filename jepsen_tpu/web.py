@@ -96,6 +96,33 @@ def service_section() -> str:
         ("coalesced", st.get("coalesced", 0)),
         ("warm-hit ratio", warm),
     ]
+    live = st.get("live") or {}
+
+    def _rate(k):
+        v = live.get(k)
+        return f"{v:.2f}/s" if isinstance(v, (int, float)) else "n/a"
+
+    if live:
+        qw = live.get("queue_wait_mean_s")
+        busy = live.get("device_busy_ratio")
+        rows.append((
+            "last 60 s",
+            f"req {_rate('requests_per_s')}"
+            f" · hist {_rate('histories_per_s')}"
+            f" · disp {_rate('dispatches_per_s')}",
+        ))
+        rows.append((
+            "queue wait / busy",
+            (f"{qw * 1e3:.1f} ms"
+             if isinstance(qw, (int, float)) else "n/a")
+            + " / "
+            + (f"{busy:.0%}" if isinstance(busy, (int, float)) else "n/a"),
+        ))
+    if st.get("journal_path"):
+        rows.append((
+            "dispatch journal",
+            f"{st.get('journal_rows', 0)} rows → {st.get('journal_path')}",
+        ))
     cells = "".join(
         f"<tr><td>{html.escape(str(k))}</td>"
         f"<td>{html.escape(str(v))}</td></tr>"

@@ -454,3 +454,130 @@ def test_bench_union_mode_not_rehardcoded(monkeypatch):
 
     src = inspect.getsource(bench)
     assert 'os.environ.get("JEPSEN_TPU_DENSE_UNION"' not in src
+
+
+# ---------------------------------------------------------------------------
+# sliding-window metrics (fleet telemetry)
+# ---------------------------------------------------------------------------
+
+
+def _fake_clock(monkeypatch, start=1000.0):
+    from jepsen_tpu.obs import metrics as metrics_mod
+
+    clock = {"t": start}
+    monkeypatch.setattr(metrics_mod, "_now", lambda: clock["t"])
+    return clock
+
+
+def test_windowed_counter_ages_out_but_cumulative_survives(monkeypatch):
+    clock = _fake_clock(monkeypatch)
+    reg = MetricsRegistry()
+    c = reg.counter("jepsen_win_total")
+    c.inc(5)
+    assert c.window_sum() == 5
+    clock["t"] += 30
+    c.inc(2)
+    assert c.window_sum() == 7  # both bursts inside the minute
+    clock["t"] += 45  # the first burst is now > 60 s old
+    assert c.window_sum() == 2
+    clock["t"] += 600
+    assert c.window_sum() == 0  # window empty...
+    with c._lock:
+        assert c.value == 7  # ...cumulative total untouched
+
+
+def test_windowed_ring_wrap_resets_stale_slot(monkeypatch):
+    from jepsen_tpu.obs.metrics import SLOT_SECONDS, WINDOW_SLOTS
+
+    clock = _fake_clock(monkeypatch)
+    reg = MetricsRegistry()
+    c = reg.counter("jepsen_wrap_total")
+    c.inc(9)
+    # advance exactly one full ring revolution: the new slot maps to
+    # the SAME ring index and must displace the stale count, not add
+    clock["t"] += SLOT_SECONDS * WINDOW_SLOTS
+    c.inc(3)
+    assert c.window_sum() == 3
+
+
+def test_windowed_histogram_totals(monkeypatch):
+    clock = _fake_clock(monkeypatch)
+    reg = MetricsRegistry()
+    h = reg.histogram("jepsen_winlat_seconds")
+    h.observe(0.5)
+    h.observe(1.5)
+    assert h.window_totals() == (2, 2.0)
+    clock["t"] += 120
+    assert h.window_totals() == (0, 0.0)
+    with h._lock:
+        assert h.count == 2 and h.sum == 2.0
+
+
+def test_window_aggregation_helpers(monkeypatch):
+    _fake_clock(monkeypatch)
+    reg = MetricsRegistry()
+    reg.counter("jepsen_req_total", route="a").inc(3)
+    reg.counter("jepsen_req_total", route="b").inc(1)
+    reg.histogram("jepsen_lat_seconds").observe(0.25)
+    reg.histogram("jepsen_lat_seconds").observe(0.75)
+    # rates sum across label sets, over the 60 s window
+    assert reg.window_rate("jepsen_req_total") == pytest.approx(4 / 60)
+    assert reg.window_rate("jepsen_lat_seconds") == pytest.approx(2 / 60)
+    assert reg.window_mean("jepsen_lat_seconds") == pytest.approx(0.5)
+    assert reg.window_seconds_sum("jepsen_lat_seconds") == pytest.approx(1.0)
+    # never-recorded names degrade quietly
+    assert reg.window_rate("jepsen_absent_total") == 0.0
+    assert reg.window_mean("jepsen_absent_seconds") is None
+
+
+def test_rate1m_gauges_in_exposition():
+    from jepsen_tpu.obs.metrics import rate1m_name
+
+    # the naming rule: strip the unit suffix, append _rate1m
+    assert rate1m_name("jepsen_req_total") == "jepsen_req_rate1m"
+    assert rate1m_name("jepsen_lat_seconds") == "jepsen_lat_rate1m"
+    assert rate1m_name("jepsen_queue") == "jepsen_queue_rate1m"
+
+    reg = MetricsRegistry()
+    reg.counter("jepsen_req_total", route="a").inc(6)
+    reg.histogram("jepsen_lat_seconds").observe(0.1)
+    reg.gauge("jepsen_depth").set(3)
+    text = reg.prometheus_text()
+    assert "# TYPE jepsen_req_rate1m gauge" in text
+    assert 'jepsen_req_rate1m{route="a"} 0.1' in text  # 6/60 s
+    assert "# TYPE jepsen_lat_rate1m gauge" in text
+    # gauges are instantaneous already: no synthesized rate family
+    assert "jepsen_depth_rate1m" not in text
+    assert export_mod.validate_prometheus_text(text) is None
+
+
+def test_series_cardinality_cap_folds_overflow():
+    from jepsen_tpu.obs.metrics import SERIES_DROPPED
+
+    reg = MetricsRegistry(max_series=3)
+    for i in range(5):
+        reg.counter("jepsen_cap_total", k=str(i)).inc()
+    fam = [d for d in reg.snapshot() if d["name"] == "jepsen_cap_total"]
+    # 3 real series + ONE overflow series holding the folded tail
+    assert len(fam) == 4
+    by_labels = {tuple(sorted(d["labels"].items())): d for d in fam}
+    assert by_labels[(("overflow", "1"),)]["value"] == 2
+    assert reg.value(SERIES_DROPPED) == 2
+    # the fold is sticky: later novel label sets keep landing there
+    reg.counter("jepsen_cap_total", k="99").inc()
+    assert by_labels != {}  # unchanged real series
+    assert reg.value(SERIES_DROPPED) == 3
+    # the drop counter itself and the overflow series are exempt from
+    # the cap (no recursion, the evidence can always be recorded)
+    assert export_mod.validate_prometheus_text(
+        reg.prometheus_text()) is None
+
+
+def test_max_series_env_override(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_OBS_MAX_SERIES", "7")
+    reg = MetricsRegistry()
+    assert reg.max_series == 7
+    monkeypatch.setenv("JEPSEN_TPU_OBS_MAX_SERIES", "not-a-number")
+    from jepsen_tpu.obs.metrics import DEFAULT_MAX_SERIES
+
+    assert MetricsRegistry().max_series == DEFAULT_MAX_SERIES

@@ -1,0 +1,144 @@
+"""Dispatch-journal tests (jepsen_tpu/obs/journal.py).
+
+The journal is the durable per-dispatch flight record (one JSONL row
+per device dispatch, doc/observability.md "Fleet telemetry"): its
+schema is pinned (v1), its growth is bounded by size rotation, and
+its read-back path must skip damage rather than crash — a corrupted
+telemetry file must never take down a tuner or a bench that reads it.
+"""
+
+import json
+
+import pytest
+
+from jepsen_tpu.obs import journal
+
+
+def _row(**over):
+    base = dict(
+        kernel="dense", E=4, C=3, F=0, rows=32, n_devices=1,
+        mesh_shape=[1], window=4, compile_s=0.0, execute_s=0.002,
+        coalesced=1, cache="hit", closure_mode="", union="gather",
+        calibration="", trace_id="ab12",
+    )
+    base.update(over)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# schema pin
+# ---------------------------------------------------------------------------
+
+
+def test_validate_row_accepts_a_full_row():
+    row = dict(_row(), v=journal.SCHEMA_VERSION, ts=1700000000.0)
+    assert journal.validate_row(row) is True
+
+
+def test_validate_row_rejects_drift():
+    good = dict(_row(), v=1, ts=1.0)
+    for breakage in (
+        {"v": 2},                 # unknown schema version
+        {"kernel": 7},            # wrong type
+        {"rows": "32"},           # stringly-typed int
+        {"rows": True},           # bool is not an int here
+        {"cache": "warm"},        # not in the hit/miss enum
+        {"mesh_shape": "1x1"},    # list pinned
+        {"surprise": 1},          # extras are drift too
+    ):
+        bad = dict(good, **breakage)
+        assert journal.validate_row(bad) is False, breakage
+    missing = dict(good)
+    del missing["kernel"]
+    assert journal.validate_row(missing) is False
+
+
+# ---------------------------------------------------------------------------
+# emit + rotation
+# ---------------------------------------------------------------------------
+
+
+def test_emit_appends_schema_valid_lines(tmp_path):
+    path = str(tmp_path / "dispatch-journal.jsonl")
+    j = journal.DispatchJournal(path)
+    assert j.emit(**_row()) is not None
+    assert j.emit(**_row(cache="miss", compile_s=0.5, execute_s=0.0))
+    assert j.written == 2 and j.dropped == 0
+    lines = [json.loads(s) for s in open(path).read().splitlines()]
+    assert len(lines) == 2
+    for row in lines:
+        assert journal.validate_row(row) is True
+        assert row["v"] == journal.SCHEMA_VERSION
+        assert row["ts"] > 0
+
+
+def test_emit_drops_invalid_rows_without_raising(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal.DispatchJournal(path)
+    assert j.emit(**_row(cache="warm")) is None
+    assert j.emit(**{**_row(), "bogus_field": 1}) is None
+    assert j.dropped == 2 and j.written == 0
+
+
+def test_size_rotation_keeps_one_predecessor(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal.DispatchJournal(path, max_bytes=600)
+    for i in range(12):
+        assert j.emit(**_row(rows=i)) is not None
+    assert j.files() == [path + ".1", path]
+    # rotated + current cover a contiguous recent suffix, in order
+    rows = list(journal.read_rows(path, strict=True))
+    assert [r["rows"] for r in rows] == sorted(r["rows"] for r in rows)
+    assert rows[-1]["rows"] == 11
+    assert len(rows) < 12  # the oldest rows aged out with rotation
+
+
+# ---------------------------------------------------------------------------
+# read-back
+# ---------------------------------------------------------------------------
+
+
+def test_read_rows_skips_damage_unless_strict(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal.DispatchJournal(path)
+    j.emit(**_row())
+    with open(path, "a") as f:
+        f.write("{not json\n")
+        f.write(json.dumps({"v": 1, "ts": 1.0}) + "\n")  # schema-bad
+    j.emit(**_row(rows=99))
+    rows = list(journal.read_rows(path))
+    assert [r["rows"] for r in rows] == [32, 99]
+    with pytest.raises(ValueError):
+        list(journal.read_rows(path, strict=True))
+
+
+def test_read_rows_of_missing_file_is_empty(tmp_path):
+    assert list(journal.read_rows(str(tmp_path / "absent.jsonl"))) == []
+
+
+def test_module_singleton_noop_until_configured(tmp_path):
+    journal.configure(None)
+    assert journal.active() is None and journal.path() is None
+    assert journal.emit(**_row()) is None  # silently dropped
+    path = str(tmp_path / "j.jsonl")
+    try:
+        journal.configure(path)
+        assert journal.path() == path
+        assert journal.emit(**_row()) is not None
+        assert journal.active().written == 1
+    finally:
+        journal.configure(None)
+
+
+def test_journal_rows_reads_back_as_cost_evidence(tmp_path):
+    from jepsen_tpu.tune import calibrate
+
+    path = str(tmp_path / "j.jsonl")
+    j = journal.DispatchJournal(path)
+    j.emit(**_row(cache="miss", compile_s=0.5, execute_s=0.0))
+    j.emit(**_row(execute_s=0.002, coalesced=2))
+    ev = calibrate.journal_rows(path)
+    assert [e["seconds"] for e in ev] == [0.5, 0.002]
+    assert all(e["corpus"] == "journal" for e in ev)
+    assert ev[1]["coalesced"] == 2
+    assert calibrate.journal_rows(path, kernel="frontier") == []

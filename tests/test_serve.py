@@ -527,6 +527,148 @@ def test_status_advertises_mesh_and_mesh_matched_requests_serviced(
         daemon.stop()
 
 
+# ---------------------------------------------------------------------------
+# cross-seam trace propagation (fleet telemetry)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ctx_wire_round_trip():
+    """trace_ctx rides the /check and /elle bodies verbatim and
+    survives the codec; malformed contexts degrade to None (untraced),
+    never to an error — telemetry must not fail a checker run."""
+    from jepsen_tpu.obs import propagate
+
+    ctx = propagate.make_ctx(parent_sid=7)
+    assert propagate.parse_ctx(ctx) == ctx
+    body = protocol.decode_body(protocol.check_request(
+        m.cas_register(0), mixed_corpus(seed=3, n=3, wide=False)[:1],
+        {}, trace_ctx=ctx))
+    assert propagate.parse_ctx(body["trace_ctx"]) == ctx
+    # absent by default: untraced runs send the pre-telemetry body
+    body = protocol.decode_body(protocol.check_request(
+        m.cas_register(0), [], {}))
+    assert "trace_ctx" not in body
+    for bad in (None, "x", 7, {}, {"trace_id": "UPPER", "parent_sid": 0},
+                {"trace_id": "ab", "parent_sid": "zero"},
+                {"trace_id": "g" * 8, "parent_sid": 1},
+                {"trace_id": "a" * 65, "parent_sid": 1}):
+        assert propagate.parse_ctx(bad) is None
+
+
+def test_service_run_exports_one_stitched_trace():
+    """A service-routed run is ONE trace: the client-side span and the
+    daemon-side spans share a trace id, /trace?ctx= serves the
+    daemon's dump for it, and the Chrome export stitches both sides
+    with flow events."""
+    import os as _os
+
+    from jepsen_tpu.obs import export as obs_export
+    from jepsen_tpu.obs import propagate
+
+    obs.enable(reset=True)
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=17, n=3, wide=False)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        client.check_batch(model, hists, slot_cap=32)
+
+        spans = obs.tracer().finished()
+        by_role = {}
+        for s in spans:
+            role = (s.attrs or {}).get(propagate.ATTR_ROLE)
+            if role:
+                by_role.setdefault(role, []).append(s)
+        assert by_role.get("client") and by_role.get("daemon")
+        tid = by_role["client"][0].attrs[propagate.ATTR_TRACE_ID]
+        assert any(
+            s.attrs[propagate.ATTR_TRACE_ID] == tid
+            for s in by_role["daemon"]
+        )
+        # the daemon span is parented under the client's span id
+        client_sid = by_role["client"][0].sid
+        assert any(
+            int(s.attrs.get("parent_sid", -1)) == client_sid
+            for s in by_role["daemon"]
+        )
+
+        # /trace serves exactly this trace's daemon-side dump
+        code, body = client._request(f"/trace?ctx={tid}")
+        assert code == 200
+        dump = protocol.decode_body(body)
+        assert dump["spans"] and all(
+            propagate.span_matches(s, tid) for s in dump["spans"])
+        assert dump["pid"] == _os.getpid()
+
+        # in-process daemon: adopt() must refuse same-pid dumps (the
+        # spans are already in the shared tracer — adopting would
+        # duplicate every event)
+        assert propagate.adopt(
+            dump["spans"], pid=dump["pid"],
+            wall_origin=dump["wall_origin"],
+            origin_ns=dump["origin_ns"]) == 0
+
+        events = obs_export.chrome_trace(obs.tracer())["traceEvents"]
+        flows = [e for e in events if e.get("cat") == "trace_ctx"
+                 and e.get("id") == tid]
+        assert {"s", "f"} <= {e["ph"] for e in flows}
+    finally:
+        daemon.stop()
+        obs.enable(reset=True)
+
+
+def test_adopted_remote_spans_merge_into_chrome_trace():
+    """A genuinely remote dump (different pid) is adopted and rebased
+    onto the local wall clock in the merged export."""
+    import os as _os
+    import time as _time
+
+    from jepsen_tpu.obs import export as obs_export
+    from jepsen_tpu.obs import propagate
+
+    obs.enable(reset=True)
+    t = obs.tracer()
+    now = _time.monotonic_ns()
+    remote = {
+        "name": "serve/check", "cat": "serve", "t0": now,
+        "t1": now + 5_000_000, "tid": 1, "pid": _os.getpid() + 1,
+        "sid": 0, "parent": None,
+        "attrs": {"trace_id": "ab12", "ctx_role": "daemon"},
+    }
+    assert propagate.adopt(
+        [remote], pid=remote["pid"], wall_origin=t.wall_origin,
+        origin_ns=now) == 1
+    events = obs_export.chrome_trace(t)["traceEvents"]
+    merged = [e for e in events if e.get("pid") == remote["pid"]]
+    assert merged and merged[0]["name"] == "serve/check"
+    assert abs(merged[0]["dur"] - 5_000.0) < 1.0  # µs
+    obs.enable(reset=True)
+
+
+def test_daemon_queue_wait_and_live_status():
+    """Admission→dispatch queue wait is measured (the invisibility
+    fix) and /status carries the last-60 s live view."""
+    obs.enable(reset=True)
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=23, n=3, wide=False)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        client.check_batch(model, hists, slot_cap=32)
+        snap = {d["name"]: d for d in obs.registry().snapshot()}
+        qw = snap.get("jepsen_serve_queue_wait_seconds")
+        assert qw is not None and qw["count"] >= 1
+        live = client.status()["live"]
+        assert live["requests_per_s"] > 0
+        assert live["queue_wait_mean_s"] is not None
+        assert 0.0 <= live["device_busy_ratio"] <= 1.0
+    finally:
+        daemon.stop()
+        obs.enable(reset=True)
+
+
 def test_render_prom_matches_file_dump(tmp_path):
     from jepsen_tpu.obs import export as obs_export
 
