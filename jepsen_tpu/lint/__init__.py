@@ -1,16 +1,24 @@
 """jtlint: the project-native static-analysis suite.
 
-``python -m jepsen_tpu.lint [paths]`` runs four AST-based passes that
+``python -m jepsen_tpu.lint [paths]`` runs seven AST-based passes that
 encode this repo's real invariants (doc/static-analysis.md):
 
 - **trace-safety** — host impurity reachable inside jit/vmap/pmap
   traced code, and implicit device syncs in the dispatch path.
 - **lock-discipline** — ``# jt: guarded-by(<lock>)`` lockset checking
   over the multi-threaded engine/obs/control state.
+- **concurrency** — whole-program race inference: thread roots, call
+  graph, escape analysis, and interprocedural locksets — no
+  annotations required, existing annotations audited.
 - **obs-hygiene** — span enter/exit pairing and ``jepsen_*`` metric
   naming/registration/doc conformance.
 - **protocol** — checker ``check`` seam conformance and suite
   workload/fault/name-table drift.
+- **contracts** — both sides of every serialized seam diffed
+  statically: service frames, journal schema, calibration params, and
+  the ``JEPSEN_TPU_*`` env registry (:mod:`jepsen_tpu.lint.envvars`).
+- **budget** — every jit-kernel dispatch rides an Executor /
+  ``safe_dispatch``-capped path (the ``has_cycle_batch`` bug class).
 
 Dependency-free (stdlib ``ast`` only — linting ``ops/`` never imports
 JAX), wired into ``make lint`` / ``make check``, non-zero exit on any

@@ -24,11 +24,54 @@ def _default_paths() -> List[str]:
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
 
+def _sarif_report(result) -> dict:
+    """SARIF 2.1.0 (the subset GitHub code scanning consumes): one run,
+    one rule descriptor per distinct rule, one result per finding.
+    Fingerprints ride along so annotation identity survives line drift
+    exactly like the baseline does."""
+    seen_rules = sorted({f.rule for f in result.findings})
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                    },
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": max(1, f.col + 1),
+                    },
+                },
+            }],
+            "partialFingerprints": {"jtlint/v1": f.fingerprint()},
+        })
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jtlint",
+                "informationUri":
+                    "doc/static-analysis.md",
+                "rules": [{"id": r} for r in seen_rules],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m jepsen_tpu.lint",
-        description="jtlint: trace-safety, lock-discipline, obs-hygiene "
-                    "and protocol-conformance static analysis",
+        description="jtlint: trace-safety, lock-discipline, concurrency "
+                    "(whole-program race inference), obs-hygiene, "
+                    "protocol-conformance, seam-contract and "
+                    "dispatch-budget static analysis",
     )
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the jepsen_tpu "
@@ -50,6 +93,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", metavar="FILE", nargs="?", const="lint.json",
                     default=None,
                     help="write a JSON report (default file: lint.json)")
+    ap.add_argument("--sarif", metavar="FILE", nargs="?",
+                    const="lint.sarif", default=None,
+                    help="write a SARIF 2.1.0 report (default file: "
+                         "lint.sarif) — CI renders it as annotations")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress the summary line")
     args = ap.parse_args(argv)
@@ -147,6 +194,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         }
         with open(args.json, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.sarif is not None:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(_sarif_report(result), fh, indent=2, sort_keys=True)
             fh.write("\n")
 
     if not args.quiet:

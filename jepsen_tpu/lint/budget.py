@@ -1,0 +1,313 @@
+"""budget-discipline pass: every kernel dispatch rides a capped path.
+
+PR 10's review found ``has_cycle_batch`` had shipped calling its jit
+closure directly — no ``safe_dispatch`` cap, no chunking — so one
+oversized batch could blow the device-memory budget that every other
+dispatch path respects.  This pass closes that bug class structurally.
+
+The model, whole-program and inference-based:
+
+- A **builder** is a function that manufactures a dispatchable kernel:
+  its body returns a ``jax.jit(...)`` result (directly, through a
+  local, or as a ``@jax.jit``-decorated inner ``def``), or stamps a
+  ``safe_dispatch`` attribute, or merely delegates by returning a call
+  to another builder (``make_check_fn`` → ``_make_check_fn``).
+  Builder names are collected across every scanned file first, so
+  cross-module construction sites resolve.
+- A **kernel value** is the result of calling a builder: a local
+  (``fn = make_check_fn(...)``), an instance attribute
+  (``self.fn = _cyclic_fn(...)``), or an immediate call
+  (``builder(...)(...)``).
+
+Rules:
+
+- ``budget-direct-dispatch`` — a kernel value *called* outside the
+  sanctioned dispatch paths.  Sanctioned: ``engine/execution.py`` (the
+  Executor owns chunking), ``*smoke.py`` files, a call inside a lambda
+  that is itself an argument of a ``jax.jit(...)`` call (the
+  jit-of-jit rebatching wrapper), a function whose body visibly
+  enforces the budget (reads ``.safe_dispatch``/``.disp`` or calls a
+  ``*max_dispatch*`` helper), and lines annotated
+  ``# jt: direct-dispatch`` (bench/tune measurement loops — a declared
+  exception, with the annotation as the audit trail).
+- ``budget-missing-cap`` — a builder that returns a jit result without
+  stamping ``safe_dispatch`` anywhere in its body.  A builder wrapped
+  by a capping builder carries ``# jt: allow[budget-missing-cap]``
+  with the rationale naming its wrapper.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
+                   dotted_name, register)
+
+#: function names sanctioned to dispatch directly (the engine's own
+#: chunk loop helpers take the kernel as a parameter, which this pass
+#: never tracks — parameters are the *capped* hand-off idiom)
+SANCTIONED_FILES = ("engine/execution.py",)
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    return name in ("jax.jit", "jit") or name.endswith(".jit")
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target) or ""
+        if name in ("jax.jit", "jit") or name.endswith(".jit"):
+            return True
+    return False
+
+
+class _FileModel:
+    """Per-file builder/call facts, resolved program-wide later."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.idx = FunctionIndex(sf.tree)
+        #: fn qualname -> set of bare names it `return <name>(...)`s
+        self.delegations: Dict[str, Set[str]] = {}
+        #: fn qualnames that are definitely builders (jit seen locally)
+        self.local_builders: Set[str] = set()
+        #: fn qualnames that stamp `.safe_dispatch` somewhere
+        self.cappers: Set[str] = set()
+        #: builders that return a jit result (missing-cap candidates)
+        self.jit_returners: Dict[str, ast.AST] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        for q, fn in self.idx.funcs.items():
+            jit_vars: Set[str] = set()
+            jit_defs: Set[str] = set()
+            caps = False
+            # first sweep: what the body defines (two sweeps because a
+            # Return can precede the Assign feeding it in walk order)
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Assign):
+                    if _is_jit_call(node.value):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                jit_vars.add(t.id)
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and t.attr == "safe_dispatch"):
+                            caps = True
+                elif (isinstance(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and node is not fn and _jit_decorated(node)):
+                    jit_defs.add(node.name)
+            # second sweep: what it returns
+            returns_jit = False
+            delegates: Set[str] = set()
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    v = node.value
+                    if _is_jit_call(v):
+                        returns_jit = True
+                    elif isinstance(v, ast.Name) and (v.id in jit_vars
+                                                      or v.id in jit_defs):
+                        returns_jit = True
+                    elif (isinstance(v, ast.Call)
+                          and isinstance(v.func, ast.Name)):
+                        delegates.add(v.func.id)
+            if returns_jit:
+                self.jit_returners[q] = fn
+                self.local_builders.add(q)
+            if caps:
+                self.cappers.add(q)
+                self.local_builders.add(q)
+            if delegates:
+                self.delegations[q] = delegates
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk ``fn`` without descending into nested defs (they are
+    indexed — and judged — as their own functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+class BudgetDiscipline(Pass):
+    name = "budget"
+    rules = ("budget-direct-dispatch", "budget-missing-cap")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        models = [
+            _FileModel(sf) for sf in project.files if sf.tree is not None
+        ]
+        builders = self._builder_names(models)
+        for m in models:
+            self._check_missing_cap(m, builders, out)
+            self._check_direct_dispatch(m, builders, out)
+        return out
+
+    # -- phase 1: program-wide builder name set ----------------------------
+
+    def _builder_names(self, models: List[_FileModel]) -> Set[str]:
+        names: Set[str] = set()
+        for m in models:
+            for q in m.local_builders:
+                names.add(_last(q))
+        # delegation fixpoint: `def make(): return _make(...)` where
+        # _make is a builder makes `make` a builder too
+        changed = True
+        while changed:
+            changed = False
+            for m in models:
+                for q, callees in m.delegations.items():
+                    if _last(q) not in names and callees & names:
+                        names.add(_last(q))
+                        changed = True
+        return names
+
+    # -- budget-missing-cap ------------------------------------------------
+
+    def _check_missing_cap(self, m: _FileModel, builders: Set[str],
+                           out: List[Finding]) -> None:
+        for q, fn in sorted(m.jit_returners.items()):
+            if q in m.cappers:
+                continue
+            self._emit(
+                out, m.sf, "budget-missing-cap", fn, q,
+                f"`{_last(q)}` returns a jit kernel without stamping"
+                " `safe_dispatch` — every dispatchable fn must carry"
+                " its footprint-safe row cap (or the wrapping builder"
+                " must, with an allow naming it)")
+
+    # -- budget-direct-dispatch --------------------------------------------
+
+    def _sanctioned_file(self, sf: SourceFile) -> bool:
+        rel = sf.rel.replace(os.sep, "/")
+        if rel.endswith("smoke.py"):
+            return True
+        return any(rel.endswith(s) for s in SANCTIONED_FILES)
+
+    def _enforcing_fn(self, fn: ast.AST) -> bool:
+        """The enclosing function visibly participates in budget
+        enforcement: it reads the cap or calls a `*max_dispatch*`
+        helper before dispatching."""
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("safe_dispatch", "disp")
+                    and isinstance(node.ctx, ast.Load)):
+                return True
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if "max_dispatch" in _last(name):
+                    return True
+        return False
+
+    def _check_direct_dispatch(self, m: _FileModel, builders: Set[str],
+                               out: List[Finding]) -> None:
+        if self._sanctioned_file(m.sf):
+            return
+        sf, idx = m.sf, m.idx
+        # lambda bodies that are arguments of a jax.jit(...) call: the
+        # jit-of-jit rebatching wrapper (`jax.jit(lambda adj:
+        # base(adj))`) re-enters the tracer, it does not dispatch
+        jit_lambda_spans: List[Tuple[int, int]] = []
+        for node in ast.walk(sf.tree):
+            if _is_jit_call(node):
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        jit_lambda_spans.append(
+                            (arg.lineno, arg.end_lineno or arg.lineno))
+
+        def in_jit_lambda(n: ast.AST) -> bool:
+            return any(lo <= n.lineno <= hi for lo, hi in jit_lambda_spans)
+
+        # per-class kernel attrs: self.x = <builder>(...)
+        kernel_attrs: Dict[str, Set[str]] = {}
+        for cq, cls in idx.classes.items():
+            attrs: Set[str] = set()
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not (isinstance(node.value, ast.Call)
+                        and _last(dotted_name(node.value.func) or "")
+                        in builders):
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        attrs.add(t.attr)
+            if attrs:
+                kernel_attrs[cq] = attrs
+
+        for q, fn in sorted(idx.funcs.items()):
+            cls = self._owning_class(q, idx)
+            attrs = kernel_attrs.get(cls, set()) if cls else set()
+            enforcing = self._enforcing_fn(fn)
+            kernel_vars: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if (isinstance(node.value, ast.Call)
+                            and _last(dotted_name(node.value.func) or "")
+                            in builders):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                kernel_vars.add(t.id)
+                if not isinstance(node, ast.Call):
+                    continue
+                target: Optional[str] = None
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in kernel_vars):
+                    target = node.func.id
+                elif (isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id == "self"
+                      and node.func.attr in attrs):
+                    target = f"self.{node.func.attr}"
+                elif (isinstance(node.func, ast.Call)
+                      and _last(dotted_name(node.func.func) or "")
+                      in builders):
+                    target = _last(dotted_name(node.func.func) or "")
+                if target is None:
+                    continue
+                if enforcing or in_jit_lambda(node):
+                    continue
+                if sf.marked(node.lineno, "direct-dispatch"):
+                    continue
+                self._emit(
+                    out, sf, "budget-direct-dispatch", node, q,
+                    f"kernel `{target}` dispatched directly — route it"
+                    " through the Executor or a `safe_dispatch`-capped"
+                    " chunk loop (or annotate a measurement loop"
+                    " `# jt: direct-dispatch`)")
+
+    def _owning_class(self, q: str, idx: FunctionIndex) -> Optional[str]:
+        parent = idx.parents.get(q)
+        while parent is not None:
+            if parent in idx.classes:
+                return parent
+            parent = idx.parents.get(parent)
+        return None
+
+    def _emit(self, out, sf, rule, node, scope, msg) -> None:
+        if sf.allowed(node.lineno, rule):
+            return
+        out.append(Finding(rule, sf.rel, node.lineno, node.col_offset,
+                           msg, scope))
+
+
+register(BudgetDiscipline())

@@ -1,0 +1,855 @@
+"""concurrency pass: whole-program race inference, no annotations needed.
+
+lock-discipline (PR 5) is opt-in: it checks the five modules that
+declare `# jt: guarded-by` contracts and is silent everywhere else.
+The serve tier and the fleet work stacked on top of it multiply the
+thread surface faster than hand annotation can follow, so this pass
+inverts the burden of proof: it *infers* which state is shared and
+which locks actually protect it, across every scanned file at once.
+
+The inference, in order:
+
+1. **Thread roots.**  ``# jt: thread-entry`` marks, ``threading.Thread
+   (target=f)``, ``pool.submit(f, …)``, ``on_retire=f`` retirement
+   callbacks, and — structurally — ``do_*`` methods of classes whose
+   bases mention ``RequestHandler`` (http.server dispatches each
+   request on its own thread; the mark inside daemon.py's ``do_GET``
+   comment is prose, the class shape is the contract).
+2. **Call graph.**  Same-module calls, ``self.m()``, imported-module
+   ``alias.f()``, constructor-typed locals and ``self.attr`` receivers
+   (``executor = execution.Executor(…)`` → ``executor.submit`` →
+   ``Executor.submit``), and a conservative class-hierarchy fallback:
+   an unresolved ``x.m()`` edges to ``m`` only when at most
+   :data:`CHA_MAX` scanned classes define it and ``m`` isn't a builtin
+   collection method (``seen.add(…)`` must not edge into
+   ``_SlotRing.add``).  Nested defs run on behalf of their parent.
+3. **Colors.**  Every root seeds its own color; functions nothing in
+   the scanned tree calls (public API) and module-import-time call
+   targets seed ``main``.  Colors flow caller → callee to a fixpoint;
+   state touched under ≥2 colors is *shared*.
+4. **Locksets.**  A function's effective lockset is ``holds(fn)`` ∪
+   the *intersection* over its call sites of (``with``-scope locks at
+   the site ∪ the caller's effective set) — a decreasing fixpoint
+   from ⊤.  This proves e.g. that a helper is only ever entered with
+   the registry lock held, without any ``holds`` annotation.
+5. **Happens-before.**  Hand-offs through ``Future.result()`` /
+   ``queue.get()`` are modeled implicitly: accesses through typed
+   *locals* of another class are out of scope (the request object
+   crossing the queue is the hand-off), and accesses textually after
+   a ``.wait()``/``.join()``/``.result()`` in the same body are
+   exempt from drift findings (the write they observe was published
+   before the synchronization edge).
+
+State tracked: ``self._*`` attributes accessed in their owning class,
+and module globals.  ``__init__`` is exempt (construction precedes
+sharing); attributes holding synchronization primitives are skipped;
+attributes already carrying ``# jt: guarded-by`` stay lock-discipline's
+contract (this pass instead *audits the annotations themselves*).
+
+Rules:
+
+- ``concurrency-unguarded-shared`` — shared state mutated with an
+  empty effective lockset.  The worst bug class a checker can have:
+  corruption that only *occasionally* happens.
+- ``concurrency-guard-drift`` — every mutation of the state agrees on
+  a lock, but this access doesn't hold it (the classic forgotten-lock
+  read that works until it doesn't).
+- ``concurrency-lock-missing`` — a ``guarded-by(L)``/``holds(L)``
+  annotation naming a lock the module never constructs: the
+  annotation drifted from the code it documents.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Set, Tuple
+
+from .core import (Finding, FunctionIndex, OWNER_THREAD, Pass, Project,
+                   SourceFile, dotted_name, register)
+
+#: method calls that mutate their receiver
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "rotate", "sort", "reverse", "write", "writelines",
+    "put", "put_nowait",
+})
+
+#: constructors whose product is a synchronization object (or a thread
+#: handle) — the primitive itself is not a data race
+SYNC_CTORS = frozenset({
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "local", "Thread",
+})
+
+#: calls that establish a happens-before edge for what follows them
+WAIT_CALLS = frozenset({"wait", "join", "result"})
+
+#: builtin collection methods the CHA fallback must never edge through
+CHA_BLOCKLIST = frozenset({
+    "add", "append", "get", "pop", "update", "clear", "remove",
+    "discard", "items", "keys", "values", "extend", "insert", "sort",
+    "count", "index", "copy", "join", "split", "read", "write",
+    "close", "put", "set", "release", "acquire", "notify",
+    "notify_all", "start",
+})
+
+#: max program classes defining a method before CHA gives up on it
+CHA_MAX = 3
+
+MAIN_COLOR = "main"
+
+FnKey = Tuple[str, str]          # (module, fn qualname)
+StateKey = Tuple[str, str, str]  # (module, class qualname or "", attr)
+
+
+class Access(NamedTuple):
+    key: StateKey
+    kind: str                    # "read" | "write"
+    site_locks: FrozenSet[str]
+    fn: FnKey
+    node: ast.AST
+    sf: SourceFile
+    in_init: bool
+    hb_shielded: bool
+
+
+def _module_of(rel: str) -> str:
+    rel = rel.replace(os.sep, "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _ctor_last(call: ast.Call) -> str:
+    return (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+
+
+def _value_candidates(v: ast.AST) -> List[ast.AST]:
+    """The leaf expressions an assignment value may evaluate to —
+    unwraps conditional expressions (`C(...) if flag else None`)."""
+    if isinstance(v, ast.IfExp):
+        return _value_candidates(v.body) + _value_candidates(v.orelse)
+    return [v]
+
+
+class _ModModel:
+    """Per-module facts: imports, classes, globals, annotations."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.idx = FunctionIndex(sf.tree)
+        self.module = _module_of(sf.rel)
+        self.package = self.module.rsplit(".", 1)[0] \
+            if "." in self.module else ""
+        #: alias -> imported module dotted name
+        self.import_mods: Dict[str, str] = {}
+        #: name -> (module, original name) for `from m import n`
+        self.import_names: Dict[str, Tuple[str, str]] = {}
+        #: module-level assigned names
+        self.globals: Set[str] = set()
+        self.sync_globals: Set[str] = set()
+        #: (class qualname, attr) -> constructor call for typing
+        self.attr_ctors: Dict[Tuple[str, str], ast.Call] = {}
+        self.sync_attrs: Set[Tuple[str, str]] = set()
+        #: guarded-by annotations: (line, lock, state key)
+        self.guards: List[Tuple[int, str, StateKey]] = []
+        self.holds_decls: List[Tuple[int, str, str]] = []
+        #: resolved types, filled program-wide: (cls, attr) -> class key
+        self.attr_types: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._collect()
+
+    # -- local collection ---------------------------------------------------
+
+    def _collect(self) -> None:
+        self._collect_imports()
+        self._collect_globals()
+        self._collect_attrs()
+        self._collect_annotations()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.import_mods[a.asname] = a.name
+                    else:
+                        head = a.name.split(".", 1)[0]
+                        self.import_mods[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    parts = self.module.split(".")
+                    parts = parts[: len(parts) - node.level]
+                    base = ".".join(parts + ([node.module]
+                                             if node.module else []))
+                for a in node.names:
+                    bound = a.asname or a.name
+                    self.import_names[bound] = (base, a.name)
+                    self.import_mods[bound] = (f"{base}.{a.name}"
+                                               if base else a.name)
+
+    def _collect_globals(self) -> None:
+        for stmt in self.sf.tree.body:
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.globals.add(t.id)
+                    if (isinstance(getattr(stmt, "value", None), ast.Call)
+                            and _ctor_last(stmt.value) in SYNC_CTORS):
+                        self.sync_globals.add(t.id)
+
+    def _collect_attrs(self) -> None:
+        for cq, cls in self.idx.classes.items():
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if isinstance(node.value, ast.Call):
+                        self.attr_ctors.setdefault((cq, t.attr),
+                                                   node.value)
+                        if _ctor_last(node.value) in SYNC_CTORS:
+                            self.sync_attrs.add((cq, t.attr))
+
+    def _collect_annotations(self) -> None:
+        for cq, cls in self.idx.classes.items():
+            for node in ast.walk(cls):
+                target = None
+                if isinstance(node, ast.Assign) and node.targets:
+                    target = node.targets[0]
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    target = node.target
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                lock = self.sf.guarded_by(node.lineno)
+                if lock:
+                    self.guards.append(
+                        (node.lineno, lock,
+                         (self.module, cq, target.attr)))
+        for stmt in self.sf.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    lock = self.sf.guarded_by(stmt.lineno)
+                    if lock:
+                        self.guards.append(
+                            (stmt.lineno, lock, (self.module, "", t.id)))
+        for q, fn in self.idx.funcs.items():
+            lock = self.sf.holds(fn.lineno)
+            if lock:
+                self.holds_decls.append((fn.lineno, lock, q))
+
+    # -- program-phase helpers ----------------------------------------------
+
+    def owning_class(self, fn_q: str) -> Optional[str]:
+        parent = self.idx.parents.get(fn_q)
+        while parent is not None:
+            if parent in self.idx.classes:
+                return parent
+            parent = self.idx.parents.get(parent)
+        return None
+
+    def lock_names(self) -> Set[str]:
+        out = set(self.sync_globals)
+        out.update(attr for (_, attr) in self.sync_attrs)
+        return out
+
+
+class _Program:
+    """The cross-module view: types, call sites, colors, locksets."""
+
+    def __init__(self, models: List[_ModModel]):
+        self.models = {m.module: m for m in models}
+        self.fn_node: Dict[FnKey, ast.AST] = {}
+        #: method name -> class keys defining it (CHA fallback)
+        self.method_defs: Dict[str, List[Tuple[str, str]]] = {}
+        for m in models:
+            for q, fn in m.idx.funcs.items():
+                self.fn_node[(m.module, q)] = fn
+                cls = m.owning_class(q)
+                if cls is not None and "." not in q[len(cls) + 1:]:
+                    self.method_defs.setdefault(
+                        q.rsplit(".", 1)[-1], []).append((m.module, cls))
+        self._resolve_attr_types()
+        self.entries: Set[FnKey] = set()
+        self.main_seeds: Set[FnKey] = set()
+        #: http.server handler classes: one instance per request, so
+        #: their own attrs are request-confined by the framework
+        self.handler_classes: Set[Tuple[str, str]] = set()
+        #: classes whose instances are stored in module globals
+        self.global_stored: Set[Tuple[str, str]] = set()
+        #: callee -> [(caller, site locks)]
+        self.call_sites: Dict[FnKey, List[Tuple[FnKey,
+                                                FrozenSet[str]]]] = {}
+        self.accesses: List[Access] = []
+        for m in models:
+            self._collect_entries(m)
+        for m in models:
+            self._walk_module(m)
+
+    def shared_classes(self) -> Set[Tuple[str, str]]:
+        """Instance-escape fixpoint: a class is *shared* when its
+        instances are reachable from more than one thread — it hosts a
+        thread root itself, lives in a module global, or is stored in
+        an attribute of a shared class.  Everything else (per-worker
+        protocol clients, the per-run RunContext, request handlers) is
+        instance-confined no matter how many colors its methods get."""
+        shared: Set[Tuple[str, str]] = set(self.global_stored)
+        for (mod, q) in self.entries:
+            m = self.models.get(mod)
+            if m is None:
+                continue
+            cls = m.owning_class(q)
+            if cls is not None and (mod, cls) not in self.handler_classes:
+                shared.add((mod, cls))
+        changed = True
+        while changed:
+            changed = False
+            for m in self.models.values():
+                for (cq, _attr), t in m.attr_types.items():
+                    if (m.module, cq) in shared and t not in shared \
+                            and t not in self.handler_classes:
+                        shared.add(t)
+                        changed = True
+        return shared
+
+    # -- constructor typing -------------------------------------------------
+
+    def resolve_class(self, m: _ModModel,
+                      node: ast.AST) -> Optional[Tuple[str, str]]:
+        """The scanned class a constructor expression refers to."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        if "." not in name:
+            if name in m.idx.classes:
+                return (m.module, name)
+            imp = m.import_names.get(name)
+            if imp and imp[0] in self.models:
+                m2 = self.models[imp[0]]
+                if imp[1] in m2.idx.classes:
+                    return (imp[0], imp[1])
+            return None
+        head, last = name.rsplit(".", 1)
+        mod2 = m.import_mods.get(head)
+        if mod2 and mod2 in self.models:
+            m2 = self.models[mod2]
+            if last in m2.idx.classes:
+                return (mod2, last)
+        return None
+
+    def _resolve_attr_types(self) -> None:
+        for m in self.models.values():
+            for (cq, attr), call in m.attr_ctors.items():
+                t = self.resolve_class(m, call.func)
+                if t is not None:
+                    m.attr_types[(cq, attr)] = t
+
+    # -- thread roots -------------------------------------------------------
+
+    def _collect_entries(self, m: _ModModel) -> None:
+        for q, fn in m.idx.funcs.items():
+            if m.sf.marked(fn.lineno, "thread-entry"):
+                self.entries.add((m.module, q))
+        for cq, cls in m.idx.classes.items():
+            if not any("RequestHandler" in (dotted_name(b) or "")
+                       for b in cls.bases):
+                continue
+            self.handler_classes.add((m.module, cq))
+            for q in m.idx.funcs:
+                if (m.idx.parents.get(q) == cq
+                        and q.rsplit(".", 1)[-1].startswith("do_")):
+                    self.entries.add((m.module, q))
+        for q, fn in m.idx.funcs.items():
+            cls = m.owning_class(q)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ref: Optional[ast.AST] = None
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "submit" and node.args):
+                    ref = node.args[0]
+                if (dotted_name(node.func) or "").rsplit(
+                        ".", 1)[-1] == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            ref = kw.value
+                # NOT a root: `on_retire=` callbacks — DispatchWindow
+                # runs retirement on the *owner* thread (and enforces
+                # it at runtime), so they inherit the caller's color
+                # via a plain call edge instead (see _walk_fn)
+                if ref is None:
+                    continue
+                for key in self._resolve_ref(m, cls, ref, {}):
+                    self.entries.add(key)
+
+    def _resolve_ref(self, m: _ModModel, cls: Optional[str],
+                     ref: ast.AST,
+                     local_types: Dict[str, Tuple[str, str]]
+                     ) -> List[FnKey]:
+        """A callable reference (callback or call target) -> fn keys."""
+        if isinstance(ref, ast.Name):
+            if (m.module, ref.id) in self.fn_node:
+                return [(m.module, ref.id)]
+            imp = m.import_names.get(ref.id)
+            if imp and (imp[0], imp[1]) in self.fn_node:
+                return [(imp[0], imp[1])]
+            t = None
+            if ref.id in local_types:
+                t = local_types[ref.id]
+            if ref.id in m.idx.classes:
+                t = (m.module, ref.id)
+            elif imp and imp[0] in self.models \
+                    and imp[1] in self.models[imp[0]].idx.classes:
+                t = (imp[0], imp[1])
+            if t is not None and (t[0], f"{t[1]}.__init__") in self.fn_node:
+                return [(t[0], f"{t[1]}.__init__")]
+            return []
+        if not isinstance(ref, ast.Attribute):
+            return []
+        last = ref.attr
+        base = ref.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                if cls is not None:
+                    key = (m.module, f"{cls}.{last}")
+                    if key in self.fn_node:
+                        return [key]
+                return self._cha(last)
+            if base.id in local_types:
+                t = local_types[base.id]
+                key = (t[0], f"{t[1]}.{last}")
+                return [key] if key in self.fn_node else self._cha(last)
+            mod2 = m.import_mods.get(base.id)
+            if mod2 and mod2 in self.models:
+                if (mod2, last) in self.fn_node:
+                    return [(mod2, last)]
+                if last in self.models[mod2].idx.classes:
+                    key = (mod2, f"{last}.__init__")
+                    return [key] if key in self.fn_node else []
+                return []
+            return self._cha(last)
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls is not None):
+            t = m.attr_types.get((cls, base.attr))
+            if t is not None:
+                key = (t[0], f"{t[1]}.{last}")
+                return [key] if key in self.fn_node else []
+            return self._cha(last)
+        return self._cha(last)
+
+    def _cha(self, method: str) -> List[FnKey]:
+        if method in CHA_BLOCKLIST:
+            return []
+        defs = self.method_defs.get(method, [])
+        if not defs or len(defs) > CHA_MAX:
+            return []
+        out = []
+        for (mod, cls) in defs:
+            key = (mod, f"{cls}.{method}")
+            if key in self.fn_node:
+                out.append(key)
+        return out
+
+    # -- per-function walk: edges + accesses --------------------------------
+
+    def _walk_module(self, m: _ModModel) -> None:
+        # module-import-time call targets run on the main thread
+        self._top_level_calls(m)
+        # module-level `G = C(...)`: C escapes to every importer
+        for stmt in m.sf.tree.body:
+            if isinstance(stmt, ast.Assign) \
+                    and any(isinstance(t, ast.Name) for t in stmt.targets):
+                for v in _value_candidates(stmt.value):
+                    if isinstance(v, ast.Call):
+                        t = self.resolve_class(m, v.func)
+                        if t is not None:
+                            self.global_stored.add(t)
+        for q, fn in sorted(m.idx.funcs.items()):
+            self._walk_fn(m, q, fn)
+
+    def _top_level_calls(self, m: _ModModel) -> None:
+        def scan(body) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    scan(stmt.body)
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        for key in self._resolve_ref(m, None,
+                                                     node.func, {}):
+                            self.main_seeds.add(key)
+        scan(m.sf.tree.body)
+
+    def _walk_fn(self, m: _ModModel, q: str, fn: ast.AST) -> None:
+        caller: FnKey = (m.module, q)
+        cls = m.owning_class(q)
+        in_init = q.rsplit(".", 1)[-1] == "__init__"
+        local_types: Dict[str, Tuple[str, str]] = {}
+        global_decls: Set[str] = set()
+        shadowed: Set[str] = set()
+        min_wait = [None]  # type: List[Optional[int]]
+
+        # pre-pass: global decls first (walk order is arbitrary), then
+        # local constructor types, shadowing, earliest HB call
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                global_decls.update(node.names)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tid = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    t = self.resolve_class(m, node.value.func)
+                    if t is not None:
+                        local_types[tid] = t
+                if tid in m.globals and tid not in global_decls:
+                    shadowed.add(tid)
+                if tid in global_decls:
+                    # a scanned-class instance published to a module
+                    # global escapes to every thread (e.g. the journal
+                    # singleton `_active = DispatchJournal(...) if path
+                    # else None` — the IfExp is unwrapped)
+                    for v in _value_candidates(node.value):
+                        t = None
+                        if isinstance(v, ast.Call):
+                            t = self.resolve_class(m, v.func)
+                        elif isinstance(v, ast.Name):
+                            t = local_types.get(v.id)
+                        if t is not None:
+                            self.global_stored.add(t)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id == "self" \
+                    and cls is not None \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in local_types:
+                # `self.x = <typed local>`: the attr carries the type
+                # (escape + receiver resolution)
+                m.attr_types.setdefault((cls, node.targets[0].attr),
+                                        local_types[node.value.id])
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in WAIT_CALLS):
+                if min_wait[0] is None or node.lineno < min_wait[0]:
+                    min_wait[0] = node.lineno
+
+        def shielded(node: ast.AST) -> bool:
+            return min_wait[0] is not None and node.lineno > min_wait[0]
+
+        def record(attr_key: StateKey, kind: str, locks: FrozenSet[str],
+                   node: ast.AST) -> None:
+            self.accesses.append(Access(
+                attr_key, kind, locks, caller, node, m.sf,
+                in_init, shielded(node)))
+
+        def visit(node: ast.AST, locks: FrozenSet[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: its body is its own fn key, reached on
+                # behalf of this one
+                nested = (m.module, self.idx_qual(m, node) or q)
+                if nested in self.fn_node and nested != caller:
+                    self.call_sites.setdefault(nested, []).append(
+                        (caller, frozenset()))
+                return
+            if isinstance(node, ast.With):
+                added = set()
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call):
+                        continue
+                    name = dotted_name(ctx)
+                    if name:
+                        added.add(name.rsplit(".", 1)[-1])
+                inner = locks | added
+                for item in node.items:
+                    visit(item.context_expr, locks)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                refs = self._resolve_ref(m, cls, node.func, local_types)
+                for key in refs:
+                    self.call_sites.setdefault(key, []).append(
+                        (caller, locks))
+                for kw in node.keywords:
+                    # retirement callbacks run on the window-owner
+                    # thread: a plain call edge, not a thread root
+                    if kw.arg == "on_retire":
+                        for key in self._resolve_ref(m, cls, kw.value,
+                                                     local_types):
+                            self.call_sites.setdefault(key, []).append(
+                                (caller, locks))
+                if (not refs and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in MUTATORS):
+                    recv = node.func.value
+                    self._mutation(m, cls, recv, locks, node, record,
+                                   shadowed)
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and cls is not None):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    record((m.module, cls, node.attr), "write", locks,
+                           node)
+                elif isinstance(node.ctx, ast.Load) \
+                        and not self._is_receiver(node):
+                    record((m.module, cls, node.attr), "read", locks,
+                           node)
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                self._mutation(m, cls, node.value, locks, node, record,
+                               shadowed)
+            if isinstance(node, ast.Name) \
+                    and node.id in m.globals and node.id not in shadowed:
+                if isinstance(node.ctx, ast.Store):
+                    if node.id in global_decls:
+                        record((m.module, "", node.id), "write", locks,
+                               node)
+                elif isinstance(node.ctx, ast.Load):
+                    record((m.module, "", node.id), "read", locks, node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks)
+
+        for stmt in fn.body:
+            visit(stmt, frozenset())
+
+    def _is_receiver(self, node: ast.Attribute) -> bool:
+        # marker so `self.x.append(...)` isn't double-counted; the
+        # mutation record carries the write, the Load is implied
+        return getattr(node, "_jt_receiver", False)
+
+    def _mutation(self, m: _ModModel, cls: Optional[str], recv: ast.AST,
+                  locks: FrozenSet[str], node: ast.AST, record,
+                  shadowed: Set[str]) -> None:
+        """A mutating method call / subscript store on ``recv``."""
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and cls is not None):
+            recv._jt_receiver = True  # type: ignore[attr-defined]
+            record((m.module, cls, recv.attr), "write", locks, node)
+        elif isinstance(recv, ast.Name) and recv.id in m.globals \
+                and recv.id not in shadowed:
+            record((m.module, "", recv.id), "write", locks, node)
+
+    def idx_qual(self, m: _ModModel, fn: ast.AST) -> Optional[str]:
+        for q, node in m.idx.funcs.items():
+            if node is fn:
+                return q
+        return None
+
+    # -- fixpoints ----------------------------------------------------------
+
+    def colors(self) -> Dict[FnKey, FrozenSet[str]]:
+        out: Dict[FnKey, Set[str]] = {k: set() for k in self.fn_node}
+        for e in self.entries:
+            if e in out:
+                out[e].add(f"{e[0]}:{e[1]}")
+        for k in self.fn_node:
+            if k in self.main_seeds or (
+                    k not in self.entries and not self.call_sites.get(k)):
+                out[k].add(MAIN_COLOR)
+        changed = True
+        while changed:
+            changed = False
+            for callee, sites in self.call_sites.items():
+                if callee not in out:
+                    continue
+                for caller, _ in sites:
+                    add = out.get(caller, set()) - out[callee]
+                    if add:
+                        out[callee].update(add)
+                        changed = True
+        return {k: frozenset(v) for k, v in out.items()}
+
+    def eff_locks(self) -> Dict[FnKey, Optional[FrozenSet[str]]]:
+        holds: Dict[FnKey, FrozenSet[str]] = {}
+        for m in self.models.values():
+            for (_, lock, q) in m.holds_decls:
+                if lock != OWNER_THREAD:
+                    holds[(m.module, q)] = frozenset({lock})
+        eff: Dict[FnKey, Optional[FrozenSet[str]]] = {
+            k: None for k in self.fn_node}  # None = ⊤ (unconstrained)
+        changed = True
+        while changed:
+            changed = False
+            for k in self.fn_node:
+                sites = self.call_sites.get(k, [])
+                acc: Optional[FrozenSet[str]] = None
+                constrained = False
+                if k in self.entries or k in self.main_seeds \
+                        or not sites:
+                    acc = frozenset()
+                    constrained = True
+                for caller, locks in sites:
+                    ce = eff.get(caller)
+                    if ce is None:
+                        continue
+                    s = locks | ce
+                    acc = s if not constrained else (acc & s)
+                    constrained = True
+                if not constrained:
+                    continue
+                new = holds.get(k, frozenset()) | acc
+                if eff[k] is None or new != eff[k]:
+                    # decreasing from ⊤: only ever shrink
+                    if eff[k] is None or new < eff[k]:
+                        eff[k] = new
+                        changed = True
+        return eff
+
+
+def _display(key: StateKey) -> str:
+    mod, cls, attr = key
+    short = mod.rsplit(".", 1)[-1]
+    if cls:
+        return f"{short}.{cls.rsplit('.', 1)[-1]}.{attr}"
+    return f"{short} global `{attr}`"
+
+
+class ConcurrencyPass(Pass):
+    name = "concurrency"
+    rules = ("concurrency-unguarded-shared", "concurrency-guard-drift",
+             "concurrency-lock-missing")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        models = [
+            _ModModel(sf) for sf in project.files if sf.tree is not None
+        ]
+        if not models:
+            return out
+        prog = _Program(models)
+        colors = prog.colors()
+        eff = prog.eff_locks()
+        self._check_shared(models, prog, colors, eff, out)
+        self._check_annotations(models, out)
+        return out
+
+    # -- shared-state rules -------------------------------------------------
+
+    def _check_shared(self, models, prog: _Program, colors, eff,
+                      out: List[Finding]) -> None:
+        by_mod = {m.module: m for m in models}
+        guarded: Set[StateKey] = set()
+        for m in models:
+            for (_, _, key) in m.guards:
+                guarded.add(key)
+
+        shared_cls = prog.shared_classes()
+        grouped: Dict[StateKey, List[Access]] = {}
+        for a in prog.accesses:
+            grouped.setdefault(a.key, []).append(a)
+
+        for key in sorted(grouped):
+            mod, cls, attr = key
+            m = by_mod[mod]
+            if key in guarded:
+                continue
+            if cls and (mod, cls) not in shared_cls:
+                continue
+            if cls and (cls, attr) in m.sync_attrs:
+                continue
+            if not cls and attr in m.sync_globals:
+                continue
+            accesses = grouped[key]
+            shared_colors: Set[str] = set()
+            for a in accesses:
+                if not a.in_init:
+                    shared_colors |= colors.get(a.fn, frozenset())
+            if len(shared_colors) < 2:
+                continue
+
+            def locked(a: Access) -> FrozenSet[str]:
+                e = eff.get(a.fn)
+                return a.site_locks | (e or frozenset())
+
+            writes = [a for a in accesses
+                      if a.kind == "write" and not a.in_init]
+            if not writes:
+                continue
+            naked = [a for a in writes if not locked(a)]
+            for a in naked:
+                self._emit(
+                    out, a, "concurrency-unguarded-shared",
+                    f"`{_display(key)}` is mutated without any lock"
+                    " held, but it is reachable from more than one"
+                    " thread root — guard the mutation or annotate the"
+                    " confinement (`# jt: guarded-by(...)`)")
+            if naked:
+                continue
+            common = frozenset.intersection(
+                *[locked(a) for a in writes])
+            if not common:
+                continue
+            for a in accesses:
+                if a.in_init or a.hb_shielded:
+                    continue
+                if locked(a) & common:
+                    continue
+                lock_disp = "`, `".join(sorted(common))
+                self._emit(
+                    out, a, "concurrency-guard-drift",
+                    f"every mutation of `{_display(key)}` holds"
+                    f" `{lock_disp}`, but this access doesn't — a"
+                    " torn read/write window on shared state")
+
+    # -- annotation audit ---------------------------------------------------
+
+    def _check_annotations(self, models, out: List[Finding]) -> None:
+        for m in models:
+            known = m.lock_names()
+            decls = [(line, lock, f"guarded-by({lock})")
+                     for (line, lock, _) in m.guards]
+            decls += [(line, lock, f"holds({lock})")
+                      for (line, lock, _) in m.holds_decls]
+            for line, lock, disp in sorted(decls):
+                if lock == OWNER_THREAD:
+                    continue
+                base = lock.rsplit(".", 1)[-1]
+                if base in known:
+                    continue
+                if m.sf.allowed(line, "concurrency-lock-missing"):
+                    continue
+                probe = ast.Pass()
+                probe.lineno = line
+                scope = m.idx.enclosing(m.sf.tree, probe)
+                out.append(Finding(
+                    "concurrency-lock-missing", m.sf.rel, line, 0,
+                    f"`# jt: {disp}` names a lock this module never"
+                    " constructs — the annotation drifted from the"
+                    " code it documents", scope))
+
+    def _emit(self, out: List[Finding], a: Access, rule: str,
+              msg: str) -> None:
+        if a.sf.allowed(a.node.lineno, rule):
+            return
+        scope = a.fn[1]
+        out.append(Finding(rule, a.sf.rel, a.node.lineno,
+                           a.node.col_offset, msg, scope))
+
+
+register(ConcurrencyPass())

@@ -1,0 +1,598 @@
+"""seam-contract pass: both sides of every serialized seam agree.
+
+Every seam in this package is a pair of dict-shaped frames meeting
+over JSON: the service protocol (client stamps a frame, daemon parses
+it, and back), the dispatch journal (``execution.py`` emits rows,
+``validate_row`` gates them, doc/observability.md documents them),
+the calibration artifact (``PARAM_KEYS`` names what ``tune`` writes,
+the ``cal.*()`` accessors read it back), and the environment
+(``JEPSEN_TPU_*`` reads vs the :mod:`jepsen_tpu.lint.envvars`
+registry vs the operator doc).  PR 6's review caught a JSON
+key-stringification wire bug by hand; this pass catches the whole
+drift class statically, on both sides at once.
+
+The frame model (no imports, pure AST):
+
+- **Writer keys** of a function: the string keys of dict literals it
+  returns (directly, inside a returned tuple, or via a local later
+  returned or passed to ``encode_body``), plus constant subscript
+  stores on that local (``body["trace_ctx"] = …``).  A ``**spread``
+  is chased through ``x = dict(self.attr)`` / ``x = self.attr`` to a
+  class-wide ``self.attr = {…literal…}``; an unresolvable spread
+  marks the frame *open* (reads can no longer be proven unwritten).
+  Nested dict literals contribute to the readable key set but not to
+  the top-level frame (a nested payload is its own seam).
+- **Reader keys** of a function: constant ``var["k"]`` loads and
+  ``var.get("k")`` calls on the seam's designated payload variables.
+
+Rules:
+
+- ``seam-frame-drift`` — a key parsed on one side and never written
+  on the other (dead read: the reader sees only its default), or —
+  for request seams, where both ends are ours — written and never
+  parsed (dead weight on the wire).
+- ``seam-journal-schema`` — an ``emit(...)`` site in
+  ``engine/execution.py`` passing a key ``validate_row`` would drop,
+  or omitting a schema field (rows silently vanish from the journal:
+  exactly the failure the journal exists to record), or a schema
+  field missing from the doc/observability.md table.
+- ``seam-calibration-params`` — a ``.params["k"]`` accessor reading a
+  key ``PARAM_KEYS`` doesn't persist (always-default accessor), or a
+  persisted key no accessor reads (dead artifact weight).
+- ``seam-env-read`` — a ``JEPSEN_TPU_*`` environment read absent
+  from the :mod:`jepsen_tpu.lint.envvars` registry.
+- ``seam-env-doc`` — the registry vs the generated
+  doc/configuration.md table vs actual reads: undocumented registry
+  entries, documented-but-unregistered names, and (on full-tree
+  runs) registered names nothing reads any more.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
+                   dotted_name, register)
+
+_BACKTICK = re.compile(r"`([A-Za-z_][A-Za-z0-9_]*)`")
+_ENV_TOKEN = re.compile(r"`(JEPSEN_TPU_[A-Z0-9_]+)`")
+
+
+class Seam(NamedTuple):
+    name: str
+    writer_file: str
+    writer_fns: Tuple[str, ...]
+    reader_file: str
+    reader_fns: Tuple[str, ...]
+    reader_vars: Tuple[str, ...]
+    #: request frames have both ends in this package, so a written-
+    #: never-parsed key is drift too; response/status frames tolerate
+    #: extra keys (operator-facing surface, `jq`-able on purpose)
+    two_way: bool
+
+
+#: the serialized seams of the service tier.  A seam engages only
+#: when both files (and at least one function on each side) are in
+#: the scanned set, so subset runs and fixtures stay honest.
+SEAMS: Tuple[Seam, ...] = (
+    Seam("check-request", "serve/protocol.py", ("check_request",),
+         "serve/daemon.py", ("handle_check", "_check_flow"),
+         ("payload", "body"), True),
+    Seam("elle-request", "serve/protocol.py", ("elle_request",),
+         "serve/daemon.py", ("handle_elle",),
+         ("payload", "body"), True),
+    Seam("check-response", "serve/daemon.py", ("_check_flow",),
+         "serve/client.py", ("check_batch",),
+         ("payload",), False),
+    Seam("elle-response", "serve/daemon.py", ("_elle_flow",),
+         "serve/client.py", ("screen_graphs",),
+         ("payload",), False),
+    Seam("status", "serve/daemon.py", ("status",),
+         "serve/client.py", ("format_status", "format_live",
+                             "format_top", "mesh_matches_daemon"),
+         ("st", "live"), False),
+    Seam("trace", "serve/daemon.py", ("trace_dump",),
+         "serve/client.py", ("fetch_trace",),
+         ("payload",), True),
+)
+
+#: journal fields stamped by the journal itself, not by emit sites
+JOURNAL_AUTO_KEYS = frozenset({"v", "ts"})
+
+
+class _Frame(NamedTuple):
+    top_keys: Set[str]       # keys of the frame dict itself
+    all_keys: Set[str]       # + nested dict-literal keys
+    open: bool               # an unresolved **spread widens the frame
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dict_keys(d: ast.Dict) -> Tuple[Set[str], bool]:
+    """(constant string keys, has-spread) of one dict literal."""
+    keys: Set[str] = set()
+    spread = False
+    for k in d.keys:
+        if k is None:
+            spread = True
+            continue
+        s = _const_str(k)
+        if s is not None:
+            keys.add(s)
+    return keys, spread
+
+
+def _nested_keys(d: ast.Dict) -> Set[str]:
+    out: Set[str] = set()
+    for v in d.values:
+        for sub in ast.walk(v):
+            if isinstance(sub, ast.Dict):
+                out |= _dict_keys(sub)[0]
+    return out
+
+
+class _ClassAttrLiterals:
+    """``self.attr = {…literal…}`` keys, class-wide — resolves the
+    ``**stats`` spread in ``status()`` back to the ``__init__``
+    counter literal."""
+
+    def __init__(self, idx: FunctionIndex, fn_q: str):
+        self.keys: Dict[str, Set[str]] = {}
+        cls = _owning_class(fn_q, idx)
+        if cls is None:
+            return
+        for node in ast.walk(idx.classes[cls]):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    self.keys.setdefault(t.attr, set()).update(
+                        _dict_keys(node.value)[0])
+
+
+def _owning_class(q: str, idx: FunctionIndex) -> Optional[str]:
+    parent = idx.parents.get(q)
+    while parent is not None:
+        if parent in idx.classes:
+            return parent
+        parent = idx.parents.get(parent)
+    return None
+
+
+def writer_frame(fn: ast.AST, idx: FunctionIndex, fn_q: str) -> _Frame:
+    """The union frame a writer function puts on the wire."""
+    top: Set[str] = set()
+    all_keys: Set[str] = set()
+    is_open = False
+
+    # locals holding dict literals, plus spread-resolution aliases.
+    # Resolution is deferred until AFTER the walk: ast.walk is
+    # breadth-first, so a Return at the top of the body is visited
+    # before an alias assignment nested inside a `with` block.
+    dict_vars: Dict[str, ast.Dict] = {}
+    alias_of: Dict[str, str] = {}       # x = dict(self.attr) / self.attr
+    frame_vars: Set[str] = set()        # locals that reach the wire
+    sub_stores: Dict[str, Set[str]] = {}
+    frame_dicts: List[ast.Dict] = []    # dict literals in return position
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                if isinstance(node.value, ast.Dict):
+                    dict_vars[t.id] = node.value
+                else:
+                    src = node.value
+                    if (isinstance(src, ast.Call)
+                            and dotted_name(src.func) == "dict"
+                            and len(src.args) == 1):
+                        src = src.args[0]
+                    if (isinstance(src, ast.Attribute)
+                            and isinstance(src.value, ast.Name)
+                            and src.value.id == "self"):
+                        alias_of[t.id] = src.attr
+            elif (isinstance(t, ast.Subscript)
+                  and isinstance(t.value, ast.Name)):
+                key = _const_str(t.slice)
+                if key is not None:
+                    sub_stores.setdefault(t.value.id, set()).add(key)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            values = [node.value]
+            if isinstance(node.value, ast.Tuple):
+                values = list(node.value.elts)
+            for v in values:
+                if isinstance(v, ast.Dict):
+                    frame_dicts.append(v)
+                elif isinstance(v, ast.Name):
+                    frame_vars.add(v.id)
+                elif isinstance(v, ast.Call):
+                    for a in v.args:
+                        if isinstance(a, ast.Name):
+                            frame_vars.add(a.id)
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name.rsplit(".", 1)[-1] == "encode_body":
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        frame_vars.add(a.id)
+
+    for var in frame_vars:
+        d = dict_vars.get(var)
+        if d is not None:
+            frame_dicts.append(d)
+            stored = sub_stores.get(var, set())
+            top |= stored
+            all_keys |= stored
+
+    for d in frame_dicts:
+        k, spread = _dict_keys(d)
+        top |= k
+        all_keys |= k | _nested_keys(d)
+        # a value that is a local dict literal (`"live": live`)
+        # contributes its keys to the readable set — the reader
+        # indexes into the nested payload by those names
+        for v in d.values:
+            if isinstance(v, ast.Name) and v.id in dict_vars:
+                nest = dict_vars[v.id]
+                all_keys |= _dict_keys(nest)[0] | _nested_keys(nest)
+        if spread:
+            is_open |= _resolve_spread(d, alias_of, idx, fn_q, top,
+                                       all_keys)
+    return _Frame(top, all_keys, is_open)
+
+
+def _resolve_spread(d: ast.Dict, alias_of: Dict[str, str],
+                    idx: FunctionIndex, fn_q: str,
+                    top: Set[str], all_keys: Set[str]) -> bool:
+    """Fold resolvable ``**spread`` keys into the frame.  Returns
+    True when any spread stays opaque (frame must be treated open)."""
+    attrs = _ClassAttrLiterals(idx, fn_q)
+    opaque = False
+    for k, v in zip(d.keys, d.values):
+        if k is not None:
+            continue
+        resolved: Optional[Set[str]] = None
+        if isinstance(v, ast.Name):
+            attr = alias_of.get(v.id)
+            if attr is not None and attr in attrs.keys:
+                resolved = attrs.keys[attr]
+        elif (isinstance(v, ast.Attribute)
+              and isinstance(v.value, ast.Name)
+              and v.value.id == "self" and v.attr in attrs.keys):
+            resolved = attrs.keys[v.attr]
+        if resolved is None:
+            opaque = True
+        else:
+            top.update(resolved)
+            all_keys.update(resolved)
+    return opaque
+
+
+def reader_keys(fn: ast.AST,
+                var_names: Tuple[str, ...]) -> List[Tuple[str, ast.AST]]:
+    """(key, node) for every constant read off a designated payload
+    variable: ``var["k"]`` loads and ``var.get("k")`` calls."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in var_names
+                and isinstance(node.ctx, ast.Load)):
+            key = _const_str(node.slice)
+            if key is not None:
+                out.append((key, node))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "get"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id in var_names
+              and node.args):
+            key = _const_str(node.args[0])
+            if key is not None:
+                out.append((key, node))
+    return out
+
+
+def _find_fns(sf: SourceFile, names: Tuple[str, ...]):
+    idx = FunctionIndex(sf.tree)
+    hits = []
+    for q, fn in idx.funcs.items():
+        if q.rsplit(".", 1)[-1] in names:
+            hits.append((q, fn))
+    return idx, sorted(hits)
+
+
+def _doc_path(project: Project, option: str, filename: str) -> Optional[str]:
+    configured = project.options.get(option, "__default__")
+    if configured != "__default__":
+        return configured
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p = os.path.join(root, "doc", filename)
+    return p if os.path.exists(p) else None
+
+
+def _read_doc(path: Optional[str]) -> Optional[str]:
+    if not path:
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+class SeamContracts(Pass):
+    name = "contracts"
+    rules = ("seam-frame-drift", "seam-journal-schema",
+             "seam-calibration-params", "seam-env-read", "seam-env-doc")
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for seam in SEAMS:
+            self._check_seam(project, seam, out)
+        self._check_journal(project, out)
+        self._check_calibration(project, out)
+        self._check_env(project, out)
+        return out
+
+    # -- seam-frame-drift ---------------------------------------------------
+
+    def _check_seam(self, project: Project, seam: Seam,
+                    out: List[Finding]) -> None:
+        wf = project.file_named(seam.writer_file)
+        rf = project.file_named(seam.reader_file)
+        if wf is None or rf is None or wf.tree is None or rf.tree is None:
+            return
+        widx, writers = _find_fns(wf, seam.writer_fns)
+        _, readers = _find_fns(rf, seam.reader_fns)
+        if not writers or not readers:
+            return
+
+        frame_top: Set[str] = set()
+        frame_all: Set[str] = set()
+        is_open = False
+        for q, fn in writers:
+            fr = writer_frame(fn, widx, q)
+            frame_top |= fr.top_keys
+            frame_all |= fr.all_keys
+            is_open |= fr.open
+        if not frame_all:
+            return
+
+        read: Set[str] = set()
+        for q, fn in readers:
+            for key, node in reader_keys(fn, seam.reader_vars):
+                read.add(key)
+                if key not in frame_all and not is_open:
+                    self._emit(
+                        out, rf, "seam-frame-drift", node, q,
+                        f"`{seam.name}` seam: `{key}` is parsed here but"
+                        f" never written by"
+                        f" `{seam.writer_file}:{seam.writer_fns[0]}` —"
+                        " the read only ever sees its default")
+        if seam.two_way:
+            for q, fn in writers:
+                fr = writer_frame(fn, widx, q)
+                for key in sorted(fr.top_keys - read):
+                    self._emit(
+                        out, wf, "seam-frame-drift", fn, q,
+                        f"`{seam.name}` seam: `{key}` is written here but"
+                        f" never parsed by"
+                        f" `{seam.reader_file}` — dead weight on the wire")
+
+    # -- seam-journal-schema ------------------------------------------------
+
+    def _schema_keys(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            target = None
+            if isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.value
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            if (isinstance(target, ast.Name) and target.id == "_SCHEMA"
+                    and isinstance(value, ast.Dict)):
+                return _dict_keys(value)[0], node
+        return None, None
+
+    def _check_journal(self, project: Project, out: List[Finding]) -> None:
+        jf = project.file_named("obs/journal.py")
+        if jf is None or jf.tree is None:
+            return
+        schema, schema_node = self._schema_keys(jf)
+        if not schema:
+            return
+
+        ef = project.file_named("engine/execution.py")
+        if ef is not None and ef.tree is not None:
+            idx = FunctionIndex(ef.tree)
+            for node in ast.walk(ef.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "emit"):
+                    continue
+                recv = dotted_name(node.func.value) or ""
+                if "journal" not in recv:
+                    continue
+                scope = idx.enclosing(ef.tree, node)
+                kwargs = {kw.arg for kw in node.keywords
+                          if kw.arg is not None}
+                spread = any(kw.arg is None for kw in node.keywords)
+                for extra in sorted(kwargs - schema):
+                    self._emit(
+                        out, ef, "seam-journal-schema", node, scope,
+                        f"journal emit passes `{extra}`, which"
+                        " `validate_row` drops — the whole row is"
+                        " silently discarded; add the field to _SCHEMA"
+                        " or remove it here")
+                if not spread:
+                    missing = sorted(schema - JOURNAL_AUTO_KEYS - kwargs)
+                    for m in missing:
+                        self._emit(
+                            out, ef, "seam-journal-schema", node, scope,
+                            f"journal emit omits schema field `{m}` —"
+                            " `validate_row` requires every field, so"
+                            " this row is silently dropped")
+
+        doc = _read_doc(_doc_path(project, "journal_doc",
+                                  "observability.md"))
+        if doc is not None:
+            documented = set(_BACKTICK.findall(doc))
+            for key in sorted(schema - documented):
+                self._emit(
+                    out, jf, "seam-journal-schema", schema_node,
+                    "obs/journal._SCHEMA",
+                    f"journal schema field `{key}` is missing from the"
+                    " doc/observability.md schema table — the doc is"
+                    " the operator contract")
+
+    # -- seam-calibration-params --------------------------------------------
+
+    def _check_calibration(self, project: Project,
+                           out: List[Finding]) -> None:
+        af = project.file_named("tune/artifact.py")
+        if af is None or af.tree is None:
+            return
+        keys: Optional[Set[str]] = None
+        keys_node = None
+        for node in ast.walk(af.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "PARAM_KEYS"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                keys = {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+                keys_node = node
+        if not keys:
+            return
+        idx = FunctionIndex(af.tree)
+        read: Set[str] = set()
+        for node in ast.walk(af.tree):
+            if not (isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "params"):
+                continue
+            key = _const_str(node.slice)
+            if key is None:
+                continue
+            read.add(key)
+            if key not in keys:
+                self._emit(
+                    out, af, "seam-calibration-params", node,
+                    idx.enclosing(af.tree, node),
+                    f"accessor reads params[`{key}`] but PARAM_KEYS never"
+                    " persists it — the accessor always answers its"
+                    " default")
+        for key in sorted(keys - read):
+            self._emit(
+                out, af, "seam-calibration-params", keys_node,
+                "tune/artifact.PARAM_KEYS",
+                f"PARAM_KEYS persists `{key}` but no accessor reads it"
+                " back — dead weight in every calibration artifact")
+
+    # -- seam-env-read / seam-env-doc ---------------------------------------
+
+    def _registry_names(self, project: Project) -> Optional[Set[str]]:
+        override = project.options.get("env_registry")
+        if override is not None:
+            return set(override)
+        try:
+            from . import envvars
+        except ImportError:          # pragma: no cover - sibling module
+            return None
+        return set(envvars.names())
+
+    def _env_reads(self, sf: SourceFile) -> List[Tuple[str, ast.AST]]:
+        out: List[Tuple[str, ast.AST]] = []
+        for node in ast.walk(sf.tree):
+            name: Optional[str] = None
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func) or ""
+                last = fn.rsplit(".", 1)[-1]
+                if (fn in ("os.environ.get", "environ.get", "os.getenv",
+                           "getenv")
+                        or last == "resolve_knob"
+                        or last.startswith("_env")):
+                    if node.args:
+                        name = _const_str(node.args[0])
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)
+                  and dotted_name(node.value) in ("os.environ", "environ")):
+                name = _const_str(node.slice)
+            if name is not None and name.startswith("JEPSEN_TPU_"):
+                out.append((name, node))
+        return out
+
+    def _check_env(self, project: Project, out: List[Finding]) -> None:
+        registry = self._registry_names(project)
+        if registry is None:
+            return
+        anchor = project.file_named("lint/envvars.py")
+        full_tree = (anchor is not None
+                     or project.options.get("env_registry") is not None)
+        read_anywhere: Set[str] = set()
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for name, node in self._env_reads(sf):
+                read_anywhere.add(name)
+                if name not in registry:
+                    self._emit(
+                        out, sf, "seam-env-read", node,
+                        FunctionIndex(sf.tree).enclosing(sf.tree, node),
+                        f"`{name}` is read here but not registered in"
+                        " lint/envvars.py — every JEPSEN_TPU_* knob"
+                        " must appear in the central registry (and the"
+                        " generated doc table)")
+
+        anchor_sf = anchor or (project.files[0] if project.files else None)
+        if anchor_sf is None or anchor_sf.tree is None:
+            return
+        anchor_node = anchor_sf.tree
+
+        doc = _read_doc(_doc_path(project, "env_doc", "configuration.md"))
+        if doc is not None:
+            documented = set(_ENV_TOKEN.findall(doc))
+            for name in sorted(registry - documented):
+                self._emit(
+                    out, anchor_sf, "seam-env-doc", anchor_node,
+                    "lint/envvars.REGISTRY",
+                    f"registered variable `{name}` is missing from the"
+                    " generated doc/configuration.md table — regenerate"
+                    " it with `python -m jepsen_tpu.lint.envvars`")
+            for name in sorted(documented - registry):
+                self._emit(
+                    out, anchor_sf, "seam-env-doc", anchor_node,
+                    "lint/envvars.REGISTRY",
+                    f"doc/configuration.md documents `{name}`, which the"
+                    " registry doesn't know — remove the doc row or"
+                    " register the variable")
+        if full_tree:
+            for name in sorted(registry - read_anywhere):
+                self._emit(
+                    out, anchor_sf, "seam-env-doc", anchor_node,
+                    "lint/envvars.REGISTRY",
+                    f"registered variable `{name}` is never read by any"
+                    " scanned module — stale registry entry")
+
+    def _emit(self, out, sf, rule, node, scope, msg) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if sf.allowed(line, rule):
+            return
+        out.append(Finding(rule, sf.rel, line, col, msg, scope))
+
+
+register(SeamContracts())

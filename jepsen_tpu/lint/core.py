@@ -273,6 +273,9 @@ def _ensure_registered() -> None:
         return
     _registered = True
     # importing the pass modules registers them
+    from . import budget  # noqa: F401
+    from . import concurrency  # noqa: F401
+    from . import contracts  # noqa: F401
     from . import lock_discipline  # noqa: F401
     from . import obs_hygiene  # noqa: F401
     from . import protocol  # noqa: F401

@@ -116,7 +116,9 @@ class MembershipNemesis(Nemesis):
     def setup(self, test):
         with self.lock:
             self.state = _init_special_fields(self.state.setup(test) or self.state)
-        self.running = True
+        # lock-free start/stop flag: the store is atomic under the
+        # GIL and the view loops tolerate one stale NODE_VIEW_INTERVAL
+        self.running = True  # jt: allow[concurrency-unguarded-shared] — lock-free stop flag (see above)
         for node in test["nodes"]:
             t = threading.Thread(
                 target=self._view_loop,
@@ -140,7 +142,9 @@ class MembershipNemesis(Nemesis):
             _time.sleep(NODE_VIEW_INTERVAL)
 
     def _update_node_view(self, test, node):
-        nv = self.state.node_view(test, node)
+        with self.lock:
+            state = self.state
+        nv = state.node_view(test, node)
         if nv is None:
             return
         with self.lock:
@@ -161,11 +165,14 @@ class MembershipNemesis(Nemesis):
             return op2
 
     def teardown(self, test):
-        self.running = False
-        self.state.teardown(test)
+        # lock-free stop flag (see setup); loops exit within one interval
+        self.running = False  # jt: allow[concurrency-unguarded-shared] — lock-free stop flag (see setup)
+        with self.lock:
+            self.state.teardown(test)
 
     def fs(self):
-        return self.state.fs()
+        with self.lock:
+            return self.state.fs()
 
 
 class MembershipGenerator(gen.Generator):

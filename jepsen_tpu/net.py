@@ -394,7 +394,10 @@ class _Forwarder:
     def block(self):
         import socket
 
-        self.blocked = True
+        # lock-free fault flag: pump threads poll it per recv; the
+        # store is atomic under the GIL and one extra forwarded chunk
+        # is acceptable
+        self.blocked = True  # jt: allow[concurrency-unguarded-shared] — lock-free fault flag (see above)
         # shut the listener down so NEW connection attempts are refused
         # outright (a definite, safe failure for clients) rather than
         # accepted-then-reset (which reads as an indeterminate cut).
@@ -422,20 +425,26 @@ class _Forwarder:
                     pass
 
     def unblock(self):
+        # block/unblock/close all run on the nemesis control thread;
+        # `blocked` is additionally polled lock-free by pump threads
+        # (see block) and `_listener` is handed to the accept thread
+        # only via _start_accepting, AFTER _accept_done ordered the
+        # old accept loop's exit
         if not self.blocked or self._closed:
-            self.blocked = False
+            self.blocked = False  # jt: allow[concurrency-unguarded-shared] — control-thread flag (see above)
             return
-        self.blocked = False
-        self._listener = self._listen(self.port)
+        self.blocked = False  # jt: allow[concurrency-unguarded-shared] — control-thread flag (see above)
+        self._listener = self._listen(self.port)  # jt: allow[concurrency-unguarded-shared] — published via _start_accepting thread start
         self._start_accepting()
 
     def close(self):
         import socket
 
-        self._closed = True
+        # only the control thread reads `_closed` (unblock)
+        self._closed = True  # jt: allow[concurrency-unguarded-shared] — control-thread flag, atomic store
         try:
             self._listener.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         self.block()
-        self.blocked = False
+        self.blocked = False  # jt: allow[concurrency-unguarded-shared] — control-thread flag (see unblock)

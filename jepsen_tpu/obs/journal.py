@@ -112,7 +112,11 @@ class DispatchJournal:
         row.setdefault("v", SCHEMA_VERSION)
         row.setdefault("ts", time.time())
         if not validate_row(row):
-            self.dropped += 1
+            # the counter is shared with every emitting thread; the
+            # write path below already takes the lock, so the reject
+            # path must too or increments can be lost
+            with self._lock:
+                self.dropped += 1
             return None
         line = json.dumps(row, sort_keys=True) + "\n"
         with self._lock:
@@ -183,17 +187,19 @@ def configure(path: Optional[str],
 
 
 def active() -> Optional[DispatchJournal]:
-    return _active
+    # lock-free snapshot of an atomic reference; readers tolerate
+    # either side of a configure() swap
+    return _active  # jt: allow[concurrency-guard-drift] — atomic-ref snapshot (see above)
 
 
 def path() -> Optional[str]:
-    j = _active
+    j = _active  # jt: allow[concurrency-guard-drift] — atomic-ref snapshot
     return j.path if j else None
 
 
 def emit(**fields: Any) -> Optional[Dict[str, Any]]:
     """Append to the process journal; silently a no-op when unconfigured."""
-    j = _active
+    j = _active  # jt: allow[concurrency-guard-drift] — atomic-ref snapshot
     if j is None:
         return None
     return j.emit(**fields)

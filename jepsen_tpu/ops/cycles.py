@@ -195,7 +195,7 @@ def _bool_closure(adj, mode: str = "fixed"):
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _closure_fn(n: int, mode: str = "fixed"):
+def _closure_fn(n: int, mode: str = "fixed"):  # jt: allow[budget-missing-cap] — capped by the engine-facing wrapper _cyclic_fn
     @jax.jit
     def has_cycle(adj):  # adj: (B, n, n) bool
         r, used = _bool_closure(adj, mode)
@@ -625,7 +625,7 @@ def screen_graphs(
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _reach_fn(n: int):
+def _reach_fn(n: int):  # jt: allow[budget-missing-cap] — single-matrix (B=1) convenience kernel, see reachability
     @jax.jit
     def close(a):
         r, _ = _bool_closure(a)
@@ -641,6 +641,6 @@ def reachability(adj: np.ndarray) -> np.ndarray:
     padded[: adj.shape[0], : adj.shape[1]] = adj
     # single-matrix convenience API: the caller wants the closure NOW,
     # there is no batch to overlap with — sanctioned inline sync
-    return np.asarray(_reach_fn(n)(jnp.asarray(padded)))[  # jt: allow[trace-sync]
+    return np.asarray(_reach_fn(n)(jnp.asarray(padded)))[  # jt: allow[trace-sync, budget-direct-dispatch] — B=1, no batch to chunk
         : adj.shape[0], : adj.shape[1]
     ]

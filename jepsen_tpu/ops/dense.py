@@ -787,7 +787,7 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
 
 
 @lru_cache(maxsize=64)
-def _make_dense_fn_cached(spec_name: str, E: int, C: int, V, union="gather"):
+def _make_dense_fn_cached(spec_name: str, E: int, C: int, V, union="gather"):  # jt: allow[budget-missing-cap] — capped by the make_dense_fn wrapper (stamps wgl.DEFAULT_MAX_DISPATCH)
     if spec_name == "unordered-queue":
         return jax.jit(build_dense_queue(E, C, union=union))
     if spec_name == "multi-register":

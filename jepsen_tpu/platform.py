@@ -83,8 +83,11 @@ def probe_accelerator(
     plugin can flake once at init); a clean "no accelerator present"
     answer (exit 3) is deterministic and returns immediately."""
     global _accelerator_ok, _accelerator_error
-    if _accelerator_ok is not None:
-        return _accelerator_ok, _accelerator_error
+    # double-checked memo: the unlocked fast path reads a pair that is
+    # only ever written once, under _probe_lock, before any reader can
+    # observe _accelerator_ok non-None
+    if _accelerator_ok is not None:  # jt: allow[concurrency-guard-drift] — double-checked fast path (see above)
+        return _accelerator_ok, _accelerator_error  # jt: allow[concurrency-guard-drift] — double-checked fast path
     with _probe_lock:
         if _accelerator_ok is not None:
             return _accelerator_ok, _accelerator_error

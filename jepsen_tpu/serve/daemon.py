@@ -237,6 +237,7 @@ class CheckerDaemon:
         self._wake = threading.Condition()
         self._queue: List[_Request] = []  # jt: guarded-by(_wake)
         self._queued_rows = 0  # jt: guarded-by(_wake)
+        self._in_flight = 0  # jt: guarded-by(_wake)
         self.stats = {  # jt: guarded-by(_wake)
             "requests": 0, "histories": 0, "rejected": 0,
             "coalesced": 0, "batches": 0, "warm_dispatches": 0,
@@ -321,6 +322,7 @@ class CheckerDaemon:
             batch = self._queue
             self._queue = []
             self._queued_rows = 0
+            self._in_flight = len(batch)
             obs.gauge_set("jepsen_serve_queue_depth", 0)
             return batch
 
@@ -333,7 +335,10 @@ class CheckerDaemon:
             ensure_usable_backend()
             import jax
 
-            self._platform = jax.devices()[0].platform
+            # the assignments below are published to handler threads
+            # by `_ready.set()` / `start()`'s `_ready.wait()` — no
+            # handler can observe them mid-write
+            self._platform = jax.devices()[0].platform  # jt: allow[concurrency-unguarded-shared] — published via _ready (see above)
             # created HERE: the dispatch window is owner-thread
             # confined to the device thread
             executor = execution.Executor(self.window, mesh=self.mesh)
@@ -341,10 +346,10 @@ class CheckerDaemon:
             # passed (parallel.mesh.engine_default_mesh); adopt the
             # RESOLVED mesh so /status advertises what actually runs
             # and mesh-matched client requests can be serviced
-            self.mesh = executor.mesh
-            self._n_devices = executor.n_devices
+            self.mesh = executor.mesh  # jt: allow[concurrency-unguarded-shared] — published via _ready
+            self._n_devices = executor.n_devices  # jt: allow[concurrency-unguarded-shared] — published via _ready
         except Exception as e:  # noqa: BLE001 — surface via /healthz + 500s
-            self._fatal = repr(e)
+            self._fatal = repr(e)  # jt: allow[concurrency-unguarded-shared] — published via _ready
             self._ready.set()
             self._fail_all_queued()
             return
@@ -357,6 +362,8 @@ class CheckerDaemon:
                 continue
             try:
                 self._process_batch(executor, batch)
+                with self._wake:
+                    self._in_flight = 0
             except Exception as e:  # noqa: BLE001 — one bad batch must
                 # not kill the daemon; its unsettled requests answer 500
                 # (requests whose group already settled keep their
@@ -378,6 +385,7 @@ class CheckerDaemon:
                         n_err += 1
                 with self._wake:
                     self.stats["errors"] += n_err
+                    self._in_flight = 0
 
     def _fail_all_queued(self) -> None:
         with self._wake:
@@ -590,6 +598,7 @@ class CheckerDaemon:
         with self._wake:
             stats = dict(self.stats)
             depth = len(self._queue)
+            in_flight = self._in_flight
         total = stats["warm_dispatches"] + stats["cold_dispatches"]
         cal = tune.active()
         reg = obs.registry()
@@ -637,6 +646,7 @@ class CheckerDaemon:
                 if self.mesh is not None else None
             ),
             "queue_depth": depth,
+            "in_flight": in_flight,
             "max_queue_runs": self.max_queue_runs,
             "max_queue_rows": self.max_queue_rows,
             "stopping": self._stopping.is_set(),
@@ -668,7 +678,7 @@ class CheckerDaemon:
             obs_journal.configure(self.journal_path,
                                   self.journal_max_bytes)
         handler = _make_handler(self)
-        self._server = ThreadingHTTPServer((self.host, self.port), handler)
+        self._server = ThreadingHTTPServer((self.host, self.port), handler)  # jt: allow[concurrency-unguarded-shared] — written before serve/device threads start (Thread.start publication)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
         self._device_thread = threading.Thread(

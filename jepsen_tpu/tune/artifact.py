@@ -381,7 +381,7 @@ def active() -> Optional[Calibration]:
     absent, or rejected.  This is what every engine lookup consults —
     the no-artifact fast path is a single ``os.path.exists``."""
     global _active
-    got = _active
+    got = _active  # jt: allow[concurrency-guard-drift] — double-checked fast path; resolved once under _lock
     if got is not _UNRESOLVED:
         return got
     with _lock:

@@ -24,14 +24,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run_lint(tmp_path, sources, rules=None, options=None, subdir=""):
     """Write {relpath: code} fixtures under tmp_path and lint them.
-    Default options disable the repo-doc cross-check so fixture metric
-    names aren't judged against the real observability.md."""
+    Default options disable the repo-doc cross-checks so fixture metric
+    names, journal schemas, and env registries aren't judged against
+    the real observability.md / configuration.md."""
     base = tmp_path / subdir if subdir else tmp_path
     for rel, code in sources.items():
         p = base / rel
         p.parent.mkdir(parents=True, exist_ok=True)
         p.write_text(textwrap.dedent(code))
-    opts = {"metric_doc": None}
+    opts = {"metric_doc": None, "journal_doc": None, "env_doc": None}
     opts.update(options or {})
     return lint_paths([str(base)], rules=rules, options=opts)
 
@@ -734,7 +735,14 @@ def test_all_rules_inventory():
                      "obs-metric-kind", "obs-metric-doc",
                      "proto-check-signature", "proto-check-return",
                      "proto-workload-ref", "proto-fault-ref",
-                     "proto-suite-exports", "proto-unused-import"):
+                     "proto-suite-exports", "proto-unused-import",
+                     "concurrency-unguarded-shared",
+                     "concurrency-guard-drift",
+                     "concurrency-lock-missing",
+                     "seam-frame-drift", "seam-journal-schema",
+                     "seam-calibration-params", "seam-env-read",
+                     "seam-env-doc",
+                     "budget-direct-dispatch", "budget-missing-cap"):
         assert expected in rules
 
 
@@ -815,3 +823,713 @@ def test_cli_list_rules():
 def test_committed_baseline_loads():
     bl = load_baseline(DEFAULT_BASELINE)
     assert bl is not None and bl["version"] == 1
+
+
+# ---------------------------------------------------------------------------
+# concurrency: inferred whole-program race analysis
+# ---------------------------------------------------------------------------
+
+
+def test_conc_unguarded_shared_thread_target(tmp_path):
+    """No annotations anywhere: the pass infers the thread root from
+    Thread(target=...), colors the call graph, and flags both naked
+    mutation sites of the shared list."""
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.items = []
+                self.t = threading.Thread(target=self.loop)
+
+            def loop(self):
+                self.items.append(1)
+
+            def submit(self, x):
+                self.items.append(x)
+    """})
+    assert rules_of(res) == ["concurrency-unguarded-shared"] * 2
+
+
+def test_conc_unguarded_shared_pool_submit_global(tmp_path):
+    """Executor.submit(f) makes f a thread root; a module-global dict
+    mutated both there and on the main path is a race."""
+    res = run_lint(tmp_path, {"m.py": """
+        from concurrent.futures import ThreadPoolExecutor
+
+        counts = {}
+
+        def work(k):
+            counts[k] = 1
+
+        def main():
+            ex = ThreadPoolExecutor(2)
+            ex.submit(work, "a")
+            work("b")
+    """})
+    assert rules_of(res) == ["concurrency-unguarded-shared"]
+    assert "counts" in res.findings[0].message
+
+
+def test_conc_unguarded_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.flag = False
+                self.t = threading.Thread(target=self.loop)
+
+            def loop(self):
+                while not self.flag:
+                    pass
+
+            def stop(self):
+                self.flag = True  # jt: allow[concurrency-unguarded-shared] — atomic bool
+    """})
+    assert res.findings == []
+
+
+def test_conc_guard_drift_attr(tmp_path):
+    """Every write holds the lock; the lock-free read is the drift."""
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self.t = threading.Thread(target=self.loop)
+
+            def loop(self):
+                with self._lock:
+                    self.n = self.n + 1
+
+            def bump(self):
+                with self._lock:
+                    self.n = self.n + 1
+
+            def peek(self):
+                return self.n
+    """})
+    assert rules_of(res) == ["concurrency-guard-drift"]
+    assert res.findings[0].scope.endswith("peek")
+
+
+def test_conc_guard_drift_module_global(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _state = None
+
+        def loop():  # jt: thread-entry
+            set_state(1)
+
+        def set_state(v):
+            global _state
+            with _lock:
+                _state = v
+
+        def get_state():
+            return _state
+    """})
+    assert rules_of(res) == ["concurrency-guard-drift"]
+    assert res.findings[0].scope.endswith("get_state")
+
+
+def test_conc_guard_drift_suppressed_and_hb_shield(tmp_path):
+    """The allow silences one read; a read AFTER a join()/result()
+    hand-off is happens-before shielded and needs no annotation."""
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        _lock = threading.Lock()
+        _state = None
+
+        def loop():  # jt: thread-entry
+            set_state(1)
+
+        def set_state(v):
+            global _state
+            with _lock:
+                _state = v
+
+        def get_state():
+            return _state  # jt: allow[concurrency-guard-drift] — snapshot
+
+        def finisher(t):
+            t.join()
+            return _state
+    """})
+    assert res.findings == []
+
+
+def test_conc_guarded_annotation_silences_inference(tmp_path):
+    """An existing `# jt: guarded-by(...)` declaration hands the key to
+    the lock-discipline pass — the inference engine must not double-
+    report it."""
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # jt: guarded-by(_lock)
+                self.t = threading.Thread(target=self.loop)
+
+            def loop(self):
+                with self._lock:
+                    self.items.append(1)
+
+            def submit(self, x):
+                with self._lock:
+                    self.items.append(x)
+    """}, rules=["concurrency-unguarded-shared",
+                 "concurrency-guard-drift"])
+    assert res.findings == []
+
+
+def test_conc_instance_confined_not_flagged(tmp_path):
+    """Escape analysis: a class whose instances never leave one thread
+    (no entry methods, no global/attr publication) is confined, even
+    when its METHODS are reachable from several thread roots."""
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class PerRun:
+            def __init__(self):
+                self.rows = []
+
+            def add(self, x):
+                self.rows.append(x)
+
+        def worker():  # jt: thread-entry
+            ctx = PerRun()
+            helper(ctx)
+
+        def main():
+            ctx = PerRun()
+            helper(ctx)
+
+        def helper(ctx):
+            ctx.add(1)
+    """})
+    assert res.findings == []
+
+
+def test_conc_lock_missing(tmp_path):
+    """Annotations are audited assertions: naming a lock the module
+    never constructs is drift.  owner-thread is reserved, not a lock."""
+    res = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = 0  # jt: guarded-by(_mutex)
+                self.b = 0  # jt: guarded-by(owner-thread)
+
+            def f(self):  # jt: holds(_biglock)
+                return self.a
+    """}, rules=["concurrency-lock-missing"])
+    assert rules_of(res) == ["concurrency-lock-missing"] * 2
+    msgs = " ".join(f.message for f in res.findings)
+    assert "_mutex" in msgs and "_biglock" in msgs
+
+
+def test_conc_lock_missing_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        class C:
+            def __init__(self):
+                # the lock lives on the collaborating engine object
+                self.a = 0  # jt: guarded-by(_engine_lock), allow[concurrency-lock-missing]
+    """})
+    assert res.findings == []
+
+
+# -- inference internals: thread graph, escape, locksets --------------------
+
+
+def _program(tmp_path, sources):
+    import textwrap as _tw
+
+    from jepsen_tpu.lint.concurrency import _ModModel, _Program
+    from jepsen_tpu.lint.core import load_file
+
+    models = []
+    for rel, code in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_tw.dedent(code))
+        models.append(_ModModel(load_file(str(p), rel)))
+    return _Program(models)
+
+
+def test_conc_thread_graph_entries(tmp_path):
+    prog = _program(tmp_path, {"m.py": """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        from http.server import BaseHTTPRequestHandler
+
+        def marked():  # jt: thread-entry
+            ...
+
+        def pooled(x):
+            ...
+
+        def targeted():
+            ...
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                ...
+
+        def main():
+            threading.Thread(target=targeted).start()
+            ThreadPoolExecutor(2).submit(pooled, 1)
+    """})
+    qs = {q for (_, q) in prog.entries}
+    assert {"marked", "pooled", "targeted", "Handler.do_GET"} <= qs
+    assert "main" not in qs
+
+
+def test_conc_colors_propagate_through_calls(tmp_path):
+    prog = _program(tmp_path, {"m.py": """
+        import threading
+
+        def worker():  # jt: thread-entry
+            shared_sink()
+
+        def main_path():
+            shared_sink()
+
+        def shared_sink():
+            ...
+    """})
+    colors = prog.colors()
+    sink = colors[("m", "shared_sink")]
+    assert len(sink) == 2  # the worker color AND main
+
+
+def test_conc_escape_shared_classes(tmp_path):
+    prog = _program(tmp_path, {"m.py": """
+        class Published:
+            def go(self):
+                ...
+
+        class Confined:
+            def go(self):
+                ...
+
+        G = Published()
+
+        def use():
+            c = Confined()
+            c.go()
+    """})
+    shared = prog.shared_classes()
+    assert ("m", "Published") in shared
+    assert ("m", "Confined") not in shared
+
+
+def test_conc_interprocedural_locksets(tmp_path):
+    """A callee only ever invoked under the lock inherits it; one
+    unlocked call site drains the intersection."""
+    prog = _program(tmp_path, {"m.py": """
+        import threading
+
+        _lock = threading.Lock()
+
+        def always_locked():
+            ...
+
+        def sometimes():
+            ...
+
+        def a():
+            with _lock:
+                always_locked()
+                sometimes()
+
+        def b():
+            with _lock:
+                always_locked()
+            sometimes()
+    """})
+    eff = prog.eff_locks()
+    assert eff[("m", "always_locked")] == frozenset({"_lock"})
+    assert eff[("m", "sometimes")] == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# contracts: serialized-seam drift
+# ---------------------------------------------------------------------------
+
+
+def test_seam_parsed_never_written(tmp_path):
+    """The status seam: a client field the daemon never stamps is a
+    dead read."""
+    res = run_lint(tmp_path, {
+        "serve/daemon.py": """
+            class D:
+                def status(self):
+                    return {"ok": True, "pid": 1}
+        """,
+        "serve/client.py": """
+            def format_status(st):
+                return st["ok"], st.get("in_flight", 0)
+        """,
+    })
+    assert rules_of(res) == ["seam-frame-drift"]
+    assert "in_flight" in res.findings[0].message
+
+
+def test_seam_written_never_parsed_two_way(tmp_path):
+    """Request seams have both ends in-package: a stamped field the
+    daemon never parses is dead wire weight."""
+    res = run_lint(tmp_path, {
+        "serve/protocol.py": """
+            def check_request(runs):
+                body = {"runs": runs, "vestigial": 1}
+                return encode_body(body)
+        """,
+        "serve/daemon.py": """
+            class D:
+                def handle_check(self, payload):
+                    return payload["runs"]
+        """,
+    })
+    assert rules_of(res) == ["seam-frame-drift"]
+    assert "vestigial" in res.findings[0].message
+
+
+def test_seam_spread_resolves_through_alias(tmp_path):
+    """`**stats` chased through `stats = dict(self.stats)` (even
+    inside a `with` block) back to the __init__ literal: reads of the
+    counter keys are NOT drift, and the frame stays closed so a truly
+    unwritten key still is."""
+    res = run_lint(tmp_path, {
+        "serve/daemon.py": """
+            import threading
+
+            class D:
+                def __init__(self):
+                    self._wake = threading.Condition()
+                    self.stats = {"requests": 0, "errors": 0}
+
+                def status(self):
+                    with self._wake:
+                        stats = dict(self.stats)
+                    return {"ok": True, **stats}
+        """,
+        "serve/client.py": """
+            def format_status(st):
+                return st["requests"], st["errors"], st["ghost"]
+        """,
+    })
+    assert rules_of(res) == ["seam-frame-drift"]
+    assert "ghost" in res.findings[0].message
+
+
+def test_seam_suppressed(tmp_path):
+    res = run_lint(tmp_path, {
+        "serve/daemon.py": """
+            class D:
+                def status(self):
+                    return {"ok": True}
+        """,
+        "serve/client.py": """
+            def format_status(st):
+                return st.get("legacy")  # jt: allow[seam-frame-drift] — pre-v2 daemons
+        """,
+    })
+    assert res.findings == []
+
+
+def test_journal_schema_extra_and_missing(tmp_path):
+    res = run_lint(tmp_path, {
+        "obs/journal.py": """
+            _SCHEMA = {"v": (int,), "ts": (float,), "op": (str,)}
+        """,
+        "engine/execution.py": """
+            def good(journal):
+                journal.emit(op="check")
+
+            def extra(journal):
+                journal.emit(op="check", bogus=1)
+
+            def missing(journal):
+                journal.emit()
+        """,
+    })
+    assert rules_of(res) == ["seam-journal-schema"] * 2
+    msgs = " ".join(f.message for f in res.findings)
+    assert "bogus" in msgs and "op" in msgs
+
+
+def test_journal_schema_doc_and_suppressed(tmp_path):
+    doc = tmp_path / "journal.md"
+    doc.write_text("| `v` | `ts` |\n")
+    res = run_lint(
+        tmp_path,
+        {
+            "obs/journal.py": """
+                _SCHEMA = {"v": (int,), "ts": (float,), "op": (str,)}
+            """,
+            "engine/execution.py": """
+                def noisy(journal):
+                    journal.emit(debug=1)  # jt: allow[seam-journal-schema] — local probe
+            """,
+        },
+        options={"journal_doc": str(doc)}, subdir="pkg",
+    )
+    assert rules_of(res) == ["seam-journal-schema"]
+    assert "op" in res.findings[0].message  # undocumented schema field
+
+
+def test_calibration_params_both_directions(tmp_path):
+    res = run_lint(tmp_path, {"tune/artifact.py": """
+        PARAM_KEYS = ("window", "dead_weight")
+
+        class Calibration:
+            def window(self):
+                return self.params["window"]
+
+            def phantom(self):
+                return self.params["phantom"]
+    """})
+    assert rules_of(res) == ["seam-calibration-params"] * 2
+    msgs = " ".join(f.message for f in res.findings)
+    assert "phantom" in msgs and "dead_weight" in msgs
+
+
+def test_calibration_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"tune/artifact.py": """
+        PARAM_KEYS = ("window", "reserved")  # jt: allow[seam-calibration-params] — v2 reader keys
+
+        class Calibration:
+            def window(self):
+                return self.params["window"]
+    """})
+    assert res.findings == []
+
+
+def test_env_read_unregistered(tmp_path):
+    opts = {"env_registry": ["JEPSEN_TPU_KNOWN"]}
+    res = run_lint(tmp_path, {"m.py": """
+        import os
+
+        def a():
+            return os.environ.get("JEPSEN_TPU_MYSTERY")
+
+        def b():
+            return os.environ["JEPSEN_TPU_OTHER"]
+
+        def c():
+            return os.getenv("JEPSEN_TPU_KNOWN")
+
+        def d():
+            return os.environ.get("UNRELATED_VAR")
+    """}, options=opts)
+    assert rules_of(res) == ["seam-env-read"] * 2
+    msgs = " ".join(f.message for f in res.findings)
+    assert "JEPSEN_TPU_MYSTERY" in msgs and "JEPSEN_TPU_OTHER" in msgs
+
+
+def test_env_read_resolve_knob_and_suppressed(tmp_path):
+    opts = {"env_registry": ["JEPSEN_TPU_KNOWN"]}
+    res = run_lint(tmp_path, {"m.py": """
+        def a(cal):
+            return cal.resolve_knob("JEPSEN_TPU_TUNED", int, None, 4)
+
+        def b():
+            import os
+            return os.getenv("JEPSEN_TPU_LEGACY")  # jt: allow[seam-env-read] — removed next major
+    """}, rules=["seam-env-read"], options=opts)
+    assert rules_of(res) == ["seam-env-read"]
+    assert "JEPSEN_TPU_TUNED" in res.findings[0].message
+
+
+def test_env_doc_drift(tmp_path):
+    doc = tmp_path / "conf.md"
+    doc.write_text("| `JEPSEN_TPU_A` | | `JEPSEN_TPU_C` |\n")
+    res = run_lint(
+        tmp_path,
+        {"m.py": """
+            import os
+
+            def a():
+                return os.environ.get("JEPSEN_TPU_A")
+        """},
+        options={"env_registry": ["JEPSEN_TPU_A", "JEPSEN_TPU_B"],
+                 "env_doc": str(doc)}, subdir="pkg",
+    )
+    assert rules_of(res) == ["seam-env-doc"] * 3
+    msgs = " ".join(f.message for f in res.findings)
+    assert "JEPSEN_TPU_B" in msgs      # registered, undocumented + unread
+    assert "JEPSEN_TPU_C" in msgs      # documented, unregistered
+
+
+def test_env_doc_suppressed(tmp_path):
+    doc = tmp_path / "conf.md"
+    doc.write_text("nothing documented\n")
+    res = run_lint(
+        tmp_path,
+        {"m.py": ("# jt: allow[seam-env-doc] — doc table regenerates in CI\n"
+                  "import os\n\n\n"
+                  "def a():\n"
+                  "    return os.environ.get('JEPSEN_TPU_A')\n")},
+        options={"env_registry": ["JEPSEN_TPU_A"],
+                 "env_doc": str(doc)}, subdir="pkg",
+    )
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# budget: dispatch-cap discipline
+# ---------------------------------------------------------------------------
+
+
+def test_budget_direct_dispatch_local_and_immediate(tmp_path):
+    """A kernel built here and called here without any cap in sight:
+    once through a local, once as an immediate builder()(...) call."""
+    res = run_lint(tmp_path, {"m.py": """
+        import jax
+
+        def make_k(n):
+            @jax.jit
+            def k(x):
+                return x + n
+            k.safe_dispatch = 4096
+            return k
+
+        def run(xs):
+            k = make_k(1)
+            return k(xs)
+
+        def run_inline(xs):
+            return make_k(2)(xs)
+    """})
+    assert rules_of(res) == ["budget-direct-dispatch"] * 2
+
+
+def test_budget_direct_dispatch_attr_kernel(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import jax
+
+        def build(n):
+            fn = jax.jit(lambda x: x)
+            fn.safe_dispatch = n
+            return fn
+
+        class Engine:
+            def __init__(self, n):
+                self.fn = build(n)
+
+            def naked(self, xs):
+                return self.fn(xs)
+    """})
+    assert rules_of(res) == ["budget-direct-dispatch"]
+    assert "self.fn" in res.findings[0].message
+
+
+def test_budget_direct_dispatch_sanctioned_forms(tmp_path):
+    """Cap-enforcing chunk loops, jit-of-jit rebatching lambdas,
+    *smoke.py files, and annotated measurement loops all pass."""
+    res = run_lint(tmp_path, {
+        "m.py": """
+            import jax
+
+            def make_k(n):
+                @jax.jit
+                def k(x):
+                    return x
+                k.safe_dispatch = n
+                return k
+
+            def chunked(xs):
+                k = make_k(1)
+                cap = k.safe_dispatch
+                return [k(c) for c in chunks(xs, cap)]
+
+            def rewrap(base):
+                return jax.jit(lambda x: make_k(1)(x))
+
+            def bench(xs):
+                k = make_k(1)
+                for _ in range(10):
+                    k(xs)  # jt: direct-dispatch — timed measurement loop
+        """,
+        "toolsmoke.py": """
+            from m import make_k
+
+            def main():
+                k = make_k(1)
+                return k([1])
+        """,
+    }, rules=["budget-direct-dispatch"])
+    assert res.findings == []
+
+
+def test_budget_missing_cap_positive(tmp_path):
+    res = run_lint(tmp_path, {"m.py": """
+        import jax
+
+        def build_inner(n):
+            @jax.jit
+            def k(x):
+                return x
+            return k
+
+        def build_direct(n):
+            return jax.jit(lambda x: x + n)
+    """})
+    assert rules_of(res) == ["budget-missing-cap"] * 2
+
+
+def test_budget_missing_cap_capped_delegation_suppressed(tmp_path):
+    """Stamping anywhere in the body satisfies the rule; delegating to
+    a capped builder does too; the wrapped-base idiom is an allow
+    naming its wrapper."""
+    res = run_lint(tmp_path, {"m.py": """
+        import jax
+
+        def capped(n):
+            fn = jax.jit(lambda x: x)
+            fn.safe_dispatch = n
+            return fn
+
+        def delegate(n):
+            return capped(n)
+
+        def base(n):  # jt: allow[budget-missing-cap] — capped by the `capped` wrapper
+            @jax.jit
+            def k(x):
+                return x
+            return k
+    """})
+    assert res.findings == []
+
+
+def test_budget_cross_module_builder_resolution(tmp_path):
+    """Builder names resolve program-wide: the builder lives in one
+    module, the uncapped dispatch in another."""
+    res = run_lint(tmp_path, {
+        "kern.py": """
+            import jax
+
+            def make_k(n):
+                fn = jax.jit(lambda x: x)
+                fn.safe_dispatch = n
+                return fn
+        """,
+        "user.py": """
+            from kern import make_k
+
+            def run(xs):
+                k = make_k(8)
+                return k(xs)
+        """,
+    })
+    assert rules_of(res) == ["budget-direct-dispatch"]
