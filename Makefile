@@ -2,7 +2,7 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
@@ -11,7 +11,7 @@ test:
 # observability, pipeline, checker-service, slice-dispatch,
 # decomposition, auto-tune, transactional-screen, and closure/union
 # kernel smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke
+check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke
 
 # jtlint static analysis (doc/static-analysis.md): all seven passes —
 # trace-safety, lock-discipline, concurrency (whole-program race
@@ -54,6 +54,19 @@ pipeline-smoke:
 # or a shutdown that drops in-flight work
 serve-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.smoke
+
+# self-chaos gate (doc/checker-service.md "Failure modes & recovery"):
+# a daemon subprocess SIGKILLed mid-request and mid-WAL-write, then
+# restarted — retried request ids replay the verdict WAL and
+# re-dispatch only what the torn line lost, byte-identical to the
+# in-process engine on both kernel routes; a stall/drop fault proxy on
+# the local HTTP seam — every client call bounded by its deadline
+# budget, the circuit breaker trips to in-process and recovers via a
+# half-open /healthz probe, and a dropped response's retry is deduped
+# by request id (no double counting).  Every injected fault must be
+# accounted in client + daemon metrics.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.chaos
 
 # slice-native dispatch gate (doc/checker-engines.md): the production
 # check_batch path sharded over a forced 8-virtual-device host mesh on

@@ -386,11 +386,30 @@ def serve_cmd() -> Dict[str, dict]:
             help="(--checker) queued client runs before /check "
             "answers 503 backlogged (default 8)",
         )
+        p.add_argument(
+            "--supervise",
+            action="store_true",
+            help="(--checker) run the daemon as a supervised child "
+            "and restart it on abnormal exit; the restart re-warms "
+            "from the journal, verdict WAL, and jit cache",
+        )
 
     def run(args) -> int:
         if args.checker:
             from . import serve as serve_mod
+            from .serve import daemon as daemon_mod
 
+            if args.supervise:
+                child = []
+                if args.host:
+                    child += ["--host", args.host]
+                if args.port is not None:
+                    child += ["--port", str(args.port)]
+                if args.engine_window is not None:
+                    child += ["--window", str(args.engine_window)]
+                if args.max_queue is not None:
+                    child += ["--max-queue", str(args.max_queue)]
+                return daemon_mod.supervise(child)
             serve_mod.serve(
                 host=args.host or serve_mod.DEFAULT_HOST,
                 port=args.port,

@@ -330,6 +330,9 @@ class DecomposedRun:
         self.sub_ctx: Optional[RunContext] = None
         self._fed = False
         self._next_i = 0  # split progress (restartable; see _split)
+        #: optional ``(tag, idx, result)`` verdict sink (see
+        #: :meth:`attach_wal`); applied to contexts as they are created
+        self._settle_sink = None
         if not lazy:
             # eager construction (the service path, and every caller
             # that inspects streams()/counters right away): drain the
@@ -382,6 +385,7 @@ class DecomposedRun:
                 self._pass_idx.append(i)
                 if self.main_ctx is None:
                     self.main_ctx = RunContext(self.model, [], **self._kw)
+                    self._bind_sink("main", self.main_ctx)
                 idx = self.main_ctx.append(h)
                 if rec and self._active:
                     obs.count(
@@ -397,6 +401,7 @@ class DecomposedRun:
                     self.sub_ctx = RunContext(
                         submodel, [], models=[], **self._kw
                     )
+                    self._bind_sink("sub", self.sub_ctx)
                 slots.append((key, self.sub_ctx.append(subh, submodel)))
             self._parts_of[i] = slots
             self.n_partitions += len(slots)
@@ -417,6 +422,7 @@ class DecomposedRun:
             # empty batch: keep the historical empty main context so
             # streams()/contexts stay non-surprising
             self.main_ctx = RunContext(self.model, [], **self._kw)
+            self._bind_sink("main", self.main_ctx)
 
     def _ensure_fed(self) -> None:
         """Finish the split eagerly for consumers that need the whole
@@ -444,6 +450,54 @@ class DecomposedRun:
         if self.sub_ctx is not None:
             out.append(("sub", self.sub_ctx))
         return out
+
+    # -- verdict WAL seam (doc/checker-service.md "Failure modes") --------
+
+    def _bind_sink(self, tag: str, ctx: RunContext) -> None:
+        if self._settle_sink is None:
+            return
+        sink = self._settle_sink
+
+        def _on_settle(_ctx, idx, result, _tag=tag, _sink=sink):
+            _sink(_tag, idx, result)
+
+        ctx.on_settle = _on_settle
+
+    def attach_wal(self, sink) -> None:
+        """Install a ``(tag, idx, result)`` verdict sink — every slot
+        that settles from now on (in already-created contexts AND in
+        contexts the split creates later) is appended to the WAL by
+        the sink.  ``tag`` is the stream tag (``"main"``/``"sub"``)."""
+        self._settle_sink = sink
+        if self.main_ctx is not None:
+            self._bind_sink("main", self.main_ctx)
+        if self.sub_ctx is not None:
+            self._bind_sink("sub", self.sub_ctx)
+
+    def replay(self, rows: Dict[Tuple[str, int], dict]) -> int:
+        """Pre-fill result slots from replayed WAL rows —
+        ``{(tag, idx): result}`` — BYPASSING the settle hook (a
+        replayed verdict must not re-append to the WAL).  Settled
+        slots never re-encode (the planner skips them), so a restarted
+        run re-dispatches only its unsettled partitions.  Returns the
+        number of slots filled; out-of-range or already-settled slots
+        are ignored (a WAL can outlive the request mix that wrote it).
+        """
+        self._ensure_fed()
+        by_tag = {tag: ctx for tag, ctx in self.streams()}
+        n = 0
+        for (tag, idx), result in rows.items():
+            ctx = by_tag.get(tag)
+            if ctx is None or not (0 <= idx < len(ctx.results)):
+                continue
+            if ctx.results[idx] is None:
+                ctx.results[idx] = result
+                n += 1
+        return n
+
+    def settled_count(self) -> int:
+        """Slots holding verdicts across both streams (replay + live)."""
+        return sum(c.settled_count() for c in self.contexts)
 
     def drain_oracles(self) -> None:
         for ctx in self.contexts:

@@ -115,6 +115,8 @@ def run(
     window: Optional[int] = None,
     bucketed: Optional[bool] = None,
     decomposed: Optional[bool] = None,
+    wal_sink=None,
+    wal_replay=None,
 ) -> List[dict]:
     """Check ``histories`` through the full pipeline; per-history result
     dicts in input order, exactly the shapes ``wgl.check_batch``
@@ -143,6 +145,14 @@ def run(
         oracle_fallback=oracle_fallback, oracle_budget_s=oracle_budget_s,
         enabled=decomposed, lazy=True,
     )
+    # -- crash-safe resumption (doc/checker-service.md "Failure modes
+    # & recovery"): WAL-replayed verdicts pre-fill result slots (they
+    # never re-encode — the planner skips settled rows), and a settle
+    # sink appends every NEW verdict so a later restart resumes here
+    if wal_sink is not None:
+        dec.attach_wal(wal_sink)
+    if wal_replay:
+        dec.replay(wal_replay)
     ex = Executor(
         window, mesh=mesh, escalation=escalation,
         sufficient_rung=sufficient_rung, max_dispatch=max_dispatch,

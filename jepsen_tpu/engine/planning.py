@@ -111,6 +111,11 @@ class RunContext:
         self.results: List[Optional[dict]] = [None] * len(histories)
         self.oracle_futs: Dict[int, Tuple[Any, str]] = {}
         self.oracle_deferred: List[Tuple[int, str]] = []
+        #: optional ``(ctx, idx, result)`` hook fired once per settled
+        #: slot — the verdict-WAL seam.  Monotone: a slot that already
+        #: holds a verdict never re-settles, so the hook fires at most
+        #: once per index.
+        self.on_settle: Optional[Any] = None
 
     def model_for(self, idx: int):
         """The model history ``idx`` checks against (encode init state
@@ -136,7 +141,26 @@ class RunContext:
         return idx
 
     def assign(self, idx: int, result: dict) -> None:
+        """Settle one result slot — monotone accumulation.
+
+        A slot settles exactly once: re-assignment of an
+        already-settled index is a no-op, which makes replayed
+        (WAL-pre-filled) verdicts authoritative over any re-dispatch
+        and lets the settle hook fire at most once per index.
+        """
+        if self.results[idx] is not None:
+            return
         self.results[idx] = result
+        if self.on_settle is not None:
+            self.on_settle(self, idx, result)
+
+    def settled(self, idx: int) -> bool:
+        """True when ``idx`` already holds a verdict (replayed or
+        settled this run) — such rows must not re-encode/re-dispatch."""
+        return self.results[idx] is not None
+
+    def settled_count(self) -> int:
+        return sum(1 for r in self.results if r is not None)
 
     def route_oracle(self, idx: int, engine_tag: str,
                      unresolved_tag: str) -> None:
@@ -153,8 +177,8 @@ class RunContext:
         from ..checker import linear
 
         if not self.oracle_fallback:
-            self.results[idx] = {"valid?": "unknown",
-                                 "engine": unresolved_tag}
+            self.assign(idx, {"valid?": "unknown",
+                              "engine": unresolved_tag})
             return
         if self.oracle_budget_s is not None:
             self.oracle_deferred.append((idx, engine_tag))
@@ -193,15 +217,17 @@ class RunContext:
         for idx, (fut, engine_tag) in self.oracle_futs.items():
             r = fut.result()
             r["engine"] = engine_tag
-            self.results[idx] = r
+            self.assign(idx, r)
         pure = self.spec.pure_fs if self.spec else ()
         for idx, engine_tag in self.oracle_deferred:
+            if self.settled(idx):
+                continue  # replayed verdicts win; skip the search
             r = linear.analysis(
                 self.model_for(idx), self.histories[idx], pure_fs=pure,
                 budget_s=self.oracle_budget_s,
             )
             r["engine"] = engine_tag
-            self.results[idx] = r
+            self.assign(idx, r)
 
 
 class PlannedBucket:
@@ -295,7 +321,14 @@ class Planner:
         landed in (``None`` IS a valid key in unbucketed mode), or
         :data:`_ROUTED_ORACLE` when it went to the oracle instead —
         that search starts NOW, on the worker pool, overlapping all
-        remaining encode and device work."""
+        remaining encode and device work.
+
+        A slot that already holds a verdict — WAL-replayed before
+        encode — is skipped outright: settled rows never re-encode or
+        re-dispatch, which is what makes a restarted run re-dispatch
+        only its unsettled partitions."""
+        if ctx.settled(idx):
+            return _ROUTED_ORACLE
         e = self.encode_one(ctx, idx)
         if e is None:
             ctx.route_oracle(idx, "oracle-fallback", "unencodable")

@@ -333,10 +333,16 @@ def _read_doc(path: Optional[str]) -> Optional[str]:
         return None
 
 
+# subprocess entry points that block until the child exits — on the
+# control plane the child is an ssh/scp/kubectl talking to the network
+_SUBPROCESS_BLOCKERS = {"run", "call", "check_call", "check_output"}
+
+
 class SeamContracts(Pass):
     name = "contracts"
     rules = ("seam-frame-drift", "seam-journal-schema",
-             "seam-calibration-params", "seam-env-read", "seam-env-doc")
+             "seam-calibration-params", "seam-env-read", "seam-env-doc",
+             "net-timeout")
 
     def run(self, project: Project) -> List[Finding]:
         out: List[Finding] = []
@@ -345,6 +351,7 @@ class SeamContracts(Pass):
         self._check_journal(project, out)
         self._check_calibration(project, out)
         self._check_env(project, out)
+        self._check_net_timeout(project, out)
         return out
 
     # -- seam-frame-drift ---------------------------------------------------
@@ -586,6 +593,67 @@ class SeamContracts(Pass):
                     "lint/envvars.REGISTRY",
                     f"registered variable `{name}` is never read by any"
                     " scanned module — stale registry entry")
+
+    # -- net-timeout ---------------------------------------------------------
+
+    def _check_net_timeout(self, project: Project,
+                           out: List[Finding]) -> None:
+        """Every blocking call on the network-facing seams (``serve/``,
+        the client's HTTP path included, and the ``control/`` transport
+        plane) must carry an explicit bound.  A dead peer must cost a
+        timeout, never a hang: the chaos harness
+        (``python -m jepsen_tpu.serve.chaos``) proves the dynamic half;
+        this rule keeps new call sites from regressing the static half.
+        Sanctioned indefinite waits (a supervisor blocking on its
+        child's lifetime, the HTTP server's accept loop) carry
+        ``# jt: allow[net-timeout] — reason`` annotations."""
+        files = {id(sf): sf for d in ("serve", "control")
+                 for sf in project.files_in(d)}
+        for sf in files.values():
+            if sf.tree is None:
+                continue
+            idx = FunctionIndex(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                last = name.rsplit(".", 1)[-1]
+                kwargs = {kw.arg for kw in node.keywords
+                          if kw.arg is not None}
+                spread = any(kw.arg is None for kw in node.keywords)
+                msg = None
+                if last == "urlopen":
+                    if "timeout" not in kwargs and not spread:
+                        msg = ("urlopen without timeout= — a stalled"
+                               " daemon holds this thread forever; pass"
+                               " the remaining deadline budget")
+                elif last == "create_connection":
+                    if ("timeout" not in kwargs and len(node.args) < 2
+                            and not spread):
+                        msg = ("socket.create_connection without a"
+                               " timeout — a black-holed peer blocks"
+                               " until the kernel gives up (minutes)")
+                elif (last in _SUBPROCESS_BLOCKERS
+                      and name.startswith("subprocess.")):
+                    if "timeout" not in kwargs and not spread:
+                        msg = (f"subprocess.{last} without timeout= —"
+                               " a hung ssh/scp/kubectl child blocks"
+                               " the control plane indefinitely")
+                elif (last == "wait" and isinstance(node.func,
+                                                    ast.Attribute)):
+                    if "timeout" not in kwargs and not node.args \
+                            and not spread:
+                        msg = ("unbounded .wait() — if the signalling"
+                               " side died, this waits forever; pass a"
+                               " timeout or annotate the sanctioned"
+                               " block with jt: allow[net-timeout]")
+                elif last == "serve_forever":
+                    msg = ("serve_forever blocks this thread for the"
+                           " process lifetime — annotate the sanctioned"
+                           " accept loop with jt: allow[net-timeout]")
+                if msg:
+                    self._emit(out, sf, "net-timeout", node,
+                               idx.enclosing(sf.tree, node), msg)
 
     def _emit(self, out, sf, rule, node, scope, msg) -> None:
         line = getattr(node, "lineno", 1)

@@ -33,10 +33,30 @@ class EnvVar(NamedTuple):
 
 #: every environment variable the package reads, alphabetical
 REGISTRY: Tuple[EnvVar, ...] = (
+    EnvVar("JEPSEN_TPU_BREAKER_COOLDOWN", "5.0",
+           "serve/client.py",
+           "seconds an open circuit breaker waits before a half-open "
+           "`/healthz` probe may close it again"),
+    EnvVar("JEPSEN_TPU_BREAKER_FAILURES", "3",
+           "serve/client.py",
+           "consecutive connection-level failures that trip the "
+           "breaker open; tripped calls fast-fail to in-process"),
     EnvVar("JEPSEN_TPU_CALIBRATION", "auto-discover",
            "tune/artifact.py",
            "calibration artifact path; `0`/`off` disables, unset "
            "auto-discovers `calibration.json`"),
+    EnvVar("JEPSEN_TPU_CLIENT_BACKOFF", "0.1",
+           "serve/client.py",
+           "base seconds for client retry backoff (exponential with "
+           "full jitter, capped by the deadline budget)"),
+    EnvVar("JEPSEN_TPU_CLIENT_DEADLINE", "630.0",
+           "serve/client.py",
+           "per-request wall-clock budget in seconds across ALL retry "
+           "attempts; a stalled daemon costs at most this"),
+    EnvVar("JEPSEN_TPU_CLIENT_RETRIES", "2",
+           "serve/client.py",
+           "connection-level retries after the first attempt (never "
+           "retries a 503 — the daemon answered)"),
     EnvVar("JEPSEN_TPU_CYCLES_CLOSURE", "auto",
            "ops/cycles.py",
            "closure kernel variant (`fixed`/`earlyexit`); env > "
@@ -106,6 +126,11 @@ REGISTRY: Tuple[EnvVar, ...] = (
     EnvVar("JEPSEN_TPU_SERVE_HOST", "127.0.0.1",
            "serve/client.py",
            "daemon host the service client targets"),
+    EnvVar("JEPSEN_TPU_SERVE_JIT_CACHE", "unset",
+           "serve/daemon.py",
+           "persistent jit-compilation cache directory for the "
+           "`serve()` production entry; a supervised restart rewarms "
+           "from it; unset disables"),
     EnvVar("JEPSEN_TPU_SERVE_MAX_QUEUE", "8",
            "serve/daemon.py",
            "admission bound in queued runs; excess requests get 503 "
@@ -121,6 +146,11 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "serve/client.py",
            "service routing: `1` requires the resident daemon, `auto` "
            "spawns one, `0`/unset stays in-process"),
+    EnvVar("JEPSEN_TPU_WAL", "verdict-wal.jsonl",
+           "serve/daemon.py",
+           "verdict write-ahead-log path for the `serve()` production "
+           "entry; settled verdicts survive kill -9 and replay into "
+           "retried request ids; falsy disables"),
 )
 
 

@@ -29,11 +29,21 @@ old files):
 
 Rotation: when the current file exceeds ``max_bytes`` the writer
 renames it to ``<path>.1`` (replacing any previous ``.1``) and starts
-fresh — bounded disk, and readers see at most two files.
+fresh — bounded disk, and readers see at most two files.  The rename
+is followed by a directory fsync so a crash right after rotation
+cannot lose the directory entry.
 
 The module-level singleton (``configure``/``emit``/``path``) is a
 no-op until configured, so library use (tests, in-process engines)
 never writes to cwd by accident.
+
+Verdict WAL (``VerdictWAL``, schema below): the service layer's
+crash-safe verdict record.  Where the dispatch journal records *cost
+evidence*, the WAL records *settled verdicts* — one append-only row
+per (request, stream, history index) the engine settles, so a daemon
+killed mid-batch can replay everything already decided and re-dispatch
+only the unsettled remainder (doc/checker-service.md "Failure modes &
+recovery").
 """
 
 from __future__ import annotations
@@ -69,6 +79,22 @@ _SCHEMA: Dict[str, tuple] = {
     "calibration": (str,),
     "trace_id": (str,),
 }
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` so a rename survives a
+    crash; best-effort (some filesystems refuse directory fds)."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def validate_row(row: Any) -> bool:
@@ -137,6 +163,9 @@ class DispatchJournal:
         except OSError:
             return  # no file yet
         os.replace(self.path, self.path + ".1")
+        # crash consistency: persist the directory entry for the
+        # rename before any new-file write can depend on it
+        _fsync_dir(self.path)
 
     def files(self) -> List[str]:
         """Rotated-then-current paths that exist, oldest first."""
@@ -169,6 +198,179 @@ def read_rows(path: str, *, strict: bool = False) -> Iterator[Dict[str, Any]]:
                     yield row
                 elif strict:
                     raise ValueError(f"{p}:{lineno}: schema violation")
+
+
+# -- verdict write-ahead log ----------------------------------------------
+
+WAL_SCHEMA_VERSION = 1
+DEFAULT_WAL_FILENAME = "verdict-wal.jsonl"
+
+#: required fields -> acceptable types (verdict-WAL schema pin).
+#: ``req`` is the client request id (idempotency key), ``stream`` the
+#: decomposition stream tag ("main"/"sub"), ``idx`` the history index
+#: within that stream, ``result`` the settled verdict dict.
+_WAL_SCHEMA: Dict[str, tuple] = {
+    "v": (int,),
+    "ts": (int, float),
+    "req": (str,),
+    "stream": (str,),
+    "idx": (int,),
+    "result": (dict,),
+}
+
+
+def validate_verdict_row(row: Any) -> bool:
+    """True iff ``row`` matches the pinned verdict-WAL v1 schema."""
+    if not isinstance(row, dict):
+        return False
+    if row.get("v") != WAL_SCHEMA_VERSION:
+        return False
+    if set(row) != set(_WAL_SCHEMA):
+        return False
+    for key, types in _WAL_SCHEMA.items():
+        if not isinstance(row[key], types):
+            return False
+        if types == (int,) and isinstance(row[key], bool):
+            return False
+    return True
+
+
+class VerdictWAL:
+    """Append-only per-verdict write-ahead log, one JSONL row per
+    settled (request, stream, history) slot.
+
+    Verdict accumulation is monotone — a slot settles exactly once and
+    never changes — so the log needs no update-in-place and replay is
+    a pure union.  Durability model: appends ride the page cache (a
+    kill -9 of the *process* loses nothing already written(2)); only
+    ``compact()`` — which rewrites the file — pays write-temp + atomic
+    rename + directory fsync for crash consistency.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self.written = 0  #: rows appended by this writer
+        self.dropped = 0  #: rows lost to write errors (disk full etc.)
+        self._repair_tail()
+
+    def _repair_tail(self) -> None:
+        """Seal a torn tail left by a crash mid-append: without a
+        trailing newline, the FIRST row this writer appends would
+        concatenate onto the torn fragment and both would be lost on
+        read-back — one damaged line must never cascade into two."""
+        try:
+            with open(self.path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
+        except OSError:
+            pass  # absent file (fresh WAL) or unreadable — append as-is
+
+    def append(self, req: str, stream: str, idx: int,
+               result: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Append one settled verdict; fills ``v``/``ts``, validates.
+
+        Returns the row dict on success, None when dropped — WAL
+        failures must never fail a check.
+        """
+        row = {
+            "v": WAL_SCHEMA_VERSION,
+            "ts": time.time(),
+            "req": req,
+            "stream": stream,
+            "idx": idx,
+            "result": result,
+        }
+        if not validate_verdict_row(row):
+            with self._lock:
+                self.dropped += 1
+            return None
+        line = json.dumps(row, sort_keys=True, default=str) + "\n"
+        with self._lock:
+            try:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line)
+                self.written += 1
+            except OSError:
+                self.dropped += 1
+                return None
+        return row
+
+    def sink_for(self, req: str):
+        """A ``(stream, idx, result) -> None`` settle sink bound to one
+        request id — the shape ``DecomposedRun.attach_wal`` expects."""
+        def _sink(stream: str, idx: int, result: Dict[str, Any]) -> None:
+            self.append(req, stream, idx, result)
+        return _sink
+
+    def compact(self, keep_reqs=None) -> int:
+        """Rewrite the log keeping only rows whose ``req`` is in
+        ``keep_reqs`` (None keeps everything — pure rewrite).
+
+        Crash-consistent: live rows stream into ``<path>.tmp``, which
+        is fsynced, atomically renamed over the log, and sealed with a
+        directory fsync — a crash at any point leaves either the old
+        or the new file, never a torn one.  Returns rows kept.
+        """
+        with self._lock:
+            rows = [r for r in read_verdict_rows(self.path)
+                    if keep_reqs is None or r["req"] in keep_reqs]
+            tmp = self.path + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for r in rows:
+                        f.write(json.dumps(r, sort_keys=True) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+                _fsync_dir(self.path)
+            except OSError:
+                self.dropped += 1
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            return len(rows)
+
+
+def read_verdict_rows(path: str) -> List[Dict[str, Any]]:
+    """All valid verdict rows from a WAL path, file order.
+
+    Damaged lines — the half-written tail of a killed daemon — are
+    skipped: prior rows must survive a torn final append.
+    """
+    rows: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if validate_verdict_row(row):
+                rows.append(row)
+    return rows
+
+
+def replay_index(path: str) -> Dict[str, Dict[tuple, Dict[str, Any]]]:
+    """WAL rows grouped for replay: ``{req: {(stream, idx): result}}``.
+
+    Later rows win, though monotone settle means duplicates only arise
+    from a retried request re-settling identically.
+    """
+    index: Dict[str, Dict[tuple, Dict[str, Any]]] = {}
+    for row in read_verdict_rows(path):
+        index.setdefault(row["req"], {})[(row["stream"], row["idx"])] = (
+            row["result"])
+    return index
 
 
 # -- module singleton (no-op until configured) ----------------------------

@@ -26,16 +26,38 @@ def main(argv=None) -> int:
     p.add_argument("--max-queue", type=int, default=None,
                    help="admission bound: queued client runs before "
                    "/check answers 503 (default 8)")
+    p.add_argument("--wal", default=None,
+                   help="verdict write-ahead log path (default "
+                   "JEPSEN_TPU_WAL or verdict-wal.jsonl; 'off' "
+                   "disables crash-safe resumption)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run the daemon as a supervised child and "
+                   "restart it on abnormal exit (crash recovery; "
+                   "doc/checker-service.md)")
     args = p.parse_args(argv)
 
     from . import daemon, protocol
 
+    if args.supervise:
+        # re-exec ourselves minus --supervise; the child inherits the
+        # environment, so journal/WAL/jit-cache paths carry over and a
+        # restart resumes where the crash left off
+        child = [a for a in (argv if argv is not None else sys.argv[1:])
+                 if a != "--supervise"]
+        return daemon.supervise(child)
+    kw = {}
+    if args.wal is not None:
+        kw["wal_path"] = (
+            None if args.wal.lower() in ("0", "false", "off", "no", "")
+            else args.wal
+        )
     daemon.serve(
         host=args.host or protocol.DEFAULT_HOST,
         port=args.port,
         window=args.window,
         max_queue_runs=args.max_queue,
         block=True,
+        **kw,
     )
     return 0
 

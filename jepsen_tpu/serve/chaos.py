@@ -1,0 +1,474 @@
+"""Self-chaos harness: ``python -m jepsen_tpu.serve.chaos``.
+
+Turns the nemesis on the checker itself.  A consistency checker that
+dies with its run — or worse, silently drops a violation it had
+already found — is not fit to judge crash-prone systems, so the
+service stack must survive the same faults it is built to detect.
+Three scenarios, each asserting the acceptance gates from
+doc/checker-service.md "Failure modes & recovery":
+
+1. **kill -9 + WAL resume**: a daemon subprocess is SIGKILLed — once
+   mid-request, once after settling two full batches (dense and
+   frontier kernel routes) — and its verdict WAL's final line is torn
+   mid-write (the crash-consistency worst case).  A restarted daemon
+   replays the WAL into retried request ids: the fully-journaled
+   request performs ZERO re-dispatches (``replayed == settled``), the
+   torn request re-runs exactly the one lost row, and every final
+   result list is byte-identical (canonical JSON) to the in-process
+   engine.  The mid-request client never hangs: it fails bounded or
+   completes, and its retry after restart gets identical verdicts.
+2. **stalled socket + circuit breaker**: a fault-injecting TCP proxy
+   on the local HTTP seam stalls responses past the client deadline.
+   Every stalled call returns within the deadline budget (never
+   hangs), consecutive failures trip the breaker, a tripped breaker
+   fast-fails to the transparent in-process fallback (same verdicts),
+   and after the cooldown a half-open ``/healthz`` probe through the
+   un-stalled proxy closes the breaker again (recovery).
+3. **dropped response + idempotent retry**: the proxy forwards a
+   request to the daemon but drops the response.  The client's retry
+   carries the same request id, the daemon serves it from the
+   completed-response cache (``deduped`` + 1), and the request
+   counters advance by exactly ONE — retried work is never
+   double-counted.
+
+Every injected fault is accounted for in metrics: client retries,
+breaker trips and probes (this process's registry), WAL replays and
+request dedups (the daemon's ``/metrics``).
+
+Wired into ``make chaos-smoke`` / ``make check``.  Exit codes: 0 ok,
+1 any gate failed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+
+def _canon(results) -> str:
+    from jepsen_tpu.serve import protocol
+
+    return json.dumps(protocol.sanitize_results(results), sort_keys=True)
+
+
+def _metric_value(text: str, name: str):
+    total = None
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            head = line.split(" ", 1)[0]
+            if head == name or head.startswith(name + "{"):
+                try:
+                    total = (total or 0.0) + float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    return None
+    return total
+
+
+# -- daemon-subprocess lifecycle ---------------------------------------------
+
+
+def _spawn_daemon(port: int, tmp: str):
+    """Start a real daemon subprocess (the kill -9 target must be a
+    separate process) with its journal + verdict WAL in ``tmp``."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["JEPSEN_TPU_JOURNAL"] = os.path.join(tmp, "journal.jsonl")
+    env["JEPSEN_TPU_WAL"] = os.path.join(tmp, "verdict-wal.jsonl")
+    # cwd is ``tmp`` (isolation), so the child can't rely on an
+    # importable package in its working directory — point it at ours
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    prior = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = root + (os.pathsep + prior if prior else "")
+    log = open(os.path.join(tmp, "daemon.log"), "ab")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-m", "jepsen_tpu.serve",
+             "--port", str(port)],
+            cwd=tmp, env=env, stdout=log, stderr=log,
+        )
+    finally:
+        log.close()
+
+
+def _wait_healthy(client, proc, wait_s: float = 90.0) -> bool:
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        if client.healthy(timeout=0.5):
+            return True
+        if proc.poll() is not None:
+            return False
+        time.sleep(0.2)
+    return False
+
+
+def _sigkill(proc) -> None:
+    try:
+        os.kill(proc.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass  # already dead — the harness assertions will say why
+    proc.wait(timeout=30)
+
+
+def _tear_tail(path: str) -> None:
+    """Simulate a crash mid-append: drop any already-torn tail, then
+    cut the last COMPLETE line in half (no trailing newline) — the
+    read-back must skip it without losing prior rows."""
+    with open(path, "rb") as f:
+        data = f.read()
+    complete, _, _ = data.rpartition(b"\n")
+    head, _, last = complete.rpartition(b"\n")
+    torn = last[: max(1, len(last) // 2)]
+    with open(path, "wb") as f:
+        if head:
+            f.write(head + b"\n")
+        f.write(torn)
+
+
+def _post_check(client, model, histories, opts, rid):
+    """POST /check with a CALLER-CHOSEN request id (the crash-retry
+    scenarios must replay the same id across daemon lives, which
+    ``ServiceClient.check_batch``'s per-call ids cannot do)."""
+    from jepsen_tpu.serve import protocol
+
+    body = protocol.check_request(model, histories, opts, req=rid)
+    code, resp = client._resilient_post("/check", body)
+    return code, protocol.decode_body(resp)
+
+
+# -- the fault-injecting proxy (the local HTTP seam) --------------------------
+
+
+def _recv_http(conn) -> bytes:
+    """Read one Content-Length-framed HTTP message off a socket."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = conn.recv(65536)
+        if not chunk:
+            return buf
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    n = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            n = int(line.split(b":", 1)[1].strip())
+    while len(rest) < n:
+        chunk = conn.recv(65536)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest
+
+
+class _FaultProxy:
+    """TCP proxy between client and daemon with three modes:
+    ``forward`` (pass-through), ``stall`` (accept, never answer —
+    the frozen-daemon fault), ``drop_response`` (forward the request
+    upstream, swallow the response — the lost-reply fault that forces
+    an idempotent retry)."""
+
+    def __init__(self, upstream_port: int):
+        self.upstream = upstream_port
+        self.mode = "forward"
+        self.drop_remaining = 0
+        self.stalled = 0
+        self.dropped = 0
+        self._release = threading.Event()
+        self._stop = False
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self) -> None:  # jt: thread-entry
+        self._srv.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True,
+            ).start()
+
+    def _handle(self, conn) -> None:  # jt: thread-entry
+        up = None
+        try:
+            conn.settimeout(30)
+            mode = self.mode
+            if mode == "stall":
+                self.stalled += 1
+                # hold the client's socket open, answer nothing: its
+                # deadline budget — not this proxy — must end the wait
+                self._release.wait(timeout=30)
+                return
+            data = _recv_http(conn)
+            if not data:
+                return
+            up = socket.create_connection(
+                ("127.0.0.1", self.upstream), timeout=10)
+            up.settimeout(60)
+            up.sendall(data)
+            resp = _recv_http(up)
+            if mode == "drop_response" and self.drop_remaining > 0:
+                self.drop_remaining -= 1
+                self.dropped += 1
+                return  # the daemon DID the work; the client sees EOF
+            conn.sendall(resp)
+        except OSError:
+            pass
+        finally:
+            for s in (conn, up):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+    def close(self) -> None:
+        self._stop = True  # jt: allow[concurrency-unguarded-shared] — monotonic shutdown flag; the accept loop re-reads it every 0.2s tick
+        self._release.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# -- the harness --------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu import models as m
+    from jepsen_tpu import obs
+    from jepsen_tpu.engine.smoke import _corpus
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.serve import ServiceClient, client as client_mod
+    from jepsen_tpu.serve.smoke import _corpus_b
+    from jepsen_tpu.util import free_port
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    obs.enable(reset=True)
+    model = m.cas_register(0)
+    batch = _corpus()
+    batch_v = _corpus_b()
+    configs = {
+        "dense": dict(slot_cap=32, max_dispatch=4),
+        "frontier": dict(slot_cap=32, max_dispatch=4, max_closure=9),
+    }
+    expected = {route: _canon(wgl.check_batch(model, batch, **kw))
+                for route, kw in configs.items()}
+    expected_v = _canon(wgl.check_batch(model, batch_v, **configs["dense"]))
+
+    tmp = tempfile.mkdtemp(prefix="jepsen-chaos-")
+    wal_path = os.path.join(tmp, "verdict-wal.jsonl")
+    port = free_port()
+    client_mod.reset_breakers()
+
+    # == scenario 1: kill -9 mid-request, then after settled batches ==
+    proc = _spawn_daemon(port, tmp)
+    client = ServiceClient(port=port)
+    check(_wait_healthy(client, proc), "daemon A did not come up")
+    rid_v = "chaos-victim"
+    victim = {}
+
+    def post_victim():
+        try:
+            victim["out"] = _post_check(
+                client, model, batch_v, configs["dense"], rid_v)
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            victim["err"] = e
+
+    t0 = time.monotonic()
+    t = threading.Thread(target=post_victim)
+    t.start()
+    time.sleep(0.05)
+    _sigkill(proc)  # the nemesis: kill -9 mid-request
+    t.join(timeout=60)
+    check(not t.is_alive(),
+          "client hung after daemon was SIGKILLed mid-request")
+    check(time.monotonic() - t0 < 60,
+          "mid-request kill was not bounded by the client deadline")
+    # the victim's retries against the dead daemon are consecutive
+    # connection failures, so they legitimately trip the breaker —
+    # scenario 2 pins that behaviour; here it would mask the WAL path
+    client_mod.reset_breakers()
+
+    # a fresh daemon life settles both kernel routes completely
+    proc = _spawn_daemon(port, tmp)
+    check(_wait_healthy(client, proc), "daemon A2 did not come up")
+    settled = {}
+    for route, kw in configs.items():
+        code, payload = _post_check(
+            client, model, batch, kw, f"chaos-{route}")
+        check(code == 200, f"{route}: first pass returned {code}")
+        check(_canon(payload.get("results") or []) == expected[route],
+              f"{route}: pre-crash verdicts diverged from in-process")
+        diag = payload.get("diag") or {}
+        settled[route] = diag.get("settled", 0)
+        check(settled[route] > 0, f"{route}: no settled count in diag")
+        check(diag.get("replayed") == 0,
+              f"{route}: fresh request claims WAL replays ({diag})")
+    _sigkill(proc)  # kill -9 again — now with a fully-written WAL
+    check(os.path.exists(wal_path), "verdict WAL was never written")
+    _tear_tail(wal_path)  # corrupt the journal mid-write
+
+    # restart: retried ids replay the WAL, re-dispatching only what
+    # the torn line lost
+    proc = _spawn_daemon(port, tmp)
+    check(_wait_healthy(client, proc), "daemon B did not come up")
+    for route, kw in configs.items():
+        code, payload = _post_check(
+            client, model, batch, kw, f"chaos-{route}")
+        check(code == 200, f"{route}: replay pass returned {code}")
+        check(_canon(payload.get("results") or []) == expected[route],
+              f"{route}: post-crash verdicts diverged from in-process")
+        diag = payload.get("diag") or {}
+        want = settled[route] - (1 if route == "frontier" else 0)
+        check(diag.get("replayed") == want,
+              f"{route}: replayed {diag.get('replayed')} of "
+              f"{settled[route]} settled rows, wanted {want}")
+        if route == "dense":
+            # fully journaled ⇒ zero re-dispatches: the crash cost
+            # nothing but the replay read
+            check(diag.get("cold_dispatches", 0) == 0
+                  and diag.get("warm_dispatches", 0) == 0,
+                  f"{route}: fully-replayed request re-dispatched "
+                  f"({diag})")
+    # the mid-request victim retries its id against the restarted
+    # daemon: identical verdicts, whatever the crash interrupted
+    code, payload = _post_check(
+        client, model, batch_v, configs["dense"], rid_v)
+    check(code == 200 and _canon(payload.get("results") or [])
+          == expected_v,
+          "victim retry after kill -9 diverged from in-process")
+    st = client.status()
+    mtext = client.metrics_text()
+    want_replayed = (settled["dense"] + settled["frontier"] - 1
+                     + (payload.get("diag") or {}).get("replayed", 0))
+    check(st.get("replayed") == want_replayed,
+          f"/status replayed {st.get('replayed')} != {want_replayed}")
+    check(_metric_value(mtext, "jepsen_serve_wal_replayed_total")
+          == want_replayed,
+          "jepsen_serve_wal_replayed_total does not account the replays")
+    check(st.get("wal_path") == wal_path and st.get("wal_rows", 0) > 0,
+          f"/status does not advertise the WAL ({st.get('wal_path')}, "
+          f"{st.get('wal_rows')})")
+
+    # == scenario 2: stalled socket → deadline, breaker, fallback ==
+    os.environ["JEPSEN_TPU_CLIENT_DEADLINE"] = "2.0"
+    os.environ["JEPSEN_TPU_CLIENT_BACKOFF"] = "0.05"
+    os.environ["JEPSEN_TPU_BREAKER_FAILURES"] = "3"
+    os.environ["JEPSEN_TPU_BREAKER_COOLDOWN"] = "1.0"
+    client_mod.reset_breakers()
+    proxy = _FaultProxy(port)
+    proxy.mode = "stall"
+    stalled = ServiceClient(port=proxy.port)
+    br = client_mod.breaker_for(stalled.host, stalled.port)
+    for i in range(3):
+        t0 = time.monotonic()
+        try:
+            _post_check(stalled, model, batch_v, configs["dense"],
+                        f"chaos-stall-{i}")
+            check(False, f"stalled call {i} unexpectedly succeeded")
+        except client_mod.ServiceError:
+            pass
+        wall = time.monotonic() - t0
+        check(wall <= 3.5,
+              f"stalled call {i} took {wall:.1f}s — past the 2.0s "
+              "deadline budget")
+    check(br.state() == "open",
+          f"breaker did not trip after 3 stalled calls ({br.state()})")
+    # a tripped breaker fast-fails the transparent seam to in-process
+    t0 = time.monotonic()
+    res = client_mod.check_batch(model, batch_v, client=stalled,
+                                 **configs["dense"])
+    wall = time.monotonic() - t0
+    check(_canon(res) == expected_v,
+          "open-breaker fallback verdicts diverged from in-process")
+    check(wall <= 0.75,
+          f"open breaker did not fast-fail ({wall:.2f}s)")
+    # recovery: un-stall the seam, wait out the cooldown, and the
+    # half-open /healthz probe closes the breaker again
+    proxy.mode = "forward"
+    proxy._release.set()
+    time.sleep(1.1)
+    req0 = client.status().get("requests", 0)
+    res = client_mod.check_batch(model, batch_v, client=stalled,
+                                 **configs["dense"])
+    check(_canon(res) == expected_v,
+          "post-recovery verdicts diverged from in-process")
+    check(br.state() == "closed",
+          f"breaker did not close after half-open probe ({br.state()})")
+    check(br.probes >= 1, "recovery path never probed /healthz")
+    check(client.status().get("requests", 0) > req0,
+          "post-recovery request did not reach the daemon")
+
+    # == scenario 3: dropped response → idempotent retry, no double count ==
+    st0 = client.status()
+    proxy.mode = "drop_response"
+    proxy.drop_remaining = 1
+    code, payload = _post_check(stalled, model, batch_v,
+                                configs["dense"], "chaos-dedup")
+    check(code == 200 and _canon(payload.get("results") or [])
+          == expected_v,
+          "retried-after-drop verdicts diverged from in-process")
+    st1 = client.status()
+    check(proxy.dropped == 1, "proxy never dropped a response")
+    check(st1.get("requests", 0) - st0.get("requests", 0) == 1,
+          f"duplicate request double-counted "
+          f"({st0.get('requests')} → {st1.get('requests')})")
+    check(st1.get("deduped", 0) - st0.get("deduped", 0) == 1,
+          f"daemon did not dedupe the retried id "
+          f"({st0.get('deduped')} → {st1.get('deduped')})")
+    check((_metric_value(client.metrics_text(),
+                         "jepsen_serve_request_dedup_total") or 0) >= 1,
+          "jepsen_serve_request_dedup_total does not account the dedup")
+
+    # == fault accounting, client side (this process's registry) ==
+    mine = obs.render_prom()
+    for name in ("jepsen_client_retries_total",
+                 "jepsen_client_breaker_trips_total",
+                 "jepsen_client_breaker_probes_total"):
+        check((_metric_value(mine, name) or 0) >= 1,
+              f"client metrics missing {name}")
+
+    # teardown
+    proxy.close()
+    try:
+        client.shutdown()
+        proc.wait(timeout=30)
+    except Exception:  # noqa: BLE001 — fall back to the hard kill
+        _sigkill(proc)
+
+    if failures:
+        for f_ in failures:
+            print(f"chaos-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "chaos-smoke: ok (kill -9 + torn-WAL replay byte-identical on "
+        "both kernel routes; stalled-socket calls bounded by the "
+        "deadline, breaker tripped to in-process and recovered "
+        "half-open; dropped response deduped by request id; all "
+        "faults accounted in metrics)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,17 +15,29 @@ inherited, only the analysis hop changes.  ``checker.linearizable``
 resolves ``algorithm="auto"`` to the service when
 ``JEPSEN_TPU_SERVICE`` opts in, so a fleet can flip every run to the
 warm daemon with one environment variable and zero test edits.
+
+Resilience (doc/checker-service.md "Failure modes & recovery"): every
+``/check``/``/elle`` POST carries an idempotent request id and runs
+through bounded exponential backoff with jitter under an overall
+per-request deadline budget, behind a per-address circuit breaker
+(N consecutive connection failures trip it open; after a cooldown a
+single half-open ``/healthz`` probe decides).  An open breaker
+fast-fails to :class:`ServiceUnavailable`, which the transparent seam
+turns into the in-process engine — a dead daemon costs one probe per
+cooldown, not a connect timeout per batch.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .. import obs
 from ..obs import propagate
@@ -40,6 +52,28 @@ from .protocol import UnsupportedModel  # noqa: F401 (re-export)
 #: the fallback contract covers hangs, not just refusals
 DEFAULT_CLIENT_TIMEOUT_S = 630.0
 
+#: retry/breaker defaults (env-overridable; doc/configuration.md)
+DEFAULT_CLIENT_RETRIES = 2
+DEFAULT_CLIENT_BACKOFF_S = 0.1
+DEFAULT_BREAKER_FAILURES = 3
+DEFAULT_BREAKER_COOLDOWN_S = 5.0
+
+
+def _env_pos_float(name: str, default: float) -> float:
+    try:
+        v = float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+    return v if v > 0 else default
+
+
+def _env_nonneg_int(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+    return v if v >= 0 else default
+
 
 class ServiceError(Exception):
     """The daemon was reachable but could not serve the request."""
@@ -47,6 +81,101 @@ class ServiceError(Exception):
 
 class ServiceUnavailable(ServiceError):
     """No healthy daemon at the configured address."""
+
+
+class CircuitBreaker:
+    """Per-address breaker: closed → open after ``failures``
+    consecutive connection failures → half-open after ``cooldown_s``
+    (one probe decides: success closes, failure re-opens).
+
+    Shared by every :class:`ServiceClient` pointed at one address (the
+    transparent seam constructs a fresh client per call, so per-client
+    state would never accumulate failures) — see :func:`breaker_for`.
+    """
+
+    def __init__(self, failures: int = DEFAULT_BREAKER_FAILURES,
+                 cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S):
+        self.failures = max(1, failures)
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._consecutive = 0  # jt: guarded-by(_lock)
+        self._opened_at: Optional[float] = None  # jt: guarded-by(_lock)
+        self.trips = 0  #: times the breaker tripped open
+        self.probes = 0  #: half-open probes attempted
+
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if time.monotonic() - self._opened_at >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    def allow(self, probe=None) -> bool:
+        """True when a request may proceed.  While open within the
+        cooldown: False (fast-fail).  After the cooldown: half-open —
+        run ``probe()`` (a cheap liveness check); its verdict closes or
+        re-opens the breaker."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+        # half-open: probe outside the lock (it does network I/O)
+        ok = bool(probe()) if probe is not None else False
+        with self._lock:
+            self.probes += 1
+            if ok:
+                self._opened_at = None
+                self._consecutive = 0
+                return True
+            self._opened_at = time.monotonic()
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = None
+
+    def record_failure(self) -> bool:
+        """Count one connection failure; True when this one TRIPPED
+        the breaker open."""
+        with self._lock:
+            self._consecutive += 1
+            if (self._opened_at is None
+                    and self._consecutive >= self.failures):
+                self._opened_at = time.monotonic()
+                self.trips += 1
+                return True
+            return False
+
+
+#: one breaker per daemon address, process-wide — resolve_client()
+#: builds a fresh ServiceClient per seam call, so breaker state must
+#: outlive any single client instance
+_BREAKERS: Dict[Tuple[str, Optional[int]], CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(host: str, port: Optional[int]) -> CircuitBreaker:
+    key = (host, port)
+    with _breakers_lock:
+        br = _BREAKERS.get(key)
+        if br is None:
+            br = _BREAKERS[key] = CircuitBreaker(
+                failures=_env_nonneg_int("JEPSEN_TPU_BREAKER_FAILURES",
+                                         DEFAULT_BREAKER_FAILURES)
+                or DEFAULT_BREAKER_FAILURES,
+                cooldown_s=_env_pos_float("JEPSEN_TPU_BREAKER_COOLDOWN",
+                                          DEFAULT_BREAKER_COOLDOWN_S),
+            )
+        return br
+
+
+def reset_breakers() -> None:
+    """Forget all breaker state (tests, and a fresh daemon spawn)."""
+    with _breakers_lock:
+        _BREAKERS.clear()
 
 
 def service_mode() -> str:
@@ -118,6 +247,72 @@ class ServiceClient:
         except (urllib.error.URLError, ConnectionError, OSError) as e:
             raise ServiceUnavailable(f"no daemon at {self._url('')}: {e}")
 
+    def _resilient_post(self, path: str, body: bytes):
+        """POST with retry/backoff/deadline through the address's
+        circuit breaker (the body — and its idempotent request id —
+        is byte-identical across attempts, so the daemon can dedupe).
+
+        - **deadline budget**: the whole call (attempts + backoff
+          sleeps) is bounded by ``JEPSEN_TPU_CLIENT_DEADLINE`` (or the
+          client's own timeout when smaller) — a stalled daemon can
+          never hang the checker past it.
+        - **retries**: connection-level failures retry up to
+          ``JEPSEN_TPU_CLIENT_RETRIES`` times with exponential backoff
+          + full jitter from ``JEPSEN_TPU_CLIENT_BACKOFF``.  HTTP-level
+          errors (503 backlog, daemon-side 500) do NOT retry: the
+          daemon answered; retrying would fight its load shedding.
+        - **breaker**: open → immediate :class:`ServiceUnavailable`
+          (the seam falls back in-process); half-open → one
+          ``/healthz`` probe decides.
+        """
+        br = breaker_for(self.host, self.port)
+        if not br.allow(lambda: self._probe(br)):
+            raise ServiceUnavailable(
+                f"circuit open for {self.host}:{self.port} "
+                f"(state {br.state()})")
+        attempt_timeout = self.timeout or DEFAULT_CLIENT_TIMEOUT_S
+        budget = min(
+            _env_pos_float("JEPSEN_TPU_CLIENT_DEADLINE",
+                           DEFAULT_CLIENT_TIMEOUT_S),
+            attempt_timeout if self.timeout else float("inf"),
+        )
+        deadline = time.monotonic() + budget
+        retries = _env_nonneg_int("JEPSEN_TPU_CLIENT_RETRIES",
+                                  DEFAULT_CLIENT_RETRIES)
+        backoff = _env_pos_float("JEPSEN_TPU_CLIENT_BACKOFF",
+                                 DEFAULT_CLIENT_BACKOFF_S)
+        attempt = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                obs.count("jepsen_client_deadline_exhausted_total")
+                raise ServiceUnavailable(
+                    f"deadline budget ({budget:.1f}s) exhausted for "
+                    f"{self._url(path)}")
+            try:
+                code, resp = self._request(
+                    path, body=body,
+                    timeout=min(attempt_timeout, remaining))
+            except ServiceUnavailable:
+                if br.record_failure():
+                    obs.count("jepsen_client_breaker_trips_total")
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                delay = min(backoff * (2 ** (attempt - 1)), remaining)
+                delay *= 0.5 + random.random() / 2  # full jitter
+                if attempt > retries or remaining <= delay:
+                    raise
+                obs.count("jepsen_client_retries_total")
+                time.sleep(delay)
+                continue
+            br.record_success()
+            return code, resp
+
+    def _probe(self, br: CircuitBreaker) -> bool:
+        """The half-open liveness probe (cheap, hard-bounded)."""
+        obs.count("jepsen_client_breaker_probes_total")
+        return self.healthy(timeout=0.5)
+
     def healthy(self, timeout: float = 0.5) -> bool:
         try:
             code, body = self._request("/healthz", timeout=timeout)
@@ -188,8 +383,9 @@ class ServiceClient:
         back."""
         with obs.span("client/elle", cat="serve", graphs=len(encs)) as sp:
             ctx = self._trace_ctx(sp)
-            body = protocol.elle_request(encs, trace_ctx=ctx)
-            code, resp = self._request("/elle", body=body)
+            body = protocol.elle_request(encs, trace_ctx=ctx,
+                                         req=protocol.request_id())
+            code, resp = self._resilient_post("/elle", body)
             payload = protocol.decode_body(resp)
             if code == 503:
                 raise ServiceError(
@@ -218,8 +414,9 @@ class ServiceClient:
         ) as sp:
             ctx = self._trace_ctx(sp)
             body = protocol.check_request(model, histories, opts,
-                                          trace_ctx=ctx)
-            code, resp = self._request("/check", body=body)
+                                          trace_ctx=ctx,
+                                          req=protocol.request_id())
+            code, resp = self._resilient_post("/check", body)
             payload = protocol.decode_body(resp)
             if code == 503:
                 raise ServiceError(
@@ -236,6 +433,26 @@ class ServiceClient:
         if ctx:
             self.fetch_trace(ctx["trace_id"])
         return results
+
+
+def _reap(proc, grace_s: float = 10.0) -> None:
+    """Terminate a child without ever leaking it: SIGTERM → bounded
+    wait → SIGKILL → bounded wait.  The second wait can still time out
+    (a child stuck in uninterruptible sleep survives SIGKILL until the
+    kernel releases it); that is swallowed — the caller's error path
+    must not be replaced by ``TimeoutExpired``, and the kernel will
+    reap the KILLed child without us."""
+    proc.terminate()
+    try:
+        proc.wait(timeout=grace_s)
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    proc.kill()
+    try:
+        proc.wait(timeout=grace_s)
+    except subprocess.TimeoutExpired:
+        pass
 
 
 def spawn_daemon(port: Optional[int] = None,
@@ -263,15 +480,10 @@ def spawn_daemon(port: Optional[int] = None,
             raise ServiceUnavailable(
                 f"spawned daemon exited with {proc.returncode}")
         time.sleep(0.25)
-    proc.terminate()
-    try:
-        # reap it: an unwaited child is a zombie for our lifetime, and
-        # a half-initialized daemon surviving SIGTERM would squat the
-        # port in an unknown state for the next auto-start
-        proc.wait(timeout=10)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.wait(timeout=10)
+    # reap it: an unwaited child is a zombie for our lifetime, and a
+    # half-initialized daemon surviving SIGTERM would squat the port
+    # in an unknown state for the next auto-start
+    _reap(proc)
     raise ServiceUnavailable(f"daemon not healthy within {wait_s}s")
 
 
@@ -442,6 +654,13 @@ def format_status(st: dict) -> str:
         f" + {st.get('warm_dispatches', 0)} warm"
         f" (warm-hit ratio {warm})"
     )
+    quarantine = st.get("quarantine") or []
+    if quarantine:
+        lines.append(
+            "  quarantine: "
+            + ", ".join(f"{q.get('route')} → oracle ({q.get('error')})"
+                        for q in quarantine)
+        )
     live = st.get("live")
     if live:
         lines.append("  " + format_live(live))

@@ -52,6 +52,7 @@ import json
 import os
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -148,7 +149,8 @@ class _Request:
     kind = "check"
     __slots__ = ("run", "streams", "group_key", "model",
                  "plan_opts", "exec_opts", "n", "rows", "t_admitted",
-                 "device_done", "error", "diag", "abandoned", "trace_id")
+                 "device_done", "error", "diag", "abandoned", "trace_id",
+                 "replayed")
 
     def __init__(self, run, streams, group_key, model, plan_opts,
                  exec_opts, n, trace_id: Optional[str] = None):
@@ -173,6 +175,9 @@ class _Request:
         self.abandoned = False
         #: caller's trace id (obs.propagate); None when untraced
         self.trace_id = trace_id
+        #: result slots pre-filled from the verdict WAL (a retried
+        #: request after a daemon restart) — surfaced in the diag
+        self.replayed = 0
 
 
 class CheckerDaemon:
@@ -193,6 +198,7 @@ class CheckerDaemon:
         cost_fn=None,
         journal_path: Optional[str] = None,
         journal_max_bytes: int = obs_journal.DEFAULT_MAX_BYTES,
+        wal_path: Optional[str] = None,
     ):
         #: per-bucket device-cost estimator driving largest-first
         #: dispatch of coalesced work.  The default is the
@@ -226,6 +232,27 @@ class CheckerDaemon:
         #: to cwd by accident; the `serve()` CLI entry defaults it ON
         self.journal_path = journal_path
         self.journal_max_bytes = journal_max_bytes
+        #: verdict-WAL destination (obs.journal.VerdictWAL): None = off
+        #: (constructor default, like the dispatch journal); the
+        #: `serve()` entry wires it from JEPSEN_TPU_WAL.  On a fresh
+        #: start the existing file becomes the replay index — a
+        #: restarted daemon re-dispatches only unsettled partitions of
+        #: retried requests (doc/checker-service.md "Failure modes &
+        #: recovery")
+        self.wal_path = wal_path
+        self._wal: Optional[obs_journal.VerdictWAL] = None
+        self._wal_replay: Dict[str, dict] = {}
+        #: completed-response cache for idempotent retries: a client
+        #: retry (same request id) of an ALREADY-ANSWERED request is
+        #: served from here without touching the device or the
+        #: counters — retried work is never double-counted
+        self._done: "OrderedDict[str, Tuple[int, dict]]" = OrderedDict()  # jt: guarded-by(_wake)
+        self._done_cap = 128
+        #: quarantined (kernel, E, C) routes: a device fault on one
+        #: route degrades THAT route to the CPU oracle instead of
+        #: failing whole batches (graceful degradation); values are
+        #: the triggering error repr
+        self._quarantine: Dict[Tuple, str] = {}  # jt: guarded-by(_wake)
         self.t_start = time.time()
         self._server: Optional[ThreadingHTTPServer] = None
         self._device_thread: Optional[threading.Thread] = None
@@ -243,6 +270,7 @@ class CheckerDaemon:
             "coalesced": 0, "batches": 0, "warm_dispatches": 0,
             "cold_dispatches": 0, "errors": 0,
             "elle_requests": 0, "elle_graphs": 0,
+            "quarantined_rows": 0, "replayed": 0, "deduped": 0,
         }
         self._platform: Optional[str] = None
         self._fatal: Optional[str] = None
@@ -566,10 +594,60 @@ class CheckerDaemon:
         # verdicts are order-independent by the engine contract, so
         # reordering is purely a throughput decision.
         planned.sort(key=self.cost_fn, reverse=True)
+        with self._wake:
+            quarantined = set(self._quarantine)
+        # graceful degradation: a device fault on one (kernel, E, C)
+        # route quarantines THAT route to the CPU oracle — this group
+        # keeps dispatching its other routes, and the batch answers
+        # 200 with oracle verdicts where the device failed, instead of
+        # resetting the executor and 500ing everyone (the whole-batch
+        # reset in _device_loop stays as the last resort).  Routed
+        # tokens are deduped so a row salvaged from the in-flight
+        # window never double-submits an oracle search.
+        routed = set()
+
+        def _oracle_route(tokens) -> int:
+            n = 0
+            for ctx, idx in tokens:
+                key = (id(ctx), idx)
+                if key in routed or ctx.settled(idx):
+                    continue
+                routed.add(key)
+                ctx.route_oracle(idx, "oracle", "quarantined")
+                n += 1
+            return n
+
+        n_quarantined = 0
         with obs.span("serve/dispatch", cat="serve", **attrs):
             for pb in planned:
-                executor.submit(pb)
-            executor.drain()
+                route = (pb.plan.kernel, pb.plan.E, pb.plan.C)
+                if route not in quarantined:
+                    try:
+                        executor.submit(pb)
+                        continue
+                    except Exception as e:  # noqa: BLE001 — degrade the route
+                        self._mark_quarantined([route], e)
+                        quarantined.add(route)
+                        n_quarantined += _oracle_route(
+                            self._salvage_executor(executor))
+                n_quarantined += _oracle_route(pb.rows)
+            try:
+                executor.drain()
+            except Exception as e:  # noqa: BLE001 — fault surfaced at sync
+                # the drain exposed an in-flight fault: quarantine the
+                # routes the window was still carrying and salvage
+                # their rows to the oracle pool
+                routes = {(ch["plan"].kernel, ch["plan"].E, ch["plan"].C)
+                          for ch in executor._chunks.values()}
+                routes |= {(esc[0].kernel, esc[0].E, esc[0].C)
+                           for esc in executor._pending_escalations}
+                self._mark_quarantined(routes or {("?", 0, 0)}, e)
+                quarantined.update(routes)
+                n_quarantined += _oracle_route(
+                    self._salvage_executor(executor))
+        if n_quarantined:
+            with self._wake:
+                self.stats["quarantined_rows"] += n_quarantined
         warm = executor.phase_counts["execute"] - pc0["execute"]
         cold = executor.phase_counts["compile"] - pc0["compile"]
         if warm:
@@ -599,6 +677,8 @@ class CheckerDaemon:
             stats = dict(self.stats)
             depth = len(self._queue)
             in_flight = self._in_flight
+            quarantine = [{"route": str(k), "error": v}
+                          for k, v in self._quarantine.items()]
         total = stats["warm_dispatches"] + stats["cold_dispatches"]
         cal = tune.active()
         reg = obs.registry()
@@ -654,6 +734,11 @@ class CheckerDaemon:
             if total else None,
             "journal_path": journal.path if journal else None,
             "journal_rows": journal.written if journal else 0,
+            # degraded (kernel, E, C) routes currently served by the
+            # CPU oracle, with the device error that tripped each
+            "quarantine": quarantine,
+            "wal_path": self._wal.path if self._wal else None,
+            "wal_rows": self._wal.written if self._wal else 0,
             "live": live,
             **stats,
         }
@@ -677,6 +762,12 @@ class CheckerDaemon:
         if self.journal_path:
             obs_journal.configure(self.journal_path,
                                   self.journal_max_bytes)
+        if self.wal_path:
+            # build the replay index BEFORE the writer reopens the
+            # file: every verdict a previous daemon life settled is
+            # replayed into retried requests instead of re-dispatched
+            self._wal_replay = obs_journal.replay_index(self.wal_path)  # jt: allow[concurrency-unguarded-shared] — written before serve/device threads start (Thread.start publication)
+            self._wal = obs_journal.VerdictWAL(self.wal_path)  # jt: allow[concurrency-unguarded-shared] — written before serve/device threads start (Thread.start publication)
         handler = _make_handler(self)
         self._server = ThreadingHTTPServer((self.host, self.port), handler)  # jt: allow[concurrency-unguarded-shared] — written before serve/device threads start (Thread.start publication)
         self._server.daemon_threads = True
@@ -686,14 +777,14 @@ class CheckerDaemon:
             daemon=True,
         )
         self._device_thread.start()
-        self._ready.wait()
+        self._ready.wait()  # jt: allow[net-timeout] — own device thread signals after jit warmup; bounding startup is the supervisor's job
         if block:
             print(
                 f"jepsen-tpu checker service on "
                 f"http://{self.host}:{self.port}/ (pid {os.getpid()})"
             )
             try:
-                self._server.serve_forever()
+                self._server.serve_forever()  # jt: allow[net-timeout] — the accept loop IS the process; shutdown() ends it
             finally:
                 self.stop()
         else:
@@ -733,6 +824,67 @@ class CheckerDaemon:
             self._server.shutdown()
             self._server.server_close()
 
+    # -- idempotent retries (handler threads) --------------------------------
+
+    def _dedup_hit(self, req_id) -> Optional[Tuple[int, dict]]:
+        """Serve a retried request id from the completed-response
+        cache: a client retry of an ALREADY-ANSWERED request (the
+        response was lost on the wire, not the work) is answered from
+        here without touching the device or inflating the request
+        counters — retried work is never double-counted."""
+        if not req_id:
+            return None
+        with self._wake:
+            hit = self._done.get(req_id)
+            if hit is None:
+                return None
+            self._done.move_to_end(req_id)
+            self.stats["deduped"] += 1
+        obs.count("jepsen_serve_request_dedup_total")
+        return hit
+
+    def _dedup_store(self, req_id, code: int, payload: dict) -> None:
+        if not req_id or code != 200:
+            # only durable successes are idempotent-replayable; a
+            # retried failure should retry the actual work
+            return
+        with self._wake:
+            self._done[req_id] = (code, payload)
+            self._done.move_to_end(req_id)
+            while len(self._done) > self._done_cap:
+                self._done.popitem(last=False)
+
+    # -- graceful degradation (device thread) --------------------------------
+
+    def _mark_quarantined(self, routes, err) -> int:
+        """Record device-faulted (kernel, E, C) routes: subsequent
+        buckets on a quarantined route go straight to the CPU oracle
+        instead of re-hitting the faulty compile/dispatch — one bad
+        route degrades, the daemon and every other route keep serving
+        (doc/checker-service.md "Failure modes & recovery")."""
+        with self._wake:
+            fresh = [r for r in routes if r not in self._quarantine]
+            for r in fresh:
+                self._quarantine[r] = repr(err)
+            n_q = len(self._quarantine)
+        if fresh:
+            obs.count("jepsen_serve_quarantine_total", len(fresh))
+            obs.gauge_set("jepsen_serve_quarantined_routes", n_q)
+        return len(fresh)
+
+    def _salvage_executor(self, executor) -> list:
+        """Capture the in-flight chunks' row tokens, then reset the
+        window: after a device fault the executor's transient state is
+        poisoned (see Executor.reset), but the un-settled rows it was
+        carrying can still get verdicts from the oracle pool."""
+        pending = [tok for ch in executor._chunks.values()
+                   for tok in ch["rows"]]
+        # parked escalations carry rows too — reset() would drop them
+        pending += [tok for esc in executor._pending_escalations
+                    for tok in esc[2]]
+        executor.reset()
+        return pending
+
     # -- the /check entry (handler threads) ----------------------------------
 
     def handle_check(self, body: bytes) -> Tuple[int, dict]:
@@ -760,6 +912,13 @@ class CheckerDaemon:
 
     def _check_flow(self, payload, model, histories, opts,
                     trace_id: Optional[str]) -> Tuple[int, dict]:
+        #: the client's idempotency key (serve.protocol request ids) —
+        #: doubles as the verdict-WAL run id, so a retry after a
+        #: daemon crash finds its settled partitions under the same id
+        req_id = payload.get("req")
+        cached = self._dedup_hit(req_id)
+        if cached is not None:
+            return cached
         if not self.precheck_admit(len(histories)):
             # overload sheds BEFORE the planning half: no encode, no
             # oracle-pool submissions for a request we will refuse
@@ -809,6 +968,23 @@ class CheckerDaemon:
             model, histories,
             oracle_fallback=bool(opts.get("oracle_fallback", True)),
         )
+        # crash-safe resumption: every NEW verdict appends to the WAL
+        # under this request's id, and a RETRIED id replays the
+        # verdicts a previous daemon life already settled — replay
+        # runs BEFORE encode, so settled rows never re-encode and only
+        # unsettled partitions re-dispatch (the planner's settled-row
+        # skip)
+        replayed = 0
+        if self._wal is not None:
+            run.attach_wal(
+                self._wal.sink_for(req_id or protocol.request_id()))
+            prior = self._wal_replay.get(req_id) if req_id else None
+            if prior:
+                replayed = run.replay(prior)
+                if replayed:
+                    with self._wake:
+                        self.stats["replayed"] += replayed
+                    obs.count("jepsen_serve_wal_replayed_total", replayed)
         streams = []
         with obs.span("serve/plan", cat="serve", histories=len(histories)):
             for tag, sctx in run.streams():
@@ -821,6 +997,7 @@ class CheckerDaemon:
                 )
         req = _Request(run, streams, group_key, model, plan_opts,
                        exec_opts, len(histories), trace_id=trace_id)
+        req.replayed = replayed
         if not self.admit(req):
             # planning already submitted this run's unencodable rows
             # to the oracle pool; cancel what has not started — the
@@ -850,10 +1027,17 @@ class CheckerDaemon:
         if req.error is not None:
             return 500, {"error": req.error}
         run.drain_oracles()
-        return 200, {
+        diag = dict(req.diag)
+        # the resumption evidence the chaos harness keys on: how many
+        # slots came pre-settled from the WAL vs settled in total
+        diag["replayed"] = req.replayed
+        diag["settled"] = run.settled_count()
+        body = {
             "results": protocol.sanitize_results(run.results()),
-            "diag": req.diag,
+            "diag": diag,
         }
+        self._dedup_store(req_id, 200, body)
+        return 200, body
 
     # -- the /elle entry (handler threads) -----------------------------------
 
@@ -877,10 +1061,14 @@ class CheckerDaemon:
             attrs["parent_sid"] = ctx["parent_sid"]
         with obs.span("serve/elle", cat="serve", **attrs):
             return self._elle_flow(graphs,
-                                   ctx["trace_id"] if ctx else None)
+                                   ctx["trace_id"] if ctx else None,
+                                   req_id=payload.get("req"))
 
-    def _elle_flow(self, graphs,
-                   trace_id: Optional[str]) -> Tuple[int, dict]:
+    def _elle_flow(self, graphs, trace_id: Optional[str],
+                   req_id: Optional[str] = None) -> Tuple[int, dict]:
+        cached = self._dedup_hit(req_id)
+        if cached is not None:
+            return cached
         req = _ElleRequest(graphs, trace_id=trace_id)
         if not self.admit(req):
             with self._wake:
@@ -898,10 +1086,12 @@ class CheckerDaemon:
             return 500, {"error": "device thread timed out"}
         if req.error is not None:
             return 500, {"error": req.error}
-        return 200, {
+        body = {
             "results": protocol.elle_results_to_wire(req.results or []),
             "diag": req.diag,
         }
+        self._dedup_store(req_id, 200, body)
+        return 200, body
 
 
 def _make_handler(daemon: CheckerDaemon):
@@ -994,5 +1184,75 @@ def serve(host: str = protocol.DEFAULT_HOST,
         if jp.lower() in ("0", "false", "off", "no", ""):
             jp = None
         kw["journal_path"] = jp
+    if "wal_path" not in kw:
+        # crash-safe by default at the production entry, like the
+        # dispatch journal: verdicts append to JEPSEN_TPU_WAL and a
+        # restarted daemon replays them into retried request ids
+        # (doc/checker-service.md "Failure modes & recovery")
+        wp = os.environ.get("JEPSEN_TPU_WAL",
+                            obs_journal.DEFAULT_WAL_FILENAME)
+        if wp.lower() in ("0", "false", "off", "no", ""):
+            wp = None
+        kw["wal_path"] = wp
+    # a persistent jit cache survives daemon crashes: the supervised
+    # restart re-warms compiled kernels from disk instead of paying
+    # every cold compile again.  Best-effort — an older jax without
+    # the knob just runs cold.
+    cache_dir = os.environ.get("JEPSEN_TPU_SERVE_JIT_CACHE", "")
+    if cache_dir and cache_dir.lower() not in ("0", "false", "off", "no"):
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception:  # noqa: BLE001 — cache warming is optional
+            pass
     d = CheckerDaemon(host, port, window=window, **kw)
     return d.start(block=block)
+
+
+def supervise(child_args, *, max_restarts: int = 16,
+              backoff_s: float = 1.0, max_backoff_s: float = 30.0) -> int:
+    """``serve --supervise``: run the daemon as a child process and
+    restart it whenever it dies abnormally (kill -9, device wedge, OOM
+    — the faults the self-chaos harness injects).  The restarted child
+    inherits this process's environment, so it re-warms from the same
+    dispatch journal, verdict WAL, and jit cache paths: clients that
+    retry their request ids replay settled verdicts instead of
+    recomputing them.  Returns the child's final exit code — 0 on a
+    clean exit (/shutdown) or supervisor signal, the last crash code
+    once the restart budget is exhausted."""
+    import signal
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, "-m", "jepsen_tpu.serve", *child_args]
+    state = {"sig": None, "proc": None}
+
+    def _forward(signum, frame):  # jt: thread-entry
+        state["sig"] = signum
+        p = state["proc"]
+        if p is not None and p.poll() is None:
+            p.terminate()
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, _forward)
+    restarts = 0
+    delay = backoff_s
+    while True:
+        proc = subprocess.Popen(cmd)
+        state["proc"] = proc
+        rc = proc.wait()  # jt: allow[net-timeout] — the supervisor's whole job is blocking on the child's lifetime
+        if state["sig"] is not None:
+            return 0
+        if rc == 0:
+            return 0
+        restarts += 1
+        if restarts > max_restarts:
+            print(f"jepsen-tpu serve: restart budget exhausted "
+                  f"(rc={rc})", file=sys.stderr)
+            return rc
+        print(f"jepsen-tpu serve: child exited rc={rc}; restart "
+              f"{restarts}/{max_restarts} in {delay:.1f}s",
+              file=sys.stderr)
+        time.sleep(delay)
+        delay = min(delay * 2, max_backoff_s)

@@ -223,12 +223,24 @@ class WireGraph:
         self.nonadj = tuple((int(w), int(r)) for w, r in nonadj)
 
 
-def elle_request(encs, trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
+def request_id() -> str:
+    """A fresh idempotent request id — the client mints one per
+    logical request and reuses it verbatim across retries, so the
+    daemon can dedupe a retried ``/check``/``/elle`` (never
+    double-counting it) and key the request's verdict-WAL rows."""
+    import uuid
+
+    return uuid.uuid4().hex
+
+
+def elle_request(encs, trace_ctx: Optional[Dict[str, Any]] = None,
+                 req: Optional[str] = None) -> bytes:
     """Build a ``POST /elle`` body from encoded graphs
     (:class:`jepsen_tpu.elle.encode.EncodedGraph`): per graph the
     uint8 relation-bit matrix plus its canonical filter profile.
     ``trace_ctx`` (obs.propagate) rides along so the daemon's spans
-    link back to the caller's trace."""
+    link back to the caller's trace; ``req`` is the idempotent
+    request id (:func:`request_id`) the daemon dedupes retries by."""
     body = {
         "graphs": [
             {
@@ -241,6 +253,8 @@ def elle_request(encs, trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
     }
     if trace_ctx:
         body["trace_ctx"] = dict(trace_ctx)
+    if req:
+        body["req"] = req
     return encode_body(body)
 
 
@@ -299,12 +313,17 @@ def elle_results_from_wire(items, encs) -> list:
 
 
 def check_request(model, histories, opts: Optional[Dict[str, Any]] = None,
-                  trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
+                  trace_ctx: Optional[Dict[str, Any]] = None,
+                  req: Optional[str] = None) -> bytes:
     """Build a ``POST /check`` body; raises :class:`UnsupportedModel`
     when the model (or an opt) has no wire form.  ``trace_ctx``
     (obs.propagate ``{"trace_id", "parent_sid"}``) is optional and
     never affects verdicts: it only tags the daemon-side spans so one
-    service-routed run exports one stitched Chrome trace."""
+    service-routed run exports one stitched Chrome trace.  ``req`` is
+    the idempotent request id (:func:`request_id`): a retried request
+    carries the same id, so the daemon can answer from its completed-
+    response cache or resume the request's verdict-WAL rows instead of
+    double-counting the work."""
     wire_opts = {}
     for k, v in (opts or {}).items():
         if k not in CHECK_OPTS:
@@ -319,4 +338,6 @@ def check_request(model, histories, opts: Optional[Dict[str, Any]] = None,
     }
     if trace_ctx:
         body["trace_ctx"] = dict(trace_ctx)
+    if req:
+        body["req"] = req
     return encode_body(body)

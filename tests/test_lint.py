@@ -741,7 +741,7 @@ def test_all_rules_inventory():
                      "concurrency-lock-missing",
                      "seam-frame-drift", "seam-journal-schema",
                      "seam-calibration-params", "seam-env-read",
-                     "seam-env-doc",
+                     "seam-env-doc", "net-timeout",
                      "budget-direct-dispatch", "budget-missing-cap"):
         assert expected in rules
 
@@ -1533,3 +1533,88 @@ def test_budget_cross_module_builder_resolution(tmp_path):
         """,
     })
     assert rules_of(res) == ["budget-direct-dispatch"]
+
+
+# ---------------------------------------------------------------------------
+# net-timeout (serve/ + control/ blocking-call discipline)
+# ---------------------------------------------------------------------------
+
+
+NET_TIMEOUT_BAD = """
+    import socket
+    import subprocess
+    import urllib.request
+
+    def fetch(url):
+        return urllib.request.urlopen(url).read()
+
+    def connect(host, port):
+        return socket.create_connection((host, port))
+
+    def push(argv):
+        subprocess.run(argv, check=True)
+
+    def reap(proc):
+        proc.wait()
+
+    def serve(server):
+        server.serve_forever()
+"""
+
+
+def test_net_timeout_positive_on_both_seams(tmp_path):
+    """Every unbounded blocking idiom fires, in serve/ and control/
+    alike: urlopen, create_connection, the subprocess entry points,
+    argless .wait(), and serve_forever (always — sanctioned accept
+    loops must carry the annotation)."""
+    res = run_lint(tmp_path, {"serve/conn.py": NET_TIMEOUT_BAD},
+                   rules=["net-timeout"])
+    assert rules_of(res) == ["net-timeout"] * 5
+    res2 = run_lint(tmp_path, {"control/push.py": NET_TIMEOUT_BAD},
+                    rules=["net-timeout"], subdir="ctl")
+    assert rules_of(res2) == ["net-timeout"] * 5
+
+
+def test_net_timeout_scope_is_the_network_seams_only(tmp_path):
+    """The same code outside serve/ and control/ is out of scope —
+    engine-internal waits are the concurrency pass's business."""
+    res = run_lint(tmp_path, {"engine/conn.py": NET_TIMEOUT_BAD},
+                   rules=["net-timeout"])
+    assert res.findings == []
+
+
+def test_net_timeout_bounded_calls_pass(tmp_path):
+    res = run_lint(tmp_path, {"serve/conn.py": """
+        import socket
+        import subprocess
+        import urllib.request
+
+        def fetch(url, kw):
+            urllib.request.urlopen(url, timeout=5).read()
+            return urllib.request.urlopen(url, **kw).read()
+
+        def connect(host, port):
+            return socket.create_connection((host, port), 3.0)
+
+        def push(argv):
+            subprocess.run(argv, check=True, timeout=30)
+
+        def reap(proc, ready):
+            proc.wait(timeout=10)
+            ready.wait(0.5)
+    """}, rules=["net-timeout"])
+    assert res.findings == []
+
+
+def test_net_timeout_suppressed(tmp_path):
+    """Sanctioned indefinite waits carry the annotation, on the line
+    or standalone above it."""
+    res = run_lint(tmp_path, {"serve/loop.py": """
+        def serve(server):
+            server.serve_forever()  # jt: allow[net-timeout] — the accept loop IS the process
+
+        def hold(ready):
+            # jt: allow[net-timeout] — own device thread signals after warmup
+            ready.wait()
+    """}, rules=["net-timeout"])
+    assert res.findings == []

@@ -142,3 +142,137 @@ def test_journal_rows_reads_back_as_cost_evidence(tmp_path):
     assert all(e["corpus"] == "journal" for e in ev)
     assert ev[1]["coalesced"] == 2
     assert calibrate.journal_rows(path, kernel="frontier") == []
+
+
+# ---------------------------------------------------------------------------
+# rotation under a torn tail (crash mid-append just before rotation)
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_survives_truncated_final_line(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal.DispatchJournal(path, max_bytes=600)
+    for i in range(6):
+        assert j.emit(**_row(rows=i)) is not None
+    # kill -9 mid-append: the final line is cut mid-JSON, no newline
+    with open(path, "rb+") as f:
+        data = f.read()
+        f.seek(0)
+        f.truncate()
+        f.write(data[: len(data) - len(data.rpartition(b"\n")[2]) - 9])
+    # a fresh writer keeps emitting over the damaged file, through a
+    # rotation — the torn line must cost one row, never the corpus
+    j2 = journal.DispatchJournal(path, max_bytes=600)
+    for i in range(6, 12):
+        assert j2.emit(**_row(rows=i)) is not None
+    rows = list(journal.read_rows(path))
+    got = [r["rows"] for r in rows]
+    assert got == sorted(got)
+    assert set(range(6, 12)) <= set(got)  # nothing new was lost
+    assert 4 not in got or 5 not in got  # the torn row itself is gone
+
+
+# ---------------------------------------------------------------------------
+# verdict write-ahead log (crash-safe resumable verdicts)
+# ---------------------------------------------------------------------------
+
+
+def _verdict(i=0, valid=True):
+    return {"valid": valid, "op_count": 10 + i}
+
+
+def test_validate_verdict_row_pins_schema():
+    good = {"v": journal.WAL_SCHEMA_VERSION, "ts": 1.0, "req": "r1",
+            "stream": "main", "idx": 0, "result": _verdict()}
+    assert journal.validate_verdict_row(good) is True
+    for breakage in (
+        {"v": 2},                  # unknown schema version
+        {"req": 7},                # wrong type
+        {"idx": "0"},              # stringly-typed int
+        {"idx": True},             # bool is not an int here
+        {"result": [1, 2]},        # result is a dict
+        {"surprise": 1},           # extras are drift too
+    ):
+        assert journal.validate_verdict_row(dict(good, **breakage)) \
+            is False, breakage
+    missing = dict(good)
+    del missing["stream"]
+    assert journal.validate_verdict_row(missing) is False
+    assert journal.validate_verdict_row("not a dict") is False
+
+
+def test_wal_append_read_round_trip(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    assert wal.append("r1", "main", 0, _verdict(0)) is not None
+    assert wal.append("r1", "main", 1, _verdict(1)) is not None
+    assert wal.written == 2 and wal.dropped == 0
+    rows = journal.read_verdict_rows(path)
+    assert [(r["req"], r["stream"], r["idx"]) for r in rows] == [
+        ("r1", "main", 0), ("r1", "main", 1)]
+    assert all(journal.validate_verdict_row(r) for r in rows)
+    assert rows[0]["result"] == _verdict(0)
+
+
+def test_wal_read_skips_damaged_lines(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    wal.append("r1", "main", 0, _verdict(0))
+    with open(path, "a") as f:
+        f.write("{torn json\n")
+        f.write(json.dumps({"v": 1, "ts": 1.0}) + "\n")  # schema-bad
+    wal.append("r1", "main", 1, _verdict(1))
+    assert [r["idx"] for r in journal.read_verdict_rows(path)] == [0, 1]
+
+
+def test_wal_tail_repair_prevents_append_cascade(tmp_path):
+    """A torn tail without a newline must cost ONE row: a new writer's
+    first append must not concatenate onto the fragment (which would
+    corrupt both lines on read-back)."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    wal.append("r1", "main", 0, _verdict(0))
+    with open(path, "a") as f:
+        f.write('{"v": 1, "ts": 2.0, "req": "r1", "str')  # kill -9 here
+    wal2 = journal.VerdictWAL(path)  # reopen seals the torn tail
+    wal2.append("r1", "main", 2, _verdict(2))
+    assert [r["idx"] for r in journal.read_verdict_rows(path)] == [0, 2]
+
+
+def test_wal_replay_index_groups_by_request(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    wal.append("r1", "main", 0, _verdict(0))
+    wal.append("r1", "sub", 0, _verdict(1))
+    wal.append("r2", "main", 0, _verdict(2))
+    wal.append("r1", "main", 0, _verdict(9))  # retried settle: last wins
+    idx = journal.replay_index(path)
+    assert set(idx) == {"r1", "r2"}
+    assert idx["r1"][("main", 0)] == _verdict(9)
+    assert idx["r1"][("sub", 0)] == _verdict(1)
+    assert idx["r2"] == {("main", 0): _verdict(2)}
+    assert journal.replay_index(str(tmp_path / "absent.jsonl")) == {}
+
+
+def test_wal_compact_keeps_only_named_requests(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    for i in range(3):
+        wal.append("old", "main", i, _verdict(i))
+    wal.append("live", "main", 0, _verdict(7))
+    with open(path, "a") as f:
+        f.write("{torn\n")
+    assert wal.compact(keep_reqs={"live"}) == 1
+    rows = journal.read_verdict_rows(path)
+    assert [(r["req"], r["idx"]) for r in rows] == [("live", 0)]
+    assert not (tmp_path / "wal.jsonl.tmp").exists()
+
+
+def test_wal_sink_binds_one_request_id(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    sink = wal.sink_for("req-abc")
+    sink("main", 3, _verdict(3))
+    rows = journal.read_verdict_rows(path)
+    assert [(r["req"], r["stream"], r["idx"]) for r in rows] == [
+        ("req-abc", "main", 3)]

@@ -10,7 +10,9 @@ falls back in-process transparently).
 """
 
 import random
+import subprocess
 import threading
+import time
 
 import pytest
 
@@ -682,3 +684,286 @@ def test_render_prom_matches_file_dump(tmp_path):
     assert obs_export.validate_prometheus_text(text) is None
     assert "jepsen_serve_requests_total 3" in text
     obs.enable(reset=True)
+
+
+# ---------------------------------------------------------------------------
+# client resilience: retry / deadline / circuit breaker (the nemesis
+# turned on the checker — doc/checker-service.md "Failure modes &
+# recovery"; the full kill/stall/drop matrix lives in serve/chaos.py)
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    br = serve_client.CircuitBreaker(failures=2, cooldown_s=0.05)
+    assert br.state() == "closed" and br.allow()
+    assert br.record_failure() is False
+    assert br.state() == "closed"  # one short of the trip
+    assert br.record_failure() is True  # this one trips it open
+    assert br.state() == "open" and br.trips == 1
+    # open within the cooldown: fast-fail, the probe is never run
+    assert br.allow(lambda: 1 / 0) is False
+    time.sleep(0.06)
+    assert br.state() == "half-open"
+    # half-open probe fails: re-opens for another cooldown
+    assert br.allow(lambda: False) is False
+    assert br.state() == "open" and br.probes == 1
+    time.sleep(0.06)
+    # half-open probe succeeds: closes and clears the failure count
+    assert br.allow(lambda: True) is True
+    assert br.state() == "closed" and br.probes == 2
+    # a success between failures resets the consecutive count
+    assert br.record_failure() is False
+    br.record_success()
+    assert br.record_failure() is False
+    assert br.state() == "closed"
+
+
+def test_breaker_trips_to_in_process_and_fast_fails(monkeypatch):
+    """Consecutive connection failures trip the shared per-address
+    breaker; while open, posts fast-fail without touching the socket,
+    and the transparent seam above it still answers in-process."""
+    monkeypatch.setenv("JEPSEN_TPU_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("JEPSEN_TPU_BREAKER_COOLDOWN", "60")
+    monkeypatch.setenv("JEPSEN_TPU_CLIENT_RETRIES", "0")
+    monkeypatch.delenv("JEPSEN_TPU_SERVICE", raising=False)
+    serve_client.reset_breakers()
+    client = _dead_port_client()
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=17, n=3, wide=False)
+    body = protocol.check_request(model, hists, {"slot_cap": 32})
+    try:
+        for _ in range(2):
+            with pytest.raises(serve_client.ServiceUnavailable):
+                client._resilient_post("/check", body)
+        br = serve_client.breaker_for(client.host, client.port)
+        assert br.state() == "open" and br.trips == 1
+        with pytest.raises(serve_client.ServiceUnavailable,
+                           match="circuit open"):
+            client._resilient_post("/check", body)
+        # the seam above the breaker: verdicts still arrive in-process
+        got = serve_client.check_batch(model, hists, client=client,
+                                       slot_cap=32)
+        expected = wgl.check_batch(model, hists, slot_cap=32)
+        assert [sig(r) for r in got] == [sig(r) for r in expected]
+    finally:
+        serve_client.reset_breakers()
+
+
+def test_breaker_half_open_probe_recovers_against_live_daemon(
+        monkeypatch):
+    """After the cooldown a tripped breaker goes half-open: the next
+    post runs one /healthz probe, which closes the breaker and lets
+    the request through to a recovered daemon."""
+    monkeypatch.setenv("JEPSEN_TPU_BREAKER_FAILURES", "1")
+    monkeypatch.setenv("JEPSEN_TPU_BREAKER_COOLDOWN", "0.2")
+    monkeypatch.setenv("JEPSEN_TPU_CLIENT_RETRIES", "0")
+    serve_client.reset_breakers()
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=19, n=3, wide=False)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        br = serve_client.breaker_for(client.host, client.port)
+        assert br.record_failure() is True and br.state() == "open"
+        body = protocol.check_request(model, hists, {"slot_cap": 32})
+        with pytest.raises(serve_client.ServiceUnavailable,
+                           match="circuit open"):
+            client._resilient_post("/check", body)
+        time.sleep(0.25)
+        assert br.state() == "half-open"
+        code, resp = client._resilient_post("/check", body)
+        assert code == 200
+        assert br.state() == "closed" and br.probes == 1
+        assert "results" in protocol.decode_body(resp)
+    finally:
+        daemon.stop()
+        serve_client.reset_breakers()
+
+
+def test_client_deadline_budget_is_a_hard_bound(monkeypatch):
+    """The whole resilient post — attempts plus backoff sleeps — is
+    bounded by JEPSEN_TPU_CLIENT_DEADLINE, and exhaustion is counted
+    in the caller's registry."""
+    monkeypatch.setenv("JEPSEN_TPU_CLIENT_DEADLINE", "1e-9")
+    serve_client.reset_breakers()
+    obs.enable(reset=True)
+    client = _dead_port_client()
+    t0 = time.monotonic()
+    with pytest.raises(serve_client.ServiceUnavailable,
+                       match="deadline budget"):
+        client._resilient_post("/check", b"{}")
+    assert time.monotonic() - t0 < 5.0
+    assert "jepsen_client_deadline_exhausted_total" in obs.render_prom()
+    obs.enable(reset=True)
+    serve_client.reset_breakers()
+
+
+def test_request_id_dedup_answers_retry_from_cache():
+    """A retried POST /check carrying the same idempotent request id
+    is answered from the completed-response cache: byte-identical
+    payload, and the work is never admitted (or counted) twice."""
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=29, n=3, wide=False)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        body = protocol.check_request(
+            model, hists, {"slot_cap": 32}, req="retry-dup-1")
+        code1, resp1 = client._resilient_post("/check", body)
+        before = daemon.status()
+        code2, resp2 = client._resilient_post("/check", body)
+        after = daemon.status()
+        assert code1 == code2 == 200
+        assert resp1 == resp2
+        assert after["deduped"] == before["deduped"] + 1
+        assert after["requests"] == before["requests"]
+        assert after["histories"] == before["histories"]
+    finally:
+        daemon.stop()
+
+
+def test_reap_escalates_sigterm_to_sigkill_and_never_raises():
+    """spawn_daemon's child-reaping satellite: SIGTERM → bounded wait
+    → SIGKILL → bounded wait, and even a child stuck past SIGKILL
+    must not leak TimeoutExpired into the caller's error path."""
+
+    class _StuckProc:
+        def __init__(self, dies_on_kill=True):
+            self.calls = []
+            self._dies_on_kill = dies_on_kill
+
+        def terminate(self):
+            self.calls.append("terminate")
+
+        def kill(self):
+            self.calls.append("kill")
+
+        def wait(self, timeout=None):
+            self.calls.append("wait")
+            if "kill" in self.calls and self._dies_on_kill:
+                return 0
+            raise subprocess.TimeoutExpired(cmd="daemon",
+                                            timeout=timeout)
+
+    p = _StuckProc()
+    serve_client._reap(p, grace_s=0.01)
+    assert p.calls == ["terminate", "wait", "kill", "wait"]
+
+    p2 = _StuckProc(dies_on_kill=False)
+    serve_client._reap(p2, grace_s=0.01)
+    assert p2.calls == ["terminate", "wait", "kill", "wait"]
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: device faults quarantine routes, reset()
+# recovers the executor (windows 1 and 4)
+# ---------------------------------------------------------------------------
+
+
+def test_device_fault_quarantines_route_to_oracle(monkeypatch):
+    """A device fault on a (kernel, E, C) route must not fail the
+    batch: the route is quarantined to the CPU oracle, /status lists
+    it with the error that tripped it, the quarantine metrics appear,
+    and a second batch on the same routes skips the device outright."""
+    from jepsen_tpu.engine import execution
+
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=7, n=3, wide=False)
+    expected = wgl.check_batch(model, hists, slot_cap=32)
+
+    def exploding_submit(self, pb):
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr(execution.Executor, "submit", exploding_submit)
+    obs.enable(reset=True)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        got = client.check_batch(model, hists, slot_cap=32)
+        # oracle-routed rows carry their own engine tag; the verdicts
+        # themselves must be unchanged
+        assert [r.get("valid?") for r in got] == [
+            r.get("valid?") for r in expected]
+        st = daemon.status()
+        assert st["quarantine"], "route should be quarantined"
+        assert all(q["route"] and q["error"] for q in st["quarantine"])
+        assert st["quarantined_rows"] > 0
+        assert st["errors"] == 0  # degraded, never failed
+        text = client.metrics_text()
+        assert "jepsen_serve_quarantine_total" in text
+        assert "jepsen_serve_quarantined_routes" in text
+        n_routes = len(st["quarantine"])
+        got2 = client.check_batch(model, hists, slot_cap=32)
+        assert [r.get("valid?") for r in got2] == [
+            r.get("valid?") for r in expected]
+        assert len(daemon.status()["quarantine"]) == n_routes
+    finally:
+        daemon.stop()
+        obs.enable(reset=True)
+
+
+@pytest.mark.parametrize("window", [1, 4])
+def test_reset_recovers_from_mid_dispatch_device_fault(
+        window, monkeypatch):
+    """A device fault surfacing mid-dispatch — with earlier dispatches
+    retired (window=1) or still in flight (window=4) — must leave the
+    executor recoverable: reset() abandons the poisoned window entries,
+    chunk map and parked escalations, and the SAME executor then
+    produces clean verdicts for the next batch."""
+    model = m.cas_register(0)
+    hists = mixed_corpus(seed=33, n=6, wide=False)
+    expected = wgl.check_batch(model, hists, slot_cap=32)
+
+    def run_through(ex):
+        ctx = RunContext(model, hists)
+        planner = Planner(model, spec=ctx.spec, slot_cap=32,
+                          frontier=wgl.DEFAULT_FRONTIER, bucketed=True)
+        buckets, order = planner.encode_buckets(ctx)
+        for k in order:
+            pb = planner.plan_rows(k, *buckets[k])
+            if pb is not None:
+                ex.submit(pb)
+        ex.drain()
+        ctx.drain_oracles()
+        return ctx
+
+    real = wgl._run_rows
+    calls = {"n": 0}
+
+    def counting(fn, mesh, arrays):
+        calls["n"] += 1
+        return real(fn, mesh, arrays)
+
+    monkeypatch.setattr(wgl, "_run_rows", counting)
+    ctx = run_through(Executor(window))
+    assert [sig(r) for r in ctx.results] == [
+        sig(r) for r in expected]
+    total = calls["n"]
+    assert total >= 1
+
+    # fault the LAST dispatch of the identical (deterministic) replay:
+    # everything before it is retired or in flight when it surfaces
+    calls["n"] = 0
+
+    def flaky(fn, mesh, arrays):
+        calls["n"] += 1
+        if calls["n"] >= total:
+            raise RuntimeError("injected device fault")
+        return real(fn, mesh, arrays)
+
+    monkeypatch.setattr(wgl, "_run_rows", flaky)
+    ex = Executor(window)
+    with pytest.raises(RuntimeError, match="injected device fault"):
+        run_through(ex)
+    ex.reset()
+    assert ex._win.depth == 0
+    assert not ex._chunks and not ex._pending_escalations
+
+    # the SAME executor, next batch: clean verdicts, nothing leaked
+    monkeypatch.setattr(wgl, "_run_rows", real)
+    ctx3 = run_through(ex)
+    assert [sig(r) for r in ctx3.results] == [
+        sig(r) for r in expected]
