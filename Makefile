@@ -2,7 +2,7 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke docker-smoke docker-up docker-down
+.PHONY: test bench check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
@@ -11,7 +11,7 @@ test:
 # observability, pipeline, checker-service, slice-dispatch,
 # decomposition, auto-tune, transactional-screen, and closure/union
 # kernel smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke
+check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke
 
 # jtlint static analysis (doc/static-analysis.md): all seven passes —
 # trace-safety, lock-discipline, concurrency (whole-program race
@@ -67,6 +67,16 @@ serve-smoke:
 # accounted in client + daemon metrics.
 chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.chaos
+
+# online-checking gate (doc/checker-service.md "Online checking"): a
+# batch with injected violations fed incrementally through POST /feed
+# against an in-process daemon, a concurrent GET /watch subscriber —
+# the violation verdict must reach /watch BEFORE the feed closes, on
+# both kernel routes and at op granularity (the interpreter shipper's
+# wire shape), with close results byte-identical to the in-process
+# batch check and feed/watch telemetry live on /metrics
+online-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.online_smoke
 
 # slice-native dispatch gate (doc/checker-engines.md): the production
 # check_batch path sharded over a forced 8-virtual-device host mesh on

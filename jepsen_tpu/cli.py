@@ -508,24 +508,57 @@ def serve_cmd() -> Dict[str, dict]:
                       file=sys.stderr)
                 return EXIT_USAGE
 
-        def frame() -> str:
-            blocks = []
+        def tail_verdicts(c, st, limit: int = 8) -> list:
+            """Bounded tail of one daemon's verdict channel: replay
+            only the last ``limit`` WAL rows via ``Last-Event-ID``,
+            stop as soon as they've arrived (or the read times out)."""
+            rows: list = []
+            wal_rows = st.get("wal_rows") or 0
+            if not wal_rows:
+                return rows
+            try:
+                for off, row in c.watch(
+                        last_id=max(-1, wal_rows - limit - 1),
+                        timeout=1.0):
+                    rows.append((f"{c.host}:{c.port}", off, row))
+                    if off >= wal_rows - 1 or len(rows) >= limit:
+                        break
+            except (ServiceError, ServiceUnavailable, OSError):
+                pass
+            return rows
+
+        def frame():
+            """One rendered fleet frame + the per-address errors (an
+            entry per daemon that did not answer /status)."""
+            blocks, verdicts, errors = [], [], []
             for c in clients:
                 try:
-                    blocks.append(
-                        client_mod.format_top(c.host, c.port, c.status()))
-                except (ServiceError, ServiceUnavailable):
+                    st = c.status()
+                except (ServiceError, ServiceUnavailable) as e:
                     blocks.append(f"○ {c.host}:{c.port}  (unreachable)")
-            return "\n".join(blocks)
+                    errors.append((f"{c.host}:{c.port}", str(e)))
+                    continue
+                blocks.append(client_mod.format_top(c.host, c.port, st))
+                verdicts.extend(tail_verdicts(c, st))
+            verdicts.sort(key=lambda e: (e[2].get("ts") or 0, e[1]))
+            blocks.append(client_mod.format_verdicts(verdicts))
+            return "\n".join(blocks), errors
 
         if args.once:
-            print(frame())
+            text, errors = frame()
+            print(text)
+            if len(errors) == len(clients):
+                # every daemon unreachable: a monitoring script must
+                # see a nonzero exit, with the reason per address
+                for addr, err in errors:
+                    print(f"top: {addr}: {err}", file=sys.stderr)
+                return EXIT_UNKNOWN
             return EXIT_VALID
         try:
             while True:
                 # clear + home, then the frame: a refreshing view
                 # without curses (stdlib-only, like the web UI)
-                print("\x1b[2J\x1b[H" + frame(), flush=True)
+                print("\x1b[2J\x1b[H" + frame()[0], flush=True)
                 time_mod.sleep(max(0.1, args.interval))
         except KeyboardInterrupt:
             return EXIT_VALID
@@ -549,8 +582,9 @@ def serve_cmd() -> Dict[str, dict]:
         },
         "top": {
             "help": "live fleet view of one or more checker daemons "
-            "(last-60s rates, queue wait, journal; --once for one "
-            "frame)",
+            "(last-60s rates, queue wait, journal, settled verdicts; "
+            "--once for one frame, nonzero exit when no daemon "
+            "answers)",
             "add_opts": add_top_opts,
             "run": top,
         },

@@ -424,6 +424,22 @@ class DecomposedRun:
             self.main_ctx = RunContext(self.model, [], **self._kw)
             self._bind_sink("main", self.main_ctx)
 
+    def extend(self, histories: Sequence) -> List[Tuple[RunContext, int]]:
+        """Streaming-ingest seam (``POST /feed``): append ``histories``
+        to an already-constructed run and drive the restartable split
+        over JUST the new tail, returning the fresh ``(ctx, idx)``
+        planner rows — prior rows never re-split, re-encode, or
+        re-settle, so a feed session dispatches each delta the moment
+        it arrives.  Composes with :meth:`replay`: rows a previous
+        daemon life already settled (same request id) pre-fill on the
+        next replay call and skip encode entirely."""
+        self._ensure_fed()  # classify everything before the new tail
+        if not isinstance(self._histories, list):
+            self._histories = list(self._histories)
+        self._histories.extend(histories)
+        self.n = len(self._histories)
+        return list(self._split())
+
     def _ensure_fed(self) -> None:
         """Finish the split eagerly for consumers that need the whole
         picture (a lazy run whose feed was never driven — or was

@@ -49,6 +49,28 @@ _UNSET = object()
 _ROUTED_ORACLE = object()
 
 
+def _note_settle_times(result: dict) -> None:
+    """Record the run's time-to-first-verdict / time-to-violation
+    gauges (doc/observability.md "Online checking") the moment a slot
+    settles: seconds since the tracer's wall origin, written once — the
+    FIRST settle and the FIRST ``valid? = false`` verdict win.  This is
+    the summary seam the online monitor keys on (a violation at op 40k
+    should show a detect time near op 40k, not at run end)."""
+    from .. import obs
+
+    if not obs.enabled():
+        return
+    import time as _time
+
+    reg = obs.registry()
+    dt = _time.time() - obs.tracer().wall_origin
+    if reg.value("jepsen_run_first_verdict_seconds") is None:
+        obs.gauge_set("jepsen_run_first_verdict_seconds", round(dt, 6))
+    if (result.get("valid?") is False
+            and reg.value("jepsen_run_first_violation_seconds") is None):
+        obs.gauge_set("jepsen_run_first_violation_seconds", round(dt, 6))
+
+
 def default_bucketed() -> bool:
     """Shape bucketing default: on unless ``JEPSEN_TPU_ENGINE_BUCKETED``
     is falsy."""
@@ -151,6 +173,7 @@ class RunContext:
         if self.results[idx] is not None:
             return
         self.results[idx] = result
+        _note_settle_times(result)
         if self.on_settle is not None:
             self.on_settle(self, idx, result)
 
@@ -350,9 +373,19 @@ class Planner:
         contexts merge by key before a single stack+plan, so
         same-shape requests share compiled executables AND dispatch
         rows."""
+        return self.encode_rows(ctx, range(len(ctx.histories)))
+
+    def encode_rows(self, ctx: RunContext, idxs):
+        """:meth:`encode_buckets` restricted to the given indices —
+        the streaming-ingest delta path (``POST /feed``): a feed
+        append encodes ONLY the rows :meth:`DecomposedRun.extend
+        <jepsen_tpu.engine.decompose.DecomposedRun.extend>` just
+        created, so per-partition sub-histories bucket and dispatch as
+        operations complete instead of waiting for session close.
+        Settled (WAL-replayed) rows skip as everywhere else."""
         buckets: Dict[Any, Tuple[list, list]] = {}
         order: List[Any] = []
-        for idx in range(len(ctx.histories)):
+        for idx in idxs:
             self._accumulate(ctx, idx, buckets, order)
         return buckets, order
 

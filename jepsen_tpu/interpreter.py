@@ -29,6 +29,159 @@ from .util import relative_time_nanos
 #: (reference: interpreter.clj:166-170)
 MAX_PENDING_INTERVAL_US = 1000
 
+#: Live shipper: bounded buffer between the scheduler and the shipper
+#: thread; a full buffer drops (and counts) rather than blocking the
+#: workload (doc/checker-service.md "Online checking")
+LIVE_BUFFER_OPS = 4096
+#: Live shipper: max events shipped in one ``/feed`` append
+LIVE_BATCH_OPS = 64
+
+
+def live_enabled() -> bool:
+    """``JEPSEN_TPU_LIVE=1`` opts the interpreter into shipping history
+    events to the resident checker daemon as they land, so ``/watch``
+    subscribers see verdicts while the workload is still running."""
+    import os
+
+    return os.environ.get("JEPSEN_TPU_LIVE", "") == "1"
+
+
+class _LiveShipper:
+    """Ships history events to a daemon feed session as they land.
+
+    Contract with the workload: **never block, never fail.**
+    :meth:`offer` is a ``put_nowait`` off the scheduler loop — a full
+    buffer or a dead daemon drops events (counted as
+    ``jepsen_feed_drops_total``) instead of applying backpressure to op
+    timing, and every daemon error is swallowed after counting.  The
+    post-hoc checker stays the authority on the verdict either way;
+    the feed only buys earlier detection.
+
+    Ships BOTH invocations and completions, in history-append order:
+    the daemon's incremental probe needs the real concurrency
+    structure, and serializing inv/comp pairs would narrow
+    linearization windows into false violations.
+    """
+
+    #: consecutive append failures before the shipper gives up for the
+    #: rest of the run (the resilient client already retried each one)
+    MAX_STRIKES = 3
+
+    def __init__(self, model):
+        from .serve import client as serve_client
+
+        self._serve_client = serve_client
+        self._q = queue.Queue(maxsize=LIVE_BUFFER_OPS)
+        self._closing = threading.Event()
+        self._client = serve_client.ServiceClient(timeout=5.0)
+        self._model = model
+        self._session = None
+        self._dead = threading.Event()
+        self.final_results: Optional[list] = None
+        self._thread = threading.Thread(
+            target=self._run, name="jepsen-live-shipper", daemon=True
+        )
+        self._thread.start()
+
+    def offer(self, op: dict) -> None:
+        """Enqueue one history event (scheduler thread; never blocks)."""
+        if self._dead.is_set() or not isinstance(op.get("process"), int):
+            return  # nemesis/system events aren't model operations
+        import time as _time
+
+        try:
+            self._q.put_nowait((_time.time(), op))
+        except queue.Full:
+            obs.count("jepsen_feed_drops_total")
+
+    def close(self, wait_s: float = 10.0) -> None:
+        """Flush the buffer and close the feed session, bounded in time
+        — teardown must not hang on a wedged daemon."""
+        self._closing.set()
+        self._thread.join(timeout=wait_s)
+
+    # ── shipper thread ────────────────────────────────────────────
+
+    def _drain(self, max_n: int):
+        batch = []
+        while len(batch) < max_n:
+            try:
+                batch.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return batch
+
+    def _run(self):
+        import logging
+        import time as _time
+
+        log = logging.getLogger("jepsen_tpu.live")
+        try:
+            self._session = self._client.open_feed(self._model)
+        except Exception as e:
+            log.info("live feed disabled (no daemon session): %s", e)
+            self._dead.set()
+            return
+        strikes = 0
+        while True:
+            batch = self._drain(LIVE_BATCH_OPS)
+            if not batch:
+                if self._closing.is_set():
+                    break
+                _time.sleep(0.05)
+                continue
+            t_inv = min(t for t, _ in batch)
+            ops = [op for _, op in batch]
+            try:
+                self._session.append(ops=ops, t_inv=t_inv)
+                strikes = 0
+            except Exception:
+                # the resilient client already retried; this delta is
+                # lost to the feed (the post-hoc check still sees it)
+                obs.count("jepsen_feed_drops_total", len(ops))
+                strikes += 1
+                if strikes >= self.MAX_STRIKES:
+                    log.info(
+                        "live feed gave up after %d failed deltas",
+                        strikes)
+                    self._dead.set()
+                    return
+        try:
+            self.final_results = self._session.close()
+            if self.final_results:
+                log.info(
+                    "live feed closed: online verdict valid?=%s",
+                    self.final_results[-1].get("valid?"))
+        except Exception as e:
+            log.info("live feed close failed: %s", e)
+        finally:
+            self._dead.set()
+
+
+def _live_model(test: dict):
+    """The model the live feed probes against: an explicit
+    ``test["model"]`` wins, else the checker's (the linearizable
+    checker carries one).  None → live shipping stays off."""
+    model = test.get("model")
+    if model is None:
+        model = getattr(test.get("checker"), "model", None)
+    return model
+
+
+def _make_shipper(test: dict) -> Optional[_LiveShipper]:
+    if not live_enabled():
+        return None
+    model = _live_model(test)
+    if model is None:
+        return None
+    try:
+        from .serve import protocol
+
+        protocol.model_to_wire(model)  # no wire form → nothing to feed
+    except Exception:
+        return None
+    return _LiveShipper(model)
+
 
 class ClientWorker:
     """Wraps a client, reopening it when its process changes (unless the
@@ -184,6 +337,10 @@ def run(test: dict) -> History:
     outstanding = 0
     poll_timeout_us = 0
     history: List[dict] = []
+    # online checking: opt-in shipper feeding the checker daemon a
+    # live copy of the history (JEPSEN_TPU_LIVE=1); never blocks the
+    # scheduler, never fails the run
+    shipper = _make_shipper(test)
 
     try:
         while True:
@@ -217,6 +374,8 @@ def run(test: dict) -> History:
                     ctx = {**ctx, "workers": workers_map}
                 if goes_in_history(op_done):
                     history.append(op_done)
+                    if shipper is not None:
+                        shipper.offer(op_done)
                 outstanding -= 1
                 poll_timeout_us = 0
                 continue
@@ -233,6 +392,8 @@ def run(test: dict) -> History:
                     q.put({"type": "exit"})
                 for w in workers:
                     w.thread.join(timeout=10)
+                if shipper is not None:
+                    shipper.close()
                 return _to_history(history)
 
             op, g2 = res
@@ -257,6 +418,8 @@ def run(test: dict) -> History:
             g2 = gen.update(g2, test, ctx, op)
             if goes_in_history(op):
                 history.append(op)
+                if shipper is not None:
+                    shipper.offer(op)
             g = g2
             outstanding += 1
             poll_timeout_us = 0
@@ -267,6 +430,9 @@ def run(test: dict) -> History:
         # are daemon threads as a last resort)
         import time as _time
 
+        if shipper is not None:
+            # bounded; the abort cause below must not wait on a daemon
+            shipper.close(wait_s=2.0)
         deadline = _time.monotonic() + 10.0
         pending = list(workers)
         while pending and _time.monotonic() < deadline:

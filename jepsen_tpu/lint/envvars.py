@@ -97,6 +97,12 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "serve/daemon.py",
            "dispatch-journal path for the `serve()` production entry; "
            "falsy disables"),
+    EnvVar("JEPSEN_TPU_LIVE", "unset",
+           "interpreter.py",
+           "`1` ships history events to the checker daemon as they "
+           "land (online checking); never blocks or fails the "
+           "workload — a full buffer drops and counts.  Requires a "
+           "test-level wire model; keyed workloads stay post-hoc"),
     EnvVar("JEPSEN_TPU_OBS", "1",
            "obs/__init__.py",
            "observability master switch; `0` disables span + metric "
@@ -151,6 +157,10 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "verdict write-ahead-log path for the `serve()` production "
            "entry; settled verdicts survive kill -9 and replay into "
            "retried request ids; falsy disables"),
+    EnvVar("JEPSEN_TPU_WAL_COMPACT_BYTES", "33554432",
+           "serve/daemon.py",
+           "WAL size past which the daemon compacts away completed "
+           "runs' rows during idle turns; `0` disables"),
 )
 
 

@@ -291,6 +291,15 @@ def summary(tracer: Tracer, registry: MetricsRegistry) -> dict:
     occ = registry.value("jepsen_engine_occupancy_ratio")
     if occ is not None:
         out["engine-occupancy"] = round(occ, 4)
+    # online-checking latency: seconds from the run's wall origin to
+    # the first settled verdict / first violation verdict (the gauges
+    # set once by engine.planning as partitions settle)
+    ttfv = registry.value("jepsen_run_first_verdict_seconds")
+    if ttfv is not None:
+        out["time-to-first-verdict"] = round(ttfv, 4)
+    ttv = registry.value("jepsen_run_first_violation_seconds")
+    if ttv is not None:
+        out["time-to-violation"] = round(ttv, 4)
     return out
 
 
@@ -333,6 +342,11 @@ def format_summary(s: dict) -> str:
         if s.get("engine-occupancy") is not None:
             pipe += f", occupancy: {s['engine-occupancy']:.0%}"
         extras.append(pipe)
+    if s.get("time-to-first-verdict") is not None:
+        online = f"first verdict: {s['time-to-first-verdict']:.3f}s"
+        if s.get("time-to-violation") is not None:
+            online += f", first violation: {s['time-to-violation']:.3f}s"
+        extras.append(online)
     if s.get("spans-dropped"):
         extras.append(f"spans dropped: {s['spans-dropped']}")
     if extras:

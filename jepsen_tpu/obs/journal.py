@@ -173,6 +173,61 @@ class DispatchJournal:
                 if os.path.exists(p)]
 
 
+# -- tail-follow (the ONE journal row reader) ------------------------------
+
+
+def _decode_line(line, validate) -> tuple:
+    """One JSONL line → ``(row, why)``: the validated dict or None, and
+    ``"ok" | "blank" | "json" | "schema"``.  The single damage-skip
+    decision every journal reader shares — :func:`read_rows` (dispatch
+    journal → ``tune.calibrate.journal_rows``),
+    :func:`read_verdict_rows` (WAL replay), and the live ``/watch``
+    tailer (:class:`WalTail`) — so a half-written tail line from a
+    crashed daemon is skipped identically everywhere."""
+    if isinstance(line, bytes):
+        try:
+            line = line.decode("utf-8")
+        except UnicodeDecodeError:
+            return None, "json"
+    line = line.strip()
+    if not line:
+        return None, "blank"
+    try:
+        row = json.loads(line)
+    except ValueError:
+        return None, "json"
+    if not validate(row):
+        return None, "schema"
+    return row, "ok"
+
+
+def follow_rows(paths, validate, *, start: int = 0,
+                strict: bool = False) -> Iterator[tuple]:
+    """THE journal tail-follow reader: yield ``(offset, row)`` for every
+    valid row across ``paths`` in order.  ``offset`` numbers valid rows
+    from 0 — damaged lines are skipped and consume no offset, so an
+    offset is a stable resume cursor even over a file with torn lines.
+    ``start`` skips rows below that offset (the replay half of the
+    ``/watch`` ``Last-Event-ID`` contract); ``strict`` raises on the
+    first damaged line instead of skipping."""
+    offset = 0
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        with open(p, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                row, why = _decode_line(line, validate)
+                if row is None:
+                    if strict and why == "json":
+                        raise ValueError(f"{p}:{lineno}: bad JSON")
+                    if strict and why == "schema":
+                        raise ValueError(f"{p}:{lineno}: schema violation")
+                    continue
+                if offset >= start:
+                    yield offset, row
+                offset += 1
+
+
 def read_rows(path: str, *, strict: bool = False) -> Iterator[Dict[str, Any]]:
     """Yield valid rows from a journal path (rotated ``.1`` first).
 
@@ -180,24 +235,9 @@ def read_rows(path: str, *, strict: bool = False) -> Iterator[Dict[str, Any]]:
     a half-written tail line from a crashed daemon must not poison the
     whole corpus.
     """
-    for p in (path + ".1", path):
-        if not os.path.exists(p):
-            continue
-        with open(p, "r", encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    row = json.loads(line)
-                except ValueError:
-                    if strict:
-                        raise ValueError(f"{p}:{lineno}: bad JSON")
-                    continue
-                if validate_row(row):
-                    yield row
-                elif strict:
-                    raise ValueError(f"{p}:{lineno}: schema violation")
+    for _offset, row in follow_rows((path + ".1", path), validate_row,
+                                    strict=strict):
+        yield row
 
 
 # -- verdict write-ahead log ----------------------------------------------
@@ -343,21 +383,8 @@ def read_verdict_rows(path: str) -> List[Dict[str, Any]]:
     Damaged lines — the half-written tail of a killed daemon — are
     skipped: prior rows must survive a torn final append.
     """
-    rows: List[Dict[str, Any]] = []
-    if not os.path.exists(path):
-        return rows
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                row = json.loads(line)
-            except ValueError:
-                continue
-            if validate_verdict_row(row):
-                rows.append(row)
-    return rows
+    return [row for _offset, row
+            in follow_rows((path,), validate_verdict_row)]
 
 
 def replay_index(path: str) -> Dict[str, Dict[tuple, Dict[str, Any]]]:
@@ -371,6 +398,76 @@ def replay_index(path: str) -> Dict[str, Dict[tuple, Dict[str, Any]]]:
         index.setdefault(row["req"], {})[(row["stream"], row["idx"])] = (
             row["result"])
     return index
+
+
+class WalTail:
+    """Incremental follower over a verdict WAL — the live half of the
+    tail-follow contract behind the daemon's ``/watch`` channel.
+
+    ``poll()`` returns the ``(offset, row)`` pairs appended since the
+    last poll, with the same valid-row offsets :func:`follow_rows`
+    assigns (damaged lines consume no offset) and the same damage-skip
+    decision (:func:`_decode_line`).  Differences forced by liveness:
+
+    - an in-progress tail line without its newline is left *pending*
+      (the writer appends line+newline in one write, so a complete row
+      always arrives with its terminator; a torn line never completes
+      and is sealed + skipped after the writer's ``_repair_tail``);
+    - a rewrite of the file (``compact()``'s atomic rename, detected by
+      inode change or shrink) restarts the follower at offset 0 of the
+      new file — retained rows are re-delivered, which is safe because
+      verdict settlement is monotone and rows carry their full
+      ``(req, stream, idx)`` identity.
+
+    ``start`` resumes past already-consumed offsets (``Last-Event-ID``
+    + 1): rows below it are read but not returned.
+    """
+
+    def __init__(self, path: str, *, start: int = 0):
+        self.path = path
+        self._skip = max(0, int(start))
+        self._pos = 0     # byte offset after the last complete line read
+        self._count = 0   # valid rows consumed so far (= next offset)
+        self._sig = None  # (st_dev, st_ino) identity of the followed file
+
+    def poll(self) -> List[tuple]:
+        """Newly appended ``(offset, row)`` pairs since the last poll
+        (empty when nothing new, the file is absent, or only a torn
+        in-progress tail arrived)."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return []
+        sig = (st.st_dev, st.st_ino)
+        if self._sig is not None and (sig != self._sig
+                                      or st.st_size < self._pos):
+            # compacted (atomic-rename rewrite) or truncated: restart
+            # from the top of the replacement file
+            self._pos = 0
+            self._count = 0
+            self._skip = 0
+        self._sig = sig
+        if st.st_size <= self._pos:
+            return []
+        out: List[tuple] = []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._pos)
+                while True:
+                    line = f.readline()
+                    if not line or not line.endswith(b"\n"):
+                        break  # torn in-progress tail: wait for newline
+                    self._pos = f.tell()
+                    row, _why = _decode_line(line, validate_verdict_row)
+                    if row is None:
+                        continue  # damage skipped, consumes no offset
+                    offset = self._count
+                    self._count += 1
+                    if offset >= self._skip:
+                        out.append((offset, row))
+        except OSError:
+            return out
+        return out
 
 
 # -- module singleton (no-op until configured) ----------------------------

@@ -30,6 +30,16 @@ doc/checker-service.md "Failure modes & recovery":
    completed-response cache (``deduped`` + 1), and the request
    counters advance by exactly ONE — retried work is never
    double-counted.
+4. **WAL auto-compaction + crash during compaction**: a daemon with a
+   1-byte ``JEPSEN_TPU_WAL_COMPACT_BYTES`` threshold compacts its
+   verdict WAL on the first idle turn (counted in
+   ``jepsen_serve_wal_compactions_total``), keeping exactly the
+   completed request's rows and leaving no ``.tmp`` behind.  A kill -9
+   that leaves a half-written ``<wal>.tmp`` next to the intact WAL —
+   the crash-during-compaction worst case, since ``compact()`` only
+   renames after fsync — must not confuse the restart: the retried
+   request id replays every settled row with zero re-dispatches and
+   byte-identical results.
 
 Every injected fault is accounted for in metrics: client retries,
 breaker trips and probes (this process's registry), WAL replays and
@@ -440,6 +450,70 @@ def main(argv=None) -> int:
                          "jepsen_serve_request_dedup_total") or 0) >= 1,
           "jepsen_serve_request_dedup_total does not account the dedup")
 
+    # == scenario 4: WAL auto-compaction + crash during compaction ==
+    from jepsen_tpu.obs import journal as obs_journal
+
+    os.environ["JEPSEN_TPU_WAL_COMPACT_BYTES"] = "1"
+    tmp2 = tempfile.mkdtemp(prefix="jepsen-chaos-compact-")
+    wal2 = os.path.join(tmp2, "verdict-wal.jsonl")
+    port2 = free_port()
+    client_mod.reset_breakers()
+    proc2 = _spawn_daemon(port2, tmp2)
+    client2 = ServiceClient(port=port2)
+    check(_wait_healthy(client2, proc2), "daemon C did not come up")
+    code, payload = _post_check(
+        client2, model, batch, configs["dense"], "chaos-compact")
+    check(code == 200, f"compaction-prep check returned {code}")
+    settled_c = (payload.get("diag") or {}).get("settled", 0)
+    check(settled_c > 0, "compaction prep settled nothing")
+    # the device thread compacts on its next idle turn (~1 s quiet)
+    st = {}
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = client2.status()
+        if st.get("wal_compactions", 0) >= 1:
+            break
+        time.sleep(0.2)
+    check(st.get("wal_compactions", 0) >= 1,
+          "idle daemon never compacted a WAL past the 1-byte threshold")
+    check((_metric_value(client2.metrics_text(),
+                         "jepsen_serve_wal_compactions_total") or 0) >= 1,
+          "jepsen_serve_wal_compactions_total does not account it")
+    check(not os.path.exists(wal2 + ".tmp"),
+          "compaction left its .tmp behind")
+    kept = list(obs_journal.read_verdict_rows(wal2))
+    check(len(kept) == settled_c
+          and all(r.get("req") == "chaos-compact" for r in kept),
+          f"compacted WAL diverged ({len(kept)} rows, "
+          f"wanted {settled_c} × chaos-compact)")
+    # crash "mid-compaction": kill -9, then plant the half-written
+    # .tmp a real crash would leave beside the intact (renamed-over or
+    # original) WAL — the restart must ignore it and replay cleanly
+    _sigkill(proc2)
+    with open(wal2 + ".tmp", "w") as f:
+        f.write('{"v": 1, "req": "chaos-compact", "stream": "ma')
+    proc2 = _spawn_daemon(port2, tmp2)
+    check(_wait_healthy(client2, proc2),
+          "daemon C2 did not come up beside a stale compaction .tmp")
+    code, payload = _post_check(
+        client2, model, batch, configs["dense"], "chaos-compact")
+    diag = payload.get("diag") or {}
+    check(code == 200 and _canon(payload.get("results") or [])
+          == expected["dense"],
+          "post-compaction replay diverged from in-process")
+    check(diag.get("replayed") == settled_c,
+          f"compacted WAL replayed {diag.get('replayed')} of "
+          f"{settled_c} settled rows")
+    check(diag.get("cold_dispatches", 0) == 0
+          and diag.get("warm_dispatches", 0) == 0,
+          "fully-compacted-and-replayed request re-dispatched")
+    os.environ.pop("JEPSEN_TPU_WAL_COMPACT_BYTES", None)
+    try:
+        client2.shutdown()
+        proc2.wait(timeout=30)
+    except Exception:  # noqa: BLE001 — fall back to the hard kill
+        _sigkill(proc2)
+
     # == fault accounting, client side (this process's registry) ==
     mine = obs.render_prom()
     for name in ("jepsen_client_retries_total",
@@ -464,8 +538,9 @@ def main(argv=None) -> int:
         "chaos-smoke: ok (kill -9 + torn-WAL replay byte-identical on "
         "both kernel routes; stalled-socket calls bounded by the "
         "deadline, breaker tripped to in-process and recovered "
-        "half-open; dropped response deduped by request id; all "
-        "faults accounted in metrics)"
+        "half-open; dropped response deduped by request id; idle WAL "
+        "compaction kept only live rows and survived a simulated "
+        "crash mid-compaction; all faults accounted in metrics)"
     )
     return 0
 

@@ -29,6 +29,7 @@ cooldown, not a connect timeout per batch.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import subprocess
@@ -434,6 +435,148 @@ class ServiceClient:
             self.fetch_trace(ctx["trace_id"])
         return results
 
+    def open_feed(self, model, opts: Optional[dict] = None,
+                  req: Optional[str] = None) -> "FeedSession":
+        """Open a streaming-ingest session (``POST /feed`` op=open) and
+        return its :class:`FeedSession`.  ``req`` doubles as the
+        session id and the verdict-WAL run id, so passing the same id
+        after a daemon crash resumes against the replayed WAL rows."""
+        return FeedSession(self, model, opts=opts, req=req).open()
+
+    def watch(self, last_id: int = -1, timeout: Optional[float] = None):
+        """Subscribe to the daemon's verdict channel (``GET /watch``)
+        and yield ``(offset, row)`` tuples as verdicts settle.
+
+        One generator == one HTTP connection.  ``last_id`` >= 0 is sent
+        as ``Last-Event-ID`` so replay resumes *after* that WAL row.
+        The generator ends (rather than raising) when the connection
+        drops or the read ``timeout`` expires with the daemon quiet —
+        callers reconnect with the last offset they saw.  Raises
+        :class:`ServiceUnavailable` only when the initial connection
+        fails.
+        """
+        headers = {}
+        if last_id >= 0:
+            headers["Last-Event-ID"] = str(last_id)
+        request = urllib.request.Request(self._url("/watch"),
+                                         headers=headers)
+        try:
+            resp = urllib.request.urlopen(
+                request, timeout=timeout or self.timeout or 30.0)
+        except urllib.error.HTTPError as e:
+            raise ServiceError(f"/watch returned {e.code}")
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise ServiceUnavailable(
+                f"no daemon at {self._url('/watch')}: {e}")
+        try:
+            event_id = None
+            data: Optional[str] = None
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").rstrip("\r\n")
+                if not line:  # blank line terminates one SSE event
+                    if data is not None:
+                        try:
+                            row = json.loads(data)
+                        except ValueError:
+                            row = None
+                        if isinstance(row, dict):
+                            try:
+                                off = int(event_id)
+                            except (TypeError, ValueError):
+                                off = -1
+                            yield off, row
+                    event_id, data = None, None
+                elif line.startswith(":"):
+                    pass  # keep-alive comment
+                elif line.startswith("id:"):
+                    event_id = line[3:].strip()
+                elif line.startswith("data:"):
+                    chunk = line[5:].strip()
+                    data = chunk if data is None else data + chunk
+        except (ConnectionError, OSError):
+            return  # subscriber-side disconnect: end of stream
+        finally:
+            resp.close()
+
+
+class FeedSession:
+    """Client half of one streaming-ingest session.
+
+    Appends carry a monotonically increasing ``seq``; the daemon acks
+    ``seq <= last_seq`` as a duplicate without re-dispatching, so a
+    retried append (connection reset after the daemon ingested it) is
+    safe.  ``append`` only advances ``seq`` after a 200, which makes
+    the retry loop in the caller trivially idempotent.
+    """
+
+    def __init__(self, client: ServiceClient, model,
+                 opts: Optional[dict] = None,
+                 req: Optional[str] = None):
+        self.client = client
+        self.model = model
+        self.opts = dict(opts or {})
+        self.req = req or protocol.request_id()
+        self.sid: Optional[str] = None
+        self.seq = 0
+        self.resumed = False
+        self.closed = False
+        self.last_diag: dict = {}
+
+    def open(self) -> "FeedSession":
+        body = protocol.feed_open_request(self.model, self.opts,
+                                          req=self.req)
+        code, resp = self.client._resilient_post("/feed", body)
+        payload = protocol.decode_body(resp)
+        if code == 503:
+            raise ServiceError(
+                f"daemon backlogged: {payload.get('error')}")
+        if code != 200:
+            raise ServiceError(
+                f"/feed open returned {code}: {payload.get('error')}")
+        self.sid = payload["session"]
+        self.resumed = bool(payload.get("resumed"))
+        return self
+
+    def append(self, histories=None, ops=None,
+               t_inv: Optional[float] = None) -> dict:
+        """Ship one delta — whole histories and/or raw op events (both
+        invocations and completions, in history-append order).  Returns
+        the daemon's ack (``accepted``/``rows``/``settled``/``diag``)."""
+        if self.sid is None:
+            raise ServiceError("feed session not open")
+        body = protocol.feed_append_request(
+            self.sid, self.seq, histories=histories, ops=ops,
+            t_inv=t_inv)
+        code, resp = self.client._resilient_post("/feed", body)
+        payload = protocol.decode_body(resp)
+        if code == 503:
+            raise ServiceError(
+                f"daemon backlogged: {payload.get('error')}")
+        if code != 200:
+            raise ServiceError(
+                f"/feed append returned {code}: {payload.get('error')}")
+        self.seq += 1
+        self.last_diag = payload.get("diag") or {}
+        return payload
+
+    def close(self) -> List[dict]:
+        """Finalize the session; returns the settled results (client
+        histories in feed order, assembled op-history last when ops
+        were fed) — byte-identical to a one-shot ``/check`` of the same
+        histories."""
+        if self.sid is None:
+            raise ServiceError("feed session not open")
+        body = protocol.feed_close_request(self.sid, self.seq,
+                                           req=self.req + ":close")
+        code, resp = self.client._resilient_post("/feed", body)
+        payload = protocol.decode_body(resp)
+        if code != 200:
+            raise ServiceError(
+                f"/feed close returned {code}: {payload.get('error')}")
+        self.closed = True
+        self.last_diag = payload.get("diag") or {}
+        return payload["results"]
+
 
 def _reap(proc, grace_s: float = 10.0) -> None:
     """Terminate a child without ever leaking it: SIGTERM → bounded
@@ -654,6 +797,17 @@ def format_status(st: dict) -> str:
         f" + {st.get('warm_dispatches', 0)} warm"
         f" (warm-hit ratio {warm})"
     )
+    if (st.get("feed_open") or st.get("feed_sessions")
+            or st.get("watch_subscribers")):
+        lines.append(
+            f"  online: {st.get('feed_open', 0)} open feed(s)"
+            f" ({st.get('feed_sessions', 0)} sessions,"
+            f" {st.get('feed_deltas', 0)} deltas,"
+            f" {st.get('feed_histories', 0)} histories)"
+            f" · watchers {st.get('watch_subscribers', 0)}"
+            f" ({st.get('watch_events', 0)} events)"
+            f" · compactions {st.get('wal_compactions', 0)}"
+        )
     quarantine = st.get("quarantine") or []
     if quarantine:
         lines.append(
@@ -686,6 +840,8 @@ def format_live(live: dict) -> str:
         f" · hist {_rate(live, 'histories_per_s')}"
         f" · elle {_rate(live, 'elle_graphs_per_s')}"
         f" · disp {_rate(live, 'dispatches_per_s')}"
+        f" · feed {_rate(live, 'feed_deltas_per_s')}"
+        f" · watch {_rate(live, 'watch_events_per_s')}"
         f" · wait "
         + (f"{qw * 1e3:.1f}ms" if isinstance(qw, (int, float)) else "n/a")
         + " · busy "
@@ -710,6 +866,42 @@ def format_top(host: str, port, st: dict) -> str:
         f"  queue {st.get('queue_depth', 0)}/{st.get('max_queue_runs')}"
         f" · in-flight {st.get('in_flight', 0)}"
         f" · coalesced {st.get('coalesced', 0)}"
+        + (f" · feeds {st.get('feed_open', 0)}"
+           if st.get("feed_open") else "")
+        + (f" · watchers {st.get('watch_subscribers', 0)}"
+           if st.get("watch_subscribers") else "")
         + (f" · journal {st.get('journal_rows', 0)} rows" if jp else "")
     )
     return "\n".join([head, "  " + format_live(live), tail])
+
+
+def format_verdicts(events, limit: int = 8) -> str:
+    """Render the newest settled verdicts as ``jepsen_tpu top``'s
+    verdicts pane.  ``events`` is a sequence of ``(addr, offset, row)``
+    tuples collected off one or more ``/watch`` channels (newest
+    last); only the trailing ``limit`` rows are shown."""
+    lines = ["── verdicts " + "─" * 36]
+    rows = list(events)[-limit:]
+    if not rows:
+        lines.append("  (no settled verdicts yet)")
+        return "\n".join(lines)
+    now = time.time()
+    for addr, off, row in rows:
+        res = row.get("result") or {}
+        valid = res.get("valid?")
+        mark = "✗" if valid is False else ("✓" if valid is True else "?")
+        ts = row.get("ts")
+        age = (f"{max(0.0, now - float(ts)):.0f}s ago"
+               if isinstance(ts, (int, float)) else "t?")
+        extra = ""
+        if valid is False:
+            anom = res.get("anomaly-types") or res.get("anomalies")
+            if anom:
+                extra = f" · {anom}"
+        lines.append(
+            f"  {mark} {addr} #{off}"
+            f" req {str(row.get('req'))[:8]}"
+            f" {row.get('stream')}[{row.get('idx')}]"
+            f" · {age}{extra}"
+        )
+    return "\n".join(lines)

@@ -72,9 +72,23 @@ DEFAULT_MAX_QUEUE_ROWS = 65536
 DEFAULT_REQUEST_TIMEOUT_S = 600.0
 
 
+#: idle-window WAL auto-compaction threshold: past this size the
+#: device thread's housekeeping turn rewrites the verdict WAL down to
+#: the rows still replayable (JEPSEN_TPU_WAL_COMPACT_BYTES overrides;
+#: 0 disables)
+DEFAULT_WAL_COMPACT_BYTES = 32 * 1024 * 1024
+
+
 def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
     except ValueError:
         return default
 
@@ -180,6 +194,61 @@ class _Request:
         self.replayed = 0
 
 
+class _FeedDelta(_Request):
+    """One admitted ``/feed`` delta: a :class:`_Request` whose streams
+    carry ONLY the rows the delta's ``DecomposedRun.extend`` just
+    created (``rows`` is overridden to the delta row count — the row
+    budget must see the queued footprint, not the whole session), and
+    whose ``kind`` keeps feed traffic out of the /check request
+    counters."""
+
+    kind = "feed"
+    __slots__ = ()
+
+
+class _FeedSession:
+    """One open streaming-ingest session (``POST /feed``): the
+    session's :class:`~jepsen_tpu.engine.decompose.DecomposedRun`
+    grows by ``extend`` per delta.  ``lock`` serializes deltas — the
+    run's planning/execution phase contract allows exactly one delta
+    in flight per session (concurrent appends to one session would
+    race the device thread's result assignment)."""
+
+    __slots__ = ("sid", "run", "model", "plan_opts", "exec_opts",
+                 "group_key", "trace_id", "lock", "last_seq", "ops",
+                 "history_idx", "probe_idx", "probed_n", "prior",
+                 "t_open")
+
+    def __init__(self, sid, run, model, plan_opts, exec_opts,
+                 group_key, trace_id, prior):
+        self.sid = sid
+        self.run = run
+        self.model = model
+        self.plan_opts = plan_opts
+        self.exec_opts = exec_opts
+        self.group_key = group_key
+        self.trace_id = trace_id
+        self.lock = threading.Lock()
+        #: highest ingested delta seq — a retried append (same seq,
+        #: response lost on the wire) acks without re-dispatching
+        self.last_seq = -1
+        #: op-mode accumulator: raw completed-op event dicts in
+        #: shipped (real-time) order; probes check the assembled
+        #: prefix history as it grows
+        self.ops: List[dict] = []
+        #: run indices of client-fed whole histories, in feed order —
+        #: what close() returns results for
+        self.history_idx: List[int] = []
+        #: run index + coverage of the latest op-prefix probe (close
+        #: reuses it as the final verdict when no ops arrived since)
+        self.probe_idx: Optional[int] = None
+        self.probed_n = 0
+        #: WAL rows a previous daemon life settled under this session
+        #: id — replayed into each delta's fresh slots
+        self.prior = prior
+        self.t_open = time.time()
+
+
 class CheckerDaemon:
     """The resident service.  ``start(block=False)`` returns once the
     device thread is ready; ``port`` then holds the bound port (useful
@@ -199,6 +268,7 @@ class CheckerDaemon:
         journal_path: Optional[str] = None,
         journal_max_bytes: int = obs_journal.DEFAULT_MAX_BYTES,
         wal_path: Optional[str] = None,
+        wal_compact_bytes: Optional[int] = None,
     ):
         #: per-bucket device-cost estimator driving largest-first
         #: dispatch of coalesced work.  The default is the
@@ -242,6 +312,17 @@ class CheckerDaemon:
         self.wal_path = wal_path
         self._wal: Optional[obs_journal.VerdictWAL] = None
         self._wal_replay: Dict[str, dict] = {}
+        #: idle-window auto-compaction threshold (bytes; 0 disables) —
+        #: the device thread's housekeeping turn checks it
+        self.wal_compact_bytes = (
+            _env_int("JEPSEN_TPU_WAL_COMPACT_BYTES",
+                     DEFAULT_WAL_COMPACT_BYTES)
+            if wal_compact_bytes is None else wal_compact_bytes
+        )
+        #: open streaming-ingest sessions by session id
+        self._feeds: Dict[str, _FeedSession] = {}  # jt: guarded-by(_wake)
+        #: live /watch subscribers (SSE handler threads)
+        self._watchers = 0  # jt: guarded-by(_wake)
         #: completed-response cache for idempotent retries: a client
         #: retry (same request id) of an ALREADY-ANSWERED request is
         #: served from here without touching the device or the
@@ -271,6 +352,8 @@ class CheckerDaemon:
             "cold_dispatches": 0, "errors": 0,
             "elle_requests": 0, "elle_graphs": 0,
             "quarantined_rows": 0, "replayed": 0, "deduped": 0,
+            "feed_sessions": 0, "feed_deltas": 0, "feed_histories": 0,
+            "watch_events": 0, "wal_compactions": 0,
         }
         self._platform: Optional[str] = None
         self._fatal: Optional[str] = None
@@ -320,6 +403,11 @@ class CheckerDaemon:
                 self.stats["elle_graphs"] += req.n
                 obs.count("jepsen_serve_elle_requests_total")
                 obs.count("jepsen_serve_elle_graphs_total", req.n)
+            elif req.kind == "feed":
+                # feed deltas count under jepsen_feed_* at ingest
+                # completion (_feed_dispatch), not here: a delta is
+                # not a /check request and must not inflate its stats
+                pass
             else:
                 self.stats["requests"] += 1
                 self.stats["histories"] += req.n
@@ -335,10 +423,17 @@ class CheckerDaemon:
         """Pop the whole current backlog (the coalescing unit), waiting
         up to ``coalesce_wait_s`` after the first arrival for company."""
         with self._wake:
+            idle_waits = 0
             while not self._queue:
                 if self._stopping.is_set():
                     return []
                 self._wake.wait(timeout=0.2)
+                idle_waits += 1
+                if not self._queue and idle_waits >= 5:
+                    # ~1 s with no admissions: hand the device loop a
+                    # housekeeping turn (WAL auto-compaction) instead
+                    # of camping on the condition forever
+                    return []
             if self.coalesce_wait_s > 0:
                 deadline = time.monotonic() + self.coalesce_wait_s
                 while (len(self._queue) < self.max_queue_runs
@@ -387,6 +482,7 @@ class CheckerDaemon:
             if not batch:
                 if self._stopping.is_set():
                     return  # drained: every admitted request settled
+                self._maybe_compact_wal()
                 continue
             try:
                 self._process_batch(executor, batch)
@@ -414,6 +510,36 @@ class CheckerDaemon:
                 with self._wake:
                     self.stats["errors"] += n_err
                     self._in_flight = 0
+
+    def _maybe_compact_wal(self) -> None:
+        """Idle-window WAL auto-compaction (device thread only): past
+        :attr:`wal_compact_bytes` the verdict WAL rewrites down to the
+        rows still replayable — the request ids in the completed-
+        response cache plus every open feed session.  ``compact()``
+        swaps via ``.tmp`` + ``os.replace`` under the WAL's own lock,
+        so concurrent handler appends stay safe and a kill -9
+        mid-compaction leaves the original file intact (the chaos
+        harness pins this); ``/watch`` followers detect the rewrite
+        (WalTail's inode/size check) and restart from offset 0 —
+        re-delivery is safe because verdicts are monotone and every
+        row carries its full (req, stream, idx) identity."""
+        wal = self._wal
+        if wal is None or self.wal_compact_bytes <= 0:
+            return
+        try:
+            if os.path.getsize(wal.path) <= self.wal_compact_bytes:
+                return
+        except OSError:
+            return
+        with self._wake:
+            keep = set(self._done) | set(self._feeds)
+        try:
+            wal.compact(keep_reqs=keep)
+        except OSError:
+            return  # disk trouble: keep serving, retry next idle turn
+        with self._wake:
+            self.stats["wal_compactions"] += 1
+        obs.count("jepsen_serve_wal_compactions_total")
 
     def _fail_all_queued(self) -> None:
         with self._wake:
@@ -679,6 +805,8 @@ class CheckerDaemon:
             in_flight = self._in_flight
             quarantine = [{"route": str(k), "error": v}
                           for k, v in self._quarantine.items()]
+            feed_open = len(self._feeds)
+            watchers = self._watchers
         total = stats["warm_dispatches"] + stats["cold_dispatches"]
         cal = tune.active()
         reg = obs.registry()
@@ -688,6 +816,7 @@ class CheckerDaemon:
         busy_s = (reg.window_seconds_sum("jepsen_kernel_compile_seconds")
                   + reg.window_seconds_sum("jepsen_kernel_execute_seconds"))
         qw_mean = reg.window_mean("jepsen_serve_queue_wait_seconds")
+        lag_mean = reg.window_mean("jepsen_feed_ingest_lag_seconds")
         live = {
             "requests_per_s": round(
                 reg.window_rate("jepsen_serve_requests_total"), 4),
@@ -701,6 +830,12 @@ class CheckerDaemon:
                 round(qw_mean, 4) if qw_mean is not None else None),
             "device_busy_ratio": round(
                 min(1.0, busy_s / 60.0), 4),
+            "feed_deltas_per_s": round(
+                reg.window_rate("jepsen_feed_deltas_total"), 4),
+            "watch_events_per_s": round(
+                reg.window_rate("jepsen_watch_events_total"), 4),
+            "feed_lag_mean_s": (
+                round(lag_mean, 4) if lag_mean is not None else None),
         }
         journal = obs_journal.active()
         return {
@@ -739,6 +874,11 @@ class CheckerDaemon:
             "quarantine": quarantine,
             "wal_path": self._wal.path if self._wal else None,
             "wal_rows": self._wal.written if self._wal else 0,
+            # the online-monitor surface: open ingest sessions and
+            # live /watch subscribers (doc/checker-service.md
+            # "Online checking")
+            "feed_open": feed_open,
+            "watch_subscribers": watchers,
             "live": live,
             **stats,
         }
@@ -910,27 +1050,11 @@ class CheckerDaemon:
             return self._check_flow(payload, model, histories, opts,
                                     ctx["trace_id"] if ctx else None)
 
-    def _check_flow(self, payload, model, histories, opts,
-                    trace_id: Optional[str]) -> Tuple[int, dict]:
-        #: the client's idempotency key (serve.protocol request ids) —
-        #: doubles as the verdict-WAL run id, so a retry after a
-        #: daemon crash finds its settled partitions under the same id
-        req_id = payload.get("req")
-        cached = self._dedup_hit(req_id)
-        if cached is not None:
-            return cached
-        if not self.precheck_admit(len(histories)):
-            # overload sheds BEFORE the planning half: no encode, no
-            # oracle-pool submissions for a request we will refuse
-            with self._wake:
-                depth = len(self._queue)
-                self.stats["rejected"] += 1
-            obs.count("jepsen_serve_rejected_total")
-            return 503, {
-                "error": "backlogged",
-                "queue_depth": depth,
-                "stopping": self._stopping.is_set(),
-            }
+    def _check_opts(self, wire_model: dict, opts: dict):
+        """Resolve a request's planning/execution option dicts and its
+        compatible-group key (shared between /check and /feed so a
+        feed delta coalesces with check traffic under the same model
+        and options)."""
         from ..ops import wgl
 
         plan_opts = {
@@ -953,13 +1077,38 @@ class CheckerDaemon:
         # option agree (the wire model dict is canonical-enough: same
         # construction → same dict)
         group_key = (
-            json.dumps(payload["model"], sort_keys=True, default=repr),
+            json.dumps(wire_model, sort_keys=True, default=repr),
             json.dumps(plan_opts, sort_keys=True),
             json.dumps(
                 {**exec_opts, "escalation": list(exec_opts["escalation"])},
                 sort_keys=True,
             ),
         )
+        return plan_opts, exec_opts, group_key
+
+    def _check_flow(self, payload, model, histories, opts,
+                    trace_id: Optional[str]) -> Tuple[int, dict]:
+        #: the client's idempotency key (serve.protocol request ids) —
+        #: doubles as the verdict-WAL run id, so a retry after a
+        #: daemon crash finds its settled partitions under the same id
+        req_id = payload.get("req")
+        cached = self._dedup_hit(req_id)
+        if cached is not None:
+            return cached
+        if not self.precheck_admit(len(histories)):
+            # overload sheds BEFORE the planning half: no encode, no
+            # oracle-pool submissions for a request we will refuse
+            with self._wake:
+                depth = len(self._queue)
+                self.stats["rejected"] += 1
+            obs.count("jepsen_serve_rejected_total")
+            return 503, {
+                "error": "backlogged",
+                "queue_depth": depth,
+                "stopping": self._stopping.is_set(),
+            }
+        plan_opts, exec_opts, group_key = self._check_opts(
+            payload["model"], opts)
         # the decomposition front-end runs handler-side (pure host
         # work): partitionable histories split into per-partition
         # sub-histories whose buckets then coalesce across runs like
@@ -1038,6 +1187,279 @@ class CheckerDaemon:
         }
         self._dedup_store(req_id, 200, body)
         return 200, body
+
+    # -- the /feed entry (handler threads) -----------------------------------
+
+    def handle_feed(self, body: bytes) -> Tuple[int, dict]:
+        """Streaming ingest (doc/checker-service.md "Online
+        checking"): one endpoint, three ops — ``open`` a session,
+        ``append`` deltas (whole histories and/or completed-op dicts),
+        ``close`` for the authoritative merged results.  Every delta
+        encodes, buckets, and dispatches THROUGH THE DEVICE THREAD the
+        moment it arrives, so a violation at op 40k settles (and hits
+        the WAL, and every ``/watch`` subscriber) near op 40k instead
+        of at run end."""
+        if self._fatal is not None:
+            return 500, {"error": f"device thread failed: {self._fatal}"}
+        try:
+            payload = protocol.decode_body(body)
+            fop = payload.get("op")
+        except Exception as e:  # noqa: BLE001 — malformed client input
+            return 400, {"error": f"bad request: {e!r}"}
+        with obs.span("serve/feed", cat="serve", op=str(fop)):
+            if fop == "open":
+                return self._feed_open(payload)
+            if fop == "append":
+                return self._feed_append(payload)
+            if fop == "close":
+                return self._feed_close(payload)
+            return 400, {"error": f"unknown feed op {fop!r}"}
+
+    def _feed_open(self, payload) -> Tuple[int, dict]:
+        try:
+            model = protocol.model_from_wire(payload["model"])
+            opts = payload.get("opts") or {}
+            plan_opts, exec_opts, group_key = self._check_opts(
+                payload["model"], opts)
+        except Exception as e:  # noqa: BLE001 — malformed client input
+            return 400, {"error": f"bad request: {e!r}"}
+        ctx = propagate.parse_ctx(payload.get("trace_ctx"))
+        #: the session id doubles as the verdict-WAL run id, so a
+        #: session re-opened after a daemon crash (same client req id)
+        #: replays its settled partitions into resumed deltas
+        sid = payload.get("req") or protocol.request_id()
+        run = decompose.DecomposedRun(
+            model, [],
+            oracle_fallback=bool(opts.get("oracle_fallback", True)),
+            lazy=True,
+        )
+        prior: dict = {}
+        if self._wal is not None:
+            run.attach_wal(self._wal.sink_for(sid))
+            prior = self._wal_replay.get(sid) or {}
+        s = _FeedSession(sid, run, model, plan_opts, exec_opts,
+                         group_key, ctx["trace_id"] if ctx else None,
+                         dict(prior))
+        with self._wake:
+            if self._stopping.is_set():
+                return 503, {"error": "stopping", "stopping": True}
+            if sid in self._feeds:
+                # idempotent re-open (retry whose response was lost):
+                # the existing session keeps its state
+                return 200, {"session": sid, "resumed": True}
+            self._feeds[sid] = s
+            self.stats["feed_sessions"] += 1
+            n_open = len(self._feeds)
+        obs.count("jepsen_feed_sessions_total")
+        obs.gauge_set("jepsen_feed_open_sessions", n_open)
+        return 200, {"session": sid, "resumed": False}
+
+    def _feed_session(self, payload):
+        sid = payload.get("session")
+        with self._wake:
+            s = self._feeds.get(sid)
+        if s is None:
+            return None, (404, {"error": f"unknown feed session {sid!r}"})
+        return s, None
+
+    def _feed_append(self, payload) -> Tuple[int, dict]:
+        s, err = self._feed_session(payload)
+        if s is None:
+            return err
+        try:
+            seq = int(payload.get("seq"))
+        except (TypeError, ValueError):
+            return 400, {"error": "bad seq"}
+        with s.lock:
+            if seq <= s.last_seq:
+                # retried delta (response lost on the wire): already
+                # ingested — ack without re-dispatching anything
+                return 200, {"session": s.sid, "seq": seq,
+                             "duplicate": True, "accepted": 0,
+                             "settled": s.run.settled_count()}
+            try:
+                histories = protocol.histories_from_wire(
+                    payload.get("histories") or [])
+            except Exception as e:  # noqa: BLE001 — malformed input
+                return 400, {"error": f"bad request: {e!r}"}
+            n_client = len(histories)
+            ops = payload.get("ops") or []
+            all_ops = s.ops
+            if ops:
+                # op-mode: accumulate the shipped events (real-time
+                # order) and probe the assembled prefix history — the
+                # P-compositionality bet: grown partitions recheck
+                # cheaply in isolation, so the probe prices like its
+                # changed keys, not like the whole run.  The buffer
+                # commits only on dispatch success: a 503'd delta the
+                # client retries must not double-ingest its ops.
+                from ..history import History
+
+                try:
+                    all_ops = s.ops + [dict(o) for o in ops]
+                    probe = History.from_dicts(all_ops)
+                except Exception as e:  # noqa: BLE001 — malformed input
+                    return 400, {"error": f"bad ops: {e!r}"}
+                histories = list(histories) + [probe]
+            base = s.run.n
+            code, resp = self._feed_dispatch(s, histories,
+                                             payload.get("t_inv"))
+            if code != 200:
+                return code, resp
+            s.history_idx.extend(range(base, base + n_client))
+            if ops:
+                s.ops = all_ops
+                s.probe_idx = base + n_client
+                s.probed_n = len(s.ops)
+            s.last_seq = seq
+            resp["seq"] = seq
+            return code, resp
+
+    def _feed_dispatch(self, s: _FeedSession, histories,
+                       t_inv) -> Tuple[int, dict]:
+        """Ingest one delta: extend the session run, replay any WAL
+        rows a previous daemon life settled for the fresh slots,
+        encode ONLY the new rows, and push them through the device
+        thread like any admitted request (coalescing with concurrent
+        traffic under the session's group key)."""
+        if not histories:
+            return 200, {"session": s.sid, "accepted": 0, "rows": 0,
+                         "replayed": 0,
+                         "settled": s.run.settled_count()}
+        if not self.precheck_admit(len(histories)):
+            with self._wake:
+                depth = len(self._queue)
+                self.stats["rejected"] += 1
+            obs.count("jepsen_serve_rejected_total")
+            return 503, {
+                "error": "backlogged",
+                "queue_depth": depth,
+                "stopping": self._stopping.is_set(),
+            }
+        rows = s.run.extend(histories)
+        replayed = 0
+        if s.prior:
+            replayed = s.run.replay(s.prior)
+            if replayed:
+                with self._wake:
+                    self.stats["replayed"] += replayed
+                obs.count("jepsen_serve_wal_replayed_total", replayed)
+        streams = []
+        with obs.span("serve/feed-plan", cat="serve",
+                      histories=len(histories)):
+            for tag, sctx in s.run.streams():
+                idxs = [i for c, i in rows if c is sctx]
+                if not idxs:
+                    continue
+                planner = planning.Planner(
+                    sctx.model, spec=sctx.spec, bucketed=True,
+                    **s.plan_opts,
+                )
+                buckets, order = planner.encode_rows(sctx, idxs)
+                streams.append(
+                    _Stream(tag, sctx.model, sctx.spec, buckets, order))
+        req = _FeedDelta(s.run, streams, s.group_key, s.model,
+                         s.plan_opts, s.exec_opts, len(histories),
+                         trace_id=s.trace_id)
+        # the row budget must see THIS delta's queued footprint, not
+        # the whole session run _Request.rows would count
+        req.rows = len(rows)
+        if not self.admit(req):
+            req.abandoned = True
+            s.run.abandon_oracles()
+            with self._wake:
+                depth = len(self._queue)
+            return 503, {
+                "error": "backlogged",
+                "queue_depth": depth,
+                "stopping": self._stopping.is_set(),
+            }
+        if not req.device_done.wait(
+            _env_float("JEPSEN_TPU_SERVE_REQUEST_TIMEOUT",
+                       DEFAULT_REQUEST_TIMEOUT_S)
+        ):
+            req.abandoned = True
+            return 500, {"error": "device thread timed out"}
+        if req.error is not None:
+            return 500, {"error": req.error}
+        s.run.drain_oracles()
+        if t_inv is not None:
+            try:
+                # detect-time minus invoke-time: the monitor's core
+                # promise, as a histogram
+                obs.observe("jepsen_feed_ingest_lag_seconds",
+                            max(0.0, time.time() - float(t_inv)))
+            except (TypeError, ValueError):
+                pass
+        with self._wake:
+            self.stats["feed_deltas"] += 1
+            self.stats["feed_histories"] += len(histories)
+        obs.count("jepsen_feed_deltas_total")
+        obs.count("jepsen_feed_histories_total", len(histories))
+        return 200, {"session": s.sid, "accepted": len(histories),
+                     "rows": len(rows), "replayed": replayed,
+                     "settled": s.run.settled_count(),
+                     "diag": dict(req.diag)}
+
+    def _feed_close(self, payload) -> Tuple[int, dict]:
+        req_id = payload.get("req")
+        cached = self._dedup_hit(req_id)
+        if cached is not None:
+            return cached
+        s, err = self._feed_session(payload)
+        if s is None:
+            return err
+        with s.lock:
+            final_idx = s.probe_idx
+            if s.ops and s.probed_n < len(s.ops):
+                # ops arrived since the last probe: run the
+                # authoritative final check over the complete history
+                from ..history import History
+
+                try:
+                    final = History.from_dicts(s.ops)
+                except Exception as e:  # noqa: BLE001 — malformed input
+                    return 400, {"error": f"bad ops: {e!r}"}
+                final_idx = s.run.n
+                code, resp = self._feed_dispatch(s, [final], None)
+                if code != 200:
+                    return code, resp
+            s.run.drain_oracles()
+            results = s.run.results()
+            out = [results[i] for i in s.history_idx]
+            if final_idx is not None:
+                out.append(results[final_idx])
+            body = {
+                "results": protocol.sanitize_results(out),
+                "diag": {
+                    "session": s.sid,
+                    "deltas": s.last_seq + 1,
+                    "histories": len(s.history_idx),
+                    "ops": len(s.ops),
+                    "settled": s.run.settled_count(),
+                    "partitions": s.run.n_partitions,
+                },
+            }
+        with self._wake:
+            self._feeds.pop(s.sid, None)
+            n_open = len(self._feeds)
+        obs.gauge_set("jepsen_feed_open_sessions", n_open)
+        self._dedup_store(req_id, 200, body)
+        return 200, body
+
+    # -- the /watch channel (handler threads) --------------------------------
+
+    def _watch_enter(self) -> None:
+        with self._wake:
+            self._watchers += 1
+            n = self._watchers
+        obs.gauge_set("jepsen_watch_subscribers", n)
+
+    def _watch_exit(self) -> None:
+        with self._wake:
+            self._watchers -= 1
+            n = self._watchers
+        obs.gauge_set("jepsen_watch_subscribers", n)
 
     # -- the /elle entry (handler threads) -----------------------------------
 
@@ -1136,10 +1558,75 @@ def _make_handler(daemon: CheckerDaemon):
                         self._reply_json(400, {"error": "missing ctx"})
                     else:
                         self._reply_json(200, daemon.trace_dump(ctx))
+                elif self.path.startswith("/watch"):
+                    self._serve_watch()
                 else:
                     self._reply_json(404, {"error": "not found"})
             except BrokenPipeError:
                 pass
+
+        def _serve_watch(self):
+            """The verdict watch channel: settled verdicts as
+            server-sent events tailing the verdict WAL.  Each event's
+            ``id:`` is the WAL's logical valid-row offset (damaged
+            lines consume no offset), so a reconnecting subscriber
+            sends ``Last-Event-ID`` and resumes exactly after the last
+            row it saw — nothing replays twice.  The stream is
+            unframed, so the response closes the connection when it
+            ends (``Connection: close`` under this handler's
+            HTTP/1.1)."""
+            if daemon._wal is None:
+                self._reply_json(404, {"error": "no verdict WAL"})
+                return
+            try:
+                start = int(self.headers.get("Last-Event-ID")) + 1
+            except (TypeError, ValueError):
+                start = 0
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            daemon._watch_enter()
+            tail = obs_journal.WalTail(daemon._wal.path, start=start)
+            first = True
+            quiet_s = 0.0
+            try:
+                while not daemon._stopping.is_set():
+                    events = tail.poll()
+                    if events:
+                        if first:
+                            # the catch-up burst: rows that settled
+                            # before this subscriber connected/resumed
+                            obs.count("jepsen_watch_replay_rows_total",
+                                      len(events))
+                        chunk = "".join(
+                            f"id: {off}\ndata: "
+                            f"{json.dumps(row, sort_keys=True)}\n\n"
+                            for off, row in events
+                        )
+                        self.wfile.write(chunk.encode())
+                        self.wfile.flush()
+                        obs.count("jepsen_watch_events_total",
+                                  len(events))
+                        with daemon._wake:
+                            daemon.stats["watch_events"] += len(events)
+                        quiet_s = 0.0
+                    else:
+                        time.sleep(0.1)
+                        quiet_s += 0.1
+                        if quiet_s >= 5.0:
+                            # a dead subscriber only surfaces on write:
+                            # ping through quiet stretches so stale
+                            # watcher threads reap promptly
+                            self.wfile.write(b": keep-alive\n\n")
+                            self.wfile.flush()
+                            quiet_s = 0.0
+                    first = False
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # subscriber went away — normal lifecycle
+            finally:
+                daemon._watch_exit()
 
         def do_POST(self):  # noqa: N802 — http.server API, jt: thread-entry
             try:
@@ -1150,6 +1637,9 @@ def _make_handler(daemon: CheckerDaemon):
                     self._reply_json(code, payload)
                 elif self.path == "/elle":
                     code, payload = daemon.handle_elle(body)
+                    self._reply_json(code, payload)
+                elif self.path == "/feed":
+                    code, payload = daemon.handle_feed(body)
                     self._reply_json(code, payload)
                 elif self.path == "/shutdown":
                     self._reply_json(200, daemon.request_shutdown())

@@ -1,0 +1,189 @@
+"""Online-checking smoke: ``python -m jepsen_tpu.serve.online_smoke``.
+
+Brings a resident checker daemon up in-process (ephemeral port, a
+verdict WAL in a temp directory) and proves the live-verification
+acceptance gates on both kernel routes (dense automaton, and the
+generic frontier kernel via an explicit closure cap):
+
+- **early detection**: a batch containing injected violations
+  (``synth.generate_history(corrupt=True)``) is fed incrementally
+  through one ``POST /feed`` session, and the first ``valid? ==
+  False`` verdict for that session arrives on a concurrent ``GET
+  /watch`` subscription strictly BEFORE the feed is closed — the
+  monitor sees the violation while the "run" is still in flight;
+- **verdict byte-equality**: the settled results the feed close
+  returns are byte-identical (canonical JSON) to the in-process
+  ``wgl.check_batch`` of the same batch — streaming ingest changes
+  *when* violations surface, never *what* the verdict is;
+- **op-granularity ingest**: the same gates hold when the session is
+  fed raw history events (invocations AND completions, in
+  history-append order — the interpreter shipper's wire shape)
+  instead of whole histories, with the assembled-history verdict at
+  close byte-identical to the batch check of that history;
+- **telemetry**: the feed/watch metric families
+  (``jepsen_feed_sessions_total``, ``jepsen_feed_deltas_total``,
+  ``jepsen_feed_ingest_lag_seconds``, ``jepsen_watch_events_total``)
+  record on ``/metrics``, and the run-level
+  ``jepsen_run_first_violation_seconds`` gauge is set once verdicts
+  settle.
+
+Wired into ``make online-smoke`` / ``make check``.  Exit codes: 0 ok,
+1 any gate failed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    from jepsen_tpu import models as m
+    from jepsen_tpu import obs
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.serve import CheckerDaemon, ServiceClient
+    from jepsen_tpu.serve.smoke import _canon, _corpus_b, _metric_value
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    obs.enable(reset=True)
+    model = m.cas_register(0)
+    batch = _corpus_b()  # contains corrupt (violating) histories
+    configs = {
+        "dense": dict(slot_cap=32, max_dispatch=4),
+        "frontier": dict(slot_cap=32, max_dispatch=4, max_closure=9),
+    }
+
+    tmp = tempfile.mkdtemp(prefix="jepsen-online-")
+    daemon = CheckerDaemon(port=0,
+                           wal_path=os.path.join(tmp, "wal.jsonl"))
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        check(client.healthy(), "daemon did not come up healthy")
+
+        def spawn_watcher():
+            """A /watch subscriber from the current WAL tail; events
+            accumulate as (arrival monotonic, offset, row)."""
+            events = []
+            start = daemon.status().get("wal_rows", 0) - 1
+
+            def _tail():
+                try:
+                    for off, row in client.watch(last_id=start,
+                                                 timeout=10.0):
+                        events.append((time.monotonic(), off, row))
+                except Exception:  # noqa: BLE001 — thread must not die loud
+                    pass
+
+            threading.Thread(target=_tail, daemon=True).start()
+            return events
+
+        def first_violation(events, sid):
+            for t, off, row in list(events):
+                if (row.get("req") == sid
+                        and (row.get("result") or {}).get("valid?")
+                        is False):
+                    return t
+            return None
+
+        def await_violation(events, sid, wait_s=15.0):
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                t = first_violation(events, sid)
+                if t is not None:
+                    return t
+                time.sleep(0.05)
+            return None
+
+        # == gate 1+2: incremental history feed, both kernel routes ==
+        for route, kw in configs.items():
+            expected = wgl.check_batch(model, batch, **kw)
+            events = spawn_watcher()
+            time.sleep(0.3)  # let the subscriber attach
+            session = client.open_feed(model, kw)
+            for h in batch:
+                session.append(histories=[h], t_inv=time.time())
+            # the violation must be on the wire BEFORE the close
+            t_violation = await_violation(events, session.sid)
+            check(t_violation is not None,
+                  f"{route}: no violation verdict reached /watch "
+                  "while the feed was open")
+            t_close = time.monotonic()
+            results = session.close()
+            check(t_violation is not None and t_violation < t_close,
+                  f"{route}: violation event did not precede close")
+            check(len(results) == len(batch),
+                  f"{route}: feed close returned {len(results)} "
+                  f"results for {len(batch)} histories")
+            check(_canon(results) == _canon(expected),
+                  f"{route}: streamed verdicts diverged from the "
+                  "in-process batch check")
+
+        # == gate 3: op-granularity ingest (the shipper wire shape) ==
+        kw = configs["dense"]
+        expected = wgl.check_batch(model, batch, **kw)
+        bad_i = next(i for i, r in enumerate(expected)
+                     if r.get("valid?") is False)
+        bad_h = batch[bad_i]
+        events = spawn_watcher()
+        time.sleep(0.3)
+        session = client.open_feed(model, kw)
+        op_dicts = bad_h.to_dicts()
+        for i in range(0, len(op_dicts), 5):
+            session.append(ops=op_dicts[i:i + 5], t_inv=time.time())
+        t_violation = await_violation(events, session.sid)
+        check(t_violation is not None,
+              "ops feed: no violation verdict reached /watch while "
+              "the feed was open")
+        t_close = time.monotonic()
+        results = session.close()
+        check(t_violation is not None and t_violation < t_close,
+              "ops feed: violation event did not precede close")
+        check(results and _canon(results[-1:])
+              == _canon(wgl.check_batch(model, [bad_h], **kw)),
+              "ops feed: assembled-history verdict diverged from the "
+              "in-process check")
+
+        # == gate 4: telemetry ==
+        mtext = client.metrics_text()
+        for name in ("jepsen_feed_sessions_total",
+                     "jepsen_feed_deltas_total",
+                     "jepsen_feed_histories_total",
+                     "jepsen_watch_events_total"):
+            check((_metric_value(mtext, name) or 0) > 0,
+                  f"/metrics missing live {name}")
+        check((_metric_value(
+            mtext, "jepsen_feed_ingest_lag_seconds_count") or 0) > 0,
+            "ingest-lag histogram never observed a delta")
+        reg = obs.registry()
+        check(reg.value("jepsen_run_first_verdict_seconds") is not None,
+              "jepsen_run_first_verdict_seconds gauge never set")
+        check(reg.value("jepsen_run_first_violation_seconds")
+              is not None,
+              "jepsen_run_first_violation_seconds gauge never set")
+    finally:
+        daemon.stop()
+
+    if failures:
+        for f_ in failures:
+            print(f"online-smoke: FAIL — {f_}", file=sys.stderr)
+        return 1
+    print(
+        "online-smoke: ok (dense + frontier routes; injected violation "
+        "reached /watch before feed close, streamed verdicts "
+        "byte-identical to the batch check, op-granularity ingest "
+        "matched, feed/watch telemetry live)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,15 @@ Endpoints (doc/checker-service.md):
 - ``GET /status`` — queue depth, in-flight, counters, uptime.
 - ``GET /metrics`` — live Prometheus exposition
   (``obs.render_prom``), the same formatter as ``metrics.prom``.
+- ``POST /feed`` — streaming ingest (doc/checker-service.md "Online
+  checking"): one body schema, discriminated by ``"op"`` —
+  ``open`` (model + opts → ``{"session": id}``), ``append`` (history
+  or op-dict deltas under a session, idempotent by ``seq``), and
+  ``close`` (final merged results, byte-identical to a ``/check`` of
+  the same work).
+- ``GET /watch`` — settled verdicts as server-sent events tailing the
+  verdict WAL; ``Last-Event-ID`` (= WAL row offset) resumes a
+  reconnecting watcher without replaying anything twice.
 - ``POST /shutdown`` — drain in-flight work, then stop.
 
 Model serialization covers every model with a device ``ModelSpec``
@@ -324,13 +333,7 @@ def check_request(model, histories, opts: Optional[Dict[str, Any]] = None,
     carries the same id, so the daemon can answer from its completed-
     response cache or resume the request's verdict-WAL rows instead of
     double-counting the work."""
-    wire_opts = {}
-    for k, v in (opts or {}).items():
-        if k not in CHECK_OPTS:
-            raise UnsupportedModel(f"opt {k!r} is not serviceable")
-        if k == "escalation" and v is not None:
-            v = list(v)
-        wire_opts[k] = v
+    wire_opts = _check_opts_to_wire(opts)
     body = {
         "model": model_to_wire(model),
         "histories": histories_to_wire(histories),
@@ -338,6 +341,80 @@ def check_request(model, histories, opts: Optional[Dict[str, Any]] = None,
     }
     if trace_ctx:
         body["trace_ctx"] = dict(trace_ctx)
+    if req:
+        body["req"] = req
+    return encode_body(body)
+
+
+def _check_opts_to_wire(opts: Optional[Dict[str, Any]]) -> dict:
+    """Validate + normalize serviceable check opts (the shared half of
+    :func:`check_request` / :func:`feed_open_request`)."""
+    wire_opts = {}
+    for k, v in (opts or {}).items():
+        if k not in CHECK_OPTS:
+            raise UnsupportedModel(f"opt {k!r} is not serviceable")
+        if k == "escalation" and v is not None:
+            v = list(v)
+        wire_opts[k] = v
+    return wire_opts
+
+
+def feed_open_request(model, opts: Optional[Dict[str, Any]] = None,
+                      trace_ctx: Optional[Dict[str, Any]] = None,
+                      req: Optional[str] = None) -> bytes:
+    """Build a ``POST /feed`` session-open body.  ``req`` doubles as
+    the session's verdict-WAL run id: a feed session re-opened after a
+    daemon crash under the SAME id replays its settled partitions
+    instead of re-dispatching them (same resume contract as /check
+    retries).  Model/opts validation mirrors :func:`check_request` —
+    an unserviceable model or opt raises :class:`UnsupportedModel`
+    before any bytes hit the wire."""
+    body = {
+        "op": "open",
+        "model": model_to_wire(model),
+        "opts": _check_opts_to_wire(opts),
+    }
+    if trace_ctx:
+        body["trace_ctx"] = dict(trace_ctx)
+    if req:
+        body["req"] = req
+    return encode_body(body)
+
+
+def feed_append_request(session: str, seq: int,
+                        histories=None, ops=None,
+                        t_inv: Optional[float] = None) -> bytes:
+    """Build a ``POST /feed`` delta-append body.  ``seq`` is the
+    session-monotonic delta number — the daemon acks an
+    already-ingested seq without re-dispatching, so a client may
+    retry an append after a lost response.  A delta carries whole
+    ``histories`` (checked incrementally as independent rows) and/or
+    raw completed-op dicts ``ops`` (the interpreter's live shipper —
+    accumulated server-side and probed per partition as they arrive).
+    ``t_inv`` is the wall-clock invoke time of the delta's oldest op,
+    feeding the ``jepsen_feed_ingest_lag_seconds`` detect-minus-invoke
+    histogram."""
+    body: Dict[str, Any] = {"op": "append", "session": session,
+                            "seq": int(seq)}
+    if histories:
+        body["histories"] = histories_to_wire(histories)
+    if ops:
+        body["ops"] = list(ops)
+    if t_inv is not None:
+        body["t_inv"] = float(t_inv)
+    return encode_body(body)
+
+
+def feed_close_request(session: str, seq: int,
+                       req: Optional[str] = None) -> bytes:
+    """Build a ``POST /feed`` session-close body: the daemon runs the
+    authoritative final check (op-mode sessions check the complete
+    assembled history; history-mode sessions are already fully
+    settled), drains oracles, and answers with merged results
+    byte-identical to a ``/check`` of the same work.  ``req`` keys the
+    close response in the idempotent-retry cache."""
+    body: Dict[str, Any] = {"op": "close", "session": session,
+                            "seq": int(seq)}
     if req:
         body["req"] = req
     return encode_body(body)

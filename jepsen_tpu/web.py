@@ -28,6 +28,7 @@ th, td { padding: 4px 10px; border-bottom: 1px solid #ddd; text-align: left; }
 .valid-unknown { background: #f0e8c0; }
 a { text-decoration: none; }
 .live { color: #2a2; font-size: 0.8em; }
+.first-violation { outline: 2px solid #c33; font-weight: bold; }
 """
 
 
@@ -118,6 +119,20 @@ def service_section() -> str:
             + " / "
             + (f"{busy:.0%}" if isinstance(busy, (int, float)) else "n/a"),
         ))
+    if st.get("feed_open") or st.get("feed_sessions"):
+        rows.append((
+            "online feeds",
+            f"{st.get('feed_open', 0)} open"
+            f" ({st.get('feed_sessions', 0)} sessions,"
+            f" {st.get('feed_deltas', 0)} deltas)"
+            + (f" · feed {_rate('feed_deltas_per_s')}" if live else ""),
+        ))
+    if st.get("watch_subscribers") or st.get("watch_events"):
+        rows.append((
+            "watchers",
+            f"{st.get('watch_subscribers', 0)}"
+            f" ({st.get('watch_events', 0)} events streamed)",
+        ))
     if st.get("journal_path"):
         rows.append((
             "dispatch journal",
@@ -133,6 +148,56 @@ def service_section() -> str:
         f"<table>{cells}</table>"
         f'<p><a href="{html.escape(murl)}">live metrics</a> '
         "(Prometheus text)</p>"
+        + _verdict_panel(client, st)
+    )
+
+
+def _verdict_panel(client, st: dict, limit: int = 10) -> str:
+    """Live-verdict panel: a bounded tail of the daemon's ``/watch``
+    channel (replay only the last ``limit`` WAL rows, via
+    ``Last-Event-ID``).  The earliest violation in view is highlighted
+    — the first thing an operator wants off an online monitor."""
+    wal_rows = st.get("wal_rows") or 0
+    if not wal_rows:
+        return ""
+    events = []
+    try:
+        for off, row in client.watch(last_id=max(-1, wal_rows - limit - 1),
+                                     timeout=1.0):
+            events.append((off, row))
+            if off >= wal_rows - 1 or len(events) >= limit:
+                break
+    except Exception:  # noqa: BLE001 — the panel is best-effort
+        return ""
+    if not events:
+        return ""
+    first_violation = min(
+        (off for off, row in events
+         if (row.get("result") or {}).get("valid?") is False),
+        default=None,
+    )
+    cells = []
+    for off, row in events:
+        res = row.get("result") or {}
+        valid = res.get("valid?")
+        cls = _valid_class(valid)
+        if off == first_violation:
+            cls += " first-violation"
+        cells.append(
+            f'<tr class="{cls}">'
+            f"<td>#{off}</td>"
+            f"<td>{html.escape(str(row.get('req'))[:12])}</td>"
+            f"<td>{html.escape(str(row.get('stream')))}"
+            f"[{html.escape(str(row.get('idx')))}]</td>"
+            f"<td>{html.escape(str(valid))}</td>"
+            f"<td>{html.escape(str(res.get('engine', '')))}</td></tr>"
+        )
+    return (
+        '<h3>Settled verdicts <span class="live">●&nbsp;watch</span></h3>'
+        "<table><tr><th>wal row</th><th>run</th><th>partition</th>"
+        "<th>valid?</th><th>engine</th></tr>"
+        + "".join(cells)
+        + "</table>"
     )
 
 

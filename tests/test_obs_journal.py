@@ -276,3 +276,96 @@ def test_wal_sink_binds_one_request_id(tmp_path):
     rows = journal.read_verdict_rows(path)
     assert [(r["req"], r["stream"], r["idx"]) for r in rows] == [
         ("req-abc", "main", 3)]
+
+
+# ---------------------------------------------------------------------------
+# tail-follow (the shared /watch + WAL-replay + calibrate reader)
+# ---------------------------------------------------------------------------
+
+
+def _wal_with_damage(tmp_path):
+    """A WAL with four valid rows interleaved with every damage class
+    the readers must skip (torn JSON, schema drift, blank line)."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    wal.append("r1", "main", 0, _verdict(0))
+    with open(path, "a") as f:
+        f.write("{torn json\n")
+        f.write("\n")
+        f.write(json.dumps({"v": 1, "ts": 1.0}) + "\n")  # schema-bad
+    wal.append("r1", "main", 1, _verdict(1))
+    wal.append("r2", "main", 0, _verdict(2))
+    wal.append("r2", "main", 1, _verdict(3))
+    return path
+
+
+def test_follow_rows_offsets_are_stable_over_damage(tmp_path):
+    """Damaged lines consume NO offset — an offset is a stable resume
+    cursor (`Last-Event-ID`) even when the file holds torn lines
+    between the rows it numbers."""
+    path = _wal_with_damage(tmp_path)
+    pairs = list(journal.follow_rows(
+        (path,), journal.validate_verdict_row))
+    assert [off for off, _ in pairs] == [0, 1, 2, 3]
+    assert [r["result"]["op_count"] for _, r in pairs] == [10, 11, 12, 13]
+    # resuming from a cursor replays exactly the suffix, same offsets
+    resumed = list(journal.follow_rows(
+        (path,), journal.validate_verdict_row, start=2))
+    assert resumed == pairs[2:]
+
+
+def test_wal_tail_polls_incrementally_and_resumes(tmp_path):
+    """WalTail.poll returns only the delta since the last poll, with
+    the same offsets follow_rows assigns; a fresh tail with `start`
+    replays only the suffix past the cursor."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    tail = journal.WalTail(path)
+    assert tail.poll() == []  # absent → empty, never raises
+    wal.append("r1", "main", 0, _verdict(0))
+    wal.append("r1", "main", 1, _verdict(1))
+    first = tail.poll()
+    assert [off for off, _ in first] == [0, 1]
+    assert tail.poll() == []  # nothing new
+    wal.append("r2", "main", 0, _verdict(2))
+    assert [off for off, _ in tail.poll()] == [2]
+    # Last-Event-ID resume: a fresh follower starting at 2 sees only
+    # the tail row, numbered identically
+    late = journal.WalTail(path, start=2)
+    assert [(off, r["req"]) for off, r in late.poll()] == [(2, "r2")]
+
+
+def test_wal_tail_holds_torn_tail_until_complete(tmp_path):
+    """An in-progress tail line without its newline is pending, not
+    skipped: poll returns nothing for it, and the row is delivered
+    exactly once — at the right offset — when its remainder lands."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    wal.append("r1", "main", 0, _verdict(0))
+    tail = journal.WalTail(path)
+    assert [off for off, _ in tail.poll()] == [0]
+    with open(path, "a") as f:  # writer cut mid-append
+        f.write('{"v": 1, "ts": 2.0, "req": "r1", "str')
+    assert tail.poll() == []  # pending, not lost
+    with open(path, "a") as f:  # the remainder arrives
+        f.write('eam": "main", "idx": 1, "result": {}}\n')
+    got = tail.poll()
+    assert [(off, r["idx"]) for off, r in got] == [(1, 1)]
+    assert tail.poll() == []
+
+
+def test_wal_tail_detects_compaction_and_restarts(tmp_path):
+    """compact()'s atomic-rename rewrite changes the inode: the
+    follower restarts at offset 0 of the new file and re-delivers the
+    retained rows (safe — verdicts are monotone and rows carry full
+    identity)."""
+    path = str(tmp_path / "wal.jsonl")
+    wal = journal.VerdictWAL(path)
+    for i in range(3):
+        wal.append("old", "main", i, _verdict(i))
+    wal.append("live", "main", 0, _verdict(7))
+    tail = journal.WalTail(path)
+    assert len(tail.poll()) == 4
+    assert wal.compact(keep_reqs={"live"}) == 1
+    got = tail.poll()
+    assert [(off, r["req"]) for off, r in got] == [(0, "live")]

@@ -1,0 +1,481 @@
+"""Streaming-ingest tests (POST /feed + the online-checking seam).
+
+The contract under test: a feed session is a *schedule* for the same
+verdicts a one-shot ``/check`` of the same histories produces — never
+a different checker.  However the work is sliced into deltas (whole
+histories, raw op events, or both), whatever engine configuration is
+active (kernel route, dispatch-window depth, decomposition on/off),
+and however many daemon lives the session spans (duplicate appends,
+lost responses, kill -9 + WAL replay), the settled results at close
+are byte-identical — canonical JSON — to the in-process batch check.
+Streaming changes WHEN violations surface, never WHAT the verdict is.
+"""
+
+import json
+import random
+import tempfile
+import time
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.history import History
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.serve import (
+    CheckerDaemon,
+    ServiceClient,
+    protocol,
+)
+from jepsen_tpu.serve.smoke import _canon
+from jepsen_tpu.synth import generate_history as _gen
+from jepsen_tpu.synth import generate_mr_history as _gen_mr
+
+#: the two kernel routes the acceptance gate names (the explicit
+#: closure cap forces the generic frontier kernel)
+ROUTES = {
+    "dense": dict(slot_cap=32, max_dispatch=4),
+    "frontier": dict(slot_cap=32, max_dispatch=4, max_closure=9),
+}
+
+
+def cas_corpus(seed=45100, n=6):
+    """Mixed-length CAS-register histories, some violating."""
+    rng = random.Random(seed)
+    return [
+        _gen(rng, n_procs=3 + (i % 3), n_ops=12 + 8 * (i % 4),
+             crash_p=0.02, corrupt=(i % 2 == 0))
+        for i in range(n)
+    ]
+
+
+def soup_chunks(rng, items):
+    """Slice ``items`` into randomly sized contiguous chunks (1..5) —
+    the "op soup" schedule: the daemon must be indifferent to how the
+    stream was diced."""
+    out, i = [], 0
+    while i < len(items):
+        k = rng.randint(1, 5)
+        out.append(items[i:i + k])
+        i += k
+    return out
+
+
+def feed_all(client, model, kw, batch, seed=0, req=None):
+    """One full feed session shipping ``batch`` in soup chunks;
+    returns (results, sum of replayed-row counts across appends)."""
+    rng = random.Random(seed)
+    session = client.open_feed(model, kw, req=req)
+    replayed = 0
+    for chunk in soup_chunks(rng, batch):
+        ack = session.append(histories=chunk, t_inv=time.time())
+        replayed += ack.get("replayed", 0)
+    return session.close(), replayed
+
+
+# ---------------------------------------------------------------------------
+# incremental feed ≡ batch, across routes / windows / decomposition
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("route", sorted(ROUTES))
+@pytest.mark.parametrize("window", [1, 4])
+def test_feed_matches_batch_across_routes_and_windows(
+        route, window, monkeypatch):
+    """Soup-chunked incremental ingest settles byte-identically to the
+    one-shot batch check, on both kernel routes, with the dispatch
+    pipeline serial (window=1) and deep (window=4)."""
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_WINDOW", str(window))
+    model = m.cas_register(0)
+    kw = ROUTES[route]
+    batch = cas_corpus(seed=100 + window, n=6)
+    expected = wgl.check_batch(model, batch, **kw)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        results, _ = feed_all(client, model, kw, batch,
+                              seed=17 * window)
+        assert len(results) == len(batch)
+        assert _canon(results) == _canon(expected)
+        assert any(r.get("valid?") is False for r in results)
+    finally:
+        daemon.stop()
+
+
+@pytest.mark.parametrize("decompose", ["0", "1"])
+def test_feed_matches_batch_with_decomposition_toggled(
+        decompose, monkeypatch):
+    """A partitionable multi-register corpus through the feed, with the
+    key-partition front-end forced on and off — both sides of each
+    comparison see the same toggle, and feed ≡ batch holds in both
+    worlds."""
+    monkeypatch.setenv("JEPSEN_TPU_ENGINE_DECOMPOSE", decompose)
+    rng = random.Random(45100)
+    model = m.multi_register({k: 0 for k in range(8)})
+    batch = [
+        _gen_mr(rng, n_procs=4, n_ops=36, n_keys=8, n_values=4,
+                crash_p=0.02, corrupt=(i % 3 == 0))
+        for i in range(5)
+    ]
+    kw = dict(slot_cap=32, max_dispatch=4)
+    expected = wgl.check_batch(model, batch, **kw)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        results, _ = feed_all(client, model, kw, batch, seed=3)
+        assert _canon(results) == _canon(expected)
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# op-granularity ingest (the interpreter shipper's wire shape)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_feed_op_soup_matches_batch(seed):
+    """Raw history events — invocations AND completions, in
+    history-append order, diced into random chunks — assemble
+    server-side into the same verdict the batch check gives the whole
+    history."""
+    rng = random.Random(seed)
+    model = m.cas_register(0)
+    h = _gen(rng, n_procs=4, n_ops=24, crash_p=0.02, corrupt=True)
+    kw = ROUTES["dense"]
+    expected = wgl.check_batch(model, [h], **kw)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        session = client.open_feed(model, kw)
+        for chunk in soup_chunks(rng, h.to_dicts()):
+            session.append(ops=chunk, t_inv=time.time())
+        results = session.close()
+        # op-mode: ONE assembled-history verdict, last (and here only)
+        assert len(results) == 1
+        assert _canon(results) == _canon(expected)
+    finally:
+        daemon.stop()
+
+
+def test_feed_mixed_histories_and_ops_in_one_session():
+    """A session may carry both whole histories and an op stream: the
+    close answers client histories in feed order, the assembled
+    op-history verdict LAST — each byte-identical to its batch check."""
+    rng = random.Random(7)
+    model = m.cas_register(0)
+    hists = cas_corpus(seed=7, n=3)
+    streamed = _gen(rng, n_procs=3, n_ops=20, corrupt=True)
+    kw = ROUTES["dense"]
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        session = client.open_feed(model, kw)
+        op_chunks = soup_chunks(rng, streamed.to_dicts())
+        for i, h in enumerate(hists):
+            session.append(histories=[h],
+                           ops=op_chunks[i] if i < len(op_chunks)
+                           else None,
+                           t_inv=time.time())
+        for chunk in op_chunks[len(hists):]:
+            session.append(ops=chunk, t_inv=time.time())
+        results = session.close()
+        assert len(results) == len(hists) + 1
+        assert _canon(results[:len(hists)]) == _canon(
+            wgl.check_batch(model, hists, **kw))
+        assert _canon(results[-1:]) == _canon(
+            wgl.check_batch(model, [streamed], **kw))
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# retry idempotency on the feed wire
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_seq_is_acked_without_reingesting():
+    """A retried append (same seq — the response was lost on the wire)
+    is acknowledged as a duplicate and ingests NOTHING: the close
+    still answers one result per history, identical to the batch."""
+    model = m.cas_register(0)
+    batch = cas_corpus(seed=21, n=4)
+    kw = ROUTES["dense"]
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        session = client.open_feed(model, kw)
+        session.append(histories=[batch[0]])  # seq 0
+        # replay seq 0 verbatim, as a retry loop would
+        body = protocol.feed_append_request(session.sid, 0,
+                                            histories=[batch[0]])
+        code, resp = client._resilient_post("/feed", body)
+        payload = protocol.decode_body(resp)
+        assert code == 200
+        assert payload.get("duplicate") is True
+        assert payload.get("accepted") == 0
+        for h in batch[1:]:
+            session.append(histories=[h])
+        results = session.close()
+        assert len(results) == len(batch)
+        assert _canon(results) == _canon(
+            wgl.check_batch(model, batch, **kw))
+    finally:
+        daemon.stop()
+
+
+def test_reopen_same_session_id_is_idempotent():
+    """An open retried under the same request id (the ack was lost)
+    lands on the SAME live session instead of forking a second one."""
+    model = m.cas_register(0)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        first = client.open_feed(model, ROUTES["dense"])
+        assert first.resumed is False
+        again = client.open_feed(model, ROUTES["dense"], req=first.req)
+        assert again.sid == first.sid
+        assert again.resumed is True
+        assert daemon.status()["feed_open"] == 1
+        first.append(histories=cas_corpus(seed=5, n=2))
+        assert len(first.close()) == 2
+    finally:
+        daemon.stop()
+
+
+def test_append_to_unknown_session_is_a_client_error():
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        body = protocol.feed_append_request("no-such-session", 0,
+                                            histories=[])
+        code, resp = client._resilient_post("/feed", body)
+        assert code == 404
+        assert "unknown feed session" in json.loads(resp)["error"]
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# `jepsen_tpu top`: the settled-verdicts pane + the unreachable exit
+# ---------------------------------------------------------------------------
+
+
+def test_top_once_exits_nonzero_when_all_daemons_unreachable(capsys):
+    """A monitoring script pointing `top --once` at a dead fleet must
+    see a nonzero exit and one error line per address — not a clean 0
+    with an empty frame."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.serve.client import reset_breakers
+    from jepsen_tpu.util import free_port
+
+    reset_breakers()
+    port = free_port()
+    rc = cli.run_cli(cli.default_commands(),
+                     ["top", "--port", str(port), "--once"])
+    assert rc == cli.EXIT_UNKNOWN
+    out = capsys.readouterr()
+    assert "(unreachable)" in out.out
+    assert f"top: 127.0.0.1:{port}:" in out.err
+
+
+def test_top_once_renders_settled_verdicts_from_the_wal(capsys):
+    """With a live daemon whose WAL holds settled rows, `top --once`
+    tails the last rows off /watch into the verdicts pane."""
+    import tempfile as tempfile_mod
+
+    from jepsen_tpu import cli
+    from jepsen_tpu.serve.client import reset_breakers
+
+    model = m.cas_register(0)
+    batch = cas_corpus(seed=13, n=3)
+    tmp = tempfile_mod.mkdtemp(prefix="jepsen-top-verdicts-")
+    daemon = CheckerDaemon(port=0, wal_path=tmp + "/wal.jsonl")
+    daemon.start(block=False)
+    try:
+        reset_breakers()
+        client = ServiceClient(port=daemon.port)
+        client.check_batch(model, batch, slot_cap=32)
+        rc = cli.run_cli(cli.default_commands(),
+                         ["top", "--port", str(daemon.port), "--once"])
+        out = capsys.readouterr().out
+        assert rc == cli.EXIT_VALID
+        assert "── verdicts" in out
+        assert "(no settled verdicts yet)" not in out
+        assert "✗" in out  # the corrupt histories' violations made it
+    finally:
+        daemon.stop()
+
+
+def test_web_service_section_renders_live_verdict_panel(monkeypatch):
+    """The web UI's service panel tails /watch: settled rows render as
+    the verdicts table with the FIRST violation highlighted."""
+    from jepsen_tpu import web
+    from jepsen_tpu.serve.client import reset_breakers
+
+    model = m.cas_register(0)
+    batch = cas_corpus(seed=13, n=3)
+    tmp = tempfile.mkdtemp(prefix="jepsen-web-verdicts-")
+    daemon = CheckerDaemon(port=0, wal_path=tmp + "/wal.jsonl")
+    daemon.start(block=False)
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_PORT", str(daemon.port))
+    try:
+        reset_breakers()
+        ServiceClient(port=daemon.port).check_batch(
+            model, batch, slot_cap=32)
+        html_out = web.service_section()
+        assert "Settled verdicts" in html_out
+        assert html_out.count("first-violation") == 1
+        assert "valid-false" in html_out
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# the interpreter's live shipper (JEPSEN_TPU_LIVE=1)
+# ---------------------------------------------------------------------------
+
+
+def test_live_shipper_ships_events_and_closes_with_online_verdict(
+        monkeypatch):
+    """The shipper's full path against a real daemon: offered history
+    events (nemesis events filtered out) land in a feed session and
+    the close verdict matches the batch check of the same history."""
+    from jepsen_tpu import interpreter
+
+    rng = random.Random(3)
+    model = m.cas_register(0)
+    h = _gen(rng, n_procs=3, n_ops=16, crash_p=0.0, corrupt=True)
+    daemon = CheckerDaemon(port=0)
+    daemon.start(block=False)
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_PORT", str(daemon.port))
+    try:
+        shipper = interpreter._LiveShipper(model)
+        shipper.offer({"process": "nemesis", "type": "info",
+                       "f": "start", "value": None})  # filtered out
+        for op in h.to_dicts():
+            shipper.offer(op)
+        shipper.close()
+        assert shipper.final_results is not None
+        assert _canon(shipper.final_results[-1:]) == _canon(
+            wgl.check_batch(model, [h]))
+    finally:
+        daemon.stop()
+
+
+def test_live_shipper_never_fails_the_workload_without_a_daemon(
+        monkeypatch):
+    """No daemon listening: the shipper goes dead quietly — offers are
+    no-ops, close returns promptly, nothing raises.  Online checking
+    degrades to post-hoc, never the reverse."""
+    from jepsen_tpu import interpreter
+    from jepsen_tpu.serve.client import reset_breakers
+    from jepsen_tpu.util import free_port
+
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_PORT", str(free_port()))
+    reset_breakers()
+    shipper = interpreter._LiveShipper(m.cas_register(0))
+    for op in cas_corpus(seed=2, n=1)[0].to_dicts():
+        shipper.offer(op)
+    shipper.close(wait_s=30.0)
+    assert shipper.final_results is None
+    assert shipper._dead.is_set()
+
+
+# ---------------------------------------------------------------------------
+# crash resume: the session id doubles as the verdict-WAL run id
+# ---------------------------------------------------------------------------
+
+
+def test_feed_resumes_across_daemon_lives_via_wal_replay():
+    """A feed interrupted by a daemon death resumes under the SAME
+    session id against a fresh daemon on the same WAL: the slots the
+    first life settled replay from the log instead of re-dispatching,
+    and the close is byte-identical to the batch check."""
+    model = m.cas_register(0)
+    batch = cas_corpus(seed=33, n=6)
+    kw = ROUTES["dense"]
+    expected = wgl.check_batch(model, batch, **kw)
+    tmp = tempfile.mkdtemp(prefix="jepsen-feed-resume-")
+    wal = tmp + "/wal.jsonl"
+    sid = "feed-resume-1"
+
+    daemon = CheckerDaemon(port=0, wal_path=wal)
+    daemon.start(block=False)
+    try:
+        client = ServiceClient(port=daemon.port)
+        session = client.open_feed(model, kw, req=sid)
+        for h in batch[:3]:  # mid-feed: half the run is settled
+            session.append(histories=[h], t_inv=time.time())
+    finally:
+        daemon.stop()  # the "crash": session dies open, WAL survives
+
+    daemon2 = CheckerDaemon(port=0, wal_path=wal)
+    daemon2.start(block=False)
+    try:
+        client2 = ServiceClient(port=daemon2.port)
+        results, replayed = feed_all(client2, model, kw, batch,
+                                     seed=9, req=sid)
+        assert replayed >= 3  # life 1's settled rows came from the log
+        assert len(results) == len(batch)
+        assert _canon(results) == _canon(expected)
+        assert daemon2.status()["replayed"] >= 3
+    finally:
+        daemon2.stop()
+
+
+@pytest.mark.slow
+def test_feed_survives_kill9_mid_feed_and_resumed_feed_replays():
+    """The full crash drill against a REAL daemon subprocess: kill -9
+    mid-feed with the WAL tail torn mid-append, restart, resume the
+    same session id, re-feed everything — the retried rows replay from
+    the log and the close is byte-identical to the batch check."""
+    from jepsen_tpu.serve import client as client_mod
+    from jepsen_tpu.serve.chaos import (
+        _sigkill,
+        _spawn_daemon,
+        _tear_tail,
+        _wait_healthy,
+    )
+    from jepsen_tpu.util import free_port
+
+    model = m.cas_register(0)
+    batch = cas_corpus(seed=77, n=6)
+    kw = ROUTES["dense"]
+    expected = wgl.check_batch(model, batch, **kw)
+    tmp = tempfile.mkdtemp(prefix="jepsen-feed-kill9-")
+    wal = tmp + "/verdict-wal.jsonl"
+    port = free_port()
+    sid = "feed-kill9-1"
+    client_mod.reset_breakers()
+
+    proc = _spawn_daemon(port, tmp)
+    try:
+        client = ServiceClient(port=port)
+        assert _wait_healthy(client, proc), "daemon A did not come up"
+        session = client.open_feed(model, kw, req=sid)
+        for h in batch[:3]:
+            session.append(histories=[h], t_inv=time.time())
+    finally:
+        _sigkill(proc)
+    _tear_tail(wal)  # the kill landed mid-append
+
+    client_mod.reset_breakers()
+    proc2 = _spawn_daemon(port, tmp)
+    try:
+        client2 = ServiceClient(port=port)
+        assert _wait_healthy(client2, proc2), "daemon B did not come up"
+        results, replayed = feed_all(client2, model, kw, batch,
+                                     seed=11, req=sid)
+        # life A settled 3 histories' slots; the torn line cost ONE row
+        assert replayed >= 2
+        assert len(results) == len(batch)
+        assert _canon(results) == _canon(expected)
+    finally:
+        _sigkill(proc2)
