@@ -2,16 +2,17 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke docker-smoke docker-up docker-down
+.PHONY: test bench bench-gate check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
 
 # the full local gate: static analysis + unit tests + the
 # observability, pipeline, checker-service, slice-dispatch,
-# decomposition, auto-tune, transactional-screen, and closure/union
-# kernel smoke checks
-check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke
+# decomposition, auto-tune, transactional-screen, closure/union
+# kernel, and drift-sentinel smoke checks, plus the bench regression
+# gate over the recorded window history
+check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke bench-gate
 
 # jtlint static analysis (doc/static-analysis.md): all seven passes —
 # trace-safety, lock-discipline, concurrency (whole-program race
@@ -143,8 +144,29 @@ kernels-smoke:
 obs-fleet-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.obs.fleet_smoke
 
+# drift-sentinel gate (doc/observability.md "Drift sentinel"): a
+# synthetic dispatch journal with one shape's execute_s inflated 3×,
+# warm-scanned by a resident daemon — the sentinel must flag that
+# shape and ONLY that shape (score ~3×, one latched crossing, a
+# durable drift-retune marker row), with the drift block visible on
+# /status, the status table, top --once, and the jepsen_drift_*
+# gauges on a Prometheus-valid /metrics; plus a POST /profile
+# round-trip producing a loadable capture manifest
+drift-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.obs.drift_smoke
+
 bench:
 	python bench.py
+
+# bench regression gate (doc/observability.md "Bench regression
+# gates"): one fresh reduced-L window vs the best recorded same-label,
+# same-device-kind window in BENCH_tpu_windows.jsonl — exits nonzero
+# when any vs_baseline metric lands below best × 0.85.  On a CPU-only
+# CI host with no recorded cpu window this passes vacuously (gate runs
+# never append to the history), and on the TPU campaign host it stops
+# kernel PRs from silently losing recorded throughput.
+bench-gate:
+	env JAX_PLATFORMS=cpu JEPSEN_TPU_BENCH_L=200 python bench.py --gate
 
 # BASELINE config 2: etcd register + partition nemesis over real SSH in
 # the dockerized 5-node cluster; artifacts land in docker/smoke-store/.

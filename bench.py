@@ -50,6 +50,9 @@ ARTIFACT = os.path.join(_HERE, "BENCH_tpu_latest.json")
 WINDOWS = os.path.join(_HERE, "BENCH_tpu_windows.jsonl")
 #: per-attempt probe diagnostics (JSONL, appended across runs)
 PROBE_TRAIL = os.path.join(_HERE, "bench_probe_trail.jsonl")
+#: --gate default: a fresh window must reach this fraction of the best
+#: recorded same-label, same-device-kind window
+GATE_TOLERANCE = 0.85
 
 
 def default_shapes(on_accelerator, n_devices=1):
@@ -577,6 +580,126 @@ def _windows_summary(recs):
         "first": recs[0].get("captured_at"),
         "last": recs[-1].get("captured_at"),
     }
+
+
+def gate_candidates(recs, platform, label=None):
+    """Recorded windows comparable to a fresh gate run: same label
+    (None = the unlabeled cas-register round records) and same device
+    kind (``diag.platform``) — a recorded TPU window must never gate a
+    CPU-fallback run, and a labeled side-bench never gates the round
+    record."""
+    out = []
+    for rec in recs:
+        if (rec.get("bench") or None) != (label or None):
+            continue
+        if not rec.get("value"):
+            continue
+        if ((rec.get("diag") or {}).get("platform")) != platform:
+            continue
+        out.append(rec)
+    return out
+
+
+def gate_compare(fresh, best, tolerance):
+    """Per-metric regression table → (ok, rows).  Compares the
+    length-normalized ``vs_baseline`` pair (conservative + pipelined)
+    so a reduced-L gate run is apples-to-apples with full-length
+    windows; the floor is ``best × tolerance``.  A metric either side
+    lacks is skipped, never failed — older windows predate the
+    pipelined pair."""
+    rows = []
+    ok = True
+    for key in ("vs_baseline", "vs_baseline_pipelined"):
+        b, f = best.get(key), fresh.get(key)
+        if not isinstance(b, (int, float)) or not isinstance(f, (int, float)):
+            continue
+        if b <= 0:
+            continue
+        floor = b * tolerance
+        passed = f >= floor
+        ok = ok and passed
+        rows.append({
+            "metric": key, "fresh": round(float(f), 4),
+            "best": round(float(b), 4), "floor": round(floor, 4),
+            "ok": passed,
+        })
+    return ok, rows
+
+
+def gate_verdict(fresh, recs, platform, tolerance, label=None):
+    """The full ``--gate`` decision as data (tests drive this pure
+    half directly): pick the best comparable window, compare, and
+    report.  No comparable window is a VACUOUS PASS — the gate's job
+    is "never silently lose recorded throughput", and with nothing
+    recorded for this device kind there is nothing to lose."""
+    cands = gate_candidates(recs, platform, label)
+    if not cands:
+        return {
+            "gate": "pass",
+            "reason": f"no recorded {platform} window to compare "
+            "against (vacuous pass)",
+            "tolerance": tolerance, "platform": platform, "metrics": [],
+        }
+
+    def rank(rec):
+        vsb = rec.get("vs_baseline")
+        if vsb is None:
+            vsb = (rec.get("value") or 0) / NORTH_STAR
+        return vsb
+
+    best = max(cands, key=rank)
+    ok, rows = gate_compare(fresh, best, tolerance)
+    return {
+        "gate": "pass" if ok else "fail",
+        "tolerance": tolerance,
+        "platform": platform,
+        "best_captured_at": best.get("captured_at"),
+        "windows_compared": len(cands),
+        "metrics": rows,
+    }
+
+
+def run_gate(tolerance):
+    """``--gate``: one fresh bench window vs the best recorded
+    same-label, same-device-kind window; exit 1 when any metric lands
+    below ``best × tolerance``.  Gate runs NEVER append to the window
+    history or touch the headline artifact — a gate must not move its
+    own goalposts."""
+    warnings = []
+    os.environ.setdefault("JEPSEN_TPU_PROBE_TRAIL", PROBE_TRAIL)
+    on_accel, probe_err = probe_accelerator()
+    if not on_accel:
+        warnings.append(f"accelerator unusable ({probe_err}); CPU fallback")
+    value, L, diag = run_bench(on_accel, warnings)
+    equiv = value * (L / BASELINE_L)
+    fresh = {
+        "metric": f"cas_register_{L}op_histories_per_sec",
+        "value": round(value, 2),
+        "unit": "histories/sec",
+        "vs_baseline": round(equiv / NORTH_STAR, 4),
+    }
+    pipelined = (diag.get("samples") or [{}])[0].get("hps_pipelined")
+    if pipelined:
+        fresh["value_pipelined"] = pipelined
+        fresh["vs_baseline_pipelined"] = round(
+            pipelined * (L / BASELINE_L) / NORTH_STAR, 4)
+    verdict = gate_verdict(fresh, _read_windows(), diag.get("platform"),
+                           tolerance)
+    verdict["fresh"] = fresh
+    if warnings:
+        verdict["warnings"] = "; ".join(warnings)
+    for row in verdict["metrics"]:
+        mark = "ok" if row["ok"] else "REGRESSION"
+        print(
+            f"  {row['metric']:<26} fresh {row['fresh']:>9}"
+            f" vs best {row['best']:>9}"
+            f" (floor {row['floor']:>9})  {mark}",
+            file=sys.stderr,
+        )
+    if not verdict["metrics"]:
+        print(f"  gate: {verdict.get('reason')}", file=sys.stderr)
+    _emit(verdict)
+    return 0 if verdict["gate"] == "pass" else 1
 
 
 def bench_decompose():
@@ -1158,7 +1281,24 @@ def main():
         "vs off (decomposed vs undecomposed histories/s, n_partitions, "
         "oracle routing before/after)",
     )
+    ap.add_argument(
+        "--gate",
+        action="store_true",
+        help="regression gate: run one fresh window and exit nonzero "
+        "when it lands below the best recorded same-label, "
+        "same-device-kind window × --gate-tolerance (never appends "
+        "to the window history)",
+    )
+    ap.add_argument(
+        "--gate-tolerance",
+        type=float,
+        default=GATE_TOLERANCE,
+        help="fraction of the best recorded window the fresh run must "
+        "reach (default 0.85)",
+    )
     args, _unknown = ap.parse_known_args()
+    if args.gate:
+        sys.exit(run_gate(args.gate_tolerance))
     if args.against_service:
         bench_service()
         return

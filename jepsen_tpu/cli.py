@@ -140,6 +140,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
         "checker-engines.md 'Slice-native dispatch')",
     )
     p.add_argument(
+        "--profile",
+        action="store_true",
+        help="wrap the whole run in a jax.profiler capture window "
+        "plus device memory high-water sampling; the artifact lands "
+        "in the store dir beside trace.json (profile/profile.json; "
+        "doc/observability.md 'Device profiling')",
+    )
+    p.add_argument(
         "--engine-window",
         type=_engine_window_arg,
         help="max in-flight device dispatches in the pipelined checker "
@@ -177,6 +185,8 @@ def test_opts_to_map(args: argparse.Namespace) -> dict:
         test["tracing"] = args.tracing
     if getattr(args, "no_obs", False):
         test["obs?"] = False
+    if getattr(args, "profile", False):
+        test["profile?"] = True
     if getattr(args, "engine_window", None) is not None:
         # consumed by the linearizability checkers (checker.linearizable,
         # independent.batched_linearizable) on their way into
@@ -232,6 +242,42 @@ def given_opts(args: argparse.Namespace) -> dict:
     return {k: v for k, v in vars(args).items() if v is not None}
 
 
+def _run_profiled(test: dict) -> dict:
+    """``--profile``: run the test inside one obs.profiling capture
+    window (jax.profiler trace + device memory high-water), then move
+    the artifact into the store dir beside trace.json.  The store dir
+    only exists once the run has a start-time, so the capture lands in
+    a temp dir first."""
+    import shutil
+    import tempfile
+
+    from . import core
+    from .obs import profiling as obs_profiling
+
+    box: dict = {}
+
+    def _work():
+        box["result"] = core.run(test)
+
+    tmp = tempfile.mkdtemp(prefix="jepsen-tpu-profile-")
+    try:
+        obs_profiling.capture(tmp, label=str(test.get("name", "")),
+                              work=_work)
+        result = box["result"]
+        if result.get("store?", True) and result.get("start-time"):
+            from . import store as store_mod
+
+            dest = store_mod.path(result, "profile")
+            shutil.rmtree(dest, ignore_errors=True)
+            shutil.move(tmp, dest)
+            tmp = None
+            print(f"device profile → {dest}")
+        return result
+    finally:
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_test(test: dict) -> int:
     """Run one prepared test map; returns its exit code."""
     import os
@@ -253,7 +299,10 @@ def run_test(test: dict) -> int:
     if window is not None:
         os.environ["JEPSEN_TPU_ENGINE_WINDOW"] = str(window)
     try:
-        result = core.run(test)
+        if test.get("profile?"):
+            result = _run_profiled(test)
+        else:
+            result = core.run(test)
     finally:
         if window is not None:
             if prior is None:
@@ -467,6 +516,57 @@ def serve_cmd() -> Dict[str, dict]:
         )
         return EXIT_VALID
 
+    def add_profile_opts(p):
+        add_daemon_opts(p)
+        p.add_argument(
+            "--seconds", type=float, default=1.0,
+            help="capture window length in seconds (clamped to 30)",
+        )
+        p.add_argument(
+            "--label", default="",
+            help="label recorded in the capture manifest (and the "
+            "capture directory name)",
+        )
+        p.add_argument(
+            "--dir", dest="out_dir", default=None,
+            help="capture directory (default: a timestamped subdir of "
+            "the daemon's profiles/ dir)",
+        )
+
+    def profile(args) -> int:
+        from .serve import ServiceClient, ServiceError, ServiceUnavailable
+
+        c = ServiceClient(host=args.host, port=args.port)
+        try:
+            out = c.profile(seconds=args.seconds, label=args.label,
+                            out_dir=args.out_dir)
+        except ServiceUnavailable:
+            print(
+                f"no checker service at http://{c.host}:{c.port}/ "
+                "(start one: jepsen-tpu serve --checker)",
+                file=sys.stderr,
+            )
+            return EXIT_UNKNOWN
+        except ServiceError as e:
+            print(f"profile failed: {e}", file=sys.stderr)
+            return EXIT_UNKNOWN
+        man = out.get("manifest") or {}
+        peaks = ", ".join(
+            f"{d.get('device')} "
+            + (f"{d['peak_bytes_in_use'] / 1e6:.1f}MB"
+               if isinstance(d.get("peak_bytes_in_use"), (int, float))
+               else "n/a")
+            for d in (man.get("memory") or [])
+        ) or "no devices"
+        print(
+            f"profile capture → {out.get('dir')}"
+            f" ({man.get('wall_seconds', 0)}s, "
+            + ("trace collected" if man.get("trace") else "no trace")
+            + ")"
+        )
+        print(f"  hbm peak: {peaks}")
+        return EXIT_VALID
+
     def add_top_opts(p):
         add_daemon_opts(p)
         p.add_argument(
@@ -582,11 +682,18 @@ def serve_cmd() -> Dict[str, dict]:
         },
         "top": {
             "help": "live fleet view of one or more checker daemons "
-            "(last-60s rates, queue wait, journal, settled verdicts; "
-            "--once for one frame, nonzero exit when no daemon "
-            "answers)",
+            "(last-60s rates, queue wait, journal, quarantine, drift, "
+            "settled verdicts; --once for one frame, nonzero exit "
+            "when no daemon answers)",
             "add_opts": add_top_opts,
             "run": top,
+        },
+        "profile": {
+            "help": "capture a bounded jax.profiler window + device "
+            "memory high-water on the resident checker daemon "
+            "(POST /profile; doc/observability.md 'Device profiling')",
+            "add_opts": add_profile_opts,
+            "run": profile,
         },
     }
 

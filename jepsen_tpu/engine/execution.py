@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .. import obs
+from ..obs import drift as obs_drift
 from ..obs import journal as obs_journal
 
 
@@ -416,7 +417,7 @@ class Executor:
         cal = _cal.active()
         compile_hit = ch["phase"] == "compile"
         ctx = self.journal_context
-        obs_journal.emit(
+        row = obs_journal.emit(
             kernel=str(plan.kernel),
             E=int(getattr(plan, "E", 0) or 0),
             C=int(getattr(plan, "C", 0) or 0),
@@ -435,6 +436,13 @@ class Executor:
             calibration=(cal.calibration_id if cal is not None else ""),
             trace_id=str(ctx.get("trace_id", "") or ""),
         )
+        if row is not None:
+            # drift sentinel rides the journal stream: score the
+            # settled row's measured cost against the model's estimate
+            # (obs.drift — observation only, never a dispatch decision)
+            sentinel = obs_drift.active()
+            if sentinel is not None:
+                sentinel.observe_row(row)
 
     def _settle_rows(self, plan, arrays, rows, ok, failed_at, overflow):
         """Escalate a chunk's overflows on-device, then assign verdicts

@@ -65,6 +65,15 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "ops/dense.py",
            "dense-kernel subset-union lowering (`matmul`/`scan`); env "
            "> calibration > default"),
+    EnvVar("JEPSEN_TPU_DRIFT", "1",
+           "serve/daemon.py",
+           "cost-model drift sentinel at the `serve()` production "
+           "entry (rides the dispatch journal); falsy disables"),
+    EnvVar("JEPSEN_TPU_DRIFT_THRESHOLD", "2.0",
+           "obs/drift.py",
+           "per-shape EWMA residual deviation (max(r, 1/r)) at which "
+           "a dispatch shape counts as stale and the sentinel "
+           "recommends a retune; must exceed 1.0"),
     EnvVar("JEPSEN_TPU_ELLE_SCREEN", "auto",
            "elle/cycles.py",
            "Elle cycle-screen routing: `auto`/`1` (device screens) or "

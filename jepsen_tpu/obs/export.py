@@ -300,6 +300,19 @@ def summary(tracer: Tracer, registry: MetricsRegistry) -> dict:
     ttv = registry.value("jepsen_run_first_violation_seconds")
     if ttv is not None:
         out["time-to-violation"] = round(ttv, 4)
+    # cost-model drift sentinel (obs.drift): the aggregate residual
+    # score and the retune recommendation become durable in
+    # results.json["obs"], so a stored run records that its estimates
+    # had gone stale — not just the live /status view
+    ds = registry.value("jepsen_drift_score")
+    if ds is not None:
+        out["drift-score"] = round(ds, 4)
+    stale = registry.value("jepsen_drift_stale_shapes")
+    if stale is not None:
+        out["drift-stale-shapes"] = int(stale)
+    rec = registry.value("jepsen_drift_retune_recommended")
+    if rec is not None:
+        out["retune-recommended"] = bool(rec)
     return out
 
 

@@ -1,0 +1,138 @@
+"""On-demand **device profiling** capture.
+
+One bounded window: start a ``jax.profiler`` trace, run the supplied
+work (or just sleep the window out), stop the trace, and sample every
+local device's memory high-water mark — then write a small loadable
+``profile.json`` manifest beside the raw trace directory so the web
+UI, the CLI, and tests all consume one shape.
+
+Everything degrades gracefully off-TPU: CPU devices usually answer
+``memory_stats() -> None`` (recorded as ``null``), and environments
+without a working ``jax.profiler`` backend still produce a manifest
+with ``trace: null`` — the memory inventory and wall-clock are still
+worth having.  Nothing here raises for a missing accelerator; only
+the caller's ``work`` exceptions propagate (after the trace is
+stopped).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import obs
+
+#: manifest filename inside every capture directory
+MANIFEST = "profile.json"
+#: hard cap on the idle capture window, seconds
+MAX_SECONDS = 30.0
+
+
+def capture_available() -> bool:
+    """True when a ``jax.profiler`` trace can plausibly be collected
+    (the module imports and exposes the start/stop pair).  Tests use
+    this for their skip marks; :func:`capture` itself never needs it."""
+    try:
+        import jax
+        return (hasattr(jax, "profiler")
+                and hasattr(jax.profiler, "start_trace")
+                and hasattr(jax.profiler, "stop_trace"))
+    except Exception:
+        return False
+
+
+def _memory_inventory() -> List[Dict[str, Any]]:
+    """Per-device memory stats, ``None``-tolerant (CPU backends)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        stats: Optional[Dict[str, Any]] = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        peak = None
+        if isinstance(stats, dict):
+            peak = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use"))
+        out.append({
+            "device": str(d),
+            "platform": getattr(d, "platform", ""),
+            "peak_bytes_in_use": peak,
+            "bytes_in_use":
+                stats.get("bytes_in_use") if isinstance(stats, dict)
+                else None,
+        })
+        if peak is not None:
+            obs.gauge_max("jepsen_device_hbm_peak_bytes", float(peak),
+                          device=str(d))
+    return out
+
+
+def capture(out_dir: str, seconds: float = 1.0, label: str = "",
+            work: Optional[Callable[[], Any]] = None) -> Dict[str, Any]:
+    """Run one bounded profiling window into ``out_dir``.
+
+    With ``work`` the window lasts exactly as long as the work; idle
+    captures sleep ``seconds`` (clamped to :data:`MAX_SECONDS`).
+    Returns the manifest dict (also written to ``profile.json``).
+    ``work`` exceptions propagate after the trace is stopped."""
+    seconds = max(0.0, min(float(seconds), MAX_SECONDS))
+    os.makedirs(out_dir, exist_ok=True)
+    trace_dir = os.path.join(out_dir, "trace")
+    started = False
+    try:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception:
+        started = False
+    t0 = time.monotonic()
+    try:
+        if work is not None:
+            work()
+        else:
+            time.sleep(seconds)
+    finally:
+        wall = time.monotonic() - t0
+        if started:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                started = False
+        memory = _memory_inventory()
+        manifest = {
+            "v": 1,
+            "label": str(label or ""),
+            "requested_seconds": seconds,
+            "wall_seconds": round(wall, 6),
+            "idle": work is None,
+            "trace": ("trace" if started and os.path.isdir(trace_dir)
+                      else None),
+            "memory": memory,
+        }
+        tmp = os.path.join(out_dir, MANIFEST + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, os.path.join(out_dir, MANIFEST))
+        obs.count("jepsen_profile_captures_total")
+    return manifest
+
+
+def load_manifest(out_dir: str) -> Optional[Dict[str, Any]]:
+    """Read a capture directory's manifest back, or None."""
+    p = os.path.join(out_dir, MANIFEST)
+    try:
+        with open(p, "r", encoding="utf-8") as fh:
+            loaded = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return loaded if isinstance(loaded, dict) else None

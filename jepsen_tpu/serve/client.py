@@ -343,6 +343,23 @@ class ServiceClient:
             raise ServiceError(f"shutdown returned {code}")
         return protocol.decode_body(body)
 
+    def profile(self, seconds: float = 1.0, label: str = "",
+                out_dir: Optional[str] = None) -> dict:
+        """``POST /profile``: one bounded on-demand profiling window on
+        the daemon (obs.profiling) — returns ``{dir, manifest}``.  The
+        timeout covers the capture window itself, plus headroom."""
+        req: dict = {"seconds": float(seconds)}
+        if label:
+            req["label"] = str(label)
+        if out_dir:
+            req["dir"] = out_dir
+        code, body = self._request(
+            "/profile", body=protocol.encode_body(req),
+            timeout=max(self.timeout or 0.0, float(seconds) + 30.0))
+        if code != 200:
+            raise ServiceError(f"profile returned {code}")
+        return protocol.decode_body(body)
+
     def _trace_ctx(self, span) -> Optional[dict]:
         """Wire ``trace_ctx`` for the current client ``span`` — None
         when tracing is off (NULL_SPAN has no sid), so untraced runs
@@ -822,7 +839,35 @@ def format_status(st: dict) -> str:
     if jp:
         lines.append(
             f"  journal: {st.get('journal_rows', 0)} rows → {jp}")
+    drift = st.get("drift")
+    if drift:
+        lines.append("  " + format_drift(drift))
     return "\n".join(lines)
+
+
+def format_drift(drift: dict) -> str:
+    """One-line drift-sentinel view of a /status ``drift`` block
+    (obs.drift): aggregate score vs threshold, shape census, and —
+    when the sentinel recommends one — the retune call-out naming the
+    stale shapes."""
+    score = drift.get("score")
+    score_s = (f"{score:.2f}×" if isinstance(score, (int, float))
+               else "n/a")
+    line = (
+        f"drift: score {score_s}"
+        f" (threshold {drift.get('threshold')}×)"
+        f" · {drift.get('shapes', 0)} shape(s)"
+        f" · {drift.get('rows_scored', 0)} rows scored"
+    )
+    stale = drift.get("stale") or []
+    if drift.get("retune_recommended"):
+        shapes = ", ".join(
+            f"{s.get('kernel')}(E={s.get('E')},C={s.get('C')},"
+            f"F={s.get('F')})@{s.get('ratio')}×"
+            for s in stale
+        )
+        line += f" · RETUNE RECOMMENDED: {shapes or 'aggregate'}"
+    return line
 
 
 def _rate(live: dict, key: str) -> str:
@@ -862,6 +907,12 @@ def format_top(host: str, port, st: dict) -> str:
         + (" · DRAINING" if st.get("stopping") else "")
     )
     jp = st.get("journal_path")
+    # quarantined routes + drift score ride the same summary line:
+    # the two "this daemon needs an operator" signals the fleet view
+    # previously never showed
+    quarantined = len(st.get("quarantine") or [])
+    drift = st.get("drift") or {}
+    score = drift.get("score")
     tail = (
         f"  queue {st.get('queue_depth', 0)}/{st.get('max_queue_runs')}"
         f" · in-flight {st.get('in_flight', 0)}"
@@ -871,6 +922,10 @@ def format_top(host: str, port, st: dict) -> str:
         + (f" · watchers {st.get('watch_subscribers', 0)}"
            if st.get("watch_subscribers") else "")
         + (f" · journal {st.get('journal_rows', 0)} rows" if jp else "")
+        + f" · quarantined {quarantined}"
+        + (f" · drift {score:.2f}×"
+           + ("!" if drift.get("retune_recommended") else "")
+           if isinstance(score, (int, float)) else "")
     )
     return "\n".join([head, "  " + format_live(live), tail])
 

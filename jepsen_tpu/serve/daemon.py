@@ -58,7 +58,9 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import obs
 from ..engine import decompose, execution, planning
+from ..obs import drift as obs_drift
 from ..obs import journal as obs_journal
+from ..obs import profiling as obs_profiling
 from ..obs import propagate
 from . import protocol
 
@@ -269,6 +271,9 @@ class CheckerDaemon:
         journal_max_bytes: int = obs_journal.DEFAULT_MAX_BYTES,
         wal_path: Optional[str] = None,
         wal_compact_bytes: Optional[int] = None,
+        drift: bool = True,
+        drift_threshold: Optional[float] = None,
+        profile_dir: str = "profiles",
     ):
         #: per-bucket device-cost estimator driving largest-first
         #: dispatch of coalesced work.  The default is the
@@ -302,6 +307,14 @@ class CheckerDaemon:
         #: to cwd by accident; the `serve()` CLI entry defaults it ON
         self.journal_path = journal_path
         self.journal_max_bytes = journal_max_bytes
+        #: cost-model drift sentinel (obs.drift): rides the journal
+        #: stream, so it only arms when the journal is on; `drift=False`
+        #: (or falsy JEPSEN_TPU_DRIFT at the `serve()` entry) disables
+        self.drift = drift
+        self.drift_threshold = drift_threshold
+        #: where `POST /profile` captures land when the request names
+        #: no directory (each capture gets a timestamped subdir)
+        self.profile_dir = profile_dir
         #: verdict-WAL destination (obs.journal.VerdictWAL): None = off
         #: (constructor default, like the dispatch journal); the
         #: `serve()` entry wires it from JEPSEN_TPU_WAL.  On a fresh
@@ -838,6 +851,7 @@ class CheckerDaemon:
                 round(lag_mean, 4) if lag_mean is not None else None),
         }
         journal = obs_journal.active()
+        sentinel = obs_drift.active()
         return {
             # the resident calibration (doc/tuning.md): the artifact id
             # steering this daemon's window / union-mode / cost-ordered
@@ -869,6 +883,11 @@ class CheckerDaemon:
             if total else None,
             "journal_path": journal.path if journal else None,
             "journal_rows": journal.written if journal else 0,
+            # cost-model drift sentinel (obs.drift): per-shape EWMA
+            # residuals vs the calibration/proxy estimate, the worst-
+            # shape aggregate score, and the retune recommendation —
+            # None when the journal (and so the sentinel) is off
+            "drift": sentinel.snapshot() if sentinel is not None else None,
             # degraded (kernel, E, C) routes currently served by the
             # CPU oracle, with the device error that tripped each
             "quarantine": quarantine,
@@ -902,6 +921,12 @@ class CheckerDaemon:
         if self.journal_path:
             obs_journal.configure(self.journal_path,
                                   self.journal_max_bytes)
+            if self.drift:
+                # warm start: a restarted daemon rescores the rows its
+                # previous life journalled, so the drift view survives
+                # a crash exactly like the WAL's verdicts do
+                sentinel = obs_drift.configure(self.drift_threshold)
+                sentinel.scan(self.journal_path)
         if self.wal_path:
             # build the replay index BEFORE the writer reopens the
             # file: every verdict a previous daemon life settled is
@@ -1026,6 +1051,36 @@ class CheckerDaemon:
         return pending
 
     # -- the /check entry (handler threads) ----------------------------------
+
+    def handle_profile(self, body: bytes) -> Tuple[int, dict]:
+        """``POST /profile``: one bounded on-demand device-profiling
+        window (obs.profiling) on the serving process — jax.profiler
+        trace + per-device memory high-water — without stopping the
+        daemon.  Runs on the handler thread: capture is passive (no
+        device dispatch of its own), so in-flight checking traffic IS
+        the workload being profiled."""
+        try:
+            req = protocol.decode_body(body) if body else {}
+        except Exception as e:  # noqa: BLE001 — malformed client input
+            return 400, {"error": f"bad request: {e!r}"}
+        if not isinstance(req, dict):
+            return 400, {"error": "bad request: body must be an object"}
+        try:
+            seconds = float(req.get("seconds", 1.0))
+        except (TypeError, ValueError):
+            return 400, {"error": "bad request: seconds must be a number"}
+        label = str(req.get("label") or "")
+        out_dir = req.get("dir")
+        if not out_dir:
+            stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            name = f"{stamp}-{label}" if label else stamp
+            out_dir = os.path.join(self.profile_dir, name)
+        try:
+            manifest = obs_profiling.capture(out_dir, seconds=seconds,
+                                             label=label)
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            return 500, {"error": f"profile capture failed: {e!r}"}
+        return 200, {"ok": True, "dir": out_dir, "manifest": manifest}
 
     def handle_check(self, body: bytes) -> Tuple[int, dict]:
         if self._fatal is not None:
@@ -1641,6 +1696,9 @@ def _make_handler(daemon: CheckerDaemon):
                 elif self.path == "/feed":
                     code, payload = daemon.handle_feed(body)
                     self._reply_json(code, payload)
+                elif self.path == "/profile":
+                    code, payload = daemon.handle_profile(body)
+                    self._reply_json(code, payload)
                 elif self.path == "/shutdown":
                     self._reply_json(200, daemon.request_shutdown())
                 else:
@@ -1684,6 +1742,12 @@ def serve(host: str = protocol.DEFAULT_HOST,
         if wp.lower() in ("0", "false", "off", "no", ""):
             wp = None
         kw["wal_path"] = wp
+    if "drift" not in kw:
+        # drift sentinel on by default at the production entry (it
+        # rides the journal, so a disabled journal disables it too);
+        # falsy JEPSEN_TPU_DRIFT opts out explicitly
+        dr = os.environ.get("JEPSEN_TPU_DRIFT", "1")
+        kw["drift"] = dr.lower() not in ("0", "false", "off", "no", "")
     # a persistent jit cache survives daemon crashes: the supervised
     # restart re-warms compiled kernels from disk instead of paying
     # every cold compile again.  Best-effort — an older jax without

@@ -37,3 +37,18 @@ from .calibrate import (  # noqa: F401
     proposal_within_budget,
     run_tune,
 )
+
+
+def retune_recommended() -> bool:
+    """True when the cost-model drift sentinel (obs.drift) currently
+    recommends re-running the tune pass: some journalled dispatch
+    shape's measured cost has drifted past ``JEPSEN_TPU_DRIFT_THRESHOLD``
+    from what the active calibration (or the analytic proxy) predicts.
+    Observation only — nothing acts on it automatically; the operator
+    runs ``jepsen_tpu tune`` (doc/tuning.md "Drift sentinel")."""
+    from ..obs import drift as obs_drift
+
+    sentinel = obs_drift.active()
+    if sentinel is None:
+        return False
+    return bool(sentinel.snapshot().get("retune_recommended"))

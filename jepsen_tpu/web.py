@@ -57,6 +57,10 @@ def test_row(base: str, name: str, t: str) -> dict:
         # per-run trace artifact (jepsen_tpu.obs export): linked from
         # the home table when the run recorded one
         "trace": os.path.exists(os.path.join(d, "trace.json")),
+        # per-run device-profiling capture (obs.profiling, cli test
+        # --profile): a profile/ dir with the loadable manifest
+        "profile": os.path.exists(
+            os.path.join(d, "profile", "profile.json")),
     }
 
 
@@ -138,6 +142,17 @@ def service_section() -> str:
             "dispatch journal",
             f"{st.get('journal_rows', 0)} rows → {st.get('journal_path')}",
         ))
+    drift = st.get("drift")
+    if drift:
+        score = drift.get("score")
+        rows.append((
+            "cost-model drift",
+            (f"{score:.2f}×" if isinstance(score, (int, float))
+             else "n/a")
+            + f" over {drift.get('shapes', 0)} shape(s)"
+            + (" — RETUNE RECOMMENDED"
+               if drift.get("retune_recommended") else ""),
+        ))
     cells = "".join(
         f"<tr><td>{html.escape(str(k))}</td>"
         f"<td>{html.escape(str(v))}</td></tr>"
@@ -211,7 +226,7 @@ def home_page(base: str) -> str:
         service_section(),
         "<h1>Tests</h1>",
         "<table><tr><th>name</th><th>time</th><th>valid?</th>"
-        "<th></th><th></th></tr>",
+        "<th></th><th></th><th></th></tr>",
     ]
     for r in rows:
         link = urllib.parse.quote(f"/files/{r['name']}/{r['time']}/")
@@ -224,13 +239,21 @@ def home_page(base: str) -> str:
             if r.get("trace")
             else "<td></td>"
         )
+        plink = urllib.parse.quote(
+            f"/files/{r['name']}/{r['time']}/profile/"
+        )
+        profile_cell = (
+            f'<td><a href="{plink}">profile</a></td>'
+            if r.get("profile")
+            else "<td></td>"
+        )
         body.append(
             f'<tr class="{_valid_class(r["valid"])}">'
             f'<td><a href="{link}">{html.escape(r["name"])}</a></td>'
             f'<td><a href="{link}">{html.escape(r["time"])}</a></td>'
             f"<td>{html.escape(str(r['valid']))}</td>"
             f'<td><a href="{zlink}">zip</a></td>'
-            f"{trace_cell}</tr>"
+            f"{trace_cell}{profile_cell}</tr>"
         )
     body.append("</table>")
     return _page("Jepsen-TPU", "\n".join(body))
