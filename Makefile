@@ -2,7 +2,7 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench bench-gate check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke docker-smoke docker-up docker-down
+.PHONY: test bench bench-gate check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke fleet-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
@@ -12,7 +12,7 @@ test:
 # decomposition, auto-tune, transactional-screen, closure/union
 # kernel, and drift-sentinel smoke checks, plus the bench regression
 # gate over the recorded window history
-check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke bench-gate
+check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke fleet-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke bench-gate
 
 # jtlint static analysis (doc/static-analysis.md): all seven passes —
 # trace-safety, lock-discipline, concurrency (whole-program race
@@ -68,6 +68,17 @@ serve-smoke:
 # accounted in client + daemon metrics.
 chaos-smoke:
 	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.chaos
+
+# fleet-tier gate (doc/checker-service.md "Fleet tier"): two real
+# member daemon processes sharing one AOT executable cache, fronted by
+# an in-process rendezvous router — routed verdicts byte-identical to
+# the in-process engine on both kernel routes, same-shape concurrent
+# clients coalesce on ONE member, a SIGKILLed member's in-flight
+# request spills to the sibling losing no verdicts, and the revived
+# member warms from the shared AOT cache to answer its first request
+# with zero cold dispatches (request diag + journal cache=miss scan)
+fleet-smoke:
+	env JAX_PLATFORMS=cpu python -m jepsen_tpu.serve.fleet_smoke
 
 # online-checking gate (doc/checker-service.md "Online checking"): a
 # batch with injected violations fed incrementally through POST /feed

@@ -1064,6 +1064,134 @@ def bench_service():
     _emit(payload)
 
 
+def bench_fleet():
+    """--against-service --fleet: the restart-gap headline.  Spawn a
+    daemon with a shared on-disk AOT executable cache, pay the cold
+    jit once, shut the daemon down, respawn it against the same cache
+    directory, and time the restarted daemon's FIRST run.  Without the
+    cache that first run pays the full cold path again (the recorded
+    cold/warm gap is ~31x); with it the manifest replay pre-claims
+    every executable before /healthz goes ready, so the restarted
+    first run lands at warm-path throughput with zero cold dispatches.
+    Emits ONE JSON line like the main bench; never crashes without
+    it."""
+    import shutil
+    import tempfile
+
+    t_spawn = time.perf_counter()
+    payload = {"metric": "fleet_restart_first_run_histories_per_sec",
+               "value": 0.0, "unit": "histories/sec"}
+    client = None
+    aot_dir = tempfile.mkdtemp(prefix="jt-bench-aot-")
+    saved_aot = os.environ.get("JEPSEN_TPU_SERVE_AOT_CACHE")
+    os.environ["JEPSEN_TPU_SERVE_AOT_CACHE"] = aot_dir
+    try:
+        from jepsen_tpu import models as m
+        from jepsen_tpu import synth
+        from jepsen_tpu.serve import client as serve_client
+
+        from jepsen_tpu.util import free_port
+
+        port = int(os.environ.get("JEPSEN_TPU_SERVE_PORT", 0)) or free_port()
+        os.environ["JEPSEN_TPU_SERVE_PORT"] = str(port)
+        client = serve_client.spawn_daemon(port=port)
+        daemon_init_s = time.perf_counter() - t_spawn
+        if client.spawned_pid is None:
+            # a pre-existing daemon can't be restarted on the user's
+            # behalf, and its cache state makes the gap meaningless
+            payload["error"] = (
+                "pre-existing daemon on the port; --fleet needs a "
+                "fresh spawn to measure the restart gap"
+            )
+            client = None  # leave it running; nothing to stop
+            return
+
+        K = int(os.environ.get("JEPSEN_TPU_BENCH_SERVICE_K", 64))
+        L = int(os.environ.get("JEPSEN_TPU_BENCH_SERVICE_L", 100))
+        hists = synth.generate_batch(
+            seed=45100, n_histories=K, n_procs=5, n_ops=L,
+            crash_p=0.002, corrupt_fraction=0.25,
+        )
+        model = m.cas_register(0)
+
+        def timed_run():
+            t0 = time.perf_counter()
+            res = client.check_batch(model, hists)
+            return time.perf_counter() - t0, res, dict(client.last_diag)
+
+        cold_s, res_cold, diag_cold = timed_run()
+        warm_s, res_warm, _ = timed_run()
+
+        # restart: stop the warmed daemon, wait for the port to clear
+        # (spawn_daemon attaches to anything still answering /healthz),
+        # then bring a NEW process up against the same cache directory
+        client.shutdown()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and client.healthy():
+            time.sleep(0.25)
+        client.spawned_pid = None  # the old pid is gone either way
+        if client.healthy():
+            payload["error"] = "daemon did not exit within 60s"
+            return
+        t_restart = time.perf_counter()
+        client = serve_client.spawn_daemon(port=port)
+        restart_init_s = time.perf_counter() - t_restart
+        restart_s, res_restart, diag_restart = timed_run()
+
+        if [r.get("valid?") for r in res_cold] != [
+            r.get("valid?") for r in res_restart
+        ] or [r.get("valid?") for r in res_cold] != [
+            r.get("valid?") for r in res_warm
+        ]:
+            payload["error"] = "verdicts diverged across restart"
+        restart_hps = K / restart_s if restart_s > 0 else 0.0
+        payload.update({
+            "value": round(restart_hps, 2),
+            "history_len": L,
+            "batch": K,
+            # the restart-gap story: cold is what a cache-less restart
+            # would pay again, warm is the resident steady state, and
+            # restart_s is what the AOT-warmed respawn actually pays —
+            # restart_vs_cold ~ warm_vs_cold means the gap is closed
+            "daemon_init_s": round(daemon_init_s, 3),
+            "restart_init_s": round(restart_init_s, 3),
+            "cold_s": round(cold_s, 4),
+            "warm_s": round(warm_s, 4),
+            "restart_s": round(restart_s, 4),
+            "warm_vs_cold": round(cold_s / warm_s, 2)
+            if warm_s > 0 else None,
+            "restart_vs_cold": round(cold_s / restart_s, 2)
+            if restart_s > 0 else None,
+            "cold_dispatches": diag_cold.get("cold_dispatches"),
+            "restart_cold_dispatches": diag_restart.get("cold_dispatches"),
+            "restart_warm_dispatches": diag_restart.get("warm_dispatches"),
+        })
+        try:
+            st = client.status()
+            payload["aot"] = st.get("aot")
+        except Exception:  # noqa: BLE001 — telemetry never fails bench
+            pass
+    except Exception as e:  # noqa: BLE001 — always emit the JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        payload["error"] = repr(e)[:300]
+    finally:
+        if client is not None and client.spawned_pid is not None:
+            try:
+                client.shutdown()
+            except Exception as e:  # noqa: BLE001 — best-effort stop
+                payload.setdefault("warnings", f"shutdown failed: {e!r}")
+        if saved_aot is None:
+            os.environ.pop("JEPSEN_TPU_SERVE_AOT_CACHE", None)
+        else:
+            os.environ["JEPSEN_TPU_SERVE_AOT_CACHE"] = saved_aot
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        # in the finally (not after it): the early bail-outs above
+        # `return` out of the try, and the JSON line must still land
+        _emit(payload)
+
+
 def _elle_corpus(mode, n_hists, n_txns, key_count, anomaly_every=4):
     """A synthetic many-key transaction corpus: workload-generator
     histories (the same TxnGenerator the cycle workloads run) against
@@ -1256,6 +1384,16 @@ def main():
         "warm-path throughput and the daemon's warm-hit evidence",
     )
     ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="with --against-service: restart-gap headline — run cold "
+        "+ warm against a fresh daemon with a shared AOT executable "
+        "cache, shut it down, respawn it against the same cache "
+        "directory, and time the restarted daemon's first run (zero "
+        "cold dispatches when the cache warms it; doc/"
+        "checker-service.md 'Fleet tier')",
+    )
+    ap.add_argument(
         "--tuned",
         action="store_true",
         help="auto-tuned-dispatch headline: load (or produce) a "
@@ -1300,8 +1438,11 @@ def main():
     if args.gate:
         sys.exit(run_gate(args.gate_tolerance))
     if args.against_service:
-        bench_service()
+        bench_fleet() if args.fleet else bench_service()
         return
+    if args.fleet:
+        print("--fleet requires --against-service", file=sys.stderr)
+        sys.exit(2)
     if args.elle:
         bench_elle()
         return

@@ -442,22 +442,38 @@ def serve_cmd() -> Dict[str, dict]:
             "and restart it on abnormal exit; the restart re-warms "
             "from the journal, verdict WAL, and jit cache",
         )
+        p.add_argument(
+            "--fleet",
+            type=int,
+            default=1,
+            metavar="N",
+            help="(--checker --supervise) run N daemons on ports "
+            "PORT..PORT+N-1 with per-member WAL/journal paths and one "
+            "shared AOT executable cache; front them with "
+            "`jepsen-tpu route` (doc/checker-service.md 'Fleet tier')",
+        )
 
     def run(args) -> int:
         if args.checker:
             from . import serve as serve_mod
             from .serve import daemon as daemon_mod
 
+            if args.fleet > 1 and not args.supervise:
+                print("--fleet requires --supervise", file=sys.stderr)
+                return EXIT_USAGE
             if args.supervise:
                 child = []
                 if args.host:
                     child += ["--host", args.host]
-                if args.port is not None:
-                    child += ["--port", str(args.port)]
                 if args.engine_window is not None:
                     child += ["--window", str(args.engine_window)]
                 if args.max_queue is not None:
                     child += ["--max-queue", str(args.max_queue)]
+                if args.fleet > 1:
+                    return daemon_mod.supervise_fleet(
+                        args.fleet, child, base_port=args.port)
+                if args.port is not None:
+                    child += ["--port", str(args.port)]
                 return daemon_mod.supervise(child)
             serve_mod.serve(
                 host=args.host or serve_mod.DEFAULT_HOST,
@@ -483,38 +499,87 @@ def serve_cmd() -> Dict[str, dict]:
                        help="daemon port (default JEPSEN_TPU_SERVE_PORT "
                        "or 8519)")
 
-    def status(args) -> int:
-        from .serve import ServiceClient, ServiceUnavailable, client
+    def add_fleet_daemon_opts(p):
+        add_daemon_opts(p)
+        p.add_argument(
+            "--daemon",
+            action="append",
+            default=[],
+            metavar="HOST:PORT",
+            help="additional daemon address (repeatable) — address "
+            "the whole fleet in one command, like `top`",
+        )
 
-        c = ServiceClient(host=args.host, port=args.port)
-        try:
-            print(client.format_status(c.status()))
-        except ServiceUnavailable:
-            print(
-                f"no checker service at http://{c.host}:{c.port}/ "
-                "(start one: jepsen-tpu serve --checker)",
-                file=sys.stderr,
-            )
-            return EXIT_UNKNOWN
-        return EXIT_VALID
+    def fleet_clients(args, timeout=None):
+        """The primary ``--host``/``--port`` client plus one per
+        repeatable ``--daemon HOST:PORT``; ``None`` on a malformed
+        address (after printing the usage error)."""
+        from .serve import ServiceClient
+
+        kw = {} if timeout is None else {"timeout": timeout}
+        clients = [ServiceClient(host=args.host, port=args.port, **kw)]
+        for addr in getattr(args, "daemon", []):
+            host, _, port = str(addr).rpartition(":")
+            try:
+                clients.append(
+                    ServiceClient(host=host or None, port=int(port),
+                                  **kw))
+            except ValueError:
+                print(f"bad --daemon address {addr!r} (want HOST:PORT)",
+                      file=sys.stderr)
+                return None
+        return clients
+
+    def status(args) -> int:
+        from .serve import ServiceError, ServiceUnavailable, client
+
+        clients = fleet_clients(args)
+        if clients is None:
+            return EXIT_USAGE
+        if len(clients) == 1:
+            c = clients[0]
+            try:
+                print(client.format_status(c.status()))
+            except ServiceUnavailable:
+                print(
+                    f"no checker service at http://{c.host}:{c.port}/ "
+                    "(start one: jepsen-tpu serve --checker)",
+                    file=sys.stderr,
+                )
+                return EXIT_UNKNOWN
+            return EXIT_VALID
+        rows, unreachable = [], 0
+        for c in clients:
+            try:
+                rows.append((f"{c.host}:{c.port}", c.status()))
+            except (ServiceError, ServiceUnavailable):
+                rows.append((f"{c.host}:{c.port}", None))
+                unreachable += 1
+        print(client.format_fleet_status(rows))
+        return EXIT_UNKNOWN if unreachable == len(clients) else EXIT_VALID
 
     def shutdown(args) -> int:
-        from .serve import ServiceClient, ServiceUnavailable
+        from .serve import ServiceUnavailable
 
-        c = ServiceClient(host=args.host, port=args.port)
-        try:
-            out = c.shutdown()
-        except ServiceUnavailable:
+        clients = fleet_clients(args)
+        if clients is None:
+            return EXIT_USAGE
+        unreachable = 0
+        for c in clients:
+            try:
+                out = c.shutdown()
+            except ServiceUnavailable:
+                print(
+                    f"no checker service at http://{c.host}:{c.port}/",
+                    file=sys.stderr,
+                )
+                unreachable += 1
+                continue
             print(
-                f"no checker service at http://{c.host}:{c.port}/",
-                file=sys.stderr,
+                f"checker service at {c.host}:{c.port} draining "
+                f"({out.get('draining', 0)} queued runs), then stopping"
             )
-            return EXIT_UNKNOWN
-        print(
-            f"checker service draining ({out.get('draining', 0)} queued "
-            "runs), then stopping"
-        )
-        return EXIT_VALID
+        return EXIT_UNKNOWN if unreachable == len(clients) else EXIT_VALID
 
     def add_profile_opts(p):
         add_daemon_opts(p)
@@ -565,6 +630,44 @@ def serve_cmd() -> Dict[str, dict]:
             + ")"
         )
         print(f"  hbm peak: {peaks}")
+        return EXIT_VALID
+
+    def add_route_opts(p):
+        p.add_argument(
+            "--member",
+            action="append",
+            required=True,
+            metavar="HOST:PORT",
+            help="fleet member daemon address (repeatable)",
+        )
+        p.add_argument("--host", default=None,
+                       help="router bind host (default 127.0.0.1)")
+        p.add_argument(
+            "--port", "-b", type=int, default=None,
+            help="router bind port (default JEPSEN_TPU_SERVE_PORT or "
+            "8519 — clients point at the router unchanged)",
+        )
+
+    def route(args) -> int:
+        import os
+
+        from .serve import protocol, router
+
+        for m in args.member:
+            host, _, port = str(m).rpartition(":")
+            try:
+                int(port)
+            except ValueError:
+                print(f"bad --member address {m!r} (want HOST:PORT)",
+                      file=sys.stderr)
+                return EXIT_USAGE
+        router.Router(
+            args.member,
+            host=args.host or protocol.DEFAULT_HOST,
+            port=(args.port if args.port is not None
+                  else int(os.environ.get("JEPSEN_TPU_SERVE_PORT", 0)
+                           or protocol.DEFAULT_PORT)),
+        ).start(block=True)
         return EXIT_VALID
 
     def add_top_opts(p):
@@ -671,14 +774,25 @@ def serve_cmd() -> Dict[str, dict]:
             "run": run,
         },
         "status": {
-            "help": "show the resident checker service's status",
-            "add_opts": add_daemon_opts,
+            "help": "show the resident checker service's status "
+            "(repeatable --daemon: one fleet table row per member)",
+            "add_opts": add_fleet_daemon_opts,
             "run": status,
         },
         "shutdown": {
-            "help": "drain and stop the resident checker service",
-            "add_opts": add_daemon_opts,
+            "help": "drain and stop the resident checker service "
+            "(repeatable --daemon: every addressed member)",
+            "add_opts": add_fleet_daemon_opts,
             "run": shutdown,
+        },
+        "route": {
+            "help": "run the fleet routing front: rendezvous-hash "
+            "request shapes over --member daemons so same-shape "
+            "traffic coalesces on one resident executor, with "
+            "breaker-driven spillover (doc/checker-service.md "
+            "'Fleet tier')",
+            "add_opts": add_route_opts,
+            "run": route,
         },
         "top": {
             "help": "live fleet view of one or more checker daemons "

@@ -339,6 +339,13 @@ class Executor:
         #: daemon sets coalesced-run count + trace ids per group; the
         #: device thread is the only mutator, so no guard)
         self.journal_context: Dict[str, Any] = {}
+        #: optional ``(plan, arrays, disp_shape)`` callback fired on
+        #: every COLD dispatch (first claim of ``(fn, shape)``) — the
+        #: serve daemon's AOT executable cache records the compile here
+        #: so a restarted daemon can pre-warm the shape.  Runs on the
+        #: owner thread inside the dispatch path; exceptions are
+        #: swallowed (cache bookkeeping must never fail a dispatch).
+        self.on_cold_compile: Optional[Callable[[Any, Any, Any], None]] = None
 
     # -- stats the pipeline's telemetry reads -----------------------------
 
@@ -501,6 +508,11 @@ class Executor:
         first = wgl._claim_shape(plan.fn, disp_shape)
         phase = "compile" if first else "execute"
         self.phase_counts[phase] += 1
+        if first and self.on_cold_compile is not None:
+            try:
+                self.on_cold_compile(plan, arrays, disp_shape)
+            except Exception:  # noqa: BLE001 — cache bookkeeping only
+                pass
         # per-chip budget accounting: shard_map splits the chunk's rows
         # evenly, so each chip holds B_pad/n of them while the dispatch
         # is in flight.  Keyed on the plan's shape facts INCLUDING the

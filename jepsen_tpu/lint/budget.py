@@ -43,7 +43,7 @@ import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
-                   dotted_name, register)
+                   cached_walk, dotted_name, register)
 
 #: function names sanctioned to dispatch directly (the engine's own
 #: chunk loop helpers take the kernel as a parameter, which this pass
@@ -204,7 +204,7 @@ class BudgetDiscipline(Pass):
         """The enclosing function visibly participates in budget
         enforcement: it reads the cap or calls a `*max_dispatch*`
         helper before dispatching."""
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if (isinstance(node, ast.Attribute)
                     and node.attr in ("safe_dispatch", "disp")
                     and isinstance(node.ctx, ast.Load)):
@@ -224,7 +224,7 @@ class BudgetDiscipline(Pass):
         # jit-of-jit rebatching wrapper (`jax.jit(lambda adj:
         # base(adj))`) re-enters the tracer, it does not dispatch
         jit_lambda_spans: List[Tuple[int, int]] = []
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if _is_jit_call(node):
                 for arg in list(node.args) + [kw.value
                                               for kw in node.keywords]:
@@ -239,7 +239,7 @@ class BudgetDiscipline(Pass):
         kernel_attrs: Dict[str, Set[str]] = {}
         for cq, cls in idx.classes.items():
             attrs: Set[str] = set()
-            for node in ast.walk(cls):
+            for node in cached_walk(cls):
                 if not isinstance(node, ast.Assign):
                     continue
                 if not (isinstance(node.value, ast.Call)
@@ -259,7 +259,7 @@ class BudgetDiscipline(Pass):
             attrs = kernel_attrs.get(cls, set()) if cls else set()
             enforcing = self._enforcing_fn(fn)
             kernel_vars: Set[str] = set()
-            for node in ast.walk(fn):
+            for node in cached_walk(fn):
                 if isinstance(node, ast.Assign):
                     if (isinstance(node.value, ast.Call)
                             and _last(dotted_name(node.value.func) or "")

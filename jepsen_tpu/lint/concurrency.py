@@ -66,7 +66,7 @@ import os
 from typing import Dict, FrozenSet, List, NamedTuple, Optional, Set, Tuple
 
 from .core import (Finding, FunctionIndex, OWNER_THREAD, Pass, Project,
-                   SourceFile, dotted_name, register)
+                   SourceFile, cached_walk, dotted_name, register)
 
 #: method calls that mutate their receiver
 MUTATORS = frozenset({
@@ -172,7 +172,7 @@ class _ModModel:
         self._collect_annotations()
 
     def _collect_imports(self) -> None:
-        for node in ast.walk(self.sf.tree):
+        for node in cached_walk(self.sf.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.asname:
@@ -209,7 +209,7 @@ class _ModModel:
 
     def _collect_attrs(self) -> None:
         for cq, cls in self.idx.classes.items():
-            for node in ast.walk(cls):
+            for node in cached_walk(cls):
                 if not isinstance(node, ast.Assign):
                     continue
                 for t in node.targets:
@@ -225,7 +225,7 @@ class _ModModel:
 
     def _collect_annotations(self) -> None:
         for cq, cls in self.idx.classes.items():
-            for node in ast.walk(cls):
+            for node in cached_walk(cls):
                 target = None
                 if isinstance(node, ast.Assign) and node.targets:
                     target = node.targets[0]
@@ -380,7 +380,7 @@ class _Program:
                     self.entries.add((m.module, q))
         for q, fn in m.idx.funcs.items():
             cls = m.owning_class(q)
-            for node in ast.walk(fn):
+            for node in cached_walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 ref: Optional[ast.AST] = None
@@ -496,7 +496,7 @@ class _Program:
                 if isinstance(stmt, ast.ClassDef):
                     scan(stmt.body)
                     continue
-                for node in ast.walk(stmt):
+                for node in cached_walk(stmt):
                     if isinstance(node, ast.Call) \
                             and isinstance(node.func, ast.Name):
                         for key in self._resolve_ref(m, None,
@@ -515,10 +515,10 @@ class _Program:
 
         # pre-pass: global decls first (walk order is arbitrary), then
         # local constructor types, shadowing, earliest HB call
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if isinstance(node, ast.Global):
                 global_decls.update(node.names)
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
                 tid = node.targets[0].id

@@ -55,7 +55,7 @@ import re
 from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
 from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
-                   dotted_name, register)
+                   cached_walk, dotted_name, register)
 
 _BACKTICK = re.compile(r"`([A-Za-z_][A-Za-z0-9_]*)`")
 _ENV_TOKEN = re.compile(r"`(JEPSEN_TPU_[A-Z0-9_]+)`")
@@ -132,7 +132,7 @@ def _dict_keys(d: ast.Dict) -> Tuple[Set[str], bool]:
 def _nested_keys(d: ast.Dict) -> Set[str]:
     out: Set[str] = set()
     for v in d.values:
-        for sub in ast.walk(v):
+        for sub in cached_walk(v):
             if isinstance(sub, ast.Dict):
                 out |= _dict_keys(sub)[0]
     return out
@@ -148,7 +148,7 @@ class _ClassAttrLiterals:
         cls = _owning_class(fn_q, idx)
         if cls is None:
             return
-        for node in ast.walk(idx.classes[cls]):
+        for node in cached_walk(idx.classes[cls]):
             if not isinstance(node, ast.Assign):
                 continue
             if not isinstance(node.value, ast.Dict):
@@ -186,7 +186,7 @@ def writer_frame(fn: ast.AST, idx: FunctionIndex, fn_q: str) -> _Frame:
     sub_stores: Dict[str, Set[str]] = {}
     frame_dicts: List[ast.Dict] = []    # dict literals in return position
 
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             t = node.targets[0]
             if isinstance(t, ast.Name):
@@ -284,7 +284,7 @@ def reader_keys(fn: ast.AST,
     """(key, node) for every constant read off a designated payload
     variable: ``var["k"]`` loads and ``var.get("k")`` calls."""
     out: List[Tuple[str, ast.AST]] = []
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if (isinstance(node, ast.Subscript)
                 and isinstance(node.value, ast.Name)
                 and node.value.id in var_names
@@ -402,7 +402,7 @@ class SeamContracts(Pass):
     # -- seam-journal-schema ------------------------------------------------
 
     def _schema_keys(self, sf: SourceFile):
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             target = None
             if isinstance(node, ast.AnnAssign):
                 target, value = node.target, node.value
@@ -424,7 +424,7 @@ class SeamContracts(Pass):
         ef = project.file_named("engine/execution.py")
         if ef is not None and ef.tree is not None:
             idx = FunctionIndex(ef.tree)
-            for node in ast.walk(ef.tree):
+            for node in cached_walk(ef.tree):
                 if not (isinstance(node, ast.Call)
                         and isinstance(node.func, ast.Attribute)
                         and node.func.attr == "emit"):
@@ -473,7 +473,7 @@ class SeamContracts(Pass):
             return
         keys: Optional[Set[str]] = None
         keys_node = None
-        for node in ast.walk(af.tree):
+        for node in cached_walk(af.tree):
             if (isinstance(node, ast.Assign) and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Name)
                     and node.targets[0].id == "PARAM_KEYS"
@@ -486,7 +486,7 @@ class SeamContracts(Pass):
             return
         idx = FunctionIndex(af.tree)
         read: Set[str] = set()
-        for node in ast.walk(af.tree):
+        for node in cached_walk(af.tree):
             if not (isinstance(node, ast.Subscript)
                     and isinstance(node.value, ast.Attribute)
                     and node.value.attr == "params"):
@@ -523,7 +523,7 @@ class SeamContracts(Pass):
 
     def _env_reads(self, sf: SourceFile) -> List[Tuple[str, ast.AST]]:
         out: List[Tuple[str, ast.AST]] = []
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             name: Optional[str] = None
             if isinstance(node, ast.Call):
                 fn = dotted_name(node.func) or ""
@@ -613,7 +613,7 @@ class SeamContracts(Pass):
             if sf.tree is None:
                 continue
             idx = FunctionIndex(sf.tree)
-            for node in ast.walk(sf.tree):
+            for node in cached_walk(sf.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func) or ""

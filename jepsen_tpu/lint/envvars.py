@@ -134,6 +134,21 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "platform.py",
            "path for the probe's diagnostic trail file; unset "
            "disables"),
+    EnvVar("JEPSEN_TPU_ROUTE_PROBE_INTERVAL", "1.0",
+           "serve/router.py",
+           "seconds between the fleet router's `/healthz` membership "
+           "sweeps; a dead member's keys re-route within one "
+           "interval"),
+    EnvVar("JEPSEN_TPU_ROUTE_PROBE_TIMEOUT", "0.5",
+           "serve/router.py",
+           "per-member timeout for one router health probe"),
+    EnvVar("JEPSEN_TPU_SERVE_AOT_CACHE", "unset",
+           "serve/daemon.py",
+           "shared fleet-wide AOT executable cache directory "
+           "(manifest + persistent XLA cache); a restarted member "
+           "warms from it before `/healthz` goes ready and answers "
+           "its first request with zero cold dispatches; unset "
+           "disables"),
     EnvVar("JEPSEN_TPU_SERVE_COALESCE_WAIT", "0.0",
            "serve/daemon.py",
            "seconds the device thread lingers after the first queued "

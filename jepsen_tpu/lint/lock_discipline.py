@@ -51,7 +51,8 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (OWNER_THREAD, Finding, FunctionIndex, Pass, Project,
-                   SourceFile, call_targets, dotted_name, register)
+                   SourceFile, cached_walk, call_targets, dotted_name,
+                   register)
 
 
 def _target_attr(stmt: ast.AST) -> Optional[str]:
@@ -118,7 +119,7 @@ class _ModuleLockModel:
         # method, or on a class-level assignment)
         for cq, cls in self.index.classes.items():
             attrs: Dict[str, str] = {}
-            for node in ast.walk(cls):
+            for node in cached_walk(cls):
                 if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
                     lock = sf.guarded_by(node.lineno)
                     if lock:
@@ -138,7 +139,7 @@ class _ModuleLockModel:
         for q, fn in idx.funcs.items():
             if sf.marked(fn.lineno, "thread-entry"):
                 entries.add(q)
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
             fname = dotted_name(node.func) or ""

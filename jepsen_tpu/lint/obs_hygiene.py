@@ -47,7 +47,7 @@ import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
-                   dotted_name, register)
+                   cached_walk, dotted_name, register)
 
 METRIC_NAME_RE = re.compile(r"^jepsen_[a-z][a-z0-9_]*$")
 
@@ -136,10 +136,10 @@ class ObsHygiene(Pass):
         # classify every span call: with-item / returned / assigned /
         # bare.  Parent links via a single walk.
         parents: Dict[int, ast.AST] = {}
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             for child in ast.iter_child_nodes(node):
                 parents[id(child)] = node
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if not (isinstance(node, ast.Call) and self._span_call(node)):
                 continue
             parent = parents.get(id(node))
@@ -162,7 +162,7 @@ class ObsHygiene(Pass):
                 body = fn if fn is not None else sf.tree
                 entered = exited = False
                 in_finally = False
-                for n in ast.walk(body):
+                for n in cached_walk(body):
                     if (isinstance(n, ast.Call)
                             and isinstance(n.func, ast.Attribute)
                             and isinstance(n.func.value, ast.Name)
@@ -173,7 +173,7 @@ class ObsHygiene(Pass):
                             exited = True
                     if isinstance(n, ast.Try) and n.finalbody:
                         for fb in n.finalbody:
-                            for m in ast.walk(fb):
+                            for m in cached_walk(fb):
                                 if (isinstance(m, ast.Call)
                                         and isinstance(m.func, ast.Attribute)
                                         and isinstance(m.func.value, ast.Name)
@@ -201,7 +201,7 @@ class ObsHygiene(Pass):
     # -- metric naming -----------------------------------------------------
 
     def _check_metrics(self, sf, idx, sites, out) -> None:
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
             kind = _metric_call(node)

@@ -51,7 +51,7 @@ import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
-                   dotted_name, load_file, register)
+                   cached_walk, dotted_name, load_file, register)
 
 BUILTIN_FAULTS = {"partition", "kill", "pause", "clock", "disk"}
 SUITE_SEAMS = ("db", "client", "workloads", "test")
@@ -79,7 +79,7 @@ def _dict_keys(fn_body: ast.AST, dict_name: str) -> Set[str]:
     """String keys of every dict literal assigned to ``dict_name``
     inside ``fn_body`` (the `table = {...}` pattern)."""
     out: Set[str] = set()
-    for node in ast.walk(fn_body):
+    for node in cached_walk(fn_body):
         if isinstance(node, ast.Assign) and any(
                 isinstance(t, ast.Name) and t.id == dict_name
                 for t in node.targets):
@@ -272,7 +272,7 @@ class Protocol(Pass):
 
     def _check_workload_refs(self, sf, known: Set[str], out) -> None:
         # direct literal calls
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if isinstance(node, ast.Call):
                 fname = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
                 if fname in ("generic_workload", "workload") and node.args:
@@ -299,7 +299,7 @@ class Protocol(Pass):
                             " generic or core workload tables")
 
     def _check_fault_refs(self, sf, known: Set[str], out) -> None:
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             lists: List[ast.AST] = []
             if isinstance(node, ast.Call):
                 # opts.get("faults", [...]) defaults
@@ -332,7 +332,7 @@ class Protocol(Pass):
             if has_all:
                 return  # re-export module
         imported: Dict[str, Tuple[int, int]] = {}
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     name = (a.asname or a.name).split(".")[0]
@@ -346,7 +346,7 @@ class Protocol(Pass):
                     imported[a.asname or a.name] = (node.lineno,
                                                     node.col_offset)
         used: Set[str] = set()
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if isinstance(node, ast.Name):
                 used.add(node.id)
         for name, (line, col) in sorted(imported.items(),

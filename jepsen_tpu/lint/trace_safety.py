@@ -55,7 +55,7 @@ import ast
 from typing import Dict, List, Optional, Set
 
 from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
-                   call_targets, dotted_name, register)
+                   cached_walk, call_targets, dotted_name, register)
 
 #: decorator / wrapper dotted names that make a function traced
 TRACING_WRAPPERS = {
@@ -108,7 +108,7 @@ class _ModuleTraceModel:
         by_name: Dict[str, List[str]] = {}
         for q in idx.funcs:
             by_name.setdefault(q.rsplit(".", 1)[-1], []).append(q)
-        for node in ast.walk(self.sf.tree):
+        for node in cached_walk(self.sf.tree):
             if (isinstance(node, ast.Call)
                     and _is_tracing_wrapper(node.func) and node.args
                     and isinstance(node.args[0], ast.Name)):
@@ -137,7 +137,7 @@ class _ModuleTraceModel:
         for q, fn in idx.funcs.items():
             if q in self.traced:
                 continue
-            for node in ast.walk(fn):
+            for node in cached_walk(fn):
                 if not isinstance(node, ast.Return) or node.value is None:
                     continue
                 v = node.value
@@ -154,7 +154,7 @@ class _ModuleTraceModel:
         traced fn — ``traced(...)`` or ``producer(...)(…)``?"""
         names = {q.rsplit(".", 1)[-1] for q in self.traced}
         prod = {q.rsplit(".", 1)[-1] for q in self.producers}
-        for n in ast.walk(node):
+        for n in cached_walk(node):
             if not isinstance(n, ast.Call):
                 continue
             if isinstance(n.func, ast.Name) and n.func.id in names:
@@ -288,7 +288,7 @@ class TraceSafety(Pass):
                 node, lambda f: sf.marked(f.lineno, "timing")
             )
 
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
             if (isinstance(node.func, ast.Attribute)

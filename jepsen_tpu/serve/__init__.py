@@ -30,6 +30,16 @@ Layout:
 - :mod:`~jepsen_tpu.serve.smoke` — ``make serve-smoke``: verdict
   equality vs the in-process engine, warm-cache proof, metrics
   validity, drain-on-shutdown.
+- :mod:`~jepsen_tpu.serve.router` — :class:`Router`, the fleet tier's
+  routing front: rendezvous-hashes shape keys over ``--member``
+  daemons so same-shape traffic coalesces on one resident executor,
+  with breaker-driven spillover and probe-driven re-routing.
+- :mod:`~jepsen_tpu.serve.aotcache` — the shared on-disk AOT
+  executable cache: a restarted member warms from the fleet manifest
+  and answers its first request with zero cold dispatches.
+- :mod:`~jepsen_tpu.serve.fleet_smoke` — ``make fleet-smoke``: routed
+  verdict byte-equality, coalescing proof, kill/spill/rejoin drill,
+  warm-restart zero-rejit assertion.
 
 Start one with ``jepsen-tpu serve --checker`` (or ``python -m
 jepsen_tpu.serve``); ``jepsen-tpu status`` / ``jepsen-tpu shutdown``
@@ -45,9 +55,11 @@ from .client import (  # noqa: F401
     ServiceUnavailable,
     analysis,
     check_batch,
+    probe_healthz,
     resolve_client,
     service_mode,
     spawn_daemon,
 )
 from .daemon import CheckerDaemon, serve  # noqa: F401
 from .protocol import DEFAULT_HOST, DEFAULT_PORT, UnsupportedModel  # noqa: F401
+from .router import Router  # noqa: F401

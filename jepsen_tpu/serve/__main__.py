@@ -34,16 +34,40 @@ def main(argv=None) -> int:
                    help="run the daemon as a supervised child and "
                    "restart it on abnormal exit (crash recovery; "
                    "doc/checker-service.md)")
+    p.add_argument("--fleet", type=int, default=1, metavar="N",
+                   help="with --supervise: run N daemons on ports "
+                   "--port..--port+N-1 with per-member WAL/journal "
+                   "paths and one shared AOT cache (doc/"
+                   "checker-service.md \"Fleet tier\")")
     args = p.parse_args(argv)
 
     from . import daemon, protocol
 
+    if args.fleet > 1 and not args.supervise:
+        print("--fleet requires --supervise", file=sys.stderr)
+        return 2
     if args.supervise:
-        # re-exec ourselves minus --supervise; the child inherits the
-        # environment, so journal/WAL/jit-cache paths carry over and a
-        # restart resumes where the crash left off
-        child = [a for a in (argv if argv is not None else sys.argv[1:])
-                 if a != "--supervise"]
+        # re-exec ourselves minus the supervisor flags; the child
+        # inherits the environment, so journal/WAL/jit-cache paths
+        # carry over and a restart resumes where the crash left off
+        raw = list(argv if argv is not None else sys.argv[1:])
+        child = []
+        skip = False
+        for a in raw:
+            if skip:
+                skip = False
+                continue
+            if a == "--supervise":
+                continue
+            if a == "--fleet":
+                skip = True
+                continue
+            if a.startswith("--fleet="):
+                continue
+            child.append(a)
+        if args.fleet > 1:
+            return daemon.supervise_fleet(args.fleet, child,
+                                          base_port=args.port)
         return daemon.supervise(child)
     kw = {}
     if args.wal is not None:

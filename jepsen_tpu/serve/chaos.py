@@ -4,7 +4,7 @@ Turns the nemesis on the checker itself.  A consistency checker that
 dies with its run — or worse, silently drops a violation it had
 already found — is not fit to judge crash-prone systems, so the
 service stack must survive the same faults it is built to detect.
-Three scenarios, each asserting the acceptance gates from
+Five scenarios, each asserting the acceptance gates from
 doc/checker-service.md "Failure modes & recovery":
 
 1. **kill -9 + WAL resume**: a daemon subprocess is SIGKILLed — once
@@ -40,10 +40,19 @@ doc/checker-service.md "Failure modes & recovery":
    renames after fsync — must not confuse the restart: the retried
    request id replays every settled row with zero re-dispatches and
    byte-identical results.
+5. **fleet member SIGKILL + AOT rejoin**: two member daemons behind an
+   in-process :class:`serve.router.Router` sharing one AOT executable
+   cache.  The member owning a key is SIGKILLed mid-batch — the
+   in-flight routed request spills to the sibling with byte-identical
+   verdicts, the next request takes the counted connection-error
+   reroute path, and one probe sweep marks the member down.  Revived
+   against the same cache, the member warms ahead of ``/healthz``,
+   one sweep marks it up, its key's traffic returns, and its first
+   request performs ZERO cold dispatches.
 
 Every injected fault is accounted for in metrics: client retries,
-breaker trips and probes (this process's registry), WAL replays and
-request dedups (the daemon's ``/metrics``).
+breaker trips and probes, router reroutes (this process's registry),
+WAL replays and request dedups (the daemon's ``/metrics``).
 
 Wired into ``make chaos-smoke`` / ``make check``.  Exit codes: 0 ok,
 1 any gate failed.
@@ -84,11 +93,14 @@ def _metric_value(text: str, name: str):
 # -- daemon-subprocess lifecycle ---------------------------------------------
 
 
-def _spawn_daemon(port: int, tmp: str):
+def _spawn_daemon(port: int, tmp: str, extra_env: dict = None):
     """Start a real daemon subprocess (the kill -9 target must be a
-    separate process) with its journal + verdict WAL in ``tmp``."""
+    separate process) with its journal + verdict WAL in ``tmp``.
+    ``extra_env`` overlays the child environment (scenario 5 points
+    fleet members at one shared AOT executable cache this way)."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
     env["JEPSEN_TPU_JOURNAL"] = os.path.join(tmp, "journal.jsonl")
     env["JEPSEN_TPU_WAL"] = os.path.join(tmp, "verdict-wal.jsonl")
     # cwd is ``tmp`` (isolation), so the child can't rely on an
@@ -514,11 +526,121 @@ def main(argv=None) -> int:
     except Exception:  # noqa: BLE001 — fall back to the hard kill
         _sigkill(proc2)
 
+    # == scenario 5: fleet member SIGKILL → router spillover + AOT rejoin ==
+    # the nemesis turns on the fleet tier: kill one member mid-batch
+    # and the ROUTER (not the client) must absorb it — rerouting the
+    # in-flight request to the sibling with byte-identical verdicts —
+    # and the revived member, warm from the shared AOT executable
+    # cache, must rejoin with zero cold dispatches on its first request
+    from jepsen_tpu.serve import router as router_mod
+
+    for name in ("JEPSEN_TPU_CLIENT_DEADLINE", "JEPSEN_TPU_CLIENT_BACKOFF",
+                 "JEPSEN_TPU_BREAKER_FAILURES",
+                 "JEPSEN_TPU_BREAKER_COOLDOWN"):
+        os.environ.pop(name, None)
+    client_mod.reset_breakers()
+    tmp3 = tempfile.mkdtemp(prefix="jepsen-chaos-fleet-")
+    aot_dir = os.path.join(tmp3, "aot")
+    fleet_ports = [free_port(), free_port()]
+    fleet_procs, fleet_clients = [], []
+    for i, p in enumerate(fleet_ports):
+        mdir = os.path.join(tmp3, f"m{i}")
+        os.makedirs(mdir, exist_ok=True)
+        fleet_procs.append(_spawn_daemon(
+            p, mdir, {"JEPSEN_TPU_SERVE_AOT_CACHE": aot_dir}))
+        fleet_clients.append(ServiceClient(port=p, timeout=60.0))
+    for i, (c, pr) in enumerate(zip(fleet_clients, fleet_procs)):
+        check(_wait_healthy(c, pr), f"fleet member {i} did not come up")
+    # a long probe interval parks the background prober: every
+    # membership transition below is the harness's own deterministic
+    # probe_once() sweep, so the reroute path (connection error on a
+    # member still marked up) is exercised on purpose, not by luck
+    rt = router_mod.Router(
+        [f"127.0.0.1:{p}" for p in fleet_ports],
+        port=0, probe_interval_s=30.0)
+    rt.start(block=False)
+    check(rt.probe_once() == 2, "router prober missed a live member")
+    rclient = ServiceClient(port=rt.port)
+    req0 = [c.status().get("requests", 0) for c in fleet_clients]
+    res = rclient.check_batch(model, batch_v, **configs["dense"])
+    check(_canon(res) == expected_v,
+          "routed fleet verdicts diverged from in-process")
+    deltas = [c.status().get("requests", 0) - r0
+              for c, r0 in zip(fleet_clients, req0)]
+    owner = max(range(2), key=lambda i: deltas[i])
+    sibling = 1 - owner
+
+    # kill -9 the key's owner mid-batch; the in-flight routed request
+    # must spill to the sibling and lose nothing
+    spill = {}
+
+    def post_spill():
+        try:
+            c = ServiceClient(port=rt.port)
+            spill["res"] = c.check_batch(model, batch_v,
+                                         **configs["dense"])
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            spill["err"] = e
+
+    reroutes0 = _metric_value(obs.render_prom(),
+                              "jepsen_route_reroutes_total") or 0
+    t5 = threading.Thread(target=post_spill)
+    t5.start()
+    time.sleep(0.05)
+    _sigkill(fleet_procs[owner])
+    t5.join(timeout=120)
+    check(not t5.is_alive(), "routed request hung after member kill -9")
+    check(_canon(spill.get("res") or []) == expected_v,
+          f"mid-batch member kill lost verdicts "
+          f"({spill.get('err') or 'diverged'})")
+    # the router still thinks the owner is up (prober parked): the next
+    # request MUST take the connection-error reroute path to the sibling
+    res = rclient.check_batch(model, batch_v, **configs["dense"])
+    check(_canon(res) == expected_v,
+          "rerouted verdicts diverged from in-process")
+    check((_metric_value(obs.render_prom(),
+                         "jepsen_route_reroutes_total") or 0)
+          > reroutes0,
+          "router never counted a reroute for the killed member")
+    check(rt.probe_once() == 1,
+          "probe sweep still counts the killed member as up")
+
+    # revival: same port, same shared AOT cache — the member comes
+    # back warm and its first request performs ZERO cold dispatches
+    fleet_procs[owner] = _spawn_daemon(
+        fleet_ports[owner], os.path.join(tmp3, f"m{owner}"),
+        {"JEPSEN_TPU_SERVE_AOT_CACHE": aot_dir})
+    check(_wait_healthy(fleet_clients[owner], fleet_procs[owner]),
+          "killed fleet member did not revive")
+    st_aot = (fleet_clients[owner].status().get("aot") or {})
+    check((st_aot.get("warmed") or 0) > 0,
+          f"revived member warmed nothing from the AOT cache ({st_aot})")
+    check(rt.probe_once() == 2,
+          "probe sweep did not mark the revived member up")
+    own0 = fleet_clients[owner].status().get("requests", 0)
+    res = rclient.check_batch(model, batch_v, **configs["dense"])
+    rdiag = dict(rclient.last_diag)
+    check(_canon(res) == expected_v,
+          "post-revival routed verdicts diverged from in-process")
+    check(fleet_clients[owner].status().get("requests", 0) > own0,
+          "traffic did not return to the revived key owner")
+    check(rdiag.get("cold_dispatches", 0) == 0,
+          f"revived member paid a cold start on rejoin (diag {rdiag})")
+    rt.stop()
+    for i, (c, pr) in enumerate(zip(fleet_clients, fleet_procs)):
+        try:
+            c.shutdown()
+            pr.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — fall back to the hard kill
+            _sigkill(pr)
+
     # == fault accounting, client side (this process's registry) ==
     mine = obs.render_prom()
     for name in ("jepsen_client_retries_total",
                  "jepsen_client_breaker_trips_total",
-                 "jepsen_client_breaker_probes_total"):
+                 "jepsen_client_breaker_probes_total",
+                 "jepsen_route_requests_total",
+                 "jepsen_route_reroutes_total"):
         check((_metric_value(mine, name) or 0) >= 1,
               f"client metrics missing {name}")
 
@@ -540,7 +662,10 @@ def main(argv=None) -> int:
         "deadline, breaker tripped to in-process and recovered "
         "half-open; dropped response deduped by request id; idle WAL "
         "compaction kept only live rows and survived a simulated "
-        "crash mid-compaction; all faults accounted in metrics)"
+        "crash mid-compaction; fleet member kill -9 spilled to the "
+        "sibling losing no verdicts and rejoined warm from the AOT "
+        "cache with zero cold dispatches; all faults accounted in "
+        "metrics)"
     )
     return 0
 

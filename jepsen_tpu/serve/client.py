@@ -179,6 +179,25 @@ def reset_breakers() -> None:
         _BREAKERS.clear()
 
 
+def probe_healthz(addr: str, timeout: float = 0.5) -> bool:
+    """THE ``/healthz`` probe — the one implementation behind both the
+    client breaker's half-open cooldown probe and the router's member
+    health sweeps, so the two share a single timeout/exception taxonomy
+    (connection-level failures AND malformed bodies are both "down")
+    instead of drifting apart as hand-rolled urlopen paths.  ``addr``
+    is ``HOST:PORT``.  Counted in ``jepsen_probe_healthz_total`` by
+    outcome; never raises."""
+    req = urllib.request.Request(f"http://{addr}/healthz", method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            ok = (resp.status == 200
+                  and bool(protocol.decode_body(resp.read()).get("ok")))
+    except (urllib.error.URLError, ConnectionError, OSError, ValueError):
+        ok = False
+    obs.count("jepsen_probe_healthz_total", outcome="up" if ok else "down")
+    return ok
+
+
 def service_mode() -> str:
     """``JEPSEN_TPU_SERVICE``: ``""``/``0`` off (default), ``1``/any
     truthy = use a reachable daemon, ``auto`` = additionally spawn one
@@ -315,14 +334,9 @@ class ServiceClient:
         return self.healthy(timeout=0.5)
 
     def healthy(self, timeout: float = 0.5) -> bool:
-        try:
-            code, body = self._request("/healthz", timeout=timeout)
-        except ServiceUnavailable:
+        if self.port is None:
             return False
-        try:
-            return code == 200 and bool(protocol.decode_body(body).get("ok"))
-        except ValueError:
-            return False
+        return probe_healthz(f"{self.host}:{self.port}", timeout=timeout)
 
     def status(self) -> dict:
         code, body = self._request("/status", timeout=self.timeout or 5)
@@ -842,6 +856,43 @@ def format_status(st: dict) -> str:
     drift = st.get("drift")
     if drift:
         lines.append("  " + format_drift(drift))
+    return "\n".join(lines)
+
+
+def format_fleet_status(rows) -> str:
+    """The fleet table for ``jepsen_tpu status --daemon … --daemon …``:
+    one row per member with the operator-facing columns (devices,
+    mesh, calibration identity, drift score, quarantined routes, live
+    busy ratio).  ``rows`` is a sequence of ``(addr, status_or_None)``
+    — ``None`` marks a member that did not answer ``/status``."""
+    cols = ["member", "devices", "mesh", "calibration", "drift",
+            "quarantined", "busy"]
+    table = [cols]
+    for addr, st in rows:
+        if st is None:
+            table.append([addr, "-", "-", "unreachable", "-", "-", "-"])
+            continue
+        drift = st.get("drift") or {}
+        score = drift.get("score")
+        busy = (st.get("live") or {}).get("device_busy_ratio")
+        table.append([
+            addr,
+            str(st.get("n_devices") or 1),
+            str(st.get("mesh_shape") or "-"),
+            str(st.get("calibration") or "defaults"),
+            (f"{score:.2f}×" + ("!" if drift.get("retune_recommended")
+                                else "")
+             if isinstance(score, (int, float)) else "n/a"),
+            str(len(st.get("quarantine") or [])),
+            f"{busy:.0%}" if isinstance(busy, (int, float)) else "n/a",
+        ])
+    widths = [max(len(r[i]) for r in table) for i in range(len(cols))]
+    lines = ["── fleet " + "─" * 39]
+    for i, r in enumerate(table):
+        lines.append("  " + "  ".join(
+            c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  " + "  ".join("─" * w for w in widths))
     return "\n".join(lines)
 
 

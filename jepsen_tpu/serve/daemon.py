@@ -251,6 +251,199 @@ class _FeedSession:
         self.t_open = time.time()
 
 
+class AdmissionState:
+    """The routing/admission half of a daemon, split from device
+    ownership (ROADMAP item 1): the bounded queue and row budget, the
+    idempotent-retry response cache, quarantined routes, open feed
+    sessions, watcher accounting, and the stop flag — everything a
+    request touches BEFORE the device thread owns it, behind ONE
+    condition.  Device ownership (executor, mesh, jit cache) lives on
+    :class:`CheckerDaemon`'s device thread; nothing here reaches for
+    process-global device state, which is exactly why N daemons per
+    host (``--supervise --fleet N``) are just N ``(AdmissionState,
+    executor)`` pairs on distinct ports/WALs/journals."""
+
+    def __init__(self, max_queue_runs: int, max_queue_rows: int):
+        self.max_queue_runs = max_queue_runs
+        self.max_queue_rows = max_queue_rows
+        #: ONE condition guards every piece of handler/device shared
+        #: state (queue, row budget, stats) — and doubles as the
+        #: device thread's wake-up signal
+        self._wake = threading.Condition()
+        self._stopping = threading.Event()
+        self._queue: List[_Request] = []  # jt: guarded-by(_wake)
+        self._queued_rows = 0  # jt: guarded-by(_wake)
+        self._in_flight = 0  # jt: guarded-by(_wake)
+        self.stats = {  # jt: guarded-by(_wake)
+            "requests": 0, "histories": 0, "rejected": 0,
+            "coalesced": 0, "batches": 0, "warm_dispatches": 0,
+            "cold_dispatches": 0, "errors": 0,
+            "elle_requests": 0, "elle_graphs": 0,
+            "quarantined_rows": 0, "replayed": 0, "deduped": 0,
+            "feed_sessions": 0, "feed_deltas": 0, "feed_histories": 0,
+            "watch_events": 0, "wal_compactions": 0,
+        }
+        #: open streaming-ingest sessions by session id
+        self._feeds: Dict[str, _FeedSession] = {}  # jt: guarded-by(_wake)
+        #: live /watch subscribers (SSE handler threads)
+        self._watchers = 0  # jt: guarded-by(_wake)
+        #: completed-response cache for idempotent retries: a client
+        #: retry (same request id) of an ALREADY-ANSWERED request is
+        #: served from here without touching the device or the
+        #: counters — retried work is never double-counted
+        self._done: "OrderedDict[str, Tuple[int, dict]]" = OrderedDict()  # jt: guarded-by(_wake)
+        self._done_cap = 128
+        #: quarantined (kernel, E, C) routes: a device fault on one
+        #: route degrades THAT route to the CPU oracle instead of
+        #: failing whole batches (graceful degradation); values are
+        #: the triggering error repr
+        self._quarantine: Dict[Tuple, str] = {}  # jt: guarded-by(_wake)
+
+    # -- admission (handler threads) --------------------------------------
+
+    def precheck(self, n_rows: int) -> bool:
+        """Cheap capacity check BEFORE the planning half: a request
+        that would be refused must not pay decode+encode (nor submit
+        oracle searches the pool would burn for nobody) just to hear
+        503.  The authoritative check is :meth:`admit` — this one only
+        sheds the obvious overload early, so the race window between
+        the two is a single in-flight planning pass, not the whole
+        backlog.  ``n_rows`` here is the parent history count (the
+        decomposition fanout is unknowable pre-planning); admit()
+        re-checks against the real post-decomposition row count."""
+        with self._wake:
+            return not (
+                self._stopping.is_set()
+                or len(self._queue) >= self.max_queue_runs
+                or self._queued_rows + n_rows > self.max_queue_rows
+            )
+
+    def admit(self, req: _Request) -> bool:
+        with self._wake:
+            if self._stopping.is_set():
+                return False
+            # the authoritative row budget counts req.rows — the
+            # encoded rows actually queued (decomposition fans a
+            # parent history out into per-partition sub-rows; see
+            # _Request.rows) — while precheck's pre-planning
+            # estimate can only see the parent count
+            if (len(self._queue) >= self.max_queue_runs
+                    or self._queued_rows + req.rows > self.max_queue_rows):
+                self.stats["rejected"] += 1
+                obs.count("jepsen_serve_rejected_total")
+                return False
+            self._queue.append(req)
+            self._queued_rows += req.rows
+            if req.kind == "elle":
+                # graphs are not histories: the /check throughput
+                # accounting must not inflate from screen traffic
+                self.stats["elle_requests"] += 1
+                self.stats["elle_graphs"] += req.n
+                obs.count("jepsen_serve_elle_requests_total")
+                obs.count("jepsen_serve_elle_graphs_total", req.n)
+            elif req.kind == "feed":
+                # feed deltas count under jepsen_feed_* at ingest
+                # completion (_feed_dispatch), not here: a delta is
+                # not a /check request and must not inflate its stats
+                pass
+            else:
+                self.stats["requests"] += 1
+                self.stats["histories"] += req.n
+                obs.count("jepsen_serve_requests_total")
+                obs.count("jepsen_serve_histories_total", req.n)
+            obs.gauge_set("jepsen_serve_queue_depth", len(self._queue))
+            self._wake.notify()
+            return True
+
+    # -- the device-thread side -------------------------------------------
+
+    def take_batch(self, coalesce_wait_s: float) -> List[_Request]:
+        """Pop the whole current backlog (the coalescing unit), waiting
+        up to ``coalesce_wait_s`` after the first arrival for company."""
+        with self._wake:
+            idle_waits = 0
+            while not self._queue:
+                if self._stopping.is_set():
+                    return []
+                self._wake.wait(timeout=0.2)
+                idle_waits += 1
+                if not self._queue and idle_waits >= 5:
+                    # ~1 s with no admissions: hand the device loop a
+                    # housekeeping turn (WAL auto-compaction) instead
+                    # of camping on the condition forever
+                    return []
+            if coalesce_wait_s > 0:
+                deadline = time.monotonic() + coalesce_wait_s
+                while (len(self._queue) < self.max_queue_runs
+                       and not self._stopping.is_set()):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(timeout=remaining)
+            batch = self._queue
+            self._queue = []
+            self._queued_rows = 0
+            self._in_flight = len(batch)
+            obs.gauge_set("jepsen_serve_queue_depth", 0)
+            return batch
+
+    def drain_queue(self) -> List[_Request]:
+        """Take everything still queued (the device-thread-failed
+        path): the caller fails each request itself."""
+        with self._wake:
+            queued, self._queue = self._queue, []
+            self._queued_rows = 0
+            return queued
+
+    # -- idempotent retries (handler threads) ------------------------------
+
+    def dedup_hit(self, req_id) -> Optional[Tuple[int, dict]]:
+        """Serve a retried request id from the completed-response
+        cache: a client retry of an ALREADY-ANSWERED request (the
+        response was lost on the wire, not the work) is answered from
+        here without touching the device or inflating the request
+        counters — retried work is never double-counted."""
+        if not req_id:
+            return None
+        with self._wake:
+            hit = self._done.get(req_id)
+            if hit is None:
+                return None
+            self._done.move_to_end(req_id)
+            self.stats["deduped"] += 1
+        obs.count("jepsen_serve_request_dedup_total")
+        return hit
+
+    def dedup_store(self, req_id, code: int, payload: dict) -> None:
+        if not req_id or code != 200:
+            # only durable successes are idempotent-replayable; a
+            # retried failure should retry the actual work
+            return
+        with self._wake:
+            self._done[req_id] = (code, payload)
+            self._done.move_to_end(req_id)
+            while len(self._done) > self._done_cap:
+                self._done.popitem(last=False)
+
+    # -- graceful degradation (device thread) ------------------------------
+
+    def mark_quarantined(self, routes, err) -> int:
+        """Record device-faulted (kernel, E, C) routes: subsequent
+        buckets on a quarantined route go straight to the CPU oracle
+        instead of re-hitting the faulty compile/dispatch — one bad
+        route degrades, the daemon and every other route keep serving
+        (doc/checker-service.md "Failure modes & recovery")."""
+        with self._wake:
+            fresh = [r for r in routes if r not in self._quarantine]
+            for r in fresh:
+                self._quarantine[r] = repr(err)
+            n_q = len(self._quarantine)
+        if fresh:
+            obs.count("jepsen_serve_quarantine_total", len(fresh))
+            obs.gauge_set("jepsen_serve_quarantined_routes", n_q)
+        return len(fresh)
+
+
 class CheckerDaemon:
     """The resident service.  ``start(block=False)`` returns once the
     device thread is ready; ``port`` then holds the bound port (useful
@@ -274,6 +467,7 @@ class CheckerDaemon:
         drift: bool = True,
         drift_threshold: Optional[float] = None,
         profile_dir: str = "profiles",
+        aot_cache_dir: Optional[str] = None,
     ):
         #: per-bucket device-cost estimator driving largest-first
         #: dispatch of coalesced work.  The default is the
@@ -288,15 +482,20 @@ class CheckerDaemon:
         self.mesh = mesh
         # `is None`, not truthiness: --max-queue 0 means "refuse all
         # new work", which must not silently become the default bound
-        self.max_queue_runs = (
+        max_runs = (
             int(os.environ.get("JEPSEN_TPU_SERVE_MAX_QUEUE",
                                DEFAULT_MAX_QUEUE_RUNS))
             if max_queue_runs is None else max_queue_runs
         )
-        self.max_queue_rows = (
+        max_rows = (
             DEFAULT_MAX_QUEUE_ROWS if max_queue_rows is None
             else max_queue_rows
         )
+        #: the routing/admission half (ROADMAP item 1 split): queue,
+        #: budgets, retry cache, quarantine, feed/watch registries —
+        #: everything shared between handler threads and the device
+        #: thread.  Device ownership stays below on the device thread.
+        self.admission = AdmissionState(max_runs, max_rows)
         self.coalesce_wait_s = (
             coalesce_wait_s
             if coalesce_wait_s is not None
@@ -332,135 +531,100 @@ class CheckerDaemon:
                      DEFAULT_WAL_COMPACT_BYTES)
             if wal_compact_bytes is None else wal_compact_bytes
         )
-        #: open streaming-ingest sessions by session id
-        self._feeds: Dict[str, _FeedSession] = {}  # jt: guarded-by(_wake)
-        #: live /watch subscribers (SSE handler threads)
-        self._watchers = 0  # jt: guarded-by(_wake)
-        #: completed-response cache for idempotent retries: a client
-        #: retry (same request id) of an ALREADY-ANSWERED request is
-        #: served from here without touching the device or the
-        #: counters — retried work is never double-counted
-        self._done: "OrderedDict[str, Tuple[int, dict]]" = OrderedDict()  # jt: guarded-by(_wake)
-        self._done_cap = 128
-        #: quarantined (kernel, E, C) routes: a device fault on one
-        #: route degrades THAT route to the CPU oracle instead of
-        #: failing whole batches (graceful degradation); values are
-        #: the triggering error repr
-        self._quarantine: Dict[Tuple, str] = {}  # jt: guarded-by(_wake)
+        #: shared on-disk AOT executable cache (serve.aotcache): the
+        #: device thread records every cold compile here and pre-warms
+        #: matching entries at startup, so a supervisor-restarted
+        #: daemon's first request runs with zero cold dispatches.
+        #: None = off (constructor default, like the journal/WAL); the
+        #: `serve()` entry wires it from JEPSEN_TPU_SERVE_AOT_CACHE
+        self.aot_cache_dir = aot_cache_dir
+        self._aot_warmed = 0
+        self._aot_matched = 0
+        self._aot_recorder = None
         self.t_start = time.time()
         self._server: Optional[ThreadingHTTPServer] = None
         self._device_thread: Optional[threading.Thread] = None
         self._ready = threading.Event()
-        self._stopping = threading.Event()
-        #: ONE condition guards every piece of handler/device shared
-        #: state (queue, row budget, stats) — and doubles as the
-        #: device thread's wake-up signal
-        self._wake = threading.Condition()
-        self._queue: List[_Request] = []  # jt: guarded-by(_wake)
-        self._queued_rows = 0  # jt: guarded-by(_wake)
-        self._in_flight = 0  # jt: guarded-by(_wake)
-        self.stats = {  # jt: guarded-by(_wake)
-            "requests": 0, "histories": 0, "rejected": 0,
-            "coalesced": 0, "batches": 0, "warm_dispatches": 0,
-            "cold_dispatches": 0, "errors": 0,
-            "elle_requests": 0, "elle_graphs": 0,
-            "quarantined_rows": 0, "replayed": 0, "deduped": 0,
-            "feed_sessions": 0, "feed_deltas": 0, "feed_histories": 0,
-            "watch_events": 0, "wal_compactions": 0,
-        }
         self._platform: Optional[str] = None
         self._fatal: Optional[str] = None
         #: devices the resident executor shards across (set by the
         #: device thread once the executor exists; None = not ready)
         self._n_devices: Optional[int] = None
 
+    # -- AdmissionState delegation ------------------------------------------
+    # The handler/device code below predates the split and still says
+    # `self._wake` / `self._queue` / `self.stats`; these forwarders keep
+    # that surface (and the public ctor/status contract) stable while
+    # the state itself lives on `self.admission`.
+
+    @property
+    def _wake(self):
+        return self.admission._wake
+
+    @property
+    def _stopping(self):
+        return self.admission._stopping
+
+    @property
+    def stats(self):
+        return self.admission.stats
+
+    @property
+    def max_queue_runs(self) -> int:
+        return self.admission.max_queue_runs
+
+    @property
+    def max_queue_rows(self) -> int:
+        return self.admission.max_queue_rows
+
+    @property
+    def _queue(self):
+        return self.admission._queue
+
+    @property
+    def _queued_rows(self) -> int:
+        return self.admission._queued_rows
+
+    @property
+    def _in_flight(self) -> int:
+        return self.admission._in_flight
+
+    @_in_flight.setter
+    def _in_flight(self, v: int) -> None:
+        self.admission._in_flight = v
+
+    @property
+    def _watchers(self) -> int:
+        return self.admission._watchers
+
+    @_watchers.setter
+    def _watchers(self, v: int) -> None:
+        self.admission._watchers = v
+
+    @property
+    def _done(self):
+        return self.admission._done
+
+    @property
+    def _feeds(self):
+        return self.admission._feeds
+
+    @property
+    def _quarantine(self):
+        return self.admission._quarantine
+
     # -- admission (handler threads) ---------------------------------------
 
     def precheck_admit(self, n_rows: int) -> bool:
-        """Cheap capacity check BEFORE the planning half: a request
-        that would be refused must not pay decode+encode (nor submit
-        oracle searches the pool would burn for nobody) just to hear
-        503.  The authoritative check is :meth:`admit` — this one only
-        sheds the obvious overload early, so the race window between
-        the two is a single in-flight planning pass, not the whole
-        backlog.  ``n_rows`` here is the parent history count (the
-        decomposition fanout is unknowable pre-planning); admit()
-        re-checks against the real post-decomposition row count."""
-        with self._wake:
-            return not (
-                self._stopping.is_set()
-                or len(self._queue) >= self.max_queue_runs
-                or self._queued_rows + n_rows > self.max_queue_rows
-            )
+        return self.admission.precheck(n_rows)
 
     def admit(self, req: _Request) -> bool:
-        with self._wake:
-            if self._stopping.is_set():
-                return False
-            # the authoritative row budget counts req.rows — the
-            # encoded rows actually queued (decomposition fans a
-            # parent history out into per-partition sub-rows; see
-            # _Request.rows) — while precheck_admit's pre-planning
-            # estimate can only see the parent count
-            if (len(self._queue) >= self.max_queue_runs
-                    or self._queued_rows + req.rows > self.max_queue_rows):
-                self.stats["rejected"] += 1
-                obs.count("jepsen_serve_rejected_total")
-                return False
-            self._queue.append(req)
-            self._queued_rows += req.rows
-            if req.kind == "elle":
-                # graphs are not histories: the /check throughput
-                # accounting must not inflate from screen traffic
-                self.stats["elle_requests"] += 1
-                self.stats["elle_graphs"] += req.n
-                obs.count("jepsen_serve_elle_requests_total")
-                obs.count("jepsen_serve_elle_graphs_total", req.n)
-            elif req.kind == "feed":
-                # feed deltas count under jepsen_feed_* at ingest
-                # completion (_feed_dispatch), not here: a delta is
-                # not a /check request and must not inflate its stats
-                pass
-            else:
-                self.stats["requests"] += 1
-                self.stats["histories"] += req.n
-                obs.count("jepsen_serve_requests_total")
-                obs.count("jepsen_serve_histories_total", req.n)
-            obs.gauge_set("jepsen_serve_queue_depth", len(self._queue))
-            self._wake.notify()
-            return True
+        return self.admission.admit(req)
 
     # -- the device thread ---------------------------------------------------
 
     def _take_batch(self) -> List[_Request]:
-        """Pop the whole current backlog (the coalescing unit), waiting
-        up to ``coalesce_wait_s`` after the first arrival for company."""
-        with self._wake:
-            idle_waits = 0
-            while not self._queue:
-                if self._stopping.is_set():
-                    return []
-                self._wake.wait(timeout=0.2)
-                idle_waits += 1
-                if not self._queue and idle_waits >= 5:
-                    # ~1 s with no admissions: hand the device loop a
-                    # housekeeping turn (WAL auto-compaction) instead
-                    # of camping on the condition forever
-                    return []
-            if self.coalesce_wait_s > 0:
-                deadline = time.monotonic() + self.coalesce_wait_s
-                while (len(self._queue) < self.max_queue_runs
-                       and not self._stopping.is_set()):
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._wake.wait(timeout=remaining)
-            batch = self._queue
-            self._queue = []
-            self._queued_rows = 0
-            self._in_flight = len(batch)
-            obs.gauge_set("jepsen_serve_queue_depth", 0)
-            return batch
+        return self.admission.take_batch(self.coalesce_wait_s)
 
     def _device_loop(self) -> None:  # jt: thread-entry
         """The resident execution half: owns the device, the dispatch
@@ -484,6 +648,30 @@ class CheckerDaemon:
             # and mesh-matched client requests can be serviced
             self.mesh = executor.mesh  # jt: allow[concurrency-unguarded-shared] — published via _ready
             self._n_devices = executor.n_devices  # jt: allow[concurrency-unguarded-shared] — published via _ready
+            if self.aot_cache_dir:
+                # cold-start elimination: replay the shared manifest ON
+                # this thread, BEFORE /healthz goes ready — a restarted
+                # daemon's first request then runs with zero cold
+                # dispatches — and hook the recorder so every cold
+                # compile this life pays is warm next life (fleet-wide:
+                # the manifest and the XLA cache under it are shared)
+                from . import aotcache
+
+                try:
+                    warmed, matched = aotcache.warm(executor,
+                                                    self.aot_cache_dir)
+                    self._aot_warmed = warmed  # jt: allow[concurrency-unguarded-shared] — published via _ready
+                    self._aot_matched = matched  # jt: allow[concurrency-unguarded-shared] — published via _ready
+                    self._aot_recorder = aotcache.Recorder(  # jt: allow[concurrency-unguarded-shared] — published via _ready
+                        self.aot_cache_dir,
+                        list(self.mesh.devices.shape)
+                        if self.mesh is not None else [1],
+                    )
+                    executor.on_cold_compile = self._aot_recorder
+                except Exception:  # noqa: BLE001 — the cache is an
+                    # optimization: a damaged dir means a cold start,
+                    # never a dead daemon
+                    executor.reset()
         except Exception as e:  # noqa: BLE001 — surface via /healthz + 500s
             self._fatal = repr(e)  # jt: allow[concurrency-unguarded-shared] — published via _ready
             self._ready.set()
@@ -555,10 +743,7 @@ class CheckerDaemon:
         obs.count("jepsen_serve_wal_compactions_total")
 
     def _fail_all_queued(self) -> None:
-        with self._wake:
-            queued, self._queue = self._queue, []
-            self._queued_rows = 0
-        for req in queued:
+        for req in self.admission.drain_queue():
             req.error = f"device thread failed: {self._fatal}"
             req.device_done.set()
 
@@ -893,6 +1078,17 @@ class CheckerDaemon:
             "quarantine": quarantine,
             "wal_path": self._wal.path if self._wal else None,
             "wal_rows": self._wal.written if self._wal else 0,
+            # the AOT executable cache (serve.aotcache): entries warmed
+            # at startup vs entries matching this daemon's fingerprint
+            # + mesh, and executables recorded this life — the fleet
+            # tier's zero-cold-start evidence
+            "aot": ({
+                "dir": self.aot_cache_dir,
+                "warmed": self._aot_warmed,
+                "matched": self._aot_matched,
+                "recorded": (self._aot_recorder.recorded
+                             if self._aot_recorder is not None else 0),
+            } if self.aot_cache_dir else None),
             # the online-monitor surface: open ingest sessions and
             # live /watch subscribers (doc/checker-service.md
             # "Online checking")
@@ -992,50 +1188,15 @@ class CheckerDaemon:
     # -- idempotent retries (handler threads) --------------------------------
 
     def _dedup_hit(self, req_id) -> Optional[Tuple[int, dict]]:
-        """Serve a retried request id from the completed-response
-        cache: a client retry of an ALREADY-ANSWERED request (the
-        response was lost on the wire, not the work) is answered from
-        here without touching the device or inflating the request
-        counters — retried work is never double-counted."""
-        if not req_id:
-            return None
-        with self._wake:
-            hit = self._done.get(req_id)
-            if hit is None:
-                return None
-            self._done.move_to_end(req_id)
-            self.stats["deduped"] += 1
-        obs.count("jepsen_serve_request_dedup_total")
-        return hit
+        return self.admission.dedup_hit(req_id)
 
     def _dedup_store(self, req_id, code: int, payload: dict) -> None:
-        if not req_id or code != 200:
-            # only durable successes are idempotent-replayable; a
-            # retried failure should retry the actual work
-            return
-        with self._wake:
-            self._done[req_id] = (code, payload)
-            self._done.move_to_end(req_id)
-            while len(self._done) > self._done_cap:
-                self._done.popitem(last=False)
+        self.admission.dedup_store(req_id, code, payload)
 
     # -- graceful degradation (device thread) --------------------------------
 
     def _mark_quarantined(self, routes, err) -> int:
-        """Record device-faulted (kernel, E, C) routes: subsequent
-        buckets on a quarantined route go straight to the CPU oracle
-        instead of re-hitting the faulty compile/dispatch — one bad
-        route degrades, the daemon and every other route keep serving
-        (doc/checker-service.md "Failure modes & recovery")."""
-        with self._wake:
-            fresh = [r for r in routes if r not in self._quarantine]
-            for r in fresh:
-                self._quarantine[r] = repr(err)
-            n_q = len(self._quarantine)
-        if fresh:
-            obs.count("jepsen_serve_quarantine_total", len(fresh))
-            obs.gauge_set("jepsen_serve_quarantined_routes", n_q)
-        return len(fresh)
+        return self.admission.mark_quarantined(routes, err)
 
     def _salvage_executor(self, executor) -> list:
         """Capture the in-flight chunks' row tokens, then reset the
@@ -1748,11 +1909,28 @@ def serve(host: str = protocol.DEFAULT_HOST,
         # falsy JEPSEN_TPU_DRIFT opts out explicitly
         dr = os.environ.get("JEPSEN_TPU_DRIFT", "1")
         kw["drift"] = dr.lower() not in ("0", "false", "off", "no", "")
+    if "aot_cache_dir" not in kw:
+        # the fleet tier's shared AOT executable cache
+        # (doc/checker-service.md "Fleet tier"): record every cold
+        # compile, pre-warm them all at startup.  Off unless the env
+        # names a directory; falsy values disable.
+        ad = os.environ.get("JEPSEN_TPU_SERVE_AOT_CACHE", "")
+        if ad.lower() in ("0", "false", "off", "no", ""):
+            ad = None
+        kw["aot_cache_dir"] = ad
     # a persistent jit cache survives daemon crashes: the supervised
     # restart re-warms compiled kernels from disk instead of paying
     # every cold compile again.  Best-effort — an older jax without
-    # the knob just runs cold.
+    # the knob just runs cold.  The AOT cache grows this seam: when
+    # only JEPSEN_TPU_SERVE_AOT_CACHE is set, its xla/ subdir becomes
+    # the compilation cache, so the manifest replay at startup loads
+    # executables from disk instead of re-jitting them.
     cache_dir = os.environ.get("JEPSEN_TPU_SERVE_JIT_CACHE", "")
+    if not (cache_dir and cache_dir.lower() not in
+            ("0", "false", "off", "no")) and kw["aot_cache_dir"]:
+        from . import aotcache
+
+        cache_dir = aotcache.xla_cache_dir(kw["aot_cache_dir"])
     if cache_dir and cache_dir.lower() not in ("0", "false", "off", "no"):
         try:
             import jax
@@ -1765,22 +1943,31 @@ def serve(host: str = protocol.DEFAULT_HOST,
 
 
 def supervise(child_args, *, max_restarts: int = 16,
-              backoff_s: float = 1.0, max_backoff_s: float = 30.0) -> int:
+              backoff_s: float = 1.0, max_backoff_s: float = 30.0,
+              env: Optional[dict] = None,
+              _state: Optional[dict] = None,
+              _signals: bool = True) -> int:
     """``serve --supervise``: run the daemon as a child process and
     restart it whenever it dies abnormally (kill -9, device wedge, OOM
     — the faults the self-chaos harness injects).  The restarted child
-    inherits this process's environment, so it re-warms from the same
-    dispatch journal, verdict WAL, and jit cache paths: clients that
-    retry their request ids replay settled verdicts instead of
-    recomputing them.  Returns the child's final exit code — 0 on a
-    clean exit (/shutdown) or supervisor signal, the last crash code
-    once the restart budget is exhausted."""
+    inherits this process's environment (or ``env`` when given — the
+    fleet supervisor's per-member WAL/journal overrides), so it
+    re-warms from the same dispatch journal, verdict WAL, and
+    jit/AOT cache paths: clients that retry their request ids replay
+    settled verdicts instead of recomputing them.  Returns the child's
+    final exit code — 0 on a clean exit (/shutdown) or supervisor
+    signal, the last crash code once the restart budget is exhausted.
+
+    ``_state``/``_signals`` are :func:`supervise_fleet` seams: the
+    fleet runs one ``supervise`` per member on worker threads, where
+    ``signal.signal`` is illegal — it installs ONE handler on the main
+    thread and terminates every member through its shared state box."""
     import signal
     import subprocess
     import sys
 
     cmd = [sys.executable, "-m", "jepsen_tpu.serve", *child_args]
-    state = {"sig": None, "proc": None}
+    state = _state if _state is not None else {"sig": None, "proc": None}
 
     def _forward(signum, frame):  # jt: thread-entry
         state["sig"] = signum
@@ -1788,12 +1975,13 @@ def supervise(child_args, *, max_restarts: int = 16,
         if p is not None and p.poll() is None:
             p.terminate()
 
-    for s in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(s, _forward)
+    if _signals:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(s, _forward)
     restarts = 0
     delay = backoff_s
     while True:
-        proc = subprocess.Popen(cmd)
+        proc = subprocess.Popen(cmd, env=env)
         state["proc"] = proc
         rc = proc.wait()  # jt: allow[net-timeout] — the supervisor's whole job is blocking on the child's lifetime
         if state["sig"] is not None:
@@ -1810,3 +1998,88 @@ def supervise(child_args, *, max_restarts: int = 16,
               file=sys.stderr)
         time.sleep(delay)
         delay = min(delay * 2, max_backoff_s)
+
+
+def fleet_member_env(i: int, base_env: Optional[dict] = None) -> dict:
+    """One fleet member's environment: the dispatch journal and
+    verdict WAL get a ``-<i>`` suffix (two daemons appending to one
+    WAL would interleave torn rows), while the AOT cache dir is left
+    UNTOUCHED — sharing compiled executables across members is the
+    fleet cache's whole point (the manifest is multi-writer-safe)."""
+    env = dict(os.environ if base_env is None else base_env)
+    for var, default in (
+        ("JEPSEN_TPU_JOURNAL", obs_journal.DEFAULT_FILENAME),
+        ("JEPSEN_TPU_WAL", obs_journal.DEFAULT_WAL_FILENAME),
+    ):
+        cur = env.get(var, default)
+        if cur.lower() in ("0", "false", "off", "no", ""):
+            continue
+        root, ext = os.path.splitext(cur)
+        env[var] = f"{root}-{i}{ext}"
+    return env
+
+
+def supervise_fleet(n: int, child_args, *,
+                    base_port: Optional[int] = None,
+                    max_restarts: int = 16, backoff_s: float = 1.0,
+                    max_backoff_s: float = 30.0) -> int:
+    """``serve --supervise --fleet N``: N supervised daemons on one
+    host — ports ``base_port..base_port+N-1``, per-member WAL/journal
+    paths (:func:`fleet_member_env`), one shared AOT executable cache.
+    The admission/device split (:class:`AdmissionState`) is what makes
+    this just config: each member owns its own queue + executor pair,
+    and the router (serve.router) spreads keys across them.  Returns
+    the worst member exit code (0 when every member exited clean)."""
+    import signal
+    import sys
+
+    if base_port is None:
+        base_port = int(os.environ.get("JEPSEN_TPU_SERVE_PORT",
+                                       protocol.DEFAULT_PORT))
+    # each member gets its own --port; strip any caller-supplied one
+    args = []
+    skip = False
+    for a in child_args:
+        if skip:
+            skip = False
+            continue
+        if a == "--port":
+            skip = True
+            continue
+        args.append(a)
+    boxes = [{"sig": None, "proc": None} for _ in range(n)]
+
+    def _forward(signum, frame):  # jt: thread-entry
+        for b in boxes:
+            b["sig"] = signum
+            p = b["proc"]
+            if p is not None and p.poll() is None:
+                p.terminate()
+
+    # ONE handler on the main thread (signal.signal is main-thread
+    # only); member supervisors run with _signals=False underneath it
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, _forward)
+    rcs = [0] * n
+
+    def _member(i: int) -> None:  # jt: thread-entry
+        rcs[i] = supervise(
+            [*args, "--port", str(base_port + i)],
+            max_restarts=max_restarts, backoff_s=backoff_s,
+            max_backoff_s=max_backoff_s, env=fleet_member_env(i),
+            _state=boxes[i], _signals=False,
+        )
+
+    threads = [
+        threading.Thread(target=_member, args=(i,),
+                         name=f"jepsen-fleet-{i}", daemon=True)
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    members = ", ".join(str(base_port + i) for i in range(n))
+    print(f"jepsen-tpu serve: supervising fleet of {n} "
+          f"(ports {members})", file=sys.stderr)
+    for t in threads:
+        t.join()  # jt: allow[net-timeout] — the fleet supervisor's whole job is blocking on member lifetimes
+    return max(rcs)

@@ -1,0 +1,495 @@
+"""The fleet routing front: one process that owns NO device, only the
+map from request shape keys to the fleet member daemons that do.
+
+Why a router (ROADMAP item 1, doc/checker-service.md "Fleet tier"):
+one resident daemon amortizes jit compiles across runs, but its win
+evaporates the moment same-shape traffic is sprayed across N daemons —
+every member pays its own cold compile for every shape.  The router
+**rendezvous-hashes** each request's shape key (the wire model +
+planning opts + the pow2 history-length bucket multiset for ``/check``;
+the graph vertex-bucket multiset for ``/elle``), so same-shape traffic
+from different clients lands on ONE member's resident executor and
+coalesces there, while different shapes spread across the fleet.
+Rendezvous (highest-random-weight) hashing gives the bounded-movement
+property the fleet needs: adding or removing one member re-routes only
+that member's share of keys (tests/test_router.py pins it).
+
+Robustness semantics, in hash order:
+
+- a member's **tripped breaker** (serve.client.CircuitBreaker — the
+  same class, the same taxonomy) spills that key's traffic to the next
+  member in rendezvous order (``jepsen_route_spillover_total``);
+- a **connection-level failure** mid-forward records on the breaker
+  and reroutes the request to the next candidate in the SAME request
+  (``jepsen_route_reroutes_total``) — safe because clients send
+  idempotent request ids, so a request that half-ran on a dying member
+  is recomputed (or WAL-replayed) by the sibling, never double-counted;
+- a **dead member** is marked down by the background ``/healthz``
+  prober within one probe interval (``JEPSEN_TPU_ROUTE_PROBE_INTERVAL``)
+  and its keys re-route without waiting for a connection error;
+- **admission-control 503s propagate untouched** — backpressure is the
+  member's verdict about its own queue, and the client's in-process
+  fallback (not a blind retry on a sibling that may be equally loaded)
+  is the correct relief valve;
+- ``/feed`` sessions are **pinned**: a session's state (the growing
+  DecomposedRun) lives on the member that opened it, so appends/closes
+  follow the pin and a dead pinned member answers 503 rather than
+  silently re-opening an empty session elsewhere.
+
+The router never decodes results and never re-encodes bodies — raw
+bytes pass through both ways (verdict byte-equality with the
+in-process engine survives routing by construction); the body is
+decoded ONCE, read-only, to derive the shape key.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .. import obs
+from . import protocol
+from .client import (DEFAULT_CLIENT_TIMEOUT_S, breaker_for, probe_healthz)
+
+#: how often the background prober sweeps member /healthz (seconds);
+#: a dead member's keys re-route within one interval
+DEFAULT_PROBE_INTERVAL_S = 1.0
+#: per-probe timeout — short: the probe is loopback/LAN liveness, not
+#: device work
+DEFAULT_PROBE_TIMEOUT_S = 0.5
+
+
+def _env_pos_float(name: str, default: float) -> float:
+    try:
+        v = float(os.environ.get(name, default))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def rendezvous_order(members: List[str], key: str) -> List[str]:
+    """Members by descending rendezvous (highest-random-weight) score
+    for ``key``.  Each (member, key) pair scores independently, so
+    removing a member re-ranks NOTHING among the survivors — only the
+    removed member's keys move, each to its own second choice — and a
+    new member takes exactly the keys it now wins.  sha1 here is a
+    uniform hash, not a security boundary."""
+    return sorted(
+        members,
+        key=lambda m: hashlib.sha1(
+            f"{m}|{key}".encode()).hexdigest(),
+        reverse=True,
+    )
+
+
+def _pow2_bucket(n: int) -> int:
+    """The planner's shape-coalescing intuition, router-side: history
+    lengths (and graph vertex counts) pad to buckets, so two batches
+    whose lengths share pow2 buckets compile the same executables."""
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
+def check_route_key(payload: dict) -> str:
+    """The ``/check`` shape key: wire model + the serviceable planning
+    opts + the sorted pow2 history-length bucket multiset — a
+    deterministic, cheap stand-in for the (E, C) buckets the planner
+    will derive, computable without encoding anything.  Same model +
+    opts + length profile ⇒ same compiled executables ⇒ one member."""
+    opts = payload.get("opts") or {}
+    buckets = sorted(
+        _pow2_bucket(len(h)) for h in (payload.get("histories") or [])
+    )
+    return json.dumps(
+        ["check", payload.get("model"),
+         {k: opts.get(k) for k in protocol.CHECK_OPTS if k in opts},
+         buckets],
+        sort_keys=True, default=repr)
+
+
+def elle_route_key(payload: dict) -> str:
+    """The ``/elle`` shape key: the sorted pow2 vertex-bucket multiset
+    of the batch's relation matrices (the screen pads graphs to vertex
+    buckets, so the bucket profile determines the executables)."""
+    buckets = sorted(
+        _pow2_bucket(len(g.get("rel") or ())) for g in
+        (payload.get("graphs") or [])
+    )
+    return json.dumps(["elle", buckets], sort_keys=True)
+
+
+class RouteError(Exception):
+    """Connection-level forward failure — the reroute trigger (HTTP
+    error codes are NOT this: a member's 503/500 is an answer)."""
+
+
+class Router:
+    """The routing front.  ``start(block=False)`` returns once the
+    listener and prober are up; ``port`` then holds the bound port."""
+
+    def __init__(
+        self,
+        members: List[str],
+        host: str = protocol.DEFAULT_HOST,
+        port: int = 0,
+        *,
+        probe_interval_s: Optional[float] = None,
+        probe_timeout_s: Optional[float] = None,
+        forward_timeout_s: float = DEFAULT_CLIENT_TIMEOUT_S,
+    ):
+        if not members:
+            raise ValueError("a router needs at least one --member")
+        self.members = list(dict.fromkeys(members))  # repeatable, deduped
+        self.host = host
+        self.port = port
+        self.probe_interval_s = (
+            _env_pos_float("JEPSEN_TPU_ROUTE_PROBE_INTERVAL",
+                           DEFAULT_PROBE_INTERVAL_S)
+            if probe_interval_s is None else probe_interval_s
+        )
+        self.probe_timeout_s = (
+            _env_pos_float("JEPSEN_TPU_ROUTE_PROBE_TIMEOUT",
+                           DEFAULT_PROBE_TIMEOUT_S)
+            if probe_timeout_s is None else probe_timeout_s
+        )
+        self.forward_timeout_s = forward_timeout_s
+        self.t_start = time.time()
+        self._lock = threading.Lock()
+        #: prober-maintained liveness map; a member starts optimistic
+        #: (True) so the first request needn't wait a probe interval
+        self._up: Dict[str, bool] = {m: True for m in self.members}  # jt: guarded-by(_lock)
+        #: /feed session pins: sid -> member owning the session state
+        self._pins: Dict[str, str] = {}  # jt: guarded-by(_lock)
+        self._stopping = threading.Event()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._prober: Optional[threading.Thread] = None
+
+    # -- membership (prober thread) ----------------------------------------
+
+    def _probe_loop(self) -> None:  # jt: thread-entry
+        while not self._stopping.is_set():
+            self.probe_once()
+            self._stopping.wait(self.probe_interval_s)
+
+    def probe_once(self) -> int:
+        """One /healthz sweep over the membership; returns the number
+        of members currently up.  Public so tests and the smoke can
+        force a deterministic sweep instead of sleeping an interval."""
+        n_up = 0
+        for m in self.members:
+            ok = probe_healthz(m, timeout=self.probe_timeout_s)
+            if ok:
+                n_up += 1
+            else:
+                obs.count("jepsen_route_probe_failures_total", member=m)
+            with self._lock:
+                self._up[m] = ok
+        obs.gauge_set("jepsen_route_members_up", n_up)
+        return n_up
+
+    def _candidates(self, key: str) -> List[str]:
+        """Every member in spill order for ``key``: live members by
+        rendezvous rank first (the winner's own rank ordering IS the
+        spillover order), then down members by rank as a last resort —
+        the prober can lag a just-revived member by one interval, and
+        trying a marked-down member beats refusing outright when the
+        whole fleet looks dark."""
+        order = rendezvous_order(self.members, key)
+        with self._lock:
+            up = dict(self._up)
+        return ([m for m in order if up.get(m)]
+                + [m for m in order if not up.get(m)])
+
+    # -- forwarding (handler threads) --------------------------------------
+
+    def _send(self, member: str, path: str,
+              body: bytes) -> Tuple[int, bytes]:
+        """Forward raw bytes to one member; HTTP error statuses are
+        ANSWERS (returned as-is — a 503 is the member's admission
+        verdict), connection-level failures raise :class:`RouteError`."""
+        req = urllib.request.Request(
+            f"http://{member}{path}", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.forward_timeout_s) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise RouteError(f"{member}: {e!r}") from e
+
+    def _split(self, member: str) -> Tuple[str, int]:
+        host, _, port = member.rpartition(":")
+        return host, int(port)
+
+    def forward(self, path: str, body: bytes, key: str,
+                pinned: Optional[str] = None) -> Tuple[int, bytes]:
+        """Route one request: try candidates in rendezvous/spill order
+        (or only the pinned member, for session traffic whose state
+        cannot move).  Breaker-open members spill without a connection
+        attempt; connection failures record on the breaker and reroute
+        within this same request — idempotent request ids make the
+        retry-through-reroute safe (the sibling recomputes or
+        WAL-replays, never double-counts)."""
+        code, resp, _ = self._forward(path, body, key, pinned)
+        return code, resp
+
+    def _forward(self, path: str, body: bytes, key: str,
+                 pinned: Optional[str] = None,
+                 ) -> Tuple[int, bytes, Optional[str]]:
+        cands = [pinned] if pinned is not None else self._candidates(key)
+        errors = []
+        for member in cands:
+            br = breaker_for(*self._split(member))
+            if not br.allow(
+                    lambda m=member: probe_healthz(
+                        m, timeout=self.probe_timeout_s)):
+                obs.count("jepsen_route_spillover_total", member=member)
+                errors.append(f"{member}: breaker open")
+                continue
+            try:
+                code, resp = self._send(member, path, body)
+            except RouteError as e:
+                br.record_failure()
+                with self._lock:
+                    self._up[member] = False
+                obs.count("jepsen_route_reroutes_total", member=member)
+                errors.append(str(e))
+                continue
+            br.record_success()
+            obs.count("jepsen_route_requests_total", member=member)
+            return code, resp, member
+        # every candidate refused or died: the client's transparent
+        # seam treats this 503 like any admission refusal and falls
+        # back to its in-process engine
+        return 503, protocol.encode_body({
+            "error": "no live fleet member",
+            "members": list(self.members),
+            "detail": errors[-3:],
+        }), None
+
+    # -- per-endpoint routing ----------------------------------------------
+
+    def route_check(self, body: bytes) -> Tuple[int, bytes]:
+        try:
+            key = check_route_key(protocol.decode_body(body))
+        except Exception:  # noqa: BLE001 — malformed body: still
+            # forward (ONE deterministic member via the fallback key),
+            # so the 400 taxonomy comes from a daemon, not from a
+            # second hand-rolled validator here
+            key = "check|malformed"
+        return self.forward("/check", body, key)
+
+    def route_elle(self, body: bytes) -> Tuple[int, bytes]:
+        try:
+            key = elle_route_key(protocol.decode_body(body))
+        except Exception:  # noqa: BLE001 — malformed body, as above
+            key = "elle|malformed"
+        return self.forward("/elle", body, key)
+
+    def route_feed(self, body: bytes) -> Tuple[int, bytes]:
+        """Session-affine routing: ``open`` rendezvous-hashes its
+        (model, opts) key and pins the returned session id to the
+        member that answered; ``append``/``close`` follow the pin
+        (falling back to hashing the session id when the pin is gone —
+        a restarted router re-derives the same member the same way the
+        reopened session would)."""
+        try:
+            payload = protocol.decode_body(body)
+            fop = payload.get("op")
+        except Exception:  # noqa: BLE001 — malformed body, as above
+            return self.forward("/feed", body, "feed|malformed")
+        if fop == "open":
+            key = json.dumps(
+                ["feed", payload.get("model"), payload.get("opts")],
+                sort_keys=True, default=repr)
+            code, resp, member = self._forward("/feed", body, key)
+            if code == 200 and member is not None:
+                try:
+                    sid = protocol.decode_body(resp).get("session")
+                except Exception:  # noqa: BLE001 — not a session ack
+                    sid = None
+                if sid:
+                    with self._lock:
+                        self._pins[sid] = member
+            return code, resp
+        sid = payload.get("session")
+        with self._lock:
+            pinned = self._pins.get(sid)
+        if pinned:
+            code, resp = self.forward("/feed", body, None, pinned=pinned)
+        else:
+            code, resp = self.forward("/feed", body, f"feed-session|{sid}")
+        if fop == "close" and code == 200:
+            with self._lock:
+                self._pins.pop(sid, None)
+        return code, resp
+
+    # -- status -------------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            up = dict(self._up)
+            pins = len(self._pins)
+        return {
+            "role": "router",
+            "ok": any(up.values()),
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "members": [
+                {
+                    "member": m,
+                    "up": bool(up.get(m)),
+                    "breaker": breaker_for(*self._split(m)).state(),
+                }
+                for m in self.members
+            ],
+            "feed_pins": pins,
+            "probe_interval_s": self.probe_interval_s,
+        }
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, block: bool = True) -> "Router":
+        obs.enable()  # live /metrics needs the registry recording
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer((self.host, self.port), handler)  # jt: allow[concurrency-unguarded-shared] — written before listener/prober threads start (Thread.start publication)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="jepsen-route-probe",
+            daemon=True,
+        )
+        self._prober.start()
+        if block:
+            print(
+                f"jepsen-tpu fleet router on "
+                f"http://{self.host}:{self.port}/ -> "
+                f"{', '.join(self.members)} (pid {os.getpid()})"
+            )
+            try:
+                self._server.serve_forever()  # jt: allow[net-timeout] — the accept loop IS the process; shutdown() ends it
+            finally:
+                self.stop()
+        else:
+            threading.Thread(
+                target=self._server.serve_forever, daemon=True
+            ).start()
+        return self
+
+    def request_shutdown(self) -> dict:
+        """Stop the router (members keep serving — stopping THEM is a
+        per-member ``jepsen_tpu shutdown --daemon`` decision, never a
+        side effect of losing the front)."""
+        already = self._stopping.is_set()
+        self._stopping.set()
+        if not already:
+            threading.Thread(target=self._finish_stop, daemon=True).start()
+        return {"ok": True, "role": "router"}
+
+    def _finish_stop(self) -> None:  # jt: thread-entry
+        time.sleep(0.05)
+        if self._server is not None:
+            self._server.shutdown()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        if self._prober is not None:
+            self._prober.join(timeout=5)
+
+
+def _make_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code: int, payload: dict):
+            self._reply(code, protocol.encode_body(payload))
+
+        def do_GET(self):  # noqa: N802 — http.server API, jt: thread-entry
+            try:
+                if self.path == "/healthz":
+                    st = router.status()
+                    self._reply_json(200 if st["ok"] else 500, {
+                        "ok": st["ok"], "role": "router",
+                        "uptime_s": st["uptime_s"],
+                    })
+                elif self.path == "/status":
+                    self._reply_json(200, router.status())
+                elif self.path == "/metrics":
+                    self._reply(200, obs.render_prom().encode(),
+                                "text/plain; version=0.0.4")
+                else:
+                    self._reply_json(404, {"error": "not found"})
+            except BrokenPipeError:
+                pass
+
+        def do_POST(self):  # noqa: N802 — http.server API, jt: thread-entry
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                if self.path == "/check":
+                    code, resp = router.route_check(body)
+                    self._reply(code, resp)
+                elif self.path == "/elle":
+                    code, resp = router.route_elle(body)
+                    self._reply(code, resp)
+                elif self.path == "/feed":
+                    code, resp = router.route_feed(body)
+                    self._reply(code, resp)
+                elif self.path == "/shutdown":
+                    self._reply_json(200, router.request_shutdown())
+                else:
+                    self._reply_json(404, {"error": "not found"})
+            except BrokenPipeError:
+                pass
+
+        def log_message(self, fmt, *args):
+            pass  # the router's obs metrics are the log of record
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    """``python -m jepsen_tpu.serve.router`` / ``jepsen_tpu route``."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="jepsen_tpu route",
+        description="fleet routing front (doc/checker-service.md "
+                    "\"Fleet tier\")",
+    )
+    p.add_argument("--member", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="fleet member daemon (repeatable)")
+    p.add_argument("--host", default=protocol.DEFAULT_HOST)
+    p.add_argument("--port", type=int, default=protocol.DEFAULT_PORT,
+                   help="router bind port (default 8519 — clients "
+                   "point JEPSEN_TPU_SERVE_PORT here unchanged)")
+    args = p.parse_args(argv)
+    Router(args.member, host=args.host, port=args.port).start(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

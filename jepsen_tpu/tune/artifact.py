@@ -79,6 +79,19 @@ def code_fingerprint() -> str:
     return h.hexdigest()
 
 
+def aot_fingerprint() -> str:
+    """The cache id keying the serve tier's shared on-disk AOT
+    executable cache (doc/checker-service.md "Fleet tier"): the engine
+    :func:`code_fingerprint` joined with the active calibration id.
+    Both halves change what gets compiled — the sources define the
+    kernels, the calibration steers union/closure variants and row
+    buckets — so a manifest entry recorded under one pair must never
+    pre-warm a daemon running another."""
+    cal = active()
+    return (f"{code_fingerprint()[:16]}"
+            f"-{cal.calibration_id if cal is not None else 'untuned'}")
+
+
 def device_key() -> Tuple[str, int]:
     """(device kind, local device count) of the attached backend —
     the hardware half of the artifact key.  Initializes the backend;

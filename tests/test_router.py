@@ -1,0 +1,331 @@
+"""Fleet-router tests (jepsen_tpu/serve/router.py).
+
+The contract under test: the routing front never changes WHAT a
+request computes, only WHERE — rendezvous hashing moves the bounded
+minimum of keys on membership change, breaker/connection faults spill
+deterministically down the key's own candidate order, and idempotent
+request ids keep a retry safe no matter which member ends up serving
+it (same daemon → deduped; rerouted sibling → recomputed, verdict
+byte-identical either way).
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.serve import CheckerDaemon, ServiceClient, protocol
+from jepsen_tpu.serve import client as serve_client
+from jepsen_tpu.serve import router as router_mod
+from jepsen_tpu.serve.router import (
+    Router,
+    check_route_key,
+    elle_route_key,
+    rendezvous_order,
+)
+from jepsen_tpu.synth import generate_history as _gen
+
+
+def _keys(n=1000, seed=7):
+    rng = random.Random(seed)
+    return [f"key-{rng.getrandbits(48):012x}" for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# rendezvous hashing: the bounded-movement property
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_total_order_is_deterministic_and_complete():
+    members = ["a:1", "b:2", "c:3"]
+    for key in _keys(50):
+        order = rendezvous_order(members, key)
+        assert sorted(order) == sorted(members)
+        assert order == rendezvous_order(members, key)
+
+
+def test_rendezvous_removal_moves_only_the_removed_members_keys():
+    members = ["a:1", "b:2", "c:3"]
+    keys = _keys()
+    before = {k: rendezvous_order(members, k)[0] for k in keys}
+    survivors = ["a:1", "b:2"]
+    after = {k: rendezvous_order(survivors, k)[0] for k in keys}
+    for k in keys:
+        if before[k] != "c:3":
+            # a survivor's keys NEVER move on another member's death
+            assert after[k] == before[k]
+        else:
+            # the dead member's keys land on that key's own second
+            # choice — exactly where same-request spillover sends them
+            assert after[k] == rendezvous_order(members, k)[1]
+
+
+def test_rendezvous_addition_moves_keys_only_to_the_new_member():
+    members = ["a:1", "b:2", "c:3"]
+    keys = _keys()
+    before = {k: rendezvous_order(members, k)[0] for k in keys}
+    grown = members + ["d:4"]
+    after = {k: rendezvous_order(grown, k)[0] for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    assert all(after[k] == "d:4" for k in moved)
+    # and the new member takes roughly its fair share (1/4), never
+    # a rehash-everything avalanche
+    assert 0 < len(moved) < len(keys) // 2
+
+
+def test_rendezvous_spread_is_roughly_uniform():
+    members = [f"m{i}:80" for i in range(4)]
+    keys = _keys(2000, seed=13)
+    counts = {mem: 0 for mem in members}
+    for k in keys:
+        counts[rendezvous_order(members, k)[0]] += 1
+    for mem, n in counts.items():
+        assert 250 <= n <= 750, (mem, counts)
+
+
+# ---------------------------------------------------------------------------
+# shape keys
+# ---------------------------------------------------------------------------
+
+
+def test_check_route_key_buckets_history_lengths_pow2():
+    model = {"type": "cas-register", "value": 0}
+    base = {"model": model, "opts": {"slot_cap": 32},
+            "histories": [[0] * 5, [0] * 11]}
+    same_buckets = {"model": model, "opts": {"slot_cap": 32},
+                    "histories": [[0] * 7, [0] * 9]}
+    other = {"model": model, "opts": {"slot_cap": 32},
+             "histories": [[0] * 5, [0] * 33]}
+    # 5,11 → buckets 8,16 == 7,9 → 8,16; 33 → 64 differs
+    assert check_route_key(base) == check_route_key(same_buckets)
+    assert check_route_key(base) != check_route_key(other)
+    # non-serviceable opts (window etc.) never fragment the key space
+    with_extra = dict(base, opts={"slot_cap": 32, "window": 9})
+    assert check_route_key(base) == check_route_key(with_extra)
+    # but serviceable planning opts DO: different opts, different
+    # executables, different member
+    assert check_route_key(base) != check_route_key(
+        dict(base, opts={"slot_cap": 64}))
+
+
+def test_elle_route_key_buckets_graph_sizes():
+    g = lambda n: {"rel": [[0] * n] * n, "masks": [], "nonadj": []}  # noqa: E731
+    a = {"graphs": [g(5), g(12)]}
+    b = {"graphs": [g(8), g(9)]}     # same pow2 buckets (8, 16)
+    c = {"graphs": [g(5), g(40)]}    # 64 ≠ 16
+    assert elle_route_key(a) == elle_route_key(b)
+    assert elle_route_key(a) != elle_route_key(c)
+    assert json.loads(elle_route_key(a))[0] == "elle"
+
+
+# ---------------------------------------------------------------------------
+# breaker-driven spillover: the forward state machine, stubbed sends
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def breaker_env(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("JEPSEN_TPU_BREAKER_COOLDOWN", "600")
+    serve_client.reset_breakers()
+    yield
+    serve_client.reset_breakers()
+
+
+def _stub_router(monkeypatch, members, behaviour):
+    """A Router whose sends are scripted: behaviour[member] is either
+    ('ok', code, body) or 'dead' (connection-level failure)."""
+    rt = Router(members, port=0)
+    sent = []
+
+    def fake_send(member, path, body):
+        sent.append(member)
+        b = behaviour[member]
+        if b == "dead":
+            raise router_mod.RouteError(f"{member}: down")
+        return b[1], b[2]
+
+    monkeypatch.setattr(rt, "_send", fake_send)
+    return rt, sent
+
+
+def test_forward_reaches_the_rendezvous_winner(monkeypatch, breaker_env):
+    members = ["h1:1", "h2:2", "h3:3"]
+    rt, sent = _stub_router(
+        monkeypatch, members,
+        {mem: ("ok", 200, b"{}") for mem in members})
+    code, _ = rt.forward("/check", b"{}", "some-key")
+    assert code == 200
+    assert sent == [rendezvous_order(members, "some-key")[0]]
+
+
+def test_forward_reroutes_past_a_dead_member_in_hash_order(
+        monkeypatch, breaker_env):
+    members = ["h1:1", "h2:2", "h3:3"]
+    order = rendezvous_order(members, "k")
+    behaviour = {mem: ("ok", 200, b"{}") for mem in members}
+    behaviour[order[0]] = "dead"
+    rt, sent = _stub_router(monkeypatch, members, behaviour)
+    code, _ = rt.forward("/check", b"{}", "k")
+    assert code == 200
+    # tried the winner, recorded the failure, spilled to second choice
+    assert sent == [order[0], order[1]]
+    assert serve_client.breaker_for("h1", 1) is not None
+
+
+def test_forward_skips_a_tripped_breaker_without_a_connection_attempt(
+        monkeypatch, breaker_env):
+    members = ["h1:1", "h2:2", "h3:3"]
+    order = rendezvous_order(members, "k")
+    host, _, port = order[0].rpartition(":")
+    br = serve_client.breaker_for(host, int(port))
+    br.record_failure()
+    br.record_failure()  # threshold 2 → open
+    assert br.state() == "open"
+    rt, sent = _stub_router(
+        monkeypatch, members,
+        {mem: ("ok", 200, b"{}") for mem in members})
+    code, _ = rt.forward("/check", b"{}", "k")
+    assert code == 200
+    assert sent == [order[1]]  # winner never contacted: pure spillover
+
+
+def test_forward_propagates_member_http_errors_verbatim(
+        monkeypatch, breaker_env):
+    members = ["h1:1", "h2:2"]
+    order = rendezvous_order(members, "k")
+    body_503 = protocol.encode_body({"error": "backlogged"})
+    behaviour = {mem: ("ok", 200, b"{}") for mem in members}
+    behaviour[order[0]] = ("ok", 503, body_503)
+    rt, sent = _stub_router(monkeypatch, members, behaviour)
+    code, resp = rt.forward("/check", b"{}", "k")
+    # admission backpressure is the member's ANSWER — never rerouted
+    # to an equally-loaded sibling, never rewritten
+    assert code == 503 and resp == body_503
+    assert sent == [order[0]]
+
+
+def test_forward_all_members_dead_answers_503(monkeypatch, breaker_env):
+    members = ["h1:1", "h2:2"]
+    rt, sent = _stub_router(
+        monkeypatch, members, {mem: "dead" for mem in members})
+    code, resp = rt.forward("/check", b"{}", "k")
+    assert code == 503
+    assert protocol.decode_body(resp)["error"] == "no live fleet member"
+    assert sent == rendezvous_order(members, "k")
+
+
+def test_forward_tries_marked_down_members_last(monkeypatch, breaker_env):
+    members = ["h1:1", "h2:2", "h3:3"]
+    order = rendezvous_order(members, "k")
+    rt, sent = _stub_router(
+        monkeypatch, members,
+        {mem: ("ok", 200, b"{}") for mem in members})
+    with rt._lock:
+        rt._up[order[0]] = False
+    code, _ = rt.forward("/check", b"{}", "k")
+    assert code == 200
+    # a prober-marked-down winner is skipped up front; its keys serve
+    # from the second choice without paying a connection timeout
+    assert sent == [order[1]]
+
+
+# ---------------------------------------------------------------------------
+# retry-through-reroute: idempotent ids across real members
+# ---------------------------------------------------------------------------
+
+
+def _small_corpus(seed=991):
+    rng = random.Random(seed)
+    return [
+        _gen(rng, n_procs=3, n_ops=10, crash_p=0.02, corrupt=(i == 0))
+        for i in range(4)
+    ]
+
+
+def _post_rid(port, model, hists, opts, rid):
+    c = ServiceClient(port=port)
+    body = protocol.check_request(model, hists, opts, req=rid)
+    code, resp = c._resilient_post("/check", body)
+    return code, protocol.decode_body(resp)
+
+
+def test_retry_through_reroute_is_idempotent():
+    serve_client.reset_breakers()
+    model = m.cas_register(0)
+    hists = _small_corpus()
+    opts = {"slot_cap": 32}
+    expected = [r.get("valid?") for r in
+                wgl.check_batch(model, hists, **opts)]
+    daemons = [CheckerDaemon(port=0, coalesce_wait_s=0.1)
+               for _ in range(2)]
+    rt = None
+    try:
+        for d in daemons:
+            d.start(block=False)
+        rt = Router([f"127.0.0.1:{d.port}" for d in daemons],
+                    port=0, probe_interval_s=600.0)
+        rt.start(block=False)
+        assert rt.probe_once() == 2
+
+        rid = "router-dedup-rid"
+        code, payload = _post_rid(rt.port, model, hists, opts, rid)
+        assert code == 200
+        first = [r.get("valid?") for r in payload["results"]]
+        assert first == expected
+        owner = max(daemons,
+                    key=lambda d: d.status().get("requests", 0))
+        sibling = [d for d in daemons if d is not owner][0]
+
+        # same id, same member: served from the done-cache, counters
+        # advance by exactly one request and one dedup
+        st0 = owner.status()
+        code, payload = _post_rid(rt.port, model, hists, opts, rid)
+        assert code == 200
+        assert [r.get("valid?") for r in payload["results"]] == expected
+        st1 = owner.status()
+        assert st1["deduped"] - st0["deduped"] == 1
+
+        # the owner dies; the retry with the SAME id reroutes to the
+        # sibling, which recomputes it fresh — identical verdicts, no
+        # state shared, nothing double-counted anywhere
+        owner.stop()
+        sib0 = sibling.status().get("requests", 0)
+        code, payload = _post_rid(rt.port, model, hists, opts, rid)
+        assert code == 200
+        assert [r.get("valid?") for r in payload["results"]] == expected
+        assert sibling.status().get("requests", 0) == sib0 + 1
+    finally:
+        if rt is not None:
+            rt.stop()
+        for d in daemons:
+            d.stop()
+        serve_client.reset_breakers()
+
+
+def test_router_status_and_healthz_endpoints():
+    serve_client.reset_breakers()
+    daemon = CheckerDaemon(port=0)
+    rt = None
+    try:
+        daemon.start(block=False)
+        rt = Router([f"127.0.0.1:{daemon.port}", "127.0.0.1:9"],
+                    port=0, probe_interval_s=600.0)
+        rt.start(block=False)
+        rt.probe_once()
+        st = rt.status()
+        assert st["role"] == "router" and st["ok"]
+        ups = {mm["member"]: mm["up"] for mm in st["members"]}
+        assert ups[f"127.0.0.1:{daemon.port}"] is True
+        assert ups["127.0.0.1:9"] is False
+        # the HTTP surface agrees with the in-process view
+        rc = ServiceClient(port=rt.port)
+        assert rc.healthy()
+    finally:
+        if rt is not None:
+            rt.stop()
+        daemon.stop()
+        serve_client.reset_breakers()
