@@ -1252,7 +1252,12 @@ def bench_elle():
     and appends a ``"bench": "elle"`` record to BENCH_tpu_windows.jsonl
     (excluded from _best_window by the existing label rule; the record
     carries ``closure_mode``, so a fixed-vs-earlyexit A/B pair — run
-    via JEPSEN_TPU_CYCLES_CLOSURE — stays distinguishable).  Emits
+    via JEPSEN_TPU_CYCLES_CLOSURE — stays distinguishable).  Also
+    re-times the screened pass once per closure arithmetic and appends
+    one ``"bench": "closure-impl"`` window per impl
+    (uint8/packed32/bf16) carrying the estimated closure GFLOP/s and
+    effective GB/s — the A/B evidence the ``closure_impl`` knob is
+    tuned on (doc/checker-engines.md "Word-packed closure").  Emits
     ONE JSON line like the main bench; never crashes without it."""
     payload = {
         "metric": "elle_screened_classify_histories_per_sec",
@@ -1357,11 +1362,62 @@ def bench_elle():
             if dev_diag["device_dispatch_s"] > 0 else None,
             "platform": jax.devices()[0].platform,
         })
+        # per-impl closure windows: the same screened pass once per
+        # squaring arithmetic (JEPSEN_TPU_CYCLES_IMPL), each appended
+        # as a labeled '"bench": "closure-impl"' record — A/B evidence
+        # for the closure_impl tuning knob, excluded from _best_window
+        # by the existing label rule.  The effective-bandwidth estimate
+        # derives from the settle-site flop counter: one closure MAC
+        # touches one lane of resident state, carried at 2 B (bf16
+        # lane, uint8/bf16 impls) or 4 B per 32 lanes (packed32 word).
+        impl_windows = []
+        for impl in ops_cycles._VALID_CLOSURE_IMPLS:
+            os.environ["JEPSEN_TPU_CYCLES_IMPL"] = impl
+            try:
+                i_s, i_res, i_diag = timed("device")
+            finally:
+                os.environ.pop("JEPSEN_TPU_CYCLES_IMPL", None)
+            if [r.get("valid?") for r in i_res] != [
+                r.get("valid?") for r in cpu_res
+            ]:
+                payload["error"] = (
+                    f"closure impl {impl} verdicts diverged")
+            exec_s = i_diag["device_dispatch_s"]
+            flops = i_diag["closure_flops"]
+            lane_bytes = 4.0 / 32.0 if impl == "packed32" else 2.0
+            est_bytes = flops / 2.0 * lane_bytes
+            impl_windows.append({
+                "captured_at": _utcnow(),
+                "bench": "closure-impl",
+                "impl": impl,
+                "closure_mode": ops_cycles.closure_mode(),
+                "metric": payload["metric"],
+                "value": round(n_hists / i_s, 2) if i_s > 0 else 0.0,
+                "unit": "histories/sec",
+                "batch": n_hists,
+                "workload": mode,
+                "device_dispatch_s": round(exec_s, 4),
+                "closure_gflops_per_s": round(
+                    flops / exec_s / 1e9, 3) if exec_s > 0 else None,
+                "est_gbytes_per_s": round(
+                    est_bytes / exec_s / 1e9, 3) if exec_s > 0 else None,
+                "platform": jax.devices()[0].platform,
+            })
+        payload["closure_impls"] = {
+            w["impl"]: {
+                "hps": w["value"],
+                "closure_gflops_per_s": w["closure_gflops_per_s"],
+                "est_gbytes_per_s": w["est_gbytes_per_s"],
+            }
+            for w in impl_windows
+        }
         try:
             with open(WINDOWS, "a") as f:
                 f.write(json.dumps(
                     {"captured_at": _utcnow(), "bench": "elle", **payload}
                 ) + "\n")
+                for w in impl_windows:
+                    f.write(json.dumps(w) + "\n")
         except OSError as e:
             print(f"window append failed: {e!r}", file=sys.stderr)
     except Exception as e:  # noqa: BLE001 — always emit the JSON line

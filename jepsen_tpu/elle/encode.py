@@ -63,6 +63,13 @@ NONADJ_MASKS = (
 #: kernels and the boolean has-cycle closure share shape discipline
 GRAPH_BUCKET_MIN = 16
 
+#: boolean lanes per packed uint32 word — the word floor
+#: :func:`graph_bucket` pads vertex counts to, so the ``packed32``
+#: closure never sees ragged word lanes (mirrors
+#: ``jepsen_tpu.ops.dense.WORD_LANES``; kept literal here to avoid an
+#: elle → ops import for one constant)
+WORD_LANES = 32
+
 #: packed-plane weight of one lifted nonadjacent walk query: its
 #: 2n×2n product graph carries four n×n planes' worth of closure
 #: state, vs one plane per membership filter mask
@@ -70,14 +77,26 @@ LIFTED_PLANE_WEIGHT = 4
 
 
 def plane_weight(masks: Sequence[int],
-                 nonadj: Sequence[Tuple[int, int]]) -> int:
+                 nonadj: Sequence[Tuple[int, int]],
+                 impl: str = "uint8") -> int:
     """Packed closure planes (n×n-equivalents) one profile expands
     into on the batch axis — the ``F`` coordinate of a profile's
     ``(kernel="cycles", E, C, F)`` cost-table key since the
     plane-packing work: one plane per membership mask,
     :data:`LIFTED_PLANE_WEIGHT` per lifted walk query.  Floors at 1 so
-    an edge-free profile (no masks, no queries) still ranks."""
-    return max(1, len(masks) + LIFTED_PLANE_WEIGHT * len(nonadj))
+    an edge-free profile (no masks, no queries) still ranks.
+
+    ``impl="packed32"`` prices the whole profile at W/n ≈ 1/32 of its
+    uint8 footprint (``⌈planes/32⌉``): a word-packed plane moves one
+    uint32 word per 32 vertex lanes, so the cost-table coordinate, the
+    analytic ``rows·E²·frontier`` proxy, and the scheduler's
+    largest-first ordering all see the denser closure as ~32×
+    cheaper — the pricing half of the word-packing contract
+    (``ops.cycles.cycles_max_dispatch`` is the footprint half)."""
+    base = max(1, len(masks) + LIFTED_PLANE_WEIGHT * len(nonadj))
+    if impl == "packed32":
+        return max(1, -(-base // WORD_LANES))
+    return base
 
 
 def rel_mask(rels) -> int:
@@ -93,8 +112,20 @@ def graph_bucket(n: int) -> int:
     :data:`GRAPH_BUCKET_MIN`) so compiled screen kernels are shared
     across graphs of nearby size — the same recompile-bounding
     discipline as ``ops.cycles._bucket`` and the engine's (E, C)
-    buckets."""
-    return max(GRAPH_BUCKET_MIN, 1 << max(0, int(n) - 1).bit_length())
+    buckets.
+
+    Vertex counts first round up to a multiple of :data:`WORD_LANES`
+    (the **word floor**) so the ``packed32`` closure's uint32 words
+    never carry ragged lanes: every bucket a screen can see is a
+    multiple of 32, making W = n/32 exact.  The effective minimum
+    bucket is therefore 32.  Padding is provably inert — padded
+    rows/columns carry no relation bits (:func:`stack_rel` zero-fills),
+    an edge-free vertex is acyclic and unreachable, and the closure
+    recurrence ``r ← r ∪ r·r`` never sets a bit no path witnesses —
+    so a graph screened at bucket 32 answers byte-identically to the
+    same graph at the pre-word-floor bucket 16."""
+    n = -(-max(1, int(n)) // WORD_LANES) * WORD_LANES
+    return max(GRAPH_BUCKET_MIN, 1 << (n - 1).bit_length())
 
 
 class EncodedGraph:

@@ -529,8 +529,12 @@ def estimated_cost(pb: PlannedBucket) -> float:
         # batched boolean closure (the Elle screens): per-row work is
         # the n×n matrix squaring ladder over the packed plane stack,
         # so footprint scales with E² × the plane weight (frontier
-        # carries plane_weight(masks, nonadj) on ScreenPlan, 1 on the
-        # plain has-cycle CyclePlan)
+        # carries plane_weight(masks, nonadj, closure_impl) on
+        # ScreenPlan, 1 on the plain has-cycle CyclePlan).  Under the
+        # packed32 closure impl the frontier arrives pre-discounted by
+        # W/n ≈ 1/32 — one uint32 word per 32 vertex lanes — so the
+        # proxy and the measured cost table rank a word-packed bucket
+        # ~32× cheaper than the same profile's uint8 lowering
         return float(rows) * plan.E * plan.E * max(1, plan.frontier)
     words = max(1, -(-plan.E // 32))
     return float(rows * plan.frontier * (plan.C + 1) * words)

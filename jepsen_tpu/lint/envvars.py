@@ -61,6 +61,10 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "ops/cycles.py",
            "closure kernel variant (`fixed`/`earlyexit`); env > "
            "calibration > default"),
+    EnvVar("JEPSEN_TPU_CYCLES_IMPL", "auto",
+           "ops/cycles.py",
+           "closure squaring arithmetic (`uint8`/`packed32`/`bf16`); "
+           "env > calibration > default"),
     EnvVar("JEPSEN_TPU_DENSE_UNION", "auto",
            "ops/dense.py",
            "dense-kernel subset-union lowering (`matmul`/`scan`); env "

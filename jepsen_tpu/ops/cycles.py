@@ -44,6 +44,24 @@ check is a device-wide sync whose cost only pays off at large n.
 Rounds actually run come back as a per-row output and settle into
 ``jepsen_cycles_closure_rounds_total`` / ``_rounds_saved_total``.
 
+**Closure implementations**: orthogonal to the mode, the squaring
+*arithmetic* is a second tuned knob (``JEPSEN_TPU_CYCLES_IMPL`` >
+calibration ``closure_impl`` > :data:`DEFAULT_CLOSURE_IMPL`):
+``"uint8"`` is the historical saturated-bfloat16 lowering over the
+uint8 relation planes (1 live bit per lane), ``"bf16"`` keeps a
+boolean carry and casts to bfloat16 only for each round's MXU matmul
+(threshold > 0), and ``"packed32"`` bit-packs adjacency rows into
+uint32 words ‚Äî :func:`_pack_words`, ``W = ‚åàn/32‚åâ`` ‚Äî and squares in
+the boolean semiring as an AND-broadcast + OR-reduce over word lanes
+(no popcount: reachability only cares about any-bit).  All three run
+the identical closure recurrence on the same {0,1} lattice, so
+members/walks/rounds are byte-identical by construction (the
+kernels-smoke and fuzz gates pin it); what changes is density ‚Äî the
+packed stack moves W/n ‚âà 1/32 of the uint8 bytes, so the budget math
+(:func:`cycles_max_dispatch`, the plane-weight ``frontier``) prices
+packed rows 32√ó cheaper and a packed bucket legally dispatches ~32√ó
+more rows per chunk (doc/checker-engines.md "Word-packed closure").
+
 Since the engine-routing work these kernels no longer dispatch through
 a private loop: every batch is planned into :class:`CyclePlan` /
 :class:`ScreenPlan` buckets (power-of-two vertex buckets √ó
@@ -69,6 +87,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from . import dense
 
 
 def _bucket(n: int) -> int:
@@ -107,6 +127,14 @@ DEFAULT_CLOSURE_MODE = "fixed"
 
 _VALID_CLOSURE_MODES = ("fixed", "earlyexit")
 
+#: closure squaring arithmetic when neither the environment nor a
+#: calibration picks one: the historical saturated-bf16 lowering over
+#: uint8 planes ‚Äî packed32's unpack/repack and bf16's per-round cast
+#: are real per-round costs the tuner must measure before opting in
+DEFAULT_CLOSURE_IMPL = "uint8"
+
+_VALID_CLOSURE_IMPLS = ("uint8", "packed32", "bf16")
+
 
 def closure_mode() -> str:
     """Resolved closure-iteration mode for the cycle kernels:
@@ -129,6 +157,58 @@ def closure_mode() -> str:
     )
 
 
+def closure_impl() -> str:
+    """Resolved closure-squaring arithmetic for the cycle kernels:
+    ``JEPSEN_TPU_CYCLES_IMPL`` > active calibration (``closure_impl``
+    param ‚Äî ``jepsen_tpu tune`` measures the uint8/packed32/bf16 gap
+    per chip and shape) > :data:`DEFAULT_CLOSURE_IMPL`.  Part of every
+    closure-kernel cache key (and of the mesh ``shard_fn`` key), so
+    flipping it can never serve a stale lowering."""
+    from ..tune import artifact as _cal
+
+    def parse(v: str):
+        v = v.strip().lower()
+        return v if v in _VALID_CLOSURE_IMPLS else None
+
+    return _cal.resolve_knob(
+        "JEPSEN_TPU_CYCLES_IMPL",
+        parse,
+        lambda cal: cal.closure_impl(),
+        DEFAULT_CLOSURE_IMPL,
+    )
+
+
+def _pack_words(adj):
+    """Device word-packing: ``(..., n) bool ‚Üí (..., W) uint32`` with
+    lane ``j`` at word ``j // 32``, bit ``j % 32`` ‚Äî bit-for-bit the
+    little-order layout of the host
+    :func:`jepsen_tpu.ops.dense.pack_words_np` (the round-trip
+    property tests pin the two equal).  The weighted sum over 32-lane
+    groups is exact: distinct powers of two never carry."""
+    n = adj.shape[-1]
+    W = dense.word_count(n)
+    lanes = adj.astype(jnp.uint32)
+    pad = W * dense.WORD_LANES - n
+    if pad:
+        lanes = jnp.pad(lanes, [(0, 0)] * (lanes.ndim - 1) + [(0, pad)])
+    weights = jnp.uint32(1) << jnp.arange(
+        dense.WORD_LANES, dtype=jnp.uint32
+    )
+    return jnp.sum(
+        lanes.reshape(lanes.shape[:-1] + (W, dense.WORD_LANES)) * weights,
+        axis=-1,
+        dtype=jnp.uint32,
+    )
+
+
+def _unpack_words(words, n: int):
+    """Inverse of :func:`_pack_words`: ``(..., W) uint32 ‚Üí (..., n)``
+    bool; lanes past ``n`` are word-floor padding and are dropped."""
+    shifts = jnp.arange(dense.WORD_LANES, dtype=jnp.uint32)
+    lanes = (words[..., None] >> shifts) & jnp.uint32(1)
+    return lanes.reshape(words.shape[:-1] + (-1,))[..., :n] > 0
+
+
 def closure_rounds(n: int) -> int:
     """Squaring rounds that guarantee full transitive closure of an
     n-vertex graph (path length doubles per round)."""
@@ -140,24 +220,37 @@ def cycles_max_dispatch(
     n_filters: int = 1,
     n_lifted: int = 0,
     max_dispatch: Optional[int] = None,
+    impl: str = "uint8",
 ) -> int:
     """Largest safe per-dispatch row count for a cycle kernel over
     ``n``-vertex graphs whose packed stack carries ``n_filters``
     membership planes and ``n_lifted`` lifted (2n√ó2n) walk planes per
     row.  Returns 0 when even a single row exceeds the budget ‚Äî
     callers must route those graphs to the CPU path instead of
-    dispatching."""
+    dispatching.
+
+    ``impl="packed32"`` prices each plane at its word-packed footprint
+    ‚Äî ``n¬∑W`` uint32 words (``W = ‚åàn/32‚åâ``, the lifted planes at
+    ``2n¬∑‚åà2n/32‚åâ``) instead of ``n¬≤`` lanes, i.e. W/n ‚âà 1/32 of the
+    uint8 footprint ‚Äî so a packed bucket legally dispatches ~32√ó more
+    rows per chunk under the same :data:`CYCLES_DISPATCH_BUDGET`
+    (``"bf16"`` keeps the uint8 pricing: its carry is still one lane
+    per vertex pair)."""
     if max_dispatch is None:
         max_dispatch = DEFAULT_CYCLES_MAX_DISPATCH
-    per_row = n * n * (2 * max(1, n_filters) + 8 * n_lifted)
+    if impl == "packed32":
+        per_row = (2 * n * dense.word_count(n) * max(1, n_filters)
+                   + 2 * (2 * n) * dense.word_count(2 * n) * n_lifted)
+    else:
+        per_row = n * n * (2 * max(1, n_filters) + 8 * n_lifted)
     if per_row > CYCLES_DISPATCH_BUDGET:
         return 0
     return max(1, min(max_dispatch, CYCLES_DISPATCH_BUDGET // per_row))
 
 
-def _bool_closure(adj, mode: str = "fixed"):
-    """Transitive (‚â•1 step) boolean closure by rounds of saturated
-    bfloat16 matrix squaring; shape-static, trace-safe.  Returns
+def _bool_closure(adj, mode: str = "fixed", impl: str = "uint8"):
+    """Transitive (‚â•1 step) boolean closure by rounds of matrix
+    squaring; shape-static, trace-safe.  Returns
     ``(closure bool, rounds-run int32 scalar)``.
 
     ``mode="fixed"`` always runs the full log‚ÇÇ(n) ladder as a
@@ -165,9 +258,80 @@ def _bool_closure(adj, mode: str = "fixed"):
     a ``lax.while_loop`` that stops once a round changes nothing.
     Byte-identical by construction: the squaring step is monotone and
     idempotent at fixpoint on the saturated {0,1} values, so the extra
-    rounds the fixed ladder runs past convergence are the identity."""
+    rounds the fixed ladder runs past convergence are the identity.
+
+    ``impl`` picks the squaring arithmetic (module docstring "Closure
+    implementations"): ``"uint8"`` the historical saturated-bf16
+    carry, ``"bf16"`` a boolean carry with a per-round bf16 MXU matmul
+    thresholded > 0, ``"packed32"`` a uint32 word carry
+    (:func:`_pack_words`) squared in the boolean semiring ‚Äî one round
+    is an AND-broadcast of row lanes against the word rows plus an
+    OR-reduce over the intermediate-vertex axis, no popcount.  All
+    three run the same recurrence ``r ‚Üê r ‚à™ r¬∑r`` on the same lattice,
+    so closures AND fixpoint round counts are byte-identical across
+    impls (the fuzz gate pins diameters 1..n)."""
     n = adj.shape[-1]
     rounds = closure_rounds(n)
+
+    if impl == "packed32":
+        def square(rc):  # rc: (..., n, W) uint32 word rows
+            lanes = _unpack_words(rc, n)  # (..., n, n): i reaches k?
+            hops = jnp.bitwise_or.reduce(
+                jnp.where(lanes[..., None], rc[..., None, :, :],
+                          jnp.uint32(0)),
+                axis=-2,
+            )
+            return rc | hops
+
+        rw = _pack_words(adj)
+        if mode == "earlyexit":
+            def cond(carry):
+                _, changed, i = carry
+                return changed & (i < rounds)
+
+            def body(carry):
+                rc, _, i = carry
+                rr = square(rc)
+                return rr, jnp.any(rr != rc), i + jnp.int32(1)
+
+            rw, _, used = jax.lax.while_loop(
+                cond, body, (rw, jnp.bool_(True), jnp.int32(0))
+            )
+            return _unpack_words(rw, n), used
+
+        def step(rc, _):
+            return square(rc), None
+
+        rw, _ = jax.lax.scan(step, rw, None, length=rounds)
+        return _unpack_words(rw, n), jnp.int32(rounds)
+
+    if impl == "bf16":
+        def square_b(rb):  # rb: (..., n, n) bool carry
+            f = rb.astype(jnp.bfloat16)
+            return rb | (jnp.matmul(f, f) > 0)
+
+        rb = adj > 0 if adj.dtype != jnp.bool_ else adj
+        if mode == "earlyexit":
+            def cond(carry):
+                _, changed, i = carry
+                return changed & (i < rounds)
+
+            def body(carry):
+                rc, _, i = carry
+                rr = square_b(rc)
+                return rr, jnp.any(rr != rc), i + jnp.int32(1)
+
+            rb, _, used = jax.lax.while_loop(
+                cond, body, (rb, jnp.bool_(True), jnp.int32(0))
+            )
+            return rb, used
+
+        def step(rc, _):
+            return square_b(rc), None
+
+        rb, _ = jax.lax.scan(step, rb, None, length=rounds)
+        return rb, jnp.int32(rounds)
+
     r = adj.astype(jnp.bfloat16)
 
     if mode == "earlyexit":
@@ -195,10 +359,10 @@ def _bool_closure(adj, mode: str = "fixed"):
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _closure_fn(n: int, mode: str = "fixed"):  # jt: allow[budget-missing-cap] ‚Äî capped by the engine-facing wrapper _cyclic_fn
+def _closure_fn(n: int, mode: str = "fixed", impl: str = "uint8"):  # jt: allow[budget-missing-cap] ‚Äî capped by the engine-facing wrapper _cyclic_fn
     @jax.jit
     def has_cycle(adj):  # adj: (B, n, n) bool
-        r, used = _bool_closure(adj, mode)
+        r, used = _bool_closure(adj, mode, impl)
         diag = jnp.diagonal(r, axis1=-2, axis2=-1)
         flags = jnp.any(diag, axis=-1)
         return flags, jnp.broadcast_to(used, flags.shape)
@@ -207,29 +371,32 @@ def _closure_fn(n: int, mode: str = "fixed"):  # jt: allow[budget-missing-cap] ‚
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _cyclic_fn(n: int, mode: str = "fixed"):
+def _cyclic_fn(n: int, mode: str = "fixed", impl: str = "uint8"):
     """Engine-facing variant of :func:`_closure_fn`: tuple outputs (the
     execution layer materializes output *tuples* ‚Äî flags plus the
     per-row rounds-run evidence) and a ``safe_dispatch`` row cap like
     every other engine kernel."""
-    base = _closure_fn(n, mode)
+    base = _closure_fn(n, mode, impl)
     fn = jax.jit(lambda adj: base(adj))
-    fn.safe_dispatch = cycles_max_dispatch(n, 1, 0)
+    fn.safe_dispatch = cycles_max_dispatch(n, 1, 0, impl=impl)
+    fn.closure_impl = impl  # rides the mesh shard_fn cache key
     return fn
 
 
 def _screen_fn(n: int, masks: Tuple[int, ...],
                nonadj: Tuple[Tuple[int, int], ...]):
     """The production transactional-screen kernel: the packed lowering
-    at the resolved :func:`closure_mode` (see :func:`_screen_fn_variant`
-    for the cache and the per-mask reference lowering)."""
-    return _screen_fn_variant(n, masks, nonadj, True, closure_mode())
+    at the resolved :func:`closure_mode` / :func:`closure_impl` (see
+    :func:`_screen_fn_variant` for the cache and the per-mask
+    reference lowering)."""
+    return _screen_fn_variant(n, masks, nonadj, True, closure_mode(),
+                              closure_impl())
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
 def _screen_fn_variant(n: int, masks: Tuple[int, ...],
                        nonadj: Tuple[Tuple[int, int], ...],
-                       packed: bool, mode: str):
+                       packed: bool, mode: str, impl: str = "uint8"):
     """The transactional screen kernel for ``n``-vertex graphs: per
     relation-filter SCC membership masks plus per-(want, rest) lifted
     nonadjacent-walk masks, all in ONE dispatch over a ``(B, n, n)``
@@ -247,7 +414,10 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
     ``packed=False`` keeps the historical per-mask loop (F + Q small
     closures) as the differential reference the equality gates compare
     against; both produce byte-identical members/walks because batched
-    matmul is independent per batch element."""
+    matmul is independent per batch element.  ``impl`` selects the
+    closure squaring arithmetic (:func:`closure_impl`); it only
+    touches :func:`_bool_closure` internals, so every
+    (packed, mode, impl) combination screens identically."""
     F, Q = len(masks), len(nonadj)
 
     @jax.jit
@@ -258,7 +428,7 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
             if masks:
                 marr = jnp.asarray(masks, jnp.uint8)
                 planes = (rel[:, None] & marr[None, :, None, None]) > 0
-                c, um = _bool_closure(planes.reshape(B * F, n, n), mode)
+                c, um = _bool_closure(planes.reshape(B * F, n, n), mode, impl)
                 c = c.reshape(B, F, n, n)
                 # v sits on a cycle of this filtered subgraph iff some
                 # j is reachable forward AND backward (j = v covers
@@ -284,7 +454,7 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
                 bot = jnp.concatenate([ar, jnp.zeros_like(ar)], axis=-1)
                 lifted = jnp.concatenate([top, bot], axis=-2)
                 c, uw = _bool_closure(
-                    lifted.reshape(B * Q, 2 * n, 2 * n), mode
+                    lifted.reshape(B * Q, 2 * n, 2 * n), mode, impl
                 )
                 c = c.reshape(B, Q, 2 * n, 2 * n)
                 reach = c[:, :, n:, :n]  # from (¬∑, 1) to (¬∑, 0), ‚â•1 step
@@ -295,7 +465,7 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
         else:
             members = []
             for mask in masks:
-                r, u = _bool_closure((rel & jnp.uint8(mask)) > 0, mode)
+                r, u = _bool_closure((rel & jnp.uint8(mask)) > 0, mode, impl)
                 members.append(
                     jnp.any(r & jnp.swapaxes(r, -1, -2), axis=-1)
                 )
@@ -307,7 +477,7 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
                 top = jnp.concatenate([ar, aw], axis=-1)
                 bot = jnp.concatenate([ar, jnp.zeros_like(ar)], axis=-1)
                 c, u = _bool_closure(
-                    jnp.concatenate([top, bot], axis=-2), mode
+                    jnp.concatenate([top, bot], axis=-2), mode, impl
                 )
                 reach = c[:, n:, :n]
                 walks.append(
@@ -321,7 +491,8 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
         rounds = jnp.broadcast_to(used, (B,)).astype(jnp.int32)
         return m, w, rounds
 
-    screen.safe_dispatch = cycles_max_dispatch(n, F, Q)
+    screen.safe_dispatch = cycles_max_dispatch(n, F, Q, impl=impl)
+    screen.closure_impl = impl  # rides the mesh shard_fn cache key
     return screen
 
 
@@ -353,6 +524,16 @@ def _settle_closure_obs(plan, rounds: np.ndarray, n_live: int) -> None:
               max(0, plan.rounds_full - used), mode=plan.closure_mode)
     obs.gauge_set("jepsen_cycles_packed_plane_occupancy",
                   n_live / rounds.shape[0])
+    # which squaring arithmetic actually dispatched (the tuner settles
+    # the winner per shape; this is the evidence it actually ran)
+    obs.count("jepsen_cycles_impl_total", 1, impl=plan.closure_impl)
+    if plan.closure_impl == "packed32":
+        # live vertex lanes / carried word lanes: 1.0 on word-floored
+        # buckets, < 1 only when a caller bypasses encode.graph_bucket
+        obs.gauge_set(
+            "jepsen_cycles_word_lane_occupancy",
+            plan.E / (dense.word_count(plan.E) * dense.WORD_LANES),
+        )
     # estimated MXU work this dispatch actually ran: each round squares
     # every live row's packed plane stack (~2¬∑E¬≥ flops per E-plane;
     # the lifted 2E-planes ride the plan's frontier weight), so the
@@ -388,15 +569,17 @@ class CyclePlan:
     #: the history kernels' 6-array fills)
     pad_fills = (0,)
     __slots__ = ("fn", "disp", "E", "C", "frontier", "closure_mode",
-                 "rounds_full")
+                 "closure_impl", "rounds_full")
 
     def __init__(self, n: int, max_dispatch: Optional[int] = None):
         mode = closure_mode()
+        impl = closure_impl()
         self.closure_mode = mode
-        self.fn = _cyclic_fn(n, mode)
+        self.closure_impl = impl
+        self.fn = _cyclic_fn(n, mode, impl)
         self.E, self.C, self.frontier = n, 0, 1
         self.rounds_full = closure_rounds(n)
-        self.disp = cycles_max_dispatch(n, 1, 0, max_dispatch)
+        self.disp = cycles_max_dispatch(n, 1, 0, max_dispatch, impl)
 
     def run_rows(self, mesh, arrays):
         return _run_elle(self.fn, mesh, arrays[0], 2)
@@ -418,7 +601,7 @@ class ScreenPlan:
     kernel = "cycles"
     pad_fills = (0,)  # see CyclePlan.pad_fills
     __slots__ = ("fn", "disp", "E", "C", "frontier", "masks", "nonadj",
-                 "closure_mode", "rounds_full")
+                 "closure_mode", "closure_impl", "rounds_full")
 
     def __init__(self, n: int, masks: Tuple[int, ...],
                  nonadj: Tuple[Tuple[int, int], ...],
@@ -428,17 +611,20 @@ class ScreenPlan:
         self.masks = tuple(masks)
         self.nonadj = tuple(nonadj)
         mode = closure_mode()
+        impl = closure_impl()
         self.closure_mode = mode
+        self.closure_impl = impl
         self.fn = _screen_fn_variant(n, self.masks, self.nonadj, True,
-                                     mode)
+                                     mode, impl)
         self.E, self.C = n, 0
-        self.frontier = encode_mod.plane_weight(self.masks, self.nonadj)
+        self.frontier = encode_mod.plane_weight(self.masks, self.nonadj,
+                                                impl)
         self.rounds_full = (
             (closure_rounds(n) if self.masks else 0)
             + (closure_rounds(2 * n) if self.nonadj else 0)
         )
         self.disp = cycles_max_dispatch(
-            n, len(self.masks), len(self.nonadj), max_dispatch
+            n, len(self.masks), len(self.nonadj), max_dispatch, impl
         )
 
     def run_rows(self, mesh, arrays):
@@ -525,11 +711,71 @@ def _np_screen(rel: np.ndarray, masks: Sequence[int],
     return members, walks
 
 
-#: host-fallback stacking bound, in bool words: over-budget buckets
-#: batch through :func:`_np_has_cycle` in chunks of this many words so
-#: the vectorized closure never materializes an unbounded (B, n, n)
-#: stack for the very shapes that were too big for the device
+#: host-fallback stacking bound, in words of resident state:
+#: over-budget buckets batch through the word-packed numpy closure in
+#: chunks of this many uint32 words so the fallback never materializes
+#: an unbounded stack for the very shapes that were too big for the
+#: device.  Historically the resident stack was (B, n, n) bool ‚Äî one
+#: word per LANE ‚Äî so CPU-oracle parity on large n blew this budget
+#: 32√ó earlier than the device path, whose budget counts packed words
+#: (the PR's pinned n=1024 regression)
 _NP_STACK_BUDGET = 1 << 26
+
+
+def _np_chunk_rows(n: int) -> int:
+    """Host-fallback chunk size for ``n``-vertex graphs: the resident
+    stack is word-packed (``n¬∑W`` uint32 words per row,
+    :func:`jepsen_tpu.ops.dense.pack_words_np`) so the budget divides
+    by ``n¬∑W`` instead of the ``n¬≤`` bools the unpacked stacking paid
+    ‚Äî 32√ó more rows per chunk at n = 1024."""
+    return max(1, _NP_STACK_BUDGET // (n * dense.word_count(n)))
+
+
+def _np_packed_closure(rw: np.ndarray, n: int) -> np.ndarray:
+    """Word-packed host transitive closure: ``(B, n, W) uint32 ‚Üí
+    (B, n, W)`` closed, ``n`` a multiple of 32 (callers word-floor the
+    pad; all-zero padding rows are edge-free, hence inert).  One
+    squaring round ORs intermediate row ``k``'s word row into every
+    row ``i`` whose packed lanes reach ``k``, grouped by bit position
+    ``j`` (the intermediates ``k = 32¬∑w + j`` live at one fixed bit of
+    every word), so the transient is ``(B, n, W, W)`` uint32 ‚Äî never
+    the ``(B, n, n)`` bool plane the unpacked host closure
+    materializes.  Fixpoint rounds short-circuit: the host path
+    reports no rounds evidence, so stopping early is pure savings."""
+    rw = np.array(rw, np.uint32, copy=True)
+    for _ in range(closure_rounds(n)):
+        sq = np.zeros_like(rw)
+        for j in range(dense.WORD_LANES):
+            # pj[b, i, w]: row i reaches intermediate k = 32¬∑w + j?
+            pj = ((rw >> np.uint32(j)) & np.uint32(1)).astype(bool)
+            rj = rw[:, j::dense.WORD_LANES, :]  # (B, W, W): those rows
+            sq |= np.bitwise_or.reduce(
+                np.where(pj[..., None], rj[:, None, :, :],
+                         np.uint32(0)),
+                axis=2,
+            )
+        nxt = rw | sq
+        if np.array_equal(nxt, rw):
+            break
+        rw = nxt
+    return rw
+
+
+def _np_packed_has_cycle(rw: np.ndarray, n: int) -> np.ndarray:
+    """Cyclic flags for a word-packed ``(B, n, W)`` stack: closure in
+    sub-blocks whose ``(blk, n, W, W)`` squaring transient stays under
+    :data:`_NP_STACK_BUDGET`, then the packed diagonal test (bit
+    ``i % 32`` of word ``i // 32`` on row ``i``)."""
+    B, W = rw.shape[0], rw.shape[-1]
+    blk = max(1, _NP_STACK_BUDGET // (n * W * W))
+    flags = np.zeros(B, bool)
+    idx = np.arange(n)
+    shifts = (idx % dense.WORD_LANES).astype(np.uint32)
+    for lo in range(0, B, blk):
+        closed = _np_packed_closure(rw[lo:lo + blk], n)
+        diag = (closed[:, idx, idx // dense.WORD_LANES] >> shifts) & 1
+        flags[lo:lo + blk] = diag.any(axis=-1)
+    return flags
 
 
 def has_cycle_batch(
@@ -568,16 +814,22 @@ def has_cycle_batch(
         if plan.disp == 0:
             # even one row of this vertex bucket busts the dispatch
             # budget: decide on the host instead of crashing a worker
-            # ‚Äî batched through the vectorized numpy closure, chunked
-            # so the stack footprint stays bounded
-            chunk = max(1, _NP_STACK_BUDGET // (n * n))
+            # ‚Äî batched through the word-packed numpy closure, chunked
+            # in uint32 words so the resident stack is priced like the
+            # device path (32√ó more rows per chunk than bool stacking)
+            nw = dense.word_count(n) * dense.WORD_LANES  # word floor
+            chunk = _np_chunk_rows(nw)
             for lo in range(0, len(idxs), chunk):
                 part = idxs[lo:lo + chunk]
-                stack = np.zeros((len(part), n, n), bool)
+                stack = np.zeros(
+                    (len(part), nw, dense.word_count(nw)), np.uint32
+                )
                 for row, i in enumerate(part):
                     m = np.asarray(mats[i], dtype=bool)
-                    stack[row, : m.shape[0], : m.shape[1]] = m
-                out[part] = _np_has_cycle(stack)
+                    plane = np.zeros((nw, nw), bool)
+                    plane[: m.shape[0], : m.shape[1]] = m
+                    stack[row] = dense.pack_words_np(plane)
+                out[part] = _np_packed_has_cycle(stack, nw)
             continue
         batch = np.zeros((len(idxs), n, n), dtype=np.uint8)
         for row, i in enumerate(idxs):

@@ -242,6 +242,53 @@ def _subset_perms(C: int):
     return jnp.asarray(Pu), jnp.asarray(Pd)
 
 
+#: boolean lanes carried per packed adjacency word — the uint32 word
+#: width the cycle kernels' ``packed32`` closure and the host
+#: ``np.packbits`` fallback both pack to (doc/checker-engines.md
+#: "Word-packed closure")
+WORD_LANES = 32
+
+
+def word_count(n: int) -> int:
+    """uint32 words needed to carry ``n`` boolean lanes (≥ 1) — the
+    ``W`` of the ``(B, n, W)`` packed adjacency layout and the unit
+    the word-packed budget math prices rows in
+    (:func:`jepsen_tpu.ops.cycles.cycles_max_dispatch`)."""
+    return max(1, -(-n // WORD_LANES))
+
+
+def pack_words_np(bits: np.ndarray) -> np.ndarray:
+    """Host word-packing: ``(..., n) bool → (..., W) uint32`` with lane
+    ``j`` stored at word ``j // 32``, bit position ``j % 32`` (little
+    bit order — the layout ``np.packbits(bitorder="little")`` emits,
+    and the one the device-side
+    :func:`jepsen_tpu.ops.cycles._pack_words` reproduces bit-for-bit;
+    the round-trip property tests pin the two layouts equal)."""
+    bits = np.asarray(bits, bool)
+    n = bits.shape[-1]
+    W = word_count(n)
+    pad = W * WORD_LANES - n
+    if pad:
+        bits = np.concatenate(
+            [bits, np.zeros(bits.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    by = np.packbits(bits, axis=-1, bitorder="little").astype(np.uint32)
+    by = by.reshape(bits.shape[:-1] + (W, 4))
+    return (by[..., 0]
+            | (by[..., 1] << np.uint32(8))
+            | (by[..., 2] << np.uint32(16))
+            | (by[..., 3] << np.uint32(24)))
+
+
+def unpack_words_np(words: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of :func:`pack_words_np`: ``(..., W) uint32 → (..., n)``
+    bool — lanes past ``n`` are word-floor padding and are dropped."""
+    words = np.asarray(words, np.uint32)
+    shifts = np.arange(WORD_LANES, dtype=np.uint32)
+    lanes = (words[..., None] >> shifts) & np.uint32(1)
+    return lanes.reshape(words.shape[:-1] + (-1,))[..., :n].astype(bool)
+
+
 VALID_UNIONS = ("unroll", "gather", "matmul")
 
 

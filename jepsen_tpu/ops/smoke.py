@@ -9,10 +9,14 @@ lowering it replaces.  This gate fails loudly on:
 - packed screens diverging from the per-mask reference kernels OR from
   the pure-numpy ``_np_screen`` oracle, on rw-register-shaped (plain)
   and list-append/realtime-shaped (suffixed masks + both lifted walk
-  queries) filter profiles, across vertex buckets;
+  queries) filter profiles, across vertex buckets — under every
+  closure arithmetic (``uint8``/``packed32``/``bf16``);
 - the early-exit (``lax.while_loop``) closure diverging from the
   fixed-round scan on either Elle kernel route (has-cycle flags and
   full screens) — and the saved rounds not being recorded;
+- any closure impl's executor-routed has-cycle verdicts diverging
+  from the direct host closure, or the settled dispatches not
+  recording their ``jepsen_cycles_impl_total{impl}`` evidence;
 - ``union="matmul"`` verdicts diverging from gather/unroll on the
   register AND queue dense kernels;
 - a budget-accounting breach for packed shapes: under a deliberately
@@ -121,6 +125,7 @@ def main(argv=None) -> int:
         ("list-append/realtime", (1, 3, 7, 25, 27, 31),
          ((4, 3), (4, 27))),
     )
+    impls = ops_cycles._VALID_CLOSURE_IMPLS
     for label, masks, nonadj in profiles:
         for n in (16, 32):
             rel = _rel_corpus(rng, n, 12)
@@ -128,14 +133,16 @@ def main(argv=None) -> int:
             outs = {}
             for packed in (True, False):
                 for mode in ("fixed", "earlyexit"):
-                    fn = ops_cycles._screen_fn_variant(
-                        n, masks, nonadj, packed, mode
-                    )
-                    m, w, rounds = fn(rel)
-                    outs[(packed, mode)] = (
-                        np.asarray(m), np.asarray(w), np.asarray(rounds)
-                    )
-            base = outs[(True, "fixed")]
+                    for impl in impls:
+                        fn = ops_cycles._screen_fn_variant(
+                            n, masks, nonadj, packed, mode, impl
+                        )
+                        m, w, rounds = fn(rel)
+                        outs[(packed, mode, impl)] = (
+                            np.asarray(m), np.asarray(w),
+                            np.asarray(rounds)
+                        )
+            base = outs[(True, "fixed", "uint8")]
             check(
                 np.array_equal(base[0], want_m)
                 and np.array_equal(base[1], want_w),
@@ -147,11 +154,13 @@ def main(argv=None) -> int:
                     and np.array_equal(w, base[1]),
                     f"{label} n={n}: variant {key} diverges from packed",
                 )
-            check(
-                int(outs[(True, "earlyexit")][2].max())
-                <= int(base[2].max()),
-                f"{label} n={n}: earlyexit ran MORE rounds than fixed",
-            )
+            for impl in impls:
+                check(
+                    int(outs[(True, "earlyexit", impl)][2].max())
+                    <= int(outs[(True, "fixed", impl)][2].max()),
+                    f"{label} n={n} impl={impl}: earlyexit ran MORE "
+                    f"rounds than fixed",
+                )
 
     # -- early-exit ≡ fixed on the has-cycle route, and the corpus
     # diameters actually save rounds somewhere
@@ -160,19 +169,40 @@ def main(argv=None) -> int:
         else np.triu(np.asarray(m, bool), k=1)  # acyclic twin
         for i, m in enumerate(_rel_corpus(rng, 24, 10))
     ]
+    want = ops_cycles._np_has_cycle(np.stack(mats))
+    check(bool(want.any()) and not bool(want.all()),
+          "has-cycle corpus should mix verdicts")
+    obs.enable(reset=True)
     for mode in ("fixed", "earlyexit"):
-        os.environ["JEPSEN_TPU_CYCLES_CLOSURE"] = mode
-        try:
-            got = ops_cycles.has_cycle_batch(mats)
-        finally:
-            os.environ.pop("JEPSEN_TPU_CYCLES_CLOSURE", None)
-        want = ops_cycles._np_has_cycle(np.stack(mats))
+        for impl in impls:
+            os.environ["JEPSEN_TPU_CYCLES_CLOSURE"] = mode
+            os.environ["JEPSEN_TPU_CYCLES_IMPL"] = impl
+            try:
+                got = ops_cycles.has_cycle_batch(mats)
+                # the executor-routed lowering must agree with the
+                # direct dispatch it replaces, per impl
+                ex_r = execution.Executor(2)
+                routed = ops_cycles.has_cycle_batch(mats, executor=ex_r)
+            finally:
+                os.environ.pop("JEPSEN_TPU_CYCLES_CLOSURE", None)
+                os.environ.pop("JEPSEN_TPU_CYCLES_IMPL", None)
+            check(
+                np.array_equal(np.asarray(got), want),
+                f"has_cycle_batch[{mode},{impl}] diverges from host "
+                f"closure",
+            )
+            check(
+                np.array_equal(np.asarray(routed), want),
+                f"executor-routed has_cycle_batch[{mode},{impl}] "
+                f"diverges from direct",
+            )
+    reg = obs.registry()
+    for impl in impls:
         check(
-            np.array_equal(np.asarray(got), want),
-            f"has_cycle_batch[{mode}] diverges from host closure",
+            (reg.value("jepsen_cycles_impl_total", impl=impl) or 0) > 0,
+            f"no jepsen_cycles_impl_total evidence for impl={impl}",
         )
-        check(bool(want.any()) and not bool(want.all()),
-              "has-cycle corpus should mix verdicts")
+    obs.enable(reset=True)
 
     # -- union="matmul" ≡ gather ≡ unroll on the register and queue
     # dense kernels (mixed valid/corrupt corpora)
@@ -225,13 +255,8 @@ def main(argv=None) -> int:
         for nn in (16, 32)
         for r in _rel_corpus(rng, nn, 8)
     ]
-    obs.enable(reset=True)
-    base = ops_cycles.screen_graphs(encs)
-    ex = execution.Executor(4)
-    capped = ops_cycles.screen_graphs(encs, executor=ex, max_dispatch=64)
-    reg = obs.registry()
-    for a, b in zip(base, capped):
-        same = (a is None) == (b is None) and (
+    def _same_screens(a, b):
+        return (a is None) == (b is None) and (
             a is None or (
                 all(np.array_equal(a.members[k], b.members[k])
                     for k in a.members)
@@ -239,16 +264,44 @@ def main(argv=None) -> int:
                         for k in a.walks)
             )
         )
+
+    def _check_accounting(ex_, what):
+        check(ex_.submitted > 0,
+              f"no {what} dispatches reached the executor")
+        for acct in ex_.chip_row_accounting.values():
+            cap = acct["chip_cap"]
+            if acct["kernel"] == "dense":
+                cap *= ex_.window_size
+            check(acct["peak_chip_rows"] <= cap,
+                  f"{what} per-chip budget breach: {acct}")
+
+    obs.enable(reset=True)
+    base = ops_cycles.screen_graphs(encs)
+    ex = execution.Executor(4)
+    capped = ops_cycles.screen_graphs(encs, executor=ex, max_dispatch=64)
+    reg = obs.registry()
+    for a, b in zip(base, capped):
+        same = _same_screens(a, b)
         check(same, "capped packed screens diverge from uncapped")
         if not same:
             break
-    check(ex.submitted > 0, "no packed dispatches reached the executor")
-    for acct in ex.chip_row_accounting.values():
-        cap = acct["chip_cap"]
-        if acct["kernel"] == "dense":
-            cap *= ex.window_size
-        check(acct["peak_chip_rows"] <= cap,
-              f"per-chip budget breach: {acct}")
+    _check_accounting(ex, "packed")
+    # the same capped drill under the word-packed arithmetic: the
+    # repriced caps are wider, but accounting must still hold and the
+    # screens must stay byte-identical
+    os.environ["JEPSEN_TPU_CYCLES_IMPL"] = "packed32"
+    try:
+        ex_w = execution.Executor(4)
+        word = ops_cycles.screen_graphs(encs, executor=ex_w,
+                                        max_dispatch=64)
+    finally:
+        os.environ.pop("JEPSEN_TPU_CYCLES_IMPL", None)
+    for a, b in zip(base, word):
+        same = _same_screens(a, b)
+        check(same, "packed32 capped screens diverge from uint8")
+        if not same:
+            break
+    _check_accounting(ex_w, "packed32")
     rounds_seen = sum(
         reg.value("jepsen_cycles_closure_rounds_total", mode=md) or 0
         for md in ("fixed", "earlyexit")
@@ -270,9 +323,10 @@ def main(argv=None) -> int:
         return 1
     print(
         "kernels-smoke: ok (packed ≡ per-mask ≡ numpy on plain+suffixed "
-        "profiles; earlyexit ≡ fixed on both routes; matmul ≡ gather ≡ "
-        "unroll on register+queue; packed budget accounting over "
-        f"{ex.n_devices} device(s))"
+        "profiles; uint8 ≡ packed32 ≡ bf16 on both routes, "
+        "executor-routed ≡ direct; earlyexit ≡ fixed; matmul ≡ gather ≡ "
+        "unroll on register+queue; packed + packed32 budget accounting "
+        f"over {ex.n_devices} device(s))"
     )
     return 0
 

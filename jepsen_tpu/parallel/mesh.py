@@ -130,8 +130,15 @@ def shard_fn(check_fn, mesh: Mesh, n_in: int = 6, n_out: int = 3):  # jt: allow[
     at a shape reuse ONE sharded executable — the per-call-site-mesh +
     sharded-compiled-step-fn pattern (SNIPPETS [2]–[3]).  Inputs'
     leading dim must be divisible by the mesh size (callers pad with
-    neutral rows; see the engine's shard padding)."""
-    key = (_mesh_key(mesh), n_in, n_out)
+    neutral rows; see the engine's shard padding).
+
+    The cycle kernels stamp their resolved closure arithmetic on the
+    fn (``fn.closure_impl`` — ``ops.cycles.closure_impl``); it rides
+    the cache key so a knob flip mid-process can never resolve a
+    sharded executable traced for a different impl, even if a caller
+    ever reuses one fn object across impls."""
+    key = (_mesh_key(mesh), n_in, n_out,
+           getattr(check_fn, "closure_impl", ""))
     with _shard_lock:
         cache = getattr(check_fn, "_sharded_variants", None)
         if cache is None:

@@ -863,18 +863,26 @@ def format_fleet_status(rows) -> str:
     """The fleet table for ``jepsen_tpu status --daemon … --daemon …``:
     one row per member with the operator-facing columns (devices,
     mesh, calibration identity, drift score, quarantined routes, live
-    busy ratio).  ``rows`` is a sequence of ``(addr, status_or_None)``
-    — ``None`` marks a member that did not answer ``/status``."""
+    busy ratio, and the routing weight the router's prober would
+    derive from that busy ratio — ``router.weight_from_busy``, so the
+    table shows the same number ``jepsen_route_weight`` exports).
+    ``rows`` is a sequence of ``(addr, status_or_None)`` — ``None``
+    marks a member that did not answer ``/status``."""
+    from . import router as router_mod  # client ← router is the cycle
+
     cols = ["member", "devices", "mesh", "calibration", "drift",
-            "quarantined", "busy"]
+            "quarantined", "busy", "weight"]
     table = [cols]
     for addr, st in rows:
         if st is None:
-            table.append([addr, "-", "-", "unreachable", "-", "-", "-"])
+            table.append([addr, "-", "-", "unreachable",
+                          "-", "-", "-", "-"])
             continue
         drift = st.get("drift") or {}
         score = drift.get("score")
         busy = (st.get("live") or {}).get("device_busy_ratio")
+        weight = router_mod.weight_from_busy(
+            busy if isinstance(busy, (int, float)) else None)
         table.append([
             addr,
             str(st.get("n_devices") or 1),
@@ -885,6 +893,7 @@ def format_fleet_status(rows) -> str:
              if isinstance(score, (int, float)) else "n/a"),
             str(len(st.get("quarantine") or [])),
             f"{busy:.0%}" if isinstance(busy, (int, float)) else "n/a",
+            f"{weight:.2f}",
         ])
     widths = [max(len(r[i]) for r in table) for i in range(len(cols))]
     lines = ["── fleet " + "─" * 39]

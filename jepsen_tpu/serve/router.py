@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import math
 import os
 import threading
 import time
@@ -74,19 +75,58 @@ def _env_pos_float(name: str, default: float) -> float:
     return v if v > 0 else default
 
 
-def rendezvous_order(members: List[str], key: str) -> List[str]:
+#: weighted-rendezvous floor: a fully busy member keeps a sliver of
+#: weight so it still wins SOME keys (total starvation would dump its
+#: whole share on siblings at once — the opposite of bounded movement)
+MIN_ROUTE_WEIGHT = 0.05
+
+#: sha1 digests span [0, 2^160); +1/+2 keep the fraction strictly
+#: inside (0, 1) so log() below is finite and negative
+_HASH_SPAN = float(1 << 160)
+
+
+def weight_from_busy(busy: Optional[float]) -> float:
+    """Routing weight for a reported device-busy ratio:
+    ``max(MIN_ROUTE_WEIGHT, 1 − clamp(busy, 0, 1))``.  ``None`` — no
+    report at all — stays NEUTRAL (1.0): weighting punishes only a
+    member that positively reports load, never one that fails to
+    report.  Shared with the ``status`` fleet table so the operator
+    view prints the same number the prober feeds into
+    :func:`rendezvous_order`."""
+    if busy is None:
+        return 1.0
+    return max(MIN_ROUTE_WEIGHT, 1.0 - min(1.0, max(0.0, busy)))
+
+
+def rendezvous_order(members: List[str], key: str,
+                     weights: Optional[Dict[str, float]] = None,
+                     ) -> List[str]:
     """Members by descending rendezvous (highest-random-weight) score
     for ``key``.  Each (member, key) pair scores independently, so
     removing a member re-ranks NOTHING among the survivors — only the
     removed member's keys move, each to its own second choice — and a
     new member takes exactly the keys it now wins.  sha1 here is a
-    uniform hash, not a security boundary."""
-    return sorted(
-        members,
-        key=lambda m: hashlib.sha1(
-            f"{m}|{key}".encode()).hexdigest(),
-        reverse=True,
-    )
+    uniform hash, not a security boundary.
+
+    ``weights`` (member → weight, default/missing = 1.0) scales each
+    member's score the standard weighted-rendezvous way: the digest
+    becomes a uniform fraction u ∈ (0, 1) and the score is
+    ``-w / ln(u)``, so a member's expected key share is proportional
+    to its weight.  The transform is monotone in u, so with equal
+    weights the ordering is EXACTLY the unweighted descending-digest
+    order (the legacy tests keep pinning it), and lowering only one
+    member's weight moves only keys that member was winning — the
+    per-member analogue of the membership bounded-movement property
+    (the busy-ratio prober feeds this; doc/checker-service.md)."""
+    def score(m: str):
+        h = int(hashlib.sha1(f"{m}|{key}".encode()).hexdigest(), 16)
+        w = 1.0
+        if weights:
+            w = max(MIN_ROUTE_WEIGHT, float(weights.get(m, 1.0)))
+        u = (h + 1.0) / (_HASH_SPAN + 2.0)
+        return (-w / math.log(u), h)
+
+    return sorted(members, key=score, reverse=True)
 
 
 def _pow2_bucket(n: int) -> int:
@@ -164,6 +204,12 @@ class Router:
         #: prober-maintained liveness map; a member starts optimistic
         #: (True) so the first request needn't wait a probe interval
         self._up: Dict[str, bool] = {m: True for m in self.members}  # jt: guarded-by(_lock)
+        #: prober-maintained routing weights (1 − busy ratio from the
+        #: member's /status live block); a member starts — and on any
+        #: stale/unreachable status falls back to — neutral 1.0, so
+        #: weighting can only ever shift keys AWAY from a member that
+        #: positively reported itself busy
+        self._weights: Dict[str, float] = {m: 1.0 for m in self.members}  # jt: guarded-by(_lock)
         #: /feed session pins: sid -> member owning the session state
         self._pins: Dict[str, str] = {}  # jt: guarded-by(_lock)
         self._stopping = threading.Event()
@@ -180,7 +226,18 @@ class Router:
     def probe_once(self) -> int:
         """One /healthz sweep over the membership; returns the number
         of members currently up.  Public so tests and the smoke can
-        force a deterministic sweep instead of sleeping an interval."""
+        force a deterministic sweep instead of sleeping an interval.
+
+        The sweep doubles as the busy-ratio refresh: each live member's
+        ``/status`` live block reports ``device_busy_ratio`` (its
+        flight-recorder duty cycle), and the routing weight becomes
+        ``max(MIN_ROUTE_WEIGHT, 1 − busy)`` — a saturated member sheds
+        a proportional share of its keys to rendezvous runners-up while
+        idle members keep their full share.  A member whose status is
+        unreachable, stale, or busy-free stays NEUTRAL (1.0): weighting
+        never punishes a member for failing to report, only for
+        positively reporting load (down members are already handled by
+        the liveness partition in :meth:`_candidates`)."""
         n_up = 0
         for m in self.members:
             ok = probe_healthz(m, timeout=self.probe_timeout_s)
@@ -188,10 +245,31 @@ class Router:
                 n_up += 1
             else:
                 obs.count("jepsen_route_probe_failures_total", member=m)
+            weight = 1.0
+            if ok:
+                weight = weight_from_busy(self._member_busy_ratio(m))
+            obs.gauge_set("jepsen_route_weight", weight, member=m)
             with self._lock:
                 self._up[m] = ok
+                self._weights[m] = weight
         obs.gauge_set("jepsen_route_members_up", n_up)
         return n_up
+
+    def _member_busy_ratio(self, member: str) -> Optional[float]:
+        """One member's ``device_busy_ratio`` from its ``/status`` live
+        block, or None when the member doesn't answer, answers
+        something that isn't a status body, or reports no numeric
+        ratio.  Never raises — a malformed status must read as
+        'neutral', not take the prober thread down."""
+        try:
+            with urllib.request.urlopen(
+                    f"http://{member}/status",
+                    timeout=self.probe_timeout_s) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
+            busy = (payload.get("live") or {}).get("device_busy_ratio")
+            return float(busy) if isinstance(busy, (int, float)) else None
+        except Exception:  # noqa: BLE001 — any failure mode = neutral
+            return None
 
     def _candidates(self, key: str) -> List[str]:
         """Every member in spill order for ``key``: live members by
@@ -200,9 +278,10 @@ class Router:
         the prober can lag a just-revived member by one interval, and
         trying a marked-down member beats refusing outright when the
         whole fleet looks dark."""
-        order = rendezvous_order(self.members, key)
         with self._lock:
             up = dict(self._up)
+            weights = dict(self._weights)
+        order = rendezvous_order(self.members, key, weights)
         return ([m for m in order if up.get(m)]
                 + [m for m in order if not up.get(m)])
 
@@ -338,6 +417,7 @@ class Router:
     def status(self) -> dict:
         with self._lock:
             up = dict(self._up)
+            weights = dict(self._weights)
             pins = len(self._pins)
         return {
             "role": "router",
@@ -348,6 +428,7 @@ class Router:
                 {
                     "member": m,
                     "up": bool(up.get(m)),
+                    "weight": weights.get(m, 1.0),
                     "breaker": breaker_for(*self._split(m)).state(),
                 }
                 for m in self.members

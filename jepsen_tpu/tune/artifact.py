@@ -48,6 +48,7 @@ DEFAULT_PATH = "calibration.json"
 _FINGERPRINT_FILES = (
     "engine/execution.py",
     "engine/planning.py",
+    "elle/encode.py",
     "ops/cycles.py",
     "ops/dense.py",
     "ops/wgl.py",
@@ -55,11 +56,13 @@ _FINGERPRINT_FILES = (
 
 #: params every artifact carries; used by the round-trip/schema tests
 PARAM_KEYS = ("window", "flush_rows", "row_bucket", "union_mode",
-              "closure_mode")
+              "closure_mode", "closure_impl")
 
 _VALID_UNIONS = ("unroll", "gather", "matmul")
 
 _VALID_CLOSURES = ("fixed", "earlyexit")
+
+_VALID_IMPLS = ("uint8", "packed32", "bf16")
 
 
 def code_fingerprint() -> str:
@@ -110,8 +113,8 @@ class Calibration:
     Constructed from the raw artifact dict (already schema-checked by
     :func:`load_calibration`); exposes the engine-facing lookups —
     :meth:`window`, :meth:`flush_rows`, :meth:`row_bucket`,
-    :meth:`union_mode`, :meth:`closure_mode`, and the interpolating
-    :meth:`cost` table."""
+    :meth:`union_mode`, :meth:`closure_mode`, :meth:`closure_impl`,
+    and the interpolating :meth:`cost` table."""
 
     def __init__(self, data: Dict[str, Any]):
         self.data = data
@@ -148,6 +151,9 @@ class Calibration:
 
     def closure_mode(self) -> str:
         return str(self.params["closure_mode"])
+
+    def closure_impl(self) -> str:
+        return str(self.params["closure_impl"])
 
     def has_cost_table(self) -> bool:
         return bool(self._table)
@@ -222,8 +228,10 @@ def _proxy(kernel: str, E: int, C: int, F: int) -> float:
     if kernel == "cycles":
         # the Elle screens' boolean matrix closure: E is the vertex
         # bucket, F the packed plane weight (filter masks + lifted
-        # walk queries folded into the batch axis), per-row work
-        # scales with F planes of E×E matmul squaring
+        # walk queries folded into the batch axis; under the packed32
+        # closure impl the callers pass it pre-discounted by W/n —
+        # elle.encode.plane_weight), per-row work scales with F
+        # planes of E×E matmul squaring
         return float(max(E, 1)) * max(E, 1) * max(F, 1)
     words = max(1, -(-max(E, 1) // 32))
     return float(max(F, 1) * (max(C, 0) + 1) * words)
@@ -281,6 +289,8 @@ def validate(data: Any) -> Dict[str, Any]:
         raise ValueError(f"unknown union_mode {p['union_mode']!r}")
     if p["closure_mode"] not in _VALID_CLOSURES:
         raise ValueError(f"unknown closure_mode {p['closure_mode']!r}")
+    if p["closure_impl"] not in _VALID_IMPLS:
+        raise ValueError(f"unknown closure_impl {p['closure_impl']!r}")
     for e in data.get("cost_table", ()):
         for k in ("kernel", "E", "C", "F", "rows", "seconds"):
             if k not in e:

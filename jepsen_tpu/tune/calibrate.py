@@ -3,7 +3,7 @@
 Replaces the engine's hand-pinned dispatch constants with measured
 picks for the *attached* device (ROADMAP item 4): a coordinate-descent
 search (the schedule-fine-tuning shape of arXiv:2406.20037, sized for
-our five-knob space) from the current defaults over
+our six-knob space) from the current defaults over
 
 - ``union_mode`` — the dense subset-union lowering (the stable ~1.6×
   unroll/gather gap in BENCH_tpu_windows.jsonl is exactly what this
@@ -12,6 +12,11 @@ our five-knob space) from the current defaults over
 - ``closure_mode`` — fixed-round vs convergence-early-exit boolean
   closure in the Elle cycle screens (the sync cost of the early-exit
   ``while_loop`` only pays off at large vertex buckets),
+- ``closure_impl`` — the closure squaring arithmetic (historical
+  saturated-bf16 ``uint8`` planes, boolean-carry ``bf16`` MXU matmul,
+  or the word-packed ``packed32`` boolean semiring whose budget caps
+  price rows at W/n ≈ 1/32 of the uint8 footprint); crossed with
+  ``closure_mode`` over the sweep's screen timings,
 - ``window`` — the engine's in-flight dispatch bound,
 - ``flush_rows`` — the streaming bucket flush threshold,
 - ``row_bucket`` — the power-of-two dispatch-row floor,
@@ -67,6 +72,7 @@ PROFILES: Dict[str, Dict[str, Any]] = {
         n_hists=32, n_ops=160, n_procs=3, reps=2, passes=2,
         windows=(1, 2, 4, 8), unions=("unroll", "gather", "matmul"),
         closures=("fixed", "earlyexit"),
+        impls=("uint8", "packed32", "bf16"),
         flush_rows=(4096, 16384, 65536), row_buckets=(32, 64, 128),
         cost_rows=(32, 128), screen_ns=(16, 64), n_graphs=24,
         budget_s=100.0,
@@ -75,6 +81,7 @@ PROFILES: Dict[str, Dict[str, Any]] = {
         n_hists=10, n_ops=12, n_procs=3, reps=1, passes=1,
         windows=(1, 4), unions=("unroll", "gather", "matmul"),
         closures=("fixed", "earlyexit"),
+        impls=("uint8", "packed32", "bf16"),
         flush_rows=(16384,), row_buckets=(64,),
         cost_rows=(8,), screen_ns=(16,), n_graphs=6, budget_s=30.0,
     ),
@@ -96,7 +103,11 @@ def proposal_within_budget(plan, rows: int, window: int,
     kernels hold at most ``disp`` rows across the whole window (the
     executor splits chunks to ``disp//window``, or serializes when
     even that floors out).  A plan with no dispatchable kernel admits
-    nothing."""
+    nothing.  ``plan.disp`` already carries the closure-impl pricing
+    (``ops.cycles.cycles_max_dispatch``): a ``packed32`` screen plan's
+    cap is ~32× the uint8 cap for the same shape, so word-packed
+    candidates legally admit ~32× more rows per chunk under the same
+    per-chip word budget."""
     if plan.fn is None or plan.disp == 0:
         return rows == 0
     cap = plan.disp * max(1, n_devices)
@@ -263,7 +274,9 @@ class _Runner:
     def timed_screens(self, encs, *, window: int, reps: int) -> float:
         """Wall seconds of one screen pass over encoded dependency
         graphs (best of ``reps`` after one un-timed warmup) — the
-        traffic the ``closure_mode`` coordinate ranks on.  Same
+        traffic the ``closure_mode`` and ``closure_impl`` coordinates
+        rank on (each candidate's screens run under its own
+        mode × impl pair, so the sweep crosses the two axes).  Same
         production Executor, same budget evidence."""
         from ..engine import execution
         from ..ops import cycles as ops_cycles
@@ -305,7 +318,8 @@ def measure_config(runner: _Runner, corpora, cfg: Dict[str, Any],
     total = 0.0
     with _env(JEPSEN_TPU_DENSE_UNION=cfg["union_mode"],
               JEPSEN_TPU_ENGINE_ROW_BUCKET=cfg["row_bucket"],
-              JEPSEN_TPU_CYCLES_CLOSURE=cfg["closure_mode"]):
+              JEPSEN_TPU_CYCLES_CLOSURE=cfg["closure_mode"],
+              JEPSEN_TPU_CYCLES_IMPL=cfg["closure_impl"]):
         for max_closure in (None, 9):  # dense route, then frontier
             kw = dict(window=cfg["window"], flush_rows=cfg["flush_rows"],
                       max_closure=max_closure)
@@ -333,6 +347,7 @@ def coordinate_descent(runner: _Runner, corpora, profile: Dict[str, Any],
     space = {
         "union_mode": tuple(profile["unions"]),
         "closure_mode": tuple(profile["closures"]),
+        "closure_impl": tuple(profile["impls"]),
         "window": tuple(profile["windows"]),
         "flush_rows": tuple(profile["flush_rows"]),
         "row_bucket": tuple(profile["row_buckets"]),
@@ -340,6 +355,7 @@ def coordinate_descent(runner: _Runner, corpora, profile: Dict[str, Any],
     current = {
         "union_mode": dense.DEFAULT_UNION,
         "closure_mode": ops_cycles.DEFAULT_CLOSURE_MODE,
+        "closure_impl": ops_cycles.DEFAULT_CLOSURE_IMPL,
         "window": execution.DEFAULT_WINDOW,
         "flush_rows": planning.DEFAULT_FLUSH_ROWS,
         "row_bucket": execution.ROW_BUCKET,
@@ -405,7 +421,8 @@ def measure_cost_table(runner: _Runner, corpora, profile: Dict[str, Any],
 
     entries: List[dict] = []
     with _env(JEPSEN_TPU_DENSE_UNION=params["union_mode"],
-              JEPSEN_TPU_CYCLES_CLOSURE=params["closure_mode"]):
+              JEPSEN_TPU_CYCLES_CLOSURE=params["closure_mode"],
+              JEPSEN_TPU_CYCLES_IMPL=params["closure_impl"]):
         for name, pair in corpora.items():
             if name == "elle":
                 continue  # encoded graphs, not (model, hists) — the
@@ -464,7 +481,8 @@ def measure_cost_table(runner: _Runner, corpora, profile: Dict[str, Any],
     from ..ops import cycles as ops_cycles
 
     masks, nonadj = (1, 3, 7), ((4, 3),)
-    with _env(JEPSEN_TPU_CYCLES_CLOSURE=params["closure_mode"]):
+    with _env(JEPSEN_TPU_CYCLES_CLOSURE=params["closure_mode"],
+              JEPSEN_TPU_CYCLES_IMPL=params["closure_impl"]):
         for n in profile.get("screen_ns", ()):
             plan = ops_cycles.ScreenPlan(n, masks, nonadj)
             if plan.disp == 0:

@@ -1,0 +1,352 @@
+"""Word-packed boolean closure (the closure-impl knob).
+
+Pins the PR's contracts:
+
+- ``pack_words_np`` / ``_pack_words`` round-trip and agree bit-for-bit
+  (lane ``j`` → word ``j // 32``, bit ``j % 32``, little order), host
+  and device, ragged tails included;
+- the three closure implementations (``uint8`` saturated-bf16,
+  ``packed32`` word lanes, ``bf16`` threshold) answer byte-identically
+  across both closure modes, every chain/ring diameter 1..n, the full
+  suffixed screen profile, and both executor windows;
+- budget repricing: a ``packed32`` bucket legally keeps ~32× more rows
+  in flight under the same ``CYCLES_DISPATCH_BUDGET``, and the engine
+  accounting never exceeds the repriced cap;
+- the host fallback is word-packed too: ``_np_chunk_rows`` admits 32×
+  more rows per chunk than the historical bool stacking (the pinned
+  n=1024 regression) and stays verdict-identical to the bool oracle.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.elle import encode as elle_encode
+from jepsen_tpu.engine import execution
+from jepsen_tpu.ops import cycles as ops_cycles
+from jepsen_tpu.ops import dense
+
+IMPLS = ops_cycles._VALID_CLOSURE_IMPLS
+
+
+# ---------------------------------------------------------------------------
+# pack/unpack: round trip + the exact word/bit layout, host ≡ device
+# ---------------------------------------------------------------------------
+
+
+def _cases(n, rng):
+    yield np.zeros((3, n), bool)
+    yield np.ones((3, n), bool)
+    for j in (0, n // 2, n - 1):
+        one = np.zeros((1, n), bool)
+        one[0, j] = True
+        yield one
+    yield rng.random((4, n)) < 0.3
+
+
+@pytest.mark.parametrize("n", [1, 5, 31, 32, 33, 64, 100, 128])
+def test_pack_words_round_trip_and_host_device_layout(n):
+    rng = np.random.default_rng(1000 + n)
+    W = dense.word_count(n)
+    assert W == max(1, -(-n // 32))
+    for bits in _cases(n, rng):
+        packed = dense.pack_words_np(bits)
+        assert packed.shape == bits.shape[:-1] + (W,)
+        assert packed.dtype == np.uint32
+        assert np.array_equal(dense.unpack_words_np(packed, n), bits)
+        # the device packer emits the identical words, and its unpack
+        # inverts them — one layout everywhere, or the host fallback
+        # and the kernels would disagree about which bit is which lane
+        dev = np.asarray(ops_cycles._pack_words(bits))
+        assert np.array_equal(dev, packed), n
+        assert np.array_equal(
+            np.asarray(ops_cycles._unpack_words(packed, n)), bits)
+
+
+def test_pack_words_single_bit_lands_at_word_and_bit():
+    n = 100
+    for j in (0, 1, 31, 32, 63, 64, 99):
+        bits = np.zeros((1, n), bool)
+        bits[0, j] = True
+        packed = dense.pack_words_np(bits)
+        want = np.zeros((1, dense.word_count(n)), np.uint32)
+        want[0, j // 32] = np.uint32(1) << np.uint32(j % 32)
+        assert np.array_equal(packed, want), j
+
+
+def test_pack_words_matrix_axes_pack_rows_independently():
+    rng = np.random.default_rng(7)
+    adj = rng.random((5, 48, 48)) < 0.2
+    packed = dense.pack_words_np(adj)
+    assert packed.shape == (5, 48, 2)
+    for b in range(5):
+        assert np.array_equal(packed[b], dense.pack_words_np(adj[b]))
+
+
+# ---------------------------------------------------------------------------
+# impl byte-identity: flags, rounds, screens — every lowering agrees
+# ---------------------------------------------------------------------------
+
+
+def test_closure_impls_byte_identical_across_diameters():
+    """uint8 ≡ packed32 ≡ bf16 has-cycle flags AND rounds evidence over
+    chain/ring diameters 1..n, both closure modes — a word-lane carry
+    bug or a bf16 threshold bug would split the verdicts somewhere in
+    this sweep."""
+    n = 32
+    for mode in ("fixed", "earlyexit"):
+        fns = {impl: ops_cycles._closure_fn(n, mode, impl)
+               for impl in IMPLS}
+        for d in range(1, n + 1):
+            adj = np.zeros((2, n, n), bool)
+            for i in range(d):
+                adj[0, i, (i + 1) % n] = True   # d=n closes the ring
+            for i in range(min(d, n - 1)):
+                adj[1, i, i + 1] = True         # acyclic chain twin
+            got = {impl: tuple(np.asarray(x) for x in fn(adj))
+                   for impl, fn in fns.items()}
+            base_f, base_r = got["uint8"]
+            for impl in ("packed32", "bf16"):
+                assert np.array_equal(got[impl][0], base_f), (mode, d,
+                                                              impl)
+                assert np.array_equal(got[impl][1], base_r), (mode, d,
+                                                              impl)
+
+
+def test_closure_impls_byte_identical_on_random_soup():
+    rng = np.random.default_rng(45132)
+    for n in (16, 48):  # 48: ragged word tail on the packed lanes
+        adj = rng.random((12, n, n)) < 0.12
+        want = None
+        for mode in ("fixed", "earlyexit"):
+            for impl in IMPLS:
+                flags, _r = ops_cycles._closure_fn(n, mode, impl)(adj)
+                flags = np.asarray(flags)
+                if want is None:
+                    want = flags
+                    # sanity: the oracle agrees before impls compare
+                    assert np.array_equal(
+                        want, ops_cycles._np_has_cycle(adj))
+                assert np.array_equal(flags, want), (n, mode, impl)
+
+
+def test_screen_impls_byte_identical_full_suffixed_profile():
+    """Every (packed, mode, impl) lowering of the screen kernel answers
+    the full suffixed ladder + both lifted walk queries identically to
+    the numpy oracle — the fuzz matrix the acceptance gate names."""
+    masks, nonadj = (1, 3, 7, 25, 27, 31), ((4, 3), (4, 27))
+    nprng = np.random.default_rng(45133)
+    for n in (16, 32):
+        rel = (nprng.integers(0, 32, size=(5, n, n))
+               * (nprng.random((5, n, n)) < 0.08)).astype(np.uint8)
+        want_m, want_w = ops_cycles._np_screen(rel, masks, nonadj)
+        for impl in IMPLS:
+            for packed in (True, False):
+                for mode in ("fixed", "earlyexit"):
+                    fn = ops_cycles._screen_fn_variant(
+                        n, masks, nonadj, packed, mode, impl)
+                    m_, w_, _r = fn(rel)
+                    key = (n, impl, packed, mode)
+                    assert np.array_equal(np.asarray(m_), want_m), key
+                    assert np.array_equal(np.asarray(w_), want_w), key
+
+
+def _ring_mats(count, n):
+    mats = []
+    for i in range(count):
+        m = np.zeros((n, n), bool)
+        for v in range(n - 1):
+            m[v, v + 1] = True
+        if i % 2 == 0:
+            m[n - 1, 0] = True  # close the ring
+        mats.append(m)
+    return mats
+
+
+@pytest.mark.parametrize("window", [1, 4])
+def test_has_cycle_batch_impls_identical_both_windows(
+        monkeypatch, window):
+    """The engine-routed path (CyclePlan → Executor) answers the same
+    batch identically under every closure impl and both dispatch
+    windows — the knob changes arithmetic, never verdicts."""
+    mats = _ring_mats(14, 13) + _ring_mats(6, 37)
+    want = [ops_cycles._np_has_cycle(m) for m in mats]
+    for impl in IMPLS:
+        monkeypatch.setenv("JEPSEN_TPU_CYCLES_IMPL", impl)
+        ex = execution.Executor(window, mesh=None)
+        got = ops_cycles.has_cycle_batch(mats, executor=ex)
+        assert list(got) == want, (impl, window)
+        assert ex.submitted > 0
+
+
+def test_screen_graphs_records_impl_counter_and_occupancy(monkeypatch):
+    from jepsen_tpu import obs
+    from jepsen_tpu.elle.graph import Graph
+
+    graphs = []
+    for i in range(4):
+        g = Graph()
+        for v in range(8):
+            g.add_edge(v, v + 1, "ww")
+        if i % 2 == 0:
+            g.add_edge(8, 0, "rw")
+        graphs.append(g)
+    encs = [elle_encode.encode_graph(g) for g in graphs]
+    monkeypatch.setenv("JEPSEN_TPU_CYCLES_IMPL", "packed32")
+    obs.enable(reset=True)
+    try:
+        res = ops_cycles.screen_graphs(encs)
+        assert all(r is not None for r in res)
+        reg = obs.registry()
+        assert (reg.value("jepsen_cycles_impl_total",
+                          impl="packed32") or 0) > 0
+        occ = reg.value("jepsen_cycles_word_lane_occupancy")
+        assert occ is not None and 0.0 < occ <= 1.0, occ
+    finally:
+        obs.enable(reset=True)
+
+
+# ---------------------------------------------------------------------------
+# budget repricing: words in flight, not lanes
+# ---------------------------------------------------------------------------
+
+
+def test_cycles_max_dispatch_prices_packed_words():
+    budget = ops_cycles.CYCLES_DISPATCH_BUDGET
+    for n in (64, 1024):
+        W = dense.word_count(n)
+        uint8_cap = ops_cycles.cycles_max_dispatch(
+            n, 3, 1, max_dispatch=1 << 30)
+        packed_cap = ops_cycles.cycles_max_dispatch(
+            n, 3, 1, max_dispatch=1 << 30, impl="packed32")
+        assert uint8_cap == budget // (n * n * (2 * 3 + 8))
+        assert packed_cap == budget // (
+            2 * n * W * 3 + 2 * (2 * n) * dense.word_count(2 * n))
+        # the W/n ≈ 1/32 discount, up to lifted-plane rounding
+        assert packed_cap >= 16 * uint8_cap, (n, uint8_cap, packed_cap)
+    # bf16 carries one lane per vertex pair: uint8 pricing on purpose
+    assert (ops_cycles.cycles_max_dispatch(64, 3, 1, impl="bf16")
+            == ops_cycles.cycles_max_dispatch(64, 3, 1))
+
+
+def test_packed_dispatch_keeps_in_flight_rows_under_repriced_cap(
+        monkeypatch):
+    """Under a tight budget the packed32 route legally keeps MORE rows
+    in flight than uint8's cap — and the executor's per-chip
+    accounting confirms it never exceeds the repriced one."""
+    monkeypatch.setattr(ops_cycles, "CYCLES_DISPATCH_BUDGET", 4096)
+    n = 16
+    uint8_cap = ops_cycles.cycles_max_dispatch(n)
+    packed_cap = ops_cycles.cycles_max_dispatch(n, impl="packed32")
+    assert uint8_cap == 8 and packed_cap == 128
+    mats = _ring_mats(30, n - 3)
+    monkeypatch.setenv("JEPSEN_TPU_CYCLES_IMPL", "packed32")
+    ex = execution.Executor(1, mesh=None)
+    got = ops_cycles.has_cycle_batch(mats, executor=ex)
+    assert list(got) == [i % 2 == 0 for i in range(30)]
+    assert ex.submitted == 1  # one chunk where uint8 pays ceil(30/8)=4
+    for acct in ex.chip_row_accounting.values():
+        # row-bucket padding can round 30 up, but in-flight rows stay
+        # under the repriced cap while provably exceeding uint8's
+        assert uint8_cap < acct["peak_chip_rows"] <= packed_cap, acct
+
+
+# ---------------------------------------------------------------------------
+# host fallback: word-packed stacking (the n=1024 regression)
+# ---------------------------------------------------------------------------
+
+
+def test_np_chunk_rows_n1024_regression():
+    """CPU-oracle parity at n=1024 historically blew the stacking
+    budget 32× earlier than the device path because the resident stack
+    was (B, n, n) bool — one word per LANE.  Word-packed stacking
+    prices rows at n·W uint32 words, restoring the 32× ratio."""
+    budget = ops_cycles._NP_STACK_BUDGET
+    assert ops_cycles._np_chunk_rows(1024) == budget // (1024 * 32)
+    assert ops_cycles._np_chunk_rows(1024) == 32 * (budget // 1024 ** 2)
+    # ragged n prices by ⌈n/32⌉ words, never fewer
+    assert ops_cycles._np_chunk_rows(100) == budget // (100 * 4)
+
+
+def test_np_packed_closure_matches_bool_closure():
+    rng = np.random.default_rng(45134)
+    for n in (32, 64):
+        adj = rng.random((20, n, n)) < 0.1
+        want = ops_cycles._np_bool_closure(adj)
+        got = dense.unpack_words_np(
+            ops_cycles._np_packed_closure(dense.pack_words_np(adj), n),
+            n)
+        assert np.array_equal(got, want), n
+
+
+def test_host_fallback_packed_parity_mixed_sizes(monkeypatch):
+    """Over-budget buckets answer from the word-packed numpy closure;
+    verdicts stay byte-identical to the bool oracle across ragged
+    sizes that exercise the word floor."""
+    monkeypatch.setattr(ops_cycles, "CYCLES_DISPATCH_BUDGET", 100)
+    rng = np.random.default_rng(45135)
+    random_sizes = [12, 17, 33, 40, 64]
+    mats = []
+    for n in random_sizes:
+        for _ in range(4):
+            mats.append(rng.random((n, n)) < 0.15)
+    mats += _ring_mats(4, 45)
+    assert ops_cycles.cycles_max_dispatch(16) == 0  # all host
+    got = ops_cycles.has_cycle_batch(mats)
+    want = [ops_cycles._np_has_cycle(np.asarray(m, bool)) for m in mats]
+    assert list(got) == want
+
+
+def test_host_fallback_packed_parity_n1024(monkeypatch):
+    """The pinned regression shape itself: one cyclic ring and one
+    acyclic chain at n=1024 decide on the host through the packed
+    closure — in chunks of 2048 rows where bool stacking allowed 64."""
+    monkeypatch.setattr(ops_cycles, "CYCLES_DISPATCH_BUDGET", 100)
+    n = 1024
+    ring = np.zeros((n, n), bool)
+    for i in range(n):
+        ring[i, (i + 1) % n] = True
+    chain = np.zeros((n, n), bool)
+    for i in range(n - 1):
+        chain[i, i + 1] = True
+    got = ops_cycles.has_cycle_batch([ring, chain])
+    assert list(got) == [True, False]
+
+
+# ---------------------------------------------------------------------------
+# knob resolution + bucket word floor
+# ---------------------------------------------------------------------------
+
+
+def test_closure_impl_env_overrides_and_rejects_garbage(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_CYCLES_IMPL", "packed32")
+    assert ops_cycles.closure_impl() == "packed32"
+    monkeypatch.setenv("JEPSEN_TPU_CYCLES_IMPL", "uint16")
+    assert ops_cycles.closure_impl() == ops_cycles.DEFAULT_CLOSURE_IMPL
+
+
+def test_graph_bucket_word_floor():
+    """Every vertex bucket a screen can see is a multiple of 32, so
+    W = n/32 is exact for the packed planes; the padding rows carry no
+    edges and a word-floored screen answers identically (the byte-
+    identity fuzz above runs at the floored buckets)."""
+    assert elle_encode.graph_bucket(1) == 32
+    assert elle_encode.graph_bucket(16) == 32
+    assert elle_encode.graph_bucket(33) == 64
+    assert elle_encode.graph_bucket(64) == 64
+    assert elle_encode.graph_bucket(65) == 128
+    for n in range(1, 200, 7):
+        b = elle_encode.graph_bucket(n)
+        assert b % dense.WORD_LANES == 0 and b >= n
+
+
+def test_plane_weight_discounts_packed_profiles():
+    masks, nonadj = (1, 3, 7), ((4, 3),)
+    base = elle_encode.plane_weight(masks, nonadj)
+    assert base == 7
+    assert elle_encode.plane_weight(masks, nonadj, "packed32") == 1
+    assert elle_encode.plane_weight(masks, nonadj, "bf16") == base
+    # 40 planes span two words
+    many = tuple(range(1, 37))
+    assert elle_encode.plane_weight(many, (), "packed32") == 2

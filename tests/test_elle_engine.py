@@ -238,7 +238,8 @@ def test_calibration_cost_table_serves_cycles_rows(tmp_path):
 
     data = artifact.build_artifact(
         {"window": 4, "flush_rows": 16384, "row_bucket": 64,
-         "union_mode": "unroll", "closure_mode": "fixed"},
+         "union_mode": "unroll", "closure_mode": "fixed",
+         "closure_impl": "uint8"},
         [{"kernel": "cycles", "E": 16, "C": 0, "F": 7, "rows": 8,
           "seconds": 0.004},
          {"kernel": "cycles", "E": 16, "C": 0, "F": 7, "rows": 32,
@@ -278,7 +279,8 @@ def test_tune_cost_table_measures_cycles(tmp_path):
     prof = dict(calibrate.PROFILES["smoke"])
     corpora = {}  # the cycles arm needs no history corpus
     params = {"window": 4, "flush_rows": 16384, "row_bucket": 64,
-              "union_mode": "unroll", "closure_mode": "fixed"}
+              "union_mode": "unroll", "closure_mode": "fixed",
+              "closure_impl": "uint8"}
     entries = calibrate.measure_cost_table(runner, corpora, prof, params)
     cyc = [e for e in entries if e["kernel"] == "cycles"]
     assert cyc, entries

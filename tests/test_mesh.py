@@ -325,3 +325,28 @@ def test_check_batch_mesh_lock_models(mesh8):
         assert stats["kernels"] == {"dense": 11}, stats
         assert [o["valid?"] for o in outs] == _oracle(model, hists)
         assert False in [o["valid?"] for o in outs]
+
+
+def test_shard_fn_cache_keys_on_closure_impl(mesh8):
+    """A knob flip mid-process must never resolve a sharded executable
+    traced for a different closure arithmetic: the stamped
+    ``fn.closure_impl`` rides the shard_fn cache key, so two impls on
+    the same fn object get distinct wrapped variants and flipping back
+    reuses the first one."""
+    def fn(x):
+        return (x + 1,)
+
+    fn.closure_impl = "uint8"
+    a = mesh_mod.shard_fn(fn, mesh8, n_in=1, n_out=1)
+    assert mesh_mod.shard_fn(fn, mesh8, n_in=1, n_out=1) is a
+    fn.closure_impl = "packed32"
+    b = mesh_mod.shard_fn(fn, mesh8, n_in=1, n_out=1)
+    assert b is not a
+    assert mesh_mod.shard_fn(fn, mesh8, n_in=1, n_out=1) is b
+    fn.closure_impl = "uint8"
+    assert mesh_mod.shard_fn(fn, mesh8, n_in=1, n_out=1) is a
+    assert len(fn._sharded_variants) == 2
+    # both cached variants are runnable executables, not stale traces
+    x = np.arange(8, dtype=np.int32)
+    np.testing.assert_array_equal(np.asarray(a(x)[0]), x + 1)
+    np.testing.assert_array_equal(np.asarray(b(x)[0]), x + 1)

@@ -87,6 +87,112 @@ def test_rendezvous_spread_is_roughly_uniform():
 
 
 # ---------------------------------------------------------------------------
+# busy-ratio weighting: bounded movement per member, not just per death
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_rendezvous_neutral_weights_match_legacy_order():
+    members = ["a:1", "b:2", "c:3", "d:4"]
+    for key in _keys(100):
+        legacy = rendezvous_order(members, key)
+        assert rendezvous_order(members, key, None) == legacy
+        assert rendezvous_order(
+            members, key, {mem: 1.0 for mem in members}) == legacy
+        # missing entries default to neutral too
+        assert rendezvous_order(members, key, {}) == legacy
+
+
+def test_weighted_rendezvous_downweight_moves_only_that_members_keys():
+    members = ["a:1", "b:2", "c:3"]
+    keys = _keys()
+    before = {k: rendezvous_order(members, k)[0] for k in keys}
+    weights = {"c:3": 0.3}
+    after = {k: rendezvous_order(members, k, weights)[0] for k in keys}
+    for k in keys:
+        if before[k] != "c:3":
+            # only the busy member's score dropped; everyone else's
+            # scores are untouched, so their keys NEVER move
+            assert after[k] == before[k]
+        elif after[k] != "c:3":
+            # a shed key lands on its own runner-up, exactly where a
+            # breaker spill or member death would have sent it
+            assert after[k] == rendezvous_order(members, k)[1]
+    shed = sum(1 for k in keys
+               if before[k] == "c:3" and after[k] != "c:3")
+    assert shed > 0  # the weight drop actually sheds load
+
+
+def test_weighted_rendezvous_share_tracks_weight():
+    members = ["a:1", "b:2"]
+    keys = _keys(2000, seed=29)
+    wins = sum(
+        1 for k in keys
+        if rendezvous_order(members, k, {"b:2": 0.5})[0] == "b:2")
+    # expected share w/(1 + w) = 1/3 of 2000 ≈ 667
+    assert 500 <= wins <= 840, wins
+
+
+def test_weighted_rendezvous_floor_prevents_starvation():
+    members = ["a:1", "b:2", "c:3"]
+    keys = _keys(2000, seed=31)
+    wins = sum(
+        1 for k in keys
+        if rendezvous_order(members, k, {"c:3": 0.0})[0] == "c:3")
+    # a fully busy member keeps MIN_ROUTE_WEIGHT worth of keys — some,
+    # but far below a fair third
+    assert 0 < wins < 2000 // 3, wins
+
+
+def test_probe_refreshes_weights_and_candidates_follow(monkeypatch):
+    serve_client.reset_breakers()
+    members = ["h1:1", "h2:2", "h3:3"]
+    rt = Router(members, port=0, probe_interval_s=600.0)
+    monkeypatch.setattr(router_mod, "probe_healthz",
+                        lambda m, timeout=None: m != "h3:3")
+    busy = {"h1:1": 0.9, "h2:2": None, "h3:3": 0.4}
+    monkeypatch.setattr(rt, "_member_busy_ratio",
+                        lambda m: busy[m])
+    assert rt.probe_once() == 2
+    with rt._lock:
+        weights = dict(rt._weights)
+    assert weights["h1:1"] == pytest.approx(0.1)  # 1 - busy
+    assert weights["h2:2"] == 1.0   # no ratio reported: neutral
+    assert weights["h3:3"] == 1.0   # down member: neutral, not punished
+    st = rt.status()
+    by_m = {mm["member"]: mm for mm in st["members"]}
+    assert by_m["h1:1"]["weight"] == pytest.approx(0.1)
+    assert by_m["h3:3"]["up"] is False
+    # _candidates ranks live members by the WEIGHTED order
+    for key in _keys(50, seed=41):
+        cands = rt._candidates(key)
+        worder = rendezvous_order(members, key, weights)
+        assert cands == ([m for m in worder if m != "h3:3"]
+                         + ["h3:3"])
+    serve_client.reset_breakers()
+
+
+def test_member_busy_ratio_never_raises_on_garbage():
+    rt = Router(["127.0.0.1:9"], port=0, probe_interval_s=600.0)
+    # nothing listening on port 9: unreachable must read as neutral
+    assert rt._member_busy_ratio("127.0.0.1:9") is None
+
+
+def test_busy_weight_clamps_ratio_into_unit_interval(monkeypatch):
+    serve_client.reset_breakers()
+    rt = Router(["h1:1", "h2:2"], port=0, probe_interval_s=600.0)
+    monkeypatch.setattr(router_mod, "probe_healthz",
+                        lambda m, timeout=None: True)
+    busy = {"h1:1": 7.5, "h2:2": -3.0}  # hostile status bodies
+    monkeypatch.setattr(rt, "_member_busy_ratio", lambda m: busy[m])
+    rt.probe_once()
+    with rt._lock:
+        weights = dict(rt._weights)
+    assert weights["h1:1"] == router_mod.MIN_ROUTE_WEIGHT
+    assert weights["h2:2"] == 1.0
+    serve_client.reset_breakers()
+
+
+# ---------------------------------------------------------------------------
 # shape keys
 # ---------------------------------------------------------------------------
 
@@ -329,3 +435,41 @@ def test_router_status_and_healthz_endpoints():
             rt.stop()
         daemon.stop()
         serve_client.reset_breakers()
+
+
+# ---------------------------------------------------------------------------
+# weight_from_busy: one formula, shared by prober and fleet table
+# ---------------------------------------------------------------------------
+
+
+def test_weight_from_busy_formula_and_neutrality():
+    # no report at all is neutral — silence is never punished
+    assert router_mod.weight_from_busy(None) == 1.0
+    assert router_mod.weight_from_busy(0.0) == 1.0
+    assert router_mod.weight_from_busy(0.25) == pytest.approx(0.75)
+    # saturation hits the starvation floor, not zero
+    assert router_mod.weight_from_busy(1.0) == router_mod.MIN_ROUTE_WEIGHT
+    # out-of-range reports clamp into [0, 1] rather than extrapolate
+    assert router_mod.weight_from_busy(7.5) == router_mod.MIN_ROUTE_WEIGHT
+    assert router_mod.weight_from_busy(-3.0) == 1.0
+
+
+def test_fleet_table_prints_routing_weight_column():
+    rows = [
+        ("h1:7001", {"n_devices": 1, "platform": "cpu",
+                     "live": {"device_busy_ratio": 0.9}}),
+        ("h2:7002", {"n_devices": 1, "platform": "cpu", "live": {}}),
+        ("h3:7003", None),
+    ]
+    out = serve_client.format_fleet_status(rows)
+    lines = out.splitlines()
+    header = lines[1].split()
+    assert header[-2:] == ["busy", "weight"]
+    by_member = {ln.split()[0]: ln.split() for ln in lines[3:]}
+    # busy 0.9 → weight 0.10: the same number the prober would feed
+    # rendezvous_order and export as jepsen_route_weight
+    assert by_member["h1:7001"][-2:] == ["90%", "0.10"]
+    # a live member with no busy report is neutral, not penalized
+    assert by_member["h2:7002"][-2:] == ["n/a", "1.00"]
+    # an unreachable member has no status to derive a weight from
+    assert by_member["h3:7003"][-1] == "-"

@@ -34,7 +34,8 @@ def make_data(**over):
     code (loads cleanly unless a test breaks it on purpose)."""
     kind, n = art.device_key()
     params = {"window": 7, "flush_rows": 123, "row_bucket": 128,
-              "union_mode": "gather", "closure_mode": "fixed"}
+              "union_mode": "gather", "closure_mode": "fixed",
+              "closure_impl": "uint8"}
     params.update(over.pop("params", {}))
     cost = over.pop("cost_table", [
         {"kernel": "dense", "E": 64, "C": 4, "F": 64, "rows": 32,
@@ -80,6 +81,7 @@ def test_artifact_round_trip_is_byte_stable(tmp_path):
     assert cal.row_bucket() == 128
     assert cal.union_mode() == "gather"
     assert cal.closure_mode() == "fixed"
+    assert cal.closure_impl() == "uint8"
 
 
 def test_artifact_schema_pins_param_keys():
@@ -89,7 +91,8 @@ def test_artifact_schema_pins_param_keys():
     data = make_data()
     assert set(data["params"]) == set(art.PARAM_KEYS)
     assert art.PARAM_KEYS == ("window", "flush_rows", "row_bucket",
-                              "union_mode", "closure_mode")
+                              "union_mode", "closure_mode",
+                              "closure_impl")
     assert data["version"] == art.SCHEMA_VERSION == 1
     for field in ("calibration_id", "device_kind", "n_devices",
                   "code_fingerprint", "cost_table"):
@@ -104,6 +107,8 @@ def test_artifact_schema_pins_param_keys():
     lambda d: d["params"].update(union_mode="zip"),
     lambda d: d["params"].update(closure_mode="adaptive"),
     lambda d: d["params"].pop("closure_mode"),
+    lambda d: d["params"].update(closure_impl="uint16"),
+    lambda d: d["params"].pop("closure_impl"),
     lambda d: d["params"].update(window=0),
 ])
 def test_validate_rejects_broken_artifacts(breaker):
@@ -170,6 +175,7 @@ def test_bad_artifact_leaves_engine_on_defaults_no_crash(
     assert execution.row_bucket_floor() == execution.ROW_BUCKET
     assert dense._union_mode() == dense.DEFAULT_UNION
     assert ops_cycles.closure_mode() == ops_cycles.DEFAULT_CLOSURE_MODE
+    assert ops_cycles.closure_impl() == ops_cycles.DEFAULT_CLOSURE_IMPL
     model = m.cas_register(0)
     hists = corpus()
     got = wgl.check_batch(model, hists, slot_cap=32)
@@ -191,6 +197,9 @@ def test_lookups_serve_calibrated_values():
     cal2 = art.Calibration(make_data(params={"closure_mode": "earlyexit"}))
     tune.set_active(cal2)
     assert ops_cycles.closure_mode() == "earlyexit"
+    cal3 = art.Calibration(make_data(params={"closure_impl": "packed32"}))
+    tune.set_active(cal3)
+    assert ops_cycles.closure_impl() == "packed32"
 
 
 def test_env_beats_calibration(monkeypatch):
@@ -201,11 +210,13 @@ def test_env_beats_calibration(monkeypatch):
     monkeypatch.setenv("JEPSEN_TPU_ENGINE_ROW_BUCKET", "32")
     monkeypatch.setenv("JEPSEN_TPU_DENSE_UNION", "unroll")
     monkeypatch.setenv("JEPSEN_TPU_CYCLES_CLOSURE", "earlyexit")
+    monkeypatch.setenv("JEPSEN_TPU_CYCLES_IMPL", "bf16")
     assert execution.default_window() == 2
     assert planning.flush_rows_default() == 999
     assert execution.row_bucket_floor() == 32
     assert dense._union_mode() == "unroll"
     assert ops_cycles.closure_mode() == "earlyexit"
+    assert ops_cycles.closure_impl() == "bf16"
 
 
 def test_row_bucket_env_rounds_to_pow2(monkeypatch):
