@@ -2,7 +2,7 @@
 # the host (not available in the build image — run them on a docker-
 # capable machine).
 
-.PHONY: test bench bench-gate check lint lint-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke fleet-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke docker-smoke docker-up docker-down
+.PHONY: test bench bench-gate check lint lint-fixtures lint-jaxpr-fixtures trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke fleet-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke docker-smoke docker-up docker-down
 
 test:
 	python -m pytest tests/ -q
@@ -14,10 +14,14 @@ test:
 # gate over the recorded window history
 check: lint test trace-smoke pipeline-smoke serve-smoke chaos-smoke online-smoke fleet-smoke mesh-smoke decompose-smoke tune-smoke elle-smoke kernels-smoke obs-fleet-smoke drift-smoke bench-gate
 
-# jtlint static analysis (doc/static-analysis.md): all seven passes —
+# jtlint static analysis (doc/static-analysis.md): all eight passes —
 # trace-safety, lock-discipline, concurrency (whole-program race
 # inference), obs-hygiene, protocol conformance, seam contracts, and
-# dispatch-budget discipline.  Fails on any finding not in the
+# dispatch-budget discipline — plus the jaxpr audit, which traces
+# every registered kernel across the knob cross-product and certifies
+# budget/shape/cache-key contracts against the lowered program
+# (incremental: content-hash cached, so a warm run never imports
+# jax).  Fails on any finding not in the
 # committed baseline (jepsen_tpu/lint/baseline.json — kept EMPTY);
 # lint.json / lint.sarif are the machine-readable reports.  The run
 # prints its wall-clock and fails if the whole-tree suite exceeds the
@@ -34,6 +38,13 @@ lint:
 # framework/baseline/CLI contract — standalone, no device deps
 lint-fixtures:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q -p no:cacheprovider
+
+# the jaxpr-audit rule fixtures: every jaxpr-* rule demonstrably fires
+# on a seeded violation (and stays quiet when suppressed), plus the
+# incremental-cache round-trip pins (doc/static-analysis.md "jaxpr
+# audit")
+lint-jaxpr-fixtures:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_lint_jaxpr.py -q -p no:cacheprovider
 
 # run the in-process CLI path with tracing on and fail unless the
 # store dir holds a valid Chrome trace + Prometheus dump with phase/op

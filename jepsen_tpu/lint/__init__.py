@@ -1,7 +1,7 @@
 """jtlint: the project-native static-analysis suite.
 
-``python -m jepsen_tpu.lint [paths]`` runs seven AST-based passes that
-encode this repo's real invariants (doc/static-analysis.md):
+``python -m jepsen_tpu.lint [paths]`` runs eight passes that encode
+this repo's real invariants (doc/static-analysis.md):
 
 - **trace-safety** — host impurity reachable inside jit/vmap/pmap
   traced code, and implicit device syncs in the dispatch path.
@@ -19,10 +19,19 @@ encode this repo's real invariants (doc/static-analysis.md):
   the ``JEPSEN_TPU_*`` env registry (:mod:`jepsen_tpu.lint.envvars`).
 - **budget** — every jit-kernel dispatch rides an Executor /
   ``safe_dispatch``-capped path (the ``has_cycle_batch`` bug class).
+- **jaxpr-audit** — the one non-AST pass: every registered kernel is
+  abstractly traced (``jax.make_jaxpr``, CPU, no device work) across
+  the full knob cross-product and certified against declared
+  ``# jt: jaxpr(...)`` contracts — per-row HBM budget bands,
+  dot_general/dtype pins, host-sync and retrace hazards — plus AST
+  dataflow from knob resolvers to lru/shard cache keys.
 
-Dependency-free (stdlib ``ast`` only — linting ``ops/`` never imports
-JAX), wired into ``make lint`` / ``make check``, non-zero exit on any
-finding not in the committed baseline (``jepsen_tpu/lint/baseline.json``).
+The seven AST passes are dependency-free (stdlib ``ast`` only —
+linting ``ops/`` never imports JAX); the jaxpr audit imports jax only
+on an incremental-cache miss (content-hashed results keep the warm
+``make lint`` jax-free).  Wired into ``make lint`` / ``make check``,
+non-zero exit on any finding not in the committed baseline
+(``jepsen_tpu/lint/baseline.json``).
 Per-line suppression: ``# jt: allow[rule-id]``.
 """
 

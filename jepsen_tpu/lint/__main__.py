@@ -9,8 +9,10 @@ machine-readable report (default ``lint.json``) for trend tracking.
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import os
+import subprocess
 import sys
 import time
 from typing import List, Optional
@@ -22,6 +24,62 @@ from .core import (DEFAULT_BASELINE, all_passes, all_rules, lint_paths,
 def _default_paths() -> List[str]:
     """The installed package tree (works from any cwd)."""
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _changed_paths(paths: List[str]) -> Optional[List[str]]:
+    """The ``--changed`` file set: python files under ``paths`` that
+    differ from git HEAD (staged, unstaged, or untracked).  Returns
+    None when git is unavailable (fall back to the full set — CI must
+    never silently lint nothing)."""
+    roots = [os.path.abspath(p) for p in paths]
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if top.returncode != 0:
+            return None
+        repo = top.stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", repo, "diff", "--name-only", "HEAD", "--"],
+            capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "-C", repo, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0 or untracked.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out: List[str] = []
+    for rel in sorted(set(diff.stdout.splitlines())
+                      | set(untracked.stdout.splitlines())):
+        if not rel.endswith(".py"):
+            continue
+        ap = os.path.join(repo, rel)
+        if not os.path.isfile(ap):
+            continue  # deleted files have nothing to lint
+        if any(ap == r or ap.startswith(r + os.sep) for r in roots):
+            out.append(ap)
+    return out
+
+
+def _expand_rules(tokens: List[str], known: List[str]) -> List[str]:
+    """fnmatch-expand rule tokens (``jaxpr-*``); literal ids pass
+    through so unknown-rule detection still works."""
+    out: List[str] = []
+    for tok in tokens:
+        if any(ch in tok for ch in "*?["):
+            matches = fnmatch.filter(known, tok)
+            if matches:
+                out.extend(matches)
+            else:
+                out.append(tok)  # surfaces as unknown below
+        else:
+            out.append(tok)
+    return out
 
 
 def _sarif_report(result) -> dict:
@@ -77,7 +135,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="files or directories (default: the jepsen_tpu "
                          "package)")
     ap.add_argument("--rules", metavar="ID[,ID...]",
-                    help="run only these rule ids")
+                    help="run only these rule ids (fnmatch globs "
+                         "allowed: --rules 'jaxpr-*')")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs git HEAD (plus "
+                         "untracked) under the given paths — the CI "
+                         "fast path; exits 0 when nothing changed")
     ap.add_argument("--list-rules", action="store_true",
                     help="list every rule id and exit")
     ap.add_argument("--baseline", metavar="PATH", default=DEFAULT_BASELINE,
@@ -117,7 +180,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "the baseline must cover the full rule set",
                   file=sys.stderr)
             return 2
-        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        rules = _expand_rules(
+            [r.strip() for r in args.rules.split(",") if r.strip()],
+            all_rules())
         unknown = set(rules) - set(all_rules()) - {"parse-error"}
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}",
@@ -129,6 +194,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"no such path: {p}", file=sys.stderr)
             return 2
+    lint_options = None
+    if args.changed:
+        changed = _changed_paths(paths)
+        if changed is None:
+            print("warning: --changed needs a git checkout; "
+                  "linting the full path set", file=sys.stderr)
+        elif not changed:
+            if not args.quiet:
+                print("jtlint: no changed files")
+            return 0
+        else:
+            paths = changed
+            # whole-tree-only checks (e.g. registered-but-unread env
+            # vars) are unsound over a changed-file subset
+            lint_options = {"subset_scan": True}
 
     baseline = None
     if not args.no_baseline and not args.write_baseline:
@@ -139,7 +219,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     t0 = time.perf_counter()
-    result = lint_paths(paths, rules=rules, baseline=baseline)
+    result = lint_paths(paths, rules=rules, options=lint_options,
+                        baseline=baseline)
     elapsed = time.perf_counter() - t0
 
     if args.write_baseline:

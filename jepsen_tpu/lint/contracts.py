@@ -547,8 +547,12 @@ class SeamContracts(Pass):
         if registry is None:
             return
         anchor = project.file_named("lint/envvars.py")
-        full_tree = (anchor is not None
-                     or project.options.get("env_registry") is not None)
+        # the registered-but-never-read check is only sound when every
+        # potential reader is in the scanned set; a --changed subset
+        # that happens to include envvars.py must not fire it
+        full_tree = ((anchor is not None
+                      or project.options.get("env_registry") is not None)
+                     and not project.options.get("subset_scan"))
         read_anywhere: Set[str] = set()
         for sf in project.files:
             if sf.tree is None:

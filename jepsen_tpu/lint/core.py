@@ -276,6 +276,7 @@ def _ensure_registered() -> None:
     from . import budget  # noqa: F401
     from . import concurrency  # noqa: F401
     from . import contracts  # noqa: F401
+    from . import jaxpr_audit  # noqa: F401
     from . import lock_discipline  # noqa: F401
     from . import obs_hygiene  # noqa: F401
     from . import protocol  # noqa: F401

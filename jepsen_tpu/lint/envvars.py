@@ -110,6 +110,15 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "serve/daemon.py",
            "dispatch-journal path for the `serve()` production entry; "
            "falsy disables"),
+    EnvVar("JEPSEN_TPU_LINT_CACHE", "lint/.jaxpr_cache.json",
+           "lint/jaxpr_audit.py",
+           "jaxpr-audit incremental result cache path (package-"
+           "relative default); falsy disables caching, every lint run "
+           "re-traces"),
+    EnvVar("JEPSEN_TPU_LINT_JAXPR", "1",
+           "lint/jaxpr_audit.py",
+           "`0` disables the traced half of the jaxpr audit (budget/"
+           "shape-pin/host-sync/retrace); the AST rules still run"),
     EnvVar("JEPSEN_TPU_LIVE", "unset",
            "interpreter.py",
            "`1` ships history events to the checker daemon as they "

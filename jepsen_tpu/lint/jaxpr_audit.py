@@ -1,0 +1,993 @@
+"""jtlint v3: jaxpr-level kernel certification.
+
+The AST passes certify the *source text*; this pass certifies the
+*lowered program*.  Every registered kernel — the ``# jt: traced``
+step roots plus the knob-tunable kernel factories — is abstractly
+traced with ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` specs (CPU
+only, no device work, no compilation) across the full knob
+cross-product (``closure_impl × closure_mode × union`` and the shape
+buckets the registry declares), and four contracts are checked
+against each traced jaxpr:
+
+- ``jaxpr-budget`` — the measured peak loop-carried resident bytes
+  per batch row (walking the jaxpr, while_loop carries and scan
+  residents included) must sit inside the declared band relative to
+  the budget math's claimed per-row pricing (``cycles_max_dispatch``
+  / ``frontier_max_dispatch`` words × word size).  A mispriced knob
+  is a lint failure instead of a chip OOM.
+- ``jaxpr-shape-pin`` — declared ``dot_general``-count and dominant
+  loop-carry-dtype contracts, checked per knob combination, so the
+  one-off pins that used to live in bespoke tests become per-kernel
+  annotations.
+- ``jaxpr-host-sync`` — callback/infeed/outfeed primitives inside a
+  kernel jaxpr (a host round-trip per dispatch).
+- ``jaxpr-retrace`` — weak-typed 0-d closure captures (a python
+  scalar funneled through ``jnp``): every new python value retraces
+  the kernel silently.
+
+Two further rules need no tracing:
+
+- ``jaxpr-cache-key`` — AST dataflow from tuned-knob resolver call
+  sites (any function whose body calls ``resolve_knob``) to cache-key
+  construction: a resolver called *inside* an ``lru_cache`` body
+  bypasses the key; a wrapper that resolves a knob but doesn't pass
+  the value into its cached-factory call leaks it; a cached factory
+  taking a knob parameter must stamp it on the returned fn
+  (``fn.closure_impl`` &c.) and ``shard_fn``'s executable cache key
+  must read every stamped knob back.
+- ``jaxpr-coverage`` — a ``# jt: traced`` def in a registry module
+  with no audit registry entry: the new kernel is invisible to
+  certification until registered.
+
+Contract annotations ride the ``# jt:`` directive channel, on the
+kernel/factory def line (or the line above)::
+
+    # jt: jaxpr(dot_generals<=2*log2n+3, dtype[packed32]=uint32, budget=0.2..0.6)
+
+Clauses (comma-separated, all optional):
+
+- ``dot_generals<=EXPR`` — upper bound on dot_general count (scan
+  bodies multiply by trip count); EXPR is an integer expression over
+  ``n``, ``log2n``, ``E``, ``C``, ``F``, ``V`` and literals with
+  ``+``/``-``/``*``.
+- ``dtype=DT`` / ``dtype[KNOBVALUE]=DT`` — dominant (largest-byte)
+  loop-carry dtype, optionally conditional on a knob value in the
+  active combination.
+- ``budget=LO..HI`` — declared band for measured/claimed per-row
+  bytes.  The measured metric is the *slope* of peak resident bytes
+  between two batch sizes, so closure state and top-level inputs
+  (priced separately, by row count) don't pollute it.
+
+Tracing is expensive (~seconds across the cross-product), so results
+are cached content-addressed: sha1 of the rule version, this module's
+own source, and every registry anchor file's text.  A warm ``make
+lint`` never imports jax at all.  ``JEPSEN_TPU_LINT_CACHE`` moves (or
+falsily disables) the cache file; ``JEPSEN_TPU_LINT_JAXPR=0``
+disables the traced half outright (the AST rules still run).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
+
+from .core import (Finding, FunctionIndex, Pass, Project, SourceFile,
+                   cached_walk, dotted_name, register)
+
+#: bump to invalidate every cached audit result
+RULE_VERSION = "1"
+
+#: default incremental-cache location (package-relative, like the
+#: baseline; gitignored)
+DEFAULT_CACHE = os.path.join(os.path.dirname(__file__), ".jaxpr_cache.json")
+
+#: knob-named factory parameters and the fn attribute each must be
+#: stamped as, so ``mesh.shard_fn``'s executable cache key can read it
+KNOB_PARAM_ATTR: Dict[str, str] = {
+    "mode": "closure_mode",
+    "impl": "closure_impl",
+    "closure_mode": "closure_mode",
+    "closure_impl": "closure_impl",
+    "union": "union_mode",
+    "union_mode": "union_mode",
+    "compaction": "compaction",
+}
+
+#: the stampable knob attributes (values of KNOB_PARAM_ATTR)
+KNOB_ATTRS = tuple(sorted(set(KNOB_PARAM_ATTR.values())))
+
+
+# -- contract annotations ----------------------------------------------------
+
+
+_JAXPR_RE = re.compile(r"jaxpr\(([^)]*)\)")
+_BUDGET_RE = re.compile(r"^budget=([0-9.]+)\.\.([0-9.]+)$")
+_DOTS_RE = re.compile(r"^dot_generals<=(.+)$")
+_DTYPE_RE = re.compile(r"^dtype(?:\[([A-Za-z0-9_]+)\])?=([A-Za-z0-9_]+)$")
+
+
+class Contract:
+    """One parsed ``jaxpr(...)`` annotation."""
+
+    __slots__ = ("dot_generals", "dtypes", "budget")
+
+    def __init__(self) -> None:
+        self.dot_generals: Optional[str] = None
+        #: knob-value condition (None = unconditional) -> dtype name
+        self.dtypes: Dict[Optional[str], str] = {}
+        self.budget: Optional[Tuple[float, float]] = None
+
+
+def parse_contract(directives: Iterable[str]) -> Optional[Contract]:
+    """The contract in a directive list, or None.  Unknown clauses are
+    ignored (forward compatibility: an older lint must not fail on a
+    newer clause)."""
+    for d in directives:
+        m = _JAXPR_RE.search(d)
+        if not m:
+            continue
+        c = Contract()
+        for clause in m.group(1).split(","):
+            clause = clause.strip().replace(" ", "")
+            if not clause:
+                continue
+            b = _BUDGET_RE.match(clause)
+            if b:
+                c.budget = (float(b.group(1)), float(b.group(2)))
+                continue
+            g = _DOTS_RE.match(clause)
+            if g:
+                c.dot_generals = g.group(1)
+                continue
+            t = _DTYPE_RE.match(clause)
+            if t:
+                c.dtypes[t.group(1)] = t.group(2)
+        return c
+    return None
+
+
+def eval_bound(expr: str, env: Dict[str, int]) -> Optional[int]:
+    """Evaluate a ``dot_generals`` bound expression: integer literals
+    and the names in ``env`` under ``+``/``-``/``*`` only."""
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError:
+        return None
+
+    def ev(node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        if isinstance(node, ast.Name):
+            v = env.get(node.id)
+            return int(v) if isinstance(v, int) else None
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub, ast.Mult)):
+            left, right = ev(node.left), ev(node.right)
+            if left is None or right is None:
+                return None
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            return left * right
+        return None
+
+    return ev(tree)
+
+
+# -- jaxpr walking (duck-typed: no jax import needed at module load) ---------
+
+
+def _as_jaxpr(v: Any):
+    """The raw Jaxpr behind ``v`` (Jaxpr or ClosedJaxpr), else None."""
+    if hasattr(v, "eqns"):
+        return v
+    inner = getattr(v, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return None
+
+
+def _sub_jaxprs(eqn) -> Iterable[Any]:
+    for v in eqn.params.values():
+        j = _as_jaxpr(v)
+        if j is not None:
+            yield j
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                j = _as_jaxpr(x)
+                if j is not None:
+                    yield j
+
+
+def aval_bytes(v: Any) -> int:
+    aval = getattr(v, "aval", v)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    total = 1
+    for d in shape:
+        total *= int(d)
+    return total * int(dtype.itemsize)
+
+
+def peak_resident(jaxpr, outer: int = 0) -> int:
+    """Peak loop-carried resident bytes: for every structured-control
+    region, the bytes that must stay live across iterations (while
+    carries; scan carries + consumed xs + stacked ys), maximized over
+    nesting.  Deliberately NOT full liveness — XLA fuses away most
+    intermediate values, so the loop-carried state is the stable,
+    fusion-independent floor the budget math prices."""
+    best = outer
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "while":
+            carry = sum(aval_bytes(v) for v in eqn.outvars)
+            body = _as_jaxpr(eqn.params["body_jaxpr"])
+            cond = _as_jaxpr(eqn.params["cond_jaxpr"])
+            best = max(best, peak_resident(body, outer + carry))
+            best = max(best, peak_resident(cond, outer + carry))
+        elif name == "scan":
+            nc = eqn.params["num_carry"]
+            ncon = eqn.params["num_consts"]
+            resident = (
+                sum(aval_bytes(v) for v in eqn.invars[ncon:])
+                + sum(aval_bytes(v) for v in eqn.outvars[nc:])
+            )
+            best = max(best, peak_resident(_as_jaxpr(eqn.params["jaxpr"]),
+                                           outer + resident))
+        else:
+            for sub in _sub_jaxprs(eqn):
+                best = max(best, peak_resident(sub, outer))
+    return best
+
+
+def count_dot_generals(jaxpr) -> int:
+    """dot_general count, scan bodies multiplied by trip count (the
+    unrolled-program count the MXU actually sees)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += 1
+        elif name == "scan":
+            total += eqn.params["length"] * count_dot_generals(
+                _as_jaxpr(eqn.params["jaxpr"]))
+        else:
+            for sub in _sub_jaxprs(eqn):
+                total += count_dot_generals(sub)
+    return total
+
+
+def _carries(jaxpr, acc: List[Tuple[int, str]]) -> List[Tuple[int, str]]:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "while":
+            for v in eqn.outvars:
+                acc.append((aval_bytes(v), str(v.aval.dtype)))
+            _carries(_as_jaxpr(eqn.params["body_jaxpr"]), acc)
+        elif name == "scan":
+            nc = eqn.params["num_carry"]
+            ncon = eqn.params["num_consts"]
+            for v in eqn.invars[ncon:ncon + nc]:
+                acc.append((aval_bytes(v), str(v.aval.dtype)))
+            _carries(_as_jaxpr(eqn.params["jaxpr"]), acc)
+        else:
+            for sub in _sub_jaxprs(eqn):
+                _carries(sub, acc)
+    return acc
+
+
+def dominant_dtype(closed) -> Optional[str]:
+    """Dominant (largest-byte) loop-carry dtype; kernels with no loops
+    fall back to the dominant output dtype."""
+    cand = _carries(closed.jaxpr, [])
+    if not cand:
+        cand = [(aval_bytes(v), str(v.aval.dtype))
+                for v in closed.jaxpr.outvars]
+    if not cand:
+        return None
+    return max(cand)[1]
+
+
+def host_sync_prims(jaxpr) -> List[str]:
+    """Host round-trip primitives anywhere in the jaxpr, sorted."""
+    out: set = set()
+
+    def walk(j) -> None:
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if "callback" in name or name in ("infeed", "outfeed"):
+                out.add(name)
+            for sub in _sub_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return sorted(out)
+
+
+def weak_scalar_consts(closed) -> List[str]:
+    """Dtypes of weak-typed 0-d closure captures (python scalars that
+    went through jnp): each new python value silently retraces."""
+    out: List[str] = []
+    for c in getattr(closed, "consts", ()):
+        aval = getattr(c, "aval", None)
+        if (aval is not None and getattr(aval, "weak_type", False)
+                and getattr(aval, "shape", None) == ()):
+            out.append(str(aval.dtype))
+    return sorted(out)
+
+
+# -- kernel registry ---------------------------------------------------------
+
+
+class KernelEntry:
+    """One certifiable kernel: where it anchors in the source (path
+    suffix + def qualname — the contract annotation and suppressions
+    live there), how to build it per knob combination, and the spec
+    shapes to trace it at."""
+
+    __slots__ = ("name", "path", "scope", "axes", "shapes", "build",
+                 "arg_specs", "claimed")
+
+    def __init__(
+        self,
+        name: str,
+        path: str,
+        scope: str,
+        build: Callable[[dict, dict], Any],
+        arg_specs: Callable[[dict, int], tuple],
+        axes: Optional[Dict[str, Tuple[str, ...]]] = None,
+        shapes: Sequence[dict] = ({},),
+        claimed: Optional[Callable[[dict, dict], Optional[float]]] = None,
+    ):
+        self.name = name
+        self.path = path
+        self.scope = scope
+        self.build = build
+        self.arg_specs = arg_specs
+        self.axes = dict(axes or {})
+        self.shapes = tuple(shapes)
+        self.claimed = claimed
+
+
+def knob_combos(axes: Dict[str, Tuple[str, ...]]) -> List[Dict[str, str]]:
+    combos: List[Dict[str, str]] = [{}]
+    for key in sorted(axes):
+        combos = [dict(c, **{key: v}) for c in combos for v in axes[key]]
+    return combos
+
+
+def combo_label(shape: dict, knobs: dict) -> str:
+    items = [(k, v) for k, v in shape.items() if isinstance(v, (int, str))]
+    items += list(knobs.items())
+    return " ".join(f"{k}={v}" for k, v in sorted(items))
+
+
+def _history_specs(shape: dict, batch: int) -> tuple:
+    """The batched history checkers' 6-array input contract
+    (ops/encode.py EncodedBatch)."""
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as SDS
+    E, C = shape["E"], shape["C"]
+    return (
+        SDS((batch,), jnp.int32),
+        SDS((batch, E), jnp.int32),
+        SDS((batch, E, C), jnp.int8),
+        SDS((batch, E, C), jnp.int8),
+        SDS((batch, E, C), jnp.int16),
+        SDS((batch, E, C), jnp.int16),
+    )
+
+
+def _claimed_cycles(n_filters: int, n_lifted: int):
+    def claimed(shape: dict, knobs: dict) -> Optional[float]:
+        from jepsen_tpu.ops import cycles
+        cap = cycles.cycles_max_dispatch(
+            shape["n"], n_filters, n_lifted, max_dispatch=10 ** 9,
+            impl=knobs["impl"])
+        if not cap:
+            return None
+        words = cycles.CYCLES_DISPATCH_BUDGET // cap
+        word_bytes = 4 if knobs["impl"] == "packed32" else 2
+        return float(words * word_bytes)
+
+    return claimed
+
+
+def _claimed_frontier(shape: dict, knobs: dict) -> Optional[float]:
+    from jepsen_tpu.ops import wgl
+    cap = wgl.frontier_max_dispatch(
+        shape["F"], shape["E"], shape["C"], max_dispatch=10 ** 9)
+    if not cap:
+        return None
+    return float((wgl.FRONTIER_DISPATCH_BUDGET // cap) * 4)
+
+
+def _step_entry(fn_name: str) -> KernelEntry:
+    def build(shape: dict, knobs: dict):
+        from jepsen_tpu.ops import step_kernels
+        return getattr(step_kernels, fn_name)
+
+    def args(shape: dict, batch: int) -> tuple:
+        import jax.numpy as jnp
+        from jax import ShapeDtypeStruct as SDS
+        return tuple(SDS((), jnp.int32) for _ in range(4))
+
+    return KernelEntry(fn_name, "ops/step_kernels.py", fn_name, build, args)
+
+
+_STEP_NAMES = (
+    "register_step", "cas_register_step", "mutex_step",
+    "reentrant_mutex_step", "multi_register_step", "unordered_queue_step",
+)
+
+#: the transactional-screen probe profile the audit traces at (one
+#: representative mask/nonadjacency set; the contract must hold for
+#: any, the budget formula is parametric in (F, Q))
+_SCREEN_MASKS = (1, 3, 7)
+_SCREEN_NONADJ = ((4, 3),)
+
+_CLOSURE_AXES = {
+    "mode": ("fixed", "earlyexit"),
+    "impl": ("uint8", "packed32", "bf16"),
+}
+
+
+def default_registry() -> Tuple[KernelEntry, ...]:
+    """Every production kernel the audit certifies.  Builders import
+    lazily so a warm cache hit (or a fixture run with no anchors)
+    never imports jax or the ops modules."""
+
+    def build_cyclic(shape: dict, knobs: dict):
+        from jepsen_tpu.ops import cycles
+        return cycles._cyclic_fn(shape["n"], knobs["mode"], knobs["impl"])
+
+    def args_rel_bool(shape: dict, batch: int) -> tuple:
+        import jax.numpy as jnp
+        from jax import ShapeDtypeStruct as SDS
+        return (SDS((batch, shape["n"], shape["n"]), jnp.bool_),)
+
+    def build_screen(shape: dict, knobs: dict):
+        from jepsen_tpu.ops import cycles
+        return cycles._screen_fn_variant(
+            shape["n"], _SCREEN_MASKS, _SCREEN_NONADJ, True,
+            knobs["mode"], knobs["impl"])
+
+    def args_rel_u8(shape: dict, batch: int) -> tuple:
+        import jax.numpy as jnp
+        from jax import ShapeDtypeStruct as SDS
+        return (SDS((batch, shape["n"], shape["n"]), jnp.uint8),)
+
+    def build_dense(shape: dict, knobs: dict):
+        from jepsen_tpu.ops import dense
+        return dense._make_dense_fn_cached(
+            shape["spec"], shape["E"], shape["C"], shape["V"],
+            knobs["union"])
+
+    def build_frontier(shape: dict, knobs: dict):
+        from jepsen_tpu.ops import wgl
+        return wgl._make_check_fn(
+            shape["spec"], shape["E"], shape["C"], shape["F"],
+            shape["max_closure"], knobs["compaction"])
+
+    entries = [_step_entry(n) for n in _STEP_NAMES]
+    entries.append(KernelEntry(
+        "cyclic", "ops/cycles.py", "_cyclic_fn",
+        build_cyclic, args_rel_bool, axes=_CLOSURE_AXES,
+        shapes=({"n": 32}, {"n": 64}),
+        claimed=_claimed_cycles(1, 0),
+    ))
+    entries.append(KernelEntry(
+        "screen", "ops/cycles.py", "_screen_fn_variant",
+        build_screen, args_rel_u8, axes=_CLOSURE_AXES,
+        shapes=({"n": 32},),
+        claimed=_claimed_cycles(len(_SCREEN_MASKS), len(_SCREEN_NONADJ)),
+    ))
+    entries.append(KernelEntry(
+        "dense", "ops/dense.py", "_make_dense_fn_cached",
+        build_dense, _history_specs,
+        axes={"union": ("unroll", "gather", "matmul")},
+        shapes=({"spec": "register", "E": 16, "C": 4, "V": 8},
+                {"spec": "unordered-queue", "E": 16, "C": 4, "V": 0}),
+    ))
+    entries.append(KernelEntry(
+        "frontier", "ops/wgl.py", "_make_check_fn",
+        build_frontier, _history_specs,
+        axes={"compaction": ("hash", "sort")},
+        shapes=({"spec": "register", "E": 16, "C": 4, "F": 64,
+                 "max_closure": 5},),
+        claimed=_claimed_frontier,
+    ))
+    return tuple(entries)
+
+
+# -- the pass ----------------------------------------------------------------
+
+
+_LRU_NAMES = ("lru_cache", "cache")
+
+
+def _is_cached(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target) or ""
+        if name.rsplit(".", 1)[-1] in _LRU_NAMES:
+            return True
+    return False
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    return name.rsplit(".", 1)[-1] == "jit"
+
+
+def _returns_jitted(fn: ast.AST) -> bool:
+    """Does this factory hand back a jitted callable?  Either a
+    ``jax.jit(...)`` call in the body or a nested def decorated with
+    jit."""
+    for node in cached_walk(fn):
+        if _is_jit_call(node):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn and any(
+                    _is_jit_call(d) or (dotted_name(d) or "").endswith("jit")
+                    for d in node.decorator_list):
+                return True
+    return False
+
+
+def _call_name(node: ast.Call) -> str:
+    return (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+
+
+def _fn_index(sf: SourceFile) -> FunctionIndex:
+    """Per-file FunctionIndex, memoized on the SourceFile (the pass
+    walks every file twice: resolver discovery, then the dataflow
+    checks)."""
+    idx = getattr(sf, "_jaxpr_fn_index", None)
+    if idx is None:
+        idx = FunctionIndex(sf.tree)
+        sf._jaxpr_fn_index = idx
+    return idx
+
+
+def _knob_stamps(fn: ast.AST) -> set:
+    """Knob attributes stamped on fn objects in this function's body
+    (``anything.closure_impl = …`` with a knob-attr name)."""
+    stamps: set = set()
+    for node in cached_walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and node.targets[0].attr in KNOB_ATTRS
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id != "self"):
+            stamps.add(node.targets[0].attr)
+    return stamps
+
+
+class JaxprAudit(Pass):
+    name = "jaxpr-audit"
+    rules = (
+        "jaxpr-budget",
+        "jaxpr-cache-key",
+        "jaxpr-coverage",
+        "jaxpr-host-sync",
+        "jaxpr-retrace",
+        "jaxpr-shape-pin",
+    )
+
+    # -- plumbing ------------------------------------------------------------
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        registry = project.options.get("jaxpr_registry")
+        custom = registry is not None
+        if registry is None:
+            registry = default_registry()
+        self._check_cache_keys(project, out)
+        self._check_coverage(project, registry, out)
+        self._run_traced(project, registry, custom, out)
+        return out
+
+    def _emit(self, out: List[Finding], sf: SourceFile, rule: str,
+              line: int, col: int, scope: str, msg: str) -> None:
+        if not sf.allowed(line, rule):
+            out.append(Finding(rule, sf.rel, line, col, msg, scope))
+
+    # -- jaxpr-cache-key (AST dataflow, no tracing) --------------------------
+
+    def _resolver_names(self, project: Project) -> set:
+        """Program-wide tuned-knob resolvers: any function whose body
+        calls ``resolve_knob`` (the one sanctioned env > calibration >
+        default ladder, tune/artifact.py)."""
+        names = set()
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            idx = _fn_index(sf)
+            for q, fn in idx.funcs.items():
+                for node in cached_walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and _call_name(node) == "resolve_knob"):
+                        names.add(q.rsplit(".", 1)[-1])
+                        break
+        names.discard("resolve_knob")
+        return names
+
+    def _check_cache_keys(self, project: Project,
+                          out: List[Finding]) -> None:
+        resolvers = self._resolver_names(project)
+        stamped_attrs: set = set()
+        shard_fns: List[Tuple[SourceFile, ast.AST, str]] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            idx = FunctionIndex(sf.tree)
+            cached_factories = {
+                q.rsplit(".", 1)[-1]: fn for q, fn in idx.funcs.items()
+                if _is_cached(fn)
+            }
+            for q, fn in idx.funcs.items():
+                if q.rsplit(".", 1)[-1] == "shard_fn":
+                    shard_fns.append((sf, fn, q))
+                stamped_attrs.update(_knob_stamps(fn))
+                if _is_cached(fn):
+                    self._cached_body_resolvers(sf, fn, q, resolvers, out)
+                    self._knob_params_stamped(sf, fn, q, out)
+                else:
+                    self._resolved_reaches_factory(
+                        sf, fn, q, resolvers, cached_factories, out)
+        for sf, fn, q in shard_fns:
+            self._shard_key_reads(sf, fn, q, stamped_attrs, out)
+
+    def _cached_body_resolvers(self, sf: SourceFile, fn: ast.AST, q: str,
+                               resolvers: set, out: List[Finding]) -> None:
+        """A knob resolver called inside an lru_cache body: the
+        resolved value can flip under the cached entry's feet — the
+        caller must resolve and pass it as a key parameter."""
+        for node in cached_walk(fn):
+            if isinstance(node, ast.Call) and _call_name(node) in resolvers:
+                self._emit(
+                    out, sf, "jaxpr-cache-key", node.lineno, node.col_offset,
+                    q,
+                    f"knob resolver `{_call_name(node)}()` is called inside"
+                    f" the lru_cache'd body of `{q}` — the resolved value"
+                    " bypasses the cache key, so a knob flip resolves a"
+                    " stale cached kernel; resolve in the caller and pass"
+                    " the value as a parameter")
+
+    def _resolved_reaches_factory(self, sf: SourceFile, fn: ast.AST, q: str,
+                                  resolvers: set, factories: Dict[str, Any],
+                                  out: List[Finding]) -> None:
+        """A wrapper that resolves a knob AND calls a cached factory
+        must pass the resolved value into the factory call (directly
+        or via a local), or the factory's key can't distinguish knob
+        states."""
+        factory_calls = [
+            node for node in cached_walk(fn)
+            if isinstance(node, ast.Call) and _call_name(node) in factories
+        ]
+        if not factory_calls:
+            return
+        arg_nodes: List[ast.AST] = []
+        for call in factory_calls:
+            for a in call.args:
+                arg_nodes.extend(cached_walk(a))
+            for kw in call.keywords:
+                arg_nodes.extend(cached_walk(kw.value))
+        arg_names = {n.id for n in arg_nodes if isinstance(n, ast.Name)}
+        direct_arg_calls = {id(n) for n in arg_nodes
+                            if isinstance(n, ast.Call)}
+        for node in cached_walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node) in resolvers):
+                continue
+            if id(node) in direct_arg_calls:
+                continue
+            bound: Optional[str] = None
+            for stmt in cached_walk(fn):
+                if (isinstance(stmt, ast.Assign) and stmt.value is node
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    bound = stmt.targets[0].id
+            if bound is not None and bound in arg_names:
+                continue
+            self._emit(
+                out, sf, "jaxpr-cache-key", node.lineno, node.col_offset, q,
+                f"`{q}` resolves `{_call_name(node)}()` and calls a cached"
+                " kernel factory, but the resolved value is not passed"
+                " into the factory call — the factory's lru key cannot"
+                " see this knob")
+
+    def _knob_params_stamped(self, sf: SourceFile, fn: ast.AST, q: str,
+                             out: List[Finding]) -> None:
+        """A cached factory taking a knob-named parameter must stamp it
+        on the returned fn (``fn.closure_impl = impl`` style) so the
+        mesh shard_fn executable cache can key on it."""
+        if not _returns_jitted(fn):
+            return
+        stamps = _knob_stamps(fn)
+        for arg in getattr(fn.args, "args", ()):
+            attr = KNOB_PARAM_ATTR.get(arg.arg)
+            if attr is None or attr in stamps:
+                continue
+            self._emit(
+                out, sf, "jaxpr-cache-key", fn.lineno, fn.col_offset, q,
+                f"cached kernel factory `{q}` keys on knob parameter"
+                f" `{arg.arg}` but never stamps it on the returned fn"
+                f" (`fn.{attr} = {arg.arg}`) — mesh.shard_fn's executable"
+                " cache key cannot see it, so two knob states share one"
+                " sharded executable")
+
+    def _shard_key_reads(self, sf: SourceFile, fn: ast.AST, q: str,
+                         stamped_attrs: set, out: List[Finding]) -> None:
+        """Every knob attribute any factory stamps must be read back by
+        ``shard_fn`` (``getattr(check_fn, "<attr>", ...)``) into its
+        cache key."""
+        read: set = set()
+        for node in cached_walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "getattr" and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                read.add(node.args[1].value)
+        for attr in sorted(stamped_attrs - read):
+            self._emit(
+                out, sf, "jaxpr-cache-key", fn.lineno, fn.col_offset, q,
+                f"kernel factories stamp `fn.{attr}` but `{q}`'s"
+                " executable cache key never reads it back"
+                f" (`getattr(check_fn, \"{attr}\", ...)`) — the sharded"
+                " executable cache keys on fewer fields than the kernel"
+                " lru key")
+
+    # -- jaxpr-coverage ------------------------------------------------------
+
+    def _check_coverage(self, project: Project,
+                        registry: Sequence[KernelEntry],
+                        out: List[Finding]) -> None:
+        suffixes = sorted({e.path for e in registry})
+        covered = {(e.path, e.scope) for e in registry}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            match = [sfx for sfx in suffixes if sf.rel.endswith(sfx)]
+            if not match:
+                continue
+            idx = _fn_index(sf)
+            for q, fn in idx.funcs.items():
+                if not sf.marked(fn.lineno, "traced"):
+                    continue
+                if any((sfx, q) in covered for sfx in match):
+                    continue
+                self._emit(
+                    out, sf, "jaxpr-coverage", fn.lineno, fn.col_offset, q,
+                    f"`{q}` is marked `# jt: traced` in a registry module"
+                    " but has no jaxpr-audit registry entry — the kernel"
+                    " ships uncertified; add a KernelEntry (see"
+                    " doc/static-analysis.md \"jaxpr audit\")")
+
+    # -- traced rules --------------------------------------------------------
+
+    def _trace_enabled(self) -> bool:
+        v = os.environ.get("JEPSEN_TPU_LINT_JAXPR", "1").strip().lower()
+        return v not in ("0", "off", "false", "no", "")
+
+    def _cache_path(self, project: Project, custom: bool) -> Optional[str]:
+        if "jaxpr_cache" in project.options:
+            return project.options["jaxpr_cache"] or None
+        if custom:
+            # a custom registry's identity isn't content-hashable;
+            # don't share the default cache with it
+            return None
+        env = os.environ.get("JEPSEN_TPU_LINT_CACHE")
+        if env is not None:
+            env = env.strip()
+            if env.lower() in ("", "0", "off", "false", "no"):
+                return None
+            return env
+        return DEFAULT_CACHE
+
+    def _cache_key(self, anchored) -> str:
+        h = hashlib.sha1()
+        h.update(RULE_VERSION.encode())
+        try:
+            with open(__file__, "rb") as f:
+                h.update(f.read())
+        except OSError:  # pragma: no cover — zipapp install
+            pass
+        for entry, sf, line, _ in sorted(
+                anchored, key=lambda a: (a[1].rel, a[0].scope)):
+            h.update(f"\x1f{entry.name}\x1f{entry.path}\x1f{entry.scope}"
+                     f"\x1f{sorted(entry.axes.items())!r}"
+                     f"\x1f{entry.shapes!r}\x1f{sf.rel}\x1f".encode())
+            h.update(sf.text.encode())
+        return h.hexdigest()
+
+    def _anchor(self, project: Project, registry: Sequence[KernelEntry]):
+        """Registry entries whose anchor def exists in the scanned file
+        set.  Tracing only ever happens for anchored entries, so
+        fixture runs (and path-subset runs) never import jax for
+        kernels outside their scope."""
+        anchored = []
+        for entry in registry:
+            sf = project.file_named(entry.path)
+            if sf is None or sf.tree is None:
+                continue
+            fn = _fn_index(sf).funcs.get(entry.scope)
+            if fn is None:
+                continue
+            contract = parse_contract(sf._at(fn.lineno))
+            anchored.append((entry, sf, fn.lineno, contract))
+        anchored.sort(key=lambda a: (a[1].rel, a[0].scope, a[0].name))
+        return anchored
+
+    def _run_traced(self, project: Project,
+                    registry: Sequence[KernelEntry], custom: bool,
+                    out: List[Finding]) -> None:
+        if not self._trace_enabled():
+            return
+        anchored = self._anchor(project, registry)
+        if not anchored:
+            return
+        cache_path = self._cache_path(project, custom)
+        key = self._cache_key(anchored) if cache_path else None
+        if cache_path and os.path.exists(cache_path):
+            try:
+                with open(cache_path, "r", encoding="utf-8") as f:
+                    data = json.load(f)
+                if (isinstance(data, dict) and data.get("version") == 1
+                        and data.get("key") == key):
+                    for d in data.get("findings", ()):
+                        out.append(Finding(
+                            d["rule"], d["path"], d["line"], d["col"],
+                            d["message"], d.get("scope", "")))
+                    return
+            except (OSError, ValueError, KeyError, TypeError):
+                pass  # unreadable cache = miss
+        fresh: List[Finding] = []
+        for entry, sf, line, contract in anchored:
+            self._audit_entry(entry, sf, line, contract, fresh)
+        out.extend(fresh)
+        if cache_path:
+            payload = {
+                "version": 1,
+                "key": key,
+                "findings": [
+                    {"rule": f.rule, "path": f.path, "line": f.line,
+                     "col": f.col, "message": f.message, "scope": f.scope}
+                    for f in fresh
+                ],
+            }
+            try:
+                with open(cache_path, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, indent=2, sort_keys=True)
+                    f.write("\n")
+            except OSError:
+                pass  # read-only checkout: audit still ran, just uncached
+
+    def _audit_entry(self, entry: KernelEntry, sf: SourceFile, line: int,
+                     contract: Optional[Contract],
+                     out: List[Finding]) -> None:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        for shape in entry.shapes:
+            env = {k: v for k, v in shape.items() if isinstance(v, int)}
+            if "n" in env:
+                env["log2n"] = max(0, env["n"] - 1).bit_length()
+            for knobs in knob_combos(entry.axes):
+                label = combo_label(shape, knobs)
+                try:
+                    fn = entry.build(shape, knobs)
+                    closed = jax.make_jaxpr(fn)(*entry.arg_specs(shape, 2))
+                except Exception as e:  # noqa: BLE001 — a kernel that
+                    # won't abstractly trace is itself a finding, not a
+                    # crashed lint run
+                    self._emit(
+                        out, sf, "jaxpr-shape-pin", line, 0, entry.scope,
+                        f"kernel `{entry.name}` failed to trace at"
+                        f" [{label}]: {type(e).__name__}: {e}")
+                    continue
+                self._rule_host_sync(entry, sf, line, closed, label, out)
+                self._rule_retrace(entry, sf, line, closed, label, out)
+                if contract is not None:
+                    self._rule_shape_pin(
+                        entry, sf, line, contract, closed, env, knobs,
+                        label, out)
+                    self._rule_budget(
+                        entry, sf, line, contract, closed, shape, knobs,
+                        label, fn, out)
+
+    def _rule_host_sync(self, entry, sf, line, closed, label, out) -> None:
+        for prim in host_sync_prims(closed.jaxpr):
+            self._emit(
+                out, sf, "jaxpr-host-sync", line, 0, entry.scope,
+                f"kernel `{entry.name}` contains host round-trip"
+                f" primitive `{prim}` at [{label}] — every dispatch"
+                " synchronizes with the host; hoist the callback out of"
+                " the traced region")
+
+    def _rule_retrace(self, entry, sf, line, closed, label, out) -> None:
+        weak = weak_scalar_consts(closed)
+        if weak:
+            self._emit(
+                out, sf, "jaxpr-retrace", line, 0, entry.scope,
+                f"kernel `{entry.name}` closes over {len(weak)} weak-typed"
+                f" python scalar(s) ({', '.join(weak)}) at [{label}] —"
+                " each new python value silently retraces; capture via"
+                " an explicitly-dtyped array or pass as a traced"
+                " argument")
+
+    def _rule_shape_pin(self, entry, sf, line, contract, closed, env,
+                        knobs, label, out) -> None:
+        if contract.dot_generals is not None:
+            bound = eval_bound(contract.dot_generals, env)
+            if bound is None:
+                self._emit(
+                    out, sf, "jaxpr-shape-pin", line, 0, entry.scope,
+                    f"kernel `{entry.name}`: dot_generals bound"
+                    f" `{contract.dot_generals}` does not evaluate over"
+                    f" {sorted(env)} — fix the annotation")
+            else:
+                dots = count_dot_generals(closed.jaxpr)
+                if dots > bound:
+                    self._emit(
+                        out, sf, "jaxpr-shape-pin", line, 0, entry.scope,
+                        f"kernel `{entry.name}` lowers to {dots}"
+                        f" dot_generals at [{label}], above the declared"
+                        f" pin dot_generals<={contract.dot_generals}"
+                        f" (={bound}) — the MXU recast regressed")
+        if contract.dtypes:
+            expected = None
+            for value in sorted(knobs.values()):
+                if value in contract.dtypes:
+                    expected = contract.dtypes[value]
+                    break
+            if expected is None:
+                expected = contract.dtypes.get(None)
+            if expected is not None:
+                dom = dominant_dtype(closed)
+                if dom is not None and dom != expected:
+                    self._emit(
+                        out, sf, "jaxpr-shape-pin", line, 0, entry.scope,
+                        f"kernel `{entry.name}`'s dominant loop-carry"
+                        f" dtype is {dom} at [{label}], contract declares"
+                        f" {expected} — the lowering changed arithmetic"
+                        " width")
+
+    def _rule_budget(self, entry, sf, line, contract, closed2, shape,
+                     knobs, label, fn, out) -> None:
+        if contract.budget is None or entry.claimed is None:
+            return
+        claimed = entry.claimed(shape, knobs)
+        if not claimed:
+            return
+        import jax
+        closed4 = jax.make_jaxpr(fn)(*entry.arg_specs(shape, 4))
+        p2 = peak_resident(closed2.jaxpr)
+        p4 = peak_resident(closed4.jaxpr)
+        per_row = (p4 - p2) / 2.0
+        ratio = per_row / claimed
+        lo, hi = contract.budget
+        if not (lo <= ratio <= hi):
+            self._emit(
+                out, sf, "jaxpr-budget", line, 0, entry.scope,
+                f"kernel `{entry.name}` measures {per_row:.0f} resident"
+                f" bytes/row at [{label}] = {ratio:.2f}x the claimed"
+                f" per-row pricing ({claimed:.0f} B), outside the"
+                f" declared band {lo}..{hi} — the budget math and the"
+                " lowering disagree; reprice or re-band with a rationale")
+
+
+register(JaxprAudit())

@@ -367,11 +367,13 @@ def _closure_fn(n: int, mode: str = "fixed", impl: str = "uint8"):  # jt: allow[
         flags = jnp.any(diag, axis=-1)
         return flags, jnp.broadcast_to(used, flags.shape)
 
+    has_cycle.closure_mode = mode  # rides the mesh shard_fn cache key
+    has_cycle.closure_impl = impl
     return has_cycle
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _cyclic_fn(n: int, mode: str = "fixed", impl: str = "uint8"):
+def _cyclic_fn(n: int, mode: str = "fixed", impl: str = "uint8"):  # jt: jaxpr(dot_generals<=log2n+2, dtype[uint8]=bfloat16, dtype[packed32]=uint32, dtype[bf16]=bool, budget=0.2..0.6)
     """Engine-facing variant of :func:`_closure_fn`: tuple outputs (the
     execution layer materializes output *tuples* — flags plus the
     per-row rounds-run evidence) and a ``safe_dispatch`` row cap like
@@ -379,7 +381,8 @@ def _cyclic_fn(n: int, mode: str = "fixed", impl: str = "uint8"):
     base = _closure_fn(n, mode, impl)
     fn = jax.jit(lambda adj: base(adj))
     fn.safe_dispatch = cycles_max_dispatch(n, 1, 0, impl=impl)
-    fn.closure_impl = impl  # rides the mesh shard_fn cache key
+    fn.closure_mode = mode  # both knobs ride the mesh shard_fn cache key
+    fn.closure_impl = impl
     return fn
 
 
@@ -394,7 +397,7 @@ def _screen_fn(n: int, masks: Tuple[int, ...],
 
 
 @lru_cache(maxsize=CLOSURE_CACHE_SIZE)
-def _screen_fn_variant(n: int, masks: Tuple[int, ...],
+def _screen_fn_variant(n: int, masks: Tuple[int, ...],  # jt: jaxpr(dot_generals<=2*log2n+3, dtype[uint8]=bfloat16, dtype[packed32]=uint32, dtype[bf16]=bool, budget=0.1..0.35)
                        nonadj: Tuple[Tuple[int, int], ...],
                        packed: bool, mode: str, impl: str = "uint8"):
     """The transactional screen kernel for ``n``-vertex graphs: per
@@ -492,7 +495,8 @@ def _screen_fn_variant(n: int, masks: Tuple[int, ...],
         return m, w, rounds
 
     screen.safe_dispatch = cycles_max_dispatch(n, F, Q, impl=impl)
-    screen.closure_impl = impl  # rides the mesh shard_fn cache key
+    screen.closure_mode = mode  # both knobs ride the mesh shard_fn cache key
+    screen.closure_impl = impl
     return screen
 
 

@@ -834,13 +834,16 @@ def make_dense_fn(spec_name: str, E: int, C: int, V):
 
 
 @lru_cache(maxsize=64)
-def _make_dense_fn_cached(spec_name: str, E: int, C: int, V, union="gather"):  # jt: allow[budget-missing-cap] — capped by the make_dense_fn wrapper (stamps wgl.DEFAULT_MAX_DISPATCH)
+def _make_dense_fn_cached(spec_name: str, E: int, C: int, V, union="gather"):  # jt: allow[budget-missing-cap] — capped by the make_dense_fn wrapper (stamps wgl.DEFAULT_MAX_DISPATCH)  jt: jaxpr(dot_generals<=2*E, dtype=uint32)
     if spec_name == "unordered-queue":
-        return jax.jit(build_dense_queue(E, C, union=union))
-    if spec_name == "multi-register":
-        return jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V,
-                                   union=union))
-    if spec_name == "acquired-permits":
-        return jax.jit(build_dense(spec_name, E, C, 0, permits_shape=V,
-                                   union=union))
-    return jax.jit(build_dense(spec_name, E, C, V, union=union))
+        fn = jax.jit(build_dense_queue(E, C, union=union))
+    elif spec_name == "multi-register":
+        fn = jax.jit(build_dense(spec_name, E, C, 0, mr_shape=V,
+                                 union=union))
+    elif spec_name == "acquired-permits":
+        fn = jax.jit(build_dense(spec_name, E, C, 0, permits_shape=V,
+                                 union=union))
+    else:
+        fn = jax.jit(build_dense(spec_name, E, C, V, union=union))
+    fn.union_mode = union  # rides the mesh shard_fn cache key
+    return fn

@@ -44,7 +44,7 @@ F_PRELEASE = 11   # permit release: a = client id
 V_UNKNOWN = 0
 
 
-def register_step(state, f, a, b):  # jt: traced
+def register_step(state, f, a, b):  # jt: traced jaxpr(dot_generals<=0, dtype=int32)
     """Read/write register.  (oracle: models.Register)"""
     is_read = f == F_READ
     is_write = f == F_WRITE
@@ -54,7 +54,7 @@ def register_step(state, f, a, b):  # jt: traced
     return state2, ok
 
 
-def cas_register_step(state, f, a, b):  # jt: traced
+def cas_register_step(state, f, a, b):  # jt: traced jaxpr(dot_generals<=0, dtype=int32)
     """Read/write/compare-and-set register.  (oracle: models.CASRegister)"""
     is_read = f == F_READ
     is_write = f == F_WRITE
@@ -66,7 +66,7 @@ def cas_register_step(state, f, a, b):  # jt: traced
     return state2, ok
 
 
-def mutex_step(state, f, a, b):  # jt: traced
+def mutex_step(state, f, a, b):  # jt: traced jaxpr(dot_generals<=0, dtype=int32)
     """Lock: state 0 = free, 1 = held.  (oracle: models.Mutex)"""
     is_acq = f == F_ACQUIRE
     is_rel = f == F_RELEASE
@@ -75,7 +75,7 @@ def mutex_step(state, f, a, b):  # jt: traced
     return state2, ok
 
 
-def reentrant_mutex_step(state, f, a, b):  # jt: traced
+def reentrant_mutex_step(state, f, a, b):  # jt: traced jaxpr(dot_generals<=0, dtype=int32)
     """Reentrant owner-aware mutex with hold bound 2 (the hazelcast CP
     probe's reentrant-lock-acquire-count).  State ids: 0 = free,
     2c-1 = client c holds once, 2c = client c holds twice (a = client
@@ -107,7 +107,7 @@ MR_VALUE_BITS = 8
 MR_MAX_VALUE_ID = (1 << MR_VALUE_BITS) - 1
 
 
-def multi_register_step(state, f, a, b):  # jt: traced
+def multi_register_step(state, f, a, b):  # jt: traced jaxpr(dot_generals<=0, dtype=int32)
     """Single-mop multi-register: b = register index, a = value id; the
     int32 state packs MR_REGISTERS byte-wide registers.
     (oracle: models.MultiRegister)"""
@@ -136,7 +136,7 @@ def multi_register_step(state, f, a, b):  # jt: traced
 UQ_MAX_VALUES = 31  # ids 1..31 → bits 0..30, sign bit untouched
 
 
-def unordered_queue_step(state, f, a, b):  # jt: traced
+def unordered_queue_step(state, f, a, b):  # jt: traced jaxpr(dot_generals<=0, dtype=int32)
     """Bag of unique values as a bitset.  (oracle: models.UnorderedQueue
     restricted to multiplicity ≤ 1)"""
     bit = jnp.int32(1) << (a.astype(jnp.int32) - 1)

@@ -473,7 +473,7 @@ def make_check_fn(
 
 
 @lru_cache(maxsize=64)
-def _make_check_fn(spec_name, E, C, F, max_closure, compaction):
+def _make_check_fn(spec_name, E, C, F, max_closure, compaction):  # jt: jaxpr(dot_generals<=0, budget=0.9..1.6)
     fn = jax.jit(build_batched(spec_name, E, C, F, max_closure, compaction))
     cap = frontier_max_dispatch(F, E, C)
     if compaction == "allpairs" and cap:
@@ -483,6 +483,7 @@ def _make_check_fn(spec_name, E, C, F, max_closure, compaction):
         K = F * (C + 1)
         cap = min(cap, ALLPAIRS_ELEM_BUDGET // (K * K))
     fn.safe_dispatch = cap
+    fn.compaction = compaction  # rides the mesh shard_fn cache key
     return fn
 
 

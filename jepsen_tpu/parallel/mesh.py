@@ -132,13 +132,19 @@ def shard_fn(check_fn, mesh: Mesh, n_in: int = 6, n_out: int = 3):  # jt: allow[
     leading dim must be divisible by the mesh size (callers pad with
     neutral rows; see the engine's shard padding).
 
-    The cycle kernels stamp their resolved closure arithmetic on the
-    fn (``fn.closure_impl`` — ``ops.cycles.closure_impl``); it rides
-    the cache key so a knob flip mid-process can never resolve a
-    sharded executable traced for a different impl, even if a caller
-    ever reuses one fn object across impls."""
+    The kernel factories stamp every resolved knob on the fn
+    (``fn.closure_impl``/``fn.closure_mode`` from ``ops.cycles``,
+    ``fn.union_mode`` from ``ops.dense``, ``fn.compaction`` from
+    ``ops.wgl``); all of them ride the cache key — the same fields as
+    the factories' own lru keys — so a knob flip mid-process can never
+    resolve a sharded executable traced for a different lowering, even
+    if a caller ever reuses one fn object across knob states.  The
+    ``jaxpr-cache-key`` lint rule pins this correspondence."""
     key = (_mesh_key(mesh), n_in, n_out,
-           getattr(check_fn, "closure_impl", ""))
+           getattr(check_fn, "closure_impl", ""),
+           getattr(check_fn, "closure_mode", ""),
+           getattr(check_fn, "union_mode", ""),
+           getattr(check_fn, "compaction", ""))
     with _shard_lock:
         cache = getattr(check_fn, "_sharded_variants", None)
         if cache is None:

@@ -1,0 +1,765 @@
+"""jaxpr-audit: the jtlint v3 certification pass (lint/jaxpr_audit.py).
+
+Every ``jaxpr-*`` rule gets at least two positive fixtures (the rule
+demonstrably catches a seeded violation) and one suppressed fixture,
+plus the framework pins: determinism/fingerprint stability, the
+incremental-cache round-trip (hit ≡ miss byte-identical, stale-hash
+invalidation), the trace kill-switch, and the CLI contracts (rule
+globbing, ``--changed``, subset-run baseline merging).
+
+The traced rules run against *toy* registries injected through
+``options["jaxpr_registry"]``: each entry anchors at a fixture file
+written into tmp_path (the contract annotation and suppressions live
+there) while the kernel itself is built in-process — exactly how the
+default registry anchors at ops/cycles.py & co.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+from jepsen_tpu.lint import lint_paths
+from jepsen_tpu.lint.jaxpr_audit import (KernelEntry, RULE_VERSION, Contract,
+                                         eval_bound, parse_contract)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(tmp_path, sources, rules=None, options=None):
+    base = tmp_path
+    for rel, code in sources.items():
+        p = base / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+    opts = {"metric_doc": None, "journal_doc": None, "env_doc": None}
+    opts.update(options or {})
+    return lint_paths([str(base)], rules=rules, options=opts)
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# toy kernels for the traced rules
+# ---------------------------------------------------------------------------
+
+
+def _args_f32(shape, batch):
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as SDS
+    return (SDS((batch, 64), jnp.float32),)
+
+
+def _scan_kernel(shape, knobs):
+    """Scan carrying the (B, 64) float32 input: measured resident
+    slope is exactly 256 bytes/row."""
+    from jax import lax
+
+    def f(x):
+        def step(c, _):
+            return c * 0.5, None
+
+        c, _ = lax.scan(step, x, None, length=4)
+        return c
+
+    return f
+
+
+def _dot_kernel(shape, knobs):
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.einsum("bi,bj->bij", x, x)
+
+    return f
+
+
+def _while_kernel_dtype(shape, knobs):
+    """Carry dtype switches on the toy impl knob."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    as_int = knobs.get("impl") == "int"
+
+    def f(x):
+        c0 = x.astype(jnp.int32) if as_int else x
+
+        def cond(c):
+            return c[0, 0] < 100
+
+        def body(c):
+            return c + 1
+
+        return lax.while_loop(cond, body, c0)
+
+    return f
+
+
+def _debug_print_kernel(shape, knobs):
+    import jax
+
+    def f(x):
+        jax.debug.print("row {x}", x=x[0, 0])
+        return x * 2
+
+    return f
+
+
+def _pure_callback_kernel(shape, knobs):
+    import jax
+    import numpy as np
+
+    def f(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v).astype(np.float32),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1
+
+    return f
+
+
+def _weak_capture_kernel(value):
+    def build(shape, knobs):
+        import jax.numpy as jnp
+
+        c = jnp.asarray(value)  # weak-typed 0-d capture
+
+        def f(x):
+            return x * c
+
+        return f
+
+    return build
+
+
+def toy_entry(name, scope, build, claimed=None, axes=None,
+              path="kern/toy.py"):
+    return KernelEntry(
+        name, path, scope, build, _args_f32,
+        axes=axes, shapes=({"n": 64},), claimed=claimed)
+
+
+def anchor_src(*defs):
+    """A fixture anchor module: one stub def per (name, directive)."""
+    lines = ["def _noop(): ...", ""]
+    for name, directive in defs:
+        lines.append(f"def {name}(x):  {directive}")
+        lines.append("    return x")
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-budget
+# ---------------------------------------------------------------------------
+
+
+def test_budget_pricing_2x_under_fires(tmp_path):
+    """The seeded mispricing: claimed per-row bytes 2x the measured
+    resident slope, against a tight declared band."""
+    entry = toy_entry("t", "kern_a", _scan_kernel,
+                      claimed=lambda s, k: 512.0)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(budget=0.8..1.2)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-budget"]
+    assert "0.50x" in res.findings[0].message
+
+
+def test_budget_pricing_2x_over_fires(tmp_path):
+    entry = toy_entry("t", "kern_a", _scan_kernel,
+                      claimed=lambda s, k: 128.0)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(budget=0.8..1.2)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-budget"]
+    assert "2.00x" in res.findings[0].message
+
+
+def test_budget_correct_pricing_is_clean(tmp_path):
+    entry = toy_entry("t", "kern_a", _scan_kernel,
+                      claimed=lambda s, k: 256.0)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(budget=0.8..1.2)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert res.findings == []
+
+
+def test_budget_suppressed(tmp_path):
+    entry = toy_entry("t", "kern_a", _scan_kernel,
+                      claimed=lambda s, k: 512.0)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a",
+             "# jt: allow[jaxpr-budget] jaxpr(budget=0.8..1.2)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-shape-pin
+# ---------------------------------------------------------------------------
+
+
+def test_shape_pin_dot_count_fires(tmp_path):
+    entry = toy_entry("t", "kern_a", _dot_kernel)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(dot_generals<=0)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-shape-pin"]
+    assert "dot_generals<=0" in res.findings[0].message
+
+
+def test_shape_pin_dot_bound_expression(tmp_path):
+    """Bounds are expressions over the shape env (here n=64, so
+    log2n=6 — 1 dot_general is within log2n-5 but not log2n-6)."""
+    entry = toy_entry("t", "kern_a", _dot_kernel)
+    ok = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(dot_generals<=log2n-5)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert ok.findings == []
+    bad = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(dot_generals<=log2n-6)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(bad) == ["jaxpr-shape-pin"]
+
+
+def test_shape_pin_dtype_conditional_fires_per_combo(tmp_path):
+    """dtype[KNOBVALUE]=DT checks only the matching combination."""
+    entry = toy_entry("t", "kern_a", _while_kernel_dtype,
+                      axes={"impl": ("float", "int")})
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a",
+             "# jt: jaxpr(dtype[float]=float32, dtype[int]=uint8)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-shape-pin"]
+    assert "impl=int" in res.findings[0].message
+    assert "int32" in res.findings[0].message
+
+
+def test_shape_pin_suppressed(tmp_path):
+    entry = toy_entry("t", "kern_a", _dot_kernel)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a",
+             "# jt: allow[jaxpr-shape-pin] jaxpr(dot_generals<=0)")),
+    }, options={"jaxpr_registry": [entry]})
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-host-sync
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_debug_print_fires(tmp_path):
+    entry = toy_entry("t", "kern_a", _debug_print_kernel)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(("kern_a", "# a plain comment")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-host-sync"]
+    assert "callback" in res.findings[0].message
+
+
+def test_host_sync_pure_callback_fires(tmp_path):
+    entry = toy_entry("t", "kern_a", _pure_callback_kernel)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(("kern_a", "# a plain comment")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-host-sync"]
+
+
+def test_host_sync_suppressed(tmp_path):
+    entry = toy_entry("t", "kern_a", _debug_print_kernel)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: allow[jaxpr-host-sync] — debug build")),
+    }, options={"jaxpr_registry": [entry]})
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-retrace
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_weak_float_capture_fires(tmp_path):
+    entry = toy_entry("t", "kern_a", _weak_capture_kernel(3.0))
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(("kern_a", "# a plain comment")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-retrace"]
+    assert "weak-typed" in res.findings[0].message
+
+
+def test_retrace_weak_int_capture_fires(tmp_path):
+    entry = toy_entry("t", "kern_a", _weak_capture_kernel(7))
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(("kern_a", "# a plain comment")),
+    }, options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-retrace"]
+
+
+def test_retrace_suppressed(tmp_path):
+    entry = toy_entry("t", "kern_a", _weak_capture_kernel(3.0))
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: allow[jaxpr-retrace] — frozen constant")),
+    }, options={"jaxpr_registry": [entry]})
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-cache-key (pure AST — no tracing, no jax)
+# ---------------------------------------------------------------------------
+
+
+RESOLVER_IN_CACHED = """
+    from functools import lru_cache
+    import jax
+
+    def my_mode():
+        return resolve_knob("JEPSEN_TPU_X", str, lambda c: c.x(), "a")
+
+    @lru_cache(maxsize=8)
+    def factory(n):
+        m = my_mode()
+        return jax.jit(lambda x: x * (m == "a"))
+"""
+
+
+def test_cache_key_resolver_inside_cached_factory(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": RESOLVER_IN_CACHED},
+                   rules=["jaxpr-cache-key"])
+    assert rules_of(res) == ["jaxpr-cache-key"]
+    assert "bypasses the cache key" in res.findings[0].message
+
+
+RESOLVER_NOT_PASSED = """
+    from functools import lru_cache
+    import jax
+
+    def my_mode():
+        return resolve_knob("JEPSEN_TPU_X", str, lambda c: c.x(), "a")
+
+    @lru_cache(maxsize=8)
+    def _cached(n):
+        return jax.jit(lambda x: x)
+
+    def wrapper(n):
+        m = my_mode()
+        print(m)
+        return _cached(n)
+"""
+
+
+def test_cache_key_resolved_knob_not_passed(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": RESOLVER_NOT_PASSED},
+                   rules=["jaxpr-cache-key"])
+    assert rules_of(res) == ["jaxpr-cache-key"]
+    assert "not passed" in res.findings[0].message
+
+
+KNOB_PARAM_UNSTAMPED = """
+    from functools import lru_cache
+    import jax
+
+    @lru_cache(maxsize=8)
+    def factory(n, impl):
+        fn = jax.jit(lambda x: x)
+        fn.safe_dispatch = 1024
+        return fn
+"""
+
+
+def test_cache_key_knob_param_not_stamped(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": KNOB_PARAM_UNSTAMPED},
+                   rules=["jaxpr-cache-key"])
+    assert rules_of(res) == ["jaxpr-cache-key"]
+    assert "closure_impl" in res.findings[0].message
+
+
+SHARD_KEY_NARROW = """
+    from functools import lru_cache
+    import jax
+
+    @lru_cache(maxsize=8)
+    def factory(n, union):
+        fn = jax.jit(lambda x: x)
+        fn.union_mode = union
+        return fn
+
+    def shard_fn(check_fn, mesh):
+        key = (mesh, getattr(check_fn, "closure_impl", ""))
+        return key
+"""
+
+
+def test_cache_key_shard_key_narrower_than_lru_key(tmp_path):
+    """The hardening target: a shard_fn call site keying on fewer
+    fields than the kernel factories stamp."""
+    res = run_lint(tmp_path, {"ops/k.py": SHARD_KEY_NARROW},
+                   rules=["jaxpr-cache-key"])
+    assert rules_of(res) == ["jaxpr-cache-key"]
+    assert "union_mode" in res.findings[0].message
+    assert "fewer fields" in res.findings[0].message
+
+
+SANCTIONED = """
+    from functools import lru_cache
+    import jax
+
+    def my_mode():
+        return resolve_knob("JEPSEN_TPU_X", str, lambda c: c.x(), "a")
+
+    @lru_cache(maxsize=8)
+    def _cached(n, mode):
+        fn = jax.jit(lambda x: x)
+        fn.closure_mode = mode
+        return fn
+
+    def wrapper(n):
+        mode = my_mode()
+        return _cached(n, mode)
+
+    def wrapper_direct(n):
+        return _cached(n, my_mode())
+
+    def shard_fn(check_fn, mesh):
+        return (mesh, getattr(check_fn, "closure_mode", ""))
+"""
+
+
+def test_cache_key_sanctioned_pattern_is_clean(tmp_path):
+    """Resolve-in-the-caller, pass-as-key-parameter, stamp, read back
+    in shard_fn: the pattern ops/cycles.py & co. follow."""
+    res = run_lint(tmp_path, {"ops/k.py": SANCTIONED},
+                   rules=["jaxpr-cache-key"])
+    assert res.findings == []
+
+
+SUPPRESSED_CACHE_KEY = """
+    from functools import lru_cache
+    import jax
+
+    def my_mode():
+        return resolve_knob("JEPSEN_TPU_X", str, lambda c: c.x(), "a")
+
+    @lru_cache(maxsize=8)
+    def factory(n):
+        m = my_mode()  # jt: allow[jaxpr-cache-key] — value only logged
+        return jax.jit(lambda x: x)
+"""
+
+
+def test_cache_key_suppressed(tmp_path):
+    res = run_lint(tmp_path, {"ops/k.py": SUPPRESSED_CACHE_KEY},
+                   rules=["jaxpr-cache-key"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-coverage
+# ---------------------------------------------------------------------------
+
+
+def test_coverage_unregistered_traced_def_fires(tmp_path):
+    entry = toy_entry("t", "known", _scan_kernel,
+                      path="ops/step_kernels.py")
+    res = run_lint(tmp_path, {
+        "ops/step_kernels.py": (
+            "def known(state, f, a, b):  # jt: traced\n"
+            "    return state\n\n"
+            "def rogue(state, f, a, b):  # jt: traced\n"
+            "    return state\n"),
+    }, rules=["jaxpr-coverage"], options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-coverage"]
+    assert "`rogue`" in res.findings[0].message
+
+
+def test_coverage_default_registry_module(tmp_path):
+    """A traced def in a file shadowing a default-registry module path
+    is judged against the default registry."""
+    res = run_lint(tmp_path, {
+        "ops/cycles.py": (
+            "def new_screen(rel):  # jt: traced\n"
+            "    return rel\n"),
+    }, rules=["jaxpr-coverage"])
+    assert rules_of(res) == ["jaxpr-coverage"]
+    assert "`new_screen`" in res.findings[0].message
+
+
+def test_coverage_suppressed(tmp_path):
+    res = run_lint(tmp_path, {
+        "ops/cycles.py": (
+            "def new_screen(rel):  # jt: traced allow[jaxpr-coverage]\n"
+            "    return rel\n"),
+    }, rules=["jaxpr-coverage"])
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# contract grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_contract_clauses():
+    c = parse_contract([
+        "jaxpr(dot_generals<=2*log2n+3, dtype[packed32]=uint32, "
+        "dtype=bfloat16, budget=0.2..0.6)"])
+    assert c.dot_generals == "2*log2n+3"
+    assert c.dtypes == {"packed32": "uint32", None: "bfloat16"}
+    assert c.budget == (0.2, 0.6)
+
+
+def test_parse_contract_absent_and_unknown_clause():
+    assert parse_contract(["allow[trace-sync]"]) is None
+    c = parse_contract(["jaxpr(frobnicate=1, budget=1..2)"])
+    assert isinstance(c, Contract) and c.budget == (1.0, 2.0)
+
+
+def test_eval_bound():
+    env = {"n": 64, "log2n": 6, "E": 16}
+    assert eval_bound("2*log2n+3", env) == 15
+    assert eval_bound("log2n-5", env) == 1
+    assert eval_bound("2*E", env) == 32
+    assert eval_bound("0", env) == 0
+    assert eval_bound("q+1", env) is None          # unknown name
+    assert eval_bound("__import__('os')", env) is None  # only +-*
+
+
+# ---------------------------------------------------------------------------
+# determinism + incremental cache
+# ---------------------------------------------------------------------------
+
+
+def _dump(result):
+    return json.dumps([f.to_dict() for f in result.findings],
+                      sort_keys=True)
+
+
+def test_traced_findings_deterministic(tmp_path):
+    entry = toy_entry("t", "kern_a", _dot_kernel,
+                      axes={"impl": ("x", "y")})
+    sources = {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(dot_generals<=0)")),
+    }
+    a = run_lint(tmp_path, sources, options={"jaxpr_registry": [entry]})
+    b = run_lint(tmp_path, sources, options={"jaxpr_registry": [entry]})
+    assert _dump(a) == _dump(b)
+    assert len(a.findings) == 2  # one per knob combination
+    assert ([f.fingerprint() for f in a.findings]
+            == [f.fingerprint() for f in b.findings])
+
+
+def test_cache_roundtrip_hit_equals_miss(tmp_path):
+    cache = tmp_path / "jaxpr_cache.json"
+    entry = toy_entry("t", "kern_a", _scan_kernel,
+                      claimed=lambda s, k: 512.0)
+    sources = {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(budget=0.8..1.2)")),
+    }
+    opts = {"jaxpr_registry": [entry], "jaxpr_cache": str(cache)}
+    miss = run_lint(tmp_path, sources, options=opts)
+    assert cache.exists()
+    key1 = json.loads(cache.read_text())["key"]
+    hit = run_lint(tmp_path, sources, options=opts)
+    assert _dump(miss) == _dump(hit)
+    assert rules_of(hit) == ["jaxpr-budget"]
+    assert json.loads(cache.read_text())["key"] == key1
+
+
+def test_cache_stale_hash_invalidation(tmp_path):
+    cache = tmp_path / "jaxpr_cache.json"
+    entry = toy_entry("t", "kern_a", _scan_kernel,
+                      claimed=lambda s, k: 512.0)
+    sources = {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(budget=0.8..1.2)")),
+    }
+    opts = {"jaxpr_registry": [entry], "jaxpr_cache": str(cache)}
+    run_lint(tmp_path, sources, options=opts)
+    key1 = json.loads(cache.read_text())["key"]
+    # editing the anchor file invalidates the content hash; the edit
+    # here suppresses the finding, and a stale cache would miss that
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a",
+             "# jt: allow[jaxpr-budget] jaxpr(budget=0.8..1.2)")),
+    }, options=opts)
+    key2 = json.loads(cache.read_text())["key"]
+    assert key2 != key1
+    assert res.findings == []
+
+
+def test_trace_kill_switch(tmp_path, monkeypatch):
+    """JEPSEN_TPU_LINT_JAXPR=0 disables the traced rules; the AST
+    rules still run."""
+    monkeypatch.setenv("JEPSEN_TPU_LINT_JAXPR", "0")
+    entry = toy_entry("t", "kern_a", _dot_kernel)
+    res = run_lint(tmp_path, {
+        "kern/toy.py": anchor_src(
+            ("kern_a", "# jt: jaxpr(dot_generals<=0)")),
+        "ops/k.py": RESOLVER_IN_CACHED,
+    }, rules=["jaxpr-cache-key", "jaxpr-coverage", "jaxpr-budget",
+              "jaxpr-shape-pin", "jaxpr-host-sync", "jaxpr-retrace"],
+       options={"jaxpr_registry": [entry]})
+    assert rules_of(res) == ["jaxpr-cache-key"]
+
+
+def test_rule_version_in_cache_key(tmp_path):
+    """The cache key binds the rule version (and this module's own
+    source), so a lint upgrade re-traces."""
+    assert RULE_VERSION  # bumping it is the documented invalidation
+    cache = tmp_path / "jaxpr_cache.json"
+    entry = toy_entry("t", "kern_a", _scan_kernel)
+    run_lint(tmp_path, {"kern/toy.py": anchor_src(("kern_a", "# x"))},
+             options={"jaxpr_registry": [entry],
+                      "jaxpr_cache": str(cache)})
+    data = json.loads(cache.read_text())
+    assert data["version"] == 1 and len(data["key"]) == 40
+
+
+# ---------------------------------------------------------------------------
+# CLI: rule globbing, --changed, subset-run baseline merge
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args, cwd=None, env_extra=None):
+    return subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.lint", *args],
+        capture_output=True, text=True, cwd=cwd or REPO,
+        env={**os.environ, "PYTHONPATH": REPO, **(env_extra or {})},
+    )
+
+
+def test_cli_rule_glob_expansion(tmp_path):
+    (tmp_path / "k.py").write_text(textwrap.dedent(RESOLVER_IN_CACHED))
+    proc = _cli(str(tmp_path), "--no-baseline", "--rules", "jaxpr-*")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "jaxpr-cache-key" in proc.stdout
+    bad = _cli(str(tmp_path), "--no-baseline", "--rules", "jaxpr-zzz*")
+    assert bad.returncode == 2
+    assert "unknown rule" in bad.stderr
+
+
+def test_cli_jaxpr_subset_merges_into_baseline(tmp_path):
+    """--rules jaxpr-* subset runs merge with the committed baseline
+    without clobbering other rules' entries (the PR-5 scoping
+    contract): a full-run baseline stays green under a subset run and
+    reports no stale entries for out-of-scope rules."""
+    (tmp_path / "k.py").write_text(textwrap.dedent(RESOLVER_IN_CACHED))
+    bl = tmp_path / "bl.json"
+    full = _cli(str(tmp_path), "--baseline", str(bl), "--write-baseline")
+    assert full.returncode == 0, full.stdout + full.stderr
+    entries = json.loads(bl.read_text())["findings"]
+    # the fixture trips a contracts-pass rule too (unregistered knob)
+    assert {e["rule"] for e in entries} >= {"jaxpr-cache-key",
+                                           "seam-env-read"}
+    subset = _cli(str(tmp_path), "--baseline", str(bl),
+                  "--rules", "jaxpr-*")
+    assert subset.returncode == 0, subset.stdout + subset.stderr
+    assert "stale" not in subset.stderr
+    # the baseline file is untouched by a plain subset run
+    assert json.loads(bl.read_text())["findings"] == entries
+
+
+def test_cli_sarif_carries_jaxpr_rules(tmp_path):
+    (tmp_path / "k.py").write_text(textwrap.dedent(RESOLVER_IN_CACHED))
+    out = tmp_path / "out.sarif"
+    proc = _cli(str(tmp_path), "--no-baseline", "--sarif", str(out))
+    assert proc.returncode == 1
+    sarif = json.loads(out.read_text())
+    run = sarif["runs"][0]
+    assert {"id": "jaxpr-cache-key"} in run["tool"]["driver"]["rules"]
+    assert any(r["ruleId"] == "jaxpr-cache-key" for r in run["results"])
+
+
+def test_cli_changed_limits_paths(tmp_path):
+    """--changed lints only files that differ from HEAD (plus
+    untracked); with nothing changed it exits 0 without scanning."""
+    git = dict(cwd=str(tmp_path))
+    for cmd in (["git", "init", "-q"],
+                ["git", "config", "user.email", "t@t"],
+                ["git", "config", "user.name", "t"]):
+        subprocess.run(cmd, check=True, capture_output=True, **git)
+    (tmp_path / "clean.py").write_text(
+        textwrap.dedent(RESOLVER_IN_CACHED))  # committed: not re-linted
+    subprocess.run(["git", "add", "-A"], check=True,
+                   capture_output=True, **git)
+    subprocess.run(["git", "commit", "-qm", "seed"], check=True,
+                   capture_output=True, **git)
+    all_clean = _cli(".", "--changed", "--no-baseline", cwd=str(tmp_path))
+    assert all_clean.returncode == 0, all_clean.stdout + all_clean.stderr
+    assert "no changed files" in all_clean.stdout
+    (tmp_path / "dirty.py").write_text(
+        textwrap.dedent(RESOLVER_IN_CACHED))
+    changed = _cli(".", "--changed", "--no-baseline", cwd=str(tmp_path))
+    assert changed.returncode == 1
+    assert "dirty.py" in changed.stdout
+    assert "clean.py" not in changed.stdout
+
+
+def test_changed_subset_skips_whole_tree_env_check(tmp_path):
+    """A --changed subset that includes the env registry must not fire
+    the registered-but-never-read check — the readers are simply out
+    of the scanned set.  The subset_scan option is the wiring."""
+    sources = {"m.py": """
+        import os
+
+        def a():
+            return os.environ.get("JEPSEN_TPU_A")
+    """}
+    doc = tmp_path / "conf.md"
+    doc.write_text("| `JEPSEN_TPU_A` | | `JEPSEN_TPU_B` |\n")
+    base = {"env_registry": ["JEPSEN_TPU_A", "JEPSEN_TPU_B"],
+            "env_doc": str(doc)}
+    full = run_lint(tmp_path, sources, rules=["seam-env-doc"],
+                    options=base)
+    assert [f.message for f in full.findings
+            if "never read" in f.message]  # JEPSEN_TPU_B is unread
+    subset = run_lint(tmp_path, sources, rules=["seam-env-doc"],
+                      options={**base, "subset_scan": True})
+    assert not [f.message for f in subset.findings
+                if "never read" in f.message]
+
+
+# ---------------------------------------------------------------------------
+# the default registry against the real tree
+# ---------------------------------------------------------------------------
+
+
+def test_default_registry_anchors_every_entry():
+    """Every default-registry entry anchors at a real def with the
+    declared scope — a rename breaks the audit loudly, not silently."""
+    from jepsen_tpu.lint.core import collect_files, Project
+    from jepsen_tpu.lint.jaxpr_audit import JaxprAudit, default_registry
+
+    files = collect_files([os.path.join(REPO, "jepsen_tpu")])
+    project = Project(files, {})
+    registry = default_registry()
+    anchored = JaxprAudit()._anchor(project, registry)
+    assert len(anchored) == len(registry)
+    # the knob cross-product covers closure_impl x closure_mode x union
+    axes = {k for e in registry for k in e.axes}
+    assert axes == {"mode", "impl", "union", "compaction"}
+    # every # jt: traced def in the registry modules is registered
+    findings = []
+    JaxprAudit()._check_coverage(project, registry, findings)
+    assert findings == []
